@@ -1,0 +1,41 @@
+"""citus_tpu — a TPU-native distributed analytical SQL framework.
+
+A ground-up re-design of the capabilities of the reference system
+(citusdata/citus, a distributed-PostgreSQL extension) for the TPU/JAX
+execution model:
+
+- hash-sharded distributed tables and replicated reference tables over a
+  ``jax.sharding.Mesh`` (reference: pg_dist_partition/pg_dist_shard,
+  src/backend/distributed/metadata/)
+- a columnar storage engine with stripe/chunk-group layout, per-chunk
+  min/max skip lists and zstd/lz4 compression
+  (reference: src/backend/columnar/)
+- a layered SQL planner that splits aggregates into per-shard partial and
+  coordinator combine halves
+  (reference: src/backend/distributed/planner/multi_logical_optimizer.c)
+- an executor that lowers the per-shard scan→filter→partial-aggregate hot
+  path to jit-compiled XLA kernels and the combine step to ``psum`` over
+  ICI, with repartition shuffles as ``all_to_all``
+  (reference: src/backend/distributed/executor/adaptive_executor.c)
+
+The public API lives on :class:`citus_tpu.cluster.Cluster`.
+"""
+
+import jax as _jax
+
+# exact aggregates (DECIMAL as scaled int64) require 64-bit lanes; this
+# must happen before any array is created
+_jax.config.update("jax_enable_x64", True)
+
+from citus_tpu.version import __version__
+from citus_tpu.config import Settings, current_settings
+from citus_tpu.cluster import Cluster
+from citus_tpu import types
+
+__all__ = [
+    "__version__",
+    "Settings",
+    "current_settings",
+    "Cluster",
+    "types",
+]

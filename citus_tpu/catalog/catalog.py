@@ -1,0 +1,335 @@
+"""The catalog store.
+
+Equivalents, per reference catalog:
+
+- TableMeta      <- pg_dist_partition (+ pg_attribute via Schema)
+- ShardMeta      <- pg_dist_shard + pg_dist_placement
+- colocation_id  <- pg_dist_colocation
+- NodeMeta       <- pg_dist_node (a "node" here is a logical executor slot
+                    that maps onto a mesh device/slice at execution time)
+- text dictionaries: table-global per-column string dictionaries assigned
+  at ingest so every shard shares one id space (this is what makes
+  cross-shard GROUP BY combinable with a single psum — the TPU analog of
+  the reference's colocated-aggregation guarantees)
+
+Persistence: a single JSON document written atomically (temp + rename);
+dictionaries live in side files to keep the main document small.  All
+mutations go through commit(), the round-1 stand-in for the metadata
+2PC layer (reference: transaction/transaction_management.c) that arrives
+with multi-host support.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from citus_tpu.errors import CatalogError
+from citus_tpu.schema import Schema
+from citus_tpu.catalog.hashing import shard_hash_ranges
+
+
+class DistributionMethod:
+    HASH = "hash"            # hash-distributed over shards
+    REFERENCE = "reference"  # one shard replicated everywhere
+    LOCAL = "local"          # coordinator-local single shard
+
+
+@dataclass
+class ShardMeta:
+    shard_id: int
+    index: int                     # position within the table's shard list
+    hash_min: Optional[int] = None
+    hash_max: Optional[int] = None
+    placements: list[int] = field(default_factory=list)  # node ids
+
+    def to_json(self):
+        return {"shard_id": self.shard_id, "index": self.index,
+                "hash_min": self.hash_min, "hash_max": self.hash_max,
+                "placements": self.placements}
+
+    @staticmethod
+    def from_json(d):
+        return ShardMeta(d["shard_id"], d["index"], d["hash_min"], d["hash_max"],
+                         list(d["placements"]))
+
+
+@dataclass
+class TableMeta:
+    name: str
+    schema: Schema
+    method: str = DistributionMethod.LOCAL
+    dist_column: Optional[str] = None
+    colocation_id: int = 0
+    shards: list[ShardMeta] = field(default_factory=list)
+    # columnar options (per-table override of ColumnarSettings)
+    chunk_row_limit: int = 8192
+    stripe_row_limit: int = 131072
+    compression: str = "zstd"
+    compression_level: int = 3
+    # bumped on any DDL/ingest; plan caches key on it (the analog of the
+    # reference's syscache-invalidation-driven plan invalidation)
+    version: int = 0
+
+    @property
+    def shard_count(self) -> int:
+        return len(self.shards)
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.method == DistributionMethod.HASH
+
+    @property
+    def is_reference(self) -> bool:
+        return self.method == DistributionMethod.REFERENCE
+
+    def to_json(self):
+        return {
+            "name": self.name, "schema": self.schema.to_json(),
+            "method": self.method, "dist_column": self.dist_column,
+            "colocation_id": self.colocation_id,
+            "shards": [s.to_json() for s in self.shards],
+            "chunk_row_limit": self.chunk_row_limit,
+            "stripe_row_limit": self.stripe_row_limit,
+            "compression": self.compression,
+            "compression_level": self.compression_level,
+            "version": self.version,
+        }
+
+    @staticmethod
+    def from_json(d):
+        return TableMeta(
+            name=d["name"], schema=Schema.from_json(d["schema"]),
+            method=d["method"], dist_column=d["dist_column"],
+            colocation_id=d["colocation_id"],
+            shards=[ShardMeta.from_json(s) for s in d["shards"]],
+            chunk_row_limit=d["chunk_row_limit"],
+            stripe_row_limit=d["stripe_row_limit"],
+            compression=d["compression"],
+            compression_level=d["compression_level"],
+            version=d.get("version", 0),
+        )
+
+
+@dataclass
+class NodeMeta:
+    node_id: int
+    is_active: bool = True
+
+    def to_json(self):
+        return {"node_id": self.node_id, "is_active": self.is_active}
+
+    @staticmethod
+    def from_json(d):
+        return NodeMeta(d["node_id"], d["is_active"])
+
+
+class Catalog:
+    FILE = "catalog.json"
+
+    def __init__(self, data_dir: str):
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self._lock = threading.RLock()
+        self.tables: dict[str, TableMeta] = {}
+        self.nodes: dict[int, NodeMeta] = {}
+        self._next_shard_id = 102008  # match the reference's familiar id space
+        self._next_colocation_id = 1
+        # bumped on every DDL statement; plan caches key on it so dropped/
+        # recreated relations can never serve stale plans
+        self.ddl_epoch = 0
+        self._dicts: dict[tuple[str, str], list[str]] = {}
+        self._dict_index: dict[tuple[str, str], dict[str, int]] = {}
+        self._load()
+
+    # ---- persistence --------------------------------------------------
+    def _path(self) -> str:
+        return os.path.join(self.data_dir, self.FILE)
+
+    def _load(self) -> None:
+        p = self._path()
+        if not os.path.exists(p):
+            return
+        with open(p) as fh:
+            d = json.load(fh)
+        self.tables = {t["name"]: TableMeta.from_json(t) for t in d["tables"]}
+        self.nodes = {n["node_id"]: NodeMeta.from_json(n) for n in d["nodes"]}
+        self._next_shard_id = d["next_shard_id"]
+        self._next_colocation_id = d["next_colocation_id"]
+
+    def commit(self) -> None:
+        """Atomically persist catalog state (round-1 metadata transaction)."""
+        with self._lock:
+            d = {
+                "tables": [t.to_json() for t in self.tables.values()],
+                "nodes": [n.to_json() for n in self.nodes.values()],
+                "next_shard_id": self._next_shard_id,
+                "next_colocation_id": self._next_colocation_id,
+            }
+            tmp = self._path() + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(d, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self._path())
+            for (tbl, col), words in self._dicts.items():
+                dp = self._dict_path(tbl, col)
+                tmp = dp + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(words, fh)
+                os.replace(tmp, dp)
+
+    # ---- tables -------------------------------------------------------
+    def table(self, name: str) -> TableMeta:
+        t = self.tables.get(name)
+        if t is None:
+            raise CatalogError(f'relation "{name}" does not exist')
+        return t
+
+    def has_table(self, name: str) -> bool:
+        return name in self.tables
+
+    def create_table(self, name: str, schema: Schema, **columnar_opts) -> TableMeta:
+        with self._lock:
+            if name in self.tables:
+                raise CatalogError(f'relation "{name}" already exists')
+            t = TableMeta(name=name, schema=schema, **columnar_opts)
+            # every table starts LOCAL with a single shard on node 0
+            t.shards = [ShardMeta(self._alloc_shard_id(), 0, placements=[0])]
+            self.tables[name] = t
+            self.ddl_epoch += 1
+            return t
+
+    def drop_table(self, name: str) -> None:
+        with self._lock:
+            import shutil
+            t = self.table(name)
+            del self.tables[name]
+            self.ddl_epoch += 1
+            for key in [k for k in self._dicts if k[0] == name]:
+                del self._dicts[key]
+                self._dict_index.pop(key, None)
+            # remove on-disk shard data and dictionary side files so a
+            # recreated relation starts clean (reference: DROP TABLE drops
+            # shards via citus_drop_all_shards, operations/delete_protocol.c)
+            data_root = os.path.join(self.data_dir, "data", name)
+            if os.path.isdir(data_root):
+                shutil.rmtree(data_root, ignore_errors=True)
+            for col in t.schema.names:
+                dp = self._dict_path(name, col)
+                if os.path.exists(dp):
+                    os.remove(dp)
+
+    def distribute_table(self, name: str, dist_column: str, shard_count: int,
+                         node_ids: list[int], colocate_with: Optional[str] = None) -> TableMeta:
+        """create_distributed_table analog (reference:
+        src/backend/distributed/commands/create_distributed_table.c).
+        Caller is responsible for moving any existing data."""
+        with self._lock:
+            t = self.table(name)
+            col = t.schema.column(dist_column)
+            if col.type.kind in ("float32", "float64"):
+                raise CatalogError("cannot distribute on a floating-point column")
+            if colocate_with:
+                other = self.table(colocate_with)
+                if other.shard_count != shard_count:
+                    raise CatalogError("colocation requires equal shard counts")
+                colocation_id = other.colocation_id
+            else:
+                colocation_id = self._next_colocation_id
+                self._next_colocation_id += 1
+            self.ddl_epoch += 1
+            ranges = shard_hash_ranges(shard_count)
+            shards = []
+            for i, (lo, hi) in enumerate(ranges):
+                nid = node_ids[i % len(node_ids)]
+                shards.append(ShardMeta(self._alloc_shard_id(), i, lo, hi, [nid]))
+            t.method = DistributionMethod.HASH
+            t.dist_column = dist_column
+            t.colocation_id = colocation_id
+            t.shards = shards
+            t.version += 1
+            return t
+
+    def make_reference_table(self, name: str, node_ids: list[int]) -> TableMeta:
+        with self._lock:
+            t = self.table(name)
+            t.method = DistributionMethod.REFERENCE
+            t.dist_column = None
+            t.colocation_id = 0
+            t.shards = [ShardMeta(self._alloc_shard_id(), 0, placements=list(node_ids))]
+            t.version += 1
+            return t
+
+    def _alloc_shard_id(self) -> int:
+        sid = self._next_shard_id
+        self._next_shard_id += 1
+        return sid
+
+    # ---- nodes --------------------------------------------------------
+    def ensure_nodes(self, count: int) -> list[int]:
+        with self._lock:
+            for nid in range(count):
+                if nid not in self.nodes:
+                    self.nodes[nid] = NodeMeta(nid)
+            return sorted(self.nodes)
+
+    def active_node_ids(self) -> list[int]:
+        return sorted(n.node_id for n in self.nodes.values() if n.is_active)
+
+    # ---- shard data directories --------------------------------------
+    def shard_dir(self, table: str, shard_id: int, placement_node: int = 0) -> str:
+        return os.path.join(self.data_dir, "data", table,
+                            f"shard_{shard_id}", f"placement_{placement_node}")
+
+    # ---- text dictionaries --------------------------------------------
+    def _dict_path(self, table: str, column: str) -> str:
+        return os.path.join(self.data_dir, f"dict__{table}__{column}.json")
+
+    def _ensure_dict(self, table: str, column: str) -> None:
+        key = (table, column)
+        if key in self._dicts:
+            return
+        p = self._dict_path(table, column)
+        words = []
+        if os.path.exists(p):
+            with open(p) as fh:
+                words = json.load(fh)
+        self._dicts[key] = words
+        self._dict_index[key] = {w: i for i, w in enumerate(words)}
+
+    def encode_strings(self, table: str, column: str, values) -> "list[int]":
+        """Map strings -> table-global dictionary ids, growing the
+        dictionary for unseen strings (ingest path, coordinator-only)."""
+        with self._lock:
+            key = (table, column)
+            self._ensure_dict(table, column)
+            words, index = self._dicts[key], self._dict_index[key]
+            out = []
+            for v in values:
+                if v is None:
+                    out.append(0)
+                    continue
+                i = index.get(v)
+                if i is None:
+                    i = len(words)
+                    words.append(v)
+                    index[v] = i
+                out.append(i)
+            return out
+
+    def lookup_string_id(self, table: str, column: str, value: str) -> Optional[int]:
+        self._ensure_dict(table, column)
+        return self._dict_index[(table, column)].get(value)
+
+    def decode_strings(self, table: str, column: str, ids) -> list:
+        self._ensure_dict(table, column)
+        words = self._dicts[(table, column)]
+        return [words[i] if 0 <= i < len(words) else None for i in ids]
+
+    def dictionary(self, table: str, column: str) -> list[str]:
+        self._ensure_dict(table, column)
+        return self._dicts[(table, column)]

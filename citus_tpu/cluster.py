@@ -1,0 +1,234 @@
+"""Cluster: the public entry point.
+
+One Cluster = one coordinator over a data directory + a logical node set
+that maps onto the JAX device mesh at execution time.  SQL goes through
+``execute``; the control-plane operations the reference exposes as UDFs
+(create_distributed_table, create_reference_table, ...) are available
+both as Python methods and through their SQL spellings
+(``SELECT create_distributed_table('t','col')``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from citus_tpu.catalog import Catalog, DistributionMethod
+from citus_tpu.config import Settings, current_settings
+from citus_tpu.errors import (
+    AnalysisError, CatalogError, UnsupportedFeatureError,
+)
+from citus_tpu.executor import Result, execute_select
+from citus_tpu.ingest import TableIngestor, encode_columns, rows_to_columns
+from citus_tpu.planner import ast as A
+from citus_tpu.planner import parse_sql
+from citus_tpu.planner.bind import bind_select
+from citus_tpu.schema import Column, Schema
+from citus_tpu.types import type_from_sql
+
+
+class Cluster:
+    def __init__(self, data_dir: str, *, n_nodes: Optional[int] = None,
+                 settings: Optional[Settings] = None):
+        self.settings = settings or current_settings()
+        self.catalog = Catalog(data_dir)
+        if n_nodes is None:
+            n_nodes = max(len(jax.devices()), 1)
+        self.catalog.ensure_nodes(n_nodes)
+        self.catalog.commit()
+        # plan cache keyed by SQL text (reference analog: prepared-statement
+        # plan caching + local_plan_cache.c); invalidated by table version
+        self._plan_cache: dict[str, tuple] = {}
+
+    # ------------------------------------------------------------- DDL
+    def create_table(self, name: str, schema: Schema, *, if_not_exists: bool = False,
+                     **columnar_opts) -> None:
+        if if_not_exists and self.catalog.has_table(name):
+            return
+        col = self.settings.columnar
+        opts = {
+            "chunk_row_limit": int(columnar_opts.get("chunk_group_row_limit", col.chunk_group_row_limit)),
+            "stripe_row_limit": int(columnar_opts.get("stripe_row_limit", col.stripe_row_limit)),
+            "compression": columnar_opts.get("compression", col.compression),
+            "compression_level": int(columnar_opts.get("compression_level", col.compression_level)),
+        }
+        self.catalog.create_table(name, schema, **opts)
+        self.catalog.commit()
+
+    def drop_table(self, name: str, *, if_exists: bool = False) -> None:
+        if if_exists and not self.catalog.has_table(name):
+            return
+        self.catalog.drop_table(name)
+        self.catalog.commit()
+
+    def create_distributed_table(self, name: str, dist_column: str,
+                                 shard_count: Optional[int] = None,
+                                 colocate_with: Optional[str] = None) -> None:
+        """reference: create_distributed_table UDF
+        (src/backend/distributed/commands/create_distributed_table.c)."""
+        t = self.catalog.table(name)
+        from citus_tpu.catalog.stats import table_row_count
+        if table_row_count(self.catalog, t) > 0:
+            raise UnsupportedFeatureError(
+                "distributing a non-empty table is not supported yet; "
+                "create, distribute, then load")
+        shard_count = shard_count or self.settings.sharding.shard_count
+        self.catalog.distribute_table(name, dist_column, shard_count,
+                                      self.catalog.active_node_ids(),
+                                      colocate_with=colocate_with)
+        self.catalog.commit()
+
+    def create_reference_table(self, name: str) -> None:
+        t = self.catalog.table(name)
+        from citus_tpu.catalog.stats import table_row_count
+        if table_row_count(self.catalog, t) > 0:
+            raise UnsupportedFeatureError(
+                "converting a non-empty table is not supported yet")
+        self.catalog.make_reference_table(name, self.catalog.active_node_ids())
+        self.catalog.commit()
+
+    # ----------------------------------------------------------- ingest
+    def copy_from(self, table_name: str,
+                  columns: Optional[dict[str, Sequence[Any]]] = None,
+                  rows: Optional[Iterable[Sequence[Any]]] = None,
+                  column_names: Optional[list[str]] = None) -> int:
+        """Bulk load (the COPY analog).  Either ``columns`` (dict of
+        arrays/lists, fastest) or ``rows`` (iterable of tuples)."""
+        t = self.catalog.table(table_name)
+        if (columns is None) == (rows is None):
+            raise AnalysisError("provide exactly one of columns= or rows=")
+        if rows is not None:
+            columns = rows_to_columns(t.schema.names, rows, column_names)
+        values, validity = encode_columns(self.catalog, t, columns)
+        ing = TableIngestor(self.catalog, t)
+        ing.append(values, validity)
+        ing.finish()
+        n = len(next(iter(values.values()))) if values else 0
+        return n
+
+    # -------------------------------------------------------------- SQL
+    def execute(self, sql: str) -> Result:
+        stmts = parse_sql(sql)
+        result = Result(columns=[], rows=[])
+        for stmt in stmts:
+            result = self._execute_stmt(stmt, sql_text=sql if len(stmts) == 1 else None)
+        return result
+
+    def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
+        if isinstance(stmt, A.Select):
+            cached = self._plan_cache.get(sql_text) if sql_text else None
+            if cached is not None:
+                bound, plan, version, epoch, backend = cached
+                if (epoch == self.catalog.ddl_epoch
+                        and bound.table.version == version
+                        and backend == self.settings.executor.task_executor_backend):
+                    return execute_select(self.catalog, bound, self.settings, plan=plan)
+            bound = bind_select(self.catalog, stmt)
+            from citus_tpu.planner.physical import plan_select
+            plan = plan_select(self.catalog, bound,
+                               direct_limit=self.settings.planner.direct_gid_limit)
+            if sql_text:
+                self._plan_cache[sql_text] = (
+                    bound, plan, bound.table.version, self.catalog.ddl_epoch,
+                    self.settings.executor.task_executor_backend)
+            return execute_select(self.catalog, bound, self.settings, plan=plan)
+        if isinstance(stmt, A.CreateTable):
+            schema = Schema([
+                Column(c.name, type_from_sql(c.type_name, c.type_args or None), c.not_null)
+                for c in stmt.columns
+            ])
+            opts = {k: v for k, v in stmt.options.items() if k != "access_method"}
+            self.create_table(stmt.name, schema, if_not_exists=stmt.if_not_exists, **opts)
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropTable):
+            self.drop_table(stmt.name, if_exists=stmt.if_exists)
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.Insert):
+            return self._execute_insert(stmt)
+        if isinstance(stmt, A.UtilityCall):
+            return self._execute_utility(stmt)
+        if isinstance(stmt, A.Explain):
+            return self._execute_explain(stmt)
+        raise UnsupportedFeatureError(f"cannot execute {type(stmt).__name__}")
+
+    def _execute_insert(self, stmt: A.Insert) -> Result:
+        t = self.catalog.table(stmt.table)
+        if stmt.select is not None:
+            inner = self._execute_stmt(stmt.select)
+            names = stmt.columns or t.schema.names
+            rows = inner.rows
+            n = self.copy_from(stmt.table, rows=rows, column_names=list(names))
+            return Result(columns=[], rows=[], explain={"inserted": n})
+        rows = []
+        for row_exprs in stmt.rows:
+            row = []
+            for e in row_exprs:
+                if not isinstance(e, A.Literal):
+                    if isinstance(e, A.UnOp) and e.op == "-" and isinstance(e.operand, A.Literal):
+                        row.append(-e.operand.value)
+                        continue
+                    raise UnsupportedFeatureError("INSERT VALUES must be literals")
+                row.append(e.value)
+            rows.append(row)
+        n = self.copy_from(stmt.table, rows=rows, column_names=stmt.columns)
+        return Result(columns=[], rows=[], explain={"inserted": n})
+
+    def _execute_utility(self, stmt: A.UtilityCall) -> Result:
+        name, args = stmt.name, stmt.args
+        if name == "create_distributed_table":
+            shard_count = int(args[2]) if len(args) > 2 else None
+            self.create_distributed_table(args[0], args[1], shard_count)
+            return Result(columns=[name], rows=[(None,)])
+        if name == "create_reference_table":
+            self.create_reference_table(args[0])
+            return Result(columns=[name], rows=[(None,)])
+        if name == "citus_table_size":
+            return Result(columns=["citus_table_size"],
+                          rows=[(self._table_size(args[0]),)])
+        if name == "master_get_active_worker_nodes":
+            return Result(columns=["node_id"],
+                          rows=[(nid,) for nid in self.catalog.active_node_ids()])
+        raise UnsupportedFeatureError(f"utility {name}() not supported yet")
+
+    def _table_size(self, name: str) -> int:
+        import os
+        t = self.catalog.table(name)
+        total = 0
+        for shard in t.shards:
+            for node in shard.placements:
+                d = self.catalog.shard_dir(name, shard.shard_id, node)
+                if os.path.isdir(d):
+                    total += sum(os.path.getsize(os.path.join(d, f))
+                                 for f in os.listdir(d))
+        return total
+
+    def _execute_explain(self, stmt: A.Explain) -> Result:
+        if not isinstance(stmt.statement, A.Select):
+            raise UnsupportedFeatureError("EXPLAIN supports SELECT only")
+        bound = bind_select(self.catalog, stmt.statement)
+        from citus_tpu.planner.physical import plan_select
+        plan = plan_select(self.catalog, bound,
+                           direct_limit=self.settings.planner.direct_gid_limit)
+        t = bound.table
+        lines = []
+        kind = ("Router" if plan.is_router else "Distributed") if t.is_distributed else "Local"
+        lines.append(f"{kind} Scan on {t.name} "
+                     f"(shards: {len(plan.shard_indexes)}/{t.shard_count})")
+        if plan.intervals:
+            lines.append("  Chunk Pruning: " +
+                         ", ".join(sorted({c.column for c in plan.intervals})))
+        if bound.has_aggs:
+            mode = plan.group_mode
+            desc = {"scalar": "Global Aggregate",
+                    "direct": f"Direct GroupBy (groups: {mode.n_groups}, combine: psum)",
+                    "hash_host": "Hash GroupBy (host combine)"}[mode.kind]
+            lines.append(f"  Partial Aggregate per shard -> {desc}")
+            lines.append(f"    Partials: " + ", ".join(
+                f"{op.kind}[{op.dtype}]" for op in plan.partial_ops))
+        if stmt.analyze:
+            r = execute_select(self.catalog, bound, self.settings)
+            lines.append(f"  Rows: {r.rowcount}  Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
+        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])

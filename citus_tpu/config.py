@@ -1,0 +1,107 @@
+"""Configuration ("GUC") system.
+
+The reference defines ~139 ``citus.*`` GUCs in shared_library_init.c plus 4
+``columnar.*`` GUCs (src/backend/columnar/columnar.c).  We keep the
+load-bearing ones as a typed dataclass tree; per-table options (compression,
+chunk sizes) can be overridden at table level, mirroring
+``columnar_internal.options``.
+
+``task_executor_backend`` selects where per-shard scan kernels run:
+``"tpu"`` (default: whatever accelerator JAX sees) or ``"cpu"``
+(host-side numpy reference path, used as the correctness oracle).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ColumnarSettings:
+    """Mirrors columnar.* GUCs (reference columnar.h:224-227)."""
+
+    # Rows per chunk group.  The reference default is 10_000; we use a
+    # power of two so padded device batches tile cleanly on (8,128) VREGs.
+    chunk_group_row_limit: int = 8192
+    # Rows per stripe (reference default 150_000).
+    stripe_row_limit: int = 131072
+    compression: str = "zstd"  # zstd | lz4 | zlib | none
+    compression_level: int = 3
+
+
+@dataclass
+class PlannerSettings:
+    # GROUP BY strategy thresholds.
+    # Direct-gid when the composite key domain is provably <= this bound
+    # (exact, collision-free scatter-add).
+    direct_gid_limit: int = 65536
+    # Slot count for the fingerprint hash-aggregate fallback.
+    hash_agg_slots: int = 8192
+    # Enable repartition (all_to_all) joins; reference GUC
+    # citus.enable_repartition_joins.
+    enable_repartition_joins: bool = True
+    # Buckets per mesh axis for repartition, reference
+    # citus.repartition_join_bucket_count_per_node.
+    repartition_bucket_count_per_device: int = 1
+
+
+@dataclass
+class ExecutorSettings:
+    # "tpu" = JAX backend (accelerator or CPU mesh); "cpu" = numpy oracle.
+    task_executor_backend: str = "tpu"
+    # Max shard-kernel invocations in flight per device (analog of
+    # citus.max_adaptive_executor_pool_size).
+    max_tasks_in_flight: int = 4
+    # Pad scan batches to power-of-two row counts to bound recompiles.
+    batch_row_buckets: bool = True
+    # Smallest padded batch (rows) a kernel will ever see.
+    min_batch_rows: int = 8192
+
+
+@dataclass
+class ShardingSettings:
+    # Default shard count for create_distributed_table
+    # (reference GUC citus.shard_count, default 32).
+    shard_count: int = 8
+    # Replication factor for distributed tables
+    # (reference citus.shard_replication_factor).
+    shard_replication_factor: int = 1
+
+
+@dataclass
+class Settings:
+    columnar: ColumnarSettings = field(default_factory=ColumnarSettings)
+    planner: PlannerSettings = field(default_factory=PlannerSettings)
+    executor: ExecutorSettings = field(default_factory=ExecutorSettings)
+    sharding: ShardingSettings = field(default_factory=ShardingSettings)
+
+    def replace(self, **kw) -> "Settings":
+        return dataclasses.replace(self, **kw)
+
+
+_CURRENT = Settings()
+
+
+def current_settings() -> Settings:
+    return _CURRENT
+
+
+def set_settings(settings: Settings) -> None:
+    global _CURRENT
+    _CURRENT = settings
+
+
+@contextlib.contextmanager
+def settings_override(**sections):
+    """Temporarily override settings sections, e.g.
+    ``settings_override(executor=ExecutorSettings(task_executor_backend="cpu"))``.
+    """
+    global _CURRENT
+    old = _CURRENT
+    _CURRENT = dataclasses.replace(old, **sections)
+    try:
+        yield _CURRENT
+    finally:
+        _CURRENT = old

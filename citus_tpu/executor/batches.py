@@ -1,0 +1,106 @@
+"""Shard data -> padded device batches.
+
+The host-side half of the scan: read pruned chunks (decompressed on the
+host), concatenate, and pad to a power-of-two row bucket so XLA sees a
+small, stable set of shapes (the recompile-pressure discipline the
+reference gets from prepared-statement plan caching).  Padding rows carry
+``row_mask=False`` and zeroed values.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+
+from citus_tpu.catalog import Catalog, TableMeta
+from citus_tpu.planner.physical import PhysicalPlan
+from citus_tpu.storage import ShardReader
+from citus_tpu.storage.writer import _load_meta
+import os
+
+
+@dataclass
+class ShardBatch:
+    cols: tuple[np.ndarray, ...]    # device dtypes, padded
+    valids: tuple[np.ndarray, ...]
+    row_mask: np.ndarray
+    n_rows: int                      # real rows
+    padded_rows: int
+    shard_index: int
+
+
+def bucket_rows(n: int, min_rows: int) -> int:
+    b = max(min_rows, 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def load_shard_batches(
+    cat: Catalog, plan: PhysicalPlan, shard_index: int, *,
+    min_batch_rows: int = 8192, max_batch_rows: int = 1 << 22,
+    node_override: Optional[int] = None,
+) -> Iterator[tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]]:
+    """Yield (values, valids, n_rows) raw column groups of at most
+    max_batch_rows rows for one shard placement."""
+    table = plan.bound.table
+    shard = table.shards[shard_index]
+    node = node_override if node_override is not None else shard.placements[0]
+    d = cat.shard_dir(table.name, shard.shard_id, node)
+    if not os.path.isdir(d) or _load_meta(d)["row_count"] == 0:
+        return
+    reader = ShardReader(d, table.schema)
+    cols = plan.scan_columns
+    pend_v: dict[str, list[np.ndarray]] = {c: [] for c in cols}
+    pend_m: dict[str, list[np.ndarray]] = {c: [] for c in cols}
+    pend_rows = 0
+    for batch in reader.scan(cols, plan.intervals):
+        for c in cols:
+            pend_v[c].append(batch.values[c])
+            m = batch.validity[c]
+            pend_m[c].append(np.ones(batch.row_count, bool) if m is None else m)
+        pend_rows += batch.row_count
+        if pend_rows >= max_batch_rows:
+            yield _drain(cols, pend_v, pend_m, pend_rows)
+            pend_v = {c: [] for c in cols}
+            pend_m = {c: [] for c in cols}
+            pend_rows = 0
+    if pend_rows:
+        yield _drain(cols, pend_v, pend_m, pend_rows)
+
+
+def _drain(cols, pend_v, pend_m, pend_rows):
+    values = {c: np.concatenate(pend_v[c]) if len(pend_v[c]) > 1 else pend_v[c][0] for c in cols}
+    masks = {c: np.concatenate(pend_m[c]) if len(pend_m[c]) > 1 else pend_m[c][0] for c in cols}
+    return values, masks, pend_rows
+
+
+def pad_to_batch(table: TableMeta, plan: PhysicalPlan, values: dict, masks: dict,
+                 n_rows: int, padded_rows: int, shard_index: int) -> ShardBatch:
+    cols_out, valids_out = [], []
+    for c in plan.scan_columns:
+        dt = table.schema.column(c).type.device_dtype
+        v = values[c].astype(dt, copy=False)
+        m = masks[c]
+        if padded_rows != n_rows:
+            v = np.concatenate([v, np.zeros(padded_rows - n_rows, dt)])
+            m = np.concatenate([m, np.ones(padded_rows - n_rows, bool)])
+        cols_out.append(v)
+        valids_out.append(m)
+    row_mask = np.zeros(padded_rows, bool)
+    row_mask[:n_rows] = True
+    return ShardBatch(tuple(cols_out), tuple(valids_out), row_mask,
+                      n_rows, padded_rows, shard_index)
+
+
+def empty_batch(table: TableMeta, plan: PhysicalPlan, padded_rows: int,
+                shard_index: int) -> ShardBatch:
+    cols, valids = [], []
+    for c in plan.scan_columns:
+        dt = table.schema.column(c).type.device_dtype
+        cols.append(np.zeros(padded_rows, dt))
+        valids.append(np.ones(padded_rows, bool))
+    return ShardBatch(tuple(cols), tuple(valids), np.zeros(padded_rows, bool),
+                      0, padded_rows, shard_index)

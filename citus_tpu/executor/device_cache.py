@@ -1,0 +1,65 @@
+"""HBM-resident column batch cache.
+
+The reference keeps hot table blocks in PostgreSQL shared buffers; the
+TPU-native analog is keeping decompressed, padded column batches resident
+in device HBM across queries.  Entries are keyed by
+(table, table.version, shard, projected columns, pruning signature,
+bucket) — any ingest/DDL bumps the version and naturally invalidates.
+
+A simple byte-bounded LRU keeps us inside HBM (v5e ~16 GB); eviction
+drops the device reference and lets JAX free the buffers.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+DEFAULT_CAPACITY_BYTES = 6 << 30
+
+
+class DeviceBatchCache:
+    def __init__(self, capacity_bytes: int = DEFAULT_CAPACITY_BYTES):
+        self.capacity = capacity_bytes
+        self._entries: OrderedDict[tuple, tuple[list, int]] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple) -> Optional[list]:
+        e = self._entries.get(key)
+        if e is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return e[0]
+
+    def put(self, key: tuple, batches: list, nbytes: int) -> None:
+        if nbytes > self.capacity:
+            return  # too large to cache; stream it
+        while self._bytes + nbytes > self.capacity and self._entries:
+            _, (_, old_bytes) = self._entries.popitem(last=False)
+            self._bytes -= old_bytes
+        self._entries[key] = (batches, nbytes)
+        self._bytes += nbytes
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0
+
+
+GLOBAL_CACHE = DeviceBatchCache()
+
+
+def plan_cache_key(plan, data_dir: str) -> tuple:
+    t = plan.bound.table
+    intervals = tuple(sorted(
+        ((c.column, repr(c.lo), repr(c.hi), c.lo_inclusive, c.hi_inclusive)
+         for c in plan.intervals)))
+    # shard ids are allocated monotonically and never reused, so they (plus
+    # the data_dir) uniquely identify the relation incarnation — a dropped
+    # and recreated table can never alias a cache entry
+    shard_ids = tuple(t.shards[i].shard_id for i in plan.shard_indexes)
+    return (data_dir, t.name, t.version, tuple(plan.scan_columns),
+            shard_ids, intervals)

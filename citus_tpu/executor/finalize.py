@@ -1,0 +1,190 @@
+"""Coordinator-side finalization: combine results -> Python rows.
+
+The analog of the reference's coordinator combine query + final
+projection (MasterExtendedOpNode output): aggregate extraction from
+partial states (avg = exact sum/count division), HAVING, output
+decoding (scaled-int decimals -> Decimal, dictionary ids -> strings,
+day/microsecond encodings -> date/datetime), ORDER BY with PostgreSQL
+null ordering, DISTINCT, OFFSET/LIMIT.
+"""
+
+from __future__ import annotations
+
+import decimal
+from typing import Optional
+
+import numpy as np
+
+from citus_tpu import types as T
+from citus_tpu.catalog import Catalog
+from citus_tpu.planner.bound import BColumn, BKeyRef, compile_expr, predicate_mask, walk
+from citus_tpu.planner.physical import AggExtract, PhysicalPlan
+
+
+def extract_aggs(plan: PhysicalPlan, partials: tuple) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Partial-op arrays -> per-SQL-aggregate (values, valid) arrays."""
+    out = []
+    for ex in plan.agg_extract:
+        if ex.kind in ("count", "count_star"):
+            v = np.asarray(partials[ex.slots[0]], dtype=np.int64)
+            out.append((v, np.ones(v.shape, bool)))
+        elif ex.kind == "sum":
+            s = np.asarray(partials[ex.slots[0]])
+            c = np.asarray(partials[ex.slots[1]])
+            out.append((s, c > 0))
+        elif ex.kind == "avg":
+            s = np.asarray(partials[ex.slots[0]])
+            c = np.asarray(partials[ex.slots[1]])
+            valid = c > 0
+            if ex.out_type.is_float:
+                v = np.divide(s, np.where(valid, c, 1))
+                out.append((v.astype(np.float64), valid))
+            else:
+                # exact decimal average: sum is scaled by arg scale; output
+                # scale is arg scale + 6 -> multiply by 10^6 then divide
+                vals = np.zeros(s.shape, np.int64)
+                flat_s, flat_c = s.reshape(-1), c.reshape(-1)
+                flat_o = vals.reshape(-1)
+                for i in range(flat_s.shape[0]):
+                    if flat_c[i] > 0:
+                        q = (decimal.Decimal(int(flat_s[i])) * 1_000_000 /
+                             decimal.Decimal(int(flat_c[i])))
+                        flat_o[i] = int(q.to_integral_value(rounding=decimal.ROUND_HALF_UP))
+                out.append((vals, valid))
+        elif ex.kind in ("min", "max"):
+            v = np.asarray(partials[ex.slots[0]])
+            c = np.asarray(partials[ex.slots[1]])
+            out.append((v, c > 0))
+        else:
+            raise AssertionError(ex.kind)
+    return out
+
+
+def decode_value(cat: Catalog, table: str, expr_type: T.ColumnType,
+                 source_text_col: Optional[str], raw, valid) -> object:
+    if not valid:
+        return None
+    if expr_type.is_text:
+        if source_text_col is None:
+            return int(raw)
+        return cat.decode_strings(table, source_text_col, [int(raw)])[0]
+    return expr_type.from_physical(raw.item() if hasattr(raw, "item") else raw)
+
+
+def _text_source(e) -> Optional[str]:
+    """Output expr -> the text column whose dictionary decodes it."""
+    if isinstance(e, BColumn) and e.type.is_text:
+        return e.name
+    return None
+
+
+def finalize_groups(
+    plan: PhysicalPlan, cat: Catalog,
+    key_arrays: list[tuple[np.ndarray, np.ndarray]],
+    partials: tuple,
+) -> list[tuple]:
+    """Grouped/aggregate query: evaluate final exprs per group -> rows."""
+    bound = plan.bound
+    aggs = extract_aggs(plan, partials)
+    env = {"__keys__": key_arrays, "__aggs__": aggs}
+    n_groups = key_arrays[0][0].shape[0] if key_arrays else (
+        aggs[0][0].shape[0] if aggs else 1)
+
+    keep = np.ones(n_groups, bool)
+    if bound.having is not None:
+        fn = compile_expr(bound.having, np)
+        ref = np.zeros(n_groups)
+        keep = np.asarray(predicate_mask(np, fn, env, ref))
+        if keep.shape == ():
+            keep = np.full(n_groups, bool(keep))
+
+    # text dictionary sources for key-referencing outputs
+    text_cols: list[Optional[str]] = []
+    for e in bound.final_exprs:
+        src = None
+        if isinstance(e, BKeyRef):
+            src = _text_source(bound.group_keys[e.index])
+        text_cols.append(src)
+
+    out_cols = []
+    for e in bound.final_exprs:
+        fn = compile_expr(e, np)
+        v, valid = fn(env)
+        v = np.broadcast_to(np.asarray(v), (n_groups,) + np.shape(v)[1:]) \
+            if np.shape(v)[:1] != (n_groups,) else np.asarray(v)
+        if valid is True:
+            valid = np.ones(n_groups, bool)
+        elif valid is False:
+            valid = np.zeros(n_groups, bool)
+        else:
+            valid = np.broadcast_to(np.asarray(valid), (n_groups,))
+        out_cols.append((v, valid, e.type))
+
+    rows = []
+    for gi in range(n_groups):
+        if not keep[gi]:
+            continue
+        row = []
+        for (v, valid, t), src in zip(out_cols, text_cols):
+            row.append(decode_value(cat, bound.table.name, t, src, v[gi], bool(valid[gi])))
+        rows.append(tuple(row))
+    return rows
+
+
+def project_rows(plan: PhysicalPlan, cat: Catalog, env_batches: list[dict]) -> list[tuple]:
+    """Non-aggregate query: evaluate projections per batch on the host
+    (the device already computed the filter mask and raw columns)."""
+    bound = plan.bound
+    rows: list[tuple] = []
+    text_cols = [_text_source(e) for e in bound.final_exprs]
+    fns = plan.runtime_cache.get("np_final_fns")
+    if fns is None:
+        fns = [compile_expr(e, np) for e in bound.final_exprs]
+        plan.runtime_cache["np_final_fns"] = fns
+    for env, mask in env_batches:
+        idx = np.nonzero(mask)[0]
+        if idx.size == 0:
+            continue
+        sel_env = {name: (np.asarray(v)[idx], np.asarray(m)[idx] if not isinstance(m, bool) else m)
+                   for name, (v, m) in env.items()}
+        cols = []
+        for e, fn in zip(bound.final_exprs, fns):
+            v, valid = fn(sel_env)
+            v = np.broadcast_to(np.asarray(v), (idx.size,) + np.shape(v)[1:]) \
+                if np.shape(v)[:1] != (idx.size,) else np.asarray(v)
+            if valid is True:
+                valid = np.ones(idx.size, bool)
+            elif valid is False:
+                valid = np.zeros(idx.size, bool)
+            cols.append((v, np.broadcast_to(np.asarray(valid), (idx.size,)), e.type))
+        for ri in range(idx.size):
+            row = []
+            for (v, valid, t), src in zip(cols, text_cols):
+                row.append(decode_value(cat, bound.table.name, t, src, v[ri], bool(valid[ri])))
+            rows.append(tuple(row))
+    return rows
+
+
+def order_and_limit(plan: PhysicalPlan, rows: list[tuple]) -> list[tuple]:
+    bound = plan.bound
+    if bound.distinct:
+        seen = set()
+        uniq = []
+        for r in rows:
+            if r not in seen:
+                seen.add(r)
+                uniq.append(r)
+        rows = uniq
+    # stable multi-key sort: apply keys right-to-left; PostgreSQL default
+    # null ordering is NULLS LAST for ASC, NULLS FIRST for DESC
+    for idx, asc, nulls_first in reversed(bound.order_by):
+        nf = nulls_first if nulls_first is not None else (not asc)
+        nulls = [r for r in rows if r[idx] is None]
+        vals = [r for r in rows if r[idx] is not None]
+        vals.sort(key=lambda r, i=idx: r[i], reverse=not asc)
+        rows = (nulls + vals) if nf else (vals + nulls)
+    if bound.offset:
+        rows = rows[bound.offset:]
+    if bound.limit is not None:
+        rows = rows[:bound.limit]
+    return rows

@@ -1,0 +1,11 @@
+"""Device kernel layer.
+
+The per-shard scan→filter→project→partial-aggregate programs that replace
+the reference's row-at-a-time ColumnarScanNext hot loop
+(src/backend/columnar/columnar_customscan.c:1855 →
+columnar_reader.c:323) with whole-batch XLA computations.
+"""
+
+from citus_tpu.ops.scan_agg import build_worker_fn, combine_partials_host
+
+__all__ = ["build_worker_fn", "combine_partials_host"]

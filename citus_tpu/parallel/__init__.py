@@ -1,0 +1,12 @@
+"""Mesh management and collectives.
+
+The data plane of the distributed executor: a ``jax.sharding.Mesh`` over
+the available devices replaces the reference's worker-node topology, and
+XLA collectives over ICI replace its libpq data movement
+(SURVEY §2.5/§5.8 mapping: psum = combine-aggregate gather,
+all_gather = broadcast/reference join, all_to_all = MapMergeJob shuffle).
+"""
+
+from citus_tpu.parallel.mesh import default_mesh, shard_axis_size, sharded_partial_agg
+
+__all__ = ["default_mesh", "shard_axis_size", "sharded_partial_agg"]

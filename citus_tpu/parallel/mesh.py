@@ -1,0 +1,77 @@
+"""Device mesh + the partial-agg/combine collective.
+
+``sharded_partial_agg`` is the north-star lowering (SURVEY §2.4): each
+mesh slot runs the worker kernel on its shard's batch, then the partial
+states are combined in-mesh with psum/pmin/pmax so every device (and the
+host) sees the merged table after one collective — the reference needs a
+coordinator gather plus a combine query for the same step
+(multi_logical_optimizer.c MasterExtendedOpNode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+def default_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n or len(devs)
+    return Mesh(devs[:n], (SHARD_AXIS,))
+
+
+def shard_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[SHARD_AXIS]
+
+
+def sharded_partial_agg(worker, combine_kinds: list[str], mesh: Mesh) -> Callable:
+    """Wrap a worker fn (cols, valids, row_mask) -> partial tuple into a
+    shard_map'd program over stacked inputs [n_dev, N]:
+
+      out[i] = combine_over_shards(worker(inputs[shard]))   (replicated)
+
+    combine_kinds[i] in {sum, min, max, none} selects the collective per
+    output position; 'none' outputs are returned stacked per-shard.
+    """
+
+    def per_shard(cols, valids, row_mask):
+        cols = tuple(c[0] for c in cols)      # strip the leading shard dim
+        valids = tuple(v[0] for v in valids)
+        row_mask = row_mask[0]
+        partials = worker(cols, valids, row_mask)
+        outs = []
+        for p, kind in zip(partials, combine_kinds):
+            if kind == "sum":
+                outs.append(jax.lax.psum(p, SHARD_AXIS))
+            elif kind == "min":
+                outs.append(jax.lax.pmin(p, SHARD_AXIS))
+            elif kind == "max":
+                outs.append(jax.lax.pmax(p, SHARD_AXIS))
+            else:
+                outs.append(p[None])
+        return tuple(outs)
+
+    n_in = None  # in_specs built per call from pytree structure
+
+    @functools.partial(jax.jit, static_argnums=())
+    def run(cols, valids, row_mask):
+        in_specs = (
+            tuple(P(SHARD_AXIS) for _ in cols),
+            tuple(P(SHARD_AXIS) for _ in valids),
+            P(SHARD_AXIS),
+        )
+        out_specs = tuple(
+            P(SHARD_AXIS) if kind == "none" else P()
+            for kind in combine_kinds
+        )
+        fn = jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        return fn(cols, valids, row_mask)
+
+    return run

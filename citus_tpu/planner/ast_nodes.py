@@ -1,0 +1,205 @@
+"""SQL abstract syntax tree.
+
+A deliberately small, typed AST — the stand-in for PostgreSQL's parse
+tree that the reference receives from the postgres parser.  Desugaring
+(BETWEEN, IN, NOT LIKE, avg->sum/count) happens in later phases, not here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+# ---------------------------------------------------------------- exprs
+
+
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class ColumnRef(Expr):
+    name: str
+    table: Optional[str] = None  # qualified a.b
+
+    def __str__(self):
+        return f"{self.table}.{self.name}" if self.table else self.name
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: Any          # python int/float/Decimal/str/bool/None
+    type_name: str = "" # inferred literal category: int/decimal/float/string/bool/null
+
+    def __str__(self):
+        if self.type_name == "string":
+            return "'" + str(self.value).replace("'", "''") + "'"
+        return str(self.value)
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    def __str__(self):
+        return "*"
+
+
+@dataclass(frozen=True)
+class BinOp(Expr):
+    op: str  # + - * / % = <> < <= > >= and or
+    left: Expr
+    right: Expr
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclass(frozen=True)
+class UnOp(Expr):
+    op: str  # not, -
+    operand: Expr
+
+    def __str__(self):
+        return f"({self.op} {self.operand})"
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str
+    args: tuple[Expr, ...] = ()
+    distinct: bool = False
+
+    def __str__(self):
+        inner = ", ".join(str(a) for a in self.args)
+        if self.distinct:
+            inner = "distinct " + inner
+        return f"{self.name}({inner})"
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    expr: Expr
+    lo: Expr
+    hi: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    expr: Expr
+    items: tuple[Expr, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    expr: Expr
+    type_name: str
+    type_args: tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class CaseExpr(Expr):
+    whens: tuple[tuple[Expr, Expr], ...]
+    else_: Optional[Expr] = None
+
+
+# ------------------------------------------------------------ statements
+
+
+class Statement:
+    pass
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    type_args: list[int] = field(default_factory=list)
+    not_null: bool = False
+
+
+@dataclass
+class CreateTable(Statement):
+    name: str
+    columns: list[ColumnDef]
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)  # USING/WITH columnar opts
+
+
+@dataclass
+class DropTable(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Insert(Statement):
+    table: str
+    columns: Optional[list[str]]
+    rows: list[list[Expr]]
+    select: Optional["Select"] = None  # INSERT ... SELECT
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class Join:
+    left: "FromItem"
+    right: "FromItem"
+    kind: str            # inner, left, right, full, cross
+    condition: Optional[Expr] = None
+
+
+FromItem = "TableRef | Join"
+
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass
+class Select(Statement):
+    items: list[SelectItem]
+    from_: Optional[object] = None   # TableRef | Join | None
+    where: Optional[Expr] = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclass
+class UtilityCall(Statement):
+    """SELECT create_distributed_table('t', 'col') style UDF utilities —
+    the reference exposes its control plane as SQL-callable UDFs
+    (src/backend/distributed/sql/udfs/)."""
+
+    name: str
+    args: list[Any]
+
+
+@dataclass
+class Explain(Statement):
+    statement: Statement
+    analyze: bool = False

@@ -1,0 +1,475 @@
+"""Binder / semantic analysis: parser AST -> typed BoundSelect.
+
+This is the stand-in for PostgreSQL's analyzer plus the front half of the
+reference's logical planner: it resolves columns against the catalog,
+types every expression, desugars (BETWEEN, IN, LIKE-over-dictionary,
+text equality -> dictionary ids, decimal scale alignment), classifies
+aggregates, and validates GROUP BY semantics.  The result is ready for
+the worker/combine split (reference: multi_logical_optimizer.c's
+WorkerExtendedOpNode/MasterExtendedOpNode construction).
+"""
+
+from __future__ import annotations
+
+import decimal
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from citus_tpu import types as T
+from citus_tpu.catalog import Catalog, TableMeta
+from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
+from citus_tpu.planner import ast_nodes as A
+from citus_tpu.planner.bound import (
+    BAggRef, BBinOp, BCase, BCast, BColumn, BDateTrunc, BDictMask, BExpr,
+    BIsNull, BKeyRef, BLiteral, BScale, BUnOp, referenced_columns,
+)
+
+AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    kind: str              # sum | count | count_star | avg | min | max
+    arg: Optional[BExpr]   # None for count_star
+    out_type: T.ColumnType
+
+
+@dataclass
+class BoundSelect:
+    table: TableMeta
+    filter: Optional[BExpr]
+    group_keys: list[BExpr]
+    aggs: list[AggSpec]
+    # grouped/agg query: final_exprs over BKeyRef/BAggRef (host combine phase)
+    # plain query: final_exprs over columns (device projection)
+    final_exprs: list[BExpr]
+    output_names: list[str]
+    having: Optional[BExpr]
+    order_by: list[tuple[int, bool, Optional[bool]]]  # (output index, asc, nulls_first)
+    limit: Optional[int]
+    offset: Optional[int]
+    distinct: bool
+
+    @property
+    def has_aggs(self) -> bool:
+        return bool(self.aggs) or bool(self.group_keys)
+
+    @property
+    def scan_columns(self) -> list[str]:
+        cols: set[str] = set()
+        for e in [self.filter, *self.group_keys, *(a.arg for a in self.aggs if a.arg is not None)]:
+            if e is not None:
+                cols.update(referenced_columns(e))
+        if not self.has_aggs:
+            for e in self.final_exprs:
+                cols.update(referenced_columns(e))
+        return sorted(cols)
+
+
+def _like_to_regex(pattern: str) -> "re.Pattern":
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+class Binder:
+    def __init__(self, catalog: Catalog, table: TableMeta):
+        self.catalog = catalog
+        self.table = table
+
+    # ---------------------------------------------------------------- expr
+    def bind_scalar(self, e: A.Expr, allow_agg: bool = False) -> BExpr:
+        if isinstance(e, A.ColumnRef):
+            col = self.table.schema.column(e.name)
+            return BColumn(col.name, col.type)
+        if isinstance(e, A.Literal):
+            return self._bind_literal(e)
+        if isinstance(e, A.UnOp):
+            inner = self.bind_scalar(e.operand, allow_agg)
+            if e.op == "-":
+                if not inner.type.is_numeric:
+                    raise AnalysisError(f"cannot negate {inner.type}")
+                return BUnOp("-", inner, inner.type)
+            if e.op == "not":
+                return BUnOp("not", self._to_bool(inner), T.BOOL_T)
+        if isinstance(e, A.BinOp):
+            return self._bind_binop(e, allow_agg)
+        if isinstance(e, A.Between):
+            lo = A.BinOp(">=", e.expr, e.lo)
+            hi = A.BinOp("<=", e.expr, e.hi)
+            both = A.BinOp("and", lo, hi)
+            return self.bind_scalar(A.UnOp("not", both) if e.negated else both, allow_agg)
+        if isinstance(e, A.InList):
+            return self._bind_in(e, allow_agg)
+        if isinstance(e, A.IsNull):
+            return BIsNull(self.bind_scalar(e.expr, allow_agg), e.negated)
+        if isinstance(e, A.Cast):
+            inner = self.bind_scalar(e.expr, allow_agg)
+            target = T.type_from_sql(e.type_name, list(e.type_args) or None)
+            if target.is_text:
+                raise UnsupportedFeatureError("cast to text not supported")
+            return BCast(inner, target)
+        if isinstance(e, A.CaseExpr):
+            return self._bind_case(e, allow_agg)
+        if isinstance(e, A.FuncCall):
+            return self._bind_func(e, allow_agg)
+        raise AnalysisError(f"cannot bind expression {e}")
+
+    def _bind_literal(self, e: A.Literal) -> BLiteral:
+        v = e.value
+        if v is None:
+            return BLiteral(None, T.INT64_T)
+        if e.type_name == "int":
+            return BLiteral(int(v), T.INT64_T)
+        if e.type_name == "decimal":
+            d = v if isinstance(v, decimal.Decimal) else decimal.Decimal(str(v))
+            scale = max(0, -d.as_tuple().exponent)
+            t = T.decimal_t(38, scale)
+            return BLiteral(t.to_physical(d), t)
+        if e.type_name == "float":
+            return BLiteral(float(v), T.FLOAT64_T)
+        if e.type_name == "bool":
+            return BLiteral(1 if v else 0, T.BOOL_T)
+        if e.type_name == "string":
+            # untyped until coerced against the other side of a comparison
+            return BLiteral(v, T.TEXT_T)
+        raise AnalysisError(f"bad literal {e}")
+
+    def _coerce_string_literal(self, lit: BLiteral, target: T.ColumnType,
+                               column: Optional[BColumn]) -> BLiteral:
+        """'1994-01-01' vs date column, 'AIR' vs text column, etc."""
+        if target.kind in (T.DATE, T.TIMESTAMP):
+            return BLiteral(target.to_physical(lit.value), target)
+        if target.is_text:
+            if column is None:
+                raise AnalysisError("cannot compare two string literals from different tables")
+            did = self.catalog.lookup_string_id(self.table.name, column.name, lit.value)
+            # unseen string: id -1 never matches any row
+            return BLiteral(-1 if did is None else did, T.TEXT_T)
+        if target.is_numeric:
+            d = decimal.Decimal(lit.value)
+            scale = max(0, -d.as_tuple().exponent)
+            t = T.decimal_t(38, scale) if scale else T.INT64_T
+            return BLiteral(t.to_physical(d), t)
+        raise AnalysisError(f"cannot coerce string literal to {target}")
+
+    def _align(self, left: BExpr, right: BExpr) -> tuple[BExpr, BExpr]:
+        """Insert scale/cast adjustments so both sides share physical space."""
+        lt, rt = left.type, right.type
+        # string literal coercion
+        if isinstance(right, BLiteral) and rt.is_text and not lt.is_text:
+            right = self._coerce_string_literal(right, lt, None)
+            rt = right.type
+        if isinstance(left, BLiteral) and lt.is_text and not rt.is_text:
+            left = self._coerce_string_literal(left, rt, None)
+            lt = left.type
+        if lt.is_text and rt.is_text:
+            col = left if isinstance(left, BColumn) else (right if isinstance(right, BColumn) else None)
+            if isinstance(right, BLiteral) and isinstance(right.value, str):
+                right = self._coerce_string_literal(right, lt, col)
+            if isinstance(left, BLiteral) and isinstance(left.value, str):
+                left = self._coerce_string_literal(left, rt, col)
+            return left, right
+        # decimal scale alignment (comparisons, +, -)
+        ls = lt.scale if lt.is_decimal else 0
+        rs = rt.scale if rt.is_decimal else 0
+        if (lt.is_decimal or rt.is_decimal) and not (lt.is_float or rt.is_float):
+            if ls < rs:
+                left = self._rescale(left, rs)
+            elif rs < ls:
+                right = self._rescale(right, ls)
+        return left, right
+
+    def _rescale(self, e: BExpr, scale: int) -> BExpr:
+        cur = e.type.scale if e.type.is_decimal else 0
+        t = T.decimal_t(38, scale)
+        if isinstance(e, BLiteral):
+            if e.value is None:
+                return BLiteral(None, t)
+            return BLiteral(int(e.value) * 10 ** (scale - cur), t)
+        return BScale(e, scale - cur, t)
+
+    def _to_bool(self, e: BExpr) -> BExpr:
+        if e.type.kind != T.BOOL:
+            raise AnalysisError(f"expected boolean expression, got {e.type}")
+        return e
+
+    def _bind_binop(self, e: A.BinOp, allow_agg: bool) -> BExpr:
+        op = e.op
+        left = self.bind_scalar(e.left, allow_agg)
+        right = self.bind_scalar(e.right, allow_agg)
+        if op in ("and", "or"):
+            return BBinOp(op, self._to_bool(left), self._to_bool(right), T.BOOL_T)
+        left, right = self._align(left, right)
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            if left.type.is_text and op not in ("=", "<>"):
+                raise UnsupportedFeatureError("ordered comparison on text columns")
+            if not left.type.is_text and not right.type.is_numeric and left.type.kind != right.type.kind:
+                raise AnalysisError(f"cannot compare {left.type} and {right.type}")
+            return BBinOp(op, left, right, T.BOOL_T)
+        out = T.arith_result_type(op, left.type, right.type)
+        if op in ("+", "-") and out.is_decimal:
+            # operands already aligned to out.scale
+            out = T.decimal_t(38, max(left.type.scale if left.type.is_decimal else 0,
+                                      right.type.scale if right.type.is_decimal else 0))
+        return BBinOp(op, left, right, out)
+
+    def _bind_in(self, e: A.InList, allow_agg: bool) -> BExpr:
+        target = self.bind_scalar(e.expr, allow_agg)
+        if target.type.is_text and isinstance(target, BColumn):
+            words = self.catalog.dictionary(self.table.name, target.name)
+            values = {it.value for it in e.items if isinstance(it, A.Literal)}
+            if len(values) != len(e.items):
+                raise UnsupportedFeatureError("non-literal IN items on text")
+            mask = [w in values for w in words]
+            out: BExpr = BDictMask(target, tuple(mask))
+            return BUnOp("not", out, T.BOOL_T) if e.negated else out
+        parts = None
+        for item in e.items:
+            eq = self._bind_binop(A.BinOp("=", e.expr, item), allow_agg)
+            parts = eq if parts is None else BBinOp("or", parts, eq, T.BOOL_T)
+        if parts is None:
+            parts = BLiteral(0, T.BOOL_T)
+        return BUnOp("not", parts, T.BOOL_T) if e.negated else parts
+
+    def _bind_case(self, e: A.CaseExpr, allow_agg: bool) -> BExpr:
+        whens = [(self._to_bool(self.bind_scalar(c, allow_agg)), self.bind_scalar(v, allow_agg))
+                 for c, v in e.whens]
+        else_ = self.bind_scalar(e.else_, allow_agg) if e.else_ is not None else None
+        result_types = [v.type for _, v in whens] + ([else_.type] if else_ is not None else [])
+        out = result_types[0]
+        for t in result_types[1:]:
+            out = T.common_super_type(out, t)
+        # align decimal scales of branches
+        if out.is_decimal:
+            whens = [(c, self._rescale(v, out.scale) if v.type.is_decimal or v.type.is_integer else v)
+                     for c, v in whens]
+            if else_ is not None and (else_.type.is_decimal or else_.type.is_integer):
+                else_ = self._rescale(else_, out.scale)
+        return BCase(tuple(whens), else_, out)
+
+    def _bind_func(self, e: A.FuncCall, allow_agg: bool) -> BExpr:
+        name = e.name
+        if name in AGG_FUNCS:
+            raise AnalysisError(f"aggregate {name}() not allowed here")
+        if name == "like":
+            target = self.bind_scalar(e.args[0], allow_agg)
+            pat = e.args[1]
+            if not (isinstance(target, BColumn) and target.type.is_text
+                    and isinstance(pat, A.Literal) and isinstance(pat.value, str)):
+                raise UnsupportedFeatureError("LIKE requires text column and literal pattern")
+            rx = _like_to_regex(pat.value)
+            words = self.catalog.dictionary(self.table.name, target.name)
+            return BDictMask(target, tuple(bool(rx.match(w)) for w in words))
+        if name == "date_trunc":
+            if len(e.args) != 2 or not isinstance(e.args[0], A.Literal):
+                raise AnalysisError("date_trunc(unit, expr) expects a literal unit")
+            unit = str(e.args[0].value)
+            inner = self.bind_scalar(e.args[1], allow_agg)
+            if inner.type.kind not in (T.DATE, T.TIMESTAMP):
+                raise AnalysisError("date_trunc expects date/timestamp")
+            return BDateTrunc(unit, inner, inner.type)
+        if name == "abs":
+            inner = self.bind_scalar(e.args[0], allow_agg)
+            return BCase(((BBinOp("<", inner, BLiteral(0, T.INT64_T) if not inner.type.is_float
+                                  else BLiteral(0.0, T.FLOAT64_T), T.BOOL_T),
+                           BUnOp("-", inner, inner.type)),), inner, inner.type)
+        raise UnsupportedFeatureError(f"function {name}() not supported")
+
+    # ---------------------------------------------------------------- aggs
+    def _agg_output_type(self, kind: str, arg: Optional[BExpr]) -> T.ColumnType:
+        if kind in ("count", "count_star"):
+            return T.INT64_T
+        t = arg.type
+        if kind == "sum":
+            if t.is_decimal:
+                return T.decimal_t(38, t.scale)
+            if t.is_integer:
+                return T.INT64_T
+            if t.is_float:
+                return T.FLOAT64_T
+            raise AnalysisError(f"sum() over {t} not supported")
+        if kind == "avg":
+            if t.is_float:
+                return T.FLOAT64_T
+            if t.is_decimal or t.is_integer:
+                scale = (t.scale if t.is_decimal else 0) + 6
+                return T.decimal_t(38, scale)
+            raise AnalysisError(f"avg() over {t} not supported")
+        if kind in ("min", "max"):
+            if t.is_text:
+                raise UnsupportedFeatureError("min/max over text not supported yet")
+            return t
+        raise AnalysisError(f"unknown aggregate {kind}")
+
+    def bind_select_expr(self, e: A.Expr, key_map: dict[BExpr, int],
+                         aggs: list[AggSpec]) -> BExpr:
+        """Bind an output/having expression of a grouped query: aggregates
+        become BAggRef slots, grouping-key subexpressions become BKeyRef."""
+        if isinstance(e, A.FuncCall) and e.name in AGG_FUNCS:
+            if e.distinct:
+                raise UnsupportedFeatureError("DISTINCT aggregates not supported yet")
+            if e.name == "count" and (not e.args or isinstance(e.args[0], A.Star)):
+                spec = AggSpec("count_star", None, T.INT64_T)
+            else:
+                if len(e.args) != 1:
+                    raise AnalysisError(f"{e.name}() expects one argument")
+                arg = self.bind_scalar(e.args[0])
+                spec = AggSpec(e.name, arg, self._agg_output_type(e.name, arg))
+            for i, existing in enumerate(aggs):
+                if existing == spec:
+                    return BAggRef(i, spec.out_type)
+            aggs.append(spec)
+            return BAggRef(len(aggs) - 1, spec.out_type)
+        # non-aggregate: try matching a group key structurally
+        bound = self._try_bind_as_key(e, key_map)
+        if bound is not None:
+            return bound
+        if isinstance(e, A.BinOp):
+            left = self.bind_select_expr(e.left, key_map, aggs)
+            right = self.bind_select_expr(e.right, key_map, aggs)
+            return self._rebind_binop_from_bound(e.op, left, right)
+        if isinstance(e, A.UnOp):
+            inner = self.bind_select_expr(e.operand, key_map, aggs)
+            if e.op == "-":
+                return BUnOp("-", inner, inner.type)
+            return BUnOp("not", self._to_bool(inner), T.BOOL_T)
+        if isinstance(e, A.Cast):
+            inner = self.bind_select_expr(e.expr, key_map, aggs)
+            return BCast(inner, T.type_from_sql(e.type_name, list(e.type_args) or None))
+        if isinstance(e, A.Literal):
+            return self._bind_literal(e)
+        raise AnalysisError(
+            f"expression {e} must appear in GROUP BY or be used in an aggregate")
+
+    def _rebind_binop_from_bound(self, op: str, left: BExpr, right: BExpr) -> BExpr:
+        if op in ("and", "or"):
+            return BBinOp(op, self._to_bool(left), self._to_bool(right), T.BOOL_T)
+        left, right = self._align(left, right)
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            return BBinOp(op, left, right, T.BOOL_T)
+        out = T.arith_result_type(op, left.type, right.type)
+        return BBinOp(op, left, right, out)
+
+    def _try_bind_as_key(self, e: A.Expr, key_map: dict[BExpr, int]) -> Optional[BExpr]:
+        try:
+            bound = self.bind_scalar(e)
+        except (AnalysisError, UnsupportedFeatureError):
+            return None
+        idx = key_map.get(bound)
+        if idx is not None:
+            return BKeyRef(idx, bound.type)
+        if isinstance(bound, BLiteral):
+            return bound
+        return None
+
+
+# ------------------------------------------------------------------ select
+
+
+def bind_select(catalog: Catalog, stmt: A.Select) -> BoundSelect:
+    if stmt.from_ is None:
+        raise UnsupportedFeatureError("SELECT without FROM not supported")
+    if isinstance(stmt.from_, A.Join):
+        raise UnsupportedFeatureError("joins are handled by the join planner")
+    assert isinstance(stmt.from_, A.TableRef)
+    table = catalog.table(stmt.from_.name)
+    b = Binder(catalog, table)
+
+    # expand * early
+    items: list[A.SelectItem] = []
+    for item in stmt.items:
+        if isinstance(item.expr, A.Star):
+            for col in table.schema:
+                items.append(A.SelectItem(A.ColumnRef(col.name), col.name))
+        else:
+            items.append(item)
+
+    where = b.bind_scalar(stmt.where) if stmt.where is not None else None
+    if where is not None and where.type.kind != T.BOOL:
+        raise AnalysisError("WHERE must be boolean")
+
+    group_keys = [b.bind_scalar(g) for g in stmt.group_by]
+    key_map = {k: i for i, k in enumerate(group_keys)}
+
+    has_agg_funcs = any(_contains_agg(i.expr) for i in items) or \
+        (stmt.having is not None) or bool(group_keys)
+
+    aggs: list[AggSpec] = []
+    output_names: list[str] = []
+    final_exprs: list[BExpr] = []
+    if has_agg_funcs:
+        for i, item in enumerate(items):
+            final_exprs.append(b.bind_select_expr(item.expr, key_map, aggs))
+            output_names.append(item.alias or _default_name(item.expr, i))
+        having = None
+        if stmt.having is not None:
+            having = b.bind_select_expr(stmt.having, key_map, aggs)
+            if having.type.kind != T.BOOL:
+                raise AnalysisError("HAVING must be boolean")
+    else:
+        for i, item in enumerate(items):
+            final_exprs.append(b.bind_scalar(item.expr))
+            output_names.append(item.alias or _default_name(item.expr, i))
+        having = None
+
+    order_by: list[tuple[int, bool, Optional[bool]]] = []
+    for oi in stmt.order_by:
+        idx = _resolve_order_ref(oi.expr, items, output_names)
+        order_by.append((idx, oi.ascending, oi.nulls_first))
+
+    return BoundSelect(
+        table=table, filter=where, group_keys=group_keys, aggs=aggs,
+        final_exprs=final_exprs, output_names=output_names, having=having,
+        order_by=order_by, limit=stmt.limit, offset=stmt.offset,
+        distinct=stmt.distinct,
+    )
+
+
+def _contains_agg(e: A.Expr) -> bool:
+    if isinstance(e, A.FuncCall):
+        if e.name in AGG_FUNCS:
+            return True
+        return any(_contains_agg(a) for a in e.args)
+    if isinstance(e, A.BinOp):
+        return _contains_agg(e.left) or _contains_agg(e.right)
+    if isinstance(e, A.UnOp):
+        return _contains_agg(e.operand)
+    if isinstance(e, A.Cast):
+        return _contains_agg(e.expr)
+    if isinstance(e, A.Between):
+        return _contains_agg(e.expr) or _contains_agg(e.lo) or _contains_agg(e.hi)
+    if isinstance(e, A.CaseExpr):
+        return any(_contains_agg(c) or _contains_agg(v) for c, v in e.whens) or \
+            (e.else_ is not None and _contains_agg(e.else_))
+    return False
+
+
+def _default_name(e: A.Expr, i: int) -> str:
+    if isinstance(e, A.ColumnRef):
+        return e.name
+    if isinstance(e, A.FuncCall):
+        return e.name
+    return f"column{i + 1}"
+
+
+def _resolve_order_ref(e: A.Expr, items: list[A.SelectItem], names: list[str]) -> int:
+    if isinstance(e, A.Literal) and isinstance(e.value, int) and e.value is not True:
+        idx = e.value - 1
+        if not (0 <= idx < len(items)):
+            raise AnalysisError(f"ORDER BY position {e.value} out of range")
+        return idx
+    if isinstance(e, A.ColumnRef) and e.table is None and e.name in names:
+        return names.index(e.name)
+    # structural match against select items
+    for i, item in enumerate(items):
+        if item.expr == e:
+            return i
+    raise AnalysisError("ORDER BY expression must be an output column, alias, or position")

@@ -1,0 +1,371 @@
+"""Bound (typed) expressions and their compilation to array evaluators.
+
+The binder turns parser AST into this typed tree; the executor compiles it
+once per plan into a function over column arrays.  The same compiled form
+runs on both backends — ``numpy`` (host oracle / small local paths, the
+analog of the reference's row-at-a-time qual evaluation) and ``jax.numpy``
+inside a jitted kernel (the TPU path).  SQL three-valued logic is carried
+explicitly: every evaluation returns ``(values, valid)`` where ``valid``
+is the not-null mask; predicates treat NULL as false at the filter
+boundary, matching PostgreSQL semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from citus_tpu import types as T
+from citus_tpu.errors import AnalysisError
+
+# ---------------------------------------------------------------- nodes
+
+
+class BExpr:
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BColumn(BExpr):
+    name: str
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BLiteral(BExpr):
+    """Physical-encoded constant (None = SQL NULL)."""
+    value: Any
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BBinOp(BExpr):
+    op: str  # + - * / % = <> < <= > >= and or
+    left: BExpr
+    right: BExpr
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BUnOp(BExpr):
+    op: str  # not | -
+    operand: BExpr
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BScale(BExpr):
+    """Multiply by 10**power — decimal scale alignment."""
+    operand: BExpr
+    power: int
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BCast(BExpr):
+    operand: BExpr
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BIsNull(BExpr):
+    operand: BExpr
+    negated: bool
+    type: T.ColumnType = T.BOOL_T
+
+
+@dataclass(frozen=True)
+class BCase(BExpr):
+    whens: tuple[tuple[BExpr, BExpr], ...]
+    else_: Optional[BExpr]
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BDictMask(BExpr):
+    """Membership of a dictionary-encoded column in a precomputed id set
+    (LIKE / IN over text evaluate the pattern against the table-global
+    dictionary at bind time; the device just gathers a bool table)."""
+    operand: BExpr            # int32 dictionary ids
+    mask: tuple[bool, ...]    # mask[id] -> matches
+    type: T.ColumnType = T.BOOL_T
+
+
+@dataclass(frozen=True)
+class BAggRef(BExpr):
+    """Reference to aggregate slot ``index`` in the combine/final phase."""
+    index: int
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BKeyRef(BExpr):
+    """Reference to GROUP BY key ``index`` in the combine/final phase."""
+    index: int
+    type: T.ColumnType
+
+
+@dataclass(frozen=True)
+class BDateTrunc(BExpr):
+    """date_trunc to a fixed-width unit (device-computable on the physical
+    day/microsecond encodings)."""
+    unit: str  # hour | minute | day | week
+    operand: BExpr
+    type: T.ColumnType
+
+
+def walk(e: BExpr):
+    yield e
+    if isinstance(e, BBinOp):
+        yield from walk(e.left)
+        yield from walk(e.right)
+    elif isinstance(e, (BUnOp, BScale, BCast, BIsNull, BDictMask)):
+        yield from walk(e.operand)
+    elif isinstance(e, BCase):
+        for c, v in e.whens:
+            yield from walk(c)
+            yield from walk(v)
+        if e.else_ is not None:
+            yield from walk(e.else_)
+
+
+def referenced_columns(e: BExpr) -> list[str]:
+    return sorted({n.name for n in walk(e) if isinstance(n, BColumn)})
+
+
+# ---------------------------------------------------------- compilation
+
+
+def _trunc_div(xp, a, b):
+    """SQL integer division truncates toward zero (numpy/jnp floor_divide
+    rounds toward -inf, so do it on magnitudes)."""
+    sign = xp.sign(a) * xp.sign(b)
+    q = xp.abs(a) // xp.abs(xp.where(b == 0, 1, b))
+    return sign * q
+
+
+def compile_expr(e: BExpr, xp):
+    """BExpr -> fn(env) -> (values, valid). ``env`` maps column name ->
+    (values, valid) arrays; '__aggs__' -> list of (values, valid) for
+    BAggRef. ``xp`` is numpy or jax.numpy."""
+    if isinstance(e, BColumn):
+        name = e.name
+        return lambda env: env[name]
+    if isinstance(e, BLiteral):
+        if e.value is None:
+            zero = e.type.device_dtype.type(0)
+            return lambda env: (zero, False)
+        val = e.type.device_dtype.type(e.value)
+        return lambda env: (val, True)
+    if isinstance(e, BAggRef):
+        idx = e.index
+        return lambda env: env["__aggs__"][idx]
+    if isinstance(e, BKeyRef):
+        idx = e.index
+        return lambda env: env["__keys__"][idx]
+    if isinstance(e, BDateTrunc):
+        f = compile_expr(e.operand, xp)
+        if e.operand.type.kind == T.DATE:
+            units = {"day": 1, "week": 7}
+            if e.unit not in units:
+                raise AnalysisError(f"date_trunc({e.unit!r}) on date not supported")
+            step = np.int32(units[e.unit])
+            # epoch day 0 = Thursday; ISO weeks start Monday (epoch day -3)
+            off = np.int32(3 if e.unit == "week" else 0)
+            return lambda env: ((lambda v: (((v[0] + off) // step) * step - off, v[1]))(f(env)))
+        units = {"minute": 60_000_000, "hour": 3_600_000_000,
+                 "day": 86_400_000_000, "week": 7 * 86_400_000_000}
+        if e.unit not in units:
+            raise AnalysisError(f"date_trunc({e.unit!r}) not supported")
+        step = np.int64(units[e.unit])
+        off = np.int64(3 * 86_400_000_000 if e.unit == "week" else 0)
+        return lambda env: ((lambda v: (((v[0] + off) // step) * step - off, v[1]))(f(env)))
+    if isinstance(e, BScale):
+        f = compile_expr(e.operand, xp)
+        factor = e.type.device_dtype.type(10 ** e.power)
+        return lambda env: ((lambda v: (v[0] * factor, v[1]))(f(env)))
+    if isinstance(e, BCast):
+        f = compile_expr(e.operand, xp)
+        src, dst = e.operand.type, e.type
+        dt = dst.device_dtype
+        if src.is_decimal and dst.is_decimal:
+            diff = dst.scale - src.scale
+            if diff >= 0:
+                factor = dt.type(10 ** diff)
+                return lambda env: ((lambda v: (v[0].astype(dt) * factor, v[1]))(f(env)))
+            factor = dt.type(10 ** (-diff))
+            return lambda env: ((lambda v: (_trunc_div(xp, v[0], factor).astype(dt), v[1]))(f(env)))
+        if src.is_decimal and dst.is_float:
+            scale = 10.0 ** src.scale
+            return lambda env: ((lambda v: ((v[0] / scale).astype(dt), v[1]))(f(env)))
+        if dst.is_decimal and not src.is_decimal:
+            factor = 10 ** dst.scale
+            if src.is_float:
+                return lambda env: ((lambda v: (xp.round(v[0] * factor).astype(dt), v[1]))(f(env)))
+            return lambda env: ((lambda v: (v[0].astype(dt) * dt.type(factor), v[1]))(f(env)))
+        if src.is_decimal and dst.is_integer:
+            factor = np.int64(10 ** src.scale)
+            return lambda env: ((lambda v: (_trunc_div(xp, v[0], factor).astype(dt), v[1]))(f(env)))
+        return lambda env: ((lambda v: (v[0].astype(dt), v[1]))(f(env)))
+    if isinstance(e, BIsNull):
+        f = compile_expr(e.operand, xp)
+        neg = e.negated
+
+        def run_isnull(env):
+            _, valid = f(env)
+            if valid is True or valid is False:
+                out = valid if neg else not valid
+                return (np.bool_(out), True)
+            v = valid if neg else ~valid
+            return (v, True)
+        return run_isnull
+    if isinstance(e, BDictMask):
+        f = compile_expr(e.operand, xp)
+        table = xp.asarray(np.array(e.mask, dtype=bool))
+
+        def run_dictmask(env):
+            ids, valid = f(env)
+            n = table.shape[0]
+            safe = xp.clip(ids, 0, max(n - 1, 0))
+            return (table[safe] if n else xp.zeros_like(ids, dtype=bool), valid)
+        return run_dictmask
+    if isinstance(e, BUnOp):
+        f = compile_expr(e.operand, xp)
+        if e.op == "-":
+            return lambda env: ((lambda v: (-v[0], v[1]))(f(env)))
+        if e.op == "not":
+            # three-valued NOT: NULL stays NULL (valid mask unchanged)
+            return lambda env: ((lambda v: (~v[0] if v[0].dtype == bool else v[0] == 0, v[1]))(f(env)))
+        raise AnalysisError(f"unknown unary op {e.op}")
+    if isinstance(e, BCase):
+        conds = [(compile_expr(c, xp), compile_expr(v, xp)) for c, v in e.whens]
+        felse = compile_expr(e.else_, xp) if e.else_ is not None else None
+        dt = e.type.device_dtype
+
+        def run_case(env):
+            result = None
+            valid = None
+            taken = None
+            for fc, fv in conds:
+                cv, cvalid = fc(env)
+                vv, vvalid = fv(env)
+                # NULL condition = branch not taken (SQL CASE semantics)
+                cond = _as_bool(xp, cv) & _as_mask(xp, cvalid, cv)
+                vv = xp.asarray(vv).astype(dt)
+                if result is None:
+                    result = xp.where(cond, vv, dt.type(0))
+                    valid = xp.where(cond, _as_mask(xp, vvalid, vv), False)
+                    taken = cond
+                else:
+                    take = cond & ~taken
+                    result = xp.where(take, vv, result)
+                    valid = xp.where(take, _as_mask(xp, vvalid, vv), valid)
+                    taken = taken | cond
+            if felse is not None:
+                ev, evalid = felse(env)
+                ev = xp.asarray(ev).astype(dt)
+                result = xp.where(taken, result, ev)
+                valid = xp.where(taken, valid, _as_mask(xp, evalid, ev))
+            else:
+                valid = valid & taken
+            return (result, valid)
+        return run_case
+    if isinstance(e, BBinOp):
+        fl = compile_expr(e.left, xp)
+        fr = compile_expr(e.right, xp)
+        op = e.op
+        if op in ("and", "or"):
+            def run_logic(env):
+                lv, lvalid = fl(env)
+                rv, rvalid = fr(env)
+                lv = _as_bool(xp, lv)
+                rv = _as_bool(xp, rv)
+                lval = _as_mask(xp, lvalid, lv)
+                rval = _as_mask(xp, rvalid, rv)
+                if op == "and":
+                    # three-valued: NULL AND false = false, NULL AND true = NULL
+                    value = lv & rv
+                    valid = (lval & rval) | (lval & ~lv) | (rval & ~rv)
+                else:
+                    # NULL OR true = true, NULL OR false = NULL
+                    value = lv | rv
+                    valid = (lval & rval) | (lval & lv) | (rval & rv)
+                return (value, valid)
+            return run_logic
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            fn = {"=": lambda a, b: a == b, "<>": lambda a, b: a != b,
+                  "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+                  ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}[op]
+            return lambda env: _binary(xp, fl, fr, env, fn)
+        dt = e.type.device_dtype
+        if op == "+":
+            return lambda env: _binary(xp, fl, fr, env, lambda a, b: (a + b).astype(dt))
+        if op == "-":
+            return lambda env: _binary(xp, fl, fr, env, lambda a, b: (a - b).astype(dt))
+        if op == "*":
+            return lambda env: _binary(xp, fl, fr, env, lambda a, b: (a * b).astype(dt))
+        if op == "/":
+            if e.type.is_float:
+                return lambda env: _binary(xp, fl, fr, env,
+                                           lambda a, b: (a / xp.where(b == 0, 1, b)).astype(dt),
+                                           null_if=lambda a, b: b == 0)
+            return lambda env: _binary(xp, fl, fr, env,
+                                       lambda a, b: _trunc_div(xp, a, b).astype(dt),
+                                       null_if=lambda a, b: b == 0)
+        if op == "%":
+            return lambda env: _binary(xp, fl, fr, env,
+                                       lambda a, b: (a - _trunc_div(xp, a, b) * b).astype(dt),
+                                       null_if=lambda a, b: b == 0)
+        raise AnalysisError(f"unknown operator {op}")
+    raise AnalysisError(f"cannot compile {type(e).__name__}")
+
+
+def _as_bool(xp, v):
+    if hasattr(v, "dtype") and v.dtype != bool:
+        return v != 0
+    if isinstance(v, (bool, np.bool_)):
+        return np.bool_(v)
+    return v
+
+
+def _as_mask(xp, valid, like):
+    """Normalize python bool validity to an array mask matching ``like``."""
+    if valid is True:
+        return xp.ones_like(_as_bool(xp, like), dtype=bool) if hasattr(like, "shape") and like.shape else np.True_
+    if valid is False:
+        return xp.zeros_like(_as_bool(xp, like), dtype=bool) if hasattr(like, "shape") and like.shape else np.False_
+    return valid
+
+
+def _binary(xp, fl, fr, env, fn, null_if=None):
+    lv, lvalid = fl(env)
+    rv, rvalid = fr(env)
+    value = fn(lv, rv)
+    if lvalid is True and rvalid is True:
+        valid = True
+    elif lvalid is False or rvalid is False:
+        valid = False
+    else:
+        valid = _as_mask(xp, lvalid, value) & _as_mask(xp, rvalid, value)
+    if null_if is not None:
+        bad = null_if(lv, rv)
+        if hasattr(bad, "shape") or bad:
+            valid = _as_mask(xp, valid, value) & ~bad if hasattr(bad, "shape") else (False if bad else valid)
+    return (value, valid)
+
+
+def predicate_mask(xp, fn, env, n_rows_like):
+    """Evaluate a predicate; NULL -> false (WHERE semantics)."""
+    v, valid = fn(env)
+    v = _as_bool(xp, v)
+    if valid is True:
+        out = v
+    elif valid is False:
+        out = xp.zeros_like(v, dtype=bool) if hasattr(v, "shape") and v.shape else np.False_
+    else:
+        out = v & valid
+    if not (hasattr(out, "shape") and out.shape):
+        out = xp.full(n_rows_like.shape, bool(out)) if hasattr(n_rows_like, "shape") else out
+    return out

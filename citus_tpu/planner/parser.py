@@ -1,0 +1,591 @@
+"""SQL tokenizer + recursive-descent parser.
+
+Covers the analytical subset the engine executes: CREATE/DROP TABLE,
+INSERT ... VALUES / INSERT ... SELECT, SELECT with joins, WHERE, GROUP BY,
+HAVING, ORDER BY, LIMIT/OFFSET, EXPLAIN [ANALYZE], and the UDF-style
+utility calls the reference exposes (create_distributed_table, ...).
+The reference delegates parsing to PostgreSQL; we own it, so the grammar
+is intentionally a strict, unambiguous subset.
+"""
+
+from __future__ import annotations
+
+import decimal
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from citus_tpu.errors import SqlSyntaxError, UnsupportedFeatureError
+from citus_tpu.planner import ast_nodes as A
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+|\d+)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9$]*)
+  | (?P<op><=|>=|<>|!=|::|=|<|>|\+|-|\*|/|%|\(|\)|,|;|\.)
+    """,
+    re.VERBOSE,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "between", "is", "null",
+    "true", "false", "create", "drop", "table", "if", "exists", "insert",
+    "into", "values", "distinct", "asc", "desc", "nulls", "first", "last",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on",
+    "case", "when", "then", "else", "end", "cast", "explain", "analyze",
+    "using", "with", "like",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # num | str | ident | kw | op | eof
+    value: str
+    pos: int
+
+
+def tokenize(text: str) -> list[Token]:
+    out, pos = [], 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m:
+            raise SqlSyntaxError(f"unexpected character {text[pos]!r}", pos, text)
+        pos = m.end()
+        if m.lastgroup == "ws":
+            continue
+        kind, value = m.lastgroup, m.group()
+        if kind == "ident":
+            low = value.lower()
+            if low in KEYWORDS:
+                out.append(Token("kw", low, m.start()))
+            else:
+                out.append(Token("ident", low, m.start()))
+        else:
+            out.append(Token(kind, value, m.start()))
+    out.append(Token("eof", "", len(text)))
+    return out
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.toks = tokenize(text)
+        self.i = 0
+
+    # ---- token helpers -------------------------------------------------
+    def peek(self, offset: int = 0) -> Token:
+        return self.toks[min(self.i + offset, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in kws
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def accept_kw(self, *kws: str) -> Optional[Token]:
+        if self.at_kw(*kws):
+            return self.next()
+        return None
+
+    def accept_op(self, *ops: str) -> Optional[Token]:
+        if self.at_op(*ops):
+            return self.next()
+        return None
+
+    def expect_kw(self, kw: str) -> Token:
+        if not self.at_kw(kw):
+            self.error(f"expected {kw.upper()}")
+        return self.next()
+
+    def expect_op(self, op: str) -> Token:
+        if not self.at_op(op):
+            self.error(f"expected {op!r}")
+        return self.next()
+
+    def expect_ident(self) -> str:
+        t = self.peek()
+        if t.kind != "ident":
+            self.error("expected identifier")
+        self.next()
+        return t.value
+
+    def error(self, msg: str):
+        t = self.peek()
+        got = t.value or "end of input"
+        raise SqlSyntaxError(f"{msg}, got {got!r}", t.pos, self.text)
+
+    # ---- statements ----------------------------------------------------
+    def parse_statements(self) -> list[A.Statement]:
+        stmts = []
+        while self.peek().kind != "eof":
+            stmts.append(self.parse_statement())
+            while self.accept_op(";"):
+                pass
+        return stmts
+
+    def parse_statement(self) -> A.Statement:
+        if self.at_kw("explain"):
+            return self.parse_explain()
+        if self.at_kw("select"):
+            return self.parse_select_or_utility()
+        if self.at_kw("create"):
+            return self.parse_create_table()
+        if self.at_kw("drop"):
+            return self.parse_drop_table()
+        if self.at_kw("insert"):
+            return self.parse_insert()
+        self.error("expected a statement")
+
+    def parse_explain(self) -> A.Explain:
+        self.expect_kw("explain")
+        analyze = bool(self.accept_kw("analyze"))
+        return A.Explain(self.parse_statement(), analyze=analyze)
+
+    # -- CREATE TABLE t (col type [not null], ...) [using columnar] [with (...)]
+    def parse_create_table(self) -> A.CreateTable:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not") if self.at_kw("not") else self.error("expected NOT")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect_ident()
+        self.expect_op("(")
+        cols = []
+        while True:
+            cname = self.expect_ident()
+            tname, targs = self.parse_type_name()
+            not_null = False
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                not_null = True
+            cols.append(A.ColumnDef(cname, tname, targs, not_null))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        options: dict = {}
+        if self.accept_kw("using"):
+            options["access_method"] = self.expect_ident()
+        if self.accept_kw("with"):
+            self.expect_op("(")
+            while True:
+                key = self.expect_ident()
+                self.expect_op("=")
+                t = self.next()
+                options[key] = t.value.strip("'")
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return A.CreateTable(name, cols, if_not_exists, options)
+
+    def parse_type_name(self) -> tuple[str, list[int]]:
+        t = self.peek()
+        if t.kind not in ("ident", "kw"):
+            self.error("expected type name")
+        self.next()
+        name = t.value
+        # two-word types: double precision, character varying
+        if name == "double" and self.peek().kind == "ident" and self.peek().value == "precision":
+            self.next()
+        elif name == "character":
+            if self.peek().kind == "ident" and self.peek().value == "varying":
+                self.next()
+            name = "varchar"
+        args: list[int] = []
+        if self.at_op("("):
+            self.next()
+            while True:
+                nt = self.next()
+                if nt.kind != "num":
+                    self.error("expected number in type args")
+                args.append(int(nt.value))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return name, args
+
+    def parse_drop_table(self) -> A.DropTable:
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return A.DropTable(self.expect_ident(), if_exists)
+
+    def parse_insert(self) -> A.Insert:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        name = self.expect_ident()
+        cols = None
+        if self.at_op("("):
+            self.next()
+            cols = []
+            while True:
+                cols.append(self.expect_ident())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        if self.at_kw("select"):
+            return A.Insert(name, cols, [], select=self.parse_select())
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = []
+            while True:
+                row.append(self.parse_expr())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        return A.Insert(name, cols, rows)
+
+    # -- SELECT ----------------------------------------------------------
+    _UTILITY_FNS = {
+        "create_distributed_table", "create_reference_table",
+        "undistribute_table", "citus_add_node", "citus_remove_node",
+        "citus_set_coordinator_host", "rebalance_table_shards",
+        "citus_move_shard_placement", "citus_table_size",
+        "citus_shard_sizes", "master_get_active_worker_nodes",
+    }
+
+    def parse_select_or_utility(self) -> A.Statement:
+        save = self.i
+        self.expect_kw("select")
+        t = self.peek()
+        if (t.kind == "ident" and t.value in self._UTILITY_FNS
+                and self.peek(1).kind == "op" and self.peek(1).value == "("):
+            self.next()
+            self.expect_op("(")
+            args = []
+            if not self.at_op(")"):
+                while True:
+                    args.append(self.parse_utility_arg())
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            return A.UtilityCall(t.value, args)
+        self.i = save
+        return self.parse_select()
+
+    def parse_utility_arg(self):
+        t = self.next()
+        if t.kind == "str":
+            return t.value[1:-1].replace("''", "'")
+        if t.kind == "num":
+            return int(t.value) if "." not in t.value else float(t.value)
+        if t.kind == "kw" and t.value in ("true", "false"):
+            return t.value == "true"
+        if t.kind == "ident" and self.at_op("="):  # named arg: name => ignored
+            self.error("named utility arguments not supported")
+        if t.kind == "ident":
+            return t.value
+        self.error("bad utility argument")
+
+    def parse_select(self) -> A.Select:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        items = []
+        while True:
+            if self.at_op("*"):
+                self.next()
+                items.append(A.SelectItem(A.Star()))
+            else:
+                e = self.parse_expr()
+                alias = None
+                if self.accept_kw("as"):
+                    alias = self.expect_ident()
+                elif self.peek().kind == "ident":
+                    alias = self.expect_ident()
+                items.append(A.SelectItem(e, alias))
+            if not self.accept_op(","):
+                break
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self.parse_from()
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        group_by: list[A.Expr] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            while True:
+                group_by.append(self.parse_expr())
+                if not self.accept_op(","):
+                    break
+        having = None
+        if self.accept_kw("having"):
+            having = self.parse_expr()
+        order_by: list[A.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept_kw("asc"):
+                    pass
+                elif self.accept_kw("desc"):
+                    asc = False
+                nulls_first = None
+                if self.accept_kw("nulls"):
+                    if self.accept_kw("first"):
+                        nulls_first = True
+                    else:
+                        self.expect_kw("last")
+                        nulls_first = False
+                order_by.append(A.OrderItem(e, asc, nulls_first))
+                if not self.accept_op(","):
+                    break
+        limit = offset = None
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "num":
+                self.error("expected number after LIMIT")
+            limit = int(t.value)
+        if self.accept_kw("offset"):
+            t = self.next()
+            if t.kind != "num":
+                self.error("expected number after OFFSET")
+            offset = int(t.value)
+        return A.Select(items, from_, where, group_by, having, order_by,
+                        limit, offset, distinct)
+
+    def parse_from(self):
+        left = self.parse_table_ref()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.parse_table_ref()
+                left = A.Join(left, right, "cross", None)
+                continue
+            kind = None
+            if self.accept_kw("join") or self.accept_kw("inner"):
+                if self.peek(-1).value == "inner":
+                    self.expect_kw("join")
+                kind = "inner"
+            elif self.at_kw("left", "right", "full"):
+                kind = self.next().value
+                self.accept_kw("outer")
+                self.expect_kw("join")
+            if kind is None:
+                if self.accept_op(","):  # comma join = cross join
+                    right = self.parse_table_ref()
+                    left = A.Join(left, right, "cross", None)
+                    continue
+                break
+            right = self.parse_table_ref()
+            self.expect_kw("on")
+            cond = self.parse_expr()
+            left = A.Join(left, right, kind, cond)
+        return left
+
+    def parse_table_ref(self) -> A.TableRef:
+        if self.at_op("("):
+            raise UnsupportedFeatureError("subqueries in FROM are not supported yet")
+        name = self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek().kind == "ident":
+            alias = self.expect_ident()
+        return A.TableRef(name, alias)
+
+    # ---- expressions: precedence climbing ------------------------------
+    def parse_expr(self) -> A.Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> A.Expr:
+        left = self.parse_and()
+        while self.accept_kw("or"):
+            left = A.BinOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> A.Expr:
+        left = self.parse_not()
+        while self.accept_kw("and"):
+            left = A.BinOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> A.Expr:
+        if self.accept_kw("not"):
+            return A.UnOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> A.Expr:
+        left = self.parse_additive()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.next().value
+                if op == "!=":
+                    op = "<>"
+                left = A.BinOp(op, left, self.parse_additive())
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                if self.at_kw("between", "in", "like"):
+                    negated = True
+                else:
+                    self.i = save
+                    break
+            if self.accept_kw("between"):
+                lo = self.parse_additive()
+                self.expect_kw("and")
+                hi = self.parse_additive()
+                left = A.Between(left, lo, hi, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select"):
+                    raise UnsupportedFeatureError("IN (SELECT ...) not supported yet")
+                items = []
+                while True:
+                    items.append(self.parse_additive())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                left = A.InList(left, tuple(items), negated)
+                continue
+            if self.accept_kw("like"):
+                pattern = self.parse_additive()
+                left = A.FuncCall("like", (left, pattern))
+                if negated:
+                    left = A.UnOp("not", left)
+                continue
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                left = A.IsNull(left, neg)
+                continue
+            break
+        return left
+
+    def parse_additive(self) -> A.Expr:
+        left = self.parse_multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next().value
+            left = A.BinOp(op, left, self.parse_multiplicative())
+        return left
+
+    def parse_multiplicative(self) -> A.Expr:
+        left = self.parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            left = A.BinOp(op, left, self.parse_unary())
+        return left
+
+    def parse_unary(self) -> A.Expr:
+        if self.at_op("-"):
+            self.next()
+            return A.UnOp("-", self.parse_unary())
+        if self.at_op("+"):
+            self.next()
+            return self.parse_unary()
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> A.Expr:
+        e = self.parse_primary()
+        while self.accept_op("::"):
+            tname, targs = self.parse_type_name()
+            e = A.Cast(e, tname, tuple(targs))
+        return e
+
+    def parse_case(self) -> A.Expr:
+        self.expect_kw("case")
+        if not self.at_kw("when"):
+            raise UnsupportedFeatureError("simple CASE expr (CASE x WHEN ...) not supported")
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            whens.append((cond, self.parse_expr()))
+        else_ = None
+        if self.accept_kw("else"):
+            else_ = self.parse_expr()
+        self.expect_kw("end")
+        return A.CaseExpr(tuple(whens), else_)
+
+    def parse_primary(self) -> A.Expr:
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            if "." in t.value or "e" in t.value.lower():
+                if "e" in t.value.lower():
+                    return A.Literal(float(t.value), "float")
+                return A.Literal(decimal.Decimal(t.value), "decimal")
+            return A.Literal(int(t.value), "int")
+        if t.kind == "str":
+            self.next()
+            return A.Literal(t.value[1:-1].replace("''", "'"), "string")
+        if t.kind == "kw":
+            if t.value in ("true", "false"):
+                self.next()
+                return A.Literal(t.value == "true", "bool")
+            if t.value == "null":
+                self.next()
+                return A.Literal(None, "null")
+            if t.value == "case":
+                return self.parse_case()
+            if t.value == "cast":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_kw("as")
+                tname, targs = self.parse_type_name()
+                self.expect_op(")")
+                return A.Cast(e, tname, tuple(targs))
+            if t.value == "not":
+                self.next()
+                return A.UnOp("not", self.parse_comparison())
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "ident":
+            self.next()
+            if self.at_op("("):  # function call
+                self.next()
+                distinct = bool(self.accept_kw("distinct"))
+                args: list[A.Expr] = []
+                if self.at_op("*"):
+                    self.next()
+                    args.append(A.Star())
+                elif not self.at_op(")"):
+                    while True:
+                        args.append(self.parse_expr())
+                        if not self.accept_op(","):
+                            break
+                self.expect_op(")")
+                return A.FuncCall(t.value, tuple(args), distinct)
+            if self.accept_op("."):
+                col = self.expect_ident()
+                return A.ColumnRef(col, table=t.value)
+            return A.ColumnRef(t.value)
+        self.error("expected expression")
+
+
+def parse_sql(text: str) -> list[A.Statement]:
+    return Parser(text).parse_statements()
+
+
+def parse_statement(text: str) -> A.Statement:
+    stmts = parse_sql(text)
+    if len(stmts) != 1:
+        raise SqlSyntaxError(f"expected exactly one statement, got {len(stmts)}")
+    return stmts[0]

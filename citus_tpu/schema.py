@@ -1,0 +1,88 @@
+"""Relation schemas.
+
+A Schema is an ordered list of named, typed columns — the equivalent of a
+pg_attribute row set for one relation in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from citus_tpu.errors import AnalysisError
+from citus_tpu.types import ColumnType, type_from_sql
+
+
+@dataclass(frozen=True)
+class Column:
+    name: str
+    type: ColumnType
+    not_null: bool = False
+
+
+@dataclass
+class Schema:
+    columns: list[Column] = field(default_factory=list)
+
+    def __post_init__(self):
+        seen = set()
+        for c in self.columns:
+            if c.name in seen:
+                raise AnalysisError(f"duplicate column {c.name!r}")
+            seen.add(c.name)
+
+    def __iter__(self) -> Iterator[Column]:
+        return iter(self.columns)
+
+    def __len__(self) -> int:
+        return len(self.columns)
+
+    @property
+    def names(self) -> list[str]:
+        return [c.name for c in self.columns]
+
+    def has(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    def column(self, name: str) -> Column:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise AnalysisError(f"column {name!r} does not exist")
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise AnalysisError(f"column {name!r} does not exist")
+
+    def to_json(self) -> list:
+        return [
+            {"name": c.name, "kind": c.type.kind, "precision": c.type.precision,
+             "scale": c.type.scale, "not_null": c.not_null}
+            for c in self.columns
+        ]
+
+    @staticmethod
+    def from_json(data: list) -> "Schema":
+        return Schema([
+            Column(d["name"], ColumnType(d["kind"], d["precision"], d["scale"]), d["not_null"])
+            for d in data
+        ])
+
+    @staticmethod
+    def of(*cols: tuple) -> "Schema":
+        """Schema.of(("a", "bigint"), ("b", "decimal(12,2)")) convenience."""
+        out = []
+        for name, tspec in cols:
+            if isinstance(tspec, ColumnType):
+                out.append(Column(name, tspec))
+                continue
+            tspec = tspec.strip().lower()
+            if "(" in tspec:
+                base, rest = tspec.split("(", 1)
+                args = [int(x) for x in rest.rstrip(")").split(",")]
+                out.append(Column(name, type_from_sql(base.strip(), args)))
+            else:
+                out.append(Column(name, type_from_sql(tspec)))
+        return Schema(out)

@@ -1,0 +1,131 @@
+"""Shard reader: stripe/chunk iteration with skip-list pruning.
+
+Reference analog: ColumnarBeginRead/ColumnarReadNextRow and chunk skipping
+(src/backend/columnar/columnar_reader.c:148-180,323) — but instead of
+materializing one row per call, the unit of delivery is a whole chunk
+batch (values + validity per projected column), ready to be padded and
+shipped to a device kernel.  Pruning happens on the host from footer
+min/max stats before any stream bytes are read or decompressed, like
+SelectedChunkMask/BuildBaseConstraint in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+
+from citus_tpu.errors import StorageError
+from citus_tpu.schema import Schema
+from citus_tpu.storage.format import read_stripe_footer, read_chunk
+from citus_tpu.storage.writer import _load_meta
+
+
+@dataclass(frozen=True)
+class Interval:
+    """Closed/open numeric interval constraint on a column's physical
+    values — the pruning currency (analog of the reference's base
+    constraint over the skip list's min/max)."""
+
+    column: str
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+    lo_inclusive: bool = True
+    hi_inclusive: bool = True
+
+    def admits(self, cmin, cmax) -> bool:
+        """Could any value in [cmin, cmax] satisfy this constraint?"""
+        if cmin is None or cmax is None:
+            return True  # no stats -> cannot prune
+        if self.lo is not None:
+            if cmax < self.lo or (cmax == self.lo and not self.lo_inclusive):
+                return False
+        if self.hi is not None:
+            if cmin > self.hi or (cmin == self.hi and not self.hi_inclusive):
+                return False
+        return True
+
+
+@dataclass
+class ChunkBatch:
+    """One chunk group's worth of projected columns."""
+
+    values: dict[str, np.ndarray]
+    validity: dict[str, Optional[np.ndarray]]  # None = all valid
+    row_count: int
+    stripe_file: str
+    chunk_index: int
+
+
+class ShardReader:
+    """Reads one shard directory written by ShardWriter."""
+
+    def __init__(self, directory: str, schema: Schema):
+        self.directory = directory
+        self.schema = schema
+        self.meta = _load_meta(directory)
+
+    @property
+    def row_count(self) -> int:
+        return self.meta["row_count"]
+
+    @property
+    def stripe_files(self) -> list[str]:
+        return [s["file"] for s in self.meta["stripes"]]
+
+    def scan(
+        self,
+        columns: list[str],
+        constraints: Optional[list[Interval]] = None,
+    ) -> Iterator[ChunkBatch]:
+        """Yield chunk batches for the projected ``columns``, skipping
+        chunks refuted by ``constraints`` (conjunctive semantics)."""
+        constraints = constraints or []
+        for col in columns:
+            self.schema.column(col)  # validate projection
+        for stripe in self.meta["stripes"]:
+            path = os.path.join(self.directory, stripe["file"])
+            footer = read_stripe_footer(path)
+            selected = self._selected_chunks(footer, constraints)
+            if not selected.any():
+                continue
+            with open(path, "rb") as fh:
+                for ci in np.nonzero(selected)[0]:
+                    ci = int(ci)
+                    vals, valid = {}, {}
+                    for col in columns:
+                        stats = footer.columns[col][ci]
+                        v, m = read_chunk(fh, footer, stats, self.schema.column(col).type.storage_dtype)
+                        vals[col], valid[col] = v, m
+                    yield ChunkBatch(
+                        values=vals, validity=valid,
+                        row_count=footer.chunk_row_counts[ci],
+                        stripe_file=stripe["file"], chunk_index=ci)
+
+    def chunk_counts(self, constraints: Optional[list[Interval]] = None) -> tuple[int, int]:
+        """(selected_chunks, total_chunks) — for EXPLAIN/statistics."""
+        sel = tot = 0
+        for stripe in self.meta["stripes"]:
+            footer = read_stripe_footer(os.path.join(self.directory, stripe["file"]))
+            mask = self._selected_chunks(footer, constraints or [])
+            sel += int(mask.sum())
+            tot += footer.chunk_count
+        return sel, tot
+
+    def _selected_chunks(self, footer, constraints: list[Interval]) -> np.ndarray:
+        mask = np.ones(footer.chunk_count, dtype=bool)
+        for c in constraints:
+            chunks = footer.columns.get(c.column)
+            if chunks is None:
+                raise StorageError(f"constraint on unknown column {c.column!r}")
+            for ci, stats in enumerate(chunks):
+                if not mask[ci]:
+                    continue
+                if stats.row_count == stats.null_count:
+                    mask[ci] = False  # all null: no row can match a range
+                    continue
+                if not c.admits(stats.minimum, stats.maximum):
+                    mask[ci] = False
+        return mask

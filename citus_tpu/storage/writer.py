@@ -1,0 +1,141 @@
+"""Shard writer: buffers column batches into chunk groups and stripes.
+
+Reference analog: ColumnarBeginWrite/ColumnarWriteRow/FlushStripe
+(src/backend/columnar/columnar_writer.c:97,169,392) and the write-state
+management that makes a transaction's pending writes visible to its own
+scans (src/backend/columnar/write_state_management.c).  Here ingest is
+batch-columnar from the start (the distributed COPY path hands us column
+arrays), so the writer never sees single rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from citus_tpu.errors import StorageError
+from citus_tpu.schema import Schema
+from citus_tpu.storage.format import write_stripe_file
+
+SHARD_META = "shard_meta.json"
+
+
+def _load_meta(directory: str) -> dict:
+    p = os.path.join(directory, SHARD_META)
+    if not os.path.exists(p):
+        return {"stripes": [], "row_count": 0, "next_stripe_id": 1}
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def _store_meta(directory: str, meta: dict) -> None:
+    p = os.path.join(directory, SHARD_META)
+    tmp = p + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, p)
+
+
+class ShardWriter:
+    """Append-only writer for one shard of one table."""
+
+    def __init__(self, directory: str, schema: Schema, *, chunk_row_limit: int,
+                 stripe_row_limit: int, codec: str = "zstd", level: int = 3):
+        if stripe_row_limit % chunk_row_limit != 0:
+            raise StorageError("stripe_row_limit must be a multiple of chunk_row_limit")
+        self.directory = directory
+        self.schema = schema
+        self.chunk_row_limit = chunk_row_limit
+        self.stripe_row_limit = stripe_row_limit
+        self.codec = codec
+        self.level = level
+        os.makedirs(directory, exist_ok=True)
+        self._buf: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
+        self._buf_valid: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
+        self._buf_rows = 0
+
+    # ------------------------------------------------------------------
+    def append_batch(self, values: dict[str, np.ndarray],
+                     validity: Optional[dict[str, np.ndarray]] = None) -> None:
+        """Append a column batch.  ``values[col]`` are physical-encoded
+        arrays, all the same length; ``validity[col]`` bool arrays (missing
+        key = all valid)."""
+        lengths = {len(v) for v in values.values()}
+        if len(lengths) != 1:
+            raise StorageError("ragged column batch")
+        n = lengths.pop()
+        if n == 0:
+            return
+        if set(values) != set(self._buf):
+            raise StorageError(f"batch columns {sorted(values)} != schema {sorted(self._buf)}")
+        for col in self.schema.names:
+            v = np.asarray(values[col], dtype=self.schema.column(col).type.storage_dtype)
+            self._buf[col].append(v)
+            va = None if validity is None else validity.get(col)
+            self._buf_valid[col].append(
+                np.ones(n, dtype=bool) if va is None else np.asarray(va, dtype=bool))
+        self._buf_rows += n
+        while self._buf_rows >= self.stripe_row_limit:
+            self._flush_rows(self.stripe_row_limit)
+
+    def flush(self) -> None:
+        """Flush any buffered rows as a (possibly short) final stripe."""
+        if self._buf_rows:
+            self._flush_rows(self._buf_rows)
+
+    @property
+    def row_count(self) -> int:
+        return _load_meta(self.directory)["row_count"] + self._buf_rows
+
+    # ------------------------------------------------------------------
+    def _take(self, store: dict, col: str, n: int) -> np.ndarray:
+        chunks, got, out = store[col], 0, []
+        while got < n:
+            head = chunks[0]
+            take = min(n - got, len(head))
+            out.append(head[:take])
+            if take == len(head):
+                chunks.pop(0)
+            else:
+                chunks[0] = head[take:]
+            got += take
+        return np.concatenate(out) if len(out) != 1 else out[0]
+
+    def _flush_rows(self, n: int) -> None:
+        column_chunks: dict[str, list] = {}
+        chunk_rows: list[int] = []
+        col_vals = {c: self._take(self._buf, c, n) for c in self.schema.names}
+        col_valid = {c: self._take(self._buf_valid, c, n) for c in self.schema.names}
+        for start in range(0, n, self.chunk_row_limit):
+            stop = min(start + self.chunk_row_limit, n)
+            chunk_rows.append(stop - start)
+        for col in self.schema.names:
+            chunks = []
+            for start in range(0, n, self.chunk_row_limit):
+                stop = min(start + self.chunk_row_limit, n)
+                vals = col_vals[col][start:stop]
+                valid = col_valid[col][start:stop]
+                # null slots hold 0 so compression and device kernels see
+                # deterministic bytes
+                if not valid.all():
+                    vals = np.where(valid, vals, vals.dtype.type(0))
+                    chunks.append((vals, valid))
+                else:
+                    chunks.append((vals, None))
+            column_chunks[col] = chunks
+        meta = _load_meta(self.directory)
+        sid = meta["next_stripe_id"]
+        fname = f"stripe-{sid:06d}.cts"
+        write_stripe_file(
+            os.path.join(self.directory, fname), column_chunks, chunk_rows,
+            self.chunk_row_limit, self.codec, self.level)
+        meta["stripes"].append({"file": fname, "row_count": n})
+        meta["row_count"] += n
+        meta["next_stripe_id"] = sid + 1
+        _store_meta(self.directory, meta)
+        self._buf_rows -= n

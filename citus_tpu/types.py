@@ -1,0 +1,285 @@
+"""Column type system.
+
+Design goal: every SQL type maps to a fixed-width *physical* representation
+that a TPU kernel can process, with exact (bit-identical) aggregate
+semantics for the types the reference's analytics path cares about
+(reference: the NUMERIC/aggregate machinery used by
+multi_logical_optimizer.c's worker/master aggregate split).
+
+Physical encodings:
+
+=============  =====================  ============================
+SQL type       storage dtype          semantics
+=============  =====================  ============================
+BOOL           int8                   0/1
+SMALLINT       int16                  widened to int64 on device
+INT/INTEGER    int32                  widened to int64 on device
+BIGINT         int64
+REAL           float32
+DOUBLE         float64
+DECIMAL(p,s)   int64                  value * 10**s (exact fixed point)
+DATE           int32                  days since 1970-01-01
+TIMESTAMP      int64                  microseconds since epoch
+TEXT/VARCHAR   int32                  table-global dictionary id
+=============  =====================  ============================
+
+Exactness: DECIMAL arithmetic and SUM/AVG run on scaled int64, so results
+are bit-identical regardless of reduction order — this is what lets the
+per-shard partial aggregate + ``psum`` combine reproduce the single-node
+answer exactly (the reference gets the same property from PostgreSQL's
+arbitrary-precision NUMERIC).
+
+Nulls are carried in a separate validity bitmap (storage) / bool mask
+(device); the value slot under a null is 0.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from citus_tpu.errors import AnalysisError
+
+# type kinds
+BOOL = "bool"
+INT16 = "int16"
+INT32 = "int32"
+INT64 = "int64"
+FLOAT32 = "float32"
+FLOAT64 = "float64"
+DECIMAL = "decimal"
+DATE = "date"
+TIMESTAMP = "timestamp"
+TEXT = "text"
+
+_EPOCH_DATE = datetime.date(1970, 1, 1)
+
+_STORAGE_DTYPES = {
+    BOOL: np.int8,
+    INT16: np.int16,
+    INT32: np.int32,
+    INT64: np.int64,
+    FLOAT32: np.float32,
+    FLOAT64: np.float64,
+    DECIMAL: np.int64,
+    DATE: np.int32,
+    TIMESTAMP: np.int64,
+    TEXT: np.int32,
+}
+
+# dtype the expression/aggregate kernels compute in
+_DEVICE_DTYPES = {
+    BOOL: np.int32,
+    INT16: np.int64,
+    INT32: np.int64,
+    INT64: np.int64,
+    FLOAT32: np.float32,
+    FLOAT64: np.float64,
+    DECIMAL: np.int64,
+    DATE: np.int32,
+    TIMESTAMP: np.int64,
+    TEXT: np.int32,
+}
+
+
+@dataclass(frozen=True)
+class ColumnType:
+    kind: str
+    precision: int = 0  # DECIMAL only
+    scale: int = 0      # DECIMAL only
+
+    # ---- classification ------------------------------------------------
+    @property
+    def is_integer(self) -> bool:
+        return self.kind in (INT16, INT32, INT64)
+
+    @property
+    def is_float(self) -> bool:
+        return self.kind in (FLOAT32, FLOAT64)
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.kind == DECIMAL
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_float or self.is_decimal
+
+    @property
+    def is_text(self) -> bool:
+        return self.kind == TEXT
+
+    @property
+    def is_orderable_physical(self) -> bool:
+        """True when physical-value order == logical order (everything but
+        TEXT, whose dictionary ids are assigned in insertion order)."""
+        return self.kind != TEXT
+
+    # ---- dtypes --------------------------------------------------------
+    @property
+    def storage_dtype(self) -> np.dtype:
+        return np.dtype(_STORAGE_DTYPES[self.kind])
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(_DEVICE_DTYPES[self.kind])
+
+    # ---- value conversion ----------------------------------------------
+    def to_physical(self, value: Any) -> int | float:
+        """Python value -> physical scalar (dictionary ids handled by caller
+        for TEXT)."""
+        if value is None:
+            return 0
+        k = self.kind
+        if k == BOOL:
+            return 1 if value else 0
+        if k in (INT16, INT32, INT64):
+            return int(value)
+        if k in (FLOAT32, FLOAT64):
+            return float(value)
+        if k == DECIMAL:
+            d = value if isinstance(value, decimal.Decimal) else decimal.Decimal(str(value))
+            q = d.scaleb(self.scale).to_integral_value(rounding=decimal.ROUND_HALF_UP)
+            return int(q)
+        if k == DATE:
+            if isinstance(value, str):
+                value = datetime.date.fromisoformat(value)
+            return (value - _EPOCH_DATE).days
+        if k == TIMESTAMP:
+            if isinstance(value, str):
+                value = datetime.datetime.fromisoformat(value)
+            # integer arithmetic: float .timestamp() loses sub-us precision
+            delta = value.replace(tzinfo=None) - datetime.datetime(1970, 1, 1)
+            return delta // datetime.timedelta(microseconds=1)
+        raise AnalysisError(f"cannot convert value for type {self}")
+
+    def from_physical(self, raw: int | float, null: bool = False) -> Any:
+        """Physical scalar -> Python value (TEXT handled by caller)."""
+        if null:
+            return None
+        k = self.kind
+        if k == BOOL:
+            return bool(raw)
+        if k in (INT16, INT32, INT64):
+            return int(raw)
+        if k in (FLOAT32, FLOAT64):
+            return float(raw)
+        if k == DECIMAL:
+            return decimal.Decimal(int(raw)).scaleb(-self.scale)
+        if k == DATE:
+            return _EPOCH_DATE + datetime.timedelta(days=int(raw))
+        if k == TIMESTAMP:
+            return datetime.datetime.fromtimestamp(raw / 1_000_000, tz=datetime.timezone.utc).replace(tzinfo=None)
+        raise AnalysisError(f"cannot convert value for type {self}")
+
+    def __str__(self) -> str:
+        if self.kind == DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
+        return self.kind
+
+
+# canonical singletons
+BOOL_T = ColumnType(BOOL)
+INT16_T = ColumnType(INT16)
+INT32_T = ColumnType(INT32)
+INT64_T = ColumnType(INT64)
+FLOAT32_T = ColumnType(FLOAT32)
+FLOAT64_T = ColumnType(FLOAT64)
+DATE_T = ColumnType(DATE)
+TIMESTAMP_T = ColumnType(TIMESTAMP)
+TEXT_T = ColumnType(TEXT)
+
+
+def decimal_t(precision: int, scale: int) -> ColumnType:
+    if scale < 0 or precision <= 0 or scale > precision:
+        raise AnalysisError(f"invalid decimal({precision},{scale})")
+    return ColumnType(DECIMAL, precision, scale)
+
+
+_SQL_NAMES = {
+    "bool": BOOL_T,
+    "boolean": BOOL_T,
+    "smallint": INT16_T,
+    "int2": INT16_T,
+    "int": INT32_T,
+    "integer": INT32_T,
+    "int4": INT32_T,
+    "bigint": INT64_T,
+    "int8": INT64_T,
+    "real": FLOAT32_T,
+    "float4": FLOAT32_T,
+    "double": FLOAT64_T,
+    "float8": FLOAT64_T,
+    "date": DATE_T,
+    "timestamp": TIMESTAMP_T,
+    "text": TEXT_T,
+    "varchar": TEXT_T,
+    "char": TEXT_T,
+}
+
+
+def type_from_sql(name: str, args: Optional[list[int]] = None) -> ColumnType:
+    name = name.lower()
+    if name in ("decimal", "numeric"):
+        if not args:
+            # NUMERIC without precision: default a wide fixed-point
+            return decimal_t(18, 4)
+        if len(args) == 1:
+            return decimal_t(args[0], 0)
+        return decimal_t(args[0], args[1])
+    if name in ("double",) and args is None:
+        return FLOAT64_T
+    t = _SQL_NAMES.get(name)
+    if t is None:
+        raise AnalysisError(f"unknown type name: {name}")
+    return t
+
+
+# ---- arithmetic result typing ------------------------------------------
+
+def common_super_type(a: ColumnType, b: ColumnType) -> ColumnType:
+    """Result type for +,-,* style binary arithmetic and for comparisons'
+    operand alignment.  Mirrors (simplified) PostgreSQL numeric promotion."""
+    if a == b:
+        return a
+    if a.is_float or b.is_float:
+        return FLOAT64_T
+    if a.is_decimal or b.is_decimal:
+        # int op decimal -> decimal with the larger scale
+        sa = a.scale if a.is_decimal else 0
+        sb = b.scale if b.is_decimal else 0
+        return decimal_t(38, max(sa, sb))
+    if a.is_integer and b.is_integer:
+        return INT64_T
+    if a.kind == b.kind:
+        return a
+    if {a.kind, b.kind} == {DATE, TIMESTAMP}:
+        return TIMESTAMP_T
+    raise AnalysisError(f"no common type for {a} and {b}")
+
+
+def arith_result_type(op: str, a: ColumnType, b: ColumnType) -> ColumnType:
+    if not (a.is_numeric and b.is_numeric):
+        # allow date +/- int (day arithmetic)
+        if op in ("+", "-") and a.kind == DATE and b.is_integer:
+            return DATE_T
+        raise AnalysisError(f"operator {op} not defined for {a}, {b}")
+    if op == "/":
+        # exact decimal division is finalized on host; device computes
+        # float64 (documented divergence from PG NUMERIC division)
+        if a.is_float or b.is_float or a.is_decimal or b.is_decimal:
+            return FLOAT64_T
+        return INT64_T  # SQL integer division truncates
+    if op == "%":
+        if a.is_integer and b.is_integer:
+            return INT64_T
+        raise AnalysisError("% requires integers")
+    if op == "*" and (a.is_decimal or b.is_decimal) and not (a.is_float or b.is_float):
+        sa = a.scale if a.is_decimal else 0
+        sb = b.scale if b.is_decimal else 0
+        return decimal_t(38, sa + sb)  # scales add on multiply
+    return common_super_type(a, b)

@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+Multi-"node" behavior is tested the way the reference tests multi-node
+clusters on one box (src/test/regress/pg_regress_multi.pl launches a
+coordinator + workers on localhost): we force JAX onto the host platform
+with 8 virtual devices so every sharding/collective path runs exactly as
+it would on an 8-chip TPU slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_cluster(tmp_path):
+    import citus_tpu as ct
+
+    cluster = ct.Cluster(str(tmp_path / "db"))
+    yield cluster
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

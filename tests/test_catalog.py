@@ -1,0 +1,101 @@
+"""Catalog + hash sharding tests (pg_dist_* equivalents)."""
+
+import numpy as np
+import pytest
+
+from citus_tpu.catalog import Catalog, DistributionMethod
+from citus_tpu.catalog.hashing import (
+    INT32_MAX, INT32_MIN, hash_int64, shard_hash_ranges,
+    shard_index_for_hash, shard_index_for_values,
+)
+from citus_tpu.errors import CatalogError
+from citus_tpu.schema import Schema
+
+
+def test_hash_ranges_cover_int32_space():
+    for count in [1, 2, 3, 7, 8, 32]:
+        ranges = shard_hash_ranges(count)
+        assert ranges[0][0] == INT32_MIN
+        assert ranges[-1][1] == INT32_MAX
+        for (lo1, hi1), (lo2, hi2) in zip(ranges, ranges[1:]):
+            assert lo2 == hi1 + 1
+            assert lo1 <= hi1
+
+
+def test_hash_deterministic_and_spread():
+    v = np.arange(100000, dtype=np.int64)
+    h1, h2 = hash_int64(v), hash_int64(v)
+    np.testing.assert_array_equal(h1, h2)
+    idx = shard_index_for_hash(h1, 8)
+    counts = np.bincount(idx, minlength=8)
+    # roughly uniform: each shard within 20% of mean
+    assert counts.min() > 100000 / 8 * 0.8
+    assert counts.max() < 100000 / 8 * 1.2
+
+
+def test_shard_index_matches_ranges():
+    v = np.arange(5000, dtype=np.int64) * 7919
+    h = hash_int64(v)
+    for count in [2, 5, 8]:
+        ranges = shard_hash_ranges(count)
+        idx = shard_index_for_hash(h, count)
+        for hv, i in zip(h.tolist(), idx.tolist()):
+            lo, hi = ranges[i]
+            assert lo <= hv <= hi
+
+
+def test_catalog_create_distribute_persist(tmp_path):
+    cat = Catalog(str(tmp_path))
+    schema = Schema.of(("id", "bigint"), ("v", "double"))
+    cat.create_table("t", schema)
+    assert cat.table("t").method == DistributionMethod.LOCAL
+    nodes = cat.ensure_nodes(4)
+    cat.distribute_table("t", "id", 8, nodes)
+    cat.commit()
+
+    cat2 = Catalog(str(tmp_path))
+    t = cat2.table("t")
+    assert t.method == DistributionMethod.HASH
+    assert t.dist_column == "id"
+    assert t.shard_count == 8
+    assert [s.hash_min for s in t.shards][0] == INT32_MIN
+    # round-robin placements over 4 nodes
+    assert [s.placements[0] for s in t.shards] == [0, 1, 2, 3, 0, 1, 2, 3]
+
+
+def test_catalog_colocation(tmp_path):
+    cat = Catalog(str(tmp_path))
+    s = Schema.of(("id", "bigint"))
+    cat.create_table("a", s)
+    cat.create_table("b", s)
+    nodes = cat.ensure_nodes(2)
+    cat.distribute_table("a", "id", 4, nodes)
+    cat.distribute_table("b", "id", 4, nodes, colocate_with="a")
+    assert cat.table("a").colocation_id == cat.table("b").colocation_id
+    cat.create_table("c", s)
+    with pytest.raises(CatalogError):
+        cat.distribute_table("c", "id", 8, nodes, colocate_with="a")
+
+
+def test_catalog_errors(tmp_path):
+    cat = Catalog(str(tmp_path))
+    with pytest.raises(CatalogError):
+        cat.table("missing")
+    s = Schema.of(("id", "bigint"), ("f", "double"))
+    cat.create_table("t", s)
+    with pytest.raises(CatalogError):
+        cat.create_table("t", s)
+    with pytest.raises(CatalogError):
+        cat.distribute_table("t", "f", 4, [0])  # float dist col
+
+
+def test_text_dictionary_roundtrip(tmp_path):
+    cat = Catalog(str(tmp_path))
+    ids = cat.encode_strings("t", "c", ["x", "y", "x", "z"])
+    assert ids == [0, 1, 0, 2]
+    assert cat.decode_strings("t", "c", ids) == ["x", "y", "x", "z"]
+    cat.commit()
+    cat2 = Catalog(str(tmp_path))
+    assert cat2.encode_strings("t", "c", ["z", "w"]) == [2, 3]
+    assert cat2.lookup_string_id("t", "c", "y") == 1
+    assert cat2.lookup_string_id("t", "c", "nope") is None

@@ -1,0 +1,90 @@
+"""Regression tests for plan-cache/statistics invalidation and ingest
+edge cases found in review."""
+
+import datetime
+
+import numpy as np
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+import pytest
+
+
+def test_drop_recreate_invalidates_plan_cache(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (a bigint)")
+    cl.execute("INSERT INTO t VALUES (1), (2)")
+    sql = "SELECT count(*) FROM t"
+    assert cl.execute(sql).rows == [(2,)]
+    cl.execute("DROP TABLE t")
+    with pytest.raises(CatalogError):
+        cl.execute(sql)
+    cl.execute("CREATE TABLE t (a bigint)")
+    # recreated table must start empty (old shard files removed)
+    assert cl.execute(sql).rows == [(0,)]
+    cl.execute("INSERT INTO t VALUES (7)")
+    assert cl.execute(sql).rows == [(1,)]
+
+
+def test_drop_recreate_resets_text_dictionary(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (c text)")
+    cl.execute("INSERT INTO t VALUES ('old1'), ('old2')")
+    cl.execute("DROP TABLE t")
+    cl.execute("CREATE TABLE t (c text)")
+    assert cl.catalog.dictionary("t", "c") == []
+    cl.execute("INSERT INTO t VALUES ('new')")
+    assert cl.execute("SELECT c FROM t").rows == [("new",)]
+
+
+def test_stats_cache_isolated_between_clusters(tmp_path):
+    a = ct.Cluster(str(tmp_path / "a"))
+    b = ct.Cluster(str(tmp_path / "b"))
+    a.execute("CREATE TABLE t (g bigint)")
+    b.execute("CREATE TABLE t (g bigint)")
+    a.execute("INSERT INTO t VALUES (1), (2), (3)")
+    b.execute("INSERT INTO t VALUES (100), (200), (300)")
+    assert a.execute("SELECT g, count(*) FROM t GROUP BY g ORDER BY g").rows == \
+        [(1, 1), (2, 1), (3, 1)]
+    assert b.execute("SELECT g, count(*) FROM t GROUP BY g ORDER BY g").rows == \
+        [(100, 1), (200, 1), (300, 1)]
+
+
+def test_count_constant_arg_hash_mode(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (f double)")
+    cl.copy_from("t", columns={"f": np.array([1.5, 1.5, 2.5])})
+    # float group key -> hash_host mode; count(1) has a constant argument
+    r = cl.execute("SELECT f, count(1) FROM t GROUP BY f ORDER BY f")
+    assert r.rows == [(1.5, 2), (2.5, 1)]
+
+
+def test_decimal_rounding_consistent_between_ingest_paths(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE a (d decimal(10,2))")
+    cl.execute("CREATE TABLE b (d decimal(10,2))")
+    vals = [0.125, -0.125, 2.675]
+    cl.copy_from("a", columns={"d": np.array(vals)})          # ndarray fast path
+    cl.copy_from("b", rows=[(v,) for v in vals])              # object path
+    ra = cl.execute("SELECT d FROM a ORDER BY d").rows
+    rb = cl.execute("SELECT d FROM b ORDER BY d").rows
+    assert ra == rb
+
+
+def test_timestamp_roundtrip_exact(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (ts timestamp)")
+    ts = datetime.datetime(2026, 7, 28, 12, 0, 0, 1)
+    cl.copy_from("t", rows=[(ts,)])
+    assert cl.execute("SELECT ts FROM t").rows == [(ts,)]
+
+
+def test_ingest_invalidates_cached_group_domains(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (g bigint)")
+    cl.execute("INSERT INTO t VALUES (1), (2)")
+    sql = "SELECT g, count(*) FROM t GROUP BY g ORDER BY g"
+    assert cl.execute(sql).rows == [(1, 1), (2, 1)]
+    # new values outside the old [1,2] domain must still group correctly
+    cl.execute("INSERT INTO t VALUES (50), (50), (2)")
+    assert cl.execute(sql).rows == [(1, 1), (2, 2), (50, 2)]

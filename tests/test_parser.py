@@ -1,0 +1,118 @@
+"""SQL parser tests."""
+
+import decimal
+
+import pytest
+
+from citus_tpu.errors import SqlSyntaxError
+from citus_tpu.planner import ast as A
+from citus_tpu.planner import parse_statement
+
+
+def test_create_table():
+    s = parse_statement(
+        "CREATE TABLE lineitem (l_orderkey bigint NOT NULL, l_quantity decimal(12,2), "
+        "l_shipdate date, l_comment varchar(44)) USING columnar WITH (compression = 'zstd')")
+    assert isinstance(s, A.CreateTable)
+    assert s.name == "lineitem"
+    assert [c.name for c in s.columns] == ["l_orderkey", "l_quantity", "l_shipdate", "l_comment"]
+    assert s.columns[0].not_null
+    assert s.columns[1].type_args == [12, 2]
+    assert s.options == {"access_method": "columnar", "compression": "zstd"}
+
+
+def test_insert_values():
+    s = parse_statement("INSERT INTO t (a, b) VALUES (1, 'x'), (2, NULL)")
+    assert isinstance(s, A.Insert)
+    assert s.columns == ["a", "b"]
+    assert len(s.rows) == 2
+    assert s.rows[0][0] == A.Literal(1, "int")
+    assert s.rows[1][1] == A.Literal(None, "null")
+
+
+def test_select_q6_shape():
+    s = parse_statement(
+        "SELECT sum(l_extendedprice * l_discount) AS revenue FROM lineitem "
+        "WHERE l_shipdate >= '1994-01-01' AND l_shipdate < '1995-01-01' "
+        "AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24")
+    assert isinstance(s, A.Select)
+    assert s.items[0].alias == "revenue"
+    f = s.items[0].expr
+    assert isinstance(f, A.FuncCall) and f.name == "sum"
+    # where is a conjunction tree
+    assert isinstance(s.where, A.BinOp) and s.where.op == "and"
+
+
+def test_select_group_order_limit():
+    s = parse_statement(
+        "SELECT l_returnflag, l_linestatus, count(*) AS c FROM lineitem "
+        "GROUP BY l_returnflag, l_linestatus "
+        "ORDER BY l_returnflag, l_linestatus DESC NULLS FIRST LIMIT 10 OFFSET 2")
+    assert len(s.group_by) == 2
+    assert s.order_by[1].ascending is False
+    assert s.order_by[1].nulls_first is True
+    assert s.limit == 10 and s.offset == 2
+    c = s.items[2].expr
+    assert isinstance(c.args[0], A.Star)
+
+
+def test_operator_precedence():
+    s = parse_statement("SELECT a + b * c - d FROM t")
+    e = s.items[0].expr
+    # ((a + (b*c)) - d)
+    assert e.op == "-"
+    assert e.left.op == "+"
+    assert e.left.right.op == "*"
+    s2 = parse_statement("SELECT 1 FROM t WHERE a = 1 OR b = 2 AND c = 3")
+    assert s2.where.op == "or"
+    assert s2.where.right.op == "and"
+
+
+def test_joins():
+    s = parse_statement(
+        "SELECT o.o_orderkey FROM orders o JOIN lineitem l ON o.o_orderkey = l.l_orderkey "
+        "LEFT JOIN customer c ON c.c_custkey = o.o_custkey")
+    j = s.from_
+    assert isinstance(j, A.Join) and j.kind == "left"
+    assert j.left.kind == "inner"
+    assert j.left.left.alias == "o"
+
+
+def test_utility_call():
+    s = parse_statement("SELECT create_distributed_table('lineitem', 'l_orderkey')")
+    assert isinstance(s, A.UtilityCall)
+    assert s.args == ["lineitem", "l_orderkey"]
+
+
+def test_case_cast_in_isnull():
+    s = parse_statement(
+        "SELECT CASE WHEN a > 0 THEN 1 ELSE 0 END, CAST(b AS bigint), c::decimal(10,2) "
+        "FROM t WHERE d IS NOT NULL AND e IN (1, 2, 3)")
+    assert isinstance(s.items[0].expr, A.CaseExpr)
+    assert isinstance(s.items[1].expr, A.Cast)
+    assert isinstance(s.items[2].expr, A.Cast)
+    assert isinstance(s.where.left, A.IsNull) and s.where.left.negated
+    assert isinstance(s.where.right, A.InList)
+
+
+def test_literals():
+    s = parse_statement("SELECT 1, 1.5, 1e3, 'it''s', true, NULL FROM t")
+    vals = [i.expr for i in s.items]
+    assert vals[0] == A.Literal(1, "int")
+    assert vals[1] == A.Literal(decimal.Decimal("1.5"), "decimal")
+    assert vals[2] == A.Literal(1000.0, "float")
+    assert vals[3] == A.Literal("it's", "string")
+    assert vals[4] == A.Literal(True, "bool")
+    assert vals[5] == A.Literal(None, "null")
+
+
+def test_syntax_errors():
+    for bad in ["SELECT", "SELECT FROM t", "CREATE TABLE", "INSERT INTO", "SELECT * FROM"]:
+        with pytest.raises(SqlSyntaxError):
+            parse_statement(bad)
+
+
+def test_explain():
+    s = parse_statement("EXPLAIN ANALYZE SELECT count(*) FROM t")
+    assert isinstance(s, A.Explain) and s.analyze
+    assert isinstance(s.statement, A.Select)
