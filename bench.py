@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Benchmark: TPC-H Q1 rows scanned/sec/chip on columnar lineitem.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference's columnar scan + GROUP BY SUM runs
+75 M rows in 16 s on its microbench box = 4.6875 M rows/s.  vs_baseline
+is our warm Q1 rows/s divided by that.
+
+Data persists in .bench_data/ across runs (ingest is skipped when the
+table already exists at the right scale).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import citus_tpu as ct  # noqa: E402
+
+BASELINE_ROWS_PER_SEC = 75_000_000 / 16.0
+N_ROWS = 6_000_000  # ~TPC-H SF1 lineitem
+SHARDS = 8
+
+Q1 = """SELECT l_returnflag, l_linestatus,
+  sum(l_quantity) AS sum_qty,
+  sum(l_extendedprice) AS sum_base_price,
+  sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+  sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+  avg(l_quantity) AS avg_qty, avg(l_extendedprice) AS avg_price,
+  avg(l_discount) AS avg_disc, count(*) AS count_order
+FROM lineitem WHERE l_shipdate <= '1998-09-02'
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus"""
+
+
+def ensure_data(cl: "ct.Cluster") -> None:
+    if cl.catalog.has_table("lineitem"):
+        from citus_tpu.catalog.stats import table_row_count
+        if table_row_count(cl.catalog, cl.catalog.table("lineitem")) == N_ROWS:
+            return
+        cl.drop_table("lineitem")
+    cl.execute("""CREATE TABLE lineitem (
+        l_orderkey bigint NOT NULL, l_quantity decimal(12,2),
+        l_extendedprice decimal(12,2), l_discount decimal(12,2),
+        l_tax decimal(12,2), l_returnflag text, l_linestatus text,
+        l_shipdate date)""")
+    cl.execute(f"SELECT create_distributed_table('lineitem', 'l_orderkey', {SHARDS})")
+    rng = np.random.default_rng(7)
+    chunk = 1_000_000
+    rf = np.array(["A", "N", "R"])
+    ls = np.array(["F", "O"])
+    for start in range(0, N_ROWS, chunk):
+        n = min(chunk, N_ROWS - start)
+        cl.copy_from("lineitem", columns={
+            "l_orderkey": rng.integers(0, N_ROWS // 4, n),
+            "l_quantity": (rng.integers(100, 5100, n) / 100.0),
+            "l_extendedprice": (rng.integers(90_000, 10_500_000, n) / 100.0),
+            "l_discount": (rng.integers(0, 11, n) / 100.0),
+            "l_tax": (rng.integers(0, 9, n) / 100.0),
+            "l_returnflag": rf[rng.integers(0, 3, n)].tolist(),
+            "l_linestatus": ls[rng.integers(0, 2, n)].tolist(),
+            "l_shipdate": (rng.integers(0, 2526, n) + 8036).astype(np.int32),
+        })
+
+
+def main() -> None:
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_data")
+    cl = ct.Cluster(data_dir)
+    ensure_data(cl)
+
+    cl.execute(Q1)  # warm: compile + populate HBM cache
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cl.execute(Q1)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rows_per_sec = N_ROWS / best
+    print(json.dumps({
+        "metric": "tpch_q1_rows_scanned_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -233,14 +233,30 @@ class Catalog:
             col = t.schema.column(dist_column)
             if col.type.kind in ("float32", "float64"):
                 raise CatalogError("cannot distribute on a floating-point column")
-            if colocate_with:
-                other = self.table(colocate_with)
-                if other.shard_count != shard_count:
-                    raise CatalogError("colocation requires equal shard counts")
-                colocation_id = other.colocation_id
+            if colocate_with and colocate_with != "default":
+                if colocate_with == "none":
+                    colocation_id = self._next_colocation_id
+                    self._next_colocation_id += 1
+                else:
+                    other = self.table(colocate_with)
+                    if other.shard_count != shard_count:
+                        raise CatalogError("colocation requires equal shard counts")
+                    colocation_id = other.colocation_id
             else:
-                colocation_id = self._next_colocation_id
-                self._next_colocation_id += 1
+                # implicit default colocation: reuse the group of any table
+                # with the same shard count and distribution column type
+                # (reference: colocation_utils.c default colocation groups)
+                colocation_id = None
+                for other in self.tables.values():
+                    if (other.name != name and other.is_distributed
+                            and other.shard_count == shard_count
+                            and other.dist_column is not None
+                            and other.schema.column(other.dist_column).type.kind == col.type.kind):
+                        colocation_id = other.colocation_id
+                        break
+                if colocation_id is None:
+                    colocation_id = self._next_colocation_id
+                    self._next_colocation_id += 1
             self.ddl_epoch += 1
             ranges = shard_hash_ranges(shard_count)
             shards = []
@@ -301,24 +317,30 @@ class Catalog:
         self._dicts[key] = words
         self._dict_index[key] = {w: i for i, w in enumerate(words)}
 
-    def encode_strings(self, table: str, column: str, values) -> "list[int]":
+    def encode_strings(self, table: str, column: str, values):
         """Map strings -> table-global dictionary ids, growing the
-        dictionary for unseen strings (ingest path, coordinator-only)."""
+        dictionary for unseen strings (ingest path, coordinator-only).
+        Vectorized: unique the batch once, dict-lookup only the uniques."""
+        import numpy as np
         with self._lock:
             key = (table, column)
             self._ensure_dict(table, column)
             words, index = self._dicts[key], self._dict_index[key]
-            out = []
-            for v in values:
-                if v is None:
-                    out.append(0)
-                    continue
-                i = index.get(v)
-                if i is None:
-                    i = len(words)
-                    words.append(v)
-                    index[v] = i
-                out.append(i)
+            arr = np.asarray(values, dtype=object)
+            nulls = np.array([v is None for v in arr], dtype=bool)
+            out = np.zeros(len(arr), dtype=np.int64)
+            nn = ~nulls
+            if nn.any():
+                uniq, inverse = np.unique(arr[nn].astype(str), return_inverse=True)
+                uid = np.empty(len(uniq), dtype=np.int64)
+                for i, w in enumerate(uniq):
+                    j = index.get(w)
+                    if j is None:
+                        j = len(words)
+                        words.append(w)
+                        index[w] = j
+                    uid[i] = j
+                out[nn] = uid[inverse]
             return out
 
     def lookup_string_id(self, table: str, column: str, value: str) -> Optional[int]:

@@ -118,6 +118,11 @@ class Cluster:
         return result
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
+        if isinstance(stmt, A.Select) and isinstance(stmt.from_, A.Join):
+            from citus_tpu.executor.join_executor import execute_join_select
+            from citus_tpu.planner.join_planner import bind_join_select
+            bj = bind_join_select(self.catalog, stmt)
+            return execute_join_select(self.catalog, bj, self.settings)
         if isinstance(stmt, A.Select):
             cached = self._plan_cache.get(sql_text) if sql_text else None
             if cached is not None:

@@ -230,7 +230,9 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[tuple
 
 def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[tuple]:
     """Unbounded GROUP BY cardinality: device does scan/filter/expr eval,
-    host groups by exact key values (numpy unique over bit patterns)."""
+    host groups by exact key values (HostGroupAccumulator)."""
+    from citus_tpu.executor.host_agg import HostGroupAccumulator
+
     backend = settings.executor.task_executor_backend
     use_jax = backend != "cpu"
     if use_jax:
@@ -243,24 +245,7 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
     else:
         worker = build_worker_fn(plan, np)
 
-    n_keys = len(plan.bound.group_keys)
-    groups: dict[bytes, int] = {}
-    key_vals: list[list] = []          # per group: list of (value, valid) per key
-    accs: list[list] = []              # per group: accumulator per partial op
-
-    from citus_tpu.ops.scan_agg import _sentinel
-
-    def new_group(kvs):
-        idx = len(key_vals)
-        key_vals.append(kvs)
-        row = []
-        for op in plan.partial_ops:
-            dt = np.dtype(op.dtype)
-            row.append(dt.type(_sentinel(op.kind, dt)) if op.kind in ("min", "max")
-                       else dt.type(0))
-        accs.append(row)
-        return idx
-
+    acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
     for si in plan.shard_indexes:
         for values, masks, n in load_shard_batches(
                 cat, plan, si, min_batch_rows=1):
@@ -268,78 +253,14 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
                                           copy=False) for c in plan.scan_columns)
             valids = tuple(masks[c] for c in plan.scan_columns)
             mask, keys, args = worker(cols, valids, np.ones(n, bool))
-            mask = np.asarray(mask)
-            sel = np.nonzero(mask)[0]
-            if sel.size == 0:
-                continue
-            # encode keys as int64 bit patterns + null flags for exact unique
-            enc = np.empty((sel.size, 2 * n_keys), np.int64)
-            kv_np = []
-            for ki, (kv, kvalid) in enumerate(keys):
-                kv = np.asarray(kv)[sel]
-                kvalid = (np.ones(sel.size, bool) if kvalid is True
-                          else np.zeros(sel.size, bool) if kvalid is False
-                          else np.asarray(kvalid)[sel])
-                kv_np.append((kv, kvalid))
-                bits = kv.astype(np.float64).view(np.int64) if np.issubdtype(kv.dtype, np.floating) \
-                    else kv.astype(np.int64)
-                enc[:, 2 * ki] = np.where(kvalid, bits, 0)
-                enc[:, 2 * ki + 1] = kvalid.astype(np.int64)
-            uniq_rows, first_idx, inverse = np.unique(enc, axis=0, return_index=True,
-                                                      return_inverse=True)
-            arg_np = [(np.asarray(av)[sel],
-                       np.ones(sel.size, bool) if avalid is True
-                       else np.zeros(sel.size, bool) if avalid is False
-                       else np.asarray(avalid)[sel]) for av, avalid in args]
-            # local per-batch accumulation
-            L = uniq_rows.shape[0]
-            local = []
-            for op in plan.partial_ops:
-                dt = np.dtype(op.dtype)
-                if op.kind == "count":
-                    a = np.zeros(L, np.int64)
-                    ok = arg_np[op.arg_index][1] if op.arg_index >= 0 else np.ones(sel.size, bool)
-                    np.add.at(a, inverse, ok.astype(np.int64))
-                elif op.kind == "sum":
-                    a = np.zeros(L, dt)
-                    v, ok = arg_np[op.arg_index]
-                    np.add.at(a, inverse, np.where(ok, v, 0).astype(dt))
-                else:
-                    sent = dt.type(_sentinel(op.kind, dt))
-                    a = np.full(L, sent, dt)
-                    v, ok = arg_np[op.arg_index]
-                    upd = np.where(ok, v, sent).astype(dt)
-                    (np.minimum if op.kind == "min" else np.maximum).at(a, inverse, upd)
-                local.append(a)
-            # merge into global groups
-            for li in range(L):
-                kb = uniq_rows[li].tobytes()
-                gi = groups.get(kb)
-                if gi is None:
-                    fi = first_idx[li]
-                    kvs = [(kv[fi], bool(kvalid[fi])) for kv, kvalid in kv_np]
-                    gi = new_group(kvs)
-                    groups[kb] = gi
-                for pi, op in enumerate(plan.partial_ops):
-                    if op.kind in ("sum", "count"):
-                        accs[gi][pi] += local[pi][li]
-                    elif op.kind == "min":
-                        accs[gi][pi] = min(accs[gi][pi], local[pi][li])
-                    else:
-                        accs[gi][pi] = max(accs[gi][pi], local[pi][li])
-
-    G = len(key_vals)
-    if G == 0:
+            acc.add_batch(np.asarray(mask),
+                          [(np.asarray(v), m if isinstance(m, bool) else np.asarray(m))
+                           for v, m in keys],
+                          [(np.asarray(v), m if isinstance(m, bool) else np.asarray(m))
+                           for v, m in args])
+    key_arrays, partials = acc.finalize([k.type for k in plan.bound.group_keys])
+    if partials is None:
         return []
-    key_arrays = []
-    for ki, key in enumerate(plan.bound.group_keys):
-        dt = key.type.device_dtype
-        vals = np.array([kvs[ki][0] for kvs in key_vals], dtype=dt)
-        valid = np.array([kvs[ki][1] for kvs in key_vals], dtype=bool)
-        key_arrays.append((vals, valid))
-    partials = tuple(np.array([accs[g][pi] for g in range(G)],
-                              dtype=np.dtype(plan.partial_ops[pi].dtype))
-                     for pi in range(len(plan.partial_ops)))
     return finalize_groups(plan, cat, key_arrays, partials)
 
 

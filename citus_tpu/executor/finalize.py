@@ -60,28 +60,38 @@ def extract_aggs(plan: PhysicalPlan, partials: tuple) -> list[tuple[np.ndarray, 
     return out
 
 
-def decode_value(cat: Catalog, table: str, expr_type: T.ColumnType,
-                 source_text_col: Optional[str], raw, valid) -> object:
+def decode_qualified(cat: Catalog, expr_type: T.ColumnType,
+                     source: "Optional[tuple[str, str]]", raw, valid) -> object:
+    """Physical value -> Python value; ``source`` is (table, column) for
+    text dictionary decoding."""
     if not valid:
         return None
     if expr_type.is_text:
-        if source_text_col is None:
+        if source is None:
             return int(raw)
-        return cat.decode_strings(table, source_text_col, [int(raw)])[0]
+        return cat.decode_strings(source[0], source[1], [int(raw)])[0]
     return expr_type.from_physical(raw.item() if hasattr(raw, "item") else raw)
 
 
-def _text_source(e) -> Optional[str]:
-    """Output expr -> the text column whose dictionary decodes it."""
-    if isinstance(e, BColumn) and e.type.is_text:
-        return e.name
-    return None
+def default_text_src(plan):
+    """Returns a resolver: output expr -> (table_name, column) whose
+    dictionary decodes it, or None for non-text outputs."""
+    bound = plan.bound
+
+    def resolve(e):
+        if isinstance(e, BKeyRef):
+            e = bound.group_keys[e.index]
+        if isinstance(e, BColumn) and e.type.is_text:
+            return (bound.table.name, e.name)
+        return None
+    return resolve
 
 
 def finalize_groups(
     plan: PhysicalPlan, cat: Catalog,
     key_arrays: list[tuple[np.ndarray, np.ndarray]],
     partials: tuple,
+    text_src=None,
 ) -> list[tuple]:
     """Grouped/aggregate query: evaluate final exprs per group -> rows."""
     bound = plan.bound
@@ -98,13 +108,8 @@ def finalize_groups(
         if keep.shape == ():
             keep = np.full(n_groups, bool(keep))
 
-    # text dictionary sources for key-referencing outputs
-    text_cols: list[Optional[str]] = []
-    for e in bound.final_exprs:
-        src = None
-        if isinstance(e, BKeyRef):
-            src = _text_source(bound.group_keys[e.index])
-        text_cols.append(src)
+    resolve = text_src or default_text_src(plan)
+    text_cols = [resolve(e) for e in bound.final_exprs]
 
     out_cols = []
     for e in bound.final_exprs:
@@ -126,17 +131,19 @@ def finalize_groups(
             continue
         row = []
         for (v, valid, t), src in zip(out_cols, text_cols):
-            row.append(decode_value(cat, bound.table.name, t, src, v[gi], bool(valid[gi])))
+            row.append(decode_qualified(cat, t, src, v[gi], bool(valid[gi])))
         rows.append(tuple(row))
     return rows
 
 
-def project_rows(plan: PhysicalPlan, cat: Catalog, env_batches: list[dict]) -> list[tuple]:
+def project_rows(plan: PhysicalPlan, cat: Catalog, env_batches: list[dict],
+                 text_src=None) -> list[tuple]:
     """Non-aggregate query: evaluate projections per batch on the host
     (the device already computed the filter mask and raw columns)."""
     bound = plan.bound
     rows: list[tuple] = []
-    text_cols = [_text_source(e) for e in bound.final_exprs]
+    resolve = text_src or default_text_src(plan)
+    text_cols = [resolve(e) for e in bound.final_exprs]
     fns = plan.runtime_cache.get("np_final_fns")
     if fns is None:
         fns = [compile_expr(e, np) for e in bound.final_exprs]
@@ -160,7 +167,7 @@ def project_rows(plan: PhysicalPlan, cat: Catalog, env_batches: list[dict]) -> l
         for ri in range(idx.size):
             row = []
             for (v, valid, t), src in zip(cols, text_cols):
-                row.append(decode_value(cat, bound.table.name, t, src, v[ri], bool(valid[ri])))
+                row.append(decode_qualified(cat, t, src, v[ri], bool(valid[ri])))
             rows.append(tuple(row))
     return rows
 

@@ -1,0 +1,135 @@
+"""Host-side exact group accumulator.
+
+Shared by the hash_host GROUP BY strategy and the join executor: groups
+are identified by the exact bit patterns of their key values (+ null
+flags), so accumulation is exact for any key type and cardinality.  This
+is the coordinator-merge half of the reference's two-stage aggregation
+when pushdown isn't possible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from citus_tpu.planner.physical import PartialOp
+from citus_tpu.ops.scan_agg import _sentinel
+
+
+class HostGroupAccumulator:
+    def __init__(self, n_keys: int, partial_ops: list[PartialOp]):
+        self.n_keys = n_keys
+        self.partial_ops = partial_ops
+        self._groups: dict[bytes, int] = {}
+        self._key_vals: list[list] = []
+        self._accs: list[list] = []
+
+    def _new_group(self, kvs) -> int:
+        idx = len(self._key_vals)
+        self._key_vals.append(kvs)
+        row = []
+        for op in self.partial_ops:
+            dt = np.dtype(op.dtype)
+            row.append(dt.type(_sentinel(op.kind, dt)) if op.kind in ("min", "max")
+                       else dt.type(0))
+        self._accs.append(row)
+        return idx
+
+    def add_batch(self, mask: np.ndarray, keys: list, args: list) -> None:
+        """mask: bool [n]; keys/args: [(values, valid)] with valid either a
+        bool array or a python bool."""
+        sel = np.nonzero(np.asarray(mask))[0]
+        if sel.size == 0:
+            return
+        n_keys = self.n_keys
+
+        def norm(v, valid):
+            v = np.asarray(v)
+            if v.ndim == 0:
+                v = np.broadcast_to(v, (len(mask),))
+            v = v[sel]
+            if valid is True:
+                m = np.ones(sel.size, bool)
+            elif valid is False:
+                m = np.zeros(sel.size, bool)
+            else:
+                m = np.asarray(valid)
+                if m.ndim == 0:
+                    m = np.broadcast_to(m, (len(mask),))
+                m = m[sel]
+            return v, m
+
+        kv_np = [norm(v, m) for v, m in keys]
+        arg_np = [norm(v, m) for v, m in args]
+
+        if n_keys:
+            enc = np.empty((sel.size, 2 * n_keys), np.int64)
+            for ki, (kv, kvalid) in enumerate(kv_np):
+                bits = kv.astype(np.float64).view(np.int64) \
+                    if np.issubdtype(kv.dtype, np.floating) else kv.astype(np.int64)
+                enc[:, 2 * ki] = np.where(kvalid, bits, 0)
+                enc[:, 2 * ki + 1] = kvalid.astype(np.int64)
+            uniq_rows, first_idx, inverse = np.unique(
+                enc, axis=0, return_index=True, return_inverse=True)
+        else:
+            uniq_rows = np.zeros((1, 0), np.int64)
+            first_idx = np.zeros(1, np.int64)
+            inverse = np.zeros(sel.size, np.int64)
+
+        L = uniq_rows.shape[0]
+        local = []
+        for op in self.partial_ops:
+            dt = np.dtype(op.dtype)
+            if op.kind == "count":
+                a = np.zeros(L, np.int64)
+                ok = arg_np[op.arg_index][1] if op.arg_index >= 0 else np.ones(sel.size, bool)
+                np.add.at(a, inverse, ok.astype(np.int64))
+            elif op.kind == "sum":
+                a = np.zeros(L, dt)
+                v, ok = arg_np[op.arg_index]
+                np.add.at(a, inverse, np.where(ok, v, 0).astype(dt))
+            else:
+                sent = dt.type(_sentinel(op.kind, dt))
+                a = np.full(L, sent, dt)
+                v, ok = arg_np[op.arg_index]
+                upd = np.where(ok, v, sent).astype(dt)
+                (np.minimum if op.kind == "min" else np.maximum).at(a, inverse, upd)
+            local.append(a)
+
+        for li in range(L):
+            kb = uniq_rows[li].tobytes()
+            gi = self._groups.get(kb)
+            if gi is None:
+                fi = first_idx[li]
+                kvs = [(kv[fi], bool(kvalid[fi])) for kv, kvalid in kv_np]
+                gi = self._new_group(kvs)
+                self._groups[kb] = gi
+            for pi, op in enumerate(self.partial_ops):
+                if op.kind in ("sum", "count"):
+                    self._accs[gi][pi] += local[pi][li]
+                elif op.kind == "min":
+                    self._accs[gi][pi] = min(self._accs[gi][pi], local[pi][li])
+                else:
+                    self._accs[gi][pi] = max(self._accs[gi][pi], local[pi][li])
+
+    def finalize(self, key_types: list, scalar: bool = False):
+        """-> (key_arrays [(values, valid)], partials tuple).  ``scalar``
+        forces one group even with zero input rows (global aggregates)."""
+        G = len(self._key_vals)
+        if G == 0:
+            if not scalar:
+                return [], None
+            self._new_group([])
+            G = 1
+        key_arrays = []
+        for ki, kt in enumerate(key_types):
+            dt = kt.device_dtype
+            vals = np.array([kvs[ki][0] for kvs in self._key_vals], dtype=dt)
+            valid = np.array([kvs[ki][1] for kvs in self._key_vals], dtype=bool)
+            key_arrays.append((vals, valid))
+        partials = tuple(
+            np.array([self._accs[g][pi] for g in range(G)],
+                     dtype=np.dtype(self.partial_ops[pi].dtype))
+            for pi in range(len(self.partial_ops)))
+        return key_arrays, partials

@@ -49,10 +49,11 @@ def sharded_partial_agg(worker, combine_kinds: list[str], mesh: Mesh) -> Callabl
         for p, kind in zip(partials, combine_kinds):
             if kind == "sum":
                 outs.append(jax.lax.psum(p, SHARD_AXIS))
-            elif kind == "min":
-                outs.append(jax.lax.pmin(p, SHARD_AXIS))
-            elif kind == "max":
-                outs.append(jax.lax.pmax(p, SHARD_AXIS))
+            elif kind in ("min", "max"):
+                # TPU lowers only Sum all-reduces; min/max combine as an
+                # all_gather over ICI followed by a local reduction
+                g = jax.lax.all_gather(p, SHARD_AXIS)
+                outs.append(jnp.min(g, axis=0) if kind == "min" else jnp.max(g, axis=0))
             else:
                 outs.append(p[None])
         return tuple(outs)

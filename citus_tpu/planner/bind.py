@@ -80,15 +80,53 @@ def _like_to_regex(pattern: str) -> "re.Pattern":
 
 
 class Binder:
-    def __init__(self, catalog: Catalog, table: TableMeta):
+    """Resolves expressions against a range table of (alias, TableMeta).
+
+    Single-relation queries use bare column names as environment keys;
+    multi-relation (join) queries use qualified ``alias.column`` keys so
+    two relations' same-named columns never collide.
+    """
+
+    def __init__(self, catalog: Catalog, table: TableMeta,
+                 rels: Optional[list[tuple[str, TableMeta]]] = None):
         self.catalog = catalog
         self.table = table
+        self.rels = rels or [(table.name, table)]
+        self.qualified = len(self.rels) > 1
+
+    def resolve_column(self, name: str, rel_alias: Optional[str] = None):
+        """-> (env_key, Column, alias, TableMeta)."""
+        if rel_alias is not None:
+            for alias, t in self.rels:
+                if alias == rel_alias:
+                    col = t.schema.column(name)
+                    key = f"{alias}.{name}" if self.qualified else name
+                    return key, col, alias, t
+            raise AnalysisError(f"unknown relation alias {rel_alias!r}")
+        hits = [(alias, t) for alias, t in self.rels if t.schema.has(name)]
+        if not hits:
+            raise AnalysisError(f"column {name!r} does not exist")
+        if len(hits) > 1:
+            raise AnalysisError(f"column reference {name!r} is ambiguous")
+        alias, t = hits[0]
+        key = f"{alias}.{name}" if self.qualified else name
+        return key, t.schema.column(name), alias, t
+
+    def text_source(self, bcol: BColumn) -> tuple[str, str]:
+        """Env key of a text column -> (table_name, column_name)."""
+        if "." in bcol.name:
+            alias, col = bcol.name.split(".", 1)
+            for a, t in self.rels:
+                if a == alias:
+                    return t.name, col
+            raise AnalysisError(f"unknown alias {alias!r}")
+        return self.table.name, bcol.name
 
     # ---------------------------------------------------------------- expr
     def bind_scalar(self, e: A.Expr, allow_agg: bool = False) -> BExpr:
         if isinstance(e, A.ColumnRef):
-            col = self.table.schema.column(e.name)
-            return BColumn(col.name, col.type)
+            key, col, _, _ = self.resolve_column(e.name, e.table)
+            return BColumn(key, col.type)
         if isinstance(e, A.Literal):
             return self._bind_literal(e)
         if isinstance(e, A.UnOp):
@@ -150,7 +188,8 @@ class Binder:
         if target.is_text:
             if column is None:
                 raise AnalysisError("cannot compare two string literals from different tables")
-            did = self.catalog.lookup_string_id(self.table.name, column.name, lit.value)
+            tname, cname = self.text_source(column)
+            did = self.catalog.lookup_string_id(tname, cname, lit.value)
             # unseen string: id -1 never matches any row
             return BLiteral(-1 if did is None else did, T.TEXT_T)
         if target.is_numeric:
@@ -174,8 +213,20 @@ class Binder:
             col = left if isinstance(left, BColumn) else (right if isinstance(right, BColumn) else None)
             if isinstance(right, BLiteral) and isinstance(right.value, str):
                 right = self._coerce_string_literal(right, lt, col)
-            if isinstance(left, BLiteral) and isinstance(left.value, str):
+            elif isinstance(left, BLiteral) and isinstance(left.value, str):
                 left = self._coerce_string_literal(left, rt, col)
+            elif isinstance(left, BColumn) and isinstance(right, BColumn):
+                lsrc = self.text_source(left)
+                rsrc = self.text_source(right)
+                if lsrc != rsrc:
+                    # different dictionaries: re-encode the right side into
+                    # the left dictionary's id space
+                    from citus_tpu.planner.bound import BDictRemap
+                    lwords = self.catalog.dictionary(*lsrc)
+                    lindex = {w: i for i, w in enumerate(lwords)}
+                    rwords = self.catalog.dictionary(*rsrc)
+                    mapping = tuple(lindex.get(w, -1) for w in rwords)
+                    right = BDictRemap(right, mapping)
             return left, right
         # decimal scale alignment (comparisons, +, -)
         ls = lt.scale if lt.is_decimal else 0
@@ -224,7 +275,7 @@ class Binder:
     def _bind_in(self, e: A.InList, allow_agg: bool) -> BExpr:
         target = self.bind_scalar(e.expr, allow_agg)
         if target.type.is_text and isinstance(target, BColumn):
-            words = self.catalog.dictionary(self.table.name, target.name)
+            words = self.catalog.dictionary(*self.text_source(target))
             values = {it.value for it in e.items if isinstance(it, A.Literal)}
             if len(values) != len(e.items):
                 raise UnsupportedFeatureError("non-literal IN items on text")
@@ -266,7 +317,7 @@ class Binder:
                     and isinstance(pat, A.Literal) and isinstance(pat.value, str)):
                 raise UnsupportedFeatureError("LIKE requires text column and literal pattern")
             rx = _like_to_regex(pat.value)
-            words = self.catalog.dictionary(self.table.name, target.name)
+            words = self.catalog.dictionary(*self.text_source(target))
             return BDictMask(target, tuple(bool(rx.match(w)) for w in words))
         if name == "date_trunc":
             if len(e.args) != 2 or not isinstance(e.args[0], A.Literal):

@@ -84,6 +84,16 @@ class BCase(BExpr):
 
 
 @dataclass(frozen=True)
+class BDictRemap(BExpr):
+    """Re-encode a dictionary-id column into another relation's dictionary
+    id space (cross-relation text equality/joins stay integer-valued).
+    ``mapping[id]`` is the target id, or -1 when the string is absent."""
+    operand: BExpr
+    mapping: tuple[int, ...]
+    type: T.ColumnType = T.TEXT_T
+
+
+@dataclass(frozen=True)
 class BDictMask(BExpr):
     """Membership of a dictionary-encoded column in a precomputed id set
     (LIKE / IN over text evaluate the pattern against the table-global
@@ -121,7 +131,7 @@ def walk(e: BExpr):
     if isinstance(e, BBinOp):
         yield from walk(e.left)
         yield from walk(e.right)
-    elif isinstance(e, (BUnOp, BScale, BCast, BIsNull, BDictMask)):
+    elif isinstance(e, (BUnOp, BScale, BCast, BIsNull, BDictMask, BDictRemap)):
         yield from walk(e.operand)
     elif isinstance(e, BCase):
         for c, v in e.whens:
@@ -221,6 +231,17 @@ def compile_expr(e: BExpr, xp):
             v = valid if neg else ~valid
             return (v, True)
         return run_isnull
+    if isinstance(e, BDictRemap):
+        f = compile_expr(e.operand, xp)
+        mapping = xp.asarray(np.array(e.mapping, dtype=np.int32)) if e.mapping \
+            else xp.asarray(np.zeros(1, np.int32) - 1)
+
+        def run_remap(env):
+            ids, valid = f(env)
+            n = mapping.shape[0]
+            safe = xp.clip(ids, 0, max(n - 1, 0))
+            return (mapping[safe], valid)
+        return run_remap
     if isinstance(e, BDictMask):
         f = compile_expr(e.operand, xp)
         table = xp.asarray(np.array(e.mask, dtype=bool))

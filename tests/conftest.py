@@ -5,20 +5,27 @@ clusters on one box (src/test/regress/pg_regress_multi.pl launches a
 coordinator + workers on localhost): we force JAX onto the host platform
 with 8 virtual devices so every sharding/collective path runs exactly as
 it would on an 8-chip TPU slice.
+
+Note: this environment may register an accelerator PJRT plugin from
+sitecustomize that overrides JAX_PLATFORMS; jax.config.update is the
+reliable way to pin the cpu platform, and XLA_FLAGS must be set before
+the backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
+
+assert len(jax.devices()) == 8, f"expected 8 cpu devices, got {jax.devices()}"
 
 
 @pytest.fixture()

@@ -92,10 +92,10 @@ def test_catalog_errors(tmp_path):
 def test_text_dictionary_roundtrip(tmp_path):
     cat = Catalog(str(tmp_path))
     ids = cat.encode_strings("t", "c", ["x", "y", "x", "z"])
-    assert ids == [0, 1, 0, 2]
+    assert list(ids) == [0, 1, 0, 2]
     assert cat.decode_strings("t", "c", ids) == ["x", "y", "x", "z"]
     cat.commit()
     cat2 = Catalog(str(tmp_path))
-    assert cat2.encode_strings("t", "c", ["z", "w"]) == [2, 3]
+    assert list(cat2.encode_strings("t", "c", ["z", "w"])) == [2, 3]
     assert cat2.lookup_string_id("t", "c", "y") == 1
     assert cat2.lookup_string_id("t", "c", "nope") is None
