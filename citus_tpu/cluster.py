@@ -42,6 +42,36 @@ class Cluster:
         # plan cache keyed by SQL text (reference analog: prepared-statement
         # plan caching + local_plan_cache.c); invalidated by table version
         self._plan_cache: dict[str, tuple] = {}
+        self._background_jobs = None
+        self._maintenance = None
+
+    @property
+    def background_jobs(self):
+        """Lazy background task runner (reference: background_jobs.c)."""
+        if self._background_jobs is None:
+            from citus_tpu.operations import move_shard_placement
+            from citus_tpu.services import BackgroundJobRunner
+            r = BackgroundJobRunner(self.catalog)
+            r.register("move_shard", lambda shard_id, source, target:
+                       move_shard_placement(self.catalog, shard_id, source, target))
+            r.start()
+            self._background_jobs = r
+        return self._background_jobs
+
+    @property
+    def maintenance(self):
+        """Lazy maintenance daemon (reference: maintenanced.c)."""
+        if self._maintenance is None:
+            from citus_tpu.services import MaintenanceDaemon
+            self._maintenance = MaintenanceDaemon(self.catalog)
+            self._maintenance.start()
+        return self._maintenance
+
+    def close(self) -> None:
+        if self._background_jobs is not None:
+            self._background_jobs.stop()
+        if self._maintenance is not None:
+            self._maintenance.stop()
 
     # ------------------------------------------------------------- DDL
     def create_table(self, name: str, schema: Schema, *, if_not_exists: bool = False,
@@ -196,6 +226,62 @@ class Cluster:
         if name == "master_get_active_worker_nodes":
             return Result(columns=["node_id"],
                           rows=[(nid,) for nid in self.catalog.active_node_ids()])
+        if name == "citus_add_node":
+            from citus_tpu.catalog.catalog import NodeMeta
+            nid = max(self.catalog.nodes, default=-1) + 1
+            self.catalog.nodes[nid] = NodeMeta(nid)
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=["citus_add_node"], rows=[(nid,)])
+        if name == "citus_remove_node":
+            nid = int(args[0]) if args else None
+            if nid is None or nid not in self.catalog.nodes:
+                raise CatalogError(f"node {nid} does not exist")
+            for t in self.catalog.tables.values():
+                for s in t.shards:
+                    if nid in s.placements:
+                        raise CatalogError(
+                            f"cannot remove node {nid}: it still has shard placements")
+            del self.catalog.nodes[nid]
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=["citus_remove_node"], rows=[(None,)])
+        if name == "citus_move_shard_placement":
+            from citus_tpu.operations import move_shard_placement
+            move_shard_placement(self.catalog, int(args[0]), int(args[1]), int(args[2]))
+            self._plan_cache.clear()
+            return Result(columns=[name], rows=[(None,)])
+        if name == "get_rebalance_table_shards_plan":
+            from citus_tpu.operations import get_rebalance_plan
+            moves = get_rebalance_plan(self.catalog, args[0] if args else None)
+            return Result(columns=["shardid", "sourcenode", "targetnode"],
+                          rows=[m.to_row() for m in moves])
+        if name == "rebalance_table_shards":
+            from citus_tpu.operations import rebalance_table_shards
+            moves = rebalance_table_shards(self.catalog, args[0] if args else None)
+            self._plan_cache.clear()
+            return Result(columns=["rebalance_table_shards"],
+                          rows=[(len(moves),)])
+        if name == "citus_rebalance_start":
+            from citus_tpu.operations import get_rebalance_plan
+            moves = get_rebalance_plan(self.catalog)
+            jid = self.background_jobs.create_job("Rebalance all colocation groups")
+            prev = None
+            for m in moves:
+                prev = self.background_jobs.add_task(
+                    jid, "move_shard",
+                    {"shard_id": m.shard_id, "source": m.source_node, "target": m.target_node},
+                    depends_on=[prev] if prev is not None else None,
+                    node=m.target_node)
+            return Result(columns=["citus_rebalance_start"], rows=[(jid,)])
+        if name == "citus_job_wait":
+            status = self.background_jobs.wait_for_job(int(args[0]))
+            self._plan_cache.clear()
+            return Result(columns=["citus_job_wait"], rows=[(status,)])
+        if name == "citus_cleanup_orphaned_resources":
+            from citus_tpu.operations import try_drop_orphaned_resources
+            n = try_drop_orphaned_resources(self.catalog)
+            return Result(columns=["citus_cleanup_orphaned_resources"], rows=[(n,)])
         raise UnsupportedFeatureError(f"utility {name}() not supported yet")
 
     def _table_size(self, name: str) -> int:

@@ -1,0 +1,77 @@
+"""Deferred resource cleanup.
+
+Reference: pg_dist_cleanup + shard_cleaner.c (TryDropOrphanedResources,
+operations/shard_cleaner.c:199).  Operations that replace or move data
+never delete the old files inline — they record a cleanup entry that the
+maintenance daemon (or an explicit call) processes later, so concurrent
+readers holding the old placement finish safely and failed operations
+can't leak half-moved state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+
+from citus_tpu.catalog import Catalog
+
+CLEANUP_FILE = "cleanup.json"
+
+# policies (mirroring the reference's CLEANUP_* semantics)
+ALWAYS = "always"                 # drop whether the op succeeded or failed
+ON_FAILURE = "on_failure"         # drop only if the op failed
+DEFERRED_ON_SUCCESS = "deferred_on_success"  # drop after the op succeeded
+
+
+def _path(cat: Catalog) -> str:
+    return os.path.join(cat.data_dir, CLEANUP_FILE)
+
+
+def _load(cat: Catalog) -> list[dict]:
+    p = _path(cat)
+    if not os.path.exists(p):
+        return []
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def _store(cat: Catalog, records: list[dict]) -> None:
+    tmp = _path(cat) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(records, fh)
+    os.replace(tmp, _path(cat))
+
+
+def record_cleanup(cat: Catalog, resource_path: str, policy: str = DEFERRED_ON_SUCCESS,
+                   operation_id: int = 0) -> None:
+    records = _load(cat)
+    records.append({
+        "path": resource_path, "policy": policy,
+        "operation_id": operation_id, "recorded_at": time.time(),
+    })
+    _store(cat, records)
+
+
+def pending_cleanup(cat: Catalog) -> list[dict]:
+    return _load(cat)
+
+
+def try_drop_orphaned_resources(cat: Catalog) -> int:
+    """Drop every recorded resource; returns how many were removed.
+    Safe to call repeatedly (the maintenance daemon does)."""
+    records = _load(cat)
+    remaining, dropped = [], 0
+    for r in records:
+        p = r["path"]
+        try:
+            if os.path.isdir(p):
+                shutil.rmtree(p)
+            elif os.path.exists(p):
+                os.remove(p)
+            dropped += 1
+        except OSError:
+            remaining.append(r)  # retry next cycle
+    _store(cat, remaining)
+    return dropped

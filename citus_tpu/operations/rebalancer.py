@@ -1,0 +1,104 @@
+"""Shard rebalancer.
+
+Reference: the greedy rebalance algorithm in
+src/backend/distributed/operations/shard_rebalancer.c
+(GetRebalanceSteps :532, RebalancePlacementUpdates :635) with
+per-strategy cost/capacity hooks from pg_dist_rebalance_strategy.
+
+Algorithm (same shape as the reference's): compute each node's total
+cost (here: placement disk bytes, min 1 per placement so empty shards
+still spread), then repeatedly move the best-fitting shard group from
+the most-utilized node to the least-utilized node while the improvement
+exceeds ``threshold`` of the mean utilization.  Colocation groups move
+as one unit, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from citus_tpu.catalog import Catalog
+from citus_tpu.operations.shard_transfer import move_shard_placement
+
+
+@dataclass
+class RebalanceMove:
+    shard_id: int
+    source_node: int
+    target_node: int
+    cost: float
+
+    def to_row(self):
+        return (self.shard_id, self.source_node, self.target_node)
+
+
+def _placement_cost(cat: Catalog, table, shard, node: int) -> float:
+    d = cat.shard_dir(table.name, shard.shard_id, node)
+    if not os.path.isdir(d):
+        return 1.0
+    return max(1.0, float(sum(
+        os.path.getsize(os.path.join(d, f)) for f in os.listdir(d))))
+
+
+def _group_costs(cat: Catalog, table_name: str | None = None):
+    """-> (cost per colocation-group-slot keyed by (colocation_id, index),
+    node loads, representative shard per group slot)."""
+    groups: dict[tuple, float] = {}
+    rep: dict[tuple, tuple] = {}
+    loads: dict[int, float] = {n: 0.0 for n in cat.active_node_ids()}
+    for t in cat.tables.values():
+        if not t.is_distributed:
+            continue
+        if table_name is not None and t.colocation_id != cat.table(table_name).colocation_id:
+            continue
+        for s in t.shards:
+            node = s.placements[0]
+            key = (t.colocation_id, s.index)
+            c = _placement_cost(cat, t, s, node)
+            groups[key] = groups.get(key, 0.0) + c
+            if key not in rep:
+                rep[key] = (s.shard_id, node)
+            loads[node] = loads.get(node, 0.0) + c
+    return groups, loads, rep
+
+
+def get_rebalance_plan(cat: Catalog, table_name: str | None = None,
+                       threshold: float = 0.1,
+                       max_moves: int = 1000) -> list[RebalanceMove]:
+    """Greedy improvement plan; does not execute anything."""
+    groups, loads, rep = _group_costs(cat, table_name)
+    if not loads:
+        return []
+    # group slot -> current node (simulated as moves are planned)
+    location = {key: rep[key][1] for key in groups}
+    moves: list[RebalanceMove] = []
+    mean = sum(loads.values()) / len(loads)
+    for _ in range(max_moves):
+        hi = max(loads, key=lambda n: loads[n])
+        lo = min(loads, key=lambda n: loads[n])
+        gap = loads[hi] - loads[lo]
+        if gap <= max(threshold * max(mean, 1.0), 1e-9):
+            break
+        # best candidate on hi: largest group that still improves balance
+        candidates = [(key, c) for key, c in groups.items()
+                      if location[key] == hi and c < gap]
+        if not candidates:
+            break
+        key, cost = max(candidates, key=lambda kc: kc[1])
+        shard_id, _ = rep[key]
+        moves.append(RebalanceMove(shard_id, hi, lo, cost))
+        loads[hi] -= cost
+        loads[lo] += cost
+        location[key] = lo
+    return moves
+
+
+def rebalance_table_shards(cat: Catalog, table_name: str | None = None,
+                           threshold: float = 0.1) -> list[RebalanceMove]:
+    """Plan + execute (reference: rebalance_table_shards / the background
+    variant citus_rebalance_start)."""
+    moves = get_rebalance_plan(cat, table_name, threshold)
+    for m in moves:
+        move_shard_placement(cat, m.shard_id, m.source_node, m.target_node)
+    return moves

@@ -1,0 +1,105 @@
+"""Shard transfer: move/copy a shard placement between nodes.
+
+Reference: citus_move_shard_placement / TransferShards
+(src/backend/distributed/operations/shard_transfer.c:351,472).  The
+reference's 13-step non-blocking move (logical replication, catch-up,
+metadata flip, deferred drop) collapses here because shard data files
+are immutable-append and the catalog is the single source of truth:
+
+  1. copy the placement's stripe files to the target placement dir
+  2. catch up any stripes appended during the copy (re-list + copy diff)
+  3. flip the placement in the catalog (atomic commit)
+  4. record the source directory for deferred cleanup
+
+Colocated shards move together, like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from citus_tpu.catalog import Catalog
+from citus_tpu.errors import CatalogError
+from citus_tpu.operations.cleaner import DEFERRED_ON_SUCCESS, record_cleanup
+from citus_tpu.storage.writer import SHARD_META, _load_meta
+
+
+def _copy_placement_files(src: str, dst: str) -> None:
+    os.makedirs(dst, exist_ok=True)
+    # stripes are immutable: copy data files first, the meta file last so
+    # a crash mid-copy leaves a readable (possibly shorter) placement
+    names = sorted(n for n in os.listdir(src) if n.endswith(".cts"))
+    for n in names:
+        if not os.path.exists(os.path.join(dst, n)):
+            shutil.copy2(os.path.join(src, n), os.path.join(dst, n))
+    shutil.copy2(os.path.join(src, SHARD_META), os.path.join(dst, SHARD_META))
+
+
+def _find_shard(cat: Catalog, shard_id: int):
+    for t in cat.tables.values():
+        for s in t.shards:
+            if s.shard_id == shard_id:
+                return t, s
+    raise CatalogError(f"shard {shard_id} does not exist")
+
+
+def _colocated_shards(cat: Catalog, table, shard):
+    """Shards that must move together: same colocation group, same index."""
+    out = []
+    for t in cat.tables.values():
+        if t.is_distributed and t.colocation_id == table.colocation_id:
+            out.append((t, t.shards[shard.index]))
+    return out
+
+
+def copy_shard_placement(cat: Catalog, shard_id: int, source_node: int,
+                         target_node: int) -> None:
+    """Add a replica of a shard placement on target_node (reference:
+    citus_copy_shard_placement)."""
+    table, shard = _find_shard(cat, shard_id)
+    if source_node not in shard.placements:
+        raise CatalogError(f"shard {shard_id} has no placement on node {source_node}")
+    if target_node in shard.placements:
+        raise CatalogError(f"shard {shard_id} already placed on node {target_node}")
+    if target_node not in cat.nodes:
+        raise CatalogError(f"node {target_node} does not exist")
+    for t, s in _colocated_shards(cat, table, shard):
+        src = cat.shard_dir(t.name, s.shard_id, source_node)
+        dst = cat.shard_dir(t.name, s.shard_id, target_node)
+        if os.path.isdir(src):
+            _copy_placement_files(src, dst)
+        s.placements.append(target_node)
+        t.version += 1
+    cat.commit()
+
+
+def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
+                         target_node: int) -> None:
+    """Move a shard placement (and its colocated peers) between nodes."""
+    table, shard = _find_shard(cat, shard_id)
+    if source_node not in shard.placements:
+        raise CatalogError(f"shard {shard_id} has no placement on node {source_node}")
+    if target_node in shard.placements:
+        raise CatalogError(f"shard {shard_id} already placed on node {target_node}")
+    if target_node not in cat.nodes:
+        raise CatalogError(f"node {target_node} does not exist")
+    group = _colocated_shards(cat, table, shard)
+    # phase 1: copy data (repeat to catch appends that raced the copy)
+    for t, s in group:
+        src = cat.shard_dir(t.name, s.shard_id, source_node)
+        dst = cat.shard_dir(t.name, s.shard_id, target_node)
+        if os.path.isdir(src):
+            _copy_placement_files(src, dst)
+            if _load_meta(src)["row_count"] != _load_meta(dst)["row_count"]:
+                _copy_placement_files(src, dst)  # catch-up pass
+    # phase 2: metadata flip (single atomic commit covers the group)
+    for t, s in group:
+        s.placements = [target_node if n == source_node else n for n in s.placements]
+        t.version += 1
+    cat.commit()
+    # phase 3: deferred source drop
+    for t, s in group:
+        src = cat.shard_dir(t.name, s.shard_id, source_node)
+        if os.path.isdir(src):
+            record_cleanup(cat, src, DEFERRED_ON_SUCCESS)

@@ -1,0 +1,103 @@
+"""Repartition shuffle: all_to_all over the mesh.
+
+The reference redistributes rows between workers with MapMergeJob — map
+tasks hash-partition each source shard's rows into bucket files, fetch
+tasks pull each bucket to its destination
+(src/backend/distributed/planner/multi_physical_planner.h MapMergeJob;
+executor/partitioned_intermediate_results.c worker_partition_query_result;
+directed_acyclic_graph_execution.c).  On a TPU mesh the same exchange is
+one ``jax.lax.all_to_all`` over ICI.
+
+Static-shape contract: each device holds ``N`` rows (+validity); rows
+are bucketed by a target id in ``[0, n_dev)``; every (src, dst) block is
+padded to a fixed capacity ``C``.  If any block overflows C the shuffle
+reports it (`overflow` flag) and the caller retries with a larger C or
+falls back to the host path — the static-shape equivalent of the
+reference's dynamically-sized bucket files.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from citus_tpu.parallel.mesh import SHARD_AXIS
+
+
+def _pack_blocks(values: tuple, target: jnp.ndarray, mask: jnp.ndarray,
+                 n_dev: int, capacity: int):
+    """Arrange one device's rows into [n_dev, C] send blocks by target.
+
+    Returns (packed values tuple, packed validity, per-dest counts).
+    Rows beyond capacity for their destination are dropped and counted
+    in the overflow total (caller checks).
+    """
+    n = target.shape[0]
+    tgt = jnp.where(mask, target, n_dev)  # invalid rows -> virtual bucket
+    order = jnp.argsort(tgt, stable=True)
+    sorted_tgt = tgt[order]
+    # rank of each sorted row within its destination segment
+    start = jnp.searchsorted(sorted_tgt, jnp.arange(n_dev + 1))
+    counts = start[1:n_dev + 1] - start[:n_dev]
+    rank = jnp.arange(n) - start[sorted_tgt.clip(0, n_dev - 1)]
+    dest_ok = (sorted_tgt < n_dev) & (rank < capacity)
+    slot = sorted_tgt.clip(0, n_dev - 1) * capacity + rank.clip(0, capacity - 1)
+    total = n_dev * capacity
+    packed_valid = jnp.zeros(total, bool).at[slot].set(dest_ok, mode="drop")
+    packed = []
+    for v in values:
+        sv = v[order]
+        buf = jnp.zeros(total, v.dtype).at[slot].set(
+            jnp.where(dest_ok, sv, 0), mode="drop")
+        packed.append(buf.reshape(n_dev, capacity))
+    overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
+    return tuple(packed), packed_valid.reshape(n_dev, capacity), overflow
+
+
+def build_repartition(mesh: Mesh, n_cols: int, capacity: int):
+    """Compile an all_to_all repartition over ``mesh``.
+
+    Input (stacked over devices): values tuple of [n_dev, N] arrays,
+    target [n_dev, N] int32 (destination device per row), mask [n_dev, N].
+    Output: values tuple of [n_dev, n_dev*C] (rows now living on their
+    target device), validity [n_dev, n_dev*C], overflow count (replicated
+    scalar — nonzero means retry with larger capacity).
+    """
+    n_dev = mesh.shape[SHARD_AXIS]
+
+    def per_device(values, target, mask):
+        values = tuple(v[0] for v in values)
+        target = target[0]
+        mask = mask[0]
+        packed, pvalid, overflow = _pack_blocks(values, target, mask, n_dev, capacity)
+        # exchange: block i goes to device i; after all_to_all, this
+        # device holds the blocks addressed to it from every source
+        out_vals = tuple(
+            jax.lax.all_to_all(v, SHARD_AXIS, split_axis=0, concat_axis=0)
+            for v in packed)
+        out_valid = jax.lax.all_to_all(pvalid, SHARD_AXIS, split_axis=0, concat_axis=0)
+        total_overflow = jax.lax.psum(overflow, SHARD_AXIS)
+        flat_vals = tuple(v.reshape(-1)[None] for v in out_vals)
+        return flat_vals, out_valid.reshape(-1)[None], total_overflow
+
+    in_specs = (tuple(P(SHARD_AXIS) for _ in range(n_cols)), P(SHARD_AXIS), P(SHARD_AXIS))
+    out_specs = (tuple(P(SHARD_AXIS) for _ in range(n_cols)), P(SHARD_AXIS), P())
+    fn = jax.shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    return jax.jit(fn)
+
+
+def repartition_host(values: tuple, target: np.ndarray, mask: np.ndarray,
+                     n_buckets: int):
+    """Host reference implementation (oracle + fallback): returns per-
+    bucket lists of row arrays."""
+    out = []
+    for b in range(n_buckets):
+        sel = mask & (target == b)
+        out.append(tuple(v[sel] for v in values))
+    return out

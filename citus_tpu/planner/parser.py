@@ -260,8 +260,11 @@ class Parser:
         "create_distributed_table", "create_reference_table",
         "undistribute_table", "citus_add_node", "citus_remove_node",
         "citus_set_coordinator_host", "rebalance_table_shards",
-        "citus_move_shard_placement", "citus_table_size",
-        "citus_shard_sizes", "master_get_active_worker_nodes",
+        "get_rebalance_table_shards_plan", "citus_rebalance_start",
+        "citus_job_wait", "citus_cleanup_orphaned_resources",
+        "citus_move_shard_placement", "citus_copy_shard_placement",
+        "citus_table_size", "citus_shard_sizes",
+        "master_get_active_worker_nodes",
     }
 
     def parse_select_or_utility(self) -> A.Statement:

@@ -1,0 +1,82 @@
+"""Maintenance daemon.
+
+Reference: the per-database maintenance background worker
+(src/backend/distributed/utils/maintenanced.c) that periodically runs
+deferred-resource cleanup, 2PC recovery, deadlock detection, and
+metadata-sync retries.  Here: one daemon thread per Cluster running a
+pluggable list of periodic duties; ships with cleanup and stale-lock
+recovery, and later milestones register more duties (transaction
+recovery, health checks).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from citus_tpu.catalog import Catalog
+from citus_tpu.operations.cleaner import try_drop_orphaned_resources
+
+
+@dataclass
+class Duty:
+    name: str
+    fn: Callable[[], object]
+    interval_s: float
+    last_run: float = 0.0
+    runs: int = 0
+    errors: int = 0
+
+
+class MaintenanceDaemon:
+    def __init__(self, cat: Catalog, *, cleanup_interval_s: float = 5.0):
+        self.cat = cat
+        self._duties: list[Duty] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.register("deferred_cleanup",
+                      lambda: try_drop_orphaned_resources(cat),
+                      cleanup_interval_s)
+
+    def register(self, name: str, fn: Callable[[], object], interval_s: float) -> None:
+        self._duties.append(Duty(name, fn, interval_s))
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="citus-tpu-maintenanced")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def run_once(self) -> None:
+        """Run every duty immediately (tests + explicit triggers)."""
+        for d in self._duties:
+            self._run_duty(d)
+
+    def status(self) -> list[tuple]:
+        return [(d.name, d.interval_s, d.runs, d.errors) for d in self._duties]
+
+    def _run_duty(self, d: Duty) -> None:
+        try:
+            d.fn()
+            d.runs += 1
+        except Exception:
+            d.errors += 1
+        d.last_run = time.time()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.time()
+            for d in self._duties:
+                if now - d.last_run >= d.interval_s:
+                    self._run_duty(d)
+            self._stop.wait(timeout=0.2)

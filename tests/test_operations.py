@@ -1,0 +1,166 @@
+"""Cluster operations: shard moves, rebalancer, background jobs,
+maintenance cleanup (reference: operations/ + utils/background_jobs.c)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+
+
+def make_cluster(tmp_path, nodes=2):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=nodes)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", columns={"k": np.arange(10000, dtype=np.int64),
+                               "v": np.arange(10000, dtype=np.int64) % 97})
+    return cl
+
+
+def test_move_shard_placement(tmp_path):
+    cl = make_cluster(tmp_path)
+    before = cl.execute("SELECT count(*), sum(v) FROM t").rows
+    t = cl.catalog.table("t")
+    shard = t.shards[0]
+    src = shard.placements[0]
+    dst = 1 - src if src in (0, 1) else 0
+    cl.execute(f"SELECT citus_move_shard_placement({shard.shard_id}, {src}, {dst})")
+    assert cl.catalog.table("t").shards[0].placements == [dst]
+    # data still correct after the move
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == before
+    # source dir is recorded for deferred cleanup, then dropped
+    from citus_tpu.operations import pending_cleanup, try_drop_orphaned_resources
+    assert len(pending_cleanup(cl.catalog)) >= 1
+    n = try_drop_orphaned_resources(cl.catalog)
+    assert n >= 1
+    assert not os.path.isdir(cl.catalog.shard_dir("t", shard.shard_id, src))
+    cl.close()
+
+
+def test_move_errors(tmp_path):
+    cl = make_cluster(tmp_path)
+    t = cl.catalog.table("t")
+    shard = t.shards[0]
+    src = shard.placements[0]
+    with pytest.raises(CatalogError):
+        cl.execute(f"SELECT citus_move_shard_placement({shard.shard_id}, {src}, {src})")
+    with pytest.raises(CatalogError):
+        cl.execute(f"SELECT citus_move_shard_placement({shard.shard_id}, {1-src}, {src})")
+    with pytest.raises(CatalogError):
+        cl.execute(f"SELECT citus_move_shard_placement(999999, 0, 1)")
+    cl.close()
+
+
+def test_add_node_and_rebalance(tmp_path):
+    cl = make_cluster(tmp_path, nodes=2)
+    before = sorted(cl.execute("SELECT v, count(*) FROM t GROUP BY v").rows)
+    r = cl.execute("SELECT citus_add_node('worker-2', 5432)")
+    new_node = r.rows[0][0]
+    assert new_node == 2
+    plan = cl.execute("SELECT get_rebalance_table_shards_plan('t')")
+    assert plan.rowcount >= 1  # new empty node attracts moves
+    moved = cl.execute("SELECT rebalance_table_shards('t')").rows[0][0]
+    assert moved >= 1
+    # placements now cover the new node
+    nodes_used = {p for s in cl.catalog.table("t").shards for p in s.placements}
+    assert new_node in nodes_used
+    assert sorted(cl.execute("SELECT v, count(*) FROM t GROUP BY v").rows) == before
+    # idempotent: already balanced
+    again = cl.execute("SELECT get_rebalance_table_shards_plan('t')")
+    assert again.rowcount == 0
+    cl.close()
+
+
+def test_colocated_shards_move_together(tmp_path):
+    cl = make_cluster(tmp_path)
+    cl.execute("CREATE TABLE t2 (k bigint NOT NULL, w bigint)")
+    cl.execute("SELECT create_distributed_table('t2', 'k', 4)")
+    cl.copy_from("t2", columns={"k": np.arange(5000, dtype=np.int64),
+                                "w": np.arange(5000, dtype=np.int64)})
+    t, t2 = cl.catalog.table("t"), cl.catalog.table("t2")
+    assert t.colocation_id == t2.colocation_id
+    shard = t.shards[2]
+    src = shard.placements[0]
+    dst = 1 - src
+    join_before = cl.execute(
+        "SELECT count(*) FROM t JOIN t2 ON t.k = t2.k").rows
+    cl.execute(f"SELECT citus_move_shard_placement({shard.shard_id}, {src}, {dst})")
+    assert cl.catalog.table("t").shards[2].placements == [dst]
+    assert cl.catalog.table("t2").shards[2].placements == [dst]
+    assert cl.execute("SELECT count(*) FROM t JOIN t2 ON t.k = t2.k").rows == join_before
+    cl.close()
+
+
+def test_background_rebalance_job(tmp_path):
+    cl = make_cluster(tmp_path, nodes=2)
+    cl.execute("SELECT citus_add_node('w', 1)")
+    jid = cl.execute("SELECT citus_rebalance_start()").rows[0][0]
+    status = cl.execute(f"SELECT citus_job_wait({jid})").rows[0][0]
+    assert status == "done"
+    nodes_used = {p for s in cl.catalog.table("t").shards for p in s.placements}
+    assert 2 in nodes_used
+    assert cl.execute("SELECT count(*) FROM t").rows == [(10000,)]
+    cl.close()
+
+
+def test_background_job_retry_and_failure(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+
+    def always_fails():
+        raise RuntimeError("permanent")
+
+    r = cl.background_jobs
+    r.register("flaky", flaky)
+    r.register("boom", always_fails)
+    jid = r.create_job("test")
+    r.add_task(jid, "flaky", {}, max_attempts=5)
+    assert r.wait_for_job(jid) == "done"
+    assert calls["n"] == 3
+    jid2 = r.create_job("failing")
+    r.add_task(jid2, "boom", {}, max_attempts=2)
+    assert r.wait_for_job(jid2) == "failed"
+    cl.close()
+
+
+def test_background_job_dependencies(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1)
+    order = []
+    r = cl.background_jobs
+    r.register("step", lambda name: order.append(name))
+    jid = r.create_job("ordered")
+    t1 = r.add_task(jid, "step", {"name": "a"})
+    t2 = r.add_task(jid, "step", {"name": "b"}, depends_on=[t1])
+    r.add_task(jid, "step", {"name": "c"}, depends_on=[t2])
+    assert r.wait_for_job(jid) == "done"
+    assert order == ["a", "b", "c"]
+    cl.close()
+
+
+def test_maintenance_daemon_runs_cleanup(tmp_path):
+    cl = make_cluster(tmp_path)
+    from citus_tpu.operations import record_cleanup, pending_cleanup
+    victim = str(tmp_path / "orphan")
+    os.makedirs(victim)
+    record_cleanup(cl.catalog, victim)
+    cl.maintenance.run_once()
+    assert not os.path.exists(victim)
+    assert pending_cleanup(cl.catalog) == []
+    cl.close()
+
+
+def test_remove_node_guard(tmp_path):
+    cl = make_cluster(tmp_path)
+    with pytest.raises(CatalogError):
+        cl.execute("SELECT citus_remove_node(0)")  # still has placements
+    cl.execute("SELECT citus_add_node('x', 1)")
+    cl.execute("SELECT citus_remove_node(2)")  # fresh empty node: ok
+    assert 2 not in cl.catalog.nodes
+    cl.close()
