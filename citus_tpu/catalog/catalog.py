@@ -162,6 +162,8 @@ class Catalog:
 
     def commit(self) -> None:
         """Atomically persist catalog state (round-1 metadata transaction)."""
+        from citus_tpu.testing.faults import FAULTS
+        FAULTS.hit("catalog_commit")
         with self._lock:
             d = {
                 "tables": [t.to_json() for t in self.tables.values()],

@@ -39,11 +39,25 @@ class Cluster:
             n_nodes = max(len(jax.devices()), 1)
         self.catalog.ensure_nodes(n_nodes)
         self.catalog.commit()
+        # transaction log + recovery on open (reference: 2PC recovery at
+        # maintenance-daemon startup, transaction_recovery.c)
+        from citus_tpu.transaction import TransactionLog
+        from citus_tpu.transaction.recovery import recover_transactions
+        self.txlog = TransactionLog(data_dir)
+        recover_transactions(self.catalog, self.txlog)
         # plan cache keyed by SQL text (reference analog: prepared-statement
         # plan caching + local_plan_cache.c); invalidated by table version
         self._plan_cache: dict[str, tuple] = {}
         self._background_jobs = None
         self._maintenance = None
+        # observability (citus_stat_* / citus_locks analogs)
+        from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        from citus_tpu.stats import ActivityTracker, QueryStats
+        from citus_tpu.transaction import LockManager
+        self.counters = GLOBAL_COUNTERS
+        self.query_stats = QueryStats()
+        self.activity = ActivityTracker()
+        self.locks = LockManager()
 
     @property
     def background_jobs(self):
@@ -63,8 +77,14 @@ class Cluster:
         """Lazy maintenance daemon (reference: maintenanced.c)."""
         if self._maintenance is None:
             from citus_tpu.services import MaintenanceDaemon
-            self._maintenance = MaintenanceDaemon(self.catalog)
-            self._maintenance.start()
+            from citus_tpu.transaction.recovery import recover_transactions
+            d = MaintenanceDaemon(self.catalog)
+            # 2PC recovery duty (reference: Recover2PCInterval, default 60 s)
+            d.register("transaction_recovery",
+                       lambda: recover_transactions(self.catalog, self.txlog),
+                       interval_s=60.0)
+            d.start()
+            self._maintenance = d
         return self._maintenance
 
     def close(self) -> None:
@@ -133,18 +153,31 @@ class Cluster:
         if rows is not None:
             columns = rows_to_columns(t.schema.names, rows, column_names)
         values, validity = encode_columns(self.catalog, t, columns)
-        ing = TableIngestor(self.catalog, t)
-        ing.append(values, validity)
+        ing = TableIngestor(self.catalog, t, txlog=self.txlog)
+        try:
+            ing.append(values, validity)
+        except BaseException:
+            ing.abort()
+            raise
         ing.finish()
         n = len(next(iter(values.values()))) if values else 0
         return n
 
     # -------------------------------------------------------------- SQL
     def execute(self, sql: str) -> Result:
+        import time as _time
         stmts = parse_sql(sql)
         result = Result(columns=[], rows=[])
-        for stmt in stmts:
-            result = self._execute_stmt(stmt, sql_text=sql if len(stmts) == 1 else None)
+        gpid = self.activity.enter(sql)
+        t0 = _time.perf_counter()
+        try:
+            for stmt in stmts:
+                result = self._execute_stmt(stmt, sql_text=sql if len(stmts) == 1 else None)
+        finally:
+            self.activity.exit(gpid)
+        executor = result.explain.get("strategy", "utility") if result.explain else "utility"
+        self.query_stats.record(sql, _time.perf_counter() - t0,
+                                result.rowcount, str(executor))
         return result
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
@@ -282,6 +315,61 @@ class Cluster:
             from citus_tpu.operations import try_drop_orphaned_resources
             n = try_drop_orphaned_resources(self.catalog)
             return Result(columns=["citus_cleanup_orphaned_resources"], rows=[(n,)])
+        if name == "citus_copy_shard_placement":
+            from citus_tpu.operations import copy_shard_placement
+            copy_shard_placement(self.catalog, int(args[0]), int(args[1]), int(args[2]))
+            self._plan_cache.clear()
+            return Result(columns=[name], rows=[(None,)])
+        if name == "citus_stat_counters":
+            snap = self.counters.snapshot()
+            return Result(columns=["counter", "value"],
+                          rows=sorted(snap.items()))
+        if name == "citus_stat_counters_reset":
+            self.counters.reset()
+            return Result(columns=[name], rows=[(None,)])
+        if name == "citus_stat_statements":
+            return Result(columns=["query", "executor", "partition_key",
+                                   "calls", "total_time_ms", "rows"],
+                          rows=self.query_stats.rows_view())
+        if name == "citus_stat_statements_reset":
+            self.query_stats.reset()
+            return Result(columns=[name], rows=[(None,)])
+        if name == "citus_stat_activity":
+            return Result(columns=["global_pid", "state", "elapsed_s", "query"],
+                          rows=self.activity.rows_view())
+        if name == "citus_locks":
+            return Result(columns=["resource", "session", "mode", "granted"],
+                          rows=self.locks.lock_rows())
+        if name == "citus_lock_waits":
+            graph = self.locks.wait_graph()
+            return Result(columns=["waiting_session", "blocking_session"],
+                          rows=[(w, b) for w, bs in graph.items() for b in sorted(bs)])
+        if name == "citus_shards":
+            rows = []
+            for t in self.catalog.tables.values():
+                for s in t.shards:
+                    for node in s.placements:
+                        rows.append((t.name, s.shard_id, t.method, t.colocation_id,
+                                     node, s.hash_min, s.hash_max))
+            return Result(columns=["table_name", "shardid", "citus_table_type",
+                                   "colocation_id", "nodename", "shardminvalue",
+                                   "shardmaxvalue"], rows=rows)
+        if name == "citus_tables":
+            from citus_tpu.catalog.stats import table_row_count
+            rows = []
+            for t in self.catalog.tables.values():
+                rows.append((t.name, t.method, t.dist_column, t.colocation_id,
+                             self._table_size(t.name), t.shard_count,
+                             table_row_count(self.catalog, t)))
+            return Result(columns=["table_name", "citus_table_type",
+                                   "distribution_column", "colocation_id",
+                                   "table_size", "shard_count", "row_count"],
+                          rows=rows)
+        if name == "recover_prepared_transactions":
+            from citus_tpu.transaction.recovery import recover_transactions
+            st = recover_transactions(self.catalog, self.txlog)
+            return Result(columns=["recover_prepared_transactions"],
+                          rows=[(st["rolled_forward"] + st["rolled_back"],)])
         raise UnsupportedFeatureError(f"utility {name}() not supported yet")
 
     def _table_size(self, name: str) -> int:

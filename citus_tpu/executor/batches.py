@@ -47,11 +47,31 @@ def load_shard_batches(
     max_batch_rows rows for one shard placement."""
     table = plan.bound.table
     shard = table.shards[shard_index]
-    node = node_override if node_override is not None else shard.placements[0]
-    d = cat.shard_dir(table.name, shard.shard_id, node)
-    if not os.path.isdir(d) or _load_meta(d)["row_count"] == 0:
-        return
-    reader = ShardReader(d, table.schema)
+    from citus_tpu.testing.faults import FAULTS
+    if node_override is not None:
+        nodes = [node_override]
+    else:
+        nodes = list(shard.placements)
+    # read tasks fail over to other placements, like the reference's
+    # PlacementExecutionDone failover (adaptive_executor.c:96-100)
+    reader = None
+    last_err = None
+    for attempt, node in enumerate(nodes):
+        d = cat.shard_dir(table.name, shard.shard_id, node)
+        try:
+            FAULTS.hit("read_placement", f"{table.name}:{shard.shard_id}:{node}")
+            if not os.path.isdir(d) or _load_meta(d)["row_count"] == 0:
+                return
+            reader = ShardReader(d, table.schema)
+            break
+        except Exception as e:
+            last_err = e
+            if attempt + 1 < len(nodes):
+                from citus_tpu.executor.executor import GLOBAL_COUNTERS
+                GLOBAL_COUNTERS.bump("connection_failovers")
+                continue
+            raise
+    assert reader is not None
     cols = plan.scan_columns
     pend_v: dict[str, list[np.ndarray]] = {c: [] for c in cols}
     pend_m: dict[str, list[np.ndarray]] = {c: [] for c in cols}

@@ -31,6 +31,11 @@ from citus_tpu.executor.finalize import finalize_groups, order_and_limit, projec
 from citus_tpu.ops.scan_agg import build_worker_fn, combine_partials_host
 from citus_tpu.planner.bind import BoundSelect
 from citus_tpu.planner.physical import PhysicalPlan, plan_select
+from citus_tpu.stats import StatCounters
+
+# process-wide counters (the citus_stat_counters analog); Cluster exposes
+# a view over this
+GLOBAL_COUNTERS = StatCounters()
 
 
 @dataclass
@@ -58,8 +63,11 @@ def _combine_kinds(plan: PhysicalPlan) -> list[str]:
 
 def _load_all_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[ShardBatch]:
     """Load every (shard, batch) padded to a common power-of-two bucket."""
+    from citus_tpu.testing.faults import FAULTS
     raw = []
     for si in plan.shard_indexes:
+        FAULTS.hit("dispatch_task", f"{plan.bound.table.name}:{si}")
+        GLOBAL_COUNTERS.bump("tasks_dispatched")
         for values, masks, n in load_shard_batches(
                 cat, plan, si,
                 min_batch_rows=settings.executor.min_batch_rows):
@@ -319,11 +327,17 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
     t0 = time.perf_counter()
     if plan is None:
         plan = plan_select(cat, bound, direct_limit=settings.planner.direct_gid_limit)
+    GLOBAL_COUNTERS.bump("queries_executed")
+    if plan.is_router:
+        GLOBAL_COUNTERS.bump("router_queries")
+    elif len(plan.shard_indexes) > 1:
+        GLOBAL_COUNTERS.bump("multi_shard_queries")
     if bound.has_aggs:
         rows = _run_agg(cat, plan, settings)
     else:
         rows = _run_projection(cat, plan, settings)
     rows = order_and_limit(plan, rows)
+    GLOBAL_COUNTERS.bump("rows_returned", len(rows))
     elapsed = time.perf_counter() - t0
     return Result(
         columns=list(bound.output_names),

@@ -62,11 +62,22 @@ def encode_columns(
 
 
 class TableIngestor:
-    """Holds per-placement writers for one table; routes encoded batches."""
+    """Holds per-placement writers for one table; routes encoded batches.
 
-    def __init__(self, cat: Catalog, table: TableMeta):
+    When constructed with a transaction log, the whole ingest is a
+    two-phase commit across placements (reference: the distributed COPY
+    path commits per-shard COPY streams under 2PC,
+    transaction/transaction_management.c): stripes are written staged,
+    a PREPARED record lists every placement, COMMITTED flips them live,
+    DONE marks recovery-complete.  A crash at any point either rolls
+    forward or rolls back cleanly on the next recover().
+    """
+
+    def __init__(self, cat: Catalog, table: TableMeta, txlog=None):
         self.cat = cat
         self.table = table
+        self.txlog = txlog
+        self.xid = txlog.begin() if txlog is not None else None
         self._writers: dict[tuple[int, int], ShardWriter] = {}
 
     def _writer(self, shard_id: int, node: int) -> ShardWriter:
@@ -80,6 +91,7 @@ class TableIngestor:
                 stripe_row_limit=self.table.stripe_row_limit,
                 codec=self.table.compression,
                 level=self.table.compression_level,
+                staged_xid=self.xid,
             )
             self._writers[key] = w
         return w
@@ -103,14 +115,42 @@ class TableIngestor:
                 self._writer(shard.shard_id, node).append_batch(values, validity)
 
     def finish(self) -> int:
-        """Flush all writers; returns rows written this session."""
+        """Flush all writers (2PC when a txlog is attached); returns rows
+        written this session."""
+        from citus_tpu.storage.writer import commit_staged
+        from citus_tpu.transaction.manager import TxState
+
         total = 0
         for w in self._writers.values():
             total += w._buf_rows
             w.flush()
+        if self.txlog is not None:
+            dirs = [w.directory for w in self._writers.values()]
+            self.txlog.log(self.xid, TxState.PREPARED,
+                           {"kind": "ingest", "table": self.table.name,
+                            "placements": dirs})
+            self.txlog.log(self.xid, TxState.COMMITTED,
+                           {"table": self.table.name})
+            for d in dirs:
+                commit_staged(d, self.xid)
         self.table.version += 1  # invalidate cached plans/statistics
         self.cat.commit()  # persist grown text dictionaries + version
+        if self.txlog is not None:
+            self.txlog.log(self.xid, TxState.DONE)
         return total
+
+    def abort(self) -> None:
+        """Roll back a transactional ingest (drops staged stripes)."""
+        from citus_tpu.storage.writer import abort_staged
+        from citus_tpu.transaction.manager import TxState
+        if self.xid is None:
+            return
+        for w in self._writers.values():
+            w._buf_rows = 0
+            abort_staged(w.directory, self.xid)
+        if self.txlog is not None:
+            self.txlog.log(self.xid, TxState.ABORTED)
+            self.txlog.log(self.xid, TxState.DONE)
 
 
 def rows_to_columns(schema_names: list[str], rows: Iterable[Sequence[Any]],

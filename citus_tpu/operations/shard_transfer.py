@@ -26,6 +26,8 @@ from citus_tpu.storage.writer import SHARD_META, _load_meta
 
 
 def _copy_placement_files(src: str, dst: str) -> None:
+    from citus_tpu.testing.faults import FAULTS
+    FAULTS.hit("shard_move_copy", src)
     os.makedirs(dst, exist_ok=True)
     # stripes are immutable: copy data files first, the meta file last so
     # a crash mid-copy leaves a readable (possibly shorter) placement

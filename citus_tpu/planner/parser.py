@@ -265,6 +265,10 @@ class Parser:
         "citus_move_shard_placement", "citus_copy_shard_placement",
         "citus_table_size", "citus_shard_sizes",
         "master_get_active_worker_nodes",
+        "citus_stat_counters", "citus_stat_counters_reset",
+        "citus_stat_statements", "citus_stat_statements_reset",
+        "citus_stat_activity", "citus_locks", "citus_lock_waits",
+        "citus_shards", "citus_tables", "recover_prepared_transactions",
     }
 
     def parse_select_or_utility(self) -> A.Statement:

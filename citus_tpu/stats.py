@@ -1,0 +1,144 @@
+"""Observability: stat counters, per-query statistics, activity view.
+
+Reference analogs:
+- citus_stat_counters  (src/backend/distributed/stats/stat_counters.c —
+  lock-free per-backend slots; here a lock-guarded counter dict)
+- citus_stat_statements (stats/query_stats.c — shmem hash by queryId;
+  here keyed by normalized SQL text)
+- citus_stat_activity  (transaction/backend_data.c global pids; here
+  live statements with a global id)
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class StatCounters:
+    COUNTERS = [
+        "queries_executed",
+        "router_queries",
+        "multi_shard_queries",
+        "join_queries",
+        "tasks_dispatched",
+        "rows_ingested",
+        "rows_returned",
+        "chunks_total",
+        "chunks_selected",
+        "bytes_scanned",
+        "plan_cache_hits",
+        "plan_cache_misses",
+        "connection_failovers",
+    ]
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._c = {name: 0 for name in self.COUNTERS}
+
+    def bump(self, name: str, by: int = 1) -> None:
+        with self._mu:
+            self._c[name] = self._c.get(name, 0) + by
+
+    def snapshot(self) -> dict[str, int]:
+        with self._mu:
+            return dict(self._c)
+
+    def reset(self) -> None:
+        with self._mu:
+            for k in self._c:
+                self._c[k] = 0
+
+
+_WS = re.compile(r"\s+")
+_NUM = re.compile(r"\b\d+(\.\d+)?\b")
+_STR = re.compile(r"'(?:[^']|'')*'")
+
+
+def normalize_query(sql: str) -> str:
+    """Replace literals with placeholders so executions of the same shape
+    share one statistics bucket (queryId analog)."""
+    out = _STR.sub("?", sql)
+    out = _NUM.sub("?", out)
+    return _WS.sub(" ", out).strip().lower()
+
+
+@dataclass
+class QueryStat:
+    calls: int = 0
+    total_time_s: float = 0.0
+    rows: int = 0
+    executor: str = ""
+    partition_key: str = ""
+
+
+class QueryStats:
+    def __init__(self, max_entries: int = 5000):
+        self._mu = threading.Lock()
+        self._stats: dict[str, QueryStat] = {}
+        self.max_entries = max_entries
+
+    def record(self, sql: str, elapsed_s: float, rows: int, executor: str,
+               partition_key: str = "") -> None:
+        key = normalize_query(sql)
+        with self._mu:
+            st = self._stats.get(key)
+            if st is None:
+                if len(self._stats) >= self.max_entries:
+                    # evict the least-called entry (reference evicts by LRU
+                    # on its dump cycle; least-called is close enough here)
+                    victim = min(self._stats, key=lambda k: self._stats[k].calls)
+                    del self._stats[victim]
+                st = self._stats[key] = QueryStat(executor=executor,
+                                                  partition_key=partition_key)
+            st.calls += 1
+            st.total_time_s += elapsed_s
+            st.rows += rows
+            st.executor = executor
+
+    def rows_view(self) -> list[tuple]:
+        with self._mu:
+            return [(q, s.executor, s.partition_key, s.calls,
+                     round(s.total_time_s * 1000, 3), s.rows)
+                    for q, s in sorted(self._stats.items(),
+                                       key=lambda kv: -kv[1].total_time_s)]
+
+    def reset(self) -> None:
+        with self._mu:
+            self._stats.clear()
+
+
+_GPID = itertools.count(1)
+
+
+@dataclass
+class Activity:
+    gpid: int
+    sql: str
+    started_at: float
+    state: str = "active"
+
+
+class ActivityTracker:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._live: dict[int, Activity] = {}
+
+    def enter(self, sql: str) -> int:
+        gpid = next(_GPID)
+        with self._mu:
+            self._live[gpid] = Activity(gpid, sql, time.time())
+        return gpid
+
+    def exit(self, gpid: int) -> None:
+        with self._mu:
+            self._live.pop(gpid, None)
+
+    def rows_view(self) -> list[tuple]:
+        now = time.time()
+        with self._mu:
+            return [(a.gpid, a.state, round(now - a.started_at, 3), a.sql)
+                    for a in self._live.values()]

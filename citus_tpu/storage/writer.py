@@ -41,11 +41,75 @@ def _store_meta(directory: str, meta: dict) -> None:
     os.replace(tmp, p)
 
 
+# ---- staged (two-phase) metadata ---------------------------------------
+# A transactional write appends stripes as usual but records them in a
+# per-transaction side file; only commit_staged makes them visible by
+# merging into the live metadata (reference analog: the write-visibility
+# StripeWriteState machine, columnar.h:190-207, where a stripe exists on
+# disk before its catalog row commits).
+
+def _staged_path(directory: str, xid: int) -> str:
+    return os.path.join(directory, f"{SHARD_META}.staged.{xid}")
+
+
+def _load_staged(directory: str, xid: int) -> dict:
+    p = _staged_path(directory, xid)
+    if not os.path.exists(p):
+        return {"stripes": [], "row_count": 0}
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def _store_staged(directory: str, xid: int, staged: dict) -> None:
+    p = _staged_path(directory, xid)
+    tmp = p + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(staged, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, p)
+
+
+def commit_staged(directory: str, xid: int) -> None:
+    """Merge a transaction's staged stripes into the live metadata.
+    Idempotent: safe to re-run during 2PC roll-forward."""
+    staged = _load_staged(directory, xid)
+    p = _staged_path(directory, xid)
+    if not staged["stripes"]:
+        if os.path.exists(p):
+            os.remove(p)
+        return
+    meta = _load_meta(directory)
+    live_names = {s["file"] for s in meta["stripes"]}
+    for s in staged["stripes"]:
+        if s["file"] in live_names:
+            continue  # already applied
+        meta["stripes"].append(s)
+        meta["row_count"] += s["row_count"]
+        sid = int(s["file"].split("-")[1].split(".")[0])
+        meta["next_stripe_id"] = max(meta["next_stripe_id"], sid + 1)
+    _store_meta(directory, meta)
+    os.remove(p)
+
+
+def abort_staged(directory: str, xid: int) -> None:
+    """Delete a transaction's staged stripes + side file (rollback)."""
+    staged = _load_staged(directory, xid)
+    for s in staged["stripes"]:
+        fp = os.path.join(directory, s["file"])
+        if os.path.exists(fp):
+            os.remove(fp)
+    p = _staged_path(directory, xid)
+    if os.path.exists(p):
+        os.remove(p)
+
+
 class ShardWriter:
     """Append-only writer for one shard of one table."""
 
     def __init__(self, directory: str, schema: Schema, *, chunk_row_limit: int,
-                 stripe_row_limit: int, codec: str = "zstd", level: int = 3):
+                 stripe_row_limit: int, codec: str = "zstd", level: int = 3,
+                 staged_xid: int | None = None):
         if stripe_row_limit % chunk_row_limit != 0:
             raise StorageError("stripe_row_limit must be a multiple of chunk_row_limit")
         self.directory = directory
@@ -54,6 +118,7 @@ class ShardWriter:
         self.stripe_row_limit = stripe_row_limit
         self.codec = codec
         self.level = level
+        self.staged_xid = staged_xid
         os.makedirs(directory, exist_ok=True)
         self._buf: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
         self._buf_valid: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
@@ -129,13 +194,24 @@ class ShardWriter:
                     chunks.append((vals, None))
             column_chunks[col] = chunks
         meta = _load_meta(self.directory)
-        sid = meta["next_stripe_id"]
-        fname = f"stripe-{sid:06d}.cts"
-        write_stripe_file(
-            os.path.join(self.directory, fname), column_chunks, chunk_rows,
-            self.chunk_row_limit, self.codec, self.level)
-        meta["stripes"].append({"file": fname, "row_count": n})
-        meta["row_count"] += n
-        meta["next_stripe_id"] = sid + 1
-        _store_meta(self.directory, meta)
+        if self.staged_xid is not None:
+            staged = _load_staged(self.directory, self.staged_xid)
+            sid = meta["next_stripe_id"] + len(staged["stripes"])
+            fname = f"stripe-{sid:06d}.cts"
+            write_stripe_file(
+                os.path.join(self.directory, fname), column_chunks, chunk_rows,
+                self.chunk_row_limit, self.codec, self.level)
+            staged["stripes"].append({"file": fname, "row_count": n})
+            staged["row_count"] += n
+            _store_staged(self.directory, self.staged_xid, staged)
+        else:
+            sid = meta["next_stripe_id"]
+            fname = f"stripe-{sid:06d}.cts"
+            write_stripe_file(
+                os.path.join(self.directory, fname), column_chunks, chunk_rows,
+                self.chunk_row_limit, self.codec, self.level)
+            meta["stripes"].append({"file": fname, "row_count": n})
+            meta["row_count"] += n
+            meta["next_stripe_id"] = sid + 1
+            _store_meta(self.directory, meta)
         self._buf_rows -= n

