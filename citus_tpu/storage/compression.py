@@ -48,9 +48,27 @@ def _load_lz4():
     return lib
 
 
+def _native():
+    from citus_tpu.native import CODEC_IDS, get_lib
+    return get_lib(), CODEC_IDS
+
+
 def compress(data: bytes, codec: str, level: int = 3) -> bytes:
     if codec == CODEC_NONE:
         return data
+    lib, ids = _native()
+    if lib is not None and codec in ids:
+        import ctypes
+        import numpy as np
+        cid = ids[codec]
+        bound = lib.ct_compress_bound(cid, len(data))
+        out = np.empty(bound, np.uint8)
+        src = np.frombuffer(data, np.uint8)
+        n = lib.ct_compress(
+            cid, src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(data),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), bound, level)
+        if n > 0:
+            return out[:n].tobytes()
     if codec == CODEC_ZSTD:
         if _zstd is None:  # pragma: no cover
             raise StorageError("zstandard module not available")
@@ -71,6 +89,20 @@ def compress(data: bytes, codec: str, level: int = 3) -> bytes:
 def decompress(data: bytes, codec: str, raw_size: int) -> bytes:
     if codec == CODEC_NONE:
         return data
+    lib, ids = _native()
+    if lib is not None and codec in ids:
+        import ctypes
+        import numpy as np
+        out = np.empty(raw_size, np.uint8)
+        src = np.frombuffer(data, np.uint8)
+        n = lib.ct_decompress(
+            ids[codec], src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            raw_size)
+        if n == raw_size:
+            return out.tobytes()
+        if n >= 0:
+            return out[:n].tobytes()
     if codec == CODEC_ZSTD:
         if _zstd is None:  # pragma: no cover
             raise StorageError("zstandard module not available")

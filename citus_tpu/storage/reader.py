@@ -91,9 +91,13 @@ class ShardReader:
             selected = self._selected_chunks(footer, constraints)
             if not selected.any():
                 continue
+            sel_idx = [int(i) for i in np.nonzero(selected)[0]]
+            native = self._scan_stripe_native(path, footer, columns, sel_idx)
+            if native is not None:
+                yield from native
+                continue
             with open(path, "rb") as fh:
-                for ci in np.nonzero(selected)[0]:
-                    ci = int(ci)
+                for ci in sel_idx:
                     vals, valid = {}, {}
                     for col in columns:
                         stats = footer.columns[col][ci]
@@ -103,6 +107,71 @@ class ShardReader:
                         values=vals, validity=valid,
                         row_count=footer.chunk_row_counts[ci],
                         stripe_file=stripe["file"], chunk_index=ci)
+
+    def _scan_stripe_native(self, path, footer, columns, sel_idx):
+        """Batched read+decompress of all selected streams of one stripe
+        through the C++ runtime (one call per column); None = unavailable."""
+        from citus_tpu.native import CODEC_IDS, get_lib
+        lib = get_lib()
+        if lib is None or footer.codec not in CODEC_IDS:
+            return None
+        import ctypes
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        cid = CODEC_IDS[footer.codec]
+        # one native call per stripe: every (column, chunk) value stream
+        streams = []  # (col, k, stats)
+        for col in columns:
+            for k, ci in enumerate(sel_idx):
+                streams.append((col, k, footer.columns[col][ci]))
+        offs = np.array([s.value_offset for _, _, s in streams], np.int64)
+        clens = np.array([s.value_length for _, _, s in streams], np.int64)
+        rlens = np.array([s.value_raw_length for _, _, s in streams], np.int64)
+        dsts = np.concatenate([[0], np.cumsum(rlens)[:-1]]).astype(np.int64)
+        total = int(rlens.sum())
+        out = np.empty(max(total, 1), np.uint8)
+        scratch = np.empty(max(int(clens.max(initial=0)), 1), np.uint8)
+        rc = lib.ct_read_streams(
+            path.encode(), cid, len(streams),
+            offs.ctypes.data_as(i64p), clens.ctypes.data_as(i64p),
+            rlens.ctypes.data_as(i64p), dsts.ctypes.data_as(i64p),
+            out.ctypes.data_as(u8p), max(total, 1),
+            scratch.ctypes.data_as(u8p), len(scratch))
+        if rc != 0:
+            return None  # fall back to the python reader
+        per_col_vals: dict[str, list] = {c: [None] * len(sel_idx) for c in columns}
+        per_col_valid: dict[str, list] = {c: [None] * len(sel_idx) for c in columns}
+        for si, (col, k, s) in enumerate(streams):
+            dt = self.schema.column(col).type.storage_dtype
+            arr = out[dsts[si]:dsts[si] + rlens[si]].view(dt)
+            if arr.shape[0] != s.row_count:
+                return None
+            per_col_vals[col][k] = arr
+        # validity streams (usually few; read individually)
+        null_streams = [(col, k, footer.columns[col][ci])
+                        for col in columns for k, ci in enumerate(sel_idx)
+                        if footer.columns[col][ci].has_nulls]
+        if null_streams:
+            from citus_tpu.storage import compression as comp
+            with open(path, "rb") as fh:
+                for col, k, s in null_streams:
+                    fh.seek(s.exists_offset)
+                    braw = comp.decompress(fh.read(s.exists_length),
+                                           footer.codec, s.exists_raw_length)
+                    bits = np.frombuffer(braw, np.uint8)
+                    unpacked = np.empty(s.row_count, np.uint8)
+                    lib.ct_unpack_bits(
+                        bits.ctypes.data_as(u8p), s.row_count,
+                        unpacked.ctypes.data_as(u8p))
+                    per_col_valid[col][k] = unpacked.astype(bool)
+        out_batches = []
+        for k, ci in enumerate(sel_idx):
+            out_batches.append(ChunkBatch(
+                values={c: per_col_vals[c][k] for c in columns},
+                validity={c: per_col_valid[c][k] for c in columns},
+                row_count=footer.chunk_row_counts[ci],
+                stripe_file=os.path.basename(path), chunk_index=ci))
+        return out_batches
 
     def chunk_counts(self, constraints: Optional[list[Interval]] = None) -> tuple[int, int]:
         """(selected_chunks, total_chunks) — for EXPLAIN/statistics."""
