@@ -216,6 +216,52 @@ class Cluster:
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Insert):
             return self._execute_insert(stmt)
+        if isinstance(stmt, A.Delete):
+            from citus_tpu.executor.dml import execute_delete
+            from citus_tpu.planner.bind import Binder
+            t = self.catalog.table(stmt.table)
+            where = Binder(self.catalog, t).bind_scalar(stmt.where) \
+                if stmt.where is not None else None
+            n = execute_delete(self.catalog, self.txlog, t, where)
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[], explain={"deleted": n})
+        if isinstance(stmt, A.Update):
+            from citus_tpu.executor.dml import execute_update
+            from citus_tpu.planner.bind import Binder
+            t = self.catalog.table(stmt.table)
+            b = Binder(self.catalog, t)
+            assignments = []
+            for col, e in stmt.assignments:
+                target = t.schema.column(col)
+                bound = b.bind_scalar(e)
+                from citus_tpu.planner.bound import BCast, BLiteral
+                if target.type.is_text:
+                    if isinstance(bound, BLiteral) and isinstance(bound.value, str):
+                        did = self.catalog.encode_strings(t.name, col, [bound.value])[0]
+                        bound = BLiteral(int(did), target.type)
+                    elif not bound.type.is_text:
+                        raise AnalysisError(
+                            f"cannot assign {bound.type} to {col} ({target.type})")
+                elif bound.type.is_text:
+                    raise AnalysisError(
+                        f"cannot assign text to {col} ({target.type})")
+                elif bound.type != target.type:
+                    bound = BCast(bound, target.type)
+                assignments.append((col, bound))
+            where = b.bind_scalar(stmt.where) if stmt.where is not None else None
+            n = execute_update(self.catalog, self.txlog, t, assignments, where)
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[], explain={"updated": n})
+        if isinstance(stmt, A.Truncate):
+            from citus_tpu.executor.dml import execute_truncate
+            execute_truncate(self.catalog, self.catalog.table(stmt.table))
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.Vacuum):
+            from citus_tpu.executor.dml import execute_vacuum
+            st = execute_vacuum(self.catalog, self.catalog.table(stmt.table))
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.UtilityCall):
             return self._execute_utility(stmt)
         if isinstance(stmt, A.Explain):

@@ -190,6 +190,30 @@ class Select(Statement):
 
 
 @dataclass
+class Delete(Statement):
+    table: str
+    where: Optional[Expr] = None
+
+
+@dataclass
+class Update(Statement):
+    table: str
+    assignments: list[tuple[str, Expr]] = field(default_factory=list)
+    where: Optional[Expr] = None
+
+
+@dataclass
+class Truncate(Statement):
+    table: str
+
+
+@dataclass
+class Vacuum(Statement):
+    table: str
+    full: bool = False
+
+
+@dataclass
 class UtilityCall(Statement):
     """SELECT create_distributed_table('t', 'col') style UDF utilities —
     the reference exposes its control plane as SQL-callable UDFs

@@ -36,7 +36,8 @@ KEYWORDS = {
     "into", "values", "distinct", "asc", "desc", "nulls", "first", "last",
     "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "case", "when", "then", "else", "end", "cast", "explain", "analyze",
-    "using", "with", "like",
+    "using", "with", "like", "delete", "update", "set", "truncate",
+    "vacuum",
 }
 
 
@@ -145,6 +146,33 @@ class Parser:
             return self.parse_drop_table()
         if self.at_kw("insert"):
             return self.parse_insert()
+        if self.at_kw("delete"):
+            self.next()
+            self.expect_kw("from")
+            name = self.expect_ident()
+            where = self.parse_expr() if self.accept_kw("where") else None
+            return A.Delete(name, where)
+        if self.at_kw("update"):
+            self.next()
+            name = self.expect_ident()
+            self.expect_kw("set")
+            assignments = []
+            while True:
+                col = self.expect_ident()
+                self.expect_op("=")
+                assignments.append((col, self.parse_expr()))
+                if not self.accept_op(","):
+                    break
+            where = self.parse_expr() if self.accept_kw("where") else None
+            return A.Update(name, assignments, where)
+        if self.at_kw("truncate"):
+            self.next()
+            self.accept_kw("table")
+            return A.Truncate(self.expect_ident())
+        if self.at_kw("vacuum"):
+            self.next()
+            full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())
+            return A.Vacuum(self.expect_ident(), full)
         self.error("expected a statement")
 
     def parse_explain(self) -> A.Explain:

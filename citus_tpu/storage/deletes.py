@@ -1,0 +1,115 @@
+"""Deletion bitmaps.
+
+The reference's columnar access method rejects UPDATE/DELETE outright
+(columnar_tableam.c: columnar_fetch_row_version errors); row tables get
+them from PostgreSQL's heap.  We close that capability gap the
+columnar-native way: stripes stay immutable, and each placement keeps a
+side file mapping stripe -> packed deletion bitmap.  Scans subtract the
+bitmap; VACUUM rewrites stripes to reclaim the space.  Updates are
+delete + re-insert (the moved-row case falls out naturally because
+re-inserted rows re-hash to their shard).
+
+The side file supports the same staged/2PC protocol as shard metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+DELETES_FILE = "deletes.json"
+
+
+def _path(directory: str) -> str:
+    return os.path.join(directory, DELETES_FILE)
+
+
+def _staged_path(directory: str, xid: int) -> str:
+    return os.path.join(directory, f"{DELETES_FILE}.staged.{xid}")
+
+
+def _encode(mask: np.ndarray) -> str:
+    return np.packbits(mask.astype(np.uint8)).tobytes().hex()
+
+
+def _decode(hexstr: str, n_rows: int) -> np.ndarray:
+    bits = np.frombuffer(bytes.fromhex(hexstr), np.uint8)
+    return np.unpackbits(bits)[:n_rows].astype(bool)
+
+
+def load_deletes(directory: str) -> dict[str, str]:
+    p = _path(directory)
+    if not os.path.exists(p):
+        return {}
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def deleted_mask(directory: str, stripe_file: str, n_rows: int,
+                 cache: dict | None = None) -> np.ndarray | None:
+    d = cache if cache is not None else load_deletes(directory)
+    h = d.get(stripe_file)
+    if h is None:
+        return None
+    return _decode(h, n_rows)
+
+
+def _store(path: str, d: dict[str, str]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(d, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def stage_deletes(directory: str, xid: int,
+                  per_stripe: dict[str, tuple[np.ndarray, int]]) -> None:
+    """Stage row deletions: per_stripe[stripe_file] = (row_indexes, n_rows).
+    Merges with the placement's existing live bitmap."""
+    live = load_deletes(directory)
+    staged = {}
+    for stripe_file, (idx, n_rows) in per_stripe.items():
+        mask = deleted_mask(directory, stripe_file, n_rows, live)
+        if mask is None:
+            mask = np.zeros(n_rows, bool)
+        mask[idx] = True
+        staged[stripe_file] = _encode(mask)
+    _store(_staged_path(directory, xid), staged)
+
+
+def commit_staged_deletes(directory: str, xid: int) -> None:
+    """Merge staged bitmaps into the live file (idempotent)."""
+    p = _staged_path(directory, xid)
+    if not os.path.exists(p):
+        return
+    with open(p) as fh:
+        staged = json.load(fh)
+    live = load_deletes(directory)
+    live.update(staged)  # staged bitmaps were built on top of live ones
+    _store(_path(directory), live)
+    os.remove(p)
+
+
+def abort_staged_deletes(directory: str, xid: int) -> None:
+    p = _staged_path(directory, xid)
+    if os.path.exists(p):
+        os.remove(p)
+
+
+def clear_deletes(directory: str) -> None:
+    p = _path(directory)
+    if os.path.exists(p):
+        os.remove(p)
+
+
+def deleted_count(directory: str, stripe_rows: dict[str, int]) -> int:
+    d = load_deletes(directory)
+    total = 0
+    for stripe_file, h in d.items():
+        n = stripe_rows.get(stripe_file)
+        if n is not None:
+            total += int(_decode(h, n).sum())
+    return total

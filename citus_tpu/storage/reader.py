@@ -57,6 +57,8 @@ class ChunkBatch:
     row_count: int
     stripe_file: str
     chunk_index: int
+    # first row's offset within the stripe (position addressing for DML)
+    chunk_row_offset: int = 0
 
 
 class ShardReader:
@@ -79,22 +81,34 @@ class ShardReader:
         self,
         columns: list[str],
         constraints: Optional[list[Interval]] = None,
+        apply_deletes: bool = True,
     ) -> Iterator[ChunkBatch]:
         """Yield chunk batches for the projected ``columns``, skipping
-        chunks refuted by ``constraints`` (conjunctive semantics)."""
+        chunks refuted by ``constraints`` (conjunctive semantics) and
+        subtracting deletion bitmaps (unless ``apply_deletes=False``,
+        used by DML that needs original row positions)."""
+        from citus_tpu.storage.deletes import deleted_mask, load_deletes
         constraints = constraints or []
         for col in columns:
             self.schema.column(col)  # validate projection
+        delete_cache = load_deletes(self.directory) if apply_deletes else {}
         for stripe in self.meta["stripes"]:
             path = os.path.join(self.directory, stripe["file"])
             footer = read_stripe_footer(path)
             selected = self._selected_chunks(footer, constraints)
             if not selected.any():
                 continue
+            offsets = np.concatenate([[0], np.cumsum(footer.chunk_row_counts)[:-1]])
+            del_mask = None
+            if apply_deletes and stripe["file"] in delete_cache:
+                del_mask = deleted_mask(self.directory, stripe["file"],
+                                        footer.row_count, delete_cache)
             sel_idx = [int(i) for i in np.nonzero(selected)[0]]
             native = self._scan_stripe_native(path, footer, columns, sel_idx)
             if native is not None:
-                yield from native
+                for b in native:
+                    b.chunk_row_offset = int(offsets[b.chunk_index])
+                    yield self._subtract_deletes(b, del_mask)
                 continue
             with open(path, "rb") as fh:
                 for ci in sel_idx:
@@ -103,10 +117,26 @@ class ShardReader:
                         stats = footer.columns[col][ci]
                         v, m = read_chunk(fh, footer, stats, self.schema.column(col).type.storage_dtype)
                         vals[col], valid[col] = v, m
-                    yield ChunkBatch(
+                    b = ChunkBatch(
                         values=vals, validity=valid,
                         row_count=footer.chunk_row_counts[ci],
-                        stripe_file=stripe["file"], chunk_index=ci)
+                        stripe_file=stripe["file"], chunk_index=ci,
+                        chunk_row_offset=int(offsets[ci]))
+                    yield self._subtract_deletes(b, del_mask)
+
+    @staticmethod
+    def _subtract_deletes(b: ChunkBatch, del_mask) -> ChunkBatch:
+        if del_mask is None:
+            return b
+        sl = del_mask[b.chunk_row_offset:b.chunk_row_offset + b.row_count]
+        if not sl.any():
+            return b
+        keep = ~sl
+        b.values = {c: v[keep] for c, v in b.values.items()}
+        b.validity = {c: (m[keep] if m is not None else None)
+                      for c, m in b.validity.items()}
+        b.row_count = int(keep.sum())
+        return b
 
     def _scan_stripe_native(self, path, footer, columns, sel_idx):
         """Batched read+decompress of all selected streams of one stripe

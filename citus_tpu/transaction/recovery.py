@@ -17,15 +17,26 @@ from citus_tpu.storage.writer import SHARD_META, abort_staged, commit_staged
 from citus_tpu.transaction.manager import TransactionLog, TxState
 
 _STAGED_RE = re.compile(re.escape(SHARD_META) + r"\.staged\.(\d+)$")
+_STAGED_DEL_RE = re.compile(r"deletes\.json\.staged\.(\d+)$")
 
 
 def recover_transactions(cat: Catalog, txlog: TransactionLog) -> dict:
     """Apply every undecided transaction's outcome; returns counts."""
+    from citus_tpu.storage.deletes import abort_staged_deletes, commit_staged_deletes
+
     rolled_forward = rolled_back = 0
     for xid, state, payload in txlog.outstanding():
+        kind = payload.get("kind", "ingest")
         placements = payload.get("placements", [])
+        ingest_placements = payload.get("ingest_placements", [])
         if state == TxState.COMMITTED:
             for d in placements:
+                if os.path.isdir(d):
+                    if kind in ("delete", "update"):
+                        commit_staged_deletes(d, xid)
+                    else:
+                        commit_staged(d, xid)
+            for d in ingest_placements:
                 if os.path.isdir(d):
                     commit_staged(d, xid)
             table = payload.get("table")
@@ -35,6 +46,12 @@ def recover_transactions(cat: Catalog, txlog: TransactionLog) -> dict:
             rolled_forward += 1
         else:  # PREPARED (coordinator died before commit) or ABORTED
             for d in placements:
+                if os.path.isdir(d):
+                    if kind in ("delete", "update"):
+                        abort_staged_deletes(d, xid)
+                    else:
+                        abort_staged(d, xid)
+            for d in ingest_placements:
                 if os.path.isdir(d):
                     abort_staged(d, xid)
             rolled_back += 1
@@ -52,6 +69,11 @@ def recover_transactions(cat: Catalog, txlog: TransactionLog) -> dict:
                 m = _STAGED_RE.match(f)
                 if m and int(m.group(1)) not in known:
                     abort_staged(root, int(m.group(1)))
+                    swept += 1
+                    continue
+                m = _STAGED_DEL_RE.match(f)
+                if m and int(m.group(1)) not in known:
+                    abort_staged_deletes(root, int(m.group(1)))
                     swept += 1
     txlog.truncate_done()
     return {"rolled_forward": rolled_forward, "rolled_back": rolled_back,
