@@ -1,0 +1,72 @@
+"""Change data capture.
+
+Reference: the CDC decoder wrapper (src/backend/distributed/cdc/
+cdc_decoder.c) that rewrites logical-decoding changes from shard OIDs to
+distributed-table OIDs and suppresses internal replication (shard moves)
+via the DoNotReplicateId origin.
+
+Here the change stream is written at commit time by the DML/ingest paths
+(there is no WAL to decode): one JSONL stream per table, ordered by the
+transaction's HLC timestamp.  Internal data movement (shard moves,
+rebalances, VACUUM rewrites) bypasses the emit path entirely, giving the
+same "changes once, at the distributed-table level" guarantee.
+
+Gated by ``enable_change_data_capture`` per cluster (reference GUC
+citus.enable_change_data_capture).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Iterator, Optional
+
+
+class ChangeDataCapture:
+    def __init__(self, data_dir: str, enabled: bool = False):
+        self.dir = os.path.join(data_dir, "cdc")
+        self.enabled = enabled
+        self._mu = threading.Lock()
+
+    def _path(self, table: str) -> str:
+        return os.path.join(self.dir, f"{table}.changes.jsonl")
+
+    def emit(self, table: str, op: str, lsn: int, *,
+             rows: Optional[list] = None, count: Optional[int] = None,
+             columns: Optional[list[str]] = None) -> None:
+        """op in {insert, delete, update}; lsn = HLC transaction clock."""
+        if not self.enabled:
+            return
+        os.makedirs(self.dir, exist_ok=True)
+        rec = {"lsn": lsn, "op": op, "table": table}
+        if columns is not None:
+            rec["columns"] = columns
+        if rows is not None:
+            rec["rows"] = rows
+            rec["count"] = len(rows)
+        elif count is not None:
+            rec["count"] = count
+        with self._mu:
+            with open(self._path(table), "a") as fh:
+                fh.write(json.dumps(rec, default=str) + "\n")
+                fh.flush()
+
+    def events(self, table: str, from_lsn: int = 0) -> Iterator[dict]:
+        p = self._path(table)
+        if not os.path.exists(p):
+            return
+        with open(p) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec["lsn"] > from_lsn:
+                    yield rec
+
+    def last_lsn(self, table: str) -> int:
+        last = 0
+        for rec in self.events(table):
+            last = max(last, rec["lsn"])
+        return last

@@ -45,6 +45,10 @@ class Cluster:
         from citus_tpu.transaction.recovery import recover_transactions
         self.txlog = TransactionLog(data_dir)
         recover_transactions(self.catalog, self.txlog)
+        from citus_tpu.cdc import ChangeDataCapture
+        from citus_tpu.utils.clock import CausalClock
+        self.clock = CausalClock(data_dir)
+        self.cdc = ChangeDataCapture(data_dir, self.settings.enable_change_data_capture)
         # plan cache keyed by SQL text (reference analog: prepared-statement
         # plan caching + local_plan_cache.c); invalidated by table version
         self._plan_cache: dict[str, tuple] = {}
@@ -92,6 +96,30 @@ class Cluster:
             self._background_jobs.stop()
         if self._maintenance is not None:
             self._maintenance.stop()
+
+    def _maybe_reload_catalog(self) -> None:
+        """Pick up metadata written by other coordinators sharing this
+        data dir (the query-from-any-node / MX analog: any process can
+        plan and execute once metadata is synced; reference:
+        metadata/metadata_sync.c)."""
+        import os
+        p = self.catalog._path()
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            return
+        if getattr(self, "_catalog_mtime", None) is None:
+            self._catalog_mtime = mtime
+            return
+        if mtime != self._catalog_mtime:
+            self._catalog_mtime = mtime
+            self.catalog.tables.clear()
+            self.catalog.nodes.clear()
+            self.catalog._dicts.clear()
+            self.catalog._dict_index.clear()
+            self.catalog._load()
+            self.catalog.ddl_epoch += 1  # invalidate cached plans
+            self._plan_cache.clear()
 
     # ------------------------------------------------------------- DDL
     def create_table(self, name: str, schema: Schema, *, if_not_exists: bool = False,
@@ -161,11 +189,64 @@ class Cluster:
             raise
         ing.finish()
         n = len(next(iter(values.values()))) if values else 0
+        self.counters.bump("rows_ingested", n)
+        if self.cdc.enabled and n:
+            self.cdc.emit(t.name, "insert", self.clock.transaction_clock(),
+                          rows=self._decode_rows(t, values, validity),
+                          columns=t.schema.names)
         return n
+
+    def _decode_rows(self, t, values, validity) -> list:
+        out = []
+        names = t.schema.names
+        n = len(next(iter(values.values())))
+        text_cache = {}
+        for c in names:
+            col = t.schema.column(c)
+            if col.type.is_text:
+                text_cache[c] = self.catalog.decode_strings(
+                    t.name, c, values[c].tolist())
+        for i in range(n):
+            row = []
+            for c in names:
+                col = t.schema.column(c)
+                if not validity[c][i]:
+                    row.append(None)
+                elif col.type.is_text:
+                    row.append(text_cache[c][i])
+                else:
+                    row.append(col.type.from_physical(values[c][i].item()))
+            out.append(row)
+        return out
+
+    def copy_from_csv(self, table_name: str, path: str, *,
+                      delimiter: str = ",", header: bool = False,
+                      null_string: str = "", batch_rows: int = 200_000) -> int:
+        """Bulk load from a CSV file, streamed in batches (the reference's
+        COPY FROM with per-shard stream switchover,
+        commands/multi_copy.c)."""
+        import csv
+        t = self.catalog.table(table_name)
+        names = t.schema.names
+        total = 0
+        with open(path, newline="") as fh:
+            reader = csv.reader(fh, delimiter=delimiter)
+            if header:
+                next(reader, None)
+            batch: list = []
+            for row in reader:
+                batch.append([None if v == null_string else v for v in row])
+                if len(batch) >= batch_rows:
+                    total += self.copy_from(table_name, rows=batch)
+                    batch = []
+            if batch:
+                total += self.copy_from(table_name, rows=batch)
+        return total
 
     # -------------------------------------------------------------- SQL
     def execute(self, sql: str) -> Result:
         import time as _time
+        self._maybe_reload_catalog()
         stmts = parse_sql(sql)
         result = Result(columns=[], rows=[])
         gpid = self.activity.enter(sql)
@@ -216,6 +297,14 @@ class Cluster:
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Insert):
             return self._execute_insert(stmt)
+        if isinstance(stmt, A.CopyFrom):
+            n = self.copy_from_csv(
+                stmt.table, stmt.path,
+                delimiter=stmt.options.get("delimiter", ","),
+                header=str(stmt.options.get("header", "false")).lower()
+                in ("true", "1", "on"),
+                null_string=stmt.options.get("null", ""))
+            return Result(columns=[], rows=[], explain={"copied": n})
         if isinstance(stmt, A.Delete):
             from citus_tpu.executor.dml import execute_delete
             from citus_tpu.planner.bind import Binder
@@ -411,6 +500,35 @@ class Cluster:
                                    "distribution_column", "colocation_id",
                                    "table_size", "shard_count", "row_count"],
                           rows=rows)
+        if name == "undistribute_table":
+            from citus_tpu.operations.alter_table import undistribute_table
+            undistribute_table(self.catalog, args[0], txlog=self.txlog)
+            self._plan_cache.clear()
+            return Result(columns=[name], rows=[(None,)])
+        if name == "alter_distributed_table":
+            from citus_tpu.operations.alter_table import alter_distributed_table
+            kw = {}
+            if len(args) > 1:
+                kw["shard_count"] = int(args[1])
+            if len(args) > 2:
+                kw["distribution_column"] = str(args[2])
+            alter_distributed_table(self.catalog, args[0], txlog=self.txlog, **kw)
+            self._plan_cache.clear()
+            return Result(columns=[name], rows=[(None,)])
+        if name == "citus_get_node_clock":
+            return Result(columns=["citus_get_node_clock"],
+                          rows=[(self.clock.now(),)])
+        if name == "citus_get_transaction_clock":
+            return Result(columns=["citus_get_transaction_clock"],
+                          rows=[(self.clock.transaction_clock(),)])
+        if name == "citus_create_restore_point":
+            from citus_tpu.operations.restore import create_restore_point
+            create_restore_point(self.catalog, str(args[0]))
+            return Result(columns=["citus_create_restore_point"], rows=[(str(args[0]),)])
+        if name == "citus_list_restore_points":
+            from citus_tpu.operations.restore import list_restore_points
+            return Result(columns=["name", "created_at"],
+                          rows=list_restore_points(self.catalog))
         if name == "recover_prepared_transactions":
             from citus_tpu.transaction.recovery import recover_transactions
             st = recover_transactions(self.catalog, self.txlog)

@@ -76,6 +76,8 @@ class Settings:
     planner: PlannerSettings = field(default_factory=PlannerSettings)
     executor: ExecutorSettings = field(default_factory=ExecutorSettings)
     sharding: ShardingSettings = field(default_factory=ShardingSettings)
+    # reference GUC citus.enable_change_data_capture
+    enable_change_data_capture: bool = False
 
     def replace(self, **kw) -> "Settings":
         return dataclasses.replace(self, **kw)

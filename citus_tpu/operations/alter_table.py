@@ -1,0 +1,98 @@
+"""Table redistribution: undistribute_table / alter_distributed_table.
+
+Reference: src/backend/distributed/commands/alter_table.c —
+alter_distributed_table (change shard count / distribution column /
+colocation) and undistribute_table both work by creating a new table,
+moving the data, and swapping names under locks.  Here the swap is a
+catalog update: read every live row, rewrite the shard layout, re-ingest
+(hash routing handles the new layout), then defer-clean the old files.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from citus_tpu.catalog import Catalog, DistributionMethod
+from citus_tpu.errors import CatalogError
+from citus_tpu.operations.cleaner import DEFERRED_ON_SUCCESS, record_cleanup
+from citus_tpu.storage import ShardReader
+
+
+def _collect_all_rows(cat: Catalog, table) -> tuple[dict, dict, int]:
+    """Read every live row of a table into column arrays."""
+    vals = {c.name: [] for c in table.schema}
+    valid = {c.name: [] for c in table.schema}
+    total = 0
+    for shard in table.shards:
+        d = cat.shard_dir(table.name, shard.shard_id, shard.placements[0])
+        if not os.path.isdir(d):
+            continue
+        reader = ShardReader(d, table.schema)
+        for batch in reader.scan(table.schema.names):
+            for c in table.schema.names:
+                vals[c].append(batch.values[c])
+                m = batch.validity[c]
+                valid[c].append(np.ones(batch.row_count, bool) if m is None else m)
+            total += batch.row_count
+    out_v = {c: (np.concatenate(v) if v else
+                 np.zeros(0, table.schema.column(c).type.storage_dtype))
+             for c, v in vals.items()}
+    out_m = {c: (np.concatenate(m) if m else np.zeros(0, bool))
+             for c, m in valid.items()}
+    return out_v, out_m, total
+
+
+def _record_old_placements(cat: Catalog, table) -> None:
+    for shard in table.shards:
+        for node in shard.placements:
+            d = cat.shard_dir(table.name, shard.shard_id, node)
+            if os.path.isdir(d):
+                record_cleanup(cat, d, DEFERRED_ON_SUCCESS)
+
+
+def _reingest(cat: Catalog, table, values, validity, txlog) -> None:
+    from citus_tpu.ingest import TableIngestor
+    if len(next(iter(values.values()), [])) == 0:
+        return
+    ing = TableIngestor(cat, table, txlog=txlog)
+    ing.append(values, validity)
+    ing.finish()
+
+
+def undistribute_table(cat: Catalog, name: str, txlog=None) -> None:
+    t = cat.table(name)
+    if t.method == DistributionMethod.LOCAL:
+        raise CatalogError(f'table "{name}" is not distributed')
+    values, validity, _ = _collect_all_rows(cat, t)
+    _record_old_placements(cat, t)
+    from citus_tpu.catalog.catalog import ShardMeta
+    t.method = DistributionMethod.LOCAL
+    t.dist_column = None
+    t.colocation_id = 0
+    t.shards = [ShardMeta(cat._alloc_shard_id(), 0, placements=[0])]
+    t.version += 1
+    cat.ddl_epoch += 1
+    cat.commit()
+    _reingest(cat, t, values, validity, txlog)
+
+
+def alter_distributed_table(cat: Catalog, name: str, *,
+                            shard_count: Optional[int] = None,
+                            distribution_column: Optional[str] = None,
+                            colocate_with: Optional[str] = None,
+                            txlog=None) -> None:
+    t = cat.table(name)
+    if not t.is_distributed:
+        raise CatalogError(f'table "{name}" is not distributed')
+    new_count = shard_count or t.shard_count
+    new_col = distribution_column or t.dist_column
+    values, validity, _ = _collect_all_rows(cat, t)
+    _record_old_placements(cat, t)
+    cat.distribute_table(name, new_col, new_count, cat.active_node_ids(),
+                         colocate_with=colocate_with)
+    t.version += 1
+    cat.commit()
+    _reingest(cat, t, values, validity, txlog)

@@ -190,6 +190,13 @@ class Select(Statement):
 
 
 @dataclass
+class CopyFrom(Statement):
+    table: str
+    path: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
 class Delete(Statement):
     table: str
     where: Optional[Expr] = None

@@ -37,7 +37,7 @@ KEYWORDS = {
     "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "case", "when", "then", "else", "end", "cast", "explain", "analyze",
     "using", "with", "like", "delete", "update", "set", "truncate",
-    "vacuum",
+    "vacuum", "copy",
 }
 
 
@@ -169,6 +169,28 @@ class Parser:
             self.next()
             self.accept_kw("table")
             return A.Truncate(self.expect_ident())
+        if self.at_kw("copy"):
+            self.next()
+            name = self.expect_ident()
+            self.expect_kw("from")
+            t = self.next()
+            if t.kind != "str":
+                self.error("expected a quoted file path after COPY ... FROM")
+            path = t.value[1:-1].replace("''", "'")
+            options = {}
+            if self.accept_kw("with"):
+                self.expect_op("(")
+                while True:
+                    key = self.expect_ident() if self.peek().kind == "ident" else self.next().value
+                    if self.at_op(")") or self.at_op(","):
+                        options[key] = True
+                    else:
+                        v = self.next()
+                        options[key] = v.value.strip("'")
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return A.CopyFrom(name, path, options)
         if self.at_kw("vacuum"):
             self.next()
             full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())
@@ -297,6 +319,9 @@ class Parser:
         "citus_stat_statements", "citus_stat_statements_reset",
         "citus_stat_activity", "citus_locks", "citus_lock_waits",
         "citus_shards", "citus_tables", "recover_prepared_transactions",
+        "citus_get_node_clock", "citus_get_transaction_clock",
+        "citus_create_restore_point", "citus_list_restore_points",
+        "alter_distributed_table",
     }
 
     def parse_select_or_utility(self) -> A.Statement:

@@ -1,0 +1,147 @@
+"""Auxiliary subsystems: causal clock, CDC, restore points, MX
+(query-from-any-node), CSV COPY, alter/undistribute."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.config import Settings
+
+
+def test_causal_clock_monotone_and_persistent(tmp_path):
+    from citus_tpu.utils.clock import CausalClock, unpack
+    c = CausalClock(str(tmp_path))
+    vals = [c.now() for _ in range(1000)]
+    assert vals == sorted(vals)
+    assert len(set(vals)) == 1000
+    # adjust merges remote clocks
+    future = vals[-1] + (1 << 30)
+    after = c.adjust(future)
+    assert after > future
+    # restart never goes backwards
+    c._persist_at = 0
+    c.now()
+    c2 = CausalClock(str(tmp_path))
+    assert c2.now() > vals[-1]
+
+
+def test_clock_udfs(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1)
+    a = cl.execute("SELECT citus_get_node_clock()").rows[0][0]
+    b = cl.execute("SELECT citus_get_transaction_clock()").rows[0][0]
+    assert b > a
+
+
+def test_cdc_insert_stream(tmp_path):
+    st = Settings(enable_change_data_capture=True)
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1, settings=st)
+    cl.execute("CREATE TABLE t (a bigint, s text)")
+    cl.execute("INSERT INTO t VALUES (1, 'x'), (2, 'y')")
+    cl.execute("INSERT INTO t VALUES (3, NULL)")
+    events = list(cl.cdc.events("t"))
+    assert len(events) == 2
+    assert events[0]["op"] == "insert"
+    assert events[0]["rows"] == [[1, "x"], [2, "y"]]
+    assert events[1]["rows"] == [[3, None]]
+    assert events[1]["lsn"] > events[0]["lsn"]
+    # resume from lsn
+    resumed = list(cl.cdc.events("t", from_lsn=events[0]["lsn"]))
+    assert len(resumed) == 1
+
+
+def test_cdc_disabled_by_default(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1)
+    cl.execute("CREATE TABLE t (a bigint)")
+    cl.execute("INSERT INTO t VALUES (1)")
+    assert list(cl.cdc.events("t")) == []
+
+
+def test_restore_point_roundtrip(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", columns={"k": np.arange(100, dtype=np.int64),
+                               "v": np.arange(100, dtype=np.int64)})
+    cl.execute("SELECT citus_create_restore_point('before_damage')")
+    assert cl.execute("SELECT citus_list_restore_points()").rows[0][0] == "before_damage"
+    # damage: more inserts + deletes
+    cl.copy_from("t", columns={"k": np.arange(100, 200, dtype=np.int64),
+                               "v": np.zeros(100, dtype=np.int64)})
+    cl.execute("DELETE FROM t WHERE k < 50")
+    assert cl.execute("SELECT count(*) FROM t").rows == [(150,)]
+    from citus_tpu.operations.restore import restore_to_point
+    restore_to_point(cl.catalog, "before_damage")
+    cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    assert cl2.execute("SELECT count(*) FROM t").rows == [(100,)]
+    assert cl2.execute("SELECT sum(v) FROM t").rows == [(4950,)]
+
+
+def test_query_from_any_node(tmp_path):
+    """Two coordinators over the same metadata (the MX model)."""
+    a = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    a.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('t', 'k', 4)")
+    a.copy_from("t", columns={"k": np.arange(500, dtype=np.int64),
+                              "v": np.ones(500, dtype=np.int64)})
+    b = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    assert b.execute("SELECT count(*) FROM t").rows == [(500,)]
+    # writes through B become visible to A (catalog mtime reload)
+    b.copy_from("t", columns={"k": np.arange(500, 600, dtype=np.int64),
+                              "v": np.ones(100, dtype=np.int64)})
+    assert a.execute("SELECT count(*) FROM t").rows == [(600,)]
+    # DDL through B visible to A
+    b.execute("CREATE TABLE u (x bigint)")
+    b.execute("INSERT INTO u VALUES (7)")
+    assert a.execute("SELECT x FROM u").rows == [(7,)]
+
+
+def test_copy_from_csv(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1)
+    cl.execute("CREATE TABLE t (k bigint, name text, price decimal(8,2), d date)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 2)")
+    csv_path = tmp_path / "data.csv"
+    csv_path.write_text(
+        "k,name,price,d\n"
+        "1,apple,1.50,2024-01-01\n"
+        "2,banana,0.25,2024-01-02\n"
+        "3,,NULL_VAL,2024-01-03\n")
+    n = cl.execute(
+        f"COPY t FROM '{csv_path}' WITH (header true, null 'NULL_VAL')").explain["copied"]
+    assert n == 3
+    rows = cl.execute("SELECT k, name, price FROM t ORDER BY k").rows
+    assert rows[0][1] == "apple"
+    assert rows[2][2] is None
+    import decimal
+    assert rows[1][2] == decimal.Decimal("0.25")
+
+
+def test_alter_distributed_table_reshard(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint, s text)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 2)")
+    cl.copy_from("t", rows=[(i, i % 7, ["a", "b"][i % 2]) for i in range(3000)])
+    before = sorted(cl.execute("SELECT v, count(*) FROM t GROUP BY v").rows)
+    cl.execute("SELECT alter_distributed_table('t', 8)")
+    t = cl.catalog.table("t")
+    assert t.shard_count == 8
+    assert sorted(cl.execute("SELECT v, count(*) FROM t GROUP BY v").rows) == before
+    assert cl.execute("SELECT count(*) FROM t WHERE k = 77").rows == [(1,)]
+    # change distribution column too
+    cl.execute("SELECT alter_distributed_table('t', 4, 'v')")
+    assert cl.catalog.table("t").dist_column == "v"
+    assert sorted(cl.execute("SELECT v, count(*) FROM t GROUP BY v").rows) == before
+
+
+def test_undistribute_table(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", rows=[(i, i) for i in range(1000)])
+    cl.execute("SELECT undistribute_table('t')")
+    t = cl.catalog.table("t")
+    assert not t.is_distributed
+    assert t.shard_count == 1
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == [(1000, 499500)]
