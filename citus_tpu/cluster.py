@@ -391,6 +391,30 @@ class Cluster:
         if name == "citus_table_size":
             return Result(columns=["citus_table_size"],
                           rows=[(self._table_size(args[0]),)])
+        if name == "citus_shard_sizes":
+            import os as _os
+            rows = []
+            for t in self.catalog.tables.values():
+                for s_ in t.shards:
+                    for node in s_.placements:
+                        d = self.catalog.shard_dir(t.name, s_.shard_id, node)
+                        size = sum(_os.path.getsize(_os.path.join(d, f))
+                                   for f in _os.listdir(d)) if _os.path.isdir(d) else 0
+                        rows.append((t.name, s_.shard_id, node, size))
+            return Result(columns=["table_name", "shardid", "node", "size"], rows=rows)
+        if name == "citus_check_cluster_node_health":
+            import os as _os
+            rows = []
+            for nid in self.catalog.active_node_ids():
+                ok = True
+                for t in self.catalog.tables.values():
+                    for s_ in t.shards:
+                        if nid in s_.placements:
+                            d = self.catalog.shard_dir(t.name, s_.shard_id, nid)
+                            if _os.path.isdir(d) and not _os.access(d, _os.R_OK):
+                                ok = False
+                rows.append((nid, ok))
+            return Result(columns=["node", "healthy"], rows=rows)
         if name == "master_get_active_worker_nodes":
             return Result(columns=["node_id"],
                           rows=[(nid,) for nid in self.catalog.active_node_ids()])
@@ -551,6 +575,8 @@ class Cluster:
     def _execute_explain(self, stmt: A.Explain) -> Result:
         if not isinstance(stmt.statement, A.Select):
             raise UnsupportedFeatureError("EXPLAIN supports SELECT only")
+        if isinstance(stmt.statement.from_, A.Join):
+            return self._explain_join(stmt)
         bound = bind_select(self.catalog, stmt.statement)
         from citus_tpu.planner.physical import plan_select
         plan = plan_select(self.catalog, bound,
@@ -574,4 +600,32 @@ class Cluster:
         if stmt.analyze:
             r = execute_select(self.catalog, bound, self.settings)
             lines.append(f"  Rows: {r.rowcount}  Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
+            tasks = r.explain.get("tasks") or []
+            if tasks:
+                lines.append(f"  Tasks: {len(tasks)}  Tasks Shown: One of {len(tasks)}")
+                si, nrows, dt = tasks[0]
+                lines.append(f"    -> Task (shard index {si}): {nrows} rows, "
+                             f"{dt*1000:.2f} ms device dispatch")
+        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+
+    def _explain_join(self, stmt: A.Explain) -> Result:
+        from citus_tpu.executor.join_executor import execute_join_select
+        from citus_tpu.planner.join_planner import bind_join_select
+        bj = bind_join_select(self.catalog, stmt.statement)
+        lines = [f"Join ({bj.strategy}) over {len(bj.rels)} relations"]
+        for s_ in bj.steps:
+            keys = ", ".join(f"{l} = {r}" for l, r in
+                             zip(s_.left_keys, s_.right_keys)) or "(cross)"
+            lines.append(f"  {s_.kind.upper()} JOIN {s_.right_alias} ON {keys}")
+        for alias, _t in bj.rels:
+            rp = bj.rel_plans[alias]
+            f = f" filter: {rp.filter}" if rp.filter is not None else ""
+            lines.append(f"  Scan {alias} [{', '.join(rp.columns)}]{f}")
+        if bj.has_aggs:
+            lines.append(f"  GroupBy keys={len(bj.group_keys)} "
+                         f"partials={len(bj.partial_ops)} (host combine)")
+        if stmt.analyze:
+            r = execute_join_select(self.catalog, bj, self.settings)
+            lines.append(f"  Rows: {r.rowcount}  Tasks: {r.explain['tasks']}  "
+                         f"Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
         return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])

@@ -176,6 +176,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings):
             out = run(cols, valids, row_mask)
             acc.append(tuple(np.asarray(o) for o in out))
     else:
+        task_times = []
         jitted = plan.runtime_cache.get("jit_worker")
         if jitted is None:
             jitted = jax.jit(build_worker_fn(plan, jnp))
@@ -200,8 +201,11 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings):
         # instead of row-at-a-time fetches)
         acc_dev = None
         for b in batches:
+            t0 = time.perf_counter()
             out = jitted(b.cols, b.valids, b.row_mask)
             acc_dev = out if acc_dev is None else merge(acc_dev, out)
+            task_times.append((b.shard_index, b.n_rows, time.perf_counter() - t0))
+        plan.runtime_cache["task_times"] = task_times
         return tuple(np.asarray(o) for o in jax.device_get(acc_dev))
     return combine_partials_host(plan, acc)
 
@@ -237,23 +241,46 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[tuple
 
 
 def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[tuple]:
-    """Unbounded GROUP BY cardinality: device does scan/filter/expr eval,
-    host groups by exact key values (HostGroupAccumulator)."""
+    """Unbounded GROUP BY cardinality.
+
+    tpu backend: device-side open-addressed hash aggregation
+    (ops/hash_agg.py) with exact host merge of the per-shard tables and
+    host handling of spilled rows.  cpu backend: full host grouping."""
     from citus_tpu.executor.host_agg import HostGroupAccumulator
 
     backend = settings.executor.task_executor_backend
-    use_jax = backend != "cpu"
-    if use_jax:
+    acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
+
+    if backend != "cpu":
         import jax
         import jax.numpy as jnp
-        worker = plan.runtime_cache.get("jit_worker")
-        if worker is None:
-            worker = jax.jit(build_worker_fn(plan, jnp))
-            plan.runtime_cache["jit_worker"] = worker
-    else:
-        worker = build_worker_fn(plan, np)
+        from citus_tpu.ops.hash_agg import build_hash_agg_worker, merge_hash_tables_into
+        from citus_tpu.planner.bound import compile_expr as _ce
 
-    acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
+        S = settings.planner.hash_agg_slots
+        jitted = plan.runtime_cache.get("jit_hash_worker")
+        if jitted is None:
+            jitted = jax.jit(build_hash_agg_worker(plan, jnp, S))
+            plan.runtime_cache["jit_hash_worker"] = jitted
+        key_fns_np = [_ce(k, np) for k in plan.bound.group_keys]
+        arg_fns_np = [_ce(a, np) for a in plan.agg_args]
+        batches = _load_all_batches(cat, plan, settings)
+        for b in batches:
+            key_tables, partials, rows, spill = jitted(b.cols, b.valids, b.row_mask)
+            merge_hash_tables_into(acc, plan, key_tables, partials, rows)
+            spill = np.asarray(spill)
+            if spill.any():
+                env = {n: (np.asarray(c), np.asarray(v))
+                       for n, c, v in zip(plan.scan_columns, b.cols, b.valids)}
+                keys = [f(env) for f in key_fns_np]
+                args = [f(env) for f in arg_fns_np]
+                acc.add_batch(spill, keys, args)
+        key_arrays, partials = acc.finalize([k.type for k in plan.bound.group_keys])
+        if partials is None:
+            return []
+        return finalize_groups(plan, cat, key_arrays, partials)
+
+    worker = build_worker_fn(plan, np)
     for si in plan.shard_indexes:
         for values, masks, n in load_shard_batches(
                 cat, plan, si, min_batch_rows=1):
@@ -348,5 +375,6 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
             "router": plan.is_router,
             "intervals": [c.column for c in plan.intervals],
             "elapsed_s": elapsed,
+            "tasks": plan.runtime_cache.get("task_times", []),
         },
     )

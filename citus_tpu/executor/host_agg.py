@@ -113,6 +113,44 @@ class HostGroupAccumulator:
                 else:
                     self._accs[gi][pi] = max(self._accs[gi][pi], local[pi][li])
 
+    def merge_partials(self, mask: np.ndarray, keys: list,
+                       partial_values: list, rows: np.ndarray) -> None:
+        """Merge pre-aggregated per-group partial states (e.g. a device
+        hash table) into the accumulator.  ``mask`` marks occupied slots;
+        ``partial_values[i]`` aligns with ``self.partial_ops[i]``."""
+        sel = np.nonzero(np.asarray(mask))[0]
+        if sel.size == 0:
+            return
+        n_keys = self.n_keys
+        kv_np = [(np.asarray(v)[sel],
+                  np.asarray(m)[sel] if not isinstance(m, bool)
+                  else np.full(sel.size, m)) for v, m in keys]
+        if n_keys:
+            enc = np.empty((sel.size, 2 * n_keys), np.int64)
+            for ki, (kv, kvalid) in enumerate(kv_np):
+                bits = kv.astype(np.float64).view(np.int64) \
+                    if np.issubdtype(kv.dtype, np.floating) else kv.astype(np.int64)
+                enc[:, 2 * ki] = np.where(kvalid, bits, 0)
+                enc[:, 2 * ki + 1] = kvalid.astype(np.int64)
+        else:
+            enc = np.zeros((sel.size, 0), np.int64)
+        pv = [np.asarray(p)[sel] for p in partial_values]
+        for r in range(sel.size):
+            kb = enc[r].tobytes()
+            gi = self._groups.get(kb)
+            if gi is None:
+                kvs = [(kv[r], bool(kvalid[r])) for kv, kvalid in kv_np]
+                gi = self._new_group(kvs)
+                self._groups[kb] = gi
+            for pi, op in enumerate(self.partial_ops):
+                val = pv[pi][r]
+                if op.kind in ("sum", "count"):
+                    self._accs[gi][pi] += val
+                elif op.kind == "min":
+                    self._accs[gi][pi] = min(self._accs[gi][pi], val)
+                else:
+                    self._accs[gi][pi] = max(self._accs[gi][pi], val)
+
     def finalize(self, key_types: list, scalar: bool = False):
         """-> (key_arrays [(values, valid)], partials tuple).  ``scalar``
         forces one group even with zero input rows (global aggregates)."""

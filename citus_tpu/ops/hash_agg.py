@@ -1,0 +1,131 @@
+"""Device-side hash aggregation for unbounded GROUP BY cardinality.
+
+When the key domain can't be proven small (no direct-gid mode), the
+worker still aggregates on device into a fixed-size open-addressed hash
+table: rows claim a slot by 64-bit key fingerprint; a claim only counts
+when the slot's stored *key values* match exactly (the fingerprint is an
+optimization, never a correctness assumption).  Rows that lose their
+slot (collision or overflow) are reported in a spill mask and aggregated
+exactly on the host — the static-shape analog of a hash-agg spilling to
+disk.  Cross-shard/table merging happens on the host by exact key value
+(HostGroupAccumulator.merge_partials), mirroring the reference's
+coordinator merge when worker-level GROUP BY can't be combined by a
+single collective.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from citus_tpu.planner.bound import _as_mask, compile_expr, predicate_mask
+from citus_tpu.planner.physical import PhysicalPlan
+from citus_tpu.ops.scan_agg import _sentinel
+
+_FNV = np.uint64(0xCBF29CE484222325)
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+_GOLD = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix(xp, h, v):
+    h = (h ^ v) + _GOLD
+    h = h ^ (h >> np.uint64(30))
+    h = h * _C1
+    h = h ^ (h >> np.uint64(27))
+    h = h * _C2
+    return h ^ (h >> np.uint64(31))
+
+
+def build_hash_agg_worker(plan: PhysicalPlan, xp, slots: int) -> Callable:
+    """Worker: (cols, valids, row_mask) ->
+    (key_tables [(vals[S], valid[S])...], partial tables tuple [S],
+     rows[S], spill_mask[N])."""
+    filter_fn = compile_expr(plan.bound.filter, xp) if plan.bound.filter is not None else None
+    key_fns = [compile_expr(k, xp) for k in plan.bound.group_keys]
+    arg_fns = [compile_expr(a, xp) for a in plan.agg_args]
+    names = plan.scan_columns
+    partial_ops = plan.partial_ops
+    S = slots
+
+    def worker(cols, valids, row_mask):
+        env = {n: (c, v) for n, c, v in zip(names, cols, valids)}
+        mask = row_mask
+        if filter_fn is not None:
+            mask = mask & predicate_mask(xp, filter_fn, env, row_mask)
+        # evaluate keys + fingerprint
+        keys = []
+        h = xp.full(row_mask.shape, _FNV, np.uint64)
+        for kf in key_fns:
+            kv, kvalid = kf(env)
+            kvm = _as_mask(xp, kvalid, kv)
+            kv = xp.asarray(kv)
+            bits = (kv.view(np.uint64) if kv.dtype in (np.dtype(np.float64),)
+                    else kv.astype(np.int64).view(np.uint64))
+            bits = xp.where(kvm, bits, np.uint64(0x9E3779B97F4A7C15))
+            h = _mix(xp, h, bits + kvm.astype(np.uint64))
+            keys.append((kv, kvm))
+        slot = (h % np.uint64(S)).astype(np.int32)
+        slot = xp.where(mask, slot, 0)
+        # claim by min fingerprint per slot
+        sent = np.uint64(0xFFFFFFFFFFFFFFFF)
+        claimed = xp.full((S,), sent, np.uint64).at[slot].min(
+            xp.where(mask, h, sent))
+        claim_ok = mask & (claimed[slot] == h)
+        # store claimant key values; verify with exact value equality
+        key_tables = []
+        placed = claim_ok
+        for kv, kvm in keys:
+            dt = kv.dtype
+            ksent = dt.type(_sentinel("max", np.dtype(dt))) if not np.issubdtype(dt, np.floating) else dt.type(-np.inf)
+            kvt = xp.full((S,), ksent, dt).at[slot].max(
+                xp.where(claim_ok, kv, ksent))
+            kvalid_t = xp.zeros((S,), np.int8).at[slot].max(
+                xp.where(claim_ok, kvm.astype(np.int8) + 1, 0))
+            key_tables.append((kvt, kvalid_t))
+        for (kv, kvm), (kvt, kvalid_t) in zip(keys, key_tables):
+            placed = placed & (kvt[slot] == kv) & (kvalid_t[slot] == kvm.astype(np.int8) + 1)
+        spill = mask & ~placed
+        # aggregate placed rows into the tables
+        outs = []
+        for op in partial_ops:
+            dt = np.dtype(op.dtype)
+            if op.arg_index < 0:
+                upd = xp.where(placed, 1, 0).astype(np.int64)
+                outs.append(xp.zeros((S,), np.int64).at[slot].add(upd))
+                continue
+            v, valid = arg_fns[op.arg_index](env)
+            v = xp.asarray(v)
+            if v.ndim == 0:
+                v = xp.broadcast_to(v, row_mask.shape)
+            ok = placed & _as_mask(xp, valid, placed)
+            if op.kind == "count":
+                outs.append(xp.zeros((S,), np.int64).at[slot].add(
+                    xp.where(ok, 1, 0).astype(np.int64)))
+            elif op.kind == "sum":
+                outs.append(xp.zeros((S,), dt).at[slot].add(
+                    xp.where(ok, v, 0).astype(dt)))
+            else:
+                s_ = dt.type(_sentinel(op.kind, dt))
+                upd = xp.where(ok, v, s_).astype(dt)
+                acc = xp.full((S,), s_, dt)
+                outs.append(acc.at[slot].min(upd) if op.kind == "min"
+                            else acc.at[slot].max(upd))
+        rows = xp.zeros((S,), np.int64).at[slot].add(
+            xp.where(placed, 1, 0).astype(np.int64))
+        return tuple(key_tables), tuple(outs), rows, spill
+    return worker
+
+
+def merge_hash_tables_into(acc, plan: PhysicalPlan, key_tables, partials, rows):
+    """Feed one shard's device hash table into a HostGroupAccumulator."""
+    rows = np.asarray(rows)
+    occupied = rows > 0
+    keys = []
+    for (kvt, kvalid_t), key in zip(key_tables, plan.bound.group_keys):
+        kvt = np.asarray(kvt)
+        kvalid = np.asarray(kvalid_t) == 2  # stored flag: valid keys are +1
+        keys.append((kvt, kvalid))
+    partial_vals = [np.asarray(p) for p in partials]
+    acc.merge_partials(occupied, keys, partial_vals, rows)
