@@ -56,10 +56,11 @@ class Cluster:
         self._maintenance = None
         # observability (citus_stat_* / citus_locks analogs)
         from citus_tpu.executor.executor import GLOBAL_COUNTERS
-        from citus_tpu.stats import ActivityTracker, QueryStats
+        from citus_tpu.stats import ActivityTracker, QueryStats, TenantStats
         from citus_tpu.transaction import LockManager
         self.counters = GLOBAL_COUNTERS
         self.query_stats = QueryStats()
+        self.tenant_stats = TenantStats()
         self.activity = ActivityTracker()
         self.locks = LockManager()
 
@@ -257,8 +258,12 @@ class Cluster:
         finally:
             self.activity.exit(gpid)
         executor = result.explain.get("strategy", "utility") if result.explain else "utility"
-        self.query_stats.record(sql, _time.perf_counter() - t0,
-                                result.rowcount, str(executor))
+        elapsed = _time.perf_counter() - t0
+        rkey = result.explain.get("router_key") if result.explain else None
+        self.query_stats.record(sql, elapsed, result.rowcount, str(executor),
+                                partition_key="" if rkey is None else str(rkey))
+        if rkey is not None:
+            self.tenant_stats.record(str(rkey), elapsed)
         return result
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
@@ -493,6 +498,18 @@ class Cluster:
         if name == "citus_stat_statements_reset":
             self.query_stats.reset()
             return Result(columns=[name], rows=[(None,)])
+        if name == "citus_stat_tenants":
+            return Result(columns=["tenant", "query_count", "total_time_ms"],
+                          rows=self.tenant_stats.rows_view())
+        if name == "get_rebalance_progress":
+            rows = []
+            if self._background_jobs is not None:
+                with self._background_jobs._lock:
+                    jobs = [j["job_id"] for j in self._background_jobs._state["jobs"]]
+                for jid in jobs:
+                    rows.extend(self._background_jobs.job_progress(jid))
+            return Result(columns=["task_id", "op", "args", "status", "attempts"],
+                          rows=rows)
         if name == "citus_stat_activity":
             return Result(columns=["global_pid", "state", "elapsed_s", "query"],
                           rows=self.activity.rows_view())

@@ -376,5 +376,6 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
             "intervals": [c.column for c in plan.intervals],
             "elapsed_s": elapsed,
             "tasks": plan.runtime_cache.get("task_times", []),
+            "router_key": plan.router_key,
         },
     )

@@ -322,6 +322,7 @@ class Parser:
         "citus_get_node_clock", "citus_get_transaction_clock",
         "citus_create_restore_point", "citus_list_restore_points",
         "alter_distributed_table", "citus_check_cluster_node_health",
+        "citus_stat_tenants", "get_rebalance_progress",
     }
 
     def parse_select_or_utility(self) -> A.Statement:

@@ -85,6 +85,8 @@ class PhysicalPlan:
     # plan cache hit skips XLA recompilation (the analog of the reference's
     # prepared-statement local plan cache, local_plan_cache.c)
     runtime_cache: dict = field(default_factory=dict)
+    # distribution-key literal when the router path was chosen (tenant id)
+    router_key: Optional[object] = None
 
     @property
     def is_router(self) -> bool:
@@ -150,12 +152,14 @@ def extract_intervals(filter_: Optional[BExpr]) -> list[Interval]:
     return out
 
 
-def prune_shards(table: TableMeta, filter_: Optional[BExpr]) -> list[int]:
+def prune_shards(table: TableMeta, filter_: Optional[BExpr],
+                 return_key: bool = False):
     """Route to a single shard on distcol = const (reference fast path:
     fast_path_router_planner.c); otherwise all shards."""
     all_idx = list(range(table.shard_count))
+    key = None
     if not table.is_distributed or table.dist_column is None:
-        return all_idx
+        return (all_idx, key) if return_key else all_idx
     for c in _conjuncts(filter_):
         if not (isinstance(c, BBinOp) and c.op == "="):
             continue
@@ -167,8 +171,8 @@ def prune_shards(table: TableMeta, filter_: Optional[BExpr]) -> list[int]:
                 and not isinstance(right.value, float)):
             h = hash_int64_scalar(int(right.value))
             idx = int(shard_index_for_hash(np.array([h], np.int32), table.shard_count)[0])
-            return [idx]
-    return all_idx
+            return ([idx], right.value) if return_key else [idx]
+    return (all_idx, key) if return_key else all_idx
 
 
 # ------------------------------------------------------ group strategy
@@ -291,7 +295,7 @@ def lower_aggregates(aggs: list[AggSpec]) -> tuple[list[BExpr], list[PartialOp],
 
 def plan_select(cat: Catalog, bound: BoundSelect, *, direct_limit: int = 65536) -> PhysicalPlan:
     intervals = extract_intervals(bound.filter)
-    shard_indexes = prune_shards(bound.table, bound.filter)
+    shard_indexes, router_key = prune_shards(bound.table, bound.filter, return_key=True)
     group_mode = choose_group_mode(cat, bound, direct_limit)
     agg_args, partial_ops, agg_extract = lower_aggregates(bound.aggs)
     return PhysicalPlan(
@@ -303,4 +307,5 @@ def plan_select(cat: Catalog, bound: BoundSelect, *, direct_limit: int = 65536) 
         agg_args=agg_args,
         partial_ops=partial_ops,
         agg_extract=agg_extract,
+        router_key=router_key,
     )

@@ -102,6 +102,14 @@ class BackgroundJobRunner:
         self._wake.set()
         return tid
 
+    def job_progress(self, job_id: int) -> list[tuple]:
+        """Per-task progress rows (reference: get_rebalance_progress over
+        the DSM progress monitor, progress/multi_progress.c)."""
+        with self._lock:
+            return [(t["task_id"], t["op"], str(t["args"]), t["status"],
+                     t["attempts"]) for t in self._state["tasks"]
+                    if t["job_id"] == job_id]
+
     def job_status(self, job_id: int) -> str:
         with self._lock:
             tasks = [t for t in self._state["tasks"] if t["job_id"] == job_id]

@@ -111,6 +111,39 @@ class QueryStats:
             self._stats.clear()
 
 
+class TenantStats:
+    """Per-tenant (distribution key value) attribution for router
+    queries (reference: citus_stat_tenants, stats/stat_tenants.c) with a
+    coarse sliding window."""
+
+    WINDOW_S = 60.0
+
+    def __init__(self, max_tenants: int = 1000):
+        self._mu = threading.Lock()
+        self._t: dict[str, list] = {}  # key -> [count, total_time, window_start]
+        self.max_tenants = max_tenants
+
+    def record(self, tenant: str, elapsed_s: float) -> None:
+        now = time.time()
+        with self._mu:
+            st = self._t.get(tenant)
+            if st is None:
+                if len(self._t) >= self.max_tenants:
+                    victim = min(self._t, key=lambda k: self._t[k][0])
+                    del self._t[victim]
+                st = self._t[tenant] = [0, 0.0, now]
+            if now - st[2] > self.WINDOW_S:
+                st[0], st[1], st[2] = 0, 0.0, now
+            st[0] += 1
+            st[1] += elapsed_s
+
+    def rows_view(self) -> list[tuple]:
+        with self._mu:
+            return [(k, c, round(t * 1000, 3))
+                    for k, (c, t, _) in sorted(self._t.items(),
+                                               key=lambda kv: -kv[1][0])]
+
+
 _GPID = itertools.count(1)
 
 

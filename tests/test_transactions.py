@@ -199,3 +199,26 @@ def test_stat_views(tmp_path):
     assert len(shards_view) == 4
     tables_view = cl.execute("SELECT citus_tables()").rows
     assert any(r[0] == "t" and r[6] == 10 for r in tables_view)
+
+
+def test_tenant_stats_and_progress_views(tmp_path):
+    cl = make_cluster(tmp_path)
+    cl.copy_from("t", columns={"k": np.arange(100, dtype=np.int64),
+                               "v": np.zeros(100, dtype=np.int64)})
+    cl.execute("SELECT count(*) FROM t WHERE k = 5")
+    cl.execute("SELECT count(*) FROM t WHERE k = 5")
+    cl.execute("SELECT count(*) FROM t WHERE k = 9")
+    tenants = dict((r[0], r[1]) for r in
+                   cl.execute("SELECT citus_stat_tenants()").rows)
+    assert tenants.get("5") == 2
+    assert tenants.get("9") == 1
+    # progress view is empty without jobs, then reflects tasks
+    assert cl.execute("SELECT get_rebalance_progress()").rows == []
+    r = cl.background_jobs
+    r.register("noop", lambda: None)
+    jid = r.create_job("x")
+    r.add_task(jid, "noop", {})
+    r.wait_for_job(jid)
+    rows = cl.execute("SELECT get_rebalance_progress()").rows
+    assert rows and rows[0][3] == "done"
+    cl.close()
