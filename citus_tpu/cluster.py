@@ -365,10 +365,15 @@ class Cluster:
     def _execute_insert(self, stmt: A.Insert) -> Result:
         t = self.catalog.table(stmt.table)
         if stmt.select is not None:
-            inner = self._execute_stmt(stmt.select)
             names = stmt.columns or t.schema.names
-            rows = inner.rows
-            n = self.copy_from(stmt.table, rows=rows, column_names=list(names))
+            n = self._insert_select_arrays(t, stmt.select, list(names))
+            if n is None:
+                # general path: materialize rows through the coordinator
+                # (reference: the pull-to-coordinator INSERT..SELECT
+                # strategy, insert_select_executor.c)
+                inner = self._execute_stmt(stmt.select)
+                n = self.copy_from(stmt.table, rows=inner.rows,
+                                   column_names=list(names))
             return Result(columns=[], rows=[], explain={"inserted": n})
         rows = []
         for row_exprs in stmt.rows:
@@ -383,6 +388,88 @@ class Cluster:
             rows.append(row)
         n = self.copy_from(stmt.table, rows=rows, column_names=stmt.columns)
         return Result(columns=[], rows=[], explain={"inserted": n})
+
+    def _insert_select_arrays(self, target, sel: A.Select,
+                              names: list[str]) -> Optional[int]:
+        """Array-streaming INSERT..SELECT (the repartition strategy,
+        reference: insert_select_planner.c IsRedistributablePlan): when
+        the SELECT is a plain single-table projection whose output types
+        match the target physically, move numpy columns straight from
+        the scan into the hash-routing ingest — no Python row
+        materialization.  Returns None when ineligible."""
+        if not isinstance(sel.from_, A.TableRef):
+            return None
+        if sel.group_by or sel.having or sel.order_by or sel.limit or sel.distinct:
+            return None
+        try:
+            bound = bind_select(self.catalog, sel)
+        except Exception:
+            return None
+        if bound.has_aggs or len(bound.final_exprs) != len(names):
+            return None
+        from citus_tpu.planner.bound import (
+            BColumn, BDictRemap, compile_expr, predicate_mask,
+        )
+        from citus_tpu.planner.physical import plan_select
+        final_exprs = list(bound.final_exprs)
+        for i, (e, cname) in enumerate(zip(final_exprs, names)):
+            tgt = target.schema.column(cname).type
+            if e.type != tgt:
+                return None
+            if tgt.is_text:
+                if not isinstance(e, BColumn):
+                    return None
+                if bound.table.name != target.name or e.name != cname:
+                    # re-encode source dictionary ids into the target's
+                    # dictionary space (grows the target dictionary)
+                    src_words = self.catalog.dictionary(bound.table.name, e.name)
+                    mapping = tuple(int(x) for x in self.catalog.encode_strings(
+                        target.name, cname, src_words))
+                    final_exprs[i] = BDictRemap(e, mapping)
+        plan = plan_select(self.catalog, bound,
+                           direct_limit=self.settings.planner.direct_gid_limit)
+        from citus_tpu.executor.batches import load_shard_batches
+        fns = [compile_expr(e, np) for e in final_exprs]
+        ffn = compile_expr(bound.filter, np) if bound.filter is not None else None
+        ing = TableIngestor(self.catalog, target, txlog=self.txlog)
+        total = 0
+        for si in plan.shard_indexes:
+            for values, masks, n in load_shard_batches(
+                    self.catalog, plan, si, min_batch_rows=1):
+                env = {c: (values[c].astype(
+                            bound.table.schema.column(c).type.device_dtype, copy=False),
+                           masks[c]) for c in plan.scan_columns}
+                if ffn is not None:
+                    m = np.asarray(predicate_mask(np, ffn, env, np.ones(n, bool)))
+                    if m.shape == ():
+                        m = np.full(n, bool(m))
+                else:
+                    m = np.ones(n, bool)
+                idx = np.nonzero(m)[0]
+                if idx.size == 0:
+                    continue
+                out_v, out_m = {}, {}
+                for fn, cname in zip(fns, names):
+                    v, valid = fn(env)
+                    v = np.asarray(v)
+                    if v.ndim == 0:
+                        v = np.broadcast_to(v, (n,))
+                    if valid is True:
+                        valid = np.ones(n, bool)
+                    elif valid is False:
+                        valid = np.zeros(n, bool)
+                    st = target.schema.column(cname).type.storage_dtype
+                    out_v[cname] = v[idx].astype(st)
+                    out_m[cname] = np.asarray(valid)[idx]
+                for cname in target.schema.names:
+                    if cname not in out_v:
+                        out_v[cname] = np.zeros(idx.size, target.schema.column(cname).type.storage_dtype)
+                        out_m[cname] = np.zeros(idx.size, bool)
+                ing.append(out_v, out_m)
+                total += idx.size
+        ing.finish()
+        self.counters.bump("rows_ingested", total)
+        return total
 
     def _execute_utility(self, stmt: A.UtilityCall) -> Result:
         name, args = stmt.name, stmt.args

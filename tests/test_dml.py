@@ -129,3 +129,34 @@ def test_aggregates_respect_deletes_on_all_paths(db):
     ours = cl.execute("SELECT count(*) FROM t a JOIN t b ON a.k = b.k").rows
     theirs = sq.execute("SELECT count(*) FROM t a JOIN t b ON a.k = b.k").fetchall()
     assert ours == list(theirs)
+
+
+def test_insert_select_array_path(db, tmp_path):
+    cl, sq = db
+    cl.execute("CREATE TABLE t2 (k bigint NOT NULL, v bigint, s text)")
+    cl.execute("SELECT create_distributed_table('t2', 'k', 4)")
+    sq.execute("CREATE TABLE t2 (k INTEGER, v INTEGER, s TEXT)")
+    r = cl.execute("INSERT INTO t2 SELECT k, v, s FROM t WHERE v > 4")
+    sq.execute("INSERT INTO t2 SELECT k, v, s FROM t WHERE v > 4")
+    assert r.explain["inserted"] == 1000
+    check((cl, sq), "SELECT count(*), sum(v) FROM t2")
+    check((cl, sq), "SELECT s, count(*) FROM t2 GROUP BY s")
+
+
+def test_insert_select_with_expressions(db):
+    cl, sq = db
+    cl.execute("CREATE TABLE t3 (k bigint NOT NULL, v2 bigint)")
+    cl.execute("SELECT create_distributed_table('t3', 'k', 4)")
+    sq.execute("CREATE TABLE t3 (k INTEGER, v2 INTEGER)")
+    cl.execute("INSERT INTO t3 SELECT k, v * 2 + 1 FROM t WHERE k < 500")
+    sq.execute("INSERT INTO t3 SELECT k, v * 2 + 1 FROM t WHERE k < 500")
+    check((cl, sq), "SELECT count(*), sum(v2) FROM t3")
+
+
+def test_insert_select_aggregate_falls_back(db):
+    cl, sq = db
+    cl.execute("CREATE TABLE agg (v bigint, c bigint)")
+    sq.execute("CREATE TABLE agg (v INTEGER, c INTEGER)")
+    cl.execute("INSERT INTO agg SELECT v, count(*) FROM t GROUP BY v")
+    sq.execute("INSERT INTO agg SELECT v, count(*) FROM t GROUP BY v")
+    check((cl, sq), "SELECT count(*), sum(c) FROM agg")
