@@ -205,6 +205,62 @@ class Catalog:
             self.ddl_epoch += 1
             return t
 
+    def add_column(self, name: str, column) -> None:
+        from citus_tpu.schema import Schema
+        with self._lock:
+            t = self.table(name)
+            if t.schema.has(column.name):
+                raise CatalogError(f"column {column.name!r} already exists")
+            if column.not_null:
+                raise CatalogError(
+                    "cannot add a NOT NULL column (existing rows would violate it)")
+            t.schema = Schema(t.schema.columns + [column])
+            t.version += 1
+            self.ddl_epoch += 1
+
+    def drop_column(self, name: str, column: str) -> None:
+        from citus_tpu.schema import Schema
+        with self._lock:
+            t = self.table(name)
+            c = t.schema.column(column)
+            if t.dist_column == column:
+                raise CatalogError("cannot drop the distribution column")
+            if len(t.schema) == 1:
+                raise CatalogError("cannot drop the only column")
+            t.schema = Schema([x for x in t.schema.columns if x.name != column])
+            t.version += 1
+            self.ddl_epoch += 1
+            key = (name, column)
+            self._dicts.pop(key, None)
+            self._dict_index.pop(key, None)
+            dp = self._dict_path(name, column)
+            if os.path.exists(dp):
+                os.remove(dp)
+
+    def rename_column(self, name: str, old: str, new: str) -> None:
+        from citus_tpu.schema import Column, Schema
+        with self._lock:
+            t = self.table(name)
+            c = t.schema.column(old)
+            if t.schema.has(new):
+                raise CatalogError(f"column {new!r} already exists")
+            cols = [Column(new, x.type, x.not_null, x.storage_name)
+                    if x.name == old else x for x in t.schema.columns]
+            t.schema = Schema(cols)
+            if t.dist_column == old:
+                t.dist_column = new
+            t.version += 1
+            self.ddl_epoch += 1
+            # dictionaries are keyed by logical name: carry them over
+            self._ensure_dict(name, old)
+            words = self._dicts.pop((name, old))
+            index = self._dict_index.pop((name, old))
+            self._dicts[(name, new)] = words
+            self._dict_index[(name, new)] = index
+            oldp = self._dict_path(name, old)
+            if os.path.exists(oldp):
+                os.replace(oldp, self._dict_path(name, new))
+
     def drop_table(self, name: str) -> None:
         with self._lock:
             import shutil

@@ -346,6 +346,22 @@ class Cluster:
             n = execute_update(self.catalog, self.txlog, t, assignments, where)
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain={"updated": n})
+        if isinstance(stmt, A.AlterTable):
+            if stmt.action == "add_column":
+                col = Column(stmt.column.name,
+                             type_from_sql(stmt.column.type_name,
+                                           stmt.column.type_args or None),
+                             stmt.column.not_null)
+                self.catalog.add_column(stmt.table, col)
+            elif stmt.action == "drop_column":
+                self.catalog.drop_column(stmt.table, stmt.old_name)
+            elif stmt.action == "rename_column":
+                self.catalog.rename_column(stmt.table, stmt.old_name, stmt.new_name)
+            else:
+                raise UnsupportedFeatureError("ALTER TABLE ... RENAME TO is not supported yet")
+            self.catalog.commit()
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[])
         if isinstance(stmt, A.Truncate):
             from citus_tpu.executor.dml import execute_truncate
             execute_truncate(self.catalog, self.catalog.table(stmt.table))

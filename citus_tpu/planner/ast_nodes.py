@@ -190,6 +190,15 @@ class Select(Statement):
 
 
 @dataclass
+class AlterTable(Statement):
+    table: str
+    action: str               # add_column | drop_column | rename_column | rename_table
+    column: Optional[ColumnDef] = None
+    old_name: Optional[str] = None
+    new_name: Optional[str] = None
+
+
+@dataclass
 class CopyFrom(Statement):
     table: str
     path: str

@@ -37,7 +37,7 @@ KEYWORDS = {
     "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "case", "when", "then", "else", "end", "cast", "explain", "analyze",
     "using", "with", "like", "delete", "update", "set", "truncate",
-    "vacuum", "copy",
+    "vacuum", "copy", "alter", "add", "column", "rename", "to",
 }
 
 
@@ -169,6 +169,8 @@ class Parser:
             self.next()
             self.accept_kw("table")
             return A.Truncate(self.expect_ident())
+        if self.at_kw("alter"):
+            return self.parse_alter_table()
         if self.at_kw("copy"):
             self.next()
             name = self.expect_ident()
@@ -196,6 +198,38 @@ class Parser:
             full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())
             return A.Vacuum(self.expect_ident(), full)
         self.error("expected a statement")
+
+    def parse_alter_table(self) -> A.AlterTable:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        name = self.expect_ident()
+        if self.accept_kw("add"):
+            self.accept_kw("column")
+            cname = self.expect_ident()
+            tname, targs = self.parse_type_name()
+            not_null = False
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                not_null = True
+            return A.AlterTable(name, "add_column",
+                                column=A.ColumnDef(cname, tname, targs, not_null))
+        if self.accept_kw("drop"):
+            self.accept_kw("column")
+            return A.AlterTable(name, "drop_column", old_name=self.expect_ident())
+        if self.accept_kw("rename"):
+            if self.accept_kw("column"):
+                old = self.expect_ident()
+                self.expect_kw("to")
+                return A.AlterTable(name, "rename_column", old_name=old,
+                                    new_name=self.expect_ident())
+            if self.accept_kw("to"):
+                return A.AlterTable(name, "rename_table",
+                                    new_name=self.expect_ident())
+            old = self.expect_ident()
+            self.expect_kw("to")
+            return A.AlterTable(name, "rename_column", old_name=old,
+                                new_name=self.expect_ident())
+        self.error("expected ADD, DROP, or RENAME")
 
     def parse_explain(self) -> A.Explain:
         self.expect_kw("explain")

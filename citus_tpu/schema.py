@@ -18,6 +18,12 @@ class Column:
     name: str
     type: ColumnType
     not_null: bool = False
+    # immutable on-disk stream key; stays stable across RENAME COLUMN
+    storage_name: str = ""
+
+    def __post_init__(self):
+        if not self.storage_name:
+            object.__setattr__(self, "storage_name", self.name)
 
 
 @dataclass
@@ -59,14 +65,16 @@ class Schema:
     def to_json(self) -> list:
         return [
             {"name": c.name, "kind": c.type.kind, "precision": c.type.precision,
-             "scale": c.type.scale, "not_null": c.not_null}
+             "scale": c.type.scale, "not_null": c.not_null,
+             "storage_name": c.storage_name}
             for c in self.columns
         ]
 
     @staticmethod
     def from_json(data: list) -> "Schema":
         return Schema([
-            Column(d["name"], ColumnType(d["kind"], d["precision"], d["scale"]), d["not_null"])
+            Column(d["name"], ColumnType(d["kind"], d["precision"], d["scale"]),
+                   d["not_null"], d.get("storage_name", d["name"]))
             for d in data
         ])
 

@@ -96,6 +96,12 @@ class ShardReader:
             path = os.path.join(self.directory, stripe["file"])
             footer = read_stripe_footer(path)
             selected = self._selected_chunks(footer, constraints)
+            try:
+                from citus_tpu.executor.executor import GLOBAL_COUNTERS
+                GLOBAL_COUNTERS.bump("chunks_total", footer.chunk_count)
+                GLOBAL_COUNTERS.bump("chunks_selected", int(selected.sum()))
+            except ImportError:
+                pass
             if not selected.any():
                 continue
             offsets = np.concatenate([[0], np.cumsum(footer.chunk_row_counts)[:-1]])
@@ -114,8 +120,15 @@ class ShardReader:
                 for ci in sel_idx:
                     vals, valid = {}, {}
                     for col in columns:
-                        stats = footer.columns[col][ci]
-                        v, m = read_chunk(fh, footer, stats, self.schema.column(col).type.storage_dtype)
+                        c = self.schema.column(col)
+                        stream = footer.columns.get(c.storage_name)
+                        if stream is None:
+                            # column added after this stripe: all NULL
+                            n_ = footer.chunk_row_counts[ci]
+                            vals[col] = np.zeros(n_, c.type.storage_dtype)
+                            valid[col] = np.zeros(n_, bool)
+                            continue
+                        v, m = read_chunk(fh, footer, stream[ci], c.type.storage_dtype)
                         vals[col], valid[col] = v, m
                     b = ChunkBatch(
                         values=vals, validity=valid,
@@ -151,9 +164,14 @@ class ShardReader:
         cid = CODEC_IDS[footer.codec]
         # one native call per stripe: every (column, chunk) value stream
         streams = []  # (col, k, stats)
+        missing = []  # columns added after this stripe was written
         for col in columns:
+            sname = self.schema.column(col).storage_name
+            if sname not in footer.columns:
+                missing.append(col)
+                continue
             for k, ci in enumerate(sel_idx):
-                streams.append((col, k, footer.columns[col][ci]))
+                streams.append((col, k, footer.columns[sname][ci]))
         offs = np.array([s.value_offset for _, _, s in streams], np.int64)
         clens = np.array([s.value_length for _, _, s in streams], np.int64)
         rlens = np.array([s.value_raw_length for _, _, s in streams], np.int64)
@@ -177,10 +195,17 @@ class ShardReader:
             if arr.shape[0] != s.row_count:
                 return None
             per_col_vals[col][k] = arr
+        for col in missing:
+            dt = self.schema.column(col).type.storage_dtype
+            for k, ci in enumerate(sel_idx):
+                n_ = footer.chunk_row_counts[ci]
+                per_col_vals[col][k] = np.zeros(n_, dt)
+                per_col_valid[col][k] = np.zeros(n_, bool)
         # validity streams (usually few; read individually)
-        null_streams = [(col, k, footer.columns[col][ci])
-                        for col in columns for k, ci in enumerate(sel_idx)
-                        if footer.columns[col][ci].has_nulls]
+        null_streams = [(col, k, footer.columns[self.schema.column(col).storage_name][ci])
+                        for col in columns if col not in missing
+                        for k, ci in enumerate(sel_idx)
+                        if footer.columns[self.schema.column(col).storage_name][ci].has_nulls]
         if null_streams:
             from citus_tpu.storage import compression as comp
             with open(path, "rb") as fh:
@@ -216,9 +241,13 @@ class ShardReader:
     def _selected_chunks(self, footer, constraints: list[Interval]) -> np.ndarray:
         mask = np.ones(footer.chunk_count, dtype=bool)
         for c in constraints:
-            chunks = footer.columns.get(c.column)
+            sname = self.schema.column(c.column).storage_name                 if self.schema.has(c.column) else c.column
+            chunks = footer.columns.get(sname)
             if chunks is None:
-                raise StorageError(f"constraint on unknown column {c.column!r}")
+                # column added after this stripe: every row is NULL there,
+                # so no range constraint can match
+                mask[:] = False
+                return mask
             for ci, stats in enumerate(chunks):
                 if not mask[ci]:
                     continue

@@ -192,7 +192,7 @@ class ShardWriter:
                     chunks.append((vals, valid))
                 else:
                     chunks.append((vals, None))
-            column_chunks[col] = chunks
+            column_chunks[self.schema.column(col).storage_name] = chunks
         meta = _load_meta(self.directory)
         if self.staged_xid is not None:
             staged = _load_staged(self.directory, self.staged_xid)

@@ -160,3 +160,30 @@ def test_insert_select_aggregate_falls_back(db):
     cl.execute("INSERT INTO agg SELECT v, count(*) FROM t GROUP BY v")
     sq.execute("INSERT INTO agg SELECT v, count(*) FROM t GROUP BY v")
     check((cl, sq), "SELECT count(*), sum(c) FROM agg")
+
+
+def test_alter_table_add_drop_rename(db):
+    cl, sq = db
+    cl.execute("ALTER TABLE t ADD COLUMN extra decimal(8,2)")
+    sq.execute("ALTER TABLE t ADD COLUMN extra REAL")
+    # existing rows read NULL for the new column
+    check(db, "SELECT count(extra) FROM t")
+    cl.execute("INSERT INTO t VALUES (9999, 1, 'a', 3.50)")
+    sq.execute("INSERT INTO t VALUES (9999, 1, 'a', 3.5)")
+    check(db, "SELECT count(extra), sum(extra) FROM t")
+    # aggregate over mixed old/new stripes
+    check(db, "SELECT s, count(extra) FROM t GROUP BY s")
+    # rename + query under the new name
+    cl.execute("ALTER TABLE t RENAME COLUMN extra TO bonus")
+    sq.execute("ALTER TABLE t RENAME COLUMN extra TO bonus")
+    check(db, "SELECT count(bonus) FROM t")
+    # drop
+    cl.execute("ALTER TABLE t DROP COLUMN bonus")
+    sq.execute("ALTER TABLE t DROP COLUMN bonus")
+    from citus_tpu.errors import AnalysisError
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT bonus FROM t")
+    # guard: cannot drop distribution column
+    from citus_tpu.errors import CatalogError
+    with pytest.raises(CatalogError):
+        cl.execute("ALTER TABLE t DROP COLUMN k")
