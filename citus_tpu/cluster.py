@@ -267,6 +267,20 @@ class Cluster:
         return result
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
+        if isinstance(stmt, A.Select):
+            # recursive planning: materialize subqueries first
+            from citus_tpu.planner.recursive import rewrite_subqueries
+            new_stmt = rewrite_subqueries(
+                stmt, lambda sub: self._execute_stmt(sub))
+            if new_stmt is not stmt:
+                return self._execute_stmt(new_stmt)  # plans are not cached
+        if isinstance(stmt, A.Delete) and stmt.where is not None:
+            from citus_tpu.planner.recursive import has_subquery, rewrite_subqueries
+            if has_subquery(stmt.where):
+                wrapped = A.Select([A.SelectItem(A.Literal(1, "int"))],
+                                   from_=None, where=stmt.where)
+                rew = rewrite_subqueries(wrapped, lambda sub: self._execute_stmt(sub))
+                stmt = A.Delete(stmt.table, rew.where)
         if isinstance(stmt, A.Select) and isinstance(stmt.from_, A.Join):
             from citus_tpu.executor.join_executor import execute_join_select
             from citus_tpu.planner.join_planner import bind_join_select

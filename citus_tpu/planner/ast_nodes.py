@@ -109,6 +109,20 @@ class CaseExpr(Expr):
     else_: Optional[Expr] = None
 
 
+@dataclass(frozen=True)
+class Subquery(Expr):
+    """Scalar subquery or IN-subquery source; executed ahead of the outer
+    query as an intermediate result (reference: recursive planning,
+    planner/recursive_planning.c + read_intermediate_result)."""
+    select: object  # A.Select (unhashable field kept opaque)
+
+    def __hash__(self):
+        return id(self.select)
+
+    def __eq__(self, other):
+        return self is other
+
+
 # ------------------------------------------------------------ statements
 
 

@@ -547,7 +547,10 @@ class Parser:
             if self.accept_kw("in"):
                 self.expect_op("(")
                 if self.at_kw("select"):
-                    raise UnsupportedFeatureError("IN (SELECT ...) not supported yet")
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    left = A.InList(left, (A.Subquery(sub),), negated)
+                    continue
                 items = []
                 while True:
                     items.append(self.parse_additive())
@@ -649,6 +652,10 @@ class Parser:
                 return A.UnOp("not", self.parse_comparison())
         if t.kind == "op" and t.value == "(":
             self.next()
+            if self.at_kw("select"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return A.Subquery(sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e

@@ -1,0 +1,143 @@
+"""Recursive planning: subqueries as intermediate results.
+
+Reference: src/backend/distributed/planner/recursive_planning.c — a
+subquery that can't be pushed down executes as an independent plan and
+its result replaces the subquery via read_intermediate_result().  Here
+the same two phases: execute each A.Subquery first (through the full
+planner/executor), then rewrite the outer AST with the materialized
+result — a literal for scalar context, a literal list for IN.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from dataclasses import replace as _dc_replace
+
+from citus_tpu.errors import AnalysisError
+from citus_tpu.planner import ast_nodes as A
+
+
+def _value_to_literal(v) -> A.Literal:
+    if v is None:
+        return A.Literal(None, "null")
+    if isinstance(v, bool):
+        return A.Literal(v, "bool")
+    if isinstance(v, int):
+        return A.Literal(v, "int")
+    if isinstance(v, decimal.Decimal):
+        return A.Literal(v, "decimal")
+    if isinstance(v, float):
+        return A.Literal(v, "float")
+    if isinstance(v, str):
+        return A.Literal(v, "string")
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return A.Literal(v.isoformat(sep=" ") if isinstance(v, datetime.datetime)
+                         else v.isoformat(), "string")
+    raise AnalysisError(f"cannot use subquery value {v!r} as a literal")
+
+
+def has_subquery(e) -> bool:
+    return any(True for _ in _walk_expr(e))
+
+
+def _walk_expr(e):
+    if isinstance(e, A.Subquery):
+        yield e
+        return
+    if isinstance(e, A.BinOp):
+        yield from _walk_expr(e.left)
+        yield from _walk_expr(e.right)
+    elif isinstance(e, A.UnOp):
+        yield from _walk_expr(e.operand)
+    elif isinstance(e, A.Between):
+        yield from _walk_expr(e.expr)
+        yield from _walk_expr(e.lo)
+        yield from _walk_expr(e.hi)
+    elif isinstance(e, A.InList):
+        yield from _walk_expr(e.expr)
+        for it in e.items:
+            yield from _walk_expr(it)
+    elif isinstance(e, A.IsNull):
+        yield from _walk_expr(e.expr)
+    elif isinstance(e, A.Cast):
+        yield from _walk_expr(e.expr)
+    elif isinstance(e, A.CaseExpr):
+        for c, v in e.whens:
+            yield from _walk_expr(c)
+            yield from _walk_expr(v)
+        if e.else_ is not None:
+            yield from _walk_expr(e.else_)
+    elif isinstance(e, A.FuncCall):
+        for a in e.args:
+            yield from _walk_expr(a)
+
+
+def rewrite_subqueries(stmt: A.Select, run_select) -> A.Select:
+    """Execute every subquery in the statement via ``run_select`` and
+    substitute its result.  Returns a new Select (or the original when
+    there was nothing to do)."""
+
+    def exec_scalar(sub: A.Subquery) -> A.Literal:
+        r = run_select(sub.select)
+        if len(r.columns) != 1 and len(r.rows) and len(r.rows[0]) != 1:
+            raise AnalysisError("scalar subquery must return one column")
+        if len(r.rows) == 0:
+            return A.Literal(None, "null")
+        if len(r.rows) > 1:
+            raise AnalysisError("scalar subquery returned more than one row")
+        return _value_to_literal(r.rows[0][0])
+
+    def exec_in(sub: A.Subquery) -> tuple:
+        r = run_select(sub.select)
+        if r.rows and len(r.rows[0]) != 1:
+            raise AnalysisError("IN subquery must return one column")
+        # NULL elements can never match under IN's equality semantics
+        return tuple(_value_to_literal(row[0]) for row in r.rows
+                     if row[0] is not None)
+
+    def rw(e):
+        if e is None:
+            return None
+        if isinstance(e, A.Subquery):
+            return exec_scalar(e)
+        if isinstance(e, A.BinOp):
+            return A.BinOp(e.op, rw(e.left), rw(e.right))
+        if isinstance(e, A.UnOp):
+            return A.UnOp(e.op, rw(e.operand))
+        if isinstance(e, A.Between):
+            return A.Between(rw(e.expr), rw(e.lo), rw(e.hi), e.negated)
+        if isinstance(e, A.InList):
+            items = []
+            for it in e.items:
+                if isinstance(it, A.Subquery):
+                    items.extend(exec_in(it))
+                else:
+                    items.append(rw(it))
+            return A.InList(rw(e.expr), tuple(items), e.negated)
+        if isinstance(e, A.IsNull):
+            return A.IsNull(rw(e.expr), e.negated)
+        if isinstance(e, A.Cast):
+            return A.Cast(rw(e.expr), e.type_name, e.type_args)
+        if isinstance(e, A.CaseExpr):
+            return A.CaseExpr(tuple((rw(c), rw(v)) for c, v in e.whens),
+                              rw(e.else_) if e.else_ is not None else None)
+        if isinstance(e, A.FuncCall):
+            return A.FuncCall(e.name, tuple(rw(a) for a in e.args), e.distinct)
+        return e
+
+    exprs = ([i.expr for i in stmt.items] + [stmt.where, stmt.having]
+             + stmt.group_by + [o.expr for o in stmt.order_by])
+    if not any(e is not None and has_subquery(e) for e in exprs):
+        return stmt
+
+    return A.Select(
+        items=[A.SelectItem(rw(i.expr), i.alias) for i in stmt.items],
+        from_=stmt.from_,
+        where=rw(stmt.where),
+        group_by=[rw(g) for g in stmt.group_by],
+        having=rw(stmt.having),
+        order_by=[A.OrderItem(rw(o.expr), o.ascending, o.nulls_first)
+                  for o in stmt.order_by],
+        limit=stmt.limit, offset=stmt.offset, distinct=stmt.distinct,
+    )

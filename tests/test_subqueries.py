@@ -1,0 +1,67 @@
+"""Recursive planning: scalar subqueries + IN (SELECT ...) vs sqlite."""
+
+import sqlite3
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import AnalysisError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint, s text)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.execute("CREATE TABLE u (x bigint, y bigint)")
+    rows = [(i, i % 20, ["a", "b", "c"][i % 3]) for i in range(500)]
+    urows = [(i, i * 3) for i in range(10)]
+    cl.copy_from("t", rows=rows)
+    cl.copy_from("u", rows=urows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, v INTEGER, s TEXT)")
+    sq.execute("CREATE TABLE u (x INTEGER, y INTEGER)")
+    sq.executemany("INSERT INTO t VALUES (?,?,?)", rows)
+    sq.executemany("INSERT INTO u VALUES (?,?)", urows)
+    return cl, sq
+
+
+def check(db, sql):
+    cl, sq = db
+    ours = sorted(cl.execute(sql).rows, key=repr)
+    theirs = sorted(sq.execute(sql).fetchall(), key=repr)
+    assert ours == theirs
+
+
+QUERIES = [
+    "SELECT count(*) FROM t WHERE v > (SELECT count(*) FROM u)",
+    "SELECT count(*) FROM t WHERE k IN (SELECT x FROM u)",
+    "SELECT count(*) FROM t WHERE k NOT IN (SELECT x FROM u WHERE y > 12)",
+    "SELECT count(*) FROM t WHERE v = (SELECT min(y) FROM u WHERE x = 1)",
+    "SELECT v, count(*) FROM t WHERE v >= (SELECT max(x) FROM u) GROUP BY v ORDER BY v",
+    "SELECT count(*) FROM t WHERE k IN (SELECT x FROM u) AND v < 10",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_subqueries_vs_sqlite(db, sql):
+    check(db, sql)
+
+
+def test_scalar_subquery_empty_is_null(db):
+    cl, sq = db
+    sql = "SELECT count(*) FROM t WHERE v > (SELECT y FROM u WHERE x = 9999)"
+    check(db, sql)  # NULL comparison -> no rows
+
+
+def test_scalar_subquery_multirow_errors(db):
+    cl, _ = db
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT count(*) FROM t WHERE v > (SELECT y FROM u)")
+
+
+def test_delete_with_subquery(db):
+    cl, sq = db
+    cl.execute("DELETE FROM t WHERE k IN (SELECT x FROM u)")
+    sq.execute("DELETE FROM t WHERE k IN (SELECT x FROM u)")
+    check(db, "SELECT count(*) FROM t")
