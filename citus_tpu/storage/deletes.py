@@ -81,16 +81,39 @@ def stage_deletes(directory: str, xid: int,
 
 
 def commit_staged_deletes(directory: str, xid: int) -> None:
-    """Merge staged bitmaps into the live file (idempotent)."""
+    """Merge staged bitmaps into the live file (idempotent).  Deletion
+    bits are monotonic, so the merge is a bitwise OR — concurrent DELETE
+    transactions staged against the same base bitmap cannot lose each
+    other's bits."""
+    import fcntl
     p = _staged_path(directory, xid)
     if not os.path.exists(p):
         return
     with open(p) as fh:
         staged = json.load(fh)
-    live = load_deletes(directory)
-    live.update(staged)  # staged bitmaps were built on top of live ones
-    _store(_path(directory), live)
-    os.remove(p)
+    # serialize the read-modify-write across threads AND processes
+    lock_fd = os.open(os.path.join(directory, ".deletes.lock"),
+                      os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        live = load_deletes(directory)
+        for stripe_file, h in staged.items():
+            cur = live.get(stripe_file)
+            if cur is None:
+                live[stripe_file] = h
+                continue
+            a = np.frombuffer(bytes.fromhex(cur), np.uint8)
+            b = np.frombuffer(bytes.fromhex(h), np.uint8)
+            if len(a) != len(b):  # defensive: pad the shorter side
+                n = max(len(a), len(b))
+                a = np.pad(a, (0, n - len(a)))
+                b = np.pad(b, (0, n - len(b)))
+            live[stripe_file] = (a | b).tobytes().hex()
+        _store(_path(directory), live)
+        os.remove(p)
+    finally:
+        fcntl.flock(lock_fd, fcntl.LOCK_UN)
+        os.close(lock_fd)
 
 
 def abort_staged_deletes(directory: str, xid: int) -> None:

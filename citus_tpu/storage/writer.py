@@ -23,6 +23,27 @@ from citus_tpu.storage.format import write_stripe_file
 SHARD_META = "shard_meta.json"
 
 
+class _meta_flock:
+    """Serializes shard-metadata read-modify-write across threads and
+    processes (two coordinators may ingest into one placement)."""
+
+    def __init__(self, directory: str):
+        self._path = os.path.join(directory, ".meta.lock")
+        self._fd = None
+
+    def __enter__(self):
+        import fcntl
+        self._fd = os.open(self._path, os.O_CREAT | os.O_RDWR)
+        fcntl.flock(self._fd, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        import fcntl
+        fcntl.flock(self._fd, fcntl.LOCK_UN)
+        os.close(self._fd)
+        return False
+
+
 def _load_meta(directory: str) -> dict:
     p = os.path.join(directory, SHARD_META)
     if not os.path.exists(p):
@@ -79,16 +100,17 @@ def commit_staged(directory: str, xid: int) -> None:
         if os.path.exists(p):
             os.remove(p)
         return
-    meta = _load_meta(directory)
-    live_names = {s["file"] for s in meta["stripes"]}
-    for s in staged["stripes"]:
-        if s["file"] in live_names:
-            continue  # already applied
-        meta["stripes"].append(s)
-        meta["row_count"] += s["row_count"]
-        sid = int(s["file"].split("-")[1].split(".")[0])
-        meta["next_stripe_id"] = max(meta["next_stripe_id"], sid + 1)
-    _store_meta(directory, meta)
+    with _meta_flock(directory):
+        meta = _load_meta(directory)
+        live_names = {s["file"] for s in meta["stripes"]}
+        for s in staged["stripes"]:
+            if s["file"] in live_names:
+                continue  # already applied
+            meta["stripes"].append(s)
+            meta["row_count"] += s["row_count"]
+            sid = int(s["file"].split("-")[1].split(".")[0])
+            meta["next_stripe_id"] = max(meta["next_stripe_id"], sid + 1)
+        _store_meta(directory, meta)
     os.remove(p)
 
 
@@ -193,11 +215,13 @@ class ShardWriter:
                 else:
                     chunks.append((vals, None))
             column_chunks[self.schema.column(col).storage_name] = chunks
-        meta = _load_meta(self.directory)
         if self.staged_xid is not None:
+            # staged stripes get a transaction-unique name so concurrent
+            # ingests into one placement can never collide on a file
             staged = _load_staged(self.directory, self.staged_xid)
+            meta = _load_meta(self.directory)
             sid = meta["next_stripe_id"] + len(staged["stripes"])
-            fname = f"stripe-{sid:06d}.cts"
+            fname = f"stripe-{sid:06d}-x{self.staged_xid}-p{os.getpid()}.cts"
             write_stripe_file(
                 os.path.join(self.directory, fname), column_chunks, chunk_rows,
                 self.chunk_row_limit, self.codec, self.level)
@@ -205,13 +229,15 @@ class ShardWriter:
             staged["row_count"] += n
             _store_staged(self.directory, self.staged_xid, staged)
         else:
-            sid = meta["next_stripe_id"]
-            fname = f"stripe-{sid:06d}.cts"
-            write_stripe_file(
-                os.path.join(self.directory, fname), column_chunks, chunk_rows,
-                self.chunk_row_limit, self.codec, self.level)
-            meta["stripes"].append({"file": fname, "row_count": n})
-            meta["row_count"] += n
-            meta["next_stripe_id"] = sid + 1
-            _store_meta(self.directory, meta)
+            with _meta_flock(self.directory):
+                meta = _load_meta(self.directory)
+                sid = meta["next_stripe_id"]
+                fname = f"stripe-{sid:06d}.cts"
+                write_stripe_file(
+                    os.path.join(self.directory, fname), column_chunks, chunk_rows,
+                    self.chunk_row_limit, self.codec, self.level)
+                meta["stripes"].append({"file": fname, "row_count": n})
+                meta["row_count"] += n
+                meta["next_stripe_id"] = sid + 1
+                _store_meta(self.directory, meta)
         self._buf_rows -= n
