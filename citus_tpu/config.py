@@ -58,6 +58,10 @@ class ExecutorSettings:
     batch_row_buckets: bool = True
     # Smallest padded batch (rows) a kernel will ever see.
     min_batch_rows: int = 8192
+    # Use hand-written Pallas kernels for the segment reductions instead
+    # of the XLA one-hot formulation (off by default; both are exact and
+    # tested to agree).
+    use_pallas: bool = False
 
 
 @dataclass

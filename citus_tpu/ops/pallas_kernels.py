@@ -1,0 +1,135 @@
+"""Pallas TPU kernels for the aggregation hot loop.
+
+The default execution path lets XLA fuse the scan→filter→aggregate
+worker (ops/scan_agg.py); this module provides hand-written Pallas
+versions of the inner segment reduction for cases where explicit VMEM
+residency beats XLA's schedule: the group table stays pinned in VMEM
+scratch across the whole row stream, so each row block costs one HBM
+read of the inputs and zero round-trips of the accumulator (the
+accumulator only leaves VMEM once, at the end).
+
+Grid: one step per row block; TPU grid steps execute sequentially on a
+core, so accumulating into scratch across steps is sound.  Exactness is
+preserved: int64 accumulation, same one-hot formulation as the XLA path.
+
+Enabled via ``ExecutorSettings.use_pallas`` (off by default; the XLA
+path is the reference implementation and the two must agree exactly —
+see tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 8192
+
+
+def _segsum_kernel(gid_ref, val_ref, mask_ref, out_ref, acc_ref, *, G: int,
+                   n_blocks: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    gid = gid_ref[...]
+    val = val_ref[...]
+    mask = mask_ref[...]
+    upd = jnp.where(mask, val, 0)
+    # one-hot segment sum of this block, accumulated into VMEM scratch
+    onehot = gid[None, :] == jax.lax.broadcasted_iota(jnp.int32, (G, gid.shape[0]), 0)
+    acc_ref[...] += jnp.sum(jnp.where(onehot, upd[None, :], 0), axis=1)
+
+    @pl.when(i == n_blocks - 1)
+    def _flush():
+        out_ref[...] = acc_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("G", "block", "interpret"))
+def segment_sum_pallas(gid: jax.Array, values: jax.Array, mask: jax.Array,
+                       G: int, block: int = DEFAULT_BLOCK,
+                       interpret: bool = False) -> jax.Array:
+    """Exact masked segment sum: out[g] = sum(values[i] for gid[i]==g and
+    mask[i]).  gid int32 in [0, G); values any numeric dtype."""
+    n = gid.shape[0]
+    pad = (-n) % block
+    if pad:
+        gid = jnp.pad(gid, (0, pad))
+        values = jnp.pad(values, (0, pad))
+        mask = jnp.pad(mask, (0, pad))
+    n_blocks = (n + pad) // block
+    return pl.pallas_call(
+        functools.partial(_segsum_kernel, G=G, n_blocks=n_blocks),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((G,), lambda i: (0,)),
+        out_shape=jax.ShapeDtypeStruct((G,), values.dtype),
+        scratch_shapes=[pltpu.VMEM((G,), values.dtype)],
+        interpret=interpret,
+    )(gid, values, mask)
+
+
+def _minmax_kernel(gid_ref, val_ref, mask_ref, out_ref, acc_ref, *, G: int,
+                   n_blocks: int, kind: str, sentinel):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.full_like(acc_ref, sentinel)
+
+    gid = gid_ref[...]
+    val = val_ref[...]
+    mask = mask_ref[...]
+    upd = jnp.where(mask, val, sentinel)
+    onehot = gid[None, :] == jax.lax.broadcasted_iota(jnp.int32, (G, gid.shape[0]), 0)
+    blockwise = jnp.where(onehot, upd[None, :], sentinel)
+    red = jnp.min(blockwise, axis=1) if kind == "min" else jnp.max(blockwise, axis=1)
+    acc_ref[...] = jnp.minimum(acc_ref[...], red) if kind == "min" \
+        else jnp.maximum(acc_ref[...], red)
+
+    @pl.when(i == n_blocks - 1)
+    def _flush():
+        out_ref[...] = acc_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("G", "kind", "block", "interpret"))
+def segment_minmax_pallas(gid: jax.Array, values: jax.Array, mask: jax.Array,
+                          G: int, kind: str, block: int = DEFAULT_BLOCK,
+                          interpret: bool = False) -> jax.Array:
+    n = gid.shape[0]
+    dt = values.dtype
+    if np.issubdtype(dt, np.floating):
+        sentinel = np.inf if kind == "min" else -np.inf
+    else:
+        info = np.iinfo(dt)
+        sentinel = info.max if kind == "min" else info.min
+    pad = (-n) % block
+    if pad:
+        gid = jnp.pad(gid, (0, pad))
+        values = jnp.pad(values, (0, pad))
+        mask = jnp.pad(mask, (0, pad))
+    n_blocks = (n + pad) // block
+    return pl.pallas_call(
+        functools.partial(_minmax_kernel, G=G, n_blocks=n_blocks, kind=kind,
+                          sentinel=dt.type(sentinel)),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((G,), lambda i: (0,)),
+        out_shape=jax.ShapeDtypeStruct((G,), dt),
+        scratch_shapes=[pltpu.VMEM((G,), dt)],
+        interpret=interpret,
+    )(gid, values, mask)
