@@ -706,6 +706,13 @@ class Cluster:
                                  for f in os.listdir(d))
         return total
 
+    def profile(self, sql: str, trace_dir: str) -> Result:
+        """Execute under the JAX/XLA profiler (the tracing-integration
+        analog of SURVEY §5.1); view the trace with TensorBoard or
+        xprof."""
+        with jax.profiler.trace(trace_dir):
+            return self.execute(sql)
+
     def _execute_explain(self, stmt: A.Explain) -> Result:
         if not isinstance(stmt.statement, A.Select):
             raise UnsupportedFeatureError("EXPLAIN supports SELECT only")

@@ -61,8 +61,12 @@ def build_hash_agg_worker(plan: PhysicalPlan, xp, slots: int) -> Callable:
             kv, kvalid = kf(env)
             kvm = _as_mask(xp, kvalid, kv)
             kv = xp.asarray(kv)
-            bits = (kv.view(np.uint64) if kv.dtype in (np.dtype(np.float64),)
-                    else kv.astype(np.int64).view(np.uint64))
+            if kv.dtype == np.dtype(np.float64):
+                bits = kv.view(np.uint64)
+            elif np.issubdtype(kv.dtype, np.floating):
+                bits = kv.astype(np.float64).view(np.uint64)
+            else:
+                bits = kv.astype(np.int64).view(np.uint64)
             bits = xp.where(kvm, bits, np.uint64(0x9E3779B97F4A7C15))
             h = _mix(xp, h, bits + kvm.astype(np.uint64))
             keys.append((kv, kvm))

@@ -65,3 +65,20 @@ def test_null_keys_in_hash_mode(tmp_path):
                   key=repr)
     assert sorted(rows, key=repr) == sorted(
         [(1, 2, 40), (2, 1, 5), (None, 2, 60)], key=repr)
+
+
+def test_group_by_float32_column(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, f real, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 2)")
+    rng = np.random.default_rng(8)
+    n = 5000
+    f = (rng.integers(0, 50, n) / 4).astype(np.float32)
+    cl.copy_from("t", columns={"k": np.arange(n, dtype=np.int64), "f": f,
+                               "v": np.ones(n, dtype=np.int64)})
+    rows = cl.execute("SELECT f, count(*) FROM t GROUP BY f").rows
+    assert len(rows) == len(np.unique(f))
+    assert sum(r[1] for r in rows) == n
+    with settings_override(executor=ExecutorSettings(task_executor_backend="cpu")):
+        cpu = cl.execute("SELECT f, count(*) FROM t GROUP BY f").rows
+    assert sorted(rows) == sorted(cpu)
