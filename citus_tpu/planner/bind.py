@@ -228,6 +228,13 @@ class Binder:
                     mapping = tuple(lindex.get(w, -1) for w in rwords)
                     right = BDictRemap(right, mapping)
             return left, right
+        # mixed decimal/float: the decimal side must leave scaled-int space
+        if lt.is_float and rt.is_decimal:
+            right = BCast(right, T.FLOAT64_T)
+            rt = right.type
+        elif rt.is_float and lt.is_decimal:
+            left = BCast(left, T.FLOAT64_T)
+            lt = left.type
         # decimal scale alignment (comparisons, +, -)
         ls = lt.scale if lt.is_decimal else 0
         rs = rt.scale if rt.is_decimal else 0
