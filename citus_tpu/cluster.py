@@ -245,7 +245,7 @@ class Cluster:
         return total
 
     # -------------------------------------------------------------- SQL
-    def execute(self, sql: str) -> Result:
+    def execute(self, sql: str, params: Optional[Sequence[Any]] = None) -> Result:
         import time as _time
         self._maybe_reload_catalog()
         stmts = parse_sql(sql)
@@ -254,7 +254,14 @@ class Cluster:
         t0 = _time.perf_counter()
         try:
             for stmt in stmts:
-                result = self._execute_stmt(stmt, sql_text=sql if len(stmts) == 1 else None)
+                if params is not None:
+                    from citus_tpu.planner.recursive import rewrite_params
+                    stmt = rewrite_params(stmt, list(params))
+                # parameterized statements skip the text-keyed plan cache
+                # (deferred-pruning parameterized plans are a later
+                # milestone, reference: Job->deferredPruning)
+                key = sql if (len(stmts) == 1 and params is None) else None
+                result = self._execute_stmt(stmt, sql_text=key)
         finally:
             self.activity.exit(gpid)
         executor = result.explain.get("strategy", "utility") if result.explain else "utility"

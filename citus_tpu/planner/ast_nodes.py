@@ -110,6 +110,12 @@ class CaseExpr(Expr):
 
 
 @dataclass(frozen=True)
+class Param(Expr):
+    """$N placeholder bound at execute time (prepared-statement analog)."""
+    index: int  # 1-based
+
+
+@dataclass(frozen=True)
 class Subquery(Expr):
     """Scalar subquery or IN-subquery source; executed ahead of the outer
     query as an intermediate result (reference: recursive planning,

@@ -24,6 +24,7 @@ _TOKEN_RE = re.compile(
   | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+|\d+)
   | (?P<str>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z_0-9$]*)
+  | (?P<param>\$\d+)
   | (?P<op><=|>=|<>|!=|::|=|<|>|\+|-|\*|/|%|\(|\)|,|;|\.)
     """,
     re.VERBOSE,
@@ -650,6 +651,9 @@ class Parser:
             if t.value == "not":
                 self.next()
                 return A.UnOp("not", self.parse_comparison())
+        if t.kind == "param":
+            self.next()
+            return A.Param(int(t.value[1:]))
         if t.kind == "op" and t.value == "(":
             self.next()
             if self.at_kw("select"):

@@ -73,6 +73,86 @@ def _walk_expr(e):
             yield from _walk_expr(a)
 
 
+def bind_params(e, params):
+    """Replace $N placeholders with literal values."""
+    if e is None:
+        return None
+    if isinstance(e, A.Param):
+        if params is None or not (1 <= e.index <= len(params)):
+            raise AnalysisError(f"no value supplied for parameter ${e.index}")
+        return _value_to_literal(params[e.index - 1])
+    if isinstance(e, A.BinOp):
+        return A.BinOp(e.op, bind_params(e.left, params), bind_params(e.right, params))
+    if isinstance(e, A.UnOp):
+        return A.UnOp(e.op, bind_params(e.operand, params))
+    if isinstance(e, A.Between):
+        return A.Between(bind_params(e.expr, params), bind_params(e.lo, params),
+                         bind_params(e.hi, params), e.negated)
+    if isinstance(e, A.InList):
+        return A.InList(bind_params(e.expr, params),
+                        tuple(bind_params(i, params) for i in e.items), e.negated)
+    if isinstance(e, A.IsNull):
+        return A.IsNull(bind_params(e.expr, params), e.negated)
+    if isinstance(e, A.Cast):
+        return A.Cast(bind_params(e.expr, params), e.type_name, e.type_args)
+    if isinstance(e, A.CaseExpr):
+        return A.CaseExpr(tuple((bind_params(c, params), bind_params(v, params))
+                                for c, v in e.whens),
+                          bind_params(e.else_, params) if e.else_ is not None else None)
+    if isinstance(e, A.FuncCall):
+        return A.FuncCall(e.name, tuple(bind_params(a, params) for a in e.args),
+                          e.distinct)
+    return e
+
+
+def has_params(e) -> bool:
+    if e is None:
+        return False
+    if isinstance(e, A.Param):
+        return True
+    if isinstance(e, A.BinOp):
+        return has_params(e.left) or has_params(e.right)
+    if isinstance(e, (A.UnOp,)):
+        return has_params(e.operand)
+    if isinstance(e, A.Between):
+        return has_params(e.expr) or has_params(e.lo) or has_params(e.hi)
+    if isinstance(e, A.InList):
+        return has_params(e.expr) or any(has_params(i) for i in e.items)
+    if isinstance(e, (A.IsNull, A.Cast)):
+        return has_params(e.expr)
+    if isinstance(e, A.CaseExpr):
+        return any(has_params(c) or has_params(v) for c, v in e.whens) or             has_params(e.else_)
+    if isinstance(e, A.FuncCall):
+        return any(has_params(a) for a in e.args)
+    return False
+
+
+def rewrite_params(stmt, params):
+    """Substitute $N placeholders throughout a statement."""
+    if isinstance(stmt, A.Select):
+        return A.Select(
+            items=[A.SelectItem(bind_params(i.expr, params), i.alias)
+                   for i in stmt.items],
+            from_=stmt.from_,
+            where=bind_params(stmt.where, params),
+            group_by=[bind_params(g, params) for g in stmt.group_by],
+            having=bind_params(stmt.having, params),
+            order_by=[A.OrderItem(bind_params(o.expr, params), o.ascending,
+                                  o.nulls_first) for o in stmt.order_by],
+            limit=stmt.limit, offset=stmt.offset, distinct=stmt.distinct)
+    if isinstance(stmt, A.Delete):
+        return A.Delete(stmt.table, bind_params(stmt.where, params))
+    if isinstance(stmt, A.Update):
+        return A.Update(stmt.table,
+                        [(c, bind_params(e, params)) for c, e in stmt.assignments],
+                        bind_params(stmt.where, params))
+    if isinstance(stmt, A.Insert) and stmt.rows:
+        return A.Insert(stmt.table, stmt.columns,
+                        [[bind_params(e, params) for e in row] for row in stmt.rows],
+                        stmt.select)
+    return stmt
+
+
 def rewrite_subqueries(stmt: A.Select, run_select) -> A.Select:
     """Execute every subquery in the statement via ``run_select`` and
     substitute its result.  Returns a new Select (or the original when

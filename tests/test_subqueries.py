@@ -65,3 +65,20 @@ def test_delete_with_subquery(db):
     cl.execute("DELETE FROM t WHERE k IN (SELECT x FROM u)")
     sq.execute("DELETE FROM t WHERE k IN (SELECT x FROM u)")
     check(db, "SELECT count(*) FROM t")
+
+
+def test_parameterized_queries(db):
+    cl, sq = db
+    ours = cl.execute("SELECT count(*) FROM t WHERE v > $1 AND s = $2",
+                      params=(10, "a")).rows
+    theirs = sq.execute("SELECT count(*) FROM t WHERE v > ? AND s = ?",
+                        (10, "a")).fetchall()
+    assert ours == list(theirs)
+    # router param
+    assert cl.execute("SELECT count(*) FROM t WHERE k = $1", params=(7,)).rows == [(1,)]
+    # param in DML
+    cl.execute("DELETE FROM t WHERE v = $1", params=(3,))
+    sq.execute("DELETE FROM t WHERE v = ?", (3,))
+    check(db, "SELECT count(*) FROM t")
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT count(*) FROM t WHERE v > $2", params=(1,))
