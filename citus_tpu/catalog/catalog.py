@@ -36,6 +36,10 @@ class DistributionMethod:
     HASH = "hash"            # hash-distributed over shards
     REFERENCE = "reference"  # one shard replicated everywhere
     LOCAL = "local"          # coordinator-local single shard
+    TENANT = "tenant"        # schema-based sharding: one shard per tenant
+                             # schema, all of a schema's tables colocated
+                             # (reference: citus.enable_schema_based_sharding,
+                             # commands/schema_based_sharding.c)
 
 
 @dataclass
@@ -143,6 +147,8 @@ class Catalog:
         self.ddl_epoch = 0
         self._dicts: dict[tuple[str, str], list[str]] = {}
         self._dict_index: dict[tuple[str, str], dict[str, int]] = {}
+        # tenant schemas: name -> {"colocation_id": int, "home_node": int}
+        self.schemas: dict[str, dict] = {}
         self._load()
 
     # ---- persistence --------------------------------------------------
@@ -159,6 +165,7 @@ class Catalog:
         self.nodes = {n["node_id"]: NodeMeta.from_json(n) for n in d["nodes"]}
         self._next_shard_id = d["next_shard_id"]
         self._next_colocation_id = d["next_colocation_id"]
+        self.schemas = d.get("schemas", {})
 
     def commit(self) -> None:
         """Atomically persist catalog state (round-1 metadata transaction)."""
@@ -170,6 +177,7 @@ class Catalog:
                 "nodes": [n.to_json() for n in self.nodes.values()],
                 "next_shard_id": self._next_shard_id,
                 "next_colocation_id": self._next_colocation_id,
+                "schemas": self.schemas,
             }
             tmp = self._path() + ".tmp"
             with open(tmp, "w") as fh:
@@ -199,11 +207,48 @@ class Catalog:
             if name in self.tables:
                 raise CatalogError(f'relation "{name}" already exists')
             t = TableMeta(name=name, schema=schema, **columnar_opts)
-            # every table starts LOCAL with a single shard on node 0
-            t.shards = [ShardMeta(self._alloc_shard_id(), 0, placements=[0])]
+            if "." in name:
+                schema_name = name.split(".", 1)[0]
+                tenant = self.schemas.get(schema_name)
+                if tenant is None:
+                    raise CatalogError(f'schema "{schema_name}" does not exist')
+                # tenant table: single shard on the schema's home node,
+                # colocated with the rest of the schema
+                t.method = DistributionMethod.TENANT
+                t.colocation_id = tenant["colocation_id"]
+                t.shards = [ShardMeta(self._alloc_shard_id(), 0,
+                                      placements=[tenant["home_node"]])]
+            else:
+                # every table starts LOCAL with a single shard on node 0
+                t.shards = [ShardMeta(self._alloc_shard_id(), 0, placements=[0])]
             self.tables[name] = t
             self.ddl_epoch += 1
             return t
+
+    def create_schema(self, name: str) -> None:
+        with self._lock:
+            if name in self.schemas:
+                raise CatalogError(f'schema "{name}" already exists')
+            nodes = self.active_node_ids() or [0]
+            home = nodes[len(self.schemas) % len(nodes)]
+            self.schemas[name] = {
+                "colocation_id": self._next_colocation_id,
+                "home_node": home,
+            }
+            self._next_colocation_id += 1
+            self.ddl_epoch += 1
+
+    def drop_schema(self, name: str, cascade: bool = False) -> list[str]:
+        with self._lock:
+            if name not in self.schemas:
+                raise CatalogError(f'schema "{name}" does not exist')
+            members = [t for t in self.tables if t.startswith(name + ".")]
+            if members and not cascade:
+                raise CatalogError(
+                    f'schema "{name}" is not empty; use DROP SCHEMA ... CASCADE')
+            del self.schemas[name]
+            self.ddl_epoch += 1
+            return members
 
     def add_column(self, name: str, column) -> None:
         from citus_tpu.schema import Schema

@@ -310,6 +310,19 @@ class Cluster:
                     bound, plan, bound.table.version, self.catalog.ddl_epoch,
                     self.settings.executor.task_executor_backend)
             return execute_select(self.catalog, bound, self.settings, plan=plan)
+        if isinstance(stmt, A.CreateSchema):
+            if stmt.if_not_exists and stmt.name in self.catalog.schemas:
+                return Result(columns=[], rows=[])
+            self.catalog.create_schema(stmt.name)
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropSchema):
+            members = self.catalog.drop_schema(stmt.name, cascade=stmt.cascade)
+            for m in members:
+                self.catalog.drop_table(m)
+            self.catalog.commit()
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[])
         if isinstance(stmt, A.CreateTable):
             schema = Schema([
                 Column(c.name, type_from_sql(c.type_name, c.type_args or None), c.not_null)
@@ -622,6 +635,15 @@ class Cluster:
         if name == "citus_stat_statements_reset":
             self.query_stats.reset()
             return Result(columns=[name], rows=[(None,)])
+        if name == "citus_schemas":
+            rows = []
+            for sname, info in self.catalog.schemas.items():
+                members = [t for t in self.catalog.tables if t.startswith(sname + ".")]
+                size = sum(self._table_size(m) for m in members)
+                rows.append((sname, info["colocation_id"], info["home_node"],
+                             len(members), size))
+            return Result(columns=["schema_name", "colocation_id", "node",
+                                   "table_count", "schema_size"], rows=rows)
         if name == "citus_stat_tenants":
             return Result(columns=["tenant", "query_count", "total_time_ms"],
                           rows=self.tenant_stats.rows_view())

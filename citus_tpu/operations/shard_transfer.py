@@ -50,7 +50,9 @@ def _colocated_shards(cat: Catalog, table, shard):
     """Shards that must move together: same colocation group, same index."""
     out = []
     for t in cat.tables.values():
-        if t.is_distributed and t.colocation_id == table.colocation_id:
+        if t.colocation_id != table.colocation_id or t.colocation_id == 0:
+            continue
+        if t.is_distributed or t.method == "tenant":
             out.append((t, t.shards[shard.index]))
     return out
 

@@ -145,6 +145,18 @@ class ColumnDef:
 
 
 @dataclass
+class CreateSchema(Statement):
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSchema(Statement):
+    name: str
+    cascade: bool = False
+
+
+@dataclass
 class CreateTable(Statement):
     name: str
     columns: list[ColumnDef]

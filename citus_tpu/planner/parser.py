@@ -39,6 +39,7 @@ KEYWORDS = {
     "case", "when", "then", "else", "end", "cast", "explain", "analyze",
     "using", "with", "like", "delete", "update", "set", "truncate",
     "vacuum", "copy", "alter", "add", "column", "rename", "to",
+    "schema", "cascade",
 }
 
 
@@ -150,12 +151,12 @@ class Parser:
         if self.at_kw("delete"):
             self.next()
             self.expect_kw("from")
-            name = self.expect_ident()
+            name = self.parse_table_name()
             where = self.parse_expr() if self.accept_kw("where") else None
             return A.Delete(name, where)
         if self.at_kw("update"):
             self.next()
-            name = self.expect_ident()
+            name = self.parse_table_name()
             self.expect_kw("set")
             assignments = []
             while True:
@@ -169,12 +170,12 @@ class Parser:
         if self.at_kw("truncate"):
             self.next()
             self.accept_kw("table")
-            return A.Truncate(self.expect_ident())
+            return A.Truncate(self.parse_table_name())
         if self.at_kw("alter"):
             return self.parse_alter_table()
         if self.at_kw("copy"):
             self.next()
-            name = self.expect_ident()
+            name = self.parse_table_name()
             self.expect_kw("from")
             t = self.next()
             if t.kind != "str":
@@ -197,13 +198,13 @@ class Parser:
         if self.at_kw("vacuum"):
             self.next()
             full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())
-            return A.Vacuum(self.expect_ident(), full)
+            return A.Vacuum(self.parse_table_name(), full)
         self.error("expected a statement")
 
     def parse_alter_table(self) -> A.AlterTable:
         self.expect_kw("alter")
         self.expect_kw("table")
-        name = self.expect_ident()
+        name = self.parse_table_name()
         if self.accept_kw("add"):
             self.accept_kw("column")
             cname = self.expect_ident()
@@ -237,16 +238,29 @@ class Parser:
         analyze = bool(self.accept_kw("analyze"))
         return A.Explain(self.parse_statement(), analyze=analyze)
 
+    def parse_table_name(self) -> str:
+        name = self.expect_ident()
+        if self.accept_op("."):
+            return f"{name}.{self.expect_ident()}"
+        return name
+
     # -- CREATE TABLE t (col type [not null], ...) [using columnar] [with (...)]
-    def parse_create_table(self) -> A.CreateTable:
+    def parse_create_table(self):
         self.expect_kw("create")
+        if self.accept_kw("schema"):
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            return A.CreateSchema(self.expect_ident(), if_not_exists)
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
             self.expect_kw("not") if self.at_kw("not") else self.error("expected NOT")
             self.expect_kw("exists")
             if_not_exists = True
-        name = self.expect_ident()
+        name = self.parse_table_name()
         self.expect_op("(")
         cols = []
         while True:
@@ -301,19 +315,23 @@ class Parser:
             self.expect_op(")")
         return name, args
 
-    def parse_drop_table(self) -> A.DropTable:
+    def parse_drop_table(self):
         self.expect_kw("drop")
+        if self.accept_kw("schema"):
+            name = self.expect_ident()
+            cascade = bool(self.accept_kw("cascade"))
+            return A.DropSchema(name, cascade)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):
             self.expect_kw("exists")
             if_exists = True
-        return A.DropTable(self.expect_ident(), if_exists)
+        return A.DropTable(self.parse_table_name(), if_exists)
 
     def parse_insert(self) -> A.Insert:
         self.expect_kw("insert")
         self.expect_kw("into")
-        name = self.expect_ident()
+        name = self.parse_table_name()
         cols = None
         if self.at_op("("):
             self.next()
@@ -357,7 +375,8 @@ class Parser:
         "citus_get_node_clock", "citus_get_transaction_clock",
         "citus_create_restore_point", "citus_list_restore_points",
         "alter_distributed_table", "citus_check_cluster_node_health",
-        "citus_stat_tenants", "get_rebalance_progress",
+        "citus_stat_tenants", "get_rebalance_progress", "citus_schemas",
+        "citus_schema_tenant_set", "citus_schema_tenant_unset",
     }
 
     def parse_select_or_utility(self) -> A.Statement:
@@ -493,7 +512,7 @@ class Parser:
     def parse_table_ref(self) -> A.TableRef:
         if self.at_op("("):
             raise UnsupportedFeatureError("subqueries in FROM are not supported yet")
-        name = self.expect_ident()
+        name = self.parse_table_name()
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()

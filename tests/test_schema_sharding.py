@@ -1,0 +1,88 @@
+"""Schema-based sharding (tenant schemas).
+
+Reference: citus.enable_schema_based_sharding +
+commands/schema_based_sharding.c — every distributed schema is one
+tenant: its tables form a single colocated shard group on one node and
+move together."""
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=3)
+    yield cl
+    cl.close()
+
+
+def test_tenant_schema_lifecycle(db):
+    cl = db
+    cl.execute("CREATE SCHEMA tenant1")
+    cl.execute("CREATE SCHEMA tenant2")
+    cl.execute("CREATE TABLE tenant1.orders (id bigint, total decimal(10,2))")
+    cl.execute("CREATE TABLE tenant1.items (id bigint, qty bigint)")
+    cl.execute("CREATE TABLE tenant2.orders (id bigint, total decimal(10,2))")
+    t1o = cl.catalog.table("tenant1.orders")
+    t1i = cl.catalog.table("tenant1.items")
+    t2o = cl.catalog.table("tenant2.orders")
+    # one colocation group per schema; different schemas differ
+    assert t1o.colocation_id == t1i.colocation_id
+    assert t1o.colocation_id != t2o.colocation_id
+    # all of a tenant's shards live on the schema's home node
+    assert t1o.shards[0].placements == t1i.shards[0].placements
+    cl.execute("INSERT INTO tenant1.orders VALUES (1, 9.99), (2, 19.99)")
+    cl.execute("INSERT INTO tenant2.orders VALUES (7, 5.00)")
+    assert cl.execute("SELECT count(*), sum(total) FROM tenant1.orders").rows[0][0] == 2
+    assert cl.execute("SELECT count(*) FROM tenant2.orders").rows == [(1,)]
+    schemas = {r[0]: r for r in cl.execute("SELECT citus_schemas()").rows}
+    assert schemas["tenant1"][3] == 2  # table count
+
+
+def test_tenant_join_within_schema(db):
+    cl = db
+    cl.execute("CREATE SCHEMA app")
+    cl.execute("CREATE TABLE app.users (uid bigint, name text)")
+    cl.execute("CREATE TABLE app.events (uid bigint, n bigint)")
+    cl.execute("INSERT INTO app.users VALUES (1, 'ann'), (2, 'bo')")
+    cl.execute("INSERT INTO app.events VALUES (1, 10), (1, 20), (2, 5)")
+    r = cl.execute(
+        "SELECT u.name, sum(e.n) FROM app.users u JOIN app.events e "
+        "ON u.uid = e.uid GROUP BY u.name ORDER BY u.name")
+    assert r.rows == [("ann", 30), ("bo", 5)]
+
+
+def test_tenant_moves_as_unit(db):
+    cl = db
+    cl.execute("CREATE SCHEMA ten")
+    cl.execute("CREATE TABLE ten.a (x bigint)")
+    cl.execute("CREATE TABLE ten.b (y bigint)")
+    cl.execute("INSERT INTO ten.a VALUES (1), (2)")
+    cl.execute("INSERT INTO ten.b VALUES (9)")
+    ta = cl.catalog.table("ten.a")
+    src = ta.shards[0].placements[0]
+    dst = (src + 1) % 3
+    cl.execute(f"SELECT citus_move_shard_placement({ta.shards[0].shard_id}, {src}, {dst})")
+    assert cl.catalog.table("ten.a").shards[0].placements == [dst]
+    assert cl.catalog.table("ten.b").shards[0].placements == [dst]
+    assert cl.execute("SELECT count(*) FROM ten.a").rows == [(2,)]
+    assert cl.execute("SELECT count(*) FROM ten.b").rows == [(1,)]
+
+
+def test_schema_errors(db):
+    cl = db
+    with pytest.raises(CatalogError):
+        cl.execute("CREATE TABLE missing.t (x bigint)")
+    cl.execute("CREATE SCHEMA s1")
+    with pytest.raises(CatalogError):
+        cl.execute("CREATE SCHEMA s1")
+    cl.execute("CREATE TABLE s1.t (x bigint)")
+    with pytest.raises(CatalogError):
+        cl.execute("DROP SCHEMA s1")  # not empty
+    cl.execute("DROP SCHEMA s1 CASCADE")
+    assert not cl.catalog.has_table("s1.t")
+    with pytest.raises(CatalogError):
+        cl.execute("SELECT count(*) FROM s1.t")
