@@ -396,6 +396,14 @@ class Cluster:
             self.catalog.commit()
             self._plan_cache.clear()
             return Result(columns=[], rows=[])
+        if isinstance(stmt, A.Merge):
+            from citus_tpu.executor.merge_executor import execute_merge
+            st = execute_merge(
+                self.catalog, self.txlog, stmt,
+                encode_value=lambda tbl, col, v:
+                    int(self.catalog.encode_strings(tbl, col, [v])[0]))
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.Truncate):
             from citus_tpu.executor.dml import execute_truncate
             execute_truncate(self.catalog, self.catalog.table(stmt.table))

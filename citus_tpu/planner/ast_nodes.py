@@ -262,6 +262,24 @@ class Vacuum(Statement):
 
 
 @dataclass
+class MergeWhen:
+    matched: bool
+    action: str                       # update | delete | insert | nothing
+    condition: Optional[Expr] = None  # AND <cond> on the WHEN clause
+    assignments: list = field(default_factory=list)   # update
+    insert_columns: Optional[list] = None             # insert
+    insert_values: list = field(default_factory=list) # insert
+
+
+@dataclass
+class Merge(Statement):
+    target: "TableRef" = None
+    source: "TableRef" = None
+    on: Expr = None
+    whens: list = field(default_factory=list)
+
+
+@dataclass
 class UtilityCall(Statement):
     """SELECT create_distributed_table('t', 'col') style UDF utilities —
     the reference exposes its control plane as SQL-callable UDFs

@@ -39,7 +39,7 @@ KEYWORDS = {
     "case", "when", "then", "else", "end", "cast", "explain", "analyze",
     "using", "with", "like", "delete", "update", "set", "truncate",
     "vacuum", "copy", "alter", "add", "column", "rename", "to",
-    "schema", "cascade",
+    "schema", "cascade", "merge", "matched", "nothing", "do",
 }
 
 
@@ -173,6 +173,8 @@ class Parser:
             return A.Truncate(self.parse_table_name())
         if self.at_kw("alter"):
             return self.parse_alter_table()
+        if self.at_kw("merge"):
+            return self.parse_merge()
         if self.at_kw("copy"):
             self.next()
             name = self.parse_table_name()
@@ -200,6 +202,68 @@ class Parser:
             full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())
             return A.Vacuum(self.parse_table_name(), full)
         self.error("expected a statement")
+
+    def parse_merge(self) -> A.Merge:
+        self.expect_kw("merge")
+        self.expect_kw("into")
+        target = self.parse_table_ref()
+        self.expect_kw("using")
+        source = self.parse_table_ref()
+        self.expect_kw("on")
+        on = self.parse_expr()
+        whens = []
+        while self.at_kw("when"):
+            self.next()
+            matched = True
+            if self.accept_kw("not"):
+                self.expect_kw("matched")
+                matched = False
+            else:
+                self.expect_kw("matched")
+            cond = None
+            if self.accept_kw("and"):
+                cond = self.parse_expr()
+            self.expect_kw("then")
+            if self.accept_kw("update"):
+                self.expect_kw("set")
+                assignments = []
+                while True:
+                    col = self.expect_ident()
+                    self.expect_op("=")
+                    assignments.append((col, self.parse_expr()))
+                    if not self.accept_op(","):
+                        break
+                whens.append(A.MergeWhen(matched, "update", cond, assignments))
+            elif self.accept_kw("delete"):
+                whens.append(A.MergeWhen(matched, "delete", cond))
+            elif self.accept_kw("insert"):
+                cols = None
+                if self.at_op("("):
+                    self.next()
+                    cols = []
+                    while True:
+                        cols.append(self.expect_ident())
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op(")")
+                self.expect_kw("values")
+                self.expect_op("(")
+                vals = []
+                while True:
+                    vals.append(self.parse_expr())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                whens.append(A.MergeWhen(matched, "insert", cond,
+                                         insert_columns=cols, insert_values=vals))
+            elif self.accept_kw("do"):
+                self.expect_kw("nothing")
+                whens.append(A.MergeWhen(matched, "nothing", cond))
+            else:
+                self.error("expected UPDATE, DELETE, INSERT, or DO NOTHING")
+        if not whens:
+            self.error("MERGE requires at least one WHEN clause")
+        return A.Merge(target, source, on, whens)
 
     def parse_alter_table(self) -> A.AlterTable:
         self.expect_kw("alter")

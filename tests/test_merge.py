@@ -1,0 +1,66 @@
+"""MERGE statement vs sqlite oracle (sqlite supports UPSERT, so the
+oracle is hand-computed or expressed with equivalent statements)."""
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import ExecutionError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE tgt (id bigint NOT NULL, qty bigint, s text)")
+    cl.execute("SELECT create_distributed_table('tgt', 'id', 4)")
+    cl.execute("CREATE TABLE src (id bigint NOT NULL, qty bigint)")
+    cl.execute("SELECT create_distributed_table('src', 'id', 4)")
+    cl.copy_from("tgt", rows=[(i, i * 10, "old") for i in range(10)])
+    cl.copy_from("src", rows=[(i, 1000 + i) for i in range(5, 15)])
+    return cl
+
+
+def test_merge_update_and_insert(db):
+    cl = db
+    r = cl.execute("""
+        MERGE INTO tgt t USING src s ON t.id = s.id
+        WHEN MATCHED THEN UPDATE SET qty = s.qty, s = 'upd'
+        WHEN NOT MATCHED THEN INSERT (id, qty, s) VALUES (s.id, s.qty, 'new')""")
+    assert r.explain == {"updated": 5, "deleted": 0, "inserted": 5}
+    rows = dict((k, (q, s_)) for k, q, s_ in
+                cl.execute("SELECT id, qty, s FROM tgt ORDER BY id").rows)
+    assert rows[4] == (40, "old")        # untouched
+    assert rows[5] == (1005, "upd")      # updated
+    assert rows[14] == (1014, "new")     # inserted
+    assert len(rows) == 15
+
+
+def test_merge_delete(db):
+    cl = db
+    r = cl.execute("""
+        MERGE INTO tgt t USING src s ON t.id = s.id
+        WHEN MATCHED AND s.qty > 1007 THEN DELETE""")
+    assert r.explain["deleted"] == 2  # ids 8, 9 matched with qty 1008/1009
+    assert cl.execute("SELECT count(*) FROM tgt").rows == [(8,)]
+
+
+def test_merge_duplicate_source_match_errors(db):
+    cl = db
+    cl.execute("CREATE TABLE dup (id bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('dup', 'id', 2)")
+    cl.copy_from("dup", rows=[(5, 1), (5, 2)])  # two source rows match id 5
+    with pytest.raises(ExecutionError):
+        cl.execute("MERGE INTO tgt t USING dup d ON t.id = d.id "
+                   "WHEN MATCHED THEN UPDATE SET qty = d.v")
+
+
+def test_merge_do_nothing_and_condition(db):
+    cl = db
+    r = cl.execute("""
+        MERGE INTO tgt t USING src s ON t.id = s.id
+        WHEN MATCHED AND s.qty < 1007 THEN UPDATE SET qty = 0
+        WHEN NOT MATCHED THEN DO NOTHING""")
+    assert r.explain["updated"] == 2  # ids 5, 6
+    # + id 0 whose original qty was already 0
+    assert cl.execute("SELECT count(*) FROM tgt WHERE qty = 0").rows == [(3,)]
+    assert cl.execute("SELECT count(*) FROM tgt").rows == [(10,)]
