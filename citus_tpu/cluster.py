@@ -336,6 +336,19 @@ class Cluster:
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Insert):
             return self._execute_insert(stmt)
+        if isinstance(stmt, A.CopyTo):
+            import csv
+            t = self.catalog.table(stmt.table)
+            sel = A.Select([A.SelectItem(A.Star())], from_=A.TableRef(stmt.table))
+            r = self._execute_stmt(sel)
+            header = str(stmt.options.get("header", "false")).lower() in ("true", "1", "on")
+            with open(stmt.path, "w", newline="") as fh:
+                w = csv.writer(fh, delimiter=stmt.options.get("delimiter", ","))
+                if header:
+                    w.writerow(t.schema.names)
+                for row in r.rows:
+                    w.writerow(["" if v is None else v for v in row])
+            return Result(columns=[], rows=[], explain={"copied": r.rowcount})
         if isinstance(stmt, A.CopyFrom):
             n = self.copy_from_csv(
                 stmt.table, stmt.path,

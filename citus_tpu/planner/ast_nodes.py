@@ -238,6 +238,13 @@ class CopyFrom(Statement):
 
 
 @dataclass
+class CopyTo(Statement):
+    table: str
+    path: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
 class Delete(Statement):
     table: str
     where: Optional[Expr] = None

@@ -178,10 +178,14 @@ class Parser:
         if self.at_kw("copy"):
             self.next()
             name = self.parse_table_name()
-            self.expect_kw("from")
+            to = False
+            if self.accept_kw("to"):
+                to = True
+            else:
+                self.expect_kw("from")
             t = self.next()
             if t.kind != "str":
-                self.error("expected a quoted file path after COPY ... FROM")
+                self.error("expected a quoted file path after COPY")
             path = t.value[1:-1].replace("''", "'")
             options = {}
             if self.accept_kw("with"):
@@ -196,7 +200,7 @@ class Parser:
                     if not self.accept_op(","):
                         break
                 self.expect_op(")")
-            return A.CopyFrom(name, path, options)
+            return (A.CopyTo if to else A.CopyFrom)(name, path, options)
         if self.at_kw("vacuum"):
             self.next()
             full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())

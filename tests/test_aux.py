@@ -145,3 +145,18 @@ def test_undistribute_table(tmp_path):
     assert not t.is_distributed
     assert t.shard_count == 1
     assert cl.execute("SELECT count(*), sum(v) FROM t").rows == [(1000, 499500)]
+
+
+def test_copy_to_roundtrip(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, name text, price decimal(8,2))")
+    cl.execute("SELECT create_distributed_table('t', 'k', 2)")
+    cl.copy_from("t", rows=[(1, "a", 1.25), (2, None, 3.5), (3, "c", None)])
+    out = tmp_path / "export.csv"
+    r = cl.execute(f"COPY t TO '{out}' WITH (header true)")
+    assert r.explain["copied"] == 3
+    cl.execute("CREATE TABLE t2 (k bigint NOT NULL, name text, price decimal(8,2))")
+    cl.execute("SELECT create_distributed_table('t2', 'k', 2)")
+    cl.execute(f"COPY t2 FROM '{out}' WITH (header true, null '')")
+    assert sorted(cl.execute("SELECT k, name, price FROM t2").rows) == \
+        sorted(cl.execute("SELECT k, name, price FROM t").rows)

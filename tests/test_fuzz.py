@@ -126,3 +126,59 @@ def test_fuzz_query(db, seed):
     assert len(ours) == len(theirs), sql
     for a, b in zip(ours, theirs):
         assert a == pytest.approx(b, rel=1e-6, abs=2e-3), (sql, a, b)
+
+
+@pytest.fixture(scope="module")
+def jdb(tmp_path_factory):
+    cl = ct.Cluster(str(tmp_path_factory.mktemp("jfuzz")))
+    cl.execute("CREATE TABLE big (k bigint NOT NULL, g bigint, v decimal(10,2))")
+    cl.execute("SELECT create_distributed_table('big', 'k', 4)")
+    cl.execute("CREATE TABLE small (g bigint, label text)")
+    rng = np.random.default_rng(77)
+    big = [(i, int(rng.integers(0, 30)),
+            round(float(rng.integers(0, 5000)) / 100, 2)) for i in range(2000)]
+    small = [(i, f"lab{i % 7}") for i in range(25)]
+    cl.copy_from("big", rows=big)
+    cl.copy_from("small", rows=small)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE big (k INTEGER, g INTEGER, v REAL)")
+    sq.execute("CREATE TABLE small (g INTEGER, label TEXT)")
+    sq.executemany("INSERT INTO big VALUES (?,?,?)", big)
+    sq.executemany("INSERT INTO small VALUES (?,?)", small)
+    return cl, sq
+
+
+class JoinGen:
+    def __init__(self, seed):
+        self.r = random.Random(seed)
+
+    def query(self):
+        r = self.r
+        kind = r.choice(["inner", "left", "inner", "inner"])
+        join = "JOIN" if kind == "inner" else "LEFT JOIN"
+        where = ""
+        if r.random() < 0.6:
+            where = f" WHERE b.v {r.choice(['<', '>', '<='])} {r.randint(0, 50)}"
+            if r.random() < 0.4:
+                where += f" AND s.g {r.choice(['<', '>='])} {r.randint(0, 30)}"
+        shape = r.random()
+        if shape < 0.5:
+            agg = r.choice(["count(*)", "sum(b.v)", "min(b.v)", "count(s.label)"])
+            return (f"SELECT s.label, {agg} FROM big b {join} small s "
+                    f"ON b.g = s.g{where} GROUP BY s.label")
+        if shape < 0.75:
+            return (f"SELECT count(*), sum(b.v) FROM big b {join} small s "
+                    f"ON b.g = s.g{where}")
+        return (f"SELECT b.k, s.label FROM big b {join} small s "
+                f"ON b.g = s.g{where} AND b.k < 50")
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_fuzz_join_query(jdb, seed):
+    cl, sq = jdb
+    sql = JoinGen(seed).query()
+    ours = canon(cl.execute(sql).rows)
+    theirs = canon(sq.execute(sql).fetchall())
+    assert len(ours) == len(theirs), sql
+    for a, b in zip(ours, theirs):
+        assert a == pytest.approx(b, rel=1e-6, abs=2e-3), (sql, a, b)
