@@ -30,6 +30,10 @@ from citus_tpu.schema import Column, Schema
 from citus_tpu.types import type_from_sql
 
 
+def _option_bool(v) -> bool:
+    return str(v).lower() in ("true", "1", "on")
+
+
 class Cluster:
     def __init__(self, data_dir: str, *, n_nodes: Optional[int] = None,
                  settings: Optional[Settings] = None):
@@ -244,6 +248,50 @@ class Cluster:
                 total += self.copy_from(table_name, rows=batch)
         return total
 
+    def copy_to_csv(self, table_name: str, path: str, *,
+                    delimiter: str = ",", header: bool = False,
+                    null_string: str = "") -> int:
+        """Streaming CSV export: shards are read batch by batch, decoded,
+        and written incrementally (symmetric with copy_from_csv)."""
+        import csv
+        import os as _os
+        from citus_tpu.storage import ShardReader
+        t = self.catalog.table(table_name)
+        names = t.schema.names
+        total = 0
+        with open(path, "w", newline="") as fh:
+            w = csv.writer(fh, delimiter=delimiter)
+            if header:
+                w.writerow(names)
+            for shard in t.shards:
+                d = self.catalog.shard_dir(table_name, shard.shard_id,
+                                           shard.placements[0])
+                if not _os.path.isdir(d):
+                    continue
+                reader = ShardReader(d, t.schema)
+                for batch in reader.scan(names):
+                    decoded = {}
+                    for c in names:
+                        col = t.schema.column(c)
+                        vals = batch.values[c]
+                        if col.type.is_text:
+                            decoded[c] = self.catalog.decode_strings(
+                                table_name, c, vals.tolist())
+                        else:
+                            decoded[c] = [col.type.from_physical(v.item())
+                                          for v in vals]
+                    for i in range(batch.row_count):
+                        row = []
+                        for c in names:
+                            m = batch.validity[c]
+                            if m is not None and not m[i]:
+                                row.append(null_string)
+                            else:
+                                row.append(decoded[c][i])
+                        w.writerow(row)
+                        total += 1
+        return total
+
     # -------------------------------------------------------------- SQL
     def execute(self, sql: str, params: Optional[Sequence[Any]] = None) -> Result:
         import time as _time
@@ -337,24 +385,17 @@ class Cluster:
         if isinstance(stmt, A.Insert):
             return self._execute_insert(stmt)
         if isinstance(stmt, A.CopyTo):
-            import csv
-            t = self.catalog.table(stmt.table)
-            sel = A.Select([A.SelectItem(A.Star())], from_=A.TableRef(stmt.table))
-            r = self._execute_stmt(sel)
-            header = str(stmt.options.get("header", "false")).lower() in ("true", "1", "on")
-            with open(stmt.path, "w", newline="") as fh:
-                w = csv.writer(fh, delimiter=stmt.options.get("delimiter", ","))
-                if header:
-                    w.writerow(t.schema.names)
-                for row in r.rows:
-                    w.writerow(["" if v is None else v for v in row])
-            return Result(columns=[], rows=[], explain={"copied": r.rowcount})
+            n = self.copy_to_csv(
+                stmt.table, stmt.path,
+                delimiter=stmt.options.get("delimiter", ","),
+                header=_option_bool(stmt.options.get("header", "false")),
+                null_string=stmt.options.get("null", ""))
+            return Result(columns=[], rows=[], explain={"copied": n})
         if isinstance(stmt, A.CopyFrom):
             n = self.copy_from_csv(
                 stmt.table, stmt.path,
                 delimiter=stmt.options.get("delimiter", ","),
-                header=str(stmt.options.get("header", "false")).lower()
-                in ("true", "1", "on"),
+                header=_option_bool(stmt.options.get("header", "false")),
                 null_string=stmt.options.get("null", ""))
             return Result(columns=[], rows=[], explain={"copied": n})
         if isinstance(stmt, A.Delete):

@@ -160,3 +160,18 @@ def test_copy_to_roundtrip(tmp_path):
     cl.execute(f"COPY t2 FROM '{out}' WITH (header true, null '')")
     assert sorted(cl.execute("SELECT k, name, price FROM t2").rows) == \
         sorted(cl.execute("SELECT k, name, price FROM t").rows)
+
+
+def test_copy_to_honors_null_option(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1)
+    cl.execute("CREATE TABLE t (k bigint, s text)")
+    cl.copy_from("t", rows=[(1, ""), (2, None)])
+    out = tmp_path / "e.csv"
+    cl.execute(f"COPY t TO '{out}' WITH (null 'NULLVAL')")
+    body = out.read_text()
+    assert "NULLVAL" in body
+    # roundtrip preserves the empty-string / NULL distinction
+    cl.execute("CREATE TABLE t2 (k bigint, s text)")
+    cl.execute(f"COPY t2 FROM '{out}' WITH (null 'NULLVAL')")
+    rows = dict(cl.execute("SELECT k, s FROM t2").rows)
+    assert rows[1] == "" and rows[2] is None
