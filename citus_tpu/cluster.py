@@ -322,6 +322,8 @@ class Cluster:
         return result
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
+        if isinstance(stmt, A.WithSelect):
+            return self._execute_with(stmt)
         if isinstance(stmt, A.Select):
             # recursive planning: materialize subqueries first
             from citus_tpu.planner.recursive import rewrite_subqueries
@@ -582,6 +584,68 @@ class Cluster:
         ing.finish()
         self.counters.bump("rows_ingested", total)
         return total
+
+    _CTE_SEQ = [0]
+
+    def _execute_with(self, stmt: A.WithSelect) -> Result:
+        """Materialize each CTE as a temporary local table (the
+        intermediate-result strategy of recursive_planning.c), rewrite
+        references in later CTEs and the body, execute, drop."""
+        from citus_tpu.planner.bind import bind_select
+        from citus_tpu.planner.join_planner import bind_join_select
+        mapping: dict[str, str] = {}
+        temps: list[str] = []
+
+        def remap_from(item):
+            if isinstance(item, A.TableRef):
+                if item.name in mapping:
+                    return A.TableRef(mapping[item.name], item.alias or item.name)
+                return item
+            if isinstance(item, A.Join):
+                return A.Join(remap_from(item.left), remap_from(item.right),
+                              item.kind, item.condition)
+            return item
+
+        def remap_select(sel: A.Select) -> A.Select:
+            return A.Select(sel.items, remap_from(sel.from_), sel.where,
+                            sel.group_by, sel.having, sel.order_by,
+                            sel.limit, sel.offset, sel.distinct)
+
+        try:
+            for name, sel in stmt.ctes:
+                sel = remap_select(sel)
+                # bind to learn output column types
+                if isinstance(sel.from_, A.Join):
+                    bound = bind_join_select(self.catalog, sel)
+                else:
+                    bound = bind_select(self.catalog, sel)
+                names, types, seen = [], [], set()
+                for n, e in zip(bound.output_names, bound.final_exprs):
+                    base = n or "column"
+                    cand, i = base, 1
+                    while cand in seen:
+                        i += 1
+                        cand = f"{base}_{i}"
+                    seen.add(cand)
+                    names.append(cand)
+                    types.append(e.type)
+                r = self._execute_stmt(sel)
+                self._CTE_SEQ[0] += 1
+                tmp = f"__cte_{self._CTE_SEQ[0]}_{name}"
+                self.catalog.create_table(
+                    tmp, Schema([Column(cn, ct_) for cn, ct_ in zip(names, types)]))
+                if r.rows:
+                    self.copy_from(tmp, rows=r.rows)
+                mapping[name] = tmp
+                temps.append(tmp)
+            body = remap_select(stmt.body)
+            return self._execute_stmt(body)
+        finally:
+            for tmp in temps:
+                try:
+                    self.drop_table(tmp)
+                except Exception:
+                    pass
 
     def _execute_utility(self, stmt: A.UtilityCall) -> Result:
         name, args = stmt.name, stmt.args

@@ -209,6 +209,15 @@ class SelectItem:
 
 
 @dataclass
+class WithSelect(Statement):
+    """WITH name AS (SELECT ...) [, ...] SELECT ... — each CTE
+    materializes as an intermediate result (reference: cte_inline.c /
+    recursive_planning.c materialization path)."""
+    ctes: list = field(default_factory=list)  # [(name, Select)]
+    body: "Select" = None
+
+
+@dataclass
 class Select(Statement):
     items: list[SelectItem]
     from_: Optional[object] = None   # TableRef | Join | None

@@ -439,7 +439,10 @@ def bind_select(catalog: Catalog, stmt: A.Select) -> BoundSelect:
         raise UnsupportedFeatureError("joins are handled by the join planner")
     assert isinstance(stmt.from_, A.TableRef)
     table = catalog.table(stmt.from_.name)
-    b = Binder(catalog, table)
+    # single relation: env keys stay unqualified, but qualified references
+    # through the FROM alias (or table name) must still resolve
+    alias = stmt.from_.alias or stmt.from_.name
+    b = Binder(catalog, table, rels=[(alias, table)])
 
     # expand * early
     items: list[A.SelectItem] = []

@@ -140,6 +140,8 @@ class Parser:
     def parse_statement(self) -> A.Statement:
         if self.at_kw("explain"):
             return self.parse_explain()
+        if self.at_kw("with"):
+            return self.parse_with_select()
         if self.at_kw("select"):
             return self.parse_select_or_utility()
         if self.at_kw("create"):
@@ -206,6 +208,20 @@ class Parser:
             full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())
             return A.Vacuum(self.parse_table_name(), full)
         self.error("expected a statement")
+
+    def parse_with_select(self) -> A.WithSelect:
+        self.expect_kw("with")
+        ctes = []
+        while True:
+            name = self.expect_ident()
+            self.expect_kw("as")
+            self.expect_op("(")
+            ctes.append((name, self.parse_select()))
+            self.expect_op(")")
+            if not self.accept_op(","):
+                break
+        body = self.parse_select()
+        return A.WithSelect(ctes, body)
 
     def parse_merge(self) -> A.Merge:
         self.expect_kw("merge")

@@ -1,0 +1,63 @@
+"""WITH (CTE) queries via materialized intermediate results."""
+
+import sqlite3
+
+import pytest
+
+import citus_tpu as ct
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint, s text)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    rows = [(i, i % 15, ["x", "y", "z"][i % 3]) for i in range(1000)]
+    cl.copy_from("t", rows=rows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, v INTEGER, s TEXT)")
+    sq.executemany("INSERT INTO t VALUES (?,?,?)", rows)
+    return cl, sq
+
+
+def check(db, sql):
+    cl, sq = db
+    ours = sorted(cl.execute(sql).rows, key=repr)
+    theirs = sorted(
+        [tuple(float(x) if isinstance(x, float) else x for x in r)
+         for r in sq.execute(sql).fetchall()], key=repr)
+    ours = sorted(
+        [tuple(float(x) if hasattr(x, "as_tuple") else x for x in r)
+         for r in ours], key=repr)
+    assert ours == pytest.approx(theirs)
+
+
+CTE_QUERIES = [
+    "WITH top AS (SELECT v, count(*) AS c FROM t GROUP BY v) "
+    "SELECT count(*), sum(c) FROM top",
+    "WITH f AS (SELECT k, v FROM t WHERE v > 10) "
+    "SELECT v, count(*) FROM f GROUP BY v",
+    "WITH a AS (SELECT v, count(*) AS c FROM t GROUP BY v), "
+    "b AS (SELECT c FROM a WHERE c > 60) SELECT count(*) FROM b",
+    "WITH agg AS (SELECT s, sum(v) AS sv FROM t GROUP BY s) "
+    "SELECT t2.s, t2.sv FROM agg t2 ORDER BY t2.s",
+]
+
+
+@pytest.mark.parametrize("sql", CTE_QUERIES)
+def test_cte_vs_sqlite(db, sql):
+    check(db, sql)
+
+
+def test_cte_join_with_base_table(db):
+    cl, sq = db
+    sql = ("WITH sums AS (SELECT v, sum(k) AS sk FROM t GROUP BY v) "
+           "SELECT count(*) FROM t JOIN sums ON t.v = sums.v WHERE sums.sk > 30000")
+    check(db, sql)
+
+
+def test_cte_temp_tables_are_dropped(db):
+    cl, _ = db
+    cl.execute("WITH x AS (SELECT count(*) AS c FROM t) SELECT c FROM x")
+    leftovers = [n for n in cl.catalog.tables if n.startswith("__cte_")]
+    assert leftovers == []
