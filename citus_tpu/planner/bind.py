@@ -21,8 +21,9 @@ from citus_tpu.catalog import Catalog, TableMeta
 from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
 from citus_tpu.planner import ast_nodes as A
 from citus_tpu.planner.bound import (
-    BAggRef, BBinOp, BCase, BCast, BColumn, BDateTrunc, BDictMask, BExpr,
-    BIsNull, BKeyRef, BLiteral, BScale, BUnOp, referenced_columns,
+    BAggRef, BBinOp, BCase, BCast, BColumn, BDateTrunc, BDateTruncCivil,
+    BDictMask, BExpr, BExtract, BIsNull, BKeyRef, BLiteral, BScale, BUnOp,
+    referenced_columns,
 )
 
 AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
@@ -333,7 +334,15 @@ class Binder:
             inner = self.bind_scalar(e.args[1], allow_agg)
             if inner.type.kind not in (T.DATE, T.TIMESTAMP):
                 raise AnalysisError("date_trunc expects date/timestamp")
+            if unit in ("month", "quarter", "year"):
+                return BDateTruncCivil(unit, inner, inner.type)
             return BDateTrunc(unit, inner, inner.type)
+        if name == "extract":
+            field = str(e.args[0].value).lower()
+            inner = self.bind_scalar(e.args[1], allow_agg)
+            if inner.type.kind not in (T.DATE, T.TIMESTAMP):
+                raise AnalysisError("EXTRACT expects date/timestamp")
+            return BExtract(field, inner)
         if name == "abs":
             inner = self.bind_scalar(e.args[0], allow_agg)
             return BCase(((BBinOp("<", inner, BLiteral(0, T.INT64_T) if not inner.type.is_float

@@ -126,12 +126,57 @@ class BDateTrunc(BExpr):
     type: T.ColumnType
 
 
+@dataclass(frozen=True)
+class BExtract(BExpr):
+    """EXTRACT(field FROM date/timestamp) — vectorized proleptic-Gregorian
+    calendar math on the integer day/microsecond encodings (no table
+    lookups, fully jittable)."""
+    field: str  # year | month | day | dow | doy | hour | minute | second | epoch
+    operand: BExpr
+    type: T.ColumnType = T.INT64_T
+
+
+@dataclass(frozen=True)
+class BDateTruncCivil(BExpr):
+    """date_trunc to a calendar unit (month/quarter/year) — needs civil
+    date math rather than fixed-width division."""
+    unit: str  # month | quarter | year
+    operand: BExpr
+    type: T.ColumnType
+
+
+def civil_from_days(xp, z):
+    """days-since-1970 -> (year, month, day); Hinnant's algorithm with
+    floor divisions kept positive via the era offset."""
+    z = z.astype(np.int64) + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + xp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def days_from_civil(xp, y, m, d):
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    doy = (153 * (m + xp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
 def walk(e: BExpr):
     yield e
     if isinstance(e, BBinOp):
         yield from walk(e.left)
         yield from walk(e.right)
-    elif isinstance(e, (BUnOp, BScale, BCast, BIsNull, BDictMask, BDictRemap)):
+    elif isinstance(e, (BUnOp, BScale, BCast, BIsNull, BDictMask, BDictRemap,
+                        BExtract, BDateTruncCivil)):
         yield from walk(e.operand)
     elif isinstance(e, BCase):
         for c, v in e.whens:
@@ -175,6 +220,71 @@ def compile_expr(e: BExpr, xp):
     if isinstance(e, BKeyRef):
         idx = e.index
         return lambda env: env["__keys__"][idx]
+    if isinstance(e, BExtract):
+        f = compile_expr(e.operand, xp)
+        field = e.field
+        is_ts = e.operand.type.kind == T.TIMESTAMP
+        US_DAY = np.int64(86_400_000_000)
+
+        def run_extract(env):
+            v, valid = f(env)
+            v = xp.asarray(v)
+            if is_ts:
+                days = v // US_DAY
+                rem = v - days * US_DAY
+            else:
+                days = v.astype(np.int64)
+                rem = None
+            if field == "epoch":
+                out = v.astype(np.int64) // 1_000_000 if is_ts \
+                    else days * 86_400
+                return (out, valid)
+            if field in ("hour", "minute", "second"):
+                if rem is None:
+                    return (xp.zeros_like(days), valid)
+                if field == "hour":
+                    return (rem // 3_600_000_000, valid)
+                if field == "minute":
+                    return (rem // 60_000_000 % 60, valid)
+                return (rem // 1_000_000 % 60, valid)
+            if field == "dow":  # 0=Sunday like PostgreSQL
+                return ((days + 4) % 7, valid)
+            y, m, d = civil_from_days(xp, days)
+            if field == "year":
+                return (y, valid)
+            if field == "month":
+                return (m, valid)
+            if field == "quarter":
+                return ((m - 1) // 3 + 1, valid)
+            if field == "day":
+                return (d, valid)
+            if field == "doy":
+                jan1 = days_from_civil(xp, y, xp.ones_like(m), xp.ones_like(d))
+                return (days - jan1 + 1, valid)
+            raise AnalysisError(f"EXTRACT field {field!r} not supported")
+        return run_extract
+    if isinstance(e, BDateTruncCivil):
+        f = compile_expr(e.operand, xp)
+        unit = e.unit
+        is_ts = e.operand.type.kind == T.TIMESTAMP
+        US_DAY = np.int64(86_400_000_000)
+
+        def run_trunc_civil(env):
+            v, valid = f(env)
+            v = xp.asarray(v)
+            days = (v // US_DAY) if is_ts else v.astype(np.int64)
+            y, m, d = civil_from_days(xp, days)
+            if unit == "year":
+                m = xp.ones_like(m)
+            elif unit == "quarter":
+                m = ((m - 1) // 3) * 3 + 1
+            else:  # month
+                pass
+            out_days = days_from_civil(xp, y, m, xp.ones_like(d))
+            if is_ts:
+                return (out_days * US_DAY, valid)
+            return (out_days.astype(np.int32), valid)
+        return run_trunc_civil
     if isinstance(e, BDateTrunc):
         f = compile_expr(e.operand, xp)
         if e.operand.type.kind == T.DATE:

@@ -766,6 +766,17 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
+        if t.kind == "ident" and t.value == "extract" and \
+                self.peek(1).kind == "op" and self.peek(1).value == "(":
+            self.next()
+            self.expect_op("(")
+            ft = self.next()
+            if ft.kind not in ("ident", "kw"):
+                self.error("expected EXTRACT field")
+            self.expect_kw("from")
+            inner = self.parse_expr()
+            self.expect_op(")")
+            return A.FuncCall("extract", (A.Literal(ft.value, "string"), inner))
         if t.kind == "ident":
             self.next()
             if self.at_op("("):  # function call

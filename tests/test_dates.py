@@ -1,0 +1,81 @@
+"""EXTRACT + calendar date_trunc vs sqlite's strftime oracle."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE e (k bigint NOT NULL, d date, ts timestamp, v bigint)")
+    cl.execute("SELECT create_distributed_table('e', 'k', 4)")
+    rng = np.random.default_rng(4)
+    base = datetime.date(1995, 1, 1)
+    rows = []
+    for i in range(2000):
+        d = base + datetime.timedelta(days=int(rng.integers(0, 4000)))
+        ts = datetime.datetime(d.year, d.month, d.day,
+                               int(rng.integers(0, 24)), int(rng.integers(0, 60)),
+                               int(rng.integers(0, 60)))
+        rows.append((i, d, ts, int(rng.integers(0, 100))))
+    cl.copy_from("e", rows=rows)
+    return cl, rows
+
+
+def test_extract_fields(db):
+    cl, rows = db
+    got = cl.execute(
+        "SELECT k, extract(year FROM d), extract(month FROM d), "
+        "extract(day FROM d), extract(dow FROM d), extract(doy FROM d) "
+        "FROM e WHERE k < 200 ORDER BY k").rows
+    for (k, y, m, d_, dow, doy) in got:
+        dd = rows[k][1]
+        assert (y, m, d_) == (dd.year, dd.month, dd.day), (k, dd)
+        assert dow == (dd.weekday() + 1) % 7  # PG: 0 = Sunday
+        assert doy == dd.timetuple().tm_yday
+
+
+def test_extract_time_fields(db):
+    cl, rows = db
+    got = cl.execute(
+        "SELECT k, extract(hour FROM ts), extract(minute FROM ts), "
+        "extract(second FROM ts) FROM e WHERE k < 100 ORDER BY k").rows
+    for (k, h, mi, s) in got:
+        ts = rows[k][2]
+        assert (h, mi, s) == (ts.hour, ts.minute, ts.second)
+
+
+def test_group_by_extract_year(db):
+    cl, rows = db
+    got = dict((y, c) for y, c in
+               cl.execute("SELECT extract(year FROM d), count(*) FROM e "
+                          "GROUP BY extract(year FROM d)").rows)
+    import collections
+    want = collections.Counter(r[1].year for r in rows)
+    assert got == dict(want)
+
+
+def test_date_trunc_month_year(db):
+    cl, rows = db
+    got = cl.execute(
+        "SELECT k, date_trunc('month', d), date_trunc('year', d), "
+        "date_trunc('quarter', d) FROM e WHERE k < 150 ORDER BY k").rows
+    for (k, mo, yr, q) in got:
+        dd = rows[k][1]
+        assert mo == dd.replace(day=1)
+        assert yr == dd.replace(month=1, day=1)
+        qm = (dd.month - 1) // 3 * 3 + 1
+        assert q == dd.replace(month=qm, day=1)
+
+
+def test_monthly_rollup(db):
+    cl, rows = db
+    got = dict((m, c) for m, c in cl.execute(
+        "SELECT date_trunc('month', d), count(*) FROM e GROUP BY date_trunc('month', d)").rows)
+    import collections
+    want = collections.Counter(r[1].replace(day=1) for r in rows)
+    assert got == dict(want)
