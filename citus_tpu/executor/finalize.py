@@ -17,7 +17,9 @@ import numpy as np
 
 from citus_tpu import types as T
 from citus_tpu.catalog import Catalog
-from citus_tpu.planner.bound import BColumn, BKeyRef, compile_expr, predicate_mask, walk
+from citus_tpu.planner.bound import (
+    BColumn, BDictRemap, BKeyRef, compile_expr, predicate_mask, walk,
+)
 from citus_tpu.planner.physical import AggExtract, PhysicalPlan
 
 
@@ -81,6 +83,8 @@ def default_text_src(plan):
     def resolve(e):
         if isinstance(e, BKeyRef):
             e = bound.group_keys[e.index]
+        while isinstance(e, BDictRemap):
+            e = e.operand  # remapped ids live in the operand's dictionary
         if isinstance(e, BColumn) and e.type.is_text:
             return (bound.table.name, e.name)
         return None

@@ -215,9 +215,13 @@ class _JoinPlanView:
 
 
 def _join_text_src(bj: BoundJoinSelect):
+    from citus_tpu.planner.bound import BDictRemap
+
     def resolve(e):
         if isinstance(e, BKeyRef):
             e = bj.group_keys[e.index]
+        while isinstance(e, BDictRemap):
+            e = e.operand
         if isinstance(e, BColumn) and e.type.is_text:
             return bj.binder.text_source(e)
         return None

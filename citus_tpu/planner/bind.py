@@ -343,6 +343,24 @@ class Binder:
             if inner.type.kind not in (T.DATE, T.TIMESTAMP):
                 raise AnalysisError("EXTRACT expects date/timestamp")
             return BExtract(field, inner)
+        if name in ("upper", "lower"):
+            target = self.bind_scalar(e.args[0], allow_agg)
+            if not (isinstance(target, BColumn) and target.type.is_text):
+                raise UnsupportedFeatureError(f"{name}() requires a text column")
+            from citus_tpu.planner.bound import BDictRemap
+            tname, cname = self.text_source(target)
+            words = self.catalog.dictionary(tname, cname)
+            fn = str.upper if name == "upper" else str.lower
+            mapping = tuple(int(x) for x in self.catalog.encode_strings(
+                tname, cname, [fn(w) for w in words]))
+            return BDictRemap(target, mapping)
+        if name in ("length", "char_length"):
+            target = self.bind_scalar(e.args[0], allow_agg)
+            if not (isinstance(target, BColumn) and target.type.is_text):
+                raise UnsupportedFeatureError("length() requires a text column")
+            from citus_tpu.planner.bound import BDictLookup
+            words = self.catalog.dictionary(*self.text_source(target))
+            return BDictLookup(target, tuple(len(w) for w in words))
         if name == "abs":
             inner = self.bind_scalar(e.args[0], allow_agg)
             return BCase(((BBinOp("<", inner, BLiteral(0, T.INT64_T) if not inner.type.is_float

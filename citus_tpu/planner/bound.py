@@ -94,6 +94,15 @@ class BDictRemap(BExpr):
 
 
 @dataclass(frozen=True)
+class BDictLookup(BExpr):
+    """Per-dictionary-id lookup table -> numeric value (e.g. length(s):
+    the table holds each word's length, the device just gathers)."""
+    operand: BExpr
+    table: tuple
+    type: T.ColumnType = T.INT64_T
+
+
+@dataclass(frozen=True)
 class BDictMask(BExpr):
     """Membership of a dictionary-encoded column in a precomputed id set
     (LIKE / IN over text evaluate the pattern against the table-global
@@ -176,7 +185,7 @@ def walk(e: BExpr):
         yield from walk(e.left)
         yield from walk(e.right)
     elif isinstance(e, (BUnOp, BScale, BCast, BIsNull, BDictMask, BDictRemap,
-                        BExtract, BDateTruncCivil)):
+                        BDictLookup, BExtract, BDateTruncCivil)):
         yield from walk(e.operand)
     elif isinstance(e, BCase):
         for c, v in e.whens:
@@ -352,6 +361,17 @@ def compile_expr(e: BExpr, xp):
             safe = xp.clip(ids, 0, max(n - 1, 0))
             return (mapping[safe], valid)
         return run_remap
+    if isinstance(e, BDictLookup):
+        f = compile_expr(e.operand, xp)
+        table = xp.asarray(np.array(e.table, dtype=np.int64)) if e.table \
+            else xp.zeros(1, np.int64)
+
+        def run_dictlookup(env):
+            ids, valid = f(env)
+            n = table.shape[0]
+            safe = xp.clip(ids, 0, max(n - 1, 0))
+            return (table[safe], valid)
+        return run_dictlookup
     if isinstance(e, BDictMask):
         f = compile_expr(e.operand, xp)
         table = xp.asarray(np.array(e.mask, dtype=bool))

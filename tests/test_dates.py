@@ -79,3 +79,20 @@ def test_monthly_rollup(db):
     import collections
     want = collections.Counter(r[1].replace(day=1) for r in rows)
     assert got == dict(want)
+
+
+def test_string_functions(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db2"), n_nodes=2)
+    cl.execute("CREATE TABLE s (k bigint NOT NULL, w text)")
+    cl.execute("SELECT create_distributed_table('s', 'k', 2)")
+    cl.copy_from("s", rows=[(1, "Hello"), (2, "WORLD"), (3, "ok"), (4, None)])
+    rows = dict(cl.execute("SELECT k, upper(w) FROM s").rows)
+    assert rows == {1: "HELLO", 2: "WORLD", 3: "OK", 4: None}
+    rows = dict(cl.execute("SELECT k, lower(w) FROM s").rows)
+    assert rows == {1: "hello", 2: "world", 3: "ok", 4: None}
+    rows = dict(cl.execute("SELECT k, length(w) FROM s").rows)
+    assert rows == {1: 5, 2: 5, 3: 2, 4: None}
+    # filter + group through the transforms
+    assert cl.execute("SELECT count(*) FROM s WHERE length(w) = 5").rows == [(2,)]
+    g = dict(cl.execute("SELECT upper(w), count(*) FROM s GROUP BY upper(w)").rows)
+    assert g == {"HELLO": 1, "WORLD": 1, "OK": 1, None: 1}
