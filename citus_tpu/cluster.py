@@ -747,6 +747,31 @@ class Cluster:
             copy_shard_placement(self.catalog, int(args[0]), int(args[1]), int(args[2]))
             self._plan_cache.clear()
             return Result(columns=[name], rows=[(None,)])
+        if name == "citus_split_shard_by_split_points":
+            from citus_tpu.operations.shard_split import split_shard
+            points = [int(a) for a in args[1:] if not isinstance(a, str) or a.lstrip("-").isdigit()]
+            new_ids = split_shard(self.catalog, int(args[0]), points)
+            self._plan_cache.clear()
+            return Result(columns=["new_shard_ids"], rows=[(i,) for i in new_ids])
+        if name == "isolate_tenant_to_new_shard":
+            # reference: isolate_shards.c — put one distribution-key value
+            # in its own shard by splitting around its hash
+            from citus_tpu.catalog.hashing import hash_int64_scalar, shard_index_for_hash
+            from citus_tpu.operations.shard_split import split_shard
+            import numpy as _np
+            t = self.catalog.table(args[0])
+            h = hash_int64_scalar(int(args[1]))
+            si = int(shard_index_for_hash(_np.array([h], _np.int32), t.shard_count)[0])
+            shard = t.shards[si]
+            points = []
+            if h - 1 >= shard.hash_min:
+                points.append(h - 1)
+            if h < shard.hash_max:
+                points.append(h)
+            new_ids = split_shard(self.catalog, shard.shard_id, points)
+            self._plan_cache.clear()
+            return Result(columns=["isolate_tenant_to_new_shard"],
+                          rows=[(new_ids[1 if h - 1 >= shard.hash_min else 0],)])
         if name == "citus_stat_counters":
             snap = self.counters.snapshot()
             return Result(columns=["counter", "value"],

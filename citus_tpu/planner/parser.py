@@ -460,6 +460,7 @@ class Parser:
         "citus_create_restore_point", "citus_list_restore_points",
         "alter_distributed_table", "citus_check_cluster_node_health",
         "citus_stat_tenants", "get_rebalance_progress", "citus_schemas",
+        "citus_split_shard_by_split_points", "isolate_tenant_to_new_shard",
         "citus_schema_tenant_set", "citus_schema_tenant_unset",
     }
 
@@ -484,6 +485,11 @@ class Parser:
 
     def parse_utility_arg(self):
         t = self.next()
+        if t.kind == "op" and t.value == "-":
+            nt = self.next()
+            if nt.kind != "num":
+                self.error("expected number after '-'")
+            return -(int(nt.value) if "." not in nt.value else float(nt.value))
         if t.kind == "str":
             return t.value[1:-1].replace("''", "'")
         if t.kind == "num":

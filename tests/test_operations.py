@@ -164,3 +164,58 @@ def test_remove_node_guard(tmp_path):
     cl.execute("SELECT citus_remove_node(2)")  # fresh empty node: ok
     assert 2 not in cl.catalog.nodes
     cl.close()
+
+
+def test_split_shard(tmp_path):
+    cl = make_cluster(tmp_path)
+    before = cl.execute("SELECT count(*), sum(v) FROM t").rows
+    t = cl.catalog.table("t")
+    shard = t.shards[0]
+    mid = (shard.hash_min + shard.hash_max) // 2
+    r = cl.execute(f"SELECT citus_split_shard_by_split_points({shard.shard_id}, {mid})")
+    assert r.rowcount == 2
+    t = cl.catalog.table("t")
+    assert t.shard_count == 5
+    # ranges still tile the int32 space contiguously
+    for a, b in zip(t.shards, t.shards[1:]):
+        assert b.hash_min == a.hash_max + 1
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == before
+    # router queries still find their rows
+    assert cl.execute("SELECT count(*) FROM t WHERE k = 123").rows == [(1,)]
+    from citus_tpu.operations import try_drop_orphaned_resources
+    assert try_drop_orphaned_resources(cl.catalog) >= 1
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == before
+    cl.close()
+
+
+def test_split_colocated_group(tmp_path):
+    cl = make_cluster(tmp_path)
+    cl.execute("CREATE TABLE t2 (k bigint NOT NULL, w bigint)")
+    cl.execute("SELECT create_distributed_table('t2', 'k', 4)")
+    cl.copy_from("t2", columns={"k": np.arange(3000, dtype=np.int64),
+                                "w": np.ones(3000, dtype=np.int64)})
+    join_before = cl.execute("SELECT count(*) FROM t JOIN t2 ON t.k = t2.k").rows
+    t = cl.catalog.table("t")
+    shard = t.shards[2]
+    mid = (shard.hash_min + shard.hash_max) // 2
+    cl.execute(f"SELECT citus_split_shard_by_split_points({shard.shard_id}, {mid})")
+    assert cl.catalog.table("t").shard_count == 5
+    assert cl.catalog.table("t2").shard_count == 5
+    # colocated joins still align shard-by-shard
+    assert cl.execute("SELECT count(*) FROM t JOIN t2 ON t.k = t2.k").rows == join_before
+    cl.close()
+
+
+def test_isolate_tenant(tmp_path):
+    cl = make_cluster(tmp_path)
+    r = cl.execute("SELECT isolate_tenant_to_new_shard('t', 42)")
+    new_shard = r.rows[0][0]
+    t = cl.catalog.table("t")
+    iso = [s for s in t.shards if s.shard_id == new_shard][0]
+    from citus_tpu.catalog.hashing import hash_int64_scalar
+    h = hash_int64_scalar(42)
+    assert iso.hash_min <= h <= iso.hash_max
+    assert iso.hash_max - iso.hash_min <= 1
+    assert cl.execute("SELECT count(*) FROM t WHERE k = 42").rows == [(1,)]
+    assert cl.execute("SELECT count(*) FROM t").rows == [(10000,)]
+    cl.close()
