@@ -68,7 +68,28 @@ def ensure_data(cl: "ct.Cluster") -> None:
         })
 
 
+def _arm_watchdog(seconds: float) -> None:
+    """The TPU tunnel in this environment can wedge indefinitely during
+    device initialization; fail loudly instead of hanging forever."""
+    import threading
+
+    def boom():
+        sys.stderr.write(
+            f"bench: device initialization exceeded {seconds}s "
+            "(TPU tunnel wedged?); aborting\n")
+        sys.stderr.flush()
+        os._exit(3)
+    t = threading.Timer(seconds, boom)
+    t.daemon = True
+    t.start()
+    # disarm once the device responds
+    import jax
+    jax.devices()
+    t.cancel()
+
+
 def main() -> None:
+    _arm_watchdog(300.0)
     data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_data")
     cl = ct.Cluster(data_dir)
     ensure_data(cl)
