@@ -324,6 +324,9 @@ class Cluster:
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         if isinstance(stmt, A.WithSelect):
             return self._execute_with(stmt)
+        if isinstance(stmt, A.Select) and any(
+                isinstance(i.expr, A.WindowCall) for i in stmt.items):
+            return self._execute_window(stmt)
         if isinstance(stmt, A.Select):
             # recursive planning: materialize subqueries first
             from citus_tpu.planner.recursive import rewrite_subqueries
@@ -584,6 +587,73 @@ class Cluster:
         ing.finish()
         self.counters.bump("rows_ingested", total)
         return total
+
+    def _execute_window(self, stmt: A.Select) -> Result:
+        """Window functions: run the base projection distributed, apply
+        the window pass on the coordinator (pull strategy)."""
+        from citus_tpu.executor.window import compute_window
+        if stmt.group_by or stmt.having or stmt.distinct:
+            raise UnsupportedFeatureError(
+                "window functions with GROUP BY/HAVING/DISTINCT not supported yet")
+        base_items: list[A.SelectItem] = []
+
+        def base_slot(e: A.Expr) -> int:
+            base_items.append(A.SelectItem(e, f"__w{len(base_items)}"))
+            return len(base_items) - 1
+
+        outputs = []  # ("col", slot) | ("win", func, arg_slots, part_slots, order_specs)
+        names = []
+        for i, item in enumerate(stmt.items):
+            e = item.expr
+            if isinstance(e, A.WindowCall):
+                fn = e.func.name
+                arg_slots = [base_slot(a) for a in e.func.args
+                             if not isinstance(a, A.Star)]
+                part_slots = [base_slot(p) for p in e.partition_by]
+                order_specs = [(base_slot(oe), asc) for oe, asc in e.order_by]
+                outputs.append(("win", fn, arg_slots, part_slots, order_specs))
+                names.append(item.alias or fn)
+            else:
+                outputs.append(("col", base_slot(e)))
+                names.append(item.alias or (e.name if isinstance(e, A.ColumnRef)
+                                            else f"column{i + 1}"))
+        base = A.Select(base_items, stmt.from_, stmt.where)
+        r = self._execute_stmt(base)
+        n = r.rowcount
+        cols = [[row[j] for row in r.rows] for j in range(len(base_items))]
+        out_cols = []
+        for spec in outputs:
+            if spec[0] == "col":
+                out_cols.append(cols[spec[1]])
+            else:
+                _, fn, arg_slots, part_slots, order_specs = spec
+                out_cols.append(compute_window(
+                    n, fn, [cols[s] for s in arg_slots],
+                    [cols[s] for s in part_slots],
+                    [(cols[s], asc) for s, asc in order_specs]))
+        rows = [tuple(c[i] for c in out_cols) for i in range(n)]
+        # outer ORDER BY / LIMIT over the final outputs (name or position)
+        for oi in reversed(stmt.order_by):
+            idx = None
+            if isinstance(oi.expr, A.Literal) and isinstance(oi.expr.value, int):
+                idx = oi.expr.value - 1
+            elif isinstance(oi.expr, A.ColumnRef) and oi.expr.name in names:
+                idx = names.index(oi.expr.name)
+            if idx is None or not (0 <= idx < len(names)):
+                raise AnalysisError(
+                    "ORDER BY with window functions must reference an output "
+                    "name or position")
+            nf = oi.nulls_first if oi.nulls_first is not None else (not oi.ascending)
+            nulls = [x for x in rows if x[idx] is None]
+            vals = [x for x in rows if x[idx] is not None]
+            vals.sort(key=lambda x, j=idx: x[j], reverse=not oi.ascending)
+            rows = (nulls + vals) if nf else (vals + nulls)
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit is not None:
+            rows = rows[:stmt.limit]
+        return Result(columns=names, rows=rows,
+                      explain={"strategy": "window:pull"})
 
     _CTE_SEQ = [0]
 

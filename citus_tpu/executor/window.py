@@ -1,0 +1,117 @@
+"""Window function execution.
+
+The reference delegates window functions to PostgreSQL's executor after
+its planner proves safety (pushdown when partitioned by the distribution
+column, else pull).  Here the base projection (including partition/order
+keys and window arguments) executes through the normal distributed scan,
+and the window pass runs on the coordinator — the pull strategy.
+
+Supported: row_number, rank, dense_rank, count, sum, avg, min, max OVER
+(PARTITION BY ... ORDER BY ...), with PostgreSQL's default frame (RANGE
+UNBOUNDED PRECEDING .. CURRENT ROW: running aggregates include peer
+rows; no ORDER BY -> whole partition).
+"""
+
+from __future__ import annotations
+
+import decimal
+from typing import Any
+
+from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
+
+RANKING = {"row_number", "rank", "dense_rank"}
+AGGS = {"count", "sum", "avg", "min", "max"}
+
+
+def _order_indexes(idxs: list[int], order) -> list[int]:
+    """Stable multi-key ordering honoring ASC/DESC with PG null placement
+    (nulls last for ASC, first for DESC)."""
+    out = list(idxs)
+    for vals, asc in reversed(order):
+        nulls_first = not asc
+        nulls = [i for i in out if vals[i] is None]
+        nonnull = [i for i in out if vals[i] is not None]
+        nonnull.sort(key=lambda i: vals[i], reverse=not asc)
+        out = (nulls + nonnull) if nulls_first else (nonnull + nulls)
+    return out
+
+
+def compute_window(rows_n: int, fn_name: str, args: list[list],
+                   partition: list[list], order: list[tuple[list, bool]]) -> list:
+    """Compute one window function over decoded per-row value lists.
+
+    args/partition: list of per-row value columns; order: (values, asc).
+    Returns the per-row result list in the original row order.
+    """
+    if fn_name not in RANKING | AGGS:
+        raise UnsupportedFeatureError(f"window function {fn_name}() not supported")
+    groups: dict[tuple, list[int]] = {}
+    for i in range(rows_n):
+        key = tuple(p[i] for p in partition)
+        groups.setdefault(key, []).append(i)
+    out: list[Any] = [None] * rows_n
+    for idxs in groups.values():
+        if order:
+            idxs = _order_indexes(idxs, order)
+        okeys = [tuple(vals[i] for vals, _ in order) for i in idxs] if order else None
+        if fn_name == "row_number":
+            for pos, i in enumerate(idxs):
+                out[i] = pos + 1
+            continue
+        if fn_name in ("rank", "dense_rank"):
+            rank = dense = 0
+            prev = object()
+            for pos, i in enumerate(idxs):
+                cur = okeys[pos] if okeys is not None else ()
+                if cur != prev:
+                    rank = pos + 1
+                    dense += 1
+                    prev = cur
+                out[i] = rank if fn_name == "rank" else dense
+            continue
+        # aggregates
+        col = args[0] if args else None
+        if not order:
+            vals = [col[i] for i in idxs if col is not None and col[i] is not None] \
+                if col is not None else idxs
+            agg = _agg_value(fn_name, vals, count_star=col is None, n=len(idxs))
+            for i in idxs:
+                out[i] = agg
+            continue
+        # running frame including peers: compute per peer-group prefix
+        pos = 0
+        acc: list = []
+        count_nonnull = 0
+        while pos < len(idxs):
+            end = pos
+            while end < len(idxs) and okeys[end] == okeys[pos]:
+                end += 1
+            for j in range(pos, end):
+                i = idxs[j]
+                if col is not None and col[i] is not None:
+                    acc.append(col[i])
+                    count_nonnull += 1
+            agg = _agg_value(fn_name, acc, count_star=col is None, n=end)
+            for j in range(pos, end):
+                out[idxs[j]] = agg
+            pos = end
+    return out
+
+
+def _agg_value(fn: str, vals: list, count_star: bool, n: int):
+    if fn == "count":
+        return n if count_star else len(vals)
+    if not vals:
+        return None
+    if fn == "sum":
+        return sum(vals)
+    if fn == "min":
+        return min(vals)
+    if fn == "max":
+        return max(vals)
+    if fn == "avg":
+        s = sum(vals)
+        if isinstance(s, (int, decimal.Decimal)):
+            return decimal.Decimal(s) / len(vals)
+        return s / len(vals)
+    raise AnalysisError(fn)

@@ -110,6 +110,20 @@ class CaseExpr(Expr):
 
 
 @dataclass(frozen=True)
+class WindowCall(Expr):
+    """fn(...) OVER (PARTITION BY ... ORDER BY ...)."""
+    func: "FuncCall" = None
+    partition_by: tuple = ()
+    order_by: tuple = ()  # tuple[OrderItem-like (expr, asc)]
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
+@dataclass(frozen=True)
 class Param(Expr):
     """$N placeholder bound at execute time (prepared-statement analog)."""
     index: int  # 1-based

@@ -39,7 +39,8 @@ KEYWORDS = {
     "case", "when", "then", "else", "end", "cast", "explain", "analyze",
     "using", "with", "like", "delete", "update", "set", "truncate",
     "vacuum", "copy", "alter", "add", "column", "rename", "to",
-    "schema", "cascade", "merge", "matched", "nothing", "do",
+    "schema", "cascade", "merge", "matched", "nothing", "do", "over",
+    "partition",
 }
 
 
@@ -798,7 +799,32 @@ class Parser:
                         if not self.accept_op(","):
                             break
                 self.expect_op(")")
-                return A.FuncCall(t.value, tuple(args), distinct)
+                fc = A.FuncCall(t.value, tuple(args), distinct)
+                if self.at_kw("over"):
+                    self.next()
+                    self.expect_op("(")
+                    part, order = [], []
+                    if self.accept_kw("partition"):
+                        self.expect_kw("by")
+                        while True:
+                            part.append(self.parse_expr())
+                            if not self.accept_op(","):
+                                break
+                    if self.accept_kw("order"):
+                        self.expect_kw("by")
+                        while True:
+                            e_ = self.parse_expr()
+                            asc = True
+                            if self.accept_kw("asc"):
+                                pass
+                            elif self.accept_kw("desc"):
+                                asc = False
+                            order.append((e_, asc))
+                            if not self.accept_op(","):
+                                break
+                    self.expect_op(")")
+                    return A.WindowCall(fc, tuple(part), tuple(order))
+                return fc
             if self.accept_op("."):
                 col = self.expect_ident()
                 return A.ColumnRef(col, table=t.value)

@@ -1,0 +1,59 @@
+"""Window functions vs the sqlite oracle (sqlite's default frame matches
+PostgreSQL's: RANGE UNBOUNDED PRECEDING .. CURRENT ROW)."""
+
+import decimal
+import sqlite3
+
+import pytest
+
+import citus_tpu as ct
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, g text, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    rows = [(i, ["a", "b", "c"][i % 3], (i * 7) % 23) for i in range(300)]
+    cl.copy_from("t", rows=rows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, g TEXT, v INTEGER)")
+    sq.executemany("INSERT INTO t VALUES (?,?,?)", rows)
+    return cl, sq
+
+
+def check(db, sql):
+    cl, sq = db
+    ours = sorted(
+        [tuple(float(v) if isinstance(v, decimal.Decimal) else v for v in r)
+         for r in cl.execute(sql).rows], key=repr)
+    theirs = sorted(sq.execute(sql).fetchall(), key=repr)
+    assert len(ours) == len(theirs)
+    for a, b in zip(ours, theirs):
+        assert a == pytest.approx(b, rel=1e-9, abs=1e-9), (sql, a, b)
+
+
+WINDOW_QUERIES = [
+    "SELECT k, row_number() OVER (PARTITION BY g ORDER BY k) FROM t",
+    "SELECT k, rank() OVER (PARTITION BY g ORDER BY v) FROM t",
+    "SELECT k, dense_rank() OVER (PARTITION BY g ORDER BY v) FROM t",
+    "SELECT k, sum(v) OVER (PARTITION BY g) FROM t",
+    "SELECT k, sum(v) OVER (PARTITION BY g ORDER BY k) FROM t",
+    "SELECT k, count(*) OVER (PARTITION BY g ORDER BY v) FROM t",
+    "SELECT k, min(v) OVER (PARTITION BY g ORDER BY k) FROM t",
+    "SELECT k, row_number() OVER (ORDER BY k DESC) FROM t WHERE v > 10",
+]
+
+
+@pytest.mark.parametrize("sql", WINDOW_QUERIES)
+def test_window_vs_sqlite(db, sql):
+    check(db, sql)
+
+
+def test_window_with_outer_order_limit(db):
+    cl, sq = db
+    sql = ("SELECT k, row_number() OVER (ORDER BY k) AS rn FROM t "
+           "ORDER BY rn DESC LIMIT 5")
+    ours = cl.execute(sql).rows
+    theirs = sq.execute(sql).fetchall()
+    assert ours == list(theirs)
