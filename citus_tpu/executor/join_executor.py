@@ -125,24 +125,38 @@ def _key_matrix(frame, key_exprs, n):
 
 
 def _hash_join_indexes(lmat, lvalid, rmat, rvalid, kind):
-    """Exact multi-key hash join -> (left_idx, right_idx, right_found,
-    left_found).  NULL keys never match."""
-    lkeys = {}
-    for i in np.nonzero(lvalid)[0]:
-        lkeys.setdefault(lmat[i].tobytes(), []).append(i)
-    li_out, ri_out = [], []
-    r_matched = np.zeros(len(rmat), bool)
-    l_matched = np.zeros(len(lmat), bool)
-    for j in np.nonzero(rvalid)[0]:
-        hit = lkeys.get(rmat[j].tobytes())
-        if hit:
-            r_matched[j] = True
-            for i in hit:
-                l_matched[i] = True
-                li_out.append(i)
-                ri_out.append(j)
-    li = np.array(li_out, np.int64)
-    ri = np.array(ri_out, np.int64)
+    """Exact multi-key equi-join -> (left_idx, right_idx, left_found,
+    right_found).  NULL keys never match.  Fully vectorized: both sides
+    map into one key-group id space (np.unique over the stacked key
+    matrices), left rows bucket by group, and each right row expands to
+    its bucket with a repeat/offset construction."""
+    ln, rn = len(lmat), len(rmat)
+    lsel = np.nonzero(lvalid)[0]
+    rsel = np.nonzero(rvalid)[0]
+    l_matched = np.zeros(ln, bool)
+    r_matched = np.zeros(rn, bool)
+    if lsel.size and rsel.size:
+        both = np.concatenate([lmat[lsel], rmat[rsel]], axis=0)
+        _, inv = np.unique(both, axis=0, return_inverse=True)
+        lgid = inv[: lsel.size]
+        rgid = inv[lsel.size:]
+        G = int(inv.max()) + 1
+        lcount = np.bincount(lgid, minlength=G)
+        lorder = np.argsort(lgid, kind="stable")
+        lstart = np.concatenate([[0], np.cumsum(lcount)])
+        rcnt = lcount[rgid]
+        total = int(rcnt.sum())
+        ri = np.repeat(rsel, rcnt)
+        run_starts = np.concatenate([[0], np.cumsum(rcnt)[:-1]]).astype(np.int64)
+        offs = (np.arange(total, dtype=np.int64)
+                - np.repeat(run_starts, rcnt)
+                + np.repeat(lstart[rgid], rcnt))
+        li = lsel[lorder[offs]]
+        l_matched[li] = True
+        r_matched[rsel[rcnt > 0]] = True
+    else:
+        li = np.zeros(0, np.int64)
+        ri = np.zeros(0, np.int64)
     lfound = np.ones(len(li), bool)
     rfound = np.ones(len(ri), bool)
     if kind in ("left", "full"):
