@@ -1,0 +1,19 @@
+-- smoke coverage of the SQL surface, pg_regress style
+CREATE TABLE items (id bigint NOT NULL, name text, price decimal(8,2), added date);
+SELECT create_distributed_table('items', 'id', 4);
+INSERT INTO items VALUES (1, 'hammer', 9.99, '2024-01-05'), (2, 'nail', 0.05, '2024-01-06'),
+  (3, 'saw', 19.50, '2024-02-01'), (4, NULL, 2.50, '2024-02-10'), (5, 'drill', 89.00, NULL);
+SELECT count(*), count(name), min(price), max(price) FROM items;
+SELECT name, price FROM items WHERE price > 5 ORDER BY price DESC;
+SELECT extract(month FROM added) AS m, count(*) FROM items GROUP BY extract(month FROM added) ORDER BY m NULLS LAST;
+SELECT sum(price) FROM items WHERE name LIKE '%a%';
+UPDATE items SET price = price * 2 WHERE id = 2;
+SELECT price FROM items WHERE id = 2;
+DELETE FROM items WHERE price > 50;
+SELECT count(*) FROM items;
+SELECT id, row_number() OVER (ORDER BY price DESC) AS rn FROM items WHERE price IS NOT NULL ORDER BY rn LIMIT 3;
+WITH expensive AS (SELECT id, price FROM items WHERE price > 1)
+SELECT count(*) FROM expensive;
+SELECT nope FROM items;
+SELECT count(*) FROM missing_table;
+DROP TABLE items;

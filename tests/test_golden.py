@@ -1,0 +1,76 @@
+"""pg_regress-style golden tests.
+
+Reference: src/test/regress/sql/*.sql diffed against expected/*.out via
+pg_regress_multi.pl.  Here: each tests/golden/NAME.sql runs statement by
+statement against a fresh cluster; the formatted output must match
+tests/golden/NAME.out exactly.  Regenerate with:
+    python tests/test_golden.py --regenerate
+"""
+
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CitusTpuError
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden"
+
+
+def run_script(cl, text: str) -> str:
+    from citus_tpu.planner.parser import Parser
+    out = []
+    for raw in split_statements(text):
+        out.append(f"-- {raw}")
+        try:
+            r = cl.execute(raw)
+            if r.columns:
+                out.append(" | ".join(r.columns))
+            for row in r.rows:
+                out.append(" | ".join("\\N" if v is None else str(v) for v in row))
+            if r.columns:
+                out.append(f"({r.rowcount} rows)")
+        except CitusTpuError as e:
+            out.append(f"ERROR: {type(e).__name__}")
+        out.append("")
+    return "\n".join(out)
+
+
+def split_statements(text: str) -> list[str]:
+    stmts = []
+    for chunk in text.split(";"):
+        s = "\n".join(l for l in chunk.splitlines()
+                      if not l.strip().startswith("--")).strip()
+        if s:
+            stmts.append(s)
+    return stmts
+
+
+def sql_cases():
+    return sorted(p.stem for p in GOLDEN_DIR.glob("*.sql"))
+
+
+@pytest.mark.parametrize("name", sql_cases())
+def test_golden(name, tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    got = run_script(cl, (GOLDEN_DIR / f"{name}.sql").read_text())
+    expected_path = GOLDEN_DIR / f"{name}.out"
+    assert expected_path.exists(), f"missing {expected_path}; regenerate"
+    assert got == expected_path.read_text(), f"golden mismatch for {name}"
+
+
+if __name__ == "__main__":
+    import sys
+    import tempfile
+    if "--regenerate" in sys.argv:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        for name in sql_cases():
+            cl = ct.Cluster(tempfile.mkdtemp(), n_nodes=2)
+            out = run_script(cl, (GOLDEN_DIR / f"{name}.sql").read_text())
+            (GOLDEN_DIR / f"{name}.out").write_text(out)
+            print(f"regenerated {name}.out")
