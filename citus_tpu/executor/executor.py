@@ -364,10 +364,16 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
     else:
         rows = _run_projection(cat, plan, settings)
     rows = order_and_limit(plan, rows)
+    if bound.hidden_outputs:
+        keep = len(bound.output_names) - bound.hidden_outputs
+        rows = [r[:keep] for r in rows]
     GLOBAL_COUNTERS.bump("rows_returned", len(rows))
     elapsed = time.perf_counter() - t0
+    visible = list(bound.output_names)
+    if bound.hidden_outputs:
+        visible = visible[:len(visible) - bound.hidden_outputs]
     return Result(
-        columns=list(bound.output_names),
+        columns=visible,
         rows=rows,
         explain={
             "strategy": plan.group_mode.kind if bound.has_aggs else "projection",

@@ -51,6 +51,9 @@ class BoundSelect:
     limit: Optional[int]
     offset: Optional[int]
     distinct: bool
+    # trailing final_exprs appended only for ORDER BY on non-output
+    # expressions; trimmed from the result after sorting
+    hidden_outputs: int = 0
 
     @property
     def has_aggs(self) -> bool:
@@ -509,15 +512,32 @@ def bind_select(catalog: Catalog, stmt: A.Select) -> BoundSelect:
         having = None
 
     order_by: list[tuple[int, bool, Optional[bool]]] = []
+    hidden = 0
     for oi in stmt.order_by:
-        idx = _resolve_order_ref(oi.expr, items, output_names)
+        try:
+            idx = _resolve_order_ref(oi.expr, items, output_names)
+        except AnalysisError:
+            # ORDER BY a non-output expression: append as a hidden column
+            # (PostgreSQL semantics; forbidden with DISTINCT, like PG)
+            if stmt.distinct:
+                raise AnalysisError(
+                    "for SELECT DISTINCT, ORDER BY expressions must appear "
+                    "in the select list")
+            if has_agg_funcs:
+                bound_e = b.bind_select_expr(oi.expr, key_map, aggs)
+            else:
+                bound_e = b.bind_scalar(oi.expr)
+            final_exprs.append(bound_e)
+            output_names.append(f"__order_{hidden}")
+            idx = len(final_exprs) - 1
+            hidden += 1
         order_by.append((idx, oi.ascending, oi.nulls_first))
 
     return BoundSelect(
         table=table, filter=where, group_keys=group_keys, aggs=aggs,
         final_exprs=final_exprs, output_names=output_names, having=having,
         order_by=order_by, limit=stmt.limit, offset=stmt.offset,
-        distinct=stmt.distinct,
+        distinct=stmt.distinct, hidden_outputs=hidden,
     )
 
 

@@ -126,3 +126,19 @@ def test_mesh_path_is_used(loaded):
     from citus_tpu.executor.executor import _load_all_batches
     batches = _load_all_batches(cl.catalog, plan, cl.settings)
     assert len(batches) > 1  # multi-batch -> shard_map + psum path
+
+
+def test_order_by_non_output_column(loaded):
+    cl, sq = loaded
+    sql = "SELECT kind FROM events WHERE id < 30 ORDER BY score LIMIT 10"
+    ours = cl.execute(sql)
+    theirs = sq.execute(sql).fetchall()
+    assert ours.columns == ["kind"]
+    assert ours.rows == [tuple(r) for r in theirs]
+    # grouped query ordering by an aggregate not in the output
+    sql2 = "SELECT kind FROM events GROUP BY kind ORDER BY count(*) DESC, kind NULLS LAST"
+    ours2 = cl.execute(sql2).rows
+    theirs2 = sq.execute(
+        "SELECT kind FROM events GROUP BY kind "
+        "ORDER BY count(*) DESC, kind IS NULL, kind").fetchall()
+    assert ours2 == [tuple(r) for r in theirs2]
