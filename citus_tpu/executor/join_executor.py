@@ -291,8 +291,13 @@ def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -
         rows = project_rows(view, cat, env_batches, text_src=text_src)
 
     rows = order_and_limit(view, rows)
+    visible = list(bj.output_names)
+    if bj.hidden_outputs:
+        keep = len(visible) - bj.hidden_outputs
+        visible = visible[:keep]
+        rows = [r[:keep] for r in rows]
     return Result(
-        columns=list(bj.output_names),
+        columns=visible,
         rows=rows,
         explain={
             "strategy": f"join:{bj.strategy}",

@@ -76,6 +76,7 @@ class BoundJoinSelect:
     agg_extract: list[AggExtract] = field(default_factory=list)
     strategy: str = "colocated"                 # colocated | pull
     binder: Optional[Binder] = None
+    hidden_outputs: int = 0
 
     @property
     def has_aggs(self) -> bool:
@@ -224,8 +225,19 @@ def bind_join_select(catalog: Catalog, stmt: A.Select) -> BoundJoinSelect:
             output_names.append(item.alias or _default_name(item.expr, i))
 
     order_by = []
+    hidden = 0
     for oi in stmt.order_by:
-        idx = _resolve_order(oi.expr, items, output_names, binder, final_exprs, key_map, aggs)
+        try:
+            idx = _resolve_order(oi.expr, items, output_names, binder,
+                                 final_exprs, key_map, aggs)
+        except AnalysisError:
+            if stmt.distinct:
+                raise
+            bound_e = binder.bind_select_expr(oi.expr, key_map, aggs)                 if has_aggs else binder.bind_scalar(oi.expr)
+            final_exprs.append(bound_e)
+            output_names.append(f"__order_{hidden}")
+            idx = len(final_exprs) - 1
+            hidden += 1
         order_by.append((idx, oi.ascending, oi.nulls_first))
 
     agg_args, partial_ops, agg_extract = lower_aggregates(aggs)
@@ -260,6 +272,9 @@ def bind_join_select(catalog: Catalog, stmt: A.Select) -> BoundJoinSelect:
             note_columns(e)
     if having is not None:
         note_columns(having)
+    # (hidden ORDER BY columns were appended to final_exprs above and are
+    # covered by the loop when not aggregating; grouped hidden outputs
+    # reference keys/aggs already noted)
 
     bj = BoundJoinSelect(
         rels=rels, rel_plans=rel_plans, steps=steps, post_filter=post_filter,
@@ -267,7 +282,7 @@ def bind_join_select(catalog: Catalog, stmt: A.Select) -> BoundJoinSelect:
         output_names=output_names, having=having, order_by=order_by,
         limit=stmt.limit, offset=stmt.offset, distinct=stmt.distinct,
         agg_args=agg_args, partial_ops=partial_ops, agg_extract=agg_extract,
-        binder=binder,
+        binder=binder, hidden_outputs=hidden,
     )
     bj.strategy = _choose_strategy(bj)
     return bj

@@ -132,3 +132,14 @@ def test_qualified_star_and_ambiguity(db):
     with pytest.raises(AnalysisError):
         cl.execute("SELECT o_orderkey FROM orders o JOIN lineitem l ON o.o_orderkey = l.l_orderkey "
                    "JOIN orders o2 ON o2.o_orderkey = l.l_orderkey")
+
+
+def test_join_order_by_non_output(db):
+    cl, sq = db
+    sql = ("SELECT o.o_orderkey FROM orders o JOIN lineitem l "
+           "ON o.o_orderkey = l.l_orderkey WHERE o.o_orderkey < 30 "
+           "ORDER BY l.l_qty, o.o_orderkey LIMIT 10")
+    ours = cl.execute(sql)
+    theirs = sq.execute(sql).fetchall()
+    assert ours.columns == ["o_orderkey"]
+    assert ours.rows == [tuple(r) for r in theirs]
