@@ -437,6 +437,7 @@ class Catalog:
                 uniq, inverse = np.unique(arr[nn].astype(str), return_inverse=True)
                 uid = np.empty(len(uniq), dtype=np.int64)
                 for i, w in enumerate(uniq):
+                    w = str(w)  # plain str, not np.str_ (decode returns these)
                     j = index.get(w)
                     if j is None:
                         j = len(words)

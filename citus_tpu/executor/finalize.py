@@ -85,8 +85,15 @@ def default_text_src(plan):
             e = bound.group_keys[e.index]
         while isinstance(e, BDictRemap):
             e = e.operand  # remapped ids live in the operand's dictionary
-        if isinstance(e, BColumn) and e.type.is_text:
+        if not e.type.is_text:
+            return None
+        if isinstance(e, BColumn):
             return (bound.table.name, e.name)
+        # composite text expr (CASE/coalesce): ids come from the first
+        # text column referenced inside it
+        for n in walk(e):
+            if isinstance(n, BColumn) and n.type.is_text:
+                return (bound.table.name, n.name)
         return None
     return resolve
 

@@ -232,12 +232,18 @@ def _join_text_src(bj: BoundJoinSelect):
     from citus_tpu.planner.bound import BDictRemap
 
     def resolve(e):
+        from citus_tpu.planner.bound import walk
         if isinstance(e, BKeyRef):
             e = bj.group_keys[e.index]
         while isinstance(e, BDictRemap):
             e = e.operand
-        if isinstance(e, BColumn) and e.type.is_text:
+        if not e.type.is_text:
+            return None
+        if isinstance(e, BColumn):
             return bj.binder.text_source(e)
+        for n in walk(e):
+            if isinstance(n, BColumn) and n.type.is_text:
+                return bj.binder.text_source(n)
         return None
     return resolve
 

@@ -301,6 +301,17 @@ class Binder:
             parts = BLiteral(0, T.BOOL_T)
         return BUnOp("not", parts, T.BOOL_T) if e.negated else parts
 
+    def _bind_case_from_bound(self, whens, else_, out: T.ColumnType) -> BExpr:
+        """CASE over already-bound branches with scale alignment."""
+        if out.is_decimal:
+            whens = tuple(
+                (c, self._rescale(v, out.scale)
+                 if (v.type.is_decimal or v.type.is_integer) else v)
+                for c, v in whens)
+            if else_ is not None and (else_.type.is_decimal or else_.type.is_integer):
+                else_ = self._rescale(else_, out.scale)
+        return BCase(tuple(whens), else_, out)
+
     def _bind_case(self, e: A.CaseExpr, allow_agg: bool) -> BExpr:
         whens = [(self._to_bool(self.bind_scalar(c, allow_agg)), self.bind_scalar(v, allow_agg))
                  for c, v in e.whens]
@@ -364,6 +375,33 @@ class Binder:
             from citus_tpu.planner.bound import BDictLookup
             words = self.catalog.dictionary(*self.text_source(target))
             return BDictLookup(target, tuple(len(w) for w in words))
+        if name == "coalesce":
+            if not e.args:
+                raise AnalysisError("coalesce() requires arguments")
+            bound = [self.bind_scalar(a, allow_agg) for a in e.args]
+            # text branches: encode raw string literals into the dictionary
+            # of the first text column argument
+            text_col = next((x for x in bound
+                             if isinstance(x, BColumn) and x.type.is_text), None)
+            if text_col is not None:
+                tname, cname = self.text_source(text_col)
+                bound = [BLiteral(int(self.catalog.encode_strings(
+                             tname, cname, [x.value])[0]), T.TEXT_T)
+                         if isinstance(x, BLiteral) and isinstance(x.value, str)
+                         else x for x in bound]
+            out = bound[0].type
+            for x in bound[1:]:
+                out = T.common_super_type(out, x.type)
+            whens = tuple((BIsNull(x, negated=True), x) for x in bound[:-1])
+            return self._bind_case_from_bound(whens, bound[-1], out)
+        if name == "nullif":
+            if len(e.args) != 2:
+                raise AnalysisError("nullif() requires two arguments")
+            a = self.bind_scalar(e.args[0], allow_agg)
+            bdy = self.bind_scalar(e.args[1], allow_agg)
+            a2, b2 = self._align(a, bdy)
+            cond = BBinOp("=", a2, b2, T.BOOL_T)
+            return BCase(((cond, BLiteral(None, a.type)),), a, a.type)
         if name == "abs":
             inner = self.bind_scalar(e.args[0], allow_agg)
             return BCase(((BBinOp("<", inner, BLiteral(0, T.INT64_T) if not inner.type.is_float
@@ -487,7 +525,17 @@ def bind_select(catalog: Catalog, stmt: A.Select) -> BoundSelect:
     if where is not None and where.type.kind != T.BOOL:
         raise AnalysisError("WHERE must be boolean")
 
-    group_keys = [b.bind_scalar(g) for g in stmt.group_by]
+    # GROUP BY ordinals (GROUP BY 1, 2) refer to select-list positions
+    group_exprs = []
+    for g in stmt.group_by:
+        if isinstance(g, A.Literal) and g.type_name == "int":
+            idx = int(g.value) - 1
+            if not (0 <= idx < len(items)):
+                raise AnalysisError(f"GROUP BY position {g.value} out of range")
+            group_exprs.append(items[idx].expr)
+        else:
+            group_exprs.append(g)
+    group_keys = [b.bind_scalar(g) for g in group_exprs]
     key_map = {k: i for i, k in enumerate(group_keys)}
 
     has_agg_funcs = any(_contains_agg(i.expr) for i in items) or \

@@ -142,3 +142,16 @@ def test_order_by_non_output_column(loaded):
         "SELECT kind FROM events GROUP BY kind "
         "ORDER BY count(*) DESC, kind IS NULL, kind").fetchall()
     assert ours2 == [tuple(r) for r in theirs2]
+
+
+def test_coalesce_nullif_group_ordinals(loaded):
+    cl, sq = loaded
+    for sql in [
+        "SELECT count(*) FROM events WHERE coalesce(device, 99) = 99",
+        "SELECT coalesce(kind, 'none'), count(*) FROM events GROUP BY 1",
+        "SELECT count(*) FROM events WHERE nullif(device, 7) IS NULL",
+        "SELECT device, count(*) FROM events GROUP BY 1 ORDER BY 2 DESC LIMIT 5",
+    ]:
+        ours = sorted(canon(cl.execute(sql).rows), key=repr)
+        theirs = sorted(canon(sq.execute(sql).fetchall()), key=repr)
+        assert ours == theirs, sql
