@@ -341,6 +341,17 @@ class Cluster:
                                    from_=None, where=stmt.where)
                 rew = rewrite_subqueries(wrapped, lambda sub: self._execute_stmt(sub))
                 stmt = A.Delete(stmt.table, rew.where)
+        if isinstance(stmt, A.Update):
+            from citus_tpu.planner.recursive import has_subquery, rewrite_subqueries
+            exprs = [e for _, e in stmt.assignments] +                 ([stmt.where] if stmt.where is not None else [])
+            if any(has_subquery(e) for e in exprs):
+                items = [A.SelectItem(e) for _, e in stmt.assignments]
+                wrapped = A.Select(items or [A.SelectItem(A.Literal(1, "int"))],
+                                   from_=None, where=stmt.where)
+                rew = rewrite_subqueries(wrapped, lambda sub: self._execute_stmt(sub))
+                new_assignments = [(c, it.expr) for (c, _), it in
+                                   zip(stmt.assignments, rew.items)]                     if stmt.assignments else []
+                stmt = A.Update(stmt.table, new_assignments, rew.where)
         if isinstance(stmt, A.Select) and isinstance(stmt.from_, A.Join):
             from citus_tpu.executor.join_executor import execute_join_select
             from citus_tpu.planner.join_planner import bind_join_select

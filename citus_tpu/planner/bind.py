@@ -368,6 +368,38 @@ class Binder:
             mapping = tuple(int(x) for x in self.catalog.encode_strings(
                 tname, cname, [fn(w) for w in words]))
             return BDictRemap(target, mapping)
+        if name == "substring":
+            target = self.bind_scalar(e.args[0], allow_agg)
+            if not (isinstance(target, BColumn) and target.type.is_text):
+                raise UnsupportedFeatureError("substring() requires a text column")
+            if not all(isinstance(a, A.Literal) for a in e.args[1:]):
+                raise UnsupportedFeatureError("substring() bounds must be literals")
+            start = int(e.args[1].value) if len(e.args) > 1 else 1
+            ln = int(e.args[2].value) if len(e.args) > 2 else None
+            from citus_tpu.planner.bound import BDictRemap
+            tname, cname = self.text_source(target)
+            words = self.catalog.dictionary(tname, cname)
+            i0 = max(start - 1, 0)
+            cut = [w[i0:i0 + ln] if ln is not None else w[i0:] for w in words]
+            mapping = tuple(int(x) for x in self.catalog.encode_strings(tname, cname, cut))
+            return BDictRemap(target, mapping)
+        if name == "concat":
+            bound = [self.bind_scalar(a, allow_agg) for a in e.args]
+            cols = [x for x in bound if isinstance(x, BColumn) and x.type.is_text]
+            if len(cols) != 1 or not all(
+                    (isinstance(x, BLiteral) and isinstance(x.value, str)) or x is cols[0]
+                    for x in bound):
+                raise UnsupportedFeatureError(
+                    "concat() supports one text column plus string literals")
+            from citus_tpu.planner.bound import BDictRemap
+            tname, cname = self.text_source(cols[0])
+            words = self.catalog.dictionary(tname, cname)
+            out_words = []
+            for w in words:
+                parts = [x.value if isinstance(x, BLiteral) else w for x in bound]
+                out_words.append("".join(parts))
+            mapping = tuple(int(x) for x in self.catalog.encode_strings(tname, cname, out_words))
+            return BDictRemap(cols[0], mapping)
         if name in ("length", "char_length"):
             target = self.bind_scalar(e.args[0], allow_agg)
             if not (isinstance(target, BColumn) and target.type.is_text):

@@ -96,3 +96,24 @@ def test_string_functions(tmp_path):
     assert cl.execute("SELECT count(*) FROM s WHERE length(w) = 5").rows == [(2,)]
     g = dict(cl.execute("SELECT upper(w), count(*) FROM s GROUP BY upper(w)").rows)
     assert g == {"HELLO": 1, "WORLD": 1, "OK": 1, None: 1}
+
+
+def test_substring_concat(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db3"), n_nodes=1)
+    cl.execute("CREATE TABLE s (k bigint, w text)")
+    cl.copy_from("s", rows=[(1, "hello"), (2, "hi"), (3, None)])
+    assert dict(cl.execute("SELECT k, substring(w, 2, 3) FROM s").rows) == \
+        {1: "ell", 2: "i", 3: None}
+    assert dict(cl.execute("SELECT k, concat('<', w, '>') FROM s").rows) == \
+        {1: "<hello>", 2: "<hi>", 3: None}
+
+
+def test_update_with_subquery(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db4"), n_nodes=1)
+    cl.execute("CREATE TABLE t (k bigint, v bigint)")
+    cl.execute("CREATE TABLE u (x bigint)")
+    cl.copy_from("t", rows=[(i, i) for i in range(10)])
+    cl.copy_from("u", rows=[(3,), (5,)])
+    cl.execute("UPDATE t SET v = (SELECT max(x) FROM u) WHERE k IN (SELECT x FROM u)")
+    rows = dict(cl.execute("SELECT k, v FROM t").rows)
+    assert rows[3] == 5 and rows[5] == 5 and rows[4] == 4
