@@ -1,0 +1,21 @@
+-- joins + DML + utilities coverage
+CREATE TABLE orders (oid bigint NOT NULL, cid bigint, total decimal(10,2));
+CREATE TABLE customers (cid bigint, name text);
+SELECT create_distributed_table('orders', 'oid', 4);
+SELECT create_reference_table('customers');
+INSERT INTO orders VALUES (1, 10, 100.00), (2, 20, 250.50), (3, 10, 75.25), (4, 30, 10.00);
+INSERT INTO customers VALUES (10, 'ann'), (20, 'bo'), (30, 'cy');
+SELECT c.name, sum(o.total) FROM orders o JOIN customers c ON o.cid = c.cid GROUP BY c.name ORDER BY c.name;
+SELECT count(*) FROM orders o LEFT JOIN customers c ON o.cid = c.cid;
+UPDATE orders SET total = total + 1 WHERE oid = 4;
+SELECT total FROM orders WHERE oid = 4;
+MERGE INTO orders t USING orders s ON t.oid = s.oid WHEN MATCHED AND t.oid = 1 THEN UPDATE SET total = 999.99;
+SELECT total FROM orders WHERE oid = 1;
+DELETE FROM orders WHERE cid = 10;
+SELECT count(*) FROM orders;
+SELECT count(*) FROM customers WHERE name LIKE '%n%';
+WITH big AS (SELECT oid FROM orders WHERE total > 100)
+SELECT count(*) FROM big;
+SELECT bool_check FROM orders;
+DROP TABLE orders;
+DROP TABLE customers;

@@ -155,3 +155,26 @@ def test_coalesce_nullif_group_ordinals(loaded):
         ours = sorted(canon(cl.execute(sql).rows), key=repr)
         theirs = sorted(canon(sq.execute(sql).fetchall()), key=repr)
         assert ours == theirs, sql
+
+
+def test_having_without_group_by(loaded):
+    cl, sq = loaded
+    for sql in [
+        "SELECT count(*) FROM events HAVING count(*) > 10",
+        "SELECT count(*) FROM events HAVING count(*) > 1000000",
+    ]:
+        ours = cl.execute(sql).rows
+        theirs = sq.execute(sql).fetchall()
+        assert ours == [tuple(r) for r in theirs], sql
+
+
+def test_boolean_column_end_to_end(tmp_path_factory):
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path_factory.mktemp("booldb")), n_nodes=2)
+    cl.execute("CREATE TABLE b (k bigint NOT NULL, flag boolean, v bigint)")
+    cl.execute("SELECT create_distributed_table('b', 'k', 2)")
+    cl.execute("INSERT INTO b VALUES (1, true, 10), (2, false, 20), (3, true, 30), (4, NULL, 40)")
+    assert cl.execute("SELECT count(*) FROM b WHERE flag").rows == [(2,)]
+    assert cl.execute("SELECT count(*) FROM b WHERE NOT flag").rows == [(1,)]
+    rows = sorted(cl.execute("SELECT flag, sum(v) FROM b GROUP BY flag").rows, key=repr)
+    assert rows == sorted([(True, 40), (False, 20), (None, 40)], key=repr)
