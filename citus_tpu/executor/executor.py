@@ -251,7 +251,10 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
     backend = settings.executor.task_executor_backend
     acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
 
-    if backend != "cpu":
+    # distinct partial states are exact value sets: only the host
+    # accumulation path can carry them
+    has_distinct = any(op.kind == "distinct" for op in plan.partial_ops)
+    if backend != "cpu" and not has_distinct:
         import jax
         import jax.numpy as jnp
         from citus_tpu.ops.hash_agg import build_hash_agg_worker, merge_hash_tables_into
@@ -293,7 +296,8 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
                            for v, m in keys],
                           [(np.asarray(v), m if isinstance(m, bool) else np.asarray(m))
                            for v, m in args])
-    key_arrays, partials = acc.finalize([k.type for k in plan.bound.group_keys])
+    key_arrays, partials = acc.finalize([k.type for k in plan.bound.group_keys],
+                                        scalar=not plan.bound.group_keys)
     if partials is None:
         return []
     return finalize_groups(plan, cat, key_arrays, partials)

@@ -27,7 +27,10 @@ def extract_aggs(plan: PhysicalPlan, partials: tuple) -> list[tuple[np.ndarray, 
     """Partial-op arrays -> per-SQL-aggregate (values, valid) arrays."""
     out = []
     for ex in plan.agg_extract:
-        if ex.kind in ("count", "count_star"):
+        if ex.kind == "count_distinct":
+            v = np.asarray(partials[ex.slots[0]], dtype=np.int64)
+            out.append((v, np.ones(v.shape, bool)))
+        elif ex.kind in ("count", "count_star"):
             v = np.asarray(partials[ex.slots[0]], dtype=np.int64)
             out.append((v, np.ones(v.shape, bool)))
         elif ex.kind == "sum":

@@ -31,8 +31,12 @@ class HostGroupAccumulator:
         row = []
         for op in self.partial_ops:
             dt = np.dtype(op.dtype)
-            row.append(dt.type(_sentinel(op.kind, dt)) if op.kind in ("min", "max")
-                       else dt.type(0))
+            if op.kind == "distinct":
+                row.append(set())
+            elif op.kind in ("min", "max"):
+                row.append(dt.type(_sentinel(op.kind, dt)))
+            else:
+                row.append(dt.type(0))
         self._accs.append(row)
         return idx
 
@@ -81,6 +85,13 @@ class HostGroupAccumulator:
         local = []
         for op in self.partial_ops:
             dt = np.dtype(op.dtype)
+            if op.kind == "distinct":
+                v, ok = arg_np[op.arg_index]
+                sets = [set() for _ in range(L)]
+                for r in np.nonzero(ok)[0]:
+                    sets[inverse[r]].add(v[r].item())
+                local.append(sets)
+                continue
             if op.kind == "count":
                 a = np.zeros(L, np.int64)
                 ok = arg_np[op.arg_index][1] if op.arg_index >= 0 else np.ones(sel.size, bool)
@@ -106,7 +117,9 @@ class HostGroupAccumulator:
                 gi = self._new_group(kvs)
                 self._groups[kb] = gi
             for pi, op in enumerate(self.partial_ops):
-                if op.kind in ("sum", "count"):
+                if op.kind == "distinct":
+                    self._accs[gi][pi] |= local[pi][li]
+                elif op.kind in ("sum", "count"):
                     self._accs[gi][pi] += local[pi][li]
                 elif op.kind == "min":
                     self._accs[gi][pi] = min(self._accs[gi][pi], local[pi][li])
@@ -167,7 +180,8 @@ class HostGroupAccumulator:
             valid = np.array([kvs[ki][1] for kvs in self._key_vals], dtype=bool)
             key_arrays.append((vals, valid))
         partials = tuple(
-            np.array([self._accs[g][pi] for g in range(G)],
+            np.array([len(self._accs[g][pi]) if self.partial_ops[pi].kind == "distinct"
+                      else self._accs[g][pi] for g in range(G)],
                      dtype=np.dtype(self.partial_ops[pi].dtype))
             for pi in range(len(self.partial_ops)))
         return key_arrays, partials

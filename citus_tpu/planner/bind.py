@@ -34,6 +34,7 @@ class AggSpec:
     kind: str              # sum | count | count_star | avg | min | max
     arg: Optional[BExpr]   # None for count_star
     out_type: T.ColumnType
+    distinct: bool = False
 
 
 @dataclass
@@ -472,15 +473,17 @@ class Binder:
         """Bind an output/having expression of a grouped query: aggregates
         become BAggRef slots, grouping-key subexpressions become BKeyRef."""
         if isinstance(e, A.FuncCall) and e.name in AGG_FUNCS:
-            if e.distinct:
-                raise UnsupportedFeatureError("DISTINCT aggregates not supported yet")
+            if e.distinct and e.name not in ("count",):
+                raise UnsupportedFeatureError(
+                    f"DISTINCT is only supported for count() yet, not {e.name}()")
             if e.name == "count" and (not e.args or isinstance(e.args[0], A.Star)):
                 spec = AggSpec("count_star", None, T.INT64_T)
             else:
                 if len(e.args) != 1:
                     raise AnalysisError(f"{e.name}() expects one argument")
                 arg = self.bind_scalar(e.args[0])
-                spec = AggSpec(e.name, arg, self._agg_output_type(e.name, arg))
+                spec = AggSpec(e.name, arg, self._agg_output_type(e.name, arg),
+                               distinct=e.distinct)
             for i, existing in enumerate(aggs):
                 if existing == spec:
                     return BAggRef(i, spec.out_type)

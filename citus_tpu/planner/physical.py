@@ -214,6 +214,11 @@ def _key_domain(cat: Catalog, table: TableMeta, key: BExpr,
 
 
 def choose_group_mode(cat: Catalog, bound: BoundSelect, direct_limit: int) -> GroupMode:
+    # distinct aggregates need exact value sets: only the host grouping
+    # path carries them (reference: worker_partial_agg cannot combine
+    # DISTINCT either and falls back to pulling rows)
+    if any(a.distinct for a in bound.aggs):
+        return GroupMode(kind="hash_host")
     if not bound.group_keys:
         return GroupMode(kind="scalar")
     bounds = column_bounds(cat, bound.table)
@@ -269,7 +274,10 @@ def lower_aggregates(aggs: list[AggSpec]) -> tuple[list[BExpr], list[PartialOp],
             continue
         ai = arg_slot(spec.arg)
         acc_dtype = "float64" if spec.arg.type.is_float else "int64"
-        if spec.kind == "count":
+        if spec.kind == "count" and spec.distinct:
+            s = partial_slot("distinct", ai, "int64")
+            extracts.append(AggExtract("count_distinct", [s], spec.out_type))
+        elif spec.kind == "count":
             s = partial_slot("count", ai, "int64")
             extracts.append(AggExtract("count", [s], spec.out_type))
         elif spec.kind == "sum":

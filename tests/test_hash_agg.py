@@ -82,3 +82,27 @@ def test_group_by_float32_column(tmp_path):
     with settings_override(executor=ExecutorSettings(task_executor_backend="cpu")):
         cpu = cl.execute("SELECT f, count(*) FROM t GROUP BY f").rows
     assert sorted(rows) == sorted(cpu)
+
+
+def test_count_distinct(tmp_path):
+    import sqlite3
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, g text, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    rows = [(i, ["a", "b", None][i % 3], (i * 3) % 17 if i % 5 else None)
+            for i in range(2000)]
+    cl.copy_from("t", rows=rows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, g TEXT, v INTEGER)")
+    sq.executemany("INSERT INTO t VALUES (?,?,?)", rows)
+    for sql in [
+        "SELECT count(DISTINCT v) FROM t",
+        "SELECT g, count(DISTINCT v), count(*) FROM t GROUP BY g",
+        "SELECT count(DISTINCT v) FROM t WHERE k < 100",
+        "SELECT count(DISTINCT g) FROM t",
+    ]:
+        ours = sorted(cl.execute(sql).rows, key=repr)
+        theirs = sorted(sq.execute(sql).fetchall(), key=repr)
+        assert ours == [tuple(r) for r in theirs], sql
+    # empty input still yields one scalar row
+    assert cl.execute("SELECT count(DISTINCT v) FROM t WHERE k < 0").rows == [(0,)]
