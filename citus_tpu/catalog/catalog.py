@@ -185,6 +185,12 @@ class Catalog:
                 fh.flush()
                 os.fsync(fh.fileno())
             os.replace(tmp, self._path())
+            # remember our own write so coordinators in this process don't
+            # treat it as a foreign metadata change (see MX reload)
+            try:
+                self.self_mtime = os.path.getmtime(self._path())
+            except OSError:
+                pass
             for (tbl, col), words in self._dicts.items():
                 dp = self._dict_path(tbl, col)
                 tmp = dp + ".tmp"

@@ -106,24 +106,32 @@ class Cluster:
         """Pick up metadata written by other coordinators sharing this
         data dir (the query-from-any-node / MX analog: any process can
         plan and execute once metadata is synced; reference:
-        metadata/metadata_sync.c)."""
+        metadata/metadata_sync.c).  Writes made by THIS process must not
+        trigger a reload: concurrent sessions hold references into the
+        live catalog, and reloading underneath them (clear + load) is a
+        read-tear race — the analog of the reference only invalidating
+        on foreign syscache invalidations."""
         import os
         p = self.catalog._path()
         try:
             mtime = os.path.getmtime(p)
         except OSError:
             return
+        if mtime == getattr(self.catalog, "self_mtime", None):
+            self._catalog_mtime = mtime
+            return
         if getattr(self, "_catalog_mtime", None) is None:
             self._catalog_mtime = mtime
             return
         if mtime != self._catalog_mtime:
             self._catalog_mtime = mtime
-            self.catalog.tables.clear()
-            self.catalog.nodes.clear()
-            self.catalog._dicts.clear()
-            self.catalog._dict_index.clear()
-            self.catalog._load()
-            self.catalog.ddl_epoch += 1  # invalidate cached plans
+            with self.catalog._lock:
+                self.catalog.tables.clear()
+                self.catalog.nodes.clear()
+                self.catalog._dicts.clear()
+                self.catalog._dict_index.clear()
+                self.catalog._load()
+                self.catalog.ddl_epoch += 1  # invalidate cached plans
             self._plan_cache.clear()
 
     # ------------------------------------------------------------- DDL
