@@ -131,6 +131,25 @@ class NodeMeta:
         return NodeMeta(d["node_id"], d["is_active"])
 
 
+def _catalog_flock(data_dir: str):
+    """Cross-process serialization of catalog/dictionary writes (two
+    coordinators may share one data dir — the MX analog).  Guards every
+    read-merge-store of the dictionary side files and the catalog
+    document store itself."""
+    from citus_tpu.utils.filelock import FileLock
+    return FileLock(os.path.join(data_dir, ".catalog.lock"))
+
+
+def _stat_sig(path: str):
+    """(st_mtime_ns, st_size) change signature — mtime alone can miss a
+    foreign write landing within one timestamp tick."""
+    try:
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+
+
 class Catalog:
     FILE = "catalog.json"
 
@@ -147,6 +166,7 @@ class Catalog:
         self.ddl_epoch = 0
         self._dicts: dict[tuple[str, str], list[str]] = {}
         self._dict_index: dict[tuple[str, str], dict[str, int]] = {}
+        self._dict_sig: dict[tuple[str, str], Optional[tuple]] = {}
         # tenant schemas: name -> {"colocation_id": int, "home_node": int}
         self.schemas: dict[str, dict] = {}
         self._load()
@@ -171,7 +191,7 @@ class Catalog:
         """Atomically persist catalog state (round-1 metadata transaction)."""
         from citus_tpu.testing.faults import FAULTS
         FAULTS.hit("catalog_commit")
-        with self._lock:
+        with self._lock, _catalog_flock(self.data_dir):
             d = {
                 "tables": [t.to_json() for t in self.tables.values()],
                 "nodes": [n.to_json() for n in self.nodes.values()],
@@ -191,12 +211,9 @@ class Catalog:
                 self.self_mtime = os.path.getmtime(self._path())
             except OSError:
                 pass
-            for (tbl, col), words in self._dicts.items():
-                dp = self._dict_path(tbl, col)
-                tmp = dp + ".tmp"
-                with open(tmp, "w") as fh:
-                    json.dump(words, fh)
-                os.replace(tmp, dp)
+            # dictionaries are persisted (fsync'd) by encode_strings at
+            # growth time, before any commit record can reference their
+            # ids — nothing to write here
 
     # ---- tables -------------------------------------------------------
     def table(self, name: str) -> TableMeta:
@@ -425,11 +442,47 @@ class Catalog:
                 words = json.load(fh)
         self._dicts[key] = words
         self._dict_index[key] = {w: i for i, w in enumerate(words)}
+        self._dict_sig[key] = _stat_sig(p)
+
+    def _merge_disk_dict(self, table: str, column: str) -> None:
+        """Adopt words another coordinator appended to the on-disk
+        dictionary since we last read/wrote it.  Growth is append-only
+        and always happens under the catalog flock, so the disk file is
+        a strict extension of what we hold."""
+        key = (table, column)
+        p = self._dict_path(table, column)
+        sig = _stat_sig(p)
+        if sig is None or sig == self._dict_sig.get(key):
+            return
+        with open(p) as fh:
+            disk = json.load(fh)
+        words, index = self._dicts[key], self._dict_index[key]
+        for w in disk[len(words):]:
+            index.setdefault(w, len(words))
+            words.append(w)
+        self._dict_sig[key] = sig
+
+    def _store_dict(self, table: str, column: str) -> None:
+        key = (table, column)
+        dp = self._dict_path(table, column)
+        tmp = dp + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._dicts[key], fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, dp)
+        self._dict_sig[key] = _stat_sig(dp)
 
     def encode_strings(self, table: str, column: str, values):
         """Map strings -> table-global dictionary ids, growing the
         dictionary for unseen strings (ingest path, coordinator-only).
-        Vectorized: unique the batch once, dict-lookup only the uniques."""
+        Vectorized: unique the batch once, dict-lookup only the uniques.
+
+        Growth runs under the cross-process catalog lock with a
+        read-merge before assignment and an fsync'd store after, so two
+        coordinators ingesting into one table can never assign the same
+        id to different words, and every id handed out is durable before
+        any transaction commit record can reference it."""
         import numpy as np
         with self._lock:
             key = (table, column)
@@ -439,18 +492,25 @@ class Catalog:
             nulls = np.array([v is None for v in arr], dtype=bool)
             out = np.zeros(len(arr), dtype=np.int64)
             nn = ~nulls
-            if nn.any():
-                uniq, inverse = np.unique(arr[nn].astype(str), return_inverse=True)
-                uid = np.empty(len(uniq), dtype=np.int64)
-                for i, w in enumerate(uniq):
-                    w = str(w)  # plain str, not np.str_ (decode returns these)
-                    j = index.get(w)
-                    if j is None:
-                        j = len(words)
-                        words.append(w)
-                        index[w] = j
-                    uid[i] = j
-                out[nn] = uid[inverse]
+            if not nn.any():
+                return out
+            uniq, inverse = np.unique(arr[nn].astype(str), return_inverse=True)
+            uid = np.empty(len(uniq), dtype=np.int64)
+            fresh = [w for w in (str(w) for w in uniq) if w not in index]
+            if fresh:
+                with _catalog_flock(self.data_dir):
+                    self._merge_disk_dict(table, column)
+                    grew = False
+                    for w in fresh:
+                        if w not in index:
+                            index[w] = len(words)
+                            words.append(w)
+                            grew = True
+                    if grew:
+                        self._store_dict(table, column)
+            for i, w in enumerate(uniq):
+                uid[i] = index[str(w)]  # plain str, not np.str_
+            out[nn] = uid[inverse]
             return out
 
     def lookup_string_id(self, table: str, column: str, value: str) -> Optional[int]:

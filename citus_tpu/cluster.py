@@ -101,6 +101,38 @@ class Cluster:
             self._background_jobs.stop()
         if self._maintenance is not None:
             self._maintenance.stop()
+        # release the transaction-log owner marker: our undecided
+        # transactions become recoverable by other coordinators
+        self.txlog.close()
+
+    def _write_lock(self, table_meta, mode: str):
+        """Serialize writers on a table's colocation group (the analog of
+        LockShardResource / SerializeNonCommutativeWrites,
+        utils/resource_lock.c): EXCLUSIVE for UPDATE/DELETE/MERGE/
+        TRUNCATE/VACUUM (their scan→bitmap→re-insert sequences are not
+        commutative), SHARED for append-only ingest.  Shard moves take
+        EXCLUSIVE on the same resource across their final catch-up, so a
+        writer can never commit into a placement being retired."""
+        import contextlib
+        import threading as _threading
+
+        @contextlib.contextmanager
+        def _ctx():
+            sid = _threading.get_ident()
+            res = (f"coloc:{table_meta.colocation_id}"
+                   if table_meta.colocation_id else f"table:{table_meta.name}")
+            held = self.locks.holds(sid, res)
+            from citus_tpu.transaction.locks import EXCLUSIVE
+            if held == EXCLUSIVE or held == mode:
+                yield  # re-entrant: outer frame owns the lock
+                return
+            self.locks.acquire(sid, res, mode,
+                               timeout=self.settings.executor.lock_timeout_s)
+            try:
+                yield
+            finally:
+                self.locks.release(sid, res)
+        return _ctx()
 
     def _maybe_reload_catalog(self) -> None:
         """Pick up metadata written by other coordinators sharing this
@@ -130,6 +162,7 @@ class Cluster:
                 self.catalog.nodes.clear()
                 self.catalog._dicts.clear()
                 self.catalog._dict_index.clear()
+                self.catalog._dict_sig.clear()
                 self.catalog._load()
                 self.catalog.ddl_epoch += 1  # invalidate cached plans
             self._plan_cache.clear()
@@ -194,13 +227,15 @@ class Cluster:
         if rows is not None:
             columns = rows_to_columns(t.schema.names, rows, column_names)
         values, validity = encode_columns(self.catalog, t, columns)
-        ing = TableIngestor(self.catalog, t, txlog=self.txlog)
-        try:
-            ing.append(values, validity)
-        except BaseException:
-            ing.abort()
-            raise
-        ing.finish()
+        from citus_tpu.transaction.locks import SHARED
+        with self._write_lock(t, SHARED):
+            ing = TableIngestor(self.catalog, t, txlog=self.txlog)
+            try:
+                ing.append(values, validity)
+            except BaseException:
+                ing.abort()
+                raise
+            ing.finish()
         n = len(next(iter(values.values()))) if values else 0
         self.counters.bump("rows_ingested", n)
         if self.cdc.enabled and n:
@@ -428,7 +463,9 @@ class Cluster:
             t = self.catalog.table(stmt.table)
             where = Binder(self.catalog, t).bind_scalar(stmt.where) \
                 if stmt.where is not None else None
-            n = execute_delete(self.catalog, self.txlog, t, where)
+            from citus_tpu.transaction.locks import EXCLUSIVE
+            with self._write_lock(t, EXCLUSIVE):
+                n = execute_delete(self.catalog, self.txlog, t, where)
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain={"deleted": n})
         if isinstance(stmt, A.Update):
@@ -455,7 +492,9 @@ class Cluster:
                     bound = BCast(bound, target.type)
                 assignments.append((col, bound))
             where = b.bind_scalar(stmt.where) if stmt.where is not None else None
-            n = execute_update(self.catalog, self.txlog, t, assignments, where)
+            from citus_tpu.transaction.locks import EXCLUSIVE
+            with self._write_lock(t, EXCLUSIVE):
+                n = execute_update(self.catalog, self.txlog, t, assignments, where)
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain={"updated": n})
         if isinstance(stmt, A.AlterTable):
@@ -476,20 +515,28 @@ class Cluster:
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Merge):
             from citus_tpu.executor.merge_executor import execute_merge
-            st = execute_merge(
-                self.catalog, self.txlog, stmt,
-                encode_value=lambda tbl, col, v:
-                    int(self.catalog.encode_strings(tbl, col, [v])[0]))
+            from citus_tpu.transaction.locks import EXCLUSIVE
+            with self._write_lock(self.catalog.table(stmt.target.name), EXCLUSIVE):
+                st = execute_merge(
+                    self.catalog, self.txlog, stmt,
+                    encode_value=lambda tbl, col, v:
+                        int(self.catalog.encode_strings(tbl, col, [v])[0]))
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.Truncate):
             from citus_tpu.executor.dml import execute_truncate
-            execute_truncate(self.catalog, self.catalog.table(stmt.table))
+            from citus_tpu.transaction.locks import EXCLUSIVE
+            t = self.catalog.table(stmt.table)
+            with self._write_lock(t, EXCLUSIVE):
+                execute_truncate(self.catalog, t)
             self._plan_cache.clear()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Vacuum):
             from citus_tpu.executor.dml import execute_vacuum
-            st = execute_vacuum(self.catalog, self.catalog.table(stmt.table))
+            from citus_tpu.transaction.locks import EXCLUSIVE
+            t = self.catalog.table(stmt.table)
+            with self._write_lock(t, EXCLUSIVE):
+                st = execute_vacuum(self.catalog, t)
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.UtilityCall):
@@ -565,9 +612,34 @@ class Cluster:
         plan = plan_select(self.catalog, bound,
                            direct_limit=self.settings.planner.direct_gid_limit)
         from citus_tpu.executor.batches import load_shard_batches
+        from citus_tpu.transaction.locks import SHARED
         fns = [compile_expr(e, np) for e in final_exprs]
         ffn = compile_expr(bound.filter, np) if bound.filter is not None else None
+        with self._write_lock(target, SHARED):
+            return self._run_insert_select_arrays(
+                target, bound, plan, fns, ffn, names)
+
+    def _run_insert_select_arrays(self, target, bound, plan, fns, ffn,
+                                  names) -> int:
+        from citus_tpu.executor.batches import load_shard_batches
+        from citus_tpu.planner.bound import predicate_mask
         ing = TableIngestor(self.catalog, target, txlog=self.txlog)
+        try:
+            total = self._stream_insert_select(ing, target, bound, plan,
+                                               fns, ffn, names)
+        except BaseException:
+            ing.abort()  # failure during scan/append: staged files dropped
+            raise
+        # finish() manages its own failure path (releases the xid so
+        # recovery decides; aborting here could roll back a logged COMMIT)
+        ing.finish()
+        self.counters.bump("rows_ingested", total)
+        return total
+
+    def _stream_insert_select(self, ing, target, bound, plan, fns, ffn,
+                              names) -> int:
+        from citus_tpu.executor.batches import load_shard_batches
+        from citus_tpu.planner.bound import predicate_mask
         total = 0
         for si in plan.shard_indexes:
             for values, masks, n in load_shard_batches(
@@ -603,8 +675,6 @@ class Cluster:
                         out_m[cname] = np.zeros(idx.size, bool)
                 ing.append(out_v, out_m)
                 total += idx.size
-        ing.finish()
-        self.counters.bump("rows_ingested", total)
         return total
 
     def _execute_window(self, stmt: A.Select) -> Result:

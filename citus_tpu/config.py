@@ -62,6 +62,10 @@ class ExecutorSettings:
     # of the XLA one-hot formulation (off by default; both are exact and
     # tested to agree).
     use_pallas: bool = False
+    # Seconds a writer waits for a shard/colocation write lock before
+    # erroring (analog of lock_timeout; deadlocks are detected and
+    # cancelled immediately regardless).
+    lock_timeout_s: float = 30.0
 
 
 @dataclass

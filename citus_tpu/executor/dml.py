@@ -85,33 +85,41 @@ def execute_delete(cat: Catalog, txlog: TransactionLog, table: TableMeta,
     shard_indexes = prune_shards(table, where)
     columns = _where_columns(table, where)
     xid = txlog.begin()
-    staged_dirs = []
-    total = 0
-    for d in _placement_dirs(cat, table, shard_indexes):
-        merged, _ = _matched_rows_per_stripe(cat, table, d, where, columns)
-        if not merged:
-            continue
-        stage_deletes(d, xid, merged)
-        staged_dirs.append(d)
-        # count once per shard (placements are replicas)
-    # count distinct rows on primary placements only
-    for si in shard_indexes:
-        shard = table.shards[si]
-        d = cat.shard_dir(table.name, shard.shard_id, shard.placements[0])
-        if os.path.isdir(d):
+    try:
+        staged_dirs = []
+        total = 0
+        for d in _placement_dirs(cat, table, shard_indexes):
             merged, _ = _matched_rows_per_stripe(cat, table, d, where, columns)
-            total += sum(len(ix) for ix, _ in merged.values())
-    if not staged_dirs:
-        return 0
-    txlog.log(xid, TxState.PREPARED,
-              {"kind": "delete", "table": table.name, "placements": staged_dirs})
-    txlog.log(xid, TxState.COMMITTED, {"table": table.name})
-    for d in staged_dirs:
-        commit_staged_deletes(d, xid)
-    table.version += 1
-    cat.commit()
-    txlog.log(xid, TxState.DONE)
-    return total
+            if not merged:
+                continue
+            stage_deletes(d, xid, merged)
+            staged_dirs.append(d)
+            # count once per shard (placements are replicas)
+        # count distinct rows on primary placements only
+        for si in shard_indexes:
+            shard = table.shards[si]
+            d = cat.shard_dir(table.name, shard.shard_id, shard.placements[0])
+            if os.path.isdir(d):
+                merged, _ = _matched_rows_per_stripe(cat, table, d, where, columns)
+                total += sum(len(ix) for ix, _ in merged.values())
+        if not staged_dirs:
+            txlog.release(xid)
+            return 0
+        # catalog persisted before the commit record (durability ordering:
+        # a roll-forward must find every id/version it references on disk)
+        table.version += 1
+        cat.commit()
+        txlog.log(xid, TxState.PREPARED,
+                  {"kind": "delete", "table": table.name, "placements": staged_dirs})
+        txlog.log(xid, TxState.COMMITTED, {"table": table.name})
+        for d in staged_dirs:
+            commit_staged_deletes(d, xid)
+        txlog.log(xid, TxState.DONE)
+        return total
+    except BaseException:
+        # stop driving the transaction; recovery decides its outcome
+        txlog.release(xid)
+        raise
 
 
 def _where_columns(table: TableMeta, where: Optional[BExpr]) -> list[str]:
@@ -132,6 +140,19 @@ def execute_update(cat: Catalog, txlog: TransactionLog, table: TableMeta,
     shard_indexes = prune_shards(table, where)
     all_columns = table.schema.names
     xid = txlog.begin()
+    try:
+        return _execute_update_tx(cat, txlog, table, assignments, where,
+                                  shard_indexes, all_columns, xid)
+    except BaseException:
+        # stop driving the transaction; recovery decides its outcome
+        txlog.release(xid)
+        raise
+
+
+def _execute_update_tx(cat, txlog, table, assignments, where,
+                       shard_indexes, all_columns, xid) -> int:
+    from citus_tpu.ingest import TableIngestor
+
     staged_delete_dirs = []
     new_values = {c: [] for c in all_columns}
     new_valid = {c: [] for c in all_columns}
@@ -178,6 +199,7 @@ def execute_update(cat: Catalog, txlog: TransactionLog, table: TableMeta,
                     m = batch.validity[c]
                     new_valid[c].append(np.ones(idx.size, bool) if m is None else m[idx])
     if total == 0:
+        txlog.release(xid)
         return 0
     values = {c: np.concatenate(new_values[c]).astype(table.schema.column(c).type.storage_dtype)
               for c in all_columns}
@@ -189,6 +211,9 @@ def execute_update(cat: Catalog, txlog: TransactionLog, table: TableMeta,
     for w in ing._writers.values():
         w.flush()
     ingest_dirs = [w.directory for w in ing._writers.values()]
+    # catalog persisted before the commit record (durability ordering)
+    table.version += 1
+    cat.commit()
     txlog.log(xid, TxState.PREPARED,
               {"kind": "update", "table": table.name,
                "placements": staged_delete_dirs, "ingest_placements": ingest_dirs})
@@ -200,8 +225,6 @@ def execute_update(cat: Catalog, txlog: TransactionLog, table: TableMeta,
         commit_staged_deletes(d, xid)
     for d in ingest_dirs:
         commit_staged(d, xid)
-    table.version += 1
-    cat.commit()
     txlog.log(xid, TxState.DONE)
     return total
 

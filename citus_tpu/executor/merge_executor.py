@@ -99,6 +99,19 @@ def execute_merge(cat: Catalog, txlog: TransactionLog, stmt: A.Merge,
     src_matched = np.zeros(src_n, bool)
 
     xid = txlog.begin()
+    try:
+        return _execute_merge_tx(
+            cat, txlog, target, xid, src_frame, src_n, smat, svalid,
+            src_matched, binder, t_alias, t_keys, mw, nw, encode_value)
+    except BaseException:
+        # stop driving the transaction; recovery decides its outcome
+        txlog.release(xid)
+        raise
+
+
+def _execute_merge_tx(cat, txlog, target, xid, src_frame, src_n,
+                      smat, svalid, src_matched, binder, t_alias, t_keys,
+                      mw, nw, encode_value) -> dict:
     staged_delete_dirs: list[str] = []
     insert_rows = {c: [] for c in target.schema.names}
     insert_valid = {c: [] for c in target.schema.names}
@@ -272,7 +285,11 @@ def execute_merge(cat: Catalog, txlog: TransactionLog, stmt: A.Merge,
         ingest_dirs = [w.directory for w in ing._writers.values()]
 
     if not staged_delete_dirs and not ingest_dirs:
+        txlog.release(xid)
         return {"updated": 0, "deleted": 0, "inserted": 0}
+    # catalog persisted before the commit record (durability ordering)
+    target.version += 1
+    cat.commit()
     txlog.log(xid, TxState.PREPARED,
               {"kind": "update", "table": target.name,
                "placements": staged_delete_dirs, "ingest_placements": ingest_dirs})
@@ -283,7 +300,5 @@ def execute_merge(cat: Catalog, txlog: TransactionLog, stmt: A.Merge,
         commit_staged_deletes(d, xid)
     for d in ingest_dirs:
         commit_staged(d, xid)
-    target.version += 1
-    cat.commit()
     txlog.log(xid, TxState.DONE)
     return {"updated": n_updated, "deleted": n_deleted, "inserted": n_inserted}

@@ -120,24 +120,35 @@ class TableIngestor:
         from citus_tpu.storage.writer import commit_staged
         from citus_tpu.transaction.manager import TxState
 
-        total = 0
-        for w in self._writers.values():
-            total += w._buf_rows
-            w.flush()
-        if self.txlog is not None:
-            dirs = [w.directory for w in self._writers.values()]
-            self.txlog.log(self.xid, TxState.PREPARED,
-                           {"kind": "ingest", "table": self.table.name,
-                            "placements": dirs})
-            self.txlog.log(self.xid, TxState.COMMITTED,
-                           {"table": self.table.name})
-            for d in dirs:
-                commit_staged(d, self.xid)
-        self.table.version += 1  # invalidate cached plans/statistics
-        self.cat.commit()  # persist grown text dictionaries + version
-        if self.txlog is not None:
-            self.txlog.log(self.xid, TxState.DONE)
-        return total
+        try:
+            total = 0
+            for w in self._writers.values():
+                total += w._buf_rows
+                w.flush()
+            # persist the catalog (version bump; dictionaries are already
+            # fsync'd at encode time) BEFORE the COMMITTED record: a
+            # crash-recovery roll-forward must never flip stripes live
+            # whose dictionary ids exceed the persisted dictionary.
+            # Catalog/dictionary growth is monotonic, so persisting early
+            # is safe even if the transaction aborts below.
+            self.table.version += 1  # invalidate cached plans/statistics
+            self.cat.commit()
+            if self.txlog is not None:
+                dirs = [w.directory for w in self._writers.values()]
+                self.txlog.log(self.xid, TxState.PREPARED,
+                               {"kind": "ingest", "table": self.table.name,
+                                "placements": dirs})
+                self.txlog.log(self.xid, TxState.COMMITTED,
+                               {"table": self.table.name})
+                for d in dirs:
+                    commit_staged(d, self.xid)
+                self.txlog.log(self.xid, TxState.DONE)
+            return total
+        except BaseException:
+            # stop driving the transaction; recovery decides its outcome
+            if self.txlog is not None:
+                self.txlog.release(self.xid)
+            raise
 
     def abort(self) -> None:
         """Roll back a transactional ingest (drops staged stripes)."""

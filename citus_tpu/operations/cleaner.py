@@ -6,6 +6,15 @@ never delete the old files inline — they record a cleanup entry that the
 maintenance daemon (or an explicit call) processes later, so concurrent
 readers holding the old placement finish safely and failed operations
 can't leak half-moved state.
+
+The record file is shared by the maintenance daemon thread, foreground
+calls, and (in MX setups) other coordinator processes, so every
+load-mutate-store runs under a cross-process file lock.  Policies follow
+the reference's CLEANUP_* semantics: ALWAYS entries are dropped on every
+pass; ON_FAILURE entries are dropped only once their operation is marked
+failed (a crashed operation's entries are adopted by the next pass via
+the operation registry); DEFERRED_ON_SUCCESS entries are recorded after
+the operation succeeded and dropped on the next pass.
 """
 
 from __future__ import annotations
@@ -23,6 +32,11 @@ CLEANUP_FILE = "cleanup.json"
 ALWAYS = "always"                 # drop whether the op succeeded or failed
 ON_FAILURE = "on_failure"         # drop only if the op failed
 DEFERRED_ON_SUCCESS = "deferred_on_success"  # drop after the op succeeded
+
+
+def _cleanup_flock(cat: Catalog):
+    from citus_tpu.utils.filelock import FileLock
+    return FileLock(os.path.join(cat.data_dir, ".cleanup.lock"))
 
 
 def _path(cat: Catalog) -> str:
@@ -46,32 +60,57 @@ def _store(cat: Catalog, records: list[dict]) -> None:
 
 def record_cleanup(cat: Catalog, resource_path: str, policy: str = DEFERRED_ON_SUCCESS,
                    operation_id: int = 0) -> None:
-    records = _load(cat)
-    records.append({
-        "path": resource_path, "policy": policy,
-        "operation_id": operation_id, "recorded_at": time.time(),
-    })
-    _store(cat, records)
+    with _cleanup_flock(cat):
+        records = _load(cat)
+        records.append({
+            "path": resource_path, "policy": policy,
+            "operation_id": operation_id, "recorded_at": time.time(),
+        })
+        _store(cat, records)
+
+
+def complete_operation(cat: Catalog, operation_id: int, success: bool) -> None:
+    """Resolve ON_FAILURE records: a successful operation's entries are
+    discarded (the resources are now live data); a failed operation's
+    entries become unconditionally droppable."""
+    with _cleanup_flock(cat):
+        records = _load(cat)
+        out = []
+        for r in records:
+            if r["policy"] == ON_FAILURE and r["operation_id"] == operation_id:
+                if success:
+                    continue  # resource promoted to live data
+                r = dict(r, policy=ALWAYS)
+            out.append(r)
+        _store(cat, out)
 
 
 def pending_cleanup(cat: Catalog) -> list[dict]:
-    return _load(cat)
+    with _cleanup_flock(cat):
+        return _load(cat)
 
 
 def try_drop_orphaned_resources(cat: Catalog) -> int:
-    """Drop every recorded resource; returns how many were removed.
-    Safe to call repeatedly (the maintenance daemon does)."""
-    records = _load(cat)
-    remaining, dropped = [], 0
-    for r in records:
-        p = r["path"]
-        try:
-            if os.path.isdir(p):
-                shutil.rmtree(p)
-            elif os.path.exists(p):
-                os.remove(p)
-            dropped += 1
-        except OSError:
-            remaining.append(r)  # retry next cycle
-    _store(cat, remaining)
-    return dropped
+    """Drop every droppable recorded resource; returns how many were
+    removed.  Safe to call repeatedly and concurrently (the maintenance
+    daemon does)."""
+    with _cleanup_flock(cat):
+        records = _load(cat)
+        remaining, dropped = [], 0
+        for r in records:
+            if r["policy"] == ON_FAILURE:
+                remaining.append(r)  # operation outcome not yet resolved
+                continue
+            p = r["path"]
+            try:
+                if os.path.isdir(p):
+                    shutil.rmtree(p)
+                elif os.path.exists(p):
+                    os.remove(p)
+                dropped += 1
+            except FileNotFoundError:
+                dropped += 1  # someone else removed it: success
+            except OSError:
+                remaining.append(r)  # retry next cycle
+        _store(cat, remaining)
+        return dropped

@@ -119,6 +119,12 @@ class LockManager:
                     self._waiting_for.pop(session_id, None)
                     res.waiters = [(s, m) for s, m in res.waiters if s != session_id]
 
+    def holds(self, session_id: int, resource: str) -> Optional[str]:
+        """Mode this session currently holds on the resource, if any."""
+        with self._mu:
+            res = self._resources.get(resource)
+            return None if res is None else res.holders.get(session_id)
+
     def release(self, session_id: int, resource: str) -> None:
         with self._mu:
             res = self._resources.get(resource)

@@ -1,0 +1,28 @@
+"""Cross-process file lock (flock) used to serialize shared-data-dir
+mutations between coordinator processes — the single implementation
+behind catalog commits, dictionary growth, the transaction log, and the
+cleanup registry.  Re-entrant within a context-manager instance only;
+create one per critical section."""
+
+from __future__ import annotations
+
+import os
+
+
+class FileLock:
+    def __init__(self, path: str):
+        self._path = path
+        self._fd = None
+
+    def __enter__(self):
+        import fcntl
+        self._fd = os.open(self._path, os.O_CREAT | os.O_RDWR)
+        fcntl.flock(self._fd, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        import fcntl
+        fcntl.flock(self._fd, fcntl.LOCK_UN)
+        os.close(self._fd)
+        self._fd = None
+        return False

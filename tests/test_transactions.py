@@ -71,6 +71,7 @@ def test_recovery_rolls_back_prepared(tmp_path):
     dirs = [w.directory for w in ing._writers.values()]
     cl.txlog.log(ing.xid, TxState.PREPARED,
                  {"kind": "ingest", "table": "t", "placements": dirs})
+    cl.close()  # release the owner marker, as a real crash would
     # reopen: recovery must roll the transaction back
     cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
     assert cl2.execute("SELECT count(*) FROM t").rows == [(0,)]
@@ -86,6 +87,7 @@ def test_recovery_rolls_forward_committed(tmp_path):
                  {"kind": "ingest", "table": "t", "placements": dirs})
     cl.txlog.log(ing.xid, TxState.COMMITTED,
                  {"kind": "ingest", "table": "t", "placements": dirs})
+    cl.close()  # release the owner marker, as a real crash would
     cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
     assert cl2.execute("SELECT count(*) FROM t").rows == [(1000,)]
     assert cl2.txlog.outstanding() == []
@@ -95,6 +97,7 @@ def test_recovery_sweeps_unprepared_staged_files(tmp_path):
     """Coordinator dies mid-write, before any log record."""
     cl = make_cluster(tmp_path)
     _staged_ingest(cl)  # staged, never prepared
+    cl.close()  # release the owner marker, as a real crash would
     cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
     assert cl2.execute("SELECT count(*) FROM t").rows == [(0,)]
     # staged files swept
