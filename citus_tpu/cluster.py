@@ -76,7 +76,8 @@ class Cluster:
             from citus_tpu.services import BackgroundJobRunner
             r = BackgroundJobRunner(self.catalog)
             r.register("move_shard", lambda shard_id, source, target:
-                       move_shard_placement(self.catalog, shard_id, source, target))
+                       move_shard_placement(self.catalog, shard_id, source, target,
+                                            lock_manager=self.locks))
             r.start()
             self._background_jobs = r
         return self._background_jobs
@@ -110,28 +111,22 @@ class Cluster:
         LockShardResource / SerializeNonCommutativeWrites,
         utils/resource_lock.c): EXCLUSIVE for UPDATE/DELETE/MERGE/
         TRUNCATE/VACUUM (their scan→bitmap→re-insert sequences are not
-        commutative), SHARED for append-only ingest.  Shard moves take
-        EXCLUSIVE on the same resource across their final catch-up, so a
-        writer can never commit into a placement being retired."""
+        commutative), SHARED for append-only ingest.  Shard moves/splits
+        take EXCLUSIVE on the same resource across their final catch-up
+        and metadata flip, so a writer can never commit into a placement
+        being retired.  Two-layer (thread LockManager + process flock);
+        after acquisition the catalog is refreshed so a writer that
+        waited out a foreign mover sees the flipped placements."""
         import contextlib
-        import threading as _threading
 
         @contextlib.contextmanager
         def _ctx():
-            sid = _threading.get_ident()
-            res = (f"coloc:{table_meta.colocation_id}"
-                   if table_meta.colocation_id else f"table:{table_meta.name}")
-            held = self.locks.holds(sid, res)
-            from citus_tpu.transaction.locks import EXCLUSIVE
-            if held == EXCLUSIVE or held == mode:
-                yield  # re-entrant: outer frame owns the lock
-                return
-            self.locks.acquire(sid, res, mode,
-                               timeout=self.settings.executor.lock_timeout_s)
-            try:
+            from citus_tpu.transaction.write_locks import group_write_lock
+            with group_write_lock(self.catalog, table_meta, mode,
+                                  lock_manager=self.locks,
+                                  timeout=self.settings.executor.lock_timeout_s):
+                self._maybe_reload_catalog()
                 yield
-            finally:
-                self.locks.release(sid, res)
         return _ctx()
 
     def _maybe_reload_catalog(self) -> None:
@@ -229,6 +224,7 @@ class Cluster:
         values, validity = encode_columns(self.catalog, t, columns)
         from citus_tpu.transaction.locks import SHARED
         with self._write_lock(t, SHARED):
+            t = self.catalog.table(table_name)  # re-fetch: fresh placements
             ing = TableIngestor(self.catalog, t, txlog=self.txlog)
             try:
                 ing.append(values, validity)
@@ -465,6 +461,7 @@ class Cluster:
                 if stmt.where is not None else None
             from citus_tpu.transaction.locks import EXCLUSIVE
             with self._write_lock(t, EXCLUSIVE):
+                t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
                 n = execute_delete(self.catalog, self.txlog, t, where)
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain={"deleted": n})
@@ -494,6 +491,7 @@ class Cluster:
             where = b.bind_scalar(stmt.where) if stmt.where is not None else None
             from citus_tpu.transaction.locks import EXCLUSIVE
             with self._write_lock(t, EXCLUSIVE):
+                t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
                 n = execute_update(self.catalog, self.txlog, t, assignments, where)
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain={"updated": n})
@@ -528,7 +526,7 @@ class Cluster:
             from citus_tpu.transaction.locks import EXCLUSIVE
             t = self.catalog.table(stmt.table)
             with self._write_lock(t, EXCLUSIVE):
-                execute_truncate(self.catalog, t)
+                execute_truncate(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Vacuum):
@@ -536,7 +534,7 @@ class Cluster:
             from citus_tpu.transaction.locks import EXCLUSIVE
             t = self.catalog.table(stmt.table)
             with self._write_lock(t, EXCLUSIVE):
-                st = execute_vacuum(self.catalog, t)
+                st = execute_vacuum(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.UtilityCall):
@@ -867,7 +865,8 @@ class Cluster:
             return Result(columns=["citus_remove_node"], rows=[(None,)])
         if name == "citus_move_shard_placement":
             from citus_tpu.operations import move_shard_placement
-            move_shard_placement(self.catalog, int(args[0]), int(args[1]), int(args[2]))
+            move_shard_placement(self.catalog, int(args[0]), int(args[1]),
+                                 int(args[2]), lock_manager=self.locks)
             self._plan_cache.clear()
             return Result(columns=[name], rows=[(None,)])
         if name == "get_rebalance_table_shards_plan":
@@ -909,7 +908,8 @@ class Cluster:
         if name == "citus_split_shard_by_split_points":
             from citus_tpu.operations.shard_split import split_shard
             points = [int(a) for a in args[1:] if not isinstance(a, str) or a.lstrip("-").isdigit()]
-            new_ids = split_shard(self.catalog, int(args[0]), points)
+            new_ids = split_shard(self.catalog, int(args[0]), points,
+                                  lock_manager=self.locks)
             self._plan_cache.clear()
             return Result(columns=["new_shard_ids"], rows=[(i,) for i in new_ids])
         if name == "isolate_tenant_to_new_shard":
@@ -927,7 +927,8 @@ class Cluster:
                 points.append(h - 1)
             if h < shard.hash_max:
                 points.append(h)
-            new_ids = split_shard(self.catalog, shard.shard_id, points)
+            new_ids = split_shard(self.catalog, shard.shard_id, points,
+                                  lock_manager=self.locks)
             self._plan_cache.clear()
             return Result(columns=["isolate_tenant_to_new_shard"],
                           rows=[(new_ids[1 if h - 1 >= shard.hash_min else 0],)])

@@ -25,11 +25,26 @@ from citus_tpu.storage import ShardReader, ShardWriter
 
 
 def split_shard(cat: Catalog, shard_id: int, split_points: list[int],
-                target_nodes: list[int] | None = None) -> list[int]:
+                target_nodes: list[int] | None = None,
+                lock_manager=None) -> list[int]:
     """Split a hash shard at ``split_points`` (inclusive upper bounds of
     the leading sub-ranges).  Returns the new shard ids of the first
-    table in the colocation group."""
+    table in the colocation group.
+
+    Blocking split (reference: BlockingShardSplit, shard_split.c:554):
+    the data redistribution reads a point-in-time snapshot, so writers
+    are excluded for the whole redistribute + flip via the colocation
+    group's write lock."""
+    from citus_tpu.transaction.write_locks import EXCLUSIVE, group_write_lock
+
     table, shard = _find_shard(cat, shard_id)
+    with group_write_lock(cat, table, EXCLUSIVE, lock_manager=lock_manager):
+        return _split_shard_locked(cat, table, shard, shard_id, split_points,
+                                   target_nodes)
+
+
+def _split_shard_locked(cat, table, shard, shard_id, split_points,
+                        target_nodes) -> list[int]:
     if not table.is_distributed:
         raise CatalogError("can only split shards of hash-distributed tables")
     lo, hi = shard.hash_min, shard.hash_max

@@ -7,21 +7,29 @@ metadata flip, deferred drop) collapses here because shard data files
 are immutable-append and the catalog is the single source of truth:
 
   1. copy the placement's stripe files to the target placement dir
-  2. catch up any stripes appended during the copy (re-list + copy diff)
-  3. flip the placement in the catalog (atomic commit)
-  4. record the source directory for deferred cleanup
+     (bulk phase — writers keep writing)
+  2. under the colocation group's EXCLUSIVE write lock: final catch-up
+     copy, then flip the placement in the catalog (atomic commit) —
+     the lock blocks writers for only the diff copy + flip, like the
+     reference's global-metadata-lock window (README:2560-2565)
+  3. record the source directory for deferred cleanup
 
-Colocated shards move together, like the reference.
+Colocated shards move together, like the reference.  Half-copied target
+directories of a failed move are registered ON_FAILURE so the cleaner
+removes them.
 """
 
 from __future__ import annotations
 
 import os
 import shutil
+import time
 
 from citus_tpu.catalog import Catalog
 from citus_tpu.errors import CatalogError
-from citus_tpu.operations.cleaner import DEFERRED_ON_SUCCESS, record_cleanup
+from citus_tpu.operations.cleaner import (
+    DEFERRED_ON_SUCCESS, ON_FAILURE, complete_operation, record_cleanup,
+)
 from citus_tpu.storage.writer import SHARD_META, _load_meta
 
 
@@ -35,6 +43,12 @@ def _copy_placement_files(src: str, dst: str) -> None:
     for n in names:
         if not os.path.exists(os.path.join(dst, n)):
             shutil.copy2(os.path.join(src, n), os.path.join(dst, n))
+    # deletion bitmaps travel with the placement (they are re-copied on
+    # every pass: unlike stripes they mutate in place)
+    from citus_tpu.storage.deletes import DELETES_FILE
+    if os.path.exists(os.path.join(src, DELETES_FILE)):
+        shutil.copy2(os.path.join(src, DELETES_FILE),
+                     os.path.join(dst, DELETES_FILE))
     shutil.copy2(os.path.join(src, SHARD_META), os.path.join(dst, SHARD_META))
 
 
@@ -79,8 +93,16 @@ def copy_shard_placement(cat: Catalog, shard_id: int, source_node: int,
 
 
 def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
-                         target_node: int) -> None:
-    """Move a shard placement (and its colocated peers) between nodes."""
+                         target_node: int, lock_manager=None) -> None:
+    """Move a shard placement (and its colocated peers) between nodes.
+
+    The final catch-up copy and the catalog flip run under the
+    colocation group's EXCLUSIVE write lock — the same lock every DML
+    writer holds while committing — so a stripe can never land on the
+    source placement after the catch-up but before the flip (that write
+    would be silently lost when the source is dropped)."""
+    from citus_tpu.transaction.write_locks import EXCLUSIVE, group_write_lock
+
     table, shard = _find_shard(cat, shard_id)
     if source_node not in shard.placements:
         raise CatalogError(f"shard {shard_id} has no placement on node {source_node}")
@@ -89,19 +111,34 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
     if target_node not in cat.nodes:
         raise CatalogError(f"node {target_node} does not exist")
     group = _colocated_shards(cat, table, shard)
-    # phase 1: copy data (repeat to catch appends that raced the copy)
+    op_id = int(time.time() * 1000) % (1 << 62) or 1
     for t, s in group:
-        src = cat.shard_dir(t.name, s.shard_id, source_node)
         dst = cat.shard_dir(t.name, s.shard_id, target_node)
-        if os.path.isdir(src):
-            _copy_placement_files(src, dst)
-            if _load_meta(src)["row_count"] != _load_meta(dst)["row_count"]:
-                _copy_placement_files(src, dst)  # catch-up pass
-    # phase 2: metadata flip (single atomic commit covers the group)
-    for t, s in group:
-        s.placements = [target_node if n == source_node else n for n in s.placements]
-        t.version += 1
-    cat.commit()
+        if not os.path.isdir(dst):
+            record_cleanup(cat, dst, ON_FAILURE, operation_id=op_id)
+    try:
+        # phase 1: bulk copy with writers still running
+        for t, s in group:
+            src = cat.shard_dir(t.name, s.shard_id, source_node)
+            if os.path.isdir(src):
+                _copy_placement_files(src, cat.shard_dir(t.name, s.shard_id,
+                                                         target_node))
+        # phase 2: block writers for the diff copy + metadata flip only
+        with group_write_lock(cat, table, EXCLUSIVE, lock_manager=lock_manager):
+            for t, s in group:
+                src = cat.shard_dir(t.name, s.shard_id, source_node)
+                dst = cat.shard_dir(t.name, s.shard_id, target_node)
+                if os.path.isdir(src):
+                    _copy_placement_files(src, dst)  # final catch-up
+            for t, s in group:
+                s.placements = [target_node if n == source_node else n
+                                for n in s.placements]
+                t.version += 1
+            cat.commit()
+    except BaseException:
+        complete_operation(cat, op_id, success=False)  # cleaner drops targets
+        raise
+    complete_operation(cat, op_id, success=True)
     # phase 3: deferred source drop
     for t, s in group:
         src = cat.shard_dir(t.name, s.shard_id, source_node)

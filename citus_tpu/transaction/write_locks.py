@@ -1,0 +1,59 @@
+"""Colocation-group write locks: one lock protocol shared by DML writers
+and shard movers/splitters.
+
+Reference: the reference blocks writes with global metadata locks across
+a move's final catch-up (operations/shard_transfer.c:472, README
+2553-2574) and serializes non-commutative writes per shard
+(utils/resource_lock.c LockShardResource).  Here the unit is the
+colocation group (colocated shards always move together), and the lock
+is two-layer:
+
+- an in-process LockManager acquisition (deadlock detection, lock
+  observability views) when a manager is supplied, and
+- a cross-process flock in matching shared/exclusive mode, so writers
+  and movers in *different* coordinator processes sharing a data dir
+  exclude each other too.
+
+In-process contention resolves at the LockManager first, so the flock
+only ever blocks on foreign processes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from citus_tpu.transaction.locks import EXCLUSIVE, SHARED  # noqa: F401
+from citus_tpu.utils.filelock import FileLock
+
+
+def group_resource(table_meta) -> str:
+    """Lock resource name for a table's write group."""
+    if table_meta.colocation_id:
+        return f"coloc:{table_meta.colocation_id}"
+    return f"table:{table_meta.name}"
+
+
+@contextlib.contextmanager
+def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
+                     timeout: float = 30.0):
+    import os
+    res = group_resource(table_meta)
+    sid = threading.get_ident()
+    if lock_manager is not None:
+        held = lock_manager.holds(sid, res)
+        if held == EXCLUSIVE or held == mode:
+            # re-entrant: an outer frame of this thread already holds the
+            # manager lock AND the process flock — taking the flock again
+            # on a fresh fd would self-deadlock
+            yield
+            return
+        lock_manager.acquire(sid, res, mode, timeout=timeout)
+    try:
+        lockfile = os.path.join(cat.data_dir,
+                                ".wl_" + res.replace(":", "_") + ".lock")
+        with FileLock(lockfile, shared=(mode == SHARED), timeout=timeout):
+            yield
+    finally:
+        if lock_manager is not None:
+            lock_manager.release(sid, res)
