@@ -176,13 +176,137 @@ def _hash_join_indexes(lmat, lvalid, rmat, rvalid, kind):
 
 MAX_CROSS_ROWS = 50_000_000
 
+# --------------------------------------------------- repartition shuffle
+
+_MIX = np.int64(-7046029254386353131)  # odd 64-bit multiplier (splitmix)
+
+
+def _bucket_targets(frame, key_exprs, n, n_buckets) -> np.ndarray:
+    """Destination bucket per row: mixed hash of the join-key bit
+    patterns.  NULL-key rows never match anything; they route to bucket
+    0 so outer joins still preserve them exactly once."""
+    mat, valid = _key_matrix(frame, key_exprs, n)
+    with np.errstate(over="ignore"):
+        h = np.zeros(n, np.int64)
+        for j in range(mat.shape[1]):
+            h = (h ^ mat[:, j]) * _MIX
+            h ^= (h >> np.int64(29)) & np.int64(0x7FFFFFFFFFFFFFFF)
+    tgt = (h % n_buckets + n_buckets) % n_buckets
+    return np.where(valid, tgt, 0).astype(np.int32)
+
+
+def _host_shuffle(frame, target: np.ndarray, n_buckets: int) -> list:
+    """Host bucketing (single-device / cpu-oracle fallback) — the moral
+    equivalent of the reference's bucket files on one worker."""
+    out = []
+    for b in range(n_buckets):
+        sel = target == b
+        sub = {k: (v[sel], m[sel] if not isinstance(m, bool) else m)
+               for k, (v, m) in frame.items()}
+        out.append((sub, int(sel.sum())))
+    return out
+
+
+_SHUFFLE_CACHE: dict = {}
+
+
+def _device_shuffle(frame, target: np.ndarray, mesh) -> list:
+    """Exchange rows to their bucket device with one all_to_all over the
+    mesh (the map-merge of MapMergeJob on ICI; parallel/shuffle.py).
+    Returns per-bucket host frames."""
+    import jax
+    from citus_tpu.parallel.shuffle import build_repartition
+
+    n_dev = mesh.shape["shard"]
+    names = list(frame.keys())
+    n = len(target)
+    per = -(-max(n, 1) // n_dev)  # rows per source device (ceil)
+    pad = per * n_dev - n
+
+    def stack(a, fill):
+        a = np.concatenate([a, np.full(pad, fill, a.dtype)]) if pad else a
+        return a.reshape(n_dev, per)
+
+    values = []
+    for k in names:
+        v, m = frame[k]
+        values.append(stack(np.asarray(v), 0))
+        values.append(stack(np.asarray(m) if not isinstance(m, bool)
+                            else np.full(n, m), False))
+    tgt2 = stack(target, 0)
+    mask2 = stack(np.ones(n, bool), False)
+    # exact per-(src,dst) counts are known host-side; capacity rounded up
+    # to a power of two so the jitted exchange is reused across queries
+    counts = np.zeros((n_dev, n_dev), np.int64)
+    for s in range(n_dev):
+        row = tgt2[s][mask2[s]]
+        if row.size:
+            counts[s] = np.bincount(row, minlength=n_dev)
+    cap = max(1, int(counts.max()))
+    cap = 1 << (cap - 1).bit_length()
+    key = (mesh.shape["shard"], len(values), cap, per)
+    fn = _SHUFFLE_CACHE.get(key)
+    if fn is None:
+        fn = build_repartition(mesh, n_cols=len(values), capacity=cap)
+        _SHUFFLE_CACHE[key] = fn
+    out_vals, out_valid, overflow = fn(tuple(values), tgt2, mask2)
+    assert int(overflow) == 0, "repartition capacity undersized"
+    out_vals = [np.asarray(v) for v in out_vals]
+    out_valid = np.asarray(out_valid)
+    buckets = []
+    for d in range(n_dev):
+        sel = out_valid[d]
+        sub = {}
+        for i, k in enumerate(names):
+            sub[k] = (out_vals[2 * i][d][sel], out_vals[2 * i + 1][d][sel])
+        buckets.append((sub, int(sel.sum())))
+    return buckets
+
+
+def _repartition_tasks(cat: Catalog, bj: BoundJoinSelect, settings: Settings):
+    """Partition both distributed sides by join-key hash -> per-bucket
+    frame overrides.  Uses the all_to_all device shuffle when a
+    multi-device mesh is available, host bucketing otherwise."""
+    la, ra, lks, rks = bj.repartition_spec
+    qualified = bj.binder.qualified
+    lframe, ln = _load_rel_frame(cat, bj.rel_plans[la], qualified)
+    rframe, rn = _load_rel_frame(cat, bj.rel_plans[ra], qualified)
+    use_device = settings.executor.task_executor_backend != "cpu"
+    mesh = None
+    if use_device:
+        import jax
+        if len(jax.devices()) > 1:
+            from citus_tpu.parallel.mesh import default_mesh
+            mesh = default_mesh()
+    B = (mesh.shape["shard"] if mesh is not None
+         else settings.planner.repartition_bucket_count_per_device * 8)
+    ltgt = _bucket_targets(lframe, lks, ln, B)
+    rtgt = _bucket_targets(rframe, rks, rn, B)
+    if mesh is not None:
+        lbuckets = _device_shuffle(lframe, ltgt, mesh)
+        rbuckets = _device_shuffle(rframe, rtgt, mesh)
+        mode = "all_to_all"
+    else:
+        lbuckets = _host_shuffle(lframe, ltgt, B)
+        rbuckets = _host_shuffle(rframe, rtgt, B)
+        mode = "host"
+    overrides = [{la: lbuckets[b], ra: rbuckets[b]} for b in range(B)]
+    return overrides, mode
+
 
 def _execute_join_tree(cat: Catalog, bj: BoundJoinSelect,
-                       shard_index: Optional[int]):
-    """Join all relations for one task -> (frame, n_rows)."""
+                       shard_index: Optional[int],
+                       frame_override: Optional[dict] = None):
+    """Join all relations for one task -> (frame, n_rows).
+
+    ``frame_override`` supplies pre-partitioned frames for relations the
+    repartition shuffle already bucketed (the merge half of MapMergeJob)."""
     qualified = bj.binder.qualified
     frames = {}
     for alias, t in bj.rels:
+        if frame_override is not None and alias in frame_override:
+            frames[alias] = frame_override[alias]
+            continue
         rp = bj.rel_plans[alias]
         if t.is_distributed and shard_index is not None:
             frames[alias] = _load_rel_frame(cat, rp, qualified, [shard_index])
@@ -251,11 +375,20 @@ def _join_text_src(bj: BoundJoinSelect):
 def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -> Result:
     import time
     t0 = time.perf_counter()
-    if bj.strategy == "colocated":
+    strategy = bj.strategy
+    if strategy == "repartition" and not settings.planner.enable_repartition_joins:
+        strategy = "pull"
+    shuffle_mode = None
+    # tasks: (shard_index, frame_override) pairs
+    if strategy == "colocated":
         dist = [t for _, t in bj.rels if t.is_distributed]
-        tasks = list(range(dist[0].shard_count)) if dist else [None]
+        tasks = ([(si, None) for si in range(dist[0].shard_count)]
+                 if dist else [(None, None)])
+    elif strategy == "repartition":
+        overrides, shuffle_mode = _repartition_tasks(cat, bj, settings)
+        tasks = [(None, fo) for fo in overrides]
     else:
-        tasks = [None]
+        tasks = [(None, None)]
 
     view = _JoinPlanView(bj)
     text_src = _join_text_src(bj)
@@ -264,8 +397,8 @@ def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -
         acc = HostGroupAccumulator(len(bj.group_keys), bj.partial_ops)
         key_fns = [compile_expr(k, np) for k in bj.group_keys]
         arg_fns = [compile_expr(a, np) for a in bj.agg_args]
-        for task in tasks:
-            frame, n = _execute_join_tree(cat, bj, task)
+        for si, fo in tasks:
+            frame, n = _execute_join_tree(cat, bj, si, fo)
             if n == 0:
                 continue
             mask = np.ones(n, bool)
@@ -283,8 +416,8 @@ def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -
             rows = finalize_groups(view, cat, key_arrays, partials, text_src=text_src)
     else:
         env_batches = []
-        for task in tasks:
-            frame, n = _execute_join_tree(cat, bj, task)
+        for si, fo in tasks:
+            frame, n = _execute_join_tree(cat, bj, si, fo)
             if n == 0:
                 continue
             mask = np.ones(n, bool)
@@ -302,12 +435,15 @@ def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -
         keep = len(visible) - bj.hidden_outputs
         visible = visible[:keep]
         rows = [r[:keep] for r in rows]
+    explain = {
+        "strategy": f"join:{strategy}",
+        "tasks": len(tasks),
+        "elapsed_s": time.perf_counter() - t0,
+    }
+    if shuffle_mode is not None:
+        explain["shuffle"] = shuffle_mode
     return Result(
         columns=visible,
         rows=rows,
-        explain={
-            "strategy": f"join:{bj.strategy}",
-            "tasks": len(tasks),
-            "elapsed_s": time.perf_counter() - t0,
-        },
+        explain=explain,
     )

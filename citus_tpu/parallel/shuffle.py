@@ -53,7 +53,7 @@ def _pack_blocks(values: tuple, target: jnp.ndarray, mask: jnp.ndarray,
     for v in values:
         sv = v[order]
         buf = jnp.zeros(total, v.dtype).at[slot].set(
-            jnp.where(dest_ok, sv, 0), mode="drop")
+            jnp.where(dest_ok, sv, jnp.zeros((), v.dtype)), mode="drop")
         packed.append(buf.reshape(n_dev, capacity))
     overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
     return tuple(packed), packed_valid.reshape(n_dev, capacity), overflow

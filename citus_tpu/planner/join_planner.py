@@ -74,7 +74,10 @@ class BoundJoinSelect:
     agg_args: list[BExpr] = field(default_factory=list)
     partial_ops: list[PartialOp] = field(default_factory=list)
     agg_extract: list[AggExtract] = field(default_factory=list)
-    strategy: str = "colocated"                 # colocated | pull
+    strategy: str = "colocated"                 # colocated | repartition | pull
+    # for repartition: (left_alias, right_alias, left_keys, right_keys)
+    # of the step connecting the two distributed relations
+    repartition_spec: Optional[tuple] = None
     binder: Optional[Binder] = None
     hidden_outputs: int = 0
 
@@ -361,4 +364,51 @@ def _choose_strategy(bj: BoundJoinSelect) -> str:
                     changed = True
     if all(a in aligned for a, t in dist_rels):
         return "colocated"
+    spec = _repartition_spec(bj)
+    if spec is not None:
+        bj.repartition_spec = spec
+        return "repartition"
     return "pull"
+
+
+def _repartition_spec(bj: BoundJoinSelect) -> Optional[tuple]:
+    """Eligibility for the hash-repartition (all_to_all) join — the
+    analog of the reference's single-repartition MapMergeJob
+    (multi_physical_planner.h:160): exactly two distributed relations,
+    connected by an equi-join step whose keys live one per side; every
+    other relation replicated (reference/local) and inner-joined.  Rows
+    then match only within a hash bucket, so per-bucket joins are exact
+    — including an outer dist-dist step (NULL-key rows never match and
+    are preserved bucket-locally).
+
+    Returns (left_alias, right_alias, left_key_exprs, right_key_exprs)
+    or None."""
+    qualified = bj.binder.qualified
+    dist = [(a, t) for a, t in bj.rels if t.is_distributed]
+    if len(dist) != 2:
+        return None
+    d_aliases = {a for a, _ in dist}
+    connecting = None
+    for s in bj.steps:
+        if s.right_alias in d_aliases and s.left_keys:
+            lks, rks = [], []
+            for lk, rk in zip(s.left_keys, s.right_keys):
+                la, ra = _rel_of(lk, qualified), _rel_of(rk, qualified)
+                if la in d_aliases and ra in d_aliases and la != ra:
+                    lks.append(lk)
+                    rks.append(rk)
+            if lks:
+                if connecting is not None:
+                    return None  # two dist-dist steps: not single-repartition
+                connecting = (s, lks, rks)
+        elif s.right_alias in d_aliases:
+            return None  # dist rel joined without usable equi keys
+        elif s.kind in ("right", "full"):
+            # preserved unmatched rows of a replicated right side would
+            # re-appear in every bucket
+            return None
+    if connecting is None:
+        return None
+    s, lks, rks = connecting
+    left_alias = _rel_of(lks[0], qualified)
+    return (left_alias, s.right_alias, lks, rks)

@@ -102,9 +102,9 @@ def test_join_strategies_chosen(db):
     bcast = bind_join_select(cl.catalog, parse_sql(
         "SELECT count(*) FROM orders o JOIN nation n ON o.o_custkey = n.n_id")[0])
     assert bcast.strategy == "colocated"  # reference side replicated
-    pull = bind_join_select(cl.catalog, parse_sql(
+    repart = bind_join_select(cl.catalog, parse_sql(
         "SELECT count(*) FROM orders a JOIN orders b ON a.o_custkey = b.o_custkey")[0])
-    assert pull.strategy == "pull"
+    assert repart.strategy == "repartition"  # non-dist-key equi self-join
 
 
 def test_full_outer_join(db):
