@@ -547,15 +547,20 @@ class Cluster:
         t = self.catalog.table(stmt.table)
         if stmt.select is not None:
             names = stmt.columns or t.schema.names
-            n = self._insert_select_arrays(t, stmt.select, list(names))
-            if n is None:
+            res = self._insert_select_arrays(t, stmt.select, list(names))
+            if res is None:
                 # general path: materialize rows through the coordinator
                 # (reference: the pull-to-coordinator INSERT..SELECT
                 # strategy, insert_select_executor.c)
                 inner = self._execute_stmt(stmt.select)
                 n = self.copy_from(stmt.table, rows=inner.rows,
                                    column_names=list(names))
-            return Result(columns=[], rows=[], explain={"inserted": n})
+                strategy = "pull"
+            else:
+                n, strategy = res
+            return Result(columns=[], rows=[],
+                          explain={"inserted": n,
+                                   "strategy": f"insert_select:{strategy}"})
         rows = []
         for row_exprs in stmt.rows:
             row = []
@@ -609,22 +614,46 @@ class Cluster:
                     final_exprs[i] = BDictRemap(e, mapping)
         plan = plan_select(self.catalog, bound,
                            direct_limit=self.settings.planner.direct_gid_limit)
-        from citus_tpu.executor.batches import load_shard_batches
         from citus_tpu.transaction.locks import SHARED
         fns = [compile_expr(e, np) for e in final_exprs]
         ffn = compile_expr(bound.filter, np) if bound.filter is not None else None
+        strategy = self._insert_select_strategy(target, bound, final_exprs, names)
         with self._write_lock(target, SHARED):
-            return self._run_insert_select_arrays(
-                target, bound, plan, fns, ffn, names)
+            n = self._run_insert_select_arrays(
+                target, bound, plan, fns, ffn, names, strategy)
+        return n, strategy
+
+    def _insert_select_strategy(self, target, bound, final_exprs, names) -> str:
+        """The reference's INSERT..SELECT strategy ladder
+        (insert_select_planner.c, README:1187-1238): *colocated pushdown*
+        when source and target share a colocation group and the target's
+        distribution column is fed directly by the source's distribution
+        column (rows already live on the right shard — no re-hash, no
+        routing); else *repartition* (array-streaming re-hash through the
+        hash-routing ingest).  The caller falls back to *pull* (row
+        materialization) when the arrays path is ineligible entirely."""
+        from citus_tpu.planner.bound import BColumn
+        src = bound.table
+        if not (src.is_distributed and target.is_distributed):
+            return "repartition"
+        if src.colocation_id != target.colocation_id:
+            return "repartition"
+        if target.dist_column is None or target.dist_column not in names:
+            return "repartition"
+        i = names.index(target.dist_column)
+        e = final_exprs[i]
+        # plain column (no dict remap / cast) referencing the source's
+        # distribution column: hash(source row) == hash(target row)
+        if isinstance(e, BColumn) and e.name == src.dist_column:
+            return "colocated"
+        return "repartition"
 
     def _run_insert_select_arrays(self, target, bound, plan, fns, ffn,
-                                  names) -> int:
-        from citus_tpu.executor.batches import load_shard_batches
-        from citus_tpu.planner.bound import predicate_mask
+                                  names, strategy) -> int:
         ing = TableIngestor(self.catalog, target, txlog=self.txlog)
         try:
             total = self._stream_insert_select(ing, target, bound, plan,
-                                               fns, ffn, names)
+                                               fns, ffn, names, strategy)
         except BaseException:
             ing.abort()  # failure during scan/append: staged files dropped
             raise
@@ -635,7 +664,7 @@ class Cluster:
         return total
 
     def _stream_insert_select(self, ing, target, bound, plan, fns, ffn,
-                              names) -> int:
+                              names, strategy) -> int:
         from citus_tpu.executor.batches import load_shard_batches
         from citus_tpu.planner.bound import predicate_mask
         total = 0
@@ -671,7 +700,15 @@ class Cluster:
                     if cname not in out_v:
                         out_v[cname] = np.zeros(idx.size, target.schema.column(cname).type.storage_dtype)
                         out_m[cname] = np.zeros(idx.size, bool)
-                ing.append(out_v, out_m)
+                if strategy == "colocated":
+                    # pushdown: rows of source shard si belong to target
+                    # shard si by construction — write straight to its
+                    # placements, skipping hash + scatter entirely
+                    shard = target.shards[si]
+                    for node in shard.placements:
+                        ing._writer(shard.shard_id, node).append_batch(out_v, out_m)
+                else:
+                    ing.append(out_v, out_m)
                 total += idx.size
         return total
 

@@ -1,0 +1,95 @@
+"""INSERT..SELECT strategy ladder — VERDICT round-2 item #4.
+
+Reference: insert_select_planner.c picks colocated-pushdown /
+repartition / pull-to-coordinator (README:1187-1238, ~100M / ~10M / ~1M
+rows/s).  Here: colocated writes source-shard batches straight to the
+same-index target shard (no hash, no routing); repartition streams
+arrays through the hash-routing ingest; pull materializes rows."""
+
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE src (k bigint NOT NULL, v bigint, s text)")
+    cl.execute("SELECT create_distributed_table('src', 'k', 4)")
+    cl.copy_from("src", columns={
+        "k": np.arange(10_000, dtype=np.int64),
+        "v": np.arange(10_000, dtype=np.int64) * 2,
+        "s": [f"w{i % 11}" for i in range(10_000)]})
+    yield cl
+    cl.close()
+
+
+def test_colocated_pushdown(db):
+    db.execute("CREATE TABLE dst (k bigint NOT NULL, v bigint, s text)")
+    db.execute("SELECT create_distributed_table('dst', 'k', 4, 'src')")
+    r = db.execute("INSERT INTO dst SELECT k, v, s FROM src WHERE v < 10000")
+    assert r.explain["strategy"] == "insert_select:colocated"
+    assert r.explain["inserted"] == 5000
+    assert db.execute("SELECT count(*), sum(v) FROM dst").rows == \
+        db.execute("SELECT count(*), sum(v) FROM src WHERE v < 10000").rows
+    # rows landed on the correct shards: per-shard counts match the source
+    a = db.execute("SELECT k FROM dst ORDER BY k").rows
+    b = db.execute("SELECT k FROM src WHERE v < 10000 ORDER BY k").rows
+    assert a == b
+    # and text round-trips through the shared-dictionary space
+    assert db.execute("SELECT count(*) FROM dst WHERE s = 'w3'").rows == \
+        db.execute("SELECT count(*) FROM src WHERE s = 'w3' AND v < 10000").rows
+
+
+def test_repartition_when_dist_key_changes(db):
+    """Target distributed on a different column -> re-hash required."""
+    db.execute("CREATE TABLE byv (k bigint, v bigint NOT NULL)")
+    db.execute("SELECT create_distributed_table('byv', 'v', 4)")
+    r = db.execute("INSERT INTO byv SELECT k, v FROM src")
+    assert r.explain["strategy"] == "insert_select:repartition"
+    assert db.execute("SELECT count(*), sum(k) FROM byv").rows == \
+        db.execute("SELECT count(*), sum(k) FROM src").rows
+
+
+def test_repartition_when_expression_feeds_dist_col(db):
+    db.execute("CREATE TABLE dst2 (k bigint NOT NULL, v bigint, s text)")
+    db.execute("SELECT create_distributed_table('dst2', 'k', 4, 'src')")
+    r = db.execute("INSERT INTO dst2 SELECT k + 1, v, s FROM src")
+    assert r.explain["strategy"] == "insert_select:repartition"
+    assert db.execute("SELECT sum(k) FROM dst2").rows[0][0] == \
+        db.execute("SELECT sum(k) FROM src").rows[0][0] + 10_000
+
+
+def test_pull_fallback_for_aggregate_select(db):
+    db.execute("CREATE TABLE agg (k bigint NOT NULL, c bigint)")
+    db.execute("SELECT create_distributed_table('agg', 'k', 4)")
+    r = db.execute(
+        "INSERT INTO agg SELECT v % 10, count(*) FROM src GROUP BY v % 10")
+    assert r.explain["strategy"] == "insert_select:pull"
+    assert db.execute("SELECT count(*) FROM agg").rows == [(5,)]  # v even: 5 residues
+
+
+def test_colocated_beats_pull_wallclock(db):
+    """The ladder exists for throughput: colocated must clearly beat row
+    materialization (lenient 2x bound to avoid CI flakes; measured gap
+    is far larger)."""
+    db.execute("CREATE TABLE fast (k bigint NOT NULL, v bigint, s text)")
+    db.execute("SELECT create_distributed_table('fast', 'k', 4, 'src')")
+    t0 = time.perf_counter()
+    r = db.execute("INSERT INTO fast SELECT k, v, s FROM src")
+    dt_colo = time.perf_counter() - t0
+    assert r.explain["strategy"] == "insert_select:colocated"
+
+    db.execute("CREATE TABLE slow (k bigint NOT NULL, v bigint, s text)")
+    db.execute("SELECT create_distributed_table('slow', 'k', 4, 'src')")
+    t0 = time.perf_counter()
+    # ORDER BY forces ineligibility for the arrays path -> pull
+    r2 = db.execute("INSERT INTO slow SELECT k, v, s FROM src ORDER BY k")
+    dt_pull = time.perf_counter() - t0
+    assert r2.explain["strategy"] == "insert_select:pull"
+    assert db.execute("SELECT sum(v) FROM slow").rows == \
+        db.execute("SELECT sum(v) FROM fast").rows
+    assert dt_colo < dt_pull / 2, (dt_colo, dt_pull)
