@@ -34,6 +34,70 @@ def _option_bool(v) -> bool:
     return str(v).lower() in ("true", "1", "on")
 
 
+def _has_derived(item) -> bool:
+    if isinstance(item, A.SubqueryRef):
+        return True
+    if isinstance(item, A.Join):
+        return _has_derived(item.left) or _has_derived(item.right)
+    return False
+
+
+def _sort_rows(rows, names, order_by):
+    """ORDER BY over materialized rows: items resolve by output position
+    or output column name (PostgreSQL's rule for set operations)."""
+    for oi in reversed(order_by):
+        idx = None
+        if isinstance(oi.expr, A.Literal) and isinstance(oi.expr.value, int):
+            idx = oi.expr.value - 1
+        elif isinstance(oi.expr, A.ColumnRef) and oi.expr.table is None \
+                and oi.expr.name in names:
+            idx = names.index(oi.expr.name)
+        if idx is None or not (0 <= idx < len(names)):
+            raise AnalysisError(
+                "ORDER BY on a set operation must reference an output "
+                "column name or position")
+        nf = oi.nulls_first if oi.nulls_first is not None else (not oi.ascending)
+        nulls = [x for x in rows if x[idx] is None]
+        vals = [x for x in rows if x[idx] is not None]
+        vals.sort(key=lambda x, j=idx: x[j], reverse=not oi.ascending)
+        rows = (nulls + vals) if nf else (vals + nulls)
+    return rows
+
+
+def _infer_column_type(vals):
+    """Fallback type inference for intermediate results whose planner
+    types are unknown (e.g. window outputs): first non-NULL value wins;
+    decimals take the column's max scale."""
+    import datetime as _dt
+    import decimal as _dec
+    from citus_tpu import types as T
+    kind = None
+    max_scale = 0
+    for v in vals:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return T.BOOL_T
+        if isinstance(v, _dec.Decimal):
+            kind = "decimal"
+            max_scale = max(max_scale, -v.as_tuple().exponent)
+        elif isinstance(v, float):
+            return T.FLOAT64_T
+        elif isinstance(v, int):
+            kind = kind or "int"
+        elif isinstance(v, str):
+            return T.TEXT_T
+        elif isinstance(v, _dt.datetime):
+            return T.TIMESTAMP_T
+        elif isinstance(v, _dt.date):
+            return T.DATE_T
+        else:
+            raise AnalysisError(f"cannot infer a column type from {v!r}")
+    if kind == "decimal":
+        return T.decimal_t(max(18, max_scale), max(max_scale, 0))
+    return T.INT64_T
+
+
 class Cluster:
     def __init__(self, data_dir: str, *, n_nodes: Optional[int] = None,
                  settings: Optional[Settings] = None):
@@ -363,6 +427,11 @@ class Cluster:
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         if isinstance(stmt, A.WithSelect):
             return self._execute_with(stmt)
+        if isinstance(stmt, A.SetOp):
+            return self._execute_setop(stmt)
+        if isinstance(stmt, A.Select) and stmt.from_ is not None \
+                and _has_derived(stmt.from_):
+            return self._execute_derived(stmt)
         if isinstance(stmt, A.Select) and any(
                 isinstance(i.expr, A.WindowCall) for i in stmt.items):
             return self._execute_window(stmt)
@@ -583,7 +652,7 @@ class Cluster:
         match the target physically, move numpy columns straight from
         the scan into the hash-routing ingest — no Python row
         materialization.  Returns None when ineligible."""
-        if not isinstance(sel.from_, A.TableRef):
+        if not isinstance(sel, A.Select) or not isinstance(sel.from_, A.TableRef):
             return None
         if sel.group_by or sel.having or sel.order_by or sel.limit or sel.distinct:
             return None
@@ -781,12 +850,114 @@ class Cluster:
 
     _CTE_SEQ = [0]
 
+    def _create_temp_from_result(self, prefix: str, label: str, r: Result) -> str:
+        """Store a query result as a local temp table (the
+        read_intermediate_result analog for CTEs / derived tables / set
+        operations)."""
+        names, seen = [], set()
+        for i, n in enumerate(r.columns):
+            base = n or f"column{i + 1}"
+            cand, k = base, 1
+            while cand in seen:
+                k += 1
+                cand = f"{base}_{k}"
+            seen.add(cand)
+            names.append(cand)
+        types = list(r.types) if r.types else [None] * len(names)
+        for i, ct_ in enumerate(types):
+            if ct_ is None:
+                types[i] = _infer_column_type([row[i] for row in r.rows])
+        self._CTE_SEQ[0] += 1
+        tmp = f"__{prefix}_{self._CTE_SEQ[0]}_{label}"
+        self.catalog.create_table(
+            tmp, Schema([Column(cn, ct_) for cn, ct_ in zip(names, types)]))
+        if r.rows:
+            self.copy_from(tmp, rows=r.rows)
+        return tmp
+
+    def _execute_derived(self, stmt: A.Select) -> Result:
+        """Derived tables: execute each FROM-subquery, materialize it as
+        an intermediate result, rewrite the FROM item to reference it
+        (reference: RecursivelyPlanSubqueryWalker,
+        recursive_planning.c:1303)."""
+        temps: list[str] = []
+
+        def repl(item):
+            if isinstance(item, A.SubqueryRef):
+                r = self._execute_stmt(item.select)
+                tmp = self._create_temp_from_result("derived", item.alias, r)
+                temps.append(tmp)
+                return A.TableRef(tmp, item.alias)
+            if isinstance(item, A.Join):
+                return A.Join(repl(item.left), repl(item.right),
+                              item.kind, item.condition)
+            return item
+
+        try:
+            new_stmt = A.Select(stmt.items, repl(stmt.from_), stmt.where,
+                                stmt.group_by, stmt.having, stmt.order_by,
+                                stmt.limit, stmt.offset, stmt.distinct)
+            return self._execute_stmt(new_stmt)
+        finally:
+            for tmp in temps:
+                try:
+                    self.drop_table(tmp)
+                except Exception:
+                    pass
+
+    def _execute_setop(self, stmt: A.SetOp) -> Result:
+        """UNION / INTERSECT / EXCEPT [ALL]: execute both sides, combine
+        on the coordinator with SQL bag/set semantics (NULLs compare
+        equal, like DISTINCT).  Reference: set operations that cannot be
+        pushed down run through recursive planning
+        (recursive_planning.c:223)."""
+        from collections import Counter
+        lres = self._execute_stmt(stmt.left)
+        rres = self._execute_stmt(stmt.right)
+        if len(lres.columns) != len(rres.columns):
+            raise AnalysisError(
+                "each side of a set operation must return the same number "
+                "of columns")
+        lrows, rrows = list(lres.rows), list(rres.rows)
+        if stmt.op == "union":
+            rows = lrows + rrows
+            if not stmt.all:
+                rows = list(dict.fromkeys(rows))
+        elif stmt.op == "intersect":
+            rc = Counter(rrows)
+            if stmt.all:
+                rows, used = [], Counter()
+                for row in lrows:
+                    if used[row] < rc.get(row, 0):
+                        used[row] += 1
+                        rows.append(row)
+            else:
+                rows = [row for row in dict.fromkeys(lrows) if rc.get(row, 0)]
+        else:  # except
+            if stmt.all:
+                rc = Counter(rrows)
+                rows, used = [], Counter()
+                for row in lrows:
+                    if used[row] < rc.get(row, 0):
+                        used[row] += 1
+                    else:
+                        rows.append(row)
+            else:
+                rset = set(rrows)
+                rows = [row for row in dict.fromkeys(lrows) if row not in rset]
+        rows = _sort_rows(rows, lres.columns, stmt.order_by)
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit is not None:
+            rows = rows[:stmt.limit]
+        return Result(columns=lres.columns, rows=rows,
+                      types=lres.types or rres.types,
+                      explain={"strategy": f"setop:{stmt.op}"})
+
     def _execute_with(self, stmt: A.WithSelect) -> Result:
         """Materialize each CTE as a temporary local table (the
         intermediate-result strategy of recursive_planning.c), rewrite
         references in later CTEs and the body, execute, drop."""
-        from citus_tpu.planner.bind import bind_select
-        from citus_tpu.planner.join_planner import bind_join_select
         mapping: dict[str, str] = {}
         temps: list[str] = []
 
@@ -798,38 +969,23 @@ class Cluster:
             if isinstance(item, A.Join):
                 return A.Join(remap_from(item.left), remap_from(item.right),
                               item.kind, item.condition)
+            if isinstance(item, A.SubqueryRef):
+                return A.SubqueryRef(remap_select(item.select), item.alias)
             return item
 
-        def remap_select(sel: A.Select) -> A.Select:
+        def remap_select(sel):
+            if isinstance(sel, A.SetOp):
+                return A.SetOp(sel.op, sel.all, remap_select(sel.left),
+                               remap_select(sel.right), sel.order_by,
+                               sel.limit, sel.offset)
             return A.Select(sel.items, remap_from(sel.from_), sel.where,
                             sel.group_by, sel.having, sel.order_by,
                             sel.limit, sel.offset, sel.distinct)
 
         try:
             for name, sel in stmt.ctes:
-                sel = remap_select(sel)
-                # bind to learn output column types
-                if isinstance(sel.from_, A.Join):
-                    bound = bind_join_select(self.catalog, sel)
-                else:
-                    bound = bind_select(self.catalog, sel)
-                names, types, seen = [], [], set()
-                for n, e in zip(bound.output_names, bound.final_exprs):
-                    base = n or "column"
-                    cand, i = base, 1
-                    while cand in seen:
-                        i += 1
-                        cand = f"{base}_{i}"
-                    seen.add(cand)
-                    names.append(cand)
-                    types.append(e.type)
-                r = self._execute_stmt(sel)
-                self._CTE_SEQ[0] += 1
-                tmp = f"__cte_{self._CTE_SEQ[0]}_{name}"
-                self.catalog.create_table(
-                    tmp, Schema([Column(cn, ct_) for cn, ct_ in zip(names, types)]))
-                if r.rows:
-                    self.copy_from(tmp, rows=r.rows)
+                r = self._execute_stmt(remap_select(sel))
+                tmp = self._create_temp_from_result("cte", name, r)
                 mapping[name] = tmp
                 temps.append(tmp)
             body = remap_select(stmt.body)

@@ -43,6 +43,9 @@ class Result:
     columns: list[str]
     rows: list[tuple]
     explain: dict = field(default_factory=dict)
+    # per-visible-column ColumnType where the planner knows them (used by
+    # intermediate-result materialization: CTEs, derived tables, set ops)
+    types: Optional[list] = None
 
     @property
     def rowcount(self) -> int:
@@ -379,6 +382,7 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
     return Result(
         columns=visible,
         rows=rows,
+        types=[e.type for e in bound.final_exprs][:len(visible)],
         explain={
             "strategy": plan.group_mode.kind if bound.has_aggs else "projection",
             "shards": len(plan.shard_indexes),

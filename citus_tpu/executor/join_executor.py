@@ -445,5 +445,6 @@ def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -
     return Result(
         columns=visible,
         rows=rows,
+        types=[e.type for e in bj.final_exprs][:len(visible)],
         explain=explain,
     )

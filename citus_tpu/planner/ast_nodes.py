@@ -130,6 +130,21 @@ class Param(Expr):
 
 
 @dataclass(frozen=True)
+class Exists(Expr):
+    """EXISTS (SELECT ...) — executed ahead of the outer query via
+    recursive planning (reference: recursive_planning.c handles EXISTS
+    sublinks as subplans); LIMIT 1 semantics."""
+    select: object  # A.Select | A.SetOp
+    negated: bool = False
+
+    def __hash__(self):
+        return id(self.select)
+
+    def __eq__(self, other):
+        return self is other
+
+
+@dataclass(frozen=True)
 class Subquery(Expr):
     """Scalar subquery or IN-subquery source; executed ahead of the outer
     query as an intermediate result (reference: recursive planning,
@@ -206,6 +221,16 @@ class TableRef:
 
 
 @dataclass
+class SubqueryRef:
+    """Derived table: FROM (SELECT ...) alias — materialized as an
+    intermediate result before the outer query runs (reference:
+    recursive planning of subqueries in FROM,
+    recursive_planning.c RecursivelyPlanSubqueryWalker)."""
+    select: object  # Select | SetOp
+    alias: str
+
+
+@dataclass
 class Join:
     left: "FromItem"
     right: "FromItem"
@@ -242,6 +267,22 @@ class Select(Statement):
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+
+
+@dataclass
+class SetOp(Statement):
+    """UNION / INTERSECT / EXCEPT [ALL] over two selects (or nested set
+    operations).  Trailing ORDER BY / LIMIT / OFFSET bind to the whole
+    operation, as in PostgreSQL.  Reference: set operations route through
+    recursive planning when they cannot be pushed down
+    (recursive_planning.c:223)."""
+    op: str = "union"          # union | intersect | except
+    all: bool = False
+    left: object = None        # Select | SetOp
+    right: object = None       # Select | SetOp
+    order_by: list = field(default_factory=list)   # [OrderItem]
+    limit: Optional[int] = None
+    offset: Optional[int] = None
 
 
 @dataclass

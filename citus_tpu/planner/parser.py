@@ -40,7 +40,7 @@ KEYWORDS = {
     "using", "with", "like", "delete", "update", "set", "truncate",
     "vacuum", "copy", "alter", "add", "column", "rename", "to",
     "schema", "cascade", "merge", "matched", "nothing", "do", "over",
-    "partition",
+    "partition", "union", "intersect", "except", "all",
 }
 
 
@@ -503,7 +503,86 @@ class Parser:
             return t.value
         self.error("bad utility argument")
 
-    def parse_select(self) -> A.Select:
+    def parse_select(self):
+        """select_core (UNION|INTERSECT|EXCEPT [ALL] select_core)*
+        [ORDER BY ...] [LIMIT ...] [OFFSET ...] — INTERSECT binds
+        tighter, as in PostgreSQL; trailing ORDER BY/LIMIT bind to the
+        whole set operation.  Returns A.Select or A.SetOp."""
+        node = self._parse_setop_union()
+        order_by, limit, offset = self._parse_order_limit()
+        if order_by or limit is not None or offset is not None:
+            if node.order_by or node.limit is not None or node.offset is not None:
+                self.error("ORDER BY/LIMIT may only follow the last SELECT "
+                           "of a set operation")
+            node.order_by = order_by
+            node.limit = limit
+            node.offset = offset
+        return node
+
+    def _parse_setop_union(self):
+        left = self._parse_setop_intersect()
+        while self.at_kw("union", "except"):
+            op = self.next().value
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self._parse_setop_intersect()
+            left = A.SetOp(op, all_, left, right)
+        return left
+
+    def _parse_setop_intersect(self):
+        left = self._parse_select_core()
+        while self.accept_kw("intersect"):
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self._parse_select_core()
+            left = A.SetOp("intersect", all_, left, right)
+        return left
+
+    def _parse_order_limit(self):
+        order_by: list[A.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept_kw("asc"):
+                    pass
+                elif self.accept_kw("desc"):
+                    asc = False
+                nulls_first = None
+                if self.accept_kw("nulls"):
+                    if self.accept_kw("first"):
+                        nulls_first = True
+                    else:
+                        self.expect_kw("last")
+                        nulls_first = False
+                order_by.append(A.OrderItem(e, asc, nulls_first))
+                if not self.accept_op(","):
+                    break
+        limit = offset = None
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "num":
+                self.error("expected number after LIMIT")
+            limit = int(t.value)
+        if self.accept_kw("offset"):
+            t = self.next()
+            if t.kind != "num":
+                self.error("expected number after OFFSET")
+            offset = int(t.value)
+        return order_by, limit, offset
+
+    def _parse_select_core(self):
+        if self.at_op("("):
+            # parenthesized select / set operation as an operand
+            save = self.i
+            self.next()
+            if self.at_kw("select", "with") or self.at_op("("):
+                node = self.parse_select()
+                self.expect_op(")")
+                return node
+            self.i = save
+            self.error("expected SELECT")
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         items = []
@@ -537,39 +616,8 @@ class Parser:
         having = None
         if self.accept_kw("having"):
             having = self.parse_expr()
-        order_by: list[A.OrderItem] = []
-        if self.accept_kw("order"):
-            self.expect_kw("by")
-            while True:
-                e = self.parse_expr()
-                asc = True
-                if self.accept_kw("asc"):
-                    pass
-                elif self.accept_kw("desc"):
-                    asc = False
-                nulls_first = None
-                if self.accept_kw("nulls"):
-                    if self.accept_kw("first"):
-                        nulls_first = True
-                    else:
-                        self.expect_kw("last")
-                        nulls_first = False
-                order_by.append(A.OrderItem(e, asc, nulls_first))
-                if not self.accept_op(","):
-                    break
-        limit = offset = None
-        if self.accept_kw("limit"):
-            t = self.next()
-            if t.kind != "num":
-                self.error("expected number after LIMIT")
-            limit = int(t.value)
-        if self.accept_kw("offset"):
-            t = self.next()
-            if t.kind != "num":
-                self.error("expected number after OFFSET")
-            offset = int(t.value)
-        return A.Select(items, from_, where, group_by, having, order_by,
-                        limit, offset, distinct)
+        return A.Select(items, from_, where, group_by, having, [],
+                        None, None, distinct)
 
     def parse_from(self):
         left = self.parse_table_ref()
@@ -600,9 +648,17 @@ class Parser:
             left = A.Join(left, right, kind, cond)
         return left
 
-    def parse_table_ref(self) -> A.TableRef:
+    def parse_table_ref(self):
         if self.at_op("("):
-            raise UnsupportedFeatureError("subqueries in FROM are not supported yet")
+            # derived table: FROM (SELECT ...) [AS] alias
+            self.next()
+            sel = self.parse_select()
+            self.expect_op(")")
+            self.accept_kw("as")
+            if self.peek().kind != "ident":
+                self.error("derived table requires an alias")
+            alias = self.expect_ident()
+            return A.SubqueryRef(sel, alias)
         name = self.parse_table_name()
         alias = None
         if self.accept_kw("as"):
@@ -761,6 +817,12 @@ class Parser:
             if t.value == "not":
                 self.next()
                 return A.UnOp("not", self.parse_comparison())
+            if t.value == "exists":
+                self.next()
+                self.expect_op("(")
+                sel = self.parse_select()
+                self.expect_op(")")
+                return A.Exists(sel)
         if t.kind == "param":
             self.next()
             return A.Param(int(t.value[1:]))

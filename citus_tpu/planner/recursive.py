@@ -42,7 +42,7 @@ def has_subquery(e) -> bool:
 
 
 def _walk_expr(e):
-    if isinstance(e, A.Subquery):
+    if isinstance(e, (A.Subquery, A.Exists)):
         yield e
         return
     if isinstance(e, A.BinOp):
@@ -176,9 +176,20 @@ def rewrite_subqueries(stmt: A.Select, run_select) -> A.Select:
         return tuple(_value_to_literal(row[0]) for row in r.rows
                      if row[0] is not None)
 
+    def exec_exists(sub: A.Exists) -> A.Literal:
+        import dataclasses
+        sel = sub.select
+        if isinstance(sel, A.Select) and sel.limit is None and not sel.group_by \
+                and sel.having is None and not sel.distinct:
+            sel = dataclasses.replace(sel, limit=1)  # LIMIT 1 semantics
+        r = run_select(sel)
+        return A.Literal(len(r.rows) > 0, "bool")
+
     def rw(e):
         if e is None:
             return None
+        if isinstance(e, A.Exists):
+            return exec_exists(e)
         if isinstance(e, A.Subquery):
             return exec_scalar(e)
         if isinstance(e, A.BinOp):

@@ -88,14 +88,29 @@ class Gen:
         r = self.r
         kind = r.random()
         where = f" WHERE {self.predicate()}" if r.random() < 0.7 else ""
-        if kind < 0.4:  # global aggregates
+        if kind < 0.35:  # global aggregates
             aggs = ", ".join(self.aggregate() for _ in range(r.randint(1, 3)))
             return f"SELECT {aggs} FROM t{where}"
-        if kind < 0.8:  # group by
+        if kind < 0.65:  # group by
             key = r.choice(["a", "s", "a, s"])
             aggs = ", ".join(self.aggregate() for _ in range(r.randint(1, 2)))
             having = f" HAVING count(*) > {r.randint(0, 3)}" if r.random() < 0.3 else ""
             return (f"SELECT {key}, {aggs} FROM t{where} GROUP BY {key}{having}")
+        if kind < 0.75:  # set operations over single columns
+            col = r.choice(["a", "s"])
+            op = r.choice(["UNION", "UNION ALL", "INTERSECT", "EXCEPT"])
+            p1 = self.predicate()
+            p2 = self.predicate()
+            return (f"SELECT {col} FROM t WHERE {p1} {op} "
+                    f"SELECT {col} FROM t WHERE {p2}")
+        if kind < 0.82:  # EXISTS / NOT EXISTS (uncorrelated)
+            neg = "NOT " if r.random() < 0.5 else ""
+            return (f"SELECT count(*) FROM t{where or ' WHERE k >= 0'} "
+                    f"AND {neg}EXISTS (SELECT 1 FROM t WHERE {self.predicate()})")
+        if kind < 0.9:  # derived table with aggregation
+            key = r.choice(["a", "s"])
+            return (f"SELECT count(*) FROM (SELECT {key}, count(*) AS n "
+                    f"FROM t{where} GROUP BY {key}) z WHERE n > {r.randint(0, 5)}")
         # projection
         cols = ", ".join(r.sample(COLS, r.randint(1, 3)))
         return f"SELECT {cols} FROM t{where} AND k < 200" if where \
