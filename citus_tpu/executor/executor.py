@@ -254,10 +254,11 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
     backend = settings.executor.task_executor_backend
     acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
 
-    # distinct partial states are exact value sets: only the host
-    # accumulation path can carry them
-    has_distinct = any(op.kind == "distinct" for op in plan.partial_ops)
-    if backend != "cpu" and not has_distinct:
+    # distinct/collect partial states are exact value (multi)sets: only
+    # the host accumulation path can carry them
+    has_exact = any(op.kind in ("distinct", "collect")
+                    for op in plan.partial_ops)
+    if backend != "cpu" and not has_exact:
         import jax
         import jax.numpy as jnp
         from citus_tpu.ops.hash_agg import build_hash_agg_worker, merge_hash_tables_into

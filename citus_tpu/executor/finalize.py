@@ -23,7 +23,8 @@ from citus_tpu.planner.bound import (
 from citus_tpu.planner.physical import AggExtract, PhysicalPlan
 
 
-def extract_aggs(plan: PhysicalPlan, partials: tuple) -> list[tuple[np.ndarray, np.ndarray]]:
+def extract_aggs(plan: PhysicalPlan, partials: tuple,
+                 cat: Optional[Catalog] = None) -> list[tuple[np.ndarray, np.ndarray]]:
     """Partial-op arrays -> per-SQL-aggregate (values, valid) arrays."""
     out = []
     for ex in plan.agg_extract:
@@ -61,16 +62,23 @@ def extract_aggs(plan: PhysicalPlan, partials: tuple) -> list[tuple[np.ndarray, 
             c = np.asarray(partials[ex.slots[1]])
             out.append((v, c > 0))
         else:
-            raise AssertionError(ex.kind)
+            from citus_tpu.planner.aggregates import finalize_kind
+            fin = finalize_kind(ex.kind)
+            if fin is None:
+                raise AssertionError(ex.kind)
+            out.append(fin(ex, partials, cat))
     return out
 
 
 def decode_qualified(cat: Catalog, expr_type: T.ColumnType,
                      source: "Optional[tuple[str, str]]", raw, valid) -> object:
     """Physical value -> Python value; ``source`` is (table, column) for
-    text dictionary decoding."""
+    text dictionary decoding.  Registry aggregates (string_agg,
+    array_agg) finalize straight to Python objects, which pass through."""
     if not valid:
         return None
+    if isinstance(raw, (str, list)):
+        return raw
     if expr_type.is_text:
         if source is None:
             return int(raw)
@@ -109,7 +117,7 @@ def finalize_groups(
 ) -> list[tuple]:
     """Grouped/aggregate query: evaluate final exprs per group -> rows."""
     bound = plan.bound
-    aggs = extract_aggs(plan, partials)
+    aggs = extract_aggs(plan, partials, cat)
     env = {"__keys__": key_arrays, "__aggs__": aggs}
     n_groups = key_arrays[0][0].shape[0] if key_arrays else (
         aggs[0][0].shape[0] if aggs else 1)

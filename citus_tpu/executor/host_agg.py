@@ -30,10 +30,14 @@ class HostGroupAccumulator:
         self._key_vals.append(kvs)
         row = []
         for op in self.partial_ops:
-            dt = np.dtype(op.dtype)
             if op.kind == "distinct":
                 row.append(set())
-            elif op.kind in ("min", "max"):
+                continue
+            if op.kind == "collect":
+                row.append([])
+                continue
+            dt = np.dtype(op.dtype)
+            if op.kind in ("min", "max"):
                 row.append(dt.type(_sentinel(op.kind, dt)))
             else:
                 row.append(dt.type(0))
@@ -92,6 +96,13 @@ class HostGroupAccumulator:
                     sets[inverse[r]].add(v[r].item())
                 local.append(sets)
                 continue
+            if op.kind == "collect":
+                v, ok = arg_np[op.arg_index]
+                lists = [[] for _ in range(L)]
+                for r in np.nonzero(ok)[0]:  # scan order preserved
+                    lists[inverse[r]].append(v[r].item())
+                local.append(lists)
+                continue
             if op.kind == "count":
                 a = np.zeros(L, np.int64)
                 ok = arg_np[op.arg_index][1] if op.arg_index >= 0 else np.ones(sel.size, bool)
@@ -119,6 +130,8 @@ class HostGroupAccumulator:
             for pi, op in enumerate(self.partial_ops):
                 if op.kind == "distinct":
                     self._accs[gi][pi] |= local[pi][li]
+                elif op.kind == "collect":
+                    self._accs[gi][pi].extend(local[pi][li])
                 elif op.kind in ("sum", "count"):
                     self._accs[gi][pi] += local[pi][li]
                 elif op.kind == "min":
@@ -179,9 +192,18 @@ class HostGroupAccumulator:
             vals = np.array([kvs[ki][0] for kvs in self._key_vals], dtype=dt)
             valid = np.array([kvs[ki][1] for kvs in self._key_vals], dtype=bool)
             key_arrays.append((vals, valid))
-        partials = tuple(
-            np.array([len(self._accs[g][pi]) if self.partial_ops[pi].kind == "distinct"
-                      else self._accs[g][pi] for g in range(G)],
-                     dtype=np.dtype(self.partial_ops[pi].dtype))
-            for pi in range(len(self.partial_ops)))
-        return key_arrays, partials
+        partials = []
+        for pi, op in enumerate(self.partial_ops):
+            if op.kind == "collect":
+                a = np.empty(G, object)
+                for g in range(G):
+                    a[g] = self._accs[g][pi]
+                partials.append(a)
+            elif op.kind == "distinct":
+                partials.append(np.array(
+                    [len(self._accs[g][pi]) for g in range(G)], np.int64))
+            else:
+                partials.append(np.array(
+                    [self._accs[g][pi] for g in range(G)],
+                    dtype=np.dtype(op.dtype)))
+        return key_arrays, tuple(partials)

@@ -1,0 +1,274 @@
+"""Extended-aggregate registry: the declared partial/combine interface.
+
+Reference: arbitrary aggregates run worker-side sfuncs and a
+coordinator combinefunc (utils/aggregate_utils.c:502,847
+worker_partial_agg_sfunc / coord_combine_agg_sfunc).  Here every
+aggregate declares three pieces and the planner/executor stay generic:
+
+- ``bind``   — argument typing and the AggSpec (binder phase)
+- ``lower``  — which combinable partial slots the worker computes
+  (physical phase).  Variance-family aggregates lower to *sum/sumsq/
+  count* partials, so on device they combine with the same single psum
+  as plain sums — no new collectives, no executor changes.
+- ``finalize`` — partial slots -> per-group (values, valid) arrays
+  (coordinator combine phase)
+
+Aggregates that need exact value multisets (percentiles, string_agg,
+array_agg) declare ``needs_exact``: their partial is an order-preserving
+*collect*, which forces the host grouping path — the analog of the
+reference pulling rows when an aggregate has no combinefunc.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from citus_tpu import types as T
+from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
+from citus_tpu.planner.bound import BBinOp, BCast, BExpr
+
+
+@dataclass
+class AggDef:
+    name: str
+    bind: Callable          # (binder, A.FuncCall) -> AggSpec
+    lower: Callable         # (spec, arg_slot, partial_slot) -> AggExtract
+    finalize: Callable      # (extract, partials, cat) -> (values, valid)
+    needs_exact: bool = False  # collect-based: host grouping only
+
+
+def _as_float(e: BExpr) -> BExpr:
+    if e.type.is_float:
+        return e
+    return BCast(e, T.FLOAT64_T)
+
+
+# ------------------------------------------------------- variance family
+
+_VAR_CANON = {
+    "variance": "var_samp", "var_samp": "var_samp", "var_pop": "var_pop",
+    "stddev": "stddev_samp", "stddev_samp": "stddev_samp",
+    "stddev_pop": "stddev_pop",
+}
+
+
+def _bind_variance(binder, e):
+    from citus_tpu.planner.bind import AggSpec
+    if len(e.args) != 1:
+        raise AnalysisError(f"{e.name}() expects one argument")
+    arg = binder.bind_scalar(e.args[0])
+    if not (arg.type.is_integer or arg.type.is_float or arg.type.is_decimal):
+        raise AnalysisError(f"{e.name}() over {arg.type} not supported")
+    if e.distinct:
+        raise UnsupportedFeatureError(f"{e.name}(DISTINCT ...) not supported")
+    return AggSpec(_VAR_CANON[e.name], _as_float(arg), T.FLOAT64_T)
+
+
+def _lower_variance(spec, arg_slot, partial_slot):
+    from citus_tpu.planner.physical import AggExtract
+    ai = arg_slot(spec.arg)
+    sq = arg_slot(BBinOp("*", spec.arg, spec.arg, T.FLOAT64_T))
+    s = partial_slot("sum", ai, "float64")
+    ss = partial_slot("sum", sq, "float64")
+    c = partial_slot("count", ai, "int64")
+    return AggExtract(spec.kind, [s, ss, c], spec.out_type, param=spec.param)
+
+
+def _finalize_variance(ex, partials, cat):
+    s = np.asarray(partials[ex.slots[0]], np.float64)
+    ss = np.asarray(partials[ex.slots[1]], np.float64)
+    n = np.asarray(partials[ex.slots[2]], np.float64)
+    pop = ex.kind.endswith("_pop")
+    min_n = 1 if pop else 2
+    valid = n >= min_n
+    safe_n = np.where(n > 0, n, 1)
+    # numerically: E[x^2] - E[x]^2, clamped (catastrophic cancellation
+    # can dip epsilon-negative); matches PostgreSQL's float8 accumulator
+    mean = s / safe_n
+    m2 = ss - safe_n * mean * mean
+    denom = safe_n if pop else np.where(n > 1, n - 1, 1)
+    var = np.maximum(m2 / denom, 0.0)
+    if ex.kind.startswith("stddev"):
+        var = np.sqrt(var)
+    return var, valid
+
+
+# ------------------------------------------------------------- booleans
+
+
+def _bind_bool(binder, e):
+    from citus_tpu.planner.bind import AggSpec
+    if len(e.args) != 1:
+        raise AnalysisError(f"{e.name}() expects one argument")
+    arg = binder.bind_scalar(e.args[0])
+    if arg.type.kind != T.BOOL:
+        raise AnalysisError(f"{e.name}() requires a boolean argument")
+    return AggSpec(e.name, BCast(arg, T.INT64_T), T.BOOL_T)
+
+
+def _lower_bool(spec, arg_slot, partial_slot):
+    from citus_tpu.planner.physical import AggExtract
+    ai = arg_slot(spec.arg)
+    kind = "min" if spec.kind == "bool_and" else "max"
+    v = partial_slot(kind, ai, "int64")
+    c = partial_slot("count", ai, "int64")
+    return AggExtract(spec.kind, [v, c], spec.out_type)
+
+
+def _finalize_bool(ex, partials, cat):
+    v = np.asarray(partials[ex.slots[0]])
+    c = np.asarray(partials[ex.slots[1]])
+    return v.astype(bool), c > 0
+
+
+# ------------------------------------------------- collect-based family
+
+
+def _bind_string_agg(binder, e):
+    from citus_tpu.planner import ast_nodes as A
+    from citus_tpu.planner.bind import AggSpec
+    from citus_tpu.planner.bound import BColumn
+    if len(e.args) != 2:
+        raise AnalysisError("string_agg() expects (expression, delimiter)")
+    arg = binder.bind_scalar(e.args[0])
+    if not arg.type.is_text:
+        raise AnalysisError("string_agg() requires a text argument")
+    d = e.args[1]
+    if not (isinstance(d, A.Literal) and isinstance(d.value, str)):
+        raise AnalysisError("string_agg() delimiter must be a string literal")
+    src = None
+    if isinstance(arg, BColumn):
+        src = binder.text_source(arg)
+    else:
+        from citus_tpu.planner.bound import walk
+        for nd in walk(arg):
+            if isinstance(nd, BColumn) and nd.type.is_text:
+                src = binder.text_source(nd)
+                break
+    if src is None:
+        raise UnsupportedFeatureError("string_agg() over computed text")
+    return AggSpec("string_agg", arg, T.TEXT_T, param=(d.value, src))
+
+
+def _lower_collect(spec, arg_slot, partial_slot):
+    from citus_tpu.planner.physical import AggExtract
+    ai = arg_slot(spec.arg)
+    s = partial_slot("collect", ai, "object")
+    return AggExtract(spec.kind, [s], spec.out_type, param=spec.param)
+
+
+def _finalize_string_agg(ex, partials, cat):
+    delim, src = ex.param
+    lists = np.asarray(partials[ex.slots[0]], object)
+    out = np.empty(lists.shape[0], object)
+    valid = np.zeros(lists.shape[0], bool)
+    for i, vals in enumerate(lists):
+        if vals:
+            words = cat.decode_strings(src[0], src[1], [int(v) for v in vals])
+            out[i] = delim.join(w for w in words if w is not None)
+            valid[i] = True
+    return out, valid
+
+
+def _bind_array_agg(binder, e):
+    from citus_tpu.planner.bind import AggSpec
+    from citus_tpu.planner.bound import BColumn
+    if len(e.args) != 1:
+        raise AnalysisError("array_agg() expects one argument")
+    arg = binder.bind_scalar(e.args[0])
+    src = None
+    if arg.type.is_text and isinstance(arg, BColumn):
+        src = binder.text_source(arg)
+    return AggSpec("array_agg", arg, arg.type, param=("array", src))
+
+
+def _finalize_array_agg(ex, partials, cat):
+    _tag, src = ex.param
+    lists = np.asarray(partials[ex.slots[0]], object)
+    out = np.empty(lists.shape[0], object)
+    valid = np.zeros(lists.shape[0], bool)
+    for i, vals in enumerate(lists):
+        if vals:
+            if src is not None:
+                out[i] = cat.decode_strings(src[0], src[1],
+                                            [int(v) for v in vals])
+            else:
+                out[i] = [ex.out_type.from_physical(v) for v in vals]
+            valid[i] = True
+    return out, valid
+
+
+def _bind_percentile(binder, e):
+    """percentile_cont(frac) WITHIN GROUP (ORDER BY x) arrives desugared
+    as FuncCall(name, (frac_literal, x))."""
+    from citus_tpu.planner import ast_nodes as A
+    from citus_tpu.planner.bind import AggSpec
+    if len(e.args) != 2:
+        raise AnalysisError(f"{e.name}() requires WITHIN GROUP (ORDER BY ...)")
+    f = e.args[0]
+    if not (isinstance(f, A.Literal) and isinstance(f.value, (int, float)) or
+            (isinstance(f, A.Literal) and str(type(f.value).__name__) == "Decimal")):
+        raise AnalysisError(f"{e.name}() fraction must be a numeric literal")
+    frac = float(f.value)
+    if not (0.0 <= frac <= 1.0):
+        raise AnalysisError("percentile fraction must be in [0, 1]")
+    arg = binder.bind_scalar(e.args[1])
+    if arg.type.is_text:
+        raise UnsupportedFeatureError(f"{e.name}() over text not supported")
+    out = T.FLOAT64_T if e.name == "percentile_cont" else arg.type
+    return AggSpec(e.name, arg, out, param=frac)
+
+
+def _finalize_percentile(ex, partials, cat):
+    frac = ex.param
+    lists = np.asarray(partials[ex.slots[0]], object)
+    out = np.empty(lists.shape[0], object)
+    valid = np.zeros(lists.shape[0], bool)
+    cont = ex.kind == "percentile_cont"
+    for i, vals in enumerate(lists):
+        if not vals:
+            continue
+        v = np.sort(np.asarray(vals, np.float64 if cont else None))
+        if cont:
+            pos = frac * (len(v) - 1)
+            lo = int(math.floor(pos))
+            hi = min(lo + 1, len(v) - 1)
+            out[i] = float(v[lo] + (pos - lo) * (v[hi] - v[lo]))
+        else:
+            # discrete: first value whose cumulative fraction >= frac
+            idx = int(math.ceil(frac * len(v))) - 1 if frac > 0 else 0
+            out[i] = v[max(0, min(idx, len(v) - 1))]
+        valid[i] = True
+    return out, valid
+
+
+AGG_REGISTRY: dict[str, AggDef] = {}
+
+
+def register(defn: AggDef) -> None:
+    AGG_REGISTRY[defn.name] = defn
+
+
+for _n in ("variance", "var_samp", "var_pop", "stddev", "stddev_samp",
+           "stddev_pop"):
+    register(AggDef(_n, _bind_variance, _lower_variance, _finalize_variance))
+for _n in ("bool_and", "bool_or"):
+    register(AggDef(_n, _bind_bool, _lower_bool, _finalize_bool))
+register(AggDef("string_agg", _bind_string_agg, _lower_collect,
+                _finalize_string_agg, needs_exact=True))
+register(AggDef("array_agg", _bind_array_agg, _lower_collect,
+                _finalize_array_agg, needs_exact=True))
+for _n in ("percentile_cont", "percentile_disc"):
+    register(AggDef(_n, _bind_percentile, _lower_collect,
+                    _finalize_percentile, needs_exact=True))
+
+
+def finalize_kind(kind: str):
+    """Finalizer lookup by canonical extract kind (canonical variance
+    names differ from their aliases)."""
+    d = AGG_REGISTRY.get(kind)
+    return d.finalize if d is not None else None

@@ -31,10 +31,13 @@ AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
 
 @dataclass(frozen=True)
 class AggSpec:
-    kind: str              # sum | count | count_star | avg | min | max
+    kind: str              # sum | count | count_star | avg | min | max | registry name
     arg: Optional[BExpr]   # None for count_star
     out_type: T.ColumnType
     distinct: bool = False
+    # extra aggregate parameter (percentile fraction, string_agg
+    # delimiter + dictionary source, ...) — hashable for dedup
+    param: object = None
 
 
 @dataclass
@@ -472,11 +475,15 @@ class Binder:
                          aggs: list[AggSpec]) -> BExpr:
         """Bind an output/having expression of a grouped query: aggregates
         become BAggRef slots, grouping-key subexpressions become BKeyRef."""
-        if isinstance(e, A.FuncCall) and e.name in AGG_FUNCS:
-            if e.distinct and e.name not in ("count",):
+        from citus_tpu.planner.aggregates import AGG_REGISTRY
+        if isinstance(e, A.FuncCall) and (e.name in AGG_FUNCS
+                                          or e.name in AGG_REGISTRY):
+            if e.name in AGG_REGISTRY:
+                spec = AGG_REGISTRY[e.name].bind(self, e)
+            elif e.distinct and e.name not in ("count",):
                 raise UnsupportedFeatureError(
                     f"DISTINCT is only supported for count() yet, not {e.name}()")
-            if e.name == "count" and (not e.args or isinstance(e.args[0], A.Star)):
+            elif e.name == "count" and (not e.args or isinstance(e.args[0], A.Star)):
                 spec = AggSpec("count_star", None, T.INT64_T)
             else:
                 if len(e.args) != 1:
@@ -626,7 +633,8 @@ def bind_select(catalog: Catalog, stmt: A.Select) -> BoundSelect:
 
 def _contains_agg(e: A.Expr) -> bool:
     if isinstance(e, A.FuncCall):
-        if e.name in AGG_FUNCS:
+        from citus_tpu.planner.aggregates import AGG_REGISTRY
+        if e.name in AGG_FUNCS or e.name in AGG_REGISTRY:
             return True
         return any(_contains_agg(a) for a in e.args)
     if isinstance(e, A.BinOp):

@@ -40,7 +40,7 @@ KEYWORDS = {
     "using", "with", "like", "delete", "update", "set", "truncate",
     "vacuum", "copy", "alter", "add", "column", "rename", "to",
     "schema", "cascade", "merge", "matched", "nothing", "do", "over",
-    "partition", "union", "intersect", "except", "all",
+    "partition", "union", "intersect", "except", "all", "within",
 }
 
 
@@ -862,6 +862,21 @@ class Parser:
                             break
                 self.expect_op(")")
                 fc = A.FuncCall(t.value, tuple(args), distinct)
+                if self.at_kw("within"):
+                    # ordered-set aggregate: percentile_cont(f) WITHIN
+                    # GROUP (ORDER BY x) desugars to fn(f, x)
+                    self.next()
+                    self.expect_kw("group")
+                    self.expect_op("(")
+                    self.expect_kw("order")
+                    self.expect_kw("by")
+                    sort_expr = self.parse_expr()
+                    if self.accept_kw("desc"):
+                        self.error("WITHIN GROUP (ORDER BY ... DESC) is not "
+                                   "supported; use 1 - fraction")
+                    self.accept_kw("asc")
+                    self.expect_op(")")
+                    fc = A.FuncCall(t.value, tuple(args) + (sort_expr,), distinct)
                 if self.at_kw("over"):
                     self.next()
                     self.expect_op("(")

@@ -51,9 +51,10 @@ class PartialOp:
 @dataclass
 class AggExtract:
     """How to produce one SQL aggregate's value from partial slots."""
-    kind: str        # sum | count | count_star | avg | min | max
+    kind: str        # sum | count | count_star | avg | min | max | registry
     slots: list[int] # indexes into partial op results
     out_type: T.ColumnType
+    param: object = None  # registry-aggregate parameter (fraction, delim, ...)
 
 
 @dataclass
@@ -214,10 +215,14 @@ def _key_domain(cat: Catalog, table: TableMeta, key: BExpr,
 
 
 def choose_group_mode(cat: Catalog, bound: BoundSelect, direct_limit: int) -> GroupMode:
-    # distinct aggregates need exact value sets: only the host grouping
-    # path carries them (reference: worker_partial_agg cannot combine
-    # DISTINCT either and falls back to pulling rows)
-    if any(a.distinct for a in bound.aggs):
+    # distinct and collect-based aggregates need exact value multisets:
+    # only the host grouping path carries them (reference:
+    # worker_partial_agg cannot combine DISTINCT either and falls back to
+    # pulling rows)
+    from citus_tpu.planner.aggregates import AGG_REGISTRY
+    if any(a.distinct or (a.kind in AGG_REGISTRY
+                          and AGG_REGISTRY[a.kind].needs_exact)
+           for a in bound.aggs):
         return GroupMode(kind="hash_host")
     if not bound.group_keys:
         return GroupMode(kind="scalar")
@@ -294,7 +299,11 @@ def lower_aggregates(aggs: list[AggSpec]) -> tuple[list[BExpr], list[PartialOp],
             c = partial_slot("count", ai, "int64")
             extracts.append(AggExtract(spec.kind, [s, c], spec.out_type))
         else:
-            raise AssertionError(spec.kind)
+            from citus_tpu.planner.aggregates import AGG_REGISTRY
+            defn = AGG_REGISTRY.get(spec.kind)
+            if defn is None:
+                raise AssertionError(spec.kind)
+            extracts.append(defn.lower(spec, arg_slot, partial_slot))
     return agg_args, partials, extracts
 
 
