@@ -42,6 +42,46 @@ def _has_derived(item) -> bool:
     return False
 
 
+def _max_param_index(stmt) -> int:
+    """Highest $N referenced anywhere in a SELECT (0 when none)."""
+    mx = 0
+
+    def visit(e):
+        nonlocal mx
+        if isinstance(e, A.Param):
+            mx = max(mx, e.index)
+        elif isinstance(e, A.BinOp):
+            visit(e.left), visit(e.right)
+        elif isinstance(e, A.UnOp):
+            visit(e.operand)
+        elif isinstance(e, A.Between):
+            visit(e.expr), visit(e.lo), visit(e.hi)
+        elif isinstance(e, A.InList):
+            visit(e.expr)
+            for it in e.items:
+                visit(it)
+        elif isinstance(e, (A.IsNull, A.Cast)):
+            visit(e.expr)
+        elif isinstance(e, A.CaseExpr):
+            for c, v in e.whens:
+                visit(c), visit(v)
+            if e.else_ is not None:
+                visit(e.else_)
+        elif isinstance(e, A.FuncCall):
+            for a in e.args:
+                visit(a)
+
+    for item in stmt.items:
+        visit(item.expr)
+    visit(stmt.where)
+    visit(stmt.having)
+    for g in stmt.group_by:
+        visit(g)
+    for o in stmt.order_by:
+        visit(o.expr)
+    return mx
+
+
 def _sort_rows(rows, names, order_by):
     """ORDER BY over materialized rows: items resolve by output position
     or output column name (PostgreSQL's rule for set operations)."""
@@ -406,11 +446,16 @@ class Cluster:
         try:
             for stmt in stmts:
                 if params is not None:
+                    # parameterized plans: cached generic plan + deferred
+                    # pruning when the query shape supports it (reference:
+                    # Job->deferredPruning, fast_path_router_planner.c)
+                    if len(stmts) == 1 and isinstance(stmt, A.Select):
+                        r = self._execute_param_select(sql, stmt, list(params))
+                        if r is not None:
+                            result = r
+                            continue
                     from citus_tpu.planner.recursive import rewrite_params
                     stmt = rewrite_params(stmt, list(params))
-                # parameterized statements skip the text-keyed plan cache
-                # (deferred-pruning parameterized plans are a later
-                # milestone, reference: Job->deferredPruning)
                 key = sql if (len(stmts) == 1 and params is None) else None
                 result = self._execute_stmt(stmt, sql_text=key)
         finally:
@@ -423,6 +468,50 @@ class Cluster:
         if rkey is not None:
             self.tenant_stats.record(str(rkey), elapsed)
         return result
+
+    def _execute_param_select(self, sql: str, stmt: A.Select,
+                              params: list) -> Optional[Result]:
+        """Execute a parameterized SELECT through the generic-plan cache:
+        bind once with $N slots, prune shards at bind-value time, reuse
+        jitted kernels across values.  Returns None when the query shape
+        needs the literal-substitution fallback."""
+        from citus_tpu.planner.recursive import has_subquery
+        if not isinstance(stmt.from_, A.TableRef):
+            return None
+        if any(isinstance(i.expr, A.WindowCall) for i in stmt.items):
+            return None
+        exprs = ([i.expr for i in stmt.items] + [stmt.where, stmt.having]
+                 + stmt.group_by + [o.expr for o in stmt.order_by])
+        if any(e is not None and has_subquery(e) for e in exprs):
+            return None
+        n_params = _max_param_index(stmt)
+        if n_params > len(params):
+            raise AnalysisError(
+                f"query references ${n_params} but only "
+                f"{len(params)} parameters were supplied")
+        key = ("$param", sql)
+        backend = self.settings.executor.task_executor_backend
+        cached = self._plan_cache.get(key)
+        if cached is not None:
+            bound, plan, version, epoch, cbackend = cached
+            if (epoch == self.catalog.ddl_epoch
+                    and bound.table.version == version
+                    and cbackend == backend):
+                self.counters.bump("plan_cache_hits")
+                return execute_select(self.catalog, bound, self.settings,
+                                      plan=plan, param_values=params)
+        try:
+            bound = bind_select(self.catalog, stmt, param_count=n_params)
+        except UnsupportedFeatureError:
+            return None  # fall back to literal substitution
+        from citus_tpu.planner.physical import plan_select
+        plan = plan_select(self.catalog, bound,
+                           direct_limit=self.settings.planner.direct_gid_limit)
+        self._plan_cache[key] = (bound, plan, bound.table.version,
+                                 self.catalog.ddl_epoch, backend)
+        self.counters.bump("plan_cache_misses")
+        return execute_select(self.catalog, bound, self.settings, plan=plan,
+                              param_values=params)
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         if isinstance(stmt, A.WithSelect):

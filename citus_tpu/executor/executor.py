@@ -86,8 +86,36 @@ def _load_all_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> l
 # ------------------------------------------------------------ agg paths
 
 
-def _run_partials_cpu(cat: Catalog, plan: PhysicalPlan, settings: Settings):
+def encode_params(cat: Catalog, bound, values: Optional[list]):
+    """$N python values -> (tuple of 0-d value arrays, tuple of 0-d
+    valid arrays) per bound.param_specs.  Text parameters resolve
+    through the column's dictionary; unseen strings map to -1 (match
+    nothing, like a nonexistent id)."""
+    if not bound.param_specs:
+        return (), ()
+    if values is None or len(values) < len(bound.param_specs):
+        raise ExecutionError(
+            f"query requires {len(bound.param_specs)} parameters")
+    pcols, pvalids = [], []
+    for (ptype, src), v in zip(bound.param_specs, values):
+        if v is None:
+            pcols.append(np.zeros((), ptype.device_dtype))
+            pvalids.append(np.zeros((), bool))
+            continue
+        if ptype.is_text:
+            pid = cat.lookup_string_id(src[0], src[1], str(v))
+            phys = -1 if pid is None else pid
+        else:
+            phys = ptype.to_physical(v)
+        pcols.append(np.asarray(phys, ptype.device_dtype))
+        pvalids.append(np.ones((), bool))
+    return tuple(pcols), tuple(pvalids)
+
+
+def _run_partials_cpu(cat: Catalog, plan: PhysicalPlan, settings: Settings,
+                      params=((), ())):
     worker = build_worker_fn(plan, np)
+    pcols, pvalids = params
     shard_results = []
     for si in plan.shard_indexes:
         for values, masks, n in load_shard_batches(
@@ -95,7 +123,8 @@ def _run_partials_cpu(cat: Catalog, plan: PhysicalPlan, settings: Settings):
             cols = tuple(values[c].astype(plan.bound.table.schema.column(c).type.device_dtype,
                                           copy=False) for c in plan.scan_columns)
             valids = tuple(masks[c] for c in plan.scan_columns)
-            shard_results.append(worker(cols, valids, np.ones(n, bool)))
+            shard_results.append(worker(cols + pcols, valids + pvalids,
+                                        np.ones(n, bool)))
     if not shard_results:
         shard_results.append(_empty_partials(plan, np))
     return combine_partials_host(plan, shard_results)
@@ -144,11 +173,13 @@ def _device_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
     return dev_batches
 
 
-def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings):
+def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
+                      params=((), ())):
     import jax
     import jax.numpy as jnp
     from citus_tpu.parallel.mesh import default_mesh, sharded_partial_agg, shard_axis_size
 
+    pcols, pvalids = params
     devices = jax.devices()
     if len(devices) > 1:
         batches = _load_all_batches(cat, plan, settings)
@@ -167,14 +198,18 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings):
             run = sharded_partial_agg(worker, kinds, mesh)
             plan.runtime_cache["mesh_run"] = run
         bucket = batches[0].padded_rows
+        # parameters replicate across the shard axis ([n_dev] stacks of
+        # the 0-d values)
+        p_stack = tuple(np.stack([p] * n_dev) for p in pcols)
+        pv_stack = tuple(np.stack([v] * n_dev) for v in pvalids)
         for start in range(0, len(batches), n_dev):
             round_batches = batches[start:start + n_dev]
             while len(round_batches) < n_dev:
                 round_batches.append(empty_batch(plan.bound.table, plan, bucket, -1))
             cols = tuple(np.stack([b.cols[i] for b in round_batches])
-                         for i in range(len(plan.scan_columns)))
+                         for i in range(len(plan.scan_columns))) + p_stack
             valids = tuple(np.stack([b.valids[i] for b in round_batches])
-                           for i in range(len(plan.scan_columns)))
+                           for i in range(len(plan.scan_columns))) + pv_stack
             row_mask = np.stack([b.row_mask for b in round_batches])
             out = run(cols, valids, row_mask)
             acc.append(tuple(np.asarray(o) for o in out))
@@ -205,7 +240,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings):
         acc_dev = None
         for b in batches:
             t0 = time.perf_counter()
-            out = jitted(b.cols, b.valids, b.row_mask)
+            out = jitted(b.cols + pcols, b.valids + pvalids, b.row_mask)
             acc_dev = out if acc_dev is None else merge(acc_dev, out)
             task_times.append((b.shard_index, b.n_rows, time.perf_counter() - t0))
         plan.runtime_cache["task_times"] = task_times
@@ -225,25 +260,34 @@ def _decode_direct_keys(plan: PhysicalPlan, rows: np.ndarray):
     return keys, occupied
 
 
-def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[tuple]:
+def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings,
+             params=((), ())) -> list[tuple]:
     backend = settings.executor.task_executor_backend
     mode = plan.group_mode.kind
+    penv = _params_env(params)
     if mode in ("scalar", "direct"):
         partials = (_run_partials_cpu if backend == "cpu" else _run_partials_jax)(
-            cat, plan, settings)
+            cat, plan, settings, params)
         if mode == "scalar":
             partials = tuple(np.asarray(p).reshape(1) for p in partials)
-            return finalize_groups(plan, cat, [], partials)
+            return finalize_groups(plan, cat, [], partials, params_env=penv)
         *parts, rows = partials
         keys, occupied = _decode_direct_keys(plan, rows)
         if occupied.size == 0:
             return []
         sel_parts = tuple(np.asarray(p)[occupied] for p in parts)
-        return finalize_groups(plan, cat, keys, sel_parts)
-    return _run_agg_hash_host(cat, plan, settings)
+        return finalize_groups(plan, cat, keys, sel_parts, params_env=penv)
+    return _run_agg_hash_host(cat, plan, settings, params)
 
 
-def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[tuple]:
+def _params_env(params) -> dict:
+    pcols, pvalids = params
+    return {f"__param_{i}": (c, v)
+            for i, (c, v) in enumerate(zip(pcols, pvalids))}
+
+
+def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
+                       params=((), ())) -> list[tuple]:
     """Unbounded GROUP BY cardinality.
 
     tpu backend: device-side open-addressed hash aggregation
@@ -253,6 +297,8 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
 
     backend = settings.executor.task_executor_backend
     acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
+    pcols, pvalids = params
+    penv = _params_env(params)
 
     # distinct/collect partial states are exact value (multi)sets: only
     # the host accumulation path can carry them
@@ -273,19 +319,21 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
         arg_fns_np = [_ce(a, np) for a in plan.agg_args]
         batches = _load_all_batches(cat, plan, settings)
         for b in batches:
-            key_tables, partials, rows, spill = jitted(b.cols, b.valids, b.row_mask)
+            key_tables, partials, rows, spill = jitted(
+                b.cols + pcols, b.valids + pvalids, b.row_mask)
             merge_hash_tables_into(acc, plan, key_tables, partials, rows)
             spill = np.asarray(spill)
             if spill.any():
                 env = {n: (np.asarray(c), np.asarray(v))
                        for n, c, v in zip(plan.scan_columns, b.cols, b.valids)}
+                env.update(penv)
                 keys = [f(env) for f in key_fns_np]
                 args = [f(env) for f in arg_fns_np]
                 acc.add_batch(spill, keys, args)
         key_arrays, partials = acc.finalize([k.type for k in plan.bound.group_keys])
         if partials is None:
             return []
-        return finalize_groups(plan, cat, key_arrays, partials)
+        return finalize_groups(plan, cat, key_arrays, partials, params_env=penv)
 
     worker = build_worker_fn(plan, np)
     for si in plan.shard_indexes:
@@ -294,7 +342,8 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
             cols = tuple(values[c].astype(plan.bound.table.schema.column(c).type.device_dtype,
                                           copy=False) for c in plan.scan_columns)
             valids = tuple(masks[c] for c in plan.scan_columns)
-            mask, keys, args = worker(cols, valids, np.ones(n, bool))
+            mask, keys, args = worker(cols + pcols, valids + pvalids,
+                                      np.ones(n, bool))
             acc.add_batch(np.asarray(mask),
                           [(np.asarray(v), m if isinstance(m, bool) else np.asarray(m))
                            for v, m in keys],
@@ -304,15 +353,19 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> 
                                         scalar=not plan.bound.group_keys)
     if partials is None:
         return []
-    return finalize_groups(plan, cat, key_arrays, partials)
+    return finalize_groups(plan, cat, key_arrays, partials, params_env=penv)
 
 
 # ----------------------------------------------------------- projection
 
 
-def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[tuple]:
+def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
+                    params=((), ())) -> list[tuple]:
     backend = settings.executor.task_executor_backend
     use_jax = backend != "cpu"
+    pcols, pvalids = params
+    penv = _params_env(params)
+    pnames = tuple(penv)
     filter_fn = None
     if use_jax and plan.bound.filter is not None:
         import jax
@@ -322,9 +375,10 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> lis
         filter_fn = plan.runtime_cache.get("jit_filter")
         if filter_fn is None:
             cfn = compile_expr(plan.bound.filter, jnp)
+            all_names = tuple(plan.scan_columns) + pnames
 
             def device_mask(cols, valids, row_mask):
-                env = {n: (c, v) for n, c, v in zip(plan.scan_columns, cols, valids)}
+                env = {n: (c, v) for n, c, v in zip(all_names, cols, valids)}
                 return row_mask & predicate_mask(jnp, cfn, env, row_mask)
             filter_fn = jax.jit(device_mask)
             plan.runtime_cache["jit_filter"] = filter_fn
@@ -337,7 +391,8 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> lis
                                           copy=False) for c in plan.scan_columns)
             valids = tuple(masks[c] for c in plan.scan_columns)
             if filter_fn is not None:
-                mask = np.asarray(filter_fn(cols, valids, np.ones(n, bool)))
+                mask = np.asarray(filter_fn(cols + pcols, valids + pvalids,
+                                            np.ones(n, bool)))
             elif plan.bound.filter is not None:
                 from citus_tpu.planner.bound import compile_expr, predicate_mask
                 cfn_np = plan.runtime_cache.get("np_filter")
@@ -345,11 +400,13 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> lis
                     cfn_np = compile_expr(plan.bound.filter, np)
                     plan.runtime_cache["np_filter"] = cfn_np
                 env = {c: (cols[i], valids[i]) for i, c in enumerate(plan.scan_columns)}
+                env.update(penv)
                 mask = np.asarray(predicate_mask(np, cfn_np, env, np.ones(n, bool)))
                 mask = mask & np.ones(n, bool)
             else:
                 mask = np.ones(n, bool)
             env = {c: (cols[i], valids[i]) for i, c in enumerate(plan.scan_columns)}
+            env.update(penv)
             env_batches.append((env, mask))
     return project_rows(plan, cat, env_batches)
 
@@ -358,19 +415,29 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> lis
 
 
 def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
-                   plan: Optional[PhysicalPlan] = None) -> Result:
+                   plan: Optional[PhysicalPlan] = None,
+                   param_values: Optional[list] = None) -> Result:
     t0 = time.perf_counter()
     if plan is None:
         plan = plan_select(cat, bound, direct_limit=settings.planner.direct_gid_limit)
+    params = encode_params(cat, bound, param_values)
+    if bound.param_specs:
+        # deferred pruning: resolve the shard set for THESE parameter
+        # values on a per-execution view of the cached plan (shared
+        # runtime_cache, so jitted kernels are reused across values)
+        resolved = plan.resolve_shards(param_values)
+        if resolved != plan.shard_indexes:
+            import dataclasses
+            plan = dataclasses.replace(plan, shard_indexes=resolved)
     GLOBAL_COUNTERS.bump("queries_executed")
     if plan.is_router:
         GLOBAL_COUNTERS.bump("router_queries")
     elif len(plan.shard_indexes) > 1:
         GLOBAL_COUNTERS.bump("multi_shard_queries")
     if bound.has_aggs:
-        rows = _run_agg(cat, plan, settings)
+        rows = _run_agg(cat, plan, settings, params)
     else:
-        rows = _run_projection(cat, plan, settings)
+        rows = _run_projection(cat, plan, settings, params)
     rows = order_and_limit(plan, rows)
     if bound.hidden_outputs:
         keep = len(bound.output_names) - bound.hidden_outputs

@@ -114,11 +114,14 @@ def finalize_groups(
     key_arrays: list[tuple[np.ndarray, np.ndarray]],
     partials: tuple,
     text_src=None,
+    params_env: Optional[dict] = None,
 ) -> list[tuple]:
     """Grouped/aggregate query: evaluate final exprs per group -> rows."""
     bound = plan.bound
     aggs = extract_aggs(plan, partials, cat)
     env = {"__keys__": key_arrays, "__aggs__": aggs}
+    if params_env:
+        env.update(params_env)
     n_groups = key_arrays[0][0].shape[0] if key_arrays else (
         aggs[0][0].shape[0] if aggs else 1)
 
@@ -174,7 +177,9 @@ def project_rows(plan: PhysicalPlan, cat: Catalog, env_batches: list[dict],
         idx = np.nonzero(mask)[0]
         if idx.size == 0:
             continue
-        sel_env = {name: (np.asarray(v)[idx], np.asarray(m)[idx] if not isinstance(m, bool) else m)
+        sel_env = {name: ((v, m) if name.startswith("__param_")
+                          else (np.asarray(v)[idx],
+                                np.asarray(m)[idx] if not isinstance(m, bool) else m))
                    for name, (v, m) in env.items()}
         cols = []
         for e, fn in zip(bound.final_exprs, fns):

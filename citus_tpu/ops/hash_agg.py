@@ -45,7 +45,8 @@ def build_hash_agg_worker(plan: PhysicalPlan, xp, slots: int) -> Callable:
     filter_fn = compile_expr(plan.bound.filter, xp) if plan.bound.filter is not None else None
     key_fns = [compile_expr(k, xp) for k in plan.bound.group_keys]
     arg_fns = [compile_expr(a, xp) for a in plan.agg_args]
-    names = plan.scan_columns
+    names = plan.scan_columns + [f"__param_{i}"
+                                 for i in range(len(plan.bound.param_specs))]
     partial_ops = plan.partial_ops
     S = slots
 

@@ -47,7 +47,10 @@ def build_worker_fn(plan: PhysicalPlan, xp) -> Callable:
     arg_fns = [compile_expr(a, xp) for a in plan.agg_args]
     arg_types = [a.type for a in plan.agg_args]
     mode = plan.group_mode
-    names = plan.scan_columns
+    # $N parameters ride as trailing 0-d "columns": the jitted kernel
+    # treats them as traced inputs, so one compile serves every value
+    names = plan.scan_columns + [f"__param_{i}"
+                                 for i in range(len(plan.bound.param_specs))]
     partial_ops = plan.partial_ops
 
     def eval_mask(env, row_mask):

@@ -58,6 +58,9 @@ class BoundSelect:
     # trailing final_exprs appended only for ORDER BY on non-output
     # expressions; trimmed from the result after sorting
     hidden_outputs: int = 0
+    # parameterized plan: per-$N (ColumnType, text_source|None); values
+    # arrive at execute time as 0-d env arrays (deferred pruning)
+    param_specs: list = field(default_factory=list)
 
     @property
     def has_aggs(self) -> bool:
@@ -101,6 +104,9 @@ class Binder:
         self.table = table
         self.rels = rels or [(table.name, table)]
         self.qualified = len(self.rels) > 1
+        # $N parameter slots: 0-based index -> (ColumnType, text_source)
+        # populated by infer_param_types before a parameterized bind
+        self.param_types: dict[int, tuple] = {}
 
     def resolve_column(self, name: str, rel_alias: Optional[str] = None):
         """-> (env_key, Column, alias, TableMeta)."""
@@ -135,6 +141,14 @@ class Binder:
         if isinstance(e, A.ColumnRef):
             key, col, _, _ = self.resolve_column(e.name, e.table)
             return BColumn(key, col.type)
+        if isinstance(e, A.Param):
+            from citus_tpu.planner.bound import BParam
+            spec = self.param_types.get(e.index - 1)
+            if spec is None:
+                raise UnsupportedFeatureError(
+                    f"cannot infer a type for parameter ${e.index}; "
+                    "bind it by comparing against a typed column")
+            return BParam(e.index - 1, spec[0])
         if isinstance(e, A.Literal):
             return self._bind_literal(e)
         if isinstance(e, A.UnOp):
@@ -542,7 +556,94 @@ class Binder:
 # ------------------------------------------------------------------ select
 
 
-def bind_select(catalog: Catalog, stmt: A.Select) -> BoundSelect:
+def infer_param_types(binder: Binder, stmt: A.Select, n_params: int) -> dict:
+    """Infer $N parameter types from their comparison/arithmetic context
+    (the reference gets them from the protocol's Bind message; we derive
+    them from the query shape).  -> {0-based index: (type, text_src)}."""
+    types: dict[int, tuple] = {}
+
+    def try_bind(e):
+        try:
+            return binder.bind_scalar(e)
+        except Exception:
+            return None
+
+    def note(pi: int, other: A.Expr):
+        if pi in types:
+            return
+        bexp = try_bind(other)
+        if bexp is None:
+            return
+        src = None
+        if bexp.type.is_text:
+            from citus_tpu.planner.bound import BColumn
+            from citus_tpu.planner.bound import walk as bwalk
+            for nd in bwalk(bexp):
+                if isinstance(nd, BColumn) and nd.type.is_text:
+                    src = binder.text_source(nd)
+                    break
+            if src is None:
+                return
+        types[pi] = (bexp.type, src)
+
+    def visit(e):
+        if not isinstance(e, A.Expr):
+            return
+        if isinstance(e, A.BinOp):
+            if isinstance(e.left, A.Param) and not isinstance(e.right, A.Param):
+                note(e.left.index - 1, e.right)
+            if isinstance(e.right, A.Param) and not isinstance(e.left, A.Param):
+                note(e.right.index - 1, e.left)
+            visit(e.left)
+            visit(e.right)
+        elif isinstance(e, A.Between):
+            for x in (e.lo, e.hi):
+                if isinstance(x, A.Param):
+                    note(x.index - 1, e.expr)
+            if isinstance(e.expr, A.Param):
+                for x in (e.lo, e.hi):
+                    if not isinstance(x, A.Param):
+                        note(e.expr.index - 1, x)
+            visit(e.expr), visit(e.lo), visit(e.hi)
+        elif isinstance(e, A.InList):
+            for it in e.items:
+                if isinstance(it, A.Param):
+                    note(it.index - 1, e.expr)
+            visit(e.expr)
+            for it in e.items:
+                visit(it)
+        elif isinstance(e, A.Cast):
+            if isinstance(e.expr, A.Param):
+                types.setdefault(
+                    e.expr.index - 1,
+                    (T.type_from_sql(e.type_name, list(e.type_args) or None), None))
+            visit(e.expr)
+        elif isinstance(e, A.UnOp):
+            visit(e.operand)
+        elif isinstance(e, A.IsNull):
+            visit(e.expr)
+        elif isinstance(e, A.CaseExpr):
+            for c, v in e.whens:
+                visit(c), visit(v)
+            if e.else_ is not None:
+                visit(e.else_)
+        elif isinstance(e, A.FuncCall):
+            for a in e.args:
+                visit(a)
+
+    for item in stmt.items:
+        visit(item.expr)
+    visit(stmt.where)
+    visit(stmt.having)
+    for g in stmt.group_by:
+        visit(g)
+    for o in stmt.order_by:
+        visit(o.expr)
+    return types
+
+
+def bind_select(catalog: Catalog, stmt: A.Select,
+                param_count: int = 0) -> BoundSelect:
     if stmt.from_ is None:
         raise UnsupportedFeatureError("SELECT without FROM not supported")
     if isinstance(stmt.from_, A.Join):
@@ -553,6 +654,13 @@ def bind_select(catalog: Catalog, stmt: A.Select) -> BoundSelect:
     # through the FROM alias (or table name) must still resolve
     alias = stmt.from_.alias or stmt.from_.name
     b = Binder(catalog, table, rels=[(alias, table)])
+    if param_count:
+        b.param_types = infer_param_types(b, stmt, param_count)
+        if len(b.param_types) < param_count:
+            missing = [i + 1 for i in range(param_count)
+                       if i not in b.param_types]
+            raise UnsupportedFeatureError(
+                f"cannot infer types for parameters {missing}")
 
     # expand * early
     items: list[A.SelectItem] = []
@@ -628,6 +736,7 @@ def bind_select(catalog: Catalog, stmt: A.Select) -> BoundSelect:
         final_exprs=final_exprs, output_names=output_names, having=having,
         order_by=order_by, limit=stmt.limit, offset=stmt.offset,
         distinct=stmt.distinct, hidden_outputs=hidden,
+        param_specs=[b.param_types[i] for i in range(param_count)],
     )
 
 

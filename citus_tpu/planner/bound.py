@@ -41,6 +41,19 @@ class BLiteral(BExpr):
 
 
 @dataclass(frozen=True)
+class BParam(BExpr):
+    """Deferred $N parameter (reference: Job->deferredPruning /
+    fast-path prepared statements).  Compiles to an env lookup of a 0-d
+    runtime array — kernels jitted once serve every parameter value."""
+    index: int  # 0-based
+    type: T.ColumnType
+
+    @property
+    def env_name(self) -> str:
+        return f"__param_{self.index}"
+
+
+@dataclass(frozen=True)
 class BBinOp(BExpr):
     op: str  # + - * / % = <> < <= > >= and or
     left: BExpr
@@ -223,6 +236,9 @@ def compile_expr(e: BExpr, xp):
             return lambda env: (zero, False)
         val = e.type.device_dtype.type(e.value)
         return lambda env: (val, True)
+    if isinstance(e, BParam):
+        name = e.env_name
+        return lambda env: env[name]
     if isinstance(e, BAggRef):
         idx = e.index
         return lambda env: env["__aggs__"][idx]

@@ -88,10 +88,27 @@ class PhysicalPlan:
     runtime_cache: dict = field(default_factory=dict)
     # distribution-key literal when the router path was chosen (tenant id)
     router_key: Optional[object] = None
+    # deferred router pruning (reference: Job->deferredPruning): the
+    # filter pins the distribution column to $N — the executor prunes to
+    # one shard once the parameter value is bound, reusing this plan and
+    # its jitted kernels across values
+    router_param: Optional[int] = None
 
     @property
     def is_router(self) -> bool:
         return len(self.shard_indexes) == 1 and self.bound.table.is_distributed
+
+    def resolve_shards(self, param_values: Optional[list]) -> list[int]:
+        """Shard indexes for one execution; applies deferred pruning."""
+        if self.router_param is None or param_values is None:
+            return self.shard_indexes
+        v = param_values[self.router_param]
+        if v is None:
+            return []  # dist = NULL matches nothing
+        h = hash_int64_scalar(int(v))
+        idx = int(shard_index_for_hash(np.array([h], np.int32),
+                                       self.bound.table.shard_count)[0])
+        return [idx]
 
 
 # ------------------------------------------------------------ pruning
@@ -310,6 +327,23 @@ def lower_aggregates(aggs: list[AggSpec]) -> tuple[list[BExpr], list[PartialOp],
 # ------------------------------------------------------------ entry
 
 
+def _deferred_router_param(table: TableMeta, filter_: Optional[BExpr]) -> Optional[int]:
+    """distcol = $N in the filter -> parameter index for deferred pruning."""
+    from citus_tpu.planner.bound import BParam
+    if not table.is_distributed or table.dist_column is None:
+        return None
+    for c in _conjuncts(filter_):
+        if not (isinstance(c, BBinOp) and c.op == "="):
+            continue
+        left, right = c.left, c.right
+        if isinstance(right, BColumn) and isinstance(left, BParam):
+            left, right = right, left
+        if (isinstance(left, BColumn) and left.name == table.dist_column
+                and isinstance(right, BParam) and not right.type.is_float):
+            return right.index
+    return None
+
+
 def plan_select(cat: Catalog, bound: BoundSelect, *, direct_limit: int = 65536) -> PhysicalPlan:
     intervals = extract_intervals(bound.filter)
     shard_indexes, router_key = prune_shards(bound.table, bound.filter, return_key=True)
@@ -325,4 +359,5 @@ def plan_select(cat: Catalog, bound: BoundSelect, *, direct_limit: int = 65536) 
         partial_ops=partial_ops,
         agg_extract=agg_extract,
         router_key=router_key,
+        router_param=_deferred_router_param(bound.table, bound.filter),
     )

@@ -102,6 +102,10 @@ def bind_params(e, params):
     if isinstance(e, A.FuncCall):
         return A.FuncCall(e.name, tuple(bind_params(a, params) for a in e.args),
                           e.distinct)
+    if isinstance(e, A.Subquery):
+        return A.Subquery(rewrite_params(e.select, params))
+    if isinstance(e, A.Exists):
+        return A.Exists(rewrite_params(e.select, params), e.negated)
     return e
 
 
