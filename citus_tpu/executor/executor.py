@@ -307,7 +307,9 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     if backend != "cpu" and not has_exact:
         import jax
         import jax.numpy as jnp
-        from citus_tpu.ops.hash_agg import build_hash_agg_worker, merge_hash_tables_into
+        from citus_tpu.ops.hash_agg import (
+            build_hash_agg_worker, build_table_merge, merge_hash_tables_into,
+        )
         from citus_tpu.planner.bound import compile_expr as _ce
 
         S = settings.planner.hash_agg_slots
@@ -318,10 +320,63 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         key_fns_np = [_ce(k, np) for k in plan.bound.group_keys]
         arg_fns_np = [_ce(a, np) for a in plan.agg_args]
         batches = _load_all_batches(cat, plan, settings)
+        if not batches:
+            key_arrays, partials = acc.finalize(
+                [k.type for k in plan.bound.group_keys],
+                scalar=not plan.bound.group_keys)
+            if partials is None:
+                return []
+            return finalize_groups(plan, cat, key_arrays, partials,
+                                   params_env=penv)
+        dev_tables = []   # per-batch (key_tables, partials, rows) on device
+        spills = []       # (batch, device spill mask)
         for b in batches:
             key_tables, partials, rows, spill = jitted(
                 b.cols + pcols, b.valids + pvalids, b.row_mask)
-            merge_hash_tables_into(acc, plan, key_tables, partials, rows)
+            dev_tables.append((key_tables, partials, rows))
+            spills.append((b, spill))
+        entry_spill = None
+        entries = None
+        if len(dev_tables) > 1:
+            # combine ON DEVICE (VERDICT #8): occupied table entries are
+            # rows of (keys, partial states); re-insert them with merge
+            # semantics.  Table count pads to a power of two so the merge
+            # kernel compiles once per bucket.
+            n_pad = 1 << (len(dev_tables) - 1).bit_length()
+            while len(dev_tables) < n_pad:
+                zt = tuple((jnp.zeros_like(kv), jnp.zeros_like(kf))
+                           for kv, kf in dev_tables[0][0])
+                zp = tuple(jnp.zeros_like(p) for p in dev_tables[0][1])
+                dev_tables.append((zt, zp, jnp.zeros_like(dev_tables[0][2])))
+            entries = (
+                tuple((jnp.concatenate([t[0][ki][0] for t in dev_tables]),
+                       jnp.concatenate([t[0][ki][1] for t in dev_tables]))
+                      for ki in range(len(plan.bound.group_keys))),
+                tuple(jnp.concatenate([t[1][pi] for t in dev_tables])
+                      for pi in range(len(plan.partial_ops))),
+                jnp.concatenate([t[2] for t in dev_tables]),
+            )
+            mkey = f"jit_table_merge_{n_pad}"
+            merge_jit = plan.runtime_cache.get(mkey)
+            if merge_jit is None:
+                merge_jit = jax.jit(build_table_merge(plan, jnp, S))
+                plan.runtime_cache[mkey] = merge_jit
+            key_tables, partials, rows, entry_spill = merge_jit(*entries)
+        else:
+            key_tables, partials, rows = dev_tables[0]
+        # ONE synchronized fetch per query: the merged table + spill masks
+        fetched = jax.device_get(
+            (key_tables, partials, rows,
+             entry_spill if entry_spill is not None else (),
+             [s for _, s in spills]))
+        h_keys, h_partials, h_rows, h_entry_spill, h_spills = fetched
+        merge_hash_tables_into(acc, plan, h_keys, h_partials, h_rows)
+        if entries is not None and np.asarray(h_entry_spill).any():
+            # fingerprint-collision losers among entries: merge exactly
+            e_keys, e_partials, e_rows = jax.device_get(entries)
+            merge_hash_tables_into(acc, plan, e_keys, e_partials, e_rows,
+                                   entry_mask=np.asarray(h_entry_spill))
+        for (b, _), spill in zip(spills, h_spills):
             spill = np.asarray(spill)
             if spill.any():
                 env = {n: (np.asarray(c), np.asarray(v))
