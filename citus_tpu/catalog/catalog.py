@@ -214,6 +214,11 @@ class Catalog:
             # dictionaries are persisted (fsync'd) by encode_strings at
             # growth time, before any commit record can reference their
             # ids — nothing to write here
+        # control-plane invalidation hook (set by Cluster when an RPC
+        # control plane is attached): peers learn of this commit by push
+        cb = getattr(self, "on_commit", None)
+        if cb is not None:
+            cb()
 
     # ---- tables -------------------------------------------------------
     def table(self, name: str) -> TableMeta:

@@ -140,7 +140,9 @@ def _infer_column_type(vals):
 
 class Cluster:
     def __init__(self, data_dir: str, *, n_nodes: Optional[int] = None,
-                 settings: Optional[Settings] = None):
+                 settings: Optional[Settings] = None,
+                 serve_port: Optional[int] = None,
+                 coordinator: Optional[tuple] = None):
         self.settings = settings or current_settings()
         self.catalog = Catalog(data_dir)
         if n_nodes is None:
@@ -171,6 +173,37 @@ class Cluster:
         self.tenant_stats = TenantStats()
         self.activity = ActivityTracker()
         self.locks = LockManager()
+        # control plane (reference: metadata sync + 2PC votes over libpq;
+        # here an RPC skeleton — net/control_plane.py).  serve_port=N
+        # makes this coordinator the metadata authority; coordinator=
+        # (host, port) joins one.  Without either, multi-coordinator
+        # invalidation falls back to catalog-file mtime polling.
+        self._catalog_dirty = False
+        self._control = None
+        if serve_port is not None or coordinator is not None:
+            from citus_tpu.net.control_plane import ControlPlane
+            self._control = ControlPlane(self, serve_port=serve_port,
+                                         coordinator=coordinator)
+        self.catalog.on_commit = self._on_catalog_commit
+        # mtime-poll baseline: our own open-time commit; anything newer
+        # is a foreign change (avoids missing commits that land between
+        # construction and the first execute)
+        self._catalog_mtime = getattr(self.catalog, "self_mtime", None)
+
+    def _peer_inflight(self) -> set:
+        if self._control is not None:
+            return self._control.peer_inflight_xids()
+        return set()
+
+    def _on_catalog_commit(self) -> None:
+        if self._control is not None:
+            self._control.publish_catalog_change()
+
+    @property
+    def control_port(self) -> Optional[int]:
+        if self._control is not None and self._control.server is not None:
+            return self._control.server.port
+        return None
 
     @property
     def background_jobs(self):
@@ -195,7 +228,9 @@ class Cluster:
             d = MaintenanceDaemon(self.catalog)
             # 2PC recovery duty (reference: Recover2PCInterval, default 60 s)
             d.register("transaction_recovery",
-                       lambda: recover_transactions(self.catalog, self.txlog),
+                       lambda: recover_transactions(
+                           self.catalog, self.txlog,
+                           peer_inflight=self._peer_inflight()),
                        interval_s=60.0)
             d.start()
             self._maintenance = d
@@ -206,6 +241,8 @@ class Cluster:
             self._background_jobs.stop()
         if self._maintenance is not None:
             self._maintenance.stop()
+        if self._control is not None:
+            self._control.close()
         # release the transaction-log owner marker: our undecided
         # transactions become recoverable by other coordinators
         self.txlog.close()
@@ -237,12 +274,19 @@ class Cluster:
         """Pick up metadata written by other coordinators sharing this
         data dir (the query-from-any-node / MX analog: any process can
         plan and execute once metadata is synced; reference:
-        metadata/metadata_sync.c).  Writes made by THIS process must not
-        trigger a reload: concurrent sessions hold references into the
-        live catalog, and reloading underneath them (clear + load) is a
-        read-tear race — the analog of the reference only invalidating
-        on foreign syscache invalidations."""
+        metadata/metadata_sync.c).  With a control plane attached,
+        invalidation arrives as an RPC push (syscache-invalidation
+        analog); otherwise fall back to catalog-file mtime polling.
+        Writes made by THIS process must not trigger a reload:
+        concurrent sessions hold references into the live catalog, and
+        reloading underneath them (clear + load) is a read-tear race."""
         import os
+        if self._control is not None and self._control.connected:
+            if not self._catalog_dirty:
+                return
+            self._catalog_dirty = False
+            self._reload_catalog()
+            return
         p = self.catalog._path()
         try:
             mtime = os.path.getmtime(p)
@@ -256,15 +300,18 @@ class Cluster:
             return
         if mtime != self._catalog_mtime:
             self._catalog_mtime = mtime
-            with self.catalog._lock:
-                self.catalog.tables.clear()
-                self.catalog.nodes.clear()
-                self.catalog._dicts.clear()
-                self.catalog._dict_index.clear()
-                self.catalog._dict_sig.clear()
-                self.catalog._load()
-                self.catalog.ddl_epoch += 1  # invalidate cached plans
-            self._plan_cache.clear()
+            self._reload_catalog()
+
+    def _reload_catalog(self) -> None:
+        with self.catalog._lock:
+            self.catalog.tables.clear()
+            self.catalog.nodes.clear()
+            self.catalog._dicts.clear()
+            self.catalog._dict_index.clear()
+            self.catalog._dict_sig.clear()
+            self.catalog._load()
+            self.catalog.ddl_epoch += 1  # invalidate cached plans
+        self._plan_cache.clear()
 
     # ------------------------------------------------------------- DDL
     def create_table(self, name: str, schema: Schema, *, if_not_exists: bool = False,
@@ -1311,7 +1358,8 @@ class Cluster:
                           rows=list_restore_points(self.catalog))
         if name == "recover_prepared_transactions":
             from citus_tpu.transaction.recovery import recover_transactions
-            st = recover_transactions(self.catalog, self.txlog)
+            st = recover_transactions(self.catalog, self.txlog,
+                                      peer_inflight=self._peer_inflight())
             return Result(columns=["recover_prepared_transactions"],
                           rows=[(st["rolled_forward"] + st["rolled_back"],)])
         raise UnsupportedFeatureError(f"utility {name}() not supported yet")

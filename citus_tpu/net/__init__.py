@@ -1,0 +1,3 @@
+from citus_tpu.net.rpc import RpcClient, RpcServer
+
+__all__ = ["RpcClient", "RpcServer"]

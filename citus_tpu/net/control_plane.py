@@ -1,0 +1,131 @@
+"""Coordinator control plane over RPC.
+
+Reference mapping (metadata/metadata_sync.c, transaction/
+worker_transaction.c): one coordinator process acts as the metadata
+authority ("first worker node"); peers register, receive catalog-change
+invalidations over a push channel, and exchange in-flight transaction
+sets so 2PC recovery never adopts a live peer's transactions — the RPC
+generalization of the single-host flock liveness probe.
+
+Transport split (SURVEY §5.8): the catalog *document* still travels via
+the shared data directory (the degenerate bulk transport); what moves
+over RPC is the control information — invalidations, liveness, votes.
+A future multi-host deployment swaps the shared directory for
+fetch_catalog/push_catalog bulk methods on the same server.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Optional
+
+from citus_tpu.net.rpc import RpcClient, RpcError, RpcServer
+
+
+class ControlPlane:
+    """One coordinator's view of the control plane: optionally a server
+    (the metadata authority) and/or a client connection to one."""
+
+    def __init__(self, cluster, serve_port: Optional[int] = None,
+                 coordinator: Optional[tuple] = None):
+        self.cluster = cluster
+        self.origin = uuid.uuid4().hex[:12]
+        self.server: Optional[RpcServer] = None
+        self.client: Optional[RpcClient] = None
+        # peers' last reported in-flight xid sets (server side)
+        self._peer_inflight: dict[str, list[int]] = {}
+        self._lock = threading.Lock()
+        if serve_port is not None:
+            self.server = RpcServer(port=serve_port)
+            self.server.register("ping", lambda p: {"ok": True})
+            self.server.register("catalog_changed", self._on_catalog_changed)
+            self.server.register("report_inflight", self._on_report_inflight)
+            self.server.register("cluster_inflight", self._on_cluster_inflight)
+            self.server.register("tx_event", self._on_tx_event)
+            self.server.start()
+        if coordinator is not None:
+            host, port = coordinator
+            self.client = RpcClient(host, int(port))
+            self.client.call("ping")
+            self.client.subscribe(self._on_event)
+
+    # ---- server handlers ----------------------------------------------
+    def _on_catalog_changed(self, payload: dict) -> dict:
+        """A peer committed catalog metadata: invalidate locally and
+        re-broadcast to every other subscriber."""
+        if payload.get("origin") != self.origin:
+            self.cluster._catalog_dirty = True
+        self.server.broadcast({"event": "catalog_changed",
+                               "origin": payload.get("origin")})
+        return {"ok": True}
+
+    def _on_report_inflight(self, payload: dict) -> dict:
+        with self._lock:
+            self._peer_inflight[payload.get("origin", "?")] = \
+                [int(x) for x in payload.get("xids", [])]
+        return {"ok": True}
+
+    def _on_cluster_inflight(self, payload: dict) -> dict:
+        """All in-flight xids known cluster-wide: ours + every peer's
+        last report (the 2PC-recovery vote: don't touch these)."""
+        xids = set(self.cluster.txlog.inflight())
+        with self._lock:
+            for lst in self._peer_inflight.values():
+                xids.update(lst)
+        return {"xids": sorted(xids)}
+
+    def _on_tx_event(self, payload: dict) -> dict:
+        """2PC state transitions reported by peers (observability +
+        faster recovery adoption)."""
+        return {"ok": True}
+
+    # ---- client-side ---------------------------------------------------
+    def _on_event(self, event: dict) -> None:
+        if event.get("event") == "catalog_changed" \
+                and event.get("origin") != self.origin:
+            self.cluster._catalog_dirty = True
+
+    # ---- outbound ------------------------------------------------------
+    def publish_catalog_change(self) -> None:
+        payload = {"origin": self.origin}
+        if self.client is not None:
+            try:
+                self.client.call("catalog_changed", payload)
+            except RpcError:
+                pass  # coordinator down: peers fall back to reload-on-open
+        elif self.server is not None:
+            self.server.broadcast({"event": "catalog_changed",
+                                   "origin": self.origin})
+
+    def report_inflight(self) -> None:
+        if self.client is not None:
+            try:
+                self.client.call("report_inflight", {
+                    "origin": self.origin,
+                    "xids": sorted(self.cluster.txlog.inflight())})
+            except RpcError:
+                pass
+
+    def peer_inflight_xids(self) -> set[int]:
+        """In-flight xids of other coordinators, for recovery to spare.
+        Queried through the metadata authority."""
+        try:
+            if self.client is not None:
+                self.report_inflight()
+                return set(self.client.call("cluster_inflight")["xids"])
+            if self.server is not None:
+                return set(self._on_cluster_inflight({})["xids"])
+        except RpcError:
+            pass
+        return set()
+
+    @property
+    def connected(self) -> bool:
+        return self.client is not None or self.server is not None
+
+    def close(self) -> None:
+        if self.client is not None:
+            self.client.close()
+        if self.server is not None:
+            self.server.stop()

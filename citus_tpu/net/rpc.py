@@ -1,0 +1,192 @@
+"""Control-plane RPC: length-prefixed JSON over TCP (stdlib only).
+
+The data plane rides ICI collectives (parallel/); this is the control
+plane — the analog of the reference's libpq connections carrying
+metadata sync, node management, and 2PC votes between coordinators
+(connection/connection_management.c, metadata/metadata_sync.c).  gRPC
+would serve the same role; a dependency-free socket protocol keeps the
+skeleton self-contained.
+
+Protocol: every frame is ``<uint32 big-endian length><json body>``.
+Requests: {"id": n, "method": str, "payload": {...}} ->
+responses {"id": n, "result": {...}} or {"id": n, "error": str}.
+A client may upgrade a connection to a subscription with method
+"subscribe"; the server then pushes {"event": ..., ...} frames to it.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+
+def _send(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Optional[dict]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(min(65536, n - len(body)))
+        if not chunk:
+            return None
+        body += chunk
+    return json.loads(body)
+
+
+class RpcServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self.handlers: dict[str, Callable[[dict], dict]] = {}
+        self._subscribers: list[socket.socket] = []
+        self._lock = threading.Lock()
+        self._stopping = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+
+    def register(self, method: str, fn: Callable[[dict], dict]) -> None:
+        self.handlers[method] = fn
+
+    def start(self) -> "RpcServer":
+        self._thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = _recv(conn)
+                if msg is None:
+                    break
+                if msg.get("method") == "subscribe":
+                    with self._lock:
+                        self._subscribers.append(conn)
+                    _send(conn, {"id": msg.get("id"), "result": {"ok": True}})
+                    # connection now belongs to the push loop: it stays
+                    # open until broadcast fails or the server stops
+                    return
+                fn = self.handlers.get(msg.get("method", ""))
+                try:
+                    if fn is None:
+                        raise KeyError(f"unknown method {msg.get('method')!r}")
+                    result = fn(msg.get("payload") or {})
+                    _send(conn, {"id": msg.get("id"), "result": result or {}})
+                except Exception as e:  # report, keep serving
+                    _send(conn, {"id": msg.get("id"), "error": str(e)})
+        except OSError:
+            pass
+        with self._lock:
+            if conn in self._subscribers:
+                self._subscribers.remove(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def broadcast(self, event: dict) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for s in subs:
+            try:
+                _send(s, event)
+            except OSError:
+                with self._lock:
+                    if s in self._subscribers:
+                        self._subscribers.remove(s)
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s in self._subscribers:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._subscribers.clear()
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class RpcClient:
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.addr = (host, port)
+        self._sock = socket.create_connection(self.addr, timeout=timeout)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._listener: Optional[threading.Thread] = None
+        self._sub_sock: Optional[socket.socket] = None
+
+    def call(self, method: str, payload: Optional[dict] = None) -> dict:
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+            _send(self._sock, {"id": rid, "method": method,
+                               "payload": payload or {}})
+            resp = _recv(self._sock)
+        if resp is None:
+            raise RpcError("connection closed by coordinator")
+        if resp.get("error"):
+            raise RpcError(resp["error"])
+        return resp.get("result") or {}
+
+    def subscribe(self, callback: Callable[[dict], None]) -> None:
+        """Open a push channel; ``callback`` runs on a daemon thread for
+        every event the server broadcasts."""
+        self._sub_sock = socket.create_connection(self.addr, timeout=10.0)
+        _send(self._sub_sock, {"id": 0, "method": "subscribe"})
+        ack = _recv(self._sub_sock)  # {"result": {"ok": true}}
+        if not (ack and ack.get("result", {}).get("ok")):
+            raise RpcError("subscription refused")
+        self._sub_sock.settimeout(None)
+
+        def listen():
+            while True:
+                try:
+                    event = _recv(self._sub_sock)
+                except OSError:
+                    return
+                if event is None:
+                    return
+                try:
+                    callback(event)
+                except Exception:
+                    pass
+
+        self._listener = threading.Thread(target=listen, daemon=True)
+        self._listener.start()
+
+    def close(self) -> None:
+        for s in (self._sock, self._sub_sock):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass

@@ -34,10 +34,17 @@ ORPHAN_GRACE_SECONDS = 300.0
 
 
 def recover_transactions(cat: Catalog, txlog: TransactionLog,
-                         grace_seconds: float = ORPHAN_GRACE_SECONDS) -> dict:
-    """Apply every undecided transaction's outcome; returns counts."""
+                         grace_seconds: float = ORPHAN_GRACE_SECONDS,
+                         peer_inflight: "Optional[set]" = None) -> dict:
+    """Apply every undecided transaction's outcome; returns counts.
+
+    ``peer_inflight``: xids other coordinators report live over the
+    control plane (net/control_plane.py) — spared like local in-flight
+    transactions.  This is the RPC generalization of the flock liveness
+    probe for deployments where flock can't span hosts."""
     from citus_tpu.storage.deletes import abort_staged_deletes, commit_staged_deletes
 
+    peer_inflight = peer_inflight or set()
     blocks = txlog.blocks()
     alive_cache: dict[str, bool] = {}
 
@@ -51,7 +58,7 @@ def recover_transactions(cat: Catalog, txlog: TransactionLog,
         in-flight probe is live (not a snapshot): begin() registers the
         xid before any staged file can exist, so a check at decision
         time can never miss a writer."""
-        if xid in txlog.inflight():
+        if xid in txlog.inflight() or xid in peer_inflight:
             return True
         for lo, hi, owner in blocks:
             if lo <= xid < hi:
@@ -106,7 +113,7 @@ def recover_transactions(cat: Catalog, txlog: TransactionLog,
     now = time.time()
 
     def sweepable(xid: int, path: str) -> bool:
-        if xid in known or xid in txlog.inflight():
+        if xid in known or xid in txlog.inflight() or xid in peer_inflight:
             return False
         for lo, hi, owner in blocks:
             if lo <= xid < hi:

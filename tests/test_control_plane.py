@@ -1,0 +1,128 @@
+"""Multi-coordinator control plane over RPC — VERDICT round-2 item #9.
+
+Reference: metadata sync + node activation over libpq connections
+(metadata/metadata_sync.c:229, worker_transaction.c).  Here: a TCP
+JSON-RPC skeleton (net/rpc.py) carrying catalog invalidation pushes and
+in-flight transaction (2PC vote) exchange between coordinator
+processes, with the shared data directory as the degenerate bulk
+transport for the catalog document itself."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+
+
+def wait_until(fn, timeout=5.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_rpc_roundtrip_and_events(tmp_path):
+    from citus_tpu.net.rpc import RpcClient, RpcServer
+    srv = RpcServer().start()
+    srv.register("echo", lambda p: {"got": p["x"] * 2})
+    cli = RpcClient(srv.host, srv.port)
+    assert cli.call("echo", {"x": 21})["got"] == 42
+    events = []
+    cli.subscribe(events.append)
+    time.sleep(0.05)
+    srv.broadcast({"event": "hello"})
+    assert wait_until(lambda: events and events[0]["event"] == "hello")
+    cli.close()
+    srv.stop()
+
+
+def test_catalog_invalidation_over_rpc(tmp_path):
+    """Two in-process coordinators: invalidations travel by RPC push
+    (the mtime poller branch is bypassed entirely)."""
+    a = ct.Cluster(str(tmp_path / "db"), n_nodes=2, serve_port=0)
+    b = ct.Cluster(str(tmp_path / "db"), n_nodes=2,
+                   coordinator=("127.0.0.1", a.control_port))
+    try:
+        a.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+        a.execute("SELECT create_distributed_table('t', 'k', 4)")
+        a.copy_from("t", columns={"k": np.arange(100), "v": np.ones(100, np.int64)})
+        # b learns of a's DDL through the push channel
+        assert wait_until(lambda: b._catalog_dirty)
+        assert b.execute("SELECT count(*) FROM t").rows == [(100,)]
+        # and writes through b invalidate a
+        b.execute("CREATE TABLE u (x bigint)")
+        b.execute("INSERT INTO u VALUES (7)")
+        assert wait_until(lambda: a._catalog_dirty)
+        assert a.execute("SELECT x FROM u").rows == [(7,)]
+    finally:
+        b.close()
+        a.close()
+
+
+def test_peer_inflight_protects_recovery(tmp_path):
+    """2PC-vote exchange: the authority spares xids a peer reports
+    in-flight, even without the same-host flock probe."""
+    a = ct.Cluster(str(tmp_path / "db"), n_nodes=2, serve_port=0)
+    b = ct.Cluster(str(tmp_path / "db"), n_nodes=2,
+                   coordinator=("127.0.0.1", a.control_port))
+    try:
+        xid = b.txlog.begin()  # b holds an in-flight transaction
+        b._control.report_inflight()
+        assert xid in a._control.peer_inflight_xids()
+        assert xid in b._control.peer_inflight_xids()
+        b.txlog.release(xid)
+    finally:
+        b.close()
+        a.close()
+
+
+def test_second_process_coordinator(tmp_path):
+    """A real second coordinator process syncs metadata over RPC."""
+    a = ct.Cluster(str(tmp_path / "db"), n_nodes=2, serve_port=0)
+    try:
+        a.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+        a.execute("SELECT create_distributed_table('t', 'k', 4)")
+        a.copy_from("t", columns={"k": np.arange(50), "v": np.ones(50, np.int64)})
+        script = textwrap.dedent(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import citus_tpu as ct
+            b = ct.Cluster({str(tmp_path / 'db')!r}, n_nodes=2,
+                           coordinator=("127.0.0.1", {a.control_port}))
+            assert b.execute("SELECT count(*) FROM t").rows == [(50,)]
+            b.execute("CREATE TABLE w (x bigint)")
+            b.execute("INSERT INTO w VALUES (11), (22)")
+            b.close()
+            print("PEER OK")
+        """)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "PEER OK" in r.stdout
+        # the peer's DDL+write reached this process via RPC invalidation
+        assert wait_until(lambda: a._catalog_dirty)
+        assert a.execute("SELECT sum(x) FROM w").rows == [(33,)]
+    finally:
+        a.close()
+
+
+def test_mx_still_works_without_rpc(tmp_path):
+    """No control plane configured: the mtime-poll fallback still syncs
+    (degenerate transport only)."""
+    a = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    b = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    try:
+        a.execute("CREATE TABLE t (k bigint)")
+        a.execute("INSERT INTO t VALUES (5)")
+        assert b.execute("SELECT count(*) FROM t").rows == [(1,)]
+    finally:
+        b.close()
+        a.close()
