@@ -169,6 +169,15 @@ class Catalog:
         self._dict_sig: dict[tuple[str, str], Optional[tuple]] = {}
         # tenant schemas: name -> {"colocation_id": int, "home_node": int}
         self.schemas: dict[str, dict] = {}
+        # views: name -> SELECT sql text (reparsed at each use)
+        self.views: dict[str, str] = {}
+        # sequences: name -> {"value": next unreserved, "increment": n,
+        # "start": n}; nextval hands out values from an in-memory block
+        # reserved by bumping the persisted high-water mark (gaps on
+        # crash, like the reference's cached sequences)
+        self.sequences: dict[str, dict] = {}
+        self._seq_cache: dict[str, list] = {}   # name -> [next, limit]
+        self._seq_currval: dict[str, int] = {}  # session-last nextval
         self._load()
 
     # ---- persistence --------------------------------------------------
@@ -186,6 +195,8 @@ class Catalog:
         self._next_shard_id = d["next_shard_id"]
         self._next_colocation_id = d["next_colocation_id"]
         self.schemas = d.get("schemas", {})
+        self.views = d.get("views", {})
+        self.sequences = d.get("sequences", {})
 
     def commit(self) -> None:
         """Atomically persist catalog state (round-1 metadata transaction)."""
@@ -198,6 +209,8 @@ class Catalog:
                 "next_shard_id": self._next_shard_id,
                 "next_colocation_id": self._next_colocation_id,
                 "schemas": self.schemas,
+                "views": self.views,
+                "sequences": self.sequences,
             }
             tmp = self._path() + ".tmp"
             with open(tmp, "w") as fh:
@@ -410,6 +423,87 @@ class Catalog:
             t.shards = [ShardMeta(self._alloc_shard_id(), 0, placements=list(node_ids))]
             t.version += 1
             return t
+
+    # ---- views --------------------------------------------------------
+    def create_view(self, name: str, sql: str) -> None:
+        with self._lock:
+            if name in self.tables or name in self.views:
+                raise CatalogError(f'relation "{name}" already exists')
+            self.views[name] = sql
+            self.ddl_epoch += 1
+
+    def drop_view(self, name: str) -> None:
+        with self._lock:
+            if name not in self.views:
+                raise CatalogError(f'view "{name}" does not exist')
+            del self.views[name]
+            self.ddl_epoch += 1
+
+    # ---- sequences ----------------------------------------------------
+    SEQ_CACHE_BLOCK = 32
+
+    def create_sequence(self, name: str, start: int = 1,
+                        increment: int = 1) -> None:
+        with self._lock:
+            if name in self.sequences:
+                raise CatalogError(f'sequence "{name}" already exists')
+            if increment == 0:
+                raise CatalogError("sequence increment cannot be zero")
+            self.sequences[name] = {"value": start, "increment": increment,
+                                    "start": start}
+
+    def drop_sequence(self, name: str) -> None:
+        with self._lock:
+            if name not in self.sequences:
+                raise CatalogError(f'sequence "{name}" does not exist')
+            del self.sequences[name]
+            self._seq_cache.pop(name, None)
+            self._seq_currval.pop(name, None)
+
+    def nextval(self, name: str) -> int:
+        """Next sequence value; values come from an in-memory block
+        reserved by persisting a bumped high-water mark (crash = gap,
+        never a repeat — reference: cached sequence semantics)."""
+        with self._lock:
+            seq = self.sequences.get(name)
+            if seq is None:
+                raise CatalogError(f'sequence "{name}" does not exist')
+            inc = seq["increment"]
+            cache = self._seq_cache.get(name)
+            if cache is None or cache[0] == cache[1]:
+                base = seq["value"]
+                seq["value"] = base + inc * self.SEQ_CACHE_BLOCK
+                self._seq_cache[name] = cache = [base, seq["value"]]
+                persist = True
+            else:
+                persist = False
+        if persist:
+            self.commit()
+        with self._lock:
+            v = cache[0]
+            cache[0] = v + inc
+            self._seq_currval[name] = v
+            return v
+
+    def currval(self, name: str) -> int:
+        if name not in self.sequences:
+            raise CatalogError(f'sequence "{name}" does not exist')
+        v = self._seq_currval.get(name)
+        if v is None:
+            raise CatalogError(
+                f'currval of sequence "{name}" is not yet defined in this session')
+        return v
+
+    def setval(self, name: str, value: int) -> int:
+        with self._lock:
+            seq = self.sequences.get(name)
+            if seq is None:
+                raise CatalogError(f'sequence "{name}" does not exist')
+            seq["value"] = value + seq["increment"]
+            self._seq_cache.pop(name, None)
+            self._seq_currval[name] = value
+        self.commit()
+        return value
 
     def _alloc_shard_id(self) -> int:
         sid = self._next_shard_id

@@ -566,6 +566,13 @@ class Cluster:
         if isinstance(stmt, A.SetOp):
             return self._execute_setop(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is not None \
+                and self.catalog.views:
+            new_from = self._expand_views(stmt.from_)
+            if new_from is not stmt.from_:
+                stmt = A.Select(stmt.items, new_from, stmt.where,
+                                stmt.group_by, stmt.having, stmt.order_by,
+                                stmt.limit, stmt.offset, stmt.distinct)
+        if isinstance(stmt, A.Select) and stmt.from_ is not None \
                 and _has_derived(stmt.from_):
             return self._execute_derived(stmt)
         if isinstance(stmt, A.Select) and any(
@@ -630,6 +637,35 @@ class Cluster:
                 self.catalog.drop_table(m)
             self.catalog.commit()
             self._plan_cache.clear()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateView):
+            # validate the body against current metadata (LIMIT 0 run)
+            import dataclasses
+            probe = dataclasses.replace(stmt.select, limit=0) \
+                if isinstance(stmt.select, A.Select) else stmt.select
+            self._execute_stmt(probe)
+            self.catalog.create_view(stmt.name, stmt.sql)
+            self.catalog.commit()
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropView):
+            if stmt.if_exists and stmt.name not in self.catalog.views:
+                return Result(columns=[], rows=[])
+            self.catalog.drop_view(stmt.name)
+            self.catalog.commit()
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateSequence):
+            if stmt.if_not_exists and stmt.name in self.catalog.sequences:
+                return Result(columns=[], rows=[])
+            self.catalog.create_sequence(stmt.name, stmt.start, stmt.increment)
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropSequence):
+            if stmt.if_exists and stmt.name not in self.catalog.sequences:
+                return Result(columns=[], rows=[])
+            self.catalog.drop_sequence(stmt.name)
+            self.catalog.commit()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.CreateTable):
             schema = Schema([
@@ -773,6 +809,12 @@ class Cluster:
                 if not isinstance(e, A.Literal):
                     if isinstance(e, A.UnOp) and e.op == "-" and isinstance(e.operand, A.Literal):
                         row.append(-e.operand.value)
+                        continue
+                    if isinstance(e, A.FuncCall) and e.name in ("nextval", "currval") \
+                            and e.args and isinstance(e.args[0], A.Literal):
+                        seq = str(e.args[0].value)
+                        row.append(self.catalog.nextval(seq) if e.name == "nextval"
+                                   else self.catalog.currval(seq))
                         continue
                     raise UnsupportedFeatureError("INSERT VALUES must be literals")
                 row.append(e.value)
@@ -918,35 +960,57 @@ class Cluster:
         return total
 
     def _execute_window(self, stmt: A.Select) -> Result:
-        """Window functions: run the base projection distributed, apply
-        the window pass on the coordinator (pull strategy)."""
-        from citus_tpu.executor.window import compute_window
-        if stmt.group_by or stmt.having or stmt.distinct:
+        """Window functions: run the base projection (or grouped
+        aggregation) distributed, apply the window pass on the
+        coordinator (pull strategy)."""
+        from citus_tpu.executor.window import NAVIGATION, compute_window
+        if stmt.distinct:
             raise UnsupportedFeatureError(
-                "window functions with GROUP BY/HAVING/DISTINCT not supported yet")
+                "window functions with DISTINCT not supported yet")
         base_items: list[A.SelectItem] = []
 
         def base_slot(e: A.Expr) -> int:
             base_items.append(A.SelectItem(e, f"__w{len(base_items)}"))
             return len(base_items) - 1
 
-        outputs = []  # ("col", slot) | ("win", func, arg_slots, part_slots, order_specs)
+        def literal_value(a: A.Expr):
+            if isinstance(a, A.Literal):
+                return a.value
+            if isinstance(a, A.UnOp) and a.op == "-" \
+                    and isinstance(a.operand, A.Literal):
+                return -a.operand.value
+            raise UnsupportedFeatureError(
+                "window function extra arguments must be literals")
+
+        outputs = []  # ("col", slot) | ("win", fn, arg_slots, part, order, frame, params)
         names = []
         for i, item in enumerate(stmt.items):
             e = item.expr
             if isinstance(e, A.WindowCall):
                 fn = e.func.name
-                arg_slots = [base_slot(a) for a in e.func.args
-                             if not isinstance(a, A.Star)]
+                args = [a for a in e.func.args if not isinstance(a, A.Star)]
+                if fn in NAVIGATION:
+                    arg_slots = [base_slot(args[0])] if args else []
+                    params = tuple(literal_value(a) for a in args[1:])
+                elif fn == "ntile":
+                    arg_slots = []
+                    params = tuple(literal_value(a) for a in args[:1])
+                else:
+                    arg_slots = [base_slot(a) for a in args]
+                    params = ()
                 part_slots = [base_slot(p) for p in e.partition_by]
                 order_specs = [(base_slot(oe), asc) for oe, asc in e.order_by]
-                outputs.append(("win", fn, arg_slots, part_slots, order_specs))
+                outputs.append(("win", fn, arg_slots, part_slots, order_specs,
+                                e.frame, params))
                 names.append(item.alias or fn)
             else:
                 outputs.append(("col", base_slot(e)))
                 names.append(item.alias or (e.name if isinstance(e, A.ColumnRef)
                                             else f"column{i + 1}"))
-        base = A.Select(base_items, stmt.from_, stmt.where)
+        # the base query keeps GROUP BY/HAVING: windows then run over the
+        # grouped rows (PostgreSQL semantics — windows after aggregation)
+        base = A.Select(base_items, stmt.from_, stmt.where,
+                        stmt.group_by, stmt.having)
         r = self._execute_stmt(base)
         n = r.rowcount
         cols = [[row[j] for row in r.rows] for j in range(len(base_items))]
@@ -955,11 +1019,12 @@ class Cluster:
             if spec[0] == "col":
                 out_cols.append(cols[spec[1]])
             else:
-                _, fn, arg_slots, part_slots, order_specs = spec
+                _, fn, arg_slots, part_slots, order_specs, frame, params = spec
                 out_cols.append(compute_window(
                     n, fn, [cols[s] for s in arg_slots],
                     [cols[s] for s in part_slots],
-                    [(cols[s], asc) for s, asc in order_specs]))
+                    [(cols[s], asc) for s, asc in order_specs],
+                    frame=frame, params=params))
         rows = [tuple(c[i] for c in out_cols) for i in range(n)]
         # outer ORDER BY / LIMIT over the final outputs (name or position)
         for oi in reversed(stmt.order_by):
@@ -1040,6 +1105,20 @@ class Cluster:
                     self.drop_table(tmp)
                 except Exception:
                     pass
+
+    def _expand_views(self, item):
+        """FROM references to views become derived tables over the view's
+        stored SELECT (reference: views as distributed objects,
+        commands/view.c; execution via recursive planning)."""
+        if isinstance(item, A.TableRef) and item.name in self.catalog.views:
+            sel = parse_sql(self.catalog.views[item.name])[0]
+            return A.SubqueryRef(sel, item.alias or item.name)
+        if isinstance(item, A.Join):
+            left = self._expand_views(item.left)
+            right = self._expand_views(item.right)
+            if left is not item.left or right is not item.right:
+                return A.Join(left, right, item.kind, item.condition)
+        return item
 
     def _execute_setop(self, stmt: A.SetOp) -> Result:
         """UNION / INTERSECT / EXCEPT [ALL]: execute both sides, combine
@@ -1356,6 +1435,23 @@ class Cluster:
             from citus_tpu.operations.restore import list_restore_points
             return Result(columns=["name", "created_at"],
                           rows=list_restore_points(self.catalog))
+        if name == "nextval":
+            return Result(columns=["nextval"],
+                          rows=[(self.catalog.nextval(str(args[0])),)])
+        if name == "currval":
+            return Result(columns=["currval"],
+                          rows=[(self.catalog.currval(str(args[0])),)])
+        if name == "setval":
+            v = self.catalog.setval(str(args[0]), int(args[1]))
+            return Result(columns=["setval"], rows=[(v,)])
+        if name == "citus_views":
+            return Result(columns=["view_name", "definition"],
+                          rows=sorted(self.catalog.views.items()))
+        if name == "citus_sequences":
+            rows = [(n, s["value"], s["increment"], s["start"])
+                    for n, s in sorted(self.catalog.sequences.items())]
+            return Result(columns=["sequence_name", "next_block_start",
+                                   "increment", "start"], rows=rows)
         if name == "recover_prepared_transactions":
             from citus_tpu.transaction.recovery import recover_transactions
             st = recover_transactions(self.catalog, self.txlog,

@@ -3,23 +3,27 @@
 The reference delegates window functions to PostgreSQL's executor after
 its planner proves safety (pushdown when partitioned by the distribution
 column, else pull).  Here the base projection (including partition/order
-keys and window arguments) executes through the normal distributed scan,
-and the window pass runs on the coordinator — the pull strategy.
+keys and window arguments) executes through the normal distributed scan
+— or the grouped pipeline when the query also aggregates — and the
+window pass runs on the coordinator.
 
-Supported: row_number, rank, dense_rank, count, sum, avg, min, max OVER
-(PARTITION BY ... ORDER BY ...), with PostgreSQL's default frame (RANGE
-UNBOUNDED PRECEDING .. CURRENT ROW: running aggregates include peer
-rows; no ORDER BY -> whole partition).
+Supported: row_number, rank, dense_rank, ntile, lag, lead, first_value,
+last_value, nth_value, count, sum, avg, min, max OVER (PARTITION BY ...
+ORDER BY ... [ROWS BETWEEN ...]).  Default frame matches PostgreSQL
+(RANGE UNBOUNDED PRECEDING .. CURRENT ROW: running aggregates include
+peer rows; no ORDER BY -> whole partition); explicit ROWS frames bound
+by offsets.
 """
 
 from __future__ import annotations
 
 import decimal
-from typing import Any
+from typing import Any, Optional
 
 from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
 
-RANKING = {"row_number", "rank", "dense_rank"}
+RANKING = {"row_number", "rank", "dense_rank", "ntile"}
+NAVIGATION = {"lag", "lead", "first_value", "last_value", "nth_value"}
 AGGS = {"count", "sum", "avg", "min", "max"}
 
 
@@ -36,14 +40,35 @@ def _order_indexes(idxs: list[int], order) -> list[int]:
     return out
 
 
+def _frame_slice(frame, j: int, n: int) -> tuple[int, int]:
+    """ROWS frame bounds -> [lo, hi) positions for row at position j."""
+    (sdir, sn), (edir, en) = frame
+    if sdir == "preceding":
+        lo = 0 if sn is None else j - sn
+    elif sdir == "current":
+        lo = j
+    else:  # following
+        lo = j + (sn or 0)
+    if edir == "following":
+        hi = n if en is None else j + en + 1
+    elif edir == "current":
+        hi = j + 1
+    else:  # preceding
+        hi = j - (en or 0) + 1
+    return max(0, lo), min(n, hi)
+
+
 def compute_window(rows_n: int, fn_name: str, args: list[list],
-                   partition: list[list], order: list[tuple[list, bool]]) -> list:
+                   partition: list[list], order: list[tuple[list, bool]],
+                   frame: Optional[tuple] = None,
+                   params: tuple = ()) -> list:
     """Compute one window function over decoded per-row value lists.
 
-    args/partition: list of per-row value columns; order: (values, asc).
-    Returns the per-row result list in the original row order.
+    args/partition: per-row value columns; order: (values, asc); frame:
+    ROWS bounds; params: literal extras (lag offset/default, ntile n,
+    nth_value n).  Returns per-row results in the original row order.
     """
-    if fn_name not in RANKING | AGGS:
+    if fn_name not in RANKING | NAVIGATION | AGGS:
         raise UnsupportedFeatureError(f"window function {fn_name}() not supported")
     groups: dict[tuple, list[int]] = {}
     for i in range(rows_n):
@@ -54,6 +79,8 @@ def compute_window(rows_n: int, fn_name: str, args: list[list],
         if order:
             idxs = _order_indexes(idxs, order)
         okeys = [tuple(vals[i] for vals, _ in order) for i in idxs] if order else None
+        n = len(idxs)
+        col = args[0] if args else None
         if fn_name == "row_number":
             for pos, i in enumerate(idxs):
                 out[i] = pos + 1
@@ -69,28 +96,70 @@ def compute_window(rows_n: int, fn_name: str, args: list[list],
                     prev = cur
                 out[i] = rank if fn_name == "rank" else dense
             continue
+        if fn_name == "ntile":
+            buckets = int(params[0]) if params else 1
+            if buckets <= 0:
+                raise AnalysisError("ntile() buckets must be positive")
+            base, rem = divmod(n, buckets)
+            pos = 0
+            for b in range(buckets):
+                size = base + (1 if b < rem else 0)
+                for _ in range(size):
+                    if pos < n:
+                        out[idxs[pos]] = b + 1
+                        pos += 1
+            continue
+        if fn_name in ("lag", "lead"):
+            off = int(params[0]) if params else 1
+            default = params[1] if len(params) > 1 else None
+            for pos, i in enumerate(idxs):
+                src = pos - off if fn_name == "lag" else pos + off
+                out[i] = col[idxs[src]] if 0 <= src < n else default
+            continue
+        if fn_name in ("first_value", "last_value", "nth_value"):
+            eff = frame or ((("preceding", None), ("current", 0))
+                            if order else (("preceding", None),
+                                           ("following", None)))
+            for pos, i in enumerate(idxs):
+                lo, hi = _frame_slice(eff, pos, n)
+                if lo >= hi:
+                    out[i] = None
+                elif fn_name == "first_value":
+                    out[i] = col[idxs[lo]]
+                elif fn_name == "last_value":
+                    out[i] = col[idxs[hi - 1]]
+                else:
+                    k = int(params[0]) if params else 1
+                    out[i] = col[idxs[lo + k - 1]] if lo + k - 1 < hi else None
+            continue
         # aggregates
-        col = args[0] if args else None
+        if frame is not None:
+            for pos, i in enumerate(idxs):
+                lo, hi = _frame_slice(frame, pos, n)
+                window = [col[idxs[j]] for j in range(lo, hi)
+                          if col is not None and col[idxs[j]] is not None] \
+                    if col is not None else None
+                out[i] = _agg_value(fn_name, window if window is not None else [],
+                                    count_star=col is None, n=max(0, hi - lo))
+            continue
         if not order:
             vals = [col[i] for i in idxs if col is not None and col[i] is not None] \
                 if col is not None else idxs
-            agg = _agg_value(fn_name, vals, count_star=col is None, n=len(idxs))
+            agg = _agg_value(fn_name, vals, count_star=col is None, n=n)
             for i in idxs:
                 out[i] = agg
             continue
-        # running frame including peers: compute per peer-group prefix
+        # default frame: running aggregate including peer rows
         pos = 0
         acc: list = []
-        count_nonnull = 0
-        while pos < len(idxs):
+        while pos < n:
             end = pos
-            while end < len(idxs) and okeys[end] == okeys[pos]:
+            while end < n and okeys[end] == okeys[pos]:
                 end += 1
             for j in range(pos, end):
                 i = idxs[j]
                 if col is not None and col[i] is not None:
                     acc.append(col[i])
-                    count_nonnull += 1
             agg = _agg_value(fn_name, acc, count_star=col is None, n=end)
             for j in range(pos, end):
                 out[idxs[j]] = agg

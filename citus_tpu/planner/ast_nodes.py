@@ -111,10 +111,13 @@ class CaseExpr(Expr):
 
 @dataclass(frozen=True)
 class WindowCall(Expr):
-    """fn(...) OVER (PARTITION BY ... ORDER BY ...)."""
+    """fn(...) OVER (PARTITION BY ... ORDER BY ... [ROWS BETWEEN ...])."""
     func: "FuncCall" = None
     partition_by: tuple = ()
     order_by: tuple = ()  # tuple[OrderItem-like (expr, asc)]
+    # ROWS frame: ((dir, n|None), (dir, n|None)) with dir in
+    # preceding|current|following, None = unbounded; None = default frame
+    frame: Optional[tuple] = None
 
     def __hash__(self):
         return id(self)
@@ -267,6 +270,38 @@ class Select(Statement):
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+
+
+@dataclass
+class CreateView(Statement):
+    """CREATE VIEW name AS SELECT ... — stored as SQL text in the
+    catalog (reference: views propagate as distributed objects,
+    commands/view.c); references expand like derived tables."""
+    name: str = ""
+    select: object = None       # parsed body (validation only)
+    sql: str = ""               # body text, reparsed at each use
+
+
+@dataclass
+class DropView(Statement):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class CreateSequence(Statement):
+    """Reference: commands/sequence.c — distributed sequences hand out
+    disjoint ranges; here a catalog-backed counter with block caching."""
+    name: str = ""
+    start: int = 1
+    increment: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence(Statement):
+    name: str = ""
+    if_exists: bool = False
 
 
 @dataclass

@@ -126,6 +126,46 @@ class Binder:
         key = f"{alias}.{name}" if self.qualified else name
         return key, t.schema.column(name), alias, t
 
+    def _text_words(self, target):
+        """Resolve a text expression that is a BColumn or a chain of
+        BDictRemap transforms over one: -> (base column, table, column,
+        effective word per base dictionary id) or None.  This is what
+        lets string functions compose (upper(trim(s))): each wrapper's
+        mapping applies to the bind-time word table, and the final remap
+        is expressed over the base column's ids."""
+        from citus_tpu.planner.bound import BDictRemap
+        chain = []
+        base = target
+        while isinstance(base, BDictRemap):
+            chain.append(base.mapping)
+            base = base.operand
+        if not (isinstance(base, BColumn) and base.type.is_text):
+            return None
+        tname, cname = self.text_source(base)
+        words = self.catalog.dictionary(tname, cname)
+        eff = list(range(len(words)))
+        for mapping in reversed(chain):  # innermost transform first
+            eff = [mapping[i] if i < len(mapping) else i for i in eff]
+        return base, tname, cname, [words[i] for i in eff]
+
+    def _remap_text(self, fname: str, target, op):
+        """Bind a string function as a dictionary remap on the base
+        column (composable with other remap-family functions).  String
+        literals constant-fold."""
+        from citus_tpu.planner.bound import BDictRemap
+        if isinstance(target, BLiteral) and isinstance(target.value, str):
+            return BLiteral(op(target.value), target.type)
+        resolved = self._text_words(target)
+        if resolved is None:
+            raise UnsupportedFeatureError(
+                f"{fname}() requires a text column (or a string function "
+                "over one)")
+        base, tname, cname, eff_words = resolved
+        out_words = [op(w) for w in eff_words]
+        mapping = tuple(int(x) for x in self.catalog.encode_strings(
+            tname, cname, out_words))
+        return BDictRemap(base, mapping)
+
     def text_source(self, bcol: BColumn) -> tuple[str, str]:
         """Env key of a text column -> (table_name, column_name)."""
         if "." in bcol.name:
@@ -232,7 +272,12 @@ class Binder:
             left = self._coerce_string_literal(left, rt, None)
             lt = left.type
         if lt.is_text and rt.is_text:
-            col = left if isinstance(left, BColumn) else (right if isinstance(right, BColumn) else None)
+            def text_base(e):
+                from citus_tpu.planner.bound import BDictRemap
+                while isinstance(e, BDictRemap):
+                    e = e.operand  # remapped ids live in the base dictionary
+                return e if isinstance(e, BColumn) else None
+            col = text_base(left) or text_base(right)
             if isinstance(right, BLiteral) and isinstance(right.value, str):
                 right = self._coerce_string_literal(right, lt, col)
             elif isinstance(left, BLiteral) and isinstance(left.value, str):
@@ -377,54 +422,83 @@ class Binder:
             return BExtract(field, inner)
         if name in ("upper", "lower"):
             target = self.bind_scalar(e.args[0], allow_agg)
-            if not (isinstance(target, BColumn) and target.type.is_text):
-                raise UnsupportedFeatureError(f"{name}() requires a text column")
-            from citus_tpu.planner.bound import BDictRemap
-            tname, cname = self.text_source(target)
-            words = self.catalog.dictionary(tname, cname)
             fn = str.upper if name == "upper" else str.lower
-            mapping = tuple(int(x) for x in self.catalog.encode_strings(
-                tname, cname, [fn(w) for w in words]))
-            return BDictRemap(target, mapping)
+            return self._remap_text(name, target, fn)
         if name == "substring":
             target = self.bind_scalar(e.args[0], allow_agg)
-            if not (isinstance(target, BColumn) and target.type.is_text):
-                raise UnsupportedFeatureError("substring() requires a text column")
             if not all(isinstance(a, A.Literal) for a in e.args[1:]):
                 raise UnsupportedFeatureError("substring() bounds must be literals")
             start = int(e.args[1].value) if len(e.args) > 1 else 1
             ln = int(e.args[2].value) if len(e.args) > 2 else None
-            from citus_tpu.planner.bound import BDictRemap
-            tname, cname = self.text_source(target)
-            words = self.catalog.dictionary(tname, cname)
             i0 = max(start - 1, 0)
-            cut = [w[i0:i0 + ln] if ln is not None else w[i0:] for w in words]
-            mapping = tuple(int(x) for x in self.catalog.encode_strings(tname, cname, cut))
-            return BDictRemap(target, mapping)
+            return self._remap_text(
+                name, target,
+                lambda w: (w[i0:i0 + ln] if ln is not None else w[i0:]))
         if name == "concat":
             bound = [self.bind_scalar(a, allow_agg) for a in e.args]
-            cols = [x for x in bound if isinstance(x, BColumn) and x.type.is_text]
-            if len(cols) != 1 or not all(
-                    (isinstance(x, BLiteral) and isinstance(x.value, str)) or x is cols[0]
+            texts = [x for x in bound
+                     if x.type.is_text and not isinstance(x, BLiteral)]
+            if len(texts) != 1 or not all(
+                    (isinstance(x, BLiteral) and isinstance(x.value, str)) or x is texts[0]
                     for x in bound):
                 raise UnsupportedFeatureError(
-                    "concat() supports one text column plus string literals")
-            from citus_tpu.planner.bound import BDictRemap
-            tname, cname = self.text_source(cols[0])
-            words = self.catalog.dictionary(tname, cname)
-            out_words = []
-            for w in words:
-                parts = [x.value if isinstance(x, BLiteral) else w for x in bound]
-                out_words.append("".join(parts))
-            mapping = tuple(int(x) for x in self.catalog.encode_strings(tname, cname, out_words))
-            return BDictRemap(cols[0], mapping)
+                    "concat() supports one text expression plus string literals")
+            def cat_op(w, _parts=bound, _t=texts[0]):
+                return "".join(x.value if isinstance(x, BLiteral) else w
+                               for x in _parts)
+            return self._remap_text(name, texts[0], cat_op)
         if name in ("length", "char_length"):
             target = self.bind_scalar(e.args[0], allow_agg)
-            if not (isinstance(target, BColumn) and target.type.is_text):
-                raise UnsupportedFeatureError("length() requires a text column")
             from citus_tpu.planner.bound import BDictLookup
-            words = self.catalog.dictionary(*self.text_source(target))
-            return BDictLookup(target, tuple(len(w) for w in words))
+            resolved = self._text_words(target)
+            if resolved is None:
+                raise UnsupportedFeatureError("length() requires a text column")
+            base, _, _, eff_words = resolved
+            lut = tuple(len(w) for w in eff_words)
+            # lookup table indexes by the BASE column's ids
+            return BDictLookup(base, lut)
+        if name in ("trim", "btrim", "ltrim", "rtrim", "replace", "left",
+                    "right", "initcap", "reverse"):
+            # dictionary-remap family: apply the python string op to every
+            # dictionary word once at bind time; rows keep their ids
+            target = self.bind_scalar(e.args[0], allow_agg)
+            extras = []
+            for a in e.args[1:]:
+                lit = self.bind_scalar(a, allow_agg)
+                if isinstance(lit, BUnOp) and lit.op == "-" \
+                        and isinstance(lit.operand, BLiteral):
+                    lit = BLiteral(-lit.operand.value, lit.type)
+                if not isinstance(lit, BLiteral):
+                    raise UnsupportedFeatureError(
+                        f"{name}() extra arguments must be literals")
+                extras.append(lit.value)
+            if name in ("trim", "btrim"):
+                chars = str(extras[0]) if extras else None
+                op = lambda w: w.strip(chars)  # noqa: E731
+            elif name == "ltrim":
+                chars = str(extras[0]) if extras else None
+                op = lambda w: w.lstrip(chars)  # noqa: E731
+            elif name == "rtrim":
+                chars = str(extras[0]) if extras else None
+                op = lambda w: w.rstrip(chars)  # noqa: E731
+            elif name == "replace":
+                if len(extras) != 2:
+                    raise AnalysisError("replace() requires (text, from, to)")
+                frm, to = str(extras[0]), str(extras[1])
+                op = lambda w: w.replace(frm, to)  # noqa: E731
+            elif name == "left":
+                n_ = int(extras[0])
+                op = lambda w: w[:n_]  # noqa: E731  (negative: drop from end)
+            elif name == "right":
+                n_ = int(extras[0])
+                # right(w, n): last n chars; negative drops from the front
+                op = (lambda w: w[max(0, len(w) - n_):]) if n_ >= 0 \
+                    else (lambda w: w[-n_:])  # noqa: E731
+            elif name == "initcap":
+                op = lambda w: w.title()  # noqa: E731
+            else:  # reverse
+                op = lambda w: w[::-1]  # noqa: E731
+            return self._remap_text(name, target, op)
         if name == "coalesce":
             if not e.args:
                 raise AnalysisError("coalesce() requires arguments")
@@ -510,7 +584,16 @@ class Binder:
                     return BAggRef(i, spec.out_type)
             aggs.append(spec)
             return BAggRef(len(aggs) - 1, spec.out_type)
-        # non-aggregate: try matching a group key structurally
+        # non-aggregate: try matching a group key by source expression
+        # first (stable under dictionary growth), then structurally
+        am = getattr(self, "_ast_key_map", None)
+        if am is not None:
+            try:
+                idx = am.get(e)
+            except TypeError:
+                idx = None
+            if idx is not None:
+                return BKeyRef(idx, self._ast_key_types[idx])
         bound = self._try_bind_as_key(e, key_map)
         if bound is not None:
             return bound
@@ -687,6 +770,16 @@ def bind_select(catalog: Catalog, stmt: A.Select,
             group_exprs.append(g)
     group_keys = [b.bind_scalar(g) for g in group_exprs]
     key_map = {k: i for i, k in enumerate(group_keys)}
+    # AST-level key matching: dictionary-remap expressions (lower(s), ...)
+    # are not structurally stable across binds when the dictionary grew,
+    # but the source expression text is
+    b._ast_key_map = {}
+    b._ast_key_types = [k.type for k in group_keys]
+    for i, g in enumerate(group_exprs):
+        try:
+            b._ast_key_map.setdefault(g, i)
+        except TypeError:
+            pass
 
     has_agg_funcs = any(_contains_agg(i.expr) for i in items) or \
         (stmt.having is not None) or bool(group_keys)

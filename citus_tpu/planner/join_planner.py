@@ -210,6 +210,13 @@ def bind_join_select(catalog: Catalog, stmt: A.Select) -> BoundJoinSelect:
 
     group_keys = [binder.bind_scalar(g) for g in stmt.group_by]
     key_map = {k: i for i, k in enumerate(group_keys)}
+    binder._ast_key_map = {}
+    binder._ast_key_types = [k.type for k in group_keys]
+    for i, g in enumerate(stmt.group_by):
+        try:
+            binder._ast_key_map.setdefault(g, i)
+        except TypeError:
+            pass
     has_aggs = any(_contains_agg(i.expr) for i in items) or stmt.having is not None or bool(group_keys)
 
     aggs: list[AggSpec] = []

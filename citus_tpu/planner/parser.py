@@ -41,6 +41,7 @@ KEYWORDS = {
     "vacuum", "copy", "alter", "add", "column", "rename", "to",
     "schema", "cascade", "merge", "matched", "nothing", "do", "over",
     "partition", "union", "intersect", "except", "all", "within",
+    "rows", "range", "unbounded", "preceding", "following", "current", "row",
 }
 
 
@@ -323,6 +324,26 @@ class Parser:
         analyze = bool(self.accept_kw("analyze"))
         return A.Explain(self.parse_statement(), analyze=analyze)
 
+    def _parse_frame_bound(self):
+        """UNBOUNDED PRECEDING|FOLLOWING | CURRENT ROW | N PRECEDING|
+        FOLLOWING -> ('preceding'|'following', n|None) with None =
+        unbounded, or ('current', 0)."""
+        if self.accept_kw("unbounded"):
+            d = self.next().value
+            if d not in ("preceding", "following"):
+                self.error("expected PRECEDING or FOLLOWING")
+            return (d, None)
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ("current", 0)
+        t = self.next()
+        if t.kind != "num":
+            self.error("expected a frame bound")
+        d = self.next().value
+        if d not in ("preceding", "following"):
+            self.error("expected PRECEDING or FOLLOWING")
+        return (d, int(t.value))
+
     def parse_table_name(self) -> str:
         name = self.expect_ident()
         if self.accept_op("."):
@@ -339,6 +360,36 @@ class Parser:
                 self.expect_kw("exists")
                 if_not_exists = True
             return A.CreateSchema(self.expect_ident(), if_not_exists)
+        if self.peek().kind == "ident" and self.peek().value == "view":
+            self.next()
+            name = self.parse_table_name()
+            self.expect_kw("as")
+            body_start = self.peek().pos
+            sel = self.parse_select()
+            return A.CreateView(name, sel, self.text[body_start:self.peek().pos].strip())
+        if self.peek().kind == "ident" and self.peek().value == "sequence":
+            self.next()
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            name = self.parse_table_name()
+            start, increment = 1, 1
+            while self.peek().kind == "ident" and self.peek().value in ("start", "increment"):
+                kw = self.next().value
+                self.accept_kw("with") or (self.peek().kind == "ident"
+                                           and self.peek().value == "by" and self.next())
+                neg = bool(self.accept_op("-"))
+                t = self.next()
+                if t.kind != "num":
+                    self.error("expected a number")
+                v = -int(t.value) if neg else int(t.value)
+                if kw == "start":
+                    start = v
+                else:
+                    increment = v
+            return A.CreateSequence(name, start, increment, if_not_exists)
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -406,6 +457,14 @@ class Parser:
             name = self.expect_ident()
             cascade = bool(self.accept_kw("cascade"))
             return A.DropSchema(name, cascade)
+        if self.peek().kind == "ident" and self.peek().value in ("view", "sequence"):
+            kind = self.next().value
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.parse_table_name()
+            return (A.DropView if kind == "view" else A.DropSequence)(name, if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):
@@ -457,6 +516,7 @@ class Parser:
         "citus_stat_statements", "citus_stat_statements_reset",
         "citus_stat_activity", "citus_locks", "citus_lock_waits",
         "citus_shards", "citus_tables", "recover_prepared_transactions",
+        "nextval", "currval", "setval", "citus_views", "citus_sequences",
         "citus_get_node_clock", "citus_get_transaction_clock",
         "citus_create_restore_point", "citus_list_restore_points",
         "alter_distributed_table", "citus_check_cluster_node_health",
@@ -823,6 +883,17 @@ class Parser:
                 sel = self.parse_select()
                 self.expect_op(")")
                 return A.Exists(sel)
+            if t.value in ("left", "right") and self.peek(1).kind == "op" \
+                    and self.peek(1).value == "(":
+                # left()/right() string functions share spellings with the
+                # join keywords; the call parenthesis disambiguates
+                self.next()
+                self.expect_op("(")
+                args = [self.parse_expr()]
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+                self.expect_op(")")
+                return A.FuncCall(t.value, tuple(args))
         if t.kind == "param":
             self.next()
             return A.Param(int(t.value[1:]))
@@ -899,8 +970,18 @@ class Parser:
                             order.append((e_, asc))
                             if not self.accept_op(","):
                                 break
+                    frame = None
+                    if self.at_kw("rows", "range"):
+                        mode = self.next().value
+                        if mode == "range":
+                            self.error("RANGE frames beyond the default are "
+                                       "not supported; use ROWS")
+                        self.expect_kw("between")
+                        frame = (self._parse_frame_bound(),
+                                 (self.expect_kw("and"),
+                                  self._parse_frame_bound())[1])
                     self.expect_op(")")
-                    return A.WindowCall(fc, tuple(part), tuple(order))
+                    return A.WindowCall(fc, tuple(part), tuple(order), frame)
                 return fc
             if self.accept_op("."):
                 col = self.expect_ident()
