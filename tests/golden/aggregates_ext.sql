@@ -1,0 +1,15 @@
+-- extended aggregates: variance family, percentiles, string_agg, booleans
+CREATE TABLE m (k bigint NOT NULL, g bigint, v bigint, f double, s text, b boolean);
+SELECT create_distributed_table('m', 'k', 4);
+INSERT INTO m VALUES (1, 0, 10, 1.5, 'x', true), (2, 0, 20, 2.5, 'y', true),
+  (3, 0, 30, 3.5, 'x', false), (4, 1, 5, 0.5, 'z', true),
+  (5, 1, 15, 1.0, 'z', true), (6, 1, NULL, 2.0, NULL, NULL);
+SELECT stddev(v), stddev_pop(v), var_samp(v), var_pop(v) FROM m;
+SELECT g, stddev(v), variance(f) FROM m GROUP BY g ORDER BY g;
+SELECT percentile_cont(0.5) WITHIN GROUP (ORDER BY v) FROM m;
+SELECT g, percentile_disc(0.5) WITHIN GROUP (ORDER BY v) FROM m GROUP BY g ORDER BY g;
+SELECT bool_and(b), bool_or(b) FROM m;
+SELECT g, string_agg(s, ',') FROM m GROUP BY g ORDER BY g;
+SELECT count(DISTINCT s), count(s) FROM m;
+SELECT stddev(v) FROM m WHERE k = 1;
+DROP TABLE m;

@@ -1,0 +1,16 @@
+-- INSERT..SELECT strategy ladder + parameterless repartition behavior
+CREATE TABLE src (k bigint NOT NULL, v bigint, s text);
+SELECT create_distributed_table('src', 'k', 4);
+INSERT INTO src VALUES (1, 10, 'a'), (2, 20, 'b'), (3, 30, 'a'), (4, 40, 'c');
+CREATE TABLE colo (k bigint NOT NULL, v bigint, s text);
+SELECT create_distributed_table('colo', 'k', 4, 'src');
+INSERT INTO colo SELECT k, v, s FROM src WHERE v > 15;
+SELECT count(*), sum(v) FROM colo;
+CREATE TABLE byv (k bigint, v bigint NOT NULL);
+SELECT create_distributed_table('byv', 'v', 4);
+INSERT INTO byv SELECT k, v FROM src;
+SELECT count(*), sum(k) FROM byv;
+CREATE TABLE rollup (g text, n bigint);
+INSERT INTO rollup SELECT s, count(*) FROM src GROUP BY s;
+SELECT g, n FROM rollup ORDER BY g;
+DROP TABLE src; DROP TABLE colo; DROP TABLE byv; DROP TABLE rollup;

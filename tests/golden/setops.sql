@@ -1,0 +1,19 @@
+-- set operations, EXISTS, derived tables (recursive planning surface)
+CREATE TABLE a (k bigint NOT NULL, v bigint);
+CREATE TABLE b (k bigint NOT NULL, v bigint);
+SELECT create_distributed_table('a', 'k', 4);
+SELECT create_distributed_table('b', 'k', 4);
+INSERT INTO a VALUES (1, 1), (2, 2), (3, 3), (4, 4), (5, 5), (6, NULL);
+INSERT INTO b VALUES (1, 3), (2, 4), (3, 5), (4, 6), (5, NULL);
+SELECT v FROM a UNION SELECT v FROM b ORDER BY v NULLS LAST;
+SELECT v FROM a UNION ALL SELECT v FROM b ORDER BY v NULLS LAST;
+SELECT v FROM a INTERSECT SELECT v FROM b ORDER BY v;
+SELECT v FROM a EXCEPT SELECT v FROM b ORDER BY v;
+SELECT v FROM a WHERE v < 3 UNION SELECT v FROM a WHERE v > 4 INTERSECT SELECT v FROM b ORDER BY v;
+SELECT count(*) FROM a WHERE EXISTS (SELECT 1 FROM b WHERE b.v = 6);
+SELECT count(*) FROM a WHERE NOT EXISTS (SELECT 1 FROM b WHERE b.v = 99);
+SELECT z.v, count(*) FROM (SELECT v FROM a WHERE v IS NOT NULL UNION ALL SELECT v FROM b WHERE v IS NOT NULL) z GROUP BY z.v ORDER BY z.v;
+SELECT count(*) FROM a JOIN (SELECT k FROM b WHERE v >= 4) big ON a.k = big.k;
+SELECT v FROM a UNION SELECT k, v FROM b;
+DROP TABLE a;
+DROP TABLE b;

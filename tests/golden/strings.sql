@@ -1,0 +1,14 @@
+-- string function family over dictionary encodings
+CREATE TABLE s (k bigint NOT NULL, t text);
+SELECT create_distributed_table('s', 'k', 2);
+INSERT INTO s VALUES (1, '  Padded  '), (2, 'xxcorexx'), (3, 'citus data'), (4, NULL);
+SELECT k, trim(t) FROM s ORDER BY k;
+SELECT k, upper(trim(t)) FROM s ORDER BY k;
+SELECT k, replace(t, 'x', '') FROM s ORDER BY k;
+SELECT k, left(t, 3), right(t, 3) FROM s ORDER BY k;
+SELECT k, initcap(t), reverse(t) FROM s ORDER BY k;
+SELECT k, substring(t, 3, 4) FROM s ORDER BY k;
+SELECT k, length(trim(t)) FROM s ORDER BY k;
+SELECT lower(trim(t)) AS key, count(*) FROM s GROUP BY lower(trim(t)) ORDER BY key NULLS LAST;
+SELECT count(*) FROM s WHERE upper(t) LIKE '%CORE%';
+DROP TABLE s;

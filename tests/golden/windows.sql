@@ -1,0 +1,11 @@
+-- window frames, navigation functions, windows over GROUP BY
+CREATE TABLE w (k bigint NOT NULL, g bigint, v bigint);
+SELECT create_distributed_table('w', 'k', 4);
+INSERT INTO w VALUES (1, 0, 10), (2, 0, 40), (3, 0, 20), (4, 1, 5), (5, 1, 25), (6, 1, 15);
+SELECT k, sum(v) OVER (PARTITION BY g ORDER BY k) AS running FROM w ORDER BY k;
+SELECT k, sum(v) OVER (PARTITION BY g ORDER BY k ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS frame2 FROM w ORDER BY k;
+SELECT k, lag(v) OVER (PARTITION BY g ORDER BY k) AS prev, lead(v) OVER (PARTITION BY g ORDER BY k) AS nxt FROM w ORDER BY k;
+SELECT k, first_value(v) OVER (PARTITION BY g ORDER BY v) AS fv, last_value(v) OVER (PARTITION BY g ORDER BY v ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) AS lv FROM w ORDER BY k;
+SELECT k, ntile(3) OVER (ORDER BY v) AS bucket FROM w ORDER BY k;
+SELECT g, sum(v) AS total, rank() OVER (ORDER BY sum(v) DESC) AS rnk FROM w GROUP BY g ORDER BY g;
+DROP TABLE w;
