@@ -246,6 +246,51 @@ def _finalize_percentile(ex, partials, cat):
     return out, valid
 
 
+# -------------------------------------------------- min/max over text
+
+
+def bind_text_minmax(binder, kind: str, arg):
+    """min()/max() over a text expression: aggregate the lexicographic
+    RANK of each word (combinable int64 min/max — still one collective),
+    map the winning rank back to its word at finalize.  Built here
+    because the builtin min/max branch rejects text."""
+    from citus_tpu.planner.bind import AggSpec
+    from citus_tpu.planner.bound import BDictLookup
+    resolved = binder._text_words(arg)
+    if resolved is None:
+        raise UnsupportedFeatureError(
+            f"{kind}() over computed text is not supported")
+    base, _tname, _cname, eff_words = resolved
+    order = sorted(range(len(eff_words)), key=eff_words.__getitem__)
+    rank = [0] * len(eff_words)
+    for pos, i in enumerate(order):
+        rank[i] = pos
+    sorted_words = tuple(eff_words[i] for i in order)
+    ranked = BDictLookup(base, tuple(rank), T.INT64_T)
+    return AggSpec(f"{kind}_text", ranked, T.TEXT_T, param=sorted_words)
+
+
+def _lower_text_minmax(spec, arg_slot, partial_slot):
+    from citus_tpu.planner.physical import AggExtract
+    ai = arg_slot(spec.arg)
+    kind = "min" if spec.kind == "min_text" else "max"
+    s = partial_slot(kind, ai, "int64")
+    c = partial_slot("count", ai, "int64")
+    return AggExtract(spec.kind, [s, c], spec.out_type, param=spec.param)
+
+
+def _finalize_text_minmax(ex, partials, cat):
+    ranks = np.asarray(partials[ex.slots[0]])
+    c = np.asarray(partials[ex.slots[1]])
+    words = ex.param
+    out = np.empty(ranks.shape[0], object)
+    valid = c > 0
+    for i, r in enumerate(ranks):
+        if valid[i] and 0 <= int(r) < len(words):
+            out[i] = words[int(r)]
+    return out, valid
+
+
 AGG_REGISTRY: dict[str, AggDef] = {}
 
 
@@ -265,6 +310,8 @@ register(AggDef("array_agg", _bind_array_agg, _lower_collect,
 for _n in ("percentile_cont", "percentile_disc"):
     register(AggDef(_n, _bind_percentile, _lower_collect,
                     _finalize_percentile, needs_exact=True))
+for _n in ("min_text", "max_text"):
+    register(AggDef(_n, None, _lower_text_minmax, _finalize_text_minmax))
 
 
 def finalize_kind(kind: str):

@@ -577,8 +577,12 @@ class Binder:
                 if len(e.args) != 1:
                     raise AnalysisError(f"{e.name}() expects one argument")
                 arg = self.bind_scalar(e.args[0])
-                spec = AggSpec(e.name, arg, self._agg_output_type(e.name, arg),
-                               distinct=e.distinct)
+                if e.name in ("min", "max") and arg.type.is_text:
+                    from citus_tpu.planner.aggregates import bind_text_minmax
+                    spec = bind_text_minmax(self, e.name, arg)
+                else:
+                    spec = AggSpec(e.name, arg, self._agg_output_type(e.name, arg),
+                                   distinct=e.distinct)
             for i, existing in enumerate(aggs):
                 if existing == spec:
                     return BAggRef(i, spec.out_type)

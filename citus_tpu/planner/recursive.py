@@ -157,10 +157,119 @@ def rewrite_params(stmt, params):
     return stmt
 
 
+def _from_aliases(item) -> set:
+    if isinstance(item, A.TableRef):
+        return {item.alias or item.name}
+    if isinstance(item, A.SubqueryRef):
+        return {item.alias}
+    if isinstance(item, A.Join):
+        return _from_aliases(item.left) | _from_aliases(item.right)
+    return set()
+
+
+def _split_and(e):
+    if isinstance(e, A.BinOp) and e.op == "and":
+        return _split_and(e.left) + _split_and(e.right)
+    return [e] if e is not None else []
+
+
+def _and_all(parts):
+    out = None
+    for p in parts:
+        out = p if out is None else A.BinOp("and", out, p)
+    return out
+
+
+def _outer_refs(e, outer: set, inner: set) -> bool:
+    """Does the expression reference a column qualified by an OUTER
+    relation alias?  (Unqualified references are assumed inner.)"""
+    for n in _walk_columns(e):
+        if n.table is not None and n.table in outer and n.table not in inner:
+            return True
+    return False
+
+
+def _walk_columns(e):
+    if isinstance(e, A.ColumnRef):
+        yield e
+    elif isinstance(e, A.BinOp):
+        yield from _walk_columns(e.left)
+        yield from _walk_columns(e.right)
+    elif isinstance(e, A.UnOp):
+        yield from _walk_columns(e.operand)
+    elif isinstance(e, A.Between):
+        yield from _walk_columns(e.expr)
+        yield from _walk_columns(e.lo)
+        yield from _walk_columns(e.hi)
+    elif isinstance(e, A.InList):
+        yield from _walk_columns(e.expr)
+        for it in e.items:
+            yield from _walk_columns(it)
+    elif isinstance(e, (A.IsNull, A.Cast)):
+        yield from _walk_columns(e.expr)
+    elif isinstance(e, A.CaseExpr):
+        for c, v in e.whens:
+            yield from _walk_columns(c)
+            yield from _walk_columns(v)
+        if e.else_ is not None:
+            yield from _walk_columns(e.else_)
+    elif isinstance(e, A.FuncCall):
+        for a in e.args:
+            yield from _walk_columns(a)
+
+
+def decorrelate_exists(sub: A.Exists, outer_aliases: set,
+                       negated: bool):
+    """Equality-correlated EXISTS -> semi/anti-join rewrite (reference:
+    recursive planning converts correlated sublinks it can pull up,
+    recursive_planning.c).  EXISTS (SELECT .. FROM u WHERE u.x = t.y AND
+    <inner preds>) becomes t.y IN (SELECT x FROM u WHERE <inner preds>);
+    NOT EXISTS additionally preserves NULL outer keys (they can never
+    match, so NOT EXISTS is true for them — unlike NOT IN).  Returns the
+    rewritten expression or None when the shape is not supported."""
+    sel = sub.select
+    if not isinstance(sel, A.Select) or not isinstance(sel.from_, A.TableRef):
+        return None
+    if sel.group_by or sel.having or sel.limit is not None:
+        return None
+    inner = {sel.from_.alias or sel.from_.name}
+    # outer refs anywhere outside WHERE make the shape unsupported
+    for it in sel.items:
+        if _outer_refs(it.expr, outer_aliases, inner):
+            return None
+    corr = []
+    inner_only = []
+    for c in _split_and(sel.where):
+        if not _outer_refs(c, outer_aliases, inner):
+            inner_only.append(c)
+            continue
+        if not (isinstance(c, A.BinOp) and c.op == "="):
+            return None
+        l_out = _outer_refs(c.left, outer_aliases, inner)
+        r_out = _outer_refs(c.right, outer_aliases, inner)
+        if l_out and not r_out:
+            corr.append((c.left, c.right))
+        elif r_out and not l_out:
+            corr.append((c.right, c.left))
+        else:
+            return None
+    if len(corr) != 1:
+        return None
+    outer_e, inner_e = corr[0]
+    inner_sel = A.Select([A.SelectItem(inner_e)], sel.from_,
+                         _and_all(inner_only))
+    if not negated:
+        return A.InList(outer_e, (A.Subquery(inner_sel),), negated=False)
+    return A.BinOp("or",
+                   A.InList(outer_e, (A.Subquery(inner_sel),), negated=True),
+                   A.IsNull(outer_e))
+
+
 def rewrite_subqueries(stmt: A.Select, run_select) -> A.Select:
     """Execute every subquery in the statement via ``run_select`` and
     substitute its result.  Returns a new Select (or the original when
     there was nothing to do)."""
+    outer_aliases = _from_aliases(stmt.from_) if stmt.from_ is not None else set()
 
     def exec_scalar(sub: A.Subquery) -> A.Literal:
         r = run_select(sub.select)
@@ -193,12 +302,19 @@ def rewrite_subqueries(stmt: A.Select, run_select) -> A.Select:
         if e is None:
             return None
         if isinstance(e, A.Exists):
+            dec = decorrelate_exists(e, outer_aliases, negated=False)
+            if dec is not None:
+                return rw(dec)
             return exec_exists(e)
         if isinstance(e, A.Subquery):
             return exec_scalar(e)
         if isinstance(e, A.BinOp):
             return A.BinOp(e.op, rw(e.left), rw(e.right))
         if isinstance(e, A.UnOp):
+            if e.op == "not" and isinstance(e.operand, A.Exists):
+                dec = decorrelate_exists(e.operand, outer_aliases, negated=True)
+                if dec is not None:
+                    return rw(dec)
             return A.UnOp(e.op, rw(e.operand))
         if isinstance(e, A.Between):
             return A.Between(rw(e.expr), rw(e.lo), rw(e.hi), e.negated)

@@ -82,3 +82,55 @@ def test_parameterized_queries(db):
     check(db, "SELECT count(*) FROM t")
     with pytest.raises(AnalysisError):
         cl.execute("SELECT count(*) FROM t WHERE v > $2", params=(1,))
+
+
+def test_min_max_over_text(tmp_path):
+    """min()/max() over text: lexicographic rank partials stay
+    device-combinable (int64 min/max), finalize maps back to words."""
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path / "mmtext"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, g bigint, s text)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", rows=[(1, 0, "banana"), (2, 0, "apple"), (3, 1, "cherry"),
+                            (4, 1, "date"), (5, 0, None), (6, 2, None)])
+    assert cl.execute("SELECT min(s), max(s) FROM t").rows == [("apple", "date")]
+    assert cl.execute("SELECT g, min(s), max(s) FROM t GROUP BY g ORDER BY g").rows \
+        == [(0, "apple", "banana"), (1, "cherry", "date"), (2, None, None)]
+    assert cl.execute("SELECT min(upper(s)) FROM t").rows == [("APPLE",)]
+    assert cl.execute("SELECT max(s) FROM t WHERE k > 100").rows == [(None,)]
+    cl.close()
+
+
+def test_correlated_exists_semi_join(tmp_path):
+    """Equality-correlated [NOT] EXISTS decorrelates to semi/anti-join
+    (NULL outer keys preserved under NOT EXISTS, unlike NOT IN)."""
+    import sqlite3
+
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path / "corr"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("CREATE TABLE u (k bigint NOT NULL, w bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.execute("SELECT create_distributed_table('u', 'k', 4)")
+    trows = [(i, i % 7 if i % 11 else None) for i in range(200)]
+    urows = [(i, i % 5) for i in range(80)]
+    cl.copy_from("t", rows=trows)
+    cl.copy_from("u", rows=urows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, v INTEGER)")
+    sq.execute("CREATE TABLE u (k INTEGER, w INTEGER)")
+    sq.executemany("INSERT INTO t VALUES (?,?)", trows)
+    sq.executemany("INSERT INTO u VALUES (?,?)", urows)
+    for sql in [
+        "SELECT count(*) FROM t WHERE EXISTS (SELECT 1 FROM u WHERE u.k = t.k)",
+        "SELECT count(*) FROM t WHERE EXISTS (SELECT 1 FROM u WHERE u.k = t.k AND u.w > 2)",
+        "SELECT count(*) FROM t WHERE NOT EXISTS (SELECT 1 FROM u WHERE u.k = t.k)",
+        "SELECT count(*) FROM t WHERE NOT EXISTS (SELECT 1 FROM u WHERE u.w = t.v)",
+        "SELECT count(*) FROM t WHERE EXISTS (SELECT 1 FROM u WHERE t.v = u.w AND u.k < 40)",
+        "SELECT v, count(*) FROM t WHERE EXISTS (SELECT 1 FROM u WHERE u.k = t.k) "
+        "GROUP BY v ORDER BY v NULLS LAST",
+    ]:
+        ours = [tuple(r) for r in cl.execute(sql).rows]
+        theirs = [tuple(r) for r in sq.execute(sql).fetchall()]
+        assert ours == theirs, (sql, ours, theirs)
+    cl.close()
