@@ -1011,21 +1011,44 @@ class Cluster:
         # grouped rows (PostgreSQL semantics — windows after aggregation)
         base = A.Select(base_items, stmt.from_, stmt.where,
                         stmt.group_by, stmt.having)
-        r = self._execute_stmt(base)
-        n = r.rowcount
-        cols = [[row[j] for row in r.rows] for j in range(len(base_items))]
-        out_cols = []
-        for spec in outputs:
-            if spec[0] == "col":
-                out_cols.append(cols[spec[1]])
-            else:
-                _, fn, arg_slots, part_slots, order_specs, frame, params = spec
-                out_cols.append(compute_window(
-                    n, fn, [cols[s] for s in arg_slots],
-                    [cols[s] for s in part_slots],
-                    [(cols[s], asc) for s, asc in order_specs],
-                    frame=frame, params=params))
-        rows = [tuple(c[i] for c in out_cols) for i in range(n)]
+        def window_pass(rows_in: list) -> list[tuple]:
+            """Apply every window spec over one row set -> output rows."""
+            n = len(rows_in)
+            cols = [[row[j] for row in rows_in] for j in range(len(base_items))]
+            out_cols = []
+            for spec in outputs:
+                if spec[0] == "col":
+                    out_cols.append(cols[spec[1]])
+                else:
+                    _, fn, arg_slots, part_slots, order_specs, frame, params = spec
+                    out_cols.append(compute_window(
+                        n, fn, [cols[s] for s in arg_slots],
+                        [cols[s] for s in part_slots],
+                        [(cols[s], asc) for s, asc in order_specs],
+                        frame=frame, params=params))
+            return [tuple(c[i] for c in out_cols) for i in range(n)]
+
+        strategy = "window:pull"
+        if self._window_pushdown_eligible(stmt, outputs):
+            # every window partitions by the distribution column, so no
+            # partition spans shards: the whole window computation runs
+            # per shard and results concatenate (reference: pushdown when
+            # partitioned by the distribution column, multi_explain/
+            # query_pushdown_planning safety proof)
+            import dataclasses
+            from citus_tpu.planner.physical import plan_select
+            bound = bind_select(self.catalog, base)
+            plan = plan_select(self.catalog, bound,
+                               direct_limit=self.settings.planner.direct_gid_limit)
+            rows = []
+            for si in plan.shard_indexes:
+                shard_plan = dataclasses.replace(plan, shard_indexes=[si])
+                shard_rows = execute_select(self.catalog, bound, self.settings,
+                                            plan=shard_plan).rows
+                rows.extend(window_pass(shard_rows))
+            strategy = "window:pushdown"
+        else:
+            rows = window_pass(self._execute_stmt(base).rows)
         # outer ORDER BY / LIMIT over the final outputs (name or position)
         for oi in reversed(stmt.order_by):
             idx = None
@@ -1047,7 +1070,31 @@ class Cluster:
         if stmt.limit is not None:
             rows = rows[:stmt.limit]
         return Result(columns=names, rows=rows,
-                      explain={"strategy": "window:pull"})
+                      explain={"strategy": strategy})
+
+    def _window_pushdown_eligible(self, stmt: A.Select, outputs) -> bool:
+        """Safe to compute windows per shard: single distributed table,
+        no GROUP BY, and every window's PARTITION BY includes the plain
+        distribution column (hash partitions never span shards)."""
+        if stmt.group_by or stmt.having:
+            return False
+        if not isinstance(stmt.from_, A.TableRef):
+            return False
+        if not self.catalog.has_table(stmt.from_.name):
+            return False
+        t = self.catalog.table(stmt.from_.name)
+        if not t.is_distributed or t.dist_column is None:
+            return False
+        alias = stmt.from_.alias or stmt.from_.name
+        for item in stmt.items:
+            e = item.expr
+            if not isinstance(e, A.WindowCall):
+                continue
+            if not any(isinstance(p, A.ColumnRef) and p.name == t.dist_column
+                       and (p.table is None or p.table == alias)
+                       for p in e.partition_by):
+                return False
+        return True
 
     _CTE_SEQ = [0]
 

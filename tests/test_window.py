@@ -57,3 +57,29 @@ def test_window_with_outer_order_limit(db):
     ours = cl.execute(sql).rows
     theirs = sq.execute(sql).fetchall()
     assert ours == list(theirs)
+
+
+def test_window_pushdown_on_dist_column(tmp_path):
+    """PARTITION BY distribution column -> per-shard window computation
+    (reference: pushdown safety when partitioned by the distcol)."""
+    import sqlite3
+    cl = ct.Cluster(str(tmp_path / "wp"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, g bigint, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    rows = [(i % 10, i % 3, (i * 7) % 20) for i in range(60)]
+    cl.copy_from("t", rows=rows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, g INTEGER, v INTEGER)")
+    sq.executemany("INSERT INTO t VALUES (?,?,?)", rows)
+    sql = ("SELECT k, sum(v) OVER (PARTITION BY k ORDER BY v "
+           "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s "
+           "FROM t ORDER BY k, s")
+    r = cl.execute(sql)
+    assert r.explain["strategy"] == "window:pushdown"
+    assert sorted(r.rows) == sorted(tuple(x) for x in sq.execute(sql).fetchall())
+    # non-dist partition falls back to pull, same results
+    sql2 = "SELECT k, sum(v) OVER (PARTITION BY g) AS s FROM t ORDER BY k, s"
+    r2 = cl.execute(sql2)
+    assert r2.explain["strategy"] == "window:pull"
+    assert sorted(r2.rows) == sorted(tuple(x) for x in sq.execute(sql2).fetchall())
+    cl.close()
