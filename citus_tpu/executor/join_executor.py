@@ -301,6 +301,8 @@ def _execute_join_tree(cat: Catalog, bj: BoundJoinSelect,
 
     ``frame_override`` supplies pre-partitioned frames for relations the
     repartition shuffle already bucketed (the merge half of MapMergeJob)."""
+    if frame_override is not None and "__result__" in frame_override:
+        return frame_override["__result__"]  # stepwise DAG already joined
     qualified = bj.binder.qualified
     frames = {}
     for alias, t in bj.rels:
@@ -316,31 +318,102 @@ def _execute_join_tree(cat: Catalog, bj: BoundJoinSelect,
     cur, n = frames[bj.rels[0][0]]
     for step in bj.steps:
         right, rn = frames[step.right_alias]
-        if step.kind == "cross" or not step.left_keys:
-            if n * rn > MAX_CROSS_ROWS:
-                raise ExecutionError("cross join result too large")
-            li = np.repeat(np.arange(n, dtype=np.int64), rn)
-            ri = np.tile(np.arange(rn, dtype=np.int64), n)
-            lfound = np.ones(len(li), bool)
-            rfound = np.ones(len(ri), bool)
-        else:
-            lmat, lvalid = _key_matrix(cur, step.left_keys, n)
-            rmat, rvalid = _key_matrix(right, step.right_keys, rn)
-            li, ri, lfound, rfound = _hash_join_indexes(lmat, lvalid, rmat, rvalid, step.kind)
-        new = _gather(cur, li, lfound if step.kind in ("right", "full") else None)
-        new.update(_gather(right, ri, rfound if step.kind in ("left", "full", "inner", "cross") else None))
-        n = len(li)
-        cur = new
-        if step.residual is not None:
-            fn = compile_expr(step.residual, np)
-            mask = np.asarray(predicate_mask(np, fn, cur, np.ones(n, bool)))
-            if mask.shape == ():
-                mask = np.full(n, bool(mask))
-            keep = np.nonzero(mask)[0]
-            cur = {k: (v[keep], m[keep] if not isinstance(m, bool) else m)
-                   for k, (v, m) in cur.items()}
-            n = keep.size
+        cur, n = _apply_step(cur, n, right, rn, step)
     return cur, n
+
+
+def _apply_step(cur, n, right, rn, step):
+    """Join one step's right frame onto the accumulated frame."""
+    if step.kind == "cross" or not step.left_keys:
+        if n * rn > MAX_CROSS_ROWS:
+            raise ExecutionError("cross join result too large")
+        li = np.repeat(np.arange(n, dtype=np.int64), rn)
+        ri = np.tile(np.arange(rn, dtype=np.int64), n)
+        lfound = np.ones(len(li), bool)
+        rfound = np.ones(len(ri), bool)
+    else:
+        lmat, lvalid = _key_matrix(cur, step.left_keys, n)
+        rmat, rvalid = _key_matrix(right, step.right_keys, rn)
+        li, ri, lfound, rfound = _hash_join_indexes(lmat, lvalid, rmat, rvalid, step.kind)
+    new = _gather(cur, li, lfound if step.kind in ("right", "full") else None)
+    new.update(_gather(right, ri, rfound if step.kind in ("left", "full", "inner", "cross") else None))
+    n = len(li)
+    cur = new
+    if step.residual is not None:
+        fn = compile_expr(step.residual, np)
+        mask = np.asarray(predicate_mask(np, fn, cur, np.ones(n, bool)))
+        if mask.shape == ():
+            mask = np.full(n, bool(mask))
+        keep = np.nonzero(mask)[0]
+        cur = {k: (v[keep], m[keep] if not isinstance(m, bool) else m)
+               for k, (v, m) in cur.items()}
+        n = keep.size
+    return cur, n
+
+
+def _concat_frames(pieces):
+    """[(frame, n)] -> (frame, n) — column-wise concatenation.  Keeps a
+    zero-row frame's schema so later steps can still evaluate keys."""
+    nonzero = [(f, n) for f, n in pieces if n > 0]
+    if not nonzero:
+        return (pieces[0][0], 0) if pieces else ({}, 0)
+    pieces = nonzero
+    if len(pieces) == 1:
+        return pieces[0]
+    keys = list(pieces[0][0].keys())
+    out = {}
+    for k in keys:
+        vals = np.concatenate([np.asarray(f[k][0]) for f, _ in pieces])
+        ms = np.concatenate([
+            (np.asarray(f[k][1]) if not isinstance(f[k][1], bool)
+             else np.full(n, f[k][1])) for f, n in pieces])
+        out[k] = (vals, ms)
+    return out, sum(n for _, n in pieces)
+
+
+def _stepwise_shuffle_join(cat: Catalog, bj: BoundJoinSelect,
+                           settings: Settings):
+    """Multi-step shuffle DAG: each equi-join step hash-partitions both
+    the accumulated frame and the incoming relation on the step's keys
+    and joins bucket-by-bucket — the general MapMergeJob composition for
+    arbitrary join trees (reference: dependent MapMerge jobs executed in
+    dependency order, directed_acyclic_graph_execution.c:57).  Buckets
+    then concatenate so the next step can re-partition on ITS keys."""
+    qualified = bj.binder.qualified
+    frames = {alias: _load_rel_frame(cat, bj.rel_plans[alias], qualified)
+              for alias, _t in bj.rels}
+    use_device = settings.executor.task_executor_backend != "cpu"
+    mesh = None
+    if use_device:
+        import jax
+        if len(jax.devices()) > 1:
+            from citus_tpu.parallel.mesh import default_mesh
+            mesh = default_mesh()
+    B = (mesh.shape["shard"] if mesh is not None
+         else settings.planner.repartition_bucket_count_per_device * 8)
+    mode = "all_to_all" if mesh is not None else "host"
+    cur, n = frames[bj.rels[0][0]]
+    shuffles = 0
+    for step in bj.steps:
+        right, rn = frames[step.right_alias]
+        if step.left_keys and (n + rn) > 0:
+            ltgt = _bucket_targets(cur, step.left_keys, n, B)
+            rtgt = _bucket_targets(right, step.right_keys, rn, B)
+            if mesh is not None and cur and right:
+                lb = _device_shuffle(cur, ltgt, mesh)
+                rb = _device_shuffle(right, rtgt, mesh)
+            else:
+                lb = _host_shuffle(cur, ltgt, B)
+                rb = _host_shuffle(right, rtgt, B)
+            shuffles += 1
+            pieces = []
+            for b in range(B):
+                (f_l, n_l), (f_r, n_r) = lb[b], rb[b]
+                pieces.append(_apply_step(f_l, n_l, f_r, n_r, step))
+            cur, n = _concat_frames(pieces)
+        else:
+            cur, n = _apply_step(cur, n, right, rn, step)
+    return cur, n, mode, shuffles
 
 
 class _JoinPlanView:
@@ -384,9 +457,13 @@ def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -
         dist = [t for _, t in bj.rels if t.is_distributed]
         tasks = ([(si, None) for si in range(dist[0].shard_count)]
                  if dist else [(None, None)])
-    elif strategy == "repartition":
+    elif strategy == "repartition" and bj.repartition_spec is not None:
         overrides, shuffle_mode = _repartition_tasks(cat, bj, settings)
         tasks = [(None, fo) for fo in overrides]
+    elif strategy == "repartition":
+        frame_n = _stepwise_shuffle_join(cat, bj, settings)
+        shuffle_mode = f"{frame_n[2]}:{frame_n[3]}-step"
+        tasks = [(None, {"__result__": (frame_n[0], frame_n[1])})]
     else:
         tasks = [(None, None)]
 

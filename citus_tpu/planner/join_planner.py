@@ -375,6 +375,11 @@ def _choose_strategy(bj: BoundJoinSelect) -> str:
     if spec is not None:
         bj.repartition_spec = spec
         return "repartition"
+    if any(s.left_keys for s in bj.steps):
+        # general case: step-wise shuffle DAG (each equi step partitions
+        # both sides on its keys, joins per bucket) — always correct,
+        # bounds each join's working set; repartition_spec stays None
+        return "repartition"
     return "pull"
 
 

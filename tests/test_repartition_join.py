@@ -129,12 +129,24 @@ def test_colocated_still_colocated(db):
     assert r.explain["strategy"] == "join:colocated"
 
 
-def test_three_distributed_rels_fall_back_to_pull(db, tmp_path):
+def test_three_distributed_rels_stepwise_dag(db, tmp_path):
+    """Three distributed relations on two different join keys: the
+    step-wise shuffle DAG repartitions per step (2 shuffles), matching
+    the pull result exactly."""
     db.execute("CREATE TABLE extra (e_id bigint NOT NULL, e_k bigint)")
     db.execute("SELECT create_distributed_table('extra', 'e_id', 4)")
     db.copy_from("extra", columns={"e_id": np.arange(100),
                                    "e_k": np.arange(100)})
-    r = db.execute("""SELECT count(*) FROM lineitem l
+    sql = """SELECT count(*) FROM lineitem l
         JOIN orders o ON l.l_orderkey = o.o_orderkey
-        JOIN extra e ON e.e_k = l.l_qty""")
-    assert r.explain["strategy"] == "join:pull"
+        JOIN extra e ON e.e_k = l.l_qty"""
+    r = db.execute(sql)
+    assert r.explain["strategy"] == "join:repartition"
+    assert r.explain["shuffle"].endswith("2-step"), r.explain
+    pull = pull_cluster(tmp_path)
+    try:
+        r2 = pull.execute(sql)
+        assert r2.explain["strategy"] == "join:pull"
+        assert r.rows == r2.rows
+    finally:
+        pull.close()
