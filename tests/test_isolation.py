@@ -199,3 +199,122 @@ def test_move_during_update_serializes(db):
     expected_bumped = len([k for k in range(20_000) if k % 7 == 0])
     r = cl.execute("SELECT count(*), sum(v) FROM t").rows
     assert r == [(20_000, 20_000 + 5 * expected_bumped)]
+
+
+def test_concurrent_vacuum_and_writer(db):
+    cl = db
+    done = threading.Event()
+    wrote = [0]
+
+    def writer():
+        i = 0
+        while not done.is_set() and i < 60:
+            cl.copy_from("t", columns={
+                "k": np.arange(i * 20, (i + 1) * 20, dtype=np.int64) + 3 * 10**7,
+                "v": np.full(20, 9, dtype=np.int64)})
+            wrote[0] += 20
+            i += 1
+
+    def vacuumer():
+        cl.execute("DELETE FROM t WHERE k < 2000")
+        for _ in range(3):
+            cl.execute("VACUUM t")
+        done.set()
+
+    _run_all([writer, vacuumer])
+    assert cl.execute("SELECT count(*) FROM t").rows == \
+        [(20_000 - 2000 + wrote[0],)]
+
+
+def test_concurrent_merges_serialize(db):
+    cl = db
+    cl.execute("CREATE TABLE delta (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('delta', 'k', 4)")
+    cl.copy_from("delta", columns={"k": np.arange(1000, dtype=np.int64),
+                                   "v": np.full(1000, 5, dtype=np.int64)})
+
+    def merger():
+        cl.execute("""MERGE INTO t USING delta d ON t.k = d.k
+            WHEN MATCHED THEN UPDATE SET v = t.v + 1""")
+
+    ts = [threading.Thread(target=merger) for _ in range(3)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join(60)
+    # each merge bumped the 1000 matched rows exactly once
+    assert cl.execute("SELECT sum(v) FROM t").rows == [(20_000 + 3 * 1000,)]
+    assert cl.execute("SELECT count(*) FROM t").rows == [(20_000,)]
+
+
+def test_concurrent_split_and_readers(db):
+    cl = db
+    t = cl.catalog.table("t")
+    shard = t.shards[3]
+    mid = (shard.hash_min + shard.hash_max) // 2
+    results = []
+
+    def reader():
+        for _ in range(25):
+            results.append(cl.execute("SELECT count(*), sum(v) FROM t").rows)
+
+    def splitter():
+        from citus_tpu.operations.shard_split import split_shard
+        split_shard(cl.catalog, shard.shard_id, [mid], lock_manager=cl.locks)
+
+    _run_all([reader, splitter])
+    assert all(r == [(20_000, 20_000)] for r in results)
+    assert cl.catalog.table("t").shard_count == 5
+
+
+def test_concurrent_ddl_and_select_other_table(db):
+    """DDL on one table never disturbs readers of another."""
+    cl = db
+    errs = []
+
+    def ddl():
+        for i in range(10):
+            cl.execute(f"CREATE TABLE tmp_{i} (a bigint)")
+            cl.execute(f"INSERT INTO tmp_{i} VALUES (1)")
+            cl.execute(f"DROP TABLE tmp_{i}")
+
+    def reader():
+        for _ in range(30):
+            if cl.execute("SELECT count(*) FROM t").rows != [(20_000,)]:
+                errs.append("bad read")  # pragma: no cover
+
+    _run_all([ddl, reader])
+    assert not errs
+
+
+def test_concurrent_truncate_and_read(db):
+    cl = db
+    counts = []
+
+    def reader():
+        for _ in range(20):
+            counts.append(cl.execute("SELECT count(*) FROM t").rows[0][0])
+
+    def truncator():
+        cl.execute("TRUNCATE t")
+
+    _run_all([reader, truncator])
+    # reads see either the full table or the empty one, nothing between
+    assert all(c in (0, 20_000) for c in counts), counts
+    assert cl.execute("SELECT count(*) FROM t").rows == [(0,)]
+
+
+def test_concurrent_update_vs_delete_overlap(db):
+    """Overlapping UPDATE and DELETE serialize: every row is either
+    bumped then deleted or deleted first — never half-applied."""
+    cl = db
+
+    def updater():
+        cl.execute("UPDATE t SET v = v + 100 WHERE k < 10000")
+
+    def deleter():
+        cl.execute("DELETE FROM t WHERE k < 10000")
+
+    _run_all([updater, deleter])
+    assert cl.execute("SELECT count(*) FROM t").rows == [(10_000,)]
+    assert cl.execute("SELECT sum(v) FROM t").rows == [(10_000,)]
