@@ -565,6 +565,9 @@ class Cluster:
             return self._execute_with(stmt)
         if isinstance(stmt, A.SetOp):
             return self._execute_setop(stmt)
+        if isinstance(stmt, A.Select) and stmt.from_ is not None:
+            from citus_tpu.planner.recursive import decorrelate_scalars
+            stmt = decorrelate_scalars(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is not None \
                 and self.catalog.views:
             new_from = self._expand_views(stmt.from_)

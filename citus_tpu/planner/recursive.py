@@ -265,6 +265,109 @@ def decorrelate_exists(sub: A.Exists, outer_aliases: set,
                    A.IsNull(outer_e))
 
 
+def decorrelate_scalars(stmt: A.Select) -> A.Select:
+    """Equality-correlated scalar AGGREGATE subqueries in the select
+    list / WHERE become LEFT JOINs against a grouped derived table
+    (reference: sublink pull-up in recursive planning):
+
+        SELECT (SELECT max(x) FROM u WHERE u.k = t.k) FROM t
+        -> SELECT __corr_1.__cv FROM t
+           LEFT JOIN (SELECT u.k AS __ck, max(x) AS __cv
+                      FROM u GROUP BY u.k) __corr_1 ON t.k = __corr_1.__ck
+
+    Aggregates guarantee one row per key; a missing key yields NULL
+    (count() additionally coalesces to 0, matching scalar-subquery
+    semantics over an empty set).  Returns the original statement when
+    nothing matches."""
+    if stmt.from_ is None or stmt.group_by or stmt.having or stmt.distinct:
+        return stmt
+    if any(isinstance(i.expr, A.WindowCall) for i in stmt.items):
+        return stmt
+    outer = _from_aliases(stmt.from_)
+    counter = [0]
+    joins: list = []
+
+    def maybe_rewrite(sub: A.Subquery):
+        from citus_tpu.planner.bind import _contains_agg
+        sel = sub.select
+        if not isinstance(sel, A.Select) or not isinstance(sel.from_, A.TableRef):
+            return None
+        if sel.group_by or sel.having or sel.limit is not None \
+                or len(sel.items) != 1:
+            return None
+        item = sel.items[0]
+        if not _contains_agg(item.expr):
+            return None
+        inner = {sel.from_.alias or sel.from_.name}
+        if _outer_refs(item.expr, outer, inner):
+            return None
+        corr, inner_only = [], []
+        for c in _split_and(sel.where):
+            if not _outer_refs(c, outer, inner):
+                inner_only.append(c)
+                continue
+            if not (isinstance(c, A.BinOp) and c.op == "="):
+                return None
+            l_out = _outer_refs(c.left, outer, inner)
+            r_out = _outer_refs(c.right, outer, inner)
+            if l_out and not r_out:
+                corr.append((c.left, c.right))
+            elif r_out and not l_out:
+                corr.append((c.right, c.left))
+            else:
+                return None
+        if len(corr) != 1:
+            return None
+        outer_e, inner_e = corr[0]
+        counter[0] += 1
+        alias = f"__corr_{counter[0]}"
+        derived = A.Select(
+            [A.SelectItem(inner_e, "__ck"), A.SelectItem(item.expr, "__cv")],
+            sel.from_, _and_all(inner_only), group_by=[inner_e])
+        joins.append((alias, derived, outer_e))
+        repl: A.Expr = A.ColumnRef("__cv", table=alias)
+        if isinstance(item.expr, A.FuncCall) and item.expr.name == "count":
+            repl = A.FuncCall("coalesce", (repl, A.Literal(0, "int")))
+        return repl
+
+    def rwx(e):
+        if e is None:
+            return None
+        if isinstance(e, A.Subquery):
+            r = maybe_rewrite(e)
+            return r if r is not None else e
+        if isinstance(e, A.BinOp):
+            return A.BinOp(e.op, rwx(e.left), rwx(e.right))
+        if isinstance(e, A.UnOp):
+            return A.UnOp(e.op, rwx(e.operand))
+        if isinstance(e, A.Between):
+            return A.Between(rwx(e.expr), rwx(e.lo), rwx(e.hi), e.negated)
+        if isinstance(e, A.InList):
+            return A.InList(rwx(e.expr), tuple(rwx(i) for i in e.items), e.negated)
+        if isinstance(e, A.IsNull):
+            return A.IsNull(rwx(e.expr), e.negated)
+        if isinstance(e, A.Cast):
+            return A.Cast(rwx(e.expr), e.type_name, e.type_args)
+        if isinstance(e, A.CaseExpr):
+            return A.CaseExpr(tuple((rwx(c), rwx(v)) for c, v in e.whens),
+                              rwx(e.else_) if e.else_ is not None else None)
+        if isinstance(e, A.FuncCall):
+            return A.FuncCall(e.name, tuple(rwx(a) for a in e.args), e.distinct)
+        return e
+
+    new_items = [A.SelectItem(rwx(i.expr), i.alias) for i in stmt.items]
+    new_where = rwx(stmt.where)
+    if not joins:
+        return stmt
+    new_from = stmt.from_
+    for alias, derived, outer_e in joins:
+        new_from = A.Join(
+            new_from, A.SubqueryRef(derived, alias), "left",
+            A.BinOp("=", outer_e, A.ColumnRef("__ck", table=alias)))
+    return A.Select(new_items, new_from, new_where, [], None,
+                    stmt.order_by, stmt.limit, stmt.offset, stmt.distinct)
+
+
 def rewrite_subqueries(stmt: A.Select, run_select) -> A.Select:
     """Execute every subquery in the statement via ``run_select`` and
     substitute its result.  Returns a new Select (or the original when

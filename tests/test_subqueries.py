@@ -134,3 +134,36 @@ def test_correlated_exists_semi_join(tmp_path):
         theirs = [tuple(r) for r in sq.execute(sql).fetchall()]
         assert ours == theirs, (sql, ours, theirs)
     cl.close()
+
+
+def test_correlated_scalar_subqueries(tmp_path):
+    """Equality-correlated scalar aggregate subqueries decorrelate to a
+    LEFT JOIN on a grouped derived table (count coalesces to 0)."""
+    import sqlite3
+
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path / "cscal"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, g bigint, v bigint)")
+    cl.execute("CREATE TABLE u (k bigint NOT NULL, w bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.execute("SELECT create_distributed_table('u', 'k', 4)")
+    trows = [(i, i % 6, (i * 3) % 50) for i in range(150)]
+    urows = [(i % 40, i) for i in range(120)]
+    cl.copy_from("t", rows=trows)
+    cl.copy_from("u", rows=urows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, g INTEGER, v INTEGER)")
+    sq.execute("CREATE TABLE u (k INTEGER, w INTEGER)")
+    sq.executemany("INSERT INTO t VALUES (?,?,?)", trows)
+    sq.executemany("INSERT INTO u VALUES (?,?)", urows)
+    for sql in [
+        "SELECT t.k, (SELECT max(w) FROM u WHERE u.k = t.k) FROM t ORDER BY t.k LIMIT 50",
+        "SELECT t.k, (SELECT count(*) FROM u WHERE u.k = t.k) FROM t ORDER BY t.k LIMIT 50",
+        "SELECT count(*) FROM t WHERE t.v > (SELECT avg(w) FROM u WHERE u.k = t.g)",
+        "SELECT t.k, (SELECT sum(w) FROM u WHERE u.k = t.k AND u.w > 30) FROM t "
+        "ORDER BY t.k LIMIT 40",
+    ]:
+        ours = [tuple(r) for r in cl.execute(sql).rows]
+        theirs = [tuple(r) for r in sq.execute(sql).fetchall()]
+        assert ours == theirs, (sql, ours[:6], theirs[:6])
+    cl.close()
