@@ -10,6 +10,7 @@ both as Python methods and through their SQL spellings
 
 from __future__ import annotations
 
+import json
 from typing import Any, Iterable, Optional, Sequence
 
 import numpy as np
@@ -708,6 +709,9 @@ class Cluster:
                 t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
                 n = execute_delete(self.catalog, self.txlog, t, where)
             self._plan_cache.clear()
+            if self.cdc.enabled and n:
+                self.cdc.emit(t.name, "delete", self.clock.transaction_clock(),
+                              count=n)
             return Result(columns=[], rows=[], explain={"deleted": n})
         if isinstance(stmt, A.Update):
             from citus_tpu.executor.dml import execute_update
@@ -738,6 +742,9 @@ class Cluster:
                 t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
                 n = execute_update(self.catalog, self.txlog, t, assignments, where)
             self._plan_cache.clear()
+            if self.cdc.enabled and n:
+                self.cdc.emit(t.name, "update", self.clock.transaction_clock(),
+                              count=n)
             return Result(columns=[], rows=[], explain={"updated": n})
         if isinstance(stmt, A.AlterTable):
             if stmt.action == "add_column":
@@ -764,6 +771,10 @@ class Cluster:
                     encode_value=lambda tbl, col, v:
                         int(self.catalog.encode_strings(tbl, col, [v])[0]))
             self._plan_cache.clear()
+            if self.cdc.enabled:
+                self.cdc.emit(stmt.target.name, "merge",
+                              self.clock.transaction_clock(),
+                              count=sum(st.values()))
             return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.Truncate):
             from citus_tpu.executor.dml import execute_truncate
@@ -772,6 +783,8 @@ class Cluster:
             with self._write_lock(t, EXCLUSIVE):
                 execute_truncate(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
+            if self.cdc.enabled:
+                self.cdc.emit(t.name, "truncate", self.clock.transaction_clock())
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Vacuum):
             from citus_tpu.executor.dml import execute_vacuum
@@ -1494,6 +1507,15 @@ class Cluster:
         if name == "setval":
             v = self.catalog.setval(str(args[0]), int(args[1]))
             return Result(columns=["setval"], rows=[(v,)])
+        if name == "citus_cdc_events":
+            # consumer API: changes for a table after an LSN (reference:
+            # the decoder stream a subscriber reads)
+            table = str(args[0])
+            from_lsn = int(args[1]) if len(args) > 1 else 0
+            rows = [(e["lsn"], e["op"], e.get("count"),
+                     json.dumps(e.get("rows")) if e.get("rows") else None)
+                    for e in self.cdc.events(table, from_lsn)]
+            return Result(columns=["lsn", "op", "count", "rows"], rows=rows)
         if name == "citus_views":
             return Result(columns=["view_name", "definition"],
                           rows=sorted(self.catalog.views.items()))

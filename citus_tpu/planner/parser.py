@@ -517,6 +517,7 @@ class Parser:
         "citus_stat_activity", "citus_locks", "citus_lock_waits",
         "citus_shards", "citus_tables", "recover_prepared_transactions",
         "nextval", "currval", "setval", "citus_views", "citus_sequences",
+        "citus_cdc_events",
         "citus_get_node_clock", "citus_get_transaction_clock",
         "citus_create_restore_point", "citus_list_restore_points",
         "alter_distributed_table", "citus_check_cluster_node_health",

@@ -175,3 +175,25 @@ def test_copy_to_honors_null_option(tmp_path):
     cl.execute(f"COPY t2 FROM '{out}' WITH (null 'NULLVAL')")
     rows = dict(cl.execute("SELECT k, s FROM t2").rows)
     assert rows[1] == "" and rows[2] is None
+
+
+def test_cdc_captures_dml(tmp_path):
+    """CDC covers UPDATE/DELETE/MERGE/TRUNCATE (statement-level with
+    counts) and exposes the subscriber read API."""
+    from citus_tpu.config import Settings
+    cl = ct.Cluster(str(tmp_path / "cdcdml"),
+                    settings=Settings(enable_change_data_capture=True))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    cl.execute("UPDATE t SET v = v + 1 WHERE k < 3")
+    cl.execute("DELETE FROM t WHERE k = 1")
+    cl.execute("TRUNCATE t")
+    evs = [tuple(r[:3]) for r in cl.execute("SELECT citus_cdc_events('t')").rows]
+    assert [e[1] for e in evs] == ["insert", "update", "delete", "truncate"]
+    assert evs[1][2] == 2 and evs[2][2] == 1
+    lsns = [e[0] for e in evs]
+    assert lsns == sorted(lsns)  # HLC-ordered
+    later = cl.execute(f"SELECT citus_cdc_events('t', {lsns[1]})").rows
+    assert [r[1] for r in later] == ["delete", "truncate"]
+    cl.close()
