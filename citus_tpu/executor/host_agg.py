@@ -99,8 +99,16 @@ class HostGroupAccumulator:
             if op.kind == "collect":
                 v, ok = arg_np[op.arg_index]
                 lists = [[] for _ in range(L)]
-                for r in np.nonzero(ok)[0]:  # scan order preserved
-                    lists[inverse[r]].append(v[r].item())
+                if op.extra_args:
+                    extras = [arg_np[ei] for ei in op.extra_args]
+                    for r in np.nonzero(ok)[0]:  # scan order preserved
+                        item = (v[r].item(),) + tuple(
+                            ev[r].item() if em[r] else None
+                            for ev, em in extras)
+                        lists[inverse[r]].append(item)
+                else:
+                    for r in np.nonzero(ok)[0]:
+                        lists[inverse[r]].append(v[r].item())
                 local.append(lists)
                 continue
             if op.kind == "count":

@@ -128,6 +128,34 @@ def _finalize_bool(ex, partials, cat):
 # ------------------------------------------------- collect-based family
 
 
+def _bind_sort_keys(binder, e):
+    """ORDER BY inside an aggregate call -> (sortable BExprs, asc flags).
+    Text sort keys become lexicographic-rank lookups so plain numeric
+    ordering of collected tuples matches string ordering."""
+    from citus_tpu.planner.bound import BDictLookup
+    exprs, ascs = [], []
+    for oe, asc in getattr(e, "agg_order", ()):
+        b = binder.bind_scalar(oe)
+        if b.type.is_text:
+            resolved = binder._text_words(b)
+            if resolved is None:
+                raise UnsupportedFeatureError(
+                    "aggregate ORDER BY over computed text is not supported")
+            base, _t, _c, eff_words = resolved
+            order = sorted(range(len(eff_words)), key=eff_words.__getitem__)
+            rank = [0] * len(eff_words)
+            for pos, i in enumerate(order):
+                rank[i] = pos
+            b = BDictLookup(base, tuple(rank), T.INT64_T)
+        elif not (b.type.is_numeric or b.type.kind in (T.DATE, T.TIMESTAMP,
+                                                       T.BOOL)):
+            raise UnsupportedFeatureError(
+                f"cannot ORDER BY {b.type} inside an aggregate")
+        exprs.append(b)
+        ascs.append(bool(asc))
+    return tuple(exprs), tuple(ascs)
+
+
 def _bind_string_agg(binder, e):
     from citus_tpu.planner import ast_nodes as A
     from citus_tpu.planner.bind import AggSpec
@@ -151,24 +179,48 @@ def _bind_string_agg(binder, e):
                 break
     if src is None:
         raise UnsupportedFeatureError("string_agg() over computed text")
-    return AggSpec("string_agg", arg, T.TEXT_T, param=(d.value, src))
+    sort_exprs, ascs = _bind_sort_keys(binder, e)
+    return AggSpec("string_agg", arg, T.TEXT_T,
+                   param=(d.value, src, sort_exprs, ascs))
 
 
 def _lower_collect(spec, arg_slot, partial_slot):
     from citus_tpu.planner.physical import AggExtract
     ai = arg_slot(spec.arg)
-    s = partial_slot("collect", ai, "object")
+    sort_exprs = spec.param[2] if isinstance(spec.param, tuple) \
+        and len(spec.param) >= 4 else ()
+    extra = tuple(arg_slot(e) for e in sort_exprs)
+    s = partial_slot("collect", ai, "object", extra)
     return AggExtract(spec.kind, [s], spec.out_type, param=spec.param)
 
 
+def _sorted_items(vals, ascs):
+    """Collected (value, key...) tuples -> values in ORDER BY order
+    (PG null placement: last for ASC, first for DESC)."""
+    if not vals or not isinstance(vals[0], tuple):
+        return list(vals)
+
+    def sort_key(item):
+        parts = []
+        for k, asc in zip(item[1:], ascs):
+            null = k is None
+            v = 0 if null else (k if asc else -k)
+            parts.append((null if asc else not null, v))
+        return tuple(parts)
+    return [it[0] for it in sorted(vals, key=sort_key)]
+
+
 def _finalize_string_agg(ex, partials, cat):
-    delim, src = ex.param
+    delim, src = ex.param[0], ex.param[1]
+    ascs = ex.param[3] if len(ex.param) >= 4 else ()
     lists = np.asarray(partials[ex.slots[0]], object)
     out = np.empty(lists.shape[0], object)
     valid = np.zeros(lists.shape[0], bool)
     for i, vals in enumerate(lists):
         if vals:
-            words = cat.decode_strings(src[0], src[1], [int(v) for v in vals])
+            ordered = _sorted_items(vals, ascs)
+            words = cat.decode_strings(src[0], src[1],
+                                       [int(v) for v in ordered])
             out[i] = delim.join(w for w in words if w is not None)
             valid[i] = True
     return out, valid
@@ -183,21 +235,25 @@ def _bind_array_agg(binder, e):
     src = None
     if arg.type.is_text and isinstance(arg, BColumn):
         src = binder.text_source(arg)
-    return AggSpec("array_agg", arg, arg.type, param=("array", src))
+    sort_exprs, ascs = _bind_sort_keys(binder, e)
+    return AggSpec("array_agg", arg, arg.type,
+                   param=("array", src, sort_exprs, ascs))
 
 
 def _finalize_array_agg(ex, partials, cat):
-    _tag, src = ex.param
+    src = ex.param[1]
+    ascs = ex.param[3] if len(ex.param) >= 4 else ()
     lists = np.asarray(partials[ex.slots[0]], object)
     out = np.empty(lists.shape[0], object)
     valid = np.zeros(lists.shape[0], bool)
     for i, vals in enumerate(lists):
         if vals:
+            ordered = _sorted_items(vals, ascs)
             if src is not None:
                 out[i] = cat.decode_strings(src[0], src[1],
-                                            [int(v) for v in vals])
+                                            [int(v) for v in ordered])
             else:
-                out[i] = [ex.out_type.from_physical(v) for v in vals]
+                out[i] = [ex.out_type.from_physical(v) for v in ordered]
             valid[i] = True
     return out, valid
 

@@ -67,6 +67,8 @@ class FuncCall(Expr):
     name: str
     args: tuple[Expr, ...] = ()
     distinct: bool = False
+    # ordered-set / ordered aggregate: string_agg(x, ',' ORDER BY y DESC)
+    agg_order: tuple = ()  # tuple[(Expr, asc: bool)]
 
     def __str__(self):
         inner = ", ".join(str(a) for a in self.args)

@@ -72,6 +72,13 @@ class BoundSelect:
         for e in [self.filter, *self.group_keys, *(a.arg for a in self.aggs if a.arg is not None)]:
             if e is not None:
                 cols.update(referenced_columns(e))
+        for a in self.aggs:
+            # ordered aggregates carry sort-key expressions in param
+            if isinstance(a.param, tuple) and len(a.param) >= 4 \
+                    and isinstance(a.param[2], tuple):
+                for e in a.param[2]:
+                    if isinstance(e, BExpr):
+                        cols.update(referenced_columns(e))
         if not self.has_aggs:
             for e in self.final_exprs:
                 cols.update(referenced_columns(e))

@@ -932,8 +932,22 @@ class Parser:
                         args.append(self.parse_expr())
                         if not self.accept_op(","):
                             break
+                agg_order = []
+                if self.accept_kw("order"):
+                    # ordered aggregate: fn(args ORDER BY expr [DESC], ...)
+                    self.expect_kw("by")
+                    while True:
+                        oe = self.parse_expr()
+                        asc = True
+                        if self.accept_kw("asc"):
+                            pass
+                        elif self.accept_kw("desc"):
+                            asc = False
+                        agg_order.append((oe, asc))
+                        if not self.accept_op(","):
+                            break
                 self.expect_op(")")
-                fc = A.FuncCall(t.value, tuple(args), distinct)
+                fc = A.FuncCall(t.value, tuple(args), distinct, tuple(agg_order))
                 if self.at_kw("within"):
                     # ordered-set aggregate: percentile_cont(f) WITHIN
                     # GROUP (ORDER BY x) desugars to fn(f, x)

@@ -43,9 +43,12 @@ from citus_tpu.storage.reader import Interval
 @dataclass(frozen=True)
 class PartialOp:
     """One combinable per-shard accumulator."""
-    kind: str        # sum | count | min | max
+    kind: str        # sum | count | min | max | distinct | collect
     arg_index: int   # index into PhysicalPlan.agg_args; -1 = count rows
     dtype: str       # numpy dtype name of the accumulator
+    # collect only: additional agg_arg indexes gathered alongside the
+    # value (ordered aggregates collect (value, sortkey...) tuples)
+    extra_args: tuple = ()
 
 
 @dataclass
@@ -281,8 +284,9 @@ def lower_aggregates(aggs: list[AggSpec]) -> tuple[list[BExpr], list[PartialOp],
         agg_args.append(e)
         return len(agg_args) - 1
 
-    def partial_slot(kind: str, arg_index: int, dtype: str) -> int:
-        op = PartialOp(kind, arg_index, dtype)
+    def partial_slot(kind: str, arg_index: int, dtype: str,
+                     extra_args: tuple = ()) -> int:
+        op = PartialOp(kind, arg_index, dtype, tuple(extra_args))
         for i, p in enumerate(partials):
             if p == op:
                 return i

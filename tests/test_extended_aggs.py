@@ -159,3 +159,25 @@ def test_registry_rejects_bad_usage(db):
         cl.execute("SELECT bool_and(v) FROM t")
     with pytest.raises(AnalysisError):
         cl.execute("SELECT string_agg(v, ',') FROM t")
+
+
+def test_ordered_string_and_array_agg(tmp_path):
+    """string_agg/array_agg(... ORDER BY ...) collect (value, sortkey)
+    tuples; text sort keys order by lexicographic rank."""
+    cl = ct.Cluster(str(tmp_path / "ordagg"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, g bigint, v bigint, s text)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", rows=[(1, 0, 30, "c"), (2, 0, 10, "a"), (3, 0, 20, "b"),
+                            (4, 1, 5, "z"), (5, 1, 9, "y"), (6, 1, 7, None)])
+    assert cl.execute("SELECT g, string_agg(s, ',' ORDER BY v) FROM t "
+                      "GROUP BY g ORDER BY g").rows == \
+        [(0, "a,b,c"), (1, "z,y")]
+    assert cl.execute("SELECT g, string_agg(s, '-' ORDER BY v DESC) FROM t "
+                      "GROUP BY g ORDER BY g").rows == \
+        [(0, "c-b-a"), (1, "y-z")]
+    assert cl.execute("SELECT string_agg(s, ',' ORDER BY s) FROM t").rows == \
+        [("a,b,c,y,z",)]
+    assert cl.execute("SELECT g, array_agg(v ORDER BY v DESC) FROM t "
+                      "GROUP BY g ORDER BY g").rows == \
+        [(0, [30, 20, 10]), (1, [9, 7, 5])]
+    cl.close()
