@@ -171,6 +171,10 @@ class Catalog:
         self.schemas: dict[str, dict] = {}
         # views: name -> SELECT sql text (reparsed at each use)
         self.views: dict[str, str] = {}
+        # roles + per-table grants: table -> {role: [privileges]}
+        # (reference: commands/role.c, commands/grant.c propagation)
+        self.roles: dict[str, dict] = {}
+        self.grants: dict[str, dict] = {}
         # sequences: name -> {"value": next unreserved, "increment": n,
         # "start": n}; nextval hands out values from an in-memory block
         # reserved by bumping the persisted high-water mark (gaps on
@@ -197,6 +201,8 @@ class Catalog:
         self.schemas = d.get("schemas", {})
         self.views = d.get("views", {})
         self.sequences = d.get("sequences", {})
+        self.roles = d.get("roles", {})
+        self.grants = d.get("grants", {})
 
     def commit(self) -> None:
         """Atomically persist catalog state (round-1 metadata transaction)."""
@@ -211,6 +217,8 @@ class Catalog:
                 "schemas": self.schemas,
                 "views": self.views,
                 "sequences": self.sequences,
+                "roles": self.roles,
+                "grants": self.grants,
             }
             tmp = self._path() + ".tmp"
             with open(tmp, "w") as fh:
@@ -438,6 +446,48 @@ class Catalog:
                 raise CatalogError(f'view "{name}" does not exist')
             del self.views[name]
             self.ddl_epoch += 1
+
+    # ---- roles / grants ----------------------------------------------
+    PRIVILEGES = ("select", "insert", "update", "delete", "truncate")
+
+    def create_role(self, name: str) -> None:
+        with self._lock:
+            if name in self.roles:
+                raise CatalogError(f'role "{name}" already exists')
+            self.roles[name] = {}
+
+    def drop_role(self, name: str) -> None:
+        with self._lock:
+            if name not in self.roles:
+                raise CatalogError(f'role "{name}" does not exist')
+            del self.roles[name]
+            for tbl in self.grants.values():
+                tbl.pop(name, None)
+
+    def grant(self, table: str, role: str, privileges: list[str]) -> None:
+        with self._lock:
+            if role not in self.roles:
+                raise CatalogError(f'role "{role}" does not exist')
+            if table not in self.tables and table not in self.views:
+                raise CatalogError(f'relation "{table}" does not exist')
+            privs = list(self.PRIVILEGES) if "all" in privileges else privileges
+            cur = set(self.grants.setdefault(table, {}).get(role, []))
+            cur.update(privs)
+            self.grants[table][role] = sorted(cur)
+
+    def revoke(self, table: str, role: str, privileges: list[str]) -> None:
+        with self._lock:
+            privs = list(self.PRIVILEGES) if "all" in privileges else privileges
+            cur = set(self.grants.get(table, {}).get(role, []))
+            cur -= set(privs)
+            if table in self.grants:
+                if cur:
+                    self.grants[table][role] = sorted(cur)
+                else:
+                    self.grants[table].pop(role, None)
+
+    def has_privilege(self, role: str, table: str, privilege: str) -> bool:
+        return privilege in self.grants.get(table, {}).get(role, ())
 
     # ---- sequences ----------------------------------------------------
     SEQ_CACHE_BLOCK = 32

@@ -484,10 +484,14 @@ class Cluster:
         return total
 
     # -------------------------------------------------------------- SQL
-    def execute(self, sql: str, params: Optional[Sequence[Any]] = None) -> Result:
+    def execute(self, sql: str, params: Optional[Sequence[Any]] = None,
+                role: Optional[str] = None) -> Result:
         import time as _time
         self._maybe_reload_catalog()
         stmts = parse_sql(sql)
+        if role is not None:
+            for stmt in stmts:
+                self._check_privileges(role, stmt)
         result = Result(columns=[], rows=[])
         gpid = self.activity.enter(sql)
         t0 = _time.perf_counter()
@@ -641,6 +645,25 @@ class Cluster:
                 self.catalog.drop_table(m)
             self.catalog.commit()
             self._plan_cache.clear()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateRole):
+            if stmt.if_not_exists and stmt.name in self.catalog.roles:
+                return Result(columns=[], rows=[])
+            self.catalog.create_role(stmt.name)
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropRole):
+            if stmt.if_exists and stmt.name not in self.catalog.roles:
+                return Result(columns=[], rows=[])
+            self.catalog.drop_role(stmt.name)
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.Grant):
+            if stmt.revoke:
+                self.catalog.revoke(stmt.table, stmt.role, stmt.privileges)
+            else:
+                self.catalog.grant(stmt.table, stmt.role, stmt.privileges)
+            self.catalog.commit()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.CreateView):
             # validate the body against current metadata (LIMIT 0 run)
@@ -1275,6 +1298,72 @@ class Cluster:
                 except Exception:
                     pass
 
+    def _check_privileges(self, role: str, stmt: A.Statement) -> None:
+        """Table-level privilege enforcement for a non-superuser role
+        (reference: standard ACLs propagated by commands/grant.c; a
+        missing grant denies).  DDL and utility statements require
+        superuser (role=None)."""
+        from citus_tpu.errors import CatalogError
+        if role not in self.catalog.roles:
+            raise CatalogError(f'role "{role}" does not exist')
+
+        def deny(priv, table):
+            raise CatalogError(
+                f'permission denied for {table}: role "{role}" lacks {priv}')
+
+        def tables_of(item):
+            if isinstance(item, A.TableRef):
+                return [item.name]
+            if isinstance(item, A.SubqueryRef):
+                return stmt_tables(item.select)
+            if isinstance(item, A.Join):
+                return tables_of(item.left) + tables_of(item.right)
+            return []
+
+        def stmt_tables(s):
+            if isinstance(s, A.SetOp):
+                return stmt_tables(s.left) + stmt_tables(s.right)
+            if isinstance(s, A.Select) and s.from_ is not None:
+                return tables_of(s.from_)
+            return []
+
+        def check_read(s):
+            for t in stmt_tables(s):
+                if t in self.catalog.views:
+                    continue  # view body checked when expanded? views grant via view name
+                if not self.catalog.has_privilege(role, t, "select"):
+                    deny("SELECT", t)
+            # views referenced directly need their own SELECT grant
+            for t in stmt_tables(s):
+                if t in self.catalog.views and \
+                        not self.catalog.has_privilege(role, t, "select"):
+                    deny("SELECT", t)
+
+        if isinstance(stmt, (A.Select, A.SetOp)):
+            check_read(stmt)
+        elif isinstance(stmt, A.WithSelect):
+            for _n, sel in stmt.ctes:
+                check_read(sel)
+            check_read(stmt.body)
+        elif isinstance(stmt, A.Insert):
+            if not self.catalog.has_privilege(role, stmt.table, "insert"):
+                deny("INSERT", stmt.table)
+            if stmt.select is not None:
+                check_read(stmt.select)
+        elif isinstance(stmt, A.Update):
+            if not self.catalog.has_privilege(role, stmt.table, "update"):
+                deny("UPDATE", stmt.table)
+        elif isinstance(stmt, A.Delete):
+            if not self.catalog.has_privilege(role, stmt.table, "delete"):
+                deny("DELETE", stmt.table)
+        elif isinstance(stmt, A.Truncate):
+            if not self.catalog.has_privilege(role, stmt.table, "truncate"):
+                deny("TRUNCATE", stmt.table)
+        else:
+            from citus_tpu.errors import CatalogError as _CE
+            raise _CE(f'permission denied: role "{role}" cannot run '
+                      f'{type(stmt).__name__} statements')
+
     def _execute_utility(self, stmt: A.UtilityCall) -> Result:
         name, args = stmt.name, stmt.args
         if name == "create_distributed_table":
@@ -1516,6 +1605,16 @@ class Cluster:
                      json.dumps(e.get("rows")) if e.get("rows") else None)
                     for e in self.cdc.events(table, from_lsn)]
             return Result(columns=["lsn", "op", "count", "rows"], rows=rows)
+        if name == "citus_roles":
+            return Result(columns=["role_name"],
+                          rows=[(r,) for r in sorted(self.catalog.roles)])
+        if name == "citus_grants":
+            rows = []
+            for tbl, by_role in sorted(self.catalog.grants.items()):
+                for r, privs in sorted(by_role.items()):
+                    rows.append((tbl, r, ",".join(privs)))
+            return Result(columns=["table_name", "role_name", "privileges"],
+                          rows=rows)
         if name == "citus_views":
             return Result(columns=["view_name", "definition"],
                           rows=sorted(self.catalog.views.items()))

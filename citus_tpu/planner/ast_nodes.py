@@ -307,6 +307,29 @@ class DropSequence(Statement):
 
 
 @dataclass
+class CreateRole(Statement):
+    """Reference: roles propagate as distributed objects
+    (commands/role.c); here a catalog-registered principal."""
+    name: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropRole(Statement):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class Grant(Statement):
+    """GRANT/REVOKE privileges ON table TO/FROM role (commands/grant.c)."""
+    privileges: list = field(default_factory=list)  # select/insert/update/delete or ["all"]
+    table: str = ""
+    role: str = ""
+    revoke: bool = False
+
+
+@dataclass
 class SetOp(Statement):
     """UNION / INTERSECT / EXCEPT [ALL] over two selects (or nested set
     operations).  Trailing ORDER BY / LIMIT / OFFSET bind to the whole

@@ -42,6 +42,7 @@ KEYWORDS = {
     "schema", "cascade", "merge", "matched", "nothing", "do", "over",
     "partition", "union", "intersect", "except", "all", "within",
     "rows", "range", "unbounded", "preceding", "following", "current", "row",
+    "grant", "revoke",
 }
 
 
@@ -209,6 +210,33 @@ class Parser:
             self.next()
             full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())
             return A.Vacuum(self.parse_table_name(), full)
+        if self.at_kw("grant", "revoke"):
+            revoke = self.next().value == "revoke"
+            privs = []
+            if self.at_kw("all"):
+                self.next()
+                if self.peek().kind == "ident" and self.peek().value == "privileges":
+                    self.next()
+                privs = ["all"]
+            else:
+                while True:
+                    t = self.next()
+                    name = t.value
+                    if name not in ("select", "insert", "update", "delete",
+                                    "truncate"):
+                        self.error("expected a privilege name")
+                    privs.append(name)
+                    if not self.accept_op(","):
+                        break
+            self.expect_kw("on")
+            self.accept_kw("table")
+            table = self.parse_table_name()
+            if revoke:
+                self.expect_kw("from")
+            else:
+                self.expect_kw("to")
+            role = self.expect_ident()
+            return A.Grant(privs, table, role, revoke)
         self.error("expected a statement")
 
     def parse_with_select(self) -> A.WithSelect:
@@ -360,6 +388,14 @@ class Parser:
                 self.expect_kw("exists")
                 if_not_exists = True
             return A.CreateSchema(self.expect_ident(), if_not_exists)
+        if self.peek().kind == "ident" and self.peek().value in ("role", "user"):
+            self.next()
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            return A.CreateRole(self.expect_ident(), if_not_exists)
         if self.peek().kind == "ident" and self.peek().value == "view":
             self.next()
             name = self.parse_table_name()
@@ -457,6 +493,13 @@ class Parser:
             name = self.expect_ident()
             cascade = bool(self.accept_kw("cascade"))
             return A.DropSchema(name, cascade)
+        if self.peek().kind == "ident" and self.peek().value in ("role", "user"):
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropRole(self.expect_ident(), if_exists)
         if self.peek().kind == "ident" and self.peek().value in ("view", "sequence"):
             kind = self.next().value
             if_exists = False
@@ -517,7 +560,7 @@ class Parser:
         "citus_stat_activity", "citus_locks", "citus_lock_waits",
         "citus_shards", "citus_tables", "recover_prepared_transactions",
         "nextval", "currval", "setval", "citus_views", "citus_sequences",
-        "citus_cdc_events",
+        "citus_cdc_events", "citus_roles", "citus_grants",
         "citus_get_node_clock", "citus_get_transaction_clock",
         "citus_create_restore_point", "citus_list_restore_points",
         "alter_distributed_table", "citus_check_cluster_node_health",
