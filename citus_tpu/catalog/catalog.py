@@ -175,6 +175,9 @@ class Catalog:
         # (reference: commands/role.c, commands/grant.c propagation)
         self.roles: dict[str, dict] = {}
         self.grants: dict[str, dict] = {}
+        # SQL expression functions (inlined at planning time;
+        # reference: commands/function.c distributed functions)
+        self.functions: dict[str, dict] = {}
         # sequences: name -> {"value": next unreserved, "increment": n,
         # "start": n}; nextval hands out values from an in-memory block
         # reserved by bumping the persisted high-water mark (gaps on
@@ -203,6 +206,7 @@ class Catalog:
         self.sequences = d.get("sequences", {})
         self.roles = d.get("roles", {})
         self.grants = d.get("grants", {})
+        self.functions = d.get("functions", {})
 
     def commit(self) -> None:
         """Atomically persist catalog state (round-1 metadata transaction)."""
@@ -219,6 +223,7 @@ class Catalog:
                 "sequences": self.sequences,
                 "roles": self.roles,
                 "grants": self.grants,
+                "functions": self.functions,
             }
             tmp = self._path() + ".tmp"
             with open(tmp, "w") as fh:

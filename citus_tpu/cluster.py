@@ -83,6 +83,35 @@ def _max_param_index(stmt) -> int:
     return mx
 
 
+def _subst_args(e, sub: dict):
+    """Replace bare ColumnRefs naming function parameters with the call
+    arguments (used by SQL function inlining)."""
+    if isinstance(e, A.ColumnRef) and e.table is None and e.name in sub:
+        return sub[e.name]
+    if isinstance(e, A.BinOp):
+        return A.BinOp(e.op, _subst_args(e.left, sub), _subst_args(e.right, sub))
+    if isinstance(e, A.UnOp):
+        return A.UnOp(e.op, _subst_args(e.operand, sub))
+    if isinstance(e, A.Between):
+        return A.Between(_subst_args(e.expr, sub), _subst_args(e.lo, sub),
+                         _subst_args(e.hi, sub), e.negated)
+    if isinstance(e, A.InList):
+        return A.InList(_subst_args(e.expr, sub),
+                        tuple(_subst_args(i, sub) for i in e.items), e.negated)
+    if isinstance(e, A.IsNull):
+        return A.IsNull(_subst_args(e.expr, sub), e.negated)
+    if isinstance(e, A.Cast):
+        return A.Cast(_subst_args(e.expr, sub), e.type_name, e.type_args)
+    if isinstance(e, A.CaseExpr):
+        return A.CaseExpr(tuple((_subst_args(c, sub), _subst_args(v, sub))
+                                for c, v in e.whens),
+                          _subst_args(e.else_, sub) if e.else_ is not None else None)
+    if isinstance(e, A.FuncCall):
+        return A.FuncCall(e.name, tuple(_subst_args(a, sub) for a in e.args),
+                          e.distinct, e.agg_order)
+    return e
+
+
 def _sort_rows(rows, names, order_by):
     """ORDER BY over materialized rows: items resolve by output position
     or output column name (PostgreSQL's rule for set operations)."""
@@ -570,6 +599,8 @@ class Cluster:
             return self._execute_with(stmt)
         if isinstance(stmt, A.SetOp):
             return self._execute_setop(stmt)
+        if isinstance(stmt, (A.Select, A.SetOp)) and self.catalog.functions:
+            stmt = self._expand_functions_stmt(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is not None:
             from citus_tpu.planner.recursive import decorrelate_scalars
             stmt = decorrelate_scalars(stmt)
@@ -643,6 +674,35 @@ class Cluster:
             members = self.catalog.drop_schema(stmt.name, cascade=stmt.cascade)
             for m in members:
                 self.catalog.drop_table(m)
+            self.catalog.commit()
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateFunction):
+            from citus_tpu.planner.aggregates import AGG_REGISTRY
+            from citus_tpu.planner.bind import AGG_FUNCS
+            if stmt.name in AGG_FUNCS or stmt.name in AGG_REGISTRY:
+                raise CatalogError(
+                    f'cannot replace built-in function "{stmt.name}"')
+            if stmt.name in self.catalog.functions and not stmt.or_replace:
+                raise CatalogError(f'function "{stmt.name}" already exists')
+            # validate the body parses as an expression
+            from citus_tpu.planner.parser import Parser as _P
+            _P(stmt.body).parse_expr()
+            self.catalog.functions[stmt.name] = {
+                "args": list(stmt.arg_names),
+                "arg_types": list(stmt.arg_types),
+                "returns": stmt.returns, "body": stmt.body}
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            self._plan_cache.clear()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropFunction):
+            if stmt.if_exists and stmt.name not in self.catalog.functions:
+                return Result(columns=[], rows=[])
+            if stmt.name not in self.catalog.functions:
+                raise CatalogError(f'function "{stmt.name}" does not exist')
+            del self.catalog.functions[stmt.name]
+            self.catalog.ddl_epoch += 1
             self.catalog.commit()
             self._plan_cache.clear()
             return Result(columns=[], rows=[])
@@ -1191,6 +1251,68 @@ class Cluster:
                     self.drop_table(tmp)
                 except Exception:
                     pass
+
+    def _expand_functions_stmt(self, stmt, depth: int = 0):
+        """Inline user SQL functions (expression macros) everywhere in a
+        SELECT/set operation — the planning-time analog of delegating a
+        distributed function call next to the data
+        (function_call_delegation.c)."""
+        if depth > 8:
+            raise AnalysisError("SQL function expansion too deep (recursive?)")
+        fns = self.catalog.functions
+
+        def rw(e, d):
+            if e is None or not isinstance(e, A.Expr):
+                return e
+            if isinstance(e, A.FuncCall) and e.name in fns:
+                spec = fns[e.name]
+                if len(e.args) != len(spec["args"]):
+                    raise AnalysisError(
+                        f'{e.name}() expects {len(spec["args"])} arguments')
+                if d > 8:
+                    raise AnalysisError(
+                        "SQL function expansion too deep (recursive?)")
+                from citus_tpu.planner.parser import Parser as _P
+                body = _P(spec["body"]).parse_expr()
+                sub = {n: rw(a, d) for n, a in zip(spec["args"], e.args)}
+                return rw(_subst_args(body, sub), d + 1)
+            if isinstance(e, A.BinOp):
+                return A.BinOp(e.op, rw(e.left, d), rw(e.right, d))
+            if isinstance(e, A.UnOp):
+                return A.UnOp(e.op, rw(e.operand, d))
+            if isinstance(e, A.Between):
+                return A.Between(rw(e.expr, d), rw(e.lo, d), rw(e.hi, d), e.negated)
+            if isinstance(e, A.InList):
+                return A.InList(rw(e.expr, d), tuple(rw(i, d) for i in e.items),
+                                e.negated)
+            if isinstance(e, A.IsNull):
+                return A.IsNull(rw(e.expr, d), e.negated)
+            if isinstance(e, A.Cast):
+                return A.Cast(rw(e.expr, d), e.type_name, e.type_args)
+            if isinstance(e, A.CaseExpr):
+                return A.CaseExpr(tuple((rw(c, d), rw(v, d)) for c, v in e.whens),
+                                  rw(e.else_, d) if e.else_ is not None else None)
+            if isinstance(e, A.FuncCall):
+                return A.FuncCall(e.name, tuple(rw(a, d) for a in e.args),
+                                  e.distinct, e.agg_order)
+            if isinstance(e, A.WindowCall):
+                return A.WindowCall(rw(e.func, d), tuple(rw(p, d) for p in e.partition_by),
+                                    tuple((rw(oe, d), asc) for oe, asc in e.order_by),
+                                    e.frame)
+            return e
+
+        if isinstance(stmt, A.SetOp):
+            return A.SetOp(stmt.op, stmt.all,
+                           self._expand_functions_stmt(stmt.left, depth + 1),
+                           self._expand_functions_stmt(stmt.right, depth + 1),
+                           stmt.order_by, stmt.limit, stmt.offset)
+        return A.Select(
+            [A.SelectItem(rw(i.expr, 0), i.alias) for i in stmt.items],
+            stmt.from_, rw(stmt.where, 0),
+            [rw(g, 0) for g in stmt.group_by], rw(stmt.having, 0),
+            [A.OrderItem(rw(o.expr, 0), o.ascending, o.nulls_first)
+             for o in stmt.order_by],
+            stmt.limit, stmt.offset, stmt.distinct)
 
     def _expand_views(self, item):
         """FROM references to views become derived tables over the view's

@@ -307,6 +307,26 @@ class DropSequence(Statement):
 
 
 @dataclass
+class CreateFunction(Statement):
+    """CREATE FUNCTION name(a type, ...) RETURNS type AS 'expr'
+    LANGUAGE SQL — an expression macro inlined at planning time, the
+    analog of distributed functions executing next to the data
+    (commands/function.c + function_call_delegation.c)."""
+    name: str = ""
+    arg_names: list = field(default_factory=list)
+    arg_types: list = field(default_factory=list)   # sql type names
+    returns: str = ""
+    body: str = ""                                  # expression SQL text
+    or_replace: bool = False
+
+
+@dataclass
+class DropFunction(Statement):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
 class CreateRole(Statement):
     """Reference: roles propagate as distributed objects
     (commands/role.c); here a catalog-registered principal."""

@@ -396,6 +396,43 @@ class Parser:
                 self.expect_kw("exists")
                 if_not_exists = True
             return A.CreateRole(self.expect_ident(), if_not_exists)
+        or_replace = False
+        if self.peek().kind == "kw" and self.peek().value == "or":
+            # CREATE OR REPLACE FUNCTION
+            self.next()
+            if not (self.peek().kind == "ident" and self.peek().value == "replace"):
+                self.error("expected REPLACE")
+            self.next()
+            or_replace = True
+        if self.peek().kind == "ident" and self.peek().value == "function":
+            self.next()
+            name = self.expect_ident()
+            self.expect_op("(")
+            arg_names, arg_types = [], []
+            if not self.at_op(")"):
+                while True:
+                    arg_names.append(self.expect_ident())
+                    tn, targs = self.parse_type_name()
+                    arg_types.append(tn)
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            if not (self.peek().kind == "ident" and self.peek().value == "returns"):
+                self.error("expected RETURNS")
+            self.next()
+            ret, _ = self.parse_type_name()
+            self.expect_kw("as")
+            bt = self.next()
+            if bt.kind != "str":
+                self.error("expected a quoted function body")
+            body = bt.value[1:-1].replace("''", "'")
+            if self.peek().kind == "ident" and self.peek().value == "language":
+                self.next()
+                self.next()  # sql
+            return A.CreateFunction(name, arg_names, arg_types, ret, body,
+                                    or_replace)
+        if or_replace:
+            self.error("expected FUNCTION after OR REPLACE")
         if self.peek().kind == "ident" and self.peek().value == "view":
             self.next()
             name = self.parse_table_name()
@@ -500,6 +537,13 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             return A.DropRole(self.expect_ident(), if_exists)
+        if self.peek().kind == "ident" and self.peek().value == "function":
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropFunction(self.expect_ident(), if_exists)
         if self.peek().kind == "ident" and self.peek().value in ("view", "sequence"):
             kind = self.next().value
             if_exists = False

@@ -172,3 +172,33 @@ def test_roles_and_grants(tmp_path):
     with pytest.raises(CatalogError):
         cl2.execute("SELECT 1 FROM t", role="analyst")
     cl2.close()
+
+
+def test_sql_functions(tmp_path):
+    """CREATE FUNCTION expression macros inline at planning time
+    (reference: distributed functions + call delegation)."""
+    cl = ct.Cluster(str(tmp_path / "fns"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint, p decimal(8,2))")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.execute("INSERT INTO t VALUES (1, 10, 5.00), (2, 20, 7.50), (3, 30, 10.00)")
+    cl.execute("CREATE FUNCTION double_it(x bigint) RETURNS bigint AS 'x * 2'")
+    assert cl.execute("SELECT k, double_it(v) FROM t ORDER BY k").rows == \
+        [(1, 20), (2, 40), (3, 60)]
+    cl.execute("CREATE FUNCTION with_tax(amount decimal, rate decimal) "
+               "RETURNS decimal AS 'amount * (1 + rate)'")
+    assert float(cl.execute("SELECT sum(with_tax(p, 0.1)) FROM t").rows[0][0]) \
+        == 24.75
+    assert cl.execute("SELECT count(*) FROM t WHERE double_it(v) > 25").rows \
+        == [(2,)]
+    cl.execute("CREATE FUNCTION quad(x bigint) RETURNS bigint AS "
+               "'double_it(double_it(x))'")
+    assert cl.execute("SELECT quad(v) FROM t WHERE k = 1").rows == [(40,)]
+    cl.execute("CREATE OR REPLACE FUNCTION double_it(x bigint) RETURNS bigint "
+               "AS 'x * 3'")
+    assert cl.execute("SELECT double_it(v) FROM t WHERE k = 1").rows == [(30,)]
+    cl.execute("DROP FUNCTION quad")
+    # survives reopen
+    cl.close()
+    cl2 = ct.Cluster(str(tmp_path / "fns"))
+    assert cl2.execute("SELECT double_it(v) FROM t WHERE k = 1").rows == [(30,)]
+    cl2.close()
