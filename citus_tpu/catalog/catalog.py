@@ -178,6 +178,10 @@ class Catalog:
         # SQL expression functions (inlined at planning time;
         # reference: commands/function.c distributed functions)
         self.functions: dict[str, dict] = {}
+        # enum types + per-column bindings ("table.column" -> type name);
+        # enum columns are dictionary-encoded text with ingest validation
+        self.types: dict[str, list] = {}
+        self.enum_columns: dict[str, str] = {}
         # sequences: name -> {"value": next unreserved, "increment": n,
         # "start": n}; nextval hands out values from an in-memory block
         # reserved by bumping the persisted high-water mark (gaps on
@@ -207,6 +211,8 @@ class Catalog:
         self.roles = d.get("roles", {})
         self.grants = d.get("grants", {})
         self.functions = d.get("functions", {})
+        self.types = d.get("types", {})
+        self.enum_columns = d.get("enum_columns", {})
 
     def commit(self) -> None:
         """Atomically persist catalog state (round-1 metadata transaction)."""
@@ -224,6 +230,8 @@ class Catalog:
                 "roles": self.roles,
                 "grants": self.grants,
                 "functions": self.functions,
+                "types": self.types,
+                "enum_columns": self.enum_columns,
             }
             tmp = self._path() + ".tmp"
             with open(tmp, "w") as fh:

@@ -362,6 +362,9 @@ class Cluster:
         if if_exists and not self.catalog.has_table(name):
             return
         self.catalog.drop_table(name)
+        for key in [k for k in self.catalog.enum_columns
+                    if k.startswith(name + ".")]:
+            del self.catalog.enum_columns[key]
         self.catalog.commit()
 
     def create_distributed_table(self, name: str, dist_column: str,
@@ -677,6 +680,29 @@ class Cluster:
             self.catalog.commit()
             self._plan_cache.clear()
             return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateType):
+            if stmt.name in self.catalog.types:
+                raise CatalogError(f'type "{stmt.name}" already exists')
+            if not stmt.labels or len(set(stmt.labels)) != len(stmt.labels):
+                raise AnalysisError("enum labels must be unique and non-empty")
+            self.catalog.types[stmt.name] = list(stmt.labels)
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropType):
+            if stmt.if_exists and stmt.name not in self.catalog.types:
+                return Result(columns=[], rows=[])
+            if stmt.name not in self.catalog.types:
+                raise CatalogError(f'type "{stmt.name}" does not exist')
+            users = [k for k, v in self.catalog.enum_columns.items()
+                     if v == stmt.name]
+            if users:
+                raise CatalogError(
+                    f'cannot drop type "{stmt.name}": used by {users[0]}')
+            del self.catalog.types[stmt.name]
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
         if isinstance(stmt, A.CreateFunction):
             from citus_tpu.planner.aggregates import AGG_REGISTRY
             from citus_tpu.planner.bind import AGG_FUNCS
@@ -755,12 +781,23 @@ class Cluster:
             self.catalog.commit()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.CreateTable):
-            schema = Schema([
-                Column(c.name, type_from_sql(c.type_name, c.type_args or None), c.not_null)
-                for c in stmt.columns
-            ])
+            from citus_tpu import types as T
+            cols, enum_binds = [], []
+            for c in stmt.columns:
+                if c.type_name in self.catalog.types:
+                    cols.append(Column(c.name, T.TEXT_T, c.not_null))
+                    enum_binds.append((c.name, c.type_name))
+                else:
+                    cols.append(Column(
+                        c.name, type_from_sql(c.type_name, c.type_args or None),
+                        c.not_null))
+            schema = Schema(cols)
             opts = {k: v for k, v in stmt.options.items() if k != "access_method"}
             self.create_table(stmt.name, schema, if_not_exists=stmt.if_not_exists, **opts)
+            if enum_binds and self.catalog.has_table(stmt.name):
+                for cn, tn in enum_binds:
+                    self.catalog.enum_columns[f"{stmt.name}.{cn}"] = tn
+                self.catalog.commit()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.DropTable):
             self.drop_table(stmt.name, if_exists=stmt.if_exists)
@@ -1737,6 +1774,18 @@ class Cluster:
                     rows.append((tbl, r, ",".join(privs)))
             return Result(columns=["table_name", "role_name", "privileges"],
                           rows=rows)
+        if name == "citus_version":
+            from citus_tpu.version import __version__ as _v
+            return Result(columns=["citus_version"],
+                          rows=[(f"citus_tpu {_v} (capability parity target: "
+                                 "Citus 15.0devel)",)])
+        if name == "citus_dist_stat_activity":
+            return Result(columns=["global_pid", "state", "elapsed_s", "query"],
+                          rows=self.activity.rows_view())
+        if name == "citus_types":
+            return Result(columns=["type_name", "labels"],
+                          rows=[(n, ",".join(ls)) for n, ls in
+                                sorted(self.catalog.types.items())])
         if name == "citus_views":
             return Result(columns=["view_name", "definition"],
                           rows=sorted(self.catalog.views.items()))

@@ -50,6 +50,13 @@ def encode_columns(
             continue
         valid = np.array([v is not None for v in data], dtype=bool)
         if col.type.is_text:
+            enum_t = cat.enum_columns.get(f"{table.name}.{col.name}")
+            if enum_t is not None:
+                allowed = set(cat.types.get(enum_t, ()))
+                for v in data:
+                    if v is not None and str(v) not in allowed:
+                        raise AnalysisError(
+                            f"invalid input value for enum {enum_t}: {v!r}")
             ids = cat.encode_strings(table.name, col.name, list(data))
             values[col.name] = np.asarray(ids, dtype=col.type.storage_dtype)
         else:

@@ -327,6 +327,21 @@ class DropFunction(Statement):
 
 
 @dataclass
+class CreateType(Statement):
+    """CREATE TYPE name AS ENUM (...) — enum columns store the label's
+    declaration index; labels validate at ingest (reference: types
+    propagate as distributed objects, commands/type.c)."""
+    name: str = ""
+    labels: list = field(default_factory=list)
+
+
+@dataclass
+class DropType(Statement):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
 class CreateRole(Statement):
     """Reference: roles propagate as distributed objects
     (commands/role.c); here a catalog-registered principal."""

@@ -433,6 +433,24 @@ class Parser:
                                     or_replace)
         if or_replace:
             self.error("expected FUNCTION after OR REPLACE")
+        if self.peek().kind == "ident" and self.peek().value == "type":
+            self.next()
+            name = self.expect_ident()
+            self.expect_kw("as")
+            if not (self.peek().kind == "ident" and self.peek().value == "enum"):
+                self.error("only CREATE TYPE ... AS ENUM is supported")
+            self.next()
+            self.expect_op("(")
+            labels = []
+            while True:
+                lt = self.next()
+                if lt.kind != "str":
+                    self.error("expected a quoted enum label")
+                labels.append(lt.value[1:-1].replace("''", "'"))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return A.CreateType(name, labels)
         if self.peek().kind == "ident" and self.peek().value == "view":
             self.next()
             name = self.parse_table_name()
@@ -544,6 +562,13 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             return A.DropFunction(self.expect_ident(), if_exists)
+        if self.peek().kind == "ident" and self.peek().value == "type":
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropType(self.expect_ident(), if_exists)
         if self.peek().kind == "ident" and self.peek().value in ("view", "sequence"):
             kind = self.next().value
             if_exists = False
@@ -605,6 +630,7 @@ class Parser:
         "citus_shards", "citus_tables", "recover_prepared_transactions",
         "nextval", "currval", "setval", "citus_views", "citus_sequences",
         "citus_cdc_events", "citus_roles", "citus_grants",
+        "citus_version", "citus_dist_stat_activity", "citus_types",
         "citus_get_node_clock", "citus_get_transaction_clock",
         "citus_create_restore_point", "citus_list_restore_points",
         "alter_distributed_table", "citus_check_cluster_node_health",

@@ -202,3 +202,28 @@ def test_sql_functions(tmp_path):
     cl2 = ct.Cluster(str(tmp_path / "fns"))
     assert cl2.execute("SELECT double_it(v) FROM t WHERE k = 1").rows == [(30,)]
     cl2.close()
+
+
+def test_enum_types(tmp_path):
+    """CREATE TYPE ... AS ENUM: dictionary-encoded text with ingest
+    validation (reference: type propagation, commands/type.c)."""
+    from citus_tpu.errors import AnalysisError, CatalogError
+    cl = ct.Cluster(str(tmp_path / "enums"))
+    cl.execute("CREATE TYPE mood AS ENUM ('sad', 'ok', 'happy')")
+    cl.execute("CREATE TABLE p (k bigint NOT NULL, m mood)")
+    cl.execute("SELECT create_distributed_table('p', 'k', 4)")
+    cl.execute("INSERT INTO p VALUES (1, 'happy'), (2, 'sad'), (3, NULL)")
+    assert cl.execute("SELECT count(*) FROM p WHERE m = 'happy'").rows == [(1,)]
+    with pytest.raises(AnalysisError):
+        cl.execute("INSERT INTO p VALUES (4, 'angry')")
+    with pytest.raises(CatalogError):
+        cl.execute("DROP TYPE mood")  # still referenced
+    assert cl.execute("SELECT citus_types()").rows == [("mood", "sad,ok,happy")]
+    # survives reopen with validation intact
+    cl.close()
+    cl2 = ct.Cluster(str(tmp_path / "enums"))
+    with pytest.raises(AnalysisError):
+        cl2.execute("INSERT INTO p VALUES (5, 'nope')")
+    cl2.execute("DROP TABLE p")
+    cl2.execute("DROP TYPE mood")
+    cl2.close()
