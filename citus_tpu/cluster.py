@@ -1234,10 +1234,18 @@ class Cluster:
 
     _CTE_SEQ = [0]
 
+    #: intermediate results at/above this row count distribute back out
+    #: over the mesh instead of staying coordinator-local (reference:
+    #: RedistributeTaskListResults / distributed_intermediate_results.c)
+    DISTRIBUTED_INTERMEDIATE_ROWS = 4096
+
     def _create_temp_from_result(self, prefix: str, label: str, r: Result) -> str:
-        """Store a query result as a local temp table (the
+        """Store a query result as an intermediate-result table (the
         read_intermediate_result analog for CTEs / derived tables / set
-        operations)."""
+        operations).  Small results stay local; large ones hash-
+        distribute on their first integer-typed column so downstream
+        joins and aggregations run sharded."""
+        from citus_tpu import types as T
         names, seen = [], set()
         for i, n in enumerate(r.columns):
             base = n or f"column{i + 1}"
@@ -1255,6 +1263,15 @@ class Cluster:
         tmp = f"__{prefix}_{self._CTE_SEQ[0]}_{label}"
         self.catalog.create_table(
             tmp, Schema([Column(cn, ct_) for cn, ct_ in zip(names, types)]))
+        if len(r.rows) >= self.DISTRIBUTED_INTERMEDIATE_ROWS:
+            dist_col = next(
+                (cn for cn, ct_ in zip(names, types)
+                 if ct_.is_integer or ct_.kind in (T.DATE,)), None)
+            if dist_col is not None:
+                self.catalog.distribute_table(
+                    tmp, dist_col, self.settings.sharding.shard_count,
+                    self.catalog.active_node_ids())
+                self.catalog.commit()
         if r.rows:
             self.copy_from(tmp, rows=r.rows)
         return tmp

@@ -61,3 +61,32 @@ def test_cte_temp_tables_are_dropped(db):
     cl.execute("WITH x AS (SELECT count(*) AS c FROM t) SELECT c FROM x")
     leftovers = [n for n in cl.catalog.tables if n.startswith("__cte_")]
     assert leftovers == []
+
+
+def test_large_intermediate_results_distribute(tmp_path):
+    """CTE/derived results above the threshold hash-distribute back out
+    (reference: RedistributeTaskListResults) so downstream joins run
+    sharded; small ones stay local."""
+    import numpy as np
+    cl = ct.Cluster(str(tmp_path / "dint"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", columns={"k": np.arange(20000),
+                               "v": np.arange(20000) % 100})
+    seen_dist = []
+    orig = cl.catalog.distribute_table
+
+    def spy(*a, **kw):
+        seen_dist.append(a[0])
+        return orig(*a, **kw)
+    cl.catalog.distribute_table = spy
+    r = cl.execute("WITH big AS (SELECT k, v * 2 AS w FROM t WHERE v < 90) "
+                   "SELECT count(*), sum(w) FROM big").rows
+    v = np.arange(20000) % 100
+    assert r == [(int((v < 90).sum()), int(v[v < 90].sum() * 2))]
+    assert any(n.startswith("__cte_") for n in seen_dist)  # distributed out
+    seen_dist.clear()
+    assert cl.execute("WITH s AS (SELECT k FROM t WHERE k < 10) "
+                      "SELECT count(*) FROM s").rows == [(10,)]
+    assert not seen_dist  # small: stays local
+    cl.close()
