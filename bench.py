@@ -76,7 +76,10 @@ def ensure_data(cl: "ct.Cluster") -> None:
 
 def _emit_last_good_or_die(note: str) -> None:
     """Device unavailable: fall back to the persisted last-good result
-    (clearly labeled stale) so the driver always gets a parseable line."""
+    (clearly labeled stale) so the driver always gets a parseable line.
+    With no last-good either, re-exec ourselves on the CPU backend and
+    emit that measurement honestly labeled platform=cpu — a lower bound,
+    never passed off as a TPU number."""
     if os.path.exists(LAST_GOOD):
         with open(LAST_GOOD) as fh:
             rec = json.load(fh)
@@ -85,9 +88,26 @@ def _emit_last_good_or_die(note: str) -> None:
         print(json.dumps(rec))
         sys.stdout.flush()
         os._exit(0)
-    sys.stderr.write(f"bench: {note} and no last-good result exists\n")
+    sys.stderr.write(f"bench: {note} and no last-good result exists; "
+                     "measuring on the cpu backend as a labeled lower "
+                     "bound\n")
     sys.stderr.flush()
-    os._exit(3)
+    env = dict(os.environ, BENCH_PLATFORM="cpu")
+    try:
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             capture_output=True, text=True, env=env,
+                             timeout=1200)
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        rec = json.loads(line)
+        rec["platform"] = "cpu"
+        rec["note"] = f"{note}; cpu-backend lower bound, NOT a TPU number"
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        os._exit(0)
+    except Exception as e:
+        sys.stderr.write(f"bench: cpu fallback failed too: {e}\n")
+        sys.stderr.flush()
+        os._exit(3)
 
 
 def _probe_device(timeout_s: float) -> bool:
@@ -129,7 +149,9 @@ def _arm_watchdog(seconds: float) -> None:
 
 def main() -> None:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90"))
-    if not _probe_device(probe_timeout):
+    # a pinned platform (cpu smoke/fallback) involves no tunnel: skip the
+    # probe — it would also recurse through the fallback re-exec
+    if not PLATFORM and not _probe_device(probe_timeout):
         retry_delay = float(os.environ.get("BENCH_RETRY_DELAY_S", "120"))
         sys.stderr.write("bench: device probe timed out; retrying once "
                          f"after {retry_delay}s\n")
