@@ -405,12 +405,17 @@ class Binder:
         if name == "like":
             target = self.bind_scalar(e.args[0], allow_agg)
             pat = e.args[1]
-            if not (isinstance(target, BColumn) and target.type.is_text
-                    and isinstance(pat, A.Literal) and isinstance(pat.value, str)):
-                raise UnsupportedFeatureError("LIKE requires text column and literal pattern")
+            resolved = self._text_words(target) \
+                if target.type.is_text else None
+            if not (resolved is not None and isinstance(pat, A.Literal)
+                    and isinstance(pat.value, str)):
+                raise UnsupportedFeatureError(
+                    "LIKE requires a text column (or string function over "
+                    "one) and a literal pattern")
+            base, _t, _c, eff_words = resolved
             rx = _like_to_regex(pat.value)
-            words = self.catalog.dictionary(*self.text_source(target))
-            return BDictMask(target, tuple(bool(rx.match(w)) for w in words))
+            # pattern evaluates against the TRANSFORMED word per base id
+            return BDictMask(base, tuple(bool(rx.match(w)) for w in eff_words))
         if name == "date_trunc":
             if len(e.args) != 2 or not isinstance(e.args[0], A.Literal):
                 raise AnalysisError("date_trunc(unit, expr) expects a literal unit")

@@ -227,3 +227,13 @@ def test_enum_types(tmp_path):
     cl2.execute("DROP TABLE p")
     cl2.execute("DROP TYPE mood")
     cl2.close()
+
+
+def test_like_over_string_transforms(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "liket"))
+    cl.execute("CREATE TABLE t (k bigint, s text)")
+    cl.copy_from("t", rows=[(1, " red "), (2, "green"), (3, "BLUE"), (4, None)])
+    assert cl.execute("SELECT count(*) FROM t WHERE upper(s) LIKE '%RE%'").rows == [(2,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE trim(s) LIKE 'red'").rows == [(1,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE lower(trim(s)) LIKE 'b%'").rows == [(1,)]
+    cl.close()
