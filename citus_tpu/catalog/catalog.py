@@ -368,6 +368,40 @@ class Catalog:
             if os.path.exists(oldp):
                 os.replace(oldp, self._dict_path(name, new))
 
+    def rename_table(self, old: str, new: str) -> None:
+        """ALTER TABLE ... RENAME TO: catalog key, shard data directory,
+        dictionary side files, grants and enum bindings all move.  Views
+        whose stored SQL references the old name will error at next use
+        (recreate them), unlike the reference's OID-based views."""
+        with self._lock:
+            t = self.table(old)
+            if new in self.tables or new in self.views:
+                raise CatalogError(f'relation "{new}" already exists')
+            if "." in new or "." in old:
+                raise CatalogError("cannot rename tenant-schema tables")
+            data_old = os.path.join(self.data_dir, "data", old)
+            data_new = os.path.join(self.data_dir, "data", new)
+            if os.path.isdir(data_old):
+                os.rename(data_old, data_new)
+            for col in t.schema.names:
+                op = self._dict_path(old, col)
+                if os.path.exists(op):
+                    os.replace(op, self._dict_path(new, col))
+                key = (old, col)
+                if key in self._dicts:
+                    self._dicts[(new, col)] = self._dicts.pop(key)
+                    self._dict_index[(new, col)] = self._dict_index.pop(key)
+                    self._dict_sig[(new, col)] = self._dict_sig.pop(key, None)
+            del self.tables[old]
+            t.name = new
+            self.tables[new] = t
+            if old in self.grants:
+                self.grants[new] = self.grants.pop(old)
+            for k in [k for k in self.enum_columns if k.startswith(old + ".")]:
+                self.enum_columns[new + k[len(old):]] = self.enum_columns.pop(k)
+            t.version += 1
+            self.ddl_epoch += 1
+
     def drop_table(self, name: str) -> None:
         with self._lock:
             import shutil

@@ -83,6 +83,66 @@ def _max_param_index(stmt) -> int:
     return mx
 
 
+def _eval_const(e):
+    """Evaluate a literal-only expression tree to a Python value (SELECT
+    without FROM); NULL-propagating arithmetic/comparisons."""
+    import decimal as _dec
+    if isinstance(e, A.Literal):
+        return e.value
+    if isinstance(e, A.UnOp):
+        v = _eval_const(e.operand)
+        if e.op == "-":
+            return None if v is None else -v
+        return None if v is None else (not v)
+    if isinstance(e, A.BinOp):
+        l, r = _eval_const(e.left), _eval_const(e.right)
+        if e.op == "and":
+            if l is False or r is False:
+                return False
+            return None if (l is None or r is None) else True
+        if e.op == "or":
+            if l is True or r is True:
+                return True
+            return None if (l is None or r is None) else False
+        if l is None or r is None:
+            return None
+        if isinstance(l, (int, float)) and isinstance(r, _dec.Decimal):
+            l = _dec.Decimal(str(l))
+        if isinstance(r, (int, float)) and isinstance(l, _dec.Decimal):
+            r = _dec.Decimal(str(r))
+        ops = {"+": lambda: l + r, "-": lambda: l - r, "*": lambda: l * r,
+               "/": lambda: l / r if r else None,
+               "%": lambda: l % r if r else None,
+               "=": lambda: l == r, "<>": lambda: l != r,
+               "<": lambda: l < r, "<=": lambda: l <= r,
+               ">": lambda: l > r, ">=": lambda: l >= r}
+        if e.op not in ops:
+            raise UnsupportedFeatureError(f"operator {e.op} without FROM")
+        return ops[e.op]()
+    if isinstance(e, A.IsNull):
+        v = _eval_const(e.expr)
+        return (v is not None) if e.negated else (v is None)
+    if isinstance(e, A.Cast):
+        v = _eval_const(e.expr)
+        if v is None:
+            return None
+        t = type_from_sql(e.type_name, list(e.type_args) or None)
+        return t.from_physical(t.to_physical(v))
+    if isinstance(e, A.CaseExpr):
+        for c, v in e.whens:
+            if _eval_const(c) is True:
+                return _eval_const(v)
+        return _eval_const(e.else_) if e.else_ is not None else None
+    if isinstance(e, A.FuncCall) and e.name == "coalesce":
+        for a in e.args:
+            v = _eval_const(a)
+            if v is not None:
+                return v
+        return None
+    raise UnsupportedFeatureError(
+        f"cannot evaluate {type(e).__name__} without a FROM clause")
+
+
 def _subst_args(e, sub: dict):
     """Replace bare ColumnRefs naming function parameters with the call
     arguments (used by SQL function inlining)."""
@@ -604,6 +664,8 @@ class Cluster:
             return self._execute_setop(stmt)
         if isinstance(stmt, (A.Select, A.SetOp)) and self.catalog.functions:
             stmt = self._expand_functions_stmt(stmt)
+        if isinstance(stmt, A.Select) and stmt.from_ is None:
+            return self._execute_constant_select(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is not None:
             from citus_tpu.planner.recursive import decorrelate_scalars
             stmt = decorrelate_scalars(stmt)
@@ -877,8 +939,13 @@ class Cluster:
                 self.catalog.drop_column(stmt.table, stmt.old_name)
             elif stmt.action == "rename_column":
                 self.catalog.rename_column(stmt.table, stmt.old_name, stmt.new_name)
+            elif stmt.action == "rename_table":
+                from citus_tpu.transaction.locks import EXCLUSIVE
+                t = self.catalog.table(stmt.table)
+                with self._write_lock(t, EXCLUSIVE):
+                    self.catalog.rename_table(stmt.table, stmt.new_name)
             else:
-                raise UnsupportedFeatureError("ALTER TABLE ... RENAME TO is not supported yet")
+                raise UnsupportedFeatureError(f"ALTER TABLE {stmt.action} not supported")
             self.catalog.commit()
             self._plan_cache.clear()
             return Result(columns=[], rows=[])
@@ -1367,6 +1434,29 @@ class Cluster:
             [A.OrderItem(rw(o.expr, 0), o.ascending, o.nulls_first)
              for o in stmt.order_by],
             stmt.limit, stmt.offset, stmt.distinct)
+
+    def _execute_constant_select(self, stmt: A.Select) -> Result:
+        """SELECT without FROM: constant expressions evaluated on the
+        coordinator (one row), including scalar subqueries."""
+        from citus_tpu.planner.recursive import rewrite_subqueries
+        stmt = rewrite_subqueries(stmt, lambda sub: self._execute_stmt(sub))
+        if stmt.group_by or stmt.having or stmt.distinct:
+            raise UnsupportedFeatureError(
+                "GROUP BY/HAVING/DISTINCT need a FROM clause")
+        row, names = [], []
+        for i, item in enumerate(stmt.items):
+            row.append(_eval_const(item.expr))
+            names.append(item.alias or (item.expr.name
+                                        if isinstance(item.expr, A.ColumnRef)
+                                        else f"column{i + 1}"))
+        rows = [tuple(row)]
+        if stmt.where is not None:
+            if _eval_const(stmt.where) is not True:
+                rows = []
+        if stmt.limit is not None:
+            rows = rows[:stmt.limit]
+        return Result(columns=names, rows=rows,
+                      explain={"strategy": "constant"})
 
     def _expand_views(self, item):
         """FROM references to views become derived tables over the view's

@@ -237,3 +237,27 @@ def test_like_over_string_transforms(tmp_path):
     assert cl.execute("SELECT count(*) FROM t WHERE trim(s) LIKE 'red'").rows == [(1,)]
     assert cl.execute("SELECT count(*) FROM t WHERE lower(trim(s)) LIKE 'b%'").rows == [(1,)]
     cl.close()
+
+
+def test_select_without_from_and_rename_table(tmp_path):
+    import decimal
+    from citus_tpu.errors import CatalogError
+    cl = ct.Cluster(str(tmp_path / "misc"))
+    assert cl.execute("SELECT 1 + 2 AS three, 'hi', true, NULL").rows == \
+        [(3, "hi", True, None)]
+    assert cl.execute("SELECT 10 / 4.0").rows[0][0] == decimal.Decimal("2.5")
+    assert cl.execute("SELECT 1 WHERE 1 = 2").rows == []
+    assert cl.execute("SELECT 1 UNION SELECT 2 ORDER BY 1").rows == [(1,), (2,)]
+    cl.execute("CREATE TABLE a (k bigint NOT NULL, s text)")
+    cl.execute("SELECT create_distributed_table('a', 'k', 4)")
+    cl.execute("INSERT INTO a VALUES (1, 'x'), (2, 'y')")
+    assert cl.execute("SELECT (SELECT max(s) FROM a)").rows == [("y",)]
+    cl.execute("ALTER TABLE a RENAME TO b")
+    assert cl.execute("SELECT count(*), max(s) FROM b").rows == [(2, "y")]
+    with pytest.raises(CatalogError):
+        cl.execute("SELECT * FROM a")
+    cl.execute("INSERT INTO b VALUES (3, 'z')")
+    cl.close()
+    cl2 = ct.Cluster(str(tmp_path / "misc"))
+    assert cl2.execute("SELECT max(s) FROM b").rows == [("z",)]
+    cl2.close()
