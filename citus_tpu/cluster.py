@@ -1929,8 +1929,44 @@ class Cluster:
             return self.execute(sql)
 
     def _execute_explain(self, stmt: A.Explain) -> Result:
+        if isinstance(stmt.statement, A.SetOp):
+            so = stmt.statement
+            lines = [f"Set Operation: {so.op.upper()}{' ALL' if so.all else ''}"]
+            for side, sub in (("left", so.left), ("right", so.right)):
+                r = self._execute_explain(A.Explain(sub, analyze=stmt.analyze))
+                lines.append(f"  -> {side}:")
+                lines.extend("     " + row[0] for row in r.rows)
+            return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+        if isinstance(stmt.statement, A.Insert) \
+                and stmt.statement.select is not None:
+            ins = stmt.statement
+            t = self.catalog.table(ins.table)
+            names = list(ins.columns or t.schema.names)
+            strategy = "pull"
+            sel = ins.select
+            if isinstance(sel, A.Select) and isinstance(sel.from_, A.TableRef) \
+                    and not (sel.group_by or sel.having or sel.order_by
+                             or sel.limit or sel.distinct):
+                try:
+                    bound = bind_select(self.catalog, sel)
+                    if not bound.has_aggs and len(bound.final_exprs) == len(names):
+                        strategy = self._insert_select_strategy(
+                            t, bound, list(bound.final_exprs), names)
+                except Exception:
+                    pass
+            lines = [f"Insert into {ins.table} ({', '.join(names)})",
+                     f"  Strategy: {strategy}"
+                     + {"colocated": "  (per-shard pushdown, no re-hash)",
+                        "repartition": "  (array-streaming re-hash)",
+                        "pull": "  (coordinator row materialization)"}[strategy]]
+            if isinstance(sel, (A.Select, A.SetOp)):
+                sub = self._execute_explain(A.Explain(sel, analyze=False))
+                lines.append("  -> source:")
+                lines.extend("     " + row[0] for row in sub.rows)
+            return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
         if not isinstance(stmt.statement, A.Select):
-            raise UnsupportedFeatureError("EXPLAIN supports SELECT only")
+            raise UnsupportedFeatureError(
+                "EXPLAIN supports SELECT, set operations, and INSERT..SELECT")
         if isinstance(stmt.statement.from_, A.Join):
             return self._explain_join(stmt)
         bound = bind_select(self.catalog, stmt.statement)

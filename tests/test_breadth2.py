@@ -261,3 +261,25 @@ def test_select_without_from_and_rename_table(tmp_path):
     cl2 = ct.Cluster(str(tmp_path / "misc"))
     assert cl2.execute("SELECT max(s) FROM b").rows == [("z",)]
     cl2.close()
+
+
+def test_explain_setop_and_insert_select(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "expl"))
+    import numpy as np
+    cl.execute("CREATE TABLE s (k bigint NOT NULL, v bigint)")
+    cl.execute("CREATE TABLE d (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('s', 'k', 4)")
+    cl.execute("SELECT create_distributed_table('d', 'k', 4, 's')")
+    cl.copy_from("s", columns={"k": np.arange(50), "v": np.arange(50)})
+    out = "\n".join(r[0] for r in cl.execute(
+        "EXPLAIN INSERT INTO d SELECT k, v FROM s WHERE v < 10").rows)
+    assert "Strategy: colocated" in out and "Distributed Scan on s" in out
+    out = "\n".join(r[0] for r in cl.execute(
+        "EXPLAIN INSERT INTO d SELECT k, v FROM s ORDER BY k").rows)
+    assert "Strategy: pull" in out
+    out = "\n".join(r[0] for r in cl.execute(
+        "EXPLAIN SELECT v FROM s UNION ALL SELECT v FROM d").rows)
+    assert "Set Operation: UNION ALL" in out and "left" in out and "right" in out
+    # EXPLAIN must not have executed the insert
+    assert cl.execute("SELECT count(*) FROM d").rows == [(0,)]
+    cl.close()
