@@ -44,11 +44,15 @@ class ControlPlane:
             self.server.register("cluster_inflight", self._on_cluster_inflight)
             self.server.register("tx_event", self._on_tx_event)
             self.server.start()
+        # push channel liveness: when it dies (coordinator gone), the
+        # cluster falls back to mtime polling for invalidations
+        self.push_alive = False
         if coordinator is not None:
             host, port = coordinator
             self.client = RpcClient(host, int(port))
             self.client.call("ping")
-            self.client.subscribe(self._on_event)
+            self.push_alive = True
+            self.client.subscribe(self._on_event, on_close=self._on_push_closed)
 
     # ---- server handlers ----------------------------------------------
     def _on_catalog_changed(self, payload: dict) -> dict:
@@ -120,9 +124,16 @@ class ControlPlane:
             pass
         return set()
 
+    def _on_push_closed(self) -> None:
+        self.push_alive = False
+
     @property
     def connected(self) -> bool:
-        return self.client is not None or self.server is not None
+        """Push-based invalidation is trustworthy: we serve it, or our
+        subscription to the authority is still alive."""
+        if self.server is not None:
+            return True
+        return self.client is not None and self.push_alive
 
     def close(self) -> None:
         if self.client is not None:

@@ -157,9 +157,11 @@ class RpcClient:
             raise RpcError(resp["error"])
         return resp.get("result") or {}
 
-    def subscribe(self, callback: Callable[[dict], None]) -> None:
+    def subscribe(self, callback: Callable[[dict], None],
+                  on_close: Optional[Callable[[], None]] = None) -> None:
         """Open a push channel; ``callback`` runs on a daemon thread for
-        every event the server broadcasts."""
+        every event the server broadcasts.  ``on_close`` fires when the
+        channel dies (server gone), so the owner can fall back."""
         self._sub_sock = socket.create_connection(self.addr, timeout=10.0)
         _send(self._sub_sock, {"id": 0, "method": "subscribe"})
         ack = _recv(self._sub_sock)  # {"result": {"ok": true}}
@@ -168,17 +170,24 @@ class RpcClient:
         self._sub_sock.settimeout(None)
 
         def listen():
-            while True:
-                try:
-                    event = _recv(self._sub_sock)
-                except OSError:
-                    return
-                if event is None:
-                    return
-                try:
-                    callback(event)
-                except Exception:
-                    pass
+            try:
+                while True:
+                    try:
+                        event = _recv(self._sub_sock)
+                    except OSError:
+                        return
+                    if event is None:
+                        return
+                    try:
+                        callback(event)
+                    except Exception:
+                        pass
+            finally:
+                if on_close is not None:
+                    try:
+                        on_close()
+                    except Exception:
+                        pass
 
         self._listener = threading.Thread(target=listen, daemon=True)
         self._listener.start()

@@ -126,3 +126,25 @@ def test_mx_still_works_without_rpc(tmp_path):
     finally:
         b.close()
         a.close()
+
+
+def test_fallback_to_polling_when_authority_dies(tmp_path):
+    """Losing the push channel degrades to mtime polling — peers keep
+    seeing each other's commits through the shared catalog."""
+    a = ct.Cluster(str(tmp_path / "db"), n_nodes=2, serve_port=0)
+    b = ct.Cluster(str(tmp_path / "db"), n_nodes=2,
+                   coordinator=("127.0.0.1", a.control_port))
+    try:
+        a.execute("CREATE TABLE t (k bigint)")
+        a.execute("INSERT INTO t VALUES (1)")
+        assert wait_until(lambda: b._catalog_dirty)
+        assert b.execute("SELECT count(*) FROM t").rows == [(1,)]
+        # kill the authority's server: b's push channel dies
+        a._control.server.stop()
+        assert wait_until(lambda: not b._control.connected)
+        # a's further commits still reach b via the mtime fallback
+        a.execute("INSERT INTO t VALUES (2)")
+        assert b.execute("SELECT count(*) FROM t").rows == [(2,)]
+    finally:
+        b.close()
+        a.close()
