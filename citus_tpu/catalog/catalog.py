@@ -423,7 +423,8 @@ class Catalog:
                     os.remove(dp)
 
     def distribute_table(self, name: str, dist_column: str, shard_count: int,
-                         node_ids: list[int], colocate_with: Optional[str] = None) -> TableMeta:
+                         node_ids: list[int], colocate_with: Optional[str] = None,
+                         replication_factor: int = 1) -> TableMeta:
         """create_distributed_table analog (reference:
         src/backend/distributed/commands/create_distributed_table.c).
         Caller is responsible for moving any existing data."""
@@ -458,10 +459,13 @@ class Catalog:
                     self._next_colocation_id += 1
             self.ddl_epoch += 1
             ranges = shard_hash_ranges(shard_count)
+            rf = max(1, min(int(replication_factor), len(node_ids)))
             shards = []
             for i, (lo, hi) in enumerate(ranges):
-                nid = node_ids[i % len(node_ids)]
-                shards.append(ShardMeta(self._alloc_shard_id(), i, lo, hi, [nid]))
+                placements = [node_ids[(i + r) % len(node_ids)]
+                              for r in range(rf)]
+                shards.append(ShardMeta(self._alloc_shard_id(), i, lo, hi,
+                                        placements))
             t.method = DistributionMethod.HASH
             t.dist_column = dist_column
             t.colocation_id = colocation_id

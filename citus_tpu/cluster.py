@@ -439,9 +439,10 @@ class Cluster:
                 "distributing a non-empty table is not supported yet; "
                 "create, distribute, then load")
         shard_count = shard_count or self.settings.sharding.shard_count
-        self.catalog.distribute_table(name, dist_column, shard_count,
-                                      self.catalog.active_node_ids(),
-                                      colocate_with=colocate_with)
+        self.catalog.distribute_table(
+            name, dist_column, shard_count, self.catalog.active_node_ids(),
+            colocate_with=colocate_with,
+            replication_factor=self.settings.sharding.shard_replication_factor)
         self.catalog.commit()
 
     def create_reference_table(self, name: str) -> None:

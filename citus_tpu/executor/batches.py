@@ -53,25 +53,32 @@ def load_shard_batches(
     else:
         nodes = list(shard.placements)
     # read tasks fail over to other placements, like the reference's
-    # PlacementExecutionDone failover (adaptive_executor.c:96-100)
+    # PlacementExecutionDone failover (adaptive_executor.c:96-100).  A
+    # MISSING placement directory is a failed placement, not an empty
+    # shard — only when no placement exists at all is the shard empty.
     reader = None
-    last_err = None
     for attempt, node in enumerate(nodes):
         d = cat.shard_dir(table.name, shard.shard_id, node)
         try:
             FAULTS.hit("read_placement", f"{table.name}:{shard.shard_id}:{node}")
-            if not os.path.isdir(d) or _load_meta(d)["row_count"] == 0:
-                return
+            if not os.path.isdir(d):
+                if attempt + 1 < len(nodes):
+                    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+                    GLOBAL_COUNTERS.bump("connection_failovers")
+                    continue
+                return  # never written on any placement: empty shard
+            if _load_meta(d)["row_count"] == 0:
+                return  # authoritative: the shard is empty
             reader = ShardReader(d, table.schema)
             break
-        except Exception as e:
-            last_err = e
+        except Exception:
             if attempt + 1 < len(nodes):
                 from citus_tpu.executor.executor import GLOBAL_COUNTERS
                 GLOBAL_COUNTERS.bump("connection_failovers")
                 continue
             raise
-    assert reader is not None
+    if reader is None:
+        return
     cols = plan.scan_columns
     pend_v: dict[str, list[np.ndarray]] = {c: [] for c in cols}
     pend_m: dict[str, list[np.ndarray]] = {c: [] for c in cols}

@@ -219,3 +219,30 @@ def test_isolate_tenant(tmp_path):
     assert cl.execute("SELECT count(*) FROM t WHERE k = 42").rows == [(1,)]
     assert cl.execute("SELECT count(*) FROM t").rows == [(10000,)]
     cl.close()
+
+
+def test_shard_replication_factor(tmp_path):
+    """shard_replication_factor places replicas; reads fail over when a
+    placement directory is lost; writes hit every placement."""
+    import shutil
+
+    import numpy as np
+    from citus_tpu.config import Settings, ShardingSettings
+    cl = ct.Cluster(str(tmp_path / "rf"), n_nodes=3, settings=Settings(
+        sharding=ShardingSettings(shard_count=6, shard_replication_factor=2)))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k')")
+    t = cl.catalog.table("t")
+    assert all(len(s.placements) == 2 for s in t.shards)
+    cl.copy_from("t", columns={"k": np.arange(5000), "v": np.arange(5000)})
+    cl.execute("UPDATE t SET v = 0 WHERE k < 100")
+    expected = 12497500 - 4950
+    assert cl.execute("SELECT sum(v) FROM t").rows == [(expected,)]
+    # lose one replica of every shard: reads fail over, results unchanged
+    for s in t.shards:
+        shutil.rmtree(cl.catalog.shard_dir("t", s.shard_id, s.placements[0]),
+                      ignore_errors=True)
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == \
+        [(5000, expected)]
+    assert cl.counters.snapshot().get("connection_failovers", 0) > 0
+    cl.close()
