@@ -1698,12 +1698,17 @@ class Cluster:
             return Result(columns=[name], rows=[(None,)])
         if name == "get_rebalance_table_shards_plan":
             from citus_tpu.operations import get_rebalance_plan
-            moves = get_rebalance_plan(self.catalog, args[0] if args else None)
+            moves = get_rebalance_plan(
+                self.catalog, args[0] if args else None,
+                strategy=str(args[1]) if len(args) > 1 else "by_disk_size")
             return Result(columns=["shardid", "sourcenode", "targetnode"],
                           rows=[m.to_row() for m in moves])
         if name == "rebalance_table_shards":
             from citus_tpu.operations import rebalance_table_shards
-            moves = rebalance_table_shards(self.catalog, args[0] if args else None)
+            moves = rebalance_table_shards(
+                self.catalog, args[0] if args else None,
+                strategy=str(args[1]) if len(args) > 1 else "by_disk_size",
+                lock_manager=self.locks)
             self._plan_cache.clear()
             return Result(columns=["rebalance_table_shards"],
                           rows=[(len(moves),)])

@@ -33,7 +33,10 @@ class RebalanceMove:
         return (self.shard_id, self.source_node, self.target_node)
 
 
-def _placement_cost(cat: Catalog, table, shard, node: int) -> float:
+def _placement_cost(cat: Catalog, table, shard, node: int,
+                    strategy: str = "by_disk_size") -> float:
+    if strategy == "by_shard_count":
+        return 1.0  # every shard group weighs the same
     d = cat.shard_dir(table.name, shard.shard_id, node)
     if not os.path.isdir(d):
         return 1.0
@@ -41,7 +44,8 @@ def _placement_cost(cat: Catalog, table, shard, node: int) -> float:
         os.path.getsize(os.path.join(d, f)) for f in os.listdir(d))))
 
 
-def _group_costs(cat: Catalog, table_name: str | None = None):
+def _group_costs(cat: Catalog, table_name: str | None = None,
+                 strategy: str = "by_disk_size"):
     """-> (cost per colocation-group-slot keyed by (colocation_id, index),
     node loads, representative shard per group slot)."""
     groups: dict[tuple, float] = {}
@@ -55,7 +59,7 @@ def _group_costs(cat: Catalog, table_name: str | None = None):
         for s in t.shards:
             node = s.placements[0]
             key = (t.colocation_id, s.index)
-            c = _placement_cost(cat, t, s, node)
+            c = _placement_cost(cat, t, s, node, strategy)
             groups[key] = groups.get(key, 0.0) + c
             if key not in rep:
                 rep[key] = (s.shard_id, node)
@@ -63,11 +67,20 @@ def _group_costs(cat: Catalog, table_name: str | None = None):
     return groups, loads, rep
 
 
+REBALANCE_STRATEGIES = ("by_disk_size", "by_shard_count")
+
+
 def get_rebalance_plan(cat: Catalog, table_name: str | None = None,
                        threshold: float = 0.1,
-                       max_moves: int = 1000) -> list[RebalanceMove]:
-    """Greedy improvement plan; does not execute anything."""
-    groups, loads, rep = _group_costs(cat, table_name)
+                       max_moves: int = 1000,
+                       strategy: str = "by_disk_size") -> list[RebalanceMove]:
+    """Greedy improvement plan; does not execute anything.  ``strategy``
+    mirrors pg_dist_rebalance_strategy's built-ins: by_disk_size
+    (placement bytes) or by_shard_count (uniform weights)."""
+    if strategy not in REBALANCE_STRATEGIES:
+        from citus_tpu.errors import CatalogError
+        raise CatalogError(f"unknown rebalance strategy {strategy!r}")
+    groups, loads, rep = _group_costs(cat, table_name, strategy)
     if not loads:
         return []
     # group slot -> current node (simulated as moves are planned)
@@ -95,10 +108,13 @@ def get_rebalance_plan(cat: Catalog, table_name: str | None = None,
 
 
 def rebalance_table_shards(cat: Catalog, table_name: str | None = None,
-                           threshold: float = 0.1) -> list[RebalanceMove]:
+                           threshold: float = 0.1,
+                           strategy: str = "by_disk_size",
+                           lock_manager=None) -> list[RebalanceMove]:
     """Plan + execute (reference: rebalance_table_shards / the background
     variant citus_rebalance_start)."""
-    moves = get_rebalance_plan(cat, table_name, threshold)
+    moves = get_rebalance_plan(cat, table_name, threshold, strategy=strategy)
     for m in moves:
-        move_shard_placement(cat, m.shard_id, m.source_node, m.target_node)
+        move_shard_placement(cat, m.shard_id, m.source_node, m.target_node,
+                             lock_manager=lock_manager)
     return moves

@@ -246,3 +246,26 @@ def test_shard_replication_factor(tmp_path):
         [(5000, expected)]
     assert cl.counters.snapshot().get("connection_failovers", 0) > 0
     cl.close()
+
+
+def test_rebalance_by_shard_count(tmp_path):
+    """pg_dist_rebalance_strategy built-ins: by_shard_count weighs every
+    colocation group equally (by_disk_size remains the default)."""
+    import numpy as np
+    cl = ct.Cluster(str(tmp_path / "rbs"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 8)")
+    cl.copy_from("t", columns={"k": np.arange(10000), "v": np.arange(10000)})
+    cl.execute("SELECT citus_add_node('w', 1)")
+    cl.execute("SELECT rebalance_table_shards('t', 'by_shard_count')")
+    t = cl.catalog.table("t")
+    per_node = {}
+    for s in t.shards:
+        per_node[s.placements[0]] = per_node.get(s.placements[0], 0) + 1
+    assert max(per_node.values()) - min(per_node.values()) <= 1
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == \
+        [(10000, 49995000)]
+    from citus_tpu.errors import CatalogError
+    with pytest.raises(CatalogError):
+        cl.execute("SELECT rebalance_table_shards('t', 'bogus')")
+    cl.close()
