@@ -1887,6 +1887,40 @@ class Cluster:
                     rows.append((tbl, r, ",".join(privs)))
             return Result(columns=["table_name", "role_name", "privileges"],
                           rows=rows)
+        if name == "get_shard_id_for_distribution_column":
+            from citus_tpu.catalog.hashing import hash_int64_scalar, shard_index_for_hash
+            import numpy as _np
+            t2 = self.catalog.table(str(args[0]))
+            if not t2.is_distributed:
+                return Result(columns=[name], rows=[(t2.shards[0].shard_id,)])
+            h = hash_int64_scalar(int(args[1]))
+            si = int(shard_index_for_hash(_np.array([h], _np.int32),
+                                          t2.shard_count)[0])
+            return Result(columns=[name], rows=[(t2.shards[si].shard_id,)])
+        if name in ("citus_relation_size", "citus_total_relation_size"):
+            return Result(columns=[name],
+                          rows=[(self._table_size(str(args[0])),)])
+        if name == "citus_disable_node":
+            nid = int(args[0])
+            if nid not in self.catalog.nodes:
+                raise CatalogError(f"node {nid} does not exist")
+            self.catalog.nodes[nid].is_active = False
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            self._plan_cache.clear()
+            return Result(columns=[name], rows=[(None,)])
+        if name == "citus_activate_node":
+            nid = int(args[0])
+            if nid not in self.catalog.nodes:
+                raise CatalogError(f"node {nid} does not exist")
+            self.catalog.nodes[nid].is_active = True
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            self._plan_cache.clear()
+            return Result(columns=[name], rows=[(nid,)])
+        if name == "citus_get_active_worker_nodes":
+            return Result(columns=["node_id"],
+                          rows=[(n,) for n in self.catalog.active_node_ids()])
         if name == "citus_version":
             from citus_tpu.version import __version__ as _v
             return Result(columns=["citus_version"],

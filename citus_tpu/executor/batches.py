@@ -51,7 +51,12 @@ def load_shard_batches(
     if node_override is not None:
         nodes = [node_override]
     else:
-        nodes = list(shard.placements)
+        # prefer active nodes (citus_disable_node semantics): a disabled
+        # node's placement is only read when no active replica exists
+        def inactive(n):
+            meta = cat.nodes.get(n)
+            return meta is not None and not meta.is_active
+        nodes = sorted(shard.placements, key=inactive)
     # read tasks fail over to other placements, like the reference's
     # PlacementExecutionDone failover (adaptive_executor.c:96-100).  A
     # MISSING placement directory is a failed placement, not an empty

@@ -631,6 +631,9 @@ class Parser:
         "nextval", "currval", "setval", "citus_views", "citus_sequences",
         "citus_cdc_events", "citus_roles", "citus_grants",
         "citus_version", "citus_dist_stat_activity", "citus_types",
+        "get_shard_id_for_distribution_column", "citus_relation_size",
+        "citus_total_relation_size", "citus_disable_node",
+        "citus_activate_node", "citus_get_active_worker_nodes",
         "citus_get_node_clock", "citus_get_transaction_clock",
         "citus_create_restore_point", "citus_list_restore_points",
         "alter_distributed_table", "citus_check_cluster_node_health",

@@ -269,3 +269,26 @@ def test_rebalance_by_shard_count(tmp_path):
     with pytest.raises(CatalogError):
         cl.execute("SELECT rebalance_table_shards('t', 'bogus')")
     cl.close()
+
+
+def test_node_disable_activate_and_admin_udfs(tmp_path):
+    import numpy as np
+    from citus_tpu.config import Settings, ShardingSettings
+    cl = ct.Cluster(str(tmp_path / "adm"), n_nodes=3, settings=Settings(
+        sharding=ShardingSettings(shard_count=6, shard_replication_factor=2)))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k')")
+    cl.copy_from("t", columns={"k": np.arange(3000), "v": np.arange(3000)})
+    sid = cl.execute(
+        "SELECT get_shard_id_for_distribution_column('t', 42)").rows[0][0]
+    assert any(s.shard_id == sid for s in cl.catalog.table("t").shards)
+    assert cl.execute("SELECT citus_relation_size('t')").rows[0][0] > 0
+    cl.execute("SELECT citus_disable_node(0)")
+    assert cl.execute("SELECT citus_get_active_worker_nodes()").rows == \
+        [(1,), (2,)]
+    # reads route around the disabled node; results identical
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == \
+        [(3000, 4498500)]
+    cl.execute("SELECT citus_activate_node(0)")
+    assert len(cl.execute("SELECT citus_get_active_worker_nodes()").rows) == 3
+    cl.close()
