@@ -302,7 +302,7 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
 
     # distinct/collect partial states are exact value (multi)sets: only
     # the host accumulation path can carry them
-    has_exact = any(op.kind in ("distinct", "collect")
+    has_exact = any(op.kind in ("distinct", "collect", "collect_set")
                     for op in plan.partial_ops)
     if backend != "cpu" and not has_exact:
         import jax

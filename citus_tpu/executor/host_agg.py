@@ -30,7 +30,7 @@ class HostGroupAccumulator:
         self._key_vals.append(kvs)
         row = []
         for op in self.partial_ops:
-            if op.kind == "distinct":
+            if op.kind in ("distinct", "collect_set"):
                 row.append(set())
                 continue
             if op.kind == "collect":
@@ -89,7 +89,7 @@ class HostGroupAccumulator:
         local = []
         for op in self.partial_ops:
             dt = np.dtype(op.dtype)
-            if op.kind == "distinct":
+            if op.kind in ("distinct", "collect_set"):
                 v, ok = arg_np[op.arg_index]
                 sets = [set() for _ in range(L)]
                 for r in np.nonzero(ok)[0]:
@@ -136,7 +136,7 @@ class HostGroupAccumulator:
                 gi = self._new_group(kvs)
                 self._groups[kb] = gi
             for pi, op in enumerate(self.partial_ops):
-                if op.kind == "distinct":
+                if op.kind in ("distinct", "collect_set"):
                     self._accs[gi][pi] |= local[pi][li]
                 elif op.kind == "collect":
                     self._accs[gi][pi].extend(local[pi][li])
@@ -202,7 +202,7 @@ class HostGroupAccumulator:
             key_arrays.append((vals, valid))
         partials = []
         for pi, op in enumerate(self.partial_ops):
-            if op.kind == "collect":
+            if op.kind in ("collect", "collect_set"):
                 a = np.empty(G, object)
                 for g in range(G):
                     a[g] = self._accs[g][pi]

@@ -347,6 +347,43 @@ def _finalize_text_minmax(ex, partials, cat):
     return out, valid
 
 
+# ----------------------------------------------- DISTINCT sum/avg
+
+
+def _lower_set(spec, arg_slot, partial_slot):
+    from citus_tpu.planner.physical import AggExtract
+    ai = arg_slot(spec.arg)
+    s = partial_slot("collect_set", ai, "object")
+    return AggExtract(spec.kind, [s], spec.out_type, param=spec.param)
+
+
+def _finalize_set_sum_avg(ex, partials, cat):
+    """sum(DISTINCT)/avg(DISTINCT) over exact value sets; physical-space
+    arithmetic so decimal exactness matches the non-distinct paths
+    (avg scales by 10^6 like the builtin decimal average)."""
+    import decimal as _dec
+    sets = np.asarray(partials[ex.slots[0]], object)
+    out = np.empty(sets.shape[0], object)
+    valid = np.zeros(sets.shape[0], bool)
+    is_avg = ex.kind == "avg_distinct"
+    is_float = ex.out_type.is_float
+    for i, vals in enumerate(sets):
+        if not vals:
+            continue
+        valid[i] = True
+        if is_float:
+            s = float(sum(vals))
+            out[i] = s / len(vals) if is_avg else s
+        else:
+            s = int(sum(int(v) for v in vals))
+            if is_avg:
+                q = _dec.Decimal(s) * 1_000_000 / _dec.Decimal(len(vals))
+                out[i] = int(q.to_integral_value(rounding=_dec.ROUND_HALF_UP))
+            else:
+                out[i] = s
+    return out, valid
+
+
 AGG_REGISTRY: dict[str, AggDef] = {}
 
 
@@ -368,6 +405,9 @@ for _n in ("percentile_cont", "percentile_disc"):
                     _finalize_percentile, needs_exact=True))
 for _n in ("min_text", "max_text"):
     register(AggDef(_n, None, _lower_text_minmax, _finalize_text_minmax))
+for _n in ("sum_distinct", "avg_distinct"):
+    register(AggDef(_n, None, _lower_set, _finalize_set_sum_avg,
+                    needs_exact=True))
 
 
 def finalize_kind(kind: str):

@@ -580,9 +580,23 @@ class Binder:
                                           or e.name in AGG_REGISTRY):
             if e.name in AGG_REGISTRY:
                 spec = AGG_REGISTRY[e.name].bind(self, e)
+            elif e.distinct and e.name in ("sum", "avg"):
+                arg = self.bind_scalar(e.args[0])
+                spec = AggSpec(f"{e.name}_distinct", arg,
+                               self._agg_output_type(e.name, arg),
+                               distinct=True)
+            elif e.distinct and e.name in ("min", "max"):
+                # DISTINCT is a no-op for extrema
+                arg = self.bind_scalar(e.args[0])
+                if arg.type.is_text:
+                    from citus_tpu.planner.aggregates import bind_text_minmax
+                    spec = bind_text_minmax(self, e.name, arg)
+                else:
+                    spec = AggSpec(e.name, arg,
+                                   self._agg_output_type(e.name, arg))
             elif e.distinct and e.name not in ("count",):
                 raise UnsupportedFeatureError(
-                    f"DISTINCT is only supported for count() yet, not {e.name}()")
+                    f"DISTINCT is not supported for {e.name}()")
             elif e.name == "count" and (not e.args or isinstance(e.args[0], A.Star)):
                 spec = AggSpec("count_star", None, T.INT64_T)
             else:

@@ -181,3 +181,36 @@ def test_ordered_string_and_array_agg(tmp_path):
                       "GROUP BY g ORDER BY g").rows == \
         [(0, [30, 20, 10]), (1, [9, 7, 5])]
     cl.close()
+
+
+def test_distinct_sum_avg_minmax(tmp_path):
+    """sum/avg(DISTINCT) via exact value-set partials; DISTINCT is a
+    no-op for min/max (including text)."""
+    import decimal
+    import sqlite3
+    cl = ct.Cluster(str(tmp_path / "dagg"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, g bigint, v bigint, "
+               "d decimal(8,2), f double, s text)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    rows = [(i, i % 3, (i * 7) % 5, round((i % 4) * 1.25, 2),
+             float(i % 6), f"w{i % 4}") for i in range(100)]
+    cl.copy_from("t", rows=rows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, g INTEGER, v INTEGER, d REAL, "
+               "f REAL, s TEXT)")
+    sq.executemany("INSERT INTO t VALUES (?,?,?,?,?,?)", rows)
+    for sql in [
+        "SELECT sum(DISTINCT v), avg(DISTINCT f) FROM t",
+        "SELECT g, sum(DISTINCT v), count(DISTINCT v) FROM t GROUP BY g ORDER BY g",
+        "SELECT min(DISTINCT v), max(DISTINCT v) FROM t",
+    ]:
+        ours = [tuple(round(float(v), 6)
+                      if isinstance(v, (float, decimal.Decimal)) else v
+                      for v in r) for r in cl.execute(sql).rows]
+        theirs = [tuple(round(float(v), 6) if isinstance(v, float) else v
+                        for v in r) for r in sq.execute(sql).fetchall()]
+        assert ours == theirs, (sql, ours, theirs)
+    assert cl.execute("SELECT sum(DISTINCT d) FROM t").rows[0][0] == \
+        decimal.Decimal("7.50")
+    assert cl.execute("SELECT max(DISTINCT s) FROM t").rows == [("w3",)]
+    cl.close()
