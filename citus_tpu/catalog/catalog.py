@@ -189,6 +189,9 @@ class Catalog:
         self.sequences: dict[str, dict] = {}
         self._seq_cache: dict[str, list] = {}   # name -> [next, limit]
         self._seq_currval: dict[str, int] = {}  # session-last nextval
+        # per-section dropped names since the last commit (merge guard)
+        self._tombstones: dict[str, set] = {}
+        self._doc_sig = None
         self._load()
 
     # ---- persistence --------------------------------------------------
@@ -213,38 +216,103 @@ class Catalog:
         self.functions = d.get("functions", {})
         self.types = d.get("types", {})
         self.enum_columns = d.get("enum_columns", {})
+        self._doc_sig = _stat_sig(p)
+
+    def tombstone(self, section: str, name: str) -> None:
+        """Record a deletion so the commit-time merge never resurrects a
+        dropped object from a concurrent coordinator's document."""
+        self._tombstones.setdefault(section, set()).add(name)
+
+    def _merge_foreign_locked(self) -> None:
+        """Adopt another coordinator's catalog changes before storing
+        (read-merge-store under the catalog flock): entries on disk that
+        we neither hold nor dropped are adopted; table conflicts resolve
+        by version; sequence high-water marks by increment direction;
+        id allocators by max.  This keeps concurrent multi-coordinator
+        commits from dropping each other's objects."""
+        sig = _stat_sig(self._path())
+        if sig is None or sig == getattr(self, "_doc_sig", None):
+            return
+        try:
+            with open(self._path()) as fh:
+                d = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return
+        tomb = self._tombstones
+        for td in d.get("tables", []):
+            name = td["name"]
+            if name in tomb.get("tables", ()):
+                continue
+            mine = self.tables.get(name)
+            if mine is None or td.get("version", 0) > mine.version:
+                self.tables[name] = TableMeta.from_json(td)
+        for nd in d.get("nodes", []):
+            self.nodes.setdefault(nd["node_id"], NodeMeta.from_json(nd))
+        for sec in ("views", "sequences", "roles", "functions", "types",
+                    "enum_columns", "schemas"):
+            disk = d.get(sec, {})
+            mem = getattr(self, sec)
+            dead = tomb.get(sec, set())
+            for k, v in disk.items():
+                if k in dead:
+                    continue
+                if k not in mem:
+                    mem[k] = v
+                elif sec == "sequences":
+                    inc = mem[k].get("increment", 1)
+                    ahead = (v.get("value", 0) - mem[k]["value"])
+                    if (ahead > 0) == (inc >= 0) and ahead != 0:
+                        mem[k]["value"] = v["value"]
+        for tbl, by_role in d.get("grants", {}).items():
+            if tbl in tomb.get("tables", ()):
+                continue
+            tgt = self.grants.setdefault(tbl, {})
+            for rname, privs in by_role.items():
+                if rname not in tomb.get("roles", ()) and rname not in tgt:
+                    tgt[rname] = privs
+        self._next_shard_id = max(self._next_shard_id,
+                                  d.get("next_shard_id", 0))
+        self._next_colocation_id = max(self._next_colocation_id,
+                                       d.get("next_colocation_id", 1))
+
+    def _store_locked(self) -> None:
+        d = {
+            "tables": [t.to_json() for t in self.tables.values()],
+            "nodes": [n.to_json() for n in self.nodes.values()],
+            "next_shard_id": self._next_shard_id,
+            "next_colocation_id": self._next_colocation_id,
+            "schemas": self.schemas,
+            "views": self.views,
+            "sequences": self.sequences,
+            "roles": self.roles,
+            "grants": self.grants,
+            "functions": self.functions,
+            "types": self.types,
+            "enum_columns": self.enum_columns,
+        }
+        tmp = self._path() + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(d, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._path())
+        # remember our own write so coordinators in this process don't
+        # treat it as a foreign metadata change (see MX reload)
+        try:
+            self.self_mtime = os.path.getmtime(self._path())
+        except OSError:
+            pass
+        self._doc_sig = _stat_sig(self._path())
+        self._tombstones = {}
 
     def commit(self) -> None:
-        """Atomically persist catalog state (round-1 metadata transaction)."""
+        """Atomically persist catalog state: read-merge-store under the
+        cross-process lock (the metadata-transaction analog)."""
         from citus_tpu.testing.faults import FAULTS
         FAULTS.hit("catalog_commit")
         with self._lock, _catalog_flock(self.data_dir):
-            d = {
-                "tables": [t.to_json() for t in self.tables.values()],
-                "nodes": [n.to_json() for n in self.nodes.values()],
-                "next_shard_id": self._next_shard_id,
-                "next_colocation_id": self._next_colocation_id,
-                "schemas": self.schemas,
-                "views": self.views,
-                "sequences": self.sequences,
-                "roles": self.roles,
-                "grants": self.grants,
-                "functions": self.functions,
-                "types": self.types,
-                "enum_columns": self.enum_columns,
-            }
-            tmp = self._path() + ".tmp"
-            with open(tmp, "w") as fh:
-                json.dump(d, fh)
-                fh.flush()
-                os.fsync(fh.fileno())
-            os.replace(tmp, self._path())
-            # remember our own write so coordinators in this process don't
-            # treat it as a foreign metadata change (see MX reload)
-            try:
-                self.self_mtime = os.path.getmtime(self._path())
-            except OSError:
-                pass
+            self._merge_foreign_locked()
+            self._store_locked()
             # dictionaries are persisted (fsync'd) by encode_strings at
             # growth time, before any commit record can reference their
             # ids — nothing to write here
@@ -393,6 +461,7 @@ class Catalog:
                     self._dict_index[(new, col)] = self._dict_index.pop(key)
                     self._dict_sig[(new, col)] = self._dict_sig.pop(key, None)
             del self.tables[old]
+            self.tombstone("tables", old)
             t.name = new
             self.tables[new] = t
             if old in self.grants:
@@ -407,6 +476,7 @@ class Catalog:
             import shutil
             t = self.table(name)
             del self.tables[name]
+            self.tombstone("tables", name)
             self.ddl_epoch += 1
             for key in [k for k in self._dicts if k[0] == name]:
                 del self._dicts[key]
@@ -496,6 +566,7 @@ class Catalog:
             if name not in self.views:
                 raise CatalogError(f'view "{name}" does not exist')
             del self.views[name]
+            self.tombstone("views", name)
             self.ddl_epoch += 1
 
     # ---- roles / grants ----------------------------------------------
@@ -512,6 +583,7 @@ class Catalog:
             if name not in self.roles:
                 raise CatalogError(f'role "{name}" does not exist')
             del self.roles[name]
+            self.tombstone("roles", name)
             for tbl in self.grants.values():
                 tbl.pop(name, None)
 
@@ -558,29 +630,34 @@ class Catalog:
             if name not in self.sequences:
                 raise CatalogError(f'sequence "{name}" does not exist')
             del self.sequences[name]
+            self.tombstone("sequences", name)
             self._seq_cache.pop(name, None)
             self._seq_currval.pop(name, None)
 
     def nextval(self, name: str) -> int:
         """Next sequence value; values come from an in-memory block
-        reserved by persisting a bumped high-water mark (crash = gap,
-        never a repeat — reference: cached sequence semantics)."""
+        reserved — durably and under the cross-process lock with a
+        read-merge, so two coordinators can never reserve overlapping
+        blocks and no value is handed out before its reservation is on
+        disk (crash = gap, never a repeat)."""
         with self._lock:
-            seq = self.sequences.get(name)
-            if seq is None:
+            if name not in self.sequences:
                 raise CatalogError(f'sequence "{name}" does not exist')
-            inc = seq["increment"]
             cache = self._seq_cache.get(name)
             if cache is None or cache[0] == cache[1]:
-                base = seq["value"]
-                seq["value"] = base + inc * self.SEQ_CACHE_BLOCK
+                with _catalog_flock(self.data_dir):
+                    # pick up foreign reservations before extending
+                    self._merge_foreign_locked()
+                    seq = self.sequences.get(name)
+                    if seq is None:
+                        raise CatalogError(
+                            f'sequence "{name}" does not exist')
+                    inc = seq["increment"]
+                    base = seq["value"]
+                    seq["value"] = base + inc * self.SEQ_CACHE_BLOCK
+                    self._store_locked()  # durable BEFORE handing out
                 self._seq_cache[name] = cache = [base, seq["value"]]
-                persist = True
-            else:
-                persist = False
-        if persist:
-            self.commit()
-        with self._lock:
+            inc = self.sequences[name]["increment"]
             v = cache[0]
             cache[0] = v + inc
             self._seq_currval[name] = v

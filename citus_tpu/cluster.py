@@ -356,11 +356,14 @@ class Cluster:
             with group_write_lock(self.catalog, table_meta, mode,
                                   lock_manager=self.locks,
                                   timeout=self.settings.executor.lock_timeout_s):
-                self._maybe_reload_catalog()
+                # force_sync: an RPC invalidation push may not have
+                # arrived yet; a writer that just waited out a mover must
+                # check staleness synchronously before touching placements
+                self._maybe_reload_catalog(force_sync=True)
                 yield
         return _ctx()
 
-    def _maybe_reload_catalog(self) -> None:
+    def _maybe_reload_catalog(self, force_sync: bool = False) -> None:
         """Pick up metadata written by other coordinators sharing this
         data dir (the query-from-any-node / MX analog: any process can
         plan and execute once metadata is synced; reference:
@@ -372,11 +375,18 @@ class Cluster:
         reloading underneath them (clear + load) is a read-tear race."""
         import os
         if self._control is not None and self._control.connected:
-            if not self._catalog_dirty:
+            if self._catalog_dirty:
+                self._catalog_dirty = False
+                self._reload_catalog()
+                try:
+                    self._catalog_mtime = os.path.getmtime(self.catalog._path())
+                except OSError:
+                    pass
                 return
-            self._catalog_dirty = False
-            self._reload_catalog()
-            return
+            if not force_sync:
+                return
+            # fall through to the synchronous mtime check: write paths
+            # cannot rely on the asynchronous push having arrived
         p = self.catalog._path()
         try:
             mtime = os.path.getmtime(p)
@@ -661,10 +671,10 @@ class Cluster:
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         if isinstance(stmt, A.WithSelect):
             return self._execute_with(stmt)
-        if isinstance(stmt, A.SetOp):
-            return self._execute_setop(stmt)
         if isinstance(stmt, (A.Select, A.SetOp)) and self.catalog.functions:
             stmt = self._expand_functions_stmt(stmt)
+        if isinstance(stmt, A.SetOp):
+            return self._execute_setop(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is None:
             return self._execute_constant_select(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is not None:
@@ -763,6 +773,7 @@ class Cluster:
                 raise CatalogError(
                     f'cannot drop type "{stmt.name}": used by {users[0]}')
             del self.catalog.types[stmt.name]
+            self.catalog.tombstone("types", stmt.name)
             self.catalog.ddl_epoch += 1
             self.catalog.commit()
             return Result(columns=[], rows=[])
@@ -791,6 +802,7 @@ class Cluster:
             if stmt.name not in self.catalog.functions:
                 raise CatalogError(f'function "{stmt.name}" does not exist')
             del self.catalog.functions[stmt.name]
+            self.catalog.tombstone("functions", stmt.name)
             self.catalog.ddl_epoch += 1
             self.catalog.commit()
             self._plan_cache.clear()
@@ -1454,6 +1466,8 @@ class Cluster:
         if stmt.where is not None:
             if _eval_const(stmt.where) is not True:
                 rows = []
+        if stmt.offset:
+            rows = rows[stmt.offset:]
         if stmt.limit is not None:
             rows = rows[:stmt.limit]
         return Result(columns=names, rows=rows,
@@ -1587,23 +1601,29 @@ class Cluster:
                 return tables_of(item.left) + tables_of(item.right)
             return []
 
+        def expr_subselects(e):
+            from citus_tpu.planner.recursive import _walk_expr
+            if e is None or not isinstance(e, A.Expr):
+                return []
+            return [n.select for n in _walk_expr(e)]
+
         def stmt_tables(s):
             if isinstance(s, A.SetOp):
                 return stmt_tables(s.left) + stmt_tables(s.right)
-            if isinstance(s, A.Select) and s.from_ is not None:
-                return tables_of(s.from_)
-            return []
+            if not isinstance(s, A.Select):
+                return []
+            out = tables_of(s.from_) if s.from_ is not None else []
+            # subqueries anywhere in expressions read tables too
+            exprs = ([i.expr for i in s.items] + [s.where, s.having]
+                     + list(s.group_by) + [o.expr for o in s.order_by])
+            for e in exprs:
+                for sub in expr_subselects(e):
+                    out.extend(stmt_tables(sub))
+            return out
 
         def check_read(s):
             for t in stmt_tables(s):
-                if t in self.catalog.views:
-                    continue  # view body checked when expanded? views grant via view name
                 if not self.catalog.has_privilege(role, t, "select"):
-                    deny("SELECT", t)
-            # views referenced directly need their own SELECT grant
-            for t in stmt_tables(s):
-                if t in self.catalog.views and \
-                        not self.catalog.has_privilege(role, t, "select"):
                     deny("SELECT", t)
 
         if isinstance(stmt, (A.Select, A.SetOp)):
@@ -1620,9 +1640,16 @@ class Cluster:
         elif isinstance(stmt, A.Update):
             if not self.catalog.has_privilege(role, stmt.table, "update"):
                 deny("UPDATE", stmt.table)
+            for _c, e in stmt.assignments:
+                for sub in expr_subselects(e):
+                    check_read(sub)
+            for sub in expr_subselects(stmt.where):
+                check_read(sub)
         elif isinstance(stmt, A.Delete):
             if not self.catalog.has_privilege(role, stmt.table, "delete"):
                 deny("DELETE", stmt.table)
+            for sub in expr_subselects(stmt.where):
+                check_read(sub)
         elif isinstance(stmt, A.Truncate):
             if not self.catalog.has_privilege(role, stmt.table, "truncate"):
                 deny("TRUNCATE", stmt.table)

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import os
 import shutil
-import time
 
 from citus_tpu.catalog import Catalog
 from citus_tpu.errors import CatalogError
@@ -111,7 +110,8 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
     if target_node not in cat.nodes:
         raise CatalogError(f"node {target_node} does not exist")
     group = _colocated_shards(cat, table, shard)
-    op_id = int(time.time() * 1000) % (1 << 62) or 1
+    import uuid
+    op_id = uuid.uuid4().int & ((1 << 62) - 1)  # collision-free across movers
     for t, s in group:
         dst = cat.shard_dir(t.name, s.shard_id, target_node)
         if not os.path.isdir(dst):

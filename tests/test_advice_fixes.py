@@ -161,3 +161,61 @@ def test_cleanup_on_failure_policy(tmp_path):
     complete_operation(cl.catalog, 8, success=False)
     assert try_drop_orphaned_resources(cl.catalog) == 1
     assert not target.exists()
+
+
+def test_concurrent_catalog_commits_merge(tmp_path):
+    """Commit does read-merge-store: concurrent coordinators' objects
+    survive each other's commits; tombstoned drops stay dropped."""
+    from citus_tpu.catalog.catalog import Catalog
+    d = str(tmp_path / "merge")
+    a = ct.Cluster(d, n_nodes=2)
+    b = ct.Cluster(d, n_nodes=2)
+    a.execute("CREATE TABLE ta (x bigint)")
+    b.catalog.create_table("tb", a.catalog.table("ta").schema)
+    b.catalog.commit()  # b commits from a stale snapshot
+    a.execute("CREATE TABLE tc (y bigint)")  # a too
+    fresh = Catalog(d)
+    assert set(fresh.tables) >= {"ta", "tb", "tc"}
+    a.execute("DROP TABLE tc")
+    assert "tc" not in Catalog(d).tables  # tombstone survives merge
+    a.close(), b.close()
+
+
+def test_sequence_blocks_disjoint_across_coordinators(tmp_path):
+    d = str(tmp_path / "seqx")
+    a = ct.Cluster(d, n_nodes=2)
+    b = ct.Cluster(d, n_nodes=2)
+    a.execute("CREATE SEQUENCE sq START 1")
+    va = [a.execute("SELECT nextval('sq')").rows[0][0] for _ in range(40)]
+    vb = [b.execute("SELECT nextval('sq')").rows[0][0] for _ in range(40)]
+    assert not (set(va) & set(vb))
+    a.close(), b.close()
+
+
+def test_privileges_cover_expression_subqueries(tmp_path):
+    """A role without SELECT on t2 cannot read it through a subquery in
+    WHERE, the select list, EXISTS, or DML predicates."""
+    from citus_tpu.errors import CatalogError
+    cl = ct.Cluster(str(tmp_path / "privsub"))
+    cl.execute("CREATE TABLE t1 (x bigint)")
+    cl.execute("CREATE TABLE t2 (secret bigint)")
+    cl.execute("INSERT INTO t1 VALUES (1)")
+    cl.execute("INSERT INTO t2 VALUES (42)")
+    cl.execute("CREATE ROLE r")
+    cl.execute("GRANT SELECT ON t1 TO r")
+    cl.execute("GRANT DELETE ON t1 TO r")
+    import pytest as _pt
+    for sql in [
+        "SELECT * FROM t1 WHERE x IN (SELECT secret FROM t2)",
+        "SELECT (SELECT max(secret) FROM t2) FROM t1",
+        "SELECT * FROM t1 WHERE EXISTS (SELECT 1 FROM t2)",
+    ]:
+        with _pt.raises(CatalogError):
+            cl.execute(sql, role="r")
+    with _pt.raises(CatalogError):
+        cl.execute("DELETE FROM t1 WHERE x = (SELECT max(secret) FROM t2)",
+                   role="r")
+    cl.execute("GRANT SELECT ON t2 TO r")
+    assert cl.execute("SELECT (SELECT max(secret) FROM t2) FROM t1",
+                      role="r").rows == [(42,)]
+    cl.close()
