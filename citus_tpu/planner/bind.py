@@ -402,7 +402,7 @@ class Binder:
         name = e.name
         if name in AGG_FUNCS:
             raise AnalysisError(f"aggregate {name}() not allowed here")
-        if name == "like":
+        if name in ("like", "ilike"):
             target = self.bind_scalar(e.args[0], allow_agg)
             pat = e.args[1]
             resolved = self._text_words(target) \
@@ -413,8 +413,12 @@ class Binder:
                     "LIKE requires a text column (or string function over "
                     "one) and a literal pattern")
             base, _t, _c, eff_words = resolved
-            rx = _like_to_regex(pat.value)
+            rx = _like_to_regex(pat.value.lower() if name == "ilike"
+                                else pat.value)
             # pattern evaluates against the TRANSFORMED word per base id
+            if name == "ilike":
+                return BDictMask(base, tuple(bool(rx.match(w.lower()))
+                                             for w in eff_words))
             return BDictMask(base, tuple(bool(rx.match(w)) for w in eff_words))
         if name == "date_trunc":
             if len(e.args) != 2 or not isinstance(e.args[0], A.Literal):

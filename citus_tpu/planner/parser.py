@@ -37,7 +37,7 @@ KEYWORDS = {
     "into", "values", "distinct", "asc", "desc", "nulls", "first", "last",
     "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "case", "when", "then", "else", "end", "cast", "explain", "analyze",
-    "using", "with", "like", "delete", "update", "set", "truncate",
+    "using", "with", "like", "ilike", "delete", "update", "set", "truncate",
     "vacuum", "copy", "alter", "add", "column", "rename", "to",
     "schema", "cascade", "merge", "matched", "nothing", "do", "over",
     "partition", "union", "intersect", "except", "all", "within",
@@ -877,7 +877,7 @@ class Parser:
             negated = False
             save = self.i
             if self.accept_kw("not"):
-                if self.at_kw("between", "in", "like"):
+                if self.at_kw("between", "in", "like", "ilike"):
                     negated = True
                 else:
                     self.i = save
@@ -903,9 +903,10 @@ class Parser:
                 self.expect_op(")")
                 left = A.InList(left, tuple(items), negated)
                 continue
-            if self.accept_kw("like"):
+            if self.at_kw("like", "ilike"):
+                fname = self.next().value
                 pattern = self.parse_additive()
-                left = A.FuncCall("like", (left, pattern))
+                left = A.FuncCall(fname, (left, pattern))
                 if negated:
                     left = A.UnOp("not", left)
                 continue

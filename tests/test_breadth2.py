@@ -283,3 +283,15 @@ def test_explain_setop_and_insert_select(tmp_path):
     # EXPLAIN must not have executed the insert
     assert cl.execute("SELECT count(*) FROM d").rows == [(0,)]
     cl.close()
+
+
+def test_ilike(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "ilike"))
+    cl.execute("CREATE TABLE t (k bigint, s text)")
+    cl.copy_from("t", rows=[(1, "Red"), (2, "GREEN"), (3, "blue"), (4, None)])
+    assert cl.execute("SELECT count(*) FROM t WHERE s ILIKE 'red'").rows == [(1,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE s ILIKE '%E%'").rows == [(3,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE s LIKE '%E%'").rows == [(1,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE trim(s) ILIKE 'BLUE'").rows \
+        == [(1,)]
+    cl.close()
