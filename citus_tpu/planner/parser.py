@@ -912,6 +912,19 @@ class Parser:
                 continue
             if self.accept_kw("is"):
                 neg = bool(self.accept_kw("not"))
+                if self.accept_kw("distinct"):
+                    self.expect_kw("from")
+                    right = self.parse_additive()
+                    # null-safe equality: never yields NULL
+                    same = A.BinOp(
+                        "or",
+                        A.BinOp("and", A.IsNull(left), A.IsNull(right)),
+                        A.BinOp("and",
+                                A.BinOp("and", A.IsNull(left, True),
+                                        A.IsNull(right, True)),
+                                A.BinOp("=", left, right)))
+                    left = same if neg else A.UnOp("not", same)
+                    continue
                 self.expect_kw("null")
                 left = A.IsNull(left, neg)
                 continue

@@ -295,3 +295,19 @@ def test_ilike(tmp_path):
     assert cl.execute("SELECT count(*) FROM t WHERE trim(s) ILIKE 'BLUE'").rows \
         == [(1,)]
     cl.close()
+
+
+def test_is_distinct_from(tmp_path):
+    """Null-safe equality: never yields NULL, NULLs compare equal."""
+    cl = ct.Cluster(str(tmp_path / "isdist"))
+    cl.execute("CREATE TABLE t (k bigint, a bigint, b bigint)")
+    cl.copy_from("t", rows=[(1, 1, 1), (2, 1, 2), (3, None, 1), (4, None, None)])
+    assert cl.execute("SELECT k FROM t WHERE a IS DISTINCT FROM b "
+                      "ORDER BY k").rows == [(2,), (3,)]
+    assert cl.execute("SELECT k FROM t WHERE a IS NOT DISTINCT FROM b "
+                      "ORDER BY k").rows == [(1,), (4,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE a IS NOT DISTINCT "
+                      "FROM NULL").rows == [(2,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE a IS DISTINCT FROM 1"
+                      ).rows == [(2,)]
+    cl.close()
