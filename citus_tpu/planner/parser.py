@@ -963,11 +963,16 @@ class Parser:
 
     def parse_case(self) -> A.Expr:
         self.expect_kw("case")
+        operand = None
         if not self.at_kw("when"):
-            raise UnsupportedFeatureError("simple CASE expr (CASE x WHEN ...) not supported")
+            # simple CASE: CASE x WHEN v THEN ... desugars to the
+            # searched form CASE WHEN x = v THEN ...
+            operand = self.parse_expr()
         whens = []
         while self.accept_kw("when"):
             cond = self.parse_expr()
+            if operand is not None:
+                cond = A.BinOp("=", operand, cond)
             self.expect_kw("then")
             whens.append((cond, self.parse_expr()))
         else_ = None

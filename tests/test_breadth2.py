@@ -311,3 +311,15 @@ def test_is_distinct_from(tmp_path):
     assert cl.execute("SELECT count(*) FROM t WHERE a IS DISTINCT FROM 1"
                       ).rows == [(2,)]
     cl.close()
+
+
+def test_simple_case_expr(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "scase"))
+    cl.execute("CREATE TABLE t (k bigint, g bigint, s text)")
+    cl.copy_from("t", rows=[(1, 0, "a"), (2, 1, "b"), (3, 2, "a"), (4, None, "c")])
+    assert cl.execute("SELECT k, CASE g WHEN 0 THEN 10 WHEN 1 THEN 20 "
+                      "ELSE 99 END FROM t ORDER BY k").rows == \
+        [(1, 10), (2, 20), (3, 99), (4, 99)]
+    assert cl.execute("SELECT sum(CASE s WHEN 'a' THEN 1 ELSE 0 END) "
+                      "FROM t").rows == [(2,)]
+    cl.close()
