@@ -64,3 +64,55 @@ def test_merge_do_nothing_and_condition(db):
     # + id 0 whose original qty was already 0
     assert cl.execute("SELECT count(*) FROM tgt WHERE qty = 0").rows == [(3,)]
     assert cl.execute("SELECT count(*) FROM tgt").rows == [(10,)]
+
+
+def test_merge_text_values_and_conditions(db):
+    cl = db
+    cl.execute("CREATE TABLE ev (id bigint NOT NULL, tag text, n bigint)")
+    cl.execute("SELECT create_distributed_table('ev', 'id', 4)")
+    cl.copy_from("ev", rows=[(1, "hot", 5), (2, "cold", 3), (3, "hot", 1)])
+    cl.execute("CREATE TABLE delta (id bigint NOT NULL, n bigint)")
+    cl.execute("SELECT create_distributed_table('delta', 'id', 4)")
+    cl.copy_from("delta", rows=[(1, 10), (3, 30), (9, 90)])
+    r = cl.execute("""
+        MERGE INTO ev e USING delta d ON e.id = d.id
+        WHEN MATCHED AND e.tag = 'hot' THEN UPDATE SET n = d.n, tag = 'warm'
+        WHEN NOT MATCHED THEN INSERT (id, tag, n) VALUES (d.id, 'fresh', d.n)""")
+    assert r.explain == {"updated": 2, "deleted": 0, "inserted": 1}
+    rows = {k: (t, n) for k, t, n in
+            cl.execute("SELECT id, tag, n FROM ev ORDER BY id").rows}
+    assert rows[1] == ("warm", 10)
+    assert rows[2] == ("cold", 3)   # condition excluded it
+    assert rows[3] == ("warm", 30)
+    assert rows[9] == ("fresh", 90)
+
+
+def test_merge_is_one_transaction(db):
+    """A fault during the merge commit leaves the target untouched or
+    fully merged — never half."""
+    from citus_tpu.testing.faults import FAULTS, FaultError
+    cl = db
+    before = cl.execute("SELECT count(*), sum(qty) FROM tgt").rows
+    FAULTS.arm("catalog_commit", error=FaultError("boom"), times=1)
+    import pytest as _pt
+    with _pt.raises(FaultError):
+        cl.execute("""
+            MERGE INTO tgt t USING src s ON t.id = s.id
+            WHEN MATCHED THEN UPDATE SET qty = 0
+            WHEN NOT MATCHED THEN INSERT (id, qty, s) VALUES (s.id, 0, 'x')""")
+    FAULTS.disarm()
+    cl.execute("SELECT recover_prepared_transactions()")
+    after = cl.execute("SELECT count(*), sum(qty) FROM tgt").rows
+    merged = [(15, sum(0 for _ in range(15)))]
+    assert after == before or (after[0][0] == 15
+                               and after[0][1] == 0), (before, after)
+
+
+def test_merge_insert_only(db):
+    cl = db
+    cl.execute("DELETE FROM tgt WHERE id >= 5")
+    r = cl.execute("""
+        MERGE INTO tgt t USING src s ON t.id = s.id
+        WHEN NOT MATCHED THEN INSERT (id, qty, s) VALUES (s.id, s.qty, 'ins')""")
+    assert r.explain == {"updated": 0, "deleted": 0, "inserted": 10}
+    assert cl.execute("SELECT count(*) FROM tgt").rows == [(15,)]
