@@ -58,7 +58,8 @@ class Result:
 def _combine_kinds(plan: PhysicalPlan) -> list[str]:
     kinds = []
     for op in plan.partial_ops:
-        kinds.append({"sum": "sum", "count": "sum", "min": "min", "max": "max"}[op.kind])
+        kinds.append({"sum": "sum", "count": "sum", "min": "min",
+                      "max": "max", "hll": "max"}[op.kind])
     if plan.group_mode.kind == "direct":
         kinds.append("sum")  # group row counts
     return kinds
@@ -138,7 +139,10 @@ def _empty_partials(plan: PhysicalPlan, xp):
     outs = []
     for op in plan.partial_ops:
         dt = np.dtype(op.dtype)
-        if op.kind in ("sum", "count"):
+        if op.kind == "hll":
+            from citus_tpu.planner.aggregates import HLL_M
+            outs.append(np.zeros((HLL_M,), np.int32))
+        elif op.kind in ("sum", "count"):
             base = np.int64(0) if op.kind == "count" else dt.type(0)
             outs.append(np.zeros((G,), dt) if G else np.asarray(base, dt))
         else:
@@ -269,7 +273,11 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         partials = (_run_partials_cpu if backend == "cpu" else _run_partials_jax)(
             cat, plan, settings, params)
         if mode == "scalar":
-            partials = tuple(np.asarray(p).reshape(1) for p in partials)
+            # one group: scalars become length-1 arrays; vector partials
+            # (HLL registers) gain a leading group axis
+            partials = tuple(
+                np.asarray(p).reshape(1) if np.asarray(p).ndim == 0
+                else np.asarray(p)[None, ...] for p in partials)
             return finalize_groups(plan, cat, [], partials, params_env=penv)
         *parts, rows = partials
         keys, occupied = _decode_direct_keys(plan, rows)
@@ -302,7 +310,7 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
 
     # distinct/collect partial states are exact value (multi)sets: only
     # the host accumulation path can carry them
-    has_exact = any(op.kind in ("distinct", "collect", "collect_set")
+    has_exact = any(op.kind in ("distinct", "collect", "collect_set", "hll")
                     for op in plan.partial_ops)
     if backend != "cpu" and not has_exact:
         import jax

@@ -36,6 +36,10 @@ class HostGroupAccumulator:
             if op.kind == "collect":
                 row.append([])
                 continue
+            if op.kind == "hll":
+                from citus_tpu.planner.aggregates import HLL_M
+                row.append(np.zeros(HLL_M, np.int32))
+                continue
             dt = np.dtype(op.dtype)
             if op.kind in ("min", "max"):
                 row.append(dt.type(_sentinel(op.kind, dt)))
@@ -96,6 +100,23 @@ class HostGroupAccumulator:
                     sets[inverse[r]].add(v[r].item())
                 local.append(sets)
                 continue
+            if op.kind == "hll":
+                from citus_tpu.planner.aggregates import (
+                    HLL_M, hll_rho_buckets,
+                )
+                v, ok = arg_np[op.arg_index]
+                v = np.asarray(v)
+                bits = v.astype(np.float64).view(np.int64) \
+                    if np.issubdtype(v.dtype, np.floating) else v.astype(np.int64)
+                bucket, rho = hll_rho_buckets(np, bits, ok)
+                regs = [np.zeros(HLL_M, np.int32) for _ in range(L)]
+                for r in np.nonzero(ok)[0]:
+                    g = inverse[r]
+                    b = bucket[r]
+                    if rho[r] > regs[g][b]:
+                        regs[g][b] = rho[r]
+                local.append(regs)
+                continue
             if op.kind == "collect":
                 v, ok = arg_np[op.arg_index]
                 lists = [[] for _ in range(L)]
@@ -138,6 +159,9 @@ class HostGroupAccumulator:
             for pi, op in enumerate(self.partial_ops):
                 if op.kind in ("distinct", "collect_set"):
                     self._accs[gi][pi] |= local[pi][li]
+                elif op.kind == "hll":
+                    np.maximum(self._accs[gi][pi], local[pi][li],
+                               out=self._accs[gi][pi])
                 elif op.kind == "collect":
                     self._accs[gi][pi].extend(local[pi][li])
                 elif op.kind in ("sum", "count"):
@@ -207,6 +231,9 @@ class HostGroupAccumulator:
                 for g in range(G):
                     a[g] = self._accs[g][pi]
                 partials.append(a)
+            elif op.kind == "hll":
+                partials.append(np.stack(
+                    [self._accs[g][pi] for g in range(G)]))
             elif op.kind == "distinct":
                 partials.append(np.array(
                     [len(self._accs[g][pi]) for g in range(G)], np.int64))

@@ -82,6 +82,23 @@ def build_worker_fn(plan: PhysicalPlan, xp) -> Callable:
                     outs.append(xp.min(xp.where(ok, v, dt.type(_sentinel("min", dt))).astype(dt)))
                 elif op.kind == "max":
                     outs.append(xp.max(xp.where(ok, v, dt.type(_sentinel("max", dt))).astype(dt)))
+                elif op.kind == "hll":
+                    # HyperLogLog registers: per-row (bucket, rho), then a
+                    # one-hot segment max into [m] — combinable across
+                    # shards with the same elementwise-max collective as
+                    # plain max partials
+                    from citus_tpu.planner.aggregates import (
+                        HLL_M, hll_rho_buckets,
+                    )
+                    v = xp.asarray(v)
+                    bits = v.astype(np.float64).view(np.int64) \
+                        if np.issubdtype(v.dtype, np.floating) \
+                        else v.astype(np.int64)
+                    bucket, rho = hll_rho_buckets(xp, bits, ok)
+                    onehot = bucket[None, :] == xp.arange(
+                        HLL_M, dtype=np.int32)[:, None]
+                    outs.append(xp.max(
+                        xp.where(onehot, rho[None, :], np.int32(0)), axis=1))
             return tuple(outs)
         return worker_scalar
 
@@ -221,7 +238,7 @@ def combine_partials_host(plan: PhysicalPlan, shard_partials: list[tuple]) -> tu
             out.append(stack.sum(axis=0))
         elif op.kind == "min":
             out.append(stack.min(axis=0))
-        elif op.kind == "max":
+        elif op.kind in ("max", "hll"):
             out.append(stack.max(axis=0))
     if has_rows:
         rows = np.stack([np.asarray(sp[n]) for sp in shard_partials]).sum(axis=0)

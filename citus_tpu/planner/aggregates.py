@@ -39,6 +39,9 @@ class AggDef:
     lower: Callable         # (spec, arg_slot, partial_slot) -> AggExtract
     finalize: Callable      # (extract, partials, cat) -> (values, valid)
     needs_exact: bool = False  # collect-based: host grouping only
+    # device partial exists only for the scalar (ungrouped) shape;
+    # grouped queries route through host grouping
+    host_grouped: bool = False
 
 
 def _as_float(e: BExpr) -> BExpr:
@@ -347,6 +350,69 @@ def _finalize_text_minmax(ex, partials, cat):
     return out, valid
 
 
+# ---------------------------------------- approximate distinct (HLL)
+
+HLL_M = 128                      # registers; error ~ 1.04/sqrt(m) ≈ 9%
+HLL_ALPHA = 0.7213 / (1 + 1.079 / HLL_M)
+
+
+def hll_rho_buckets(xp, bits, ok):
+    """int64 value bits -> (bucket [N] int32, rho [N] int32); invalid
+    rows get rho 0 (neutral under max)."""
+    h = bits.astype(np.uint64)
+    # splitmix64 finalizer
+    h = (h ^ (h >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    h = (h ^ (h >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    h = h ^ (h >> np.uint64(31))
+    bucket = (h & np.uint64(HLL_M - 1)).astype(np.int32)
+    w = h >> np.uint64(7)  # remaining 57 bits
+    # rho = leading-zero count within the 57-bit window + 1
+    lz = xp.zeros(w.shape, np.int32)
+    x = w
+    for shift in (32, 16, 8, 4, 2, 1):
+        big = (x >> np.uint64(shift)) != 0
+        lz = lz + xp.where(big, 0, shift).astype(np.int32)
+        x = xp.where(big, x >> np.uint64(shift), x)
+    lz = lz - np.int32(7)  # the window is 57 bits wide, not 64
+    rho = xp.where(w == 0, np.int32(57), lz + np.int32(1))
+    rho = xp.where(ok, rho, np.int32(0))
+    return bucket, rho
+
+
+def hll_estimate(registers: np.ndarray) -> int:
+    m = float(HLL_M)
+    M = np.asarray(registers, np.float64)
+    E = HLL_ALPHA * m * m / float(np.sum(np.power(2.0, -M)))
+    if E <= 2.5 * m:
+        V = int(np.sum(M == 0))
+        if V > 0:
+            E = m * np.log(m / V)
+    return int(round(E))
+
+
+def _bind_approx_distinct(binder, e):
+    from citus_tpu.planner.bind import AggSpec
+    if len(e.args) != 1:
+        raise AnalysisError("approx_count_distinct() expects one argument")
+    arg = binder.bind_scalar(e.args[0])
+    return AggSpec("approx_count_distinct", arg, T.INT64_T)
+
+
+def _lower_approx_distinct(spec, arg_slot, partial_slot):
+    from citus_tpu.planner.physical import AggExtract
+    ai = arg_slot(spec.arg)
+    s = partial_slot("hll", ai, "int32")
+    return AggExtract("approx_count_distinct", [s], spec.out_type)
+
+
+def _finalize_approx_distinct(ex, partials, cat):
+    regs = np.asarray(partials[ex.slots[0]])
+    if regs.ndim == 1:          # scalar query: one register vector
+        regs = regs[None, :]
+    out = np.array([hll_estimate(r) for r in regs], np.int64)
+    return out, np.ones(out.shape, bool)
+
+
 # ----------------------------------------------- DISTINCT sum/avg
 
 
@@ -408,6 +474,9 @@ for _n in ("min_text", "max_text"):
 for _n in ("sum_distinct", "avg_distinct"):
     register(AggDef(_n, None, _lower_set, _finalize_set_sum_avg,
                     needs_exact=True))
+register(AggDef("approx_count_distinct", _bind_approx_distinct,
+                _lower_approx_distinct, _finalize_approx_distinct,
+                host_grouped=True))
 
 
 def finalize_kind(kind: str):

@@ -246,6 +246,11 @@ def choose_group_mode(cat: Catalog, bound: BoundSelect, direct_limit: int) -> Gr
         return GroupMode(kind="hash_host")
     if not bound.group_keys:
         return GroupMode(kind="scalar")
+    # sketch partials whose device shape exists only ungrouped route
+    # grouped queries through host grouping
+    if any(a.kind in AGG_REGISTRY and AGG_REGISTRY[a.kind].host_grouped
+           for a in bound.aggs):
+        return GroupMode(kind="hash_host")
     bounds = column_bounds(cat, bound.table)
     domains: list[KeyDomain] = []
     for key in bound.group_keys:
