@@ -690,6 +690,9 @@ class Cluster:
         if isinstance(stmt, A.Select) and stmt.from_ is not None \
                 and _has_derived(stmt.from_):
             return self._execute_derived(stmt)
+        if isinstance(stmt, A.Select) and len(stmt.group_by) == 1 \
+                and isinstance(stmt.group_by[0], A.GroupingSetsSpec):
+            return self._execute_grouping_sets(stmt, stmt.group_by[0].sets)
         if isinstance(stmt, A.Select) and any(
                 isinstance(i.expr, A.WindowCall) for i in stmt.items):
             return self._execute_window(stmt)
@@ -1486,6 +1489,52 @@ class Cluster:
             if left is not item.left or right is not item.right:
                 return A.Join(left, right, item.kind, item.condition)
         return item
+
+    def _execute_grouping_sets(self, stmt: A.Select, sets) -> Result:
+        """ROLLUP/CUBE/GROUPING SETS: one grouped execution per set,
+        select items that are grouping expressions of an absent set pad
+        to NULL, results concatenate (reference: native grouping-set
+        execution; here composed over the standard grouped pipeline)."""
+        all_keys = set()
+        for s_ in sets:
+            all_keys.update(s_)
+        names = []
+        for i, item in enumerate(stmt.items):
+            names.append(item.alias or (item.expr.name
+                                        if isinstance(item.expr, A.ColumnRef)
+                                        else f"column{i + 1}"))
+        rows_all: list[tuple] = []
+        types_first = None
+        for s_ in sets:
+            keep_pos, sub_items = [], []
+            for i, item in enumerate(stmt.items):
+                if item.expr in all_keys and item.expr not in s_:
+                    continue  # key absent from this set: pad NULL
+                keep_pos.append(i)
+                sub_items.append(item)
+            if not sub_items:
+                raise AnalysisError(
+                    "grouping-set query needs at least one aggregate or "
+                    "grouping column in the select list")
+            sub = A.Select(sub_items, stmt.from_, stmt.where, list(s_),
+                           stmt.having)
+            r = self._execute_stmt(sub)
+            if types_first is None and not any(
+                    i not in keep_pos for i in range(len(stmt.items))):
+                types_first = r.types
+            for row in r.rows:
+                full = [None] * len(stmt.items)
+                for j, pos in enumerate(keep_pos):
+                    full[pos] = row[j]
+                rows_all.append(tuple(full))
+        rows_all = _sort_rows(rows_all, names, stmt.order_by)
+        if stmt.offset:
+            rows_all = rows_all[stmt.offset:]
+        if stmt.limit is not None:
+            rows_all = rows_all[:stmt.limit]
+        return Result(columns=names, rows=rows_all, types=types_first,
+                      explain={"strategy": "grouping_sets",
+                               "sets": len(sets)})
 
     def _execute_setop(self, stmt: A.SetOp) -> Result:
         """UNION / INTERSECT / EXCEPT [ALL]: execute both sides, combine

@@ -246,6 +246,14 @@ class Join:
 FromItem = "TableRef | Join"
 
 
+@dataclass(frozen=True)
+class GroupingSetsSpec(Expr):
+    """GROUP BY ROLLUP(...)/CUBE(...)/GROUPING SETS(...) — expands to a
+    union of per-set grouped executions with NULL padding (reference:
+    PostgreSQL executes these natively; recursive composition here)."""
+    sets: tuple = ()  # tuple[tuple[Expr, ...]]
+
+
 @dataclass
 class SelectItem:
     expr: Expr

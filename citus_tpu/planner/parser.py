@@ -352,6 +352,54 @@ class Parser:
         analyze = bool(self.accept_kw("analyze"))
         return A.Explain(self.parse_statement(), analyze=analyze)
 
+    def _maybe_grouping_sets(self):
+        """ROLLUP(e...) | CUBE(e...) | GROUPING SETS((..), (..), e) as the
+        whole GROUP BY clause -> GroupingSetsSpec, else None."""
+        t = self.peek()
+        if t.kind != "ident" or t.value not in ("rollup", "cube", "grouping"):
+            return None
+        kind = self.next().value
+        if kind == "grouping":
+            if not (self.peek().kind == "ident" and self.peek().value == "sets"):
+                self.error("expected SETS after GROUPING")
+            self.next()
+            self.expect_op("(")
+            sets = []
+            while True:
+                if self.accept_op("("):
+                    exprs = []
+                    if not self.at_op(")"):
+                        while True:
+                            exprs.append(self.parse_expr())
+                            if not self.accept_op(","):
+                                break
+                    self.expect_op(")")
+                    sets.append(tuple(exprs))
+                else:
+                    sets.append((self.parse_expr(),))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return A.GroupingSetsSpec(tuple(sets))
+        self.expect_op("(")
+        exprs = []
+        while True:
+            exprs.append(self.parse_expr())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        if kind == "rollup":
+            sets = [tuple(exprs[:i]) for i in range(len(exprs), -1, -1)]
+        else:  # cube
+            if len(exprs) > 5:
+                self.error("CUBE supports at most 5 expressions")
+            from itertools import combinations
+            sets = []
+            for r in range(len(exprs), -1, -1):
+                for combo in combinations(range(len(exprs)), r):
+                    sets.append(tuple(exprs[i] for i in combo))
+        return A.GroupingSetsSpec(tuple(sets))
+
     def _parse_frame_bound(self):
         """UNBOUNDED PRECEDING|FOLLOWING | CURRENT ROW | N PRECEDING|
         FOLLOWING -> ('preceding'|'following', n|None) with None =
@@ -786,10 +834,14 @@ class Parser:
         group_by: list[A.Expr] = []
         if self.accept_kw("group"):
             self.expect_kw("by")
-            while True:
-                group_by.append(self.parse_expr())
-                if not self.accept_op(","):
-                    break
+            spec = self._maybe_grouping_sets()
+            if spec is not None:
+                group_by = [spec]
+            else:
+                while True:
+                    group_by.append(self.parse_expr())
+                    if not self.accept_op(","):
+                        break
         having = None
         if self.accept_kw("having"):
             having = self.parse_expr()

@@ -323,3 +323,27 @@ def test_simple_case_expr(tmp_path):
     assert cl.execute("SELECT sum(CASE s WHEN 'a' THEN 1 ELSE 0 END) "
                       "FROM t").rows == [(2,)]
     cl.close()
+
+
+def test_rollup_cube_grouping_sets(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "gsets"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, a text, b bigint, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", rows=[(1, "x", 1, 10), (2, "x", 2, 20),
+                            (3, "y", 1, 30), (4, "y", 2, 40)])
+    r = cl.execute("SELECT a, b, sum(v) FROM t GROUP BY ROLLUP(a, b) "
+                   "ORDER BY a NULLS LAST, b NULLS LAST").rows
+    assert r == [("x", 1, 10), ("x", 2, 20), ("x", None, 30),
+                 ("y", 1, 30), ("y", 2, 40), ("y", None, 70),
+                 (None, None, 100)]
+    r = cl.execute("SELECT a, b, sum(v) FROM t GROUP BY CUBE(a, b) "
+                   "ORDER BY a NULLS LAST, b NULLS LAST").rows
+    assert (None, 1, 40) in r and (None, 2, 60) in r and len(r) == 9
+    r = cl.execute("SELECT a, b, count(*) FROM t GROUP BY "
+                   "GROUPING SETS((a), (b), ()) "
+                   "ORDER BY a NULLS LAST, b NULLS LAST").rows
+    assert len(r) == 5
+    assert cl.execute("SELECT a, sum(v) FROM t GROUP BY ROLLUP(a) "
+                      "ORDER BY a NULLS LAST").rows == \
+        [("x", 30), ("y", 70), (None, 100)]
+    cl.close()
