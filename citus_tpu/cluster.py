@@ -1507,8 +1507,16 @@ class Cluster:
         types_first = None
         for s_ in sets:
             keep_pos, sub_items = [], []
+            grouping_marks = {}  # position -> 0/1 constant for this set
             for i, item in enumerate(stmt.items):
-                if item.expr in all_keys and item.expr not in s_:
+                e = item.expr
+                if isinstance(e, A.FuncCall) and e.name == "grouping" \
+                        and len(e.args) == 1:
+                    # GROUPING(col): 1 when the column is rolled up
+                    # (absent from this set), 0 when grouped by
+                    grouping_marks[i] = 0 if e.args[0] in s_ else 1
+                    continue
+                if e in all_keys and e not in s_:
                     continue  # key absent from this set: pad NULL
                 keep_pos.append(i)
                 sub_items.append(item)
@@ -1526,6 +1534,8 @@ class Cluster:
                 full = [None] * len(stmt.items)
                 for j, pos in enumerate(keep_pos):
                     full[pos] = row[j]
+                for pos, mark in grouping_marks.items():
+                    full[pos] = mark
                 rows_all.append(tuple(full))
         rows_all = _sort_rows(rows_all, names, stmt.order_by)
         if stmt.offset:

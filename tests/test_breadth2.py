@@ -347,3 +347,15 @@ def test_rollup_cube_grouping_sets(tmp_path):
                       "ORDER BY a NULLS LAST").rows == \
         [("x", 30), ("y", 70), (None, 100)]
     cl.close()
+
+
+def test_grouping_function(tmp_path):
+    """GROUPING(col) distinguishes rollup totals from real NULL keys."""
+    cl = ct.Cluster(str(tmp_path / "gfn"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, a text, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", rows=[(1, "x", 10), (2, None, 20), (3, "y", 30)])
+    r = cl.execute("SELECT a, grouping(a), sum(v) FROM t GROUP BY ROLLUP(a) "
+                   "ORDER BY 2, a NULLS LAST").rows
+    assert r == [("x", 0, 10), ("y", 0, 30), (None, 0, 20), (None, 1, 60)]
+    cl.close()
