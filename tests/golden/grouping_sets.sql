@@ -1,0 +1,10 @@
+-- ROLLUP / CUBE / GROUPING SETS over a distributed table
+CREATE TABLE sales (id bigint NOT NULL, region text, product text, amount decimal(10,2));
+SELECT create_distributed_table('sales', 'id', 4);
+INSERT INTO sales VALUES (1, 'east', 'ax', 10.00), (2, 'east', 'bx', 20.00),
+  (3, 'west', 'ax', 30.00), (4, 'west', 'bx', 40.00), (5, 'east', 'ax', 5.00);
+SELECT region, product, sum(amount) FROM sales GROUP BY ROLLUP(region, product) ORDER BY region NULLS LAST, product NULLS LAST;
+SELECT region, product, count(*) FROM sales GROUP BY CUBE(region, product) ORDER BY region NULLS LAST, product NULLS LAST;
+SELECT region, product, sum(amount) FROM sales GROUP BY GROUPING SETS((region), (product)) ORDER BY region NULLS LAST, product NULLS LAST;
+SELECT region, grouping(region) AS g, sum(amount) FROM sales GROUP BY ROLLUP(region) ORDER BY g, region NULLS LAST;
+DROP TABLE sales;
