@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""VERDICT round-2 item #10: time the Pallas segment-reduction kernels
+against the XLA one-hot formulation on real hardware, at several (N, G),
+and report which should be the default.
+
+Run on the TPU (no env pinning) once the tunnel is healthy:
+    python scripts/pallas_timing.py
+Prints a table and a recommendation; results feed the use_pallas default.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    backend = devs[0].platform
+    interpret = backend != "tpu"
+    print(f"backend={backend} interpret={interpret}")
+
+    from citus_tpu.ops.pallas_kernels import segment_sum_pallas
+
+    def onehot_sum(gid, upd, G):
+        onehot = gid[None, :] == jnp.arange(G, dtype=gid.dtype)[:, None]
+        return jnp.sum(jnp.where(onehot, upd[None, :], jnp.int64(0)), axis=1)
+
+    rows = []
+    for N in (65536, 262144, 1048576):
+        for G in (8, 64, 1024, 8192):
+            rng = np.random.default_rng(1)
+            gid = rng.integers(0, G, N).astype(np.int32)
+            upd = rng.integers(0, 1000, N).astype(np.int64)
+            ones = np.ones(N, bool)
+
+            f_x = jax.jit(lambda g, u: onehot_sum(g, u, G))
+            f_p = jax.jit(lambda g, u: segment_sum_pallas(
+                g, u, jnp.ones_like(g, dtype=bool), G=G, interpret=interpret))
+
+            a = np.asarray(f_x(gid, upd))
+            b = np.asarray(f_p(gid, upd))
+            assert np.array_equal(a, b), (N, G, "mismatch")
+
+            def timeit(f):
+                f(gid, upd).block_until_ready()
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    f(gid, upd).block_until_ready()
+                return (time.perf_counter() - t0) / 5
+
+            tx, tp = timeit(f_x), timeit(f_p)
+            rows.append((N, G, tx * 1e3, tp * 1e3, tx / tp))
+            print(f"N={N:>8} G={G:>5}  onehot={tx*1e3:8.3f}ms  "
+                  f"pallas={tp*1e3:8.3f}ms  speedup={tx/tp:6.2f}x",
+                  flush=True)
+
+    wins = sum(1 for r in rows if r[4] > 1.1)
+    print(f"\npallas wins {wins}/{len(rows)} configs (>1.1x)")
+    print("recommendation:",
+          "flip use_pallas default ON" if wins > len(rows) * 0.6
+          else "keep use_pallas OFF (XLA one-hot is competitive)")
+
+
+if __name__ == "__main__":
+    main()
