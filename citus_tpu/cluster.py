@@ -143,6 +143,41 @@ def _eval_const(e):
         f"cannot evaluate {type(e).__name__} without a FROM clause")
 
 
+def _replace_exprs(e, mapping: dict):
+    """Structural replacement of whole sub-expressions (used to NULL out
+    rolled-up grouping columns inside HAVING)."""
+    if e in mapping:
+        return mapping[e]
+    if isinstance(e, A.BinOp):
+        return A.BinOp(e.op, _replace_exprs(e.left, mapping),
+                       _replace_exprs(e.right, mapping))
+    if isinstance(e, A.UnOp):
+        return A.UnOp(e.op, _replace_exprs(e.operand, mapping))
+    if isinstance(e, A.Between):
+        return A.Between(_replace_exprs(e.expr, mapping),
+                         _replace_exprs(e.lo, mapping),
+                         _replace_exprs(e.hi, mapping), e.negated)
+    if isinstance(e, A.InList):
+        return A.InList(_replace_exprs(e.expr, mapping),
+                        tuple(_replace_exprs(i, mapping) for i in e.items),
+                        e.negated)
+    if isinstance(e, A.IsNull):
+        return A.IsNull(_replace_exprs(e.expr, mapping), e.negated)
+    if isinstance(e, A.Cast):
+        return A.Cast(_replace_exprs(e.expr, mapping), e.type_name, e.type_args)
+    if isinstance(e, A.CaseExpr):
+        return A.CaseExpr(tuple((_replace_exprs(c, mapping),
+                                 _replace_exprs(v, mapping))
+                                for c, v in e.whens),
+                          _replace_exprs(e.else_, mapping)
+                          if e.else_ is not None else None)
+    if isinstance(e, A.FuncCall):
+        return A.FuncCall(e.name,
+                          tuple(_replace_exprs(a, mapping) for a in e.args),
+                          e.distinct, e.agg_order)
+    return e
+
+
 def _subst_args(e, sub: dict):
     """Replace bare ColumnRefs naming function parameters with the call
     arguments (used by SQL function inlining)."""
@@ -1520,12 +1555,28 @@ class Cluster:
                     continue  # key absent from this set: pad NULL
                 keep_pos.append(i)
                 sub_items.append(item)
+            # HAVING may reference rolled-up columns: they are NULL in
+            # this set (PostgreSQL semantics)
+            having = stmt.having
+            if having is not None:
+                absent = {k for k in all_keys if k not in s_}
+                if absent:
+                    having = _replace_exprs(
+                        having, {k: A.Literal(None, "null") for k in absent})
             if not sub_items:
-                raise AnalysisError(
-                    "grouping-set query needs at least one aggregate or "
-                    "grouping column in the select list")
+                # only grouping columns selected and this is the empty
+                # set: the grand-total group is one all-NULL row
+                probe = A.Select([A.SelectItem(
+                    A.FuncCall("count", (A.Star(),)))],
+                    stmt.from_, stmt.where, list(s_), having)
+                if self._execute_stmt(probe).rows:
+                    full = [None] * len(stmt.items)
+                    for pos, mark in grouping_marks.items():
+                        full[pos] = mark
+                    rows_all.append(tuple(full))
+                continue
             sub = A.Select(sub_items, stmt.from_, stmt.where, list(s_),
-                           stmt.having)
+                           having)
             r = self._execute_stmt(sub)
             if types_first is None and not any(
                     i not in keep_pos for i in range(len(stmt.items))):
@@ -1537,6 +1588,8 @@ class Cluster:
                 for pos, mark in grouping_marks.items():
                     full[pos] = mark
                 rows_all.append(tuple(full))
+        if stmt.distinct:
+            rows_all = list(dict.fromkeys(rows_all))
         rows_all = _sort_rows(rows_all, names, stmt.order_by)
         if stmt.offset:
             rows_all = rows_all[stmt.offset:]
@@ -2093,6 +2146,20 @@ class Cluster:
         if not isinstance(stmt.statement, A.Select):
             raise UnsupportedFeatureError(
                 "EXPLAIN supports SELECT, set operations, and INSERT..SELECT")
+        sel = stmt.statement
+        if len(sel.group_by) == 1 and isinstance(sel.group_by[0],
+                                                 A.GroupingSetsSpec):
+            spec = sel.group_by[0]
+            full = max(spec.sets, key=len)
+            lines = [f"Grouping Sets: {len(spec.sets)} grouped executions"]
+            inner = A.Select(
+                [i for i in sel.items
+                 if not (isinstance(i.expr, A.FuncCall)
+                         and i.expr.name == "grouping")],
+                sel.from_, sel.where, list(full))
+            sub = self._execute_explain(A.Explain(inner, analyze=stmt.analyze))
+            lines.extend("  " + row[0] for row in sub.rows)
+            return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
         if isinstance(stmt.statement.from_, A.Join):
             return self._explain_join(stmt)
         bound = bind_select(self.catalog, stmt.statement)

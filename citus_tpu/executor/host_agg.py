@@ -109,13 +109,13 @@ class HostGroupAccumulator:
                 bits = v.astype(np.float64).view(np.int64) \
                     if np.issubdtype(v.dtype, np.floating) else v.astype(np.int64)
                 bucket, rho = hll_rho_buckets(np, bits, ok)
-                regs = [np.zeros(HLL_M, np.int32) for _ in range(L)]
-                for r in np.nonzero(ok)[0]:
-                    g = inverse[r]
-                    b = bucket[r]
-                    if rho[r] > regs[g][b]:
-                        regs[g][b] = rho[r]
-                local.append(regs)
+                flat = np.zeros(L * HLL_M, np.int32)
+                nz = np.nonzero(ok)[0]
+                if nz.size:
+                    idx = inverse[nz].astype(np.int64) * HLL_M + bucket[nz]
+                    np.maximum.at(flat, idx, rho[nz])
+                local.append([flat[g * HLL_M:(g + 1) * HLL_M]
+                              for g in range(L)])
                 continue
             if op.kind == "collect":
                 v, ok = arg_np[op.arg_index]

@@ -359,3 +359,23 @@ def test_grouping_function(tmp_path):
                    "ORDER BY 2, a NULLS LAST").rows
     assert r == [("x", 0, 10), ("y", 0, 30), (None, 0, 20), (None, 1, 60)]
     cl.close()
+
+
+def test_grouping_sets_edge_semantics(tmp_path):
+    """DISTINCT dedup across sets, HAVING over rolled-up columns (NULL
+    in absent sets), keys-only select lists (grand-total row), EXPLAIN."""
+    cl = ct.Cluster(str(tmp_path / "gedge"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, g bigint, h bigint, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", rows=[(1, 1, 1, 10), (2, 1, 2, 20), (3, 2, 1, 30)])
+    assert cl.execute("SELECT DISTINCT g, sum(v) FROM t GROUP BY "
+                      "GROUPING SETS((g),(g)) ORDER BY g").rows == \
+        [(1, 30), (2, 30)]
+    r = cl.execute("SELECT g, h, sum(v) FROM t GROUP BY ROLLUP(g, h) "
+                   "HAVING g > 0 ORDER BY g, h NULLS LAST").rows
+    assert (None, None, 60) not in r and (1, None, 30) in r
+    assert cl.execute("SELECT g FROM t GROUP BY ROLLUP(g) "
+                      "ORDER BY g NULLS LAST").rows == [(1,), (2,), (None,)]
+    ex = cl.execute("EXPLAIN SELECT g, count(*) FROM t GROUP BY ROLLUP(g)").rows
+    assert any("Grouping Sets" in x[0] for x in ex)
+    cl.close()
