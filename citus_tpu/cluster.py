@@ -143,6 +143,24 @@ def _eval_const(e):
         f"cannot evaluate {type(e).__name__} without a FROM clause")
 
 
+def _expand_returning_items(t, items, subst=None):
+    """Expand a RETURNING list to [(expr, output name)]: * becomes the
+    table's columns; substitutions (UPDATE assignments, INSERT row
+    values) apply after expansion."""
+    expanded = []
+    for it in items:
+        if isinstance(it.expr, A.Star):
+            for n in t.schema.names:
+                e = A.ColumnRef(n)
+                if subst:
+                    e = _replace_exprs(e, subst)
+                expanded.append((e, n))
+        else:
+            e = _replace_exprs(it.expr, subst) if subst else it.expr
+            expanded.append((e, it.alias or str(it.expr)))
+    return expanded
+
+
 def _replace_exprs(e, mapping: dict):
     """Structural replacement of whole sub-expressions (used to NULL out
     rolled-up grouping columns inside HAVING)."""
@@ -939,12 +957,20 @@ class Cluster:
                 if stmt.where is not None else None
             from citus_tpu.transaction.locks import EXCLUSIVE
             with self._write_lock(t, EXCLUSIVE):
+                # RETURNING reads the pre-image under the same lock so
+                # the rows returned are exactly the rows deleted
+                ret = self._returning_result(stmt.table, stmt.where,
+                                             stmt.returning) \
+                    if stmt.returning else None
                 t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
                 n = execute_delete(self.catalog, self.txlog, t, where)
             self._plan_cache.clear()
             if self.cdc.enabled and n:
                 self.cdc.emit(t.name, "delete", self.clock.transaction_clock(),
                               count=n)
+            if ret is not None:
+                ret.explain["deleted"] = n
+                return ret
             return Result(columns=[], rows=[], explain={"deleted": n})
         if isinstance(stmt, A.Update):
             from citus_tpu.executor.dml import execute_update
@@ -972,12 +998,25 @@ class Cluster:
             where = b.bind_scalar(stmt.where) if stmt.where is not None else None
             from citus_tpu.transaction.locks import EXCLUSIVE
             with self._write_lock(t, EXCLUSIVE):
+                ret = None
+                if stmt.returning:
+                    # new values = assignments substituted into the items,
+                    # evaluated over the pre-image under the same lock
+                    subst = {}
+                    for col, e in stmt.assignments:
+                        subst[A.ColumnRef(col)] = e
+                        subst[A.ColumnRef(col, stmt.table)] = e
+                    ret = self._returning_result(stmt.table, stmt.where,
+                                                 stmt.returning, subst)
                 t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
                 n = execute_update(self.catalog, self.txlog, t, assignments, where)
             self._plan_cache.clear()
             if self.cdc.enabled and n:
                 self.cdc.emit(t.name, "update", self.clock.transaction_clock(),
                               count=n)
+            if ret is not None:
+                ret.explain["updated"] = n
+                return ret
             return Result(columns=[], rows=[], explain={"updated": n})
         if isinstance(stmt, A.AlterTable):
             if stmt.action == "add_column":
@@ -1038,9 +1077,49 @@ class Cluster:
             return self._execute_explain(stmt)
         raise UnsupportedFeatureError(f"cannot execute {type(stmt).__name__}")
 
+    def _returning_result(self, table_name, where, items, subst=None):
+        """Evaluate a RETURNING clause as a distributed SELECT over the
+        affected rows (pre-image WHERE); for UPDATE, assignment
+        expressions are substituted into the items so the NEW values are
+        returned (reference: adaptive_executor.c DML RETURNING tuples)."""
+        t = self.catalog.table(table_name)
+        expanded = _expand_returning_items(t, items, subst)
+        # constant items (e.g. SET c = 'z' substituted into RETURNING c)
+        # cannot ride the distributed select: fold them on the host and
+        # splice one copy per affected row
+        consts, sel_items = {}, []
+        for idx, (e, alias) in enumerate(expanded):
+            try:
+                consts[idx] = _eval_const(e)
+            except Exception:
+                sel_items.append((idx, A.SelectItem(e, alias)))
+        if sel_items:
+            inner = self._execute_stmt(A.Select(
+                [si for _, si in sel_items], A.TableRef(table_name), where))
+            nrows, inner_rows = len(inner.rows), inner.rows
+        else:
+            cnt = A.Select([A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+                           A.TableRef(table_name), where)
+            nrows = int(self._execute_stmt(cnt).rows[0][0] or 0)
+            inner_rows = [()] * nrows
+        rows = []
+        for r in inner_rows:
+            full, j = [None] * len(expanded), 0
+            for idx in range(len(expanded)):
+                if idx in consts:
+                    full[idx] = consts[idx]
+                else:
+                    full[idx] = r[j]
+                    j += 1
+            rows.append(tuple(full))
+        return Result(columns=[a for _, a in expanded], rows=rows)
+
     def _execute_insert(self, stmt: A.Insert) -> Result:
         t = self.catalog.table(stmt.table)
         if stmt.select is not None:
+            if stmt.returning:
+                raise UnsupportedFeatureError(
+                    "RETURNING on INSERT..SELECT is not supported")
             names = stmt.columns or t.schema.names
             res = self._insert_select_arrays(t, stmt.select, list(names))
             if res is None:
@@ -1074,6 +1153,29 @@ class Cluster:
                 row.append(e.value)
             rows.append(row)
         n = self.copy_from(stmt.table, rows=rows, column_names=stmt.columns)
+        if stmt.returning:
+            names = list(stmt.columns or t.schema.names)
+            out_rows = []
+            for row in rows:
+                m = {}
+                for cn, v in zip(names, row):
+                    typ = t.schema.column(cn).type
+                    if v is not None and not typ.is_text:
+                        # what a subsequent SELECT would read back
+                        v = typ.from_physical(typ.to_physical(v))
+                    lit = A.Literal(v, "null" if v is None else
+                                    "string" if isinstance(v, str) else "int")
+                    m[A.ColumnRef(cn)] = lit
+                    m[A.ColumnRef(cn, stmt.table)] = lit
+                for cn in t.schema.names:
+                    m.setdefault(A.ColumnRef(cn), A.Literal(None, "null"))
+                    m.setdefault(A.ColumnRef(cn, stmt.table),
+                                 A.Literal(None, "null"))
+                exp = _expand_returning_items(t, stmt.returning, m)
+                out_rows.append(tuple(_eval_const(e) for e, _ in exp))
+            cols = [a for _, a in _expand_returning_items(t, stmt.returning)]
+            return Result(columns=cols, rows=out_rows,
+                          explain={"inserted": n})
         return Result(columns=[], rows=[], explain={"inserted": n})
 
     def _insert_select_arrays(self, target, sel: A.Select,

@@ -210,6 +210,7 @@ class Insert(Statement):
     columns: Optional[list[str]]
     rows: list[list[Expr]]
     select: Optional["Select"] = None  # INSERT ... SELECT
+    returning: Optional[list] = None   # [SelectItem] | None
 
 
 @dataclass
@@ -415,6 +416,7 @@ class CopyTo(Statement):
 class Delete(Statement):
     table: str
     where: Optional[Expr] = None
+    returning: Optional[list] = None   # [SelectItem] | None
 
 
 @dataclass
@@ -422,6 +424,7 @@ class Update(Statement):
     table: str
     assignments: list[tuple[str, Expr]] = field(default_factory=list)
     where: Optional[Expr] = None
+    returning: Optional[list] = None   # [SelectItem] | None
 
 
 @dataclass

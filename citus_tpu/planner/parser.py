@@ -42,7 +42,7 @@ KEYWORDS = {
     "schema", "cascade", "merge", "matched", "nothing", "do", "over",
     "partition", "union", "intersect", "except", "all", "within",
     "rows", "range", "unbounded", "preceding", "following", "current", "row",
-    "grant", "revoke",
+    "grant", "revoke", "returning",
 }
 
 
@@ -158,7 +158,7 @@ class Parser:
             self.expect_kw("from")
             name = self.parse_table_name()
             where = self.parse_expr() if self.accept_kw("where") else None
-            return A.Delete(name, where)
+            return A.Delete(name, where, self._parse_returning())
         if self.at_kw("update"):
             self.next()
             name = self.parse_table_name()
@@ -171,7 +171,8 @@ class Parser:
                 if not self.accept_op(","):
                     break
             where = self.parse_expr() if self.accept_kw("where") else None
-            return A.Update(name, assignments, where)
+            return A.Update(name, assignments, where,
+                            self._parse_returning())
         if self.at_kw("truncate"):
             self.next()
             self.accept_kw("table")
@@ -646,7 +647,9 @@ class Parser:
                     break
             self.expect_op(")")
         if self.at_kw("select"):
-            return A.Insert(name, cols, [], select=self.parse_select())
+            sel = self.parse_select()
+            return A.Insert(name, cols, [], select=sel,
+                            returning=self._parse_returning())
         self.expect_kw("values")
         rows = []
         while True:
@@ -660,7 +663,32 @@ class Parser:
             rows.append(row)
             if not self.accept_op(","):
                 break
-        return A.Insert(name, cols, rows)
+        return A.Insert(name, cols, rows,
+                        returning=self._parse_returning())
+
+    def _parse_returning(self):
+        """RETURNING expr [AS alias] [, ...] on INSERT/UPDATE/DELETE —
+        reference: RETURNING support in the adaptive executor's DML path
+        (distributed/executor/adaptive_executor.c returns tuples from
+        worker DML)."""
+        if not self.accept_kw("returning"):
+            return None
+        items = []
+        while True:
+            if self.at_op("*"):
+                self.next()
+                items.append(A.SelectItem(A.Star()))
+            else:
+                e = self.parse_expr()
+                alias = None
+                if self.accept_kw("as"):
+                    alias = self.expect_ident()
+                elif self.peek().kind == "ident":
+                    alias = self.expect_ident()
+                items.append(A.SelectItem(e, alias))
+            if not self.accept_op(","):
+                break
+        return items
 
     # -- SELECT ----------------------------------------------------------
     _UTILITY_FNS = {

@@ -145,15 +145,16 @@ def rewrite_params(stmt, params):
                                   o.nulls_first) for o in stmt.order_by],
             limit=stmt.limit, offset=stmt.offset, distinct=stmt.distinct)
     if isinstance(stmt, A.Delete):
-        return A.Delete(stmt.table, bind_params(stmt.where, params))
+        return A.Delete(stmt.table, bind_params(stmt.where, params),
+                        stmt.returning)
     if isinstance(stmt, A.Update):
         return A.Update(stmt.table,
                         [(c, bind_params(e, params)) for c, e in stmt.assignments],
-                        bind_params(stmt.where, params))
+                        bind_params(stmt.where, params), stmt.returning)
     if isinstance(stmt, A.Insert) and stmt.rows:
         return A.Insert(stmt.table, stmt.columns,
                         [[bind_params(e, params) for e in row] for row in stmt.rows],
-                        stmt.select)
+                        stmt.select, stmt.returning)
     return stmt
 
 
