@@ -58,10 +58,6 @@ class ExecutorSettings:
     batch_row_buckets: bool = True
     # Smallest padded batch (rows) a kernel will ever see.
     min_batch_rows: int = 8192
-    # Use hand-written Pallas kernels for the segment reductions instead
-    # of the XLA one-hot formulation (off by default; both are exact and
-    # tested to agree).
-    use_pallas: bool = False
     # Seconds a writer waits for a shard/colocation write lock before
     # erroring (analog of lock_timeout; deadlocks are detected and
     # cancelled immediately regardless).

@@ -113,18 +113,8 @@ def build_worker_fn(plan: PhysicalPlan, xp) -> Callable:
         # G<=64; the [G, N] product is tiled by XLA, never materialized).
         # Above the threshold, fall back to scatter.
         use_onehot = xp.__name__ != "numpy" and G <= 8192
-        from citus_tpu.config import current_settings
-        use_pallas = (xp.__name__ != "numpy" and use_onehot
-                      and current_settings().executor.use_pallas)
 
         def seg_sum(gid, upd, dt):
-            if use_pallas:
-                from citus_tpu.ops.pallas_kernels import segment_sum_pallas
-                import jax
-                interp = jax.default_backend() != "tpu"
-                return segment_sum_pallas(gid, upd.astype(dt),
-                                          xp.ones_like(gid, dtype=bool), G=G,
-                                          interpret=interp)
             if use_onehot:
                 onehot = gid[None, :] == xp.arange(G, dtype=gid.dtype)[:, None]
                 return xp.sum(xp.where(onehot, upd[None, :], dt.type(0)), axis=1)
@@ -134,13 +124,6 @@ def build_worker_fn(plan: PhysicalPlan, xp) -> Callable:
 
         def seg_minmax(gid, upd, dt, kind):
             sent = dt.type(_sentinel(kind, dt))
-            if use_pallas:
-                from citus_tpu.ops.pallas_kernels import segment_minmax_pallas
-                import jax
-                interp = jax.default_backend() != "tpu"
-                return segment_minmax_pallas(gid, upd.astype(dt),
-                                             xp.ones_like(gid, dtype=bool),
-                                             G=G, kind=kind, interpret=interp)
             if use_onehot:
                 onehot = gid[None, :] == xp.arange(G, dtype=gid.dtype)[:, None]
                 red = xp.min if kind == "min" else xp.max
