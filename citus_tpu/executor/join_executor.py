@@ -208,6 +208,146 @@ def _host_shuffle(frame, target: np.ndarray, n_buckets: int) -> list:
 
 
 _SHUFFLE_CACHE: dict = {}
+_JOIN_CACHE: dict = {}
+
+# Per-device join output capacity above which the device join falls back
+# to the host bucket path (a many-to-many explosion would not fit HBM).
+MAX_DEVICE_JOIN_CAP = 1 << 22
+
+
+def _get_mesh(settings: Settings):
+    """The multi-device mesh, or None (single device / cpu oracle)."""
+    if settings.executor.task_executor_backend == "cpu":
+        return None
+    import jax
+    if len(jax.devices()) <= 1:
+        return None
+    from citus_tpu.parallel.mesh import default_mesh
+    return default_mesh()
+
+
+def _stack_side(frame, gid, tgt, mask, n_dev):
+    """Split one relation's rows across source devices: frame columns
+    (values + validity as bool columns), gids, targets, masks all become
+    [n_dev, per] stacks; returns the per-(src,dst) max count for the
+    exchange capacity."""
+    names = list(frame.keys())
+    n = len(gid)
+    per = -(-max(n, 1) // n_dev)
+    pad = per * n_dev - n
+
+    def stack(a, fill):
+        a = np.concatenate([a, np.full(pad, fill, a.dtype)]) if pad else a
+        return a.reshape(n_dev, per)
+
+    values = []
+    for k in names:
+        v, m = frame[k]
+        values.append(stack(np.asarray(v), 0))
+        values.append(stack(np.asarray(m) if not isinstance(m, bool)
+                            else np.full(n, m), False))
+    gid2 = stack(gid.astype(np.int64), 0)
+    tgt2 = stack(tgt, 0)
+    mask2 = stack(mask, False)
+    cap = 1
+    for s in range(n_dev):
+        row = tgt2[s][mask2[s]]
+        if row.size:
+            cap = max(cap, int(np.bincount(row, minlength=n_dev).max()))
+    cap = 1 << (cap - 1).bit_length()
+    return names, tuple(values), gid2, tgt2, mask2, cap
+
+
+def _empty_joined_frame(lframe, rframe):
+    out = {}
+    for src in (lframe, rframe):
+        for k, (v, m) in src.items():
+            out[k] = (np.asarray(v)[:0],
+                      np.zeros(0, bool))
+    return out, 0
+
+
+def _device_join_step(cur, n, right, rn, step, mesh):
+    """Inner equi-join of two frames entirely on the mesh: host assigns
+    dense join-group ids (exact np.unique over both sides' key tuples —
+    no hash-collision concerns), routes gid % n_dev, and one jitted
+    collective packs, all_to_all-exchanges both sides, and sort-joins
+    per device (parallel/shuffle.py build_repartition_join).  The host
+    sees one fetch of the joined columns.  Output capacity is computed
+    exactly from per-gid count products, so the kernel never retries.
+
+    Returns (frame, n) or None when unsupported (non-inner, no keys, or
+    a many-to-many output too large for a static device buffer)."""
+    if step.kind != "inner" or not step.left_keys:
+        return None
+    lmat, lvalid = _key_matrix(cur, step.left_keys, n)
+    rmat, rvalid = _key_matrix(right, step.right_keys, rn)
+    nl_v, nr_v = int(lvalid.sum()), int(rvalid.sum())
+    if nl_v == 0 or nr_v == 0:
+        return _apply_residual(step, *_empty_joined_frame(cur, right))
+    both = np.concatenate([lmat[lvalid], rmat[rvalid]], axis=0)
+    _, inv = np.unique(both, axis=0, return_inverse=True)
+    U = int(inv.max()) + 1
+    n_dev = mesh.shape["shard"]
+    lc = np.bincount(inv[:nl_v], minlength=U)
+    rc = np.bincount(inv[nl_v:], minlength=U)
+    bucket_pairs = np.zeros(n_dev, np.int64)
+    np.add.at(bucket_pairs, np.arange(U, dtype=np.int64) % n_dev, lc * rc)
+    max_pairs = int(bucket_pairs.max())
+    if max_pairs == 0:
+        return _apply_residual(step, *_empty_joined_frame(cur, right))
+    J = 1 << (max_pairs - 1).bit_length()
+    if J > MAX_DEVICE_JOIN_CAP:
+        return None
+    lgid = np.zeros(n, np.int64)
+    lgid[lvalid] = inv[:nl_v]
+    rgid = np.zeros(rn, np.int64)
+    rgid[rvalid] = inv[nl_v:]
+    lnames, lv, lgid2, ltgt2, lmask2, cap_l = _stack_side(
+        cur, lgid, (lgid % n_dev).astype(np.int32), np.asarray(lvalid), n_dev)
+    rnames, rv, rgid2, rtgt2, rmask2, cap_r = _stack_side(
+        right, rgid, (rgid % n_dev).astype(np.int32), np.asarray(rvalid), n_dev)
+    key = (n_dev, len(lv), len(rv), cap_l, cap_r, J)
+    fn = _JOIN_CACHE.get(key)
+    if fn is None:
+        from citus_tpu.parallel.shuffle import build_repartition_join
+        fn = build_repartition_join(mesh, n_lcols=len(lv), n_rcols=len(rv),
+                                    capacity_l=cap_l, capacity_r=cap_r,
+                                    join_cap=J)
+        _JOIN_CACHE[key] = fn
+    out_l, out_r, out_valid, overflow = fn(lv, lgid2, ltgt2, lmask2,
+                                           rv, rgid2, rtgt2, rmask2)
+    if int(overflow) != 0:
+        # capacities are computed exactly host-side; a nonzero overflow
+        # means lost rows — refuse to return a wrong answer
+        raise ExecutionError("device join capacity undersized "
+                             f"(overflow={int(overflow)})")
+    out_valid = np.asarray(out_valid)
+    frame = {}
+    sels = [out_valid[d] for d in range(n_dev)]
+    total = int(out_valid.sum())
+    for names, outs in ((lnames, out_l), (rnames, out_r)):
+        for i, k in enumerate(names):
+            vals = np.asarray(outs[2 * i])
+            ms = np.asarray(outs[2 * i + 1])
+            frame[k] = (np.concatenate([vals[d][sels[d]] for d in range(n_dev)]),
+                        np.concatenate([ms[d][sels[d]] for d in range(n_dev)]))
+    return _apply_residual(step, frame, total)
+
+
+def _apply_residual(step, cur, n):
+    """Post-join residual filter (host) — shared by the device-join and
+    host-join paths."""
+    if step.residual is None or n == 0:
+        return cur, n
+    fn = compile_expr(step.residual, np)
+    mask = np.asarray(predicate_mask(np, fn, cur, np.ones(n, bool)))
+    if mask.shape == ():
+        mask = np.full(n, bool(mask))
+    keep = np.nonzero(mask)[0]
+    cur = {k: (v[keep], m[keep] if not isinstance(m, bool) else m)
+           for k, (v, m) in cur.items()}
+    return cur, keep.size
 
 
 def _device_shuffle(frame, target: np.ndarray, mesh) -> list:
@@ -250,7 +390,9 @@ def _device_shuffle(frame, target: np.ndarray, mesh) -> list:
         fn = build_repartition(mesh, n_cols=len(values), capacity=cap)
         _SHUFFLE_CACHE[key] = fn
     out_vals, out_valid, overflow = fn(tuple(values), tgt2, mask2)
-    assert int(overflow) == 0, "repartition capacity undersized"
+    if int(overflow) != 0:
+        raise ExecutionError("repartition capacity undersized "
+                             f"(overflow={int(overflow)})")
     out_vals = [np.asarray(v) for v in out_vals]
     out_valid = np.asarray(out_valid)
     buckets = []
@@ -271,13 +413,7 @@ def _repartition_tasks(cat: Catalog, bj: BoundJoinSelect, settings: Settings):
     qualified = bj.binder.qualified
     lframe, ln = _load_rel_frame(cat, bj.rel_plans[la], qualified)
     rframe, rn = _load_rel_frame(cat, bj.rel_plans[ra], qualified)
-    use_device = settings.executor.task_executor_backend != "cpu"
-    mesh = None
-    if use_device:
-        import jax
-        if len(jax.devices()) > 1:
-            from citus_tpu.parallel.mesh import default_mesh
-            mesh = default_mesh()
+    mesh = _get_mesh(settings)
     B = (mesh.shape["shard"] if mesh is not None
          else settings.planner.repartition_bucket_count_per_device * 8)
     ltgt = _bucket_targets(lframe, lks, ln, B)
@@ -337,18 +473,7 @@ def _apply_step(cur, n, right, rn, step):
         li, ri, lfound, rfound = _hash_join_indexes(lmat, lvalid, rmat, rvalid, step.kind)
     new = _gather(cur, li, lfound if step.kind in ("right", "full") else None)
     new.update(_gather(right, ri, rfound if step.kind in ("left", "full", "inner", "cross") else None))
-    n = len(li)
-    cur = new
-    if step.residual is not None:
-        fn = compile_expr(step.residual, np)
-        mask = np.asarray(predicate_mask(np, fn, cur, np.ones(n, bool)))
-        if mask.shape == ():
-            mask = np.full(n, bool(mask))
-        keep = np.nonzero(mask)[0]
-        cur = {k: (v[keep], m[keep] if not isinstance(m, bool) else m)
-               for k, (v, m) in cur.items()}
-        n = keep.size
-    return cur, n
+    return _apply_residual(step, new, len(li))
 
 
 def _concat_frames(pieces):
@@ -382,21 +507,23 @@ def _stepwise_shuffle_join(cat: Catalog, bj: BoundJoinSelect,
     qualified = bj.binder.qualified
     frames = {alias: _load_rel_frame(cat, bj.rel_plans[alias], qualified)
               for alias, _t in bj.rels}
-    use_device = settings.executor.task_executor_backend != "cpu"
-    mesh = None
-    if use_device:
-        import jax
-        if len(jax.devices()) > 1:
-            from citus_tpu.parallel.mesh import default_mesh
-            mesh = default_mesh()
+    mesh = _get_mesh(settings)
     B = (mesh.shape["shard"] if mesh is not None
          else settings.planner.repartition_bucket_count_per_device * 8)
     mode = "all_to_all" if mesh is not None else "host"
     cur, n = frames[bj.rels[0][0]]
     shuffles = 0
+    device_joins = 0
     for step in bj.steps:
         right, rn = frames[step.right_alias]
         if step.left_keys and (n + rn) > 0:
+            if mesh is not None:
+                dj = _device_join_step(cur, n, right, rn, step, mesh)
+                if dj is not None:
+                    cur, n = dj
+                    shuffles += 1
+                    device_joins += 1
+                    continue
             ltgt = _bucket_targets(cur, step.left_keys, n, B)
             rtgt = _bucket_targets(right, step.right_keys, rn, B)
             if mesh is not None and cur and right:
@@ -413,6 +540,8 @@ def _stepwise_shuffle_join(cat: Catalog, bj: BoundJoinSelect,
             cur, n = _concat_frames(pieces)
         else:
             cur, n = _apply_step(cur, n, right, rn, step)
+    if device_joins:
+        mode = f"all_to_all+{device_joins}-devjoin"
     return cur, n, mode, shuffles
 
 
@@ -457,10 +586,14 @@ def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -
         dist = [t for _, t in bj.rels if t.is_distributed]
         tasks = ([(si, None) for si in range(dist[0].shard_count)]
                  if dist else [(None, None)])
-    elif strategy == "repartition" and bj.repartition_spec is not None:
+    elif (strategy == "repartition" and bj.repartition_spec is not None
+          and _get_mesh(settings) is None):
+        # single-repartition with host buckets (cpu oracle / one device)
         overrides, shuffle_mode = _repartition_tasks(cat, bj, settings)
         tasks = [(None, fo) for fo in overrides]
     elif strategy == "repartition":
+        # on a mesh the step-wise path joins each equi step on device
+        # (all_to_all exchange + per-device sort join, one host fetch)
         frame_n = _stepwise_shuffle_join(cat, bj, settings)
         shuffle_mode = f"{frame_n[2]}:{frame_n[3]}-step"
         tasks = [(None, {"__result__": (frame_n[0], frame_n[1])})]

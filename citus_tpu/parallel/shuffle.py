@@ -92,6 +92,91 @@ def build_repartition(mesh: Mesh, n_cols: int, capacity: int):
     return jax.jit(fn)
 
 
+def _sorted_join_indexes(lgid, lvalid, rgid, rvalid, join_cap: int):
+    """Per-device inner equi-join on dense group ids -> (left_idx,
+    right_idx, out_valid, n_pairs).  Sort-based: left sorts by gid,
+    each right row binary-searches its run; output slot j maps back to
+    its (right row, offset) pair via a searchsorted over run ends.
+    Static output size ``join_cap``; the caller sizes it exactly from
+    host-side per-gid counts, so overflow is an invariant violation,
+    not a retry path."""
+    L = lgid.shape[0]
+    R = rgid.shape[0]
+    big = jnp.iinfo(lgid.dtype).max
+    lkey = jnp.where(lvalid, lgid, big)     # gids are dense >= 0: big is free
+    order = jnp.argsort(lkey)
+    skey = lkey[order]
+    lo = jnp.searchsorted(skey, rgid, side="left")
+    hi = jnp.searchsorted(skey, rgid, side="right")
+    cnt = jnp.where(rvalid, hi - lo, 0)
+    ends = jnp.cumsum(cnt)
+    total = ends[-1] if R else jnp.zeros((), cnt.dtype)
+    start = ends - cnt
+    j = jnp.arange(join_cap)
+    # first right row whose run end exceeds j (skips cnt==0 rows)
+    r_idx = jnp.searchsorted(ends, j, side="right").clip(0, max(R - 1, 0))
+    off = j - start[r_idx]
+    l_idx = order[(lo[r_idx] + off).clip(0, max(L - 1, 0))]
+    out_valid = j < total
+    return l_idx, r_idx, out_valid, total
+
+
+def build_repartition_join(mesh: Mesh, n_lcols: int, n_rcols: int,
+                           capacity_l: int, capacity_r: int, join_cap: int):
+    """Compile a fused shuffle+join over ``mesh``: both relations
+    all_to_all-exchange by join-key bucket, then each device joins its
+    bucket with a sort/searchsorted inner join — the map-merge *and* the
+    merge-side hash join of the reference's MapMergeJob pipeline
+    (multi_physical_planner.h:160), entirely on the mesh; the host sees
+    one fetch of the joined columns.
+
+    Inputs (stacked over devices): left values tuple of [n_dev, Nl]
+    (column streams incl. validity as bool columns), lgid [n_dev, Nl]
+    int64 dense join-group ids, ltgt/lmask likewise; same for the right
+    side.  Output: left columns gathered to [n_dev, join_cap], right
+    columns likewise, out_valid [n_dev, join_cap], overflow scalar
+    (must be 0 when join_cap is sized exactly)."""
+    n_dev = mesh.shape[SHARD_AXIS]
+
+    def per_device(lvals, lgid, ltgt, lmask, rvals, rgid, rtgt, rmask):
+        lvals = tuple(v[0] for v in lvals)
+        rvals = tuple(v[0] for v in rvals)
+        lgid, ltgt, lmask = lgid[0], ltgt[0], lmask[0]
+        rgid, rtgt, rmask = rgid[0], rtgt[0], rmask[0]
+
+        def exchange(values, gid, tgt, mask, capacity):
+            packed, pvalid, overflow = _pack_blocks(
+                (gid,) + values, tgt, mask, n_dev, capacity)
+            outs = tuple(
+                jax.lax.all_to_all(v, SHARD_AXIS, split_axis=0, concat_axis=0)
+                for v in packed)
+            ovalid = jax.lax.all_to_all(pvalid, SHARD_AXIS,
+                                        split_axis=0, concat_axis=0)
+            flat = tuple(v.reshape(-1) for v in outs)
+            return flat[0], flat[1:], ovalid.reshape(-1), overflow
+
+        lgid_x, lcols_x, lvalid_x, lov = exchange(lvals, lgid, ltgt, lmask,
+                                                  capacity_l)
+        rgid_x, rcols_x, rvalid_x, rov = exchange(rvals, rgid, rtgt, rmask,
+                                                  capacity_r)
+        li, ri, ovalid, total = _sorted_join_indexes(
+            lgid_x, lvalid_x, rgid_x, rvalid_x, join_cap)
+        out_l = tuple(v[li] for v in lcols_x)
+        out_r = tuple(v[ri] for v in rcols_x)
+        join_overflow = jnp.maximum(total - join_cap, 0)
+        overflow = jax.lax.psum(lov + rov + join_overflow, SHARD_AXIS)
+        return (tuple(v[None] for v in out_l), tuple(v[None] for v in out_r),
+                ovalid[None], overflow)
+
+    cols = lambda k: tuple(P(SHARD_AXIS) for _ in range(k))
+    in_specs = (cols(n_lcols), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                cols(n_rcols), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+    out_specs = (cols(n_lcols), cols(n_rcols), P(SHARD_AXIS), P())
+    fn = jax.shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    return jax.jit(fn)
+
+
 def repartition_host(values: tuple, target: np.ndarray, mask: np.ndarray,
                      n_buckets: int):
     """Host reference implementation (oracle + fallback): returns per-
