@@ -204,6 +204,12 @@ class Catalog:
             return
         with open(p) as fh:
             d = json.load(fh)
+        self.load_document(d)
+        self._doc_sig = _stat_sig(p)
+
+    def load_document(self, d: dict) -> None:
+        """Replace in-memory state with a catalog document (the unit the
+        control plane ships between coordinators)."""
         self.tables = {t["name"]: TableMeta.from_json(t) for t in d["tables"]}
         self.nodes = {n["node_id"]: NodeMeta.from_json(n) for n in d["nodes"]}
         self._next_shard_id = d["next_shard_id"]
@@ -216,7 +222,22 @@ class Catalog:
         self.functions = d.get("functions", {})
         self.types = d.get("types", {})
         self.enum_columns = d.get("enum_columns", {})
-        self._doc_sig = _stat_sig(p)
+
+    def export_document(self) -> dict:
+        return {
+            "tables": [t.to_json() for t in self.tables.values()],
+            "nodes": [n.to_json() for n in self.nodes.values()],
+            "next_shard_id": self._next_shard_id,
+            "next_colocation_id": self._next_colocation_id,
+            "schemas": self.schemas,
+            "views": self.views,
+            "sequences": self.sequences,
+            "roles": self.roles,
+            "grants": self.grants,
+            "functions": self.functions,
+            "types": self.types,
+            "enum_columns": self.enum_columns,
+        }
 
     def tombstone(self, section: str, name: str) -> None:
         """Record a deletion so the commit-time merge never resurrects a
@@ -238,6 +259,11 @@ class Catalog:
                 d = json.load(fh)
         except (OSError, json.JSONDecodeError):
             return
+        self._merge_doc(d)
+
+    def _merge_doc(self, d: dict) -> None:
+        """Adopt another coordinator's catalog document into memory
+        (tombstones guard drops; table conflicts resolve by version)."""
         tomb = self._tombstones
         for td in d.get("tables", []):
             name = td["name"]
@@ -276,20 +302,7 @@ class Catalog:
                                        d.get("next_colocation_id", 1))
 
     def _store_locked(self) -> None:
-        d = {
-            "tables": [t.to_json() for t in self.tables.values()],
-            "nodes": [n.to_json() for n in self.nodes.values()],
-            "next_shard_id": self._next_shard_id,
-            "next_colocation_id": self._next_colocation_id,
-            "schemas": self.schemas,
-            "views": self.views,
-            "sequences": self.sequences,
-            "roles": self.roles,
-            "grants": self.grants,
-            "functions": self.functions,
-            "types": self.types,
-            "enum_columns": self.enum_columns,
-        }
+        d = self.export_document()
         tmp = self._path() + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(d, fh)
@@ -306,21 +319,99 @@ class Catalog:
         self._tombstones = {}
 
     def commit(self) -> None:
-        """Atomically persist catalog state: read-merge-store under the
-        cross-process lock (the metadata-transaction analog)."""
+        """Atomically persist catalog state: the metadata-transaction
+        analog.  With a control plane attached, the commit is serialized
+        through the metadata authority — acquire the cluster-wide DDL
+        lease, merge against the authority's current document (fetched
+        over RPC), and push the merged document back; the authority is
+        the single writer of the canonical file and broadcasts the
+        invalidation (reference: metadata changes travel inside the
+        coordinator's 2PC, metadata/metadata_sync.c).  Without one —
+        or if the authority is unreachable — fall back to read-merge-
+        store under the cross-process flock (the shared-FS degenerate
+        transport)."""
         from citus_tpu.testing.faults import FAULTS
         FAULTS.hit("catalog_commit")
+        tr = getattr(self, "commit_transport", None)
+        if tr is not None and tr.commit_is_remote:
+            try:
+                with tr.catalog_lease():
+                    # network fetch happens OUTSIDE the catalog lock so
+                    # readers aren't frozen for a round trip; the lease
+                    # already serializes committers
+                    remote = tr.fetch_catalog_doc()
+                    with self._lock:
+                        if remote is not None:
+                            self._merge_doc(remote)
+                        doc = self.export_document()
+                        tombs = {k: sorted(v)
+                                 for k, v in self._tombstones.items()}
+                    tr.push_catalog_doc(doc, tombs)
+                # the authority stored the document and broadcast the
+                # invalidation (tagged with our origin); only now are the
+                # drop tombstones consumed (a failed push must leave them
+                # for the flock fallback's merge)
+                with self._lock:
+                    self._tombstones = {}
+                # stamp the authority's file write as our own so the
+                # mtime poller doesn't treat our commit as foreign and
+                # reload underneath concurrent readers
+                try:
+                    self.self_mtime = os.path.getmtime(self._path())
+                    self._doc_sig = _stat_sig(self._path())
+                except OSError:
+                    pass
+                return
+            except Exception:
+                # authority unreachable mid-commit: fall through to the
+                # shared-FS path, WITHOUT the (dead) remote lease — the
+                # flock alone serializes FS peers; a held lease expires
+                # by TTL
+                self._commit_local()
+                cb = getattr(self, "on_commit", None)
+                if cb is not None:
+                    cb()
+                return
+        if tr is not None:
+            # metadata authority committing its own DDL: serialize with
+            # remote pushers through the same lease
+            with tr.catalog_lease():
+                self._commit_local()
+        else:
+            self._commit_local()
+        # control-plane invalidation hook (set by Cluster when an RPC
+        # control plane is attached): peers learn of this commit by push
+        cb = getattr(self, "on_commit", None)
+        if cb is not None:
+            cb()
+
+    def _commit_local(self) -> None:
         with self._lock, _catalog_flock(self.data_dir):
             self._merge_foreign_locked()
             self._store_locked()
             # dictionaries are persisted (fsync'd) by encode_strings at
             # growth time, before any commit record can reference their
             # ids — nothing to write here
-        # control-plane invalidation hook (set by Cluster when an RPC
-        # control plane is attached): peers learn of this commit by push
-        cb = getattr(self, "on_commit", None)
-        if cb is not None:
-            cb()
+
+    def store_document(self, doc: dict,
+                       tombstones: Optional[dict] = None) -> None:
+        """Authority-side application of a pushed catalog document.
+        Push order is serialized by the DDL lease and every pusher
+        merged against the freshest fetched document — but a NON-
+        attached coordinator may still flock-commit between the pusher's
+        fetch and this store, so merge the disk file once more before
+        persisting, guarded by the pusher's tombstones (shipped with the
+        document) so its drops don't resurrect."""
+        with self._lock, _catalog_flock(self.data_dir):
+            self.load_document(doc)
+            self._tombstones = {k: set(v)
+                                for k, v in (tombstones or {}).items()}
+            self._merge_foreign_locked()
+            self._dicts.clear()
+            self._dict_index.clear()
+            self._dict_sig.clear()
+            self.ddl_epoch += 1
+            self._store_locked()
 
     # ---- tables -------------------------------------------------------
     def table(self, name: str) -> TableMeta:

@@ -327,6 +327,9 @@ class Cluster:
             from citus_tpu.net.control_plane import ControlPlane
             self._control = ControlPlane(self, serve_port=serve_port,
                                          coordinator=coordinator)
+            # catalog commits serialize through the authority's DDL
+            # lease and ship the document over RPC (push_catalog)
+            self.catalog.commit_transport = self._control
         self.catalog.on_commit = self._on_catalog_commit
         # mtime-poll baseline: our own open-time commit; anything newer
         # is a foreign change (avoids missing commits that land between
@@ -341,6 +344,12 @@ class Cluster:
     def _on_catalog_commit(self) -> None:
         if self._control is not None:
             self._control.publish_catalog_change()
+
+    def _on_foreign_catalog_applied(self) -> None:
+        """A pushed catalog document was just stored into our live
+        catalog (authority side): drop cached plans keyed on the old
+        metadata."""
+        self._plan_cache.clear()
 
     @property
     def control_port(self) -> Optional[int]:
@@ -456,13 +465,24 @@ class Cluster:
             self._reload_catalog()
 
     def _reload_catalog(self) -> None:
+        # with an authority attached, the catalog document itself comes
+        # over RPC (fetch_catalog) — the file is only the fallback
+        doc = None
+        if self._control is not None and self._control.connected:
+            try:
+                doc = self._control.fetch_catalog_doc()
+            except Exception:
+                doc = None
         with self.catalog._lock:
             self.catalog.tables.clear()
             self.catalog.nodes.clear()
             self.catalog._dicts.clear()
             self.catalog._dict_index.clear()
             self.catalog._dict_sig.clear()
-            self.catalog._load()
+            if doc is not None:
+                self.catalog.load_document(doc)
+            else:
+                self.catalog._load()
             self.catalog.ddl_epoch += 1  # invalidate cached plans
         self._plan_cache.clear()
 

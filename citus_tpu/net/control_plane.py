@@ -7,20 +7,30 @@ invalidations over a push channel, and exchange in-flight transaction
 sets so 2PC recovery never adopts a live peer's transactions — the RPC
 generalization of the single-host flock liveness probe.
 
-Transport split (SURVEY §5.8): the catalog *document* still travels via
-the shared data directory (the degenerate bulk transport); what moves
-over RPC is the control information — invalidations, liveness, votes.
-A future multi-host deployment swaps the shared directory for
-fetch_catalog/push_catalog bulk methods on the same server.
+Transport split (SURVEY §5.8): the catalog *document* travels over RPC
+— peers fetch it from the authority on invalidation (fetch_catalog) and
+commit by pushing the merged document back (push_catalog) under a
+cluster-wide DDL lease the authority grants (the serialization the
+reference gets from running metadata changes inside the coordinator's
+2PC).  The shared data directory remains the transport for bulk shard
+data and dictionary side files, and the degenerate fallback when no
+authority is reachable.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 import uuid
+from contextlib import contextmanager
 from typing import Optional
 
 from citus_tpu.net.rpc import RpcClient, RpcError, RpcServer
+
+# DDL lease time-to-live: a crashed holder's lease expires after this
+# many seconds (renewed implicitly by re-acquiring); generous compared
+# to a metadata commit (~ms) but short enough to bound DDL outage
+DDL_LEASE_TTL_S = 10.0
 
 
 class ControlPlane:
@@ -36,6 +46,13 @@ class ControlPlane:
         # peers' last reported in-flight xid sets (server side)
         self._peer_inflight: dict[str, list[int]] = {}
         self._lock = threading.Lock()
+        # cluster-wide DDL lease (authority side): serializes catalog
+        # commits from every coordinator; expires so a crashed holder
+        # cannot wedge DDL forever
+        self._lease_holder: Optional[str] = None
+        self._lease_expires = 0.0
+        self.stats = {"fetch_catalog": 0, "push_catalog": 0,
+                      "lease_acquired": 0, "lease_contended": 0}
         if serve_port is not None:
             self.server = RpcServer(port=serve_port)
             self.server.register("ping", lambda p: {"ok": True})
@@ -43,6 +60,9 @@ class ControlPlane:
             self.server.register("report_inflight", self._on_report_inflight)
             self.server.register("cluster_inflight", self._on_cluster_inflight)
             self.server.register("tx_event", self._on_tx_event)
+            self.server.register("ddl_lease", self._on_ddl_lease)
+            self.server.register("fetch_catalog", self._on_fetch_catalog)
+            self.server.register("push_catalog", self._on_push_catalog)
             self.server.start()
         # push channel liveness: when it dies (coordinator gone), the
         # cluster falls back to mtime polling for invalidations
@@ -84,6 +104,61 @@ class ControlPlane:
         faster recovery adoption)."""
         return {"ok": True}
 
+    # ---- catalog authority --------------------------------------------
+    def _lease_try(self, origin: str) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            if (self._lease_holder in (None, origin)
+                    or now >= self._lease_expires):
+                self._lease_holder = origin
+                self._lease_expires = now + DDL_LEASE_TTL_S
+                self.stats["lease_acquired"] += 1
+                return True
+            self.stats["lease_contended"] += 1
+            return False
+
+    def _lease_release(self, origin: str) -> None:
+        with self._lock:
+            if self._lease_holder == origin:
+                self._lease_holder = None
+
+    def _on_ddl_lease(self, payload: dict) -> dict:
+        origin = payload.get("origin", "?")
+        if payload.get("action") == "release":
+            self._lease_release(origin)
+            return {"ok": True}
+        return {"ok": self._lease_try(origin)}
+
+    def _on_fetch_catalog(self, payload: dict) -> dict:
+        """Serve the canonical catalog document.  Merge any foreign
+        shared-FS writer's changes first so the served document is never
+        behind the file (non-attached coordinators may still commit via
+        the flock path)."""
+        from citus_tpu.catalog.catalog import _catalog_flock
+        cat = self.cluster.catalog
+        with cat._lock, _catalog_flock(cat.data_dir):
+            cat._merge_foreign_locked()
+            doc = cat.export_document()
+        self.stats["fetch_catalog"] += 1
+        return {"doc": doc}
+
+    def _on_push_catalog(self, payload: dict) -> dict:
+        """A lease-holding peer committed: store its merged document as
+        canonical, refresh our own plan caches, and broadcast the
+        invalidation to every other subscriber."""
+        origin = payload.get("origin", "?")
+        with self._lock:
+            held = (self._lease_holder == origin
+                    and time.monotonic() < self._lease_expires)
+        if not held:
+            raise RpcError(f"push_catalog from {origin} without the DDL lease")
+        self.cluster.catalog.store_document(payload["doc"],
+                                            payload.get("tombstones"))
+        self.cluster._on_foreign_catalog_applied()
+        self.stats["push_catalog"] += 1
+        self.server.broadcast({"event": "catalog_changed", "origin": origin})
+        return {"ok": True}
+
     # ---- client-side ---------------------------------------------------
     def _on_event(self, event: dict) -> None:
         if event.get("event") == "catalog_changed" \
@@ -123,6 +198,54 @@ class ControlPlane:
         except RpcError:
             pass
         return set()
+
+    # ---- commit transport (Catalog.commit protocol) --------------------
+    @property
+    def commit_is_remote(self) -> bool:
+        """True when catalog commits should travel to a remote authority
+        (we are a client); the authority itself commits locally under
+        the same lease."""
+        return self.client is not None
+
+    @contextmanager
+    def catalog_lease(self, timeout: float = 30.0):
+        """Hold the cluster-wide DDL lease (RPC to the authority, or the
+        local lease map when we are the authority)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.client is not None:
+                ok = self.client.call("ddl_lease", {
+                    "origin": self.origin, "action": "acquire"}).get("ok")
+            else:
+                ok = self._lease_try(self.origin)
+            if ok:
+                break
+            if time.monotonic() >= deadline:
+                raise RpcError("timed out waiting for the DDL lease")
+            time.sleep(0.02)
+        try:
+            yield
+        finally:
+            try:
+                if self.client is not None:
+                    self.client.call("ddl_lease", {
+                        "origin": self.origin, "action": "release"})
+                else:
+                    self._lease_release(self.origin)
+            except RpcError:
+                pass  # lease expires by TTL
+
+    def fetch_catalog_doc(self) -> Optional[dict]:
+        if self.client is not None:
+            return self.client.call("fetch_catalog").get("doc")
+        return None
+
+    def push_catalog_doc(self, doc: dict,
+                         tombstones: Optional[dict] = None) -> None:
+        if self.client is not None:
+            self.client.call("push_catalog", {"doc": doc,
+                                              "tombstones": tombstones or {},
+                                              "origin": self.origin})
 
     def _on_push_closed(self) -> None:
         self.push_alive = False

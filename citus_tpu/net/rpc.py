@@ -54,6 +54,7 @@ class RpcServer:
         self.host, self.port = self._sock.getsockname()
         self.handlers: dict[str, Callable[[dict], dict]] = {}
         self._subscribers: list[socket.socket] = []
+        self._conns: list[socket.socket] = []
         self._lock = threading.Lock()
         self._stopping = False
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -75,6 +76,8 @@ class RpcServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.append(conn)
         try:
             while True:
                 msg = _recv(conn)
@@ -100,6 +103,8 @@ class RpcServer:
         with self._lock:
             if conn in self._subscribers:
                 self._subscribers.remove(conn)
+            if conn in self._conns:
+                self._conns.remove(conn)
         try:
             conn.close()
         except OSError:
@@ -117,18 +122,22 @@ class RpcServer:
                         self._subscribers.remove(s)
 
     def stop(self) -> None:
+        """Stop serving: close the listener, every push channel, AND
+        every in-flight request connection — a stopped server must look
+        dead to clients, or failover paths never exercise."""
         self._stopping = True
         try:
             self._sock.close()
         except OSError:
             pass
         with self._lock:
-            for s in self._subscribers:
+            for s in self._subscribers + self._conns:
                 try:
                     s.close()
                 except OSError:
                     pass
             self._subscribers.clear()
+            self._conns.clear()
 
 
 class RpcError(RuntimeError):
@@ -145,12 +154,15 @@ class RpcClient:
         self._sub_sock: Optional[socket.socket] = None
 
     def call(self, method: str, payload: Optional[dict] = None) -> dict:
-        with self._lock:
-            self._next_id += 1
-            rid = self._next_id
-            _send(self._sock, {"id": rid, "method": method,
-                               "payload": payload or {}})
-            resp = _recv(self._sock)
+        try:
+            with self._lock:
+                self._next_id += 1
+                rid = self._next_id
+                _send(self._sock, {"id": rid, "method": method,
+                                   "payload": payload or {}})
+                resp = _recv(self._sock)
+        except OSError as e:
+            raise RpcError(f"coordinator connection failed: {e}") from e
         if resp is None:
             raise RpcError("connection closed by coordinator")
         if resp.get("error"):
