@@ -759,7 +759,8 @@ class Cluster:
             if new_from is not stmt.from_:
                 stmt = A.Select(stmt.items, new_from, stmt.where,
                                 stmt.group_by, stmt.having, stmt.order_by,
-                                stmt.limit, stmt.offset, stmt.distinct)
+                                stmt.limit, stmt.offset, stmt.distinct,
+                                stmt.windows)
         if isinstance(stmt, A.Select) and stmt.from_ is not None \
                 and _has_derived(stmt.from_):
             return self._execute_derived(stmt)
@@ -1335,6 +1336,42 @@ class Cluster:
                 total += idx.size
         return total
 
+    @staticmethod
+    def _resolve_window_ref(wc: A.WindowCall, windows: dict,
+                            _seen: Optional[set] = None) -> A.WindowCall:
+        """Resolve OVER w / OVER (w ...) against the WINDOW clause,
+        following PostgreSQL's copy rules: the referencing spec may not
+        re-partition, may order only when the base does not, and always
+        uses its own frame (the base may not define one when copied);
+        OVER w uses the named window verbatim, frame included."""
+        if wc.ref_name is None:
+            return wc
+        if _seen is None:
+            _seen = set()
+        if wc.ref_name in _seen:
+            raise AnalysisError(
+                f'circular reference in window "{wc.ref_name}"')
+        _seen.add(wc.ref_name)
+        base = windows.get(wc.ref_name)
+        if base is None:
+            raise AnalysisError(f'window "{wc.ref_name}" does not exist')
+        if base.ref_name is not None:
+            base = Cluster._resolve_window_ref(base, windows, _seen)
+        if wc.ref_verbatim:
+            return A.WindowCall(wc.func, base.partition_by, base.order_by,
+                                base.frame)
+        if wc.partition_by:
+            raise AnalysisError(
+                "cannot override PARTITION BY of a named window")
+        if wc.order_by and base.order_by:
+            raise AnalysisError(
+                "cannot override ORDER BY of a named window that has one")
+        if base.frame is not None:
+            raise AnalysisError(
+                "cannot copy a named window that has a frame clause")
+        return A.WindowCall(wc.func, base.partition_by,
+                            wc.order_by or base.order_by, wc.frame)
+
     def _execute_window(self, stmt: A.Select) -> Result:
         """Window functions: run the base projection (or grouped
         aggregation) distributed, apply the window pass on the
@@ -1343,6 +1380,16 @@ class Cluster:
         if stmt.distinct:
             raise UnsupportedFeatureError(
                 "window functions with DISTINCT not supported yet")
+        if stmt.windows or any(isinstance(i.expr, A.WindowCall)
+                               and i.expr.ref_name is not None
+                               for i in stmt.items):
+            import dataclasses
+            wmap = dict(stmt.windows)
+            stmt = dataclasses.replace(stmt, items=[
+                A.SelectItem(self._resolve_window_ref(i.expr, wmap)
+                             if isinstance(i.expr, A.WindowCall) else i.expr,
+                             i.alias)
+                for i in stmt.items])
         base_items: list[A.SelectItem] = []
 
         def base_slot(e: A.Expr) -> int:
@@ -1448,10 +1495,47 @@ class Cluster:
         return Result(columns=names, rows=rows,
                       explain={"strategy": strategy})
 
+    @staticmethod
+    def _injective_in_column(e: A.Expr, col: str, alias: str) -> bool:
+        """True when ``e`` is an injective function of the column: equal
+        outputs imply equal column values, so partitioning by it can
+        never group rows from different shards.  Covers the column
+        itself and +/- of a constant, * by a nonzero constant, and
+        unary minus, composed."""
+        if isinstance(e, A.ColumnRef):
+            return e.name == col and (e.table is None or e.table == alias)
+        if isinstance(e, A.UnOp) and e.op == "-":
+            return Cluster._injective_in_column(e.operand, col, alias)
+        if isinstance(e, A.BinOp) and e.op in ("+", "-", "*"):
+            def const_val(x):
+                # integers only: float +/× is NOT injective over bigints
+                # (rounding collapses distinct inputs at large magnitude)
+                if isinstance(x, A.Literal) and isinstance(x.value, int) \
+                        and not isinstance(x.value, bool):
+                    return x.value
+                if isinstance(x, A.UnOp) and x.op == "-":
+                    v = const_val(x.operand)
+                    return -v if v is not None else None
+                return None
+            for side, other in ((e.left, e.right), (e.right, e.left)):
+                c = const_val(other)
+                if c is None:
+                    continue
+                if e.op == "*" and c == 0:
+                    return False
+                if e.op == "-" and side is e.right and other is e.left:
+                    # const - expr: still injective
+                    pass
+                if Cluster._injective_in_column(side, col, alias):
+                    return True
+        return False
+
     def _window_pushdown_eligible(self, stmt: A.Select, outputs) -> bool:
         """Safe to compute windows per shard: single distributed table,
-        no GROUP BY, and every window's PARTITION BY includes the plain
-        distribution column (hash partitions never span shards)."""
+        no GROUP BY, and every window's PARTITION BY includes the
+        distribution column or an injective expression over it (equal
+        partition values then imply equal distribution values, and hash
+        partitions never span shards)."""
         if stmt.group_by or stmt.having:
             return False
         if not isinstance(stmt.from_, A.TableRef):
@@ -1466,8 +1550,7 @@ class Cluster:
             e = item.expr
             if not isinstance(e, A.WindowCall):
                 continue
-            if not any(isinstance(p, A.ColumnRef) and p.name == t.dist_column
-                       and (p.table is None or p.table == alias)
+            if not any(self._injective_in_column(p, t.dist_column, alias)
                        for p in e.partition_by):
                 return False
         return True
@@ -1537,7 +1620,8 @@ class Cluster:
         try:
             new_stmt = A.Select(stmt.items, repl(stmt.from_), stmt.where,
                                 stmt.group_by, stmt.having, stmt.order_by,
-                                stmt.limit, stmt.offset, stmt.distinct)
+                                stmt.limit, stmt.offset, stmt.distinct,
+                                stmt.windows)
             return self._execute_stmt(new_stmt)
         finally:
             for tmp in temps:
@@ -1590,9 +1674,10 @@ class Cluster:
                 return A.FuncCall(e.name, tuple(rw(a, d) for a in e.args),
                                   e.distinct, e.agg_order)
             if isinstance(e, A.WindowCall):
-                return A.WindowCall(rw(e.func, d), tuple(rw(p, d) for p in e.partition_by),
+                return A.WindowCall(rw(e.func, d) if e.func is not None else None,
+                                    tuple(rw(p, d) for p in e.partition_by),
                                     tuple((rw(oe, d), asc) for oe, asc in e.order_by),
-                                    e.frame)
+                                    e.frame, e.ref_name, e.ref_verbatim)
             return e
 
         if isinstance(stmt, A.SetOp):
@@ -1606,7 +1691,8 @@ class Cluster:
             [rw(g, 0) for g in stmt.group_by], rw(stmt.having, 0),
             [A.OrderItem(rw(o.expr, 0), o.ascending, o.nulls_first)
              for o in stmt.order_by],
-            stmt.limit, stmt.offset, stmt.distinct)
+            stmt.limit, stmt.offset, stmt.distinct,
+            tuple((wn, rw(spec, 0)) for wn, spec in stmt.windows))
 
     def _execute_constant_select(self, stmt: A.Select) -> Result:
         """SELECT without FROM: constant expressions evaluated on the
@@ -1790,13 +1876,15 @@ class Cluster:
             return item
 
         def remap_select(sel):
+            import dataclasses
             if isinstance(sel, A.SetOp):
                 return A.SetOp(sel.op, sel.all, remap_select(sel.left),
                                remap_select(sel.right), sel.order_by,
                                sel.limit, sel.offset)
-            return A.Select(sel.items, remap_from(sel.from_), sel.where,
-                            sel.group_by, sel.having, sel.order_by,
-                            sel.limit, sel.offset, sel.distinct)
+            # dataclasses.replace carries every other field (windows,
+            # future additions) — positional rebuilds have dropped
+            # fields here before
+            return dataclasses.replace(sel, from_=remap_from(sel.from_))
 
         try:
             for name, sel in stmt.ctes:

@@ -9,10 +9,13 @@ window pass runs on the coordinator.
 
 Supported: row_number, rank, dense_rank, ntile, lag, lead, first_value,
 last_value, nth_value, count, sum, avg, min, max OVER (PARTITION BY ...
-ORDER BY ... [ROWS BETWEEN ...]).  Default frame matches PostgreSQL
+ORDER BY ... [ROWS|RANGE BETWEEN ...]), plus named windows (WINDOW w AS
+(...) with OVER w / OVER (w ...)).  Default frame matches PostgreSQL
 (RANGE UNBOUNDED PRECEDING .. CURRENT ROW: running aggregates include
-peer rows; no ORDER BY -> whole partition); explicit ROWS frames bound
-by offsets.
+peer rows; no ORDER BY -> whole partition).  ROWS frames bound by row
+offsets; RANGE offset frames bound by ORDER-BY value distance (single
+sort key required, as in PostgreSQL), with RANGE CURRENT ROW meaning
+the peer group on both ends.
 """
 
 from __future__ import annotations
@@ -40,9 +43,10 @@ def _order_indexes(idxs: list[int], order) -> list[int]:
     return out
 
 
-def _frame_slice(frame, j: int, n: int) -> tuple[int, int]:
+def _rows_slice(start, end, j: int, n: int) -> tuple[int, int]:
     """ROWS frame bounds -> [lo, hi) positions for row at position j."""
-    (sdir, sn), (edir, en) = frame
+    sdir, sn = start
+    edir, en = end
     if sdir == "preceding":
         lo = 0 if sn is None else j - sn
     elif sdir == "current":
@@ -58,6 +62,66 @@ def _frame_slice(frame, j: int, n: int) -> tuple[int, int]:
     return max(0, lo), min(n, hi)
 
 
+def _peer_bounds(okeys, j: int, n: int) -> tuple[int, int]:
+    """[first, last+1) of the peer group (equal full sort key) of row j."""
+    lo = j
+    while lo > 0 and okeys[lo - 1] == okeys[j]:
+        lo -= 1
+    hi = j + 1
+    while hi < n and okeys[hi] == okeys[j]:
+        hi += 1
+    return lo, hi
+
+
+def _range_slice(start, end, okeys, ovals, asc: bool, j: int,
+                 n: int) -> tuple[int, int]:
+    """RANGE frame bounds for row at sorted position j.
+
+    ``okeys`` are the full sort-key tuples (peer detection); ``ovals``
+    the single ORDER BY column values (None unless an offset bound is
+    present).  CURRENT ROW means the peer group edge; offset bounds
+    select rows whose value lies within the offset of the current value
+    in ordering direction.  A NULL current value frames its peer group
+    (NULLs are peers of each other, per PostgreSQL)."""
+    plo, phi = _peer_bounds(okeys, j, n)
+
+    def value_bound(direction: str, off, is_start: bool) -> int:
+        cur = ovals[j]
+        if cur is None:
+            return plo if is_start else phi
+        sign = 1 if asc else -1
+        # target value at the frame edge, in ordering direction
+        delta = -off if direction == "preceding" else off
+        target = cur + sign * delta
+        if is_start:
+            k = 0
+            while k < n and (ovals[k] is None
+                             or (ovals[k] < target if asc else ovals[k] > target)):
+                k += 1
+            return k
+        k = n
+        while k > 0 and (ovals[k - 1] is None
+                         or (ovals[k - 1] > target if asc else ovals[k - 1] < target)):
+            k -= 1
+        return k
+
+    sdir, sn = start
+    edir, en = end
+    if sdir == "preceding" and sn is None:
+        lo = 0
+    elif sdir == "current":
+        lo = plo
+    else:
+        lo = value_bound(sdir, sn, True)
+    if edir == "following" and en is None:
+        hi = n
+    elif edir == "current":
+        hi = phi
+    else:
+        hi = value_bound(edir, en, False)
+    return max(0, lo), min(n, hi)
+
+
 def compute_window(rows_n: int, fn_name: str, args: list[list],
                    partition: list[list], order: list[tuple[list, bool]],
                    frame: Optional[tuple] = None,
@@ -70,6 +134,18 @@ def compute_window(rows_n: int, fn_name: str, args: list[list],
     """
     if fn_name not in RANKING | NAVIGATION | AGGS:
         raise UnsupportedFeatureError(f"window function {fn_name}() not supported")
+    if frame is not None and frame[0] == "range":
+        has_offset = any(d in ("preceding", "following") and v is not None
+                         for d, v in (frame[1], frame[2]))
+        if has_offset:
+            if len(order) != 1:
+                raise AnalysisError("RANGE offset frames require exactly one "
+                                    "ORDER BY column")
+            if any(v is not None and not isinstance(
+                    v, (int, float, decimal.Decimal))
+                   or isinstance(v, bool) for v in order[0][0]):
+                raise AnalysisError("RANGE with offset requires a numeric "
+                                    "ORDER BY column")
     groups: dict[tuple, list[int]] = {}
     for i in range(rows_n):
         key = tuple(p[i] for p in partition)
@@ -81,6 +157,18 @@ def compute_window(rows_n: int, fn_name: str, args: list[list],
         okeys = [tuple(vals[i] for vals, _ in order) for i in idxs] if order else None
         n = len(idxs)
         col = args[0] if args else None
+        # loop-invariant range-frame context (built once per partition)
+        range_keys = okeys if okeys is not None else [()] * n
+        range_vals = ([order[0][0][i] for i in idxs]
+                      if len(order) == 1 else [None] * n)
+        range_asc = order[0][1] if order else True
+
+        def frame_slice(frame3, pos):
+            mode, start, end = frame3
+            if mode == "rows":
+                return _rows_slice(start, end, pos, n)
+            return _range_slice(start, end, range_keys, range_vals,
+                                range_asc, pos, n)
         if fn_name == "row_number":
             for pos, i in enumerate(idxs):
                 out[i] = pos + 1
@@ -117,11 +205,11 @@ def compute_window(rows_n: int, fn_name: str, args: list[list],
                 out[i] = col[idxs[src]] if 0 <= src < n else default
             continue
         if fn_name in ("first_value", "last_value", "nth_value"):
-            eff = frame or ((("preceding", None), ("current", 0))
-                            if order else (("preceding", None),
+            eff = frame or (("range", ("preceding", None), ("current", 0))
+                            if order else ("rows", ("preceding", None),
                                            ("following", None)))
             for pos, i in enumerate(idxs):
-                lo, hi = _frame_slice(eff, pos, n)
+                lo, hi = frame_slice(eff, pos)
                 if lo >= hi:
                     out[i] = None
                 elif fn_name == "first_value":
@@ -135,7 +223,7 @@ def compute_window(rows_n: int, fn_name: str, args: list[list],
         # aggregates
         if frame is not None:
             for pos, i in enumerate(idxs):
-                lo, hi = _frame_slice(frame, pos, n)
+                lo, hi = frame_slice(frame, pos)
                 window = [col[idxs[j]] for j in range(lo, hi)
                           if col is not None and col[idxs[j]] is not None] \
                     if col is not None else None

@@ -113,13 +113,20 @@ class CaseExpr(Expr):
 
 @dataclass(frozen=True)
 class WindowCall(Expr):
-    """fn(...) OVER (PARTITION BY ... ORDER BY ... [ROWS BETWEEN ...])."""
+    """fn(...) OVER (PARTITION BY ... ORDER BY ... [frame]) or
+    fn(...) OVER name / OVER (name ...) referencing a WINDOW clause."""
     func: "FuncCall" = None
     partition_by: tuple = ()
     order_by: tuple = ()  # tuple[OrderItem-like (expr, asc)]
-    # ROWS frame: ((dir, n|None), (dir, n|None)) with dir in
-    # preceding|current|following, None = unbounded; None = default frame
+    # frame: (mode, (dir, n|None), (dir, n|None)) with mode rows|range,
+    # dir in preceding|current|following, None = unbounded;
+    # frame None = default (RANGE UNBOUNDED PRECEDING .. CURRENT ROW)
     frame: Optional[tuple] = None
+    # named-window reference: OVER w (verbatim=True, uses w including
+    # its frame) or OVER (w ...) (copy rules: partition from w, own
+    # order only if w has none, own frame)
+    ref_name: Optional[str] = None
+    ref_verbatim: bool = False
 
     def __hash__(self):
         return id(self)
@@ -281,6 +288,9 @@ class Select(Statement):
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+    # WINDOW name AS (spec) declarations: tuple[(name, WindowCall-spec)]
+    # (the spec is a WindowCall with func=None)
+    windows: tuple = ()
 
 
 @dataclass

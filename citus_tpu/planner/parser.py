@@ -42,7 +42,7 @@ KEYWORDS = {
     "schema", "cascade", "merge", "matched", "nothing", "do", "over",
     "partition", "union", "intersect", "except", "all", "within",
     "rows", "range", "unbounded", "preceding", "following", "current", "row",
-    "grant", "revoke", "returning",
+    "grant", "revoke", "returning", "window",
 }
 
 
@@ -400,6 +400,48 @@ class Parser:
                 for combo in combinations(range(len(exprs)), r):
                     sets.append(tuple(exprs[i] for i in combo))
         return A.GroupingSetsSpec(tuple(sets))
+
+    def _parse_window_spec(self):
+        """'(' [base_window_name] [PARTITION BY ...] [ORDER BY ...]
+        [ROWS|RANGE frame] ')' -> (partition tuple, order tuple,
+        frame|None, base_name|None)."""
+        self.expect_op("(")
+        base = None
+        if self.peek().kind == "ident" and not self.at_op(")"):
+            base = self.expect_ident()
+        part, order = [], []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            while True:
+                part.append(self.parse_expr())
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e_ = self.parse_expr()
+                asc = True
+                if self.accept_kw("asc"):
+                    pass
+                elif self.accept_kw("desc"):
+                    asc = False
+                order.append((e_, asc))
+                if not self.accept_op(","):
+                    break
+        frame = None
+        if self.at_kw("rows", "range"):
+            mode = self.next().value
+            if self.accept_kw("between"):
+                start = self._parse_frame_bound()
+                self.expect_kw("and")
+                end = self._parse_frame_bound()
+            else:
+                # shorthand: frame start only, end = CURRENT ROW
+                start = self._parse_frame_bound()
+                end = ("current", 0)
+            frame = (mode, start, end)
+        self.expect_op(")")
+        return tuple(part), tuple(order), frame, base
 
     def _parse_frame_bound(self):
         """UNBOUNDED PRECEDING|FOLLOWING | CURRENT ROW | N PRECEDING|
@@ -873,8 +915,20 @@ class Parser:
         having = None
         if self.accept_kw("having"):
             having = self.parse_expr()
+        windows = []
+        if self.accept_kw("window"):
+            # WINDOW w AS (spec) [, w2 AS (spec)]: named windows for
+            # OVER w / OVER (w ...) references
+            while True:
+                wname = self.expect_ident()
+                self.expect_kw("as")
+                part, order, frame, base = self._parse_window_spec()
+                windows.append((wname, A.WindowCall(
+                    None, part, order, frame, ref_name=base)))
+                if not self.accept_op(","):
+                    break
         return A.Select(items, from_, where, group_by, having, [],
-                        None, None, distinct)
+                        None, None, distinct, tuple(windows))
 
     def parse_from(self):
         left = self.parse_table_ref()
@@ -1180,38 +1234,12 @@ class Parser:
                     fc = A.FuncCall(t.value, tuple(args) + (sort_expr,), distinct)
                 if self.at_kw("over"):
                     self.next()
-                    self.expect_op("(")
-                    part, order = [], []
-                    if self.accept_kw("partition"):
-                        self.expect_kw("by")
-                        while True:
-                            part.append(self.parse_expr())
-                            if not self.accept_op(","):
-                                break
-                    if self.accept_kw("order"):
-                        self.expect_kw("by")
-                        while True:
-                            e_ = self.parse_expr()
-                            asc = True
-                            if self.accept_kw("asc"):
-                                pass
-                            elif self.accept_kw("desc"):
-                                asc = False
-                            order.append((e_, asc))
-                            if not self.accept_op(","):
-                                break
-                    frame = None
-                    if self.at_kw("rows", "range"):
-                        mode = self.next().value
-                        if mode == "range":
-                            self.error("RANGE frames beyond the default are "
-                                       "not supported; use ROWS")
-                        self.expect_kw("between")
-                        frame = (self._parse_frame_bound(),
-                                 (self.expect_kw("and"),
-                                  self._parse_frame_bound())[1])
-                    self.expect_op(")")
-                    return A.WindowCall(fc, tuple(part), tuple(order), frame)
+                    if self.peek().kind == "ident":
+                        # OVER w: use the named window verbatim
+                        return A.WindowCall(fc, ref_name=self.expect_ident(),
+                                            ref_verbatim=True)
+                    part, order, frame, base = self._parse_window_spec()
+                    return A.WindowCall(fc, part, order, frame, ref_name=base)
                 return fc
             if self.accept_op("."):
                 col = self.expect_ident()

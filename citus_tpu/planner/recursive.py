@@ -99,6 +99,12 @@ def bind_params(e, params):
         return A.CaseExpr(tuple((bind_params(c, params), bind_params(v, params))
                                 for c, v in e.whens),
                           bind_params(e.else_, params) if e.else_ is not None else None)
+    if isinstance(e, A.WindowCall):
+        return A.WindowCall(
+            bind_params(e.func, params),
+            tuple(bind_params(p, params) for p in e.partition_by),
+            tuple((bind_params(oe, params), asc) for oe, asc in e.order_by),
+            e.frame, e.ref_name, e.ref_verbatim)
     if isinstance(e, A.FuncCall):
         return A.FuncCall(e.name, tuple(bind_params(a, params) for a in e.args),
                           e.distinct)
@@ -143,7 +149,9 @@ def rewrite_params(stmt, params):
             having=bind_params(stmt.having, params),
             order_by=[A.OrderItem(bind_params(o.expr, params), o.ascending,
                                   o.nulls_first) for o in stmt.order_by],
-            limit=stmt.limit, offset=stmt.offset, distinct=stmt.distinct)
+            limit=stmt.limit, offset=stmt.offset, distinct=stmt.distinct,
+            windows=tuple((wn, bind_params(spec, params))
+                          for wn, spec in stmt.windows))
     if isinstance(stmt, A.Delete):
         return A.Delete(stmt.table, bind_params(stmt.where, params),
                         stmt.returning)

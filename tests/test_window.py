@@ -83,3 +83,151 @@ def test_window_pushdown_on_dist_column(tmp_path):
     assert r2.explain["strategy"] == "window:pull"
     assert sorted(r2.rows) == sorted(tuple(x) for x in sq.execute(sql2).fetchall())
     cl.close()
+
+
+# ---- named windows (WINDOW clause), round-2 gap #5 -------------------
+
+NAMED_WINDOW_QUERIES = [
+    # OVER w verbatim
+    "SELECT k, sum(v) OVER w FROM t WINDOW w AS (PARTITION BY g ORDER BY k)",
+    # two functions sharing one named window
+    "SELECT k, rank() OVER w, count(*) OVER w FROM t "
+    "WINDOW w AS (PARTITION BY g ORDER BY v)",
+    # OVER (w ORDER BY ...): copy partition, add ordering
+    "SELECT k, sum(v) OVER (w ORDER BY k) FROM t WINDOW w AS (PARTITION BY g)",
+    # named window referencing another named window
+    "SELECT k, row_number() OVER w2 FROM t "
+    "WINDOW w1 AS (PARTITION BY g), w2 AS (w1 ORDER BY k)",
+    # verbatim use keeps the named window's frame
+    "SELECT k, sum(v) OVER w FROM t WINDOW w AS (PARTITION BY g ORDER BY k "
+    "ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING)",
+]
+
+
+@pytest.mark.parametrize("sql", NAMED_WINDOW_QUERIES)
+def test_named_windows_vs_sqlite(db, sql):
+    check(db, sql)
+
+
+def test_named_window_errors(db):
+    cl, _ = db
+    from citus_tpu.errors import AnalysisError
+    with pytest.raises(AnalysisError, match="does not exist"):
+        cl.execute("SELECT sum(v) OVER nope FROM t")
+    with pytest.raises(AnalysisError, match="ORDER BY"):
+        cl.execute("SELECT sum(v) OVER (w ORDER BY v) FROM t "
+                   "WINDOW w AS (PARTITION BY g ORDER BY k)")
+
+
+# ---- RANGE frames ----------------------------------------------------
+
+RANGE_QUERIES = [
+    # explicit spelling of the default frame
+    "SELECT k, sum(v) OVER (PARTITION BY g ORDER BY v "
+    "RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) FROM t",
+    # CURRENT ROW .. UNBOUNDED: peers included on the leading edge
+    "SELECT k, count(*) OVER (PARTITION BY g ORDER BY v "
+    "RANGE BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) FROM t",
+    # value-offset frames (single numeric sort key)
+    "SELECT k, sum(v) OVER (PARTITION BY g ORDER BY v "
+    "RANGE BETWEEN 3 PRECEDING AND 3 FOLLOWING) FROM t",
+    "SELECT k, count(*) OVER (ORDER BY v RANGE BETWEEN 5 PRECEDING "
+    "AND CURRENT ROW) FROM t",
+    # DESC ordering flips the value direction
+    "SELECT k, sum(v) OVER (ORDER BY v DESC RANGE BETWEEN 2 PRECEDING "
+    "AND 2 FOLLOWING) FROM t",
+    # frame-start shorthand (end = CURRENT ROW)
+    "SELECT k, sum(v) OVER (PARTITION BY g ORDER BY v RANGE UNBOUNDED "
+    "PRECEDING) FROM t",
+    "SELECT k, sum(v) OVER (PARTITION BY g ORDER BY k ROWS 2 PRECEDING) FROM t",
+]
+
+
+@pytest.mark.parametrize("sql", RANGE_QUERIES)
+def test_range_frames_vs_sqlite(db, sql):
+    check(db, sql)
+
+
+def test_range_offset_requires_single_order_key(db):
+    cl, _ = db
+    from citus_tpu.errors import AnalysisError
+    with pytest.raises(AnalysisError, match="exactly one ORDER BY"):
+        cl.execute("SELECT sum(v) OVER (ORDER BY g, v RANGE BETWEEN 1 "
+                   "PRECEDING AND CURRENT ROW) FROM t")
+
+
+def test_pushdown_on_injective_distcol_expression(tmp_path):
+    """PARTITION BY (k + 1) is injective in k: still pushdown-safe."""
+    import sqlite3
+    cl = ct.Cluster(str(tmp_path / "wi"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    rows = [(i % 10, (i * 7) % 20) for i in range(60)]
+    cl.copy_from("t", rows=rows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE t (k INTEGER, v INTEGER)")
+    sq.executemany("INSERT INTO t VALUES (?,?)", rows)
+    sql = ("SELECT k, sum(v) OVER (PARTITION BY k + 1 ORDER BY v) AS s "
+           "FROM t ORDER BY k, s")
+    r = cl.execute(sql)
+    assert r.explain["strategy"] == "window:pushdown"
+    assert sorted(r.rows) == sorted(tuple(x) for x in sq.execute(sql).fetchall())
+    # k % 3 is NOT injective: must pull
+    sql2 = ("SELECT k, sum(v) OVER (PARTITION BY k % 3) AS s FROM t "
+            "ORDER BY k, s")
+    r2 = cl.execute(sql2)
+    assert r2.explain["strategy"] == "window:pull"
+    assert sorted(r2.rows) == sorted(tuple(x) for x in sq.execute(sql2).fetchall())
+    cl.close()
+
+
+# ---- review-finding regressions --------------------------------------
+
+def test_named_window_with_params(db):
+    """$N binding keeps the WINDOW clause (rewrite_params threads it)."""
+    cl, sq = db
+    r = cl.execute("SELECT k, sum(v) OVER w FROM t WHERE k < $1 "
+                   "WINDOW w AS (PARTITION BY g)", params=[50])
+    want = sq.execute("SELECT k, sum(v) OVER (PARTITION BY g) FROM t "
+                      "WHERE k < 50").fetchall()
+    assert sorted(r.rows) == sorted(tuple(x) for x in want)
+
+
+def test_named_window_inside_cte(db):
+    cl, sq = db
+    r = cl.execute("WITH c AS (SELECT k, v, g FROM t) "
+                   "SELECT k, sum(v) OVER w FROM c WINDOW w AS (PARTITION BY g)")
+    want = sq.execute("SELECT k, sum(v) OVER (PARTITION BY g) FROM t").fetchall()
+    assert sorted(r.rows) == sorted(tuple(x) for x in want)
+
+
+def test_circular_named_window_rejected(db):
+    cl, _ = db
+    from citus_tpu.errors import AnalysisError
+    with pytest.raises(AnalysisError, match="circular"):
+        cl.execute("SELECT sum(v) OVER w FROM t WINDOW w AS (w)")
+    with pytest.raises(AnalysisError, match="circular"):
+        cl.execute("SELECT sum(v) OVER w1 FROM t "
+                   "WINDOW w1 AS (w2), w2 AS (w1)")
+
+
+def test_float_partition_expr_not_pushed_down(tmp_path):
+    """k + <huge float> collapses distinct bigints — not injective, so
+    the planner must pull, matching the single-partition oracle."""
+    cl = ct.Cluster(str(tmp_path / "wf"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", rows=[(i, 1) for i in range(8)])
+    r = cl.execute("SELECT k, count(*) OVER (PARTITION BY k + 1e18) AS c "
+                   "FROM t ORDER BY k")
+    assert r.explain["strategy"] == "window:pull"
+    assert all(row[1] == 8 for row in r.rows), r.rows
+    cl.close()
+
+
+def test_range_offset_text_key_rejected(db):
+    cl, _ = db
+    from citus_tpu.errors import AnalysisError
+    with pytest.raises(AnalysisError, match="numeric"):
+        cl.execute("SELECT count(*) OVER (ORDER BY g RANGE BETWEEN 1 "
+                   "PRECEDING AND CURRENT ROW) FROM t")
