@@ -751,8 +751,11 @@ class Cluster:
         if isinstance(stmt, A.Select) and stmt.from_ is None:
             return self._execute_constant_select(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is not None:
-            from citus_tpu.planner.recursive import decorrelate_scalars
+            from citus_tpu.planner.recursive import (
+                decorrelate_scalars, decorrelate_where,
+            )
             stmt = decorrelate_scalars(stmt)
+            stmt = decorrelate_where(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is not None \
                 and self.catalog.views:
             new_from = self._expand_views(stmt.from_)
@@ -1609,6 +1612,25 @@ class Cluster:
         def repl(item):
             if isinstance(item, A.SubqueryRef):
                 r = self._execute_stmt(item.select)
+                if item.alias.startswith("__corr1row_") \
+                        and "__cnt" in r.columns:
+                    # decorrelated NON-aggregate scalar subquery: enforce
+                    # PostgreSQL's runtime rule that it yields at most
+                    # one row per outer key.  Stricter than PostgreSQL:
+                    # we check every inner key, including ones no outer
+                    # row probes — a conservative error, never a silent
+                    # wrong answer
+                    ci = r.columns.index("__cnt")
+                    ni = (r.columns.index("__cntnull")
+                          if "__cntnull" in r.columns else None)
+                    for row in r.rows:
+                        eff = row[ci] or 0
+                        if ni is not None and (row[ni] or 0) > 0:
+                            eff += 1  # NULL is one distinct row
+                        if eff > 1:
+                            raise AnalysisError(
+                                "more than one row returned by a subquery "
+                                "used as an expression")
                 tmp = self._create_temp_from_result("derived", item.alias, r)
                 temps.append(tmp)
                 return A.TableRef(tmp, item.alias)

@@ -239,31 +239,23 @@ def decorrelate_exists(sub: A.Exists, outer_aliases: set,
     sel = sub.select
     if not isinstance(sel, A.Select) or not isinstance(sel.from_, A.TableRef):
         return None
-    if sel.group_by or sel.having or sel.limit is not None:
+    if sel.group_by or sel.having or sel.limit is not None or sel.offset:
         return None
+    from citus_tpu.planner.bind import _contains_agg
+    if any(_contains_agg(it.expr) for it in sel.items
+           if isinstance(it.expr, A.Expr)):
+        # an ungrouped aggregate query returns exactly one row, so
+        # EXISTS over it is unconditionally true (PostgreSQL semantics)
+        return A.Literal(not negated, "bool")
     inner = {sel.from_.alias or sel.from_.name}
     # outer refs anywhere outside WHERE make the shape unsupported
     for it in sel.items:
         if _outer_refs(it.expr, outer_aliases, inner):
             return None
-    corr = []
-    inner_only = []
-    for c in _split_and(sel.where):
-        if not _outer_refs(c, outer_aliases, inner):
-            inner_only.append(c)
-            continue
-        if not (isinstance(c, A.BinOp) and c.op == "="):
-            return None
-        l_out = _outer_refs(c.left, outer_aliases, inner)
-        r_out = _outer_refs(c.right, outer_aliases, inner)
-        if l_out and not r_out:
-            corr.append((c.left, c.right))
-        elif r_out and not l_out:
-            corr.append((c.right, c.left))
-        else:
-            return None
-    if len(corr) != 1:
+    split = _collect_equality_corr(sel.where, outer_aliases, inner)
+    if split is None or len(split[0]) != 1:
         return None
+    corr, inner_only = split
     outer_e, inner_e = corr[0]
     inner_sel = A.Select([A.SelectItem(inner_e)], sel.from_,
                          _and_all(inner_only))
@@ -274,20 +266,46 @@ def decorrelate_exists(sub: A.Exists, outer_aliases: set,
                    A.IsNull(outer_e))
 
 
+def _collect_equality_corr(where, outer: set, inner: set):
+    """Split WHERE into (corr pairs [(outer_e, inner_e)], inner-only
+    conjuncts); None when any correlated conjunct is not a simple
+    outer=inner equality."""
+    corr, inner_only = [], []
+    for c in _split_and(where):
+        if not _outer_refs(c, outer, inner):
+            inner_only.append(c)
+            continue
+        if not (isinstance(c, A.BinOp) and c.op == "="):
+            return None
+        l_out = _outer_refs(c.left, outer, inner)
+        r_out = _outer_refs(c.right, outer, inner)
+        if l_out and not r_out:
+            corr.append((c.left, c.right))
+        elif r_out and not l_out:
+            corr.append((c.right, c.left))
+        else:
+            return None
+    return corr, inner_only
+
+
 def decorrelate_scalars(stmt: A.Select) -> A.Select:
-    """Equality-correlated scalar AGGREGATE subqueries in the select
-    list / WHERE become LEFT JOINs against a grouped derived table
-    (reference: sublink pull-up in recursive planning):
+    """Equality-correlated scalar subqueries in the select list / WHERE
+    become LEFT JOINs against a grouped derived table (reference:
+    sublink pull-up in recursive planning):
 
         SELECT (SELECT max(x) FROM u WHERE u.k = t.k) FROM t
         -> SELECT __corr_1.__cv FROM t
-           LEFT JOIN (SELECT u.k AS __ck, max(x) AS __cv
-                      FROM u GROUP BY u.k) __corr_1 ON t.k = __corr_1.__ck
+           LEFT JOIN (SELECT u.k AS __ck1, max(x) AS __cv
+                      FROM u GROUP BY u.k) __corr_1 ON t.k = __corr_1.__ck1
 
-    Aggregates guarantee one row per key; a missing key yields NULL
-    (count() additionally coalesces to 0, matching scalar-subquery
-    semantics over an empty set).  Returns the original statement when
-    nothing matches."""
+    Multi-key correlation joins on every key.  Aggregates guarantee one
+    row per key; a missing key yields NULL (count() additionally
+    coalesces to 0, matching scalar-subquery semantics over an empty
+    set).  NON-aggregate scalars group as max(expr) with a count(*)
+    rider; the materialization layer raises when any key saw more than
+    one row (PostgreSQL's runtime error for multi-row scalar
+    subqueries — see Cluster._execute_derived).  Returns the original
+    statement when nothing matches."""
     if stmt.from_ is None or stmt.group_by or stmt.having or stmt.distinct:
         return stmt
     if any(isinstance(i.expr, A.WindowCall) for i in stmt.items):
@@ -296,46 +314,61 @@ def decorrelate_scalars(stmt: A.Select) -> A.Select:
     counter = [0]
     joins: list = []
 
-    def maybe_rewrite(sub: A.Subquery):
+    def maybe_rewrite(sub: A.Subquery, agg_only: bool = False):
         from citus_tpu.planner.bind import _contains_agg
         sel = sub.select
         if not isinstance(sel, A.Select) or not isinstance(sel.from_, A.TableRef):
             return None
         if sel.group_by or sel.having or sel.limit is not None \
-                or len(sel.items) != 1:
+                or sel.offset or len(sel.items) != 1:
             return None
         item = sel.items[0]
-        if not _contains_agg(item.expr):
+        has_agg = _contains_agg(item.expr)
+        if agg_only and not has_agg:
             return None
         inner = {sel.from_.alias or sel.from_.name}
         if _outer_refs(item.expr, outer, inner):
             return None
-        corr, inner_only = [], []
-        for c in _split_and(sel.where):
-            if not _outer_refs(c, outer, inner):
-                inner_only.append(c)
-                continue
-            if not (isinstance(c, A.BinOp) and c.op == "="):
-                return None
-            l_out = _outer_refs(c.left, outer, inner)
-            r_out = _outer_refs(c.right, outer, inner)
-            if l_out and not r_out:
-                corr.append((c.left, c.right))
-            elif r_out and not l_out:
-                corr.append((c.right, c.left))
-            else:
-                return None
-        if len(corr) != 1:
+        split = _collect_equality_corr(sel.where, outer, inner)
+        if split is None or not split[0]:
             return None
-        outer_e, inner_e = corr[0]
+        corr, inner_only = split
         counter[0] += 1
-        alias = f"__corr_{counter[0]}"
-        derived = A.Select(
-            [A.SelectItem(inner_e, "__ck"), A.SelectItem(item.expr, "__cv")],
-            sel.from_, _and_all(inner_only), group_by=[inner_e])
-        joins.append((alias, derived, outer_e))
+        key_items = [A.SelectItem(ie, f"__ck{i + 1}")
+                     for i, (_oe, ie) in enumerate(corr)]
+        if has_agg:
+            alias = f"__corr_{counter[0]}"
+            derived = A.Select(
+                key_items + [A.SelectItem(item.expr, "__cv")],
+                sel.from_, _and_all(inner_only),
+                group_by=[ie for _oe, ie in corr])
+        else:
+            # single-row scalar: max() over one row IS the row; the
+            # __cnt rider lets materialization enforce single-row-ness.
+            # For SELECT DISTINCT, count distinct non-null values and
+            # let the materialization check add one when NULL rows are
+            # present (a NULL is one distinct row to PG) — DISTINCT
+            # dedups before the one-row rule applies
+            alias = f"__corr1row_{counter[0]}"
+            extra = [A.SelectItem(A.FuncCall("max", (item.expr,)), "__cv")]
+            if sel.distinct:
+                extra += [
+                    A.SelectItem(A.FuncCall("count", (item.expr,),
+                                            distinct=True), "__cnt"),
+                    A.SelectItem(A.BinOp(
+                        "-", A.FuncCall("count", (A.Star(),)),
+                        A.FuncCall("count", (item.expr,))), "__cntnull")]
+            else:
+                extra += [A.SelectItem(A.FuncCall("count", (A.Star(),)),
+                                       "__cnt")]
+            derived = A.Select(
+                key_items + extra,
+                sel.from_, _and_all(inner_only),
+                group_by=[ie for _oe, ie in corr])
+        joins.append((alias, derived, [oe for oe, _ie in corr]))
         repl: A.Expr = A.ColumnRef("__cv", table=alias)
-        if isinstance(item.expr, A.FuncCall) and item.expr.name == "count":
+        if has_agg and isinstance(item.expr, A.FuncCall) \
+                and item.expr.name == "count":
             repl = A.FuncCall("coalesce", (repl, A.Literal(0, "int")))
         return repl
 
@@ -352,7 +385,19 @@ def decorrelate_scalars(stmt: A.Select) -> A.Select:
         if isinstance(e, A.Between):
             return A.Between(rwx(e.expr), rwx(e.lo), rwx(e.hi), e.negated)
         if isinstance(e, A.InList):
-            return A.InList(rwx(e.expr), tuple(rwx(i) for i in e.items), e.negated)
+            # IN-list subqueries are SET-valued UNLESS the item is an
+            # ungrouped aggregate (exactly one value): only the
+            # aggregate shape may decorrelate as a scalar here; true
+            # set subqueries go to the correlated-IN (decorrelate_where)
+            # / materialize (rewrite_subqueries) paths
+            items = []
+            for i in e.items:
+                if isinstance(i, A.Subquery):
+                    r = maybe_rewrite(i, agg_only=True)
+                    items.append(r if r is not None else i)
+                else:
+                    items.append(rwx(i))
+            return A.InList(rwx(e.expr), tuple(items), e.negated)
         if isinstance(e, A.IsNull):
             return A.IsNull(rwx(e.expr), e.negated)
         if isinstance(e, A.Cast):
@@ -369,12 +414,116 @@ def decorrelate_scalars(stmt: A.Select) -> A.Select:
     if not joins:
         return stmt
     new_from = stmt.from_
-    for alias, derived, outer_e in joins:
-        new_from = A.Join(
-            new_from, A.SubqueryRef(derived, alias), "left",
-            A.BinOp("=", outer_e, A.ColumnRef("__ck", table=alias)))
+    for alias, derived, outer_es in joins:
+        cond = _and_all([
+            A.BinOp("=", oe, A.ColumnRef(f"__ck{i + 1}", table=alias))
+            for i, oe in enumerate(outer_es)])
+        new_from = A.Join(new_from, A.SubqueryRef(derived, alias),
+                          "left", cond)
     return A.Select(new_items, new_from, new_where, [], None,
-                    stmt.order_by, stmt.limit, stmt.offset, stmt.distinct)
+                    stmt.order_by, stmt.limit, stmt.offset, stmt.distinct,
+                    stmt.windows)
+
+
+def _sub_outer_refs(sel: A.Select, outer: set) -> bool:
+    """Does the subquery reference any outer alias anywhere?"""
+    if not isinstance(sel, A.Select):
+        return False
+    inner = _from_aliases(sel.from_) if sel.from_ is not None else set()
+    exprs = ([i.expr for i in sel.items] + [sel.where, sel.having]
+             + list(sel.group_by))
+    return any(e is not None and _outer_refs(e, outer, inner) for e in exprs)
+
+
+def decorrelate_where(stmt: A.Select) -> A.Select:
+    """Multi-key equality-correlated [NOT] EXISTS and positive
+    correlated IN in top-level WHERE conjuncts become semi/anti joins
+    on distinct derived tables (reference: sublink-to-join pull-up,
+    recursive_planning.c):
+
+        WHERE EXISTS (SELECT 1 FROM u WHERE u.a = t.a AND u.b = t.b)
+        -> JOIN (SELECT DISTINCT a __ck1, b __ck2 FROM u) __semi_1
+           ON t.a = __semi_1.__ck1 AND t.b = __semi_1.__ck2
+
+    NOT EXISTS LEFT-JOINs the same derived and keeps only unmatched
+    rows (anti join; NULL outer keys never match and are preserved).
+    Correlated ``expr IN (SELECT x ...)`` desugars to EXISTS with the
+    extra equality ``x = expr`` first — sound in WHERE context, where
+    NULL and FALSE both filter.  Single-key EXISTS elsewhere (under OR
+    etc.) keeps the expression-level IN rewrite."""
+    if stmt.from_ is None or stmt.where is None:
+        return stmt
+    outer = _from_aliases(stmt.from_)
+    counter = [0]
+    joins: list = []   # (alias, derived, [outer keys], anti)
+    new_conjs: list = []
+    changed = False
+    for c in _split_and(stmt.where):
+        # correlated IN -> EXISTS desugar (positive conjuncts only)
+        if isinstance(c, A.InList) and not c.negated and len(c.items) == 1 \
+                and isinstance(c.items[0], A.Subquery):
+            from citus_tpu.planner.bind import _contains_agg
+            sub = c.items[0].select
+            if isinstance(sub, A.Select) and isinstance(sub.from_, A.TableRef) \
+                    and len(sub.items) == 1 and not sub.group_by \
+                    and not sub.having and sub.limit is None \
+                    and not sub.offset and not sub.distinct \
+                    and not _contains_agg(sub.items[0].expr) \
+                    and _sub_outer_refs(sub, outer):
+                c = A.Exists(A.Select(
+                    [A.SelectItem(A.Literal(1, "int"))], sub.from_,
+                    _and_all(_split_and(sub.where)
+                             + [A.BinOp("=", sub.items[0].expr, c.expr)])))
+        neg, e = False, c
+        if isinstance(e, A.UnOp) and e.op == "not" \
+                and isinstance(e.operand, A.Exists):
+            neg, e = True, e.operand
+        if isinstance(e, A.Exists):
+            from citus_tpu.planner.bind import _contains_agg
+            sel = e.select
+            if isinstance(sel, A.Select) and isinstance(sel.from_, A.TableRef) \
+                    and not sel.group_by and not sel.having \
+                    and sel.limit is None and not sel.offset:
+                if any(isinstance(i.expr, A.Expr) and _contains_agg(i.expr)
+                       for i in sel.items):
+                    # ungrouped aggregate: exactly one row, EXISTS is
+                    # unconditionally true (PostgreSQL semantics)
+                    new_conjs.append(A.Literal(not neg, "bool"))
+                    changed = True
+                    continue
+                inner = {sel.from_.alias or sel.from_.name}
+                items_ok = not any(_outer_refs(i.expr, outer, inner)
+                                   for i in sel.items)
+                split = _collect_equality_corr(sel.where, outer, inner) \
+                    if items_ok else None
+                if split is not None and split[0]:
+                    corr, inner_only = split
+                    counter[0] += 1
+                    alias = f"__semi_{counter[0]}"
+                    derived = A.Select(
+                        [A.SelectItem(ie, f"__ck{i + 1}")
+                         for i, (_oe, ie) in enumerate(corr)],
+                        sel.from_, _and_all(inner_only), distinct=True)
+                    joins.append((alias, derived,
+                                  [oe for oe, _ie in corr], neg))
+                    if neg:
+                        new_conjs.append(A.IsNull(
+                            A.ColumnRef("__ck1", table=alias)))
+                    changed = True
+                    continue
+        new_conjs.append(c)
+    if not changed:
+        return stmt
+    import dataclasses
+    new_from = stmt.from_
+    for alias, derived, outer_es, anti in joins:
+        cond = _and_all([
+            A.BinOp("=", oe, A.ColumnRef(f"__ck{i + 1}", table=alias))
+            for i, oe in enumerate(outer_es)])
+        new_from = A.Join(new_from, A.SubqueryRef(derived, alias),
+                          "left" if anti else "inner", cond)
+    return dataclasses.replace(stmt, from_=new_from,
+                               where=_and_all(new_conjs))
 
 
 def rewrite_subqueries(stmt: A.Select, run_select) -> A.Select:

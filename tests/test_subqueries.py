@@ -167,3 +167,125 @@ def test_correlated_scalar_subqueries(tmp_path):
         theirs = [tuple(r) for r in sq.execute(sql).fetchall()]
         assert ours == theirs, (sql, ours[:6], theirs[:6])
     cl.close()
+
+
+# ---- round-2 gap #6: correlation beyond single equality ---------------
+
+@pytest.fixture()
+def cdb(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "cdb"), n_nodes=2)
+    cl.execute("CREATE TABLE o (ok bigint NOT NULL, oa bigint, ob bigint, ov bigint)")
+    cl.execute("CREATE TABLE i (ik bigint NOT NULL, ia bigint, ib bigint, iv bigint)")
+    cl.execute("SELECT create_distributed_table('o', 'ok', 4)")
+    cl.execute("SELECT create_distributed_table('i', 'ik', 4)")
+    orows = [(n, n % 7, n % 5, n % 11) for n in range(300)]
+    irows = [(n, n % 9, n % 5, n % 13) for n in range(120)]
+    cl.copy_from("o", rows=orows)
+    cl.copy_from("i", rows=irows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE o (ok INTEGER, oa INTEGER, ob INTEGER, ov INTEGER)")
+    sq.execute("CREATE TABLE i (ik INTEGER, ia INTEGER, ib INTEGER, iv INTEGER)")
+    sq.executemany("INSERT INTO o VALUES (?,?,?,?)", orows)
+    sq.executemany("INSERT INTO i VALUES (?,?,?,?)", irows)
+    return cl, sq
+
+
+CORRELATED_QUERIES = [
+    # multi-key EXISTS / NOT EXISTS
+    "SELECT count(*) FROM o WHERE EXISTS (SELECT 1 FROM i WHERE "
+    "i.ia = o.oa AND i.ib = o.ob)",
+    "SELECT count(*) FROM o WHERE NOT EXISTS (SELECT 1 FROM i WHERE "
+    "i.ia = o.oa AND i.ib = o.ob AND i.iv > 5)",
+    # multi-key EXISTS with inner-only predicates + other conjuncts
+    "SELECT oa, count(*) FROM o WHERE EXISTS (SELECT 1 FROM i WHERE "
+    "i.ia = o.oa AND i.ib = o.ob AND i.iv < 9) AND o.ov > 2 "
+    "GROUP BY oa ORDER BY oa",
+    # correlated IN (single extra key -> 2-key EXISTS)
+    "SELECT count(*) FROM o WHERE o.ov IN (SELECT i.iv FROM i WHERE "
+    "i.ia = o.oa)",
+    # correlated IN composed with other predicates
+    "SELECT count(*) FROM o WHERE o.ov IN (SELECT i.iv FROM i WHERE "
+    "i.ib = o.ob AND i.ik < 60) AND o.oa < 5",
+    # multi-key correlated scalar aggregate
+    "SELECT ok, (SELECT sum(i.iv) FROM i WHERE i.ia = o.oa AND "
+    "i.ib = o.ob) FROM o ORDER BY ok LIMIT 40",
+    "SELECT ok, (SELECT count(*) FROM i WHERE i.ia = o.oa AND "
+    "i.ib = o.ob) FROM o ORDER BY ok LIMIT 40",
+]
+
+
+@pytest.mark.parametrize("sql", CORRELATED_QUERIES)
+def test_correlated_vs_sqlite(cdb, sql):
+    cl, sq = cdb
+    ours = sorted(cl.execute(sql).rows, key=repr)
+    theirs = sorted(sq.execute(sql).fetchall(), key=repr)
+    assert ours == theirs
+
+
+def test_noagg_correlated_scalar(tmp_path):
+    """Non-aggregate correlated scalar: unique inner keys work; a
+    duplicated key raises PostgreSQL's multi-row error."""
+    cl = ct.Cluster(str(tmp_path / "na"), n_nodes=2)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("CREATE TABLE lk (lk_k bigint NOT NULL, lk_v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", rows=[(i, i % 4) for i in range(50)])
+    cl.copy_from("lk", rows=[(i, i * 100) for i in range(4)])
+    r = cl.execute("SELECT k, (SELECT lk.lk_v FROM lk WHERE lk.lk_k = t.v) "
+                   "FROM t ORDER BY k LIMIT 5")
+    assert r.rows == [(0, 0), (1, 100), (2, 200), (3, 300), (4, 0)]
+    # missing key -> NULL
+    cl.execute("INSERT INTO t VALUES (100, 99)")
+    r2 = cl.execute("SELECT (SELECT lk.lk_v FROM lk WHERE lk.lk_k = t.v) "
+                    "FROM t WHERE k = 100")
+    assert r2.rows == [(None,)]
+    # duplicate inner key -> runtime error, as in PostgreSQL
+    cl.execute("INSERT INTO lk VALUES (2, 999)")
+    with pytest.raises(AnalysisError, match="more than one row"):
+        cl.execute("SELECT k, (SELECT lk.lk_v FROM lk WHERE lk.lk_k = t.v) "
+                   "FROM t ORDER BY k")
+    cl.close()
+
+
+def test_exists_under_or_still_works(cdb):
+    """EXISTS not in a top-level conjunct keeps the expression path."""
+    cl, sq = cdb
+    sql = ("SELECT count(*) FROM o WHERE o.oa = 6 OR EXISTS "
+           "(SELECT 1 FROM i WHERE i.ia = o.ob)")
+    assert cl.execute(sql).rows == list(sq.execute(sql).fetchall())
+
+
+def test_correlated_in_with_aggregate_item(cdb):
+    """IN over a correlated AGGREGATE subquery: one value per outer
+    row, not a set — must not desugar to a multi-key semi join."""
+    cl, sq = cdb
+    sql = ("SELECT count(*) FROM o WHERE o.ov IN "
+           "(SELECT max(i.iv) FROM i WHERE i.ia = o.oa)")
+    assert cl.execute(sql).rows == list(sq.execute(sql).fetchall())
+
+
+def test_exists_over_ungrouped_aggregate_is_true(cdb):
+    """EXISTS (SELECT count(*) ...) is always true: an ungrouped
+    aggregate returns exactly one row."""
+    cl, sq = cdb
+    sql = ("SELECT count(*) FROM o WHERE EXISTS "
+           "(SELECT count(*) FROM i WHERE i.ia = o.oa)")
+    assert cl.execute(sql).rows == list(sq.execute(sql).fetchall())
+    sql2 = ("SELECT count(*) FROM o WHERE NOT EXISTS "
+            "(SELECT sum(i.iv) FROM i WHERE i.ia = o.oa)")
+    assert cl.execute(sql2).rows == [(0,)]
+
+
+def test_distinct_scalar_subquery_not_decorrelated(tmp_path):
+    """SELECT DISTINCT dedups before the one-row rule; duplicates with a
+    single distinct value must not raise."""
+    cl = ct.Cluster(str(tmp_path / "ds"), n_nodes=2)
+    cl.execute("CREATE TABLE t2 (k bigint NOT NULL, v bigint)")
+    cl.execute("CREATE TABLE lk (lk_k bigint, lk_v bigint)")
+    cl.execute("SELECT create_distributed_table('t2', 'k', 2)")
+    cl.copy_from("t2", rows=[(1, 0), (2, 1)])
+    cl.copy_from("lk", rows=[(0, 5), (0, 5), (1, 7)])
+    r = cl.execute("SELECT k, (SELECT DISTINCT lk.lk_v FROM lk "
+                   "WHERE lk.lk_k = t2.v) FROM t2 ORDER BY k")
+    assert r.rows == [(1, 5), (2, 7)]
+    cl.close()
