@@ -59,7 +59,7 @@ def _combine_kinds(plan: PhysicalPlan) -> list[str]:
     kinds = []
     for op in plan.partial_ops:
         kinds.append({"sum": "sum", "count": "sum", "min": "min",
-                      "max": "max", "hll": "max"}[op.kind])
+                      "max": "max", "hll": "max", "ddsk": "sum"}[op.kind])
     if plan.group_mode.kind == "direct":
         kinds.append("sum")  # group row counts
     return kinds
@@ -142,6 +142,9 @@ def _empty_partials(plan: PhysicalPlan, xp):
         if op.kind == "hll":
             from citus_tpu.planner.aggregates import HLL_M
             outs.append(np.zeros((HLL_M,), np.int32))
+        elif op.kind == "ddsk":
+            from citus_tpu.planner.aggregates import DDSK_M
+            outs.append(np.zeros((DDSK_M,), np.int64))
         elif op.kind in ("sum", "count"):
             base = np.int64(0) if op.kind == "count" else dt.type(0)
             outs.append(np.zeros((G,), dt) if G else np.asarray(base, dt))
@@ -310,7 +313,8 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
 
     # distinct/collect partial states are exact value (multi)sets: only
     # the host accumulation path can carry them
-    has_exact = any(op.kind in ("distinct", "collect", "collect_set", "hll")
+    has_exact = any(op.kind in ("distinct", "collect", "collect_set", "hll",
+                                "ddsk")
                     for op in plan.partial_ops)
     if backend != "cpu" and not has_exact:
         import jax

@@ -40,6 +40,10 @@ class HostGroupAccumulator:
                 from citus_tpu.planner.aggregates import HLL_M
                 row.append(np.zeros(HLL_M, np.int32))
                 continue
+            if op.kind == "ddsk":
+                from citus_tpu.planner.aggregates import DDSK_M
+                row.append(np.zeros(DDSK_M, np.int64))
+                continue
             dt = np.dtype(op.dtype)
             if op.kind in ("min", "max"):
                 row.append(dt.type(_sentinel(op.kind, dt)))
@@ -117,6 +121,20 @@ class HostGroupAccumulator:
                 local.append([flat[g * HLL_M:(g + 1) * HLL_M]
                               for g in range(L)])
                 continue
+            if op.kind == "ddsk":
+                from citus_tpu.planner.aggregates import (
+                    DDSK_M, ddsk_bucket_indexes,
+                )
+                v, ok = arg_np[op.arg_index]
+                bucket = ddsk_bucket_indexes(np, np.asarray(v))
+                flat = np.zeros(L * DDSK_M, np.int64)
+                nz = np.nonzero(ok)[0]
+                if nz.size:
+                    idx = inverse[nz].astype(np.int64) * DDSK_M + bucket[nz]
+                    np.add.at(flat, idx, 1)
+                local.append([flat[g * DDSK_M:(g + 1) * DDSK_M]
+                              for g in range(L)])
+                continue
             if op.kind == "collect":
                 v, ok = arg_np[op.arg_index]
                 lists = [[] for _ in range(L)]
@@ -162,6 +180,8 @@ class HostGroupAccumulator:
                 elif op.kind == "hll":
                     np.maximum(self._accs[gi][pi], local[pi][li],
                                out=self._accs[gi][pi])
+                elif op.kind == "ddsk":
+                    self._accs[gi][pi] += local[pi][li]
                 elif op.kind == "collect":
                     self._accs[gi][pi].extend(local[pi][li])
                 elif op.kind in ("sum", "count"):
@@ -231,7 +251,7 @@ class HostGroupAccumulator:
                 for g in range(G):
                     a[g] = self._accs[g][pi]
                 partials.append(a)
-            elif op.kind == "hll":
+            elif op.kind in ("hll", "ddsk"):
                 partials.append(np.stack(
                     [self._accs[g][pi] for g in range(G)]))
             elif op.kind == "distinct":

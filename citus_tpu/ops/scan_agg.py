@@ -82,6 +82,25 @@ def build_worker_fn(plan: PhysicalPlan, xp) -> Callable:
                     outs.append(xp.min(xp.where(ok, v, dt.type(_sentinel("min", dt))).astype(dt)))
                 elif op.kind == "max":
                     outs.append(xp.max(xp.where(ok, v, dt.type(_sentinel("max", dt))).astype(dt)))
+                elif op.kind == "ddsk":
+                    # DDSketch log-bucket histogram: per-row bucket id,
+                    # one-hot segment sum into [M] — combinable across
+                    # shards with the same psum as plain sum partials.
+                    # numpy would materialize the [M, N] one-hot (M=2048
+                    # — 16x HLL's), so the host backend bincounts instead
+                    from citus_tpu.planner.aggregates import (
+                        DDSK_M, ddsk_bucket_indexes,
+                    )
+                    bucket = ddsk_bucket_indexes(xp, xp.asarray(v))
+                    if xp.__name__ == "numpy":
+                        outs.append(np.bincount(
+                            bucket[np.asarray(ok)],
+                            minlength=DDSK_M).astype(np.int64))
+                    else:
+                        onehot = bucket[None, :] == xp.arange(
+                            DDSK_M, dtype=np.int32)[:, None]
+                        outs.append(xp.sum(
+                            (onehot & ok[None, :]).astype(np.int64), axis=1))
                 elif op.kind == "hll":
                     # HyperLogLog registers: per-row (bucket, rho), then a
                     # one-hot segment max into [m] — combinable across
@@ -217,12 +236,14 @@ def combine_partials_host(plan: PhysicalPlan, shard_partials: list[tuple]) -> tu
     out = []
     for i, op in enumerate(ops):
         stack = np.stack([np.asarray(sp[i]) for sp in shard_partials])
-        if op.kind in ("sum", "count"):
+        if op.kind in ("sum", "count", "ddsk"):
             out.append(stack.sum(axis=0))
         elif op.kind == "min":
             out.append(stack.min(axis=0))
         elif op.kind in ("max", "hll"):
             out.append(stack.max(axis=0))
+        else:
+            raise AssertionError(f"uncombinable partial kind {op.kind!r}")
     if has_rows:
         rows = np.stack([np.asarray(sp[n]) for sp in shard_partials]).sum(axis=0)
         return tuple(out) + (rows,)

@@ -261,20 +261,28 @@ def _finalize_array_agg(ex, partials, cat):
     return out, valid
 
 
-def _bind_percentile(binder, e):
-    """percentile_cont(frac) WITHIN GROUP (ORDER BY x) arrives desugared
-    as FuncCall(name, (frac_literal, x))."""
+def _percentile_fraction(e) -> float:
+    """Validate fn(frac) WITHIN GROUP desugar: two args, numeric literal
+    fraction in [0, 1]."""
+    import decimal
     from citus_tpu.planner import ast_nodes as A
-    from citus_tpu.planner.bind import AggSpec
     if len(e.args) != 2:
         raise AnalysisError(f"{e.name}() requires WITHIN GROUP (ORDER BY ...)")
     f = e.args[0]
-    if not (isinstance(f, A.Literal) and isinstance(f.value, (int, float)) or
-            (isinstance(f, A.Literal) and str(type(f.value).__name__) == "Decimal")):
+    if not (isinstance(f, A.Literal)
+            and isinstance(f.value, (int, float, decimal.Decimal))):
         raise AnalysisError(f"{e.name}() fraction must be a numeric literal")
     frac = float(f.value)
     if not (0.0 <= frac <= 1.0):
         raise AnalysisError("percentile fraction must be in [0, 1]")
+    return frac
+
+
+def _bind_percentile(binder, e):
+    """percentile_cont(frac) WITHIN GROUP (ORDER BY x) arrives desugared
+    as FuncCall(name, (frac_literal, x))."""
+    from citus_tpu.planner.bind import AggSpec
+    frac = _percentile_fraction(e)
     arg = binder.bind_scalar(e.args[1])
     if arg.type.is_text:
         raise UnsupportedFeatureError(f"{e.name}() over text not supported")
@@ -413,6 +421,88 @@ def _finalize_approx_distinct(ex, partials, cat):
     return out, np.ones(out.shape, bool)
 
 
+# ---------------------------------- approximate percentiles (DDSketch)
+#
+# The reference pushes percentile computation down via the t-digest
+# extension (planner/tdigest_extension.c:250): workers build sketches,
+# the coordinator combines them.  A t-digest's variable-size centroid
+# list is a poor fit for fixed-shape device code; the TPU-native
+# equivalent is a DDSketch-style log-bucketed histogram: a FIXED vector
+# of bucket counts per group, built with the same one-hot segment-sum
+# the other aggregates use, and combined across shards with one psum —
+# identical machinery to a plain sum partial, just vector-valued.
+# Relative value error is bounded by the bucket width (~2.7% here).
+
+DDSK_HALF = 1024                      # buckets per sign
+DDSK_M = 2 * DDSK_HALF                # neg 0..1022 | zero 1023 | pos 1024..
+DDSK_LOG_MIN = float(np.log(1e-12))   # smallest resolved magnitude
+DDSK_LNG = float(np.log(1e24)) / DDSK_HALF  # ln(gamma): 1e-12..1e12 span
+
+
+def ddsk_bucket_indexes(xp, v):
+    """float values -> bucket index [N] int32 (callers mask invalid
+    rows themselves)."""
+    val = v.astype(np.float64)
+    mag = xp.abs(val)
+    li = xp.clip(
+        xp.floor((xp.log(xp.maximum(mag, 1e-300)) - DDSK_LOG_MIN) / DDSK_LNG),
+        0, DDSK_HALF - 1).astype(np.int32)
+    neg_idx = np.int32(DDSK_HALF - 2) - xp.minimum(li, np.int32(DDSK_HALF - 2))
+    pos_idx = np.int32(DDSK_HALF) + li
+    return xp.where(val > 0, pos_idx,
+                    xp.where(val < 0, neg_idx, np.int32(DDSK_HALF - 1)))
+
+
+def ddsk_bucket_values() -> np.ndarray:
+    """Representative value per bucket (geometric midpoint)."""
+    j = np.arange(DDSK_M, dtype=np.float64)
+    pos = np.exp(DDSK_LOG_MIN + (j - DDSK_HALF + 0.5) * DDSK_LNG)
+    neg = -np.exp(DDSK_LOG_MIN + ((DDSK_HALF - 2 - j) + 0.5) * DDSK_LNG)
+    vals = np.where(j >= DDSK_HALF, pos, neg)
+    vals[DDSK_HALF - 1] = 0.0
+    return vals
+
+
+def _bind_approx_percentile(binder, e):
+    """approx_percentile(frac) WITHIN GROUP (ORDER BY x): sketch-based,
+    device-combinable percentile (cont-style rank selection, value
+    resolved to the containing log bucket)."""
+    from citus_tpu.planner.bind import AggSpec
+    frac = _percentile_fraction(e)
+    arg = binder.bind_scalar(e.args[1])
+    if not (arg.type.is_integer or arg.type.is_float or arg.type.is_decimal):
+        raise AnalysisError(f"approx_percentile() over {arg.type} "
+                            "not supported")
+    return AggSpec("approx_percentile", _as_float(arg), T.FLOAT64_T,
+                   param=frac)
+
+
+def _lower_approx_percentile(spec, arg_slot, partial_slot):
+    from citus_tpu.planner.physical import AggExtract
+    ai = arg_slot(spec.arg)
+    s = partial_slot("ddsk", ai, "int64")
+    return AggExtract("approx_percentile", [s], spec.out_type,
+                      param=spec.param)
+
+
+def _finalize_approx_percentile(ex, partials, cat):
+    counts = np.asarray(partials[ex.slots[0]], np.int64)
+    if counts.ndim == 1:
+        counts = counts[None, :]
+    vals = ddsk_bucket_values()
+    out = np.zeros(counts.shape[0], np.float64)
+    valid = np.zeros(counts.shape[0], bool)
+    for g in range(counts.shape[0]):
+        total = int(counts[g].sum())
+        if total == 0:
+            continue
+        valid[g] = True
+        rank = int(math.floor(ex.param * (total - 1)))
+        cum = np.cumsum(counts[g])
+        out[g] = vals[int(np.searchsorted(cum, rank + 1, side="left"))]
+    return out, valid
+
+
 # ----------------------------------------------- DISTINCT sum/avg
 
 
@@ -476,6 +566,9 @@ for _n in ("sum_distinct", "avg_distinct"):
                     needs_exact=True))
 register(AggDef("approx_count_distinct", _bind_approx_distinct,
                 _lower_approx_distinct, _finalize_approx_distinct,
+                host_grouped=True))
+register(AggDef("approx_percentile", _bind_approx_percentile,
+                _lower_approx_percentile, _finalize_approx_percentile,
                 host_grouped=True))
 
 

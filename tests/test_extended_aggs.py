@@ -245,3 +245,69 @@ def test_approx_count_distinct(tmp_path):
     assert cl2.execute("SELECT approx_count_distinct(v) FROM t").rows[0][0] == est
     cl2.close()
     cl.close()
+
+
+# ------------- approx_percentile (DDSketch device sketch, gap #8) -----
+
+def test_approx_percentile_scalar(db):
+    """Sketch percentile within the bucket's relative-error bound of the
+    exact percentile (reference: t-digest pushdown,
+    tdigest_extension.c:250)."""
+    cl, data = db
+    for frac in (0.1, 0.5, 0.9, 0.99):
+        r = cl.execute(f"SELECT approx_percentile({frac}) WITHIN GROUP "
+                       "(ORDER BY f) FROM t")
+        exact = float(np.percentile(data["f"], frac * 100))
+        got = float(r.rows[0][0])
+        assert math.isclose(got, exact, rel_tol=0.06, abs_tol=0.5), \
+            (frac, got, exact)
+
+
+def test_approx_percentile_grouped(db):
+    cl, data = db
+    r = cl.execute("SELECT g, approx_percentile(0.5) WITHIN GROUP "
+                   "(ORDER BY v) FROM t GROUP BY g ORDER BY g")
+    for g, got in r.rows:
+        vals = data["v"][data["g"] == g]
+        exact = float(np.percentile(vals, 50))
+        assert math.isclose(float(got), exact, rel_tol=0.06, abs_tol=1.0), \
+            (g, got, exact)
+
+
+def test_approx_percentile_negative_and_int(db):
+    """Negative values route through the mirrored bucket half."""
+    cl, data = db
+    r = cl.execute("SELECT approx_percentile(0.05) WITHIN GROUP "
+                   "(ORDER BY v) FROM t")
+    exact = float(np.percentile(data["v"], 5))
+    got = float(r.rows[0][0])
+    assert math.isclose(got, exact, rel_tol=0.06, abs_tol=1.0), (got, exact)
+
+
+def test_approx_percentile_matches_cpu_oracle(db):
+    """Device combine of bucket vectors == numpy host path.  The SCALAR
+    shape is the one that rides the device worker + 'ddsk'->'sum'
+    combine (grouped queries route host via host_grouped), so that is
+    the shape the oracle comparison must use."""
+    cl, _ = db
+    sql = ("SELECT approx_percentile(0.9) WITHIN GROUP (ORDER BY f) "
+           "FROM t")
+    got = cl.execute(sql)
+    with settings_override(executor=ExecutorSettings(
+            task_executor_backend="cpu")):
+        oracle = cl.execute(sql)
+    assert got.rows == oracle.rows
+
+
+def test_approx_percentile_empty_and_nulls(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "ap"))
+    cl.execute("CREATE TABLE e (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('e', 'k', 2)")
+    r = cl.execute("SELECT approx_percentile(0.5) WITHIN GROUP "
+                   "(ORDER BY v) FROM e")
+    assert r.rows == [(None,)]
+    cl.execute("INSERT INTO e VALUES (1, NULL), (2, 42)")
+    r2 = cl.execute("SELECT approx_percentile(0.5) WITHIN GROUP "
+                    "(ORDER BY v) FROM e")
+    assert math.isclose(float(r2.rows[0][0]), 42.0, rel_tol=0.06)
+    cl.close()
