@@ -140,6 +140,12 @@ def _bind_sort_keys(binder, e):
     for oe, asc in getattr(e, "agg_order", ()):
         b = binder.bind_scalar(oe)
         if b.type.is_text:
+            # enum columns order by declaration rank (enumsortorder)
+            enum_rank = binder.enum_rank(b)
+            if enum_rank is not None:
+                exprs.append(enum_rank)
+                ascs.append(bool(asc))
+                continue
             resolved = binder._text_words(b)
             if resolved is None:
                 raise UnsupportedFeatureError(

@@ -155,6 +155,69 @@ class Binder:
             eff = [mapping[i] if i < len(mapping) else i for i in eff]
         return base, tname, cname, [words[i] for i in eff]
 
+    def enum_info(self, target):
+        """BARE enum-typed column -> (base column, type name, labels,
+        dictionary words) or None.  A string-function remap over an enum
+        column (upper(s), ...) produces plain text, not enum values —
+        it must NOT get declaration-rank semantics."""
+        if not (isinstance(target, BColumn) and target.type.is_text):
+            return None
+        tname, cname = self.text_source(target)
+        type_name = self.catalog.enum_columns.get(f"{tname}.{cname}")
+        if type_name is None:
+            return None
+        labels = list(self.catalog.types.get(type_name, ()))
+        words = self.catalog.dictionary(tname, cname)
+        return target, type_name, labels, words
+
+    @staticmethod
+    def enum_rank_lut(info) -> tuple:
+        """(enum_info) -> per-dictionary-id declaration rank table."""
+        _base, _type_name, labels, words = info
+        rank_of = {w: i for i, w in enumerate(labels)}
+        return tuple(rank_of.get(w, -1) for w in words)
+
+    def enum_rank(self, target) -> Optional[BExpr]:
+        """Enum column -> its declaration-order rank (int64), via a
+        per-dictionary-id lookup table (reference: enum comparisons use
+        enumsortorder, not label text)."""
+        from citus_tpu.planner.bound import BDictLookup
+        info = self.enum_info(target)
+        if info is None:
+            return None
+        return BDictLookup(info[0], self.enum_rank_lut(info))
+
+    def _try_enum_ordered(self, op: str, left: BExpr,
+                          right: BExpr) -> Optional[BExpr]:
+        """Ordered comparison where a side is an enum column: compare
+        declaration-order ranks.  Literal labels validate against the
+        type; mismatched enum types reject."""
+        linfo = self.enum_info(left) if left.type.is_text else None
+        rinfo = self.enum_info(right) if right.type.is_text else None
+        if linfo is None and rinfo is None:
+            return None
+
+        def side(e, info, other_info):
+            if info is not None:
+                return self.enum_rank(e), info[1]
+            if isinstance(e, BLiteral) and isinstance(e.value, str):
+                _b, type_name, labels, _w = other_info
+                if e.value not in labels:
+                    raise AnalysisError(
+                        f"invalid input value for enum {type_name}: "
+                        f"{e.value!r}")
+                return BLiteral(labels.index(e.value), T.INT64_T), type_name
+            return None, None
+
+        lr, lt_name = side(left, linfo, rinfo)
+        rr, rt_name = side(right, rinfo, linfo)
+        if lr is None or rr is None:
+            return None
+        if lt_name != rt_name:
+            raise AnalysisError(
+                f"cannot compare enum types {lt_name} and {rt_name}")
+        return BBinOp(op, lr, rr, T.BOOL_T)
+
     def _remap_text(self, fname: str, target, op):
         """Bind a string function as a dictionary remap on the base
         column (composable with other remap-family functions).  String
@@ -339,6 +402,13 @@ class Binder:
         right = self.bind_scalar(e.right, allow_agg)
         if op in ("and", "or"):
             return BBinOp(op, self._to_bool(left), self._to_bool(right), T.BOOL_T)
+        if op in ("<", "<=", ">", ">=") \
+                and (left.type.is_text or right.type.is_text):
+            # enum columns order by declaration rank (before _align
+            # coerces the literal side into dictionary-id space)
+            enum_cmp = self._try_enum_ordered(op, left, right)
+            if enum_cmp is not None:
+                return enum_cmp
         left, right = self._align(left, right)
         if op in ("=", "<>", "<", "<=", ">", ">="):
             if left.type.is_text and op not in ("=", "<>"):
@@ -857,6 +927,26 @@ def bind_select(catalog: Catalog, stmt: A.Select,
             idx = len(final_exprs) - 1
             hidden += 1
         order_by.append((idx, oi.ascending, oi.nulls_first))
+
+    # enum ORDER BY keys sort by declaration rank, not label text
+    # (reference: enum ordering via enumsortorder): redirect to a hidden
+    # rank column — functionally dependent on the enum value, so
+    # DISTINCT results are unchanged
+    from citus_tpu.planner.bound import BDictLookup
+    for oi_pos, (idx, asc, nf) in enumerate(order_by):
+        e_b = final_exprs[idx]
+        under = e_b
+        if isinstance(e_b, BKeyRef) and group_keys:
+            under = group_keys[e_b.index]
+        if not (isinstance(under, BColumn) and under.type.is_text):
+            continue
+        info = b.enum_info(under)
+        if info is None:
+            continue
+        final_exprs.append(BDictLookup(e_b, Binder.enum_rank_lut(info)))
+        output_names.append(f"__order_{hidden}")
+        order_by[oi_pos] = (len(final_exprs) - 1, asc, nf)
+        hidden += 1
 
     return BoundSelect(
         table=table, filter=where, group_keys=group_keys, aggs=aggs,

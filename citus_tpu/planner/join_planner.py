@@ -250,6 +250,25 @@ def bind_join_select(catalog: Catalog, stmt: A.Select) -> BoundJoinSelect:
             hidden += 1
         order_by.append((idx, oi.ascending, oi.nulls_first))
 
+    # enum ORDER BY keys sort by declaration rank (enumsortorder) — same
+    # redirect as bind_select's: hidden rank column, functionally
+    # dependent on the enum value
+    from citus_tpu.planner.bound import BDictLookup, BKeyRef
+    for oi_pos, (idx, asc, nf) in enumerate(order_by):
+        e_b = final_exprs[idx]
+        under = e_b
+        if isinstance(e_b, BKeyRef) and group_keys:
+            under = group_keys[e_b.index]
+        if not (isinstance(under, BColumn) and under.type.is_text):
+            continue
+        info = binder.enum_info(under)
+        if info is None:
+            continue
+        final_exprs.append(BDictLookup(e_b, binder.enum_rank_lut(info)))
+        output_names.append(f"__order_{hidden}")
+        order_by[oi_pos] = (len(final_exprs) - 1, asc, nf)
+        hidden += 1
+
     agg_args, partial_ops, agg_extract = lower_aggregates(aggs)
 
     # ---- column requirements per relation ------------------------------

@@ -424,3 +424,54 @@ def test_dml_returning_params_and_coercion(tmp_path):
     r = cl.execute("DELETE FROM t WHERE k = $1 RETURNING *", params=[2])
     assert r.rows == [(2, 20, None)] and r.explain["deleted"] == 1
     cl.close()
+
+
+def test_enum_declaration_order(tmp_path):
+    """Enum comparisons and ORDER BY follow declaration order, not label
+    text (reference: enumsortorder; round-2 gap #9)."""
+    from citus_tpu.errors import AnalysisError
+    cl = ct.Cluster(str(tmp_path / "enumord"))
+    cl.execute("CREATE TYPE sev AS ENUM ('low', 'medium', 'high', 'critical')")
+    cl.execute("CREATE TABLE ev (k bigint NOT NULL, s sev)")
+    cl.execute("SELECT create_distributed_table('ev', 'k', 4)")
+    cl.execute("INSERT INTO ev VALUES (1, 'high'), (2, 'low'), (3, 'critical'),"
+               " (4, 'medium'), (5, 'low'), (6, NULL)")
+    # declaration order: low < medium < high < critical (alphabetical
+    # order would put 'critical' < 'high' < 'low' < 'medium')
+    assert cl.execute("SELECT count(*) FROM ev WHERE s > 'medium'").rows == [(2,)]
+    assert cl.execute("SELECT count(*) FROM ev WHERE s <= 'low'").rows == [(2,)]
+    assert cl.execute("SELECT count(*) FROM ev WHERE s >= 'high'").rows == [(2,)]
+    r = cl.execute("SELECT k, s FROM ev WHERE s IS NOT NULL ORDER BY s, k")
+    assert [row[1] for row in r.rows] == \
+        ["low", "low", "medium", "high", "critical"]
+    r2 = cl.execute("SELECT k FROM ev ORDER BY s DESC, k LIMIT 2")
+    # DESC: NULLS FIRST by default, then critical
+    assert r2.rows == [(6,), (3,)]
+    # grouped: ORDER BY the enum key follows declaration order
+    g = cl.execute("SELECT s, count(*) FROM ev WHERE s IS NOT NULL "
+                   "GROUP BY s ORDER BY s")
+    assert [row[0] for row in g.rows] == ["low", "medium", "high", "critical"]
+    # enum-vs-enum column comparison
+    cl.execute("CREATE TABLE ev2 (k bigint NOT NULL, s sev)")
+    cl.execute("SELECT create_distributed_table('ev2', 'k', 4)")
+    cl.execute("INSERT INTO ev2 VALUES (1, 'medium'), (2, 'critical'), (3, 'low')")
+    j = cl.execute("SELECT count(*) FROM ev a JOIN ev2 b ON a.k = b.k "
+                   "WHERE a.s > b.s")
+    assert j.rows == [(2,)]  # k=1 high>medium, k=3 critical>low
+    # invalid label in a comparison errors like PostgreSQL
+    with pytest.raises(AnalysisError, match="invalid input value"):
+        cl.execute("SELECT count(*) FROM ev WHERE s > 'bogus'")
+    # join ORDER BY follows declaration order too
+    jo = cl.execute("SELECT a.k FROM ev a JOIN ev2 b ON a.k = b.k "
+                    "ORDER BY a.s, a.k")
+    assert jo.rows == [(2,), (1,), (3,)]  # low, high, critical
+    # aggregate-internal ORDER BY over the enum column
+    ag = cl.execute("SELECT array_agg(k ORDER BY s) FROM ev "
+                    "WHERE s IS NOT NULL")
+    assert list(ag.rows[0][0]) == [2, 5, 4, 1, 3]  # low,low,medium,high,crit
+    # a string function over an enum column yields TEXT, not enum:
+    # ordered comparison on it must NOT silently use declaration ranks
+    from citus_tpu.errors import UnsupportedFeatureError
+    with pytest.raises(UnsupportedFeatureError):
+        cl.execute("SELECT count(*) FROM ev WHERE upper(s) > 'MEDIUM'")
+    cl.close()
