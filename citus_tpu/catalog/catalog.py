@@ -182,6 +182,16 @@ class Catalog:
         # enum columns are dictionary-encoded text with ingest validation
         self.types: dict[str, list] = {}
         self.enum_columns: dict[str, str] = {}
+        # row-level security: table -> [policy dicts]; rls flags
+        # (reference: commands/policy.c)
+        self.policies: dict[str, list] = {}
+        self.rls: dict[str, bool] = {}
+        # statement-level AFTER triggers: name -> {table, event, function}
+        # (reference: commands/trigger.c)
+        self.triggers: dict[str, dict] = {}
+        # text search configurations (metadata-only propagated objects,
+        # reference: commands/text_search.c)
+        self.ts_configs: dict[str, dict] = {}
         # sequences: name -> {"value": next unreserved, "increment": n,
         # "start": n}; nextval hands out values from an in-memory block
         # reserved by bumping the persisted high-water mark (gaps on
@@ -222,6 +232,10 @@ class Catalog:
         self.functions = d.get("functions", {})
         self.types = d.get("types", {})
         self.enum_columns = d.get("enum_columns", {})
+        self.policies = d.get("policies", {})
+        self.rls = d.get("rls", {})
+        self.triggers = d.get("triggers", {})
+        self.ts_configs = d.get("ts_configs", {})
 
     def export_document(self) -> dict:
         return {
@@ -237,6 +251,10 @@ class Catalog:
             "functions": self.functions,
             "types": self.types,
             "enum_columns": self.enum_columns,
+            "policies": self.policies,
+            "rls": self.rls,
+            "triggers": self.triggers,
+            "ts_configs": self.ts_configs,
         }
 
     def tombstone(self, section: str, name: str) -> None:
@@ -275,7 +293,8 @@ class Catalog:
         for nd in d.get("nodes", []):
             self.nodes.setdefault(nd["node_id"], NodeMeta.from_json(nd))
         for sec in ("views", "sequences", "roles", "functions", "types",
-                    "enum_columns", "schemas"):
+                    "enum_columns", "schemas", "policies", "rls",
+                    "triggers", "ts_configs"):
             disk = d.get(sec, {})
             mem = getattr(self, sec)
             dead = tomb.get(sec, set())

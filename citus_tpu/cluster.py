@@ -20,7 +20,7 @@ import jax
 from citus_tpu.catalog import Catalog, DistributionMethod
 from citus_tpu.config import Settings, current_settings
 from citus_tpu.errors import (
-    AnalysisError, CatalogError, UnsupportedFeatureError,
+    AnalysisError, CatalogError, ExecutionError, UnsupportedFeatureError,
 )
 from citus_tpu.executor import Result, execute_select
 from citus_tpu.ingest import TableIngestor, encode_columns, rows_to_columns
@@ -508,6 +508,14 @@ class Cluster:
         for key in [k for k in self.catalog.enum_columns
                     if k.startswith(name + ".")]:
             del self.catalog.enum_columns[key]
+        if self.catalog.policies.pop(name, None) is not None:
+            self.catalog.tombstone("policies", name)
+        if self.catalog.rls.pop(name, None) is not None:
+            self.catalog.tombstone("rls", name)
+        for tn in [n for n, t in self.catalog.triggers.items()
+                   if t.get("table") == name]:
+            del self.catalog.triggers[tn]
+            self.catalog.tombstone("triggers", tn)
         self.catalog.commit()
 
     def create_distributed_table(self, name: str, dist_column: str,
@@ -677,15 +685,25 @@ class Cluster:
                     # parameterized plans: cached generic plan + deferred
                     # pruning when the query shape supports it (reference:
                     # Job->deferredPruning, fast_path_router_planner.c)
-                    if len(stmts) == 1 and isinstance(stmt, A.Select):
+                    # — superuser only: the cache keys on SQL text and an
+                    # RLS rewrite must never leak across roles
+                    if len(stmts) == 1 and isinstance(stmt, A.Select) \
+                            and role is None:
                         r = self._execute_param_select(sql, stmt, list(params))
                         if r is not None:
                             result = r
                             continue
                     from citus_tpu.planner.recursive import rewrite_params
                     stmt = rewrite_params(stmt, list(params))
-                key = sql if (len(stmts) == 1 and params is None) else None
+                rls_rewritten = False
+                if role is not None:
+                    # after parameter substitution so WITH CHECK sees the
+                    # actual inserted values
+                    stmt, rls_rewritten = self._apply_rls(role, stmt)
+                key = sql if (len(stmts) == 1 and params is None
+                              and not rls_rewritten) else None
                 result = self._execute_stmt(stmt, sql_text=key)
+                self._fire_triggers(stmt)
         finally:
             self.activity.exit(gpid)
         executor = result.explain.get("strategy", "utility") if result.explain else "utility"
@@ -865,13 +883,24 @@ class Cluster:
                     f'cannot replace built-in function "{stmt.name}"')
             if stmt.name in self.catalog.functions and not stmt.or_replace:
                 raise CatalogError(f'function "{stmt.name}" already exists')
-            # validate the body parses as an expression
-            from citus_tpu.planner.parser import Parser as _P
-            _P(stmt.body).parse_expr()
-            self.catalog.functions[stmt.name] = {
-                "args": list(stmt.arg_names),
-                "arg_types": list(stmt.arg_types),
-                "returns": stmt.returns, "body": stmt.body}
+            if stmt.returns != "trigger" and any(
+                    t.get("function") == stmt.name
+                    for t in self.catalog.triggers.values()):
+                raise CatalogError(
+                    f'cannot replace "{stmt.name}": trigger(s) depend on it '
+                    "remaining a trigger function")
+            # expression macros validate as expressions; trigger
+            # functions (RETURNS trigger) hold a SQL statement body
+            entry = {"args": list(stmt.arg_names),
+                     "arg_types": list(stmt.arg_types),
+                     "returns": stmt.returns, "body": stmt.body}
+            if stmt.returns == "trigger":
+                parse_sql(stmt.body)
+                entry["kind"] = "statement"
+            else:
+                from citus_tpu.planner.parser import Parser as _P
+                _P(stmt.body).parse_expr()
+            self.catalog.functions[stmt.name] = entry
             self.catalog.ddl_epoch += 1
             self.catalog.commit()
             self._plan_cache.clear()
@@ -881,6 +910,12 @@ class Cluster:
                 return Result(columns=[], rows=[])
             if stmt.name not in self.catalog.functions:
                 raise CatalogError(f'function "{stmt.name}" does not exist')
+            users = [n for n, t in self.catalog.triggers.items()
+                     if t.get("function") == stmt.name]
+            if users:
+                raise CatalogError(
+                    f'cannot drop function "{stmt.name}": trigger(s) '
+                    f'{", ".join(sorted(users))} depend on it')
             del self.catalog.functions[stmt.name]
             self.catalog.tombstone("functions", stmt.name)
             self.catalog.ddl_epoch += 1
@@ -904,6 +939,97 @@ class Cluster:
                 self.catalog.revoke(stmt.table, stmt.role, stmt.privileges)
             else:
                 self.catalog.grant(stmt.table, stmt.role, stmt.privileges)
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreatePolicy):
+            self.catalog.table(stmt.table)  # must exist
+            pols = self.catalog.policies.setdefault(stmt.table, [])
+            if any(p["name"] == stmt.name for p in pols):
+                raise CatalogError(
+                    f'policy "{stmt.name}" for table "{stmt.table}" '
+                    "already exists")
+            from citus_tpu.planner.parser import Parser as _P
+            for text in (stmt.using_sql, stmt.check_sql):
+                if text is not None:
+                    _P(text).parse_expr()  # validate
+            pols.append({"name": stmt.name, "cmd": stmt.cmd,
+                         "roles": list(stmt.roles),
+                         "using": stmt.using_sql, "check": stmt.check_sql})
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropPolicy):
+            pols = self.catalog.policies.get(stmt.table, [])
+            kept = [p for p in pols if p["name"] != stmt.name]
+            if len(kept) == len(pols):
+                if stmt.if_exists:
+                    return Result(columns=[], rows=[])
+                raise CatalogError(
+                    f'policy "{stmt.name}" for table "{stmt.table}" '
+                    "does not exist")
+            if kept:
+                self.catalog.policies[stmt.table] = kept
+            else:
+                del self.catalog.policies[stmt.table]
+                self.catalog.tombstone("policies", stmt.table)
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.AlterTableRls):
+            self.catalog.table(stmt.table)
+            if stmt.enable:
+                self.catalog.rls[stmt.table] = True
+            elif self.catalog.rls.pop(stmt.table, None) is not None:
+                self.catalog.tombstone("rls", stmt.table)
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateTrigger):
+            self.catalog.table(stmt.table)
+            if stmt.name in self.catalog.triggers:
+                raise CatalogError(f'trigger "{stmt.name}" already exists')
+            fn = self.catalog.functions.get(stmt.function)
+            if fn is None or fn.get("kind") != "statement":
+                raise CatalogError(
+                    f'"{stmt.function}" is not a trigger function '
+                    "(CREATE FUNCTION ... RETURNS trigger)")
+            self.catalog.triggers[stmt.name] = {
+                "table": stmt.table, "event": stmt.event,
+                "function": stmt.function}
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropTrigger):
+            t = self.catalog.triggers.get(stmt.name)
+            if t is None or t.get("table") != stmt.table:
+                if stmt.if_exists:
+                    return Result(columns=[], rows=[])
+                raise CatalogError(
+                    f'trigger "{stmt.name}" on "{stmt.table}" does not exist')
+            del self.catalog.triggers[stmt.name]
+            self.catalog.tombstone("triggers", stmt.name)
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateTsConfig):
+            if stmt.name in self.catalog.ts_configs:
+                raise CatalogError(
+                    f'text search configuration "{stmt.name}" already exists')
+            src = stmt.options.get("copy")
+            if src is not None and src not in self.catalog.ts_configs \
+                    and src != "simple":
+                raise CatalogError(
+                    f'text search configuration "{src}" does not exist')
+            base = (dict(self.catalog.ts_configs.get(src, {}))
+                    if src is not None else {})
+            base["parser"] = stmt.options.get("parser",
+                                              base.get("parser", "default"))
+            self.catalog.ts_configs[stmt.name] = base
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropTsConfig):
+            if stmt.name not in self.catalog.ts_configs:
+                if stmt.if_exists:
+                    return Result(columns=[], rows=[])
+                raise CatalogError(
+                    f'text search configuration "{stmt.name}" does not exist')
+            del self.catalog.ts_configs[stmt.name]
+            self.catalog.tombstone("ts_configs", stmt.name)
             self.catalog.commit()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.CreateView):
@@ -1666,6 +1792,10 @@ class Cluster:
                 return e
             if isinstance(e, A.FuncCall) and e.name in fns:
                 spec = fns[e.name]
+                if spec.get("kind") == "statement":
+                    raise AnalysisError(
+                        f'{e.name}() is a trigger function and cannot be '
+                        "called in an expression")
                 if len(e.args) != len(spec["args"]):
                     raise AnalysisError(
                         f'{e.name}() expects {len(spec["args"])} arguments')
@@ -1923,6 +2053,254 @@ class Cluster:
                 except Exception:
                     pass
 
+    def _policy_predicate(self, role: str, table: str, cmd: str,
+                          kind: str = "using") -> Optional[A.Expr]:
+        """RLS predicate for (role, table, command): None when RLS is
+        off for the table; FALSE when enabled with no applicable policy
+        (default deny); else the OR of applicable policies' expressions
+        (permissive policies, PostgreSQL default).  ``kind`` selects
+        USING or WITH CHECK (check falls back to using, as PG does)."""
+        if not self.catalog.rls.get(table):
+            return None
+        texts = []
+        for p in self.catalog.policies.get(table, ()):
+            if p["cmd"] not in ("all", cmd):
+                continue
+            if "public" not in p["roles"] and role not in p["roles"]:
+                continue
+            text = p.get(kind) or (p.get("using") if kind == "check" else None)
+            if text:
+                texts.append(text)
+        if not texts:
+            return A.Literal(False, "bool")
+        from citus_tpu.planner.parser import Parser as _P
+        cache = getattr(self, "_policy_expr_cache", None)
+        if cache is None:
+            cache = self._policy_expr_cache = {}
+        exprs = []
+        for t in texts:
+            parsed = cache.get(t)
+            if parsed is None:
+                parsed = cache[t] = _P(t).parse_expr()
+            exprs.append(parsed)
+        out = exprs[0]
+        for e in exprs[1:]:
+            out = A.BinOp("or", out, e)
+        return out
+
+    def _apply_rls(self, role: str, stmt: A.Statement):
+        """Row-level security rewrite for a non-superuser role ->
+        (statement, changed).  Every table reference of an RLS-enabled
+        table — in FROM (incl. joins/derived tables), set operations,
+        CTEs, and expression subqueries (scalar/IN/EXISTS) — wraps in a
+        policy-filtered derived table; UPDATE/DELETE additionally AND
+        the predicate into WHERE and enforce WITH CHECK on assignments;
+        INSERT VALUES rows evaluate WITH CHECK per row (reference:
+        commands/policy.c; superuser role=None bypasses, like table
+        owners in PG)."""
+        import dataclasses
+        changed = [False]
+
+        def rew_from(item):
+            if isinstance(item, A.TableRef) \
+                    and self.catalog.has_table(item.name):
+                f = self._policy_predicate(role, item.name, "select")
+                if f is None:
+                    return item
+                changed[0] = True
+                sel = A.Select([A.SelectItem(A.Star())],
+                               A.TableRef(item.name), f)
+                return A.SubqueryRef(sel,
+                                     item.alias or item.name.split(".")[-1])
+            if isinstance(item, A.Join):
+                return A.Join(rew_from(item.left), rew_from(item.right),
+                              item.kind, item.condition)
+            if isinstance(item, A.SubqueryRef):
+                return A.SubqueryRef(rew_stmt(item.select), item.alias)
+            return item
+
+        def rew_expr(e):
+            if e is None or not isinstance(e, A.Expr):
+                return e
+            if isinstance(e, A.Subquery):
+                return A.Subquery(rew_stmt(e.select))
+            if isinstance(e, A.Exists):
+                return A.Exists(rew_stmt(e.select))
+            if isinstance(e, A.BinOp):
+                return A.BinOp(e.op, rew_expr(e.left), rew_expr(e.right))
+            if isinstance(e, A.UnOp):
+                return A.UnOp(e.op, rew_expr(e.operand))
+            if isinstance(e, A.Between):
+                return A.Between(rew_expr(e.expr), rew_expr(e.lo),
+                                 rew_expr(e.hi), e.negated)
+            if isinstance(e, A.InList):
+                return A.InList(rew_expr(e.expr),
+                                tuple(rew_expr(i) for i in e.items),
+                                e.negated)
+            if isinstance(e, A.IsNull):
+                return A.IsNull(rew_expr(e.expr), e.negated)
+            if isinstance(e, A.Cast):
+                return A.Cast(rew_expr(e.expr), e.type_name, e.type_args)
+            if isinstance(e, A.CaseExpr):
+                return A.CaseExpr(
+                    tuple((rew_expr(c), rew_expr(v)) for c, v in e.whens),
+                    rew_expr(e.else_) if e.else_ is not None else None)
+            if isinstance(e, A.FuncCall):
+                return A.FuncCall(e.name, tuple(rew_expr(a) for a in e.args),
+                                  e.distinct, e.agg_order)
+            if isinstance(e, A.WindowCall):
+                return A.WindowCall(
+                    rew_expr(e.func) if e.func is not None else None,
+                    tuple(rew_expr(p) for p in e.partition_by),
+                    tuple((rew_expr(oe), asc) for oe, asc in e.order_by),
+                    e.frame, e.ref_name, e.ref_verbatim)
+            return e
+
+        def rew_stmt(s):
+            if isinstance(s, A.SetOp):
+                return dataclasses.replace(s, left=rew_stmt(s.left),
+                                           right=rew_stmt(s.right))
+            if isinstance(s, A.WithSelect):
+                return A.WithSelect(
+                    [(n, rew_stmt(sel)) for n, sel in s.ctes],
+                    rew_stmt(s.body))
+            if not isinstance(s, A.Select):
+                return s
+            return dataclasses.replace(
+                s,
+                items=[A.SelectItem(rew_expr(i.expr), i.alias)
+                       for i in s.items],
+                from_=rew_from(s.from_) if s.from_ is not None else None,
+                where=rew_expr(s.where),
+                group_by=[rew_expr(g) for g in s.group_by],
+                having=rew_expr(s.having),
+                order_by=[A.OrderItem(rew_expr(o.expr), o.ascending,
+                                      o.nulls_first) for o in s.order_by])
+
+        if isinstance(stmt, (A.Select, A.SetOp, A.WithSelect)):
+            new_stmt = rew_stmt(stmt)
+            return (new_stmt, True) if changed[0] else (stmt, False)
+        if isinstance(stmt, (A.Update, A.Delete)):
+            cmd = "update" if isinstance(stmt, A.Update) else "delete"
+            f = self._policy_predicate(role, stmt.table, cmd)
+            if f is None:
+                return stmt, False
+            if isinstance(stmt, A.Update):
+                self._rls_check_update(role, stmt)
+            where = rew_expr(f if stmt.where is None
+                             else A.BinOp("and", stmt.where, f))
+            return dataclasses.replace(stmt, where=where), True
+        if isinstance(stmt, A.Insert):
+            f = self._policy_predicate(role, stmt.table, "insert",
+                                       kind="check")
+            if f is None:
+                return stmt, False
+            if stmt.select is not None or not stmt.rows:
+                raise UnsupportedFeatureError(
+                    "INSERT ... SELECT under row-level security is not "
+                    "supported")
+            t = self.catalog.table(stmt.table)
+            cols = stmt.columns or t.schema.names
+            for row in stmt.rows:
+                subst = {c: v for c, v in zip(cols, row)}
+                checked = _subst_args(f, subst)
+                try:
+                    ok = _eval_const(checked)
+                except Exception:
+                    raise UnsupportedFeatureError(
+                        "row-level security WITH CHECK over non-constant "
+                        "inserts is not supported")
+                if ok is not True:
+                    raise AnalysisError(
+                        f'new row violates row-level security policy for '
+                        f'table "{stmt.table}"')
+            return stmt, False
+        return stmt, False
+
+    def _rls_check_update(self, role: str, stmt: A.Update) -> None:
+        """WITH CHECK enforcement for UPDATE: the NEW row must satisfy
+        the policy (PostgreSQL raises when an update rewrites a row out
+        of policy scope).  Assigned-constant columns substitute into the
+        check expression; a fully-constant result enforces directly;
+        assignments that don't touch any check column are safe when the
+        check falls back to USING (the untouched columns already passed
+        it); anything else fails closed."""
+        eff = self._policy_predicate(role, stmt.table, "update",
+                                     kind="check")
+        if eff is None:
+            return
+        from citus_tpu.planner.recursive import (
+            _walk_columns as _walk_ast_columns,
+        )
+        check_cols = {c.name for c in _walk_ast_columns(eff)
+                      if c.table is None}
+        assigned = dict(stmt.assignments)
+        subst = {}
+        for col, val in assigned.items():
+            if col in check_cols:
+                subst[col] = val
+        if subst:
+            checked = _subst_args(eff, subst)
+            remaining = {c.name for c in _walk_ast_columns(checked)}
+            if remaining:
+                raise UnsupportedFeatureError(
+                    "cannot verify row-level security WITH CHECK for this "
+                    "UPDATE (non-constant or mixed-column assignment)")
+            try:
+                ok = _eval_const(checked)
+            except Exception:
+                raise UnsupportedFeatureError(
+                    "cannot verify row-level security WITH CHECK for this "
+                    "UPDATE (non-constant assignment)")
+            if ok is not True:
+                raise AnalysisError(
+                    "new row violates row-level security policy for "
+                    f'table "{stmt.table}"')
+            return
+        # no check column assigned: safe only when check == using (the
+        # unchanged columns already satisfied USING via the row filter)
+        using = self._policy_predicate(role, stmt.table, "update",
+                                       kind="using")
+        if repr(eff) != repr(using):
+            raise UnsupportedFeatureError(
+                "cannot verify row-level security WITH CHECK for this "
+                "UPDATE (policy has a distinct WITH CHECK expression)")
+
+    def _fire_triggers(self, stmt: A.Statement, depth: int = 0) -> None:
+        """Statement-level AFTER triggers: run each matching trigger's
+        function body after a DML statement completes (reference:
+        commands/trigger.c; bodies are stored SQL statements)."""
+        if isinstance(stmt, A.Insert):
+            table, event = stmt.table, "insert"
+        elif isinstance(stmt, A.Update):
+            table, event = stmt.table, "update"
+        elif isinstance(stmt, A.Delete):
+            table, event = stmt.table, "delete"
+        elif isinstance(stmt, A.Merge):
+            # MERGE may insert, update, or delete: fire all three
+            for evt in ("insert", "update", "delete"):
+                self._fire_triggers_for(stmt.target.name, evt, depth)
+            return
+        else:
+            return
+        self._fire_triggers_for(table, event, depth)
+
+    def _fire_triggers_for(self, table: str, event: str, depth: int) -> None:
+        matching = [t for t in self.catalog.triggers.values()
+                    if t["table"] == table and t["event"] == event]
+        if not matching:
+            return
+        if depth >= 8:
+            raise ExecutionError(
+                "trigger recursion limit exceeded (8 levels)")
+        for trig in matching:
+            fn = self.catalog.functions.get(trig["function"])
+            if fn is None:
+                continue
+            for body_stmt in parse_sql(fn["body"]):
+                self._execute_stmt(body_stmt)
+                self._fire_triggers(body_stmt, depth + 1)
+
     def _check_privileges(self, role: str, stmt: A.Statement) -> None:
         """Table-level privilege enforcement for a non-superuser role
         (reference: standard ACLs propagated by commands/grant.c; a
@@ -1965,17 +2343,20 @@ class Cluster:
                     out.extend(stmt_tables(sub))
             return out
 
-        def check_read(s):
+        def check_read(s, skip=frozenset()):
             for t in stmt_tables(s):
+                if t in skip:
+                    continue  # CTE name, not a real relation
                 if not self.catalog.has_privilege(role, t, "select"):
                     deny("SELECT", t)
 
         if isinstance(stmt, (A.Select, A.SetOp)):
             check_read(stmt)
         elif isinstance(stmt, A.WithSelect):
+            cte_names = frozenset(n for n, _sel in stmt.ctes)
             for _n, sel in stmt.ctes:
-                check_read(sel)
-            check_read(stmt.body)
+                check_read(sel, skip=cte_names)
+            check_read(stmt.body, skip=cte_names)
         elif isinstance(stmt, A.Insert):
             if not self.catalog.has_privilege(role, stmt.table, "insert"):
                 deny("INSERT", stmt.table)
@@ -2304,6 +2685,26 @@ class Cluster:
             return Result(columns=["type_name", "labels"],
                           rows=[(n, ",".join(ls)) for n, ls in
                                 sorted(self.catalog.types.items())])
+        if name == "citus_policies":
+            rows = []
+            for tbl in sorted(self.catalog.policies):
+                for p in self.catalog.policies[tbl]:
+                    rows.append((tbl, p["name"], p["cmd"],
+                                 ",".join(p["roles"]), p.get("using"),
+                                 p.get("check")))
+            return Result(columns=["table_name", "policy_name", "cmd",
+                                   "roles", "using_expr", "check_expr"],
+                          rows=rows)
+        if name == "citus_triggers":
+            return Result(
+                columns=["trigger_name", "table_name", "event", "function"],
+                rows=[(n, t["table"], t["event"], t["function"])
+                      for n, t in sorted(self.catalog.triggers.items())])
+        if name == "citus_text_search_configs":
+            return Result(
+                columns=["config_name", "parser"],
+                rows=[(n, c.get("parser", "default"))
+                      for n, c in sorted(self.catalog.ts_configs.items())])
         if name == "citus_views":
             return Result(columns=["view_name", "definition"],
                           rows=sorted(self.catalog.views.items()))

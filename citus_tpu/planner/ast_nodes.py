@@ -346,6 +346,69 @@ class DropFunction(Statement):
 
 
 @dataclass
+class CreatePolicy(Statement):
+    """CREATE POLICY name ON table [FOR cmd] [TO roles] [USING (expr)]
+    [WITH CHECK (expr)] — row-level security (reference:
+    commands/policy.c propagation; enforcement here is engine-native)."""
+    name: str = ""
+    table: str = ""
+    cmd: str = "all"            # all | select | insert | update | delete
+    roles: tuple = ("public",)
+    using_sql: Optional[str] = None
+    check_sql: Optional[str] = None
+
+
+@dataclass
+class DropPolicy(Statement):
+    name: str = ""
+    table: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class AlterTableRls(Statement):
+    """ALTER TABLE t ENABLE|DISABLE ROW LEVEL SECURITY."""
+    table: str = ""
+    enable: bool = True
+
+
+@dataclass
+class CreateTrigger(Statement):
+    """CREATE TRIGGER name AFTER event ON table [FOR EACH STATEMENT]
+    EXECUTE FUNCTION f() — statement-level AFTER triggers running a
+    stored SQL-statement function (reference: commands/trigger.c
+    propagates triggers; row-level procedural bodies are PL/pgSQL and
+    out of scope)."""
+    name: str = ""
+    event: str = "insert"       # insert | update | delete
+    table: str = ""
+    function: str = ""
+
+
+@dataclass
+class DropTrigger(Statement):
+    name: str = ""
+    table: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class CreateTsConfig(Statement):
+    """CREATE TEXT SEARCH CONFIGURATION name (PARSER = p | COPY = c) —
+    propagated catalog objects (reference: commands/text_search.c; FTS
+    execution itself is the host database's concern in the reference,
+    so these are metadata-only here too)."""
+    name: str = ""
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class DropTsConfig(Statement):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
 class CreateType(Statement):
     """CREATE TYPE name AS ENUM (...) — enum columns store the label's
     declaration index; labels validate at ingest (reference: types

@@ -346,7 +346,19 @@ class Parser:
             self.expect_kw("to")
             return A.AlterTable(name, "rename_column", old_name=old,
                                 new_name=self.expect_ident())
-        self.error("expected ADD, DROP, or RENAME")
+        if self.peek().kind == "ident" \
+                and self.peek().value in ("enable", "disable"):
+            enable = self.next().value == "enable"
+            for word, kinds in (("row", ("kw", "ident")),
+                                ("level", ("ident",)),
+                                ("security", ("ident",))):
+                t = self.peek()
+                if not (t.kind in kinds and t.value == word):
+                    self.error("expected ROW LEVEL SECURITY")
+                self.next()
+            return A.AlterTableRls(name, enable)
+        self.error("expected ADD, DROP, RENAME, or ENABLE/DISABLE ROW "
+                   "LEVEL SECURITY")
 
     def parse_explain(self) -> A.Explain:
         self.expect_kw("explain")
@@ -463,6 +475,16 @@ class Parser:
             self.error("expected PRECEDING or FOLLOWING")
         return (d, int(t.value))
 
+    def _parse_paren_expr_text(self) -> str:
+        """'(' expr ')' -> the expression's source text (validated by
+        parsing, persisted as SQL so it survives the catalog)."""
+        self.expect_op("(")
+        start = self.peek().pos
+        self.parse_expr()
+        end = self.peek().pos   # position of the closing ')'
+        self.expect_op(")")
+        return self.text[start:end].strip()
+
     def parse_table_name(self) -> str:
         name = self.expect_ident()
         if self.accept_op("."):
@@ -542,6 +564,85 @@ class Parser:
                     break
             self.expect_op(")")
             return A.CreateType(name, labels)
+        if self.peek().kind == "ident" and self.peek().value == "policy":
+            self.next()
+            name = self.expect_ident()
+            self.expect_kw("on")
+            table = self.parse_table_name()
+            cmd = "all"
+            if self.peek().kind == "ident" and self.peek().value == "for":
+                self.next()
+                t = self.next()
+                if t.value not in ("all", "select", "insert", "update",
+                                   "delete"):
+                    self.error("expected ALL/SELECT/INSERT/UPDATE/DELETE")
+                cmd = t.value
+            roles: tuple = ("public",)
+            if self.accept_kw("to"):
+                rs = [self.expect_ident()]
+                while self.accept_op(","):
+                    rs.append(self.expect_ident())
+                roles = tuple(rs)
+            using_sql = check_sql = None
+            if self.accept_kw("using"):
+                using_sql = self._parse_paren_expr_text()
+            if self.accept_kw("with"):
+                if not (self.peek().kind == "ident"
+                        and self.peek().value == "check"):
+                    self.error("expected CHECK")
+                self.next()
+                check_sql = self._parse_paren_expr_text()
+            return A.CreatePolicy(name, table, cmd, roles, using_sql,
+                                  check_sql)
+        if self.peek().kind == "ident" and self.peek().value == "trigger":
+            self.next()
+            name = self.expect_ident()
+            if not (self.peek().kind == "ident"
+                    and self.peek().value == "after"):
+                self.error("only AFTER triggers are supported")
+            self.next()
+            evt = self.next()
+            if evt.value not in ("insert", "update", "delete"):
+                self.error("expected INSERT, UPDATE, or DELETE")
+            self.expect_kw("on")
+            table = self.parse_table_name()
+            if self.peek().kind == "ident" and self.peek().value == "for":
+                self.next()
+                if self.peek().kind == "ident" and self.peek().value == "each":
+                    self.next()
+                t = self.next()
+                if t.value != "statement":
+                    self.error("only FOR EACH STATEMENT triggers are "
+                               "supported")
+            if not (self.peek().kind == "ident"
+                    and self.peek().value == "execute"):
+                self.error("expected EXECUTE FUNCTION")
+            self.next()
+            if self.peek().kind == "ident" \
+                    and self.peek().value in ("function", "procedure"):
+                self.next()
+            fname = self.expect_ident()
+            self.expect_op("(")
+            self.expect_op(")")
+            return A.CreateTrigger(name, evt.value, table, fname)
+        if self.peek().kind == "ident" and self.peek().value == "text":
+            self.next()
+            for word in ("search", "configuration"):
+                if not (self.peek().kind == "ident"
+                        and self.peek().value == word):
+                    self.error(f"expected {word.upper()}")
+                self.next()
+            name = self.expect_ident()
+            options: dict = {}
+            if self.accept_op("("):
+                while True:
+                    key = self.next().value
+                    self.expect_op("=")
+                    options[key] = self.next().value.strip("'")
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return A.CreateTsConfig(name, options)
         if self.peek().kind == "ident" and self.peek().value == "view":
             self.next()
             name = self.parse_table_name()
@@ -660,6 +761,36 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             return A.DropType(self.expect_ident(), if_exists)
+        if self.peek().kind == "ident" and self.peek().value == "policy":
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.expect_ident()
+            self.expect_kw("on")
+            return A.DropPolicy(name, self.parse_table_name(), if_exists)
+        if self.peek().kind == "ident" and self.peek().value == "trigger":
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.expect_ident()
+            self.expect_kw("on")
+            return A.DropTrigger(name, self.parse_table_name(), if_exists)
+        if self.peek().kind == "ident" and self.peek().value == "text":
+            self.next()
+            for word in ("search", "configuration"):
+                if not (self.peek().kind == "ident"
+                        and self.peek().value == word):
+                    self.error(f"expected {word.upper()}")
+                self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropTsConfig(self.expect_ident(), if_exists)
         if self.peek().kind == "ident" and self.peek().value in ("view", "sequence"):
             kind = self.next().value
             if_exists = False
@@ -749,6 +880,7 @@ class Parser:
         "nextval", "currval", "setval", "citus_views", "citus_sequences",
         "citus_cdc_events", "citus_roles", "citus_grants",
         "citus_version", "citus_dist_stat_activity", "citus_types",
+        "citus_policies", "citus_triggers", "citus_text_search_configs",
         "get_shard_id_for_distribution_column", "citus_relation_size",
         "citus_total_relation_size", "citus_disable_node",
         "citus_activate_node", "citus_get_active_worker_nodes",

@@ -1,0 +1,277 @@
+"""Row-level security policies, statement triggers, and text search
+configuration objects (round-2 gap #7).
+
+Reference: commands/policy.c (policy propagation), commands/trigger.c
+(trigger propagation), commands/text_search.c (configuration objects).
+Enforcement here is engine-native: policies rewrite queries for
+non-superuser roles; triggers run stored SQL-statement functions after
+DML; text search configurations are propagated metadata objects, as in
+the reference (FTS execution lives in the host database there)."""
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import (
+    AnalysisError, CatalogError, ExecutionError, UnsupportedFeatureError,
+)
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    cl.execute("CREATE TABLE docs (k bigint NOT NULL, owner_id bigint, v bigint)")
+    cl.execute("SELECT create_distributed_table('docs', 'k', 4)")
+    cl.copy_from("docs", columns={
+        "k": np.arange(100), "owner_id": np.arange(100) % 4,
+        "v": np.arange(100)})
+    cl.execute("CREATE ROLE app")
+    cl.execute("GRANT SELECT, INSERT, UPDATE, DELETE ON docs TO app")
+    yield cl
+    cl.close()
+
+
+# ------------------------------------------------------------ policies
+
+def test_rls_default_deny_and_policy_filter(db):
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    # RLS on, no policy: default deny for non-superusers
+    assert db.execute("SELECT count(*) FROM docs", role="app").rows == [(0,)]
+    # superuser bypasses
+    assert db.execute("SELECT count(*) FROM docs").rows == [(100,)]
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    assert db.execute("SELECT count(*) FROM docs", role="app").rows == [(25,)]
+    r = db.execute("SELECT sum(v) FROM docs WHERE v < 50", role="app")
+    want = sum(v for v in range(50) if v % 4 == 2)
+    assert r.rows == [(want,)]
+
+
+def test_rls_policies_are_permissive_or(db):
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY p1 ON docs USING (owner_id = 1)")
+    db.execute("CREATE POLICY p2 ON docs USING (owner_id = 3)")
+    assert db.execute("SELECT count(*) FROM docs", role="app").rows == [(50,)]
+
+
+def test_rls_role_scoped_policy(db):
+    db.execute("CREATE ROLE other")
+    db.execute("GRANT SELECT ON docs TO other")
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY justapp ON docs TO app USING (owner_id = 0)")
+    assert db.execute("SELECT count(*) FROM docs", role="app").rows == [(25,)]
+    # 'other' has no applicable policy: default deny
+    assert db.execute("SELECT count(*) FROM docs", role="other").rows == [(0,)]
+
+
+def test_rls_update_delete(db):
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    db.execute("UPDATE docs SET v = v + 1000 WHERE v < 10", role="app")
+    # only owner_id=2 rows with v<10 updated: v in {2, 6}
+    assert db.execute("SELECT count(*) FROM docs WHERE v >= 1000").rows == [(2,)]
+    db.execute("DELETE FROM docs WHERE v >= 1000", role="app")
+    assert db.execute("SELECT count(*) FROM docs").rows == [(98,)]
+    # superuser delete is unfiltered
+    db.execute("DELETE FROM docs WHERE v < 10")
+    assert db.execute("SELECT count(*) FROM docs WHERE v < 10").rows == [(0,)]
+
+
+def test_rls_insert_with_check(db):
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    db.execute("INSERT INTO docs VALUES (200, 2, 7)", role="app")  # passes
+    with pytest.raises(AnalysisError, match="violates row-level security"):
+        db.execute("INSERT INTO docs VALUES (201, 3, 7)", role="app")
+    # superuser inserts anything
+    db.execute("INSERT INTO docs VALUES (202, 3, 7)")
+
+
+def test_rls_in_joins(db, tmp_path):
+    db.execute("CREATE TABLE tags (tk bigint NOT NULL, doc_k bigint, lbl text)")
+    db.execute("SELECT create_distributed_table('tags', 'tk', 4)")
+    db.copy_from("tags", columns={"tk": np.arange(20),
+                                  "doc_k": np.arange(20),
+                                  "lbl": ["x"] * 20})
+    db.execute("GRANT SELECT ON tags TO app")
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    r = db.execute("SELECT count(*) FROM docs d JOIN tags g "
+                   "ON d.k = g.doc_k", role="app")
+    # docs 0..19 with owner 2: k in {2, 6, 10, 14, 18}
+    assert r.rows == [(5,)]
+
+
+def test_drop_policy_and_disable(db):
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    db.execute("DROP POLICY own ON docs")
+    assert db.execute("SELECT count(*) FROM docs", role="app").rows == [(0,)]
+    db.execute("ALTER TABLE docs DISABLE ROW LEVEL SECURITY")
+    assert db.execute("SELECT count(*) FROM docs", role="app").rows == [(100,)]
+    with pytest.raises(CatalogError):
+        db.execute("DROP POLICY nope ON docs")
+    db.execute("DROP POLICY IF EXISTS nope ON docs")
+
+
+def test_policies_view_and_persistence(db, tmp_path):
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs FOR SELECT TO app "
+               "USING (owner_id = 1)")
+    v = db.execute("SELECT citus_policies()")
+    assert v.rows == [("docs", "own", "select", "app", "owner_id = 1", None)]
+    db.close()
+    cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    assert cl2.execute("SELECT count(*) FROM docs", role="app").rows == [(25,)]
+    cl2.close()
+    # reopen the fixture's handle state for teardown
+    db._closed = True if hasattr(db, "_closed") else None
+
+
+# ------------------------------------------------------------ triggers
+
+def test_statement_trigger_fires(db):
+    db.execute("CREATE TABLE audit (n bigint)")
+    db.execute("CREATE FUNCTION log_ins() RETURNS trigger AS "
+               "'INSERT INTO audit VALUES (1)'")
+    db.execute("CREATE TRIGGER t_ins AFTER INSERT ON docs "
+               "FOR EACH STATEMENT EXECUTE FUNCTION log_ins()")
+    db.execute("INSERT INTO docs VALUES (300, 0, 0), (301, 0, 0)")
+    # statement-level: one audit row per INSERT statement
+    assert db.execute("SELECT count(*) FROM audit").rows == [(1,)]
+    db.execute("UPDATE docs SET v = 1 WHERE k = 300")  # no update trigger
+    assert db.execute("SELECT count(*) FROM audit").rows == [(1,)]
+    db.execute("DROP TRIGGER t_ins ON docs")
+    db.execute("INSERT INTO docs VALUES (302, 0, 0)")
+    assert db.execute("SELECT count(*) FROM audit").rows == [(1,)]
+
+
+def test_trigger_events_and_view(db):
+    db.execute("CREATE TABLE audit (n bigint)")
+    db.execute("CREATE FUNCTION log_any() RETURNS trigger AS "
+               "'INSERT INTO audit VALUES (1)'")
+    db.execute("CREATE TRIGGER t_u AFTER UPDATE ON docs "
+               "EXECUTE FUNCTION log_any()")
+    db.execute("CREATE TRIGGER t_d AFTER DELETE ON docs "
+               "EXECUTE FUNCTION log_any()")
+    v = db.execute("SELECT citus_triggers()")
+    assert v.rows == [("t_d", "docs", "delete", "log_any"),
+                      ("t_u", "docs", "update", "log_any")]
+    db.execute("UPDATE docs SET v = 0 WHERE k = 1")
+    db.execute("DELETE FROM docs WHERE k = 2")
+    assert db.execute("SELECT count(*) FROM audit").rows == [(2,)]
+
+
+def test_trigger_recursion_limited(db):
+    db.execute("CREATE TABLE loopt (n bigint)")
+    db.execute("CREATE FUNCTION loop_fn() RETURNS trigger AS "
+               "'INSERT INTO loopt VALUES (1)'")
+    db.execute("CREATE TRIGGER t_loop AFTER INSERT ON loopt "
+               "EXECUTE FUNCTION loop_fn()")
+    with pytest.raises(ExecutionError, match="recursion"):
+        db.execute("INSERT INTO loopt VALUES (0)")
+
+
+def test_trigger_requires_trigger_function(db):
+    db.execute("CREATE FUNCTION notrig(x bigint) RETURNS bigint AS 'x + 1'")
+    with pytest.raises(CatalogError, match="not a trigger function"):
+        db.execute("CREATE TRIGGER bad AFTER INSERT ON docs "
+                   "EXECUTE FUNCTION notrig()")
+    # trigger functions cannot be called as expressions
+    db.execute("CREATE FUNCTION trg() RETURNS trigger AS "
+               "'INSERT INTO docs VALUES (1, 1, 1)'")
+    with pytest.raises(AnalysisError, match="trigger function"):
+        db.execute("SELECT trg() FROM docs")
+
+
+# ------------------------------------------- text search configurations
+
+def test_text_search_configurations(db, tmp_path):
+    db.execute("CREATE TEXT SEARCH CONFIGURATION english_fast "
+               "(PARSER = default)")
+    db.execute("CREATE TEXT SEARCH CONFIGURATION english_copy "
+               "(COPY = english_fast)")
+    v = db.execute("SELECT citus_text_search_configs()")
+    assert v.rows == [("english_copy", "default"),
+                      ("english_fast", "default")]
+    with pytest.raises(CatalogError, match="already exists"):
+        db.execute("CREATE TEXT SEARCH CONFIGURATION english_fast "
+                   "(PARSER = default)")
+    with pytest.raises(CatalogError, match="does not exist"):
+        db.execute("CREATE TEXT SEARCH CONFIGURATION bad (COPY = missing)")
+    db.execute("DROP TEXT SEARCH CONFIGURATION english_copy")
+    db.execute("DROP TEXT SEARCH CONFIGURATION IF EXISTS english_copy")
+    with pytest.raises(CatalogError):
+        db.execute("DROP TEXT SEARCH CONFIGURATION english_copy")
+    # persists across reopen
+    db.close()
+    cl2 = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    assert cl2.execute("SELECT citus_text_search_configs()").rows == \
+        [("english_fast", "default")]
+    cl2.close()
+
+
+# ------------------------------------- review-finding regressions (RLS)
+
+def test_rls_no_bypass_via_setops_and_subqueries(db):
+    db.execute("CREATE TABLE pub (k bigint)")
+    db.execute("INSERT INTO pub VALUES (2)")
+    db.execute("GRANT SELECT ON pub TO app")
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    # set operation
+    r = db.execute("SELECT count(*) FROM docs UNION ALL SELECT 0",
+                   role="app")
+    assert (25,) in r.rows and (100,) not in r.rows
+    # scalar subquery in the select list
+    r2 = db.execute("SELECT (SELECT count(*) FROM docs) FROM pub",
+                    role="app")
+    assert r2.rows == [(25,)]
+    # IN subquery in WHERE reads only policy rows
+    r3 = db.execute("SELECT count(*) FROM pub WHERE k IN "
+                    "(SELECT owner_id FROM docs)", role="app")
+    assert r3.rows == [(1,)]
+    r4 = db.execute("SELECT count(*) FROM pub WHERE k IN "
+                    "(SELECT v FROM docs WHERE owner_id = 3)", role="app")
+    assert r4.rows == [(0,)]  # owner 3 rows are invisible
+    # CTE body
+    r5 = db.execute("WITH c AS (SELECT v FROM docs) SELECT count(*) FROM c",
+                    role="app")
+    assert r5.rows == [(25,)]
+
+
+def test_rls_update_cannot_escape_policy(db):
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    with pytest.raises(AnalysisError, match="violates row-level security"):
+        db.execute("UPDATE docs SET owner_id = 99 WHERE k = 2", role="app")
+    # rewriting INTO scope is fine
+    db.execute("UPDATE docs SET owner_id = 2 WHERE k = 2", role="app")
+    # untouched policy columns stay allowed
+    db.execute("UPDATE docs SET v = v + 1 WHERE k = 2", role="app")
+    # superuser unrestricted
+    db.execute("UPDATE docs SET owner_id = 99 WHERE k = 3")
+
+
+def test_rls_parameterized_insert(db):
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    db.execute("INSERT INTO docs VALUES ($1, $2, $3)",
+               params=[400, 2, 7], role="app")
+    with pytest.raises(AnalysisError, match="violates row-level security"):
+        db.execute("INSERT INTO docs VALUES ($1, $2, $3)",
+                   params=[401, 3, 7], role="app")
+
+
+def test_replace_or_drop_trigger_function_guarded(db):
+    db.execute("CREATE TABLE audit (n bigint)")
+    db.execute("CREATE FUNCTION tf() RETURNS trigger AS "
+               "'INSERT INTO audit VALUES (1)'")
+    db.execute("CREATE TRIGGER tr AFTER INSERT ON docs "
+               "EXECUTE FUNCTION tf()")
+    with pytest.raises(CatalogError, match="depend"):
+        db.execute("CREATE OR REPLACE FUNCTION tf(x bigint) "
+                   "RETURNS bigint AS 'x + 1'")
+    with pytest.raises(CatalogError, match="depend"):
+        db.execute("DROP FUNCTION tf")
+    db.execute("DROP TRIGGER tr ON docs")
+    db.execute("DROP FUNCTION tf")
