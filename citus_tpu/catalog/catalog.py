@@ -292,8 +292,21 @@ class Catalog:
                 self.tables[name] = TableMeta.from_json(td)
         for nd in d.get("nodes", []):
             self.nodes.setdefault(nd["node_id"], NodeMeta.from_json(nd))
+        # policies are LIST-valued per table: merge per policy (by
+        # "table.name" identity) so a concurrent coordinator's added
+        # policy on a table we already track is not discarded; drops
+        # tombstone the per-policy key
+        dead_p = tomb.get("policies", set())
+        for tbl, plist in d.get("policies", {}).items():
+            if tbl in dead_p or tbl in tomb.get("tables", ()):
+                continue
+            names = {p["name"] for p in self.policies.get(tbl, [])}
+            for p in plist:
+                if f"{tbl}.{p['name']}" in dead_p or p["name"] in names:
+                    continue
+                self.policies.setdefault(tbl, []).append(p)
         for sec in ("views", "sequences", "roles", "functions", "types",
-                    "enum_columns", "schemas", "policies", "rls",
+                    "enum_columns", "schemas", "rls",
                     "triggers", "ts_configs"):
             disk = d.get(sec, {})
             mem = getattr(self, sec)

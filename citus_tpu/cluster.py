@@ -970,7 +970,8 @@ class Cluster:
                 self.catalog.policies[stmt.table] = kept
             else:
                 del self.catalog.policies[stmt.table]
-                self.catalog.tombstone("policies", stmt.table)
+            # per-policy tombstone: the commit-time merge is per policy
+            self.catalog.tombstone("policies", f"{stmt.table}.{stmt.name}")
             self.catalog.commit()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.AlterTableRls):
@@ -2100,10 +2101,14 @@ class Cluster:
         owners in PG)."""
         import dataclasses
         changed = [False]
+        EMPTY = frozenset()
 
-        def rew_from(item):
-            if isinstance(item, A.TableRef) \
-                    and self.catalog.has_table(item.name):
+        def rew_from(item, shadow):
+            if isinstance(item, A.TableRef):
+                if item.name in shadow:
+                    return item  # resolves to a CTE, not the base table
+                if not self.catalog.has_table(item.name):
+                    return item
                 f = self._policy_predicate(role, item.name, "select")
                 if f is None:
                     return item
@@ -2113,87 +2118,123 @@ class Cluster:
                 return A.SubqueryRef(sel,
                                      item.alias or item.name.split(".")[-1])
             if isinstance(item, A.Join):
-                return A.Join(rew_from(item.left), rew_from(item.right),
+                return A.Join(rew_from(item.left, shadow),
+                              rew_from(item.right, shadow),
                               item.kind, item.condition)
             if isinstance(item, A.SubqueryRef):
-                return A.SubqueryRef(rew_stmt(item.select), item.alias)
+                return A.SubqueryRef(rew_stmt(item.select, shadow),
+                                     item.alias)
             return item
 
-        def rew_expr(e):
+        def rew_expr(e, shadow):
             if e is None or not isinstance(e, A.Expr):
                 return e
             if isinstance(e, A.Subquery):
-                return A.Subquery(rew_stmt(e.select))
+                return A.Subquery(rew_stmt(e.select, shadow))
             if isinstance(e, A.Exists):
-                return A.Exists(rew_stmt(e.select))
+                return A.Exists(rew_stmt(e.select, shadow))
             if isinstance(e, A.BinOp):
-                return A.BinOp(e.op, rew_expr(e.left), rew_expr(e.right))
+                return A.BinOp(e.op, rew_expr(e.left, shadow),
+                               rew_expr(e.right, shadow))
             if isinstance(e, A.UnOp):
-                return A.UnOp(e.op, rew_expr(e.operand))
+                return A.UnOp(e.op, rew_expr(e.operand, shadow))
             if isinstance(e, A.Between):
-                return A.Between(rew_expr(e.expr), rew_expr(e.lo),
-                                 rew_expr(e.hi), e.negated)
+                return A.Between(rew_expr(e.expr, shadow),
+                                 rew_expr(e.lo, shadow),
+                                 rew_expr(e.hi, shadow), e.negated)
             if isinstance(e, A.InList):
-                return A.InList(rew_expr(e.expr),
-                                tuple(rew_expr(i) for i in e.items),
+                return A.InList(rew_expr(e.expr, shadow),
+                                tuple(rew_expr(i, shadow) for i in e.items),
                                 e.negated)
             if isinstance(e, A.IsNull):
-                return A.IsNull(rew_expr(e.expr), e.negated)
+                return A.IsNull(rew_expr(e.expr, shadow), e.negated)
             if isinstance(e, A.Cast):
-                return A.Cast(rew_expr(e.expr), e.type_name, e.type_args)
+                return A.Cast(rew_expr(e.expr, shadow), e.type_name,
+                              e.type_args)
             if isinstance(e, A.CaseExpr):
                 return A.CaseExpr(
-                    tuple((rew_expr(c), rew_expr(v)) for c, v in e.whens),
-                    rew_expr(e.else_) if e.else_ is not None else None)
+                    tuple((rew_expr(c, shadow), rew_expr(v, shadow))
+                          for c, v in e.whens),
+                    rew_expr(e.else_, shadow) if e.else_ is not None
+                    else None)
             if isinstance(e, A.FuncCall):
-                return A.FuncCall(e.name, tuple(rew_expr(a) for a in e.args),
+                return A.FuncCall(e.name,
+                                  tuple(rew_expr(a, shadow) for a in e.args),
                                   e.distinct, e.agg_order)
             if isinstance(e, A.WindowCall):
                 return A.WindowCall(
-                    rew_expr(e.func) if e.func is not None else None,
-                    tuple(rew_expr(p) for p in e.partition_by),
-                    tuple((rew_expr(oe), asc) for oe, asc in e.order_by),
+                    rew_expr(e.func, shadow) if e.func is not None else None,
+                    tuple(rew_expr(p, shadow) for p in e.partition_by),
+                    tuple((rew_expr(oe, shadow), asc)
+                          for oe, asc in e.order_by),
                     e.frame, e.ref_name, e.ref_verbatim)
             return e
 
-        def rew_stmt(s):
+        def rew_stmt(s, shadow):
             if isinstance(s, A.SetOp):
-                return dataclasses.replace(s, left=rew_stmt(s.left),
-                                           right=rew_stmt(s.right))
+                return dataclasses.replace(s, left=rew_stmt(s.left, shadow),
+                                           right=rew_stmt(s.right, shadow))
             if isinstance(s, A.WithSelect):
-                return A.WithSelect(
-                    [(n, rew_stmt(sel)) for n, sel in s.ctes],
-                    rew_stmt(s.body))
+                # a CTE's definition may reference only EARLIER CTE
+                # names; later refs resolve to the base relations
+                seen = set(shadow)
+                new_ctes = []
+                for n, sel in s.ctes:
+                    new_ctes.append((n, rew_stmt(sel, frozenset(seen))))
+                    seen.add(n)
+                return A.WithSelect(new_ctes,
+                                    rew_stmt(s.body, frozenset(seen)))
             if not isinstance(s, A.Select):
                 return s
             return dataclasses.replace(
                 s,
-                items=[A.SelectItem(rew_expr(i.expr), i.alias)
+                items=[A.SelectItem(rew_expr(i.expr, shadow), i.alias)
                        for i in s.items],
-                from_=rew_from(s.from_) if s.from_ is not None else None,
-                where=rew_expr(s.where),
-                group_by=[rew_expr(g) for g in s.group_by],
-                having=rew_expr(s.having),
-                order_by=[A.OrderItem(rew_expr(o.expr), o.ascending,
+                from_=rew_from(s.from_, shadow) if s.from_ is not None
+                else None,
+                where=rew_expr(s.where, shadow),
+                group_by=[rew_expr(g, shadow) for g in s.group_by],
+                having=rew_expr(s.having, shadow),
+                order_by=[A.OrderItem(rew_expr(o.expr, shadow), o.ascending,
                                       o.nulls_first) for o in s.order_by])
 
         if isinstance(stmt, (A.Select, A.SetOp, A.WithSelect)):
-            new_stmt = rew_stmt(stmt)
+            new_stmt = rew_stmt(stmt, EMPTY)
             return (new_stmt, True) if changed[0] else (stmt, False)
         if isinstance(stmt, (A.Update, A.Delete)):
             cmd = "update" if isinstance(stmt, A.Update) else "delete"
             f = self._policy_predicate(role, stmt.table, cmd)
+            # embedded subqueries (WHERE / SET) read through RLS too,
+            # regardless of whether the TARGET table has policies
+            new_where = rew_expr(stmt.where, EMPTY)
+            if isinstance(stmt, A.Update):
+                new_assign = [(c, rew_expr(e, EMPTY))
+                              for c, e in stmt.assignments]
             if f is None:
-                return stmt, False
+                if isinstance(stmt, A.Update):
+                    return (dataclasses.replace(
+                        stmt, assignments=new_assign, where=new_where),
+                        changed[0])
+                return dataclasses.replace(stmt, where=new_where), changed[0]
             if isinstance(stmt, A.Update):
                 self._rls_check_update(role, stmt)
-            where = rew_expr(f if stmt.where is None
-                             else A.BinOp("and", stmt.where, f))
+            where = f if new_where is None else A.BinOp("and", new_where, f)
+            if isinstance(stmt, A.Update):
+                return (dataclasses.replace(
+                    stmt, assignments=new_assign, where=where), True)
             return dataclasses.replace(stmt, where=where), True
         if isinstance(stmt, A.Insert):
+            # the SELECT source / row expressions read through RLS
+            new_select = (rew_stmt(stmt.select, EMPTY)
+                          if stmt.select is not None else None)
+            new_rows = ([[rew_expr(v, EMPTY) for v in row]
+                         for row in stmt.rows] if stmt.rows else stmt.rows)
             f = self._policy_predicate(role, stmt.table, "insert",
                                        kind="check")
             if f is None:
+                if changed[0]:
+                    return dataclasses.replace(
+                        stmt, select=new_select, rows=new_rows), True
                 return stmt, False
             if stmt.select is not None or not stmt.rows:
                 raise UnsupportedFeatureError(
@@ -2214,7 +2255,8 @@ class Cluster:
                     raise AnalysisError(
                         f'new row violates row-level security policy for '
                         f'table "{stmt.table}"')
-            return stmt, False
+            return (dataclasses.replace(stmt, rows=new_rows), True) \
+                if changed[0] else (stmt, False)
         return stmt, False
 
     def _rls_check_update(self, role: str, stmt: A.Update) -> None:
@@ -2353,10 +2395,14 @@ class Cluster:
         if isinstance(stmt, (A.Select, A.SetOp)):
             check_read(stmt)
         elif isinstance(stmt, A.WithSelect):
-            cte_names = frozenset(n for n, _sel in stmt.ctes)
-            for _n, sel in stmt.ctes:
-                check_read(sel, skip=cte_names)
-            check_read(stmt.body, skip=cte_names)
+            # a CTE's definition may reference only EARLIER CTE names —
+            # a same-named reference inside its own body resolves to the
+            # real relation and must be privilege-checked as one
+            seen: set = set()
+            for n, sel in stmt.ctes:
+                check_read(sel, skip=frozenset(seen))
+                seen.add(n)
+            check_read(stmt.body, skip=frozenset(seen))
         elif isinstance(stmt, A.Insert):
             if not self.catalog.has_privilege(role, stmt.table, "insert"):
                 deny("INSERT", stmt.table)

@@ -27,7 +27,11 @@ class ColumnarSettings:
     chunk_group_row_limit: int = 8192
     # Rows per stripe (reference default 150_000).
     stripe_row_limit: int = 131072
-    compression: str = "zstd"  # zstd | lz4 | zlib | none
+    # Stripe compression codec: zstd | lz4 | zlib | none
+    # (reference columnar.compression; decompression happens host-side
+    # before batches stream to HBM).
+    compression: str = "zstd"
+    # Codec level (reference columnar.compression_level).
     compression_level: int = 3
 
 

@@ -1,0 +1,24 @@
+"""Build hook: compile the native columnar IO library during wheel
+builds (reference: the extension's PGXS Makefiles build citus.so; here
+one C++ shared library built by make, loaded via ctypes with a pure-
+Python fallback when unavailable)."""
+
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        native = Path(__file__).parent / "citus_tpu" / "native"
+        try:
+            subprocess.run(["make", "-C", str(native)], check=True)
+        except Exception as e:  # toolchain absent: ship pure-Python
+            print(f"warning: native build skipped ({e}); "
+                  "the engine falls back to Python IO")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -1,0 +1,20 @@
+-- round-2 analytics surface: named windows, RANGE frames,
+-- approx_percentile, enum ordering, multi-key correlation
+CREATE TABLE s (k bigint NOT NULL, g bigint, h bigint, v bigint);
+SELECT create_distributed_table('s', 'k', 4);
+INSERT INTO s VALUES (1, 0, 0, 10), (2, 0, 1, 40), (3, 0, 0, 20), (4, 1, 1, 5), (5, 1, 0, 25), (6, 1, 1, 15), (7, 1, 0, 35), (8, 0, 1, 30);
+SELECT k, sum(v) OVER w AS run, count(*) OVER w AS cnt FROM s WINDOW w AS (PARTITION BY g ORDER BY k) ORDER BY k;
+SELECT k, sum(v) OVER (w ORDER BY v) AS byval FROM s WINDOW w AS (PARTITION BY g) ORDER BY k;
+SELECT k, sum(v) OVER (PARTITION BY g ORDER BY v RANGE BETWEEN 10 PRECEDING AND 10 FOLLOWING) AS near FROM s ORDER BY k;
+SELECT approx_percentile(0.5) WITHIN GROUP (ORDER BY v) AS med FROM s;
+CREATE TYPE sev AS ENUM ('low', 'high', 'critical');
+CREATE TABLE ev (k bigint NOT NULL, s sev);
+SELECT create_distributed_table('ev', 'k', 2);
+INSERT INTO ev VALUES (1, 'high'), (2, 'low'), (3, 'critical'), (4, 'low');
+SELECT k, s FROM ev WHERE s >= 'high' ORDER BY s, k;
+SELECT s, count(*) FROM ev GROUP BY s ORDER BY s DESC;
+SELECT count(*) FROM s a WHERE EXISTS (SELECT 1 FROM s b WHERE b.g = a.g AND b.h = a.h AND b.v > a.v);
+SELECT k, (SELECT max(b.v) FROM s b WHERE b.g = a.g AND b.h = a.h) AS peer_max FROM s a ORDER BY k;
+DROP TABLE ev;
+DROP TYPE sev;
+DROP TABLE s;

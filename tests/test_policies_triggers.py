@@ -275,3 +275,66 @@ def test_replace_or_drop_trigger_function_guarded(db):
         db.execute("DROP FUNCTION tf")
     db.execute("DROP TRIGGER tr ON docs")
     db.execute("DROP FUNCTION tf")
+
+
+def test_rls_applies_inside_dml_subqueries(db):
+    """INSERT..SELECT sources and UPDATE/DELETE subqueries over RLS
+    tables are policy-filtered even when the DML TARGET has no policy."""
+    db.execute("CREATE TABLE sink (k bigint, v bigint)")
+    db.execute("GRANT SELECT, INSERT, UPDATE, DELETE ON sink TO app")
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    db.execute("INSERT INTO sink SELECT k, v FROM docs", role="app")
+    assert db.execute("SELECT count(*) FROM sink").rows == [(25,)]
+    db.execute("DELETE FROM sink")
+    db.execute("INSERT INTO sink VALUES (1, 0), (3, 0)")
+    # subquery in UPDATE's WHERE reads only policy-visible docs rows
+    db.execute("UPDATE sink SET v = 99 WHERE k IN "
+               "(SELECT owner_id FROM docs)", role="app")
+    r = db.execute("SELECT k, v FROM sink ORDER BY k")
+    assert r.rows == [(1, 0), (3, 0)]  # owner_id values visible: only 2
+    db.execute("DELETE FROM sink WHERE k IN (SELECT owner_id FROM docs "
+               "WHERE owner_id = 3)", role="app")
+    assert db.execute("SELECT count(*) FROM sink").rows == [(2,)]
+
+
+def test_cte_shadowing_cannot_bypass_privileges(db):
+    """WITH secret AS (SELECT * FROM secret): inside the CTE body the
+    name is the REAL table and needs a grant."""
+    db.execute("CREATE TABLE secret (x bigint)")
+    db.execute("INSERT INTO secret VALUES (42)")
+    with pytest.raises(CatalogError, match="permission denied"):
+        db.execute("WITH secret AS (SELECT x FROM secret) "
+                   "SELECT count(*) FROM secret", role="app")
+
+
+def test_cte_shadowing_rls_table_is_the_cte(db):
+    """A CTE named like an RLS table shadows it: the body reference must
+    NOT get the policy predicate injected."""
+    db.execute("ALTER TABLE docs ENABLE ROW LEVEL SECURITY")
+    db.execute("CREATE POLICY own ON docs USING (owner_id = 2)")
+    r = db.execute("WITH docs AS (SELECT 1 AS x) SELECT x FROM docs",
+                   role="app")
+    assert r.rows == [(1,)]
+
+
+def test_policy_merge_is_per_policy(tmp_path):
+    """Two coordinators adding policies on the same table via the flock
+    path: both survive the commit-time merge."""
+    a = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    a.execute("CREATE TABLE t (k bigint, owner bigint)")
+    b = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    a.execute("CREATE POLICY p1 ON t USING (owner = 1)")
+    # b commits p2 without having seen p1's commit in memory
+    b.execute("CREATE POLICY p2 ON t USING (owner = 2)")
+    # a's next commit merges: both policies survive
+    a.execute("CREATE TABLE t2 (x bigint)")
+    names_a = {p["name"] for p in a.catalog.policies.get("t", [])}
+    assert names_a == {"p1", "p2"}, names_a
+    # and a drop through one coordinator doesn't resurrect via the other
+    a.execute("DROP POLICY p1 ON t")
+    b.execute("CREATE TABLE t3 (x bigint)")  # b merges on commit
+    a.execute("CREATE TABLE t4 (x bigint)")  # a re-merges disk
+    assert {p["name"] for p in a.catalog.policies.get("t", [])} == {"p2"}
+    b.close()
+    a.close()
