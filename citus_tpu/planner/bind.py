@@ -114,6 +114,12 @@ class Binder:
         # $N parameter slots: 0-based index -> (ColumnType, text_source)
         # populated by infer_param_types before a parameterized bind
         self.param_types: dict[int, tuple] = {}
+        # (key_map, aggs) while binding scalar-function arguments in a
+        # grouped query's select list: lets round(avg(x), 2) resolve the
+        # nested aggregate to a BAggRef and group-key references to
+        # BKeyRef (PostgreSQL allows arbitrary expressions over
+        # aggregates/keys above the Agg node)
+        self._agg_ctx = None
 
     def resolve_column(self, name: str, rel_alias: Optional[str] = None):
         """-> (env_key, Column, alias, TableMeta)."""
@@ -250,7 +256,12 @@ class Binder:
     def bind_scalar(self, e: A.Expr, allow_agg: bool = False) -> BExpr:
         if isinstance(e, A.ColumnRef):
             key, col, _, _ = self.resolve_column(e.name, e.table)
-            return BColumn(key, col.type)
+            b = BColumn(key, col.type)
+            if self._agg_ctx is not None:
+                idx = self._agg_ctx[0].get(b)
+                if idx is not None:
+                    return BKeyRef(idx, b.type)
+            return b
         if isinstance(e, A.Param):
             from citus_tpu.planner.bound import BParam
             spec = self.param_types.get(e.index - 1)
@@ -470,6 +481,10 @@ class Binder:
 
     def _bind_func(self, e: A.FuncCall, allow_agg: bool) -> BExpr:
         name = e.name
+        if self._agg_ctx is not None:
+            from citus_tpu.planner.aggregates import AGG_REGISTRY
+            if name in AGG_FUNCS or name in AGG_REGISTRY:
+                return self._bind_agg_call(e, self._agg_ctx[1])
         if name in AGG_FUNCS:
             raise AnalysisError(f"aggregate {name}() not allowed here")
         if name in ("like", "ilike"):
@@ -617,7 +632,147 @@ class Binder:
             return BCase(((BBinOp("<", inner, BLiteral(0, T.INT64_T) if not inner.type.is_float
                                   else BLiteral(0.0, T.FLOAT64_T), T.BOOL_T),
                            BUnOp("-", inner, inner.type)),), inner, inner.type)
+        bound_math = self._bind_math_func(name, e, allow_agg)
+        if bound_math is not None:
+            return bound_math
         raise UnsupportedFeatureError(f"function {name}() not supported")
+
+    def _bind_math_func(self, name: str, e: A.FuncCall,
+                        allow_agg: bool) -> Optional[BExpr]:
+        """PostgreSQL's scalar math surface (float.c / numeric.c):
+        floor/ceil/round/trunc are exact on the decimal scaled-int
+        representation; transcendentals go through float64."""
+        from citus_tpu.planner.bound import BMathFunc
+
+        def to_f(x: BExpr) -> BExpr:
+            return x if x.type.is_float else BCast(x, T.FLOAT64_T)
+
+        def literal_int(a: A.Expr, what: str) -> int:
+            lit = self.bind_scalar(a, allow_agg)
+            if isinstance(lit, BUnOp) and lit.op == "-" \
+                    and isinstance(lit.operand, BLiteral):
+                lit = BLiteral(-lit.operand.value, lit.type)
+            if not isinstance(lit, BLiteral) or lit.value is None:
+                raise UnsupportedFeatureError(f"{what} must be a literal")
+            return int(lit.value)
+
+        if name in ("floor", "ceil", "ceiling", "round", "trunc"):
+            fname = "ceil" if name == "ceiling" else name
+            if not e.args:
+                raise AnalysisError(f"{fname}() requires an argument")
+            inner = self.bind_scalar(e.args[0], allow_agg)
+            digits = 0
+            if len(e.args) > 1:
+                if fname in ("floor", "ceil"):
+                    raise AnalysisError(f"{fname}() takes one argument")
+                digits = literal_int(e.args[1], f"{fname}() digit count")
+                if digits < 0:
+                    raise UnsupportedFeatureError(
+                        f"{fname}() negative digit counts not supported")
+            t = inner.type
+            if t.is_float:
+                return BMathFunc(fname, (inner,), T.FLOAT64_T,
+                                 param=(0, digits))
+            if t.is_integer:
+                return inner
+            if t.is_decimal:
+                if digits >= t.scale:
+                    return self._rescale(inner, digits) \
+                        if digits != t.scale else inner
+                return BMathFunc(fname, (inner,), T.decimal_t(38, digits),
+                                 param=(t.scale, digits))
+            raise AnalysisError(f"{fname}() expects a numeric argument")
+        if name in ("sqrt", "exp", "ln", "log", "log10", "log2",
+                    "power", "pow"):
+            args = [self.bind_scalar(a, allow_agg) for a in e.args]
+            if any(not a.type.is_numeric for a in args):
+                raise AnalysisError(f"{name}() expects numeric arguments")
+            if name in ("power", "pow"):
+                if len(args) != 2:
+                    raise AnalysisError("power() requires two arguments")
+                return BMathFunc("power", (to_f(args[0]), to_f(args[1])),
+                                 T.FLOAT64_T)
+            if name == "log" and len(args) == 2:
+                # log(base, x) = ln(x) / ln(base)
+                lx = BMathFunc("ln", (to_f(args[1]),), T.FLOAT64_T)
+                lb = BMathFunc("ln", (to_f(args[0]),), T.FLOAT64_T)
+                return BBinOp("/", lx, lb, T.FLOAT64_T)
+            if len(args) != 1:
+                raise AnalysisError(f"{name}() requires one argument")
+            fname = "log10" if name == "log" else name
+            return BMathFunc(fname, (to_f(args[0]),), T.FLOAT64_T)
+        if name == "mod":
+            if len(e.args) != 2:
+                raise AnalysisError("mod() requires two arguments")
+            return self._bind_binop(A.BinOp("%", e.args[0], e.args[1]),
+                                    allow_agg)
+        if name == "sign":
+            if len(e.args) != 1:
+                raise AnalysisError("sign() requires one argument")
+            inner = self.bind_scalar(e.args[0], allow_agg)
+            if not inner.type.is_numeric:
+                raise AnalysisError("sign() expects a numeric argument")
+            out = T.FLOAT64_T if inner.type.is_float else T.INT64_T
+            return BMathFunc("sign", (inner,), out)
+        if name == "pi":
+            import math
+            if e.args:
+                raise AnalysisError("pi() takes no arguments")
+            return BLiteral(math.pi, T.FLOAT64_T)
+        if name in ("degrees", "radians"):
+            import math
+            if len(e.args) != 1:
+                raise AnalysisError(f"{name}() requires one argument")
+            factor = 180.0 / math.pi if name == "degrees" else math.pi / 180.0
+            inner = self.bind_scalar(e.args[0], allow_agg)
+            if not inner.type.is_numeric:
+                raise AnalysisError(f"{name}() expects a numeric argument")
+            return BBinOp("*", to_f(inner), BLiteral(factor, T.FLOAT64_T),
+                          T.FLOAT64_T)
+        if name in ("greatest", "least"):
+            if not e.args:
+                raise AnalysisError(f"{name}() requires arguments")
+            bound = [self.bind_scalar(a, allow_agg) for a in e.args]
+            # string literals coerce against the first typed argument
+            anchor = next((x.type for x in bound
+                           if not (isinstance(x, BLiteral) and x.type.is_text)),
+                          None)
+            if anchor is not None and not anchor.is_text:
+                bound = [self._coerce_string_literal(x, anchor, None)
+                         if isinstance(x, BLiteral) and x.type.is_text
+                         and isinstance(x.value, str) else x for x in bound]
+            out = bound[0].type
+            for x in bound[1:]:
+                out = T.common_super_type(out, x.type)
+            if out.is_text:
+                raise UnsupportedFeatureError(
+                    f"{name}() over text not supported")
+            if out.is_decimal:
+                bound = [self._rescale(x, out.scale)
+                         if (x.type.is_decimal or x.type.is_integer) else x
+                         for x in bound]
+            elif out.is_float:
+                bound = [to_f(x) for x in bound]
+            return BMathFunc(name, tuple(bound), out)
+        if name in ("strpos", "position"):
+            if len(e.args) != 2:
+                raise AnalysisError(f"{name}() requires two arguments")
+            target = self.bind_scalar(e.args[0], allow_agg)
+            sub = e.args[1]
+            if not (isinstance(sub, A.Literal) and isinstance(sub.value, str)):
+                raise UnsupportedFeatureError(
+                    f"{name}() substring must be a string literal")
+            resolved = self._text_words(target)
+            if resolved is None:
+                if isinstance(target, BLiteral) and isinstance(target.value, str):
+                    return BLiteral(target.value.find(sub.value) + 1, T.INT64_T)
+                raise UnsupportedFeatureError(
+                    f"{name}() requires a text column")
+            from citus_tpu.planner.bound import BDictLookup
+            base, _t, _c, eff_words = resolved
+            lut = tuple(w.find(sub.value) + 1 for w in eff_words)
+            return BDictLookup(base, lut)
+        return None
 
     # ---------------------------------------------------------------- aggs
     def _agg_output_type(self, kind: str, arg: Optional[BExpr]) -> T.ColumnType:
@@ -645,13 +800,12 @@ class Binder:
             return t
         raise AnalysisError(f"unknown aggregate {kind}")
 
-    def bind_select_expr(self, e: A.Expr, key_map: dict[BExpr, int],
-                         aggs: list[AggSpec]) -> BExpr:
-        """Bind an output/having expression of a grouped query: aggregates
-        become BAggRef slots, grouping-key subexpressions become BKeyRef."""
+    def _bind_agg_call(self, e: A.FuncCall, aggs: list[AggSpec]) -> BExpr:
+        """Aggregate call -> AggSpec (deduplicated) -> BAggRef slot."""
         from citus_tpu.planner.aggregates import AGG_REGISTRY
-        if isinstance(e, A.FuncCall) and (e.name in AGG_FUNCS
-                                          or e.name in AGG_REGISTRY):
+        # the aggregate's own argument binds in row space, not key space
+        saved_ctx, self._agg_ctx = self._agg_ctx, None
+        try:
             if e.name in AGG_REGISTRY:
                 spec = AGG_REGISTRY[e.name].bind(self, e)
             elif e.distinct and e.name in ("sum", "avg"):
@@ -688,6 +842,17 @@ class Binder:
                     return BAggRef(i, spec.out_type)
             aggs.append(spec)
             return BAggRef(len(aggs) - 1, spec.out_type)
+        finally:
+            self._agg_ctx = saved_ctx
+
+    def bind_select_expr(self, e: A.Expr, key_map: dict[BExpr, int],
+                         aggs: list[AggSpec]) -> BExpr:
+        """Bind an output/having expression of a grouped query: aggregates
+        become BAggRef slots, grouping-key subexpressions become BKeyRef."""
+        from citus_tpu.planner.aggregates import AGG_REGISTRY
+        if isinstance(e, A.FuncCall) and (e.name in AGG_FUNCS
+                                          or e.name in AGG_REGISTRY):
+            return self._bind_agg_call(e, aggs)
         # non-aggregate: try matching a group key by source expression
         # first (stable under dictionary growth), then structurally
         am = getattr(self, "_ast_key_map", None)
@@ -715,6 +880,24 @@ class Binder:
             return BCast(inner, T.type_from_sql(e.type_name, list(e.type_args) or None))
         if isinstance(e, A.Literal):
             return self._bind_literal(e)
+        if isinstance(e, (A.FuncCall, A.CaseExpr, A.Between, A.InList,
+                          A.IsNull)):
+            # scalar expression over aggregates / group keys —
+            # round(avg(x), 2), coalesce(sum(x), 0), CASE WHEN count(*)...
+            # Nested aggregates resolve to BAggRef and key references to
+            # BKeyRef via the binding context; any raw column that
+            # survives is a semantic error.
+            saved_ctx, self._agg_ctx = self._agg_ctx, (key_map, aggs)
+            try:
+                bound = self.bind_scalar(e, allow_agg=True)
+            finally:
+                self._agg_ctx = saved_ctx
+            stray = [n for n in referenced_columns(bound)]
+            if stray:
+                raise AnalysisError(
+                    f"column {stray[0]!r} must appear in GROUP BY or be "
+                    "used in an aggregate")
+            return bound
         raise AnalysisError(
             f"expression {e} must appear in GROUP BY or be used in an aggregate")
 

@@ -126,6 +126,23 @@ class BDictMask(BExpr):
 
 
 @dataclass(frozen=True)
+class BMathFunc(BExpr):
+    """Scalar math function lowered to elementwise xp ops (reference:
+    float8/numeric math in PostgreSQL's float.c / numeric.c; domain
+    violations — sqrt of a negative, log of a non-positive — yield SQL
+    NULL rather than a device-side error, since a traced kernel cannot
+    raise data-dependent errors).
+
+    ``param`` carries bind-time constants (digit count for round/trunc
+    over decimals, the operand's decimal scale) so compilation stays
+    shape-static."""
+    name: str
+    operands: tuple[BExpr, ...]
+    type: T.ColumnType
+    param: object = None
+
+
+@dataclass(frozen=True)
 class BAggRef(BExpr):
     """Reference to aggregate slot ``index`` in the combine/final phase."""
     index: int
@@ -198,8 +215,11 @@ def walk(e: BExpr):
         yield from walk(e.left)
         yield from walk(e.right)
     elif isinstance(e, (BUnOp, BScale, BCast, BIsNull, BDictMask, BDictRemap,
-                        BDictLookup, BExtract, BDateTruncCivil)):
+                        BDictLookup, BExtract, BDateTrunc, BDateTruncCivil)):
         yield from walk(e.operand)
+    elif isinstance(e, BMathFunc):
+        for o in e.operands:
+            yield from walk(o)
     elif isinstance(e, BCase):
         for c, v in e.whens:
             yield from walk(c)
@@ -398,6 +418,8 @@ def compile_expr(e: BExpr, xp):
             safe = xp.clip(ids, 0, max(n - 1, 0))
             return (table[safe] if n else xp.zeros_like(ids, dtype=bool), valid)
         return run_dictmask
+    if isinstance(e, BMathFunc):
+        return _compile_math(e, xp)
     if isinstance(e, BUnOp):
         f = compile_expr(e.operand, xp)
         if e.op == "-":
@@ -487,6 +509,114 @@ def compile_expr(e: BExpr, xp):
                                        null_if=lambda a, b: b == 0)
         raise AnalysisError(f"unknown operator {op}")
     raise AnalysisError(f"cannot compile {type(e).__name__}")
+
+
+def _round_half_away(xp, v):
+    """Round half away from zero (PostgreSQL numeric/float rounding;
+    numpy's default is banker's rounding)."""
+    return xp.where(v >= 0, xp.floor(v + 0.5), xp.ceil(v - 0.5))
+
+
+def _compile_math(e, xp):
+    name = e.name
+    fs = [compile_expr(o, xp) for o in e.operands]
+    dt = e.type.device_dtype
+
+    if name in ("sqrt", "exp", "ln", "log10", "log2"):
+        f = fs[0]
+        fn = {"sqrt": lambda x: xp.sqrt(x), "exp": lambda x: xp.exp(x),
+              "ln": lambda x: xp.log(x), "log10": lambda x: xp.log10(x),
+              "log2": lambda x: xp.log2(x)}[name]
+        # domain violations -> NULL (PostgreSQL raises; a traced kernel
+        # can't, and NULL matches the sqlite oracle)
+        if name == "exp":
+            dom = None
+        elif name == "sqrt":
+            dom = lambda x: x >= 0  # noqa: E731
+        else:
+            dom = lambda x: x > 0  # noqa: E731
+
+        def run_unary(env):
+            v, valid = f(env)
+            v = xp.asarray(v).astype(np.float64)
+            if dom is None:
+                return (fn(v), valid)
+            ok = dom(v)
+            out = fn(xp.where(ok, v, 1.0))
+            return (out, _as_mask(xp, valid, out) & ok)
+        return run_unary
+    if name == "power":
+        fa, fb = fs
+
+        def run_power(env):
+            a, avalid = fa(env)
+            b, bvalid = fb(env)
+            a = xp.asarray(a).astype(np.float64)
+            b = xp.asarray(b).astype(np.float64)
+            # 0^negative and negative^non-integer are domain errors
+            ok = ~((a == 0) & (b < 0)) & ~((a < 0) & (b != xp.floor(b)))
+            out = xp.power(xp.where(ok, a, 1.0), xp.where(ok, b, 1.0))
+            valid = _as_mask(xp, avalid, out) & _as_mask(xp, bvalid, out) & ok
+            return (out, valid)
+        return run_power
+    if name in ("floor", "ceil", "round", "trunc"):
+        f = fs[0]
+        src_scale, digits = e.param  # operand decimal scale, round digits
+        if e.operands[0].type.is_float:
+            fn = {"floor": xp.floor, "ceil": xp.ceil,
+                  "round": lambda v: _round_half_away(xp, v),
+                  "trunc": xp.trunc}[name]
+            if digits:
+                factor = np.float64(10.0 ** digits)
+                return lambda env: ((lambda v: (fn(v[0] * factor) / factor,
+                                                v[1]))(f(env)))
+            return lambda env: ((lambda v: (fn(v[0]), v[1]))(f(env)))
+        # decimal (scaled int64) path: exact integer arithmetic.  The
+        # binder only emits this node when digits < operand scale
+        # (digits >= scale is an exact rescale handled at bind time).
+        drop = src_scale - max(digits, 0)
+        assert drop > 0, "binder emits BMathFunc only for digits < scale"
+        p = np.int64(10 ** drop)
+
+        def run_dec(env):
+            v, valid = f(env)
+            v = xp.asarray(v)
+            q = v // p                       # toward -inf
+            r = v - q * p
+            if name == "floor":
+                out = q
+            elif name == "ceil":
+                out = q + (r > 0)
+            elif name == "trunc":
+                out = xp.where(v >= 0, q, q + (r > 0))
+            else:  # round half away from zero
+                qt = xp.where(v >= 0, q, q + (r > 0))   # toward zero
+                rt = v - qt * p                          # remainder, sign of v
+                out = qt + xp.sign(rt) * (2 * xp.abs(rt) >= p)
+            return (out.astype(dt), valid)
+        return run_dec
+    if name == "sign":
+        f = fs[0]
+        return lambda env: ((lambda v: (xp.sign(v[0]).astype(dt), v[1]))(f(env)))
+    if name in ("greatest", "least"):
+        take_right = (lambda a, b: b > a) if name == "greatest" \
+            else (lambda a, b: b < a)
+
+        def run_fold(env):
+            acc, acc_valid = fs[0](env)
+            acc = xp.asarray(acc).astype(dt)
+            acc_valid = _as_mask(xp, acc_valid, acc)
+            for f in fs[1:]:
+                v, valid = f(env)
+                v = xp.asarray(v).astype(dt)
+                valid = _as_mask(xp, valid, v)
+                # NULLs are ignored: take the other side when one is null
+                pick = valid & (~acc_valid | take_right(acc, v))
+                acc = xp.where(pick, v, acc)
+                acc_valid = acc_valid | valid
+            return (acc, acc_valid)
+        return run_fold
+    raise AnalysisError(f"cannot compile math function {name}")
 
 
 def _as_bool(xp, v):

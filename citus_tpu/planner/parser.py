@@ -1308,6 +1308,16 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
+        if t.kind == "ident" and t.value == "position" and \
+                self.peek(1).kind == "op" and self.peek(1).value == "(":
+            # position(substring IN string) -> strpos(string, substring)
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_additive()
+            self.expect_kw("in")
+            s = self.parse_expr()
+            self.expect_op(")")
+            return A.FuncCall("strpos", (s, sub))
         if t.kind == "ident" and t.value == "extract" and \
                 self.peek(1).kind == "op" and self.peek(1).value == "(":
             self.next()
