@@ -190,9 +190,13 @@ def _replace_exprs(e, mapping: dict):
                           _replace_exprs(e.else_, mapping)
                           if e.else_ is not None else None)
     if isinstance(e, A.FuncCall):
-        return A.FuncCall(e.name,
-                          tuple(_replace_exprs(a, mapping) for a in e.args),
-                          e.distinct, e.agg_order)
+        import dataclasses
+        return dataclasses.replace(
+            e, args=tuple(_replace_exprs(a, mapping) for a in e.args),
+            agg_order=tuple((_replace_exprs(oe, mapping), asc)
+                            for oe, asc in e.agg_order),
+            filter=_replace_exprs(e.filter, mapping)
+            if e.filter is not None else None)
     return e
 
 
@@ -220,8 +224,67 @@ def _subst_args(e, sub: dict):
                                 for c, v in e.whens),
                           _subst_args(e.else_, sub) if e.else_ is not None else None)
     if isinstance(e, A.FuncCall):
-        return A.FuncCall(e.name, tuple(_subst_args(a, sub) for a in e.args),
-                          e.distinct, e.agg_order)
+        import dataclasses
+        return dataclasses.replace(
+            e, args=tuple(_subst_args(a, sub) for a in e.args),
+            agg_order=tuple((_subst_args(oe, sub), asc)
+                            for oe, asc in e.agg_order),
+            filter=_subst_args(e.filter, sub)
+            if e.filter is not None else None)
+    return e
+
+
+def _pylit(v) -> A.Literal:
+    """Python value -> literal AST node (for synthesized statements)."""
+    import decimal as _dec
+    if v is None:
+        return A.Literal(None, "null")
+    if isinstance(v, bool):
+        return A.Literal(v, "bool")
+    if isinstance(v, int):
+        return A.Literal(v, "int")
+    if isinstance(v, float):
+        return A.Literal(v, "float")
+    if isinstance(v, _dec.Decimal):
+        return A.Literal(v, "decimal")
+    return A.Literal(str(v), "string")
+
+
+def _subst_excluded(e, excl: dict):
+    """Replace ``excluded.col`` references with the proposed row's
+    literal values (ON CONFLICT DO UPDATE, PostgreSQL semantics)."""
+    if isinstance(e, A.ColumnRef) and e.table == "excluded":
+        return excl.get(e.name, A.Literal(None, "null"))
+    if isinstance(e, A.BinOp):
+        return A.BinOp(e.op, _subst_excluded(e.left, excl),
+                       _subst_excluded(e.right, excl))
+    if isinstance(e, A.UnOp):
+        return A.UnOp(e.op, _subst_excluded(e.operand, excl))
+    if isinstance(e, A.Between):
+        return A.Between(_subst_excluded(e.expr, excl),
+                         _subst_excluded(e.lo, excl),
+                         _subst_excluded(e.hi, excl), e.negated)
+    if isinstance(e, A.InList):
+        return A.InList(_subst_excluded(e.expr, excl),
+                        tuple(_subst_excluded(i, excl) for i in e.items),
+                        e.negated)
+    if isinstance(e, A.IsNull):
+        return A.IsNull(_subst_excluded(e.expr, excl), e.negated)
+    if isinstance(e, A.Cast):
+        return A.Cast(_subst_excluded(e.expr, excl), e.type_name, e.type_args)
+    if isinstance(e, A.CaseExpr):
+        return A.CaseExpr(
+            tuple((_subst_excluded(c, excl), _subst_excluded(v, excl))
+                  for c, v in e.whens),
+            _subst_excluded(e.else_, excl) if e.else_ is not None else None)
+    if isinstance(e, A.FuncCall):
+        import dataclasses
+        return dataclasses.replace(
+            e, args=tuple(_subst_excluded(a, excl) for a in e.args),
+            agg_order=tuple((_subst_excluded(oe, excl), asc)
+                            for oe, asc in e.agg_order),
+            filter=_subst_excluded(e.filter, excl)
+            if e.filter is not None else None)
     return e
 
 
@@ -679,6 +742,9 @@ class Cluster:
         result = Result(columns=[], rows=[])
         gpid = self.activity.enter(sql)
         t0 = _time.perf_counter()
+        # active role for statements synthesized mid-execution (the
+        # upsert's internal UPDATE must see the same RLS policies)
+        self._exec_role = role
         try:
             for stmt in stmts:
                 if params is not None:
@@ -705,6 +771,7 @@ class Cluster:
                 result = self._execute_stmt(stmt, sql_text=key)
                 self._fire_triggers(stmt)
         finally:
+            self._exec_role = None
             self.activity.exit(gpid)
         executor = result.explain.get("strategy", "utility") if result.explain else "utility"
         elapsed = _time.perf_counter() - t0
@@ -724,6 +791,8 @@ class Cluster:
         from citus_tpu.planner.recursive import has_subquery
         if not isinstance(stmt.from_, A.TableRef):
             return None
+        if stmt.distinct_on:
+            return None  # DISTINCT ON dedups through _execute_distinct_on
         if any(isinstance(i.expr, A.WindowCall) for i in stmt.items):
             return None
         exprs = ([i.expr for i in stmt.items] + [stmt.where, stmt.having]
@@ -766,6 +835,8 @@ class Cluster:
             stmt = self._expand_functions_stmt(stmt)
         if isinstance(stmt, A.SetOp):
             return self._execute_setop(stmt)
+        if isinstance(stmt, A.Select) and stmt.distinct_on:
+            return self._execute_distinct_on(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is None:
             return self._execute_constant_select(stmt)
         if isinstance(stmt, A.Select) and stmt.from_ is not None:
@@ -1268,6 +1339,9 @@ class Cluster:
     def _execute_insert(self, stmt: A.Insert) -> Result:
         t = self.catalog.table(stmt.table)
         if stmt.select is not None:
+            if stmt.on_conflict is not None:
+                raise UnsupportedFeatureError(
+                    "ON CONFLICT with INSERT..SELECT is not supported")
             if stmt.returning:
                 raise UnsupportedFeatureError(
                     "RETURNING on INSERT..SELECT is not supported")
@@ -1303,6 +1377,8 @@ class Cluster:
                     raise UnsupportedFeatureError("INSERT VALUES must be literals")
                 row.append(e.value)
             rows.append(row)
+        if stmt.on_conflict is not None:
+            return self._execute_upsert(t, stmt, rows)
         n = self.copy_from(stmt.table, rows=rows, column_names=stmt.columns)
         if stmt.returning:
             names = list(stmt.columns or t.schema.names)
@@ -1328,6 +1404,145 @@ class Cluster:
             return Result(columns=cols, rows=out_rows,
                           explain={"inserted": n})
         return Result(columns=[], rows=[], explain={"inserted": n})
+
+    def _execute_upsert(self, t, stmt: A.Insert, rows: list) -> Result:
+        """INSERT ... ON CONFLICT: the conflict target is the declared
+        key (the reference requires it to include the distribution
+        column so conflicts resolve within one shard group —
+        multi_router_planner.c rejects others).  Runs under the
+        colocation group's EXCLUSIVE write lock so check+write is atomic
+        against concurrent writers and shard moves."""
+        oc = stmt.on_conflict
+        if stmt.returning:
+            raise UnsupportedFeatureError(
+                "RETURNING with ON CONFLICT is not supported")
+        if not oc.targets:
+            raise UnsupportedFeatureError(
+                "ON CONFLICT requires an explicit (column, ...) target")
+        names = list(stmt.columns or t.schema.names)
+        for c in oc.targets:
+            if not t.schema.has(c):
+                raise AnalysisError(f"column {c!r} does not exist")
+            if c not in names:
+                raise AnalysisError(
+                    "ON CONFLICT target columns must be inserted columns")
+        if t.is_distributed and t.dist_column not in oc.targets:
+            raise UnsupportedFeatureError(
+                "ON CONFLICT target must include the distribution column")
+        for c, _e in oc.assignments:
+            if not t.schema.has(c):
+                raise AnalysisError(f"column {c!r} does not exist")
+            if t.is_distributed and c == t.dist_column:
+                raise UnsupportedFeatureError(
+                    "ON CONFLICT DO UPDATE cannot modify the distribution "
+                    "column")
+        key_idx = [names.index(c) for c in oc.targets]
+
+        def norm_key(vals) -> tuple:
+            """Canonicalize proposed key values to what a SELECT reads
+            back (physical round-trip), so they compare equal to probed
+            rows: 5.0 -> Decimal('5.00'), '2020-01-01' -> date."""
+            out = []
+            for c, v in zip(oc.targets, vals):
+                typ = t.schema.column(c).type
+                if v is None or typ.is_text:
+                    out.append(v)
+                else:
+                    out.append(typ.from_physical(typ.to_physical(v)))
+            return tuple(out)
+
+        if oc.action == "update":
+            # PostgreSQL raises error 21000 whenever two proposed rows
+            # would affect the same target row; checking up front keeps
+            # the statement all-or-nothing (no partially applied updates)
+            dup_check: set = set()
+            for row in rows:
+                raw = tuple(row[i] for i in key_idx)
+                if any(v is None for v in raw):
+                    continue
+                key = norm_key(raw)
+                if key in dup_check:
+                    raise ExecutionError(
+                        "ON CONFLICT DO UPDATE command cannot affect row "
+                        "a second time")
+                dup_check.add(key)
+        inserted = updated = skipped = 0
+        from citus_tpu.transaction.locks import EXCLUSIVE
+        with self._write_lock(t, EXCLUSIVE):
+            # one batched probe instead of a per-row count(*) under the
+            # lock: fetch the conflict-target columns of candidate rows
+            # (pruned by the distribution-column IN-list) into a set
+            probe_rows = [row for row in rows
+                          if not any(row[i] is None for i in key_idx)]
+            existing: set = set()
+            if probe_rows:
+                where = None
+                if t.is_distributed and t.dist_column in names:
+                    di = names.index(t.dist_column)
+                    dvals = sorted({row[di] for row in probe_rows})
+                    where = A.InList(A.ColumnRef(t.dist_column),
+                                     tuple(_pylit(v) for v in dvals), False)
+                chk = A.Select([A.SelectItem(A.ColumnRef(c))
+                                for c in oc.targets],
+                               A.TableRef(t.name), where)
+                existing = {tuple(r) for r in self._execute_stmt(chk).rows}
+            to_insert: list = []
+            affected: set = set()  # keys inserted/updated by this command
+            for row in rows:
+                raw = tuple(row[i] for i in key_idx)
+                if any(v is None for v in raw):
+                    # NULL never equals NULL: no conflict possible
+                    to_insert.append(row)
+                    inserted += 1
+                    continue
+                key = norm_key(raw)
+                if key in affected:
+                    # only reachable for DO NOTHING (DO UPDATE duplicate
+                    # keys were rejected before any mutation)
+                    skipped += 1
+                    continue
+                if key not in existing:
+                    affected.add(key)
+                    to_insert.append(row)
+                    inserted += 1
+                    continue
+                if oc.action == "nothing":
+                    skipped += 1
+                    continue
+                affected.add(key)
+                cond = None
+                for c, v in zip(oc.targets, raw):
+                    eq = A.BinOp("=", A.ColumnRef(c), _pylit(v))
+                    cond = eq if cond is None else A.BinOp("and", cond, eq)
+                excl = {c: _pylit(v) for c, v in zip(names, row)}
+                assignments = [(c, _subst_excluded(e, excl))
+                               for c, e in oc.assignments]
+                where = cond
+                if oc.where is not None:
+                    where = A.BinOp("and", cond,
+                                    _subst_excluded(oc.where, excl))
+                upd: A.Statement = A.Update(t.name, assignments, where)
+                exec_role = getattr(self, "_exec_role", None)
+                rls_applied = False
+                if exec_role is not None:
+                    # the conflicting row must pass the role's UPDATE
+                    # policies (PostgreSQL enforces USING + WITH CHECK
+                    # on the ON CONFLICT update path too)
+                    upd, rls_applied = self._apply_rls(exec_role, upd)
+                r = self._execute_stmt(upd)
+                n_upd = r.explain.get("updated", 0)
+                if rls_applied and n_upd == 0 and oc.where is None:
+                    raise AnalysisError(
+                        f'new row violates row-level security policy for '
+                        f'table "{t.name}"')
+                updated += n_upd
+                skipped += 0 if n_upd else 1  # DO UPDATE ... WHERE filtered
+            if to_insert:
+                self.copy_from(t.name, rows=to_insert,
+                               column_names=stmt.columns)
+        return Result(columns=[], rows=[],
+                      explain={"inserted": inserted, "updated": updated,
+                               "skipped": skipped, "strategy": "upsert"})
 
     def _insert_select_arrays(self, target, sel: A.Select,
                               names: list[str]) -> Optional[int]:
@@ -1502,11 +1717,66 @@ class Cluster:
         return A.WindowCall(wc.func, base.partition_by,
                             wc.order_by or base.order_by, wc.frame)
 
+    def _execute_distinct_on(self, stmt: A.Select) -> Result:
+        """SELECT DISTINCT ON (exprs): keep the first row of each key
+        group in ORDER BY order (PostgreSQL semantics — planned as
+        Unique over Sort).  The key expressions run as trailing hidden
+        outputs of the inner query; deduplication happens on the
+        coordinator, then LIMIT/OFFSET apply to the deduplicated rows."""
+        import dataclasses as _dc
+        on = list(stmt.distinct_on)
+
+        def resolve(e):
+            # ordinals and output aliases resolve to their select item
+            if isinstance(e, A.Literal) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                idx = e.value - 1
+                if 0 <= idx < len(stmt.items):
+                    return stmt.items[idx].expr
+            if isinstance(e, A.ColumnRef) and e.table is None:
+                for it in stmt.items:
+                    if it.alias == e.name:
+                        return it.expr
+            return e
+
+        for i, e in enumerate(on):
+            if i < len(stmt.order_by) \
+                    and resolve(stmt.order_by[i].expr) != resolve(e):
+                raise AnalysisError(
+                    "SELECT DISTINCT ON expressions must match initial "
+                    "ORDER BY expressions")
+        order_by = list(stmt.order_by) \
+            or [A.OrderItem(e, True, None) for e in on]
+        hidden = [A.SelectItem(resolve(e), f"__distinct_on_{i}")
+                  for i, e in enumerate(on)]
+        inner = _dc.replace(stmt, items=list(stmt.items) + hidden,
+                            order_by=order_by, limit=None, offset=None,
+                            distinct_on=())
+        r = self._execute_stmt(inner)
+        k = len(on)
+        seen, rows = set(), []
+        for row in r.rows:
+            key = row[-k:]
+            if key in seen:
+                continue
+            seen.add(key)
+            rows.append(row[:-k])
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit is not None:
+            rows = rows[:stmt.limit]
+        return Result(columns=r.columns[:-k], rows=rows,
+                      explain={**(r.explain or {}),
+                               "strategy": "distinct_on"},
+                      types=r.types[:-k] if r.types else r.types)
+
     def _execute_window(self, stmt: A.Select) -> Result:
         """Window functions: run the base projection (or grouped
         aggregation) distributed, apply the window pass on the
         coordinator (pull strategy)."""
-        from citus_tpu.executor.window import NAVIGATION, compute_window
+        import dataclasses
+
+        from citus_tpu.executor.window import AGGS, NAVIGATION, compute_window
         if stmt.distinct:
             raise UnsupportedFeatureError(
                 "window functions with DISTINCT not supported yet")
@@ -1541,6 +1811,15 @@ class Cluster:
             e = item.expr
             if isinstance(e, A.WindowCall):
                 fn = e.func.name
+                if e.func.filter is not None:
+                    if fn not in AGGS:
+                        raise AnalysisError(
+                            "FILTER is only allowed for aggregate window "
+                            "functions")
+                    # same CASE desugar as plain aggregates: the window
+                    # aggregates above skip NULL inputs
+                    from citus_tpu.planner.bind import rewrite_agg_filter
+                    e = dataclasses.replace(e, func=rewrite_agg_filter(e.func))
                 args = [a for a in e.func.args if not isinstance(a, A.Star)]
                 if fn in NAVIGATION:
                     arg_slots = [base_slot(args[0])] if args else []
@@ -1824,8 +2103,12 @@ class Cluster:
                 return A.CaseExpr(tuple((rw(c, d), rw(v, d)) for c, v in e.whens),
                                   rw(e.else_, d) if e.else_ is not None else None)
             if isinstance(e, A.FuncCall):
-                return A.FuncCall(e.name, tuple(rw(a, d) for a in e.args),
-                                  e.distinct, e.agg_order)
+                import dataclasses
+                return dataclasses.replace(
+                    e, args=tuple(rw(a, d) for a in e.args),
+                    agg_order=tuple((rw(oe, d), asc)
+                                    for oe, asc in e.agg_order),
+                    filter=rw(e.filter, d) if e.filter is not None else None)
             if isinstance(e, A.WindowCall):
                 return A.WindowCall(rw(e.func, d) if e.func is not None else None,
                                     tuple(rw(p, d) for p in e.partition_by),
@@ -1845,7 +2128,8 @@ class Cluster:
             [A.OrderItem(rw(o.expr, 0), o.ascending, o.nulls_first)
              for o in stmt.order_by],
             stmt.limit, stmt.offset, stmt.distinct,
-            tuple((wn, rw(spec, 0)) for wn, spec in stmt.windows))
+            tuple((wn, rw(spec, 0)) for wn, spec in stmt.windows),
+            tuple(rw(e, 0) for e in stmt.distinct_on))
 
     def _execute_constant_select(self, stmt: A.Select) -> Result:
         """SELECT without FROM: constant expressions evaluated on the
@@ -2158,9 +2442,13 @@ class Cluster:
                     rew_expr(e.else_, shadow) if e.else_ is not None
                     else None)
             if isinstance(e, A.FuncCall):
-                return A.FuncCall(e.name,
-                                  tuple(rew_expr(a, shadow) for a in e.args),
-                                  e.distinct, e.agg_order)
+                import dataclasses
+                return dataclasses.replace(
+                    e, args=tuple(rew_expr(a, shadow) for a in e.args),
+                    agg_order=tuple((rew_expr(oe, shadow), asc)
+                                    for oe, asc in e.agg_order),
+                    filter=rew_expr(e.filter, shadow)
+                    if e.filter is not None else None)
             if isinstance(e, A.WindowCall):
                 return A.WindowCall(
                     rew_expr(e.func, shadow) if e.func is not None else None,
@@ -2406,6 +2694,13 @@ class Cluster:
         elif isinstance(stmt, A.Insert):
             if not self.catalog.has_privilege(role, stmt.table, "insert"):
                 deny("INSERT", stmt.table)
+            if stmt.on_conflict is not None \
+                    and stmt.on_conflict.action == "update" \
+                    and not self.catalog.has_privilege(role, stmt.table,
+                                                       "update"):
+                # DO UPDATE modifies existing rows (PostgreSQL requires
+                # UPDATE privilege in addition to INSERT)
+                deny("UPDATE", stmt.table)
             if stmt.select is not None:
                 check_read(stmt.select)
         elif isinstance(stmt, A.Update):

@@ -69,6 +69,9 @@ class FuncCall(Expr):
     distinct: bool = False
     # ordered-set / ordered aggregate: string_agg(x, ',' ORDER BY y DESC)
     agg_order: tuple = ()  # tuple[(Expr, asc: bool)]
+    # agg(...) FILTER (WHERE cond) — desugared at bind time by wrapping
+    # the value argument in CASE WHEN cond THEN arg END
+    filter: Optional[Expr] = None
 
     def __str__(self):
         inner = ", ".join(str(a) for a in self.args)
@@ -211,6 +214,16 @@ class DropTable(Statement):
     if_exists: bool = False
 
 
+@dataclass(frozen=True)
+class OnConflict:
+    """INSERT ... ON CONFLICT (cols) DO NOTHING | DO UPDATE SET ...
+    [WHERE ...].  Assignments/where may reference ``excluded.col``."""
+    targets: tuple = ()        # conflict target column names
+    action: str = "nothing"    # nothing | update
+    assignments: tuple = ()    # tuple[(col, Expr)]
+    where: Optional[Expr] = None
+
+
 @dataclass
 class Insert(Statement):
     table: str
@@ -218,6 +231,7 @@ class Insert(Statement):
     rows: list[list[Expr]]
     select: Optional["Select"] = None  # INSERT ... SELECT
     returning: Optional[list] = None   # [SelectItem] | None
+    on_conflict: Optional[OnConflict] = None
 
 
 @dataclass
@@ -291,6 +305,9 @@ class Select(Statement):
     # WINDOW name AS (spec) declarations: tuple[(name, WindowCall-spec)]
     # (the spec is a WindowCall with func=None)
     windows: tuple = ()
+    # SELECT DISTINCT ON (expr, ...): keep the first row per key in
+    # ORDER BY order (PostgreSQL extension)
+    distinct_on: tuple = ()
 
 
 @dataclass

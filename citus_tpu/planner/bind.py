@@ -97,6 +97,26 @@ def _like_to_regex(pattern: str) -> "re.Pattern":
     return re.compile("^" + "".join(out) + "$", re.DOTALL)
 
 
+def rewrite_agg_filter(e: A.FuncCall) -> A.FuncCall:
+    """agg(x) FILTER (WHERE f) -> agg(CASE WHEN f THEN x END): every
+    supported aggregate ignores NULL inputs, so masking the value
+    argument is exactly the reference's filter semantics (PostgreSQL
+    evaluates FILTER before the transition function)."""
+    import dataclasses
+    f = e.filter
+    if e.name == "count" and (not e.args or isinstance(e.args[0], A.Star)):
+        new_args = (A.CaseExpr(((f, A.Literal(1, "int")),), None),)
+        return dataclasses.replace(e, args=new_args, filter=None)
+    if not e.args:
+        raise AnalysisError(f"{e.name}() requires an argument")
+    # ordered-set aggregates carry the value expression last
+    vi = len(e.args) - 1 if e.name in (
+        "percentile_cont", "percentile_disc", "approx_percentile") else 0
+    args = list(e.args)
+    args[vi] = A.CaseExpr(((f, args[vi]),), None)
+    return dataclasses.replace(e, args=tuple(args), filter=None)
+
+
 class Binder:
     """Resolves expressions against a range table of (alias, TableMeta).
 
@@ -487,6 +507,10 @@ class Binder:
                 return self._bind_agg_call(e, self._agg_ctx[1])
         if name in AGG_FUNCS:
             raise AnalysisError(f"aggregate {name}() not allowed here")
+        if e.filter is not None:
+            raise AnalysisError(
+                f"FILTER specified, but {name}() is not an aggregate "
+                "function")
         if name in ("like", "ilike"):
             target = self.bind_scalar(e.args[0], allow_agg)
             pat = e.args[1]
@@ -803,6 +827,8 @@ class Binder:
     def _bind_agg_call(self, e: A.FuncCall, aggs: list[AggSpec]) -> BExpr:
         """Aggregate call -> AggSpec (deduplicated) -> BAggRef slot."""
         from citus_tpu.planner.aggregates import AGG_REGISTRY
+        if e.filter is not None:
+            e = rewrite_agg_filter(e)
         # the aggregate's own argument binds in row space, not key space
         saved_ctx, self._agg_ctx = self._agg_ctx, None
         try:

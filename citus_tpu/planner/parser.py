@@ -10,6 +10,7 @@ is intentionally a strict, unambiguous subset.
 
 from __future__ import annotations
 
+import dataclasses
 import decimal
 import re
 from dataclasses import dataclass
@@ -822,6 +823,7 @@ class Parser:
         if self.at_kw("select"):
             sel = self.parse_select()
             return A.Insert(name, cols, [], select=sel,
+                            on_conflict=self._parse_on_conflict(),
                             returning=self._parse_returning())
         self.expect_kw("values")
         rows = []
@@ -837,7 +839,42 @@ class Parser:
             if not self.accept_op(","):
                 break
         return A.Insert(name, cols, rows,
+                        on_conflict=self._parse_on_conflict(),
                         returning=self._parse_returning())
+
+    def _parse_on_conflict(self):
+        """ON CONFLICT [(col, ...)] DO NOTHING | DO UPDATE SET col =
+        expr [, ...] [WHERE cond] — expressions may reference
+        ``excluded.col`` (the proposed row, as in PostgreSQL)."""
+        save = self.i
+        if not self.accept_kw("on"):
+            return None
+        if not (self.peek().kind == "ident" and self.peek().value == "conflict"):
+            self.i = save
+            return None
+        self.next()
+        targets = []
+        if self.accept_op("("):
+            while True:
+                targets.append(self.expect_ident())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        self.expect_kw("do")
+        if self.accept_kw("nothing"):
+            return A.OnConflict(tuple(targets), "nothing")
+        self.expect_kw("update")
+        self.expect_kw("set")
+        assignments = []
+        while True:
+            col = self.expect_ident()
+            self.expect_op("=")
+            assignments.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return A.OnConflict(tuple(targets), "update", tuple(assignments),
+                            where)
 
     def _parse_returning(self):
         """RETURNING expr [AS alias] [, ...] on INSERT/UPDATE/DELETE —
@@ -1012,6 +1049,16 @@ class Parser:
             self.error("expected SELECT")
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
+        distinct_on: tuple = ()
+        if distinct and self.accept_kw("on"):
+            # SELECT DISTINCT ON (expr, ...): first row per key
+            self.expect_op("(")
+            on_list = [self.parse_expr()]
+            while self.accept_op(","):
+                on_list.append(self.parse_expr())
+            self.expect_op(")")
+            distinct_on = tuple(on_list)
+            distinct = False
         items = []
         while True:
             if self.at_op("*"):
@@ -1060,7 +1107,7 @@ class Parser:
                 if not self.accept_op(","):
                     break
         return A.Select(items, from_, where, group_by, having, [],
-                        None, None, distinct, tuple(windows))
+                        None, None, distinct, tuple(windows), distinct_on)
 
     def parse_from(self):
         left = self.parse_table_ref()
@@ -1374,6 +1421,15 @@ class Parser:
                     self.accept_kw("asc")
                     self.expect_op(")")
                     fc = A.FuncCall(t.value, tuple(args) + (sort_expr,), distinct)
+                if self.peek().kind == "ident" and self.peek().value == "filter" \
+                        and self.peek(1).kind == "op" and self.peek(1).value == "(":
+                    # agg(...) FILTER (WHERE cond) [OVER ...]
+                    self.next()
+                    self.expect_op("(")
+                    self.expect_kw("where")
+                    cond = self.parse_expr()
+                    self.expect_op(")")
+                    fc = dataclasses.replace(fc, filter=cond)
                 if self.at_kw("over"):
                     self.next()
                     if self.peek().kind == "ident":

@@ -106,8 +106,13 @@ def bind_params(e, params):
             tuple((bind_params(oe, params), asc) for oe, asc in e.order_by),
             e.frame, e.ref_name, e.ref_verbatim)
     if isinstance(e, A.FuncCall):
-        return A.FuncCall(e.name, tuple(bind_params(a, params) for a in e.args),
-                          e.distinct)
+        import dataclasses
+        return dataclasses.replace(
+            e, args=tuple(bind_params(a, params) for a in e.args),
+            agg_order=tuple((bind_params(oe, params), asc)
+                            for oe, asc in e.agg_order),
+            filter=bind_params(e.filter, params)
+            if e.filter is not None else None)
     if isinstance(e, A.Subquery):
         return A.Subquery(rewrite_params(e.select, params))
     if isinstance(e, A.Exists):
@@ -139,6 +144,7 @@ def has_params(e) -> bool:
 
 def rewrite_params(stmt, params):
     """Substitute $N placeholders throughout a statement."""
+    import dataclasses
     if isinstance(stmt, A.Select):
         return A.Select(
             items=[A.SelectItem(bind_params(i.expr, params), i.alias)
@@ -151,7 +157,9 @@ def rewrite_params(stmt, params):
                                   o.nulls_first) for o in stmt.order_by],
             limit=stmt.limit, offset=stmt.offset, distinct=stmt.distinct,
             windows=tuple((wn, bind_params(spec, params))
-                          for wn, spec in stmt.windows))
+                          for wn, spec in stmt.windows),
+            distinct_on=tuple(bind_params(e, params)
+                              for e in stmt.distinct_on))
     if isinstance(stmt, A.Delete):
         return A.Delete(stmt.table, bind_params(stmt.where, params),
                         stmt.returning)
@@ -160,9 +168,17 @@ def rewrite_params(stmt, params):
                         [(c, bind_params(e, params)) for c, e in stmt.assignments],
                         bind_params(stmt.where, params), stmt.returning)
     if isinstance(stmt, A.Insert) and stmt.rows:
+        oc = stmt.on_conflict
+        if oc is not None:
+            oc = dataclasses.replace(
+                oc,
+                assignments=tuple((c, bind_params(e, params))
+                                  for c, e in oc.assignments),
+                where=bind_params(oc.where, params)
+                if oc.where is not None else None)
         return A.Insert(stmt.table, stmt.columns,
                         [[bind_params(e, params) for e in row] for row in stmt.rows],
-                        stmt.select, stmt.returning)
+                        stmt.select, stmt.returning, oc)
     return stmt
 
 
@@ -406,7 +422,11 @@ def decorrelate_scalars(stmt: A.Select) -> A.Select:
             return A.CaseExpr(tuple((rwx(c), rwx(v)) for c, v in e.whens),
                               rwx(e.else_) if e.else_ is not None else None)
         if isinstance(e, A.FuncCall):
-            return A.FuncCall(e.name, tuple(rwx(a) for a in e.args), e.distinct)
+            import dataclasses
+            return dataclasses.replace(
+                e, args=tuple(rwx(a) for a in e.args),
+                agg_order=tuple((rwx(oe), asc) for oe, asc in e.agg_order),
+                filter=rwx(e.filter) if e.filter is not None else None)
         return e
 
     new_items = [A.SelectItem(rwx(i.expr), i.alias) for i in stmt.items]
@@ -595,7 +615,11 @@ def rewrite_subqueries(stmt: A.Select, run_select) -> A.Select:
             return A.CaseExpr(tuple((rw(c), rw(v)) for c, v in e.whens),
                               rw(e.else_) if e.else_ is not None else None)
         if isinstance(e, A.FuncCall):
-            return A.FuncCall(e.name, tuple(rw(a) for a in e.args), e.distinct)
+            import dataclasses
+            return dataclasses.replace(
+                e, args=tuple(rw(a) for a in e.args),
+                agg_order=tuple((rw(oe), asc) for oe, asc in e.agg_order),
+                filter=rw(e.filter) if e.filter is not None else None)
         return e
 
     exprs = ([i.expr for i in stmt.items] + [stmt.where, stmt.having]

@@ -1,0 +1,250 @@
+"""INSERT ... ON CONFLICT (upsert) and SELECT DISTINCT ON.
+
+Reference: PostgreSQL ON CONFLICT through the router modify path —
+the reference requires the conflict target to include the distribution
+column (multi_router_planner.c) so conflicts resolve within one shard
+group; DISTINCT ON plans as Unique over Sort.
+"""
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import (
+    AnalysisError, ExecutionError, UnsupportedFeatureError,
+)
+
+
+@pytest.fixture()
+def kv(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE kv (k bigint NOT NULL, v bigint, note text)")
+    cl.execute("SELECT create_distributed_table('kv','k',4)")
+    cl.execute("INSERT INTO kv (k, v, note) VALUES "
+               "(1, 10, 'a'), (2, 20, 'b'), (3, 30, 'c')")
+    return cl
+
+
+def test_do_nothing(kv):
+    r = kv.execute("INSERT INTO kv (k, v) VALUES (1, 99), (5, 50) "
+                   "ON CONFLICT (k) DO NOTHING")
+    assert r.explain["inserted"] == 1 and r.explain["skipped"] == 1
+    assert kv.execute("SELECT v FROM kv WHERE k = 1").rows == [(10,)]
+    assert kv.execute("SELECT v FROM kv WHERE k = 5").rows == [(50,)]
+
+
+def test_do_update_with_excluded(kv):
+    r = kv.execute("INSERT INTO kv (k, v) VALUES (2, 7), (6, 60) "
+                   "ON CONFLICT (k) DO UPDATE SET v = excluded.v + kv.v")
+    assert r.explain == {"inserted": 1, "updated": 1, "skipped": 0,
+                         "strategy": "upsert"}
+    assert kv.execute("SELECT v FROM kv WHERE k = 2").rows == [(27,)]
+
+
+def test_do_update_where_clause(kv):
+    kv.execute("INSERT INTO kv (k, v) VALUES (3, 1) "
+               "ON CONFLICT (k) DO UPDATE SET v = excluded.v WHERE kv.v > 25")
+    assert kv.execute("SELECT v FROM kv WHERE k = 3").rows == [(1,)]
+    kv.execute("INSERT INTO kv (k, v) VALUES (3, 2) "
+               "ON CONFLICT (k) DO UPDATE SET v = excluded.v WHERE kv.v > 25")
+    assert kv.execute("SELECT v FROM kv WHERE k = 3").rows == [(1,)]
+
+
+def test_intra_batch_conflict(kv):
+    # a row inserted earlier in the same command conflicts with a later one
+    r = kv.execute("INSERT INTO kv (k, v) VALUES (9, 1), (9, 2) "
+                   "ON CONFLICT (k) DO NOTHING")
+    assert r.explain["inserted"] == 1 and r.explain["skipped"] == 1
+    with pytest.raises(ExecutionError):
+        kv.execute("INSERT INTO kv (k, v) VALUES (8, 1), (8, 2) "
+                   "ON CONFLICT (k) DO UPDATE SET v = excluded.v")
+    # ... and twice against the same PRE-EXISTING row (PG error 21000)
+    with pytest.raises(ExecutionError):
+        kv.execute("INSERT INTO kv (k, v) VALUES (1, 5), (1, 6) "
+                   "ON CONFLICT (k) DO UPDATE SET v = excluded.v")
+
+
+def test_decimal_date_key_normalization(tmp_path):
+    """Proposed key values must compare equal to stored rows after the
+    physical round-trip (5.0 vs Decimal('5.00'), string vs date)."""
+    cl = ct.Cluster(str(tmp_path / "dbn"))
+    cl.execute("CREATE TABLE p (k bigint NOT NULL, amt decimal(8,2), d date, "
+               "v bigint)")
+    cl.execute("SELECT create_distributed_table('p','k',2)")
+    cl.execute("INSERT INTO p VALUES (1, 5.00, '2020-01-01', 1)")
+    r = cl.execute("INSERT INTO p VALUES (1, 5.0, '2020-01-01', 2) "
+                   "ON CONFLICT (k, amt, d) DO UPDATE SET v = excluded.v")
+    assert r.explain["updated"] == 1
+    assert cl.execute("SELECT v FROM p WHERE k = 1").rows == [(2,)]
+
+
+def test_filter_on_scalar_function_rejected(kv):
+    with pytest.raises(AnalysisError):
+        kv.execute("SELECT abs(v) FILTER (WHERE v > 0) FROM kv")
+
+
+def test_filter_survives_param_plans(kv):
+    r = kv.execute("SELECT count(*) FILTER (WHERE v > $1) FROM kv",
+                   params=[15])
+    total = kv.execute("SELECT count(*) FILTER (WHERE v > 15) FROM kv").rows
+    assert r.rows == total
+    assert r.rows[0][0] < kv.execute("SELECT count(*) FROM kv").rows[0][0]
+
+
+def test_null_key_never_conflicts(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db2"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, u bigint, v bigint)")
+    cl.execute("SELECT create_distributed_table('t','k',2)")
+    cl.execute("INSERT INTO t VALUES (1, NULL, 1)")
+    r = cl.execute("INSERT INTO t VALUES (1, NULL, 2) "
+                   "ON CONFLICT (k, u) DO NOTHING")
+    assert r.explain["inserted"] == 1
+    assert cl.execute("SELECT count(*) FROM t").rows == [(2,)]
+
+
+def test_validation_errors(kv):
+    with pytest.raises(UnsupportedFeatureError):
+        kv.execute("INSERT INTO kv (k, v) VALUES (1, 1) "
+                   "ON CONFLICT (v) DO NOTHING")      # missing distcol
+    with pytest.raises(UnsupportedFeatureError):
+        kv.execute("INSERT INTO kv (k, v) VALUES (1, 1) "
+                   "ON CONFLICT DO NOTHING")           # no explicit target
+    with pytest.raises(UnsupportedFeatureError):
+        kv.execute("INSERT INTO kv (k, v) VALUES (1, 1) "
+                   "ON CONFLICT (k) DO UPDATE SET k = 5")  # distcol update
+    with pytest.raises(AnalysisError):
+        kv.execute("INSERT INTO kv (k, v) VALUES (1, 1) "
+                   "ON CONFLICT (nope) DO NOTHING")
+
+
+def test_upsert_text_and_multi_key(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db3"))
+    cl.execute("CREATE TABLE s (k bigint NOT NULL, tag text, v bigint)")
+    cl.execute("SELECT create_distributed_table('s','k',2)")
+    cl.execute("INSERT INTO s VALUES (1, 'x', 1), (1, 'y', 2)")
+    r = cl.execute("INSERT INTO s VALUES (1, 'x', 9), (1, 'z', 3) "
+                   "ON CONFLICT (k, tag) DO UPDATE SET v = excluded.v")
+    assert r.explain["inserted"] == 1 and r.explain["updated"] == 1
+    assert cl.execute("SELECT tag, v FROM s WHERE k = 1 ORDER BY tag").rows \
+        == [("x", 9), ("y", 2), ("z", 3)]
+
+
+# ------------------------------------------------------------ DISTINCT ON
+
+@pytest.fixture()
+def events(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db4"))
+    cl.execute("CREATE TABLE e (id bigint NOT NULL, dev bigint, ts bigint, "
+               "v double)")
+    cl.execute("SELECT create_distributed_table('e','id',4)")
+    rows = [(i, i % 3, (i * 7) % 20, float(i)) for i in range(60)]
+    cl.copy_from("e", rows=rows)
+    return cl, rows
+
+
+def test_distinct_on_latest_per_group(events):
+    cl, rows = events
+    got = cl.execute("SELECT DISTINCT ON (dev) dev, ts, v FROM e "
+                     "ORDER BY dev, ts DESC, v DESC").rows
+    best = {}
+    for i, d, t, v in rows:
+        if d not in best or (t, v) > best[d]:
+            best[d] = (t, v)
+    assert got == [(d,) + best[d] for d in sorted(best)]
+
+
+def test_distinct_on_with_limit_and_outer_order(events):
+    cl, _ = events
+    got = cl.execute("SELECT DISTINCT ON (dev) dev, ts FROM e "
+                     "ORDER BY dev DESC, ts LIMIT 2").rows
+    assert [r[0] for r in got] == [2, 1]
+
+
+def test_distinct_on_requires_matching_order(events):
+    cl, _ = events
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT DISTINCT ON (dev) dev, ts FROM e ORDER BY ts")
+
+
+def test_distinct_on_no_order_by(events):
+    cl, _ = events
+    got = cl.execute("SELECT DISTINCT ON (dev) dev FROM e").rows
+    assert sorted(r[0] for r in got) == [0, 1, 2]
+
+
+def test_upsert_requires_update_privilege(kv):
+    kv.execute("CREATE ROLE bob")
+    kv.execute("GRANT INSERT ON kv TO bob")
+    from citus_tpu.errors import CatalogError
+    with pytest.raises(CatalogError):
+        kv.execute("INSERT INTO kv (k, v) VALUES (1, 5) "
+                   "ON CONFLICT (k) DO UPDATE SET v = excluded.v", role="bob")
+    kv.execute("GRANT UPDATE ON kv TO bob")
+    kv.execute("INSERT INTO kv (k, v) VALUES (1, 5) "
+               "ON CONFLICT (k) DO UPDATE SET v = excluded.v", role="bob")
+    assert kv.execute("SELECT v FROM kv WHERE k = 1").rows == [(5,)]
+
+
+def test_upsert_respects_update_rls(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "dbrls"))
+    cl.execute("CREATE TABLE t (id bigint NOT NULL, tenant bigint, v bigint)")
+    cl.execute("SELECT create_distributed_table('t','id',2)")
+    cl.execute("INSERT INTO t VALUES (2, 2, 200)")
+    cl.execute("CREATE ROLE bob")
+    cl.execute("GRANT INSERT ON t TO bob")
+    cl.execute("GRANT UPDATE ON t TO bob")
+    cl.execute("GRANT SELECT ON t TO bob")
+    cl.execute("ALTER TABLE t ENABLE ROW LEVEL SECURITY")
+    cl.execute("CREATE POLICY p ON t USING (tenant = 1) "
+               "WITH CHECK (tenant = 1)")
+    # the conflicting row belongs to tenant 2: bob's UPDATE policy must
+    # block the DO UPDATE (PostgreSQL raises an RLS violation)
+    with pytest.raises(AnalysisError):
+        cl.execute("INSERT INTO t VALUES (2, 1, 999) "
+                   "ON CONFLICT (id) DO UPDATE SET v = excluded.v",
+                   role="bob")
+    assert cl.execute("SELECT v FROM t WHERE id = 2").rows == [(200,)]
+
+
+def test_atomic_duplicate_update_rejection(kv):
+    """Duplicate DO UPDATE keys abort BEFORE any update applies."""
+    with pytest.raises(ExecutionError):
+        kv.execute("INSERT INTO kv (k, v) VALUES (1, 200), (1, 300) "
+                   "ON CONFLICT (k) DO UPDATE SET v = excluded.v")
+    assert kv.execute("SELECT v FROM kv WHERE k = 1").rows == [(10,)]
+
+
+def test_distinct_on_survives_function_catalog(events):
+    """SQL-function expansion must not strip distinct_on."""
+    cl, _ = events
+    cl.execute("CREATE FUNCTION addone(x bigint) RETURNS bigint AS 'x + 1'")
+    got = cl.execute("SELECT DISTINCT ON (dev) dev, ts FROM e "
+                     "ORDER BY dev, ts DESC").rows
+    assert len(got) == 3
+    assert len({r[0] for r in got}) == 3
+
+
+def test_distinct_on_parameterized(events):
+    cl, _ = events
+    got = cl.execute("SELECT DISTINCT ON (dev) dev, ts FROM e "
+                     "WHERE id < $1 ORDER BY dev, ts DESC", params=[60]).rows
+    assert len(got) == 3
+
+
+def test_agg_order_survives_function_inlining(tmp_path):
+    """Macro parameters inside an aggregate's ORDER BY substitute too."""
+    cl = ct.Cluster(str(tmp_path / "dbfn"))
+    cl.execute("CREATE TABLE w (id bigint NOT NULL, g bigint, s text)")
+    cl.execute("SELECT create_distributed_table('w','id',2)")
+    cl.execute("INSERT INTO w VALUES (1, 1, 'a'), (2, 1, 'b'), (3, 1, 'c')")
+    cl.execute("CREATE FUNCTION cat(k bigint) RETURNS text AS "
+               "'string_agg(s, '','' ORDER BY id * k)'")
+    r = cl.execute("SELECT g, cat(-1) FROM w GROUP BY g").rows
+    assert r == [(1, "c,b,a")]
+
+
+def test_distinct_on_expression(events):
+    cl, _ = events
+    got = cl.execute("SELECT DISTINCT ON (dev % 2) dev % 2, ts FROM e "
+                     "ORDER BY dev % 2, ts DESC").rows
+    assert [r[0] for r in got] == [0, 1]
+    assert all(r[1] == 19 for r in got)
