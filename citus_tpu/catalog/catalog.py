@@ -77,6 +77,10 @@ class TableMeta:
     # bumped on any DDL/ingest; plan caches key on it (the analog of the
     # reference's syscache-invalidation-driven plan invalidation)
     version: int = 0
+    # foreign keys declared ON this table (referencing side), each
+    # {"name", "columns", "ref_table", "ref_columns", "on_delete"}
+    # (reference: pg_constraint rows + foreign_constraint.c validation)
+    foreign_keys: list = field(default_factory=list)
 
     @property
     def shard_count(self) -> int:
@@ -101,6 +105,7 @@ class TableMeta:
             "compression": self.compression,
             "compression_level": self.compression_level,
             "version": self.version,
+            "foreign_keys": self.foreign_keys,
         }
 
     @staticmethod
@@ -115,6 +120,7 @@ class TableMeta:
             compression=d["compression"],
             compression_level=d["compression_level"],
             version=d.get("version", 0),
+            foreign_keys=d.get("foreign_keys", []),
         )
 
 
@@ -454,6 +460,18 @@ class Catalog:
 
     def has_table(self, name: str) -> bool:
         return name in self.tables
+
+    def referencing_fks(self, name: str) -> list[tuple[str, dict]]:
+        """Foreign keys of OTHER tables that reference ``name`` ->
+        [(referencing_table, fk)] (the reverse edge set of the
+        reference's foreign-key graph cache,
+        utils/foreign_key_relationship.c)."""
+        out = []
+        for t in self.tables.values():
+            for fk in t.foreign_keys:
+                if fk["ref_table"] == name:
+                    out.append((t.name, fk))
+        return out
 
     def create_table(self, name: str, schema: Schema, **columnar_opts) -> TableMeta:
         with self._lock:

@@ -379,6 +379,8 @@ class Cluster:
         self.tenant_stats = TenantStats()
         self.activity = ActivityTracker()
         self.locks = LockManager()
+        # thread id -> role active in that thread's execute() call
+        self._exec_roles: dict[int, Optional[str]] = {}
         # control plane (reference: metadata sync + 2PC votes over libpq;
         # here an RPC skeleton — net/control_plane.py).  serve_port=N
         # makes this coordinator the metadata authority; coordinator=
@@ -567,6 +569,8 @@ class Cluster:
     def drop_table(self, name: str, *, if_exists: bool = False) -> None:
         if if_exists and not self.catalog.has_table(name):
             return
+        from citus_tpu.integrity import forbid_drop_referenced
+        forbid_drop_referenced(self.catalog, name)
         self.catalog.drop_table(name)
         for key in [k for k in self.catalog.enum_columns
                     if k.startswith(name + ".")]:
@@ -597,6 +601,12 @@ class Cluster:
             name, dist_column, shard_count, self.catalog.active_node_ids(),
             colocate_with=colocate_with,
             replication_factor=self.settings.sharding.shard_replication_factor)
+        try:
+            from citus_tpu.integrity import validate_fk_distribution
+            validate_fk_distribution(self.catalog, name)
+        except Exception:
+            self.catalog._load()  # roll back the uncommitted distribution
+            raise
         self.catalog.commit()
 
     def create_reference_table(self, name: str) -> None:
@@ -606,6 +616,12 @@ class Cluster:
             raise UnsupportedFeatureError(
                 "converting a non-empty table is not supported yet")
         self.catalog.make_reference_table(name, self.catalog.active_node_ids())
+        try:
+            from citus_tpu.integrity import validate_fk_distribution
+            validate_fk_distribution(self.catalog, name)
+        except Exception:
+            self.catalog._load()
+            raise
         self.catalog.commit()
 
     # ----------------------------------------------------------- ingest
@@ -621,16 +637,38 @@ class Cluster:
         if rows is not None:
             columns = rows_to_columns(t.schema.names, rows, column_names)
         values, validity = encode_columns(self.catalog, t, columns)
+        import contextlib as _ctxlib
+
         from citus_tpu.transaction.locks import SHARED
         with self._write_lock(t, SHARED):
             t = self.catalog.table(table_name)  # re-fetch: fresh placements
-            ing = TableIngestor(self.catalog, t, txlog=self.txlog)
-            try:
-                ing.append(values, validity)
-            except BaseException:
-                ing.abort()
-                raise
-            ing.finish()
+            with _ctxlib.ExitStack() as stack:
+                if t.foreign_keys:
+                    # hold the parents' group locks (SHARED) across
+                    # probe + write, so a concurrent parent DELETE
+                    # (EXCLUSIVE on the parent group) cannot interleave
+                    # between the FK check and the ingest commit
+                    from citus_tpu.integrity import check_ingest
+                    from citus_tpu.transaction.write_locks import (
+                        group_resource, group_write_lock,
+                    )
+                    parents = {}
+                    for fk in t.foreign_keys:
+                        p = self.catalog.table(fk["ref_table"])
+                        parents[group_resource(p)] = p
+                    for res in sorted(parents):
+                        stack.enter_context(group_write_lock(
+                            self.catalog, parents[res], SHARED,
+                            lock_manager=self.locks,
+                            timeout=self.settings.executor.lock_timeout_s))
+                    check_ingest(self, t, columns)
+                ing = TableIngestor(self.catalog, t, txlog=self.txlog)
+                try:
+                    ing.append(values, validity)
+                except BaseException:
+                    ing.abort()
+                    raise
+                ing.finish()
         n = len(next(iter(values.values()))) if values else 0
         self.counters.bump("rows_ingested", n)
         if self.cdc.enabled and n:
@@ -743,8 +781,11 @@ class Cluster:
         gpid = self.activity.enter(sql)
         t0 = _time.perf_counter()
         # active role for statements synthesized mid-execution (the
-        # upsert's internal UPDATE must see the same RLS policies)
-        self._exec_role = role
+        # upsert's internal UPDATE must see the same RLS policies);
+        # per-thread: concurrent execute() calls must not see each
+        # other's roles
+        import threading as _threading
+        self._exec_roles[_threading.get_ident()] = role
         try:
             for stmt in stmts:
                 if params is not None:
@@ -771,7 +812,7 @@ class Cluster:
                 result = self._execute_stmt(stmt, sql_text=key)
                 self._fire_triggers(stmt)
         finally:
-            self._exec_role = None
+            self._exec_roles.pop(_threading.get_ident(), None)
             self.activity.exit(gpid)
         executor = result.explain.get("strategy", "utility") if result.explain else "utility"
         elapsed = _time.perf_counter() - t0
@@ -1146,7 +1187,17 @@ class Cluster:
                         c.not_null))
             schema = Schema(cols)
             opts = {k: v for k, v in stmt.options.items() if k != "access_method"}
+            fks = []
+            pre_existing = self.catalog.has_table(stmt.name)
+            if stmt.foreign_keys and not pre_existing:
+                from citus_tpu.integrity import declare_fks
+                fks = declare_fks(self.catalog, stmt.name,
+                                  stmt.foreign_keys, schema=schema)
             self.create_table(stmt.name, schema, if_not_exists=stmt.if_not_exists, **opts)
+            if fks and not pre_existing and self.catalog.has_table(stmt.name):
+                # IF NOT EXISTS no-op must not clobber existing constraints
+                self.catalog.table(stmt.name).foreign_keys = fks
+                self.catalog.commit()
             if enum_binds and self.catalog.has_table(stmt.name):
                 for cn, tn in enum_binds:
                     self.catalog.enum_columns[f"{stmt.name}.{cn}"] = tn
@@ -1179,6 +1230,11 @@ class Cluster:
                 if stmt.where is not None else None
             from citus_tpu.transaction.locks import EXCLUSIVE
             with self._write_lock(t, EXCLUSIVE):
+                if self.catalog.referencing_fks(stmt.table):
+                    # RESTRICT / CASCADE / SET NULL on referencing tables
+                    # before the parent rows disappear
+                    from citus_tpu.integrity import on_parent_delete
+                    on_parent_delete(self, stmt.table, stmt.where)
                 # RETURNING reads the pre-image under the same lock so
                 # the rows returned are exactly the rows deleted
                 ret = self._returning_result(stmt.table, stmt.where,
@@ -1220,6 +1276,14 @@ class Cluster:
             where = b.bind_scalar(stmt.where) if stmt.where is not None else None
             from citus_tpu.transaction.locks import EXCLUSIVE
             with self._write_lock(t, EXCLUSIVE):
+                assigned_cols = {c for c, _e in stmt.assignments}
+                if self.catalog.referencing_fks(stmt.table):
+                    from citus_tpu.integrity import on_parent_update
+                    on_parent_update(self, stmt.table, assigned_cols,
+                                     stmt.where)
+                if t.foreign_keys:
+                    from citus_tpu.integrity import check_child_update
+                    check_child_update(self, t, stmt.assignments)
                 ret = None
                 if stmt.returning:
                     # new values = assignments substituted into the items,
@@ -1251,11 +1315,24 @@ class Cluster:
                 self.catalog.drop_column(stmt.table, stmt.old_name)
             elif stmt.action == "rename_column":
                 self.catalog.rename_column(stmt.table, stmt.old_name, stmt.new_name)
+                # keep FK metadata consistent: this table's own key
+                # columns and every child's referenced-column names
+                for fk in self.catalog.table(stmt.table).foreign_keys:
+                    fk["columns"] = [stmt.new_name if c == stmt.old_name
+                                     else c for c in fk["columns"]]
+                for _child, fk in self.catalog.referencing_fks(stmt.table):
+                    fk["ref_columns"] = [stmt.new_name if c == stmt.old_name
+                                         else c for c in fk["ref_columns"]]
             elif stmt.action == "rename_table":
                 from citus_tpu.transaction.locks import EXCLUSIVE
                 t = self.catalog.table(stmt.table)
                 with self._write_lock(t, EXCLUSIVE):
                     self.catalog.rename_table(stmt.table, stmt.new_name)
+                # repoint children's FK edges at the new name
+                for other in self.catalog.tables.values():
+                    for fk in other.foreign_keys:
+                        if fk["ref_table"] == stmt.table:
+                            fk["ref_table"] = stmt.new_name
             else:
                 raise UnsupportedFeatureError(f"ALTER TABLE {stmt.action} not supported")
             self.catalog.commit()
@@ -1264,6 +1341,13 @@ class Cluster:
         if isinstance(stmt, A.Merge):
             from citus_tpu.executor.merge_executor import execute_merge
             from citus_tpu.transaction.locks import EXCLUSIVE
+            _mt = self.catalog.table(stmt.target.name)
+            if _mt.foreign_keys or self.catalog.referencing_fks(_mt.name):
+                # the merge executor writes through the storage layer
+                # directly; fail closed rather than bypass FK enforcement
+                raise UnsupportedFeatureError(
+                    "MERGE on tables with foreign key constraints is not "
+                    "supported")
             with self._write_lock(self.catalog.table(stmt.target.name), EXCLUSIVE):
                 st = execute_merge(
                     self.catalog, self.txlog, stmt,
@@ -1277,7 +1361,9 @@ class Cluster:
             return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.Truncate):
             from citus_tpu.executor.dml import execute_truncate
+            from citus_tpu.integrity import forbid_truncate_referenced
             from citus_tpu.transaction.locks import EXCLUSIVE
+            forbid_truncate_referenced(self.catalog, stmt.table)
             t = self.catalog.table(stmt.table)
             with self._write_lock(t, EXCLUSIVE):
                 execute_truncate(self.catalog, self.catalog.table(stmt.table))
@@ -1346,7 +1432,10 @@ class Cluster:
                 raise UnsupportedFeatureError(
                     "RETURNING on INSERT..SELECT is not supported")
             names = stmt.columns or t.schema.names
-            res = self._insert_select_arrays(t, stmt.select, list(names))
+            # FK-constrained targets take the pull path so every row goes
+            # through copy_from's parent probe (check_ingest)
+            res = None if t.foreign_keys \
+                else self._insert_select_arrays(t, stmt.select, list(names))
             if res is None:
                 # general path: materialize rows through the coordinator
                 # (reference: the pull-to-coordinator INSERT..SELECT
@@ -1522,24 +1611,35 @@ class Cluster:
                     where = A.BinOp("and", cond,
                                     _subst_excluded(oc.where, excl))
                 upd: A.Statement = A.Update(t.name, assignments, where)
-                exec_role = getattr(self, "_exec_role", None)
-                rls_applied = False
+                import threading as _threading
+                exec_role = self._exec_roles.get(_threading.get_ident())
                 if exec_role is not None:
                     # the conflicting row must pass the role's UPDATE
-                    # policies (PostgreSQL enforces USING + WITH CHECK
-                    # on the ON CONFLICT update path too)
-                    upd, rls_applied = self._apply_rls(exec_role, upd)
+                    # policies regardless of the conflict WHERE clause
+                    # (PostgreSQL raises the RLS violation whenever the
+                    # existing row fails USING)
+                    pol = self._policy_predicate(exec_role, t.name,
+                                                 "update")
+                    if pol is not None:
+                        vis = A.Select(
+                            [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+                            A.TableRef(t.name), A.BinOp("and", cond, pol))
+                        if not self._execute_stmt(vis).rows[0][0]:
+                            raise AnalysisError(
+                                f'new row violates row-level security '
+                                f'policy for table "{t.name}"')
+                    upd, _ = self._apply_rls(exec_role, upd)
                 r = self._execute_stmt(upd)
                 n_upd = r.explain.get("updated", 0)
-                if rls_applied and n_upd == 0 and oc.where is None:
-                    raise AnalysisError(
-                        f'new row violates row-level security policy for '
-                        f'table "{t.name}"')
                 updated += n_upd
                 skipped += 0 if n_upd else 1  # DO UPDATE ... WHERE filtered
             if to_insert:
                 self.copy_from(t.name, rows=to_insert,
                                column_names=stmt.columns)
+        if oc.action == "update":
+            # PostgreSQL fires statement-level UPDATE triggers whenever
+            # DO UPDATE is specified (INSERT triggers fire at execute())
+            self._fire_triggers_for(t.name, "update", 0)
         return Result(columns=[], rows=[],
                       explain={"inserted": inserted, "updated": updated,
                                "skipped": skipped, "strategy": "upsert"})

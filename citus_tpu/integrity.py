@@ -1,0 +1,354 @@
+"""Foreign-key integrity: declaration rules and runtime enforcement.
+
+Reference mapping:
+- Declaration matrix: the reference validates every foreign key against
+  the distribution state of both sides
+  (commands/foreign_constraint.c ErrorIfUnsupportedForeignConstraintExists):
+  distributed<->distributed requires colocation AND the key covering
+  both distribution columns; distributed->reference is free;
+  reference->distributed is rejected.
+- Reverse edges: utils/foreign_key_relationship.c caches the FK graph;
+  here Catalog.referencing_fks() recomputes it (catalog is small).
+- Enforcement: PostgreSQL enforces FKs with internal triggers per row;
+  Citus inherits that per shard because colocation makes every FK local
+  to one worker.  Here enforcement is set-based on the coordinator: an
+  ingest batch probes the parent once with the batch's distinct key
+  tuples, and referenced-side DELETE/UPDATE pre-images drive
+  RESTRICT / CASCADE / SET NULL before the write commits.  All probes
+  and cascades run under the statement's write locks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from citus_tpu import types as T
+from citus_tpu.errors import AnalysisError, ExecutionError, CatalogError
+from citus_tpu.planner import ast as A
+
+#: IN-list chunk size for parent probes
+_PROBE_CHUNK = 1000
+
+
+class ForeignKeyViolation(ExecutionError):
+    pass
+
+
+# ------------------------------------------------------------- declaration
+
+
+def declare_fks(catalog, table_name: str, fkeys: list[dict],
+                schema=None) -> list[dict]:
+    """Validate CREATE TABLE foreign keys -> normalized catalog records.
+    Referenced columns default to the parent's distribution column."""
+    out = []
+    child_schema = schema if schema is not None else (
+        catalog.table(table_name).schema
+        if catalog.has_table(table_name) else None)
+    for i, fk in enumerate(fkeys):
+        ref = fk["ref_table"]
+        if not catalog.has_table(ref):
+            raise CatalogError(f'relation "{ref}" does not exist')
+        parent = catalog.table(ref)
+        ref_cols = list(fk["ref_columns"])
+        if not ref_cols:
+            if parent.dist_column is None:
+                raise AnalysisError(
+                    f'foreign key to "{ref}" must name the referenced '
+                    "column(s)")
+            ref_cols = [parent.dist_column]
+        if len(ref_cols) != len(fk["columns"]):
+            raise AnalysisError(
+                "number of referencing and referenced columns for foreign "
+                "key disagree")
+        for c in ref_cols:
+            if not parent.schema.has(c):
+                raise AnalysisError(
+                    f'column "{c}" referenced in foreign key constraint '
+                    f'does not exist in "{ref}"')
+        if child_schema is not None:
+            for c, rc in zip(fk["columns"], ref_cols):
+                if not child_schema.has(c):
+                    raise AnalysisError(f'column "{c}" does not exist')
+                ct, pt = child_schema.column(c).type, \
+                    parent.schema.column(rc).type
+                if ct.is_text != pt.is_text or \
+                        (not ct.is_text and ct.kind != pt.kind
+                         and not (ct.is_numeric and pt.is_numeric)):
+                    raise AnalysisError(
+                        f'foreign key constraint on "{c}" ({ct}) and '
+                        f'"{ref}"."{rc}" ({pt}): incompatible types')
+        out.append({"name": fk.get("name") or f"{table_name}_fk_{i + 1}",
+                    "columns": list(fk["columns"]), "ref_table": ref,
+                    "ref_columns": ref_cols,
+                    "on_delete": fk.get("on_delete", "restrict")})
+    return out
+
+
+def _fk_rule_error(child, parent, fk) -> Optional[str]:
+    """Citus's distribution matrix for one FK edge, or None when legal
+    (reference: ErrorIfUnsupportedForeignConstraintExists)."""
+    c_dist, p_dist = child.is_distributed, parent.is_distributed
+    c_ref, p_ref = child.is_reference, parent.is_reference
+    if c_dist and p_dist:
+        if child.colocation_id == 0 or \
+                child.colocation_id != parent.colocation_id:
+            return ("cannot create foreign key constraint since relations "
+                    "are not colocated or not distributed")
+        pairs = dict(zip(fk["columns"], fk["ref_columns"]))
+        if pairs.get(child.dist_column) != parent.dist_column:
+            return ("cannot create foreign key constraint since the "
+                    "foreign key must include the distribution column of "
+                    "both relations")
+        return None
+    if p_ref:
+        return None  # anything may reference a reference table
+    if c_ref and p_dist:
+        return ("cannot create foreign key constraint since foreign keys "
+                "from reference tables to distributed tables are not "
+                "supported")
+    # local <-> local and local <-> distributed: allowed.  The reference
+    # rejects FKs between distributed and plain local tables because its
+    # per-worker triggers cannot see across nodes; here enforcement is
+    # coordinator-side and set-based, so locality is not required — a
+    # deliberate superset (like columnar UPDATE/DELETE support).
+    return None
+
+
+def validate_fk_distribution(catalog, table_name: str) -> None:
+    """Re-check every FK edge touching ``table_name`` after its
+    distribution state changed (create_distributed_table /
+    create_reference_table run this before committing)."""
+    t = catalog.table(table_name)
+    for fk in t.foreign_keys:
+        err = _fk_rule_error(t, catalog.table(fk["ref_table"]), fk)
+        if err:
+            raise AnalysisError(err)
+    for child_name, fk in catalog.referencing_fks(table_name):
+        err = _fk_rule_error(catalog.table(child_name), t, fk)
+        if err:
+            raise AnalysisError(err)
+
+
+# ------------------------------------------------------------ enforcement
+
+
+def _canon(typ, v):
+    """Value -> physical comparison space (both batch inputs and decoded
+    query results land on the same representation)."""
+    if v is None:
+        return None
+    if isinstance(v, (np.generic,)):
+        v = v.item()
+    if typ.is_text:
+        return str(v)
+    if typ.kind in (T.DATE, T.TIMESTAMP) and isinstance(v, (int, float)) \
+            and not isinstance(v, bool):
+        return int(v)  # already physical (ingest fast path)
+    return typ.to_physical(v)
+
+
+def _parent_key_set(cluster, parent_name: str, ref_cols: list[str],
+                    first_vals: list) -> set:
+    """Fetch the parent's distinct key tuples restricted to the probe
+    values of the first key column -> set of canon tuples."""
+    from citus_tpu.cluster import _pylit
+    parent = cluster.catalog.table(parent_name)
+    types = [parent.schema.column(c).type for c in ref_cols]
+    out: set = set()
+    for i in range(0, len(first_vals), _PROBE_CHUNK):
+        chunk = first_vals[i:i + _PROBE_CHUNK]
+        where = A.InList(A.ColumnRef(ref_cols[0]),
+                         tuple(_pylit(v) for v in chunk), False)
+        sel = A.Select([A.SelectItem(A.ColumnRef(c)) for c in ref_cols],
+                       A.TableRef(parent_name), where, distinct=True)
+        for row in cluster._execute_stmt(sel).rows:
+            out.add(tuple(_canon(tt, v) for tt, v in zip(types, row)))
+    return out
+
+
+def check_ingest(cluster, table_meta, columns: dict) -> None:
+    """Every non-null FK tuple of the batch must exist in its parent
+    (the INSERT/COPY half of PostgreSQL's RI triggers, done set-based:
+    one probe per FK per batch)."""
+    for fk in table_meta.foreign_keys:
+        cols, ref_cols = fk["columns"], fk["ref_columns"]
+        if any(c not in columns for c in cols):
+            # column not provided -> all NULL -> no constraint to check
+            continue
+        # canonicalize BOTH sides in the parent's type space, so e.g. a
+        # double child column referencing a decimal parent compares in
+        # the parent's scaled-int representation
+        parent = cluster.catalog.table(fk["ref_table"])
+        types = [parent.schema.column(rc).type for rc in ref_cols]
+        n = len(next(iter(columns.values()))) if columns else 0
+        seqs = [columns[c] for c in cols]
+        tuples: set = set()
+        for i in range(n):
+            vals = tuple(_canon(tt, s[i]) for tt, s in zip(types, seqs))
+            if any(v is None for v in vals):
+                continue  # MATCH SIMPLE: any NULL -> not checked
+            tuples.add(vals)
+        if not tuples:
+            continue
+        # probe literals come from the raw input (pre-physical) so text/
+        # date literals bind naturally; keyed by the first column
+        raw_by_first: dict = {}
+        for i in range(n):
+            vals = tuple(_canon(tt, s[i]) for tt, s in zip(types, seqs))
+            if any(v is None for v in vals):
+                continue
+            v0 = seqs[0][i]
+            raw_by_first.setdefault(vals[0], v0.item()
+                                    if isinstance(v0, np.generic) else v0)
+        parent_keys = _parent_key_set(cluster, fk["ref_table"], ref_cols,
+                                      sorted(raw_by_first.values(),
+                                             key=repr))
+        missing = tuples - parent_keys
+        if missing:
+            bad = next(iter(missing))
+            raise ForeignKeyViolation(
+                f'insert or update on table "{table_meta.name}" violates '
+                f'foreign key constraint "{fk["name"]}": Key '
+                f'({", ".join(cols)})=({", ".join(map(str, bad))}) is not '
+                f'present in table "{fk["ref_table"]}"')
+
+
+def referenced_preimage(cluster, table_name: str, where,
+                        ref_cols: list[str]) -> list[tuple]:
+    """DISTINCT referenced-column tuples of the rows a DELETE/UPDATE on
+    the parent is about to touch."""
+    sel = A.Select([A.SelectItem(A.ColumnRef(c)) for c in ref_cols],
+                   A.TableRef(table_name), where, distinct=True)
+    return [tuple(r) for r in cluster._execute_stmt(sel).rows]
+
+
+def _child_match_where(fk: dict, key_tuples: list[tuple]):
+    """WHERE matching child rows whose FK equals any deleted parent key."""
+    from citus_tpu.cluster import _pylit
+    cond = None
+    for key in key_tuples:
+        eq = None
+        for c, v in zip(fk["columns"], key):
+            if v is None:
+                eq = None
+                break
+            this = A.BinOp("=", A.ColumnRef(c), _pylit(v))
+            eq = this if eq is None else A.BinOp("and", eq, this)
+        if eq is None:
+            continue
+        cond = eq if cond is None else A.BinOp("or", cond, eq)
+    return cond
+
+
+def on_parent_delete(cluster, table_name: str, where) -> None:
+    """Apply referenced-side actions before deleting parent rows:
+    RESTRICT errors, CASCADE deletes children (recursively through the
+    normal DELETE path), SET NULL clears the child columns."""
+    refs = cluster.catalog.referencing_fks(table_name)
+    if not refs:
+        return
+    for child_name, fk in refs:
+        keys = referenced_preimage(cluster, table_name, where,
+                                   fk["ref_columns"])
+        cond = _child_match_where(fk, keys)
+        if cond is None:
+            continue
+        if fk["on_delete"] == "cascade":
+            cluster._execute_stmt(A.Delete(child_name, cond))
+            # cascaded writes fire the child's statement triggers too
+            # (PostgreSQL fires RI-triggered DML triggers)
+            cluster._fire_triggers_for(child_name, "delete", 0)
+            continue
+        if fk["on_delete"] == "set null":
+            assignments = [(c, A.Literal(None, "null"))
+                           for c in fk["columns"]]
+            cluster._execute_stmt(A.Update(child_name, assignments, cond))
+            cluster._fire_triggers_for(child_name, "update", 0)
+            continue
+        chk = A.Select([A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+                       A.TableRef(child_name), cond)
+        if cluster._execute_stmt(chk).rows[0][0]:
+            raise ForeignKeyViolation(
+                f'update or delete on table "{table_name}" violates '
+                f'foreign key constraint "{fk["name"]}" on table '
+                f'"{child_name}"')
+
+
+def on_parent_update(cluster, table_name: str, assigned_cols: set,
+                     where) -> None:
+    """RESTRICT semantics when an UPDATE rewrites referenced key columns
+    that child rows still point at (PostgreSQL NO ACTION at statement
+    end; value-preserving updates of referenced columns are rare enough
+    that the conservative check is acceptable)."""
+    for child_name, fk in cluster.catalog.referencing_fks(table_name):
+        if not assigned_cols.intersection(fk["ref_columns"]):
+            continue
+        keys = referenced_preimage(cluster, table_name, where,
+                                   fk["ref_columns"])
+        cond = _child_match_where(fk, keys)
+        if cond is None:
+            continue
+        chk = A.Select([A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+                       A.TableRef(child_name), cond)
+        if cluster._execute_stmt(chk).rows[0][0]:
+            raise ForeignKeyViolation(
+                f'update or delete on table "{table_name}" violates '
+                f'foreign key constraint "{fk["name"]}" on table '
+                f'"{child_name}"')
+
+
+def check_child_update(cluster, table_meta, assignments: list) -> None:
+    """UPDATE assigning FK columns: constant new values must exist in
+    the parent; non-constant assignments to FK columns fail closed."""
+    for fk in table_meta.foreign_keys:
+        touched = [(c, e) for c, e in assignments if c in fk["columns"]]
+        if not touched:
+            continue
+        for c, e in touched:
+            if not isinstance(e, A.Literal):
+                from citus_tpu.errors import UnsupportedFeatureError
+                raise UnsupportedFeatureError(
+                    f'updating foreign key column "{c}" with a '
+                    "non-constant expression is not supported")
+        if len(touched) != len(fk["columns"]):
+            from citus_tpu.errors import UnsupportedFeatureError
+            raise UnsupportedFeatureError(
+                "partial updates of a multi-column foreign key are not "
+                "supported")
+        new = {c: e.value for c, e in touched}
+        vals = [new[c] for c in fk["columns"]]
+        if any(v is None for v in vals):
+            continue
+        types = [cluster.catalog.table(fk["ref_table"]).schema.column(rc).type
+                 for rc in fk["ref_columns"]]
+        want = tuple(_canon(tt, v) for tt, v in zip(types, vals))
+        parent_keys = _parent_key_set(cluster, fk["ref_table"],
+                                      fk["ref_columns"], [vals[0]])
+        if want not in parent_keys:
+            raise ForeignKeyViolation(
+                f'insert or update on table "{table_meta.name}" violates '
+                f'foreign key constraint "{fk["name"]}": Key '
+                f'({", ".join(fk["columns"])})='
+                f'({", ".join(map(str, vals))}) is not present in table '
+                f'"{fk["ref_table"]}"')
+
+
+def forbid_truncate_referenced(catalog, table_name: str) -> None:
+    refs = [c for c, _fk in catalog.referencing_fks(table_name)
+            if c != table_name]
+    if refs:
+        raise AnalysisError(
+            f'cannot truncate a table referenced in a foreign key '
+            f'constraint: table "{refs[0]}" references "{table_name}"')
+
+
+def forbid_drop_referenced(catalog, table_name: str) -> None:
+    refs = [c for c, _fk in catalog.referencing_fks(table_name)
+            if c != table_name]
+    if refs:
+        raise AnalysisError(
+            f'cannot drop table "{table_name}" because other objects '
+            f'depend on it: constraint on table "{refs[0]}"')

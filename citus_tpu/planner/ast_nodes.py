@@ -206,6 +206,9 @@ class CreateTable(Statement):
     columns: list[ColumnDef]
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)  # USING/WITH columnar opts
+    # foreign keys (column-level REFERENCES + table-level FOREIGN KEY):
+    # [{"columns", "ref_table", "ref_columns", "on_delete"}]
+    foreign_keys: list = field(default_factory=list)
 
 
 @dataclass

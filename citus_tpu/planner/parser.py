@@ -683,13 +683,37 @@ class Parser:
         name = self.parse_table_name()
         self.expect_op("(")
         cols = []
+        fkeys = []
         while True:
+            if self.peek().kind == "ident" and self.peek().value == "foreign":
+                # table constraint: FOREIGN KEY (cols) REFERENCES t (cols)
+                self.next()
+                if not (self.peek().kind == "ident"
+                        and self.peek().value == "key"):
+                    self.error("expected KEY")
+                self.next()
+                self.expect_op("(")
+                fcols = [self.expect_ident()]
+                while self.accept_op(","):
+                    fcols.append(self.expect_ident())
+                self.expect_op(")")
+                fkeys.append(self._parse_references(fcols))
+                if not self.accept_op(","):
+                    break
+                continue
             cname = self.expect_ident()
             tname, targs = self.parse_type_name()
             not_null = False
-            if self.accept_kw("not"):
-                self.expect_kw("null")
-                not_null = True
+            while True:
+                if self.accept_kw("not"):
+                    self.expect_kw("null")
+                    not_null = True
+                    continue
+                if self.peek().kind == "ident" \
+                        and self.peek().value == "references":
+                    fkeys.append(self._parse_references([cname]))
+                    continue
+                break
             cols.append(A.ColumnDef(cname, tname, targs, not_null))
             if not self.accept_op(","):
                 break
@@ -707,7 +731,43 @@ class Parser:
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
-        return A.CreateTable(name, cols, if_not_exists, options)
+        return A.CreateTable(name, cols, if_not_exists, options, fkeys)
+
+    def _parse_references(self, fcols: list[str]) -> dict:
+        """REFERENCES tbl [(cols)] [ON DELETE CASCADE|RESTRICT|SET NULL|
+        NO ACTION] — the referenced columns default to the referenced
+        table's distribution column (resolved at DDL time)."""
+        if not (self.peek().kind == "ident"
+                and self.peek().value == "references"):
+            self.error("expected REFERENCES")
+        self.next()
+        ref_table = self.parse_table_name()
+        ref_cols: list[str] = []
+        if self.accept_op("("):
+            ref_cols.append(self.expect_ident())
+            while self.accept_op(","):
+                ref_cols.append(self.expect_ident())
+            self.expect_op(")")
+        on_delete = "restrict"
+        if self.accept_kw("on"):
+            self.expect_kw("delete")
+            if self.accept_kw("cascade"):
+                on_delete = "cascade"
+            elif self.accept_kw("set"):
+                self.expect_kw("null")
+                on_delete = "set null"
+            elif self.peek().kind == "ident" \
+                    and self.peek().value in ("restrict", "no"):
+                if self.next().value == "no":
+                    if not (self.peek().kind == "ident"
+                            and self.peek().value == "action"):
+                        self.error("expected ACTION")
+                    self.next()
+            else:
+                self.error("expected CASCADE, RESTRICT, SET NULL or "
+                           "NO ACTION")
+        return {"columns": list(fcols), "ref_table": ref_table,
+                "ref_columns": ref_cols, "on_delete": on_delete}
 
     def parse_type_name(self) -> tuple[str, list[int]]:
         t = self.peek()

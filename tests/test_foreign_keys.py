@@ -1,0 +1,238 @@
+"""Foreign-key constraints: declaration matrix + runtime enforcement.
+
+Reference: commands/foreign_constraint.c
+(ErrorIfUnsupportedForeignConstraintExists) for the distribution rules;
+PostgreSQL RI triggers for enforcement semantics (here set-based on the
+coordinator: one parent probe per ingest batch, pre-image driven
+RESTRICT / CASCADE / SET NULL on the referenced side).
+"""
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
+from citus_tpu.integrity import ForeignKeyViolation
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"))
+    c.execute("CREATE TABLE customers (cid bigint NOT NULL, name text)")
+    c.execute("CREATE TABLE orders (oid bigint NOT NULL, "
+              "cid bigint REFERENCES customers (cid), amt bigint)")
+    c.execute("SELECT create_distributed_table('customers','cid',4)")
+    c.execute("SELECT create_distributed_table('orders','cid',4)")
+    c.execute("INSERT INTO customers VALUES (1,'a'), (2,'b'), (3,'c')")
+    c.execute("INSERT INTO orders VALUES (10,1,100), (11,2,200)")
+    return c
+
+
+# --------------------------------------------------------- declaration
+
+
+def test_distribution_must_cover_fk_key(tmp_path):
+    c = ct.Cluster(str(tmp_path / "d"))
+    c.execute("CREATE TABLE p (k bigint NOT NULL)")
+    c.execute("CREATE TABLE f (i bigint NOT NULL, k bigint REFERENCES p (k))")
+    c.execute("SELECT create_distributed_table('p','k',4)")
+    with pytest.raises(AnalysisError):
+        # the FK doesn't include f's distribution column
+        c.execute("SELECT create_distributed_table('f','i',4)")
+    c.execute("SELECT create_distributed_table('f','k',4)")
+
+
+def test_colocation_required(tmp_path):
+    c = ct.Cluster(str(tmp_path / "d"))
+    c.execute("CREATE TABLE p (k bigint NOT NULL)")
+    c.execute("CREATE TABLE f (k bigint NOT NULL REFERENCES p (k))")
+    c.execute("SELECT create_distributed_table('p','k',4)")
+    with pytest.raises(AnalysisError):
+        # different shard count -> different colocation group
+        c.execute("SELECT create_distributed_table('f','k',8)")
+
+
+def test_reference_to_distributed_rejected(tmp_path):
+    c = ct.Cluster(str(tmp_path / "d"))
+    c.execute("CREATE TABLE p (k bigint NOT NULL)")
+    c.execute("CREATE TABLE f (k bigint NOT NULL REFERENCES p (k))")
+    c.execute("SELECT create_distributed_table('p','k',4)")
+    with pytest.raises(AnalysisError):
+        c.execute("SELECT create_reference_table('f')")
+
+
+def test_unknown_parent_and_columns(tmp_path):
+    c = ct.Cluster(str(tmp_path / "d"))
+    from citus_tpu.errors import CatalogError
+    with pytest.raises(CatalogError):
+        c.execute("CREATE TABLE f (k bigint REFERENCES nope (k))")
+    c.execute("CREATE TABLE p (k bigint NOT NULL)")
+    with pytest.raises(AnalysisError):
+        c.execute("CREATE TABLE f (k bigint REFERENCES p (missing))")
+    with pytest.raises(AnalysisError):
+        # type mismatch text vs bigint
+        c.execute("CREATE TABLE f (k text REFERENCES p (k))")
+
+
+def test_anything_may_reference_reference_table(tmp_path):
+    c = ct.Cluster(str(tmp_path / "d"))
+    c.execute("CREATE TABLE dims (d bigint NOT NULL, label text)")
+    c.execute("SELECT create_reference_table('dims')")
+    c.execute("CREATE TABLE facts (i bigint NOT NULL, d bigint "
+              "REFERENCES dims (d))")
+    # FK on a non-distribution column is fine against a reference table
+    c.execute("SELECT create_distributed_table('facts','i',4)")
+    c.execute("INSERT INTO dims VALUES (1,'x')")
+    c.execute("INSERT INTO facts VALUES (1, 1)")
+    with pytest.raises(ForeignKeyViolation):
+        c.execute("INSERT INTO facts VALUES (2, 9)")
+
+
+# --------------------------------------------------------- enforcement
+
+
+def test_insert_violation_and_null(cl):
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("INSERT INTO orders VALUES (12, 99, 1)")
+    cl.execute("INSERT INTO orders VALUES (13, NULL, 1)")  # MATCH SIMPLE
+    assert cl.execute("SELECT count(*) FROM orders").rows == [(3,)]
+
+
+def test_copy_from_batch_violation(cl):
+    with pytest.raises(ForeignKeyViolation):
+        cl.copy_from("orders", rows=[(20, 1, 5), (21, 42, 5)])
+    # the failed batch must not be partially applied
+    assert cl.execute("SELECT count(*) FROM orders").rows == [(2,)]
+
+
+def test_delete_restrict_and_allowed(cl):
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("DELETE FROM customers WHERE cid = 1")
+    cl.execute("DELETE FROM customers WHERE cid = 3")  # no children
+    assert cl.execute("SELECT count(*) FROM customers").rows == [(2,)]
+
+
+def test_delete_cascade_recursive(tmp_path):
+    c = ct.Cluster(str(tmp_path / "d"))
+    c.execute("CREATE TABLE a (k bigint NOT NULL)")
+    c.execute("CREATE TABLE b (k bigint NOT NULL "
+              "REFERENCES a (k) ON DELETE CASCADE)")
+    c.execute("CREATE TABLE cc (k bigint NOT NULL "
+              "REFERENCES b (k) ON DELETE CASCADE)")
+    c.execute("SELECT create_distributed_table('a','k',2)")
+    c.execute("SELECT create_distributed_table('b','k',2)")
+    c.execute("SELECT create_distributed_table('cc','k',2)")
+    c.execute("INSERT INTO a VALUES (1), (2)")
+    c.execute("INSERT INTO b VALUES (1), (2)")
+    c.execute("INSERT INTO cc VALUES (1), (2)")
+    c.execute("DELETE FROM a WHERE k = 1")
+    assert c.execute("SELECT count(*) FROM b").rows == [(1,)]
+    assert c.execute("SELECT count(*) FROM cc").rows == [(1,)]
+
+
+def test_delete_set_null(tmp_path):
+    c = ct.Cluster(str(tmp_path / "d"))
+    c.execute("CREATE TABLE r (k bigint NOT NULL)")
+    c.execute("SELECT create_reference_table('r')")
+    c.execute("CREATE TABLE s (i bigint NOT NULL, k bigint "
+              "REFERENCES r (k) ON DELETE SET NULL)")
+    c.execute("SELECT create_distributed_table('s','i',2)")
+    c.execute("INSERT INTO r VALUES (1), (2)")
+    c.execute("INSERT INTO s VALUES (1, 1), (2, 2)")
+    c.execute("DELETE FROM r WHERE k = 1")
+    assert c.execute("SELECT i, k FROM s ORDER BY i").rows \
+        == [(1, None), (2, 2)]
+
+
+def test_parent_key_update_restricted(cl):
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("UPDATE customers SET cid = 77 WHERE cid = 1")
+    # updating a non-referenced column is free
+    cl.execute("UPDATE customers SET name = 'z' WHERE cid = 1")
+
+
+def test_child_fk_update_checked(cl):
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("UPDATE orders SET cid = 42 WHERE oid = 10")
+    cl.execute("UPDATE orders SET cid = 3 WHERE oid = 10")
+    assert cl.execute("SELECT cid FROM orders WHERE oid = 10").rows == [(3,)]
+    with pytest.raises(UnsupportedFeatureError):
+        cl.execute("UPDATE orders SET cid = cid + 1 WHERE oid = 10")
+
+
+def test_truncate_and_drop_blocked(cl):
+    with pytest.raises(AnalysisError):
+        cl.execute("TRUNCATE customers")
+    with pytest.raises(AnalysisError):
+        cl.execute("DROP TABLE customers")
+    # dropping the child first unblocks the parent
+    cl.execute("DROP TABLE orders")
+    cl.execute("DROP TABLE customers")
+
+
+def test_upsert_respects_fk(cl):
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("INSERT INTO orders VALUES (30, 77, 1) "
+                   "ON CONFLICT (oid, cid) DO NOTHING")
+
+
+def test_insert_select_respects_fk(cl):
+    cl.execute("CREATE TABLE src (oid bigint NOT NULL, cid bigint, "
+               "amt bigint)")
+    cl.execute("SELECT create_distributed_table('src','cid',4)")
+    cl.execute("INSERT INTO src VALUES (50, 1, 7), (51, 42, 7)")
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("INSERT INTO orders SELECT oid, cid, amt FROM src")
+    assert cl.execute("SELECT count(*) FROM orders").rows == [(2,)]
+    cl.execute("DELETE FROM src WHERE cid = 42")
+    cl.execute("INSERT INTO orders SELECT oid, cid, amt FROM src")
+    assert cl.execute("SELECT count(*) FROM orders").rows == [(3,)]
+
+
+def test_merge_fails_closed_on_fk_tables(cl):
+    cl.execute("CREATE TABLE stage (oid bigint NOT NULL, cid bigint, "
+               "amt bigint)")
+    cl.execute("SELECT create_distributed_table('stage','cid',4)")
+    with pytest.raises(UnsupportedFeatureError):
+        cl.execute("MERGE INTO orders o USING stage s ON o.oid = s.oid "
+                   "WHEN NOT MATCHED THEN INSERT VALUES (s.oid, s.cid, "
+                   "s.amt)")
+
+
+def test_cross_kind_numeric_fk(tmp_path):
+    """Child double referencing a decimal parent compares in the
+    parent's scaled-int space."""
+    c = ct.Cluster(str(tmp_path / "d"))
+    c.execute("CREATE TABLE p (k decimal(8,2) NOT NULL)")
+    c.execute("CREATE TABLE f (i bigint NOT NULL, k double "
+              "REFERENCES p (k))")
+    c.execute("INSERT INTO p VALUES (5.00)")
+    c.execute("INSERT INTO f VALUES (1, 5.0)")  # exists -> ok
+    with pytest.raises(ForeignKeyViolation):
+        c.execute("INSERT INTO f VALUES (2, 6.0)")
+
+
+def test_if_not_exists_does_not_clobber_fks(cl):
+    cl.execute("CREATE TABLE IF NOT EXISTS orders (zzz bigint "
+               "REFERENCES customers (cid))")
+    t = cl.catalog.table("orders")
+    assert t.foreign_keys and t.foreign_keys[0]["columns"] == ["cid"]
+    # parent-side protection still works
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("DELETE FROM customers WHERE cid = 1")
+
+
+def test_rename_keeps_fk_edges(cl):
+    cl.execute("ALTER TABLE customers RENAME TO clients")
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("DELETE FROM clients WHERE cid = 1")
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("INSERT INTO orders VALUES (60, 99, 1)")
+    cl.execute("INSERT INTO orders VALUES (61, 3, 1)")  # cid=3 exists
+
+
+def test_fk_survives_catalog_reload(cl, tmp_path):
+    # a second coordinator sharing the data dir sees the constraint
+    import os
+    c2 = ct.Cluster(os.path.join(str(tmp_path), "db"))
+    with pytest.raises(ForeignKeyViolation):
+        c2.execute("INSERT INTO orders VALUES (31, 88, 1)")
