@@ -36,11 +36,35 @@ def _option_bool(v) -> bool:
 
 
 def _has_derived(item) -> bool:
-    if isinstance(item, A.SubqueryRef):
+    if isinstance(item, (A.SubqueryRef, A.FunctionRef)):
         return True
     if isinstance(item, A.Join):
         return _has_derived(item.left) or _has_derived(item.right)
     return False
+
+
+def _srf_result(name: str, args, alias) -> "Result":
+    """Evaluate a set-returning FROM function to rows (reference:
+    PostgreSQL SRFs; only constant arguments are supported since the
+    call is unlateral)."""
+    vals = [_eval_const(a) for a in args]
+    if name == "generate_series":
+        if len(vals) not in (2, 3):
+            raise AnalysisError(
+                "generate_series(start, stop [, step]) expects 2 or 3 "
+                "arguments")
+        if any(v is None for v in vals):
+            # PostgreSQL: a NULL bound yields zero rows
+            return Result(columns=[alias or "generate_series"], rows=[])
+        start, stop = int(vals[0]), int(vals[1])
+        step = int(vals[2]) if len(vals) > 2 else 1
+        if step == 0:
+            raise ExecutionError("step size cannot equal zero")
+        end = stop + (1 if step > 0 else -1)
+        rows = [(v,) for v in range(start, end, step)]
+        return Result(columns=[alias or "generate_series"], rows=rows)
+    raise UnsupportedFeatureError(
+        f"set-returning function {name}() is not supported in FROM")
 
 
 def _max_param_index(stmt) -> int:
@@ -139,8 +163,125 @@ def _eval_const(e):
             if v is not None:
                 return v
         return None
+    if isinstance(e, A.FuncCall):
+        v = _eval_const_func(e)
+        if v is not NotImplemented:
+            return v
     raise UnsupportedFeatureError(
         f"cannot evaluate {type(e).__name__} without a FROM clause")
+
+
+def _eval_const_func(e):
+    """Constant evaluation of the scalar math/string surface (SELECT
+    without FROM); NotImplemented when the function is unknown."""
+    import decimal as _dec
+    import math as _math
+    args = [_eval_const(a) for a in e.args]
+    name = e.name
+    if name == "pi":
+        return _math.pi
+    if any(a is None for a in args):
+        # all these functions are strict (NULL in -> NULL out)
+        known = {"abs", "floor", "ceil", "ceiling", "round", "trunc",
+                 "sign", "sqrt", "exp", "ln", "log", "log10", "log2",
+                 "power", "pow", "mod", "degrees", "radians", "greatest",
+                 "least", "upper", "lower", "length", "char_length",
+                 "strpos", "nullif", "reverse", "initcap", "trim",
+                 "btrim", "ltrim", "rtrim", "replace", "left", "right"}
+        if name in ("greatest", "least"):
+            vals = [a for a in args if a is not None]
+            if not vals:
+                return None
+            return max(vals) if name == "greatest" else min(vals)
+        return None if name in known else NotImplemented
+    try:
+        if name == "abs":
+            return abs(args[0])
+        if name in ("floor", "ceil", "ceiling"):
+            f = _math.floor if name == "floor" else _math.ceil
+            v = f(args[0])
+            return _dec.Decimal(v) if isinstance(args[0], _dec.Decimal) \
+                else (float(v) if isinstance(args[0], float) else v)
+        if name == "round":
+            nd = int(args[1]) if len(args) > 1 else 0
+            d = args[0] if isinstance(args[0], _dec.Decimal) \
+                else _dec.Decimal(str(args[0]))
+            q = d.quantize(_dec.Decimal(1).scaleb(-nd),
+                           rounding=_dec.ROUND_HALF_UP)
+            return float(q) if isinstance(args[0], float) else q
+        if name == "trunc":
+            nd = int(args[1]) if len(args) > 1 else 0
+            d = args[0] if isinstance(args[0], _dec.Decimal) \
+                else _dec.Decimal(str(args[0]))
+            q = d.quantize(_dec.Decimal(1).scaleb(-nd),
+                           rounding=_dec.ROUND_DOWN)
+            return float(q) if isinstance(args[0], float) else q
+        if name == "sign":
+            v = args[0]
+            return (v > 0) - (v < 0)
+        if name == "sqrt":
+            return _math.sqrt(args[0]) if args[0] >= 0 else None
+        if name == "exp":
+            return _math.exp(args[0])
+        if name in ("ln", "log", "log10", "log2"):
+            if name == "log" and len(args) == 2:
+                return (_math.log(args[1]) / _math.log(args[0])
+                        if args[1] > 0 and args[0] > 0 else None)
+            if args[0] <= 0:
+                return None
+            return _math.log(args[0]) if name == "ln" else (
+                _math.log2(args[0]) if name == "log2"
+                else _math.log10(args[0]))
+        if name in ("power", "pow"):
+            return float(args[0]) ** float(args[1])
+        if name == "mod":
+            a, b = args
+            if not b:
+                return None
+            # SQL mod truncates toward zero; exact integer arithmetic
+            # (float division would lose precision past 2^53)
+            q = abs(a) // abs(b)
+            if (a < 0) != (b < 0):
+                q = -q
+            return a - q * b
+        if name == "degrees":
+            return _math.degrees(args[0])
+        if name == "radians":
+            return _math.radians(args[0])
+        if name in ("greatest", "least"):
+            return max(args) if name == "greatest" else min(args)
+        if name == "nullif":
+            return None if args[0] == args[1] else args[0]
+        if args and isinstance(args[0], str):
+            s = args[0]
+            if name == "upper":
+                return s.upper()
+            if name == "lower":
+                return s.lower()
+            if name in ("length", "char_length"):
+                return len(s)
+            if name == "strpos":
+                return s.find(str(args[1])) + 1
+            if name == "reverse":
+                return s[::-1]
+            if name == "initcap":
+                return s.title()
+            if name in ("trim", "btrim"):
+                return s.strip(str(args[1]) if len(args) > 1 else None)
+            if name == "ltrim":
+                return s.lstrip(str(args[1]) if len(args) > 1 else None)
+            if name == "rtrim":
+                return s.rstrip(str(args[1]) if len(args) > 1 else None)
+            if name == "replace":
+                return s.replace(str(args[1]), str(args[2]))
+            if name == "left":
+                return s[:int(args[1])]
+            if name == "right":
+                n = int(args[1])
+                return s[max(0, len(s) - n):] if n >= 0 else s[-n:]
+    except (ValueError, OverflowError, ArithmeticError):
+        return None
+    return NotImplemented
 
 
 def _expand_returning_items(t, items, subst=None):
@@ -2140,6 +2281,12 @@ class Cluster:
                 tmp = self._create_temp_from_result("derived", item.alias, r)
                 temps.append(tmp)
                 return A.TableRef(tmp, item.alias)
+            if isinstance(item, A.FunctionRef):
+                r = _srf_result(item.name, item.args, item.alias)
+                label = item.alias or item.name
+                tmp = self._create_temp_from_result("srf", label, r)
+                temps.append(tmp)
+                return A.TableRef(tmp, item.alias or item.name)
             if isinstance(item, A.Join):
                 return A.Join(repl(item.left), repl(item.right),
                               item.kind, item.condition)
@@ -3160,7 +3307,114 @@ class Cluster:
                                       peer_inflight=self._peer_inflight())
             return Result(columns=["recover_prepared_transactions"],
                           rows=[(st["rolled_forward"] + st["rolled_back"],)])
+        if name == "run_command_on_workers":
+            # reference: operations/citus_tools.c run_command_on_workers —
+            # one row per node.  Nodes here share one engine, so the
+            # command runs ONCE and the result row replicates per node
+            # (running it N times would also repeat side effects)
+            try:
+                r = self.execute(str(args[0]))
+                cell = r.rows[0][0] if r.rows and r.rows[0] else ""
+                ok, res = True, str(cell)
+            except Exception as exc:
+                ok, res = False, str(exc)
+            rows = [(nid, ok, res)
+                    for nid in sorted(self.catalog.active_node_ids())]
+            return Result(columns=["nodeid", "success", "result"], rows=rows)
+        if name in ("run_command_on_shards", "run_command_on_placements"):
+            return self._run_command_on_shards(
+                str(args[0]), str(args[1]),
+                per_placement=(name == "run_command_on_placements"))
+        if name == "master_get_table_ddl_events":
+            return Result(columns=["master_get_table_ddl_events"],
+                          rows=[(d,) for d in self._table_ddl(str(args[0]))])
+        if name == "citus_backend_gpid":
+            import threading as _threading
+            return Result(columns=["citus_backend_gpid"],
+                          rows=[(_threading.get_ident(),)])
+        if name == "citus_coordinator_nodeid":
+            nids = sorted(self.catalog.active_node_ids())
+            return Result(columns=["citus_coordinator_nodeid"],
+                          rows=[(nids[0] if nids else 0,)])
         raise UnsupportedFeatureError(f"utility {name}() not supported yet")
+
+    def _run_command_on_shards(self, table_name: str, command: str,
+                               per_placement: bool = False) -> Result:
+        """reference: citus_tools.c run_command_on_shards/_placements —
+        the %s placeholder becomes the shard; here the command is a
+        SELECT template executed with the plan restricted to one shard
+        (the shard-suffix-name trick has no meaning without SQL-visible
+        shard relations)."""
+        import dataclasses as _dc
+
+        from citus_tpu.planner.physical import plan_select
+        t = self.catalog.table(table_name)
+        sql = command.replace("%s", table_name)
+        stmt = parse_sql(sql)[0]
+        if not isinstance(stmt, A.Select):
+            raise UnsupportedFeatureError(
+                "run_command_on_shards supports SELECT commands")
+        if not (isinstance(stmt.from_, A.TableRef)
+                and stmt.from_.name == t.name):
+            raise AnalysisError(
+                "run_command_on_shards command must read the named table "
+                "(use %s as the relation)")
+        bound = bind_select(self.catalog, stmt)
+        plan = plan_select(self.catalog, bound,
+                           direct_limit=self.settings.planner.direct_gid_limit)
+        rows = []
+        # one row per shard of the table (reference behavior), even when
+        # the command's WHERE clause would prune some shards
+        for si in range(len(t.shards)):
+            shard = t.shards[si]
+            targets = shard.placements if per_placement else [None]
+            for node in targets:
+                try:
+                    sp = _dc.replace(plan, shard_indexes=[si])
+                    r = execute_select(self.catalog, bound, self.settings,
+                                       plan=sp)
+                    cell = r.rows[0][0] if r.rows and r.rows[0] else ""
+                    row = (shard.shard_id, True, str(cell))
+                except Exception as exc:
+                    row = (shard.shard_id, False, str(exc))
+                if per_placement:
+                    row = (row[0], node) + row[1:]
+                rows.append(row)
+        cols = ["shardid", "nodeid", "success", "result"] if per_placement \
+            else ["shardid", "success", "result"]
+        return Result(columns=cols, rows=rows)
+
+    def _table_ddl(self, name: str) -> list[str]:
+        """Reconstruct the DDL statements that recreate a table
+        (reference: master_get_table_ddl_events,
+        operations/node_protocol.c)."""
+        t = self.catalog.table(name)
+        sql_names = {"bool": "boolean", "int16": "smallint", "int32": "int",
+                     "int64": "bigint", "float32": "real",
+                     "float64": "double", "date": "date",
+                     "timestamp": "timestamp", "text": "text"}
+        cols = []
+        for c in t.schema:
+            enum_t = self.catalog.enum_columns.get(f"{name}.{c.name}")
+            tn = enum_t if enum_t else sql_names.get(c.type.kind, str(c.type))
+            if c.type.is_decimal:
+                tn = str(c.type)  # decimal(p,s) spells itself
+            cols.append(f"{c.name} {tn}"
+                        + (" NOT NULL" if c.not_null else ""))
+        for fk in t.foreign_keys:
+            action = "" if fk["on_delete"] == "restrict" \
+                else f" ON DELETE {fk['on_delete'].upper()}"
+            cols.append(
+                f"FOREIGN KEY ({', '.join(fk['columns'])}) REFERENCES "
+                f"{fk['ref_table']} ({', '.join(fk['ref_columns'])})"
+                + action)
+        out = [f"CREATE TABLE {name} ({', '.join(cols)})"]
+        if t.is_distributed:
+            out.append(f"SELECT create_distributed_table('{name}', "
+                       f"'{t.dist_column}', {t.shard_count})")
+        elif t.is_reference:
+            out.append(f"SELECT create_reference_table('{name}')")
+        return out
 
     def _table_size(self, name: str) -> int:
         import os

@@ -261,6 +261,16 @@ class SubqueryRef:
 
 
 @dataclass
+class FunctionRef:
+    """Set-returning function in FROM: generate_series(a, b [, step]).
+    Materialized like a derived table (reference: SRFs run through the
+    standard executor; here the recursive-planning temp-table seam)."""
+    name: str
+    args: tuple = ()
+    alias: Optional[str] = None
+
+
+@dataclass
 class Join:
     left: "FromItem"
     right: "FromItem"

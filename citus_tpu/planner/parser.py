@@ -987,6 +987,9 @@ class Parser:
         "citus_stat_tenants", "get_rebalance_progress", "citus_schemas",
         "citus_split_shard_by_split_points", "isolate_tenant_to_new_shard",
         "citus_schema_tenant_set", "citus_schema_tenant_unset",
+        "run_command_on_workers", "run_command_on_shards",
+        "run_command_on_placements", "master_get_table_ddl_events",
+        "citus_backend_gpid", "citus_coordinator_nodeid",
     }
 
     def parse_select_or_utility(self) -> A.Statement:
@@ -1209,6 +1212,24 @@ class Parser:
                 self.error("derived table requires an alias")
             alias = self.expect_ident()
             return A.SubqueryRef(sel, alias)
+        if self.peek().kind == "ident" and self.peek(1).kind == "op" \
+                and self.peek(1).value == "(":
+            # set-returning function: FROM generate_series(1, 10) g
+            fname = self.expect_ident()
+            self.expect_op("(")
+            args = []
+            if not self.at_op(")"):
+                while True:
+                    args.append(self.parse_expr())
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            alias = None
+            if self.accept_kw("as"):
+                alias = self.expect_ident()
+            elif self.peek().kind == "ident":
+                alias = self.expect_ident()
+            return A.FunctionRef(fname, tuple(args), alias)
         name = self.parse_table_name()
         alias = None
         if self.accept_kw("as"):

@@ -1,0 +1,122 @@
+"""Set-returning FROM functions + the run_command_on_* admin surface.
+
+Reference: PostgreSQL SRFs in FROM (materialized here through the
+recursive-planning temp-table seam); operations/citus_tools.c
+run_command_on_workers/_shards/_placements;
+operations/node_protocol.c master_get_table_ddl_events.
+"""
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import ExecutionError, UnsupportedFeatureError
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"))
+    c.execute("CREATE TABLE t (k bigint NOT NULL, v decimal(10,2))")
+    c.execute("SELECT create_distributed_table('t','k',4)")
+    c.copy_from("t", rows=[(i, round(i / 3, 2)) for i in range(100)])
+    return c
+
+
+def test_generate_series_basic(cl):
+    assert cl.execute("SELECT * FROM generate_series(1, 5) g").rows \
+        == [(1,), (2,), (3,), (4,), (5,)]
+    assert cl.execute(
+        "SELECT g FROM generate_series(10, 2, -3) AS g").rows \
+        == [(10,), (7,), (4,)]
+    assert cl.execute("SELECT count(*) FROM generate_series(5, 1)").rows \
+        == [(0,)]
+    with pytest.raises(ExecutionError):
+        cl.execute("SELECT * FROM generate_series(1, 5, 0)")
+
+
+def test_generate_series_join_and_agg(cl):
+    rows = cl.execute("SELECT g, v FROM generate_series(0, 3) g "
+                      "JOIN t ON t.k = g ORDER BY g").rows
+    assert [r[0] for r in rows] == [0, 1, 2, 3]
+    assert cl.execute("SELECT sum(g) FROM generate_series(1, 100) g "
+                      "WHERE g % 7 = 0").rows == [(735,)]
+
+
+def test_run_command_on_workers(cl):
+    rows = cl.execute(
+        "SELECT run_command_on_workers('SELECT count(*) FROM t')").rows
+    assert len(rows) == len(cl.catalog.active_node_ids())
+    assert all(ok and res == "100" for _n, ok, res in rows)
+
+
+def test_run_command_on_shards_partitions_the_count(cl):
+    rows = cl.execute("SELECT run_command_on_shards('t', "
+                      "'SELECT count(*) FROM %s')").rows
+    assert len(rows) == 4
+    assert all(ok for _s, ok, _r in rows)
+    assert sum(int(r) for _s, _ok, r in rows) == 100
+
+
+def test_run_command_on_placements(cl):
+    rows = cl.execute("SELECT run_command_on_placements('t', "
+                      "'SELECT count(*) FROM %s')").rows
+    assert all(len(r) == 4 for r in rows)
+    assert sum(int(r[3]) for r in rows) == 100
+
+
+def test_run_command_on_shards_rejects_ddl(cl):
+    with pytest.raises(UnsupportedFeatureError):
+        cl.execute("SELECT run_command_on_shards('t', 'DROP TABLE %s')")
+
+
+def test_master_get_table_ddl_events_round_trips(cl, tmp_path):
+    ddl = [r[0] for r in
+           cl.execute("SELECT master_get_table_ddl_events('t')").rows]
+    c2 = ct.Cluster(str(tmp_path / "db2"))
+    for stmt in ddl:
+        c2.execute(stmt)
+    t2 = c2.catalog.table("t")
+    assert t2.is_distributed and t2.dist_column == "k"
+    assert t2.schema.names == ["k", "v"]
+
+
+def test_ddl_events_include_fks(cl):
+    cl.execute("CREATE TABLE child (k bigint NOT NULL REFERENCES t (k) "
+               "ON DELETE CASCADE)")
+    ddl = [r[0] for r in
+           cl.execute("SELECT master_get_table_ddl_events('child')").rows]
+    assert any("FOREIGN KEY (k) REFERENCES t (k) ON DELETE CASCADE" in d
+               for d in ddl)
+
+
+def test_gpid_and_coordinator(cl):
+    assert cl.execute("SELECT citus_backend_gpid()").rows[0][0] > 0
+    assert cl.execute("SELECT citus_coordinator_nodeid()").rows[0][0] == 0
+
+
+def test_review_regressions(cl):
+    # exact integer mod past 2^53
+    assert cl.execute("SELECT mod(100000000000000001, 3)").rows == [(2,)]
+    # NULL generate_series bound -> zero rows (PostgreSQL)
+    assert cl.execute("SELECT * FROM generate_series(1, NULL) g").rows == []
+    # unknown zero-arg function -> clean error, not IndexError
+    with pytest.raises(UnsupportedFeatureError):
+        cl.execute("SELECT now()")
+    # per-shard rows survive WHERE pruning; all 4 shards reported
+    rows = cl.execute("SELECT run_command_on_shards('t', "
+                      "'SELECT count(*) FROM %s WHERE k = 5')").rows
+    assert len(rows) == 4 and sum(int(r[2]) for r in rows) == 1
+    # command must target the named table
+    from citus_tpu.errors import AnalysisError
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT run_command_on_shards('t', "
+                   "'SELECT 1 FROM generate_series(1,2) g')")
+
+
+def test_constant_math_without_from(cl):
+    r = cl.execute("SELECT sqrt(-1), power(2, 10), mod(17, 5), "
+                   "greatest(1, NULL, 3), round(2.675, 2)").rows[0]
+    assert r[0] is None
+    assert r[1] == 1024.0
+    assert r[2] == 2
+    assert r[3] == 3
+    assert float(r[4]) == pytest.approx(2.68)
