@@ -119,6 +119,30 @@ def _eval_const(e):
             return None if v is None else -v
         return None if v is None else (not v)
     if isinstance(e, A.BinOp):
+        if isinstance(e.left, A.IntervalLiteral) \
+                or isinstance(e.right, A.IntervalLiteral):
+            import datetime as _dt
+
+            from citus_tpu.planner.bound import py_add_interval
+            if e.op not in ("+", "-"):
+                raise UnsupportedFeatureError(
+                    f"operator {e.op} is not defined for intervals")
+            ivl = e.right if isinstance(e.right, A.IntervalLiteral) \
+                else e.left
+            other = e.left if ivl is e.right else e.right
+            if ivl is e.left and e.op != "+":
+                raise UnsupportedFeatureError(
+                    "interval arithmetic supports date/timestamp ± interval")
+            v = _eval_const(other)
+            if v is None:
+                return None
+            if not isinstance(v, (_dt.date, _dt.datetime)):
+                raise AnalysisError(
+                    "cannot add an interval to a non-date value "
+                    "(use a typed literal: date '...')")
+            sign = 1 if e.op == "+" else -1
+            return py_add_interval(v, sign * ivl.months, sign * ivl.days,
+                                   sign * ivl.micros)
         l, r = _eval_const(e.left), _eval_const(e.right)
         if e.op == "and":
             if l is False or r is False:
@@ -151,7 +175,11 @@ def _eval_const(e):
         if v is None:
             return None
         t = type_from_sql(e.type_name, list(e.type_args) or None)
-        return t.from_physical(t.to_physical(v))
+        try:
+            return t.from_physical(t.to_physical(v))
+        except (ValueError, TypeError):
+            raise AnalysisError(
+                f"invalid input syntax for type {e.type_name}: {v!r}")
     if isinstance(e, A.CaseExpr):
         for c, v in e.whens:
             if _eval_const(c) is True:
@@ -180,6 +208,10 @@ def _eval_const_func(e):
     name = e.name
     if name == "pi":
         return _math.pi
+    if name in ("current_date", "current_timestamp", "now"):
+        import datetime as _dt
+        return _dt.date.today() if name == "current_date" \
+            else _dt.datetime.now()
     if any(a is None for a in args):
         # all these functions are strict (NULL in -> NULL out)
         known = {"abs", "floor", "ceil", "ceiling", "round", "trunc",

@@ -81,6 +81,15 @@ class FuncCall(Expr):
 
 
 @dataclass(frozen=True)
+class IntervalLiteral(Expr):
+    """INTERVAL 'n' unit / INTERVAL '1 year 2 days' — PostgreSQL's
+    months/days/microseconds decomposition."""
+    months: int = 0
+    days: int = 0
+    micros: int = 0
+
+
+@dataclass(frozen=True)
 class Between(Expr):
     expr: Expr
     lo: Expr

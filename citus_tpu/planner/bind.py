@@ -316,6 +316,16 @@ class Binder:
             target = T.type_from_sql(e.type_name, list(e.type_args) or None)
             if target.is_text:
                 raise UnsupportedFeatureError("cast to text not supported")
+            if target.kind in (T.DATE, T.TIMESTAMP) \
+                    and isinstance(inner, BLiteral) \
+                    and isinstance(inner.value, str):
+                # typed literal: date '1998-12-01' folds at bind time
+                try:
+                    return BLiteral(target.to_physical(inner.value), target)
+                except (ValueError, TypeError):
+                    raise AnalysisError(
+                        f"invalid input syntax for type {e.type_name}: "
+                        f"{inner.value!r}")
             return BCast(inner, target)
         if isinstance(e, A.CaseExpr):
             return self._bind_case(e, allow_agg)
@@ -427,8 +437,59 @@ class Binder:
             raise AnalysisError(f"expected boolean expression, got {e.type}")
         return e
 
+    def _bind_interval_arith(self, e: A.BinOp, allow_agg: bool) -> BExpr:
+        """date/timestamp ± INTERVAL.  Literal dates fold; expressions
+        lower to civil month addition (BAddMonths) plus fixed-width
+        day/microsecond offsets.  A DATE result stays DATE when the
+        interval has no sub-day component (the reference promotes to
+        timestamp; for comparisons at midnight the value is identical)."""
+        from citus_tpu.planner.bound import BAddMonths, py_add_interval
+        if e.op not in ("+", "-"):
+            raise UnsupportedFeatureError(
+                f"operator {e.op} is not defined for intervals")
+        if isinstance(e.left, A.IntervalLiteral):
+            if isinstance(e.right, A.IntervalLiteral) or e.op != "+":
+                raise UnsupportedFeatureError(
+                    "interval arithmetic supports date/timestamp ± interval")
+            ivl, other_ast = e.left, e.right
+        else:
+            ivl, other_ast = e.right, e.left
+        sign = 1 if e.op == "+" else -1
+        other = self.bind_scalar(other_ast, allow_agg)
+        if other.type.kind not in (T.DATE, T.TIMESTAMP):
+            raise AnalysisError(
+                f"cannot add interval to {other.type}")
+        months = sign * ivl.months
+        days = sign * ivl.days
+        micros = sign * ivl.micros
+        if other.type.kind == T.DATE and micros:
+            raise UnsupportedFeatureError(
+                "sub-day intervals on date values are not supported")
+        if isinstance(other, BLiteral):
+            if other.value is None:
+                return other
+            v = other.type.from_physical(other.value)
+            out = py_add_interval(v, months, days, micros)
+            return BLiteral(other.type.to_physical(out), other.type)
+        result: BExpr = other
+        if months:
+            result = BAddMonths(result, months, other.type)
+        if other.type.kind == T.DATE:
+            if days:
+                result = BBinOp("+", result, BLiteral(days, T.INT64_T),
+                                other.type)
+        else:
+            total = days * 86_400_000_000 + micros
+            if total:
+                result = BBinOp("+", result, BLiteral(total, T.INT64_T),
+                                other.type)
+        return result
+
     def _bind_binop(self, e: A.BinOp, allow_agg: bool) -> BExpr:
         op = e.op
+        if isinstance(e.left, A.IntervalLiteral) \
+                or isinstance(e.right, A.IntervalLiteral):
+            return self._bind_interval_arith(e, allow_agg)
         left = self.bind_scalar(e.left, allow_agg)
         right = self.bind_scalar(e.right, allow_agg)
         if op in ("and", "or"):
@@ -529,6 +590,13 @@ class Binder:
                 return BDictMask(base, tuple(bool(rx.match(w.lower()))
                                              for w in eff_words))
             return BDictMask(base, tuple(bool(rx.match(w)) for w in eff_words))
+        if name in ("current_date", "current_timestamp", "now"):
+            import datetime as _dt
+            if name == "current_date":
+                return BLiteral(T.DATE_T.to_physical(_dt.date.today()),
+                                T.DATE_T)
+            return BLiteral(T.TIMESTAMP_T.to_physical(_dt.datetime.now()),
+                            T.TIMESTAMP_T)
         if name == "date_trunc":
             if len(e.args) != 2 or not isinstance(e.args[0], A.Literal):
                 raise AnalysisError("date_trunc(unit, expr) expects a literal unit")

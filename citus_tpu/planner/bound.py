@@ -176,6 +176,44 @@ class BExtract(BExpr):
 
 
 @dataclass(frozen=True)
+class BAddMonths(BExpr):
+    """date/timestamp + N months: civil month addition with day-of-month
+    clamping (PostgreSQL timestamp_pl_interval semantics), vectorized on
+    the integer day/microsecond encodings."""
+    operand: BExpr
+    months: int
+    type: T.ColumnType
+
+
+def py_add_interval(value, months: int, days: int, micros: int):
+    """Python-side interval addition for constant folding (value is a
+    datetime.date or datetime.datetime)."""
+    import datetime as _dt
+    d = value
+    if months:
+        is_date = not isinstance(d, _dt.datetime)
+        y, m = d.year, d.month - 1 + months
+        y += m // 12
+        m = m % 12 + 1
+        if m == 12:
+            last = 31
+        else:
+            last = ((_dt.date(y, m + 1, 1) if m < 12
+                     else _dt.date(y + 1, 1, 1))
+                    - _dt.date(y, m, 1)).days
+        day = min(d.day, last)
+        d = d.replace(year=y, month=m, day=day) if not is_date \
+            else _dt.date(y, m, day)
+    if days:
+        d = d + _dt.timedelta(days=days)
+    if micros:
+        if not isinstance(d, _dt.datetime):
+            d = _dt.datetime(d.year, d.month, d.day)
+        d = d + _dt.timedelta(microseconds=micros)
+    return d
+
+
+@dataclass(frozen=True)
 class BDateTruncCivil(BExpr):
     """date_trunc to a calendar unit (month/quarter/year) — needs civil
     date math rather than fixed-width division."""
@@ -215,7 +253,8 @@ def walk(e: BExpr):
         yield from walk(e.left)
         yield from walk(e.right)
     elif isinstance(e, (BUnOp, BScale, BCast, BIsNull, BDictMask, BDictRemap,
-                        BDictLookup, BExtract, BDateTrunc, BDateTruncCivil)):
+                        BDictLookup, BExtract, BDateTrunc, BDateTruncCivil,
+                        BAddMonths)):
         yield from walk(e.operand)
     elif isinstance(e, BMathFunc):
         for o in e.operands:
@@ -308,6 +347,37 @@ def compile_expr(e: BExpr, xp):
                 return (days - jan1 + 1, valid)
             raise AnalysisError(f"EXTRACT field {field!r} not supported")
         return run_extract
+    if isinstance(e, BAddMonths):
+        f = compile_expr(e.operand, xp)
+        months = int(e.months)
+        is_ts = e.operand.type.kind == T.TIMESTAMP
+        US_DAY = np.int64(86_400_000_000)
+
+        def run_add_months(env):
+            v, valid = f(env)
+            v = xp.asarray(v)
+            if is_ts:
+                days = v // US_DAY
+                rem = v - days * US_DAY
+            else:
+                days = v.astype(np.int64)
+                rem = None
+            y, m, d = civil_from_days(xp, days)
+            mt = (m - 1) + months
+            y = y + mt // 12
+            m = mt % 12 + 1
+            # clamp to the target month's length (PostgreSQL semantics:
+            # Jan 31 + 1 month = Feb 28/29)
+            nm_y = y + (m == 12)
+            nm_m = xp.where(m == 12, 1, m + 1)
+            month_len = days_from_civil(xp, nm_y, nm_m, xp.ones_like(d)) \
+                - days_from_civil(xp, y, m, xp.ones_like(d))
+            d = xp.minimum(d, month_len)
+            out_days = days_from_civil(xp, y, m, d)
+            if is_ts:
+                return (out_days * US_DAY + rem, valid)
+            return (out_days.astype(np.int32), valid)
+        return run_add_months
     if isinstance(e, BDateTruncCivil):
         f = compile_expr(e.operand, xp)
         unit = e.unit

@@ -1436,6 +1436,26 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
+        if t.kind == "ident" and t.value in ("date", "timestamp") \
+                and self.peek(1).kind == "str":
+            # typed literal: date '1998-12-01' / timestamp '...'
+            tname = t.value
+            self.next()
+            lit = self.next()
+            return A.Cast(A.Literal(lit.value[1:-1], "string"), tname, ())
+        if t.kind == "ident" and t.value == "interval" \
+                and self.peek(1).kind == "str":
+            self.next()
+            body = self.next().value[1:-1].strip()
+            # optional trailing unit token: INTERVAL '90' day
+            unit = None
+            if self.peek().kind == "ident" and self.peek().value in _IVL_UNITS:
+                unit = self.next().value
+            return _parse_interval(body, unit, self.error)
+        if t.kind == "ident" and t.value in ("current_date",
+                                             "current_timestamp"):
+            self.next()
+            return A.FuncCall(t.value, ())
         if t.kind == "ident" and t.value == "position" and \
                 self.peek(1).kind == "op" and self.peek(1).value == "(":
             # position(substring IN string) -> strpos(string, substring)
@@ -1525,6 +1545,47 @@ class Parser:
                 return A.ColumnRef(col, table=t.value)
             return A.ColumnRef(t.value)
         self.error("expected expression")
+
+
+_IVL_UNITS = {
+    "year": ("months", 12), "years": ("months", 12),
+    "month": ("months", 1), "months": ("months", 1),
+    "week": ("days", 7), "weeks": ("days", 7),
+    "day": ("days", 1), "days": ("days", 1),
+    "hour": ("micros", 3_600_000_000), "hours": ("micros", 3_600_000_000),
+    "minute": ("micros", 60_000_000), "minutes": ("micros", 60_000_000),
+    "second": ("micros", 1_000_000), "seconds": ("micros", 1_000_000),
+}
+
+
+def _parse_interval(body: str, unit, error) -> A.IntervalLiteral:
+    """'90' + unit, or PostgreSQL's verbose form '1 year 2 days'."""
+    parts = {"months": 0, "days": 0, "micros": 0}
+    toks = body.split()
+    if unit is not None:
+        try:
+            qty = int(body)
+        except ValueError:
+            error(f"bad interval quantity {body!r}")
+        field, mult = _IVL_UNITS[unit]
+        parts[field] += qty * mult
+        return A.IntervalLiteral(**parts)
+    if len(toks) == 1:
+        # bare number means days? PostgreSQL: seconds for interval-only;
+        # analytics usage virtually always writes a unit — require one
+        error("interval requires a unit (e.g. interval '90 days')")
+    i = 0
+    while i < len(toks):
+        try:
+            qty = int(toks[i])
+        except ValueError:
+            error(f"bad interval {body!r}")
+        if i + 1 >= len(toks) or toks[i + 1].lower() not in _IVL_UNITS:
+            error(f"bad interval {body!r}")
+        field, mult = _IVL_UNITS[toks[i + 1].lower()]
+        parts[field] += qty * mult
+        i += 2
+    return A.IntervalLiteral(**parts)
 
 
 def parse_sql(text: str) -> list[A.Statement]:

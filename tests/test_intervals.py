@@ -1,0 +1,89 @@
+"""Typed date/timestamp literals + INTERVAL arithmetic (the TPC-H
+predicate surface: ``l_shipdate <= date '1998-12-01' - interval '90'
+day``).  Reference: PostgreSQL datetime types; the reference pushes
+these expressions down into shard queries unchanged."""
+
+import datetime as dt
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.config import ExecutorSettings, settings_override
+from citus_tpu.errors import UnsupportedFeatureError
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"))
+    c.execute("CREATE TABLE t (k bigint NOT NULL, d date, ts timestamp)")
+    c.execute("SELECT create_distributed_table('t','k',4)")
+    c.copy_from("t", rows=[
+        (1, "1998-09-01", "1998-09-01 10:30:00"),
+        (2, "1998-12-01", "1998-12-01 00:00:00"),
+        (3, "1995-01-31", "1995-01-31 23:59:59"),
+        (4, None, None),
+    ])
+    return c
+
+
+def test_typed_date_literal(cl):
+    assert cl.execute(
+        "SELECT count(*) FROM t WHERE d <= date '1998-12-01'").rows == [(3,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE ts < "
+                      "timestamp '1998-09-01 10:30:01'").rows == [(2,)]
+
+
+def test_tpch_style_predicates(cl):
+    assert cl.execute("SELECT count(*) FROM t WHERE d <= "
+                      "date '1998-12-01' - interval '90' day").rows == [(2,)]
+    assert cl.execute("SELECT count(*) FROM t WHERE d < "
+                      "date '1995-01-01' + interval '1' year").rows == [(1,)]
+
+
+def test_column_plus_interval_months_clamped(cl):
+    rows = cl.execute("SELECT d + interval '1' month FROM t "
+                      "WHERE k < 4 ORDER BY k").rows
+    assert rows == [(dt.date(1998, 10, 1),), (dt.date(1999, 1, 1),),
+                    (dt.date(1995, 2, 28),)]  # Jan 31 clamps to Feb 28
+    rows = cl.execute("SELECT d + interval '1 year 2 months' FROM t "
+                      "WHERE k = 3").rows
+    assert rows == [(dt.date(1996, 3, 31),)]
+
+
+def test_timestamp_intervals(cl):
+    rows = cl.execute("SELECT ts + interval '90' minute FROM t "
+                      "WHERE k = 1").rows
+    assert rows == [(dt.datetime(1998, 9, 1, 12, 0),)]
+    rows = cl.execute("SELECT ts - interval '2 days' FROM t "
+                      "WHERE k = 2").rows
+    assert rows == [(dt.datetime(1998, 11, 29, 0, 0),)]
+
+
+def test_constant_fold_and_null(cl):
+    assert cl.execute("SELECT date '1998-12-01' - interval '90' day").rows \
+        == [(dt.date(1998, 9, 2),)]
+    # NULL date propagates
+    assert cl.execute("SELECT d + interval '1' day FROM t WHERE k = 4").rows \
+        == [(None,)]
+
+
+def test_subday_interval_on_date_rejected(cl):
+    with pytest.raises(UnsupportedFeatureError):
+        cl.execute("SELECT d + interval '90' minute FROM t")
+
+
+def test_current_date(cl):
+    assert cl.execute("SELECT count(*) FROM t WHERE d < current_date").rows \
+        == [(3,)]
+    today = cl.execute("SELECT current_date").rows[0][0]
+    assert today == dt.date.today()
+
+
+def test_jax_vs_cpu(cl):
+    sql = ("SELECT d + interval '3' month, count(*) FROM t "
+           "WHERE d >= date '1995-01-01' GROUP BY d + interval '3' month "
+           "ORDER BY 1")
+    jr = cl.execute(sql).rows
+    with settings_override(executor=ExecutorSettings(task_executor_backend="cpu")):
+        cr = cl.execute(sql).rows
+    assert jr == cr

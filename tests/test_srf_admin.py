@@ -100,7 +100,7 @@ def test_review_regressions(cl):
     assert cl.execute("SELECT * FROM generate_series(1, NULL) g").rows == []
     # unknown zero-arg function -> clean error, not IndexError
     with pytest.raises(UnsupportedFeatureError):
-        cl.execute("SELECT now()")
+        cl.execute("SELECT totally_unknown_fn()")
     # per-shard rows survive WHERE pruning; all 4 shards reported
     rows = cl.execute("SELECT run_command_on_shards('t', "
                       "'SELECT count(*) FROM %s WHERE k = 5')").rows
