@@ -56,6 +56,15 @@ def _srf_result(name: str, args, alias) -> "Result":
         if any(v is None for v in vals):
             # PostgreSQL: a NULL bound yields zero rows
             return Result(columns=[alias or "generate_series"], rows=[])
+        import decimal as _dec
+        for v in vals:
+            integral = (isinstance(v, int) and not isinstance(v, bool)) \
+                or (isinstance(v, float) and v.is_integer()) \
+                or (isinstance(v, _dec.Decimal) and v == v.to_integral_value())
+            if not integral:
+                raise AnalysisError(
+                    "generate_series requires integer bounds "
+                    f"(got {v!r}); timestamp series are not supported")
         start, stop = int(vals[0]), int(vals[1])
         step = int(vals[2]) if len(vals) > 2 else 1
         if step == 0:
@@ -212,13 +221,17 @@ def _eval_const_func(e):
         import datetime as _dt
         return _dt.date.today() if name == "current_date" \
             else _dt.datetime.now()
+    if name == "nullif":
+        # NULLIF is not strict: it returns the first argument unless the
+        # comparison is true, so nullif(5, NULL) = 5 (PostgreSQL).
+        return None if args[0] == args[1] else args[0]
     if any(a is None for a in args):
         # all these functions are strict (NULL in -> NULL out)
         known = {"abs", "floor", "ceil", "ceiling", "round", "trunc",
                  "sign", "sqrt", "exp", "ln", "log", "log10", "log2",
                  "power", "pow", "mod", "degrees", "radians", "greatest",
                  "least", "upper", "lower", "length", "char_length",
-                 "strpos", "nullif", "reverse", "initcap", "trim",
+                 "strpos", "reverse", "initcap", "trim",
                  "btrim", "ltrim", "rtrim", "replace", "left", "right"}
         if name in ("greatest", "least"):
             vals = [a for a in args if a is not None]
@@ -236,11 +249,13 @@ def _eval_const_func(e):
                 else (float(v) if isinstance(args[0], float) else v)
         if name == "round":
             nd = int(args[1]) if len(args) > 1 else 0
+            if isinstance(args[0], float):
+                # round(double precision) ties to even in PostgreSQL
+                return float(round(args[0], nd))
             d = args[0] if isinstance(args[0], _dec.Decimal) \
                 else _dec.Decimal(str(args[0]))
-            q = d.quantize(_dec.Decimal(1).scaleb(-nd),
-                           rounding=_dec.ROUND_HALF_UP)
-            return float(q) if isinstance(args[0], float) else q
+            return d.quantize(_dec.Decimal(1).scaleb(-nd),
+                              rounding=_dec.ROUND_HALF_UP)
         if name == "trunc":
             nd = int(args[1]) if len(args) > 1 else 0
             d = args[0] if isinstance(args[0], _dec.Decimal) \
@@ -282,8 +297,6 @@ def _eval_const_func(e):
             return _math.radians(args[0])
         if name in ("greatest", "least"):
             return max(args) if name == "greatest" else min(args)
-        if name == "nullif":
-            return None if args[0] == args[1] else args[0]
         if args and isinstance(args[0], str):
             s = args[0]
             if name == "upper":
@@ -1453,7 +1466,7 @@ class Cluster:
                 if self.catalog.referencing_fks(stmt.table):
                     from citus_tpu.integrity import on_parent_update
                     on_parent_update(self, stmt.table, assigned_cols,
-                                     stmt.where)
+                                     stmt.where, stmt.assignments)
                 if t.foreign_keys:
                     from citus_tpu.integrity import check_child_update
                     check_child_update(self, t, stmt.assignments)
@@ -1485,6 +1498,25 @@ class Cluster:
                              stmt.column.not_null)
                 self.catalog.add_column(stmt.table, col)
             elif stmt.action == "drop_column":
+                # PostgreSQL drops the table's own FK constraints that
+                # include the column; a referenced parent column needs
+                # CASCADE (unsupported here), so fail closed instead of
+                # leaving a stale constraint behind.
+                for child, fk in self.catalog.referencing_fks(stmt.table):
+                    if child == stmt.table:
+                        continue  # self-FK belongs to this table: dropped
+                    if stmt.old_name in fk["ref_columns"]:
+                        raise AnalysisError(
+                            f'cannot drop column "{stmt.old_name}" of '
+                            f'table "{stmt.table}" because foreign key '
+                            f'constraint "{fk["name"]}" on table '
+                            f'"{child}" depends on it')
+                t = self.catalog.table(stmt.table)
+                t.foreign_keys[:] = [
+                    fk for fk in t.foreign_keys
+                    if stmt.old_name not in fk["columns"]
+                    and not (fk["ref_table"] == stmt.table
+                             and stmt.old_name in fk["ref_columns"])]
                 self.catalog.drop_column(stmt.table, stmt.old_name)
             elif stmt.action == "rename_column":
                 self.catalog.rename_column(stmt.table, stmt.old_name, stmt.new_name)

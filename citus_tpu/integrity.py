@@ -278,22 +278,66 @@ def on_parent_delete(cluster, table_name: str, where) -> None:
 
 
 def on_parent_update(cluster, table_name: str, assigned_cols: set,
-                     where) -> None:
-    """RESTRICT semantics when an UPDATE rewrites referenced key columns
-    that child rows still point at (PostgreSQL NO ACTION at statement
-    end; value-preserving updates of referenced columns are rare enough
-    that the conservative check is acceptable)."""
+                     where, assignments=None) -> None:
+    """NO ACTION semantics when an UPDATE rewrites referenced key
+    columns that child rows still point at.  A pre-image key survives
+    (no error) when the constant assignments map it to itself
+    (e.g. UPDATE parent SET pk = <same value>) or when parent rows
+    outside the statement's WHERE still carry it; otherwise matching
+    child rows raise, conservatively pre-statement rather than at
+    statement end as PostgreSQL does."""
     for child_name, fk in cluster.catalog.referencing_fks(table_name):
         if not assigned_cols.intersection(fk["ref_columns"]):
             continue
         keys = referenced_preimage(cluster, table_name, where,
                                    fk["ref_columns"])
-        cond = _child_match_where(fk, keys)
+        const = {c: e.value for c, e in (assignments or [])
+                 if c in fk["ref_columns"] and isinstance(e, A.Literal)}
+        all_const = all(isinstance(e, A.Literal)
+                        for c, e in (assignments or [])
+                        if c in fk["ref_columns"])
+        at_risk = []
+        for key in keys:
+            if all_const and assignments is not None:
+                post = tuple(const.get(c, v)
+                             for c, v in zip(fk["ref_columns"], key))
+                if post == key:
+                    continue  # value-preserving: key survives as-is
+            at_risk.append(key)
+        cond = _child_match_where(fk, at_risk)
         if cond is None:
             continue
-        chk = A.Select([A.SelectItem(A.FuncCall("count", (A.Star(),)))],
-                       A.TableRef(child_name), cond)
-        if cluster._execute_stmt(chk).rows[0][0]:
+        # one batched probe finds the conflicting keys; the per-key
+        # escape check below runs only for those
+        probe = A.Select([A.SelectItem(A.ColumnRef(c))
+                          for c in fk["columns"]],
+                         A.TableRef(child_name), cond, distinct=True)
+        child_keys = [tuple(r) for r in cluster._execute_stmt(probe).rows]
+        if not child_keys:
+            continue
+        for key in at_risk:
+            if not any(len(ck) == len(key)
+                       and all(a == b for a, b in zip(ck, key))
+                       for ck in child_keys):
+                continue
+            if where is not None:
+                # rows with this key the WHERE does not touch keep the
+                # key present in the post-update parent; a NULL WHERE
+                # result also leaves its row untouched, hence coalesce
+                key_eq = None
+                for c, v in zip(fk["ref_columns"], key):
+                    from citus_tpu.cluster import _pylit
+                    this = A.BinOp("=", A.ColumnRef(c), _pylit(v))
+                    key_eq = this if key_eq is None \
+                        else A.BinOp("and", key_eq, this)
+                untouched = A.UnOp("not", A.FuncCall(
+                    "coalesce", (where, A.Literal(False, "bool"))))
+                cnt = A.Select([A.SelectItem(
+                    A.FuncCall("count", (A.Star(),)))],
+                    A.TableRef(table_name),
+                    A.BinOp("and", key_eq, untouched))
+                if cluster._execute_stmt(cnt).rows[0][0]:
+                    continue
             raise ForeignKeyViolation(
                 f'update or delete on table "{table_name}" violates '
                 f'foreign key constraint "{fk["name"]}" on table '

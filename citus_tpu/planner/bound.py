@@ -581,12 +581,6 @@ def compile_expr(e: BExpr, xp):
     raise AnalysisError(f"cannot compile {type(e).__name__}")
 
 
-def _round_half_away(xp, v):
-    """Round half away from zero (PostgreSQL numeric/float rounding;
-    numpy's default is banker's rounding)."""
-    return xp.where(v >= 0, xp.floor(v + 0.5), xp.ceil(v - 0.5))
-
-
 def _compile_math(e, xp):
     name = e.name
     fs = [compile_expr(o, xp) for o in e.operands]
@@ -633,8 +627,11 @@ def _compile_math(e, xp):
         f = fs[0]
         src_scale, digits = e.param  # operand decimal scale, round digits
         if e.operands[0].type.is_float:
+            # round(double precision) breaks ties to even in PostgreSQL
+            # (xp.round is half-to-even); half-away-from-zero applies
+            # only to the numeric/decimal path below.
             fn = {"floor": xp.floor, "ceil": xp.ceil,
-                  "round": lambda v: _round_half_away(xp, v),
+                  "round": xp.round,
                   "trunc": xp.trunc}[name]
             if digits:
                 factor = np.float64(10.0 ** digits)

@@ -219,3 +219,91 @@ def test_privileges_cover_expression_subqueries(tmp_path):
     assert cl.execute("SELECT (SELECT max(secret) FROM t2) FROM t1",
                       role="r").rows == [(42,)]
     cl.close()
+
+
+# ------------------------------------------------ round-2 advisor fixes
+
+
+def test_drop_column_drops_own_fk(tmp_path):
+    """ALTER TABLE DROP COLUMN removes the table's own FK constraints
+    that include the column (PostgreSQL drops dependent constraints),
+    so parent DELETEs keep working afterwards."""
+    cl = ct.Cluster(str(tmp_path / "dfk"))
+    cl.execute("CREATE TABLE parent (pid bigint NOT NULL, v bigint)")
+    cl.execute("CREATE TABLE child (cid bigint NOT NULL, "
+               "pid bigint REFERENCES parent (pid))")
+    cl.execute("SELECT create_reference_table('parent')")
+    cl.execute("SELECT create_distributed_table('child','cid',4)")
+    cl.execute("INSERT INTO parent VALUES (1, 10)")
+    cl.execute("ALTER TABLE child DROP COLUMN pid")
+    assert cl.catalog.table("child").foreign_keys == []
+    # the parent DELETE no longer probes a dropped column
+    cl.execute("DELETE FROM parent WHERE pid = 1")
+
+
+def test_drop_referenced_column_refused(tmp_path):
+    """Dropping a parent column a child FK references is refused (the
+    CASCADE that PostgreSQL would require is unsupported)."""
+    from citus_tpu.errors import AnalysisError
+    cl = ct.Cluster(str(tmp_path / "dref"))
+    cl.execute("CREATE TABLE parent (pid bigint NOT NULL, v bigint)")
+    cl.execute("CREATE TABLE child (cid bigint NOT NULL, "
+               "pid bigint REFERENCES parent (pid))")
+    cl.execute("SELECT create_distributed_table('parent','pid',4)")
+    cl.execute("SELECT create_distributed_table('child','pid',4)")
+    with pytest.raises(AnalysisError):
+        cl.execute("ALTER TABLE parent DROP COLUMN pid")
+    # constraint still intact and enforced
+    from citus_tpu.integrity import ForeignKeyViolation
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("INSERT INTO child VALUES (1, 99)")
+
+
+def test_value_preserving_parent_update_allowed(tmp_path):
+    """UPDATE parent SET pk = <same value> succeeds even with matching
+    child rows (PostgreSQL NO ACTION re-checks the post-image)."""
+    cl = ct.Cluster(str(tmp_path / "vpu"))
+    cl.execute("CREATE TABLE parent (pid bigint NOT NULL, v bigint)")
+    cl.execute("CREATE TABLE child (cid bigint NOT NULL, "
+               "pid bigint REFERENCES parent (pid))")
+    cl.execute("SELECT create_distributed_table('parent','pid',4)")
+    cl.execute("SELECT create_distributed_table('child','pid',4)")
+    cl.execute("INSERT INTO parent VALUES (1, 10)")
+    cl.execute("INSERT INTO child VALUES (100, 1)")
+    cl.execute("UPDATE parent SET pid = 1 WHERE pid = 1")  # no-op rewrite
+    # a genuinely key-changing update still raises
+    from citus_tpu.integrity import ForeignKeyViolation
+    with pytest.raises(ForeignKeyViolation):
+        cl.execute("UPDATE parent SET pid = 2 WHERE pid = 1")
+
+
+def test_nullif_not_strict(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "nifd"))
+    assert cl.execute("SELECT nullif(5, NULL)").rows[0][0] == 5
+    assert cl.execute("SELECT nullif(5, 5)").rows[0][0] is None
+    assert cl.execute("SELECT nullif(5, 4)").rows[0][0] == 5
+    assert cl.execute("SELECT nullif(NULL, 5)").rows[0][0] is None
+
+
+def test_generate_series_rejects_non_integer(tmp_path):
+    from citus_tpu.errors import AnalysisError
+    cl = ct.Cluster(str(tmp_path / "gsr"))
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT * FROM generate_series('a', 'b')")
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT * FROM generate_series(1.5, 3)")
+    assert [r[0] for r in
+            cl.execute("SELECT * FROM generate_series(1, 3)").rows] == [1, 2, 3]
+
+
+def test_float_round_half_to_even(tmp_path):
+    """PostgreSQL round(double precision) ties to even: round(2.5)=2."""
+    cl = ct.Cluster(str(tmp_path / "rte"))
+    cl.execute("CREATE TABLE fr (k bigint NOT NULL, x double precision)")
+    cl.execute("SELECT create_distributed_table('fr','k',2)")
+    cl.execute("INSERT INTO fr VALUES (1, 2.5), (2, 3.5), (3, -2.5)")
+    rows = dict(cl.execute(
+        "SELECT k, round(x) FROM fr ORDER BY k").rows)
+    assert rows[1] == 2.0 and rows[2] == 4.0 and rows[3] == -2.0
+    # numeric literals keep half-away-from-zero (PostgreSQL numeric)
+    assert cl.execute("SELECT round(2.5)").rows[0][0] == 3
