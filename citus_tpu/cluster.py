@@ -20,7 +20,8 @@ import jax
 from citus_tpu.catalog import Catalog, DistributionMethod
 from citus_tpu.config import Settings, current_settings
 from citus_tpu.errors import (
-    AnalysisError, CatalogError, ExecutionError, UnsupportedFeatureError,
+    AnalysisError, CatalogError, ExecutionError, TransactionError,
+    UnsupportedFeatureError,
 )
 from citus_tpu.executor import Result, execute_select
 from citus_tpu.ingest import TableIngestor, encode_columns, rows_to_columns
@@ -640,6 +641,11 @@ class Cluster:
         return self._maintenance
 
     def close(self) -> None:
+        # an open transaction on the default session rolls back
+        # (connection-close semantics)
+        ds = getattr(self, "_default_session_obj", None)
+        if ds is not None and ds.txn is not None:
+            self._rollback_txn(ds)
         if self._background_jobs is not None:
             self._background_jobs.stop()
         if self._maintenance is not None:
@@ -665,6 +671,15 @@ class Cluster:
 
         @contextlib.contextmanager
         def _ctx():
+            from citus_tpu.storage.overlay import current_overlay
+            txn = current_overlay()
+            if txn is not None:
+                # inside BEGIN..COMMIT: two-phase locking — acquire into
+                # the transaction and retain until COMMIT/ROLLBACK
+                # (reference holds shard locks to transaction end)
+                txn.hold_group_lock(self, table_meta, mode)
+                yield
+                return
             from citus_tpu.transaction.write_locks import group_write_lock
             with group_write_lock(self.catalog, table_meta, mode,
                                   lock_manager=self.locks,
@@ -814,9 +829,33 @@ class Cluster:
     def copy_from(self, table_name: str,
                   columns: Optional[dict[str, Sequence[Any]]] = None,
                   rows: Optional[Iterable[Sequence[Any]]] = None,
-                  column_names: Optional[list[str]] = None) -> int:
+                  column_names: Optional[list[str]] = None,
+                  session=None) -> int:
         """Bulk load (the COPY analog).  Either ``columns`` (dict of
-        arrays/lists, fastest) or ``rows`` (iterable of tuples)."""
+        arrays/lists, fastest) or ``rows`` (iterable of tuples).  Inside
+        an open transaction (``session`` with BEGIN, or called from a
+        statement of one) the write stages under the transaction's xid
+        and commits with it."""
+        from citus_tpu.storage.overlay import current_overlay, transaction_overlay
+        if session is None:
+            # match execute(): a BEGIN issued through cl.execute() opens
+            # a transaction on the shared default session, and a COPY
+            # issued the same way must join it, not autocommit past it
+            session = self._default_session()
+        if session.txn is not None and current_overlay() is None:
+            if session.txn.failed:
+                from citus_tpu.transaction.session import InFailedTransaction
+                raise InFailedTransaction(
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block")
+            with transaction_overlay(session.txn):
+                try:
+                    return self.copy_from(table_name, columns=columns,
+                                          rows=rows,
+                                          column_names=column_names)
+                except Exception:
+                    session.txn.failed = True
+                    raise
         t = self.catalog.table(table_name)
         if (columns is None) == (rows is None):
             raise AnalysisError("provide exactly one of columns= or rows=")
@@ -826,6 +865,7 @@ class Cluster:
         import contextlib as _ctxlib
 
         from citus_tpu.transaction.locks import SHARED
+        txn = current_overlay()
         with self._write_lock(t, SHARED):
             t = self.catalog.table(table_name)  # re-fetch: fresh placements
             with _ctxlib.ExitStack() as stack:
@@ -843,25 +883,55 @@ class Cluster:
                         p = self.catalog.table(fk["ref_table"])
                         parents[group_resource(p)] = p
                     for res in sorted(parents):
-                        stack.enter_context(group_write_lock(
-                            self.catalog, parents[res], SHARED,
-                            lock_manager=self.locks,
-                            timeout=self.settings.executor.lock_timeout_s))
+                        if txn is not None:
+                            txn.hold_group_lock(self, parents[res], SHARED)
+                        else:
+                            stack.enter_context(group_write_lock(
+                                self.catalog, parents[res], SHARED,
+                                lock_manager=self.locks,
+                                timeout=self.settings.executor.lock_timeout_s))
                     check_ingest(self, t, columns)
-                ing = TableIngestor(self.catalog, t, txlog=self.txlog)
-                try:
-                    ing.append(values, validity)
-                except BaseException:
-                    ing.abort()
-                    raise
-                ing.finish()
+                if txn is not None:
+                    # stage under the open transaction; COMMIT flips it.
+                    # On failure, REGISTER (don't abort) what was staged:
+                    # aborting the xid would destroy earlier statements'
+                    # staged rows; registration lets ROLLBACK [TO
+                    # SAVEPOINT] clean exactly this statement's stripes.
+                    ing = TableIngestor(self.catalog, t, txlog=None)
+                    ing.xid = txn.xid
+                    try:
+                        ing.append(values, validity)
+                        for w in ing._writers.values():
+                            w.flush()
+                    finally:
+                        txn.record_ingest(
+                            t.name,
+                            [w.directory for w in ing._writers.values()])
+                else:
+                    ing = TableIngestor(self.catalog, t, txlog=self.txlog)
+                    try:
+                        ing.append(values, validity)
+                    except BaseException:
+                        ing.abort()
+                        raise
+                    ing.finish()
         n = len(next(iter(values.values()))) if values else 0
         self.counters.bump("rows_ingested", n)
         if self.cdc.enabled and n:
-            self.cdc.emit(t.name, "insert", self.clock.transaction_clock(),
-                          rows=self._decode_rows(t, values, validity),
-                          columns=t.schema.names)
+            self._emit_cdc(t.name, "insert",
+                           rows=self._decode_rows(t, values, validity),
+                           columns=t.schema.names)
         return n
+
+    def _emit_cdc(self, table: str, op: str, **kw) -> None:
+        """Emit a change event — or, inside an open transaction, defer
+        it to COMMIT (PostgreSQL logical decoding emits on commit)."""
+        from citus_tpu.storage.overlay import current_overlay
+        txn = current_overlay()
+        if txn is not None:
+            txn.cdc_events.append((table, op, kw))
+        else:
+            self.cdc.emit(table, op, self.clock.transaction_clock(), **kw)
 
     def _decode_rows(self, t, values, validity) -> list:
         out = []
@@ -955,10 +1025,29 @@ class Cluster:
         return total
 
     # -------------------------------------------------------------- SQL
+    def session(self):
+        """Open an interactive session (the psql-connection analog):
+        supports BEGIN/COMMIT/ROLLBACK and savepoints.  Statements run
+        through ``Cluster.execute`` directly use a shared default
+        session, so ``cl.execute("BEGIN")`` works too."""
+        from citus_tpu.transaction.session import Session
+        return Session(self)
+
+    def _default_session(self):
+        if getattr(self, "_default_session_obj", None) is None:
+            self._default_session_obj = self.session()
+        return self._default_session_obj
+
     def execute(self, sql: str, params: Optional[Sequence[Any]] = None,
-                role: Optional[str] = None) -> Result:
+                role: Optional[str] = None, session=None) -> Result:
         import time as _time
-        self._maybe_reload_catalog()
+        if session is None:
+            session = self._default_session()
+        if session.txn is None:
+            # inside a transaction the catalog object must stay stable
+            # (statements hold references into it; PostgreSQL blocks
+            # conflicting DDL with locks instead)
+            self._maybe_reload_catalog()
         stmts = parse_sql(sql)
         if role is not None:
             for stmt in stmts:
@@ -974,29 +1063,34 @@ class Cluster:
         self._exec_roles[_threading.get_ident()] = role
         try:
             for stmt in stmts:
-                if params is not None:
-                    # parameterized plans: cached generic plan + deferred
-                    # pruning when the query shape supports it (reference:
-                    # Job->deferredPruning, fast_path_router_planner.c)
-                    # — superuser only: the cache keys on SQL text and an
-                    # RLS rewrite must never leak across roles
-                    if len(stmts) == 1 and isinstance(stmt, A.Select) \
-                            and role is None:
-                        r = self._execute_param_select(sql, stmt, list(params))
-                        if r is not None:
-                            result = r
-                            continue
-                    from citus_tpu.planner.recursive import rewrite_params
-                    stmt = rewrite_params(stmt, list(params))
-                rls_rewritten = False
-                if role is not None:
-                    # after parameter substitution so WITH CHECK sees the
-                    # actual inserted values
-                    stmt, rls_rewritten = self._apply_rls(role, stmt)
-                key = sql if (len(stmts) == 1 and params is None
-                              and not rls_rewritten) else None
-                result = self._execute_stmt(stmt, sql_text=key)
-                self._fire_triggers(stmt)
+                if isinstance(stmt, A.TransactionStmt):
+                    result = self._execute_transaction_stmt(session, stmt)
+                    continue
+                txn = session.txn
+                if txn is not None:
+                    if txn.failed:
+                        from citus_tpu.transaction.session import (
+                            InFailedTransaction,
+                        )
+                        raise InFailedTransaction(
+                            "current transaction is aborted, commands "
+                            "ignored until end of transaction block")
+                    from citus_tpu.storage.overlay import transaction_overlay
+                    try:
+                        self._guard_in_txn(stmt)
+                        with transaction_overlay(txn):
+                            result = self._execute_in_session(
+                                stmt, sql, stmts, params, role)
+                            self._fire_triggers(stmt)
+                    except Exception:
+                        # PostgreSQL: any error aborts the transaction
+                        # block until ROLLBACK [TO SAVEPOINT]
+                        txn.failed = True
+                        raise
+                else:
+                    result = self._execute_in_session(stmt, sql, stmts,
+                                                      params, role)
+                    self._fire_triggers(stmt)
         finally:
             self._exec_roles.pop(_threading.get_ident(), None)
             self.activity.exit(gpid)
@@ -1008,6 +1102,181 @@ class Cluster:
         if rkey is not None:
             self.tenant_stats.record(str(rkey), elapsed)
         return result
+
+    def _execute_in_session(self, stmt, sql, stmts, params, role) -> Result:
+        """One statement through parameter substitution, RLS rewrite,
+        and plan-cache keying (the pre-session body of execute())."""
+        if params is not None:
+            # parameterized plans: cached generic plan + deferred
+            # pruning when the query shape supports it (reference:
+            # Job->deferredPruning, fast_path_router_planner.c)
+            # — superuser only: the cache keys on SQL text and an
+            # RLS rewrite must never leak across roles
+            if len(stmts) == 1 and isinstance(stmt, A.Select) \
+                    and role is None:
+                r = self._execute_param_select(sql, stmt, list(params))
+                if r is not None:
+                    return r
+            from citus_tpu.planner.recursive import rewrite_params
+            stmt = rewrite_params(stmt, list(params))
+        rls_rewritten = False
+        if role is not None:
+            # after parameter substitution so WITH CHECK sees the
+            # actual inserted values
+            stmt, rls_rewritten = self._apply_rls(role, stmt)
+        key = sql if (len(stmts) == 1 and params is None
+                      and not rls_rewritten) else None
+        return self._execute_stmt(stmt, sql_text=key)
+
+    #: statement types allowed inside BEGIN..COMMIT.  DDL and cluster
+    #: operations commit catalog changes immediately, so allowing them
+    #: would break transaction atomicity — refuse instead (PostgreSQL
+    #: allows transactional DDL; a documented divergence for now).
+    _TXN_ALLOWED = None  # initialized lazily below
+
+    def _guard_in_txn(self, stmt) -> None:
+        if Cluster._TXN_ALLOWED is None:
+            Cluster._TXN_ALLOWED = (A.Select, A.WithSelect, A.SetOp,
+                                    A.Explain, A.Insert, A.Update, A.Delete)
+        if not isinstance(stmt, Cluster._TXN_ALLOWED):
+            raise UnsupportedFeatureError(
+                f"{type(stmt).__name__} cannot run inside a transaction "
+                "block")
+
+    def _execute_transaction_stmt(self, session, stmt) -> Result:
+        """BEGIN/COMMIT/ROLLBACK/SAVEPOINT state machine (reference:
+        CoordinatedTransactionCallback, transaction_management.c:319;
+        subtransaction callback :176)."""
+        from citus_tpu.transaction.session import OpenTransaction
+        kind = stmt.kind
+        txn = session.txn
+        if kind == "begin":
+            if txn is not None:
+                return Result(columns=[], rows=[],
+                              explain={"warning": "there is already a "
+                                       "transaction in progress"})
+            xid = self.txlog.begin()
+            session.txn = OpenTransaction(xid, session.lock_sid)
+            return Result(columns=[], rows=[], explain={"transaction": "begin"})
+        if kind == "commit":
+            if txn is None:
+                return Result(columns=[], rows=[],
+                              explain={"warning": "there is no transaction "
+                                       "in progress"})
+            if txn.failed:
+                # COMMIT of an aborted transaction rolls back
+                self._rollback_txn(session)
+                return Result(columns=[], rows=[],
+                              explain={"transaction": "rollback"})
+            self._commit_txn(session)
+            return Result(columns=[], rows=[], explain={"transaction": "commit"})
+        if kind == "rollback":
+            if txn is None:
+                return Result(columns=[], rows=[],
+                              explain={"warning": "there is no transaction "
+                                       "in progress"})
+            self._rollback_txn(session)
+            return Result(columns=[], rows=[], explain={"transaction": "rollback"})
+        # savepoint family requires an open transaction (PostgreSQL
+        # errors outside one)
+        if txn is None:
+            raise TransactionError(
+                f"{kind.upper()} can only be used in transaction blocks")
+        if kind == "savepoint":
+            if txn.failed:
+                from citus_tpu.transaction.session import InFailedTransaction
+                raise InFailedTransaction(
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block")
+            txn.savepoints.append((stmt.name, txn.snapshot()))
+            return Result(columns=[], rows=[])
+        if kind == "rollback_to":
+            for i in range(len(txn.savepoints) - 1, -1, -1):
+                if txn.savepoints[i][0] == stmt.name:
+                    txn.restore(txn.savepoints[i][1])
+                    # the savepoint itself survives (PostgreSQL keeps it
+                    # so you can roll back to it again); later ones die
+                    del txn.savepoints[i + 1:]
+                    self._plan_cache.clear()
+                    return Result(columns=[], rows=[])
+            txn.failed = True  # error in a txn block aborts it (25P02)
+            raise TransactionError(f'savepoint "{stmt.name}" does not exist')
+        if kind == "release":
+            if txn.failed:
+                from citus_tpu.transaction.session import InFailedTransaction
+                raise InFailedTransaction(
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block")
+            for i in range(len(txn.savepoints) - 1, -1, -1):
+                if txn.savepoints[i][0] == stmt.name:
+                    del txn.savepoints[i:]
+                    return Result(columns=[], rows=[])
+            txn.failed = True  # error in a txn block aborts it (25P02)
+            raise TransactionError(f'savepoint "{stmt.name}" does not exist')
+        raise AnalysisError(f"unknown transaction statement {kind!r}")
+
+    def _commit_txn(self, session) -> None:
+        """PREPARED -> COMMITTED -> flip staged state -> DONE across
+        every placement the transaction touched — the interactive-
+        transaction generalization of the per-statement 2PC (reference:
+        pre-commit PREPARE on all write connections,
+        transaction_management.c:319)."""
+        from citus_tpu.storage.deletes import commit_staged_deletes
+        from citus_tpu.storage.writer import commit_staged
+        from citus_tpu.transaction.manager import TxState
+
+        txn = session.txn
+        try:
+            if not txn.has_writes:
+                self.txlog.release(txn.xid)
+                return
+            try:
+                # catalog (with version bumps) persisted before the
+                # COMMITTED record: roll-forward must find everything it
+                # references on disk (same ordering as ingest.finish)
+                for name in sorted(txn.tables):
+                    if self.catalog.has_table(name):
+                        self.catalog.table(name).version += 1
+                self.catalog.commit()
+                payload = {"kind": "txn",
+                           "placements": sorted(txn.delete_dirs),
+                           "ingest_placements": sorted(txn.ingest_dirs),
+                           "tables": sorted(txn.tables)}
+                self.txlog.log(txn.xid, TxState.PREPARED, payload)
+                self.txlog.log(txn.xid, TxState.COMMITTED, payload)
+                for d in sorted(txn.delete_dirs):
+                    commit_staged_deletes(d, txn.xid)
+                for d in sorted(txn.ingest_dirs):
+                    commit_staged(d, txn.xid)
+                self.txlog.log(txn.xid, TxState.DONE)
+            except BaseException:
+                # stop driving; recovery decides the outcome from the log
+                self.txlog.release(txn.xid)
+                raise
+            self._plan_cache.clear()
+            if self.cdc.enabled:
+                clock = self.clock.transaction_clock()
+                for table, op, kw in txn.cdc_events:
+                    self.cdc.emit(table, op, clock, **kw)
+        finally:
+            txn.release_locks(self)
+            session.txn = None
+
+    def _rollback_txn(self, session) -> None:
+        from citus_tpu.storage.deletes import abort_staged_deletes
+        from citus_tpu.storage.writer import abort_staged
+
+        txn = session.txn
+        try:
+            for d in sorted(txn.ingest_dirs):
+                abort_staged(d, txn.xid)
+            for d in sorted(txn.delete_dirs):
+                abort_staged_deletes(d, txn.xid)
+            self.txlog.release(txn.xid)
+            self._plan_cache.clear()
+        finally:
+            txn.release_locks(self)
+            session.txn = None
 
     def _execute_param_select(self, sql: str, stmt: A.Select,
                               params: list) -> Optional[Result]:
@@ -1427,11 +1696,12 @@ class Cluster:
                                              stmt.returning) \
                     if stmt.returning else None
                 t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
-                n = execute_delete(self.catalog, self.txlog, t, where)
+                from citus_tpu.storage.overlay import current_overlay
+                n = execute_delete(self.catalog, self.txlog, t, where,
+                                   txn=current_overlay())
             self._plan_cache.clear()
             if self.cdc.enabled and n:
-                self.cdc.emit(t.name, "delete", self.clock.transaction_clock(),
-                              count=n)
+                self._emit_cdc(t.name, "delete", count=n)
             if ret is not None:
                 ret.explain["deleted"] = n
                 return ret
@@ -1481,11 +1751,12 @@ class Cluster:
                     ret = self._returning_result(stmt.table, stmt.where,
                                                  stmt.returning, subst)
                 t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
-                n = execute_update(self.catalog, self.txlog, t, assignments, where)
+                from citus_tpu.storage.overlay import current_overlay
+                n = execute_update(self.catalog, self.txlog, t, assignments,
+                                   where, txn=current_overlay())
             self._plan_cache.clear()
             if self.cdc.enabled and n:
-                self.cdc.emit(t.name, "update", self.clock.transaction_clock(),
-                              count=n)
+                self._emit_cdc(t.name, "update", count=n)
             if ret is not None:
                 ret.explain["updated"] = n
                 return ret
@@ -1924,6 +2195,25 @@ class Cluster:
 
     def _run_insert_select_arrays(self, target, bound, plan, fns, ffn,
                                   names, strategy) -> int:
+        from citus_tpu.storage.overlay import current_overlay
+        txn = current_overlay()
+        if txn is not None:
+            # inside BEGIN..COMMIT: stage under the transaction's xid.
+            # On failure, register staged dirs (never abort the xid —
+            # that would destroy earlier statements' staged rows)
+            ing = TableIngestor(self.catalog, target, txlog=None)
+            ing.xid = txn.xid
+            try:
+                total = self._stream_insert_select(ing, target, bound, plan,
+                                                   fns, ffn, names, strategy)
+                for w in ing._writers.values():
+                    w.flush()
+            finally:
+                txn.record_ingest(
+                    target.name,
+                    [w.directory for w in ing._writers.values()])
+            self.counters.bump("rows_ingested", total)
+            return total
         ing = TableIngestor(self.catalog, target, txlog=self.txlog)
         try:
             total = self._stream_insert_select(ing, target, bound, plan,

@@ -72,7 +72,8 @@ def load_shard_batches(
                     GLOBAL_COUNTERS.bump("connection_failovers")
                     continue
                 return  # never written on any placement: empty shard
-            if _load_meta(d)["row_count"] == 0:
+            from citus_tpu.storage.overlay import visible_meta
+            if visible_meta(d)["row_count"] == 0:
                 return  # authoritative: the shard is empty
             reader = ShardReader(d, table.schema)
             break

@@ -55,8 +55,9 @@ def _matched_rows_per_stripe(cat: Catalog, table: TableMeta, directory: str,
     matched_batches = []
     for s in reader.meta["stripes"]:
         stripe_rows[s["file"]] = s["row_count"]
-    from citus_tpu.storage.deletes import load_deletes, deleted_mask
-    dcache = load_deletes(directory)
+    from citus_tpu.storage.deletes import deleted_mask
+    from citus_tpu.storage.overlay import visible_deletes
+    dcache = visible_deletes(directory)
     for batch in reader.scan(columns, intervals, apply_deletes=False):
         env = {c: (batch.values[c],
                    batch.validity[c] if batch.validity[c] is not None else True)
@@ -81,27 +82,41 @@ def _matched_rows_per_stripe(cat: Catalog, table: TableMeta, directory: str,
 
 
 def execute_delete(cat: Catalog, txlog: TransactionLog, table: TableMeta,
-                   where: Optional[BExpr]) -> int:
+                   where: Optional[BExpr], txn=None) -> int:
+    """``txn``: an open interactive transaction (transaction/session.py)
+    — stage under its xid and leave the commit to its COMMIT."""
     shard_indexes = prune_shards(table, where)
     columns = _where_columns(table, where)
-    xid = txlog.begin()
+    xid = txn.xid if txn is not None else txlog.begin()
     try:
         staged_dirs = []
         total = 0
-        for d in _placement_dirs(cat, table, shard_indexes):
-            merged, _ = _matched_rows_per_stripe(cat, table, d, where, columns)
-            if not merged:
-                continue
-            stage_deletes(d, xid, merged)
-            staged_dirs.append(d)
-            # count once per shard (placements are replicas)
-        # count distinct rows on primary placements only
+        # stage AND count in one pass: an open transaction's overlay
+        # makes staged deletes visible, so a second scan after staging
+        # would see the rows as already gone
         for si in shard_indexes:
             shard = table.shards[si]
-            d = cat.shard_dir(table.name, shard.shard_id, shard.placements[0])
-            if os.path.isdir(d):
-                merged, _ = _matched_rows_per_stripe(cat, table, d, where, columns)
-                total += sum(len(ix) for ix, _ in merged.values())
+            primary = shard.placements[0]
+            for node in shard.placements:
+                d = cat.shard_dir(table.name, shard.shard_id, node)
+                if not os.path.isdir(d):
+                    continue
+                merged, _ = _matched_rows_per_stripe(cat, table, d, where,
+                                                     columns)
+                if not merged:
+                    continue
+                if node == primary:
+                    # count once per shard (placements are replicas)
+                    total += sum(len(ix) for ix, _ in merged.values())
+                stage_deletes(d, xid, merged)
+                staged_dirs.append(d)
+                if txn is not None:
+                    # register per-dir as staged, so a mid-statement
+                    # failure leaves nothing outside the transaction's
+                    # bookkeeping (ROLLBACK [TO SAVEPOINT] must clean it)
+                    txn.record_deletes(table.name, [d])
+        if txn is not None:
+            return total
         if not staged_dirs:
             txlog.release(xid)
             return 0
@@ -133,24 +148,26 @@ def _where_columns(table: TableMeta, where: Optional[BExpr]) -> list[str]:
 
 def execute_update(cat: Catalog, txlog: TransactionLog, table: TableMeta,
                    assignments: list[tuple[str, BExpr]],
-                   where: Optional[BExpr]) -> int:
-    """delete matched rows + re-insert with assignments applied, one 2PC."""
+                   where: Optional[BExpr], txn=None) -> int:
+    """delete matched rows + re-insert with assignments applied, one 2PC
+    (or staged under ``txn``'s xid when inside an open transaction)."""
     from citus_tpu.ingest import TableIngestor
 
     shard_indexes = prune_shards(table, where)
     all_columns = table.schema.names
-    xid = txlog.begin()
+    xid = txn.xid if txn is not None else txlog.begin()
     try:
         return _execute_update_tx(cat, txlog, table, assignments, where,
-                                  shard_indexes, all_columns, xid)
+                                  shard_indexes, all_columns, xid, txn)
     except BaseException:
-        # stop driving the transaction; recovery decides its outcome
-        txlog.release(xid)
+        if txn is None:
+            # stop driving the transaction; recovery decides its outcome
+            txlog.release(xid)
         raise
 
 
 def _execute_update_tx(cat, txlog, table, assignments, where,
-                       shard_indexes, all_columns, xid) -> int:
+                       shard_indexes, all_columns, xid, txn=None) -> int:
     from citus_tpu.ingest import TableIngestor
 
     staged_delete_dirs = []
@@ -176,6 +193,10 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
                 if m2:
                     stage_deletes(pd, xid, m2)
                     staged_delete_dirs.append(pd)
+                    if txn is not None:
+                        # register immediately: a later failure in this
+                        # statement must leave nothing unregistered
+                        txn.record_deletes(table.name, [pd])
         # build replacement rows
         for batch, mask in matched:
             idx = np.nonzero(mask)[0]
@@ -199,7 +220,8 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
                     m = batch.validity[c]
                     new_valid[c].append(np.ones(idx.size, bool) if m is None else m[idx])
     if total == 0:
-        txlog.release(xid)
+        if txn is None:
+            txlog.release(xid)
         return 0
     values = {c: np.concatenate(new_values[c]).astype(table.schema.column(c).type.storage_dtype)
               for c in all_columns}
@@ -207,6 +229,17 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
     ing = TableIngestor(cat, table, txlog=None)
     ing.xid = xid  # share the DML transaction
     ing._writers = {}
+    if txn is not None:
+        # interactive transaction: leave everything staged; COMMIT
+        # flips.  Register even on failure so rollback cleans it.
+        try:
+            ing.append(values, validity)
+            for w in ing._writers.values():
+                w.flush()
+        finally:
+            txn.record_ingest(table.name,
+                              [w.directory for w in ing._writers.values()])
+        return total
     ing.append(values, validity)
     for w in ing._writers.values():
         w.flush()

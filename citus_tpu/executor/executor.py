@@ -160,11 +160,18 @@ def _device_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
     """Load batches and pin them in the HBM cache (single-device path)."""
     import jax
     from citus_tpu.executor.device_cache import GLOBAL_CACHE, plan_cache_key
+    from citus_tpu.storage.overlay import current_overlay
 
+    # an open transaction's staged writes change what a scan sees
+    # without bumping table.version — bypass the HBM cache for tables
+    # the transaction touched (other tables still hit it)
+    txn = current_overlay()
+    overlaid = txn is not None and plan.bound.table.name in txn.tables
     key = plan_cache_key(plan, cat.data_dir)
-    cached = GLOBAL_CACHE.get(key)
-    if cached is not None:
-        return cached
+    if not overlaid:
+        cached = GLOBAL_CACHE.get(key)
+        if cached is not None:
+            return cached
     batches = _load_all_batches(cat, plan, settings)
     dev_batches = []
     nbytes = 0
@@ -176,7 +183,8 @@ def _device_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
         dev_batches.append(ShardBatch(cols, valids, row_mask, b.n_rows,
                                       b.padded_rows, b.shard_index))
     jax.block_until_ready([b.cols for b in dev_batches])
-    GLOBAL_CACHE.put(key, dev_batches, nbytes)
+    if not overlaid:
+        GLOBAL_CACHE.put(key, dev_batches, nbytes)
     return dev_batches
 
 

@@ -33,7 +33,7 @@ from citus_tpu.executor.host_agg import HostGroupAccumulator
 from citus_tpu.planner.bound import BColumn, BKeyRef, compile_expr, predicate_mask
 from citus_tpu.planner.join_planner import BoundJoinSelect, RelPlan
 from citus_tpu.storage import ShardReader
-from citus_tpu.storage.writer import _load_meta
+from citus_tpu.storage.overlay import visible_meta
 
 # frame: dict[qualified_col -> (values ndarray, valid ndarray)] + row count
 
@@ -49,7 +49,7 @@ def _load_rel_frame(cat: Catalog, rp: RelPlan, qualified: bool,
     for si in idxs:
         shard = t.shards[si]
         d = cat.shard_dir(t.name, shard.shard_id, shard.placements[0])
-        if not os.path.isdir(d) or _load_meta(d)["row_count"] == 0:
+        if not os.path.isdir(d) or visible_meta(d)["row_count"] == 0:
             continue
         reader = ShardReader(d, t.schema)
         for batch in reader.scan(rp.columns, rp.intervals):

@@ -545,6 +545,15 @@ class Truncate(Statement):
 
 
 @dataclass
+class TransactionStmt(Statement):
+    """BEGIN / COMMIT / ROLLBACK / SAVEPOINT family (reference:
+    transaction/transaction_management.c wraps exactly these)."""
+
+    kind: str  # begin | commit | rollback | savepoint | rollback_to | release
+    name: Optional[str] = None  # savepoint name
+
+
+@dataclass
 class Vacuum(Statement):
     table: str
     full: bool = False

@@ -133,6 +133,54 @@ class Parser:
         raise SqlSyntaxError(f"{msg}, got {got!r}", t.pos, self.text)
 
     # ---- statements ----------------------------------------------------
+    def _try_parse_transaction_stmt(self) -> "Optional[A.Statement]":
+        """BEGIN/START TRANSACTION, COMMIT/END, ROLLBACK/ABORT,
+        SAVEPOINT, ROLLBACK TO [SAVEPOINT], RELEASE [SAVEPOINT]
+        (PostgreSQL spellings; reference wraps these in
+        transaction_management.c:319)."""
+        t = self.peek()
+        word = t.value.lower() if t.kind in ("ident", "kw") else None
+
+        def _eat_work_transaction():
+            n = self.peek()
+            if n.kind == "ident" and n.value.lower() in ("work", "transaction"):
+                self.next()
+
+        if word in ("begin", "start"):
+            self.next()
+            if word == "start":
+                n = self.peek()
+                if not (n.kind == "ident"
+                        and n.value.lower() == "transaction"):
+                    self.error("expected TRANSACTION after START")
+                self.next()
+            else:
+                _eat_work_transaction()
+            return A.TransactionStmt("begin")
+        if word in ("commit", "end"):
+            self.next()
+            _eat_work_transaction()
+            return A.TransactionStmt("commit")
+        if word in ("rollback", "abort"):
+            self.next()
+            if word == "rollback" and self.accept_kw("to"):
+                n = self.peek()
+                if n.kind == "ident" and n.value.lower() == "savepoint":
+                    self.next()
+                return A.TransactionStmt("rollback_to", self.expect_ident())
+            _eat_work_transaction()
+            return A.TransactionStmt("rollback")
+        if word == "savepoint":
+            self.next()
+            return A.TransactionStmt("savepoint", self.expect_ident())
+        if word == "release":
+            self.next()
+            n = self.peek()
+            if n.kind == "ident" and n.value.lower() == "savepoint":
+                self.next()
+            return A.TransactionStmt("release", self.expect_ident())
+        return None
+
     def parse_statements(self) -> list[A.Statement]:
         stmts = []
         while self.peek().kind != "eof":
@@ -142,6 +190,9 @@ class Parser:
         return stmts
 
     def parse_statement(self) -> A.Statement:
+        ts = self._try_parse_transaction_stmt()
+        if ts is not None:
+            return ts
         if self.at_kw("explain"):
             return self.parse_explain()
         if self.at_kw("with"):

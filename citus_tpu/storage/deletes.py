@@ -68,16 +68,25 @@ def _store(path: str, d: dict[str, str]) -> None:
 def stage_deletes(directory: str, xid: int,
                   per_stripe: dict[str, tuple[np.ndarray, int]]) -> None:
     """Stage row deletions: per_stripe[stripe_file] = (row_indexes, n_rows).
-    Merges with the placement's existing live bitmap."""
+    Merges with the placement's existing live bitmap AND with anything
+    this transaction already staged (a multi-statement transaction may
+    delete from the same stripe twice)."""
     live = load_deletes(directory)
-    staged = {}
+    p = _staged_path(directory, xid)
+    if os.path.exists(p):
+        with open(p) as fh:
+            staged = json.load(fh)
+    else:
+        staged = {}
+    base = dict(live)
+    base.update(staged)  # staged bitmaps are supersets of live
     for stripe_file, (idx, n_rows) in per_stripe.items():
-        mask = deleted_mask(directory, stripe_file, n_rows, live)
+        mask = deleted_mask(directory, stripe_file, n_rows, base)
         if mask is None:
             mask = np.zeros(n_rows, bool)
         mask[idx] = True
         staged[stripe_file] = _encode(mask)
-    _store(_staged_path(directory, xid), staged)
+    _store(p, staged)
 
 
 def commit_staged_deletes(directory: str, xid: int) -> None:

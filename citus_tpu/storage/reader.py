@@ -65,9 +65,10 @@ class ShardReader:
     """Reads one shard directory written by ShardWriter."""
 
     def __init__(self, directory: str, schema: Schema):
+        from citus_tpu.storage.overlay import visible_meta
         self.directory = directory
         self.schema = schema
-        self.meta = _load_meta(directory)
+        self.meta = visible_meta(directory)
 
     @property
     def row_count(self) -> int:
@@ -87,11 +88,12 @@ class ShardReader:
         chunks refuted by ``constraints`` (conjunctive semantics) and
         subtracting deletion bitmaps (unless ``apply_deletes=False``,
         used by DML that needs original row positions)."""
-        from citus_tpu.storage.deletes import deleted_mask, load_deletes
+        from citus_tpu.storage.deletes import deleted_mask
+        from citus_tpu.storage.overlay import visible_deletes
         constraints = constraints or []
         for col in columns:
             self.schema.column(col)  # validate projection
-        delete_cache = load_deletes(self.directory) if apply_deletes else {}
+        delete_cache = visible_deletes(self.directory) if apply_deletes else {}
         for stripe in self.meta["stripes"]:
             path = os.path.join(self.directory, stripe["file"])
             footer = read_stripe_footer(path)

@@ -15,5 +15,7 @@ against workers' pg_prepared_xacts.
 
 from citus_tpu.transaction.manager import TransactionLog, TxState
 from citus_tpu.transaction.locks import LockManager, DeadlockDetected, LockTimeout
+from citus_tpu.transaction.session import InFailedTransaction, Session
 
-__all__ = ["TransactionLog", "TxState", "LockManager", "DeadlockDetected", "LockTimeout"]
+__all__ = ["TransactionLog", "TxState", "LockManager", "DeadlockDetected",
+           "LockTimeout", "Session", "InFailedTransaction"]

@@ -77,22 +77,31 @@ def recover_transactions(cat: Catalog, txlog: TransactionLog,
         if state == TxState.COMMITTED:
             for d in placements:
                 if os.path.isdir(d):
-                    if kind in ("delete", "update"):
+                    if kind in ("delete", "update", "txn"):
+                        # "txn" (interactive BEGIN..COMMIT): placements
+                        # carry staged deletion bitmaps; staged stripes
+                        # ride ingest_placements
                         commit_staged_deletes(d, xid)
                     else:
                         commit_staged(d, xid)
             for d in ingest_placements:
                 if os.path.isdir(d):
                     commit_staged(d, xid)
-            table = payload.get("table")
-            if table and cat.has_table(table):
-                cat.table(table).version += 1
+            tables = payload.get("tables") or []
+            if payload.get("table"):
+                tables = tables + [payload["table"]]
+            bumped = False
+            for table in tables:
+                if cat.has_table(table):
+                    cat.table(table).version += 1
+                    bumped = True
+            if bumped:
                 cat.commit()
             rolled_forward += 1
         else:  # PREPARED (coordinator died before commit) or ABORTED
             for d in placements:
                 if os.path.isdir(d):
-                    if kind in ("delete", "update"):
+                    if kind in ("delete", "update", "txn"):
                         abort_staged_deletes(d, xid)
                     else:
                         abort_staged(d, xid)

@@ -1,0 +1,267 @@
+"""Interactive multi-statement transactions: BEGIN/COMMIT/ROLLBACK and
+savepoints over the staged-write 2PC substrate.
+
+Reference: transaction/transaction_management.c:319
+(CoordinatedTransactionCallback — pre-commit PREPARE on every write
+connection, then COMMIT PREPARED) and the subtransaction/savepoint
+callback at :176.  The TPU-native shape: a transaction's writes stage
+per-xid side files (stripes + deletion bitmaps) across placements;
+statements of the same session read them through the thread-local
+overlay (storage/overlay.py); COMMIT is the familiar
+PREPARED -> COMMITTED -> flip -> DONE sequence over *all* placements the
+transaction touched, so the whole interactive transaction commits
+atomically and recovery (transaction/recovery.py) rolls a mid-commit
+kill forward or back exactly like single-statement 2PC.
+
+Savepoints exploit the staged representation directly: because every
+pending effect of the transaction lives in small per-placement side
+files, a savepoint is a snapshot of those side files' contents, and
+ROLLBACK TO restores them (deleting stripe data files staged after the
+snapshot).  PostgreSQL divergence: locks acquired after the savepoint
+are retained until transaction end (conservative; PostgreSQL releases
+them).
+
+Two-phase locking: write locks acquired by statements are retained until
+COMMIT/ROLLBACK (the reference holds row/shard locks to transaction
+end).  Lock identity is the session (not the thread), so concurrent
+sessions in one process contend correctly and the in-process deadlock
+detector sees them as distinct nodes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+from typing import Optional
+
+from citus_tpu.errors import TransactionError
+from citus_tpu.transaction.locks import EXCLUSIVE, SHARED
+
+
+class InFailedTransaction(TransactionError):
+    """A prior statement failed; only ROLLBACK (or ROLLBACK TO a
+    savepoint) is accepted — PostgreSQL's 25P02."""
+
+
+#: session lock ids live far above thread idents so the two id spaces
+#: used with the shared LockManager can never collide
+_session_ids = itertools.count(1 << 48)
+
+
+class _HeldLock:
+    """A retained two-layer lock: LockManager grant + open flock fd."""
+
+    def __init__(self, mode: str, fd: int):
+        self.mode = mode
+        self.fd = fd
+
+
+class OpenTransaction:
+    """State of one BEGIN..COMMIT block."""
+
+    def __init__(self, xid: int, lock_sid: int):
+        self.xid = xid
+        self.lock_sid = lock_sid
+        self.failed = False
+        self.ingest_dirs: set[str] = set()   # staged stripes
+        self.delete_dirs: set[str] = set()   # staged deletion bitmaps
+        self.tables: set[str] = set()        # touched (version bump at commit)
+        self.locks: dict[str, _HeldLock] = {}
+        self.cdc_events: list[tuple] = []    # deferred to commit
+        self.savepoints: list[tuple[str, dict]] = []
+
+    # ---- write registration -------------------------------------------
+    def record_ingest(self, table_name: str, dirs) -> None:
+        self.tables.add(table_name)
+        self.ingest_dirs.update(dirs)
+
+    def record_deletes(self, table_name: str, dirs) -> None:
+        self.tables.add(table_name)
+        self.delete_dirs.update(dirs)
+
+    @property
+    def has_writes(self) -> bool:
+        return bool(self.ingest_dirs or self.delete_dirs)
+
+    # ---- retained locks ------------------------------------------------
+    def hold_group_lock(self, cluster, table_meta, mode: str) -> None:
+        """Acquire (or upgrade) the colocation-group write lock and
+        retain it until transaction end.  Mirrors
+        write_locks.group_write_lock but without the statement-scoped
+        release."""
+        import fcntl
+        from citus_tpu.transaction.write_locks import (
+            group_resource, lockfile_path,
+        )
+
+        res = group_resource(table_meta)
+        held = self.locks.get(res)
+        if held is not None and (held.mode == EXCLUSIVE or held.mode == mode):
+            return
+        timeout = cluster.settings.executor.lock_timeout_s
+        # layer 1: in-process manager (deadlock detection; handles the
+        # SHARED -> EXCLUSIVE upgrade as a re-acquire)
+        cluster.locks.acquire(self.lock_sid, res, mode, timeout=timeout)
+        try:
+            flmode = fcntl.LOCK_SH if mode == SHARED else fcntl.LOCK_EX
+            if held is not None:
+                # upgrade the existing fd in place (atomic wrt other fds)
+                self._flock_with_timeout(held.fd, flmode, timeout)
+                held.mode = mode
+            else:
+                lockfile = lockfile_path(cluster.catalog.data_dir, res)
+                fd = os.open(lockfile, os.O_CREAT | os.O_RDWR)
+                try:
+                    self._flock_with_timeout(fd, flmode, timeout)
+                except BaseException:
+                    os.close(fd)
+                    raise
+                self.locks[res] = _HeldLock(mode, fd)
+        except BaseException:
+            if held is None:
+                cluster.locks.release(self.lock_sid, res)
+            raise
+        # a writer that just waited out a foreign mover must see the
+        # flipped placements (same rule as Cluster._write_lock)
+        cluster._maybe_reload_catalog(force_sync=True)
+
+    @staticmethod
+    def _flock_with_timeout(fd: int, mode, timeout: float) -> None:
+        """utils.filelock.FileLock opens a fresh fd per acquisition, so
+        it cannot express the SHARED -> EXCLUSIVE upgrade-in-place a
+        retained transaction lock needs; this is the same poll loop
+        applied to an existing fd."""
+        import fcntl
+        import time
+
+        from citus_tpu.utils.filelock import LockTimeout
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                fcntl.flock(fd, mode | fcntl.LOCK_NB)
+                return
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise LockTimeout(
+                        "could not acquire transaction write lock "
+                        f"within {timeout}s")
+                time.sleep(0.02)
+
+    def release_locks(self, cluster) -> None:
+        import fcntl
+        for res, held in self.locks.items():
+            try:
+                fcntl.flock(held.fd, fcntl.LOCK_UN)
+                os.close(held.fd)
+            except OSError:
+                pass
+            cluster.locks.release(self.lock_sid, res)
+        self.locks.clear()
+        cluster.locks.release_all(self.lock_sid)
+
+    # ---- savepoints ----------------------------------------------------
+    def snapshot(self) -> dict:
+        """Capture the transaction's staged side-file state (savepoint).
+        Small by construction: side files are metadata, not data."""
+        from citus_tpu.storage.deletes import _staged_path as _del_staged
+        from citus_tpu.storage.writer import _staged_path as _meta_staged
+
+        def read(p):
+            if not os.path.exists(p):
+                return None
+            with open(p) as fh:
+                return fh.read()
+
+        return {
+            "ingest": {d: read(_meta_staged(d, self.xid))
+                       for d in self.ingest_dirs},
+            "deletes": {d: read(_del_staged(d, self.xid))
+                        for d in self.delete_dirs},
+            "ingest_dirs": set(self.ingest_dirs),
+            "delete_dirs": set(self.delete_dirs),
+            "tables": set(self.tables),
+            "n_cdc": len(self.cdc_events),
+        }
+
+    def restore(self, snap: dict) -> None:
+        """ROLLBACK TO SAVEPOINT: put every staged side file back to its
+        snapshot content, deleting stripe files staged since."""
+        from citus_tpu.storage.deletes import _staged_path as _del_staged
+        from citus_tpu.storage.writer import _staged_path as _meta_staged
+
+        for d in self.ingest_dirs:
+            p = _meta_staged(d, self.xid)
+            old_text = snap["ingest"].get(d)
+            old_files = set()
+            if old_text is not None:
+                old_files = {s["file"]
+                             for s in json.loads(old_text)["stripes"]}
+            if os.path.exists(p):
+                with open(p) as fh:
+                    cur = json.load(fh)
+                for s in cur["stripes"]:
+                    if s["file"] not in old_files:
+                        fp = os.path.join(d, s["file"])
+                        if os.path.exists(fp):
+                            os.remove(fp)
+            self._write_or_remove(p, old_text)
+        for d in self.delete_dirs:
+            self._write_or_remove(_del_staged(d, self.xid),
+                                  snap["deletes"].get(d))
+        self.ingest_dirs = set(snap["ingest_dirs"])
+        self.delete_dirs = set(snap["delete_dirs"])
+        self.tables = set(snap["tables"])
+        del self.cdc_events[snap["n_cdc"]:]
+        self.failed = False  # PostgreSQL: clears the aborted state
+
+    @staticmethod
+    def _write_or_remove(path: str, text: Optional[str]) -> None:
+        if text is None:
+            if os.path.exists(path):
+                os.remove(path)
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+
+class Session:
+    """One interactive connection to the cluster (the psql-session
+    analog).  Outside a BEGIN block every statement autocommits exactly
+    as before; inside one, writes stage under the session's xid and
+    COMMIT/ROLLBACK decide them atomically."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self.lock_sid = next(_session_ids)
+        self.txn: Optional[OpenTransaction] = None
+
+    # -- public surface --------------------------------------------------
+    def execute(self, sql: str, params=None, role=None):
+        return self._cluster.execute(sql, params=params, role=role,
+                                     session=self)
+
+    def copy_from(self, table_name: str, **kw):
+        return self._cluster.copy_from(table_name, session=self, **kw)
+
+    @property
+    def in_transaction(self) -> bool:
+        return self.txn is not None
+
+    def close(self) -> None:
+        """Abandoning an open transaction rolls it back (connection
+        close semantics)."""
+        if self.txn is not None:
+            self._cluster._rollback_txn(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

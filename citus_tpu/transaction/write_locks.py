@@ -34,6 +34,15 @@ def group_resource(table_meta) -> str:
     return f"table:{table_meta.name}"
 
 
+def lockfile_path(data_dir: str, res: str) -> str:
+    """Flock file for a write-group resource.  Single source of truth:
+    statement writers (here), transactional writers (session.py), and
+    shard movers must all compute byte-identical paths or they stop
+    excluding each other."""
+    import os
+    return os.path.join(data_dir, ".wl_" + res.replace(":", "_") + ".lock")
+
+
 @contextlib.contextmanager
 def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
                      timeout: float = 30.0):
@@ -50,8 +59,7 @@ def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
             return
         lock_manager.acquire(sid, res, mode, timeout=timeout)
     try:
-        lockfile = os.path.join(cat.data_dir,
-                                ".wl_" + res.replace(":", "_") + ".lock")
+        lockfile = lockfile_path(cat.data_dir, res)
         with FileLock(lockfile, shared=(mode == SHARED), timeout=timeout):
             yield
     finally:

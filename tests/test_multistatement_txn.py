@@ -1,0 +1,446 @@
+"""Multi-statement interactive transactions: BEGIN/COMMIT/ROLLBACK,
+savepoints, two-phase locking, and mid-commit crash recovery.
+
+Reference: transaction/transaction_management.c:319
+(CoordinatedTransactionCallback — pre-commit PREPARE on all write
+connections), the subxact/savepoint callback at :176, and
+transaction_recovery.c (RecoverTwoPhaseCommits).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import TransactionError, UnsupportedFeatureError
+from citus_tpu.transaction.session import InFailedTransaction
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"))
+    c.execute("CREATE TABLE accounts (aid bigint NOT NULL, balance bigint)")
+    c.execute("CREATE TABLE audit (eid bigint NOT NULL, note text)")
+    c.execute("SELECT create_distributed_table('accounts','aid',4)")
+    c.execute("SELECT create_distributed_table('audit','eid',4)")
+    c.execute("INSERT INTO accounts VALUES (1, 100), (2, 200)")
+    return c
+
+
+# ------------------------------------------------------------ basics
+
+
+def test_read_your_writes_and_isolation(cl):
+    s1, s2 = cl.session(), cl.session()
+    s1.execute("BEGIN")
+    s1.execute("INSERT INTO accounts VALUES (3, 300)")
+    s1.execute("UPDATE accounts SET balance = 150 WHERE aid = 1")
+    # s1 sees its own staged writes across statements
+    assert sorted(s1.execute(
+        "SELECT aid, balance FROM accounts ORDER BY aid").rows) == \
+        [(1, 150), (2, 200), (3, 300)]
+    # s2 sees none of it until COMMIT
+    assert sorted(s2.execute(
+        "SELECT aid, balance FROM accounts ORDER BY aid").rows) == \
+        [(1, 100), (2, 200)]
+    s1.execute("COMMIT")
+    assert sorted(s2.execute(
+        "SELECT aid, balance FROM accounts ORDER BY aid").rows) == \
+        [(1, 150), (2, 200), (3, 300)]
+
+
+def test_atomic_multi_table_commit(cl):
+    s1, s2 = cl.session(), cl.session()
+    s1.execute("BEGIN")
+    s1.execute("UPDATE accounts SET balance = 50 WHERE aid = 1")
+    s1.execute("INSERT INTO audit VALUES (1, 'debit')")
+    assert s2.execute("SELECT count(*) FROM audit").rows == [(0,)]
+    s1.execute("COMMIT")
+    # both effects landed atomically
+    assert s2.execute(
+        "SELECT balance FROM accounts WHERE aid = 1").rows == [(50,)]
+    assert s2.execute("SELECT note FROM audit").rows == [("debit",)]
+
+
+def test_rollback_restores_preimage(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("DELETE FROM accounts WHERE aid = 2")
+    s.execute("UPDATE accounts SET balance = 0 WHERE aid = 1")
+    s.execute("INSERT INTO accounts VALUES (9, 900)")
+    assert sorted(s.execute("SELECT aid FROM accounts").rows) == [(1,), (9,)]
+    s.execute("ROLLBACK")
+    assert sorted(cl.execute(
+        "SELECT aid, balance FROM accounts ORDER BY aid").rows) == \
+        [(1, 100), (2, 200)]
+
+
+def test_delete_of_rows_inserted_in_same_txn(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO accounts VALUES (5, 500), (6, 600)")
+    s.execute("DELETE FROM accounts WHERE aid = 5")
+    assert sorted(s.execute("SELECT aid FROM accounts ORDER BY aid").rows) \
+        == [(1,), (2,), (6,)]
+    s.execute("COMMIT")
+    assert sorted(cl.execute("SELECT aid FROM accounts ORDER BY aid").rows) \
+        == [(1,), (2,), (6,)]
+
+
+def test_two_deletes_same_stripe_accumulate(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("DELETE FROM accounts WHERE aid = 1")
+    s.execute("DELETE FROM accounts WHERE aid = 2")
+    assert s.execute("SELECT count(*) FROM accounts").rows == [(0,)]
+    s.execute("COMMIT")
+    assert cl.execute("SELECT count(*) FROM accounts").rows == [(0,)]
+
+
+def test_aggregate_sees_staged_writes(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO accounts VALUES (3, 300)")
+    assert s.execute("SELECT sum(balance) FROM accounts").rows == [(600,)]
+    s.execute("ROLLBACK")
+    assert cl.execute("SELECT sum(balance) FROM accounts").rows == [(300,)]
+
+
+# ------------------------------------------------------------ statements
+
+
+def test_begin_twice_warns_commit_without_txn_warns(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    r = s.execute("BEGIN")
+    assert "already a transaction" in r.explain.get("warning", "")
+    s.execute("ROLLBACK")
+    r = s.execute("COMMIT")
+    assert "no transaction" in r.explain.get("warning", "")
+
+
+def test_error_aborts_block_until_rollback(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO accounts VALUES (4, 400)")
+    with pytest.raises(Exception):
+        s.execute("SELECT no_such_column FROM accounts")
+    with pytest.raises(InFailedTransaction):
+        s.execute("SELECT 1")
+    # COMMIT of an aborted transaction rolls back
+    r = s.execute("COMMIT")
+    assert r.explain.get("transaction") == "rollback"
+    assert sorted(cl.execute("SELECT aid FROM accounts").rows) == [(1,), (2,)]
+
+
+def test_ddl_refused_in_transaction(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    with pytest.raises(UnsupportedFeatureError):
+        s.execute("CREATE TABLE x (a bigint)")
+    s.execute("ROLLBACK")
+
+
+def test_spellings(cl):
+    s = cl.session()
+    s.execute("START TRANSACTION")
+    s.execute("INSERT INTO accounts VALUES (7, 700)")
+    s.execute("END")  # = COMMIT
+    assert (7,) in cl.execute("SELECT aid FROM accounts").rows
+    s.execute("BEGIN WORK")
+    s.execute("INSERT INTO accounts VALUES (8, 800)")
+    s.execute("ABORT")  # = ROLLBACK
+    assert (8,) not in cl.execute("SELECT aid FROM accounts").rows
+
+
+# ------------------------------------------------------------ savepoints
+
+
+def test_savepoint_rollback_to_and_release(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO accounts VALUES (10, 1)")
+    s.execute("SAVEPOINT a")
+    s.execute("INSERT INTO accounts VALUES (11, 1)")
+    s.execute("SAVEPOINT b")
+    s.execute("DELETE FROM accounts WHERE aid = 1")
+    s.execute("ROLLBACK TO SAVEPOINT b")   # undoes the delete
+    assert (1,) in s.execute("SELECT aid FROM accounts").rows
+    s.execute("ROLLBACK TO a")             # undoes insert of 11, b is gone
+    s.execute("RELEASE SAVEPOINT a")
+    s.execute("COMMIT")
+    rows = sorted(cl.execute("SELECT aid FROM accounts").rows)
+    assert (10,) in rows and (11,) not in rows and (1,) in rows
+
+
+def test_unknown_savepoint_aborts_block(cl):
+    """PostgreSQL: an error inside a transaction block — including a
+    bad ROLLBACK TO — puts it in the aborted state (25P02)."""
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO accounts VALUES (14, 1)")
+    with pytest.raises(TransactionError):
+        s.execute("ROLLBACK TO nosuch")
+    with pytest.raises(InFailedTransaction):
+        s.execute("SELECT 1")
+    s.execute("ROLLBACK")
+    assert (14,) not in cl.execute("SELECT aid FROM accounts").rows
+
+
+def test_ddl_refusal_aborts_block(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO accounts VALUES (15, 1)")
+    with pytest.raises(UnsupportedFeatureError):
+        s.execute("CREATE TABLE x (a bigint)")
+    # the refusal aborted the block: COMMIT rolls back
+    r = s.execute("COMMIT")
+    assert r.explain.get("transaction") == "rollback"
+    assert (15,) not in cl.execute("SELECT aid FROM accounts").rows
+
+
+def test_copy_from_joins_default_session_txn(cl):
+    cl.execute("BEGIN")
+    cl.copy_from("accounts", rows=[(20, 2000)])
+    assert (20,) in cl.execute("SELECT aid FROM accounts").rows
+    cl.execute("ROLLBACK")
+    assert (20,) not in cl.execute("SELECT aid FROM accounts").rows
+
+
+def test_join_sees_staged_rows_in_empty_table(cl):
+    """Joins must see staged inserts into a previously-empty table
+    (the empty-shard skip consults the overlay)."""
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO audit VALUES (1, 'x'), (2, 'y')")
+    rows = s.execute(
+        "SELECT a.aid, b.note FROM accounts a JOIN audit b "
+        "ON a.aid = b.eid ORDER BY a.aid").rows
+    assert rows == [(1, "x"), (2, "y")]
+    s.execute("ROLLBACK")
+
+
+def test_savepoint_clears_aborted_state(cl):
+    """PostgreSQL: ROLLBACK TO a savepoint set before the failure
+    resumes the transaction."""
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO accounts VALUES (12, 1)")
+    s.execute("SAVEPOINT sp")
+    with pytest.raises(Exception):
+        s.execute("SELECT broken FROM accounts")
+    with pytest.raises(InFailedTransaction):
+        s.execute("SELECT 1")
+    s.execute("ROLLBACK TO sp")
+    s.execute("INSERT INTO accounts VALUES (13, 1)")
+    s.execute("COMMIT")
+    rows = sorted(cl.execute("SELECT aid FROM accounts").rows)
+    assert (12,) in rows and (13,) in rows
+
+
+def test_savepoint_outside_txn_errors(cl):
+    with pytest.raises(TransactionError):
+        cl.session().execute("SAVEPOINT sp")
+
+
+# ------------------------------------------------------------ locking
+
+
+def test_conflicting_write_blocks_until_commit(cl):
+    """Two-phase locking: a concurrent session's conflicting UPDATE
+    waits for the open transaction's COMMIT (the reference holds shard
+    write locks to transaction end)."""
+    s1 = cl.session()
+    s1.execute("BEGIN")
+    s1.execute("UPDATE accounts SET balance = 111 WHERE aid = 1")
+
+    done = threading.Event()
+    errors = []
+
+    def blocked_writer():
+        try:
+            cl.session().execute(
+                "UPDATE accounts SET balance = 222 WHERE aid = 1")
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+        finally:
+            done.set()
+
+    th = threading.Thread(target=blocked_writer, daemon=True)
+    th.start()
+    assert not done.wait(0.5), "writer should block on the open txn's lock"
+    s1.execute("COMMIT")
+    assert done.wait(10), "writer should proceed after COMMIT"
+    th.join()
+    assert not errors
+    # the blocked writer ran after the commit: last write wins
+    assert cl.execute(
+        "SELECT balance FROM accounts WHERE aid = 1").rows == [(222,)]
+
+
+def test_deadlock_between_sessions_detected(tmp_path):
+    """Opposite-order lock acquisition across two open transactions:
+    the in-process wait-graph detector cancels one (youngest-victim
+    policy, distributed_deadlock_detection.c:105).  The two tables use
+    different shard counts so they land in different colocation groups
+    (= different lock resources)."""
+    from citus_tpu.transaction import DeadlockDetected
+
+    c = ct.Cluster(str(tmp_path / "dl"))
+    c.execute("CREATE TABLE t1 (k bigint NOT NULL)")
+    c.execute("CREATE TABLE t2 (k bigint NOT NULL)")
+    c.execute("SELECT create_distributed_table('t1','k',4)")
+    c.execute("SELECT create_distributed_table('t2','k',8)")
+
+    b = threading.Barrier(2, timeout=10)
+    outcomes = {}
+
+    def run(name, first, second):
+        s = c.session()
+        try:
+            s.execute("BEGIN")
+            s.execute(f"DELETE FROM {first} WHERE k = -1")  # EXCLUSIVE
+            b.wait()
+            s.execute(f"DELETE FROM {second} WHERE k = -2")
+            s.execute("COMMIT")
+            outcomes[name] = "committed"
+        except DeadlockDetected:
+            outcomes[name] = "deadlock"
+            s.execute("ROLLBACK")
+        except Exception as e:  # pragma: no cover
+            outcomes[name] = f"error:{type(e).__name__}"
+
+    t1 = threading.Thread(target=run, args=("a", "t1", "t2"), daemon=True)
+    t2 = threading.Thread(target=run, args=("b", "t2", "t1"), daemon=True)
+    t1.start(), t2.start()
+    t1.join(60), t2.join(60)
+    assert sorted(outcomes.values()) == ["committed", "deadlock"], outcomes
+
+
+# ------------------------------------------------------------ CDC
+
+
+def test_cdc_events_deferred_to_commit(tmp_path):
+    from citus_tpu.config import Settings
+    st = Settings()
+    st.enable_change_data_capture = True
+    c = ct.Cluster(str(tmp_path / "cdcdb"), settings=st)
+    c.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    c.execute("SELECT create_distributed_table('t','k',2)")
+    s = c.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO t VALUES (1, 10)")
+    s.execute("UPDATE t SET v = 11 WHERE k = 1")
+    assert list(c.cdc.events("t")) == []  # nothing until commit
+    s.execute("COMMIT")
+    ops = [e["op"] for e in c.cdc.events("t")]
+    assert ops == ["insert", "update"]
+    # rolled-back events never surface
+    s.execute("BEGIN")
+    s.execute("INSERT INTO t VALUES (2, 20)")
+    s.execute("ROLLBACK")
+    assert [e["op"] for e in c.cdc.events("t")] == ["insert", "update"]
+
+
+# ------------------------------------------------------------ recovery
+
+
+_KILL_SCRIPT = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import citus_tpu as ct
+from citus_tpu.storage import writer as W
+
+data_dir, mode = sys.argv[1], sys.argv[2]
+cl = ct.Cluster(data_dir, n_nodes=2)
+s = cl.session()
+s.execute("BEGIN")
+s.execute("INSERT INTO accounts VALUES (50, 5000)")
+s.execute("UPDATE accounts SET balance = 101 WHERE aid = 1")
+
+if mode == "after_committed":
+    # die after the COMMITTED record, before any staged state flips
+    orig = W.commit_staged
+    def boom(directory, xid):
+        os._exit(9)
+    W.commit_staged = boom
+    from citus_tpu.storage import deletes as D
+    D.commit_staged_deletes = boom
+    try:
+        s.execute("COMMIT")
+    except SystemExit:
+        raise
+elif mode == "before_committed":
+    # die after PREPARED, before COMMITTED
+    from citus_tpu.transaction.manager import TransactionLog, TxState
+    orig_log = cl.txlog.log
+    def log(xid, state, payload=None):
+        orig_log(xid, state, payload)
+        if state == TxState.PREPARED:
+            os._exit(9)
+    cl.txlog.log = log
+    s.execute("COMMIT")
+"""
+
+
+def _run_kill(cl, tmp_path, mode):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run([sys.executable, "-c", _KILL_SCRIPT,
+                        cl.catalog.data_dir, mode],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 9, (p.returncode, p.stderr[-2000:])
+
+
+def test_recovery_rolls_forward_after_committed_record(cl, tmp_path):
+    """Killed between the COMMITTED record and the flip: recovery rolls
+    the whole interactive transaction forward."""
+    _run_kill(cl, tmp_path, "after_committed")
+    from citus_tpu.transaction.recovery import recover_transactions
+    st = recover_transactions(cl.catalog, cl.txlog)
+    assert st["rolled_forward"] >= 1
+    cl._reload_catalog()
+    rows = dict(cl.execute(
+        "SELECT aid, balance FROM accounts ORDER BY aid").rows)
+    assert rows[50] == 5000 and rows[1] == 101
+
+
+def test_recovery_rolls_back_prepared_without_committed(cl, tmp_path):
+    """Killed between PREPARED and COMMITTED: recovery rolls back and
+    the pre-image survives."""
+    _run_kill(cl, tmp_path, "before_committed")
+    from citus_tpu.transaction.recovery import recover_transactions
+    st = recover_transactions(cl.catalog, cl.txlog)
+    assert st["rolled_back"] >= 1
+    cl._reload_catalog()
+    rows = dict(cl.execute(
+        "SELECT aid, balance FROM accounts ORDER BY aid").rows)
+    assert 50 not in rows and rows[1] == 100
+
+
+def test_abandoned_session_rolls_back_on_close(tmp_path):
+    c = ct.Cluster(str(tmp_path / "ab"))
+    c.execute("CREATE TABLE t (k bigint NOT NULL)")
+    c.execute("SELECT create_distributed_table('t','k',2)")
+    with c.session() as s:
+        s.execute("BEGIN")
+        s.execute("INSERT INTO t VALUES (1)")
+    # context exit closed the session -> rollback
+    assert c.execute("SELECT count(*) FROM t").rows == [(0,)]
+
+
+def test_upsert_inside_transaction(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO accounts VALUES (1, 999) "
+              "ON CONFLICT (aid) DO UPDATE SET balance = 999")
+    assert s.execute(
+        "SELECT balance FROM accounts WHERE aid = 1").rows == [(999,)]
+    s.execute("ROLLBACK")
+    assert cl.execute(
+        "SELECT balance FROM accounts WHERE aid = 1").rows == [(100,)]
