@@ -1,0 +1,6 @@
+#!/bin/sh
+# appends one line per probe attempt to TUNNEL_PROBES.log
+TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+OUT=$(timeout 90 python -c "import jax; d=jax.devices(); print('DEVICES', len(d), d[0].platform)" 2>&1 | tail -1)
+RC=$?
+echo "$TS rc=$RC $OUT" >> /root/repo/TUNNEL_PROBES.log

@@ -1,14 +1,24 @@
 #!/usr/bin/env python
-"""Benchmark: TPC-H Q1 rows scanned/sec/chip on columnar lineitem.
+"""Benchmark: TPC-H Q1 + Q6 + repartition join on columnar lineitem.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+The headline metric stays Q1 rows scanned/sec/chip; "extra" carries Q6
+(BASELINE config 1) and the repartition-join rate (config 5, exercising
+parallel/shuffle.py's build_repartition_join).
 
 Baseline (BASELINE.md): the reference's columnar scan + GROUP BY SUM runs
 75 M rows in 16 s on its microbench box = 4.6875 M rows/s.  vs_baseline
-is our warm Q1 rows/s divided by that.
+is our warm Q1 rows/s divided by that.  The join compares against the
+reference's ~10 M rows/s repartition INSERT..SELECT throughput
+(distributed/README.md:1761).
 
 Data persists in .bench_data/ across runs (ingest is skipped when the
 table already exists at the right scale).
+
+BENCH_SWEEP=1 additionally measures Q1 at 2x and 4x the configured row
+count (the throughput-vs-size curve past the HBM batch cache; the
+streaming pipeline should degrade smoothly, not collapse) and reports it
+under "sweep".
 """
 
 import json
@@ -46,11 +56,52 @@ FROM lineitem WHERE l_shipdate <= date '1998-12-01' - interval '90' day
 GROUP BY l_returnflag, l_linestatus
 ORDER BY l_returnflag, l_linestatus"""
 
+Q6 = """SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= date '1994-01-01'
+  AND l_shipdate < date '1995-01-01'
+  AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24"""
 
-def ensure_data(cl: "ct.Cluster") -> None:
+# config 5: equi-join on the NON-distribution key of the probe side —
+# forces the repartition (all_to_all) path; orders_b is distributed on
+# o_custkey, lineitem on l_orderkey
+QJOIN = """SELECT count(*), sum(l.l_quantity)
+FROM lineitem l JOIN orders_b o ON l.l_orderkey = o.o_orderkey
+WHERE o.o_flag = 'H'"""
+
+#: reference repartition INSERT..SELECT throughput (README:1761)
+JOIN_BASELINE_ROWS_PER_SEC = 10_000_000.0
+
+
+def ensure_join_data(cl: "ct.Cluster", n_orders: int) -> None:
+    """orders_b: the build side of the repartition join, distributed on
+    o_custkey so the l_orderkey = o_orderkey join must reshuffle."""
+    if cl.catalog.has_table("orders_b"):
+        from citus_tpu.catalog.stats import table_row_count
+        if table_row_count(cl.catalog, cl.catalog.table("orders_b")) == n_orders:
+            return
+        cl.drop_table("orders_b")
+    cl.execute("""CREATE TABLE orders_b (
+        o_orderkey bigint NOT NULL, o_custkey bigint NOT NULL,
+        o_flag text)""")
+    cl.execute(f"SELECT create_distributed_table('orders_b', 'o_custkey', {SHARDS})")
+    rng = np.random.default_rng(11)
+    flags = np.array(["H", "L", "M"])
+    chunk = 1_000_000
+    for start in range(0, n_orders, chunk):
+        n = min(chunk, n_orders - start)
+        cl.copy_from("orders_b", columns={
+            "o_orderkey": np.arange(start, start + n, dtype=np.int64),
+            "o_custkey": rng.integers(0, n_orders // 8 + 1, n),
+            "o_flag": flags[rng.integers(0, 3, n)].tolist(),
+        })
+
+
+def ensure_data(cl: "ct.Cluster", n_rows: int = None) -> None:
+    n_rows = N_ROWS if n_rows is None else n_rows
     if cl.catalog.has_table("lineitem"):
         from citus_tpu.catalog.stats import table_row_count
-        if table_row_count(cl.catalog, cl.catalog.table("lineitem")) == N_ROWS:
+        if table_row_count(cl.catalog, cl.catalog.table("lineitem")) == n_rows:
             return
         cl.drop_table("lineitem")
     cl.execute("""CREATE TABLE lineitem (
@@ -63,10 +114,10 @@ def ensure_data(cl: "ct.Cluster") -> None:
     chunk = 1_000_000
     rf = np.array(["A", "N", "R"])
     ls = np.array(["F", "O"])
-    for start in range(0, N_ROWS, chunk):
-        n = min(chunk, N_ROWS - start)
+    for start in range(0, n_rows, chunk):
+        n = min(chunk, n_rows - start)
         cl.copy_from("lineitem", columns={
-            "l_orderkey": rng.integers(0, N_ROWS // 4, n),
+            "l_orderkey": rng.integers(0, n_rows // 4, n),
             "l_quantity": (rng.integers(100, 5100, n) / 100.0),
             "l_extendedprice": (rng.integers(90_000, 10_500_000, n) / 100.0),
             "l_discount": (rng.integers(0, 11, n) / 100.0),
@@ -95,7 +146,10 @@ def _emit_last_good_or_die(note: str) -> None:
                      "measuring on the cpu backend as a labeled lower "
                      "bound\n")
     sys.stderr.flush()
-    env = dict(os.environ, BENCH_PLATFORM="cpu")
+    # the fallback child is a Q1 lower bound only: the join ingest or a
+    # size sweep could blow the timeout that the plain run fits in
+    env = dict(os.environ, BENCH_PLATFORM="cpu", BENCH_JOIN="0",
+               BENCH_SWEEP="0")
     try:
         out = subprocess.run([sys.executable, os.path.abspath(__file__)],
                              capture_output=True, text=True, env=env,
@@ -172,19 +226,46 @@ def main() -> None:
     cl = ct.Cluster(data_dir)
     ensure_data(cl)
 
-    cl.execute(Q1)  # warm: compile + populate HBM cache
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        cl.execute(Q1)
-        times.append(time.perf_counter() - t0)
-    best = min(times)
+    def timed(sql, warm=1, reps=3):
+        for _ in range(warm):
+            cl.execute(sql)
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            cl.execute(sql)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    best = timed(Q1)
     rows_per_sec = N_ROWS / best
+    q6_rate = N_ROWS / timed(Q6)
+    extra = {
+        "q6_rows_per_sec": round(q6_rate, 1),
+        "q6_vs_baseline": round(q6_rate / BASELINE_ROWS_PER_SEC, 3),
+    }
+    if os.environ.get("BENCH_JOIN", "1") != "0":
+        n_orders = N_ROWS // 4
+        ensure_join_data(cl, n_orders)
+        join_rate = (N_ROWS + n_orders) / timed(QJOIN, reps=2)
+        extra["repartition_join_rows_per_sec"] = round(join_rate, 1)
+        extra["join_vs_repartition_baseline"] = round(
+            join_rate / JOIN_BASELINE_ROWS_PER_SEC, 3)
+    if os.environ.get("BENCH_SWEEP") == "1":
+        # throughput-vs-size curve past the HBM batch cache: the
+        # streaming pipeline should degrade smoothly, not collapse
+        sweep = {str(N_ROWS): round(rows_per_sec, 1)}
+        for mult in (2, 4):
+            n_sweep = N_ROWS * mult
+            ensure_data(cl, n_sweep)
+            sweep[str(n_sweep)] = round(n_sweep / timed(Q1), 1)
+        ensure_data(cl, N_ROWS)  # restore the standard scale
+        extra["sweep_rows_per_sec_by_table_rows"] = sweep
     rec = {
         "metric": "tpch_q1_rows_scanned_per_sec_per_chip",
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+        "extra": extra,
     }
     # persist last-good only for real-device runs: a CPU smoke run must
     # never become the stale fallback for a TPU bench

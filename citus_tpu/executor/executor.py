@@ -156,36 +156,29 @@ def _empty_partials(plan: PhysicalPlan, xp):
     return tuple(outs)
 
 
-def _device_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
-    """Load batches and pin them in the HBM cache (single-device path)."""
-    import jax
-    from citus_tpu.executor.device_cache import GLOBAL_CACHE, plan_cache_key
-    from citus_tpu.storage.overlay import current_overlay
+#: streaming mode keeps at most this many batches in flight on the
+#: device ahead of the kernel consuming them (double buffering: the host
+#: decompresses + transfers batch i+1..i+2 while batch i computes)
+PREFETCH_DEPTH = 2
 
-    # an open transaction's staged writes change what a scan sees
-    # without bumping table.version — bypass the HBM cache for tables
-    # the transaction touched (other tables still hit it)
-    txn = current_overlay()
-    overlaid = txn is not None and plan.bound.table.name in txn.tables
-    key = plan_cache_key(plan, cat.data_dir)
-    if not overlaid:
-        cached = GLOBAL_CACHE.get(key)
-        if cached is not None:
-            return cached
-    batches = _load_all_batches(cat, plan, settings)
-    dev_batches = []
-    nbytes = 0
-    for b in batches:
-        cols = tuple(jax.device_put(c) for c in b.cols)
-        valids = tuple(jax.device_put(v) for v in b.valids)
-        row_mask = jax.device_put(b.row_mask)
-        nbytes += sum(c.nbytes for c in b.cols) + sum(v.nbytes for v in b.valids) + b.row_mask.nbytes
-        dev_batches.append(ShardBatch(cols, valids, row_mask, b.n_rows,
-                                      b.padded_rows, b.shard_index))
-    jax.block_until_ready([b.cols for b in dev_batches])
-    if not overlaid:
-        GLOBAL_CACHE.put(key, dev_batches, nbytes)
-    return dev_batches
+
+def _iter_padded_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
+    """Lazily yield host ShardBatches, each padded to its own
+    power-of-two bucket.  Unlike _load_all_batches, nothing is
+    materialized up front — the streaming scan path's host half
+    (reference analog: ColumnarReadNextRow never materializes a stripe,
+    columnar_reader.c:323).  Full batches share one shape; only tail
+    batches differ, so the per-shape jit cache stays small."""
+    from citus_tpu.testing.faults import FAULTS
+    for si in plan.shard_indexes:
+        FAULTS.hit("dispatch_task", f"{plan.bound.table.name}:{si}")
+        GLOBAL_COUNTERS.bump("tasks_dispatched")
+        for values, masks, n in load_shard_batches(
+                cat, plan, si,
+                min_batch_rows=settings.executor.min_batch_rows):
+            bucket = bucket_rows(n, settings.executor.min_batch_rows)
+            yield pad_to_batch(plan.bound.table, plan, values, masks, n,
+                               bucket, si)
 
 
 def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
@@ -196,71 +189,129 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
 
     pcols, pvalids = params
     devices = jax.devices()
-    if len(devices) > 1:
-        batches = _load_all_batches(cat, plan, settings)
-    else:
-        batches = _device_batches(cat, plan, settings)
-    if not batches:
-        return combine_partials_host(plan, [_empty_partials(plan, np)])
     kinds = _combine_kinds(plan)
-    acc: list = []
-    if len(devices) > 1 and len(batches) > 1:
-        mesh = default_mesh()
-        n_dev = shard_axis_size(mesh)
-        run = plan.runtime_cache.get("mesh_run")
-        if run is None:
-            worker = build_worker_fn(plan, jnp)
-            run = sharded_partial_agg(worker, kinds, mesh)
-            plan.runtime_cache["mesh_run"] = run
-        bucket = batches[0].padded_rows
-        # parameters replicate across the shard axis ([n_dev] stacks of
-        # the 0-d values)
-        p_stack = tuple(np.stack([p] * n_dev) for p in pcols)
-        pv_stack = tuple(np.stack([v] * n_dev) for v in pvalids)
-        for start in range(0, len(batches), n_dev):
-            round_batches = batches[start:start + n_dev]
-            while len(round_batches) < n_dev:
-                round_batches.append(empty_batch(plan.bound.table, plan, bucket, -1))
-            cols = tuple(np.stack([b.cols[i] for b in round_batches])
-                         for i in range(len(plan.scan_columns))) + p_stack
-            valids = tuple(np.stack([b.valids[i] for b in round_batches])
-                           for i in range(len(plan.scan_columns))) + pv_stack
-            row_mask = np.stack([b.row_mask for b in round_batches])
-            out = run(cols, valids, row_mask)
-            acc.append(tuple(np.asarray(o) for o in out))
-    else:
-        task_times = []
-        jitted = plan.runtime_cache.get("jit_worker")
-        if jitted is None:
-            jitted = jax.jit(build_worker_fn(plan, jnp))
-            plan.runtime_cache["jit_worker"] = jitted
-        merge = plan.runtime_cache.get("jit_merge")
-        if merge is None:
-            def _merge(a, b):
-                out = []
-                for x, y, kind in zip(a, b, kinds):
-                    if kind == "sum":
-                        out.append(x + y)
-                    elif kind == "min":
-                        out.append(jnp.minimum(x, y))
-                    else:
-                        out.append(jnp.maximum(x, y))
-                return tuple(out)
-            merge = jax.jit(_merge)
-            plan.runtime_cache["jit_merge"] = merge
-        # accumulate on device; a single device_get at the end avoids one
-        # host round-trip per batch (the tunnel/PCIe latency dominates
-        # otherwise — same reason the reference streams per-task results
-        # instead of row-at-a-time fetches)
-        acc_dev = None
-        for b in batches:
+
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE, plan_cache_key
+    from citus_tpu.storage.overlay import current_overlay
+
+    # an open transaction's staged writes change what a scan sees
+    # without bumping table.version — bypass the HBM cache for tables
+    # the transaction touched (other tables still hit it)
+    txn = current_overlay()
+    overlaid = txn is not None and plan.bound.table.name in txn.tables
+    key = plan_cache_key(plan, cat.data_dir)
+    cached = None if overlaid else GLOBAL_CACHE.get(key)
+
+    host_iter = None
+    if cached is None and len(devices) > 1:
+        batches = _load_all_batches(cat, plan, settings)
+        if not batches:
+            return combine_partials_host(plan, [_empty_partials(plan, np)])
+        if len(batches) > 1:
+            acc: list = []
+            mesh = default_mesh()
+            n_dev = shard_axis_size(mesh)
+            run = plan.runtime_cache.get("mesh_run")
+            if run is None:
+                worker = build_worker_fn(plan, jnp)
+                run = sharded_partial_agg(worker, kinds, mesh)
+                plan.runtime_cache["mesh_run"] = run
+            bucket = batches[0].padded_rows
+            # parameters replicate across the shard axis ([n_dev] stacks
+            # of the 0-d values)
+            p_stack = tuple(np.stack([p] * n_dev) for p in pcols)
+            pv_stack = tuple(np.stack([v] * n_dev) for v in pvalids)
+            for start in range(0, len(batches), n_dev):
+                round_batches = batches[start:start + n_dev]
+                while len(round_batches) < n_dev:
+                    round_batches.append(empty_batch(plan.bound.table, plan, bucket, -1))
+                cols = tuple(np.stack([b.cols[i] for b in round_batches])
+                             for i in range(len(plan.scan_columns))) + p_stack
+                valids = tuple(np.stack([b.valids[i] for b in round_batches])
+                               for i in range(len(plan.scan_columns))) + pv_stack
+                row_mask = np.stack([b.row_mask for b in round_batches])
+                out = run(cols, valids, row_mask)
+                acc.append(tuple(np.asarray(o) for o in out))
+            return combine_partials_host(plan, acc)
+        host_iter = iter(batches)  # 1 batch: run it on the default device
+
+    # ---- single-device path: streaming pipeline + HBM pinning --------
+    from collections import deque
+
+    task_times: list = []
+    jitted = plan.runtime_cache.get("jit_worker")
+    if jitted is None:
+        jitted = jax.jit(build_worker_fn(plan, jnp))
+        plan.runtime_cache["jit_worker"] = jitted
+    merge = plan.runtime_cache.get("jit_merge")
+    if merge is None:
+        def _merge(a, b):
+            out = []
+            for x, y, kind in zip(a, b, kinds):
+                if kind == "sum":
+                    out.append(x + y)
+                elif kind == "min":
+                    out.append(jnp.minimum(x, y))
+                else:
+                    out.append(jnp.maximum(x, y))
+            return tuple(out)
+        merge = jax.jit(_merge)
+        plan.runtime_cache["jit_merge"] = merge
+
+    # accumulate on device; a single device_get at the end avoids one
+    # host round-trip per batch (the tunnel/PCIe latency dominates
+    # otherwise — same reason the reference streams per-task results
+    # instead of row-at-a-time fetches)
+    acc_dev = None
+    if cached is not None:
+        for b in cached:
             t0 = time.perf_counter()
             out = jitted(b.cols + pcols, b.valids + pvalids, b.row_mask)
             acc_dev = out if acc_dev is None else merge(acc_dev, out)
-            task_times.append((b.shard_index, b.n_rows, time.perf_counter() - t0))
-        plan.runtime_cache["task_times"] = task_times
-        return tuple(np.asarray(o) for o in jax.device_get(acc_dev))
-    return combine_partials_host(plan, acc)
+            task_times.append((b.shard_index, b.n_rows,
+                               time.perf_counter() - t0))
+    else:
+        # stream: decompress batch i+1 on the host and transfer it while
+        # batch i computes (XLA's async dispatch overlaps the copy and
+        # compute streams); collect device references opportunistically
+        # and pin them only if the whole working set fits the cache —
+        # past capacity, throughput degrades to the pipeline rate
+        # instead of collapsing (SURVEY §2.4 "Pipelined ingest")
+        collect: Optional[list] = None if overlaid else []
+        nbytes = 0
+        inflight: deque = deque()
+        if host_iter is None:
+            host_iter = _iter_padded_batches(cat, plan, settings)
+        for hb in host_iter:
+            db = ShardBatch(tuple(jax.device_put(c) for c in hb.cols),
+                            tuple(jax.device_put(v) for v in hb.valids),
+                            jax.device_put(hb.row_mask), hb.n_rows,
+                            hb.padded_rows, hb.shard_index)
+            t0 = time.perf_counter()
+            out = jitted(db.cols + pcols, db.valids + pvalids, db.row_mask)
+            acc_dev = out if acc_dev is None else merge(acc_dev, out)
+            task_times.append((db.shard_index, db.n_rows,
+                               time.perf_counter() - t0))
+            nbytes += (sum(c.nbytes for c in hb.cols)
+                       + sum(v.nbytes for v in hb.valids)
+                       + hb.row_mask.nbytes)
+            if collect is not None:
+                collect.append(db)
+                if nbytes > GLOBAL_CACHE.capacity:
+                    collect = None  # working set exceeds HBM cache: stream
+            if collect is None:
+                # bound in-flight device memory: wait for the output from
+                # PREFETCH_DEPTH batches ago before admitting another
+                inflight.append(out)
+                if len(inflight) > PREFETCH_DEPTH:
+                    jax.block_until_ready(inflight.popleft())
+        if acc_dev is None:
+            return combine_partials_host(plan, [_empty_partials(plan, np)])
+        if collect is not None:
+            jax.block_until_ready([b.cols for b in collect])
+            GLOBAL_CACHE.put(key, collect, nbytes)
+    plan.runtime_cache["task_times"] = task_times
+    return tuple(np.asarray(o) for o in jax.device_get(acc_dev))
 
 
 def _decode_direct_keys(plan: PhysicalPlan, rows: np.ndarray):
