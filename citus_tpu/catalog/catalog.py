@@ -81,6 +81,10 @@ class TableMeta:
     # {"name", "columns", "ref_table", "ref_columns", "on_delete"}
     # (reference: pg_constraint rows + foreign_constraint.c validation)
     foreign_keys: list = field(default_factory=list)
+    # secondary indexes, each {"name", "column", "unique"} — per-stripe
+    # sorted segments beside the stripe files (reference: pg_index rows +
+    # columnar_index_build_range_scan, columnar_tableam.c:1444)
+    indexes: list = field(default_factory=list)
 
     @property
     def shard_count(self) -> int:
@@ -94,6 +98,21 @@ class TableMeta:
     def is_reference(self) -> bool:
         return self.method == DistributionMethod.REFERENCE
 
+    def index_on(self, column: str):
+        """The index over ``column``, or None."""
+        for ix in self.indexes:
+            if ix["column"] == column:
+                return ix
+        return None
+
+    @property
+    def unique_indexes(self) -> list:
+        return [ix for ix in self.indexes if ix.get("unique")]
+
+    @property
+    def index_columns(self) -> list[str]:
+        return [ix["column"] for ix in self.indexes]
+
     def to_json(self):
         return {
             "name": self.name, "schema": self.schema.to_json(),
@@ -106,6 +125,7 @@ class TableMeta:
             "compression_level": self.compression_level,
             "version": self.version,
             "foreign_keys": self.foreign_keys,
+            "indexes": self.indexes,
         }
 
     @staticmethod
@@ -121,6 +141,7 @@ class TableMeta:
             compression_level=d["compression_level"],
             version=d.get("version", 0),
             foreign_keys=d.get("foreign_keys", []),
+            indexes=d.get("indexes", []),
         )
 
 

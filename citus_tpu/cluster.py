@@ -786,6 +786,86 @@ class Cluster:
             self.catalog.tombstone("triggers", tn)
         self.catalog.commit()
 
+    # ----------------------------------------------------------- indexes
+    def _find_index(self, name: str):
+        """-> (table_meta, index dict) or (None, None)."""
+        for t in self.catalog.tables.values():
+            for ix in t.indexes:
+                if ix["name"] == name:
+                    return t, ix
+        return None, None
+
+    def _drop_index_segments(self, t, column: str) -> None:
+        from citus_tpu.storage.index import drop_segments
+        import os as _os
+        for shard in t.shards:
+            for node in shard.placements:
+                d = self.catalog.shard_dir(t.name, shard.shard_id, node)
+                if _os.path.isdir(d):
+                    drop_segments(d, column)
+
+    def create_index(self, name: str, table: str, column: str, *,
+                     unique: bool = False,
+                     if_not_exists: bool = False) -> None:
+        """CREATE [UNIQUE] INDEX: register the index, validate existing
+        data for UNIQUE, and backfill per-stripe segments on every
+        placement (reference: commands/index.c DDL propagation +
+        columnar_index_build_range_scan, columnar_tableam.c:1444)."""
+        from citus_tpu.storage.index import backfill_index
+        from citus_tpu.transaction.locks import EXCLUSIVE
+        existing_t, existing = self._find_index(name)
+        if existing is not None:
+            if if_not_exists:
+                return
+            raise CatalogError(f'index "{name}" already exists')
+        t = self.catalog.table(table)
+        t.schema.column(column)  # must exist
+        if t.schema.column(column).type.is_float and unique:
+            raise UnsupportedFeatureError(
+                "UNIQUE indexes over floating-point columns are not "
+                "supported (no exact equality)")
+        if t.index_on(column) is not None:
+            raise CatalogError(
+                f'column "{column}" of "{table}" is already indexed')
+        ix = {"name": name, "column": column, "unique": bool(unique)}
+        # EXCLUSIVE write lock: no ingest may slip between the uniqueness
+        # validation / backfill and the catalog flip
+        with self._write_lock(t, EXCLUSIVE):
+            if unique:
+                from citus_tpu.integrity import validate_unique_backfill
+                validate_unique_backfill(self.catalog, t, ix)
+            # segments first, catalog second: a backfill failure must
+            # leave no in-memory claim of an index that was never built
+            backfill_index(self.catalog, t, [column])
+            t.indexes.append(ix)
+            t.version += 1
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+        self._plan_cache.clear()
+
+    def _execute_create_index(self, stmt: A.CreateIndex) -> Result:
+        self.create_index(stmt.name, stmt.table, stmt.column,
+                          unique=stmt.unique,
+                          if_not_exists=stmt.if_not_exists)
+        return Result(columns=[], rows=[])
+
+    def _execute_drop_index(self, stmt: A.DropIndex) -> Result:
+        t, ix = self._find_index(stmt.name)
+        if ix is None:
+            if stmt.if_exists:
+                return Result(columns=[], rows=[])
+            raise CatalogError(f'index "{stmt.name}" does not exist')
+        from citus_tpu.transaction.locks import EXCLUSIVE
+        with self._write_lock(t, EXCLUSIVE):
+            t.indexes.remove(ix)
+            # another index may not share the column (enforced at CREATE)
+            self._drop_index_segments(t, ix["column"])
+            t.version += 1
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+        self._plan_cache.clear()
+        return Result(columns=[], rows=[])
+
     def create_distributed_table(self, name: str, dist_column: str,
                                  shard_count: Optional[int] = None,
                                  colocate_with: Optional[str] = None) -> None:
@@ -864,57 +944,22 @@ class Cluster:
         values, validity = encode_columns(self.catalog, t, columns)
         import contextlib as _ctxlib
 
-        from citus_tpu.transaction.locks import SHARED
+        from citus_tpu.transaction.locks import EXCLUSIVE, SHARED
         txn = current_overlay()
-        with self._write_lock(t, SHARED):
-            t = self.catalog.table(table_name)  # re-fetch: fresh placements
-            with _ctxlib.ExitStack() as stack:
-                if t.foreign_keys:
-                    # hold the parents' group locks (SHARED) across
-                    # probe + write, so a concurrent parent DELETE
-                    # (EXCLUSIVE on the parent group) cannot interleave
-                    # between the FK check and the ingest commit
-                    from citus_tpu.integrity import check_ingest
-                    from citus_tpu.transaction.write_locks import (
-                        group_resource, group_write_lock,
-                    )
-                    parents = {}
-                    for fk in t.foreign_keys:
-                        p = self.catalog.table(fk["ref_table"])
-                        parents[group_resource(p)] = p
-                    for res in sorted(parents):
-                        if txn is not None:
-                            txn.hold_group_lock(self, parents[res], SHARED)
-                        else:
-                            stack.enter_context(group_write_lock(
-                                self.catalog, parents[res], SHARED,
-                                lock_manager=self.locks,
-                                timeout=self.settings.executor.lock_timeout_s))
-                    check_ingest(self, t, columns)
-                if txn is not None:
-                    # stage under the open transaction; COMMIT flips it.
-                    # On failure, REGISTER (don't abort) what was staged:
-                    # aborting the xid would destroy earlier statements'
-                    # staged rows; registration lets ROLLBACK [TO
-                    # SAVEPOINT] clean exactly this statement's stripes.
-                    ing = TableIngestor(self.catalog, t, txlog=None)
-                    ing.xid = txn.xid
-                    try:
-                        ing.append(values, validity)
-                        for w in ing._writers.values():
-                            w.flush()
-                    finally:
-                        txn.record_ingest(
-                            t.name,
-                            [w.directory for w in ing._writers.values()])
-                else:
-                    ing = TableIngestor(self.catalog, t, txlog=self.txlog)
-                    try:
-                        ing.append(values, validity)
-                    except BaseException:
-                        ing.abort()
-                        raise
-                    ing.finish()
+        # unique enforcement needs probe+write atomicity: two SHARED
+        # ingests could both miss the probe and insert the same key.
+        # The mode is re-derived from the fresh TableMeta inside the
+        # lock — a CREATE UNIQUE INDEX committed after our stale fetch
+        # must escalate us before the probe runs.
+        lock_mode = EXCLUSIVE if t.unique_indexes else SHARED
+        while True:
+            with self._write_lock(t, lock_mode):
+                t = self.catalog.table(table_name)  # re-fetch: fresh placements
+                if t.unique_indexes and lock_mode == SHARED:
+                    lock_mode = EXCLUSIVE
+                    continue  # retry under the stronger lock
+                self._copy_from_locked(t, txn, columns, values, validity)
+                break
         n = len(next(iter(values.values()))) if values else 0
         self.counters.bump("rows_ingested", n)
         if self.cdc.enabled and n:
@@ -922,6 +967,63 @@ class Cluster:
                            rows=self._decode_rows(t, values, validity),
                            columns=t.schema.names)
         return n
+
+    def _copy_from_locked(self, t, txn, columns, values, validity) -> None:
+        """copy_from's body under the table write lock: FK + unique
+        probes, then the staged or 2PC ingest."""
+        import contextlib as _ctxlib
+
+        from citus_tpu.transaction.locks import SHARED
+        with _ctxlib.ExitStack() as stack:
+            if t.foreign_keys:
+                # hold the parents' group locks (SHARED) across
+                # probe + write, so a concurrent parent DELETE
+                # (EXCLUSIVE on the parent group) cannot interleave
+                # between the FK check and the ingest commit
+                from citus_tpu.integrity import check_ingest
+                from citus_tpu.transaction.write_locks import (
+                    group_resource, group_write_lock,
+                )
+                parents = {}
+                for fk in t.foreign_keys:
+                    p = self.catalog.table(fk["ref_table"])
+                    parents[group_resource(p)] = p
+                for res in sorted(parents):
+                    if txn is not None:
+                        txn.hold_group_lock(self, parents[res], SHARED)
+                    else:
+                        stack.enter_context(group_write_lock(
+                            self.catalog, parents[res], SHARED,
+                            lock_manager=self.locks,
+                            timeout=self.settings.executor.lock_timeout_s))
+                check_ingest(self, t, columns)
+            if t.unique_indexes:
+                from citus_tpu.integrity import check_unique_ingest
+                check_unique_ingest(self, t, values, validity)
+            if txn is not None:
+                # stage under the open transaction; COMMIT flips it.
+                # On failure, REGISTER (don't abort) what was staged:
+                # aborting the xid would destroy earlier statements'
+                # staged rows; registration lets ROLLBACK [TO
+                # SAVEPOINT] clean exactly this statement's stripes.
+                ing = TableIngestor(self.catalog, t, txlog=None)
+                ing.xid = txn.xid
+                try:
+                    ing.append(values, validity)
+                    for w in ing._writers.values():
+                        w.flush()
+                finally:
+                    txn.record_ingest(
+                        t.name,
+                        [w.directory for w in ing._writers.values()])
+            else:
+                ing = TableIngestor(self.catalog, t, txlog=self.txlog)
+                try:
+                    ing.append(values, validity)
+                except BaseException:
+                    ing.abort()
+                    raise
+                ing.finish()
 
     def _emit_cdc(self, table: str, op: str, **kw) -> None:
         """Emit a change event — or, inside an open transaction, defer
@@ -1644,6 +1746,24 @@ class Cluster:
             opts = {k: v for k, v in stmt.options.items() if k != "access_method"}
             fks = []
             pre_existing = self.catalog.has_table(stmt.name)
+            # pre-validate implicit PK/UNIQUE indexes BEFORE the table
+            # commits: PostgreSQL's CREATE TABLE is all-or-nothing
+            want_indexes = []
+            if not pre_existing:
+                seen_ix: set = set()
+                for c in stmt.columns:
+                    if not (c.primary_key or c.unique):
+                        continue
+                    iname = (f"{stmt.name}_pkey" if c.primary_key
+                             else f"{stmt.name}_{c.name}_key")
+                    if iname in seen_ix or self._find_index(iname)[1] is not None:
+                        raise CatalogError(f'index "{iname}" already exists')
+                    seen_ix.add(iname)
+                    if schema.column(c.name).type.is_float:
+                        raise UnsupportedFeatureError(
+                            "UNIQUE indexes over floating-point columns "
+                            "are not supported (no exact equality)")
+                    want_indexes.append((iname, c.name))
             if stmt.foreign_keys and not pre_existing:
                 from citus_tpu.integrity import declare_fks
                 fks = declare_fks(self.catalog, stmt.name,
@@ -1657,10 +1777,20 @@ class Cluster:
                 for cn, tn in enum_binds:
                     self.catalog.enum_columns[f"{stmt.name}.{cn}"] = tn
                 self.catalog.commit()
+            if want_indexes and self.catalog.has_table(stmt.name):
+                # PRIMARY KEY / UNIQUE column constraints become unique
+                # indexes (PostgreSQL's implicit btree; pg_index rows) —
+                # pre-validated above, so these cannot fail halfway
+                for iname, cname in want_indexes:
+                    self.create_index(iname, stmt.name, cname, unique=True)
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.DropTable):
             self.drop_table(stmt.name, if_exists=stmt.if_exists)
             return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateIndex):
+            return self._execute_create_index(stmt)
+        if isinstance(stmt, A.DropIndex):
+            return self._execute_drop_index(stmt)
         if isinstance(stmt, A.Insert):
             return self._execute_insert(stmt)
         if isinstance(stmt, A.CopyTo):
@@ -1769,6 +1899,11 @@ class Cluster:
                              stmt.column.not_null)
                 self.catalog.add_column(stmt.table, col)
             elif stmt.action == "drop_column":
+                t0 = self.catalog.table(stmt.table)
+                if t0.index_on(stmt.old_name) is not None:
+                    self._drop_index_segments(t0, stmt.old_name)
+                    t0.indexes[:] = [ix for ix in t0.indexes
+                                     if ix["column"] != stmt.old_name]
                 # PostgreSQL drops the table's own FK constraints that
                 # include the column; a referenced parent column needs
                 # CASCADE (unsupported here), so fail closed instead of
@@ -1790,6 +1925,28 @@ class Cluster:
                              and stmt.old_name in fk["ref_columns"])]
                 self.catalog.drop_column(stmt.table, stmt.old_name)
             elif stmt.action == "rename_column":
+                t0 = self.catalog.table(stmt.table)
+                if t0.index_on(stmt.old_name) is not None:
+                    # segments are keyed by logical column name on disk:
+                    # rename them with the column
+                    import os as _os
+                    suffix = f".idx.{stmt.old_name}.npz"
+                    for shard in t0.shards:
+                        for node in shard.placements:
+                            d = self.catalog.shard_dir(
+                                t0.name, shard.shard_id, node)
+                            if not _os.path.isdir(d):
+                                continue
+                            for f in _os.listdir(d):
+                                if f.endswith(suffix):
+                                    base = f[:-len(suffix)]
+                                    _os.replace(
+                                        _os.path.join(d, f),
+                                        _os.path.join(
+                                            d, base + f".idx.{stmt.new_name}.npz"))
+                    for ix in t0.indexes:
+                        if ix["column"] == stmt.old_name:
+                            ix["column"] = stmt.new_name
                 self.catalog.rename_column(stmt.table, stmt.old_name, stmt.new_name)
                 # keep FK metadata consistent: this table's own key
                 # columns and every child's referenced-column names
@@ -1824,6 +1981,9 @@ class Cluster:
                 raise UnsupportedFeatureError(
                     "MERGE on tables with foreign key constraints is not "
                     "supported")
+            if _mt.unique_indexes:
+                raise UnsupportedFeatureError(
+                    "MERGE on tables with UNIQUE indexes is not supported")
             with self._write_lock(self.catalog.table(stmt.target.name), EXCLUSIVE):
                 st = execute_merge(
                     self.catalog, self.txlog, stmt,
@@ -1908,9 +2068,10 @@ class Cluster:
                 raise UnsupportedFeatureError(
                     "RETURNING on INSERT..SELECT is not supported")
             names = stmt.columns or t.schema.names
-            # FK-constrained targets take the pull path so every row goes
-            # through copy_from's parent probe (check_ingest)
-            res = None if t.foreign_keys \
+            # FK-constrained and unique-indexed targets take the pull
+            # path so every row goes through copy_from's probes
+            # (check_ingest / check_unique_ingest)
+            res = None if (t.foreign_keys or t.unique_indexes) \
                 else self._insert_select_arrays(t, stmt.select, list(names))
             if res is None:
                 # general path: materialize rows through the coordinator
@@ -3853,6 +4014,13 @@ class Cluster:
         kind = ("Router" if plan.is_router else "Distributed") if t.is_distributed else "Local"
         lines.append(f"{kind} Scan on {t.name} "
                      f"(shards: {len(plan.shard_indexes)}/{t.shard_count})")
+        if plan.index_eq is not None:
+            icol, ival, iname = plan.index_eq
+            if t.schema.column(icol).type.is_text:
+                # literal was bound to its dictionary id; show the string
+                decoded = self.catalog.decode_strings(t.name, icol, [int(ival)])
+                ival = decoded[0] if decoded else ival
+            lines.append(f"  Index Lookup: {icol} = {ival!r} using {iname}")
         if plan.intervals:
             lines.append("  Chunk Pruning: " +
                          ", ".join(sorted({c.column for c in plan.intervals})))

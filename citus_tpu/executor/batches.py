@@ -89,7 +89,12 @@ def load_shard_batches(
     pend_v: dict[str, list[np.ndarray]] = {c: [] for c in cols}
     pend_m: dict[str, list[np.ndarray]] = {c: [] for c in cols}
     pend_rows = 0
-    for batch in reader.scan(cols, plan.intervals):
+    if plan.index_eq is not None:
+        col, value, _name = plan.index_eq
+        source = reader.lookup_eq(cols, col, value, plan.intervals)
+    else:
+        source = reader.scan(cols, plan.intervals)
+    for batch in source:
         for c in cols:
             pend_v[c].append(batch.values[c])
             m = batch.validity[c]

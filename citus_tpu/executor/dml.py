@@ -174,6 +174,7 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
     new_values = {c: [] for c in all_columns}
     new_valid = {c: [] for c in all_columns}
     assign_map = dict(assignments)
+    replaced: dict = {}  # {primary_dir: {stripe_file: positions}} for unique probe
     total = 0
     for si in shard_indexes:
         shard = table.shards[si]
@@ -184,6 +185,7 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
         merged, matched = _matched_rows_per_stripe(cat, table, d, where, all_columns)
         if not merged:
             continue
+        replaced[d] = {sf: set(ix.tolist()) for sf, (ix, _) in merged.items()}
         total += sum(len(ix) for ix, _ in merged.values())
         # stage the deletion on every placement of this shard
         for node in shard.placements:
@@ -226,6 +228,10 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
     values = {c: np.concatenate(new_values[c]).astype(table.schema.column(c).type.storage_dtype)
               for c in all_columns}
     validity = {c: np.concatenate(new_valid[c]) for c in all_columns}
+    if table.unique_indexes:
+        from citus_tpu.integrity import check_unique_update
+        check_unique_update(cat, table, values, validity,
+                            set(assign_map), replaced)
     ing = TableIngestor(cat, table, txlog=None)
     ing.xid = xid  # share the DML transaction
     ing._writers = {}
@@ -299,7 +305,8 @@ def execute_vacuum(cat: Catalog, table: TableMeta) -> dict:
                             chunk_row_limit=table.chunk_row_limit,
                             stripe_row_limit=table.stripe_row_limit,
                             codec=table.compression,
-                            level=table.compression_level)
+                            level=table.compression_level,
+                            index_columns=tuple(table.index_columns))
             live = 0
             for batch in reader.scan(table.schema.names):
                 vals = {c: batch.values[c] for c in table.schema.names}

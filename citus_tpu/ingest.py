@@ -99,6 +99,7 @@ class TableIngestor:
                 codec=self.table.compression,
                 level=self.table.compression_level,
                 staged_xid=self.xid,
+                index_columns=tuple(self.table.index_columns),
             )
             self._writers[key] = w
         return w

@@ -32,6 +32,166 @@ from citus_tpu.planner import ast as A
 _PROBE_CHUNK = 1000
 
 
+class UniqueViolation(ExecutionError):
+    """Duplicate key in a UNIQUE index (PostgreSQL SQLSTATE 23505)."""
+
+
+def _decode_index_value(cat, t, col: str, phys):
+    typ = t.schema.column(col).type
+    if typ.is_text:
+        return cat.decode_strings(t.name, col, [int(phys)])[0]
+    return typ.from_physical(np.asarray(phys).item())
+
+
+def _unique_conflict(cat, t, ix: dict, phys_value) -> "UniqueViolation":
+    v = _decode_index_value(cat, t, ix["column"], phys_value)
+    return UniqueViolation(
+        f'duplicate key value violates unique constraint "{ix["name"]}": '
+        f'Key ({ix["column"]})=({v}) already exists')
+
+
+def _probe_unique_live(cat, t, ix: dict, uniq: np.ndarray,
+                       exclude: Optional[dict] = None):
+    """First value of ``uniq`` (sorted physical values) with a live match
+    in any shard, or None.  ``exclude``: {placement_dir: {stripe_file:
+    positions about to be deleted}} — rows an in-flight UPDATE replaces
+    do not conflict."""
+    import os
+
+    from citus_tpu.storage.deletes import deleted_mask
+    from citus_tpu.storage.index import load_segment
+    from citus_tpu.storage.overlay import visible_deletes, visible_meta
+    from citus_tpu.storage.reader import ShardReader
+
+    col = ix["column"]
+    for shard in t.shards:
+        d = cat.shard_dir(t.name, shard.shard_id, shard.placements[0])
+        if not os.path.isdir(d):
+            continue
+        meta = visible_meta(d)
+        dcache = visible_deletes(d)
+        excl_dir = (exclude or {}).get(d, {})
+        reader = None
+        for s in meta["stripes"]:
+            seg = load_segment(d, s["file"], col)
+            if seg is None:
+                # stripe written before the index: scan its column
+                if reader is None:
+                    reader = ShardReader(d, t.schema)
+                for batch in reader.scan([col], only_stripes={s["file"]},
+                                         apply_deletes=False):
+                    bm = batch.validity[col]
+                    bv = batch.values[col]
+                    keep = np.ones(batch.row_count, bool) if bm is None \
+                        else np.asarray(bm).copy()
+                    gpos = batch.chunk_row_offset + np.arange(batch.row_count)
+                    dm = deleted_mask(d, s["file"], s["row_count"], dcache) \
+                        if s["file"] in dcache else None
+                    if dm is not None:
+                        keep &= ~dm[gpos]
+                    excl = excl_dir.get(s["file"])
+                    if excl is not None and len(excl):
+                        keep &= ~np.isin(gpos, np.fromiter(excl, np.int64))
+                    hit = np.isin(bv[keep], uniq)
+                    if hit.any():
+                        return bv[keep][hit][0]
+                continue
+            sv, pos = seg
+            lo = np.searchsorted(sv, uniq, "left")
+            hi = np.searchsorted(sv, uniq, "right")
+            found = hi > lo
+            if not found.any():
+                continue
+            dm = deleted_mask(d, s["file"], s["row_count"], dcache) \
+                if s["file"] in dcache else None
+            excl = excl_dir.get(s["file"])
+            excl_arr = np.fromiter(excl, np.int64) if excl else None
+            for val, a, b in zip(uniq[found], lo[found], hi[found]):
+                p = pos[int(a):int(b)]
+                if dm is not None:
+                    p = p[~dm[p]]
+                if excl_arr is not None and p.size:
+                    p = p[~np.isin(p, excl_arr)]
+                if p.size:
+                    return val
+    return None
+
+
+def check_unique_ingest(cluster, t, values: dict, validity: dict) -> None:
+    """Reject a physical-encoded ingest batch that would duplicate a
+    UNIQUE-indexed column — within the batch or against live rows
+    (delete-aware; the active transaction's staged writes included via
+    the overlay).  Reference: unique-index enforcement at insert time,
+    which the columnar AM gets from btree uniqueness during
+    columnar_index_build_range_scan inserts."""
+    cat = cluster.catalog
+    for ix in t.unique_indexes:
+        col = ix["column"]
+        if col not in values:
+            continue
+        v = np.asarray(values[col])
+        m = np.asarray(validity[col])
+        vv = v[m]
+        if vv.size == 0:
+            continue
+        uniq, counts = np.unique(vv, return_counts=True)
+        if (counts > 1).any():
+            raise _unique_conflict(cat, t, ix, uniq[counts > 1][0])
+        hit = _probe_unique_live(cat, t, ix, uniq)
+        if hit is not None:
+            raise _unique_conflict(cat, t, ix, hit)
+
+
+def check_unique_update(cat, t, values: dict, validity: dict,
+                        assigned_cols: set, exclude: dict) -> None:
+    """UPDATE-side uniqueness: the replacement batch must not collide
+    with itself or with surviving rows (``exclude`` holds the positions
+    being replaced).  Only assigned unique columns can create new
+    conflicts — untouched columns keep their already-unique values."""
+    for ix in t.unique_indexes:
+        col = ix["column"]
+        if col not in assigned_cols or col not in values:
+            continue
+        v = np.asarray(values[col])
+        m = np.asarray(validity[col])
+        vv = v[m]
+        if vv.size == 0:
+            continue
+        uniq, counts = np.unique(vv, return_counts=True)
+        if (counts > 1).any():
+            raise _unique_conflict(cat, t, ix, uniq[counts > 1][0])
+        hit = _probe_unique_live(cat, t, ix, uniq, exclude=exclude)
+        if hit is not None:
+            raise _unique_conflict(cat, t, ix, hit)
+
+
+def validate_unique_backfill(cat, t, ix: dict) -> None:
+    """CREATE UNIQUE INDEX on existing data: every live value must be
+    distinct (per column, across all shards — uniqueness is global even
+    though segments are per-stripe)."""
+    import os
+
+    from citus_tpu.storage.reader import ShardReader
+
+    col = ix["column"]
+    seen: set = set()
+    for shard in t.shards:
+        d = cat.shard_dir(t.name, shard.shard_id, shard.placements[0])
+        if not os.path.isdir(d):
+            continue
+        reader = ShardReader(d, t.schema)
+        for batch in reader.scan([col]):
+            bm = batch.validity[col]
+            bv = batch.values[col] if bm is None else batch.values[col][np.asarray(bm)]
+            u, c = np.unique(bv, return_counts=True)
+            if (c > 1).any():
+                raise _unique_conflict(cat, t, ix, u[c > 1][0])
+            dup = seen.intersection(u.tolist())
+            if dup:
+                raise _unique_conflict(cat, t, ix, next(iter(dup)))
+            seen.update(u.tolist())
+
+
 class ForeignKeyViolation(ExecutionError):
     pass
 

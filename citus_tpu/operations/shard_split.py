@@ -99,7 +99,8 @@ def _split_shard_locked(cat, table, shard, shard_id, split_points,
                     cat.shard_dir(t.name, ns.shard_id, target_nodes[bi]),
                     t.schema, chunk_row_limit=t.chunk_row_limit,
                     stripe_row_limit=t.stripe_row_limit,
-                    codec=t.compression, level=t.compression_level)
+                    codec=t.compression, level=t.compression_level,
+                    index_columns=tuple(t.index_columns))
             for batch in reader.scan(t.schema.names):
                 h = hash_int64(batch.values[t.dist_column].astype(np.int64))
                 for bi, (blo, bhi) in enumerate(bounds):

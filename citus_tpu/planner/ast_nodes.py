@@ -195,6 +195,8 @@ class ColumnDef:
     type_name: str
     type_args: list[int] = field(default_factory=list)
     not_null: bool = False
+    primary_key: bool = False   # implies not_null + unique index
+    unique: bool = False        # column-level UNIQUE constraint
 
 
 @dataclass
@@ -222,6 +224,24 @@ class CreateTable(Statement):
 
 @dataclass
 class DropTable(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateIndex(Statement):
+    """CREATE [UNIQUE] INDEX name ON table (column).
+    Reference: commands/index.c (DDL propagation) +
+    columnar_tableam.c:1444 (index build over columnar)."""
+    name: str
+    table: str
+    column: str
+    unique: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropIndex(Statement):
     name: str
     if_exists: bool = False
 

@@ -561,6 +561,28 @@ class Parser:
                 self.expect_kw("exists")
                 if_not_exists = True
             return A.CreateRole(self.expect_ident(), if_not_exists)
+        if self.peek().kind == "ident" and self.peek().value in ("unique",
+                                                                 "index"):
+            unique = self.next().value == "unique"
+            if unique:
+                if not (self.peek().kind == "ident"
+                        and self.peek().value == "index"):
+                    self.error("expected INDEX after UNIQUE")
+                self.next()
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            name = self.expect_ident()
+            self.expect_kw("on")
+            table = self.parse_table_name()
+            self.expect_op("(")
+            column = self.expect_ident()
+            if self.accept_op(","):
+                self.error("multi-column indexes are not supported")
+            self.expect_op(")")
+            return A.CreateIndex(name, table, column, unique, if_not_exists)
         or_replace = False
         if self.peek().kind == "kw" and self.peek().value == "or":
             # CREATE OR REPLACE FUNCTION
@@ -755,17 +777,35 @@ class Parser:
             cname = self.expect_ident()
             tname, targs = self.parse_type_name()
             not_null = False
+            primary_key = False
+            unique = False
             while True:
                 if self.accept_kw("not"):
                     self.expect_kw("null")
                     not_null = True
                     continue
                 if self.peek().kind == "ident" \
+                        and self.peek().value == "primary":
+                    self.next()
+                    if not (self.peek().kind == "ident"
+                            and self.peek().value == "key"):
+                        self.error("expected KEY after PRIMARY")
+                    self.next()
+                    primary_key = True
+                    not_null = True
+                    continue
+                if self.peek().kind == "ident" \
+                        and self.peek().value == "unique":
+                    self.next()
+                    unique = True
+                    continue
+                if self.peek().kind == "ident" \
                         and self.peek().value == "references":
                     fkeys.append(self._parse_references([cname]))
                     continue
                 break
-            cols.append(A.ColumnDef(cname, tname, targs, not_null))
+            cols.append(A.ColumnDef(cname, tname, targs, not_null,
+                                    primary_key, unique))
             if not self.accept_op(","):
                 break
         self.expect_op(")")
@@ -903,6 +943,13 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             return A.DropTsConfig(self.expect_ident(), if_exists)
+        if self.peek().kind == "ident" and self.peek().value == "index":
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropIndex(self.expect_ident(), if_exists)
         if self.peek().kind == "ident" and self.peek().value in ("view", "sequence"):
             kind = self.next().value
             if_exists = False

@@ -96,6 +96,10 @@ class PhysicalPlan:
     # one shard once the parameter value is bound, reusing this plan and
     # its jitted kernels across values
     router_param: Optional[int] = None
+    # (column, physical value, index name) when an equality conjunct hits
+    # a secondary index: the scan gathers exact rows via per-stripe
+    # segments instead of reading every chunk
+    index_eq: Optional[tuple] = None
 
     @property
     def is_router(self) -> bool:
@@ -353,6 +357,26 @@ def _deferred_router_param(table: TableMeta, filter_: Optional[BExpr]) -> Option
     return None
 
 
+def _index_eq(table: TableMeta, filter_: Optional[BExpr]):
+    """(column, physical value, index name) when an AND conjunct pins an
+    indexed column to a literal — the index point-lookup path (reference:
+    an index path winning over ColumnarScan in the planner,
+    columnar_customscan.c costing vs btree)."""
+    for c in _conjuncts(filter_):
+        if not (isinstance(c, BBinOp) and c.op == "="):
+            continue
+        left, right = c.left, c.right
+        if isinstance(right, BColumn) and isinstance(left, BLiteral):
+            left, right = right, left
+        if not (isinstance(left, BColumn) and isinstance(right, BLiteral)
+                and right.value is not None):
+            continue
+        ix = table.index_on(left.name)
+        if ix is not None:
+            return (left.name, right.value, ix["name"])
+    return None
+
+
 def plan_select(cat: Catalog, bound: BoundSelect, *, direct_limit: int = 65536) -> PhysicalPlan:
     intervals = extract_intervals(bound.filter)
     shard_indexes, router_key = prune_shards(bound.table, bound.filter, return_key=True)
@@ -369,4 +393,5 @@ def plan_select(cat: Catalog, bound: BoundSelect, *, direct_limit: int = 65536) 
         agg_extract=agg_extract,
         router_key=router_key,
         router_param=_deferred_router_param(bound.table, bound.filter),
+        index_eq=_index_eq(bound.table, bound.filter),
     )

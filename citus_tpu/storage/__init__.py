@@ -27,6 +27,10 @@ Differences by design (TPU-first):
 from citus_tpu.storage.format import StripeFooter, ChunkStats, write_stripe_file, read_stripe_footer, read_chunk
 from citus_tpu.storage.writer import ShardWriter
 from citus_tpu.storage.reader import ShardReader, ChunkBatch, Interval
+from citus_tpu.storage.index import (
+    backfill_index, build_segment, drop_segments, load_segment,
+    matching_positions, positions_eq, probe_any,
+)
 
 __all__ = [
     "StripeFooter",
@@ -38,4 +42,11 @@ __all__ = [
     "ShardReader",
     "ChunkBatch",
     "Interval",
+    "backfill_index",
+    "build_segment",
+    "drop_segments",
+    "load_segment",
+    "matching_positions",
+    "positions_eq",
+    "probe_any",
 ]

@@ -132,9 +132,8 @@ def backfill_index(cat, table, columns: list[str]) -> int:
                 # accumulate the stripe's full column(s) in row order
                 vals = {c: [] for c in missing}
                 valid = {c: [] for c in missing}
-                for batch in reader.scan(missing, apply_deletes=False):
-                    if batch.stripe_file != sf:
-                        continue
+                for batch in reader.scan(missing, apply_deletes=False,
+                                         only_stripes={sf}):
                     for c in missing:
                         vals[c].append(batch.values[c])
                         m = batch.validity[c]

@@ -83,11 +83,13 @@ class ShardReader:
         columns: list[str],
         constraints: Optional[list[Interval]] = None,
         apply_deletes: bool = True,
+        only_stripes: Optional[set] = None,
     ) -> Iterator[ChunkBatch]:
         """Yield chunk batches for the projected ``columns``, skipping
         chunks refuted by ``constraints`` (conjunctive semantics) and
         subtracting deletion bitmaps (unless ``apply_deletes=False``,
-        used by DML that needs original row positions)."""
+        used by DML that needs original row positions).  ``only_stripes``
+        restricts to a stripe-file subset (index-lookup fallback)."""
         from citus_tpu.storage.deletes import deleted_mask
         from citus_tpu.storage.overlay import visible_deletes
         constraints = constraints or []
@@ -95,6 +97,8 @@ class ShardReader:
             self.schema.column(col)  # validate projection
         delete_cache = visible_deletes(self.directory) if apply_deletes else {}
         for stripe in self.meta["stripes"]:
+            if only_stripes is not None and stripe["file"] not in only_stripes:
+                continue
             path = os.path.join(self.directory, stripe["file"])
             footer = read_stripe_footer(path)
             selected = self._selected_chunks(footer, constraints)
@@ -138,6 +142,75 @@ class ShardReader:
                         stripe_file=stripe["file"], chunk_index=ci,
                         chunk_row_offset=int(offsets[ci]))
                     yield self._subtract_deletes(b, del_mask)
+
+    def lookup_eq(
+        self,
+        columns: list[str],
+        column: str,
+        value,
+        constraints: Optional[list[Interval]] = None,
+    ) -> Iterator[ChunkBatch]:
+        """Index-driven point lookup: yield batches holding ONLY the rows
+        whose ``column`` equals ``value`` (live rows; deletes applied).
+        Stripes without a segment fall back to a pruned full scan —
+        never wrong, just slower (reference analog: an index scan over
+        columnar random row access, columnar_reader.c:370-391)."""
+        from citus_tpu.storage.deletes import deleted_mask
+        from citus_tpu.storage.index import positions_eq
+        from citus_tpu.storage.overlay import visible_deletes
+        try:
+            from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        except ImportError:
+            GLOBAL_COUNTERS = None
+        delete_cache = visible_deletes(self.directory)
+        fallback: set = set()
+        for stripe in self.meta["stripes"]:
+            pos = positions_eq(self.directory, stripe["file"], column, value)
+            if pos is None:
+                fallback.add(stripe["file"])
+                continue
+            path = os.path.join(self.directory, stripe["file"])
+            footer = read_stripe_footer(path)
+            if GLOBAL_COUNTERS is not None:
+                GLOBAL_COUNTERS.bump("index_lookups")
+                GLOBAL_COUNTERS.bump("chunks_total", footer.chunk_count)
+            if pos.size == 0:
+                continue
+            if stripe["file"] in delete_cache:
+                dm = deleted_mask(self.directory, stripe["file"],
+                                  footer.row_count, delete_cache)
+                if dm is not None:
+                    pos = pos[~dm[pos]]
+                    if pos.size == 0:
+                        continue
+            bounds = np.concatenate([[0], np.cumsum(footer.chunk_row_counts)])
+            chunk_of = np.searchsorted(bounds, pos, "right") - 1
+            needed = np.unique(chunk_of)
+            if GLOBAL_COUNTERS is not None:
+                GLOBAL_COUNTERS.bump("chunks_selected", int(needed.size))
+            with open(path, "rb") as fh:
+                for ci in needed:
+                    local = np.sort(pos[chunk_of == ci]) - bounds[ci]
+                    vals, valid = {}, {}
+                    for col in columns:
+                        c = self.schema.column(col)
+                        stream = footer.columns.get(c.storage_name)
+                        if stream is None:
+                            # column added after this stripe: all NULL
+                            vals[col] = np.zeros(local.size, c.type.storage_dtype)
+                            valid[col] = np.zeros(local.size, bool)
+                            continue
+                        v, m = read_chunk(fh, footer, stream[int(ci)],
+                                          c.type.storage_dtype)
+                        vals[col] = v[local]
+                        valid[col] = None if m is None else m[local]
+                    yield ChunkBatch(values=vals, validity=valid,
+                                     row_count=int(local.size),
+                                     stripe_file=stripe["file"],
+                                     chunk_index=int(ci))
+        if fallback:
+            yield from self.scan(columns, constraints,
+                                 only_stripes=fallback)
 
     @staticmethod
     def _subtract_deletes(b: ChunkBatch, del_mask) -> ChunkBatch:

@@ -117,10 +117,19 @@ def commit_staged(directory: str, xid: int) -> None:
 def abort_staged(directory: str, xid: int) -> None:
     """Delete a transaction's staged stripes + side file (rollback)."""
     staged = _load_staged(directory, xid)
+    if staged["stripes"]:
+        listing = os.listdir(directory)
     for s in staged["stripes"]:
         fp = os.path.join(directory, s["file"])
         if os.path.exists(fp):
             os.remove(fp)
+        # index segments travel with their stripe file
+        for f in listing:
+            if f.startswith(s["file"] + ".idx."):
+                try:
+                    os.remove(os.path.join(directory, f))
+                except OSError:
+                    pass
     p = _staged_path(directory, xid)
     if os.path.exists(p):
         os.remove(p)
@@ -131,7 +140,8 @@ class ShardWriter:
 
     def __init__(self, directory: str, schema: Schema, *, chunk_row_limit: int,
                  stripe_row_limit: int, codec: str = "zstd", level: int = 3,
-                 staged_xid: int | None = None):
+                 staged_xid: int | None = None,
+                 index_columns: tuple[str, ...] = ()):
         if stripe_row_limit % chunk_row_limit != 0:
             raise StorageError("stripe_row_limit must be a multiple of chunk_row_limit")
         self.directory = directory
@@ -141,6 +151,9 @@ class ShardWriter:
         self.codec = codec
         self.level = level
         self.staged_xid = staged_xid
+        # columns with a secondary index: each flushed stripe also gets a
+        # sorted value->offset segment per indexed column
+        self.index_columns = tuple(index_columns)
         os.makedirs(directory, exist_ok=True)
         self._buf: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
         self._buf_valid: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
@@ -225,6 +238,7 @@ class ShardWriter:
             write_stripe_file(
                 os.path.join(self.directory, fname), column_chunks, chunk_rows,
                 self.chunk_row_limit, self.codec, self.level)
+            self._build_index_segments(fname, col_vals, col_valid)
             staged["stripes"].append({"file": fname, "row_count": n})
             staged["row_count"] += n
             _store_staged(self.directory, self.staged_xid, staged)
@@ -236,8 +250,21 @@ class ShardWriter:
                 write_stripe_file(
                     os.path.join(self.directory, fname), column_chunks, chunk_rows,
                     self.chunk_row_limit, self.codec, self.level)
+                self._build_index_segments(fname, col_vals, col_valid)
                 meta["stripes"].append({"file": fname, "row_count": n})
                 meta["row_count"] += n
                 meta["next_stripe_id"] = sid + 1
                 _store_meta(self.directory, meta)
         self._buf_rows -= n
+
+    def _build_index_segments(self, fname: str, col_vals, col_valid) -> None:
+        """Write each indexed column's segment beside the new stripe
+        (before the stripe enters any metadata, so a reader never sees a
+        live stripe whose segment is mid-write)."""
+        if not self.index_columns:
+            return
+        from citus_tpu.storage.index import build_segment
+        for col in self.index_columns:
+            if col in col_vals:
+                build_segment(self.directory, fname, col,
+                              col_vals[col], col_valid[col])

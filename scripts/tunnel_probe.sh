@@ -2,11 +2,16 @@
 # appends one line per probe attempt to TUNNEL_PROBES.log
 TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
 # the axon tunnel serializes clients: probing while a bench run owns the
-# device would block (or wedge) both — record the skip instead
+# device would starve both (single-core box) — record the skip instead
 if pgrep -f "python bench.py" >/dev/null 2>&1; then
     echo "$TS rc=skip bench.py holds the device (probe skipped)" >> /root/repo/TUNNEL_PROBES.log
     exit 0
 fi
-OUT=$(timeout 90 python -c "import jax; d=jax.devices(); print('DEVICES', len(d), d[0].platform)" 2>&1 | tail -1)
+# NOTE: rc must be python's, not a pipeline tail's; a timed-out probe
+# still emits the axon-plugin WARNING on stderr, so only an explicit
+# DEVICES line counts as success
+OUT=$(timeout "${PROBE_TIMEOUT_S:-120}" python -c "import jax; d=jax.devices(); print('DEVICES', len(d), d[0].platform)" 2>&1)
 RC=$?
-echo "$TS rc=$RC $OUT" >> /root/repo/TUNNEL_PROBES.log
+LAST=$(printf '%s\n' "$OUT" | grep DEVICES | tail -1)
+[ -n "$LAST" ] || LAST=$(printf '%s\n' "$OUT" | tail -1)
+echo "$TS rc=$RC $LAST" >> /root/repo/TUNNEL_PROBES.log

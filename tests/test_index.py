@@ -1,0 +1,255 @@
+"""CREATE/DROP INDEX, unique enforcement, and index point lookups.
+
+Reference semantics being matched: DDL-propagated indexes
+(src/backend/distributed/commands/index.c), index builds over columnar
+(columnar_tableam.c:1444 columnar_index_build_range_scan), and btree
+uniqueness (duplicate key SQLSTATE 23505).  The TPU-native shape is a
+per-stripe sorted value->offset segment beside each stripe file.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+from citus_tpu.executor.executor import GLOBAL_COUNTERS
+from citus_tpu.integrity import UniqueViolation
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("""CREATE TABLE items (
+        id bigint NOT NULL, grp bigint, label text, price decimal(10,2))""")
+    cl.execute("SELECT create_distributed_table('items', 'id', 4)")
+    rows = [(i, i % 7, f"label-{i % 50}", i * 1.25) for i in range(5000)]
+    cl.copy_from("items", rows=rows)
+    return cl
+
+
+def seg_files(cl, table, column):
+    t = cl.catalog.table(table)
+    out = []
+    for shard in t.shards:
+        for node in shard.placements:
+            d = cl.catalog.shard_dir(table, shard.shard_id, node)
+            if os.path.isdir(d):
+                out += [f for f in os.listdir(d)
+                        if f.endswith(f".idx.{column}.npz")]
+    return out
+
+
+# ------------------------------------------------------------ DDL wiring
+
+def test_create_index_backfills_existing_stripes(db):
+    db.execute("CREATE INDEX items_grp_idx ON items (grp)")
+    t = db.catalog.table("items")
+    assert t.indexes == [{"name": "items_grp_idx", "column": "grp",
+                          "unique": False}]
+    assert len(seg_files(db, "items", "grp")) > 0
+
+
+def test_new_ingest_builds_segments_without_backfill(db):
+    db.execute("CREATE INDEX items_grp_idx ON items (grp)")
+    before = len(seg_files(db, "items", "grp"))
+    db.copy_from("items", rows=[(9001, 3, "x", 1.0)])
+    assert len(seg_files(db, "items", "grp")) > before
+
+
+def test_drop_index_removes_segments_and_metadata(db):
+    db.execute("CREATE INDEX items_grp_idx ON items (grp)")
+    assert seg_files(db, "items", "grp")
+    db.execute("DROP INDEX items_grp_idx")
+    assert db.catalog.table("items").indexes == []
+    assert seg_files(db, "items", "grp") == []
+    # queries on the column still work (plain scan)
+    r = db.execute("SELECT count(*) FROM items WHERE grp = 3")
+    assert r.rows[0][0] == len([i for i in range(5000) if i % 7 == 3])
+
+
+def test_index_name_collision_and_if_not_exists(db):
+    db.execute("CREATE INDEX ix ON items (grp)")
+    with pytest.raises(CatalogError):
+        db.execute("CREATE INDEX ix ON items (price)")
+    db.execute("CREATE INDEX IF NOT EXISTS ix ON items (grp)")  # no-op
+    with pytest.raises(CatalogError):
+        db.execute("CREATE INDEX ix2 ON items (grp)")  # column taken
+    with pytest.raises(CatalogError):
+        db.execute("DROP INDEX nope")
+    db.execute("DROP INDEX IF EXISTS nope")  # no-op
+
+
+# ------------------------------------------------------- point lookups
+
+def test_point_query_uses_index_and_prunes_chunks(db):
+    db.execute("CREATE INDEX items_grp_idx ON items (grp)")
+    GLOBAL_COUNTERS.reset()
+    r = db.execute("SELECT count(*) FROM items WHERE grp = 5")
+    assert r.rows[0][0] == len([i for i in range(5000) if i % 7 == 5])
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap.get("index_lookups", 0) > 0
+
+
+def test_explain_shows_index_path(db):
+    db.execute("CREATE INDEX items_grp_idx ON items (grp)")
+    r = db.execute("EXPLAIN SELECT sum(price) FROM items WHERE grp = 2")
+    text = "\n".join(row[0] for row in r.rows)
+    assert "Index Lookup: grp = 2 using items_grp_idx" in text
+
+
+def test_index_lookup_matches_scan_results(db):
+    # identical results with and without the index, incl. aggregates,
+    # projections, and text-column indexes (dictionary-id equality)
+    queries = [
+        "SELECT count(*), sum(price), min(id), max(id) FROM items WHERE grp = 4",
+        "SELECT id, price FROM items WHERE grp = 6 ORDER BY id LIMIT 20",
+        "SELECT count(*) FROM items WHERE label = 'label-17'",
+        "SELECT grp, count(*) FROM items WHERE grp = 1 GROUP BY grp",
+    ]
+    before = [db.execute(q).rows for q in queries]
+    db.execute("CREATE INDEX ix_grp ON items (grp)")
+    db.execute("CREATE INDEX ix_label ON items (label)")
+    after = [db.execute(q).rows for q in queries]
+    assert before == after
+
+
+def test_index_lookup_respects_deletes(db):
+    db.execute("CREATE INDEX ix_grp ON items (grp)")
+    expected = len([i for i in range(5000) if i % 7 == 2])
+    assert db.execute("SELECT count(*) FROM items WHERE grp = 2").rows[0][0] == expected
+    db.execute("DELETE FROM items WHERE grp = 2 AND id < 1000")
+    gone = len([i for i in range(1000) if i % 7 == 2])
+    r = db.execute("SELECT count(*) FROM items WHERE grp = 2")
+    assert r.rows[0][0] == expected - gone
+
+
+def test_index_survives_vacuum_rewrite(db):
+    db.execute("CREATE INDEX ix_grp ON items (grp)")
+    db.execute("DELETE FROM items WHERE grp = 0")
+    db.execute("VACUUM items")
+    # rewritten stripes must carry fresh segments
+    assert seg_files(db, "items", "grp")
+    r = db.execute("SELECT count(*) FROM items WHERE grp = 3")
+    assert r.rows[0][0] == len([i for i in range(5000) if i % 7 == 3])
+
+
+def test_rename_column_carries_index(db):
+    db.execute("CREATE INDEX ix_grp ON items (grp)")
+    db.execute("ALTER TABLE items RENAME COLUMN grp TO bucket")
+    t = db.catalog.table("items")
+    assert t.index_on("bucket") is not None
+    assert seg_files(db, "items", "bucket")
+    r = db.execute("SELECT count(*) FROM items WHERE bucket = 3")
+    assert r.rows[0][0] == len([i for i in range(5000) if i % 7 == 3])
+
+
+def test_drop_column_drops_index(db):
+    db.execute("CREATE INDEX ix_grp ON items (grp)")
+    db.execute("ALTER TABLE items DROP COLUMN grp")
+    assert db.catalog.table("items").indexes == []
+    assert seg_files(db, "items", "grp") == []
+
+
+# ------------------------------------------------------------ uniqueness
+
+def test_unique_index_rejects_duplicate_ingest(db):
+    db.execute("CREATE UNIQUE INDEX items_id_key ON items (id)")
+    with pytest.raises(UniqueViolation, match="items_id_key"):
+        db.copy_from("items", rows=[(17, 0, "dup", 1.0)])
+    # batch-internal duplicate
+    with pytest.raises(UniqueViolation):
+        db.copy_from("items", rows=[(90001, 0, "a", 1.0),
+                                    (90001, 1, "b", 2.0)])
+    # non-duplicate still loads
+    assert db.copy_from("items", rows=[(90002, 0, "ok", 1.0)]) == 1
+
+
+def test_unique_on_non_distribution_column(db):
+    # global uniqueness across shards even though the column is not the
+    # distribution key (beyond the reference, which refuses this)
+    db.execute("CREATE UNIQUE INDEX items_price_key ON items (price)")
+    with pytest.raises(UniqueViolation):
+        db.copy_from("items", rows=[(80001, 0, "x", 100 * 1.25)])
+
+
+def test_unique_backfill_validates_existing_data(db):
+    db.copy_from("items", rows=[(70001, 0, "dup-grp", 1.0),
+                                (70002, 0, "dup-grp", 2.0)])
+    with pytest.raises(UniqueViolation):
+        db.execute("CREATE UNIQUE INDEX ix_label ON items (label)")
+    assert db.catalog.table("items").indexes == []
+
+
+def test_delete_frees_unique_value(db):
+    db.execute("CREATE UNIQUE INDEX items_id_key ON items (id)")
+    db.execute("DELETE FROM items WHERE id = 42")
+    assert db.copy_from("items", rows=[(42, 0, "back", 9.0)]) == 1
+    with pytest.raises(UniqueViolation):
+        db.copy_from("items", rows=[(42, 0, "again", 9.0)])
+
+
+def test_update_respects_unique(db):
+    db.execute("CREATE UNIQUE INDEX items_id_key ON items (id)")
+    with pytest.raises(UniqueViolation):
+        db.execute("UPDATE items SET id = 100 WHERE id = 200")
+    # no-conflict update passes; self-replacement is not a conflict
+    db.execute("UPDATE items SET id = 990001 WHERE id = 200")
+    assert db.execute("SELECT count(*) FROM items WHERE id = 990001").rows[0][0] == 1
+    # updating a NON-unique column of a unique-indexed table is fine
+    db.execute("UPDATE items SET grp = 99 WHERE id = 300")
+
+
+def test_upsert_still_works_with_unique_index(db):
+    db.execute("CREATE UNIQUE INDEX items_id_key ON items (id)")
+    db.execute("INSERT INTO items (id, grp, label, price) VALUES "
+               "(55, 0, 'x', 1.0) ON CONFLICT (id) DO UPDATE SET grp = 77")
+    r = db.execute("SELECT grp FROM items WHERE id = 55")
+    assert r.rows == [(77,)]
+    db.execute("INSERT INTO items (id, grp, label, price) VALUES "
+               "(600001, 5, 'new', 2.0) ON CONFLICT (id) DO NOTHING")
+    assert db.execute("SELECT count(*) FROM items WHERE id = 600001").rows[0][0] == 1
+
+
+def test_primary_key_column_constraint(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db2"))
+    cl.execute("CREATE TABLE users (uid bigint PRIMARY KEY, name text UNIQUE)")
+    t = cl.catalog.table("users")
+    assert t.index_on("uid")["unique"] and t.index_on("uid")["name"] == "users_pkey"
+    assert t.index_on("name")["unique"]
+    assert t.schema.column("uid").not_null
+    cl.execute("INSERT INTO users VALUES (1, 'ann'), (2, 'bo')")
+    with pytest.raises(UniqueViolation, match="users_pkey"):
+        cl.execute("INSERT INTO users VALUES (1, 'carl')")
+    with pytest.raises(UniqueViolation, match="users_name_key"):
+        cl.execute("INSERT INTO users VALUES (3, 'ann')")
+
+
+def test_create_table_pk_validation_is_atomic(tmp_path):
+    """A failing implicit index must not leave a half-created table
+    (PostgreSQL: CREATE TABLE is all-or-nothing)."""
+    from citus_tpu.errors import UnsupportedFeatureError
+    cl = ct.Cluster(str(tmp_path / "db3"))
+    with pytest.raises(UnsupportedFeatureError):
+        cl.execute("CREATE TABLE bad (x double precision PRIMARY KEY)")
+    assert not cl.catalog.has_table("bad")
+    cl.execute("CREATE TABLE a (k bigint PRIMARY KEY)")
+    with pytest.raises(CatalogError):
+        # index name a_pkey is taken by table a
+        cl.execute("CREATE UNIQUE INDEX a_pkey ON a (k)")
+
+
+def test_unique_index_inside_transaction_overlay(db):
+    """Staged (uncommitted) rows of the open transaction also conflict."""
+    db.execute("CREATE UNIQUE INDEX items_id_key ON items (id)")
+    with db.session() as s:
+        db.execute("BEGIN", session=s)
+        db.execute("INSERT INTO items (id, grp, label, price) VALUES "
+                   "(770001, 1, 'a', 1.0)", session=s)
+        with pytest.raises(UniqueViolation):
+            db.execute("INSERT INTO items (id, grp, label, price) VALUES "
+                       "(770001, 2, 'b', 2.0)", session=s)
+        db.execute("ROLLBACK", session=s)
+    # rolled back: the value is free again
+    assert db.copy_from("items", rows=[(770001, 1, "c", 1.0)]) == 1
