@@ -229,7 +229,48 @@ class Catalog:
         # per-section dropped names since the last commit (merge guard)
         self._tombstones: dict[str, set] = {}
         self._doc_sig = None
+        # transactional-DDL staging guard: while one transaction stages
+        # DDL in this (shared) in-memory catalog, other sessions of the
+        # same process must not persist the document (their commit would
+        # durably leak the uncommitted DDL)
+        self._staging_cv = threading.Condition()
+        self._staging_txn = None
         self._load()
+
+    # ---- transactional-DDL staging guard ------------------------------
+    def _begin_staging(self, txn, timeout: float = 30.0) -> None:
+        import time as _time
+        with self._staging_cv:
+            deadline = _time.monotonic() + timeout
+            while self._staging_txn is not None and self._staging_txn is not txn:
+                rem = deadline - _time.monotonic()
+                if rem <= 0:
+                    from citus_tpu.utils.filelock import LockTimeout
+                    raise LockTimeout(
+                        "another transaction is staging DDL in this process")
+                self._staging_cv.wait(rem)
+            self._staging_txn = txn
+
+    def _end_staging(self, txn) -> None:
+        with self._staging_cv:
+            if self._staging_txn is txn:
+                self._staging_txn = None
+                self._staging_cv.notify_all()
+
+    def _await_no_staging(self, timeout: float = 30.0) -> None:
+        """Block a non-transactional catalog persist while another
+        session's transaction has DDL staged in memory."""
+        import time as _time
+        with self._staging_cv:
+            deadline = _time.monotonic() + timeout
+            while self._staging_txn is not None:
+                rem = deadline - _time.monotonic()
+                if rem <= 0:
+                    from citus_tpu.utils.filelock import LockTimeout
+                    raise LockTimeout(
+                        "a transaction with staged DDL is open; retry "
+                        "after it commits or rolls back")
+                self._staging_cv.wait(rem)
 
     # ---- persistence --------------------------------------------------
     def _path(self) -> str:
@@ -391,6 +432,21 @@ class Catalog:
         transport)."""
         from citus_tpu.testing.faults import FAULTS
         FAULTS.hit("catalog_commit")
+        from citus_tpu.storage.overlay import current_overlay
+        txn = current_overlay()
+        if txn is not None:
+            # transactional DDL: the statement mutated the in-memory
+            # catalog; persistence + invalidation broadcast happen once
+            # at COMMIT (Cluster._commit_txn), discard at ROLLBACK
+            # (reference: DDL rides the coordinated transaction,
+            # commands/utility_hook.c:148).  Staging claims the process-
+            # wide guard so no concurrent session persists the shared
+            # in-memory document (which now holds uncommitted DDL).
+            self._begin_staging(txn)
+            txn.catalog_dirty = True
+            txn.ddl_statements += 1
+            return
+        self._await_no_staging()
         tr = getattr(self, "commit_transport", None)
         if tr is not None and tr.commit_is_remote:
             try:
@@ -572,7 +628,19 @@ class Catalog:
             self._dict_index.pop(key, None)
             dp = self._dict_path(name, column)
             if os.path.exists(dp):
-                os.remove(dp)
+                from citus_tpu.storage.overlay import current_overlay
+                txn = current_overlay()
+                if txn is not None:
+                    # irreversible file removal: defer to COMMIT; a
+                    # re-added same-name column keeps its dictionary
+                    def _remove_dict(name=name, column=column, dp=dp):
+                        t2 = self.tables.get(name)
+                        if (t2 is None or not t2.schema.has(column)) \
+                                and os.path.exists(dp):
+                            os.remove(dp)
+                    txn.on_commit.append(_remove_dict)
+                else:
+                    os.remove(dp)
 
     def rename_column(self, name: str, old: str, new: str) -> None:
         from citus_tpu.schema import Column, Schema
@@ -634,8 +702,8 @@ class Catalog:
             self.ddl_epoch += 1
 
     def drop_table(self, name: str) -> None:
+        from citus_tpu.storage.overlay import current_overlay
         with self._lock:
-            import shutil
             t = self.table(name)
             del self.tables[name]
             self.tombstone("tables", name)
@@ -643,16 +711,46 @@ class Catalog:
             for key in [k for k in self._dicts if k[0] == name]:
                 del self._dicts[key]
                 self._dict_index.pop(key, None)
-            # remove on-disk shard data and dictionary side files so a
-            # recreated relation starts clean (reference: DROP TABLE drops
-            # shards via citus_drop_all_shards, operations/delete_protocol.c)
-            data_root = os.path.join(self.data_dir, "data", name)
-            if os.path.isdir(data_root):
-                shutil.rmtree(data_root, ignore_errors=True)
-            for col in t.schema.names:
-                dp = self._dict_path(name, col)
-                if os.path.exists(dp):
-                    os.remove(dp)
+            txn = current_overlay()
+            if txn is not None:
+                # transactional DROP: file removal is irreversible, so it
+                # runs only if the transaction commits.  Capture THIS
+                # incarnation's shard ids: a same-name table recreated
+                # later in the transaction gets fresh ids, and its files
+                # must survive the deferred removal.
+                cols = list(t.schema.names)
+                old_sids = [s.shard_id for s in t.shards]
+                txn.on_commit.append(
+                    lambda: self._remove_table_files(name, cols, old_sids))
+            else:
+                self._remove_table_files(name, list(t.schema.names))
+
+    def _remove_table_files(self, name: str, col_names: list[str],
+                            only_shard_ids: Optional[list[int]] = None) -> None:
+        """Remove on-disk shard data and dictionary side files so a
+        recreated relation starts clean (reference: DROP TABLE drops
+        shards via citus_drop_all_shards, operations/delete_protocol.c).
+        ``only_shard_ids`` (deferred transactional drop): if the table
+        exists again at commit time, remove only the dropped
+        incarnation's shard dirs and keep the shared dictionary files."""
+        import shutil
+        data_root = os.path.join(self.data_dir, "data", name)
+        recreated = only_shard_ids is not None and name in self.tables
+        if recreated:
+            # shard dirs are data/<table>/shard_<id>/placement_<node>
+            keep = {f"shard_{s.shard_id}" for s in self.tables[name].shards}
+            for sid in only_shard_ids:
+                entry = f"shard_{sid}"
+                if entry not in keep:
+                    shutil.rmtree(os.path.join(data_root, entry),
+                                  ignore_errors=True)
+            return
+        if os.path.isdir(data_root):
+            shutil.rmtree(data_root, ignore_errors=True)
+        for col in col_names:
+            dp = self._dict_path(name, col)
+            if os.path.exists(dp):
+                os.remove(dp)
 
     def distribute_table(self, name: str, dist_column: str, shard_count: int,
                          node_ids: list[int], colocate_with: Optional[str] = None,
@@ -807,6 +905,19 @@ class Catalog:
                 raise CatalogError(f'sequence "{name}" does not exist')
             cache = self._seq_cache.get(name)
             if cache is None or cache[0] == cache[1]:
+                from citus_tpu.storage.overlay import current_overlay
+                txn = current_overlay()
+                if txn is not None and txn.catalog_dirty:
+                    # block reservation persists the whole document; with
+                    # staged DDL in memory that would leak uncommitted
+                    # state to disk — fail closed
+                    from citus_tpu.errors import UnsupportedFeatureError
+                    raise UnsupportedFeatureError(
+                        "nextval needs a new block reservation, which "
+                        "cannot run after DDL in the same transaction")
+                # another session's staged DDL must not be persisted by
+                # our block reservation's document store
+                self._await_no_staging()
                 with _catalog_flock(self.data_dir):
                     # pick up foreign reservations before extending
                     self._merge_foreign_locked()

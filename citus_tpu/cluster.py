@@ -740,15 +740,20 @@ class Cluster:
             except Exception:
                 doc = None
         with self.catalog._lock:
-            self.catalog.tables.clear()
-            self.catalog.nodes.clear()
-            self.catalog._dicts.clear()
-            self.catalog._dict_index.clear()
-            self.catalog._dict_sig.clear()
+            # swap, never clear-then-refill: load_document reassigns each
+            # section dict atomically, so concurrent readers see either
+            # the old or the new state — no read-tear window
+            self.catalog._dicts = {}
+            self.catalog._dict_index = {}
+            self.catalog._dict_sig = {}
+            import os as _os
             if doc is not None:
                 self.catalog.load_document(doc)
-            else:
+            elif _os.path.exists(self.catalog._path()):
                 self.catalog._load()
+            else:
+                self.catalog.tables = {}
+                self.catalog.nodes = {}
             self.catalog.ddl_epoch += 1  # invalidate cached plans
         self._plan_cache.clear()
 
@@ -804,6 +809,17 @@ class Cluster:
                 if _os.path.isdir(d):
                     drop_segments(d, column)
 
+    def _drop_index_segments_if_unindexed(self, table_name: str,
+                                          column: str) -> None:
+        """Deferred (COMMIT-time) segment removal: a same-name index
+        recreated later in the transaction must keep its fresh segments;
+        a dropped table's removal owns its whole directory."""
+        if not self.catalog.has_table(table_name):
+            return
+        t2 = self.catalog.table(table_name)
+        if t2.index_on(column) is None:
+            self._drop_index_segments(t2, column)
+
     def create_index(self, name: str, table: str, column: str, *,
                      unique: bool = False,
                      if_not_exists: bool = False) -> None:
@@ -830,6 +846,7 @@ class Cluster:
         ix = {"name": name, "column": column, "unique": bool(unique)}
         # EXCLUSIVE write lock: no ingest may slip between the uniqueness
         # validation / backfill and the catalog flip
+        from citus_tpu.storage.overlay import current_overlay
         with self._write_lock(t, EXCLUSIVE):
             if unique:
                 from citus_tpu.integrity import validate_unique_backfill
@@ -837,6 +854,12 @@ class Cluster:
             # segments first, catalog second: a backfill failure must
             # leave no in-memory claim of an index that was never built
             backfill_index(self.catalog, t, [column])
+            txn = current_overlay()
+            if txn is not None:
+                # ROLLBACK must remove the backfilled segments (additive
+                # files: invisible to peers until the catalog commits)
+                txn.on_rollback.append(
+                    lambda: self._drop_index_segments(t, column))
             t.indexes.append(ix)
             t.version += 1
             self.catalog.ddl_epoch += 1
@@ -855,11 +878,20 @@ class Cluster:
             if stmt.if_exists:
                 return Result(columns=[], rows=[])
             raise CatalogError(f'index "{stmt.name}" does not exist')
+        from citus_tpu.storage.overlay import current_overlay
         from citus_tpu.transaction.locks import EXCLUSIVE
         with self._write_lock(t, EXCLUSIVE):
             t.indexes.remove(ix)
             # another index may not share the column (enforced at CREATE)
-            self._drop_index_segments(t, ix["column"])
+            txn = current_overlay()
+            if txn is not None:
+                # segment removal is irreversible: defer to COMMIT
+                col = ix["column"]
+                tname = t.name
+                txn.on_commit.append(
+                    lambda: self._drop_index_segments_if_unindexed(tname, col))
+            else:
+                self._drop_index_segments(t, ix["column"])
             t.version += 1
             self.catalog.ddl_epoch += 1
             self.catalog.commit()
@@ -1238,12 +1270,35 @@ class Cluster:
 
     def _guard_in_txn(self, stmt) -> None:
         if Cluster._TXN_ALLOWED is None:
-            Cluster._TXN_ALLOWED = (A.Select, A.WithSelect, A.SetOp,
-                                    A.Explain, A.Insert, A.Update, A.Delete)
+            Cluster._TXN_ALLOWED = (
+                A.Select, A.WithSelect, A.SetOp, A.Explain, A.Insert,
+                A.Update, A.Delete,
+                # transactional DDL: catalog mutations stage in memory
+                # (Catalog.commit defers), physical file actions defer to
+                # COMMIT / register rollback cleanups (reference: DDL in
+                # transaction blocks via citus_ProcessUtility,
+                # utility_hook.c:148)
+                A.CreateTable, A.DropTable, A.CreateIndex, A.DropIndex,
+                A.CreateSchema, A.CreateView, A.DropView, A.CreateSequence,
+                A.DropSequence, A.CreateFunction, A.DropFunction,
+                A.CreateType, A.DropType, A.CreateRole, A.DropRole,
+                A.Grant, A.CreatePolicy, A.DropPolicy, A.CreateTrigger,
+                A.DropTrigger, A.AlterTableRls, A.AlterTable,
+                A.UtilityCall)
         if not isinstance(stmt, Cluster._TXN_ALLOWED):
             raise UnsupportedFeatureError(
                 f"{type(stmt).__name__} cannot run inside a transaction "
                 "block")
+        if isinstance(stmt, A.AlterTable) and stmt.action in (
+                "rename_table", "rename_column"):
+            # renames shard-data directories / dictionary and segment
+            # files in place — not stageable
+            raise UnsupportedFeatureError(
+                "ALTER TABLE RENAME cannot run inside a transaction block")
+        if isinstance(stmt, A.UtilityCall) and stmt.name not in (
+                "create_distributed_table", "create_reference_table"):
+            raise UnsupportedFeatureError(
+                f"{stmt.name}() cannot run inside a transaction block")
 
     def _execute_transaction_stmt(self, session, stmt) -> Result:
         """BEGIN/COMMIT/ROLLBACK/SAVEPOINT state machine (reference:
@@ -1259,6 +1314,10 @@ class Cluster:
                                        "transaction in progress"})
             xid = self.txlog.begin()
             session.txn = OpenTransaction(xid, session.lock_sid)
+            # DDL rollback restores drop-tombstones along with the
+            # in-memory document
+            session.txn.tombstones_snapshot = {
+                k: set(v) for k, v in self.catalog._tombstones.items()}
             return Result(columns=[], rows=[], explain={"transaction": "begin"})
         if kind == "commit":
             if txn is None:
@@ -1290,12 +1349,12 @@ class Cluster:
                 raise InFailedTransaction(
                     "current transaction is aborted, commands ignored "
                     "until end of transaction block")
-            txn.savepoints.append((stmt.name, txn.snapshot()))
+            txn.savepoints.append((stmt.name, txn.snapshot(self.catalog)))
             return Result(columns=[], rows=[])
         if kind == "rollback_to":
             for i in range(len(txn.savepoints) - 1, -1, -1):
                 if txn.savepoints[i][0] == stmt.name:
-                    txn.restore(txn.savepoints[i][1])
+                    txn.restore(txn.savepoints[i][1], self)
                     # the savepoint itself survives (PostgreSQL keeps it
                     # so you can roll back to it again); later ones die
                     del txn.savepoints[i + 1:]
@@ -1329,28 +1388,41 @@ class Cluster:
 
         txn = session.txn
         try:
-            if not txn.has_writes:
+            if not (txn.has_writes or txn.catalog_dirty or txn.on_commit):
                 self.txlog.release(txn.xid)
                 return
             try:
-                # catalog (with version bumps) persisted before the
-                # COMMITTED record: roll-forward must find everything it
-                # references on disk (same ordering as ingest.finish)
+                # catalog (with version bumps + staged DDL) persisted
+                # before the COMMITTED record: roll-forward must find
+                # everything it references on disk (same ordering as
+                # ingest.finish).  The overlay is inactive here, so this
+                # commit persists and broadcasts for real — the single
+                # DDL-lease application point of the transaction's DDL.
                 for name in sorted(txn.tables):
                     if self.catalog.has_table(name):
                         self.catalog.table(name).version += 1
+                # release the staging guard just before the persist: this
+                # commit IS the transaction's DDL application point
+                self.catalog._end_staging(txn)
                 self.catalog.commit()
-                payload = {"kind": "txn",
-                           "placements": sorted(txn.delete_dirs),
-                           "ingest_placements": sorted(txn.ingest_dirs),
-                           "tables": sorted(txn.tables)}
-                self.txlog.log(txn.xid, TxState.PREPARED, payload)
-                self.txlog.log(txn.xid, TxState.COMMITTED, payload)
-                for d in sorted(txn.delete_dirs):
-                    commit_staged_deletes(d, txn.xid)
-                for d in sorted(txn.ingest_dirs):
-                    commit_staged(d, txn.xid)
-                self.txlog.log(txn.xid, TxState.DONE)
+                if txn.has_writes:
+                    payload = {"kind": "txn",
+                               "placements": sorted(txn.delete_dirs),
+                               "ingest_placements": sorted(txn.ingest_dirs),
+                               "tables": sorted(txn.tables)}
+                    self.txlog.log(txn.xid, TxState.PREPARED, payload)
+                    self.txlog.log(txn.xid, TxState.COMMITTED, payload)
+                    for d in sorted(txn.delete_dirs):
+                        commit_staged_deletes(d, txn.xid)
+                    for d in sorted(txn.ingest_dirs):
+                        commit_staged(d, txn.xid)
+                    self.txlog.log(txn.xid, TxState.DONE)
+                else:
+                    self.txlog.release(txn.xid)
+                # deferred physical DDL effects (segment drops, table
+                # file removal) — only after the catalog flip is durable
+                for act in txn.on_commit:
+                    act()
             except BaseException:
                 # stop driving; recovery decides the outcome from the log
                 self.txlog.release(txn.xid)
@@ -1361,6 +1433,7 @@ class Cluster:
                 for table, op, kw in txn.cdc_events:
                     self.cdc.emit(table, op, clock, **kw)
         finally:
+            self.catalog._end_staging(txn)
             txn.release_locks(self)
             session.txn = None
 
@@ -1374,9 +1447,24 @@ class Cluster:
                 abort_staged(d, txn.xid)
             for d in sorted(txn.delete_dirs):
                 abort_staged_deletes(d, txn.xid)
+            # physical artifacts staged by DDL (e.g. backfilled index
+            # segments) — remove in reverse order of creation
+            for act in reversed(txn.on_rollback):
+                try:
+                    act()
+                except Exception:
+                    pass  # best-effort: orphan files never affect reads
+            if txn.catalog_dirty:
+                # discard staged DDL: the on-disk document was never
+                # touched, so reloading it restores the pre-BEGIN state
+                self._reload_catalog()
+                self.catalog._tombstones = {
+                    k: set(v) for k, v in txn.tombstones_snapshot.items()}
             self.txlog.release(txn.xid)
             self._plan_cache.clear()
         finally:
+            # only now may other sessions persist the (restored) catalog
+            self.catalog._end_staging(txn)
             txn.release_locks(self)
             session.txn = None
 
@@ -1901,7 +1989,17 @@ class Cluster:
             elif stmt.action == "drop_column":
                 t0 = self.catalog.table(stmt.table)
                 if t0.index_on(stmt.old_name) is not None:
-                    self._drop_index_segments(t0, stmt.old_name)
+                    from citus_tpu.storage.overlay import current_overlay
+                    txn0 = current_overlay()
+                    if txn0 is not None:
+                        # irreversible file removal: defer to COMMIT
+                        col0 = stmt.old_name
+                        tname0 = t0.name
+                        txn0.on_commit.append(
+                            lambda: self._drop_index_segments_if_unindexed(
+                                tname0, col0))
+                    else:
+                        self._drop_index_segments(t0, stmt.old_name)
                     t0.indexes[:] = [ix for ix in t0.indexes
                                      if ix["column"] != stmt.old_name]
                 # PostgreSQL drops the table's own FK constraints that

@@ -70,6 +70,16 @@ class OpenTransaction:
         self.locks: dict[str, _HeldLock] = {}
         self.cdc_events: list[tuple] = []    # deferred to commit
         self.savepoints: list[tuple[str, dict]] = []
+        # ---- transactional DDL (reference: citus_ProcessUtility runs
+        # DDL inside the coordinated transaction, utility_hook.c:148).
+        # DDL statements mutate the in-memory catalog; Catalog.commit()
+        # defers persistence here, COMMIT persists once under the DDL
+        # lease, ROLLBACK reloads the untouched on-disk document.
+        self.catalog_dirty = False
+        self.ddl_statements = 0       # bumped per deferred catalog commit
+        self.on_commit: list = []     # deferred physical actions (file drops)
+        self.on_rollback: list = []   # cleanup of staged physical artifacts
+        self.tombstones_snapshot: dict = {}  # restored on rollback
 
     # ---- write registration -------------------------------------------
     def record_ingest(self, table_name: str, dirs) -> None:
@@ -123,8 +133,18 @@ class OpenTransaction:
                 cluster.locks.release(self.lock_sid, res)
             raise
         # a writer that just waited out a foreign mover must see the
-        # flipped placements (same rule as Cluster._write_lock)
-        cluster._maybe_reload_catalog(force_sync=True)
+        # flipped placements (same rule as Cluster._write_lock).  With
+        # staged DDL in memory a full reload would wipe it — merge the
+        # foreign document into the staged state instead (same merge the
+        # commit path uses): flipped placements arrive, staged objects
+        # survive.
+        if not self.catalog_dirty:
+            cluster._maybe_reload_catalog(force_sync=True)
+        else:
+            from citus_tpu.catalog.catalog import _catalog_flock
+            cat = cluster.catalog
+            with cat._lock, _catalog_flock(cat.data_dir):
+                cat._merge_foreign_locked()
 
     @staticmethod
     def _flock_with_timeout(fd: int, mode, timeout: float) -> None:
@@ -162,9 +182,11 @@ class OpenTransaction:
         cluster.locks.release_all(self.lock_sid)
 
     # ---- savepoints ----------------------------------------------------
-    def snapshot(self) -> dict:
+    def snapshot(self, catalog=None) -> dict:
         """Capture the transaction's staged side-file state (savepoint).
-        Small by construction: side files are metadata, not data."""
+        Small by construction: side files are metadata, not data.  With
+        ``catalog`` given, also captures the in-memory catalog document
+        so ROLLBACK TO can discard DDL staged after the savepoint."""
         from citus_tpu.storage.deletes import _staged_path as _del_staged
         from citus_tpu.storage.writer import _staged_path as _meta_staged
 
@@ -183,11 +205,55 @@ class OpenTransaction:
             "delete_dirs": set(self.delete_dirs),
             "tables": set(self.tables),
             "n_cdc": len(self.cdc_events),
+            "catalog_dirty": self.catalog_dirty,
+            "ddl_statements": self.ddl_statements,
+            "n_on_commit": len(self.on_commit),
+            "n_on_rollback": len(self.on_rollback),
+            # document captured only when DDL is already staged (a clean
+            # transaction restores from disk instead — no O(catalog)
+            # copy per savepoint on the DML path).  JSON round-trip:
+            # export_document shares mutable lists (indexes,
+            # foreign_keys) with the live TableMeta objects.
+            "catalog_doc": (json.loads(json.dumps(catalog.export_document()))
+                            if catalog is not None and self.catalog_dirty
+                            else None),
+            "tombstones": (None if catalog is None else
+                           {k: set(v)
+                            for k, v in catalog._tombstones.items()}),
         }
 
-    def restore(self, snap: dict) -> None:
+    def restore(self, snap: dict, cluster=None) -> None:
         """ROLLBACK TO SAVEPOINT: put every staged side file back to its
         snapshot content, deleting stripe files staged since."""
+        if snap.get("ddl_statements", 0) != self.ddl_statements:
+            # DDL staged after the savepoint: undo its physical
+            # artifacts, then restore the catalog as of the savepoint
+            for act in reversed(self.on_rollback[snap["n_on_rollback"]:]):
+                try:
+                    act()
+                except Exception:
+                    pass
+            del self.on_rollback[snap["n_on_rollback"]:]
+            del self.on_commit[snap["n_on_commit"]:]
+            if cluster is not None:
+                cat = cluster.catalog
+                if snap.get("catalog_doc") is not None:
+                    # mid-transaction DDL state: load the captured doc
+                    with cat._lock:
+                        cat.load_document(snap["catalog_doc"])
+                        cat.ddl_epoch += 1
+                else:
+                    # no DDL before the savepoint: disk still holds the
+                    # savepoint-time state
+                    cluster._reload_catalog()
+                if snap.get("tombstones") is not None:
+                    cat._tombstones = {k: set(v)
+                                       for k, v in snap["tombstones"].items()}
+            if not snap["catalog_dirty"] and cluster is not None:
+                # the staging guard was claimed by post-savepoint DDL
+                cluster.catalog._end_staging(self)
+            self.catalog_dirty = snap["catalog_dirty"]
+            self.ddl_statements = snap["ddl_statements"]
         from citus_tpu.storage.deletes import _staged_path as _del_staged
         from citus_tpu.storage.writer import _staged_path as _meta_staged
 

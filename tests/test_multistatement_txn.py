@@ -137,11 +137,17 @@ def test_error_aborts_block_until_rollback(cl):
     assert sorted(cl.execute("SELECT aid FROM accounts").rows) == [(1,), (2,)]
 
 
-def test_ddl_refused_in_transaction(cl):
+def test_unstageable_ddl_refused_in_transaction(cl):
+    """Most DDL now stages transactionally (round 4); statements with
+    in-place physical effects (directory renames, VACUUM) stay refused."""
     s = cl.session()
     s.execute("BEGIN")
     with pytest.raises(UnsupportedFeatureError):
-        s.execute("CREATE TABLE x (a bigint)")
+        s.execute("ALTER TABLE accounts RENAME TO accounts2")
+    s.execute("ROLLBACK")
+    s.execute("BEGIN")
+    with pytest.raises(UnsupportedFeatureError):
+        s.execute("VACUUM accounts")
     s.execute("ROLLBACK")
 
 
@@ -196,7 +202,7 @@ def test_ddl_refusal_aborts_block(cl):
     s.execute("BEGIN")
     s.execute("INSERT INTO accounts VALUES (15, 1)")
     with pytest.raises(UnsupportedFeatureError):
-        s.execute("CREATE TABLE x (a bigint)")
+        s.execute("ALTER TABLE accounts RENAME TO accounts2")
     # the refusal aborted the block: COMMIT rolls back
     r = s.execute("COMMIT")
     assert r.explain.get("transaction") == "rollback"

@@ -1,0 +1,240 @@
+"""Transactional DDL: CREATE/DROP TABLE, indexes, views, sequences &c.
+staged inside BEGIN..COMMIT and discarded by ROLLBACK.
+
+Reference: citus_ProcessUtility runs DDL inside transaction blocks with
+2PC (src/backend/distributed/commands/utility_hook.c:148; the 6-step
+sequence in distributed/README.md:1773-1799).  TPU-native shape: DDL
+mutates the in-memory catalog, Catalog.commit() defers persistence into
+the OpenTransaction, COMMIT persists once under the DDL lease,
+ROLLBACK reloads the untouched on-disk document; irreversible file
+actions (drops) defer to COMMIT, reversible artifacts (index segments)
+register rollback cleanups.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError, UnsupportedFeatureError
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"))
+    c.execute("CREATE TABLE base (k bigint, v bigint)")
+    c.execute("SELECT create_distributed_table('base', 'k', 4)")
+    c.copy_from("base", rows=[(i, i * 10) for i in range(100)])
+    return c
+
+
+def test_create_table_rollback_leaves_no_trace(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE t2 (a bigint, b text)")
+    s.execute("INSERT INTO t2 VALUES (1, 'x'), (2, 'y')")
+    assert s.execute("SELECT count(*) FROM t2").rows == [(2,)]
+    s.execute("ROLLBACK")
+    assert not cl.catalog.has_table("t2")
+    with pytest.raises(Exception):
+        cl.execute("SELECT count(*) FROM t2")
+
+
+def test_create_distribute_ingest_commit_is_atomic(cl, tmp_path):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE ev (id bigint, amt decimal(8,2))")
+    s.execute("SELECT create_distributed_table('ev', 'id', 4)")
+    s.copy_from("ev", rows=[(i, i / 4) for i in range(1000)])
+    # isolation: a second coordinator on the same data dir must not see
+    # the staged table before COMMIT (reference: uncommitted DDL is
+    # invisible to other backends)
+    peer = ct.Cluster(str(tmp_path / "db"))
+    assert not peer.catalog.has_table("ev")
+    s.execute("COMMIT")
+    assert cl.execute("SELECT count(*) FROM ev").rows == [(1000,)]
+    t = cl.catalog.table("ev")
+    assert t.is_distributed and t.shard_count == 4
+    peer2 = ct.Cluster(str(tmp_path / "db"))
+    assert peer2.execute("SELECT count(*) FROM ev").rows == [(1000,)]
+
+
+def test_drop_table_rollback_keeps_table_and_files(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("DROP TABLE base")
+    assert not cl.catalog.has_table("base")  # staged: invisible in-session
+    s.execute("ROLLBACK")
+    assert cl.catalog.has_table("base")
+    assert cl.execute("SELECT count(*) FROM base").rows == [(100,)]
+
+
+def test_drop_table_commit_removes_files(cl):
+    data_root = os.path.join(cl.catalog.data_dir, "data", "base")
+    assert os.path.isdir(data_root)
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("DROP TABLE base")
+    assert os.path.isdir(data_root)  # file removal deferred to COMMIT
+    s.execute("COMMIT")
+    assert not cl.catalog.has_table("base")
+    assert not os.path.isdir(data_root)
+
+
+def _seg_files(cl, table, column):
+    t = cl.catalog.table(table)
+    out = []
+    for shard in t.shards:
+        for node in shard.placements:
+            d = cl.catalog.shard_dir(table, shard.shard_id, node)
+            if os.path.isdir(d):
+                out += [f for f in os.listdir(d)
+                        if f.endswith(f".idx.{column}.npz")]
+    return out
+
+
+def test_create_index_rollback_removes_segments(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE INDEX base_v ON base (v)")
+    assert _seg_files(cl, "base", "v")  # backfilled (staged)
+    s.execute("ROLLBACK")
+    assert cl.catalog.table("base").indexes == []
+    assert _seg_files(cl, "base", "v") == []
+
+
+def test_drop_index_rollback_keeps_segments(cl):
+    cl.execute("CREATE INDEX base_v ON base (v)")
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("DROP INDEX base_v")
+    assert cl.catalog.table("base").indexes == []
+    assert _seg_files(cl, "base", "v")  # physical drop deferred
+    s.execute("ROLLBACK")
+    assert cl.catalog.table("base").index_on("v") is not None
+    assert _seg_files(cl, "base", "v")
+    r = cl.execute("EXPLAIN SELECT count(*) FROM base WHERE v = 50")
+    assert any("Index Lookup" in row[0] for row in r.rows)
+
+
+def test_create_index_commit_enforces_unique(cl):
+    from citus_tpu.integrity import UniqueViolation
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE UNIQUE INDEX base_k ON base (k)")
+    s.execute("COMMIT")
+    with pytest.raises(UniqueViolation):
+        cl.copy_from("base", rows=[(5, 999)])
+
+
+def test_savepoint_rolls_back_ddl(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE a1 (x bigint)")
+    s.execute("SAVEPOINT sp")
+    s.execute("CREATE TABLE b1 (y bigint)")
+    s.execute("CREATE INDEX base_v ON base (v)")
+    assert cl.catalog.has_table("b1")
+    s.execute("ROLLBACK TO SAVEPOINT sp")
+    assert cl.catalog.has_table("a1")
+    assert not cl.catalog.has_table("b1")
+    assert cl.catalog.table("base").indexes == []
+    assert _seg_files(cl, "base", "v") == []
+    s.execute("COMMIT")
+    assert cl.catalog.has_table("a1")
+    assert not cl.catalog.has_table("b1")
+
+
+def test_catalog_objects_stage_and_rollback(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE VIEW v1 AS SELECT k FROM base WHERE v > 500")
+    s.execute("CREATE SEQUENCE seq1 START 10")
+    s.execute("CREATE TYPE mood AS ENUM ('sad', 'ok', 'happy')")
+    s.execute("CREATE ROLE analyst")
+    assert s.execute("SELECT count(*) FROM v1").rows == [(49,)]
+    s.execute("ROLLBACK")
+    assert "v1" not in cl.catalog.views
+    assert "seq1" not in cl.catalog.sequences
+    assert "mood" not in cl.catalog.types
+    assert "analyst" not in cl.catalog.roles
+
+
+def test_failed_statement_after_ddl_rolls_back_cleanly(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE t3 (a bigint NOT NULL)")
+    with pytest.raises(Exception):
+        s.execute("INSERT INTO t3 VALUES (NULL)")
+    r = s.execute("COMMIT")  # aborted block: rolls back
+    assert r.explain.get("transaction") == "rollback"
+    assert not cl.catalog.has_table("t3")
+
+
+def test_nextval_block_reservation_after_ddl_refused(cl):
+    cl.execute("CREATE SEQUENCE s1")
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE t4 (a bigint)")
+    with pytest.raises(UnsupportedFeatureError):
+        s.execute("SELECT nextval('s1')")
+    s.execute("ROLLBACK")
+    # outside the block the sequence works
+    assert cl.execute("SELECT nextval('s1')").rows == [(1,)]
+
+
+def test_drop_recreate_table_in_txn_keeps_new_data(cl):
+    """The deferred file removal of the dropped incarnation must not
+    destroy the recreated table's committed data."""
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("DROP TABLE base")
+    s.execute("CREATE TABLE base (k bigint, v bigint)")
+    s.execute("INSERT INTO base VALUES (1, 111)")
+    s.execute("COMMIT")
+    assert cl.execute("SELECT count(*), sum(v) FROM base").rows == [(1, 111)]
+
+
+def test_drop_recreate_index_same_column_in_txn(cl):
+    cl.execute("CREATE INDEX base_v ON base (v)")
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("DROP INDEX base_v")
+    s.execute("CREATE INDEX base_v2 ON base (v)")
+    s.execute("COMMIT")
+    # the recreated index's segments survived the deferred drop
+    assert _seg_files(cl, "base", "v")
+    r = cl.execute("EXPLAIN SELECT count(*) FROM base WHERE v = 50")
+    assert any("base_v2" in row[0] for row in r.rows)
+    assert cl.execute("SELECT count(*) FROM base WHERE v = 500").rows == [(1,)]
+
+
+def test_concurrent_autocommit_ddl_blocked_while_staging(cl):
+    """Another session's catalog persist must not leak staged DDL; it
+    waits for the staging transaction (and times out, like a lock)."""
+    from citus_tpu.utils.filelock import LockTimeout
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE staged_t (a bigint)")
+    with pytest.raises(LockTimeout):
+        cl.catalog._await_no_staging(timeout=0.2)
+    s.execute("ROLLBACK")
+    cl.catalog._await_no_staging(timeout=0.2)  # free again
+    assert not cl.catalog.has_table("staged_t")
+    cl.execute("CREATE TABLE other (b bigint)")  # proceeds normally
+    assert cl.catalog.has_table("other")
+
+
+def test_ddl_commit_is_crash_atomic(cl, tmp_path):
+    """Kill between stage and COMMIT: a fresh coordinator sees nothing
+    (the on-disk document was never touched)."""
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE ghost (a bigint)")
+    s.copy_from("ghost", rows=[(1,)])
+    # simulate a crash: abandon the session/process without COMMIT
+    fresh = ct.Cluster(str(tmp_path / "db"))
+    assert not fresh.catalog.has_table("ghost")
+    fresh.maintenance.run_once()  # 2PC recovery sweeps the orphan xid
+    assert not fresh.catalog.has_table("ghost")
