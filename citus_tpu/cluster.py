@@ -587,6 +587,12 @@ class Cluster:
         # is a foreign change (avoids missing commits that land between
         # construction and the first execute)
         self._catalog_mtime = getattr(self.catalog, "self_mtime", None)
+        # the maintenance daemon starts with the cluster (reference: the
+        # per-database daemon starts with the database, maintenanced.c:138)
+        # — opt out via settings.start_maintenance_daemon for embedded
+        # uses that drive run_once() themselves
+        if self.settings.start_maintenance_daemon:
+            self.maintenance  # noqa: B018 — property constructs + starts
 
     def _peer_inflight(self) -> set:
         if self._control is not None:
@@ -636,6 +642,13 @@ class Cluster:
                            self.catalog, self.txlog,
                            peer_inflight=self._peer_inflight()),
                        interval_s=60.0)
+            # global deadlock detection (reference:
+            # CheckForDistributedDeadlocks every 2 s,
+            # distributed_deadlock_detection.c:105)
+            from citus_tpu.transaction.global_deadlock import run_detection
+            d.register("deadlock_detection",
+                       lambda: run_detection(self),
+                       interval_s=self.settings.deadlock_detection_interval_s)
             d.start()
             self._maintenance = d
         return self._maintenance

@@ -86,6 +86,13 @@ class Settings:
     sharding: ShardingSettings = field(default_factory=ShardingSettings)
     # reference GUC citus.enable_change_data_capture
     enable_change_data_capture: bool = False
+    # start the maintenance daemon with the cluster (reference: the
+    # per-database daemon starts with the database, maintenanced.c:138);
+    # opt-out for embedded/test uses that drive run_once() themselves
+    start_maintenance_daemon: bool = True
+    # cross-process deadlock detection cadence (reference default: every
+    # 2 s, citus.distributed_deadlock_detection_factor x deadlock_timeout)
+    deadlock_detection_interval_s: float = 2.0
 
     def replace(self, **kw) -> "Settings":
         return dataclasses.replace(self, **kw)

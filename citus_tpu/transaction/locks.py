@@ -119,6 +119,18 @@ class LockManager:
                     self._waiting_for.pop(session_id, None)
                     res.waiters = [(s, m) for s, m in res.waiters if s != session_id]
 
+    def cancel(self, session_id: int) -> None:
+        """Mark a session as a deadlock victim (global detector found a
+        cross-process cycle through it); its acquire() raises.  Only
+        sessions currently waiting at this layer are flagged — a victim
+        blocked in the flock layer is cancelled by its file marker, and
+        a stale _victims entry would kill the session id's next
+        unrelated acquire (thread idents are recycled)."""
+        with self._mu:
+            if session_id in self._waiting_for:
+                self._victims.add(session_id)
+                self._mu.notify_all()
+
     def holds(self, session_id: int, resource: str) -> Optional[str]:
         """Mode this session currently holds on the resource, if any."""
         with self._mu:
@@ -133,8 +145,9 @@ class LockManager:
             self._mu.notify_all()
 
     # ---- deadlock detection ----------------------------------------------
-    def wait_graph(self) -> dict[int, set[int]]:
-        """session -> sessions it waits on (BuildLocalWaitGraph analog)."""
+    def _wait_graph_locked(self) -> dict[int, set[int]]:
+        """session -> sessions it waits on (BuildLocalWaitGraph analog).
+        Caller must hold self._mu."""
         graph: dict[int, set[int]] = {}
         for session, resource in self._waiting_for.items():
             res = self._resources.get(resource)
@@ -145,31 +158,22 @@ class LockManager:
                 graph[session] = blockers
         return graph
 
+    def wait_graph(self) -> dict[int, set[int]]:
+        with self._mu:
+            return self._wait_graph_locked()
+
+    def session_starts(self) -> dict[int, float]:
+        with self._mu:
+            return dict(self._session_started)
+
     def _find_deadlock_victim(self) -> Optional[int]:
         """DFS cycle search; victim = youngest session in the cycle
-        (CheckForDistributedDeadlocks policy)."""
-        graph = self.wait_graph()
-        visited: set[int] = set()
-
-        def dfs(node: int, stack: list[int]) -> Optional[list[int]]:
-            if node in stack:
-                return stack[stack.index(node):]
-            if node in visited:
-                return None
-            visited.add(node)
-            stack.append(node)
-            for nxt in graph.get(node, ()):
-                cycle = dfs(nxt, stack)
-                if cycle is not None:
-                    return cycle
-            stack.pop()
-            return None
-
-        for start in list(graph):
-            cycle = dfs(start, [])
-            if cycle:
-                return max(cycle, key=lambda s: self._session_started.get(s, 0.0))
-        return None
+        (CheckForDistributedDeadlocks policy).  Runs under self._mu
+        (called from acquire); shares the cycle search with the global
+        detector so the two layers cannot diverge."""
+        from citus_tpu.transaction.global_deadlock import find_cycle_victim
+        return find_cycle_victim(self._wait_graph_locked(),
+                                 self._session_started)
 
     # ---- observability ----------------------------------------------------
     def lock_rows(self) -> list[tuple]:

@@ -61,8 +61,10 @@ class OpenTransaction:
     """State of one BEGIN..COMMIT block."""
 
     def __init__(self, xid: int, lock_sid: int):
+        import time as _time
         self.xid = xid
         self.lock_sid = lock_sid
+        self.started = _time.time()  # deadlock victim policy: youngest dies
         self.failed = False
         self.ingest_dirs: set[str] = set()   # staged stripes
         self.delete_dirs: set[str] = set()   # staged deletion bitmaps
@@ -105,29 +107,66 @@ class OpenTransaction:
             group_resource, lockfile_path,
         )
 
+        from citus_tpu.transaction.global_deadlock import (
+            flock_wait_instrumented, make_gpid, publish_hold,
+        )
+
         res = group_resource(table_meta)
         held = self.locks.get(res)
         if held is not None and (held.mode == EXCLUSIVE or held.mode == mode):
             return
         timeout = cluster.settings.executor.lock_timeout_s
+        data_dir = cluster.catalog.data_dir
+        gpid = make_gpid(self.lock_sid)
         # layer 1: in-process manager (deadlock detection; handles the
         # SHARED -> EXCLUSIVE upgrade as a re-acquire)
         cluster.locks.acquire(self.lock_sid, res, mode, timeout=timeout)
         try:
             flmode = fcntl.LOCK_SH if mode == SHARED else fcntl.LOCK_EX
             if held is not None:
-                # upgrade the existing fd in place (atomic wrt other fds)
-                self._flock_with_timeout(held.fd, flmode, timeout)
+                # SHARED -> EXCLUSIVE upgrade, converted in place on the
+                # held fd (a second fd would self-conflict: flock locks
+                # exclude between fds of one process).  Linux conversion
+                # is not atomic — a failed attempt silently DROPS the
+                # shared hold — so a contended upgrade fails CLOSED: one
+                # non-blocking attempt; on conflict the lock is released
+                # outright and the statement error aborts the block.
+                # Waiting here and succeeding later would resume the
+                # transaction after a foreign writer mutated the group —
+                # a silent 2PL violation.
+                try:
+                    fcntl.flock(held.fd, flmode | fcntl.LOCK_NB)
+                except OSError:
+                    try:
+                        fcntl.flock(held.fd, fcntl.LOCK_UN)
+                        os.close(held.fd)
+                    except OSError:
+                        pass
+                    del self.locks[res]
+                    cluster.locks.release(self.lock_sid, res)
+                    from citus_tpu.transaction.global_deadlock import (
+                        _record_path, clear_record,
+                    )
+                    clear_record(_record_path(data_dir, "h", gpid, res))
+                    from citus_tpu.errors import TransactionError
+                    raise TransactionError(
+                        f"could not upgrade write lock on {res!r} "
+                        "SHARED -> EXCLUSIVE (concurrent writer); "
+                        "transaction aborted — retry")
                 held.mode = mode
             else:
-                lockfile = lockfile_path(cluster.catalog.data_dir, res)
+                lockfile = lockfile_path(data_dir, res)
                 fd = os.open(lockfile, os.O_CREAT | os.O_RDWR)
                 try:
-                    self._flock_with_timeout(fd, flmode, timeout)
+                    flock_wait_instrumented(
+                        fd, flmode, timeout, data_dir=data_dir, gpid=gpid,
+                        res=res, mode=mode, started=self.started)
                 except BaseException:
                     os.close(fd)
                     raise
                 self.locks[res] = _HeldLock(mode, fd)
+            # advertise the hold for cross-process wait graphs
+            publish_hold(data_dir, gpid, res, mode, self.started)
         except BaseException:
             if held is None:
                 cluster.locks.release(self.lock_sid, res)
@@ -146,31 +185,12 @@ class OpenTransaction:
             with cat._lock, _catalog_flock(cat.data_dir):
                 cat._merge_foreign_locked()
 
-    @staticmethod
-    def _flock_with_timeout(fd: int, mode, timeout: float) -> None:
-        """utils.filelock.FileLock opens a fresh fd per acquisition, so
-        it cannot express the SHARED -> EXCLUSIVE upgrade-in-place a
-        retained transaction lock needs; this is the same poll loop
-        applied to an existing fd."""
-        import fcntl
-        import time
-
-        from citus_tpu.utils.filelock import LockTimeout
-
-        deadline = time.monotonic() + timeout
-        while True:
-            try:
-                fcntl.flock(fd, mode | fcntl.LOCK_NB)
-                return
-            except OSError:
-                if time.monotonic() >= deadline:
-                    raise LockTimeout(
-                        "could not acquire transaction write lock "
-                        f"within {timeout}s")
-                time.sleep(0.02)
-
     def release_locks(self, cluster) -> None:
         import fcntl
+
+        from citus_tpu.transaction.global_deadlock import (
+            check_cancelled, clear_holds, make_gpid,
+        )
         for res, held in self.locks.items():
             try:
                 fcntl.flock(held.fd, fcntl.LOCK_UN)
@@ -180,6 +200,9 @@ class OpenTransaction:
             cluster.locks.release(self.lock_sid, res)
         self.locks.clear()
         cluster.locks.release_all(self.lock_sid)
+        gpid = make_gpid(self.lock_sid)
+        clear_holds(cluster.catalog.data_dir, gpid)
+        check_cancelled(cluster.catalog.data_dir, gpid)  # consume stale marker
 
     # ---- savepoints ----------------------------------------------------
     def snapshot(self, catalog=None) -> dict:

@@ -24,7 +24,6 @@ import contextlib
 import threading
 
 from citus_tpu.transaction.locks import EXCLUSIVE, SHARED  # noqa: F401
-from citus_tpu.utils.filelock import FileLock
 
 
 def group_resource(table_meta) -> str:
@@ -46,7 +45,14 @@ def lockfile_path(data_dir: str, res: str) -> str:
 @contextlib.contextmanager
 def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
                      timeout: float = 30.0):
+    import fcntl
     import os
+    import time
+
+    from citus_tpu.transaction.global_deadlock import (
+        check_cancelled, clear_record, flock_wait_instrumented, make_gpid,
+        publish_hold,
+    )
     res = group_resource(table_meta)
     sid = threading.get_ident()
     if lock_manager is not None:
@@ -59,9 +65,33 @@ def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
             return
         lock_manager.acquire(sid, res, mode, timeout=timeout)
     try:
+        # statement-scoped writers participate in the global wait graph
+        # too: an autocommit ingest holding FK-parent locks can complete
+        # a cycle with a transaction in another process
+        gpid = make_gpid(sid)
         lockfile = lockfile_path(cat.data_dir, res)
-        with FileLock(lockfile, shared=(mode == SHARED), timeout=timeout):
+        fd = os.open(lockfile, os.O_CREAT | os.O_RDWR)
+        hold_rec = None
+        try:
+            flock_wait_instrumented(
+                fd, fcntl.LOCK_SH if mode == SHARED else fcntl.LOCK_EX,
+                timeout, data_dir=cat.data_dir, gpid=gpid, res=res,
+                mode=mode, started=time.time())
+            hold_rec = publish_hold(cat.data_dir, gpid, res, mode,
+                                    time.time())
             yield
+        finally:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            except OSError:
+                pass
+            os.close(fd)
+            if hold_rec is not None:
+                clear_record(hold_rec)
+            # consume any marker that raced our acquisition: thread
+            # idents are recycled, a stale marker must never abort a
+            # later unrelated statement
+            check_cancelled(cat.data_dir, gpid)
     finally:
         if lock_manager is not None:
             lock_manager.release(sid, res)

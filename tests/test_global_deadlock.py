@@ -1,0 +1,155 @@
+"""Global (cross-process) deadlock detection.
+
+Reference: BuildGlobalWaitGraph (transaction/lock_graph.c:142) +
+CheckForDistributedDeadlocks (distributed_deadlock_detection.c:105) —
+merged wait graph across nodes, DFS cycles, cancel the youngest.
+Here: holder/waiter records beside the flock lockfiles, assembled by
+the maintenance daemon; victims get a cancel marker their flock wait
+loops poll.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.transaction import global_deadlock as gd
+from citus_tpu.transaction.locks import EXCLUSIVE, SHARED
+
+
+def test_graph_and_victim_selection(tmp_path):
+    d = str(tmp_path)
+    gd.publish_hold(d, "100:1", "coloc:1", EXCLUSIVE, started=10.0)
+    gd.publish_wait(d, "100:1", "coloc:2", EXCLUSIVE, started=10.0)
+    gd.publish_hold(d, "200:2", "coloc:2", EXCLUSIVE, started=20.0)
+    gd.publish_wait(d, "200:2", "coloc:1", EXCLUSIVE, started=20.0)
+    edges, started = gd.build_global_graph(d)
+    assert edges["100:1"] == {"200:2"}
+    assert edges["200:2"] == {"100:1"}
+    victim = gd.find_cycle_victim(edges, started)
+    assert victim == "200:2"  # youngest dies
+
+
+def test_shared_holders_do_not_conflict(tmp_path):
+    d = str(tmp_path)
+    gd.publish_hold(d, "100:1", "coloc:1", SHARED, started=1.0)
+    gd.publish_wait(d, "200:2", "coloc:1", SHARED, started=2.0)
+    edges, _ = gd.build_global_graph(d)
+    assert edges == {}
+    gd.publish_wait(d, "300:3", "coloc:1", EXCLUSIVE, started=3.0)
+    edges, _ = gd.build_global_graph(d)
+    assert edges["300:3"] == {"100:1"}
+
+
+def test_dead_process_records_are_swept(tmp_path):
+    d = str(tmp_path)
+    p = gd.publish_hold(d, "999999:1", "coloc:1", EXCLUSIVE, started=1.0)
+    # overwrite with a guaranteed-dead pid
+    import json
+    rec = json.load(open(p))
+    rec["pid"] = 2 ** 22 - 7  # beyond pid_max on this box
+    json.dump(rec, open(p, "w"))
+    holds, waits, started = gd._load_records(d)
+    assert holds == {} and waits == []
+    assert not os.path.exists(p)
+
+
+CHILD = r"""
+import os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import citus_tpu as ct
+from citus_tpu.transaction.locks import DeadlockDetected
+
+data_dir, sync_dir = sys.argv[1], sys.argv[2]
+cl = ct.Cluster(data_dir)
+s = cl.session()
+s.execute("BEGIN")
+s.execute("UPDATE a SET v = v + 1 WHERE k = 1")   # lock group a
+open(os.path.join(sync_dir, "child_locked_a"), "w").close()
+deadline = time.time() + 30
+while not os.path.exists(os.path.join(sync_dir, "parent_locked_b")):
+    if time.time() > deadline:
+        print("SYNC_TIMEOUT"); sys.exit(2)
+    time.sleep(0.05)
+try:
+    s.execute("UPDATE b SET v = v + 1 WHERE k = 1")  # -> cycle
+    print("CHILD_COMPLETED")
+except DeadlockDetected:
+    print("CHILD_DEADLOCK_VICTIM")
+    s.execute("ROLLBACK")
+except Exception as e:
+    print("CHILD_OTHER:" + type(e).__name__)
+cl.close()
+"""
+
+
+def test_two_process_opposite_order_resolves_by_victim(tmp_path):
+    """The round-2 done-criterion: two processes taking group locks in
+    opposite order resolve by victim cancellation within the detection
+    interval — NOT by LockTimeout."""
+    data_dir = str(tmp_path / "db")
+    sync_dir = str(tmp_path / "sync")
+    os.makedirs(sync_dir)
+    cl = ct.Cluster(data_dir)
+    cl.execute("CREATE TABLE a (k bigint, v bigint)")
+    cl.execute("CREATE TABLE b (k bigint, v bigint)")
+    cl.create_distributed_table("a", "k", 2, colocate_with="none")
+    cl.create_distributed_table("b", "k", 2, colocate_with="none")
+    cl.copy_from("a", rows=[(1, 0)])
+    cl.copy_from("b", rows=[(1, 0)])
+
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    # parent transaction begins FIRST -> child is the younger victim
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("UPDATE b SET v = v + 1 WHERE k = 1")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo_root)
+    child = subprocess.Popen(
+        [sys.executable, str(script), data_dir, sync_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    deadline = time.time() + 60
+    while not os.path.exists(os.path.join(sync_dir, "child_locked_a")):
+        assert child.poll() is None, child.communicate()
+        assert time.time() < deadline, "child never locked a"
+        time.sleep(0.05)
+    open(os.path.join(sync_dir, "parent_locked_b"), "w").close()
+    time.sleep(0.3)  # let the child reach its blocking UPDATE b
+    t0 = time.time()
+    s.execute("UPDATE a SET v = v + 1 WHERE k = 1")  # blocks, then wins
+    elapsed = time.time() - t0
+    s.execute("COMMIT")
+    out, err = child.communicate(timeout=60)
+    assert "CHILD_DEADLOCK_VICTIM" in out, (out, err)
+    # resolved by cancellation (detection interval ~2s), not by the 30s
+    # lock timeout
+    assert elapsed < 15, f"took {elapsed:.1f}s — smells like LockTimeout"
+    assert cl.execute("SELECT v FROM a WHERE k = 1").rows == [(1,)]
+    assert cl.execute("SELECT v FROM b WHERE k = 1").rows == [(1,)]
+    cl.close()
+
+
+def test_daemon_registers_deadlock_duty(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    names = [d[0] for d in cl.maintenance.status()]
+    assert "deadlock_detection" in names
+    cl.close()
+
+
+def test_daemon_starts_with_cluster(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    assert cl._maintenance is not None          # started at open
+    assert cl.maintenance._thread is not None   # thread live
+    cl.close()
+    from citus_tpu.config import Settings
+    st = Settings(start_maintenance_daemon=False)
+    cl2 = ct.Cluster(str(tmp_path / "db2"), settings=st)
+    assert cl2._maintenance is None             # opt-out honored
+    cl2.close()
