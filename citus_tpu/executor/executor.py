@@ -181,6 +181,44 @@ def _iter_padded_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
                                bucket, si)
 
 
+def _repad_batch(b: ShardBatch, bucket: int) -> ShardBatch:
+    """Grow a padded batch to a larger bucket (mesh rounds stack, so all
+    members share one shape)."""
+    pad = bucket - b.padded_rows
+    if pad <= 0:
+        return b
+    cols = tuple(np.concatenate([c, np.zeros(pad, c.dtype)]) for c in b.cols)
+    valids = tuple(np.concatenate([v, np.ones(pad, bool)]) for v in b.valids)
+    mask = np.concatenate([b.row_mask, np.zeros(pad, bool)])
+    return ShardBatch(cols, valids, mask, b.n_rows, bucket, b.shard_index)
+
+
+def _run_mesh_round(plan, run, buf: list, n_dev: int, shard_sharding,
+                    p_stack, pv_stack, collect):
+    """Stack one round of host batches onto the mesh, run the sharded
+    worker+collective, and (optionally) retain the device-sharded inputs
+    for the HBM cache.  -> (device outputs, input bytes)."""
+    import jax
+    bucket = max(b.padded_rows for b in buf)
+    while len(buf) < n_dev:
+        buf.append(empty_batch(plan.bound.table, plan, bucket, -1))
+    buf = [_repad_batch(b, bucket) for b in buf]
+    cols = tuple(np.stack([b.cols[i] for b in buf])
+                 for i in range(len(plan.scan_columns)))
+    valids = tuple(np.stack([b.valids[i] for b in buf])
+                   for i in range(len(plan.scan_columns)))
+    mask = np.stack([b.row_mask for b in buf])
+    dcols = tuple(jax.device_put(c, shard_sharding) for c in cols)
+    dvalids = tuple(jax.device_put(v, shard_sharding) for v in valids)
+    dmask = jax.device_put(mask, shard_sharding)
+    out = run(dcols + p_stack, dvalids + pv_stack, dmask)
+    nbytes = (sum(c.nbytes for c in cols) + sum(v.nbytes for v in valids)
+              + mask.nbytes)
+    if collect is not None:
+        collect.append((dcols, dvalids, dmask))
+    return out, nbytes
+
+
 def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                       params=((), ())):
     import jax
@@ -203,37 +241,80 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     cached = None if overlaid else GLOBAL_CACHE.get(key)
 
     host_iter = None
-    if cached is None and len(devices) > 1:
-        batches = _load_all_batches(cat, plan, settings)
-        if not batches:
+    # a single-batch table cached under the non-mesh key serves from the
+    # single-device path below without touching disk — only enter the
+    # mesh machinery when no such entry exists
+    if len(devices) > 1 and cached is None:
+        from collections import deque
+        mesh = default_mesh()
+        n_dev = shard_axis_size(mesh)
+        # mesh cache entries are device-sharded stacks — a different
+        # structure than the single-device ShardBatch list, so they key
+        # separately
+        mkey = key + ("mesh", n_dev)
+        mcached = None if overlaid else GLOBAL_CACHE.get(mkey)
+        run = plan.runtime_cache.get("mesh_run")
+        if run is None:
+            worker = build_worker_fn(plan, jnp)
+            run = sharded_partial_agg(worker, kinds, mesh)
+            plan.runtime_cache["mesh_run"] = run
+        # parameters replicate across the shard axis ([n_dev] stacks of
+        # the 0-d values); never cached — they change per execution
+        p_stack = tuple(np.stack([p] * n_dev) for p in pcols)
+        pv_stack = tuple(np.stack([v] * n_dev) for v in pvalids)
+        acc: list = []
+        if mcached is not None:
+            for dcols, dvalids, dmask in mcached:
+                acc.append(run(dcols + p_stack, dvalids + pv_stack, dmask))
+            return combine_partials_host(
+                plan, [tuple(np.asarray(o) for o in out) for out in acc])
+        # streaming mesh path: group the lazy host stream into device
+        # rounds of n_dev, re-padded to the round's max bucket — the
+        # host never materializes more than one round plus the bounded
+        # in-flight window (SURVEY §2.4 "Pipelined ingest"; closes the
+        # round-3 gap where the mesh path loaded every batch up front)
+        from jax.sharding import NamedSharding, PartitionSpec
+        shard_sharding = NamedSharding(mesh, PartitionSpec("shard"))
+        collect: Optional[list] = None if overlaid else []
+        nbytes = 0
+        inflight: deque = deque()
+        stream = _iter_padded_batches(cat, plan, settings)
+        first = next(stream, None)
+        if first is None:
             return combine_partials_host(plan, [_empty_partials(plan, np)])
-        if len(batches) > 1:
-            acc: list = []
-            mesh = default_mesh()
-            n_dev = shard_axis_size(mesh)
-            run = plan.runtime_cache.get("mesh_run")
-            if run is None:
-                worker = build_worker_fn(plan, jnp)
-                run = sharded_partial_agg(worker, kinds, mesh)
-                plan.runtime_cache["mesh_run"] = run
-            bucket = batches[0].padded_rows
-            # parameters replicate across the shard axis ([n_dev] stacks
-            # of the 0-d values)
-            p_stack = tuple(np.stack([p] * n_dev) for p in pcols)
-            pv_stack = tuple(np.stack([v] * n_dev) for v in pvalids)
-            for start in range(0, len(batches), n_dev):
-                round_batches = batches[start:start + n_dev]
-                while len(round_batches) < n_dev:
-                    round_batches.append(empty_batch(plan.bound.table, plan, bucket, -1))
-                cols = tuple(np.stack([b.cols[i] for b in round_batches])
-                             for i in range(len(plan.scan_columns))) + p_stack
-                valids = tuple(np.stack([b.valids[i] for b in round_batches])
-                               for i in range(len(plan.scan_columns))) + pv_stack
-                row_mask = np.stack([b.row_mask for b in round_batches])
-                out = run(cols, valids, row_mask)
-                acc.append(tuple(np.asarray(o) for o in out))
-            return combine_partials_host(plan, acc)
-        host_iter = iter(batches)  # 1 batch: run it on the default device
+        second = next(stream, None)
+        if second is None:
+            host_iter = iter([first])  # 1 batch: default-device path
+        else:
+            import itertools as _it
+            buf: list = []
+            for hb in _it.chain([first, second], stream):
+                buf.append(hb)
+                if len(buf) < n_dev:
+                    continue
+                out, nb = _run_mesh_round(
+                    plan, run, buf, n_dev, shard_sharding,
+                    p_stack, pv_stack, collect)
+                acc.append(out)
+                nbytes += nb
+                buf = []
+                if collect is not None and nbytes > GLOBAL_CACHE.capacity:
+                    collect = None  # working set exceeds HBM cache: stream
+                if collect is None:
+                    inflight.append(out)
+                    if len(inflight) > PREFETCH_DEPTH:
+                        jax.block_until_ready(inflight.popleft())
+            if buf:
+                out, nb = _run_mesh_round(
+                    plan, run, buf, n_dev, shard_sharding,
+                    p_stack, pv_stack, collect)
+                acc.append(out)
+                nbytes += nb
+            if collect is not None and nbytes <= GLOBAL_CACHE.capacity:
+                jax.block_until_ready([r[0] for r in collect])
+                GLOBAL_CACHE.put(mkey, collect, nbytes)
+            return combine_partials_host(
+                plan, [tuple(np.asarray(o) for o in out) for out in acc])
 
     # ---- single-device path: streaming pipeline + HBM pinning --------
     from collections import deque

@@ -54,10 +54,11 @@ def _write_record(path: str, rec: dict) -> None:
 
 
 def publish_wait(data_dir: str, gpid: str, res: str, mode: str,
-                 started: float) -> str:
+                 started: float, nonce: Optional[str] = None) -> str:
     p = _record_path(data_dir, "w", gpid, res)
     _write_record(p, {"gpid": gpid, "resource": res, "mode": mode,
-                      "started": started, "pid": os.getpid()})
+                      "started": started, "pid": os.getpid(),
+                      "nonce": nonce})
     return p
 
 
@@ -87,24 +88,37 @@ def clear_holds(data_dir: str, gpid: str) -> None:
 
 
 # ---- cancellation markers ------------------------------------------------
+# A marker targets one specific WAIT (by nonce), not a gpid: thread-ident
+# gpids are recycled, and a marker computed from a stale graph snapshot
+# must never abort a later unrelated statement that reuses the id.
 
 def _cancel_path(data_dir: str, gpid: str) -> str:
     return os.path.join(waiters_dir(data_dir),
                         f"cancel_{gpid.replace(':', '_')}")
 
 
-def request_cancel(data_dir: str, gpid: str) -> None:
-    with open(_cancel_path(data_dir, gpid), "w") as fh:
-        fh.write(str(time.time()))
+def request_cancel(data_dir: str, gpid: str,
+                   nonce: Optional[str] = None) -> None:
+    _write_record(_cancel_path(data_dir, gpid),
+                  {"at": time.time(), "nonce": nonce})
 
 
-def check_cancelled(data_dir: str, gpid: str) -> bool:
-    """Consume this transaction's cancel marker if present."""
+def check_cancelled(data_dir: str, gpid: str,
+                    nonce: Optional[str] = None) -> bool:
+    """Consume this wait's cancel marker.  A marker with a different
+    nonce is stale (aimed at a previous wait of a recycled id): it is
+    removed and ignored."""
     p = _cancel_path(data_dir, gpid)
-    if os.path.exists(p):
+    if not os.path.exists(p):
+        return False
+    try:
+        with open(p) as fh:
+            rec = json.load(fh)
+    except (OSError, ValueError):
         clear_record(p)
-        return True
-    return False
+        return False
+    clear_record(p)
+    return nonce is None or rec.get("nonce") in (None, nonce)
 
 
 def _pid_alive(pid: int) -> bool:
@@ -120,8 +134,8 @@ def _pid_alive(pid: int) -> bool:
 # ---- the detector --------------------------------------------------------
 
 def _load_records(data_dir: str):
-    """-> (holds: {res: [(gpid, mode)]}, waits: [(gpid, res, mode)],
-    started: {gpid: t}), dropping records of dead processes."""
+    """-> (holds: {res: [(gpid, mode)]}, waits: [(gpid, res, mode,
+    nonce)], started: {gpid: t}), dropping records of dead processes."""
     d = waiters_dir(data_dir)
     holds: dict[str, list] = {}
     waits: list[tuple] = []
@@ -143,8 +157,64 @@ def _load_records(data_dir: str):
         if f.startswith("h_"):
             holds.setdefault(rec["resource"], []).append((gpid, rec["mode"]))
         else:
-            waits.append((gpid, rec["resource"], rec["mode"]))
+            waits.append((gpid, rec["resource"], rec["mode"],
+                          rec.get("nonce")))
     return holds, waits, started
+
+
+# ---- manager-layer graph dumps -------------------------------------------
+# In-process LockManager waits never touch the flock layer, so they are
+# invisible in the hold/wait records.  Each process's detector dumps its
+# local manager graph; every detector merges all live dumps — a cycle
+# spanning two processes' manager layers is then visible to both.
+
+def _graph_dump_path(data_dir: str, pid: int) -> str:
+    return os.path.join(waiters_dir(data_dir), f"graph_{pid}.json")
+
+
+def dump_local_graph(data_dir: str, local_graph: dict,
+                     local_started: dict) -> None:
+    pid = os.getpid()
+    p = _graph_dump_path(data_dir, pid)
+    if not local_graph:
+        clear_record(p)
+        return
+    _write_record(p, {
+        "pid": pid,
+        "edges": {str(s): [str(b) for b in blockers]
+                  for s, blockers in local_graph.items()},
+        "started": {str(s): t for s, t in local_started.items()},
+    })
+
+
+def _load_graph_dumps(data_dir: str, skip_pid: Optional[int] = None):
+    """-> (edges {gpid: set}, started {gpid: t}) from every live
+    process's manager-graph dump."""
+    d = waiters_dir(data_dir)
+    edges: dict[str, set] = {}
+    started: dict[str, float] = {}
+    for f in os.listdir(d):
+        if not f.startswith("graph_"):
+            continue
+        p = os.path.join(d, f)
+        try:
+            with open(p) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        pid = int(rec.get("pid", -1))
+        if pid == skip_pid:
+            continue
+        if not _pid_alive(pid):
+            clear_record(p)
+            continue
+        for s, blockers in rec.get("edges", {}).items():
+            node = f"{pid}:{s}"
+            edges.setdefault(node, set()).update(
+                f"{pid}:{b}" for b in blockers)
+        for s, t in rec.get("started", {}).items():
+            started.setdefault(f"{pid}:{s}", t)
+    return edges, started
 
 
 def build_global_graph(data_dir: str,
@@ -160,13 +230,22 @@ def build_global_graph(data_dir: str,
     layer participants too."""
     holds, waits, started = _load_records(data_dir)
     edges: dict[str, set] = {}
-    for gpid, res, mode in waits:
+    wait_nonces: dict[str, str] = {}
+    for gpid, res, mode, nonce in waits:
+        if nonce is not None:
+            wait_nonces[gpid] = nonce
         for holder, hmode in holds.get(res, ()):
             if holder == gpid:
                 continue
             if mode == SHARED and hmode == SHARED:
                 continue
             edges.setdefault(gpid, set()).add(holder)
+    # other processes' manager-layer graphs (their detectors dump them)
+    fedges, fstarted = _load_graph_dumps(data_dir, skip_pid=os.getpid())
+    for node, blockers in fedges.items():
+        edges.setdefault(node, set()).update(blockers)
+    for node, t0 in fstarted.items():
+        started.setdefault(node, t0)
     if local_graph:
         pfx = local_prefix or str(os.getpid())
         for sid, blockers in local_graph.items():
@@ -175,7 +254,7 @@ def build_global_graph(data_dir: str,
                 edges.setdefault(node, set()).add(f"{pfx}:{b}")
         for sid, t0 in (local_started or {}).items():
             started.setdefault(f"{pfx}:{sid}", t0)
-    return edges, started
+    return edges, started, wait_nonces
 
 
 def find_cycle_victim(edges: dict, started: dict) -> Optional[str]:
@@ -211,20 +290,31 @@ def run_detection(cluster) -> Optional[str]:
     if not os.path.isdir(os.path.join(data_dir, ".waiters")):
         return None
     local = cluster.locks.wait_graph()
-    edges, started = build_global_graph(
-        data_dir, local_graph=local,
-        local_started=cluster.locks.session_starts())
+    local_started = cluster.locks.session_starts()
+    # share our manager layer with other processes' detectors (a cycle
+    # through two processes' manager layers is invisible to either side
+    # alone)
+    dump_local_graph(data_dir, local, local_started)
+    edges, started, wait_nonces = build_global_graph(
+        data_dir, local_graph=local, local_started=local_started)
     victim = find_cycle_victim(edges, started)
     if victim is None:
         return None
-    request_cancel(data_dir, victim)
     pid_s, _, sid_s = victim.partition(":")
-    if pid_s == str(os.getpid()):
-        # manager-layer waiters of this process don't poll files
+    is_local = pid_s == str(os.getpid())
+    if victim in wait_nonces:
+        # flock-layer waiter (any process): targeted marker
+        request_cancel(data_dir, victim, wait_nonces[victim])
+    elif is_local:
+        # manager-layer waiter of this process: flag it directly
         try:
             cluster.locks.cancel(int(sid_s))
         except ValueError:
-            pass
+            return None
+    else:
+        # remote manager-layer victim: its own daemon sees the same
+        # merged graph (we just dumped ours) and cancels it locally
+        return None
     try:
         from citus_tpu.executor.executor import GLOBAL_COUNTERS
         GLOBAL_COUNTERS.bump("deadlocks_cancelled")
@@ -251,19 +341,21 @@ def flock_wait_instrumented(fd: int, flmode, timeout: float, *,
         return  # uncontended: no record churn
     except OSError:
         pass
-    wait_rec = publish_wait(data_dir, gpid, res, mode, started)
+    # the nonce scopes cancellation to THIS wait: markers computed from a
+    # stale snapshot (or aimed at a previous wait of a recycled thread
+    # ident) are discarded, never spuriously aborting a new statement
+    nonce = os.urandom(8).hex()
+    wait_rec = publish_wait(data_dir, gpid, res, mode, started, nonce)
     try:
         deadline = time.monotonic() + timeout
         while True:
             try:
                 fcntl.flock(fd, flmode | fcntl.LOCK_NB)
-                # a marker written as we acquired is stale: this wait
-                # edge is gone, and gpids (thread idents) are recycled —
-                # consume it so it cannot abort an unrelated statement
-                check_cancelled(data_dir, gpid)
+                # a marker written as we acquired is stale: consume it
+                check_cancelled(data_dir, gpid, nonce)
                 return
             except OSError:
-                if check_cancelled(data_dir, gpid):
+                if check_cancelled(data_dir, gpid, nonce):
                     raise DeadlockDetected(
                         f"deadlock detected; transaction {gpid} cancelled")
                 if time.monotonic() >= deadline:

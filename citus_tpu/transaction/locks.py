@@ -51,7 +51,10 @@ class LockManager:
     # ---- session lifecycle ---------------------------------------------
     def begin_session(self, session_id: int) -> None:
         with self._mu:
-            self._session_started.setdefault(session_id, time.monotonic())
+            # wall clock, not monotonic: start times feed the GLOBAL
+            # youngest-dies victim policy, where they compare against
+            # other processes' wall-clock records
+            self._session_started.setdefault(session_id, time.time())
 
     def release_all(self, session_id: int) -> None:
         with self._mu:

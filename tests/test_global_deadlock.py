@@ -26,7 +26,7 @@ def test_graph_and_victim_selection(tmp_path):
     gd.publish_wait(d, "100:1", "coloc:2", EXCLUSIVE, started=10.0)
     gd.publish_hold(d, "200:2", "coloc:2", EXCLUSIVE, started=20.0)
     gd.publish_wait(d, "200:2", "coloc:1", EXCLUSIVE, started=20.0)
-    edges, started = gd.build_global_graph(d)
+    edges, started, _nonces = gd.build_global_graph(d)
     assert edges["100:1"] == {"200:2"}
     assert edges["200:2"] == {"100:1"}
     victim = gd.find_cycle_victim(edges, started)
@@ -37,11 +37,53 @@ def test_shared_holders_do_not_conflict(tmp_path):
     d = str(tmp_path)
     gd.publish_hold(d, "100:1", "coloc:1", SHARED, started=1.0)
     gd.publish_wait(d, "200:2", "coloc:1", SHARED, started=2.0)
-    edges, _ = gd.build_global_graph(d)
+    edges, _, _ = gd.build_global_graph(d)
     assert edges == {}
     gd.publish_wait(d, "300:3", "coloc:1", EXCLUSIVE, started=3.0)
-    edges, _ = gd.build_global_graph(d)
+    edges, _, _ = gd.build_global_graph(d)
     assert edges["300:3"] == {"100:1"}
+
+
+def test_manager_layer_cycle_across_processes(tmp_path, monkeypatch):
+    """A cycle threading two processes' in-process manager layers is
+    only visible once each process dumps its manager graph: P111.s1
+    ->(mgr) P111.s2 ->(flock r2) P222.s3 ->(mgr) P222.s4 ->(flock r1)
+    -> P111.s1."""
+    d = str(tmp_path)
+    monkeypatch.setattr(gd, "_pid_alive", lambda pid: True)
+    gd._write_record(gd._graph_dump_path(d, 111),
+                     {"pid": 111, "edges": {"1": ["2"]},
+                      "started": {"1": 10.0, "2": 11.0}})
+    gd._write_record(gd._record_path(d, "w", "111:2", "r2"),
+                     {"gpid": "111:2", "resource": "r2", "mode": EXCLUSIVE,
+                      "started": 11.0, "pid": 111, "nonce": "abc"})
+    gd._write_record(gd._record_path(d, "h", "222:3", "r2"),
+                     {"gpid": "222:3", "resource": "r2", "mode": EXCLUSIVE,
+                      "started": 12.0, "pid": 222})
+    gd._write_record(gd._graph_dump_path(d, 222),
+                     {"pid": 222, "edges": {"3": ["4"]},
+                      "started": {"3": 12.0, "4": 13.0}})
+    gd._write_record(gd._record_path(d, "w", "222:4", "r1"),
+                     {"gpid": "222:4", "resource": "r1", "mode": EXCLUSIVE,
+                      "started": 13.0, "pid": 222, "nonce": "def"})
+    gd._write_record(gd._record_path(d, "h", "111:1", "r1"),
+                     {"gpid": "111:1", "resource": "r1", "mode": EXCLUSIVE,
+                      "started": 10.0, "pid": 111})
+    edges, started, nonces = gd.build_global_graph(d)
+    victim = gd.find_cycle_victim(edges, started)
+    assert victim == "222:4"          # youngest across all four layers
+    assert nonces[victim] == "def"    # cancellable by targeted marker
+
+
+def test_stale_cancel_marker_is_ignored(tmp_path):
+    d = str(tmp_path)
+    gd.request_cancel(d, "100:7", nonce="old-wait")
+    # a NEW wait with a different nonce must not be aborted by it
+    assert gd.check_cancelled(d, "100:7", nonce="new-wait") is False
+    # and the stale marker was consumed
+    assert gd.check_cancelled(d, "100:7", nonce="new-wait") is False
+    gd.request_cancel(d, "100:7", nonce="new-wait")
+    assert gd.check_cancelled(d, "100:7", nonce="new-wait") is True
 
 
 def test_dead_process_records_are_swept(tmp_path):

@@ -238,10 +238,15 @@ def test_shard_replication_factor(tmp_path):
     cl.execute("UPDATE t SET v = 0 WHERE k < 100")
     expected = 12497500 - 4950
     assert cl.execute("SELECT sum(v) FROM t").rows == [(expected,)]
-    # lose one replica of every shard: reads fail over, results unchanged
+    # lose one replica of every shard: reads fail over, results unchanged.
+    # Drop the HBM batch cache first — it would (validly) serve the query
+    # without touching the lost placement, and this test is about the
+    # disk-read failover path.
     for s in t.shards:
         shutil.rmtree(cl.catalog.shard_dir("t", s.shard_id, s.placements[0]),
                       ignore_errors=True)
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
     assert cl.execute("SELECT count(*), sum(v) FROM t").rows == \
         [(5000, expected)]
     assert cl.counters.snapshot().get("connection_failovers", 0) > 0
