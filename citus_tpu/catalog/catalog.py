@@ -85,6 +85,13 @@ class TableMeta:
     # sorted segments beside the stripe files (reference: pg_index rows +
     # columnar_index_build_range_scan, columnar_tableam.c:1444)
     indexes: list = field(default_factory=list)
+    # declarative range partitioning (reference: PostgreSQL partitioned
+    # tables + multi_partitioning_utils.c helpers).  A parent carries
+    # partition_by = {"column", "kind": "range"} and holds no data; a
+    # partition carries partition_of = {"parent", "lo", "hi"} with
+    # PHYSICAL bounds, lo inclusive / hi exclusive (None = unbounded)
+    partition_by: Optional[dict] = None
+    partition_of: Optional[dict] = None
 
     @property
     def shard_count(self) -> int:
@@ -113,6 +120,10 @@ class TableMeta:
     def index_columns(self) -> list[str]:
         return [ix["column"] for ix in self.indexes]
 
+    @property
+    def is_partitioned(self) -> bool:
+        return self.partition_by is not None
+
     def to_json(self):
         return {
             "name": self.name, "schema": self.schema.to_json(),
@@ -126,6 +137,8 @@ class TableMeta:
             "version": self.version,
             "foreign_keys": self.foreign_keys,
             "indexes": self.indexes,
+            "partition_by": self.partition_by,
+            "partition_of": self.partition_of,
         }
 
     @staticmethod
@@ -142,6 +155,8 @@ class TableMeta:
             version=d.get("version", 0),
             foreign_keys=d.get("foreign_keys", []),
             indexes=d.get("indexes", []),
+            partition_by=d.get("partition_by"),
+            partition_of=d.get("partition_of"),
         )
 
 
@@ -529,6 +544,15 @@ class Catalog:
             self._store_locked()
 
     # ---- tables -------------------------------------------------------
+    def partitions_of(self, parent: str) -> list[TableMeta]:
+        """Range partitions of a parent, ordered by lower bound
+        (None-lo first)."""
+        parts = [t for t in self.tables.values()
+                 if t.partition_of is not None
+                 and t.partition_of["parent"] == parent]
+        return sorted(parts, key=lambda t: (
+            t.partition_of["lo"] is not None, t.partition_of["lo"] or 0))
+
     def table(self, name: str) -> TableMeta:
         t = self.tables.get(name)
         if t is None:

@@ -790,6 +790,11 @@ class Cluster:
             return
         from citus_tpu.integrity import forbid_drop_referenced
         forbid_drop_referenced(self.catalog, name)
+        t = self.catalog.table(name)
+        if t.is_partitioned:
+            # PostgreSQL: dropping the parent drops its partitions
+            for p in list(self.catalog.partitions_of(name)):
+                self.drop_table(p.name)
         self.catalog.drop_table(name)
         for key in [k for k in self.catalog.enum_columns
                     if k.startswith(name + ".")]:
@@ -803,6 +808,113 @@ class Cluster:
             del self.catalog.triggers[tn]
             self.catalog.tombstone("triggers", tn)
         self.catalog.commit()
+
+    # ------------------------------------------------------- partitioning
+    def _create_partition(self, name: str, parent: str, lo_raw, hi_raw,
+                          *, if_not_exists: bool = False) -> None:
+        """CREATE TABLE name PARTITION OF parent FOR VALUES FROM..TO:
+        clone the parent's schema, record physical bounds, inherit the
+        parent's distribution (siblings colocate).  Reference:
+        PostgreSQL partition DDL distributed per-partition
+        (multi_partitioning_utils.c)."""
+        from citus_tpu.partitioning import bound_to_physical, check_new_partition
+        if if_not_exists and self.catalog.has_table(name):
+            return
+        pt = self.catalog.table(parent)
+        if not pt.is_partitioned:
+            raise CatalogError(f'"{parent}" is not partitioned')
+        col = pt.schema.column(pt.partition_by["column"])
+        lo = bound_to_physical(col.type, lo_raw)
+        hi = bound_to_physical(col.type, hi_raw)
+        check_new_partition(self.catalog, pt, lo, hi)
+        self.catalog.create_table(
+            name, pt.schema,
+            chunk_row_limit=pt.chunk_row_limit,
+            stripe_row_limit=pt.stripe_row_limit,
+            compression=pt.compression,
+            compression_level=pt.compression_level)
+        t = self.catalog.table(name)
+        t.partition_of = {"parent": parent, "lo": lo, "hi": hi}
+        # constraints declared on the parent apply to every partition
+        # (PostgreSQL propagates both; unique keys were validated at
+        # parent creation to include the partition column)
+        import json as _json
+        t.foreign_keys = _json.loads(_json.dumps(pt.foreign_keys))
+        if pt.method == DistributionMethod.HASH:
+            siblings = [p for p in self.catalog.partitions_of(parent)
+                        if p.name != name and p.is_distributed]
+            self.catalog.distribute_table(
+                name, pt.dist_column,
+                pt.partition_by.get("shard_count")
+                or self.settings.sharding.shard_count,
+                self.catalog.active_node_ids(),
+                colocate_with=siblings[0].name if siblings else None,
+                replication_factor=self.settings.sharding.shard_replication_factor)
+        self.catalog.commit()
+        for ix in pt.indexes:
+            self.create_index(f"{name}_{ix['column']}_key", name,
+                              ix["column"], unique=ix.get("unique", False))
+        self._plan_cache.clear()
+
+    def _partition_dml(self, stmt, t) -> Result:
+        """UPDATE/DELETE against a partitioned parent: run per surviving
+        partition (pruned on the WHERE) and sum the counts."""
+        import dataclasses
+        from citus_tpu.partitioning import prune_partitions
+        if getattr(stmt, "returning", None):
+            raise UnsupportedFeatureError(
+                "RETURNING on a partitioned parent is not supported")
+        if isinstance(stmt, A.Update):
+            pcol = t.partition_by["column"]
+            if any(c == pcol for c, _ in stmt.assignments):
+                raise UnsupportedFeatureError(
+                    "updating the partition column through the parent "
+                    "(row movement) is not supported; update the "
+                    "partition directly")
+        total_key = "updated" if isinstance(stmt, A.Update) else "deleted"
+        total = 0
+        for p in prune_partitions(self.catalog, t, stmt.where):
+            sub = dataclasses.replace(stmt, table=p.name)
+            r = self._execute_stmt(sub)
+            total += r.explain.get(total_key, 0)
+        return Result(columns=[], rows=[], explain={total_key: total})
+
+    def _copy_into_partitions(self, t, columns) -> int:
+        """Route an ingest batch against a partitioned parent to its
+        partitions by range (the multi-level ShardIdForTuple)."""
+        from citus_tpu.partitioning import partition_for_rows
+        pcol = t.partition_by["column"]
+        if pcol not in columns:
+            raise AnalysisError(f"missing column {pcol!r} in ingest batch")
+        col = t.schema.column(pcol)
+        raw = columns[pcol]
+        if isinstance(raw, np.ndarray) and raw.dtype != object \
+                and raw.dtype.kind in "iuf":
+            # mirror encode_columns' numeric fast path exactly (decimal
+            # floats scale by 10^scale with ROUND_HALF_UP; integer input
+            # is already physical), so routing and storage agree
+            if col.type.kind == "decimal" \
+                    and np.issubdtype(raw.dtype, np.floating):
+                x = raw * float(10 ** col.type.scale)
+                phys = np.where(x >= 0, np.floor(x + 0.5),
+                                np.ceil(x - 0.5)).astype(np.int64)
+            else:
+                phys = raw.astype(col.type.storage_dtype)
+        else:
+            vals = list(raw)
+            if any(v is None for v in vals):
+                raise AnalysisError(
+                    f'no partition of relation "{t.name}" found for row '
+                    f"({pcol} is null)")
+            phys = np.asarray([col.type.to_physical(v) for v in vals])
+        n = 0
+        cols_np = {c: (v if isinstance(v, np.ndarray)
+                       else np.asarray(v, dtype=object))
+                   for c, v in columns.items()}
+        for pname, mask in partition_for_rows(self.catalog, t, phys):
+            sub = {c: v[mask] for c, v in cols_np.items()}
+            n += self.copy_from(pname, columns=sub)
+        return n
 
     # ----------------------------------------------------------- indexes
     def _find_index(self, name: str):
@@ -848,6 +960,10 @@ class Cluster:
                 return
             raise CatalogError(f'index "{name}" already exists')
         t = self.catalog.table(table)
+        if t.is_partitioned:
+            raise UnsupportedFeatureError(
+                "CREATE INDEX on a partitioned parent is not supported; "
+                "create the index on each partition")
         t.schema.column(column)  # must exist
         if t.schema.column(column).type.is_float and unique:
             raise UnsupportedFeatureError(
@@ -917,6 +1033,25 @@ class Cluster:
         """reference: create_distributed_table UDF
         (src/backend/distributed/commands/create_distributed_table.c)."""
         t = self.catalog.table(name)
+        if t.is_partitioned:
+            # distribute every partition (colocated siblings) and record
+            # the distribution on the metadata-only parent
+            shard_count = shard_count or self.settings.sharding.shard_count
+            t.schema.column(dist_column)
+            first = None
+            for p in self.catalog.partitions_of(name):
+                self.create_distributed_table(
+                    p.name, dist_column, shard_count,
+                    colocate_with=first or colocate_with)
+                first = first or p.name
+            t.method = DistributionMethod.HASH
+            t.dist_column = dist_column
+            t.partition_by["shard_count"] = shard_count
+            if first is not None:
+                t.colocation_id = self.catalog.table(first).colocation_id
+            t.version += 1
+            self.catalog.commit()
+            return
         from citus_tpu.catalog.stats import table_row_count
         if table_row_count(self.catalog, t) > 0:
             raise UnsupportedFeatureError(
@@ -986,6 +1121,11 @@ class Cluster:
             raise AnalysisError("provide exactly one of columns= or rows=")
         if rows is not None:
             columns = rows_to_columns(t.schema.names, rows, column_names)
+        if t.is_partitioned:
+            # two-level routing: range partition first, then hash shard
+            # within it (each recursive call re-enters with the same
+            # session/transaction context)
+            return self._copy_into_partitions(t, columns)
         values, validity = encode_columns(self.catalog, t, columns)
         import contextlib as _ctxlib
 
@@ -1490,6 +1630,11 @@ class Cluster:
         from citus_tpu.planner.recursive import has_subquery
         if not isinstance(stmt.from_, A.TableRef):
             return None
+        if self.catalog.has_table(stmt.from_.name) \
+                and self.catalog.table(stmt.from_.name).is_partitioned:
+            # partitioned parents need the expand_from rewrite, which
+            # runs in _execute_stmt — fall back to literal substitution
+            return None
         if stmt.distinct_on:
             return None  # DISTINCT ON dedups through _execute_distinct_on
         if any(isinstance(i.expr, A.WindowCall) for i in stmt.items):
@@ -1552,6 +1697,15 @@ class Cluster:
                                 stmt.group_by, stmt.having, stmt.order_by,
                                 stmt.limit, stmt.offset, stmt.distinct,
                                 stmt.windows)
+        if isinstance(stmt, A.Select) and stmt.from_ is not None and any(
+                t.is_partitioned for t in self.catalog.tables.values()):
+            # partitioned parents rewrite to their surviving partitions
+            # (partition pruning stacks on shard + chunk pruning)
+            from citus_tpu.partitioning import expand_from
+            new_from = expand_from(self, stmt.from_, stmt.where)
+            if new_from is not stmt.from_:
+                import dataclasses as _dc
+                stmt = _dc.replace(stmt, from_=new_from)
         if isinstance(stmt, A.Select) and stmt.from_ is not None \
                 and _has_derived(stmt.from_):
             return self._execute_derived(stmt)
@@ -1832,6 +1986,12 @@ class Cluster:
             self.catalog.drop_sequence(stmt.name)
             self.catalog.commit()
             return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateTable) and stmt.partition_of is not None:
+            self._create_partition(
+                stmt.name, stmt.partition_of["parent"],
+                stmt.partition_of["lo"], stmt.partition_of["hi"],
+                if_not_exists=stmt.if_not_exists)
+            return Result(columns=[], rows=[])
         if isinstance(stmt, A.CreateTable):
             from citus_tpu import types as T
             cols, enum_binds = [], []
@@ -1884,6 +2044,21 @@ class Cluster:
                 # pre-validated above, so these cannot fail halfway
                 for iname, cname in want_indexes:
                     self.create_index(iname, stmt.name, cname, unique=True)
+            if stmt.partition_by is not None \
+                    and not pre_existing and self.catalog.has_table(stmt.name):
+                t0 = self.catalog.table(stmt.name)
+                t0.schema.column(stmt.partition_by)  # must exist
+                # PostgreSQL: a unique constraint on a partitioned table
+                # must include the partition column (per-partition
+                # enforcement then equals global — ranges are disjoint)
+                for _, cname in want_indexes:
+                    if cname != stmt.partition_by:
+                        raise UnsupportedFeatureError(
+                            "unique constraint on partitioned table must "
+                            "include the partition column")
+                t0.partition_by = {"column": stmt.partition_by,
+                                   "kind": "range"}
+                self.catalog.commit()
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.DropTable):
             self.drop_table(stmt.name, if_exists=stmt.if_exists)
@@ -1912,6 +2087,8 @@ class Cluster:
             from citus_tpu.executor.dml import execute_delete
             from citus_tpu.planner.bind import Binder
             t = self.catalog.table(stmt.table)
+            if t.is_partitioned:
+                return self._partition_dml(stmt, t)
             where = Binder(self.catalog, t).bind_scalar(stmt.where) \
                 if stmt.where is not None else None
             from citus_tpu.transaction.locks import EXCLUSIVE
@@ -1941,6 +2118,8 @@ class Cluster:
             from citus_tpu.executor.dml import execute_update
             from citus_tpu.planner.bind import Binder
             t = self.catalog.table(stmt.table)
+            if t.is_partitioned:
+                return self._partition_dml(stmt, t)
             b = Binder(self.catalog, t)
             assignments = []
             for col, e in stmt.assignments:
@@ -1993,6 +2172,21 @@ class Cluster:
                 return ret
             return Result(columns=[], rows=[], explain={"updated": n})
         if isinstance(stmt, A.AlterTable):
+            if self.catalog.has_table(stmt.table) \
+                    and self.catalog.table(stmt.table).is_partitioned:
+                if stmt.action in ("rename_table", "rename_column"):
+                    raise UnsupportedFeatureError(
+                        "renaming a partitioned parent (or its columns) "
+                        "is not supported")
+                if stmt.action == "drop_column" \
+                        and stmt.old_name == self.catalog.table(
+                            stmt.table).partition_by["column"]:
+                    raise CatalogError("cannot drop the partition column")
+                # PostgreSQL: schema changes on the parent cascade to
+                # every partition
+                import dataclasses as _dc
+                for p in self.catalog.partitions_of(stmt.table):
+                    self._execute_stmt(_dc.replace(stmt, table=p.name))
             if stmt.action == "add_column":
                 col = Column(stmt.column.name,
                              type_from_sql(stmt.column.type_name,
@@ -2112,6 +2306,11 @@ class Cluster:
             from citus_tpu.transaction.locks import EXCLUSIVE
             forbid_truncate_referenced(self.catalog, stmt.table)
             t = self.catalog.table(stmt.table)
+            if t.is_partitioned:
+                import dataclasses as _dc
+                for p in self.catalog.partitions_of(stmt.table):
+                    self._execute_stmt(_dc.replace(stmt, table=p.name))
+                return Result(columns=[], rows=[])
             with self._write_lock(t, EXCLUSIVE):
                 execute_truncate(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
@@ -2179,10 +2378,23 @@ class Cluster:
                 raise UnsupportedFeatureError(
                     "RETURNING on INSERT..SELECT is not supported")
             names = stmt.columns or t.schema.names
-            # FK-constrained and unique-indexed targets take the pull
-            # path so every row goes through copy_from's probes
-            # (check_ingest / check_unique_ingest)
-            res = None if (t.foreign_keys or t.unique_indexes) \
+            # FK-constrained, unique-indexed, and partitioned targets —
+            # and partitioned sources — take the pull path: copy_from's
+            # probes and partition routing only run there, and a
+            # partitioned source must expand through _execute_stmt
+            def _refs_partitioned(item) -> bool:
+                if isinstance(item, A.Join):
+                    return _refs_partitioned(item.left) \
+                        or _refs_partitioned(item.right)
+                return (isinstance(item, A.TableRef)
+                        and self.catalog.has_table(item.name)
+                        and self.catalog.table(item.name).is_partitioned)
+            direct_ok = not (t.foreign_keys or t.unique_indexes
+                             or t.is_partitioned)
+            if direct_ok and isinstance(stmt.select, A.Select) \
+                    and stmt.select.from_ is not None:
+                direct_ok = not _refs_partitioned(stmt.select.from_)
+            res = None if not direct_ok \
                 else self._insert_select_arrays(t, stmt.select, list(names))
             if res is None:
                 # general path: materialize rows through the coordinator
@@ -3606,6 +3818,29 @@ class Cluster:
         if name == "create_reference_table":
             self.create_reference_table(args[0])
             return Result(columns=[name], rows=[(None,)])
+        if name == "create_time_partitions":
+            from citus_tpu.partitioning import create_time_partitions
+            n = create_time_partitions(
+                self, args[0], args[1], args[2],
+                args[3] if len(args) > 3 else None)
+            return Result(columns=[name], rows=[(n > 0,)],
+                          explain={"partitions_created": n})
+        if name == "drop_old_time_partitions":
+            from citus_tpu.partitioning import drop_old_time_partitions
+            n = drop_old_time_partitions(self, args[0], args[1])
+            return Result(columns=[name], rows=[(n,)],
+                          explain={"partitions_dropped": n})
+        if name == "time_partitions":
+            # the time_partitions view (reference: a SQL view over
+            # pg_class + partition bounds)
+            rows = []
+            for t in self.catalog.tables.values():
+                if t.partition_of is not None:
+                    rows.append((t.partition_of["parent"], t.name,
+                                 t.partition_of["lo"], t.partition_of["hi"]))
+            return Result(
+                columns=["parent_table", "partition", "from_value",
+                         "to_value"], rows=sorted(rows))
         if name == "citus_table_size":
             return Result(columns=["citus_table_size"],
                           rows=[(self._table_size(args[0]),)])
@@ -4116,6 +4351,24 @@ class Cluster:
             return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
         if isinstance(stmt.statement.from_, A.Join):
             return self._explain_join(stmt)
+        sel0 = stmt.statement
+        if isinstance(sel0.from_, A.TableRef) \
+                and self.catalog.has_table(sel0.from_.name) \
+                and self.catalog.table(sel0.from_.name).is_partitioned:
+            from citus_tpu.partitioning import prune_partitions
+            pt = self.catalog.table(sel0.from_.name)
+            parts = self.catalog.partitions_of(pt.name)
+            surv = prune_partitions(self.catalog, pt, sel0.where)
+            lines = [f"Append on {pt.name} "
+                     f"(partitions: {len(surv)}/{len(parts)})"]
+            if surv:
+                import dataclasses as _dc
+                rep = _dc.replace(sel0, from_=A.TableRef(
+                    surv[0].name, sel0.from_.alias or pt.name))
+                sub = self._execute_explain(A.Explain(rep, analyze=False))
+                lines.append(f"  Partitions Shown: One of {len(surv)}")
+                lines.extend("  " + r[0] for r in sub.rows)
+            return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
         bound = bind_select(self.catalog, stmt.statement)
         from citus_tpu.planner.physical import plan_select
         plan = plan_select(self.catalog, bound,

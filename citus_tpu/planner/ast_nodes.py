@@ -220,6 +220,12 @@ class CreateTable(Statement):
     # foreign keys (column-level REFERENCES + table-level FOREIGN KEY):
     # [{"columns", "ref_table", "ref_columns", "on_delete"}]
     foreign_keys: list = field(default_factory=list)
+    # PARTITION BY RANGE (col) -> the partition column name
+    partition_by: "str | None" = None
+    # CREATE TABLE x PARTITION OF parent FOR VALUES FROM (a) TO (b):
+    # {"parent", "lo", "hi"} with raw literal values (None = MINVALUE/
+    # MAXVALUE); physical conversion happens at DDL execution
+    partition_of: "dict | None" = None
 
 
 @dataclass

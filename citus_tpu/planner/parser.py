@@ -754,6 +754,31 @@ class Parser:
             self.expect_kw("exists")
             if_not_exists = True
         name = self.parse_table_name()
+        if self.accept_kw("partition"):
+            # CREATE TABLE x PARTITION OF parent FOR VALUES FROM (a) TO (b)
+            if not (self.peek().kind == "ident" and self.peek().value == "of"):
+                self.error("expected OF")
+            self.next()
+            parent = self.parse_table_name()
+            lo = hi = None
+            if self.peek().value == "for":
+                self.next()
+                if self.peek().value != "values":
+                    self.error("expected VALUES")
+                self.next()
+                self.expect_kw("from")
+                self.expect_op("(")
+                lo = self._parse_partition_bound()
+                self.expect_op(")")
+                self.expect_kw("to")
+                self.expect_op("(")
+                hi = self._parse_partition_bound()
+                self.expect_op(")")
+            else:
+                self.error("expected FOR VALUES FROM (..) TO (..)")
+            return A.CreateTable(name, [], if_not_exists,
+                                 partition_of={"parent": parent,
+                                               "lo": lo, "hi": hi})
         self.expect_op("(")
         cols = []
         fkeys = []
@@ -810,6 +835,16 @@ class Parser:
                 break
         self.expect_op(")")
         options: dict = {}
+        partition_by = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            # "range" lexes as a keyword (window frames use it)
+            if self.peek().value != "range":
+                self.error("only PARTITION BY RANGE is supported")
+            self.next()
+            self.expect_op("(")
+            partition_by = self.expect_ident()
+            self.expect_op(")")
         if self.accept_kw("using"):
             options["access_method"] = self.expect_ident()
         if self.accept_kw("with"):
@@ -822,7 +857,24 @@ class Parser:
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
-        return A.CreateTable(name, cols, if_not_exists, options, fkeys)
+        return A.CreateTable(name, cols, if_not_exists, options, fkeys,
+                             partition_by=partition_by)
+
+    def _parse_partition_bound(self):
+        """One FOR VALUES bound: literal, MINVALUE, or MAXVALUE (both
+        map to None = unbounded)."""
+        t = self.peek()
+        if t.kind == "ident" and t.value in ("minvalue", "maxvalue"):
+            self.next()
+            return None
+        neg = bool(self.accept_op("-"))
+        t = self.next()
+        if t.kind == "num":
+            v = float(t.value) if "." in t.value else int(t.value)
+            return -v if neg else v
+        if t.kind == "str":
+            return t.value[1:-1].replace("''", "'")
+        self.error("expected a partition bound literal")
 
     def _parse_references(self, fcols: list[str]) -> dict:
         """REFERENCES tbl [(cols)] [ON DELETE CASCADE|RESTRICT|SET NULL|
@@ -1088,6 +1140,8 @@ class Parser:
         "run_command_on_workers", "run_command_on_shards",
         "run_command_on_placements", "master_get_table_ddl_events",
         "citus_backend_gpid", "citus_coordinator_nodeid",
+        "create_time_partitions", "drop_old_time_partitions",
+        "time_partitions",
     }
 
     def parse_select_or_utility(self) -> A.Statement:

@@ -1,0 +1,234 @@
+"""Range-partitioned (distributed) tables + time-partition helpers.
+
+Reference: PostgreSQL PARTITION BY RANGE distributed per-partition, and
+create_time_partitions / drop_old_time_partitions
+(src/backend/distributed/utils/multi_partitioning_utils.c)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import AnalysisError, CatalogError, UnsupportedFeatureError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("""CREATE TABLE events (
+        tenant bigint NOT NULL, ts date, amount bigint)
+        PARTITION BY RANGE (ts)""")
+    cl.execute("CREATE TABLE events_2024h1 PARTITION OF events "
+               "FOR VALUES FROM ('2024-01-01') TO ('2024-07-01')")
+    cl.execute("CREATE TABLE events_2024h2 PARTITION OF events "
+               "FOR VALUES FROM ('2024-07-01') TO ('2025-01-01')")
+    cl.execute("SELECT create_distributed_table('events', 'tenant', 4)")
+    rows = []
+    d0 = datetime.date(2024, 1, 1)
+    for i in range(2000):
+        rows.append((i % 10, (d0 + datetime.timedelta(days=i % 360)).isoformat(), i))
+    cl.copy_from("events", rows=rows)
+    return cl
+
+
+def test_metadata_shape(db):
+    t = db.catalog.table("events")
+    assert t.is_partitioned and t.partition_by["column"] == "ts"
+    parts = db.catalog.partitions_of("events")
+    assert [p.name for p in parts] == ["events_2024h1", "events_2024h2"]
+    for p in parts:
+        assert p.is_distributed and p.shard_count == 4
+    # siblings colocate
+    assert parts[0].colocation_id == parts[1].colocation_id
+
+
+def test_ingest_routes_by_range(db):
+    h1 = db.execute("SELECT count(*) FROM events_2024h1").rows[0][0]
+    h2 = db.execute("SELECT count(*) FROM events_2024h2").rows[0][0]
+    assert h1 + h2 == 2000 and h1 > 0 and h2 > 0
+    # rows landed in the right partition
+    assert db.execute(
+        "SELECT count(*) FROM events_2024h1 WHERE ts >= '2024-07-01'"
+    ).rows == [(0,)]
+
+
+def test_parent_scan_unions_partitions(db):
+    assert db.execute("SELECT count(*) FROM events").rows == [(2000,)]
+    a = db.execute("SELECT sum(amount) FROM events").rows[0][0]
+    b = (db.execute("SELECT sum(amount) FROM events_2024h1").rows[0][0]
+         + db.execute("SELECT sum(amount) FROM events_2024h2").rows[0][0])
+    assert a == b == sum(range(2000))
+    # group-by through the parent
+    r = db.execute("SELECT tenant, count(*) FROM events GROUP BY tenant "
+                   "ORDER BY tenant")
+    assert len(r.rows) == 10 and sum(c for _, c in r.rows) == 2000
+
+
+def test_partition_pruning_single_partition(db):
+    r = db.execute("EXPLAIN SELECT count(*) FROM events "
+                   "WHERE ts >= date '2024-02-01' AND ts < date '2024-03-01'")
+    text = "\n".join(row[0] for row in r.rows)
+    assert "partitions: 1/2" in text
+    assert "Chunk Pruning" in text  # stacked: partition + chunk level
+    got = db.execute("SELECT count(*) FROM events "
+                     "WHERE ts >= date '2024-02-01' AND ts < date '2024-03-01'").rows
+    d0 = datetime.date(2024, 1, 1)
+    expect = sum(1 for i in range(2000)
+                 if datetime.date(2024, 2, 1) <= d0 + datetime.timedelta(days=i % 360)
+                 < datetime.date(2024, 3, 1))
+    assert got == [(expect,)]
+
+
+def test_row_outside_partitions_rejected(db):
+    with pytest.raises(AnalysisError, match="no partition"):
+        db.copy_from("events", rows=[(1, "2030-01-01", 5)])
+    with pytest.raises(AnalysisError):
+        db.copy_from("events", rows=[(1, None, 5)])
+
+
+def test_overlapping_partition_rejected(db):
+    with pytest.raises(CatalogError, match="overlap"):
+        db.execute("CREATE TABLE events_bad PARTITION OF events "
+                   "FOR VALUES FROM ('2024-06-01') TO ('2024-08-01')")
+
+
+def test_dml_through_parent(db):
+    r = db.execute("UPDATE events SET amount = 0 WHERE amount < 100")
+    assert r.explain["updated"] == 100
+    assert db.execute("SELECT sum(amount) FROM events").rows == \
+        [(sum(range(100, 2000)),)]
+    r = db.execute("DELETE FROM events WHERE ts < date '2024-07-01'")
+    assert r.explain["deleted"] > 0
+    assert db.execute("SELECT count(*) FROM events_2024h1").rows == [(0,)]
+    with pytest.raises(UnsupportedFeatureError, match="row movement"):
+        db.execute("UPDATE events SET ts = '2024-08-01' WHERE amount = 150")
+
+
+def test_drop_parent_cascades(db):
+    db.execute("DROP TABLE events")
+    assert not db.catalog.has_table("events")
+    assert not db.catalog.has_table("events_2024h1")
+    assert not db.catalog.has_table("events_2024h2")
+
+
+def test_truncate_parent(db):
+    db.execute("TRUNCATE events")
+    assert db.execute("SELECT count(*) FROM events").rows == [(0,)]
+
+
+def test_joins_through_parent(db):
+    db.execute("CREATE TABLE tenants (tenant bigint, name text)")
+    db.copy_from("tenants", rows=[(i, f"t{i}") for i in range(10)])
+    r = db.execute(
+        "SELECT t.name, count(*) FROM events e JOIN tenants t "
+        "ON e.tenant = t.tenant GROUP BY t.name ORDER BY t.name")
+    assert len(r.rows) == 10 and sum(c for _, c in r.rows) == 2000
+
+
+def test_create_time_partitions_and_retention(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db2"))
+    cl.execute("CREATE TABLE metrics (k bigint, ts timestamp, v bigint) "
+               "PARTITION BY RANGE (ts)")
+    r = cl.execute("SELECT create_time_partitions('metrics', '1 hour', "
+                   "'2024-01-01 06:00', '2024-01-01 00:00')")
+    assert r.rows == [(True,)]
+    parts = cl.catalog.partitions_of("metrics")
+    assert len(parts) == 6
+    assert parts[0].name == "metrics_p2024010100"
+    # idempotent: nothing new to create
+    r2 = cl.execute("SELECT create_time_partitions('metrics', '1 hour', "
+                    "'2024-01-01 06:00', '2024-01-01 00:00')")
+    assert r2.rows == [(False,)]
+    # extend from the last bound without start_from
+    cl.execute("SELECT create_time_partitions('metrics', '1 hour', "
+               "'2024-01-01 08:00')")
+    assert len(cl.catalog.partitions_of("metrics")) == 8
+    cl.copy_from("metrics", rows=[(1, "2024-01-01 03:30:00", 7),
+                                  (2, "2024-01-01 07:15:00", 9)])
+    assert cl.execute("SELECT count(*) FROM metrics_p2024010103").rows == [(1,)]
+    # retention drop
+    r3 = cl.execute("SELECT drop_old_time_partitions('metrics', "
+                    "'2024-01-01 06:00')")
+    assert r3.rows == [(6,)]
+    assert len(cl.catalog.partitions_of("metrics")) == 2
+    assert cl.execute("SELECT count(*) FROM metrics").rows == [(1,)]
+    # time_partitions view
+    tp = cl.execute("SELECT time_partitions()").rows
+    assert len(tp) == 2 and all(r[0] == "metrics" for r in tp)
+
+
+def test_daily_time_partitions_on_date_column(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db3"))
+    cl.execute("CREATE TABLE logs (k bigint, d date, msg text) "
+               "PARTITION BY RANGE (d)")
+    cl.execute("SELECT create_time_partitions('logs', '1 day', "
+               "'2024-03-05', '2024-03-01')")
+    parts = cl.catalog.partitions_of("logs")
+    assert [p.name for p in parts] == [
+        "logs_p20240301", "logs_p20240302", "logs_p20240303",
+        "logs_p20240304"]
+
+
+def test_insert_select_through_parent(db):
+    db.execute("CREATE TABLE staging (tenant bigint, ts date, amount bigint)")
+    db.copy_from("staging", rows=[(1, "2024-03-03", 100000),
+                                  (2, "2024-09-09", 200000)])
+    r = db.execute("INSERT INTO events SELECT * FROM staging")
+    assert r.explain["inserted"] == 2
+    assert db.execute(
+        "SELECT count(*) FROM events WHERE amount >= 100000").rows == [(2,)]
+    # partitioned SOURCE expands too
+    db.execute("CREATE TABLE flat (tenant bigint, ts date, amount bigint)")
+    db.execute("INSERT INTO flat SELECT * FROM events")
+    assert db.execute("SELECT count(*) FROM flat").rows == [(2002,)]
+
+
+def test_parameterized_select_on_parent(db):
+    r = db.execute("SELECT count(*) FROM events WHERE amount < $1",
+                   params=[100])
+    assert r.rows == [(100,)]
+
+
+def test_parent_pk_enforced_in_partitions(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db4"))
+    cl.execute("CREATE TABLE seq_events (ts date PRIMARY KEY, v bigint) "
+               "PARTITION BY RANGE (ts)")
+    cl.execute("CREATE TABLE seq_a PARTITION OF seq_events "
+               "FOR VALUES FROM ('2024-01-01') TO ('2024-02-01')")
+    from citus_tpu.integrity import UniqueViolation
+    cl.copy_from("seq_events", rows=[("2024-01-05", 1)])
+    with pytest.raises(UniqueViolation):
+        cl.copy_from("seq_events", rows=[("2024-01-05", 2)])
+    # unique key NOT including the partition column is refused (PG rule)
+    with pytest.raises(UnsupportedFeatureError, match="partition column"):
+        cl.execute("CREATE TABLE bad (k bigint PRIMARY KEY, ts date) "
+                   "PARTITION BY RANGE (ts)")
+
+
+def test_decimal_partition_column_routing(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db5"))
+    cl.execute("CREATE TABLE priced (k bigint, amount decimal(10,2)) "
+               "PARTITION BY RANGE (amount)")
+    cl.execute("CREATE TABLE priced_lo PARTITION OF priced "
+               "FOR VALUES FROM (0) TO (100)")
+    cl.execute("CREATE TABLE priced_hi PARTITION OF priced "
+               "FOR VALUES FROM (100) TO (1000)")
+    # float ndarray fast path must scale like encode_columns
+    cl.copy_from("priced", columns={"k": np.arange(4),
+                                    "amount": np.array([50.0, 99.99, 100.0, 500.5])})
+    assert cl.execute("SELECT count(*) FROM priced_lo").rows == [(2,)]
+    assert cl.execute("SELECT count(*) FROM priced_hi").rows == [(2,)]
+    # and the object path agrees
+    cl.copy_from("priced", rows=[(9, 42.42)])
+    assert cl.execute("SELECT count(*) FROM priced_lo").rows == [(3,)]
+
+
+def test_alter_parent_cascades_add_column(db):
+    db.execute("ALTER TABLE events ADD COLUMN note text")
+    assert db.catalog.table("events_2024h1").schema.has("note")
+    db.copy_from("events", rows=[(1, "2024-05-05", 1, "hello")])
+    r = db.execute("SELECT note FROM events WHERE note = 'hello'")
+    assert r.rows == [("hello",)]
+    with pytest.raises(CatalogError, match="partition column"):
+        db.execute("ALTER TABLE events DROP COLUMN ts")
