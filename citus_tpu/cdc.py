@@ -11,6 +11,12 @@ transaction's HLC timestamp.  Internal data movement (shard moves,
 rebalances, VACUUM rewrites) bypasses the emit path entirely, giving the
 same "changes once, at the distributed-table level" guarantee.
 
+Stream hygiene (round 4): a sparse lsn->byte-offset index grows with the
+stream so ``events(from_lsn)`` seeks instead of rescanning history
+(O(new records), like a replication slot's confirmed_flush position);
+``acknowledge()`` truncates records a consumer has confirmed, keeping
+the stream bounded (the slot-advance / WAL-recycling analog).
+
 Gated by ``enable_change_data_capture`` per cluster (reference GUC
 citus.enable_change_data_capture).
 """
@@ -22,16 +28,30 @@ import os
 import threading
 from typing import Iterator, Optional
 
+#: a new index entry per this many appended stream bytes
+INDEX_STRIDE_BYTES = 16384
+
 
 class ChangeDataCapture:
     def __init__(self, data_dir: str, enabled: bool = False):
         self.dir = os.path.join(data_dir, "cdc")
         self.enabled = enabled
         self._mu = threading.Lock()
+        # observability: bytes actually read by events() — tests assert
+        # seek-reads stay O(new records)
+        self.bytes_read = 0
+        self._index_cache: dict[str, tuple] = {}  # table -> (sig, entries)
 
     def _path(self, table: str) -> str:
         return os.path.join(self.dir, f"{table}.changes.jsonl")
 
+    def _index_path(self, table: str) -> str:
+        return os.path.join(self.dir, f"{table}.changes.idx.jsonl")
+
+    def _ack_path(self, table: str) -> str:
+        return os.path.join(self.dir, f"{table}.ack.json")
+
+    # ------------------------------------------------------------ write
     def emit(self, table: str, op: str, lsn: int, *,
              rows: Optional[list] = None, count: Optional[int] = None,
              columns: Optional[list[str]] = None) -> None:
@@ -47,17 +67,77 @@ class ChangeDataCapture:
             rec["count"] = len(rows)
         elif count is not None:
             rec["count"] = count
-        with self._mu:
-            with open(self._path(table), "a") as fh:
+        from citus_tpu.utils.filelock import FileLock
+        with self._mu, FileLock(os.path.join(self.dir, ".cdc.lock")):
+            # the cross-process lock is shared with acknowledge(): an
+            # append racing its read-rewrite-replace would otherwise be
+            # dropped by the os.replace
+            p = self._path(table)
+            size = os.path.getsize(p) if os.path.exists(p) else 0
+            entries = self._load_index(table)
+            last_off = entries[-1][1] if entries else -INDEX_STRIDE_BYTES
+            if size - last_off >= INDEX_STRIDE_BYTES:
+                # `size` is a record boundary (appends are whole lines
+                # under the lock), so seeking there lands on a record
+                with open(self._index_path(table), "a") as fh:
+                    fh.write(json.dumps({"lsn": lsn, "offset": size}) + "\n")
+                self._index_cache.pop(table, None)
+            with open(p, "a") as fh:
                 fh.write(json.dumps(rec, default=str) + "\n")
                 fh.flush()
 
+    # ------------------------------------------------------------- read
+    def _load_index(self, table: str) -> list[tuple[int, int]]:
+        """[(lsn, byte offset)] ascending; cached on (mtime, size)."""
+        p = self._index_path(table)
+        try:
+            st = os.stat(p)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return []
+        cached = self._index_cache.get(table)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        entries = []
+        with open(p) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    d = json.loads(line)
+                    entries.append((d["lsn"], d["offset"]))
+        self._index_cache[table] = (sig, entries)
+        return entries
+
+    def _seek_offset(self, table: str, from_lsn: int) -> int:
+        """Largest indexed offset that is safely at-or-before the first
+        record with lsn > from_lsn.  One entry of slack absorbs HLC
+        skew between concurrent emitters."""
+        if from_lsn <= 0:
+            return 0
+        entries = self._load_index(table)
+        idx = -1
+        for i, (lsn, _off) in enumerate(entries):
+            if lsn < from_lsn:
+                idx = i
+            else:
+                break
+        idx -= 1  # one stride of slack for HLC skew between emitters
+        return entries[idx][1] if idx >= 0 else 0
+
     def events(self, table: str, from_lsn: int = 0) -> Iterator[dict]:
+        """Changes with lsn > from_lsn.  Seeks via the sparse index:
+        reading the tail of a long-history stream costs O(new records),
+        not O(history) — the confirmed_flush_lsn resume semantics of a
+        logical replication slot."""
         p = self._path(table)
         if not os.path.exists(p):
             return
+        start = self._seek_offset(table, from_lsn)
         with open(p) as fh:
+            if start:
+                fh.seek(start)
             for line in fh:
+                self.bytes_read += len(line)
                 line = line.strip()
                 if not line:
                     continue
@@ -66,7 +146,86 @@ class ChangeDataCapture:
                     yield rec
 
     def last_lsn(self, table: str) -> int:
-        last = 0
-        for rec in self.events(table):
-            last = max(last, rec["lsn"])
-        return last
+        """Newest change lsn — tail-read, O(last records) not
+        O(history).  The window grows backwards until it holds at least
+        one complete record (a single bulk-ingest record can exceed any
+        fixed window)."""
+        p = self._path(table)
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            return 0
+        if size == 0:
+            return 0
+        window = 1 << 16
+        while True:
+            tail = min(size, window)
+            with open(p, "rb") as fh:
+                fh.seek(size - tail)
+                chunk = fh.read(tail)
+            self.bytes_read += len(chunk)
+            lines = chunk.splitlines()
+            if tail < size:
+                lines = lines[1:]  # first line of a partial window
+            last = 0
+            for line in lines:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                last = max(last, rec["lsn"])
+            if last or tail == size:
+                return last
+            window *= 4
+
+    # --------------------------------------------------------- rotation
+    def acknowledge(self, table: str, upto_lsn: int) -> int:
+        """Consumer confirmation: drop records with lsn <= upto_lsn and
+        rebuild the index (slot advance + WAL recycling).  Returns the
+        number of records truncated."""
+        p = self._path(table)
+        if not os.path.exists(p):
+            return 0
+        from citus_tpu.utils.filelock import FileLock
+        with self._mu, FileLock(os.path.join(self.dir, ".cdc.lock")):
+            kept, dropped = [], 0
+            with open(p) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if json.loads(line)["lsn"] <= upto_lsn:
+                        dropped += 1
+                    else:
+                        kept.append(line)
+            # the confirmed position advances monotonically even when
+            # nothing is truncated (consumer acked past the stream tail)
+            if upto_lsn > self.acknowledged_lsn(table):
+                with open(self._ack_path(table), "w") as fh:
+                    json.dump({"acknowledged_lsn": upto_lsn}, fh)
+            if not dropped:
+                return 0
+            tmp = p + ".tmp"
+            idx_tmp = self._index_path(table) + ".tmp"
+            off = 0
+            with open(tmp, "w") as fh, open(idx_tmp, "w") as ix:
+                last_indexed = -INDEX_STRIDE_BYTES
+                for line in kept:
+                    if off - last_indexed >= INDEX_STRIDE_BYTES:
+                        ix.write(json.dumps(
+                            {"lsn": json.loads(line)["lsn"],
+                             "offset": off}) + "\n")
+                        last_indexed = off
+                    fh.write(line + "\n")
+                    off += len(line) + 1
+            os.replace(tmp, p)
+            os.replace(idx_tmp, self._index_path(table))
+            self._index_cache.pop(table, None)
+            return dropped
+
+    def acknowledged_lsn(self, table: str) -> int:
+        try:
+            with open(self._ack_path(table)) as fh:
+                return json.load(fh)["acknowledged_lsn"]
+        except (OSError, ValueError, KeyError):
+            return 0

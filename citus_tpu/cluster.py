@@ -810,6 +810,37 @@ class Cluster:
         self.catalog.commit()
 
     # ------------------------------------------------------- partitioning
+    def _internal_txn(self):
+        """All-or-nothing wrapper for engine-generated multi-statement
+        work (multi-partition writes): inside a user transaction it is
+        transparent (that transaction provides atomicity); otherwise it
+        opens, stages, and 2PC-commits an internal one, rolling back on
+        any failure."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            from citus_tpu.storage.overlay import (
+                current_overlay, transaction_overlay,
+            )
+            if current_overlay() is not None:
+                yield
+                return
+            from citus_tpu.transaction.session import OpenTransaction
+            s = self.session()
+            xid = self.txlog.begin()
+            s.txn = OpenTransaction(xid, s.lock_sid)
+            s.txn.tombstones_snapshot = {
+                k: set(v) for k, v in self.catalog._tombstones.items()}
+            try:
+                with transaction_overlay(s.txn):
+                    yield
+            except BaseException:
+                self._rollback_txn(s)
+                raise
+            self._commit_txn(s)
+        return _ctx()
+
     def _create_partition(self, name: str, parent: str, lo_raw, hi_raw,
                           *, if_not_exists: bool = False) -> None:
         """CREATE TABLE name PARTITION OF parent FOR VALUES FROM..TO:
@@ -873,10 +904,13 @@ class Cluster:
                     "partition directly")
         total_key = "updated" if isinstance(stmt, A.Update) else "deleted"
         total = 0
-        for p in prune_partitions(self.catalog, t, stmt.where):
-            sub = dataclasses.replace(stmt, table=p.name)
-            r = self._execute_stmt(sub)
-            total += r.explain.get(total_key, 0)
+        # atomic across partitions: a later partition's failure must not
+        # leave earlier partitions' writes committed
+        with self._internal_txn():
+            for p in prune_partitions(self.catalog, t, stmt.where):
+                sub = dataclasses.replace(stmt, table=p.name)
+                r = self._execute_stmt(sub)
+                total += r.explain.get(total_key, 0)
         return Result(columns=[], rows=[], explain={total_key: total})
 
     def _copy_into_partitions(self, t, columns) -> int:
@@ -911,9 +945,13 @@ class Cluster:
         cols_np = {c: (v if isinstance(v, np.ndarray)
                        else np.asarray(v, dtype=object))
                    for c, v in columns.items()}
-        for pname, mask in partition_for_rows(self.catalog, t, phys):
-            sub = {c: v[mask] for c, v in cols_np.items()}
-            n += self.copy_from(pname, columns=sub)
+        routed = partition_for_rows(self.catalog, t, phys)
+        # atomic across partitions (a unique violation in the second
+        # partition must not leave the first partition's rows behind)
+        with self._internal_txn():
+            for pname, mask in routed:
+                sub = {c: v[mask] for c, v in cols_np.items()}
+                n += self.copy_from(pname, columns=sub)
         return n
 
     # ----------------------------------------------------------- indexes
@@ -2007,8 +2045,9 @@ class Cluster:
             opts = {k: v for k, v in stmt.options.items() if k != "access_method"}
             fks = []
             pre_existing = self.catalog.has_table(stmt.name)
-            # pre-validate implicit PK/UNIQUE indexes BEFORE the table
-            # commits: PostgreSQL's CREATE TABLE is all-or-nothing
+            # pre-validate implicit PK/UNIQUE indexes and the partition
+            # clause BEFORE the table commits: PostgreSQL's CREATE TABLE
+            # is all-or-nothing
             want_indexes = []
             if not pre_existing:
                 seen_ix: set = set()
@@ -2025,6 +2064,15 @@ class Cluster:
                             "UNIQUE indexes over floating-point columns "
                             "are not supported (no exact equality)")
                     want_indexes.append((iname, c.name))
+                if stmt.partition_by is not None:
+                    schema.column(stmt.partition_by)  # must exist
+                    # PostgreSQL: a unique constraint on a partitioned
+                    # table must include the partition column
+                    for _, cname in want_indexes:
+                        if cname != stmt.partition_by:
+                            raise UnsupportedFeatureError(
+                                "unique constraint on partitioned table "
+                                "must include the partition column")
             if stmt.foreign_keys and not pre_existing:
                 from citus_tpu.integrity import declare_fks
                 fks = declare_fks(self.catalog, stmt.name,
@@ -2046,16 +2094,8 @@ class Cluster:
                     self.create_index(iname, stmt.name, cname, unique=True)
             if stmt.partition_by is not None \
                     and not pre_existing and self.catalog.has_table(stmt.name):
+                # validated before create_table above
                 t0 = self.catalog.table(stmt.name)
-                t0.schema.column(stmt.partition_by)  # must exist
-                # PostgreSQL: a unique constraint on a partitioned table
-                # must include the partition column (per-partition
-                # enforcement then equals global — ranges are disjoint)
-                for _, cname in want_indexes:
-                    if cname != stmt.partition_by:
-                        raise UnsupportedFeatureError(
-                            "unique constraint on partitioned table must "
-                            "include the partition column")
                 t0.partition_by = {"column": stmt.partition_by,
                                    "kind": "range"}
                 self.catalog.commit()

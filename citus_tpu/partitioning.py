@@ -142,17 +142,25 @@ def expand_from(cluster, item, where: Optional[A.Expr]):
                          A.Literal(False, "bool"))
         return A.SubqueryRef(empty, alias)
     # push the WHERE into each arm (qualifiers stripped) so shard/chunk
-    # pruning still fires inside every partition; the outer query keeps
-    # its own copy — filtering twice is idempotent
+    # pruning still fires inside every partition — but ONLY when every
+    # referenced column resolves against the parent itself (a predicate
+    # naming a join partner would fail inside the single-table arm).
+    # The outer query keeps its own copy; filtering twice is idempotent.
     arm_where = None
     if where is not None:
         from citus_tpu.planner.recursive import _walk_columns, has_subquery
         if not has_subquery(where):
-            from citus_tpu.cluster import _replace_exprs
             names = {alias, item.name}
-            mapping = {c: A.ColumnRef(c.name) for c in _walk_columns(where)
-                       if c.table in names}
-            arm_where = _replace_exprs(where, mapping) if mapping else where
+            refs = list(_walk_columns(where))
+            pushable = all(
+                (c.table is None or c.table in names)
+                and t.schema.has(c.name) for c in refs)
+            if pushable:
+                from citus_tpu.cluster import _replace_exprs
+                mapping = {c: A.ColumnRef(c.name) for c in refs
+                           if c.table in names}
+                arm_where = _replace_exprs(where, mapping) \
+                    if mapping else where
     node = A.Select(cols, A.TableRef(survivors[0].name), where=arm_where)
     for p in survivors[1:]:
         node = A.SetOp("union", True, node,

@@ -1,0 +1,144 @@
+"""CDC stream hygiene: seek-reads and ack-based rotation.
+
+Reference: a logical replication slot's confirmed_flush position —
+resuming a consumer never rescans acknowledged history, and
+acknowledged WAL is recycled (cdc/cdc_decoder.c rides real slots)."""
+
+import os
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.cdc import ChangeDataCapture
+
+
+@pytest.fixture()
+def stream(tmp_path):
+    cdc = ChangeDataCapture(str(tmp_path), enabled=True)
+    for i in range(2000):
+        cdc.emit("t", "insert", lsn=1000 + i,
+                 rows=[[i, f"value-{i}"]], columns=["k", "v"])
+    return cdc
+
+
+def test_events_from_lsn_is_o_new_records(stream):
+    total = os.path.getsize(stream._path("t"))
+    stream.bytes_read = 0
+    tail = list(stream.events("t", from_lsn=1000 + 1990))
+    assert [r["lsn"] for r in tail] == list(range(2991, 3000))
+    # seek-read bound: at most two index strides of history plus the
+    # actual tail — constant in stream length, not O(history)
+    from citus_tpu.cdc import INDEX_STRIDE_BYTES
+    assert stream.bytes_read < 2 * INDEX_STRIDE_BYTES + 4096, \
+        f"read {stream.bytes_read} of {total} bytes"
+    assert stream.bytes_read < total / 4
+
+
+def test_full_scan_still_complete(stream):
+    assert len(list(stream.events("t"))) == 2000
+    assert len(list(stream.events("t", from_lsn=0))) == 2000
+
+
+def test_last_lsn_is_tail_read(stream):
+    total = os.path.getsize(stream._path("t"))
+    stream.bytes_read = 0
+    assert stream.last_lsn("t") == 2999
+    assert stream.bytes_read <= (1 << 16)
+    assert stream.bytes_read < total
+
+
+def test_acknowledge_rotates_and_resumes(stream):
+    p = stream._path("t")
+    size_before = os.path.getsize(p)
+    dropped = stream.acknowledge("t", upto_lsn=1000 + 1499)
+    assert dropped == 1500
+    assert os.path.getsize(p) < size_before / 2
+    assert stream.acknowledged_lsn("t") == 2499
+    remaining = list(stream.events("t"))
+    assert [r["lsn"] for r in remaining] == list(range(2500, 3000))
+    # seek within the rotated stream still works
+    assert [r["lsn"] for r in stream.events("t", from_lsn=2990)] == \
+        list(range(2991, 3000))
+    # appends continue after rotation
+    stream.emit("t", "delete", lsn=5000, count=3)
+    assert stream.last_lsn("t") == 5000
+    assert stream.acknowledge("t", upto_lsn=100) == 0  # nothing older
+
+
+def test_last_lsn_with_oversized_record(tmp_path):
+    cdc = ChangeDataCapture(str(tmp_path), enabled=True)
+    big = [[i, "x" * 100] for i in range(2000)]  # ~200KB single record
+    cdc.emit("t", "insert", lsn=77, rows=big, columns=["k", "v"])
+    assert cdc.last_lsn("t") == 77
+
+
+def test_ack_position_is_monotonic_without_truncation(tmp_path):
+    cdc = ChangeDataCapture(str(tmp_path), enabled=True)
+    cdc.emit("u", "insert", lsn=5, count=1)
+    assert cdc.acknowledge("u", 5) == 1
+    assert cdc.acknowledge("u", 10) == 0  # nothing to drop...
+    assert cdc.acknowledged_lsn("u") == 10  # ...but the position advances
+
+
+def test_partition_parent_writes_are_atomic(tmp_path):
+    """A unique violation in the second partition must roll back the
+    first partition's rows (PostgreSQL inserts nothing)."""
+    from citus_tpu.integrity import UniqueViolation
+    cl = ct.Cluster(str(tmp_path / "dbp"))
+    cl.execute("CREATE TABLE e (ts date PRIMARY KEY, v bigint) "
+               "PARTITION BY RANGE (ts)")
+    cl.execute("CREATE TABLE e_a PARTITION OF e "
+               "FOR VALUES FROM ('2024-01-01') TO ('2024-02-01')")
+    cl.execute("CREATE TABLE e_b PARTITION OF e "
+               "FOR VALUES FROM ('2024-02-01') TO ('2024-03-01')")
+    cl.copy_from("e", rows=[("2024-02-10", 1)])
+    with pytest.raises(UniqueViolation):
+        cl.copy_from("e", rows=[("2024-01-05", 2),      # would land in e_a
+                                ("2024-02-10", 3)])     # duplicate in e_b
+    assert cl.execute("SELECT count(*) FROM e").rows == [(1,)]
+    assert cl.execute("SELECT count(*) FROM e_a").rows == [(0,)]
+
+
+def test_join_with_predicate_on_other_table(tmp_path):
+    """The pushed-down arm WHERE must not swallow predicates that
+    reference a join partner."""
+    cl = ct.Cluster(str(tmp_path / "dbj"))
+    cl.execute("CREATE TABLE ev (tenant bigint, ts date, v bigint) "
+               "PARTITION BY RANGE (ts)")
+    cl.execute("CREATE TABLE ev_a PARTITION OF ev "
+               "FOR VALUES FROM ('2024-01-01') TO ('2024-02-01')")
+    cl.execute("CREATE TABLE ev_b PARTITION OF ev "
+               "FOR VALUES FROM ('2024-02-01') TO ('2024-03-01')")
+    cl.copy_from("ev", rows=[(1, "2024-01-10", 5), (2, "2024-02-10", 7)])
+    cl.execute("CREATE TABLE tn (tenant bigint, name text)")
+    cl.copy_from("tn", rows=[(1, "alpha"), (2, "beta")])
+    r = cl.execute("SELECT count(*) FROM ev e JOIN tn t "
+                   "ON e.tenant = t.tenant WHERE t.name = 'beta'")
+    assert r.rows == [(1,)]
+
+
+def test_partition_by_validation_is_atomic(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "dbv"))
+    with pytest.raises(Exception):
+        cl.execute("CREATE TABLE bad (k bigint PRIMARY KEY, ts date) "
+                   "PARTITION BY RANGE (ts)")
+    assert not cl.catalog.has_table("bad")
+    # retry with a corrected definition succeeds
+    cl.execute("CREATE TABLE bad (k bigint, ts date PRIMARY KEY) "
+               "PARTITION BY RANGE (ts)")
+    assert cl.catalog.table("bad").is_partitioned
+
+
+def test_cluster_surface_uses_hygiene(tmp_path):
+    from citus_tpu.config import Settings
+    cl = ct.Cluster(str(tmp_path / "db"),
+                    settings=Settings(enable_change_data_capture=True))
+    cl.execute("CREATE TABLE t (k bigint, v bigint)")
+    for i in range(50):
+        cl.copy_from("t", rows=[(i, i)])
+    last = cl.cdc.last_lsn("t")
+    assert last > 0
+    assert cl.cdc.acknowledge("t", last) == 50
+    assert list(cl.cdc.events("t")) == []
+    cl.copy_from("t", rows=[(99, 99)])
+    assert len(list(cl.cdc.events("t", from_lsn=last))) == 1
