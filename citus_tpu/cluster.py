@@ -649,6 +649,13 @@ class Cluster:
             d.register("deadlock_detection",
                        lambda: run_detection(self),
                        interval_s=self.settings.deadlock_detection_interval_s)
+            if self._control is not None:
+                # authority health / lease-based promotion (reference:
+                # node_promotion.c; HA via external failover managers in
+                # the reference, built-in here)
+                d.register("authority_watch",
+                           lambda: self._control.ensure_authority(),
+                           interval_s=self.settings.authority_watch_interval_s)
             d.start()
             self._maintenance = d
         return self._maintenance

@@ -93,6 +93,8 @@ class Settings:
     # cross-process deadlock detection cadence (reference default: every
     # 2 s, citus.distributed_deadlock_detection_factor x deadlock_timeout)
     deadlock_detection_interval_s: float = 2.0
+    # authority health / lease-based promotion cadence
+    authority_watch_interval_s: float = 2.0
 
     def replace(self, **kw) -> "Settings":
         return dataclasses.replace(self, **kw)

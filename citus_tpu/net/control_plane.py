@@ -19,6 +19,8 @@ authority is reachable.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 import uuid
@@ -31,6 +33,12 @@ from citus_tpu.net.rpc import RpcClient, RpcError, RpcServer
 # many seconds (renewed implicitly by re-acquiring); generous compared
 # to a metadata commit (~ms) but short enough to bound DDL outage
 DDL_LEASE_TTL_S = 10.0
+
+#: shared-FS advertisement of the current metadata authority — the
+#: promotion arbiter (the role a DCS plays for Patroni; reference:
+#: operations/node_promotion.c promotes a secondary into the metadata
+#: writer role)
+AUTHORITY_FILE = ".authority.json"
 
 
 class ControlPlane:
@@ -51,19 +59,16 @@ class ControlPlane:
         # cannot wedge DDL forever
         self._lease_holder: Optional[str] = None
         self._lease_expires = 0.0
+        # serializes failover attempts within this process (maintenance
+        # duty vs explicit calls)
+        self._failover_mu = threading.Lock()
         self.stats = {"fetch_catalog": 0, "push_catalog": 0,
                       "lease_acquired": 0, "lease_contended": 0}
         if serve_port is not None:
             self.server = RpcServer(port=serve_port)
-            self.server.register("ping", lambda p: {"ok": True})
-            self.server.register("catalog_changed", self._on_catalog_changed)
-            self.server.register("report_inflight", self._on_report_inflight)
-            self.server.register("cluster_inflight", self._on_cluster_inflight)
-            self.server.register("tx_event", self._on_tx_event)
-            self.server.register("ddl_lease", self._on_ddl_lease)
-            self.server.register("fetch_catalog", self._on_fetch_catalog)
-            self.server.register("push_catalog", self._on_push_catalog)
+            self._register_handlers()
             self.server.start()
+            self._write_authority_file()
         # push channel liveness: when it dies (coordinator gone), the
         # cluster falls back to mtime polling for invalidations
         self.push_alive = False
@@ -73,6 +78,16 @@ class ControlPlane:
             self.client.call("ping")
             self.push_alive = True
             self.client.subscribe(self._on_event, on_close=self._on_push_closed)
+
+    def _register_handlers(self) -> None:
+        self.server.register("ping", lambda p: {"ok": True})
+        self.server.register("catalog_changed", self._on_catalog_changed)
+        self.server.register("report_inflight", self._on_report_inflight)
+        self.server.register("cluster_inflight", self._on_cluster_inflight)
+        self.server.register("tx_event", self._on_tx_event)
+        self.server.register("ddl_lease", self._on_ddl_lease)
+        self.server.register("fetch_catalog", self._on_fetch_catalog)
+        self.server.register("push_catalog", self._on_push_catalog)
 
     # ---- server handlers ----------------------------------------------
     def _on_catalog_changed(self, payload: dict) -> dict:
@@ -249,6 +264,143 @@ class ControlPlane:
 
     def _on_push_closed(self) -> None:
         self.push_alive = False
+
+    # ---- authority failover (reference: node_promotion.c) ---------------
+    def _authority_path(self) -> str:
+        return os.path.join(self.cluster.catalog.data_dir, AUTHORITY_FILE)
+
+    def _write_authority_file(self) -> None:
+        tmp = self._authority_path() + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"host": "127.0.0.1", "port": self.server.port,
+                       "origin": self.origin, "pid": os.getpid(),
+                       "promoted_at": time.time()}, fh)
+        os.replace(tmp, self._authority_path())
+
+    def _read_authority_file(self) -> Optional[dict]:
+        try:
+            with open(self._authority_path()) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def ensure_authority(self) -> str:
+        """Keep a live metadata authority (maintenance-daemon duty).
+
+        Healthy -> 'ok'.  When our push channel to the authority is
+        dead: under the shared-FS promotion lock, first try the
+        currently-advertised authority (another peer may have promoted
+        already) -> 'repointed'; otherwise promote OURSELVES — start
+        serving, advertise, and re-sync — -> 'promoted'.  Writes never
+        stop either way: Catalog.commit already falls back to the
+        flock path while no authority is reachable."""
+        from citus_tpu.utils.filelock import FileLock
+        lock = os.path.join(self.cluster.catalog.data_dir, ".authority.lock")
+        # one attempt at a time per control plane: the maintenance duty
+        # and an explicit call must not promote twice
+        with self._failover_mu:
+            if self.server is not None:
+                # split-brain guard: while we were unreachable, a peer
+                # may have promoted (the authority FILE, written under
+                # the promotion flock, is the arbiter).  If it advertises
+                # a live different authority, step down; if the
+                # advertised one is dead, re-assert ourselves.
+                info = self._read_authority_file()
+                if info is None or info.get("origin") == self.origin:
+                    return "ok"
+                with FileLock(lock, timeout=10.0):
+                    info = self._read_authority_file()
+                    if info is None or info.get("origin") == self.origin:
+                        return "ok"
+                    if self._try_repoint(info):
+                        old_server, self.server = self.server, None
+                        try:
+                            old_server.stop()
+                        except Exception:
+                            pass
+                        return "stepped_down"
+                    self._write_authority_file()
+                    return "ok"
+            if self.client is not None and self.push_alive:
+                return "ok"
+            with FileLock(lock, timeout=10.0):
+                # re-check under the flock: another process may have
+                # promoted while we waited
+                info = self._read_authority_file()
+                if info and info.get("origin") != self.origin \
+                        and self._try_repoint(info):
+                    return "repointed"
+                self._promote()
+                return "promoted"
+
+    def _try_repoint(self, info: dict) -> bool:
+        """Subscribe to the advertised authority if it answers; any
+        mid-handshake failure (it died between ping and subscribe) falls
+        back to promotion.  Never leaks sockets on failure."""
+        c = None
+        try:
+            c = RpcClient(info["host"], int(info["port"]))
+            c.call("ping")
+        except Exception:
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            return False
+        old, self.client = self.client, c
+        # alive BEFORE subscribe, matching __init__: an on_close firing
+        # during subscribe must be able to clear it, never be overwritten
+        self.push_alive = True
+        try:
+            c.subscribe(self._on_event, on_close=self._on_push_closed)
+        except Exception:
+            self.push_alive = False
+            self.client = old
+            try:
+                c.close()
+            except Exception:
+                pass
+            return False
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+        # events may have been missed during the outage: force a re-sync
+        self.cluster._catalog_dirty = True
+        return True
+
+    def _promote(self) -> None:
+        """Become the metadata authority: serve, advertise, re-sync.
+        Reference: citus_promote_clone_and_rebalance / node promotion
+        turning a secondary into the metadata writer
+        (operations/node_promotion.c)."""
+        if self.client is not None:
+            try:
+                self.client.close()
+            except Exception:
+                pass
+            self.client = None
+        self.push_alive = False
+        self.server = RpcServer(port=0)
+        self._register_handlers()
+        self.server.start()
+        self._write_authority_file()
+        # adopt the freshest on-disk document before serving fetches
+        from citus_tpu.catalog.catalog import _catalog_flock
+        cat = self.cluster.catalog
+        try:
+            with cat._lock, _catalog_flock(cat.data_dir):
+                cat._merge_foreign_locked()
+        except Exception:
+            pass
+        self.cluster._plan_cache.clear()
+        try:
+            from citus_tpu.executor.executor import GLOBAL_COUNTERS
+            GLOBAL_COUNTERS.bump("authority_promotions")
+        except ImportError:
+            pass
 
     @property
     def connected(self) -> bool:
