@@ -58,14 +58,37 @@ def _srf_result(name: str, args, alias) -> "Result":
             # PostgreSQL: a NULL bound yields zero rows
             return Result(columns=[alias or "generate_series"], rows=[])
         import decimal as _dec
+        import math as _math
+        numeric = False
         for v in vals:
-            integral = (isinstance(v, int) and not isinstance(v, bool)) \
-                or (isinstance(v, float) and v.is_integer()) \
-                or (isinstance(v, _dec.Decimal) and v == v.to_integral_value())
-            if not integral:
+            if isinstance(v, bool) \
+                    or not isinstance(v, (int, float, _dec.Decimal)):
                 raise AnalysisError(
-                    "generate_series requires integer bounds "
+                    "generate_series requires numeric bounds "
                     f"(got {v!r}); timestamp series are not supported")
+            if (isinstance(v, float) and not _math.isfinite(v)) \
+                    or (isinstance(v, _dec.Decimal) and not v.is_finite()):
+                raise AnalysisError(
+                    "generate_series bound cannot be infinity or NaN")
+            if not isinstance(v, int):
+                # PostgreSQL: any numeric argument makes the whole
+                # series numeric (2.0..4.0 -> 2.0, 3.0, 4.0)
+                numeric = True
+        if numeric:
+            # PostgreSQL numeric generate_series(1.1, 4.0, 1.3) ->
+            # 1.1, 2.4, 3.7 — exact decimal stepping
+            start = _dec.Decimal(str(vals[0]))
+            stop = _dec.Decimal(str(vals[1]))
+            step = _dec.Decimal(str(vals[2])) if len(vals) > 2 \
+                else _dec.Decimal(1)
+            if step == 0:
+                raise ExecutionError("step size cannot equal zero")
+            rows = []
+            v = start
+            while (v <= stop) if step > 0 else (v >= stop):
+                rows.append((v,))
+                v += step
+            return Result(columns=[alias or "generate_series"], rows=rows)
         start, stop = int(vals[0]), int(vals[1])
         step = int(vals[2]) if len(vals) > 2 else 1
         if step == 0:
@@ -557,6 +580,8 @@ class Cluster:
         self._plan_cache: dict[str, tuple] = {}
         self._background_jobs = None
         self._maintenance = None
+        # per-thread implicit sessions: {thread ident: (Thread, Session)}
+        self._default_sessions: dict = {}
         # observability (citus_stat_* / citus_locks analogs)
         from citus_tpu.executor.executor import GLOBAL_COUNTERS
         from citus_tpu.stats import ActivityTracker, QueryStats, TenantStats
@@ -661,11 +686,11 @@ class Cluster:
         return self._maintenance
 
     def close(self) -> None:
-        # an open transaction on the default session rolls back
+        # open transactions on the per-thread default sessions roll back
         # (connection-close semantics)
-        ds = getattr(self, "_default_session_obj", None)
-        if ds is not None and ds.txn is not None:
-            self._rollback_txn(ds)
+        for _owner, ds in list(getattr(self, "_default_sessions", {}).values()):
+            if ds.txn is not None:
+                self._rollback_txn(ds)
         if self._background_jobs is not None:
             self._background_jobs.stop()
         if self._maintenance is not None:
@@ -1366,9 +1391,29 @@ class Cluster:
         return Session(self)
 
     def _default_session(self):
-        if getattr(self, "_default_session_obj", None) is None:
-            self._default_session_obj = self.session()
-        return self._default_session_obj
+        """One implicit session PER THREAD (each thread of the
+        session-less API is its own psql connection): a BEGIN issued on
+        one thread must not pull other threads' autocommit statements
+        into its transaction block, and concurrent statements keep
+        distinct lock identities.  CPython reuses thread idents, so each
+        entry remembers its owning Thread — a recycled ident rolls back
+        the dead owner's abandoned transaction instead of inheriting it."""
+        import threading as _th
+        sessions = self._default_sessions
+        me = _th.current_thread()
+        tid = me.ident
+        entry = sessions.get(tid)
+        if entry is not None:
+            owner, s = entry
+            if owner is me:
+                return s
+            # ident recycled from a dead thread: its abandoned open
+            # transaction rolls back (connection-close semantics)
+            if s.txn is not None:
+                self._rollback_txn(s)
+        s = self.session()
+        sessions[tid] = (me, s)
+        return s
 
     def execute(self, sql: str, params: Optional[Sequence[Any]] = None,
                 role: Optional[str] = None, session=None) -> Result:

@@ -285,15 +285,53 @@ def test_nullif_not_strict(tmp_path):
     assert cl.execute("SELECT nullif(NULL, 5)").rows[0][0] is None
 
 
-def test_generate_series_rejects_non_integer(tmp_path):
+def test_generate_series_numeric_and_integer(tmp_path):
+    """PostgreSQL supports numeric generate_series(1.1, 4.0, 1.3);
+    round 4 implements it instead of rejecting (round-3 ADVICE)."""
+    from decimal import Decimal
     from citus_tpu.errors import AnalysisError
     cl = ct.Cluster(str(tmp_path / "gsr"))
     with pytest.raises(AnalysisError):
         cl.execute("SELECT * FROM generate_series('a', 'b')")
-    with pytest.raises(AnalysisError):
-        cl.execute("SELECT * FROM generate_series(1.5, 3)")
     assert [r[0] for r in
             cl.execute("SELECT * FROM generate_series(1, 3)").rows] == [1, 2, 3]
+    assert [r[0] for r in cl.execute(
+        "SELECT * FROM generate_series(1.1, 4.0, 1.3)").rows] == \
+        [Decimal("1.1"), Decimal("2.4"), Decimal("3.7")]
+    assert [r[0] for r in cl.execute(
+        "SELECT * FROM generate_series(1.5, 3)").rows] == \
+        [Decimal("1.5"), Decimal("2.5")]
+    # any numeric argument makes the whole series numeric (PG typing)
+    assert [r[0] for r in cl.execute(
+        "SELECT * FROM generate_series(2.0, 4.0)").rows] == \
+        [Decimal("2.0"), Decimal("3.0"), Decimal("4.0")]
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT * FROM generate_series(true, false)")
+
+
+def test_default_session_is_thread_local(tmp_path):
+    """Round-3 ADVICE (medium): BEGIN on the session-less API must not
+    pull other threads' statements into its transaction block."""
+    import threading
+    cl = ct.Cluster(str(tmp_path / "tls"))
+    cl.execute("CREATE TABLE t (k bigint)")
+    cl.execute("BEGIN")
+    cl.execute("INSERT INTO t VALUES (1)")  # staged in THIS thread's txn
+
+    results = {}
+
+    def other_thread():
+        # autocommit: must not join (or see) the open transaction
+        cl.execute("INSERT INTO t VALUES (2)")
+        results["count"] = cl.execute("SELECT count(*) FROM t").rows[0][0]
+
+    th = threading.Thread(target=other_thread)
+    th.start()
+    th.join()
+    assert results["count"] == 1  # sees only its own committed row
+    cl.execute("ROLLBACK")
+    # the staged row is gone; the other thread's autocommit row persists
+    assert cl.execute("SELECT count(*) FROM t").rows == [(1,)]
 
 
 def test_float_round_half_to_even(tmp_path):
