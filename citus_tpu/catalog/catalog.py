@@ -234,6 +234,20 @@ class Catalog:
         # text search configurations (metadata-only propagated objects,
         # reference: commands/text_search.c)
         self.ts_configs: dict[str, dict] = {}
+        # object-surface breadth (reference: commands/extension.c,
+        # domain.c, collation.c, publication.c, statistics.c):
+        # extensions: name -> {"version"}; domains: name -> {"base",
+        # "args", "not_null", "check"}; collations: name -> {"locale",
+        # "provider"}; publications: name -> {"tables": [..] | "all"};
+        # statistics: name -> {"table", "columns", "ndistinct"}
+        self.extensions: dict[str, dict] = {}
+        # "table.column" -> domain name (domain-typed columns resolve to
+        # the base type at DDL time; checks enforce at ingest)
+        self.domain_columns: dict[str, str] = {}
+        self.domains: dict[str, dict] = {}
+        self.collations: dict[str, dict] = {}
+        self.publications: dict[str, dict] = {}
+        self.statistics: dict[str, dict] = {}
         # sequences: name -> {"value": next unreserved, "increment": n,
         # "start": n}; nextval hands out values from an in-memory block
         # reserved by bumping the persisted high-water mark (gaps on
@@ -319,6 +333,12 @@ class Catalog:
         self.rls = d.get("rls", {})
         self.triggers = d.get("triggers", {})
         self.ts_configs = d.get("ts_configs", {})
+        self.extensions = d.get("extensions", {})
+        self.domain_columns = d.get("domain_columns", {})
+        self.domains = d.get("domains", {})
+        self.collations = d.get("collations", {})
+        self.publications = d.get("publications", {})
+        self.statistics = d.get("statistics", {})
 
     def export_document(self) -> dict:
         return {
@@ -338,6 +358,12 @@ class Catalog:
             "rls": self.rls,
             "triggers": self.triggers,
             "ts_configs": self.ts_configs,
+            "extensions": self.extensions,
+            "domain_columns": self.domain_columns,
+            "domains": self.domains,
+            "collations": self.collations,
+            "publications": self.publications,
+            "statistics": self.statistics,
         }
 
     def tombstone(self, section: str, name: str) -> None:
@@ -390,7 +416,9 @@ class Catalog:
                 self.policies.setdefault(tbl, []).append(p)
         for sec in ("views", "sequences", "roles", "functions", "types",
                     "enum_columns", "schemas", "rls",
-                    "triggers", "ts_configs"):
+                    "triggers", "ts_configs", "extensions", "domains",
+                    "collations", "publications", "statistics",
+                    "domain_columns"):
             disk = d.get(sec, {})
             mem = getattr(self, sec)
             dead = tomb.get(sec, set())

@@ -54,9 +54,12 @@ class ChangeDataCapture:
     # ------------------------------------------------------------ write
     def emit(self, table: str, op: str, lsn: int, *,
              rows: Optional[list] = None, count: Optional[int] = None,
-             columns: Optional[list[str]] = None) -> None:
-        """op in {insert, delete, update}; lsn = HLC transaction clock."""
-        if not self.enabled:
+             columns: Optional[list[str]] = None,
+             force: bool = False) -> None:
+        """op in {insert, delete, update}; lsn = HLC transaction clock.
+        ``force`` bypasses the global switch (publication-covered tables
+        capture even when enable_change_data_capture is off)."""
+        if not (self.enabled or force):
             return
         os.makedirs(self.dir, exist_ok=True)
         rec = {"lsn": lsn, "op": op, "table": table}

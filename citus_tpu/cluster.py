@@ -839,6 +839,14 @@ class Cluster:
                    if t.get("table") == name]:
             del self.catalog.triggers[tn]
             self.catalog.tombstone("triggers", tn)
+        for key in [k for k in self.catalog.domain_columns
+                    if k.startswith(name + ".")]:
+            del self.catalog.domain_columns[key]
+            self.catalog.tombstone("domain_columns", key)
+        for pub in self.catalog.publications.values():
+            tl = pub.get("tables")
+            if isinstance(tl, list) and name in tl:
+                tl.remove(name)  # PostgreSQL drops the table from pubs
         self.catalog.commit()
 
     # ------------------------------------------------------- partitioning
@@ -985,6 +993,21 @@ class Cluster:
                 sub = {c: v[mask] for c, v in cols_np.items()}
                 n += self.copy_from(pname, columns=sub)
         return n
+
+    def _drop_catalog_object(self, section: str, stmt) -> Result:
+        """DROP for the simple metadata-object sections (extension,
+        domain, collation, publication, statistics)."""
+        store = getattr(self.catalog, section)
+        if stmt.name not in store:
+            if stmt.if_exists:
+                return Result(columns=[], rows=[])
+            raise CatalogError(
+                f'{section[:-1]} "{stmt.name}" does not exist')
+        del store[stmt.name]
+        self.catalog.tombstone(section, stmt.name)
+        self.catalog.ddl_epoch += 1
+        self.catalog.commit()
+        return Result(columns=[], rows=[])
 
     # ----------------------------------------------------------- indexes
     def _find_index(self, name: str):
@@ -1196,6 +1219,7 @@ class Cluster:
             # within it (each recursive call re-enters with the same
             # session/transaction context)
             return self._copy_into_partitions(t, columns)
+        self._check_domains(t, columns)
         values, validity = encode_columns(self.catalog, t, columns)
         import contextlib as _ctxlib
 
@@ -1217,7 +1241,7 @@ class Cluster:
                 break
         n = len(next(iter(values.values()))) if values else 0
         self.counters.bump("rows_ingested", n)
-        if self.cdc.enabled and n:
+        if self._cdc_captures(t.name) and n:
             self._emit_cdc(t.name, "insert",
                            rows=self._decode_rows(t, values, validity),
                            columns=t.schema.names)
@@ -1280,6 +1304,95 @@ class Cluster:
                     raise
                 ing.finish()
 
+    def _domain_columns_of(self, t) -> list[tuple[str, str, dict]]:
+        """[(column, domain name, domain def)] for ``t``."""
+        out = []
+        for cname in t.schema.names:
+            dn = self.catalog.domain_columns.get(f"{t.name}.{cname}")
+            if dn is None:
+                continue
+            dom = self.catalog.domains.get(dn)
+            if dom is not None:
+                out.append((cname, dn, dom))
+        return out
+
+    def _check_domain_values(self, dn: str, dom: dict, values) -> None:
+        """Evaluate one domain's CHECK over an iterable of logical
+        values.  Distinct-value memoization keeps categorical bulk
+        ingest cheap; NULL passes CHECK (NOT NULL is the column's)."""
+        import numpy as _np
+        from citus_tpu.planner.parser import Parser as _P
+        if not dom.get("check"):
+            return
+        expr = _P(dom["check"]).parse_expr()
+        verdicts: dict = {}
+        for v in values:
+            if v is None:
+                continue
+            if isinstance(v, _np.generic):
+                v = v.item()
+            ok = verdicts.get(v)
+            if ok is None:
+                sub = {A.ColumnRef("value"): _pylit(v)}
+                try:
+                    ok = _eval_const(_replace_exprs(expr, sub)) is True
+                except Exception:
+                    raise UnsupportedFeatureError(
+                        f'cannot evaluate CHECK of domain "{dn}" '
+                        f"({dom['check']!r})")
+                verdicts[v] = ok
+            if not ok:
+                raise ExecutionError(
+                    f'value {v!r} for domain "{dn}" violates check '
+                    f"constraint ({dom['check']})")
+
+    def _check_domains(self, t, columns) -> None:
+        """Domain CHECK enforcement at ingest (reference: domain
+        constraints fire on every insert; VALUE names the checked
+        value)."""
+        for cname, dn, dom in self._domain_columns_of(t):
+            if cname in columns:
+                self._check_domain_values(dn, dom, columns[cname])
+
+    def _check_domains_physical(self, t, values, validity) -> None:
+        """Same enforcement over PHYSICAL column arrays (the UPDATE
+        re-insert path): decode back to logical values first."""
+        for cname, dn, dom in self._domain_columns_of(t):
+            if cname not in values or not dom.get("check"):
+                continue
+            col = t.schema.column(cname)
+            vals = []
+            for phys, ok in zip(values[cname], validity[cname]):
+                if not ok:
+                    continue
+                if col.type.is_text:
+                    vals.append(self.catalog.decode_strings(
+                        t.name, cname, [int(phys)])[0])
+                else:
+                    vals.append(col.type.from_physical(
+                        np.asarray(phys).item()))
+            self._check_domain_values(dn, dom, vals)
+
+    def _cdc_captures(self, table: str) -> bool:
+        """The table's changes are captured when CDC is globally on OR
+        any publication covers it (reference: commands/publication.c —
+        publications gate logical decoding per table)."""
+        if self.cdc.enabled:
+            return True
+        if not self.catalog.publications:
+            return False
+        # a publication on a partitioned parent covers its partitions
+        # (writes route to leaves before this gate runs)
+        names = {table}
+        t = self.catalog.tables.get(table)
+        if t is not None and t.partition_of is not None:
+            names.add(t.partition_of["parent"])
+        for pub in self.catalog.publications.values():
+            tl = pub.get("tables")
+            if tl == "all" or (isinstance(tl, list) and names & set(tl)):
+                return True
+        return False
+
     def _emit_cdc(self, table: str, op: str, **kw) -> None:
         """Emit a change event — or, inside an open transaction, defer
         it to COMMIT (PostgreSQL logical decoding emits on commit)."""
@@ -1288,7 +1401,8 @@ class Cluster:
         if txn is not None:
             txn.cdc_events.append((table, op, kw))
         else:
-            self.cdc.emit(table, op, self.clock.transaction_clock(), **kw)
+            self.cdc.emit(table, op, self.clock.transaction_clock(),
+                          force=True, **kw)
 
     def _decode_rows(self, t, values, validity) -> list:
         out = []
@@ -1527,6 +1641,10 @@ class Cluster:
                 A.CreateType, A.DropType, A.CreateRole, A.DropRole,
                 A.Grant, A.CreatePolicy, A.DropPolicy, A.CreateTrigger,
                 A.DropTrigger, A.AlterTableRls, A.AlterTable,
+                A.CreateExtension, A.DropExtension, A.CreateDomain,
+                A.DropDomain, A.CreateCollation, A.DropCollation,
+                A.CreatePublication, A.DropPublication,
+                A.CreateStatistics, A.DropStatistics,
                 A.UtilityCall)
         if not isinstance(stmt, Cluster._TXN_ALLOWED):
             raise UnsupportedFeatureError(
@@ -1671,10 +1789,11 @@ class Cluster:
                 self.txlog.release(txn.xid)
                 raise
             self._plan_cache.clear()
-            if self.cdc.enabled:
+            if txn.cdc_events:
                 clock = self.clock.transaction_clock()
                 for table, op, kw in txn.cdc_events:
-                    self.cdc.emit(table, op, clock, **kw)
+                    # queued only for captured tables at statement time
+                    self.cdc.emit(table, op, clock, force=True, **kw)
         finally:
             self.catalog._end_staging(txn)
             txn.release_locks(self)
@@ -2085,10 +2204,18 @@ class Cluster:
         if isinstance(stmt, A.CreateTable):
             from citus_tpu import types as T
             cols, enum_binds = [], []
+            domain_binds = []
             for c in stmt.columns:
                 if c.type_name in self.catalog.types:
                     cols.append(Column(c.name, T.TEXT_T, c.not_null))
                     enum_binds.append((c.name, c.type_name))
+                elif c.type_name in self.catalog.domains:
+                    d = self.catalog.domains[c.type_name]
+                    cols.append(Column(
+                        c.name,
+                        type_from_sql(d["base"], d["args"] or None),
+                        c.not_null or d["not_null"]))
+                    domain_binds.append((c.name, c.type_name))
                 else:
                     cols.append(Column(
                         c.name, type_from_sql(c.type_name, c.type_args or None),
@@ -2138,6 +2265,11 @@ class Cluster:
                 for cn, tn in enum_binds:
                     self.catalog.enum_columns[f"{stmt.name}.{cn}"] = tn
                 self.catalog.commit()
+            if domain_binds and not pre_existing \
+                    and self.catalog.has_table(stmt.name):
+                for cn, dn in domain_binds:
+                    self.catalog.domain_columns[f"{stmt.name}.{cn}"] = dn
+                self.catalog.commit()
             if want_indexes and self.catalog.has_table(stmt.name):
                 # PRIMARY KEY / UNIQUE column constraints become unique
                 # indexes (PostgreSQL's implicit btree; pg_index rows) —
@@ -2159,6 +2291,86 @@ class Cluster:
             return self._execute_create_index(stmt)
         if isinstance(stmt, A.DropIndex):
             return self._execute_drop_index(stmt)
+        if isinstance(stmt, A.CreateExtension):
+            if stmt.name in self.catalog.extensions:
+                if stmt.if_not_exists:
+                    return Result(columns=[], rows=[])
+                raise CatalogError(f'extension "{stmt.name}" already exists')
+            self.catalog.extensions[stmt.name] = {
+                "version": stmt.version or "1.0"}
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropExtension):
+            return self._drop_catalog_object("extensions", stmt)
+        if isinstance(stmt, A.CreateDomain):
+            if stmt.name in self.catalog.domains:
+                raise CatalogError(f'domain "{stmt.name}" already exists')
+            type_from_sql(stmt.base, stmt.type_args or None)  # must resolve
+            if stmt.check_sql is not None:
+                from citus_tpu.planner.parser import Parser as _P
+                _P(stmt.check_sql).parse_expr()  # validate
+            self.catalog.domains[stmt.name] = {
+                "base": stmt.base, "args": list(stmt.type_args or []),
+                "not_null": stmt.not_null, "check": stmt.check_sql}
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropDomain):
+            users = [k for k, v in self.catalog.domain_columns.items()
+                     if v == stmt.name]
+            if users and stmt.name in self.catalog.domains:
+                raise CatalogError(
+                    f'cannot drop domain "{stmt.name}": column {users[0]} '
+                    "depends on it")
+            return self._drop_catalog_object("domains", stmt)
+        if isinstance(stmt, A.CreateCollation):
+            if stmt.name in self.catalog.collations:
+                raise CatalogError(f'collation "{stmt.name}" already exists')
+            self.catalog.collations[stmt.name] = dict(stmt.options)
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropCollation):
+            return self._drop_catalog_object("collations", stmt)
+        if isinstance(stmt, A.CreatePublication):
+            if stmt.name in self.catalog.publications:
+                raise CatalogError(
+                    f'publication "{stmt.name}" already exists')
+            if isinstance(stmt.tables, list):
+                for tn in stmt.tables:
+                    self.catalog.table(tn)  # must exist
+            self.catalog.publications[stmt.name] = {
+                "tables": stmt.tables}
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropPublication):
+            return self._drop_catalog_object("publications", stmt)
+        if isinstance(stmt, A.CreateStatistics):
+            if stmt.name in self.catalog.statistics:
+                raise CatalogError(
+                    f'statistics object "{stmt.name}" already exists')
+            t = self.catalog.table(stmt.table)
+            for c in stmt.columns:
+                t.schema.column(c)
+            # extended statistics: n-distinct over the column combination
+            # (reference: CREATE STATISTICS ndistinct; computed eagerly —
+            # our ANALYZE analog)
+            sel = A.Select(
+                [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+                A.SubqueryRef(A.Select(
+                    [A.SelectItem(A.ColumnRef(c)) for c in stmt.columns],
+                    A.TableRef(stmt.table), distinct=True), "d"))
+            nd = self._execute_stmt(sel).rows[0][0]
+            self.catalog.statistics[stmt.name] = {
+                "table": stmt.table, "columns": list(stmt.columns),
+                "ndistinct": int(nd)}
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.DropStatistics):
+            return self._drop_catalog_object("statistics", stmt)
         if isinstance(stmt, A.Insert):
             return self._execute_insert(stmt)
         if isinstance(stmt, A.CopyTo):
@@ -2200,7 +2412,7 @@ class Cluster:
                 n = execute_delete(self.catalog, self.txlog, t, where,
                                    txn=current_overlay())
             self._plan_cache.clear()
-            if self.cdc.enabled and n:
+            if self._cdc_captures(t.name) and n:
                 self._emit_cdc(t.name, "delete", count=n)
             if ret is not None:
                 ret.explain["deleted"] = n
@@ -2254,10 +2466,15 @@ class Cluster:
                                                  stmt.returning, subst)
                 t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
                 from citus_tpu.storage.overlay import current_overlay
+                assigned = {c for c, _e in stmt.assignments}
+                check = None
+                if any(c in assigned
+                       for c, _dn, _d in self._domain_columns_of(t)):
+                    check = lambda v, m: self._check_domains_physical(t, v, m)  # noqa: E731
                 n = execute_update(self.catalog, self.txlog, t, assignments,
-                                   where, txn=current_overlay())
+                                   where, txn=current_overlay(), check=check)
             self._plan_cache.clear()
-            if self.cdc.enabled and n:
+            if self._cdc_captures(t.name) and n:
                 self._emit_cdc(t.name, "update", count=n)
             if ret is not None:
                 ret.explain["updated"] = n
@@ -2280,11 +2497,27 @@ class Cluster:
                 for p in self.catalog.partitions_of(stmt.table):
                     self._execute_stmt(_dc.replace(stmt, table=p.name))
             if stmt.action == "add_column":
-                col = Column(stmt.column.name,
-                             type_from_sql(stmt.column.type_name,
-                                           stmt.column.type_args or None),
-                             stmt.column.not_null)
-                self.catalog.add_column(stmt.table, col)
+                from citus_tpu import types as T
+                tn = stmt.column.type_name
+                if tn in self.catalog.types:  # enum
+                    col = Column(stmt.column.name, T.TEXT_T,
+                                 stmt.column.not_null)
+                    self.catalog.add_column(stmt.table, col)
+                    self.catalog.enum_columns[
+                        f"{stmt.table}.{stmt.column.name}"] = tn
+                elif tn in self.catalog.domains:
+                    d = self.catalog.domains[tn]
+                    col = Column(stmt.column.name,
+                                 type_from_sql(d["base"], d["args"] or None),
+                                 stmt.column.not_null or d["not_null"])
+                    self.catalog.add_column(stmt.table, col)
+                    self.catalog.domain_columns[
+                        f"{stmt.table}.{stmt.column.name}"] = tn
+                else:
+                    col = Column(stmt.column.name,
+                                 type_from_sql(tn, stmt.column.type_args or None),
+                                 stmt.column.not_null)
+                    self.catalog.add_column(stmt.table, col)
             elif stmt.action == "drop_column":
                 t0 = self.catalog.table(stmt.table)
                 if t0.index_on(stmt.old_name) is not None:
@@ -2320,6 +2553,11 @@ class Cluster:
                     if stmt.old_name not in fk["columns"]
                     and not (fk["ref_table"] == stmt.table
                              and stmt.old_name in fk["ref_columns"])]
+                key = f"{stmt.table}.{stmt.old_name}"
+                if self.catalog.domain_columns.pop(key, None) is not None:
+                    self.catalog.tombstone("domain_columns", key)
+                if self.catalog.enum_columns.pop(key, None) is not None:
+                    self.catalog.tombstone("enum_columns", key)
                 self.catalog.drop_column(stmt.table, stmt.old_name)
             elif stmt.action == "rename_column":
                 t0 = self.catalog.table(stmt.table)
@@ -2387,9 +2625,9 @@ class Cluster:
                     encode_value=lambda tbl, col, v:
                         int(self.catalog.encode_strings(tbl, col, [v])[0]))
             self._plan_cache.clear()
-            if self.cdc.enabled:
+            if self._cdc_captures(stmt.target.name):
                 self.cdc.emit(stmt.target.name, "merge",
-                              self.clock.transaction_clock(),
+                              self.clock.transaction_clock(), force=True,
                               count=sum(st.values()))
             return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.Truncate):
@@ -2406,8 +2644,9 @@ class Cluster:
             with self._write_lock(t, EXCLUSIVE):
                 execute_truncate(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
-            if self.cdc.enabled:
-                self.cdc.emit(t.name, "truncate", self.clock.transaction_clock())
+            if self._cdc_captures(t.name):
+                self.cdc.emit(t.name, "truncate",
+                              self.clock.transaction_clock(), force=True)
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Vacuum):
             from citus_tpu.executor.dml import execute_vacuum
@@ -2482,7 +2721,8 @@ class Cluster:
                         and self.catalog.has_table(item.name)
                         and self.catalog.table(item.name).is_partitioned)
             direct_ok = not (t.foreign_keys or t.unique_indexes
-                             or t.is_partitioned)
+                             or t.is_partitioned
+                             or self._domain_columns_of(t))
             if direct_ok and isinstance(stmt.select, A.Select) \
                     and stmt.select.from_ is not None:
                 direct_ok = not _refs_partitioned(stmt.select.from_)
@@ -3933,6 +4173,41 @@ class Cluster:
             return Result(
                 columns=["parent_table", "partition", "from_value",
                          "to_value"], rows=sorted(rows))
+        if name == "citus_extensions":
+            return Result(columns=["name", "version"],
+                          rows=sorted((k, v.get("version"))
+                                      for k, v in self.catalog.extensions.items()))
+        if name == "citus_domains":
+            return Result(
+                columns=["name", "base_type", "not_null", "check"],
+                rows=sorted((k, v["base"], v["not_null"], v.get("check"))
+                            for k, v in self.catalog.domains.items()))
+        if name == "citus_collations":
+            return Result(columns=["name", "locale", "provider"],
+                          rows=sorted((k, v.get("locale"), v.get("provider"))
+                                      for k, v in self.catalog.collations.items()))
+        if name == "citus_publications":
+            rows = []
+            for k, v in sorted(self.catalog.publications.items()):
+                tl = v.get("tables")
+                rows.append((k, "ALL TABLES" if tl == "all"
+                             else ", ".join(tl)))
+            return Result(columns=["name", "tables"], rows=rows)
+        if name == "citus_statistics_objects":
+            return Result(
+                columns=["name", "table", "columns", "ndistinct"],
+                rows=sorted((k, v["table"], ", ".join(v["columns"]),
+                             v["ndistinct"])
+                            for k, v in self.catalog.statistics.items()))
+        if name == "citus_stat_pool":
+            # shared task-pool admission counters (the
+            # citus.max_shared_pool_size / shared_connection_stats view)
+            from citus_tpu.executor.admission import GLOBAL_POOL
+            st = GLOBAL_POOL.stats()
+            st["pool_size"] = self.settings.executor.max_shared_pool_size
+            cols = ["pool_size", "in_use", "high_water", "granted",
+                    "denied_optional", "waits"]
+            return Result(columns=cols, rows=[tuple(st[c] for c in cols)])
         if name == "citus_table_size":
             return Result(columns=["citus_table_size"],
                           rows=[(self._table_size(args[0]),)])

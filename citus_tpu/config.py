@@ -55,9 +55,19 @@ class PlannerSettings:
 class ExecutorSettings:
     # "tpu" = JAX backend (accelerator or CPU mesh); "cpu" = numpy oracle.
     task_executor_backend: str = "tpu"
-    # Max shard-kernel invocations in flight per device (analog of
-    # citus.max_adaptive_executor_pool_size).
-    max_tasks_in_flight: int = 4
+    # Max shard-kernel invocations in flight per device — the streaming
+    # prefetch window (analog of citus.max_adaptive_executor_pool_size).
+    # Default 2 = classic double buffering; raising it trades HBM
+    # headroom for deeper overlap in the past-cache streaming regime.
+    max_tasks_in_flight: int = 2
+    # Process-wide cap on queries driving device work concurrently;
+    # 0 = unlimited (analog of citus.max_shared_pool_size backed by
+    # connection/shared_connection_stats.c's shared counters).
+    max_shared_pool_size: int = 0
+    # Prefer replica (non-primary) placements for reads — the
+    # citus.use_secondary_nodes='always' analog; failover to the
+    # primary still applies when no replica answers.
+    use_secondary_nodes: bool = False
     # Pad scan batches to power-of-two row counts to bound recompiles.
     batch_row_buckets: bool = True
     # Smallest padded batch (rows) a kernel will ever see.

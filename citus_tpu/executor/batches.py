@@ -42,6 +42,7 @@ def load_shard_batches(
     cat: Catalog, plan: PhysicalPlan, shard_index: int, *,
     min_batch_rows: int = 8192, max_batch_rows: int = 1 << 22,
     node_override: Optional[int] = None,
+    prefer_secondary: bool = False,
 ) -> Iterator[tuple[dict[str, np.ndarray], dict[str, np.ndarray], int]]:
     """Yield (values, valids, n_rows) raw column groups of at most
     max_batch_rows rows for one shard placement."""
@@ -52,11 +53,15 @@ def load_shard_batches(
         nodes = [node_override]
     else:
         # prefer active nodes (citus_disable_node semantics): a disabled
-        # node's placement is only read when no active replica exists
-        def inactive(n):
+        # node's placement is only read when no active replica exists;
+        # with prefer_secondary (citus.use_secondary_nodes='always'),
+        # replica placements outrank the primary for reads
+        def order(n):
             meta = cat.nodes.get(n)
-            return meta is not None and not meta.is_active
-        nodes = sorted(shard.placements, key=inactive)
+            inactive = meta is not None and not meta.is_active
+            is_primary = n == shard.placements[0]
+            return (inactive, is_primary if prefer_secondary else False)
+        nodes = sorted(shard.placements, key=order)
     # read tasks fail over to other placements, like the reference's
     # PlacementExecutionDone failover (adaptive_executor.c:96-100).  A
     # MISSING placement directory is a failed placement, not an empty

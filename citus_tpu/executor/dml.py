@@ -148,9 +148,11 @@ def _where_columns(table: TableMeta, where: Optional[BExpr]) -> list[str]:
 
 def execute_update(cat: Catalog, txlog: TransactionLog, table: TableMeta,
                    assignments: list[tuple[str, BExpr]],
-                   where: Optional[BExpr], txn=None) -> int:
+                   where: Optional[BExpr], txn=None, check=None) -> int:
     """delete matched rows + re-insert with assignments applied, one 2PC
-    (or staged under ``txn``'s xid when inside an open transaction)."""
+    (or staged under ``txn``'s xid when inside an open transaction).
+    ``check(values, validity)`` validates the replacement batch before
+    it is written (domain CHECK enforcement)."""
     from citus_tpu.ingest import TableIngestor
 
     shard_indexes = prune_shards(table, where)
@@ -158,7 +160,8 @@ def execute_update(cat: Catalog, txlog: TransactionLog, table: TableMeta,
     xid = txn.xid if txn is not None else txlog.begin()
     try:
         return _execute_update_tx(cat, txlog, table, assignments, where,
-                                  shard_indexes, all_columns, xid, txn)
+                                  shard_indexes, all_columns, xid, txn,
+                                  check=check)
     except BaseException:
         if txn is None:
             # stop driving the transaction; recovery decides its outcome
@@ -167,7 +170,8 @@ def execute_update(cat: Catalog, txlog: TransactionLog, table: TableMeta,
 
 
 def _execute_update_tx(cat, txlog, table, assignments, where,
-                       shard_indexes, all_columns, xid, txn=None) -> int:
+                       shard_indexes, all_columns, xid, txn=None,
+                       check=None) -> int:
     from citus_tpu.ingest import TableIngestor
 
     staged_delete_dirs = []
@@ -232,6 +236,8 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
         from citus_tpu.integrity import check_unique_update
         check_unique_update(cat, table, values, validity,
                             set(assign_map), replaced)
+    if check is not None:
+        check(values, validity)
     ing = TableIngestor(cat, table, txlog=None)
     ing.xid = xid  # share the DML transaction
     ing._writers = {}

@@ -74,7 +74,8 @@ def _load_all_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> l
         GLOBAL_COUNTERS.bump("tasks_dispatched")
         for values, masks, n in load_shard_batches(
                 cat, plan, si,
-                min_batch_rows=settings.executor.min_batch_rows):
+                min_batch_rows=settings.executor.min_batch_rows,
+                prefer_secondary=settings.executor.use_secondary_nodes):
             raw.append((si, values, masks, n))
     if not raw:
         return []
@@ -158,8 +159,13 @@ def _empty_partials(plan: PhysicalPlan, xp):
 
 #: streaming mode keeps at most this many batches in flight on the
 #: device ahead of the kernel consuming them (double buffering: the host
-#: decompresses + transfers batch i+1..i+2 while batch i computes)
+#: decompresses + transfers batch i+1..i+2 while batch i computes);
+#: ExecutorSettings.max_tasks_in_flight raises the window
 PREFETCH_DEPTH = 2
+
+
+def _prefetch_depth(settings: Settings) -> int:
+    return max(PREFETCH_DEPTH, settings.executor.max_tasks_in_flight)
 
 
 def _iter_padded_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
@@ -175,7 +181,8 @@ def _iter_padded_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
         GLOBAL_COUNTERS.bump("tasks_dispatched")
         for values, masks, n in load_shard_batches(
                 cat, plan, si,
-                min_batch_rows=settings.executor.min_batch_rows):
+                min_batch_rows=settings.executor.min_batch_rows,
+                prefer_secondary=settings.executor.use_secondary_nodes):
             bucket = bucket_rows(n, settings.executor.min_batch_rows)
             yield pad_to_batch(plan.bound.table, plan, values, masks, n,
                                bucket, si)
@@ -302,7 +309,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                     collect = None  # working set exceeds HBM cache: stream
                 if collect is None:
                     inflight.append(out)
-                    if len(inflight) > PREFETCH_DEPTH:
+                    if len(inflight) > _prefetch_depth(settings):
                         jax.block_until_ready(inflight.popleft())
             if buf:
                 out, nb = _run_mesh_round(
@@ -382,9 +389,9 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                     collect = None  # working set exceeds HBM cache: stream
             if collect is None:
                 # bound in-flight device memory: wait for the output from
-                # PREFETCH_DEPTH batches ago before admitting another
+                # max_tasks_in_flight batches ago before admitting another
                 inflight.append(out)
-                if len(inflight) > PREFETCH_DEPTH:
+                if len(inflight) > _prefetch_depth(settings):
                     jax.block_until_ready(inflight.popleft())
         if acc_dev is None:
             return combine_partials_host(plan, [_empty_partials(plan, np)])
@@ -641,10 +648,15 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
         GLOBAL_COUNTERS.bump("router_queries")
     elif len(plan.shard_indexes) > 1:
         GLOBAL_COUNTERS.bump("multi_shard_queries")
-    if bound.has_aggs:
-        rows = _run_agg(cat, plan, settings, params)
-    else:
-        rows = _run_projection(cat, plan, settings, params)
+    # admission control: one device-dispatch slot per executing query
+    # (the citus.max_shared_pool_size analog; 0 = unlimited)
+    from citus_tpu.executor.admission import GLOBAL_POOL
+    with GLOBAL_POOL.slot(settings.executor.max_shared_pool_size,
+                          timeout=settings.executor.lock_timeout_s):
+        if bound.has_aggs:
+            rows = _run_agg(cat, plan, settings, params)
+        else:
+            rows = _run_projection(cat, plan, settings, params)
     rows = order_and_limit(plan, rows)
     if bound.hidden_outputs:
         keep = len(bound.output_names) - bound.hidden_outputs

@@ -235,6 +235,80 @@ class DropTable(Statement):
 
 
 @dataclass
+class CreateExtension(Statement):
+    """Reference: commands/extension.c propagation."""
+    name: str
+    if_not_exists: bool = False
+    version: "str | None" = None
+
+
+@dataclass
+class DropExtension(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateDomain(Statement):
+    """CREATE DOMAIN name AS type [NOT NULL] [CHECK (expr)].
+    Reference: commands/domain.c propagation; VALUE refers to the
+    checked value inside the CHECK expression."""
+    name: str
+    base: str
+    type_args: list = field(default_factory=list)
+    not_null: bool = False
+    check_sql: "str | None" = None
+
+
+@dataclass
+class DropDomain(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateCollation(Statement):
+    """Reference: commands/collation.c propagation (metadata object)."""
+    name: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class DropCollation(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreatePublication(Statement):
+    """CREATE PUBLICATION name FOR TABLE t1, t2 | FOR ALL TABLES.
+    Reference: commands/publication.c; gates the CDC stream."""
+    name: str
+    tables: "list | str" = "all"   # list of names, or "all"
+
+
+@dataclass
+class DropPublication(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateStatistics(Statement):
+    """CREATE STATISTICS name ON c1, c2 FROM t.
+    Reference: commands/statistics.c propagation."""
+    name: str
+    columns: list = field(default_factory=list)
+    table: str = ""
+
+
+@dataclass
+class DropStatistics(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class CreateIndex(Statement):
     """CREATE [UNIQUE] INDEX name ON table (column).
     Reference: commands/index.c (DDL propagation) +

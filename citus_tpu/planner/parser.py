@@ -561,6 +561,76 @@ class Parser:
                 self.expect_kw("exists")
                 if_not_exists = True
             return A.CreateRole(self.expect_ident(), if_not_exists)
+        if self.peek().kind == "ident" and self.peek().value == "extension":
+            self.next()
+            ine = self._accept_if_not_exists()
+            name = self.expect_ident()
+            version = None
+            if self.peek().kind == "ident" and self.peek().value == "version":
+                self.next()
+                vt = self.next()
+                version = vt.value.strip("'")
+            return A.CreateExtension(name, ine, version)
+        if self.peek().kind == "ident" and self.peek().value == "domain":
+            self.next()
+            name = self.expect_ident()
+            self.expect_kw("as")
+            base, targs = self.parse_type_name()
+            not_null = False
+            check_sql = None
+            while True:
+                if self.accept_kw("not"):
+                    self.expect_kw("null")
+                    not_null = True
+                    continue
+                if self.peek().kind == "ident" and self.peek().value == "check":
+                    self.next()
+                    check_sql = self._parse_paren_expr_text()
+                    continue
+                break
+            return A.CreateDomain(name, base, targs, not_null, check_sql)
+        if self.peek().kind == "ident" and self.peek().value == "collation":
+            self.next()
+            name = self.expect_ident()
+            options: dict = {}
+            if self.accept_op("("):
+                while True:
+                    key = self.next().value
+                    self.expect_op("=")
+                    options[key] = self.next().value.strip("'")
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return A.CreateCollation(name, options)
+        if self.peek().kind == "ident" and self.peek().value == "publication":
+            self.next()
+            name = self.expect_ident()
+            # no FOR clause = EMPTY publication (PostgreSQL semantics),
+            # not FOR ALL TABLES
+            tables: "list | str" = []
+            if self.peek().value == "for":
+                self.next()
+                if self.peek().value == "all":
+                    self.next()
+                    if self.peek().value != "tables":
+                        self.error("expected TABLES")
+                    self.next()
+                    tables = "all"
+                else:
+                    self.expect_kw("table")
+                    tables = [self.parse_table_name()]
+                    while self.accept_op(","):
+                        tables.append(self.parse_table_name())
+            return A.CreatePublication(name, tables)
+        if self.peek().kind == "ident" and self.peek().value == "statistics":
+            self.next()
+            name = self.expect_ident()
+            self.expect_kw("on")
+            cols = [self.expect_ident()]
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_kw("from")
+            return A.CreateStatistics(name, cols, self.parse_table_name())
         if self.peek().kind == "ident" and self.peek().value in ("unique",
                                                                  "index"):
             unique = self.next().value == "unique"
@@ -860,6 +930,13 @@ class Parser:
         return A.CreateTable(name, cols, if_not_exists, options, fkeys,
                              partition_by=partition_by)
 
+    def _accept_if_not_exists(self) -> bool:
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            return True
+        return False
+
     def _parse_partition_bound(self):
         """One FOR VALUES bound: literal, MINVALUE, or MAXVALUE (both
         map to None = unbounded)."""
@@ -1002,6 +1079,19 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             return A.DropIndex(self.expect_ident(), if_exists)
+        if self.peek().kind == "ident" and self.peek().value in (
+                "extension", "domain", "collation", "publication",
+                "statistics"):
+            kind = self.next().value
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            node = {"extension": A.DropExtension, "domain": A.DropDomain,
+                    "collation": A.DropCollation,
+                    "publication": A.DropPublication,
+                    "statistics": A.DropStatistics}[kind]
+            return node(self.expect_ident(), if_exists)
         if self.peek().kind == "ident" and self.peek().value in ("view", "sequence"):
             kind = self.next().value
             if_exists = False
@@ -1141,7 +1231,9 @@ class Parser:
         "run_command_on_placements", "master_get_table_ddl_events",
         "citus_backend_gpid", "citus_coordinator_nodeid",
         "create_time_partitions", "drop_old_time_partitions",
-        "time_partitions",
+        "time_partitions", "citus_stat_pool", "citus_extensions",
+        "citus_domains", "citus_collations", "citus_publications",
+        "citus_statistics_objects",
     }
 
     def parse_select_or_utility(self) -> A.Statement:

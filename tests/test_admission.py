@@ -1,0 +1,87 @@
+"""Shared task-pool admission control.
+
+Reference: citus.max_shared_pool_size backed by shared-memory counters
+(connection/shared_connection_stats.c) — bounds the node-wide worker
+connections; optional acquisitions fail fast, required ones wait."""
+
+import threading
+import time
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import ExecutionError
+from citus_tpu.executor.admission import GLOBAL_POOL, SharedTaskPool
+
+
+def test_required_waits_and_bounds_concurrency():
+    pool = SharedTaskPool()
+    peak = []
+
+    def work(i):
+        with pool.slot(2, timeout=10):
+            peak.append(pool.in_use)
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert pool.high_water <= 2
+    assert pool.granted == 8
+    assert pool.waits > 0
+    assert pool.in_use == 0
+
+
+def test_optional_denied_fast():
+    pool = SharedTaskPool()
+    assert pool.acquire(1) is True
+    t0 = time.monotonic()
+    assert pool.acquire(1, optional=True) is False
+    assert time.monotonic() - t0 < 0.1  # never waited
+    assert pool.stats()["denied_optional"] == 1
+    pool.release()
+
+
+def test_required_times_out():
+    pool = SharedTaskPool()
+    pool.acquire(1)
+    with pytest.raises(ExecutionError, match="max_shared_pool_size"):
+        pool.acquire(1, timeout=0.1)
+    pool.release()
+
+
+def test_unlimited_by_default():
+    pool = SharedTaskPool()
+    for _ in range(64):
+        assert pool.acquire(0) is True
+    assert pool.high_water == 64
+
+
+def test_queries_bounded_end_to_end(tmp_path):
+    """Concurrent queries through the SQL surface respect the cap and
+    the citus_stat_pool view reports it."""
+    import dataclasses
+    from citus_tpu.config import ExecutorSettings, Settings
+    st = Settings(executor=ExecutorSettings(max_shared_pool_size=2))
+    cl = ct.Cluster(str(tmp_path / "db"), settings=st)
+    cl.execute("CREATE TABLE t (k bigint, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 8)")
+    cl.copy_from("t", rows=[(i, i) for i in range(20000)])
+    results = []
+
+    def q():
+        results.append(cl.execute("SELECT sum(v) FROM t").rows[0][0])
+
+    threads = [threading.Thread(target=q) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [sum(range(20000))] * 6
+    view = cl.execute("SELECT citus_stat_pool()")
+    row = dict(zip(view.columns, view.rows[0]))
+    assert row["pool_size"] == 2
+    assert row["in_use"] == 0
+    assert row["granted"] >= 6

@@ -1,0 +1,179 @@
+"""Object-surface breadth: extensions, domains, collations,
+publications, statistics objects, secondary-node routing.
+
+Reference: commands/extension.c, domain.c, collation.c, publication.c,
+statistics.c propagation + citus.use_secondary_nodes."""
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError, ExecutionError
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    return ct.Cluster(str(tmp_path / "db"))
+
+
+def test_extensions(cl):
+    cl.execute("CREATE EXTENSION citus")
+    cl.execute("CREATE EXTENSION IF NOT EXISTS citus")
+    with pytest.raises(CatalogError):
+        cl.execute("CREATE EXTENSION citus")
+    assert cl.execute("SELECT citus_extensions()").rows == [("citus", "1.0")]
+    cl.execute("DROP EXTENSION citus")
+    assert cl.execute("SELECT citus_extensions()").rows == []
+    cl.execute("DROP EXTENSION IF EXISTS citus")
+
+
+def test_domain_enforced_on_ingest(cl):
+    cl.execute("CREATE DOMAIN posint AS bigint CHECK (value > 0)")
+    cl.execute("CREATE TABLE t (k bigint, qty posint)")
+    assert cl.catalog.table("t").schema.column("qty").type.kind == "int64"
+    cl.execute("INSERT INTO t VALUES (1, 5)")
+    with pytest.raises(ExecutionError, match="posint"):
+        cl.execute("INSERT INTO t VALUES (2, -3)")
+    cl.execute("INSERT INTO t VALUES (3, NULL)")  # NULL passes CHECK
+    assert cl.execute("SELECT count(*) FROM t").rows == [(2,)]
+    with pytest.raises(CatalogError, match="depends on it"):
+        cl.execute("DROP DOMAIN posint")
+    rows = cl.execute("SELECT citus_domains()").rows
+    assert rows == [("posint", "bigint", False, "value > 0")]
+
+
+def test_domain_not_null(cl):
+    cl.execute("CREATE DOMAIN req_text AS text NOT NULL")
+    cl.execute("CREATE TABLE u (k bigint, name req_text)")
+    with pytest.raises(Exception):
+        cl.execute("INSERT INTO u VALUES (1, NULL)")
+    cl.execute("INSERT INTO u VALUES (1, 'ok')")
+
+
+def test_collations_registry(cl):
+    cl.execute("CREATE COLLATION german (locale = 'de_DE', provider = 'icu')")
+    assert cl.execute("SELECT citus_collations()").rows == \
+        [("german", "de_DE", "icu")]
+    cl.execute("DROP COLLATION german")
+    with pytest.raises(CatalogError):
+        cl.execute("DROP COLLATION german")
+
+
+def test_publication_gates_cdc(cl):
+    """CDC is globally off, but a publication covering the table turns
+    its change stream on (reference: publications gate logical
+    decoding per table)."""
+    assert not cl.cdc.enabled
+    cl.execute("CREATE TABLE ev (k bigint, v bigint)")
+    cl.execute("CREATE TABLE quiet (k bigint)")
+    cl.copy_from("ev", rows=[(1, 10)])
+    assert list(cl.cdc.events("ev")) == []  # not yet published
+    cl.execute("CREATE PUBLICATION pub_ev FOR TABLE ev")
+    cl.copy_from("ev", rows=[(2, 20)])
+    cl.copy_from("quiet", rows=[(1,)])
+    evs = list(cl.cdc.events("ev"))
+    assert len(evs) == 1 and evs[0]["op"] == "insert"
+    assert list(cl.cdc.events("quiet")) == []  # uncovered table stays quiet
+    cl.execute("DROP PUBLICATION pub_ev")
+    cl.copy_from("ev", rows=[(3, 30)])
+    assert len(list(cl.cdc.events("ev"))) == 1  # stream stopped
+
+
+def test_publication_for_all_tables(cl):
+    cl.execute("CREATE TABLE a (k bigint)")
+    cl.execute("CREATE PUBLICATION everything FOR ALL TABLES")
+    cl.copy_from("a", rows=[(1,)])
+    assert len(list(cl.cdc.events("a"))) == 1
+    assert cl.execute("SELECT citus_publications()").rows == \
+        [("everything", "ALL TABLES")]
+
+
+def test_statistics_objects(cl):
+    cl.execute("CREATE TABLE s (a bigint, b bigint)")
+    cl.copy_from("s", rows=[(i % 3, i % 4) for i in range(120)])
+    cl.execute("CREATE STATISTICS s_ab ON a, b FROM s")
+    rows = cl.execute("SELECT citus_statistics_objects()").rows
+    assert rows == [("s_ab", "s", "a, b", 12)]  # 3x4 combinations
+    cl.execute("DROP STATISTICS s_ab")
+    assert cl.execute("SELECT citus_statistics_objects()").rows == []
+
+
+def test_domain_enforced_on_update_and_insert_select(cl):
+    cl.execute("CREATE DOMAIN posint AS bigint CHECK (value > 0)")
+    cl.execute("CREATE TABLE t (k bigint, qty posint)")
+    cl.execute("INSERT INTO t VALUES (1, 5)")
+    with pytest.raises(ExecutionError, match="posint"):
+        cl.execute("UPDATE t SET qty = -5 WHERE k = 1")
+    assert cl.execute("SELECT qty FROM t").rows == [(5,)]
+    cl.execute("CREATE TABLE src (k bigint, qty bigint)")
+    cl.execute("INSERT INTO src VALUES (2, -7)")
+    with pytest.raises(ExecutionError, match="posint"):
+        cl.execute("INSERT INTO t SELECT * FROM src")
+    assert cl.execute("SELECT count(*) FROM t").rows == [(1,)]
+
+
+def test_drop_table_cleans_domain_and_publication_refs(cl):
+    cl.execute("CREATE DOMAIN posint AS bigint CHECK (value > 0)")
+    cl.execute("CREATE TABLE t (k bigint, qty posint)")
+    cl.execute("CREATE PUBLICATION p FOR TABLE t")
+    cl.execute("DROP TABLE t")
+    cl.execute("DROP DOMAIN posint")  # no stale dependency
+    assert cl.catalog.publications["p"]["tables"] == []
+    # re-created same-name table is NOT domain-bound or published
+    cl.execute("CREATE TABLE t (k bigint, qty bigint)")
+    cl.execute("INSERT INTO t VALUES (1, -5)")  # plain bigint: fine
+    assert list(cl.cdc.events("t")) == []
+
+
+def test_empty_publication_captures_nothing(cl):
+    cl.execute("CREATE TABLE q (k bigint)")
+    cl.execute("CREATE PUBLICATION empty_pub")
+    cl.copy_from("q", rows=[(1,)])
+    assert list(cl.cdc.events("q")) == []
+
+
+def test_publication_on_partitioned_parent(cl):
+    cl.execute("CREATE TABLE pe (k bigint, d date) PARTITION BY RANGE (d)")
+    cl.execute("CREATE TABLE pe_a PARTITION OF pe "
+               "FOR VALUES FROM ('2024-01-01') TO ('2025-01-01')")
+    cl.execute("CREATE PUBLICATION ppub FOR TABLE pe")
+    cl.copy_from("pe", rows=[(1, "2024-05-05")])
+    # captured under the leaf partition's stream (pubviaroot=false style)
+    assert len(list(cl.cdc.events("pe_a"))) == 1
+
+
+def test_add_column_with_domain_and_enum(cl):
+    cl.execute("CREATE DOMAIN posint AS bigint CHECK (value > 0)")
+    cl.execute("CREATE TYPE mood AS ENUM ('sad', 'happy')")
+    cl.execute("CREATE TABLE t (k bigint)")
+    cl.execute("ALTER TABLE t ADD COLUMN qty posint")
+    cl.execute("ALTER TABLE t ADD COLUMN m mood")
+    with pytest.raises(ExecutionError, match="posint"):
+        cl.execute("INSERT INTO t VALUES (1, -2, 'sad')")
+    cl.execute("INSERT INTO t VALUES (1, 2, 'happy')")
+    assert cl.execute("SELECT m FROM t WHERE qty = 2").rows == [("happy",)]
+
+
+def test_secondary_node_routing(tmp_path):
+    """use_secondary_nodes prefers replica placements for reads."""
+    import numpy as np
+    from citus_tpu.config import ExecutorSettings, Settings, ShardingSettings
+    st = Settings(sharding=ShardingSettings(shard_replication_factor=2),
+                  executor=ExecutorSettings(use_secondary_nodes=True))
+    cl = ct.Cluster(str(tmp_path / "db2"), n_nodes=2, settings=st)
+    cl.execute("CREATE TABLE r (k bigint, v bigint)")
+    cl.execute("SELECT create_distributed_table('r', 'k', 4)")
+    cl.copy_from("r", rows=[(i, i) for i in range(1000)])
+    # destroy every PRIMARY placement: reads must come from replicas
+    import shutil
+    t = cl.catalog.table("r")
+    for s in t.shards:
+        shutil.rmtree(cl.catalog.shard_dir("r", s.shard_id, s.placements[0]),
+                      ignore_errors=True)
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    fo_before = cl.counters.snapshot().get("connection_failovers", 0)
+    assert cl.execute("SELECT count(*), sum(v) FROM r").rows == \
+        [(1000, sum(range(1000)))]
+    # replicas served directly — no failover was needed
+    assert cl.counters.snapshot().get("connection_failovers", 0) == fo_before
+    cl.close()
