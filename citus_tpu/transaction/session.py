@@ -17,9 +17,10 @@ Savepoints exploit the staged representation directly: because every
 pending effect of the transaction lives in small per-placement side
 files, a savepoint is a snapshot of those side files' contents, and
 ROLLBACK TO restores them (deleting stripe data files staged after the
-snapshot).  PostgreSQL divergence: locks acquired after the savepoint
-are retained until transaction end (conservative; PostgreSQL releases
-them).
+snapshot).  Locks acquired after the savepoint are released by
+ROLLBACK TO, like PostgreSQL's subtransaction abort; the one remaining
+divergence is a post-savepoint UPGRADE of an already-held lock, which
+keeps the stronger mode until transaction end (conservative).
 
 Two-phase locking: write locks acquired by statements are retained until
 COMMIT/ROLLBACK (the reference holds row/shard locks to transaction
@@ -228,6 +229,7 @@ class OpenTransaction:
             "delete_dirs": set(self.delete_dirs),
             "tables": set(self.tables),
             "n_cdc": len(self.cdc_events),
+            "locks": set(self.locks),
             "catalog_dirty": self.catalog_dirty,
             "ddl_statements": self.ddl_statements,
             "n_on_commit": len(self.on_commit),
@@ -248,6 +250,27 @@ class OpenTransaction:
     def restore(self, snap: dict, cluster=None) -> None:
         """ROLLBACK TO SAVEPOINT: put every staged side file back to its
         snapshot content, deleting stripe files staged since."""
+        if cluster is not None and "locks" in snap:
+            # PostgreSQL releases locks the rolled-back subtransaction
+            # acquired; locks held AT the savepoint are retained (a
+            # post-savepoint upgrade of one of those keeps the stronger
+            # mode — conservative divergence)
+            import fcntl
+
+            from citus_tpu.transaction.global_deadlock import (
+                _record_path, clear_record, make_gpid,
+            )
+            gpid = make_gpid(self.lock_sid)
+            data_dir = cluster.catalog.data_dir
+            for res in [r for r in self.locks if r not in snap["locks"]]:
+                held = self.locks.pop(res)
+                try:
+                    fcntl.flock(held.fd, fcntl.LOCK_UN)
+                    os.close(held.fd)
+                except OSError:
+                    pass
+                cluster.locks.release(self.lock_sid, res)
+                clear_record(_record_path(data_dir, "h", gpid, res))
         if snap.get("ddl_statements", 0) != self.ddl_statements:
             # DDL staged after the savepoint: undo its physical
             # artifacts, then restore the catalog as of the savepoint
