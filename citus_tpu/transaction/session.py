@@ -98,6 +98,53 @@ class OpenTransaction:
         return bool(self.ingest_dirs or self.delete_dirs)
 
     # ---- retained locks ------------------------------------------------
+    def _release_one(self, cluster, res: str, held: "_HeldLock") -> None:
+        """Tear one retained lock down across all layers (flock fd,
+        in-process manager, cross-process hold record)."""
+        import fcntl
+
+        from citus_tpu.transaction.global_deadlock import (
+            _record_path, clear_record, make_gpid,
+        )
+        try:
+            fcntl.flock(held.fd, fcntl.LOCK_UN)
+            os.close(held.fd)
+        except OSError:
+            pass
+        cluster.locks.release(self.lock_sid, res)
+        clear_record(_record_path(cluster.catalog.data_dir, "h",
+                                  make_gpid(self.lock_sid), res))
+
+    def _acquire_res(self, cluster, res: str, mode: str) -> None:
+        """Fresh two-layer acquisition of ``res`` into the retained set
+        (manager lock, flock on a new fd, hold record)."""
+        import fcntl
+
+        from citus_tpu.transaction.global_deadlock import (
+            flock_wait_instrumented, make_gpid, publish_hold,
+        )
+        from citus_tpu.transaction.write_locks import lockfile_path
+
+        timeout = cluster.settings.executor.lock_timeout_s
+        data_dir = cluster.catalog.data_dir
+        gpid = make_gpid(self.lock_sid)
+        cluster.locks.acquire(self.lock_sid, res, mode, timeout=timeout)
+        try:
+            fd = os.open(lockfile_path(data_dir, res), os.O_CREAT | os.O_RDWR)
+            try:
+                flock_wait_instrumented(
+                    fd, fcntl.LOCK_SH if mode == SHARED else fcntl.LOCK_EX,
+                    timeout, data_dir=data_dir, gpid=gpid, res=res,
+                    mode=mode, started=self.started)
+            except BaseException:
+                os.close(fd)
+                raise
+            self.locks[res] = _HeldLock(mode, fd)
+            publish_hold(data_dir, gpid, res, mode, self.started)
+        except BaseException:
+            cluster.locks.release(self.lock_sid, res)
+            raise
+
     def hold_group_lock(self, cluster, table_meta, mode: str) -> None:
         """Acquire (or upgrade) the colocation-group write lock and
         retain it until transaction end.  Mirrors
@@ -138,17 +185,8 @@ class OpenTransaction:
                 try:
                     fcntl.flock(held.fd, flmode | fcntl.LOCK_NB)
                 except OSError:
-                    try:
-                        fcntl.flock(held.fd, fcntl.LOCK_UN)
-                        os.close(held.fd)
-                    except OSError:
-                        pass
                     del self.locks[res]
-                    cluster.locks.release(self.lock_sid, res)
-                    from citus_tpu.transaction.global_deadlock import (
-                        _record_path, clear_record,
-                    )
-                    clear_record(_record_path(data_dir, "h", gpid, res))
+                    self._release_one(cluster, res, held)
                     from citus_tpu.errors import TransactionError
                     raise TransactionError(
                         f"could not upgrade write lock on {res!r} "
@@ -187,18 +225,11 @@ class OpenTransaction:
                 cat._merge_foreign_locked()
 
     def release_locks(self, cluster) -> None:
-        import fcntl
-
         from citus_tpu.transaction.global_deadlock import (
             check_cancelled, clear_holds, make_gpid,
         )
-        for res, held in self.locks.items():
-            try:
-                fcntl.flock(held.fd, fcntl.LOCK_UN)
-                os.close(held.fd)
-            except OSError:
-                pass
-            cluster.locks.release(self.lock_sid, res)
+        for res, held in list(self.locks.items()):
+            self._release_one(cluster, res, held)
         self.locks.clear()
         cluster.locks.release_all(self.lock_sid)
         gpid = make_gpid(self.lock_sid)
@@ -229,7 +260,7 @@ class OpenTransaction:
             "delete_dirs": set(self.delete_dirs),
             "tables": set(self.tables),
             "n_cdc": len(self.cdc_events),
-            "locks": set(self.locks),
+            "locks": {res: held.mode for res, held in self.locks.items()},
             "catalog_dirty": self.catalog_dirty,
             "ddl_statements": self.ddl_statements,
             "n_on_commit": len(self.on_commit),
@@ -255,22 +286,15 @@ class OpenTransaction:
             # acquired; locks held AT the savepoint are retained (a
             # post-savepoint upgrade of one of those keeps the stronger
             # mode — conservative divergence)
-            import fcntl
-
-            from citus_tpu.transaction.global_deadlock import (
-                _record_path, clear_record, make_gpid,
-            )
-            gpid = make_gpid(self.lock_sid)
-            data_dir = cluster.catalog.data_dir
             for res in [r for r in self.locks if r not in snap["locks"]]:
-                held = self.locks.pop(res)
-                try:
-                    fcntl.flock(held.fd, fcntl.LOCK_UN)
-                    os.close(held.fd)
-                except OSError:
-                    pass
-                cluster.locks.release(self.lock_sid, res)
-                clear_record(_record_path(data_dir, "h", gpid, res))
+                self._release_one(cluster, res, self.locks.pop(res))
+            # a failed post-savepoint upgrade dropped the lock outright;
+            # the restored pre-savepoint staged writes need it back —
+            # re-acquire at the snapshotted mode (may block; on failure
+            # the block stays failed, exactly like any statement error)
+            for res, mode in snap["locks"].items():
+                if res not in self.locks:
+                    self._acquire_res(cluster, res, mode)
         if snap.get("ddl_statements", 0) != self.ddl_statements:
             # DDL staged after the savepoint: undo its physical
             # artifacts, then restore the catalog as of the savepoint

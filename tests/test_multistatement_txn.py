@@ -456,7 +456,6 @@ def test_rollback_to_savepoint_releases_later_locks(tmp_path):
     """PostgreSQL parity (round-3 weak #6): locks acquired after a
     savepoint are released by ROLLBACK TO, so another session can write
     the table without waiting for the transaction to end."""
-    import dataclasses
     from citus_tpu.config import ExecutorSettings, Settings
     st = Settings(executor=ExecutorSettings(lock_timeout_s=1.0))
     cl = ct.Cluster(str(tmp_path / "db"), settings=st)
@@ -478,3 +477,41 @@ def test_rollback_to_savepoint_releases_later_locks(tmp_path):
     s1.execute("COMMIT")
     assert cl.execute("SELECT x FROM a").rows == [(2,)]
     assert cl.execute("SELECT x FROM b").rows == [(3,)]
+
+
+def test_rollback_to_reacquires_lock_dropped_by_failed_upgrade(tmp_path):
+    """A failed post-savepoint SHARED->EXCLUSIVE upgrade (contended by
+    ANOTHER PROCESS at the flock layer) drops the lock outright;
+    ROLLBACK TO must re-acquire it so the restored pre-savepoint staged
+    writes stay protected (2PL)."""
+    from citus_tpu.config import ExecutorSettings, Settings
+    from citus_tpu.transaction.write_locks import group_resource, lockfile_path
+    st = Settings(executor=ExecutorSettings(lock_timeout_s=1.0))
+    cl = ct.Cluster(str(tmp_path / "db"), settings=st)
+    cl.execute("CREATE TABLE a (x bigint)")
+    cl.copy_from("a", rows=[(1,)])
+    s1 = cl.session()
+    s1.execute("BEGIN")
+    s1.execute("INSERT INTO a VALUES (2)")     # SHARED group lock
+    s1.execute("SAVEPOINT sp")
+    res = group_resource(cl.catalog.table("a"))
+    lockfile = lockfile_path(cl.catalog.data_dir, res)
+    hold = subprocess.Popen(  # foreign process holds SHARED on the flock
+        [sys.executable, "-c",
+         "import fcntl, os, sys, time; "
+         "fd = os.open(sys.argv[1], os.O_CREAT | os.O_RDWR); "
+         "fcntl.flock(fd, fcntl.LOCK_SH); print('held', flush=True); "
+         "time.sleep(30)", lockfile],
+        stdout=subprocess.PIPE, text=True)
+    assert hold.stdout.readline().strip() == "held"
+    try:
+        with pytest.raises(TransactionError, match="upgrade"):
+            s1.execute("UPDATE a SET x = 9")   # flock upgrade fails, drop
+        assert res not in s1.txn.locks         # the lock is really gone
+    finally:
+        hold.terminate()
+        hold.wait()
+    s1.execute("ROLLBACK TO SAVEPOINT sp")     # must re-acquire SHARED
+    assert res in s1.txn.locks and s1.txn.locks[res].mode == "shared"
+    s1.execute("COMMIT")
+    assert sorted(cl.execute("SELECT x FROM a").rows) == [(1,), (2,)]
