@@ -927,6 +927,20 @@ class Cluster:
                               ix["column"], unique=ix.get("unique", False))
         self._plan_cache.clear()
 
+    def _fanout_partitions(self, stmt, *, aggregate_explain: bool = False
+                           ) -> Result:
+        """Run a single-table utility statement (TRUNCATE, VACUUM) on
+        every partition of the named parent, optionally summing the
+        integer explain stats."""
+        import dataclasses as _dc
+        agg: dict = {}
+        for p in self.catalog.partitions_of(stmt.table):
+            sub = self._execute_stmt(_dc.replace(stmt, table=p.name))
+            if aggregate_explain:
+                for k, v in sub.explain.items():
+                    agg[k] = agg.get(k, 0) + v
+        return Result(columns=[], rows=[], explain=agg)
+
     def _partition_dml(self, stmt, t) -> Result:
         """UPDATE/DELETE against a partitioned parent: run per surviving
         partition (pruned on the WHERE) and sum the counts."""
@@ -2637,10 +2651,7 @@ class Cluster:
             forbid_truncate_referenced(self.catalog, stmt.table)
             t = self.catalog.table(stmt.table)
             if t.is_partitioned:
-                import dataclasses as _dc
-                for p in self.catalog.partitions_of(stmt.table):
-                    self._execute_stmt(_dc.replace(stmt, table=p.name))
-                return Result(columns=[], rows=[])
+                return self._fanout_partitions(stmt)
             with self._write_lock(t, EXCLUSIVE):
                 execute_truncate(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
@@ -2652,6 +2663,9 @@ class Cluster:
             from citus_tpu.executor.dml import execute_vacuum
             from citus_tpu.transaction.locks import EXCLUSIVE
             t = self.catalog.table(stmt.table)
+            if t.is_partitioned:
+                # the parent holds no data: vacuum every partition
+                return self._fanout_partitions(stmt, aggregate_explain=True)
             with self._write_lock(t, EXCLUSIVE):
                 st = execute_vacuum(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
