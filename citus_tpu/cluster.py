@@ -1658,7 +1658,7 @@ class Cluster:
                 A.CreateExtension, A.DropExtension, A.CreateDomain,
                 A.DropDomain, A.CreateCollation, A.DropCollation,
                 A.CreatePublication, A.DropPublication,
-                A.CreateStatistics, A.DropStatistics,
+                A.CreateStatistics, A.DropStatistics, A.Analyze,
                 A.UtilityCall)
         if not isinstance(stmt, Cluster._TXN_ALLOWED):
             raise UnsupportedFeatureError(
@@ -2371,15 +2371,10 @@ class Cluster:
             # extended statistics: n-distinct over the column combination
             # (reference: CREATE STATISTICS ndistinct; computed eagerly —
             # our ANALYZE analog)
-            sel = A.Select(
-                [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
-                A.SubqueryRef(A.Select(
-                    [A.SelectItem(A.ColumnRef(c)) for c in stmt.columns],
-                    A.TableRef(stmt.table), distinct=True), "d"))
-            nd = self._execute_stmt(sel).rows[0][0]
+            nd = self._compute_ndistinct(stmt.table, list(stmt.columns))
             self.catalog.statistics[stmt.name] = {
                 "table": stmt.table, "columns": list(stmt.columns),
-                "ndistinct": int(nd)}
+                "ndistinct": nd}
             self.catalog.ddl_epoch += 1
             self.catalog.commit()
             return Result(columns=[], rows=[])
@@ -2572,6 +2567,12 @@ class Cluster:
                     self.catalog.tombstone("domain_columns", key)
                 if self.catalog.enum_columns.pop(key, None) is not None:
                     self.catalog.tombstone("enum_columns", key)
+                # PostgreSQL auto-drops extended statistics with a column
+                for sname in [n for n, st in self.catalog.statistics.items()
+                              if st["table"] == stmt.table
+                              and stmt.old_name in st["columns"]]:
+                    del self.catalog.statistics[sname]
+                    self.catalog.tombstone("statistics", sname)
                 self.catalog.drop_column(stmt.table, stmt.old_name)
             elif stmt.action == "rename_column":
                 t0 = self.catalog.table(stmt.table)
@@ -2670,11 +2671,80 @@ class Cluster:
                 st = execute_vacuum(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain=st)
+        if isinstance(stmt, A.Analyze):
+            return self._execute_analyze(stmt.table)
+        if isinstance(stmt, A.VacuumAnalyze):
+            self._execute_stmt(A.Vacuum(stmt.table, stmt.full))
+            return self._execute_analyze(stmt.table)
+        if isinstance(stmt, A.Reindex):
+            return self._execute_reindex(stmt)
         if isinstance(stmt, A.UtilityCall):
             return self._execute_utility(stmt)
         if isinstance(stmt, A.Explain):
             return self._execute_explain(stmt)
         raise UnsupportedFeatureError(f"cannot execute {type(stmt).__name__}")
+
+    def _compute_ndistinct(self, table: str, columns: list) -> int:
+        """count(DISTINCT (cols)) — the extended-statistics ndistinct."""
+        sel = A.Select(
+            [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+            A.SubqueryRef(A.Select(
+                [A.SelectItem(A.ColumnRef(c)) for c in columns],
+                A.TableRef(table), distinct=True), "d"))
+        return int(self._execute_stmt(sel).rows[0][0])
+
+    def _execute_analyze(self, table: Optional[str]) -> Result:
+        """ANALYZE [table]: recompute extended-statistics ndistinct
+        (column min/max stats are always skip-list-live here, so there
+        is no per-column histogram pass to run)."""
+        if table is not None:
+            self.catalog.table(table)  # PostgreSQL: unknown relation errors
+        refreshed = 0
+        for name, st in self.catalog.statistics.items():
+            if table is not None and st["table"] != table:
+                continue
+            if not self.catalog.has_table(st["table"]):
+                continue
+            st["ndistinct"] = self._compute_ndistinct(st["table"],
+                                                      st["columns"])
+            refreshed += 1
+        if refreshed:
+            self.catalog.commit()
+        return Result(columns=[], rows=[],
+                      explain={"statistics_refreshed": refreshed})
+
+    def _execute_reindex(self, stmt: A.Reindex) -> Result:
+        """REINDEX INDEX name | REINDEX TABLE name: rebuild segment
+        files from the stripe data (recovers from lost/corrupted
+        segments; a missing segment is only a slow path, never wrong)."""
+        from citus_tpu.storage.index import backfill_index
+        from citus_tpu.transaction.locks import EXCLUSIVE
+        if stmt.kind == "index":
+            t, ix = self._find_index(stmt.name)
+            if ix is None:
+                raise CatalogError(f'index "{stmt.name}" does not exist')
+            targets = [(t, [ix["column"]])]
+        else:
+            t = self.catalog.table(stmt.name)
+            if t.is_partitioned:
+                targets = [(p, p.index_columns)
+                           for p in self.catalog.partitions_of(t.name)
+                           if p.indexes]
+            else:
+                targets = [(t, t.index_columns)] if t.indexes else []
+        rebuilt = 0
+        for tt, cols in targets:
+            with self._write_lock(tt, EXCLUSIVE):
+                for col in cols:
+                    self._drop_index_segments(tt, col)
+                rebuilt += backfill_index(self.catalog, tt, list(cols))
+                tt.version += 1
+        if targets:
+            self.catalog.ddl_epoch += 1
+            self.catalog.commit()
+            self._plan_cache.clear()
+        return Result(columns=[], rows=[],
+                      explain={"segments_rebuilt": rebuilt})
 
     def _returning_result(self, table_name, where, items, subst=None):
         """Evaluate a RETURNING clause as a distributed SELECT over the

@@ -309,6 +309,28 @@ class DropStatistics(Statement):
 
 
 @dataclass
+class Analyze(Statement):
+    """ANALYZE [table]: refresh derived statistics (extended-statistics
+    ndistinct; column bounds are always skip-list-live here).
+    Reference: commands/vacuum.c ANALYZE propagation."""
+    table: "str | None" = None
+
+
+@dataclass
+class VacuumAnalyze(Statement):
+    table: str = ""
+    full: bool = False
+
+
+@dataclass
+class Reindex(Statement):
+    """REINDEX INDEX name | REINDEX TABLE name: rebuild segment files
+    (reference: reindex propagated through commands/index.c)."""
+    kind: str = "index"   # index | table
+    name: str = ""
+
+
+@dataclass
 class CreateIndex(Statement):
     """CREATE [UNIQUE] INDEX name ON table (column).
     Reference: commands/index.c (DDL propagation) +

@@ -261,8 +261,26 @@ class Parser:
             return (A.CopyTo if to else A.CopyFrom)(name, path, options)
         if self.at_kw("vacuum"):
             self.next()
-            full = bool(self.peek().kind == "ident" and self.peek().value == "full" and self.next())
+            # "full" lexes as a keyword (FULL OUTER JOIN)
+            full = bool(self.peek().value == "full" and self.next())
+            if self.accept_kw("analyze"):
+                name = self.parse_table_name()
+                return A.VacuumAnalyze(name, full)
             return A.Vacuum(self.parse_table_name(), full)
+        if self.at_kw("analyze"):
+            self.next()
+            name = self.parse_table_name() if self.peek().kind in (
+                "ident",) else None
+            return A.Analyze(name)
+        if self.peek().kind == "ident" and self.peek().value == "reindex":
+            self.next()
+            t = self.peek()
+            if t.kind in ("ident", "kw") and t.value in ("index", "table"):
+                self.next()
+                kind = t.value
+            else:
+                self.error("expected INDEX or TABLE after REINDEX")
+            return A.Reindex(kind, self.parse_table_name())
         if self.at_kw("grant", "revoke"):
             revoke = self.next().value == "revoke"
             privs = []

@@ -177,3 +177,61 @@ def test_secondary_node_routing(tmp_path):
     # replicas served directly — no failover was needed
     assert cl.counters.snapshot().get("connection_failovers", 0) == fo_before
     cl.close()
+
+def test_analyze_refreshes_statistics(cl):
+    cl.execute("CREATE TABLE s2 (a bigint, b bigint)")
+    cl.copy_from("s2", rows=[(i % 2, i % 2) for i in range(40)])
+    cl.execute("CREATE STATISTICS s2_ab ON a, b FROM s2")
+    assert cl.execute("SELECT citus_statistics_objects()").rows[0][3] == 2
+    cl.copy_from("s2", rows=[(i % 10, i % 7) for i in range(200)])
+    r = cl.execute("ANALYZE s2")
+    assert r.explain["statistics_refreshed"] == 1
+    nd = cl.execute("SELECT citus_statistics_objects()").rows[0][3]
+    assert nd > 2
+    # bare ANALYZE refreshes everything
+    assert cl.execute("ANALYZE").explain["statistics_refreshed"] == 1
+
+
+def test_reindex_rebuilds_segments(cl):
+    import os
+    cl.execute("CREATE TABLE ri (k bigint, v bigint)")
+    cl.copy_from("ri", rows=[(i, i % 50) for i in range(5000)])
+    cl.execute("CREATE INDEX ri_v ON ri (v)")
+
+    def segs():
+        t = cl.catalog.table("ri")
+        out = []
+        for shard in t.shards:
+            for node in shard.placements:
+                d = cl.catalog.shard_dir("ri", shard.shard_id, node)
+                if os.path.isdir(d):
+                    out += [os.path.join(d, f) for f in os.listdir(d)
+                            if f.endswith(".idx.v.npz")]
+        return out
+
+    before = segs()
+    assert before
+    for p in before:  # simulate lost/corrupted segments
+        os.remove(p)
+    r = cl.execute("REINDEX INDEX ri_v")
+    assert r.explain["segments_rebuilt"] >= len(before)
+    assert segs()
+    assert cl.execute("SELECT count(*) FROM ri WHERE v = 7").rows == [(100,)]
+    r2 = cl.execute("REINDEX TABLE ri")
+    assert r2.explain["segments_rebuilt"] >= len(before)
+    # VACUUM ANALYZE spelling parses and runs
+    cl.execute("VACUUM ANALYZE ri")
+
+
+def test_analyze_edge_cases(cl):
+    with pytest.raises(CatalogError):
+        cl.execute("ANALYZE no_such_table")
+    cl.execute("CREATE TABLE dc (a bigint, b bigint, c bigint)")
+    cl.copy_from("dc", rows=[(1, 2, 3)])
+    cl.execute("CREATE STATISTICS dc_ab ON a, b FROM dc")
+    # dropping a member column auto-drops the statistics object (PG)
+    cl.execute("ALTER TABLE dc DROP COLUMN b")
+    assert cl.execute("SELECT citus_statistics_objects()").rows == []
+    cl.execute("ANALYZE")  # no stale entry to trip over
+    # VACUUM FULL spelling parses
+    cl.execute("VACUUM FULL dc")
