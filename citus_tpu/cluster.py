@@ -2631,9 +2631,8 @@ class Cluster:
                 raise UnsupportedFeatureError(
                     "MERGE on tables with foreign key constraints is not "
                     "supported")
-            if _mt.unique_indexes:
-                raise UnsupportedFeatureError(
-                    "MERGE on tables with UNIQUE indexes is not supported")
+            # unique indexes are enforced inside execute_merge (pre-commit
+            # delete-aware probe); FK targets stay refused above
             with self._write_lock(self.catalog.table(stmt.target.name), EXCLUSIVE):
                 st = execute_merge(
                     self.catalog, self.txlog, stmt,

@@ -34,6 +34,36 @@ from citus_tpu.transaction.manager import TransactionLog, TxState
 from citus_tpu import types as T
 
 
+def _eval_text_target(cat, source, s_alias, target, tcol, bound, env, n):
+    """Evaluate a value destined for a TEXT target column.  A bare
+    source column's codes live in the SOURCE table's dictionary — decode
+    there and re-encode into the target column's dictionary.  Anything
+    else that touches source text fails closed (a computed text value
+    cannot be remapped after the fact)."""
+    from citus_tpu.planner.bound import referenced_columns
+    pfx = s_alias + "."
+    if isinstance(bound, BColumn) and bound.name.startswith(pfx):
+        src_col = bound.name[len(pfx):]
+        v, m = _eval(env, bound, n)
+        codes = np.asarray(v).astype(np.int64)
+        mm = np.asarray(m) if not isinstance(m, bool) else np.full(n, m)
+        out = np.zeros(n, np.int64)
+        idx = np.nonzero(mm)[0]
+        if idx.size:
+            words = cat.decode_strings(source.name, src_col,
+                                       codes[idx].tolist())
+            out[idx] = cat.encode_strings(target.name, tcol, words)
+        return out, mm
+    if any(c.startswith(pfx)
+           and source.schema.has(c[len(pfx):])
+           and source.schema.column(c[len(pfx):]).type.is_text
+           for c in referenced_columns(bound)):
+        raise UnsupportedFeatureError(
+            f"MERGE cannot assign a computed text expression over source "
+            f"columns to {tcol!r} (dictionary remap of computed values)")
+    return _eval(env, bound, n)
+
+
 def _eval(frame, expr, n):
     v, valid = compile_expr(expr, np)(frame)
     v = np.asarray(v)
@@ -80,6 +110,11 @@ def execute_merge(cat: Catalog, txlog: TransactionLog, stmt: A.Merge,
         raise UnsupportedFeatureError("MERGE requires an equi-join ON condition")
     if residual:
         raise UnsupportedFeatureError("non-equi MERGE ON conjuncts are not supported yet")
+    if any(k.type.is_text for k in t_keys):
+        # per-table dictionaries: source and target codes for the same
+        # string differ, so a raw-code equi-join would be silently wrong
+        raise UnsupportedFeatureError(
+            "MERGE ON text join keys is not supported yet")
 
     matched_when = [w for w in stmt.whens if w.matched]
     notmatched_when = [w for w in stmt.whens if not w.matched]
@@ -102,7 +137,8 @@ def execute_merge(cat: Catalog, txlog: TransactionLog, stmt: A.Merge,
     try:
         return _execute_merge_tx(
             cat, txlog, target, xid, src_frame, src_n, smat, svalid,
-            src_matched, binder, t_alias, t_keys, mw, nw, encode_value)
+            src_matched, binder, t_alias, t_keys, mw, nw, encode_value,
+            source, s_alias)
     except BaseException:
         # stop driving the transaction; recovery decides its outcome
         txlog.release(xid)
@@ -111,10 +147,12 @@ def execute_merge(cat: Catalog, txlog: TransactionLog, stmt: A.Merge,
 
 def _execute_merge_tx(cat, txlog, target, xid, src_frame, src_n,
                       smat, svalid, src_matched, binder, t_alias, t_keys,
-                      mw, nw, encode_value) -> dict:
+                      mw, nw, encode_value, source, s_alias) -> dict:
     staged_delete_dirs: list[str] = []
     insert_rows = {c: [] for c in target.schema.names}
     insert_valid = {c: [] for c in target.schema.names}
+    # rows being replaced, for the delete-aware unique probe
+    replaced: dict = {}
     n_updated = n_deleted = 0
 
     # ---- per target shard: join + stage matched actions ----------------
@@ -194,6 +232,9 @@ def _execute_merge_tx(cat, txlog, target, xid, src_frame, src_n,
             per_stripe.setdefault(sf, []).append(pos_flat[li[i]])
         merged = {sf: (np.asarray(ix, np.int64), stripe_rows[sf])
                   for sf, ix in per_stripe.items()}
+        repl = replaced.setdefault(d, {})
+        for sf, (ix, _rows) in merged.items():
+            repl.setdefault(sf, set()).update(ix.tolist())
         for node in shard.placements:
             pd = cat.shard_dir(target.name, shard.shard_id, node)
             if os.path.isdir(pd):
@@ -218,7 +259,11 @@ def _execute_merge_tx(cat, txlog, target, xid, src_frame, src_n,
         for c in target.schema.names:
             tc = target.schema.column(c)
             if c in assign:
-                v, m = _eval(env, assign[c], li.size)
+                if tc.type.is_text:
+                    v, m = _eval_text_target(cat, source, s_alias, target,
+                                             c, assign[c], env, li.size)
+                else:
+                    v, m = _eval(env, assign[c], li.size)
             else:
                 v, m = env[f"{t_alias}.{c}"]
             insert_rows[c].append(np.asarray(v)[sel].astype(tc.type.storage_dtype))
@@ -228,7 +273,6 @@ def _execute_merge_tx(cat, txlog, target, xid, src_frame, src_n,
     # ---- WHEN NOT MATCHED: inserts from unmatched source rows ----------
     n_inserted = 0
     if nw is not None and nw.action == "insert":
-        un = np.nonzero(~src_matched & np.asarray(svalid))[0]
         # rows with NULL join keys are also "not matched"
         un = np.nonzero(~src_matched)[0]
         if un.size:
@@ -257,7 +301,12 @@ def _execute_merge_tx(cat, txlog, target, xid, src_frame, src_n,
                             raise AnalysisError(f"cannot insert {bound.type} into {col}")
                     elif bound.type != tc.type and not bound.type.is_text:
                         bound = BCast(bound, tc.type)
-                    v, m = _eval(sub_env, bound, un.size)
+                    if tc.type.is_text:
+                        v, m = _eval_text_target(cat, source, s_alias,
+                                                 target, col, bound,
+                                                 sub_env, un.size)
+                    else:
+                        v, m = _eval(sub_env, bound, un.size)
                     provided[col] = (np.asarray(v)[sel], np.asarray(m)[sel])
                 for c in target.schema.names:
                     tc = target.schema.column(c)
@@ -277,6 +326,12 @@ def _execute_merge_tx(cat, txlog, target, xid, src_frame, src_n,
         from citus_tpu.ingest import TableIngestor
         values = {c: np.concatenate(insert_rows[c]) for c in target.schema.names}
         validity = {c: np.concatenate(insert_valid[c]) for c in target.schema.names}
+        if target.unique_indexes:
+            # batch-internal + delete-aware live probe BEFORE anything
+            # commits: rows replaced by WHEN MATCHED do not conflict
+            from citus_tpu.integrity import check_unique_update
+            check_unique_update(cat, target, values, validity,
+                                set(target.schema.names), replaced)
         ing = TableIngestor(cat, target, txlog=None)
         ing.xid = xid
         ing.append(values, validity)

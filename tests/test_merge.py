@@ -116,3 +116,74 @@ def test_merge_insert_only(db):
         WHEN NOT MATCHED THEN INSERT (id, qty, s) VALUES (s.id, s.qty, 'ins')""")
     assert r.explain == {"updated": 0, "deleted": 0, "inserted": 10}
     assert cl.execute("SELECT count(*) FROM tgt").rows == [(15,)]
+
+
+def test_merge_respects_unique_index(tmp_path):
+    """Round 4: MERGE on unique-indexed targets is allowed and enforced
+    (pre-commit delete-aware probe; replaced rows don't self-conflict)."""
+    import citus_tpu as ct
+    from citus_tpu.integrity import UniqueViolation
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE tgt (k bigint PRIMARY KEY, v bigint)")
+    cl.execute("CREATE TABLE src (k bigint, v bigint)")
+    cl.copy_from("tgt", rows=[(1, 10), (2, 20)])
+    cl.copy_from("src", rows=[(1, 11), (3, 30)])
+    # matched update (self-replacement of k=1) + unmatched insert (k=3)
+    r = cl.execute(
+        "MERGE INTO tgt t USING src s ON t.k = s.k "
+        "WHEN MATCHED THEN UPDATE SET v = s.v "
+        "WHEN NOT MATCHED THEN INSERT (k, v) VALUES (s.k, s.v)")
+    assert r.explain == {"updated": 1, "deleted": 0, "inserted": 1}
+    assert sorted(cl.execute("SELECT k, v FROM tgt").rows) == \
+        [(1, 11), (2, 20), (3, 30)]
+    # an insert arm that would duplicate an existing key aborts atomically
+    cl.execute("CREATE TABLE src2 (k bigint, v bigint)")
+    cl.copy_from("src2", rows=[(9, 90)])
+    with pytest.raises(UniqueViolation):
+        cl.execute(
+            "MERGE INTO tgt t USING src2 s ON t.k = s.k + 1000 "
+            "WHEN NOT MATCHED THEN INSERT (k, v) VALUES (2, s.v)")
+    assert sorted(cl.execute("SELECT k, v FROM tgt").rows) == \
+        [(1, 11), (2, 20), (3, 30)]
+
+
+def test_merge_text_insert_remaps_dictionaries(tmp_path):
+    """Source text codes live in the source table's dictionary; MERGE
+    must re-encode them into the target's (the reviewer's repro: 'bob'
+    silently became NULL before the remap)."""
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE tgt (k bigint, name text)")
+    cl.execute("CREATE TABLE src (k bigint, name text)")
+    cl.copy_from("tgt", rows=[(1, "alice")])
+    cl.copy_from("src", rows=[(2, "bob"), (3, "alice")])
+    cl.execute("MERGE INTO tgt t USING src s ON t.k = s.k "
+               "WHEN NOT MATCHED THEN INSERT (k, name) VALUES (s.k, s.name)")
+    assert sorted(cl.execute("SELECT k, name FROM tgt").rows) == \
+        [(1, "alice"), (2, "bob"), (3, "alice")]
+    # matched text assignment remaps too
+    cl.execute("MERGE INTO tgt t USING src s ON t.k = s.k "
+               "WHEN MATCHED THEN UPDATE SET name = s.name")
+    assert sorted(cl.execute("SELECT k, name FROM tgt").rows) == \
+        [(1, "alice"), (2, "bob"), (3, "alice")]
+
+
+def test_merge_text_unique_and_on_keys_fail_closed(tmp_path):
+    import citus_tpu as ct
+    from citus_tpu.errors import UnsupportedFeatureError
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE tgt (name text PRIMARY KEY, v bigint)")
+    cl.execute("CREATE TABLE src (name text, v bigint)")
+    cl.copy_from("tgt", rows=[("alice", 1)])
+    cl.copy_from("src", rows=[("zed", 2), ("alice", 3)])
+    # text ON keys: codes are incomparable across dictionaries
+    with pytest.raises(UnsupportedFeatureError, match="text join keys"):
+        cl.execute("MERGE INTO tgt t USING src s ON t.name = s.name "
+                   "WHEN MATCHED THEN UPDATE SET v = s.v")
+    # but a remapped text INSERT through a non-text key enforces UNIQUE
+    from citus_tpu.integrity import UniqueViolation
+    with pytest.raises(UniqueViolation):
+        cl.execute("MERGE INTO tgt t USING src s ON t.v = s.v "
+                   "WHEN NOT MATCHED THEN INSERT (name, v) "
+                   "VALUES (s.name, s.v)")  # src has 'alice' -> duplicate
+    assert cl.execute("SELECT count(*) FROM tgt").rows == [(1,)]
