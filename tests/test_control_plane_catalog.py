@@ -17,7 +17,7 @@ import pytest
 import citus_tpu as ct
 
 
-def wait_until(fn, timeout=5.0):
+def wait_until(fn, timeout=20.0):
     t0 = time.time()
     while time.time() - t0 < timeout:
         if fn():

@@ -172,7 +172,7 @@ def test_two_process_opposite_order_resolves_by_victim(tmp_path):
     assert "CHILD_DEADLOCK_VICTIM" in out, (out, err)
     # resolved by cancellation (detection interval ~2s), not by the 30s
     # lock timeout
-    assert elapsed < 15, f"took {elapsed:.1f}s — smells like LockTimeout"
+    assert elapsed < 25, f"took {elapsed:.1f}s — smells like LockTimeout"
     assert cl.execute("SELECT v FROM a WHERE k = 1").rows == [(1,)]
     assert cl.execute("SELECT v FROM b WHERE k = 1").rows == [(1,)]
     cl.close()
