@@ -68,6 +68,13 @@ class ExecutorSettings:
     # citus.use_secondary_nodes='always' analog; failover to the
     # primary still applies when no replica answers.
     use_secondary_nodes: bool = False
+    # Lower the scan->filter->partial-agg worker through a Pallas
+    # kernel (VMEM row blocks, on-core accumulation) instead of the
+    # XLA-fused jnp worker.  Off by default: the fused path is the
+    # reference; this is the hand-scheduled alternative (interpreter
+    # mode off-TPU).  Scope: the SINGLE-DEVICE streaming path only —
+    # the multi-device mesh path always runs the fused sharded worker.
+    use_pallas_scan: bool = False
     # Pad scan batches to power-of-two row counts to bound recompiles.
     batch_row_buckets: bool = True
     # Smallest padded batch (rows) a kernel will ever see.

@@ -331,6 +331,25 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     if jitted is None:
         jitted = jax.jit(build_worker_fn(plan, jnp))
         plan.runtime_cache["jit_worker"] = jitted
+    pallas_workers: Optional[dict] = None
+    if settings.executor.use_pallas_scan:
+        from citus_tpu.ops.pallas_scan import supports_plan
+        if supports_plan(plan):
+            # one kernel per padded batch length (same shape discipline
+            # as the jit cache); interpreter mode off-TPU
+            pallas_workers = plan.runtime_cache.setdefault("pallas_workers", {})
+
+    def _worker_for(n_padded: int):
+        if pallas_workers is None:
+            return jitted
+        w = pallas_workers.get(n_padded)
+        if w is None:
+            from citus_tpu.ops.pallas_scan import build_pallas_worker
+            w = build_pallas_worker(
+                plan, n_padded, len(pcols),
+                interpret=devices[0].platform != "tpu")
+            pallas_workers[n_padded] = w
+        return w
     merge = plan.runtime_cache.get("jit_merge")
     if merge is None:
         def _merge(a, b):
@@ -354,7 +373,8 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     if cached is not None:
         for b in cached:
             t0 = time.perf_counter()
-            out = jitted(b.cols + pcols, b.valids + pvalids, b.row_mask)
+            out = _worker_for(b.padded_rows)(b.cols + pcols,
+                                            b.valids + pvalids, b.row_mask)
             acc_dev = out if acc_dev is None else merge(acc_dev, out)
             task_times.append((b.shard_index, b.n_rows,
                                time.perf_counter() - t0))
@@ -376,7 +396,9 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                             jax.device_put(hb.row_mask), hb.n_rows,
                             hb.padded_rows, hb.shard_index)
             t0 = time.perf_counter()
-            out = jitted(db.cols + pcols, db.valids + pvalids, db.row_mask)
+            out = _worker_for(db.padded_rows)(db.cols + pcols,
+                                             db.valids + pvalids,
+                                             db.row_mask)
             acc_dev = out if acc_dev is None else merge(acc_dev, out)
             task_times.append((db.shard_index, db.n_rows,
                                time.perf_counter() - t0))

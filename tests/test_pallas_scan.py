@@ -1,0 +1,124 @@
+"""Pallas scan->filter->partial-agg kernel vs the fused-XLA worker.
+
+Runs in interpreter mode on the CPU mesh (same program as on a chip,
+no Mosaic); results must be BIT-IDENTICAL to the default path, which is
+itself bit-identical to the numpy oracle."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.config import ExecutorSettings
+
+QUERIES = [
+    "SELECT count(*) FROM t",
+    "SELECT sum(v), min(v), max(v), count(v) FROM t",
+    "SELECT sum(q * (1 - dd)) FROM t WHERE d <= 9500",
+    "SELECT rf, count(*), sum(q) FROM t GROUP BY rf ORDER BY rf",
+    "SELECT avg(v) FROM t WHERE v > 0",
+]
+
+
+@pytest.fixture(scope="module")
+def db(tmp_path_factory):
+    cl = ct.Cluster(str(tmp_path_factory.mktemp("pls")))
+    cl.execute("""CREATE TABLE t (k bigint NOT NULL, v bigint,
+        q decimal(12,2), dd decimal(12,2), rf text, d date)""")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    rng = np.random.default_rng(3)
+    n = 60_000
+    cl.copy_from("t", columns={
+        "k": rng.integers(0, n, n),
+        "v": rng.integers(-1000, 1000, n),
+        "q": rng.integers(100, 5100, n) / 100.0,
+        "dd": rng.integers(0, 11, n) / 100.0,
+        "rf": np.array(["A", "N", "R"])[rng.integers(0, 3, n)].tolist(),
+        "d": (rng.integers(0, 2500, n) + 8036).astype(np.int32)})
+    return cl
+
+
+def run_with(cl, sql, **exec_kw):
+    old = cl.settings
+    cl.settings = dataclasses.replace(
+        old, executor=dataclasses.replace(old.executor, **exec_kw))
+    try:
+        cl._plan_cache.clear()
+        from citus_tpu.executor.device_cache import GLOBAL_CACHE
+        GLOBAL_CACHE.clear()
+        return cl.execute(sql).rows
+    finally:
+        cl.settings = old
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_pallas_matches_default_and_oracle(db, sql):
+    default = run_with(db, sql)
+    pallas = run_with(db, sql, use_pallas_scan=True)
+    oracle = run_with(db, sql, task_executor_backend="cpu")
+    assert pallas == default == oracle
+
+
+def test_pallas_multi_block_accumulation(db):
+    """Force several grid steps per batch so cross-step accumulation
+    (init-then-merge) is exercised."""
+    import citus_tpu.ops.pallas_scan as ps
+    old = ps.BLOCK_ROWS
+    ps.BLOCK_ROWS = 4096
+    try:
+        sql = "SELECT sum(v), min(v), max(v), count(*) FROM t WHERE v != 17"
+        assert run_with(db, sql, use_pallas_scan=True) == run_with(db, sql)
+    finally:
+        ps.BLOCK_ROWS = old
+
+
+def test_pallas_with_parameters(db):
+    sql = "SELECT count(*), sum(v) FROM t WHERE v > $1"
+    old = db.settings
+    db.settings = dataclasses.replace(
+        old, executor=dataclasses.replace(old.executor, use_pallas_scan=True))
+    try:
+        db._plan_cache.clear()
+        got = db.execute(sql, params=[250]).rows
+    finally:
+        db.settings = old
+    db._plan_cache.clear()
+    want = db.execute(sql, params=[250]).rows
+    assert got == want
+
+
+def test_unsupported_plans_fall_back(db):
+    """hll/ddsk partials (VMEM-hostile one-hots) fall back to the fused
+    path; results stay correct either way."""
+    from citus_tpu.ops import pallas_scan as ps
+    sql = "SELECT approx_count_distinct(v) FROM t"
+    assert run_with(db, sql, use_pallas_scan=True) == run_with(db, sql)
+    from citus_tpu.planner.bind import bind_select
+    from citus_tpu.planner.parser import parse_statement
+    from citus_tpu.planner.physical import plan_select
+    bound = bind_select(db.catalog, parse_statement(sql))
+    plan = plan_select(db.catalog, bound)
+    assert not ps.supports_plan(plan)
+
+
+def test_direct_group_block_shrinks_to_vmem_budget():
+    """A wide direct-group domain shrinks the row block to keep the
+    one-hot intermediate inside the VMEM budget."""
+    from citus_tpu.ops import pallas_scan as ps
+
+    def plan_with_groups(g):
+        class _GM:
+            kind = "direct"
+            n_groups = g
+
+        class _Plan:
+            group_mode = _GM()
+        return _Plan()
+
+    block = ps._block_rows_for(plan_with_groups(256), ps.BLOCK_ROWS)
+    assert block * 256 * 8 <= ps._DIRECT_VMEM_BUDGET
+    assert block >= ps._MIN_BLOCK
+    # a domain too wide for even the minimum block is unsupported
+    assert ps._block_rows_for(plan_with_groups(4096),
+                              ps.BLOCK_ROWS) < ps._MIN_BLOCK
