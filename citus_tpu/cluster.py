@@ -1659,7 +1659,7 @@ class Cluster:
                 A.DropDomain, A.CreateCollation, A.DropCollation,
                 A.CreatePublication, A.DropPublication,
                 A.CreateStatistics, A.DropStatistics, A.Analyze,
-                A.UtilityCall)
+                A.CreateTableAs, A.UtilityCall)
         if not isinstance(stmt, Cluster._TXN_ALLOWED):
             raise UnsupportedFeatureError(
                 f"{type(stmt).__name__} cannot run inside a transaction "
@@ -2209,6 +2209,25 @@ class Cluster:
             self.catalog.drop_sequence(stmt.name)
             self.catalog.commit()
             return Result(columns=[], rows=[])
+        if isinstance(stmt, A.CreateTableAs):
+            if self.catalog.has_table(stmt.name):
+                if stmt.if_not_exists:
+                    return Result(columns=[], rows=[])
+                raise CatalogError(
+                    f'relation "{stmt.name}" already exists')
+            r = self._execute_stmt(stmt.select)
+            names, types = self._schema_from_result(r, strict_empty=True)
+            # atomic create+load: a load failure must not leave an empty
+            # committed table behind (transparent inside a user txn)
+            with self._internal_txn():
+                self.create_table(stmt.name,
+                                  Schema([Column(cn, ct_)
+                                          for cn, ct_ in zip(names, types)]))
+                if r.rows:
+                    self.copy_from(stmt.name, rows=r.rows,
+                                   column_names=names)
+            return Result(columns=[], rows=[],
+                          explain={"selected": len(r.rows)})
         if isinstance(stmt, A.CreateTable) and stmt.partition_of is not None:
             self._create_partition(
                 stmt.name, stmt.partition_of["parent"],
@@ -3465,13 +3484,12 @@ class Cluster:
     #: RedistributeTaskListResults / distributed_intermediate_results.c)
     DISTRIBUTED_INTERMEDIATE_ROWS = 4096
 
-    def _create_temp_from_result(self, prefix: str, label: str, r: Result) -> str:
-        """Store a query result as an intermediate-result table (the
-        read_intermediate_result analog for CTEs / derived tables / set
-        operations).  Small results stay local; large ones hash-
-        distribute on their first integer-typed column so downstream
-        joins and aggregations run sharded."""
-        from citus_tpu import types as T
+    def _schema_from_result(self, r: Result, *, strict_empty: bool = False):
+        """(deduped column names, column types) for materializing a
+        query result as a table.  Planner types win; otherwise infer
+        from values.  ``strict_empty``: refuse to guess types for an
+        empty untyped result (a PERSISTENT table must not silently get
+        bigint columns; throwaway intermediates tolerate the default)."""
         names, seen = [], set()
         for i, n in enumerate(r.columns):
             base = n or f"column{i + 1}"
@@ -3484,7 +3502,22 @@ class Cluster:
         types = list(r.types) if r.types else [None] * len(names)
         for i, ct_ in enumerate(types):
             if ct_ is None:
+                if strict_empty and not r.rows:
+                    raise UnsupportedFeatureError(
+                        f"cannot infer the type of column {names[i]!r} "
+                        "from an empty result; create the table "
+                        "explicitly and INSERT instead")
                 types[i] = _infer_column_type([row[i] for row in r.rows])
+        return names, types
+
+    def _create_temp_from_result(self, prefix: str, label: str, r: Result) -> str:
+        """Store a query result as an intermediate-result table (the
+        read_intermediate_result analog for CTEs / derived tables / set
+        operations).  Small results stay local; large ones hash-
+        distribute on their first integer-typed column so downstream
+        joins and aggregations run sharded."""
+        from citus_tpu import types as T
+        names, types = self._schema_from_result(r)
         self._CTE_SEQ[0] += 1
         tmp = f"__{prefix}_{self._CTE_SEQ[0]}_{label}"
         self.catalog.create_table(

@@ -235,6 +235,15 @@ class DropTable(Statement):
 
 
 @dataclass
+class CreateTableAs(Statement):
+    """CREATE TABLE name AS SELECT ... — schema inferred from the
+    result (planner types where known, value inference otherwise)."""
+    name: str
+    select: object = None   # Select | SetOp | WithSelect
+    if_not_exists: bool = False
+
+
+@dataclass
 class CreateExtension(Statement):
     """Reference: commands/extension.c propagation."""
     name: str

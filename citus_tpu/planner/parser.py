@@ -867,6 +867,14 @@ class Parser:
             return A.CreateTable(name, [], if_not_exists,
                                  partition_of={"parent": parent,
                                                "lo": lo, "hi": hi})
+        if self.at_kw("as"):
+            # CREATE TABLE x AS SELECT ... (CTAS)
+            self.next()
+            if self.at_kw("with"):
+                sel: A.Statement = self.parse_with_select()
+            else:
+                sel = self.parse_select()
+            return A.CreateTableAs(name, sel, if_not_exists)
         self.expect_op("(")
         cols = []
         fkeys = []

@@ -120,3 +120,57 @@ def test_constant_math_without_from(cl):
     assert r[2] == 2
     assert r[3] == 3
     assert float(r[4]) == pytest.approx(2.68)
+
+
+def test_create_table_as_select(tmp_path):
+    """CTAS: schema inferred from the result; distributable after."""
+    import citus_tpu as ct
+    from citus_tpu.errors import CatalogError
+    cl = ct.Cluster(str(tmp_path / "ctas"))
+    cl.execute("CREATE TABLE src (k bigint, v decimal(10,2), s text)")
+    cl.execute("SELECT create_distributed_table('src', 'k', 4)")
+    cl.copy_from("src", rows=[(i, i / 4, ["a", "b"][i % 2])
+                              for i in range(100)])
+    r = cl.execute("CREATE TABLE agg AS SELECT s, count(*) AS n, "
+                   "sum(v) AS total FROM src GROUP BY s")
+    assert r.explain["selected"] == 2
+    t = cl.catalog.table("agg")
+    assert t.schema.names == ["s", "n", "total"]
+    assert sorted(cl.execute("SELECT s, n FROM agg").rows) == \
+        [("a", 50), ("b", 50)]
+    # totals survived the round trip exactly
+    assert cl.execute("SELECT sum(total) FROM agg").rows == \
+        cl.execute("SELECT sum(v) FROM src").rows
+    with pytest.raises(CatalogError):
+        cl.execute("CREATE TABLE agg AS SELECT 1 AS one")
+    cl.execute("CREATE TABLE IF NOT EXISTS agg AS SELECT 1 AS one")  # no-op
+    # CTAS over a set operation / computed projection
+    cl.execute("CREATE TABLE u AS SELECT k FROM src WHERE k < 3 "
+               "UNION SELECT k + 100 FROM src WHERE k < 2")
+    assert cl.execute("SELECT count(*) FROM u").rows == [(5,)]
+    # the new table is an ordinary table: index it
+    cl.execute("CREATE UNIQUE INDEX u_k ON u (k)")
+
+
+def test_ctas_in_transaction_and_atomicity(tmp_path):
+    import citus_tpu as ct
+    from citus_tpu.errors import UnsupportedFeatureError
+    cl = ct.Cluster(str(tmp_path / "ctas2"))
+    cl.execute("CREATE TABLE src (k bigint, s text)")
+    cl.copy_from("src", rows=[(1, "a"), (2, "b")])
+    # CTAS inside a transaction block stages and rolls back cleanly
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE snap AS SELECT * FROM src")
+    assert s.execute("SELECT count(*) FROM snap").rows == [(2,)]
+    s.execute("ROLLBACK")
+    assert not cl.catalog.has_table("snap")
+    s.execute("BEGIN")
+    s.execute("CREATE TABLE snap AS SELECT * FROM src")
+    s.execute("COMMIT")
+    assert cl.execute("SELECT count(*) FROM snap").rows == [(2,)]
+    # empty untyped result (window output) refuses to guess a schema
+    with pytest.raises(UnsupportedFeatureError, match="empty result"):
+        cl.execute("CREATE TABLE w AS SELECT s, row_number() OVER "
+                   "(ORDER BY k) AS rn FROM src WHERE k < 0")
+    assert not cl.catalog.has_table("w")
