@@ -1465,21 +1465,33 @@ class Cluster:
                 total += self.copy_from(table_name, rows=batch)
         return total
 
+    @staticmethod
+    def _open_csv_writer(fh, columns, *, delimiter: str, header: bool):
+        """One CSV emission convention for both COPY TO forms."""
+        import csv
+        w = csv.writer(fh, delimiter=delimiter)
+        if header:
+            w.writerow(columns)
+        return w
+
     def copy_to_csv(self, table_name: str, path: str, *,
                     delimiter: str = ",", header: bool = False,
                     null_string: str = "") -> int:
         """Streaming CSV export: shards are read batch by batch, decoded,
         and written incrementally (symmetric with copy_from_csv)."""
-        import csv
         import os as _os
         from citus_tpu.storage import ShardReader
+        from citus_tpu.transaction.write_locks import flip_latch
         t = self.catalog.table(table_name)
         names = t.schema.names
         total = 0
-        with open(path, "w", newline="") as fh:
-            w = csv.writer(fh, delimiter=delimiter)
-            if header:
-                w.writerow(names)
+        with open(path, "w", newline="") as fh, \
+                flip_latch(self.catalog.data_dir, t, shared=True,
+                           timeout=self.settings.executor.lock_timeout_s):
+            # SHARED flip latch: the multi-shard export must not
+            # interleave with TRUNCATE's per-shard flips
+            w = self._open_csv_writer(fh, names, delimiter=delimiter,
+                                      header=header)
             for shard in t.shards:
                 d = self.catalog.shard_dir(table_name, shard.shard_id,
                                            shard.placements[0])
@@ -2408,6 +2420,17 @@ class Cluster:
                 header=_option_bool(stmt.options.get("header", "false")),
                 null_string=stmt.options.get("null", ""))
             return Result(columns=[], rows=[], explain={"copied": n})
+        if isinstance(stmt, A.CopyQueryTo):
+            r = self._execute_stmt(stmt.select)
+            nulls = stmt.options.get("null", "")
+            with open(stmt.path, "w", newline="") as fh:
+                w = self._open_csv_writer(
+                    fh, r.columns,
+                    delimiter=stmt.options.get("delimiter", ","),
+                    header=_option_bool(stmt.options.get("header", "false")))
+                for row in r.rows:
+                    w.writerow([nulls if v is None else v for v in row])
+            return Result(columns=[], rows=[], explain={"copied": len(r.rows)})
         if isinstance(stmt, A.CopyFrom):
             n = self.copy_from_csv(
                 stmt.table, stmt.path,

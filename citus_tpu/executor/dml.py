@@ -275,19 +275,27 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
 
 
 def execute_truncate(cat: Catalog, table: TableMeta) -> None:
-    for shard in table.shards:
-        for node in shard.placements:
-            d = cat.shard_dir(table.name, shard.shard_id, node)
-            if not os.path.isdir(d):
-                continue
-            meta = _load_meta(d)
-            for s in meta["stripes"]:
-                record_cleanup(cat, os.path.join(d, s["file"]), DEFERRED_ON_SUCCESS)
-            from citus_tpu.storage.writer import _store_meta
-            _store_meta(d, {"stripes": [], "row_count": 0,
-                            "next_stripe_id": meta["next_stripe_id"]})
-            clear_deletes(d)
-    table.version += 1
+    from citus_tpu.config import current_settings
+    from citus_tpu.transaction.write_locks import flip_latch
+    # EXCLUSIVE flip latch: a concurrent scan holds it SHARED across its
+    # whole load, so it sees every shard pre-truncate or every shard
+    # post-truncate — never a torn mixture
+    with flip_latch(cat.data_dir, table, shared=False,
+                    timeout=current_settings().executor.lock_timeout_s):
+        for shard in table.shards:
+            for node in shard.placements:
+                d = cat.shard_dir(table.name, shard.shard_id, node)
+                if not os.path.isdir(d):
+                    continue
+                meta = _load_meta(d)
+                for s in meta["stripes"]:
+                    record_cleanup(cat, os.path.join(d, s["file"]),
+                                   DEFERRED_ON_SUCCESS)
+                from citus_tpu.storage.writer import _store_meta
+                _store_meta(d, {"stripes": [], "row_count": 0,
+                                "next_stripe_id": meta["next_stripe_id"]})
+                clear_deletes(d)
+        table.version += 1
     cat.commit()
 
 

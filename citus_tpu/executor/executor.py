@@ -673,8 +673,13 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
     # admission control: one device-dispatch slot per executing query
     # (the citus.max_shared_pool_size analog; 0 = unlimited)
     from citus_tpu.executor.admission import GLOBAL_POOL
+    from citus_tpu.transaction.write_locks import flip_latch
     with GLOBAL_POOL.slot(settings.executor.max_shared_pool_size,
-                          timeout=settings.executor.lock_timeout_s):
+                          timeout=settings.executor.lock_timeout_s), \
+            flip_latch(cat.data_dir, bound.table, shared=True,
+                       timeout=settings.executor.lock_timeout_s):
+        # the SHARED flip latch makes the multi-shard scan atomic
+        # against TRUNCATE's per-shard metadata flips
         if bound.has_aggs:
             rows = _run_agg(cat, plan, settings, params)
         else:

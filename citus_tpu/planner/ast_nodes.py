@@ -656,6 +656,14 @@ class CopyTo(Statement):
 
 
 @dataclass
+class CopyQueryTo(Statement):
+    """COPY (SELECT ...) TO 'path' — query-result export."""
+    select: object = None
+    path: str = ""
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
 class Delete(Statement):
     table: str
     where: Optional[Expr] = None

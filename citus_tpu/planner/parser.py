@@ -235,29 +235,22 @@ class Parser:
             return self.parse_merge()
         if self.at_kw("copy"):
             self.next()
+            if self.at_op("("):
+                # COPY (query) TO 'path' — export a query result
+                self.next()
+                sub: A.Statement = self.parse_with_select() \
+                    if self.at_kw("with") else self.parse_select()
+                self.expect_op(")")
+                self.expect_kw("to")
+                path, options = self._parse_copy_path_and_options()
+                return A.CopyQueryTo(sub, path, options)
             name = self.parse_table_name()
             to = False
             if self.accept_kw("to"):
                 to = True
             else:
                 self.expect_kw("from")
-            t = self.next()
-            if t.kind != "str":
-                self.error("expected a quoted file path after COPY")
-            path = t.value[1:-1].replace("''", "'")
-            options = {}
-            if self.accept_kw("with"):
-                self.expect_op("(")
-                while True:
-                    key = self.expect_ident() if self.peek().kind == "ident" else self.next().value
-                    if self.at_op(")") or self.at_op(","):
-                        options[key] = True
-                    else:
-                        v = self.next()
-                        options[key] = v.value.strip("'")
-                    if not self.accept_op(","):
-                        break
-                self.expect_op(")")
+            path, options = self._parse_copy_path_and_options()
             return (A.CopyTo if to else A.CopyFrom)(name, path, options)
         if self.at_kw("vacuum"):
             self.next()
@@ -955,6 +948,28 @@ class Parser:
             self.expect_op(")")
         return A.CreateTable(name, cols, if_not_exists, options, fkeys,
                              partition_by=partition_by)
+
+    def _parse_copy_path_and_options(self):
+        """'path' [WITH (opt [value], ...)] — shared by every COPY form."""
+        t = self.next()
+        if t.kind != "str":
+            self.error("expected a quoted file path after COPY")
+        path = t.value[1:-1].replace("''", "'")
+        options: dict = {}
+        if self.accept_kw("with"):
+            self.expect_op("(")
+            while True:
+                key = self.expect_ident() \
+                    if self.peek().kind == "ident" else self.next().value
+                if self.at_op(")") or self.at_op(","):
+                    options[key] = True
+                else:
+                    v = self.next()
+                    options[key] = v.value.strip("'")
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return path, options
 
     def _accept_if_not_exists(self) -> bool:
         if self.accept_kw("if"):

@@ -43,6 +43,50 @@ def lockfile_path(data_dir: str, res: str) -> str:
 
 
 @contextlib.contextmanager
+def flip_latch(data_dir: str, table_meta, shared: bool,
+               timeout: float = 30.0):
+    """Whole-table metadata-flip latch (TRUNCATE's per-shard meta
+    rewrites are not one atomic operation): readers hold it SHARED
+    across their batch loading, TRUNCATE holds it EXCLUSIVE across all
+    its flips — a scan sees the table entirely before or entirely after
+    (the reference gets this from ACCESS EXCLUSIVE vs ACCESS SHARE).
+    Deliberately NOT the write lock: reads must not wait for UPDATEs.
+
+    flock has no writer priority, so the exclusive side drops an intent
+    marker first: new readers hold off while existing ones drain —
+    PostgreSQL's ACCESS EXCLUSIVE queueing, poor man's edition.  Only
+    one exclusive acquirer exists per group at a time (TRUNCATE already
+    holds the group's EXCLUSIVE write lock), so the marker is safe."""
+    import os
+    import time
+    from citus_tpu.utils.filelock import FileLock, LockTimeout
+    res = group_resource(table_meta)
+    path = os.path.join(data_dir, ".fl_" + res.replace(":", "_") + ".lock")
+    intent = path + ".intent"
+    if shared:
+        deadline = time.monotonic() + timeout
+        while os.path.exists(intent):
+            if time.monotonic() >= deadline:
+                raise LockTimeout(
+                    f"table flip in progress on {res!r} (reader held off "
+                    f"beyond {timeout}s)")
+            time.sleep(0.005)
+        with FileLock(path, shared=True, timeout=timeout):
+            yield
+        return
+    with open(intent, "w"):
+        pass
+    try:
+        with FileLock(path, shared=False, timeout=timeout):
+            yield
+    finally:
+        try:
+            os.remove(intent)
+        except OSError:
+            pass
+
+
+@contextlib.contextmanager
 def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
                      timeout: float = 30.0):
     import fcntl

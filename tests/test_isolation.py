@@ -318,3 +318,27 @@ def test_concurrent_update_vs_delete_overlap(db):
     _run_all([updater, deleter])
     assert cl.execute("SELECT count(*) FROM t").rows == [(10_000,)]
     assert cl.execute("SELECT sum(v) FROM t").rows == [(10_000,)]
+
+
+def test_concurrent_truncate_and_join_read(db):
+    """The flip latch also covers join scans (they load frames outside
+    execute_select)."""
+    cl = db
+    cl.execute("CREATE TABLE dims_tr (k bigint, name text)")
+    cl.copy_from("dims_tr", rows=[(i, f"d{i % 5}") for i in range(100)])
+    counts = []
+
+    def reader():
+        for _ in range(10):
+            r = cl.execute("SELECT count(*) FROM t JOIN dims_tr dm "
+                           "ON t.k = dm.k")
+            counts.append(r.rows[0][0])
+
+    def truncator():
+        cl.execute("TRUNCATE t")
+
+    _run_all([reader, truncator])
+    # t.k is 0..19999 (unique), dims 0..99: the join matches exactly 100
+    # rows pre-truncate and 0 after — anything else is a torn read
+    assert all(c in (0, 100) for c in counts), counts
+    assert cl.execute("SELECT count(*) FROM t").rows == [(0,)]

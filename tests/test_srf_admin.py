@@ -174,3 +174,16 @@ def test_ctas_in_transaction_and_atomicity(tmp_path):
         cl.execute("CREATE TABLE w AS SELECT s, row_number() OVER "
                    "(ORDER BY k) AS rn FROM src WHERE k < 0")
     assert not cl.catalog.has_table("w")
+
+
+def test_copy_query_to(tmp_path):
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path / "cq"))
+    cl.execute("CREATE TABLE t (k bigint, s text)")
+    cl.copy_from("t", rows=[(1, "a"), (2, None), (3, "c")])
+    out = str(tmp_path / "out.csv")
+    r = cl.execute(f"COPY (SELECT k, s FROM t WHERE k > 1 ORDER BY k) "
+                   f"TO '{out}' WITH (header 'true', null 'NULL')")
+    assert r.explain["copied"] == 2
+    lines = open(out).read().splitlines()
+    assert lines == ["k,s", "2,NULL", "3,c"]
