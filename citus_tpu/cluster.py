@@ -673,14 +673,16 @@ class Cluster:
             from citus_tpu.transaction.global_deadlock import run_detection
             d.register("deadlock_detection",
                        lambda: run_detection(self),
-                       interval_s=self.settings.deadlock_detection_interval_s)
+                       interval_s=lambda:
+                       self.settings.deadlock_detection_interval_s)
             if self._control is not None:
                 # authority health / lease-based promotion (reference:
                 # node_promotion.c; HA via external failover managers in
                 # the reference, built-in here)
                 d.register("authority_watch",
                            lambda: self._control.ensure_authority(),
-                           interval_s=self.settings.authority_watch_interval_s)
+                           interval_s=lambda:
+                           self.settings.authority_watch_interval_s)
             d.start()
             self._maintenance = d
         return self._maintenance
@@ -1671,7 +1673,8 @@ class Cluster:
                 A.DropDomain, A.CreateCollation, A.DropCollation,
                 A.CreatePublication, A.DropPublication,
                 A.CreateStatistics, A.DropStatistics, A.Analyze,
-                A.CreateTableAs, A.UtilityCall)
+                A.CreateTableAs, A.SetConfig, A.ShowConfig,
+                A.UtilityCall)
         if not isinstance(stmt, Cluster._TXN_ALLOWED):
             raise UnsupportedFeatureError(
                 f"{type(stmt).__name__} cannot run inside a transaction "
@@ -2712,6 +2715,10 @@ class Cluster:
                 st = execute_vacuum(self.catalog, self.catalog.table(stmt.table))
             self._plan_cache.clear()
             return Result(columns=[], rows=[], explain=st)
+        if isinstance(stmt, A.SetConfig):
+            return self._execute_set(stmt)
+        if isinstance(stmt, A.ShowConfig):
+            return self._execute_show(stmt)
         if isinstance(stmt, A.Analyze):
             return self._execute_analyze(stmt.table)
         if isinstance(stmt, A.VacuumAnalyze):
@@ -2733,6 +2740,121 @@ class Cluster:
                 [A.SelectItem(A.ColumnRef(c)) for c in columns],
                 A.TableRef(table), distinct=True), "d"))
         return int(self._execute_stmt(sel).rows[0][0])
+
+    #: SET/SHOW surface: GUC name -> (settings section, field, coercion)
+    #: (reference: the citus.* GUCs, shared_library_init.c:980+).
+    #: Settings apply to this Cluster handle (every session of it).
+    _GUCS = {
+        "citus.task_executor_backend": ("executor", "task_executor_backend", str),
+        "citus.max_shared_pool_size": ("executor", "max_shared_pool_size", int),
+        "citus.max_adaptive_executor_pool_size": ("executor", "max_tasks_in_flight", int),
+        "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
+        "citus.use_pallas_scan": ("executor", "use_pallas_scan", "bool"),
+        "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
+        "citus.shard_count": ("sharding", "shard_count", int),
+        "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
+        "citus.enable_change_data_capture": (None, "enable_change_data_capture", "bool"),
+        "citus.distributed_deadlock_detection_interval": (None, "deadlock_detection_interval_s", float),
+        # PostgreSQL spelling: bare numbers are MILLISECONDS; unit
+        # suffixes ('3s', '500ms') accepted
+        "lock_timeout": ("executor", "lock_timeout_s", "ms_duration"),
+    }
+
+    def _guc_key(self, name: str) -> str:
+        name = name.lower()
+        if name in self._GUCS:
+            return name
+        if f"citus.{name}" in self._GUCS:
+            return f"citus.{name}"
+        raise CatalogError(f'unrecognized configuration parameter "{name}"')
+
+    def _execute_set(self, stmt: A.SetConfig) -> Result:
+        import dataclasses as _dc
+        key = self._guc_key(stmt.name)
+        section, field_, coerce = self._GUCS[key]
+        v = stmt.value
+        if coerce == "bool":
+            if not isinstance(v, bool):
+                s = str(v).lower()
+                if s in ("true", "on", "1", "yes"):
+                    v = True
+                elif s in ("false", "off", "0", "no"):
+                    v = False
+                else:
+                    raise CatalogError(
+                        f'parameter "{stmt.name}" requires a Boolean '
+                        f"value (got {stmt.value!r})")
+        elif coerce == "secondary":
+            # PostgreSQL spelling: citus.use_secondary_nodes = always|never
+            if isinstance(v, bool):
+                pass
+            elif str(v).lower() in ("always", "never"):
+                v = str(v).lower() == "always"
+            else:
+                raise CatalogError(
+                    f'invalid value for parameter "{stmt.name}": '
+                    f"{stmt.value!r} (expected always or never)")
+        elif coerce == "ms_duration":
+            # bare numbers are milliseconds (PostgreSQL); 's'/'ms'
+            # suffixes accepted
+            s = str(v).strip().lower()
+            try:
+                if s.endswith("ms"):
+                    v = float(s[:-2]) / 1000.0
+                elif s.endswith("s"):
+                    v = float(s[:-1])
+                else:
+                    v = float(s) / 1000.0
+            except ValueError:
+                raise CatalogError(
+                    f'invalid value for parameter "{stmt.name}": '
+                    f"{stmt.value!r}")
+        else:
+            try:
+                v = coerce(v)
+            except (TypeError, ValueError):
+                raise CatalogError(
+                    f'invalid value for parameter "{stmt.name}": {stmt.value!r}')
+        from citus_tpu.storage.overlay import current_overlay
+        txn = current_overlay()
+        if txn is not None:
+            # PostgreSQL: a non-LOCAL SET is undone if the transaction
+            # aborts
+            prev_settings, prev_cdc = self.settings, self.cdc.enabled
+
+            def _restore(prev_settings=prev_settings, prev_cdc=prev_cdc):
+                self.settings = prev_settings
+                self.cdc.enabled = prev_cdc
+                self._plan_cache.clear()
+            txn.on_rollback.append(_restore)
+        if section is None:
+            self.settings = _dc.replace(self.settings, **{field_: v})
+        else:
+            sec = _dc.replace(getattr(self.settings, section), **{field_: v})
+            self.settings = _dc.replace(self.settings, **{section: sec})
+        if key == "citus.enable_change_data_capture":
+            self.cdc.enabled = bool(v)
+        self._plan_cache.clear()  # backend/knob changes invalidate plans
+        return Result(columns=[], rows=[])
+
+    def _guc_value(self, key: str) -> str:
+        section, field_, coerce = self._GUCS[key]
+        v = getattr(self.settings, field_) if section is None \
+            else getattr(getattr(self.settings, section), field_)
+        if coerce == "secondary":
+            return "always" if v else "never"
+        if isinstance(v, bool):
+            return "on" if v else "off"  # PostgreSQL boolean rendering
+        if coerce == "ms_duration":
+            return f"{v * 1000:g}ms"
+        return str(v)
+
+    def _execute_show(self, stmt: A.ShowConfig) -> Result:
+        if stmt.name == "all":
+            rows = [(k, self._guc_value(k)) for k in sorted(self._GUCS)]
+            return Result(columns=["name", "setting"], rows=rows)
+        key = self._guc_key(stmt.name)
+        return Result(columns=[stmt.name], rows=[(self._guc_value(key),)])
 
     def _execute_analyze(self, table: Optional[str]) -> Result:
         """ANALYZE [table]: recompute extended-statistics ndistinct

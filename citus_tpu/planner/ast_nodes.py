@@ -318,6 +318,20 @@ class DropStatistics(Statement):
 
 
 @dataclass
+class SetConfig(Statement):
+    """SET [citus.]name = value | TO value — runtime settings (the GUC
+    surface; reference: ~139 citus.* GUCs, shared_library_init.c)."""
+    name: str
+    value: object = None
+
+
+@dataclass
+class ShowConfig(Statement):
+    """SHOW [citus.]name | SHOW ALL."""
+    name: str = "all"
+
+
+@dataclass
 class Analyze(Statement):
     """ANALYZE [table]: refresh derived statistics (extended-statistics
     ndistinct; column bounds are always skip-list-live here).

@@ -252,6 +252,34 @@ class Parser:
                 self.expect_kw("from")
             path, options = self._parse_copy_path_and_options()
             return (A.CopyTo if to else A.CopyFrom)(name, path, options)
+        if self.peek().value == "set" and self.peek().kind in ("kw", "ident"):
+            self.next()
+            name = self.expect_ident()
+            if self.accept_op("."):
+                name = f"{name}.{self.expect_ident()}"
+            if not (self.accept_op("=") or self.accept_kw("to")):
+                self.error("expected = or TO after SET name")
+            t = self.next()
+            if t.kind == "str":
+                value: object = t.value[1:-1].replace("''", "'")
+            elif t.kind == "num":
+                value = float(t.value) \
+                    if ("." in t.value or "e" in t.value.lower()) \
+                    else int(t.value)
+            elif t.value in ("true", "false", "on", "off"):
+                value = t.value in ("true", "on")
+            else:
+                value = t.value
+            return A.SetConfig(name, value)
+        if self.peek().kind == "ident" and self.peek().value == "show":
+            self.next()
+            if self.at_kw("all"):
+                self.next()
+                return A.ShowConfig("all")
+            name = self.expect_ident()
+            if self.accept_op("."):
+                name = f"{name}.{self.expect_ident()}"
+            return A.ShowConfig(name)
         if self.at_kw("vacuum"):
             self.next()
             # "full" lexes as a keyword (FULL OUTER JOIN)

@@ -24,7 +24,9 @@ from citus_tpu.operations.cleaner import try_drop_orphaned_resources
 class Duty:
     name: str
     fn: Callable[[], object]
-    interval_s: float
+    # a float, or a zero-arg callable re-read every tick (so SET-style
+    # runtime changes to an interval take effect on a running daemon)
+    interval_s: "float | Callable[[], float]"
     last_run: float = 0.0
     runs: int = 0
     errors: int = 0
@@ -62,8 +64,13 @@ class MaintenanceDaemon:
         for d in self._duties:
             self._run_duty(d)
 
+    @staticmethod
+    def _interval(d: Duty) -> float:
+        return d.interval_s() if callable(d.interval_s) else d.interval_s
+
     def status(self) -> list[tuple]:
-        return [(d.name, d.interval_s, d.runs, d.errors) for d in self._duties]
+        return [(d.name, self._interval(d), d.runs, d.errors)
+                for d in self._duties]
 
     def _run_duty(self, d: Duty) -> None:
         try:
@@ -77,6 +84,6 @@ class MaintenanceDaemon:
         while not self._stop.is_set():
             now = time.time()
             for d in self._duties:
-                if now - d.last_run >= d.interval_s:
+                if now - d.last_run >= self._interval(d):
                     self._run_duty(d)
             self._stop.wait(timeout=0.2)

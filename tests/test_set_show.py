@@ -1,0 +1,101 @@
+"""SET / SHOW runtime settings (the GUC surface).
+
+Reference: the citus.* GUCs defined in shared_library_init.c:980+;
+settings here apply to the Cluster handle."""
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    return ct.Cluster(str(tmp_path / "db"))
+
+
+def test_set_and_show_roundtrip(cl):
+    assert cl.execute("SHOW citus.shard_count").rows == [("8",)]
+    cl.execute("SET citus.shard_count = 16")
+    assert cl.execute("SHOW citus.shard_count").rows == [("16",)]
+    # the setting actually drives DDL
+    cl.execute("CREATE TABLE t (k bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k')")
+    assert cl.catalog.table("t").shard_count == 16
+    # prefix optional, TO spelling works
+    cl.execute("SET shard_count TO 4")
+    assert cl.execute("SHOW shard_count").rows == [("4",)]
+
+
+def test_set_backend_switches_executor(cl):
+    cl.execute("CREATE TABLE t (k bigint, v bigint)")
+    cl.copy_from("t", rows=[(i, i) for i in range(100)])
+    want = cl.execute("SELECT sum(v) FROM t").rows
+    cl.execute("SET citus.task_executor_backend = 'cpu'")
+    assert cl.execute("SHOW citus.task_executor_backend").rows == [("cpu",)]
+    assert cl.execute("SELECT sum(v) FROM t").rows == want  # bit-identical
+    cl.execute("SET citus.task_executor_backend = 'tpu'")
+
+
+def test_set_secondary_nodes_spelling(cl):
+    assert cl.execute("SHOW citus.use_secondary_nodes").rows == [("never",)]
+    cl.execute("SET citus.use_secondary_nodes = 'always'")
+    assert cl.execute("SHOW citus.use_secondary_nodes").rows == [("always",)]
+    assert cl.settings.executor.use_secondary_nodes is True
+
+
+def test_set_cdc_flag_takes_effect(cl):
+    cl.execute("CREATE TABLE ev (k bigint)")
+    cl.copy_from("ev", rows=[(1,)])
+    assert list(cl.cdc.events("ev")) == []
+    cl.execute("SET citus.enable_change_data_capture = on")
+    cl.copy_from("ev", rows=[(2,)])
+    assert len(list(cl.cdc.events("ev"))) == 1
+
+
+def test_lock_timeout_pg_units_and_boolean_rendering(cl):
+    cl.execute("SET lock_timeout = 3000")      # bare number = ms (PG)
+    assert cl.settings.executor.lock_timeout_s == 3.0
+    cl.execute("SET lock_timeout = '2s'")
+    assert cl.settings.executor.lock_timeout_s == 2.0
+    cl.execute("SET lock_timeout = '500ms'")
+    assert cl.settings.executor.lock_timeout_s == 0.5
+    assert cl.execute("SHOW lock_timeout").rows == [("500ms",)]
+    # booleans render as on/off (PG)
+    assert cl.execute("SHOW citus.enable_repartition_joins").rows == [("on",)]
+    with pytest.raises(CatalogError, match="Boolean"):
+        cl.execute("SET citus.use_pallas_scan = 'tru'")
+    with pytest.raises(CatalogError, match="always or never"):
+        cl.execute("SET citus.use_secondary_nodes = 'alway'")
+
+
+def test_set_rolls_back_with_transaction(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("SET citus.shard_count = 64")
+    assert cl.execute("SHOW citus.shard_count").rows == [("64",)]
+    s.execute("ROLLBACK")
+    assert cl.execute("SHOW citus.shard_count").rows == [("8",)]
+    s.execute("BEGIN")
+    s.execute("SET citus.shard_count = 32")
+    s.execute("COMMIT")
+    assert cl.execute("SHOW citus.shard_count").rows == [("32",)]
+
+
+def test_deadlock_interval_is_live(cl):
+    names = {d[0]: d[1] for d in cl.maintenance.status()}
+    assert names["deadlock_detection"] == 2.0
+    cl.execute("SET citus.distributed_deadlock_detection_interval = 0.5")
+    names = {d[0]: d[1] for d in cl.maintenance.status()}
+    assert names["deadlock_detection"] == 0.5
+
+
+def test_show_all_and_unknown(cl):
+    rows = cl.execute("SHOW ALL").rows
+    names = [r[0] for r in rows]
+    assert "citus.task_executor_backend" in names
+    assert "citus.max_shared_pool_size" in names
+    with pytest.raises(CatalogError, match="unrecognized"):
+        cl.execute("SHOW citus.nope")
+    with pytest.raises(CatalogError):
+        cl.execute("SET citus.shard_count = 'many'")
