@@ -1579,21 +1579,37 @@ class Cluster:
         # per-thread: concurrent execute() calls must not see each
         # other's roles
         import threading as _threading
-        self._exec_roles[_threading.get_ident()] = role
+        # restore (not pop) on exit: a nested execute() — EXECUTE of a
+        # prepared statement — must not clear the outer call's role,
+        # or later synthesized statements would skip RLS
+        _tid = _threading.get_ident()
+        _prev_role = self._exec_roles.get(_tid)
+        self._exec_roles[_tid] = role
         try:
             for stmt in stmts:
                 if isinstance(stmt, A.TransactionStmt):
                     result = self._execute_transaction_stmt(session, stmt)
                     continue
                 txn = session.txn
+                if txn is not None and txn.failed:
+                    from citus_tpu.transaction.session import (
+                        InFailedTransaction,
+                    )
+                    raise InFailedTransaction(
+                        "current transaction is aborted, commands "
+                        "ignored until end of transaction block")
+                if isinstance(stmt, (A.Prepare, A.ExecutePrepared,
+                                     A.Deallocate)):
+                    try:
+                        result = self._execute_prepared_stmt(session, stmt,
+                                                             role)
+                    except Exception:
+                        # PostgreSQL: any error aborts the block
+                        if txn is not None:
+                            txn.failed = True
+                        raise
+                    continue
                 if txn is not None:
-                    if txn.failed:
-                        from citus_tpu.transaction.session import (
-                            InFailedTransaction,
-                        )
-                        raise InFailedTransaction(
-                            "current transaction is aborted, commands "
-                            "ignored until end of transaction block")
                     from citus_tpu.storage.overlay import transaction_overlay
                     try:
                         self._guard_in_txn(stmt)
@@ -1611,15 +1627,21 @@ class Cluster:
                                                       params, role)
                     self._fire_triggers(stmt)
         finally:
-            self._exec_roles.pop(_threading.get_ident(), None)
+            if _prev_role is None:
+                self._exec_roles.pop(_tid, None)
+            else:
+                self._exec_roles[_tid] = _prev_role
             self.activity.exit(gpid)
-        executor = result.explain.get("strategy", "utility") if result.explain else "utility"
-        elapsed = _time.perf_counter() - t0
-        rkey = result.explain.get("router_key") if result.explain else None
-        self.query_stats.record(sql, elapsed, result.rowcount, str(executor),
-                                partition_key="" if rkey is None else str(rkey))
-        if rkey is not None:
-            self.tenant_stats.record(str(rkey), elapsed)
+        # the nested execute() of an EXECUTE already recorded the
+        # underlying statement — don't double-count the wrapper
+        if not (len(stmts) == 1 and isinstance(stmts[0], A.ExecutePrepared)):
+            executor = result.explain.get("strategy", "utility") if result.explain else "utility"
+            elapsed = _time.perf_counter() - t0
+            rkey = result.explain.get("router_key") if result.explain else None
+            self.query_stats.record(sql, elapsed, result.rowcount, str(executor),
+                                    partition_key="" if rkey is None else str(rkey))
+            if rkey is not None:
+                self.tenant_stats.record(str(rkey), elapsed)
         return result
 
     def _execute_in_session(self, stmt, sql, stmts, params, role) -> Result:
@@ -1689,6 +1711,33 @@ class Cluster:
                 "create_distributed_table", "create_reference_table"):
             raise UnsupportedFeatureError(
                 f"{stmt.name}() cannot run inside a transaction block")
+
+    def _execute_prepared_stmt(self, session, stmt, role) -> Result:
+        """PREPARE / EXECUTE / DEALLOCATE — the stored unit is SQL text,
+        so EXECUTE rides the text-keyed generic-plan cache (one compile
+        serves every invocation; reference: prepared statements with
+        deferred pruning, fast_path_router_planner.c)."""
+        if isinstance(stmt, A.Prepare):
+            if stmt.name in session.prepared:
+                raise CatalogError(
+                    f'prepared statement "{stmt.name}" already exists')
+            session.prepared[stmt.name] = stmt.sql
+            return Result(columns=[], rows=[])
+        if isinstance(stmt, A.Deallocate):
+            if stmt.name is None:
+                session.prepared.clear()
+                return Result(columns=[], rows=[])
+            if session.prepared.pop(stmt.name, None) is None:
+                raise CatalogError(
+                    f'prepared statement "{stmt.name}" does not exist')
+            return Result(columns=[], rows=[])
+        sql = session.prepared.get(stmt.name)
+        if sql is None:
+            raise CatalogError(
+                f'prepared statement "{stmt.name}" does not exist')
+        args = [_eval_const(a) for a in stmt.args]
+        return self.execute(sql, params=args or None, role=role,
+                            session=session)
 
     def _execute_transaction_stmt(self, session, stmt) -> Result:
         """BEGIN/COMMIT/ROLLBACK/SAVEPOINT state machine (reference:
@@ -4397,6 +4446,11 @@ class Cluster:
         elif isinstance(stmt, A.Truncate):
             if not self.catalog.has_privilege(role, stmt.table, "truncate"):
                 deny("TRUNCATE", stmt.table)
+        elif isinstance(stmt, (A.Prepare, A.ExecutePrepared, A.Deallocate)):
+            # any role may manage prepared statements (PostgreSQL);
+            # EXECUTE re-enters execute() with the same role, which
+            # checks privileges on the underlying statement
+            pass
         else:
             from citus_tpu.errors import CatalogError as _CE
             raise _CE(f'permission denied: role "{role}" cannot run '

@@ -318,6 +318,26 @@ class DropStatistics(Statement):
 
 
 @dataclass
+class Prepare(Statement):
+    """PREPARE name [(types)] AS statement — the stored unit is the
+    statement's SQL text, so EXECUTE rides the text-keyed generic-plan
+    cache (reference: prepared statements + Job->deferredPruning)."""
+    name: str
+    sql: str = ""
+
+
+@dataclass
+class ExecutePrepared(Statement):
+    name: str
+    args: list = field(default_factory=list)   # literal Exprs
+
+
+@dataclass
+class Deallocate(Statement):
+    name: "str | None" = None   # None = ALL
+
+
+@dataclass
 class SetConfig(Statement):
     """SET [citus.]name = value | TO value — runtime settings (the GUC
     surface; reference: ~139 citus.* GUCs, shared_library_init.c)."""

@@ -252,6 +252,43 @@ class Parser:
                 self.expect_kw("from")
             path, options = self._parse_copy_path_and_options()
             return (A.CopyTo if to else A.CopyFrom)(name, path, options)
+        if self.peek().kind == "ident" and self.peek().value == "prepare":
+            self.next()
+            name = self.expect_ident()
+            if self.accept_op("("):  # optional parameter-type list
+                while True:
+                    self.parse_type_name()
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            self.expect_kw("as")
+            start = self.peek().pos
+            body = self.parse_statement()  # validate + consume
+            if isinstance(body, (A.Prepare, A.ExecutePrepared,
+                                 A.Deallocate, A.TransactionStmt)):
+                self.error("PREPARE body must be a plannable statement")
+            sql = self.text[start:self.peek().pos].strip().rstrip(";")
+            return A.Prepare(name, sql)
+        if self.peek().kind == "ident" and self.peek().value == "execute" \
+                and self.peek(1).kind == "ident":
+            self.next()
+            name = self.expect_ident()
+            args = []
+            if self.accept_op("("):
+                while True:
+                    args.append(self.parse_expr())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return A.ExecutePrepared(name, args)
+        if self.peek().kind == "ident" and self.peek().value == "deallocate":
+            self.next()
+            if self.peek().kind == "ident" and self.peek().value == "prepare":
+                self.next()
+            if self.at_kw("all"):
+                self.next()
+                return A.Deallocate(None)
+            return A.Deallocate(self.expect_ident())
         if self.peek().value == "set" and self.peek().kind in ("kw", "ident"):
             self.next()
             name = self.expect_ident()

@@ -376,6 +376,9 @@ class Session:
         self._cluster = cluster
         self.lock_sid = next(_session_ids)
         self.txn: Optional[OpenTransaction] = None
+        # PREPARE name AS ... statements (per session, like PostgreSQL;
+        # NOT transactional — they survive ROLLBACK)
+        self.prepared: dict[str, str] = {}
 
     # -- public surface --------------------------------------------------
     def execute(self, sql: str, params=None, role=None):

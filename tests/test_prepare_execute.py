@@ -1,0 +1,104 @@
+"""PREPARE / EXECUTE / DEALLOCATE — SQL-spelling prepared statements
+over the text-keyed generic-plan cache (reference: prepared statements
++ Job->deferredPruning)."""
+
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"))
+    c.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    c.execute("SELECT create_distributed_table('t', 'k', 4)")
+    c.copy_from("t", rows=[(i, i * 10) for i in range(100)])
+    return c
+
+
+def test_prepare_execute_select_with_params(cl):
+    s = cl.session()
+    s.execute("PREPARE q (bigint) AS SELECT count(*), sum(v) FROM t "
+              "WHERE k < $1")
+    assert s.execute("EXECUTE q (10)").rows == [(10, sum(i * 10 for i in range(10)))]
+    assert s.execute("EXECUTE q (50)").rows == [(50, sum(i * 10 for i in range(50)))]
+    # router form reuses the generic plan with deferred pruning
+    s.execute("PREPARE pt AS SELECT v FROM t WHERE k = $1")
+    h0 = cl.counters.snapshot().get("plan_cache_hits", 0)
+    for key in (3, 7, 11):
+        assert s.execute(f"EXECUTE pt ({key})").rows == [(key * 10,)]
+    assert cl.counters.snapshot().get("plan_cache_hits", 0) >= h0 + 2
+
+
+def test_prepare_execute_dml_and_errors(cl):
+    s = cl.session()
+    s.execute("PREPARE ins AS INSERT INTO t VALUES (1000, 1)")
+    s.execute("EXECUTE ins")
+    assert cl.execute("SELECT count(*) FROM t WHERE k = 1000").rows == [(1,)]
+    with pytest.raises(CatalogError, match="already exists"):
+        s.execute("PREPARE ins AS SELECT 1")
+    with pytest.raises(CatalogError, match="does not exist"):
+        s.execute("EXECUTE nope")
+    s.execute("DEALLOCATE ins")
+    with pytest.raises(CatalogError, match="does not exist"):
+        s.execute("EXECUTE ins")
+    # prepared statements are per session
+    s2 = cl.session()
+    s2.execute("PREPARE q2 AS SELECT 1")
+    with pytest.raises(CatalogError):
+        s.execute("EXECUTE q2")
+
+
+def test_prepared_survive_rollback_and_deallocate_all(cl):
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("PREPARE q AS SELECT count(*) FROM t")
+    s.execute("ROLLBACK")
+    assert s.execute("EXECUTE q").rows == [(100,)]  # PG: not transactional
+    s.execute("PREPARE r AS SELECT 1")
+    s.execute("DEALLOCATE ALL")
+    for name in ("q", "r"):
+        with pytest.raises(CatalogError):
+            s.execute(f"EXECUTE {name}")
+
+
+def test_prepare_works_for_roles_and_checks_inner_privileges(cl):
+    from citus_tpu.errors import SqlSyntaxError
+    cl.execute("CREATE ROLE alice")
+    cl.execute("GRANT SELECT ON t TO alice")
+    s = cl.session()
+    s.execute("PREPARE pq AS SELECT count(*) FROM t", role="alice")
+    assert s.execute("EXECUTE pq", role="alice").rows == [(100,)]
+    # the underlying statement's privileges still apply
+    s.execute("PREPARE pd AS DELETE FROM t", role="alice")
+    with pytest.raises(CatalogError, match="permission denied"):
+        s.execute("EXECUTE pd", role="alice")
+    # recursive/unplannable bodies rejected at parse time
+    with pytest.raises(SqlSyntaxError, match="plannable"):
+        s.execute("PREPARE bad AS EXECUTE bad")
+    with pytest.raises(SqlSyntaxError, match="plannable"):
+        s.execute("PREPARE bad2 AS BEGIN")
+
+
+def test_prepared_error_aborts_transaction_block(cl):
+    from citus_tpu.transaction.session import InFailedTransaction
+    s = cl.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO t VALUES (2000, 1)")
+    with pytest.raises(CatalogError):
+        s.execute("EXECUTE nope")
+    with pytest.raises(InFailedTransaction):
+        s.execute("SELECT 1")
+    r = s.execute("COMMIT")
+    assert r.explain.get("transaction") == "rollback"
+    assert cl.execute("SELECT count(*) FROM t WHERE k = 2000").rows == [(0,)]
+
+
+def test_execute_not_double_counted_in_stats(cl):
+    s = cl.session()
+    s.execute("PREPARE sq AS SELECT count(*) FROM t")
+    s.execute("EXECUTE sq")
+    r = cl.execute("SELECT citus_stat_statements()")
+    texts = [row[0] for row in r.rows]
+    assert not any(t.startswith("EXECUTE sq") for t in texts), texts
