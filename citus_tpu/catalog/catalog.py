@@ -866,9 +866,12 @@ class Catalog:
             return t
 
     # ---- views --------------------------------------------------------
-    def create_view(self, name: str, sql: str) -> None:
+    def create_view(self, name: str, sql: str,
+                    or_replace: bool = False) -> None:
         with self._lock:
-            if name in self.tables or name in self.views:
+            if name in self.tables:
+                raise CatalogError(f'relation "{name}" already exists')
+            if name in self.views and not or_replace:
                 raise CatalogError(f'relation "{name}" already exists')
             self.views[name] = sql
             self.ddl_epoch += 1

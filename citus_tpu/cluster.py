@@ -520,6 +520,56 @@ def _sort_rows(rows, names, order_by):
     return rows
 
 
+def _limit0(stmt):
+    """A zero-row variant of a SELECT-shaped statement (column/type
+    probing without scanning)."""
+    import dataclasses as _dc
+    if isinstance(stmt, (A.Select, A.SetOp)):
+        return _dc.replace(stmt, limit=0)
+    if isinstance(stmt, A.WithSelect):
+        return _dc.replace(stmt, body=_dc.replace(stmt.body, limit=0))
+    return stmt
+
+
+def _from_relations_scope(node) -> set:
+    """Relations referenced inside one WITH scope (CTE bodies + body)."""
+    inner: set = set()
+    for _n, sub in node.ctes:
+        inner |= _from_relations(sub)
+    inner |= _from_relations(node.body)
+    return inner
+
+
+def _from_relations(s) -> set:
+    """Relation names referenced in FROM clauses (incl. joins, derived
+    tables, set-op arms) — the self-reference guard for CREATE OR
+    REPLACE VIEW."""
+    out: set = set()
+
+    def from_item(item):
+        if isinstance(item, A.TableRef):
+            out.add(item.name)
+        elif isinstance(item, A.Join):
+            from_item(item.left)
+            from_item(item.right)
+        elif isinstance(item, A.SubqueryRef):
+            walk(item.select)
+
+    def walk(node):
+        if isinstance(node, A.SetOp):
+            walk(node.left)
+            walk(node.right)
+        elif isinstance(node, A.WithSelect):
+            cte_names = {n for n, _ in node.ctes}
+            inner = _from_relations_scope(node)
+            out.update(inner - cte_names)
+        elif isinstance(node, A.Select) and node.from_ is not None:
+            from_item(node.from_)
+
+    walk(s)
+    return out
+
+
 def _infer_column_type(vals):
     """Fallback type inference for intermediate results whose planner
     types are unknown (e.g. window outputs): first non-NULL value wins;
@@ -928,6 +978,23 @@ class Cluster:
             self.create_index(f"{name}_{ix['column']}_key", name,
                               ix["column"], unique=ix.get("unique", False))
         self._plan_cache.clear()
+
+    def _truncate_one(self, name: str) -> None:
+        """Truncate one (possibly partitioned) relation; FK validation
+        happens at the statement level, list-aware."""
+        from citus_tpu.executor.dml import execute_truncate
+        from citus_tpu.transaction.locks import EXCLUSIVE
+        t = self.catalog.table(name)
+        if t.is_partitioned:
+            for p in self.catalog.partitions_of(name):
+                self._truncate_one(p.name)
+            return
+        with self._write_lock(t, EXCLUSIVE):
+            execute_truncate(self.catalog, self.catalog.table(name))
+        self._plan_cache.clear()
+        if self._cdc_captures(t.name):
+            self.cdc.emit(t.name, "truncate",
+                          self.clock.transaction_clock(), force=True)
 
     def _fanout_partitions(self, stmt, *, aggregate_explain: bool = False
                            ) -> Result:
@@ -1959,7 +2026,26 @@ class Cluster:
         return execute_select(self.catalog, bound, self.settings, plan=plan,
                               param_values=params)
 
+    #: statement-recursion ceiling: subquery materialization, view
+    #: expansion, and partition fan-out all re-enter _execute_stmt; a
+    #: circular view reference (direct, via subqueries, or through
+    #: another view) would otherwise die with a raw RecursionError
+    _MAX_STMT_DEPTH = 64
+    _stmt_depth = __import__("threading").local()
+
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
+        depth = getattr(self._stmt_depth, "v", 0)
+        if depth >= self._MAX_STMT_DEPTH:
+            raise AnalysisError(
+                "query nesting too deep (possible circular view "
+                "reference)")
+        self._stmt_depth.v = depth + 1
+        try:
+            return self._execute_stmt_inner(stmt, sql_text)
+        finally:
+            self._stmt_depth.v = depth
+
+    def _execute_stmt_inner(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         if isinstance(stmt, A.WithSelect):
             return self._execute_with(stmt)
         if isinstance(stmt, (A.Select, A.SetOp)) and self.catalog.functions:
@@ -2249,8 +2335,33 @@ class Cluster:
             import dataclasses
             probe = dataclasses.replace(stmt.select, limit=0) \
                 if isinstance(stmt.select, A.Select) else stmt.select
-            self._execute_stmt(probe)
-            self.catalog.create_view(stmt.name, stmt.sql)
+            replacing = stmt.or_replace and stmt.name in self.catalog.views
+            if replacing:
+                if stmt.name in _from_relations(stmt.select):
+                    raise AnalysisError(
+                        f'view "{stmt.name}" cannot reference itself')
+            new_r = self._execute_stmt(probe)
+            if replacing:
+                # PostgreSQL: a replace may only ADD columns at the end,
+                # keeping existing names AND types
+                from citus_tpu.planner.parser import parse_statement
+                old_sel = parse_statement(self.catalog.views[stmt.name])
+                old_r = self._execute_stmt(_limit0(old_sel))
+                old_cols = old_r.columns
+                if new_r.columns[:len(old_cols)] != old_cols:
+                    raise AnalysisError(
+                        "cannot drop, rename, or reorder columns of "
+                        f'view "{stmt.name}" with CREATE OR REPLACE')
+                if old_r.types and new_r.types:
+                    for i, (ot, nt) in enumerate(zip(old_r.types,
+                                                     new_r.types)):
+                        if ot is not None and nt is not None \
+                                and ot.kind != nt.kind:
+                            raise AnalysisError(
+                                "cannot change data type of view column "
+                                f'"{old_cols[i]}"')
+            self.catalog.create_view(stmt.name, stmt.sql,
+                                     or_replace=stmt.or_replace)
             self.catalog.commit()
             self._plan_cache.clear()
             return Result(columns=[], rows=[])
@@ -2739,19 +2850,25 @@ class Cluster:
                               count=sum(st.values()))
             return Result(columns=[], rows=[], explain=st)
         if isinstance(stmt, A.Truncate):
-            from citus_tpu.executor.dml import execute_truncate
             from citus_tpu.integrity import forbid_truncate_referenced
-            from citus_tpu.transaction.locks import EXCLUSIVE
-            forbid_truncate_referenced(self.catalog, stmt.table)
-            t = self.catalog.table(stmt.table)
-            if t.is_partitioned:
-                return self._fanout_partitions(stmt)
-            with self._write_lock(t, EXCLUSIVE):
-                execute_truncate(self.catalog, self.catalog.table(stmt.table))
-            self._plan_cache.clear()
-            if self._cdc_captures(t.name):
-                self.cdc.emit(t.name, "truncate",
-                              self.clock.transaction_clock(), force=True)
+            # validate EVERY relation up front (existence + FK rule with
+            # list-awareness: a referenced parent is fine when all its
+            # children are in the same list, like PostgreSQL): truncation
+            # deletes files irreversibly, so a bad later name must not
+            # leave earlier tables already emptied
+            names = (stmt.table,) + tuple(stmt.more)
+            expanded = []
+            for name in names:
+                t0 = self.catalog.table(name)
+                expanded.append(name)
+                if t0.is_partitioned:
+                    expanded += [p.name
+                                 for p in self.catalog.partitions_of(name)]
+            for name in expanded:
+                forbid_truncate_referenced(self.catalog, name,
+                                           also_truncated=set(expanded))
+            for name in names:
+                self._truncate_one(name)
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Vacuum):
             from citus_tpu.executor.dml import execute_vacuum
@@ -4444,8 +4561,9 @@ class Cluster:
             for sub in expr_subselects(stmt.where):
                 check_read(sub)
         elif isinstance(stmt, A.Truncate):
-            if not self.catalog.has_privilege(role, stmt.table, "truncate"):
-                deny("TRUNCATE", stmt.table)
+            for name in (stmt.table,) + tuple(stmt.more):
+                if not self.catalog.has_privilege(role, name, "truncate"):
+                    deny("TRUNCATE", name)
         elif isinstance(stmt, (A.Prepare, A.ExecutePrepared, A.Deallocate)):
             # any role may manage prepared statements (PostgreSQL);
             # EXECUTE re-enters execute() with the same role, which

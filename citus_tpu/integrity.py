@@ -540,9 +540,13 @@ def check_child_update(cluster, table_meta, assignments: list) -> None:
                 f'"{fk["ref_table"]}"')
 
 
-def forbid_truncate_referenced(catalog, table_name: str) -> None:
+def forbid_truncate_referenced(catalog, table_name: str,
+                               also_truncated=()) -> None:
+    """A referenced parent may only be truncated when every referencing
+    table is truncated in the same statement (PostgreSQL: TRUNCATE p, c
+    is allowed; TRUNCATE p alone is not)."""
     refs = [c for c, _fk in catalog.referencing_fks(table_name)
-            if c != table_name]
+            if c != table_name and c not in also_truncated]
     if refs:
         raise AnalysisError(
             f'cannot truncate a table referenced in a foreign key '

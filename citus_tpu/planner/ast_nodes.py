@@ -505,6 +505,7 @@ class CreateView(Statement):
     name: str = ""
     select: object = None       # parsed body (validation only)
     sql: str = ""               # body text, reparsed at each use
+    or_replace: bool = False
 
 
 @dataclass
@@ -715,6 +716,8 @@ class Update(Statement):
 @dataclass
 class Truncate(Statement):
     table: str
+    # TRUNCATE a, b, c — additional tables beyond the first
+    more: tuple = ()
 
 
 @dataclass

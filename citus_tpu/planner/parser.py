@@ -228,7 +228,10 @@ class Parser:
         if self.at_kw("truncate"):
             self.next()
             self.accept_kw("table")
-            return A.Truncate(self.parse_table_name())
+            names = [self.parse_table_name()]
+            while self.accept_op(","):
+                names.append(self.parse_table_name())
+            return A.Truncate(names[0], tuple(names[1:]))
         if self.at_kw("alter"):
             return self.parse_alter_table()
         if self.at_kw("merge"):
@@ -764,8 +767,9 @@ class Parser:
                 self.next()  # sql
             return A.CreateFunction(name, arg_names, arg_types, ret, body,
                                     or_replace)
-        if or_replace:
-            self.error("expected FUNCTION after OR REPLACE")
+        if or_replace and not (self.peek().kind == "ident"
+                               and self.peek().value == "view"):
+            self.error("expected FUNCTION or VIEW after OR REPLACE")
         if self.peek().kind == "ident" and self.peek().value == "type":
             self.next()
             name = self.expect_ident()
@@ -868,8 +872,11 @@ class Parser:
             name = self.parse_table_name()
             self.expect_kw("as")
             body_start = self.peek().pos
-            sel = self.parse_select()
-            return A.CreateView(name, sel, self.text[body_start:self.peek().pos].strip())
+            sel = self.parse_with_select() if self.at_kw("with") \
+                else self.parse_select()
+            return A.CreateView(name, sel,
+                                self.text[body_start:self.peek().pos].strip(),
+                                or_replace)
         if self.peek().kind == "ident" and self.peek().value == "sequence":
             self.next()
             if_not_exists = False
