@@ -41,6 +41,10 @@ class ChangeDataCapture:
         # seek-reads stay O(new records)
         self.bytes_read = 0
         self._index_cache: dict[str, tuple] = {}  # table -> (sig, entries)
+        # table -> (known stream size, max lsn of those bytes): the
+        # running prefix-max recorded into index entries so seeks are
+        # exact under HLC skew between emitters
+        self._prefix_cache: dict[str, tuple[int, int]] = {}
 
     def _path(self, table: str) -> str:
         return os.path.join(self.dir, f"{table}.changes.jsonl")
@@ -78,16 +82,68 @@ class ChangeDataCapture:
             p = self._path(table)
             size = os.path.getsize(p) if os.path.exists(p) else 0
             entries = self._load_index(table)
+            pmax = self._prefix_max(table, size, entries)
             last_off = entries[-1][1] if entries else -INDEX_STRIDE_BYTES
             if size - last_off >= INDEX_STRIDE_BYTES:
                 # `size` is a record boundary (appends are whole lines
-                # under the lock), so seeking there lands on a record
+                # under the lock), so seeking there lands on a record.
+                # pmax = max lsn over every byte before `offset`: the
+                # seek can then PROVE all earlier records are consumed,
+                # exactly, under any HLC skew between emitters
                 with open(self._index_path(table), "a") as fh:
-                    fh.write(json.dumps({"lsn": lsn, "offset": size}) + "\n")
+                    fh.write(json.dumps({"lsn": lsn, "offset": size,
+                                         "pmax": pmax}) + "\n")
                 self._index_cache.pop(table, None)
             with open(p, "a") as fh:
-                fh.write(json.dumps(rec, default=str) + "\n")
+                line = json.dumps(rec, default=str) + "\n"
+                fh.write(line)
                 fh.flush()
+            self._prefix_cache[table] = (size + len(line.encode()),
+                                         max(pmax, lsn))
+
+    def _prefix_max(self, table: str, size: int, entries) -> int:
+        """Max lsn over the stream's first ``size`` bytes.  Cached per
+        table; foreign appends (another process emitting into the same
+        stream) are folded in by scanning only the grown delta.  Called
+        under the cdc lock."""
+        known = self._prefix_cache.get(table)
+        if known is not None and known[0] == size:
+            return known[1]
+        if known is not None and 0 < known[0] < size:
+            m = max(known[1], self._range_max(table, known[0], size))
+        elif size == 0:
+            m = 0
+        else:
+            # cold start over an existing stream: index maxima cover the
+            # prefix up to the last entry; scan the remaining (< one
+            # stride) tail.  An old-format entry (no pmax) only knows
+            # its own record's lsn, so fall back to a full scan once.
+            m = 0
+            start = 0
+            if entries:
+                if any(e[2] is None for e in entries):
+                    start = 0
+                else:
+                    m = max(max(e[2] for e in entries),
+                            max(e[0] for e in entries))
+                    start = entries[-1][1]
+            m = max(m, self._range_max(table, start, size))
+        self._prefix_cache[table] = (size, m)
+        return m
+
+    def _range_max(self, table: str, start: int, end: int) -> int:
+        m = 0
+        with open(self._path(table), "rb") as fh:
+            fh.seek(start)
+            data = fh.read(end - start)
+        for line in data.splitlines():
+            line = line.strip()
+            if line:
+                try:
+                    m = max(m, json.loads(line)["lsn"])
+                except ValueError:
+                    pass
+        return m
 
     # ------------------------------------------------------------- read
     def _load_index(self, table: str) -> list[tuple[int, int]]:
@@ -107,25 +163,27 @@ class ChangeDataCapture:
                 line = line.strip()
                 if line:
                     d = json.loads(line)
-                    entries.append((d["lsn"], d["offset"]))
+                    entries.append((d["lsn"], d["offset"], d.get("pmax")))
         self._index_cache[table] = (sig, entries)
         return entries
 
     def _seek_offset(self, table: str, from_lsn: int) -> int:
-        """Largest indexed offset that is safely at-or-before the first
-        record with lsn > from_lsn.  One entry of slack absorbs HLC
-        skew between concurrent emitters."""
+        """Largest indexed offset provably safe to resume from: an
+        entry's ``pmax`` is the max lsn over every record before its
+        offset, so pmax <= from_lsn guarantees nothing before the
+        offset survives the ``lsn > from_lsn`` filter — exact under any
+        HLC skew between concurrent emitters (a heuristic backstep is
+        not: bursts compress arbitrarily many skewed records into one
+        stride).  Old-format entries without pmax are never trusted."""
         if from_lsn <= 0:
             return 0
         entries = self._load_index(table)
-        idx = -1
-        for i, (lsn, _off) in enumerate(entries):
-            if lsn < from_lsn:
-                idx = i
-            else:
+        best = 0
+        for _lsn, off, pmax in entries:
+            if pmax is None or pmax > from_lsn:
                 break
-        idx -= 1  # one stride of slack for HLC skew between emitters
-        return entries[idx][1] if idx >= 0 else 0
+            best = off
+        return best
 
     def events(self, table: str, from_lsn: int = 0) -> Iterator[dict]:
         """Changes with lsn > from_lsn.  Seeks via the sparse index:
@@ -211,19 +269,23 @@ class ChangeDataCapture:
             tmp = p + ".tmp"
             idx_tmp = self._index_path(table) + ".tmp"
             off = 0
+            running_max = 0
             with open(tmp, "w") as fh, open(idx_tmp, "w") as ix:
                 last_indexed = -INDEX_STRIDE_BYTES
                 for line in kept:
                     if off - last_indexed >= INDEX_STRIDE_BYTES:
                         ix.write(json.dumps(
                             {"lsn": json.loads(line)["lsn"],
-                             "offset": off}) + "\n")
+                             "offset": off, "pmax": running_max}) + "\n")
                         last_indexed = off
                     fh.write(line + "\n")
-                    off += len(line) + 1
+                    off += len(line.encode()) + 1
+                    running_max = max(running_max,
+                                      json.loads(line)["lsn"])
             os.replace(tmp, p)
             os.replace(idx_tmp, self._index_path(table))
             self._index_cache.pop(table, None)
+            self._prefix_cache[table] = (off, running_max)
             return dropped
 
     def acknowledged_lsn(self, table: str) -> int:

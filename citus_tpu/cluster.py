@@ -1022,9 +1022,10 @@ class Cluster:
             pcol = t.partition_by["column"]
             if any(c == pcol for c, _ in stmt.assignments):
                 raise UnsupportedFeatureError(
-                    "updating the partition column through the parent "
-                    "(row movement) is not supported; update the "
-                    "partition directly")
+                    "updating the partition column (row movement) is "
+                    "not supported; DELETE the rows and re-INSERT them "
+                    "through the parent so they route to the right "
+                    "partition")
         total_key = "updated" if isinstance(stmt, A.Update) else "deleted"
         total = 0
         # atomic across partitions: a later partition's failure must not
@@ -1304,6 +1305,9 @@ class Cluster:
             return self._copy_into_partitions(t, columns)
         self._check_domains(t, columns)
         values, validity = encode_columns(self.catalog, t, columns)
+        if t.partition_of is not None:
+            from citus_tpu.partitioning import check_partition_bounds
+            check_partition_bounds(self.catalog, t, values, validity)
         import contextlib as _ctxlib
 
         from citus_tpu.transaction.locks import EXCLUSIVE, SHARED
@@ -2681,10 +2685,19 @@ class Cluster:
                 t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
                 from citus_tpu.storage.overlay import current_overlay
                 assigned = {c for c, _e in stmt.assignments}
-                check = None
+                checks = []
                 if any(c in assigned
                        for c, _dn, _d in self._domain_columns_of(t)):
-                    check = lambda v, m: self._check_domains_physical(t, v, m)  # noqa: E731
+                    checks.append(
+                        lambda v, m: self._check_domains_physical(t, v, m))
+                if t.partition_of is not None:
+                    from citus_tpu.partitioning import check_partition_bounds
+                    checks.append(
+                        lambda v, m: check_partition_bounds(
+                            self.catalog, t, v, m))
+                check = None
+                if checks:
+                    check = lambda v, m: [c(v, m) for c in checks]  # noqa: E731
                 n = execute_update(self.catalog, self.txlog, t, assignments,
                                    where, txn=current_overlay(), check=check)
             self._plan_cache.clear()
@@ -2867,8 +2880,25 @@ class Cluster:
             for name in expanded:
                 forbid_truncate_referenced(self.catalog, name,
                                            also_truncated=set(expanded))
-            for name in names:
-                self._truncate_one(name)
+            # acquire every relation's EXCLUSIVE lock (sorted, to dodge
+            # lock-order inversions) BEFORE the first irreversible flip:
+            # PostgreSQL's TRUNCATE a, b is all-or-nothing, so a later
+            # table's lock timeout must fail the statement while no
+            # table has been emptied yet
+            import contextlib as _ctxlib
+            from citus_tpu.transaction.locks import EXCLUSIVE
+            from citus_tpu.transaction.write_locks import group_resource
+            metas = {}
+            for name in expanded:
+                t0 = self.catalog.table(name)
+                if not t0.is_partitioned:
+                    metas.setdefault(group_resource(t0), t0)
+            with _ctxlib.ExitStack() as stack:
+                for res in sorted(metas):
+                    stack.enter_context(
+                        self._write_lock(metas[res], EXCLUSIVE))
+                for name in names:
+                    self._truncate_one(name)
             return Result(columns=[], rows=[])
         if isinstance(stmt, A.Vacuum):
             from citus_tpu.executor.dml import execute_vacuum

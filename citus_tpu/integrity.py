@@ -50,6 +50,20 @@ def _unique_conflict(cat, t, ix: dict, phys_value) -> "UniqueViolation":
         f'Key ({ix["column"]})=({v}) already exists')
 
 
+def _probe_placement_dir(cat, t, shard) -> Optional[str]:
+    """First readable placement directory of ``shard`` (same failover
+    order as load_shard_batches: a missing primary directory is a failed
+    placement, not an empty shard), or None when no placement was ever
+    written.  Probing only placements[0] could miss existing keys — and
+    admit duplicates — while the primary is unavailable."""
+    import os
+    for node in shard.placements:
+        d = cat.shard_dir(t.name, shard.shard_id, node)
+        if os.path.isdir(d):
+            return d
+    return None
+
+
 def _probe_unique_live(cat, t, ix: dict, uniq: np.ndarray,
                        exclude: Optional[dict] = None):
     """First value of ``uniq`` (sorted physical values) with a live match
@@ -65,8 +79,8 @@ def _probe_unique_live(cat, t, ix: dict, uniq: np.ndarray,
 
     col = ix["column"]
     for shard in t.shards:
-        d = cat.shard_dir(t.name, shard.shard_id, shard.placements[0])
-        if not os.path.isdir(d):
+        d = _probe_placement_dir(cat, t, shard)
+        if d is None:
             continue
         meta = visible_meta(d)
         dcache = visible_deletes(d)
@@ -176,8 +190,8 @@ def validate_unique_backfill(cat, t, ix: dict) -> None:
     col = ix["column"]
     seen: set = set()
     for shard in t.shards:
-        d = cat.shard_dir(t.name, shard.shard_id, shard.placements[0])
-        if not os.path.isdir(d):
+        d = _probe_placement_dir(cat, t, shard)
+        if d is None:
             continue
         reader = ShardReader(d, t.schema)
         for batch in reader.scan([col]):

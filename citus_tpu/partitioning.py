@@ -72,6 +72,40 @@ def partition_for_rows(cat, parent_meta, phys_values: np.ndarray):
     return out
 
 
+def check_partition_bounds(cat, leaf_meta, values, validity) -> None:
+    """Enforce a leaf partition's [lo, hi) bounds on a physical ingest
+    batch written directly to the leaf (the implicit partition CHECK
+    constraint PostgreSQL attaches to every partition).  Without this a
+    direct COPY/INSERT/UPDATE on the leaf could store rows the parent's
+    partition pruning would silently exclude."""
+    info = leaf_meta.partition_of
+    if info is None:
+        return
+    parent = cat.table(info["parent"])
+    pcol = parent.partition_by["column"]
+    vals = values.get(pcol)
+    if vals is None:
+        return
+    valid = validity.get(pcol)
+    lo, hi = info["lo"], info["hi"]
+    bad = np.zeros(len(vals), bool)
+    if valid is not None:
+        # NULL never satisfies a range partition constraint
+        bad |= ~np.asarray(valid, bool)
+    if lo is not None:
+        bad |= vals < lo
+    if hi is not None:
+        bad |= vals >= hi
+    if bad.any():
+        i = int(np.nonzero(bad)[0][0])
+        detail = "null" if (valid is not None and not valid[i]) \
+            else f"physical value {vals[i]}"
+        raise AnalysisError(
+            f'new row for relation "{leaf_meta.name}" violates partition '
+            f"constraint ({pcol} {detail} outside [{lo}, {hi})); "
+            f'write through the parent "{parent.name}" to route rows')
+
+
 def prune_partitions(cat, parent_meta, where: Optional[A.Expr]):
     """Partitions that can hold rows satisfying the WHERE clause —
     bound-level pruning from `col op literal` AND-conjuncts, the analog

@@ -64,8 +64,24 @@ def flip_latch(data_dir: str, table_meta, shared: bool,
     path = os.path.join(data_dir, ".fl_" + res.replace(":", "_") + ".lock")
     intent = path + ".intent"
     if shared:
+        from citus_tpu.transaction.global_deadlock import _pid_alive
         deadline = time.monotonic() + timeout
         while os.path.exists(intent):
+            # crash cleanup: a writer killed between dropping the intent
+            # and its finally-removal would otherwise hold readers off
+            # forever — the intent records its owner pid; any reader may
+            # reap it once that pid is dead
+            try:
+                with open(intent) as f:
+                    owner = int(f.read().strip() or -1)
+            except (OSError, ValueError):
+                owner = -1  # mid-write or already removed: re-check
+            if owner > 0 and not _pid_alive(owner):
+                try:
+                    os.remove(intent)
+                except OSError:
+                    pass
+                continue
             if time.monotonic() >= deadline:
                 raise LockTimeout(
                     f"table flip in progress on {res!r} (reader held off "
@@ -74,8 +90,8 @@ def flip_latch(data_dir: str, table_meta, shared: bool,
         with FileLock(path, shared=True, timeout=timeout):
             yield
         return
-    with open(intent, "w"):
-        pass
+    with open(intent, "w") as f:
+        f.write(str(os.getpid()))
     try:
         with FileLock(path, shared=False, timeout=timeout):
             yield

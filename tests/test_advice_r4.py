@@ -1,0 +1,210 @@
+"""Regression tests for the round-4 advisor findings: partition-bound
+enforcement on direct leaf writes, flip-latch crash cleanup, unique-probe
+placement failover, CDC resume under HLC skew, and all-or-nothing
+multi-table TRUNCATE locking."""
+
+import datetime
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import AnalysisError
+
+
+@pytest.fixture()
+def pdb(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("""CREATE TABLE events (
+        tenant bigint NOT NULL, ts date, amount bigint)
+        PARTITION BY RANGE (ts)""")
+    cl.execute("CREATE TABLE events_h1 PARTITION OF events "
+               "FOR VALUES FROM ('2024-01-01') TO ('2024-07-01')")
+    cl.execute("CREATE TABLE events_h2 PARTITION OF events "
+               "FOR VALUES FROM ('2024-07-01') TO ('2025-01-01')")
+    cl.execute("SELECT create_distributed_table('events', 'tenant', 4)")
+    return cl
+
+
+class TestPartitionBoundEnforcement:
+    """High finding: a direct write into a leaf partition must respect
+    the leaf's [lo, hi) bounds (PostgreSQL's implicit partition CHECK);
+    otherwise parent queries that prune partitions silently lose rows."""
+
+    def test_direct_leaf_copy_out_of_range_rejected(self, pdb):
+        with pytest.raises(AnalysisError, match="partition constraint"):
+            pdb.copy_from("events_h1",
+                          rows=[(1, "2024-09-15", 10)])  # belongs in h2
+        assert pdb.execute("SELECT count(*) FROM events_h1").rows == [(0,)]
+
+    def test_direct_leaf_insert_null_partition_col_rejected(self, pdb):
+        with pytest.raises(AnalysisError, match="partition constraint"):
+            pdb.execute("INSERT INTO events_h1 VALUES (1, NULL, 10)")
+
+    def test_direct_leaf_copy_in_range_ok(self, pdb):
+        pdb.copy_from("events_h1", rows=[(1, "2024-03-01", 10)])
+        assert pdb.execute("SELECT count(*) FROM events").rows == [(1,)]
+
+    def test_leaf_update_moving_row_out_of_range_rejected(self, pdb):
+        pdb.copy_from("events", rows=[(1, "2024-03-01", 10)])
+        with pytest.raises(AnalysisError, match="partition constraint"):
+            pdb.execute("UPDATE events_h1 SET ts = date '2024-12-01' "
+                        "WHERE tenant = 1")
+        # row unchanged, still visible through the pruned parent query
+        assert pdb.execute(
+            "SELECT count(*) FROM events WHERE ts < '2024-07-01'"
+        ).rows == [(1,)]
+
+    def test_parent_query_with_pruning_never_loses_rows(self, pdb):
+        """The exact advisor scenario: an out-of-range leaf row would be
+        invisible to a pruned parent query; the write must fail instead."""
+        with pytest.raises(AnalysisError):
+            pdb.execute("INSERT INTO events_h1 VALUES (7, '2024-10-01', 5)")
+        total = pdb.execute("SELECT count(*) FROM events").rows[0][0]
+        pruned = pdb.execute(
+            "SELECT count(*) FROM events WHERE ts >= '2024-01-01'"
+        ).rows[0][0]
+        assert total == pruned == 0
+
+
+def test_flip_latch_stale_intent_reaped(tmp_path):
+    """Medium finding: a writer killed between dropping the .intent
+    marker and its finally-removal must not lock readers out forever —
+    readers reap a marker whose owner pid is dead."""
+    from citus_tpu.config import ExecutorSettings, Settings
+    st = Settings(executor=ExecutorSettings(lock_timeout_s=2.0))
+    cl = ct.Cluster(str(tmp_path / "db"), settings=st)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", columns={"k": np.arange(100), "v": np.arange(100)})
+    from citus_tpu.transaction.write_locks import group_resource
+    res = group_resource(cl.catalog.table("t"))
+    intent = os.path.join(cl.catalog.data_dir,
+                          ".fl_" + res.replace(":", "_") + ".lock.intent")
+    # forge a crash: intent owned by a pid that no longer exists
+    with open(intent, "w") as f:
+        f.write("999999999")
+    assert cl.execute("SELECT count(*) FROM t").rows == [(100,)]
+    assert not os.path.exists(intent)  # reader reaped it
+    cl.close()
+
+
+def test_flip_latch_live_intent_still_blocks(tmp_path):
+    """A marker owned by a LIVE process keeps holding new readers off
+    (the writer-priority queueing the marker exists for)."""
+    from citus_tpu.config import ExecutorSettings, Settings
+    from citus_tpu.utils.filelock import LockTimeout
+    st = Settings(executor=ExecutorSettings(lock_timeout_s=0.3))
+    cl = ct.Cluster(str(tmp_path / "db"), settings=st)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", columns={"k": np.arange(10)})
+    from citus_tpu.transaction.write_locks import group_resource
+    res = group_resource(cl.catalog.table("t"))
+    intent = os.path.join(cl.catalog.data_dir,
+                          ".fl_" + res.replace(":", "_") + ".lock.intent")
+    with open(intent, "w") as f:
+        f.write(str(os.getpid()))  # this (live) process
+    try:
+        with pytest.raises(LockTimeout):
+            cl.execute("SELECT count(*) FROM t")
+    finally:
+        os.remove(intent)
+    cl.close()
+
+
+def test_unique_probe_fails_over_to_replica(tmp_path):
+    """Medium finding: with the primary placement directory gone, the
+    uniqueness probe must read the replica (like normal reads do) rather
+    than silently admitting duplicates."""
+    from citus_tpu.config import Settings, ShardingSettings
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2, settings=Settings(
+        sharding=ShardingSettings(shard_count=4,
+                                  shard_replication_factor=2)))
+    cl.execute("CREATE TABLE u (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('u', 'k')")
+    cl.execute("CREATE UNIQUE INDEX u_k_key ON u (k)")
+    cl.copy_from("u", columns={"k": np.arange(200), "v": np.arange(200)})
+    t = cl.catalog.table("u")
+    for s in t.shards:
+        shutil.rmtree(cl.catalog.shard_dir("u", s.shard_id, s.placements[0]),
+                      ignore_errors=True)
+    from citus_tpu.integrity import UniqueViolation
+    with pytest.raises(UniqueViolation):
+        cl.copy_from("u", rows=[(5, 99)])  # k=5 exists (on the replica)
+    cl.close()
+
+
+def test_cdc_resume_survives_multi_stride_hlc_skew(tmp_path):
+    """Low finding: events(from_lsn) must not seek past records whose
+    lsn exceeds from_lsn merely because emitter skew spans more than one
+    index stride.  A skewed emitter writes an old lsn thousands of
+    records (many strides) after its timestamp's position."""
+    from citus_tpu.cdc import ChangeDataCapture
+    cs = ChangeDataCapture(str(tmp_path / "db"), enabled=True)
+    for i in range(3000):
+        cs.emit("t", "insert", lsn=10_000 + i,
+                rows=[[i, f"value-{i}"]], columns=["k", "v"])
+        if i == 2500:  # skew far beyond one 16KiB index stride
+            cs.emit("t", "insert", lsn=10_200,
+                    rows=[[-1, "late"]], columns=["k", "v"])
+    got = [r["lsn"] for r in cs.events("t", from_lsn=10_199)]
+    # the late-written skewed record (lsn 10200, duplicated) AND every
+    # larger lsn must all survive the seek
+    assert got.count(10_200) == 2
+    assert sorted(got) == sorted([10_200] + list(range(10_200, 13_000)))
+
+
+def test_cdc_resume_cross_instance_prefix_max(tmp_path):
+    """A second ChangeDataCapture over the same stream (another
+    coordinator process) must fold the first's records into its index
+    prefix-max rather than trusting its own (empty) history."""
+    from citus_tpu.cdc import ChangeDataCapture
+    d = str(tmp_path / "db")
+    a = ChangeDataCapture(d, enabled=True)
+    for i in range(1200):
+        a.emit("t", "insert", lsn=5_000 + i, count=1)
+    b = ChangeDataCapture(d, enabled=True)  # cold start, foreign bytes
+    for i in range(1200):
+        b.emit("t", "insert", lsn=6_200 + i, count=1)
+    got = [r["lsn"] for r in b.events("t", from_lsn=6_150)]
+    assert got == list(range(6_151, 7_400))
+
+
+def test_multi_table_truncate_all_or_nothing(tmp_path):
+    """Low finding: TRUNCATE a, b is all-or-nothing — a lock failure on
+    b must surface BEFORE a is irreversibly emptied."""
+    import subprocess
+    import sys
+
+    from citus_tpu.config import ExecutorSettings, Settings
+    from citus_tpu.transaction.write_locks import group_resource, lockfile_path
+    st = Settings(executor=ExecutorSettings(lock_timeout_s=1.0))
+    cl = ct.Cluster(str(tmp_path / "db"), settings=st)
+    cl.execute("CREATE TABLE a (x bigint)")
+    cl.execute("CREATE TABLE b (x bigint)")
+    cl.copy_from("a", rows=[(1,), (2,)])
+    cl.copy_from("b", rows=[(3,)])
+    res = group_resource(cl.catalog.table("b"))
+    lockfile = lockfile_path(cl.catalog.data_dir, res)
+    hold = subprocess.Popen(  # foreign process holds EXCLUSIVE on b
+        [sys.executable, "-c", (
+            "import fcntl, sys, time\n"
+            "fd = open(sys.argv[1], 'w')\n"
+            "fcntl.flock(fd, fcntl.LOCK_EX)\n"
+            "print('held', flush=True)\n"
+            "time.sleep(30)\n"), lockfile],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert hold.stdout.readline().strip() == "held"
+        with pytest.raises(Exception):
+            cl.execute("TRUNCATE a, b")
+        # a must still hold its rows: no partial truncate happened
+        assert cl.execute("SELECT count(*) FROM a").rows == [(2,)]
+        assert cl.execute("SELECT count(*) FROM b").rows == [(1,)]
+    finally:
+        hold.kill()
+        hold.wait()
+    cl.close()
