@@ -164,13 +164,30 @@ class TableMeta:
 class NodeMeta:
     node_id: int
     is_active: bool = True
+    # data-plane endpoint of the coordinator hosting this node's
+    # placements (pg_dist_node nodename/nodeport analog,
+    # sql/citus--8.0-1.sql:401).  None = placements live in this
+    # process's own data directory (shared-dir / single-host mode).
+    host: Optional[str] = None
+    port: Optional[int] = None
+
+    @property
+    def endpoint(self) -> Optional[tuple]:
+        if self.host is None or self.port is None:
+            return None
+        return (self.host, self.port)
 
     def to_json(self):
-        return {"node_id": self.node_id, "is_active": self.is_active}
+        d = {"node_id": self.node_id, "is_active": self.is_active}
+        if self.host is not None:
+            d["host"] = self.host
+            d["port"] = self.port
+        return d
 
     @staticmethod
     def from_json(d):
-        return NodeMeta(d["node_id"], d["is_active"])
+        return NodeMeta(d["node_id"], d["is_active"],
+                        d.get("host"), d.get("port"))
 
 
 def _catalog_flock(data_dir: str):
@@ -203,6 +220,13 @@ class Catalog:
         self.nodes: dict[int, NodeMeta] = {}
         self._next_shard_id = 102008  # match the reference's familiar id space
         self._next_colocation_id = 1
+        # cross-host bulk data plane (net/data_plane.py DataPlaneClient);
+        # set by the Cluster when remote node endpoints are in play, read
+        # by the executor's placement failover and the shard mover
+        self.remote_data = None
+        # node ids whose placements live in THIS process's data dir;
+        # None = all of them (shared-dir / single-host mode)
+        self.hosted_nodes: Optional[set] = None
         # bumped on every DDL statement; plan caches key on it so dropped/
         # recreated relations can never serve stale plans
         self.ddl_epoch = 0
@@ -1027,6 +1051,19 @@ class Catalog:
     def active_node_ids(self) -> list[int]:
         return sorted(n.node_id for n in self.nodes.values() if n.is_active)
 
+    def is_remote_node(self, node: int) -> bool:
+        """True when ``node``'s placements live on ANOTHER coordinator
+        (it advertises a data-plane endpoint and this process does not
+        host it)."""
+        if self.hosted_nodes is None or node in self.hosted_nodes:
+            return False
+        meta = self.nodes.get(node)
+        return meta is not None and meta.endpoint is not None
+
+    def node_endpoint(self, node: int) -> Optional[tuple]:
+        meta = self.nodes.get(node)
+        return meta.endpoint if meta is not None else None
+
     # ---- shard data directories --------------------------------------
     def shard_dir(self, table: str, shard_id: int, placement_node: int = 0) -> str:
         return os.path.join(self.data_dir, "data", table,
@@ -1041,6 +1078,10 @@ class Catalog:
         if key in self._dicts:
             return
         p = self._dict_path(table, column)
+        if not os.path.exists(p):
+            # attached coordinator without the side file: the authority
+            # holds the canonical dictionary — mirror it locally
+            self._fetch_remote_dict(table, column)
         words = []
         if os.path.exists(p):
             with open(p) as fh:
@@ -1048,6 +1089,29 @@ class Catalog:
         self._dicts[key] = words
         self._dict_index[key] = {w: i for i, w in enumerate(words)}
         self._dict_sig[key] = _stat_sig(p)
+
+    def _fetch_remote_dict(self, table: str, column: str) -> bool:
+        """Mirror the authority's dictionary side file (returns True
+        when fetched).  No-op without a remote commit transport."""
+        tr = getattr(self, "commit_transport", None)
+        if tr is None or not getattr(tr, "commit_is_remote", False):
+            return False
+        try:
+            words = tr.fetch_dict(table, column)
+        except Exception:
+            return False
+        if words is None:
+            return False
+        p = self._dict_path(table, column)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(words, fh)
+        os.replace(tmp, p)
+        key = (table, column)
+        self._dicts[key] = list(words)
+        self._dict_index[key] = {w: i for i, w in enumerate(words)}
+        self._dict_sig[key] = _stat_sig(p)
+        return True
 
     def _merge_disk_dict(self, table: str, column: str) -> None:
         """Adopt words another coordinator appended to the on-disk
@@ -1103,16 +1167,29 @@ class Catalog:
             uid = np.empty(len(uniq), dtype=np.int64)
             fresh = [w for w in (str(w) for w in uniq) if w not in index]
             if fresh:
-                with _catalog_flock(self.data_dir):
-                    self._merge_disk_dict(table, column)
-                    grew = False
-                    for w in fresh:
-                        if w not in index:
-                            index[w] = len(words)
+                tr = getattr(self, "commit_transport", None)
+                if tr is not None and getattr(tr, "commit_is_remote", False):
+                    # attached coordinator: id assignment must be global —
+                    # route growth through the metadata authority (it
+                    # holds the canonical dictionary under its flock) and
+                    # adopt the returned full word list
+                    new_words = tr.grow_dict(table, column, fresh)
+                    for i, w in enumerate(new_words):
+                        if i >= len(words):
                             words.append(w)
-                            grew = True
-                    if grew:
-                        self._store_dict(table, column)
+                        index.setdefault(w, i)
+                    self._store_dict(table, column)
+                else:
+                    with _catalog_flock(self.data_dir):
+                        self._merge_disk_dict(table, column)
+                        grew = False
+                        for w in fresh:
+                            if w not in index:
+                                index[w] = len(words)
+                                words.append(w)
+                                grew = True
+                        if grew:
+                            self._store_dict(table, column)
             for i, w in enumerate(uniq):
                 uid[i] = index[str(w)]  # plain str, not np.str_
             out[nn] = uid[inverse]
@@ -1125,6 +1202,15 @@ class Catalog:
     def decode_strings(self, table: str, column: str, ids) -> list:
         self._ensure_dict(table, column)
         words = self._dicts[(table, column)]
+        if any(i >= len(words) for i in ids):
+            # an id beyond our mirror: another coordinator grew the
+            # dictionary — adopt the shared-FS growth, else refetch from
+            # the authority
+            self._merge_disk_dict(table, column)
+            words = self._dicts[(table, column)]
+            if any(i >= len(words) for i in ids):
+                self._fetch_remote_dict(table, column)
+                words = self._dicts[(table, column)]
         return [words[i] if 0 <= i < len(words) else None for i in ids]
 
     def dictionary(self, table: str, column: str) -> list[str]:

@@ -2139,2973 +2139,141 @@ class Cluster:
                     bound, plan, bound.table.version, self.catalog.ddl_epoch,
                     self.settings.executor.task_executor_backend)
             return execute_select(self.catalog, bound, self.settings, plan=plan)
-        if isinstance(stmt, A.CreateSchema):
-            if stmt.if_not_exists and stmt.name in self.catalog.schemas:
-                return Result(columns=[], rows=[])
-            self.catalog.create_schema(stmt.name)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropSchema):
-            members = self.catalog.drop_schema(stmt.name, cascade=stmt.cascade)
-            for m in members:
-                self.catalog.drop_table(m)
-            self.catalog.commit()
-            self._plan_cache.clear()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateType):
-            if stmt.name in self.catalog.types:
-                raise CatalogError(f'type "{stmt.name}" already exists')
-            if not stmt.labels or len(set(stmt.labels)) != len(stmt.labels):
-                raise AnalysisError("enum labels must be unique and non-empty")
-            self.catalog.types[stmt.name] = list(stmt.labels)
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropType):
-            if stmt.if_exists and stmt.name not in self.catalog.types:
-                return Result(columns=[], rows=[])
-            if stmt.name not in self.catalog.types:
-                raise CatalogError(f'type "{stmt.name}" does not exist')
-            users = [k for k, v in self.catalog.enum_columns.items()
-                     if v == stmt.name]
-            if users:
-                raise CatalogError(
-                    f'cannot drop type "{stmt.name}": used by {users[0]}')
-            del self.catalog.types[stmt.name]
-            self.catalog.tombstone("types", stmt.name)
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateFunction):
-            from citus_tpu.planner.aggregates import AGG_REGISTRY
-            from citus_tpu.planner.bind import AGG_FUNCS
-            if stmt.name in AGG_FUNCS or stmt.name in AGG_REGISTRY:
-                raise CatalogError(
-                    f'cannot replace built-in function "{stmt.name}"')
-            if stmt.name in self.catalog.functions and not stmt.or_replace:
-                raise CatalogError(f'function "{stmt.name}" already exists')
-            if stmt.returns != "trigger" and any(
-                    t.get("function") == stmt.name
-                    for t in self.catalog.triggers.values()):
-                raise CatalogError(
-                    f'cannot replace "{stmt.name}": trigger(s) depend on it '
-                    "remaining a trigger function")
-            # expression macros validate as expressions; trigger
-            # functions (RETURNS trigger) hold a SQL statement body
-            entry = {"args": list(stmt.arg_names),
-                     "arg_types": list(stmt.arg_types),
-                     "returns": stmt.returns, "body": stmt.body}
-            if stmt.returns == "trigger":
-                parse_sql(stmt.body)
-                entry["kind"] = "statement"
-            else:
-                from citus_tpu.planner.parser import Parser as _P
-                _P(stmt.body).parse_expr()
-            self.catalog.functions[stmt.name] = entry
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            self._plan_cache.clear()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropFunction):
-            if stmt.if_exists and stmt.name not in self.catalog.functions:
-                return Result(columns=[], rows=[])
-            if stmt.name not in self.catalog.functions:
-                raise CatalogError(f'function "{stmt.name}" does not exist')
-            users = [n for n, t in self.catalog.triggers.items()
-                     if t.get("function") == stmt.name]
-            if users:
-                raise CatalogError(
-                    f'cannot drop function "{stmt.name}": trigger(s) '
-                    f'{", ".join(sorted(users))} depend on it')
-            del self.catalog.functions[stmt.name]
-            self.catalog.tombstone("functions", stmt.name)
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            self._plan_cache.clear()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateRole):
-            if stmt.if_not_exists and stmt.name in self.catalog.roles:
-                return Result(columns=[], rows=[])
-            self.catalog.create_role(stmt.name)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropRole):
-            if stmt.if_exists and stmt.name not in self.catalog.roles:
-                return Result(columns=[], rows=[])
-            self.catalog.drop_role(stmt.name)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.Grant):
-            if stmt.revoke:
-                self.catalog.revoke(stmt.table, stmt.role, stmt.privileges)
-            else:
-                self.catalog.grant(stmt.table, stmt.role, stmt.privileges)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreatePolicy):
-            self.catalog.table(stmt.table)  # must exist
-            pols = self.catalog.policies.setdefault(stmt.table, [])
-            if any(p["name"] == stmt.name for p in pols):
-                raise CatalogError(
-                    f'policy "{stmt.name}" for table "{stmt.table}" '
-                    "already exists")
-            from citus_tpu.planner.parser import Parser as _P
-            for text in (stmt.using_sql, stmt.check_sql):
-                if text is not None:
-                    _P(text).parse_expr()  # validate
-            pols.append({"name": stmt.name, "cmd": stmt.cmd,
-                         "roles": list(stmt.roles),
-                         "using": stmt.using_sql, "check": stmt.check_sql})
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropPolicy):
-            pols = self.catalog.policies.get(stmt.table, [])
-            kept = [p for p in pols if p["name"] != stmt.name]
-            if len(kept) == len(pols):
-                if stmt.if_exists:
-                    return Result(columns=[], rows=[])
-                raise CatalogError(
-                    f'policy "{stmt.name}" for table "{stmt.table}" '
-                    "does not exist")
-            if kept:
-                self.catalog.policies[stmt.table] = kept
-            else:
-                del self.catalog.policies[stmt.table]
-            # per-policy tombstone: the commit-time merge is per policy
-            self.catalog.tombstone("policies", f"{stmt.table}.{stmt.name}")
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.AlterTableRls):
-            self.catalog.table(stmt.table)
-            if stmt.enable:
-                self.catalog.rls[stmt.table] = True
-            elif self.catalog.rls.pop(stmt.table, None) is not None:
-                self.catalog.tombstone("rls", stmt.table)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateTrigger):
-            self.catalog.table(stmt.table)
-            if stmt.name in self.catalog.triggers:
-                raise CatalogError(f'trigger "{stmt.name}" already exists')
-            fn = self.catalog.functions.get(stmt.function)
-            if fn is None or fn.get("kind") != "statement":
-                raise CatalogError(
-                    f'"{stmt.function}" is not a trigger function '
-                    "(CREATE FUNCTION ... RETURNS trigger)")
-            self.catalog.triggers[stmt.name] = {
-                "table": stmt.table, "event": stmt.event,
-                "function": stmt.function}
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropTrigger):
-            t = self.catalog.triggers.get(stmt.name)
-            if t is None or t.get("table") != stmt.table:
-                if stmt.if_exists:
-                    return Result(columns=[], rows=[])
-                raise CatalogError(
-                    f'trigger "{stmt.name}" on "{stmt.table}" does not exist')
-            del self.catalog.triggers[stmt.name]
-            self.catalog.tombstone("triggers", stmt.name)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateTsConfig):
-            if stmt.name in self.catalog.ts_configs:
-                raise CatalogError(
-                    f'text search configuration "{stmt.name}" already exists')
-            src = stmt.options.get("copy")
-            if src is not None and src not in self.catalog.ts_configs \
-                    and src != "simple":
-                raise CatalogError(
-                    f'text search configuration "{src}" does not exist')
-            base = (dict(self.catalog.ts_configs.get(src, {}))
-                    if src is not None else {})
-            base["parser"] = stmt.options.get("parser",
-                                              base.get("parser", "default"))
-            self.catalog.ts_configs[stmt.name] = base
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropTsConfig):
-            if stmt.name not in self.catalog.ts_configs:
-                if stmt.if_exists:
-                    return Result(columns=[], rows=[])
-                raise CatalogError(
-                    f'text search configuration "{stmt.name}" does not exist')
-            del self.catalog.ts_configs[stmt.name]
-            self.catalog.tombstone("ts_configs", stmt.name)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateView):
-            # validate the body against current metadata (LIMIT 0 run)
-            import dataclasses
-            probe = dataclasses.replace(stmt.select, limit=0) \
-                if isinstance(stmt.select, A.Select) else stmt.select
-            replacing = stmt.or_replace and stmt.name in self.catalog.views
-            if replacing:
-                if stmt.name in _from_relations(stmt.select):
-                    raise AnalysisError(
-                        f'view "{stmt.name}" cannot reference itself')
-            new_r = self._execute_stmt(probe)
-            if replacing:
-                # PostgreSQL: a replace may only ADD columns at the end,
-                # keeping existing names AND types
-                from citus_tpu.planner.parser import parse_statement
-                old_sel = parse_statement(self.catalog.views[stmt.name])
-                old_r = self._execute_stmt(_limit0(old_sel))
-                old_cols = old_r.columns
-                if new_r.columns[:len(old_cols)] != old_cols:
-                    raise AnalysisError(
-                        "cannot drop, rename, or reorder columns of "
-                        f'view "{stmt.name}" with CREATE OR REPLACE')
-                if old_r.types and new_r.types:
-                    for i, (ot, nt) in enumerate(zip(old_r.types,
-                                                     new_r.types)):
-                        if ot is not None and nt is not None \
-                                and ot.kind != nt.kind:
-                            raise AnalysisError(
-                                "cannot change data type of view column "
-                                f'"{old_cols[i]}"')
-            self.catalog.create_view(stmt.name, stmt.sql,
-                                     or_replace=stmt.or_replace)
-            self.catalog.commit()
-            self._plan_cache.clear()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropView):
-            if stmt.if_exists and stmt.name not in self.catalog.views:
-                return Result(columns=[], rows=[])
-            self.catalog.drop_view(stmt.name)
-            self.catalog.commit()
-            self._plan_cache.clear()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateSequence):
-            if stmt.if_not_exists and stmt.name in self.catalog.sequences:
-                return Result(columns=[], rows=[])
-            self.catalog.create_sequence(stmt.name, stmt.start, stmt.increment)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropSequence):
-            if stmt.if_exists and stmt.name not in self.catalog.sequences:
-                return Result(columns=[], rows=[])
-            self.catalog.drop_sequence(stmt.name)
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateTableAs):
-            if self.catalog.has_table(stmt.name):
-                if stmt.if_not_exists:
-                    return Result(columns=[], rows=[])
-                raise CatalogError(
-                    f'relation "{stmt.name}" already exists')
-            r = self._execute_stmt(stmt.select)
-            names, types = self._schema_from_result(r, strict_empty=True)
-            # atomic create+load: a load failure must not leave an empty
-            # committed table behind (transparent inside a user txn)
-            with self._internal_txn():
-                self.create_table(stmt.name,
-                                  Schema([Column(cn, ct_)
-                                          for cn, ct_ in zip(names, types)]))
-                if r.rows:
-                    self.copy_from(stmt.name, rows=r.rows,
-                                   column_names=names)
-            return Result(columns=[], rows=[],
-                          explain={"selected": len(r.rows)})
-        if isinstance(stmt, A.CreateTable) and stmt.partition_of is not None:
-            self._create_partition(
-                stmt.name, stmt.partition_of["parent"],
-                stmt.partition_of["lo"], stmt.partition_of["hi"],
-                if_not_exists=stmt.if_not_exists)
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateTable):
-            from citus_tpu import types as T
-            cols, enum_binds = [], []
-            domain_binds = []
-            for c in stmt.columns:
-                if c.type_name in self.catalog.types:
-                    cols.append(Column(c.name, T.TEXT_T, c.not_null))
-                    enum_binds.append((c.name, c.type_name))
-                elif c.type_name in self.catalog.domains:
-                    d = self.catalog.domains[c.type_name]
-                    cols.append(Column(
-                        c.name,
-                        type_from_sql(d["base"], d["args"] or None),
-                        c.not_null or d["not_null"]))
-                    domain_binds.append((c.name, c.type_name))
-                else:
-                    cols.append(Column(
-                        c.name, type_from_sql(c.type_name, c.type_args or None),
-                        c.not_null))
-            schema = Schema(cols)
-            opts = {k: v for k, v in stmt.options.items() if k != "access_method"}
-            fks = []
-            pre_existing = self.catalog.has_table(stmt.name)
-            # pre-validate implicit PK/UNIQUE indexes and the partition
-            # clause BEFORE the table commits: PostgreSQL's CREATE TABLE
-            # is all-or-nothing
-            want_indexes = []
-            if not pre_existing:
-                seen_ix: set = set()
-                for c in stmt.columns:
-                    if not (c.primary_key or c.unique):
-                        continue
-                    iname = (f"{stmt.name}_pkey" if c.primary_key
-                             else f"{stmt.name}_{c.name}_key")
-                    if iname in seen_ix or self._find_index(iname)[1] is not None:
-                        raise CatalogError(f'index "{iname}" already exists')
-                    seen_ix.add(iname)
-                    if schema.column(c.name).type.is_float:
-                        raise UnsupportedFeatureError(
-                            "UNIQUE indexes over floating-point columns "
-                            "are not supported (no exact equality)")
-                    want_indexes.append((iname, c.name))
-                if stmt.partition_by is not None:
-                    schema.column(stmt.partition_by)  # must exist
-                    # PostgreSQL: a unique constraint on a partitioned
-                    # table must include the partition column
-                    for _, cname in want_indexes:
-                        if cname != stmt.partition_by:
-                            raise UnsupportedFeatureError(
-                                "unique constraint on partitioned table "
-                                "must include the partition column")
-            if stmt.foreign_keys and not pre_existing:
-                from citus_tpu.integrity import declare_fks
-                fks = declare_fks(self.catalog, stmt.name,
-                                  stmt.foreign_keys, schema=schema)
-            self.create_table(stmt.name, schema, if_not_exists=stmt.if_not_exists, **opts)
-            if fks and not pre_existing and self.catalog.has_table(stmt.name):
-                # IF NOT EXISTS no-op must not clobber existing constraints
-                self.catalog.table(stmt.name).foreign_keys = fks
-                self.catalog.commit()
-            if enum_binds and self.catalog.has_table(stmt.name):
-                for cn, tn in enum_binds:
-                    self.catalog.enum_columns[f"{stmt.name}.{cn}"] = tn
-                self.catalog.commit()
-            if domain_binds and not pre_existing \
-                    and self.catalog.has_table(stmt.name):
-                for cn, dn in domain_binds:
-                    self.catalog.domain_columns[f"{stmt.name}.{cn}"] = dn
-                self.catalog.commit()
-            if want_indexes and self.catalog.has_table(stmt.name):
-                # PRIMARY KEY / UNIQUE column constraints become unique
-                # indexes (PostgreSQL's implicit btree; pg_index rows) —
-                # pre-validated above, so these cannot fail halfway
-                for iname, cname in want_indexes:
-                    self.create_index(iname, stmt.name, cname, unique=True)
-            if stmt.partition_by is not None \
-                    and not pre_existing and self.catalog.has_table(stmt.name):
-                # validated before create_table above
-                t0 = self.catalog.table(stmt.name)
-                t0.partition_by = {"column": stmt.partition_by,
-                                   "kind": "range"}
-                self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropTable):
-            self.drop_table(stmt.name, if_exists=stmt.if_exists)
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.CreateIndex):
-            return self._execute_create_index(stmt)
-        if isinstance(stmt, A.DropIndex):
-            return self._execute_drop_index(stmt)
-        if isinstance(stmt, A.CreateExtension):
-            if stmt.name in self.catalog.extensions:
-                if stmt.if_not_exists:
-                    return Result(columns=[], rows=[])
-                raise CatalogError(f'extension "{stmt.name}" already exists')
-            self.catalog.extensions[stmt.name] = {
-                "version": stmt.version or "1.0"}
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropExtension):
-            return self._drop_catalog_object("extensions", stmt)
-        if isinstance(stmt, A.CreateDomain):
-            if stmt.name in self.catalog.domains:
-                raise CatalogError(f'domain "{stmt.name}" already exists')
-            type_from_sql(stmt.base, stmt.type_args or None)  # must resolve
-            if stmt.check_sql is not None:
-                from citus_tpu.planner.parser import Parser as _P
-                _P(stmt.check_sql).parse_expr()  # validate
-            self.catalog.domains[stmt.name] = {
-                "base": stmt.base, "args": list(stmt.type_args or []),
-                "not_null": stmt.not_null, "check": stmt.check_sql}
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropDomain):
-            users = [k for k, v in self.catalog.domain_columns.items()
-                     if v == stmt.name]
-            if users and stmt.name in self.catalog.domains:
-                raise CatalogError(
-                    f'cannot drop domain "{stmt.name}": column {users[0]} '
-                    "depends on it")
-            return self._drop_catalog_object("domains", stmt)
-        if isinstance(stmt, A.CreateCollation):
-            if stmt.name in self.catalog.collations:
-                raise CatalogError(f'collation "{stmt.name}" already exists')
-            self.catalog.collations[stmt.name] = dict(stmt.options)
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropCollation):
-            return self._drop_catalog_object("collations", stmt)
-        if isinstance(stmt, A.CreatePublication):
-            if stmt.name in self.catalog.publications:
-                raise CatalogError(
-                    f'publication "{stmt.name}" already exists')
-            if isinstance(stmt.tables, list):
-                for tn in stmt.tables:
-                    self.catalog.table(tn)  # must exist
-            self.catalog.publications[stmt.name] = {
-                "tables": stmt.tables}
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropPublication):
-            return self._drop_catalog_object("publications", stmt)
-        if isinstance(stmt, A.CreateStatistics):
-            if stmt.name in self.catalog.statistics:
-                raise CatalogError(
-                    f'statistics object "{stmt.name}" already exists')
-            t = self.catalog.table(stmt.table)
-            for c in stmt.columns:
-                t.schema.column(c)
-            # extended statistics: n-distinct over the column combination
-            # (reference: CREATE STATISTICS ndistinct; computed eagerly —
-            # our ANALYZE analog)
-            nd = self._compute_ndistinct(stmt.table, list(stmt.columns))
-            self.catalog.statistics[stmt.name] = {
-                "table": stmt.table, "columns": list(stmt.columns),
-                "ndistinct": nd}
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.DropStatistics):
-            return self._drop_catalog_object("statistics", stmt)
-        if isinstance(stmt, A.Insert):
-            return self._execute_insert(stmt)
-        if isinstance(stmt, A.CopyTo):
-            n = self.copy_to_csv(
-                stmt.table, stmt.path,
-                delimiter=stmt.options.get("delimiter", ","),
-                header=_option_bool(stmt.options.get("header", "false")),
-                null_string=stmt.options.get("null", ""))
-            return Result(columns=[], rows=[], explain={"copied": n})
-        if isinstance(stmt, A.CopyQueryTo):
-            r = self._execute_stmt(stmt.select)
-            nulls = stmt.options.get("null", "")
-            with open(stmt.path, "w", newline="") as fh:
-                w = self._open_csv_writer(
-                    fh, r.columns,
-                    delimiter=stmt.options.get("delimiter", ","),
-                    header=_option_bool(stmt.options.get("header", "false")))
-                for row in r.rows:
-                    w.writerow([nulls if v is None else v for v in row])
-            return Result(columns=[], rows=[], explain={"copied": len(r.rows)})
-        if isinstance(stmt, A.CopyFrom):
-            n = self.copy_from_csv(
-                stmt.table, stmt.path,
-                delimiter=stmt.options.get("delimiter", ","),
-                header=_option_bool(stmt.options.get("header", "false")),
-                null_string=stmt.options.get("null", ""))
-            return Result(columns=[], rows=[], explain={"copied": n})
-        if isinstance(stmt, A.Delete):
-            from citus_tpu.executor.dml import execute_delete
-            from citus_tpu.planner.bind import Binder
-            t = self.catalog.table(stmt.table)
-            if t.is_partitioned:
-                return self._partition_dml(stmt, t)
-            where = Binder(self.catalog, t).bind_scalar(stmt.where) \
-                if stmt.where is not None else None
-            from citus_tpu.transaction.locks import EXCLUSIVE
-            with self._write_lock(t, EXCLUSIVE):
-                if self.catalog.referencing_fks(stmt.table):
-                    # RESTRICT / CASCADE / SET NULL on referencing tables
-                    # before the parent rows disappear
-                    from citus_tpu.integrity import on_parent_delete
-                    on_parent_delete(self, stmt.table, stmt.where)
-                # RETURNING reads the pre-image under the same lock so
-                # the rows returned are exactly the rows deleted
-                ret = self._returning_result(stmt.table, stmt.where,
-                                             stmt.returning) \
-                    if stmt.returning else None
-                t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
-                from citus_tpu.storage.overlay import current_overlay
-                n = execute_delete(self.catalog, self.txlog, t, where,
-                                   txn=current_overlay())
-            self._plan_cache.clear()
-            if self._cdc_captures(t.name) and n:
-                self._emit_cdc(t.name, "delete", count=n)
-            if ret is not None:
-                ret.explain["deleted"] = n
-                return ret
-            return Result(columns=[], rows=[], explain={"deleted": n})
-        if isinstance(stmt, A.Update):
-            from citus_tpu.executor.dml import execute_update
-            from citus_tpu.planner.bind import Binder
-            t = self.catalog.table(stmt.table)
-            if t.is_partitioned:
-                return self._partition_dml(stmt, t)
-            b = Binder(self.catalog, t)
-            assignments = []
-            for col, e in stmt.assignments:
-                target = t.schema.column(col)
-                bound = b.bind_scalar(e)
-                from citus_tpu.planner.bound import BCast, BLiteral
-                if target.type.is_text:
-                    if isinstance(bound, BLiteral) and isinstance(bound.value, str):
-                        did = self.catalog.encode_strings(t.name, col, [bound.value])[0]
-                        bound = BLiteral(int(did), target.type)
-                    elif not bound.type.is_text:
-                        raise AnalysisError(
-                            f"cannot assign {bound.type} to {col} ({target.type})")
-                elif bound.type.is_text:
-                    raise AnalysisError(
-                        f"cannot assign text to {col} ({target.type})")
-                elif bound.type != target.type:
-                    bound = BCast(bound, target.type)
-                assignments.append((col, bound))
-            where = b.bind_scalar(stmt.where) if stmt.where is not None else None
-            from citus_tpu.transaction.locks import EXCLUSIVE
-            with self._write_lock(t, EXCLUSIVE):
-                assigned_cols = {c for c, _e in stmt.assignments}
-                if self.catalog.referencing_fks(stmt.table):
-                    from citus_tpu.integrity import on_parent_update
-                    on_parent_update(self, stmt.table, assigned_cols,
-                                     stmt.where, stmt.assignments)
-                if t.foreign_keys:
-                    from citus_tpu.integrity import check_child_update
-                    check_child_update(self, t, stmt.assignments)
-                ret = None
-                if stmt.returning:
-                    # new values = assignments substituted into the items,
-                    # evaluated over the pre-image under the same lock
-                    subst = {}
-                    for col, e in stmt.assignments:
-                        subst[A.ColumnRef(col)] = e
-                        subst[A.ColumnRef(col, stmt.table)] = e
-                    ret = self._returning_result(stmt.table, stmt.where,
-                                                 stmt.returning, subst)
-                t = self.catalog.table(stmt.table)  # re-fetch: fresh placements
-                from citus_tpu.storage.overlay import current_overlay
-                assigned = {c for c, _e in stmt.assignments}
-                checks = []
-                if any(c in assigned
-                       for c, _dn, _d in self._domain_columns_of(t)):
-                    checks.append(
-                        lambda v, m: self._check_domains_physical(t, v, m))
-                if t.partition_of is not None:
-                    from citus_tpu.partitioning import check_partition_bounds
-                    checks.append(
-                        lambda v, m: check_partition_bounds(
-                            self.catalog, t, v, m))
-                check = None
-                if checks:
-                    check = lambda v, m: [c(v, m) for c in checks]  # noqa: E731
-                n = execute_update(self.catalog, self.txlog, t, assignments,
-                                   where, txn=current_overlay(), check=check)
-            self._plan_cache.clear()
-            if self._cdc_captures(t.name) and n:
-                self._emit_cdc(t.name, "update", count=n)
-            if ret is not None:
-                ret.explain["updated"] = n
-                return ret
-            return Result(columns=[], rows=[], explain={"updated": n})
-        if isinstance(stmt, A.AlterTable):
-            if self.catalog.has_table(stmt.table) \
-                    and self.catalog.table(stmt.table).is_partitioned:
-                if stmt.action in ("rename_table", "rename_column"):
-                    raise UnsupportedFeatureError(
-                        "renaming a partitioned parent (or its columns) "
-                        "is not supported")
-                if stmt.action == "drop_column" \
-                        and stmt.old_name == self.catalog.table(
-                            stmt.table).partition_by["column"]:
-                    raise CatalogError("cannot drop the partition column")
-                # PostgreSQL: schema changes on the parent cascade to
-                # every partition
-                import dataclasses as _dc
-                for p in self.catalog.partitions_of(stmt.table):
-                    self._execute_stmt(_dc.replace(stmt, table=p.name))
-            if stmt.action == "add_column":
-                from citus_tpu import types as T
-                tn = stmt.column.type_name
-                if tn in self.catalog.types:  # enum
-                    col = Column(stmt.column.name, T.TEXT_T,
-                                 stmt.column.not_null)
-                    self.catalog.add_column(stmt.table, col)
-                    self.catalog.enum_columns[
-                        f"{stmt.table}.{stmt.column.name}"] = tn
-                elif tn in self.catalog.domains:
-                    d = self.catalog.domains[tn]
-                    col = Column(stmt.column.name,
-                                 type_from_sql(d["base"], d["args"] or None),
-                                 stmt.column.not_null or d["not_null"])
-                    self.catalog.add_column(stmt.table, col)
-                    self.catalog.domain_columns[
-                        f"{stmt.table}.{stmt.column.name}"] = tn
-                else:
-                    col = Column(stmt.column.name,
-                                 type_from_sql(tn, stmt.column.type_args or None),
-                                 stmt.column.not_null)
-                    self.catalog.add_column(stmt.table, col)
-            elif stmt.action == "drop_column":
-                t0 = self.catalog.table(stmt.table)
-                if t0.index_on(stmt.old_name) is not None:
-                    from citus_tpu.storage.overlay import current_overlay
-                    txn0 = current_overlay()
-                    if txn0 is not None:
-                        # irreversible file removal: defer to COMMIT
-                        col0 = stmt.old_name
-                        tname0 = t0.name
-                        txn0.on_commit.append(
-                            lambda: self._drop_index_segments_if_unindexed(
-                                tname0, col0))
-                    else:
-                        self._drop_index_segments(t0, stmt.old_name)
-                    t0.indexes[:] = [ix for ix in t0.indexes
-                                     if ix["column"] != stmt.old_name]
-                # PostgreSQL drops the table's own FK constraints that
-                # include the column; a referenced parent column needs
-                # CASCADE (unsupported here), so fail closed instead of
-                # leaving a stale constraint behind.
-                for child, fk in self.catalog.referencing_fks(stmt.table):
-                    if child == stmt.table:
-                        continue  # self-FK belongs to this table: dropped
-                    if stmt.old_name in fk["ref_columns"]:
-                        raise AnalysisError(
-                            f'cannot drop column "{stmt.old_name}" of '
-                            f'table "{stmt.table}" because foreign key '
-                            f'constraint "{fk["name"]}" on table '
-                            f'"{child}" depends on it')
-                t = self.catalog.table(stmt.table)
-                t.foreign_keys[:] = [
-                    fk for fk in t.foreign_keys
-                    if stmt.old_name not in fk["columns"]
-                    and not (fk["ref_table"] == stmt.table
-                             and stmt.old_name in fk["ref_columns"])]
-                key = f"{stmt.table}.{stmt.old_name}"
-                if self.catalog.domain_columns.pop(key, None) is not None:
-                    self.catalog.tombstone("domain_columns", key)
-                if self.catalog.enum_columns.pop(key, None) is not None:
-                    self.catalog.tombstone("enum_columns", key)
-                # PostgreSQL auto-drops extended statistics with a column
-                for sname in [n for n, st in self.catalog.statistics.items()
-                              if st["table"] == stmt.table
-                              and stmt.old_name in st["columns"]]:
-                    del self.catalog.statistics[sname]
-                    self.catalog.tombstone("statistics", sname)
-                self.catalog.drop_column(stmt.table, stmt.old_name)
-            elif stmt.action == "rename_column":
-                t0 = self.catalog.table(stmt.table)
-                if t0.index_on(stmt.old_name) is not None:
-                    # segments are keyed by logical column name on disk:
-                    # rename them with the column
-                    import os as _os
-                    suffix = f".idx.{stmt.old_name}.npz"
-                    for shard in t0.shards:
-                        for node in shard.placements:
-                            d = self.catalog.shard_dir(
-                                t0.name, shard.shard_id, node)
-                            if not _os.path.isdir(d):
-                                continue
-                            for f in _os.listdir(d):
-                                if f.endswith(suffix):
-                                    base = f[:-len(suffix)]
-                                    _os.replace(
-                                        _os.path.join(d, f),
-                                        _os.path.join(
-                                            d, base + f".idx.{stmt.new_name}.npz"))
-                    for ix in t0.indexes:
-                        if ix["column"] == stmt.old_name:
-                            ix["column"] = stmt.new_name
-                self.catalog.rename_column(stmt.table, stmt.old_name, stmt.new_name)
-                # keep FK metadata consistent: this table's own key
-                # columns and every child's referenced-column names
-                for fk in self.catalog.table(stmt.table).foreign_keys:
-                    fk["columns"] = [stmt.new_name if c == stmt.old_name
-                                     else c for c in fk["columns"]]
-                for _child, fk in self.catalog.referencing_fks(stmt.table):
-                    fk["ref_columns"] = [stmt.new_name if c == stmt.old_name
-                                         else c for c in fk["ref_columns"]]
-            elif stmt.action == "rename_table":
-                from citus_tpu.transaction.locks import EXCLUSIVE
-                t = self.catalog.table(stmt.table)
-                with self._write_lock(t, EXCLUSIVE):
-                    self.catalog.rename_table(stmt.table, stmt.new_name)
-                # repoint children's FK edges at the new name
-                for other in self.catalog.tables.values():
-                    for fk in other.foreign_keys:
-                        if fk["ref_table"] == stmt.table:
-                            fk["ref_table"] = stmt.new_name
-            else:
-                raise UnsupportedFeatureError(f"ALTER TABLE {stmt.action} not supported")
-            self.catalog.commit()
-            self._plan_cache.clear()
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.Merge):
-            from citus_tpu.executor.merge_executor import execute_merge
-            from citus_tpu.transaction.locks import EXCLUSIVE
-            _mt = self.catalog.table(stmt.target.name)
-            if _mt.foreign_keys or self.catalog.referencing_fks(_mt.name):
-                # the merge executor writes through the storage layer
-                # directly; fail closed rather than bypass FK enforcement
-                raise UnsupportedFeatureError(
-                    "MERGE on tables with foreign key constraints is not "
-                    "supported")
-            # unique indexes are enforced inside execute_merge (pre-commit
-            # delete-aware probe); FK targets stay refused above
-            with self._write_lock(self.catalog.table(stmt.target.name), EXCLUSIVE):
-                st = execute_merge(
-                    self.catalog, self.txlog, stmt,
-                    encode_value=lambda tbl, col, v:
-                        int(self.catalog.encode_strings(tbl, col, [v])[0]))
-            self._plan_cache.clear()
-            if self._cdc_captures(stmt.target.name):
-                self.cdc.emit(stmt.target.name, "merge",
-                              self.clock.transaction_clock(), force=True,
-                              count=sum(st.values()))
-            return Result(columns=[], rows=[], explain=st)
-        if isinstance(stmt, A.Truncate):
-            from citus_tpu.integrity import forbid_truncate_referenced
-            # validate EVERY relation up front (existence + FK rule with
-            # list-awareness: a referenced parent is fine when all its
-            # children are in the same list, like PostgreSQL): truncation
-            # deletes files irreversibly, so a bad later name must not
-            # leave earlier tables already emptied
-            names = (stmt.table,) + tuple(stmt.more)
-            expanded = []
-            for name in names:
-                t0 = self.catalog.table(name)
-                expanded.append(name)
-                if t0.is_partitioned:
-                    expanded += [p.name
-                                 for p in self.catalog.partitions_of(name)]
-            for name in expanded:
-                forbid_truncate_referenced(self.catalog, name,
-                                           also_truncated=set(expanded))
-            # acquire every relation's EXCLUSIVE lock (sorted, to dodge
-            # lock-order inversions) BEFORE the first irreversible flip:
-            # PostgreSQL's TRUNCATE a, b is all-or-nothing, so a later
-            # table's lock timeout must fail the statement while no
-            # table has been emptied yet
-            import contextlib as _ctxlib
-            from citus_tpu.transaction.locks import EXCLUSIVE
-            from citus_tpu.transaction.write_locks import group_resource
-            metas = {}
-            for name in expanded:
-                t0 = self.catalog.table(name)
-                if not t0.is_partitioned:
-                    metas.setdefault(group_resource(t0), t0)
-            with _ctxlib.ExitStack() as stack:
-                for res in sorted(metas):
-                    stack.enter_context(
-                        self._write_lock(metas[res], EXCLUSIVE))
-                for name in names:
-                    self._truncate_one(name)
-            return Result(columns=[], rows=[])
-        if isinstance(stmt, A.Vacuum):
-            from citus_tpu.executor.dml import execute_vacuum
-            from citus_tpu.transaction.locks import EXCLUSIVE
-            t = self.catalog.table(stmt.table)
-            if t.is_partitioned:
-                # the parent holds no data: vacuum every partition
-                return self._fanout_partitions(stmt, aggregate_explain=True)
-            with self._write_lock(t, EXCLUSIVE):
-                st = execute_vacuum(self.catalog, self.catalog.table(stmt.table))
-            self._plan_cache.clear()
-            return Result(columns=[], rows=[], explain=st)
-        if isinstance(stmt, A.SetConfig):
-            return self._execute_set(stmt)
-        if isinstance(stmt, A.ShowConfig):
-            return self._execute_show(stmt)
-        if isinstance(stmt, A.Analyze):
-            return self._execute_analyze(stmt.table)
-        if isinstance(stmt, A.VacuumAnalyze):
-            self._execute_stmt(A.Vacuum(stmt.table, stmt.full))
-            return self._execute_analyze(stmt.table)
-        if isinstance(stmt, A.Reindex):
-            return self._execute_reindex(stmt)
-        if isinstance(stmt, A.UtilityCall):
-            return self._execute_utility(stmt)
-        if isinstance(stmt, A.Explain):
-            return self._execute_explain(stmt)
+        # everything below SELECT dispatches through the per-statement
+        # handler registry (commands/; the DistributeObjectOps analog)
+        from citus_tpu.commands import loader as _loader
+        _loader.ensure_loaded()
+        from citus_tpu.commands.registry import lookup as _lookup
+        handler = _lookup(stmt)
+        if handler is not None:
+            return handler(self, stmt)
         raise UnsupportedFeatureError(f"cannot execute {type(stmt).__name__}")
 
-    def _compute_ndistinct(self, table: str, columns: list) -> int:
-        """count(DISTINCT (cols)) — the extended-statistics ndistinct."""
-        sel = A.Select(
-            [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
-            A.SubqueryRef(A.Select(
-                [A.SelectItem(A.ColumnRef(c)) for c in columns],
-                A.TableRef(table), distinct=True), "d"))
-        return int(self._execute_stmt(sel).rows[0][0])
+    # --- SET/SHOW/ANALYZE/REINDEX/RETURNING: commands/config_cmds.py ---
+    def _compute_ndistinct(self, table, columns):
+        from citus_tpu.commands.config_cmds import _compute_ndistinct
+        return _compute_ndistinct(self, table, columns)
 
-    #: SET/SHOW surface: GUC name -> (settings section, field, coercion)
-    #: (reference: the citus.* GUCs, shared_library_init.c:980+).
-    #: Settings apply to this Cluster handle (every session of it).
-    _GUCS = {
-        "citus.task_executor_backend": ("executor", "task_executor_backend", str),
-        "citus.max_shared_pool_size": ("executor", "max_shared_pool_size", int),
-        "citus.max_adaptive_executor_pool_size": ("executor", "max_tasks_in_flight", int),
-        "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
-        "citus.use_pallas_scan": ("executor", "use_pallas_scan", "bool"),
-        "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
-        "citus.shard_count": ("sharding", "shard_count", int),
-        "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
-        "citus.enable_change_data_capture": (None, "enable_change_data_capture", "bool"),
-        "citus.distributed_deadlock_detection_interval": (None, "deadlock_detection_interval_s", float),
-        # PostgreSQL spelling: bare numbers are MILLISECONDS; unit
-        # suffixes ('3s', '500ms') accepted
-        "lock_timeout": ("executor", "lock_timeout_s", "ms_duration"),
-    }
+    def _guc_key(self, name):
+        from citus_tpu.commands.config_cmds import _guc_key
+        return _guc_key(self, name)
 
-    def _guc_key(self, name: str) -> str:
-        name = name.lower()
-        if name in self._GUCS:
-            return name
-        if f"citus.{name}" in self._GUCS:
-            return f"citus.{name}"
-        raise CatalogError(f'unrecognized configuration parameter "{name}"')
+    def _execute_set(self, stmt):
+        from citus_tpu.commands.config_cmds import _execute_set
+        return _execute_set(self, stmt)
 
-    def _execute_set(self, stmt: A.SetConfig) -> Result:
-        import dataclasses as _dc
-        key = self._guc_key(stmt.name)
-        section, field_, coerce = self._GUCS[key]
-        v = stmt.value
-        if coerce == "bool":
-            if not isinstance(v, bool):
-                s = str(v).lower()
-                if s in ("true", "on", "1", "yes"):
-                    v = True
-                elif s in ("false", "off", "0", "no"):
-                    v = False
-                else:
-                    raise CatalogError(
-                        f'parameter "{stmt.name}" requires a Boolean '
-                        f"value (got {stmt.value!r})")
-        elif coerce == "secondary":
-            # PostgreSQL spelling: citus.use_secondary_nodes = always|never
-            if isinstance(v, bool):
-                pass
-            elif str(v).lower() in ("always", "never"):
-                v = str(v).lower() == "always"
-            else:
-                raise CatalogError(
-                    f'invalid value for parameter "{stmt.name}": '
-                    f"{stmt.value!r} (expected always or never)")
-        elif coerce == "ms_duration":
-            # bare numbers are milliseconds (PostgreSQL); 's'/'ms'
-            # suffixes accepted
-            s = str(v).strip().lower()
-            try:
-                if s.endswith("ms"):
-                    v = float(s[:-2]) / 1000.0
-                elif s.endswith("s"):
-                    v = float(s[:-1])
-                else:
-                    v = float(s) / 1000.0
-            except ValueError:
-                raise CatalogError(
-                    f'invalid value for parameter "{stmt.name}": '
-                    f"{stmt.value!r}")
-        else:
-            try:
-                v = coerce(v)
-            except (TypeError, ValueError):
-                raise CatalogError(
-                    f'invalid value for parameter "{stmt.name}": {stmt.value!r}')
-        from citus_tpu.storage.overlay import current_overlay
-        txn = current_overlay()
-        if txn is not None:
-            # PostgreSQL: a non-LOCAL SET is undone if the transaction
-            # aborts
-            prev_settings, prev_cdc = self.settings, self.cdc.enabled
+    def _guc_value(self, key):
+        from citus_tpu.commands.config_cmds import _guc_value
+        return _guc_value(self, key)
 
-            def _restore(prev_settings=prev_settings, prev_cdc=prev_cdc):
-                self.settings = prev_settings
-                self.cdc.enabled = prev_cdc
-                self._plan_cache.clear()
-            txn.on_rollback.append(_restore)
-        if section is None:
-            self.settings = _dc.replace(self.settings, **{field_: v})
-        else:
-            sec = _dc.replace(getattr(self.settings, section), **{field_: v})
-            self.settings = _dc.replace(self.settings, **{section: sec})
-        if key == "citus.enable_change_data_capture":
-            self.cdc.enabled = bool(v)
-        self._plan_cache.clear()  # backend/knob changes invalidate plans
-        return Result(columns=[], rows=[])
+    def _execute_show(self, stmt):
+        from citus_tpu.commands.config_cmds import _execute_show
+        return _execute_show(self, stmt)
 
-    def _guc_value(self, key: str) -> str:
-        section, field_, coerce = self._GUCS[key]
-        v = getattr(self.settings, field_) if section is None \
-            else getattr(getattr(self.settings, section), field_)
-        if coerce == "secondary":
-            return "always" if v else "never"
-        if isinstance(v, bool):
-            return "on" if v else "off"  # PostgreSQL boolean rendering
-        if coerce == "ms_duration":
-            return f"{v * 1000:g}ms"
-        return str(v)
+    def _execute_analyze(self, table):
+        from citus_tpu.commands.config_cmds import _execute_analyze
+        return _execute_analyze(self, table)
 
-    def _execute_show(self, stmt: A.ShowConfig) -> Result:
-        if stmt.name == "all":
-            rows = [(k, self._guc_value(k)) for k in sorted(self._GUCS)]
-            return Result(columns=["name", "setting"], rows=rows)
-        key = self._guc_key(stmt.name)
-        return Result(columns=[stmt.name], rows=[(self._guc_value(key),)])
-
-    def _execute_analyze(self, table: Optional[str]) -> Result:
-        """ANALYZE [table]: recompute extended-statistics ndistinct
-        (column min/max stats are always skip-list-live here, so there
-        is no per-column histogram pass to run)."""
-        if table is not None:
-            self.catalog.table(table)  # PostgreSQL: unknown relation errors
-        refreshed = 0
-        for name, st in self.catalog.statistics.items():
-            if table is not None and st["table"] != table:
-                continue
-            if not self.catalog.has_table(st["table"]):
-                continue
-            st["ndistinct"] = self._compute_ndistinct(st["table"],
-                                                      st["columns"])
-            refreshed += 1
-        if refreshed:
-            self.catalog.commit()
-        return Result(columns=[], rows=[],
-                      explain={"statistics_refreshed": refreshed})
-
-    def _execute_reindex(self, stmt: A.Reindex) -> Result:
-        """REINDEX INDEX name | REINDEX TABLE name: rebuild segment
-        files from the stripe data (recovers from lost/corrupted
-        segments; a missing segment is only a slow path, never wrong)."""
-        from citus_tpu.storage.index import backfill_index
-        from citus_tpu.transaction.locks import EXCLUSIVE
-        if stmt.kind == "index":
-            t, ix = self._find_index(stmt.name)
-            if ix is None:
-                raise CatalogError(f'index "{stmt.name}" does not exist')
-            targets = [(t, [ix["column"]])]
-        else:
-            t = self.catalog.table(stmt.name)
-            if t.is_partitioned:
-                targets = [(p, p.index_columns)
-                           for p in self.catalog.partitions_of(t.name)
-                           if p.indexes]
-            else:
-                targets = [(t, t.index_columns)] if t.indexes else []
-        rebuilt = 0
-        for tt, cols in targets:
-            with self._write_lock(tt, EXCLUSIVE):
-                for col in cols:
-                    self._drop_index_segments(tt, col)
-                rebuilt += backfill_index(self.catalog, tt, list(cols))
-                tt.version += 1
-        if targets:
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            self._plan_cache.clear()
-        return Result(columns=[], rows=[],
-                      explain={"segments_rebuilt": rebuilt})
+    def _execute_reindex(self, stmt):
+        from citus_tpu.commands.config_cmds import _execute_reindex
+        return _execute_reindex(self, stmt)
 
     def _returning_result(self, table_name, where, items, subst=None):
-        """Evaluate a RETURNING clause as a distributed SELECT over the
-        affected rows (pre-image WHERE); for UPDATE, assignment
-        expressions are substituted into the items so the NEW values are
-        returned (reference: adaptive_executor.c DML RETURNING tuples)."""
-        t = self.catalog.table(table_name)
-        expanded = _expand_returning_items(t, items, subst)
-        # constant items (e.g. SET c = 'z' substituted into RETURNING c)
-        # cannot ride the distributed select: fold them on the host and
-        # splice one copy per affected row
-        consts, sel_items = {}, []
-        for idx, (e, alias) in enumerate(expanded):
-            try:
-                consts[idx] = _eval_const(e)
-            except Exception:
-                sel_items.append((idx, A.SelectItem(e, alias)))
-        if sel_items:
-            inner = self._execute_stmt(A.Select(
-                [si for _, si in sel_items], A.TableRef(table_name), where))
-            nrows, inner_rows = len(inner.rows), inner.rows
-        else:
-            cnt = A.Select([A.SelectItem(A.FuncCall("count", (A.Star(),)))],
-                           A.TableRef(table_name), where)
-            nrows = int(self._execute_stmt(cnt).rows[0][0] or 0)
-            inner_rows = [()] * nrows
-        rows = []
-        for r in inner_rows:
-            full, j = [None] * len(expanded), 0
-            for idx in range(len(expanded)):
-                if idx in consts:
-                    full[idx] = consts[idx]
-                else:
-                    full[idx] = r[j]
-                    j += 1
-            rows.append(tuple(full))
-        return Result(columns=[a for _, a in expanded], rows=rows)
+        from citus_tpu.commands.config_cmds import _returning_result
+        return _returning_result(self, table_name, where, items, subst)
+
 
     def _execute_insert(self, stmt: A.Insert) -> Result:
-        t = self.catalog.table(stmt.table)
-        if stmt.select is not None:
-            if stmt.on_conflict is not None:
-                raise UnsupportedFeatureError(
-                    "ON CONFLICT with INSERT..SELECT is not supported")
-            if stmt.returning:
-                raise UnsupportedFeatureError(
-                    "RETURNING on INSERT..SELECT is not supported")
-            names = stmt.columns or t.schema.names
-            # FK-constrained, unique-indexed, and partitioned targets —
-            # and partitioned sources — take the pull path: copy_from's
-            # probes and partition routing only run there, and a
-            # partitioned source must expand through _execute_stmt
-            def _refs_partitioned(item) -> bool:
-                if isinstance(item, A.Join):
-                    return _refs_partitioned(item.left) \
-                        or _refs_partitioned(item.right)
-                return (isinstance(item, A.TableRef)
-                        and self.catalog.has_table(item.name)
-                        and self.catalog.table(item.name).is_partitioned)
-            direct_ok = not (t.foreign_keys or t.unique_indexes
-                             or t.is_partitioned
-                             or self._domain_columns_of(t))
-            if direct_ok and isinstance(stmt.select, A.Select) \
-                    and stmt.select.from_ is not None:
-                direct_ok = not _refs_partitioned(stmt.select.from_)
-            res = None if not direct_ok \
-                else self._insert_select_arrays(t, stmt.select, list(names))
-            if res is None:
-                # general path: materialize rows through the coordinator
-                # (reference: the pull-to-coordinator INSERT..SELECT
-                # strategy, insert_select_executor.c)
-                inner = self._execute_stmt(stmt.select)
-                n = self.copy_from(stmt.table, rows=inner.rows,
-                                   column_names=list(names))
-                strategy = "pull"
-            else:
-                n, strategy = res
-            return Result(columns=[], rows=[],
-                          explain={"inserted": n,
-                                   "strategy": f"insert_select:{strategy}"})
-        rows = []
-        for row_exprs in stmt.rows:
-            row = []
-            for e in row_exprs:
-                if not isinstance(e, A.Literal):
-                    if isinstance(e, A.UnOp) and e.op == "-" and isinstance(e.operand, A.Literal):
-                        row.append(-e.operand.value)
-                        continue
-                    if isinstance(e, A.FuncCall) and e.name in ("nextval", "currval") \
-                            and e.args and isinstance(e.args[0], A.Literal):
-                        seq = str(e.args[0].value)
-                        row.append(self.catalog.nextval(seq) if e.name == "nextval"
-                                   else self.catalog.currval(seq))
-                        continue
-                    raise UnsupportedFeatureError("INSERT VALUES must be literals")
-                row.append(e.value)
-            rows.append(row)
-        if stmt.on_conflict is not None:
-            return self._execute_upsert(t, stmt, rows)
-        n = self.copy_from(stmt.table, rows=rows, column_names=stmt.columns)
-        if stmt.returning:
-            names = list(stmt.columns or t.schema.names)
-            out_rows = []
-            for row in rows:
-                m = {}
-                for cn, v in zip(names, row):
-                    typ = t.schema.column(cn).type
-                    if v is not None and not typ.is_text:
-                        # what a subsequent SELECT would read back
-                        v = typ.from_physical(typ.to_physical(v))
-                    lit = A.Literal(v, "null" if v is None else
-                                    "string" if isinstance(v, str) else "int")
-                    m[A.ColumnRef(cn)] = lit
-                    m[A.ColumnRef(cn, stmt.table)] = lit
-                for cn in t.schema.names:
-                    m.setdefault(A.ColumnRef(cn), A.Literal(None, "null"))
-                    m.setdefault(A.ColumnRef(cn, stmt.table),
-                                 A.Literal(None, "null"))
-                exp = _expand_returning_items(t, stmt.returning, m)
-                out_rows.append(tuple(_eval_const(e) for e, _ in exp))
-            cols = [a for _, a in _expand_returning_items(t, stmt.returning)]
-            return Result(columns=cols, rows=out_rows,
-                          explain={"inserted": n})
-        return Result(columns=[], rows=[], explain={"inserted": n})
+        from citus_tpu.commands.insert import execute_insert
+        return execute_insert(self, stmt)
 
-    def _execute_upsert(self, t, stmt: A.Insert, rows: list) -> Result:
-        """INSERT ... ON CONFLICT: the conflict target is the declared
-        key (the reference requires it to include the distribution
-        column so conflicts resolve within one shard group —
-        multi_router_planner.c rejects others).  Runs under the
-        colocation group's EXCLUSIVE write lock so check+write is atomic
-        against concurrent writers and shard moves."""
-        oc = stmt.on_conflict
-        if stmt.returning:
-            raise UnsupportedFeatureError(
-                "RETURNING with ON CONFLICT is not supported")
-        if not oc.targets:
-            raise UnsupportedFeatureError(
-                "ON CONFLICT requires an explicit (column, ...) target")
-        names = list(stmt.columns or t.schema.names)
-        for c in oc.targets:
-            if not t.schema.has(c):
-                raise AnalysisError(f"column {c!r} does not exist")
-            if c not in names:
-                raise AnalysisError(
-                    "ON CONFLICT target columns must be inserted columns")
-        if t.is_distributed and t.dist_column not in oc.targets:
-            raise UnsupportedFeatureError(
-                "ON CONFLICT target must include the distribution column")
-        for c, _e in oc.assignments:
-            if not t.schema.has(c):
-                raise AnalysisError(f"column {c!r} does not exist")
-            if t.is_distributed and c == t.dist_column:
-                raise UnsupportedFeatureError(
-                    "ON CONFLICT DO UPDATE cannot modify the distribution "
-                    "column")
-        key_idx = [names.index(c) for c in oc.targets]
+    # --- SELECT machinery: delegated to commands/select_exec.py ---
+    def _execute_distinct_on(self, stmt):
+        from citus_tpu.commands.select_exec import _execute_distinct_on
+        return _execute_distinct_on(self, stmt)
 
-        def norm_key(vals) -> tuple:
-            """Canonicalize proposed key values to what a SELECT reads
-            back (physical round-trip), so they compare equal to probed
-            rows: 5.0 -> Decimal('5.00'), '2020-01-01' -> date."""
-            out = []
-            for c, v in zip(oc.targets, vals):
-                typ = t.schema.column(c).type
-                if v is None or typ.is_text:
-                    out.append(v)
-                else:
-                    out.append(typ.from_physical(typ.to_physical(v)))
-            return tuple(out)
+    def _execute_window(self, stmt):
+        from citus_tpu.commands.select_exec import _execute_window
+        return _execute_window(self, stmt)
 
-        if oc.action == "update":
-            # PostgreSQL raises error 21000 whenever two proposed rows
-            # would affect the same target row; checking up front keeps
-            # the statement all-or-nothing (no partially applied updates)
-            dup_check: set = set()
-            for row in rows:
-                raw = tuple(row[i] for i in key_idx)
-                if any(v is None for v in raw):
-                    continue
-                key = norm_key(raw)
-                if key in dup_check:
-                    raise ExecutionError(
-                        "ON CONFLICT DO UPDATE command cannot affect row "
-                        "a second time")
-                dup_check.add(key)
-        inserted = updated = skipped = 0
-        from citus_tpu.transaction.locks import EXCLUSIVE
-        with self._write_lock(t, EXCLUSIVE):
-            # one batched probe instead of a per-row count(*) under the
-            # lock: fetch the conflict-target columns of candidate rows
-            # (pruned by the distribution-column IN-list) into a set
-            probe_rows = [row for row in rows
-                          if not any(row[i] is None for i in key_idx)]
-            existing: set = set()
-            if probe_rows:
-                where = None
-                if t.is_distributed and t.dist_column in names:
-                    di = names.index(t.dist_column)
-                    dvals = sorted({row[di] for row in probe_rows})
-                    where = A.InList(A.ColumnRef(t.dist_column),
-                                     tuple(_pylit(v) for v in dvals), False)
-                chk = A.Select([A.SelectItem(A.ColumnRef(c))
-                                for c in oc.targets],
-                               A.TableRef(t.name), where)
-                existing = {tuple(r) for r in self._execute_stmt(chk).rows}
-            to_insert: list = []
-            affected: set = set()  # keys inserted/updated by this command
-            for row in rows:
-                raw = tuple(row[i] for i in key_idx)
-                if any(v is None for v in raw):
-                    # NULL never equals NULL: no conflict possible
-                    to_insert.append(row)
-                    inserted += 1
-                    continue
-                key = norm_key(raw)
-                if key in affected:
-                    # only reachable for DO NOTHING (DO UPDATE duplicate
-                    # keys were rejected before any mutation)
-                    skipped += 1
-                    continue
-                if key not in existing:
-                    affected.add(key)
-                    to_insert.append(row)
-                    inserted += 1
-                    continue
-                if oc.action == "nothing":
-                    skipped += 1
-                    continue
-                affected.add(key)
-                cond = None
-                for c, v in zip(oc.targets, raw):
-                    eq = A.BinOp("=", A.ColumnRef(c), _pylit(v))
-                    cond = eq if cond is None else A.BinOp("and", cond, eq)
-                excl = {c: _pylit(v) for c, v in zip(names, row)}
-                assignments = [(c, _subst_excluded(e, excl))
-                               for c, e in oc.assignments]
-                where = cond
-                if oc.where is not None:
-                    where = A.BinOp("and", cond,
-                                    _subst_excluded(oc.where, excl))
-                upd: A.Statement = A.Update(t.name, assignments, where)
-                import threading as _threading
-                exec_role = self._exec_roles.get(_threading.get_ident())
-                if exec_role is not None:
-                    # the conflicting row must pass the role's UPDATE
-                    # policies regardless of the conflict WHERE clause
-                    # (PostgreSQL raises the RLS violation whenever the
-                    # existing row fails USING)
-                    pol = self._policy_predicate(exec_role, t.name,
-                                                 "update")
-                    if pol is not None:
-                        vis = A.Select(
-                            [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
-                            A.TableRef(t.name), A.BinOp("and", cond, pol))
-                        if not self._execute_stmt(vis).rows[0][0]:
-                            raise AnalysisError(
-                                f'new row violates row-level security '
-                                f'policy for table "{t.name}"')
-                    upd, _ = self._apply_rls(exec_role, upd)
-                r = self._execute_stmt(upd)
-                n_upd = r.explain.get("updated", 0)
-                updated += n_upd
-                skipped += 0 if n_upd else 1  # DO UPDATE ... WHERE filtered
-            if to_insert:
-                self.copy_from(t.name, rows=to_insert,
-                               column_names=stmt.columns)
-        if oc.action == "update":
-            # PostgreSQL fires statement-level UPDATE triggers whenever
-            # DO UPDATE is specified (INSERT triggers fire at execute())
-            self._fire_triggers_for(t.name, "update", 0)
-        return Result(columns=[], rows=[],
-                      explain={"inserted": inserted, "updated": updated,
-                               "skipped": skipped, "strategy": "upsert"})
+    def _schema_from_result(self, r, *, strict_empty: bool = False):
+        from citus_tpu.commands.select_exec import _schema_from_result
+        return _schema_from_result(self, r, strict_empty=strict_empty)
 
-    def _insert_select_arrays(self, target, sel: A.Select,
-                              names: list[str]) -> Optional[int]:
-        """Array-streaming INSERT..SELECT (the repartition strategy,
-        reference: insert_select_planner.c IsRedistributablePlan): when
-        the SELECT is a plain single-table projection whose output types
-        match the target physically, move numpy columns straight from
-        the scan into the hash-routing ingest — no Python row
-        materialization.  Returns None when ineligible."""
-        if not isinstance(sel, A.Select) or not isinstance(sel.from_, A.TableRef):
-            return None
-        if sel.group_by or sel.having or sel.order_by or sel.limit or sel.distinct:
-            return None
-        try:
-            bound = bind_select(self.catalog, sel)
-        except Exception:
-            return None
-        if bound.has_aggs or len(bound.final_exprs) != len(names):
-            return None
-        from citus_tpu.planner.bound import (
-            BColumn, BDictRemap, compile_expr, predicate_mask,
-        )
-        from citus_tpu.planner.physical import plan_select
-        final_exprs = list(bound.final_exprs)
-        for i, (e, cname) in enumerate(zip(final_exprs, names)):
-            tgt = target.schema.column(cname).type
-            if e.type != tgt:
-                return None
-            if tgt.is_text:
-                if not isinstance(e, BColumn):
-                    return None
-                if bound.table.name != target.name or e.name != cname:
-                    # re-encode source dictionary ids into the target's
-                    # dictionary space (grows the target dictionary)
-                    src_words = self.catalog.dictionary(bound.table.name, e.name)
-                    mapping = tuple(int(x) for x in self.catalog.encode_strings(
-                        target.name, cname, src_words))
-                    final_exprs[i] = BDictRemap(e, mapping)
-        plan = plan_select(self.catalog, bound,
-                           direct_limit=self.settings.planner.direct_gid_limit)
-        from citus_tpu.transaction.locks import SHARED
-        fns = [compile_expr(e, np) for e in final_exprs]
-        ffn = compile_expr(bound.filter, np) if bound.filter is not None else None
-        strategy = self._insert_select_strategy(target, bound, final_exprs, names)
-        with self._write_lock(target, SHARED):
-            n = self._run_insert_select_arrays(
-                target, bound, plan, fns, ffn, names, strategy)
-        return n, strategy
+    def _create_temp_from_result(self, prefix, label, r):
+        from citus_tpu.commands.select_exec import _create_temp_from_result
+        return _create_temp_from_result(self, prefix, label, r)
 
-    def _insert_select_strategy(self, target, bound, final_exprs, names) -> str:
-        """The reference's INSERT..SELECT strategy ladder
-        (insert_select_planner.c, README:1187-1238): *colocated pushdown*
-        when source and target share a colocation group and the target's
-        distribution column is fed directly by the source's distribution
-        column (rows already live on the right shard — no re-hash, no
-        routing); else *repartition* (array-streaming re-hash through the
-        hash-routing ingest).  The caller falls back to *pull* (row
-        materialization) when the arrays path is ineligible entirely."""
-        from citus_tpu.planner.bound import BColumn
-        src = bound.table
-        if not (src.is_distributed and target.is_distributed):
-            return "repartition"
-        if src.colocation_id != target.colocation_id:
-            return "repartition"
-        if target.dist_column is None or target.dist_column not in names:
-            return "repartition"
-        i = names.index(target.dist_column)
-        e = final_exprs[i]
-        # plain column (no dict remap / cast) referencing the source's
-        # distribution column: hash(source row) == hash(target row)
-        if isinstance(e, BColumn) and e.name == src.dist_column:
-            return "colocated"
-        return "repartition"
-
-    def _run_insert_select_arrays(self, target, bound, plan, fns, ffn,
-                                  names, strategy) -> int:
-        from citus_tpu.storage.overlay import current_overlay
-        txn = current_overlay()
-        if txn is not None:
-            # inside BEGIN..COMMIT: stage under the transaction's xid.
-            # On failure, register staged dirs (never abort the xid —
-            # that would destroy earlier statements' staged rows)
-            ing = TableIngestor(self.catalog, target, txlog=None)
-            ing.xid = txn.xid
-            try:
-                total = self._stream_insert_select(ing, target, bound, plan,
-                                                   fns, ffn, names, strategy)
-                for w in ing._writers.values():
-                    w.flush()
-            finally:
-                txn.record_ingest(
-                    target.name,
-                    [w.directory for w in ing._writers.values()])
-            self.counters.bump("rows_ingested", total)
-            return total
-        ing = TableIngestor(self.catalog, target, txlog=self.txlog)
-        try:
-            total = self._stream_insert_select(ing, target, bound, plan,
-                                               fns, ffn, names, strategy)
-        except BaseException:
-            ing.abort()  # failure during scan/append: staged files dropped
-            raise
-        # finish() manages its own failure path (releases the xid so
-        # recovery decides; aborting here could roll back a logged COMMIT)
-        ing.finish()
-        self.counters.bump("rows_ingested", total)
-        return total
-
-    def _stream_insert_select(self, ing, target, bound, plan, fns, ffn,
-                              names, strategy) -> int:
-        from citus_tpu.executor.batches import load_shard_batches
-        from citus_tpu.planner.bound import predicate_mask
-        total = 0
-        for si in plan.shard_indexes:
-            for values, masks, n in load_shard_batches(
-                    self.catalog, plan, si, min_batch_rows=1):
-                env = {c: (values[c].astype(
-                            bound.table.schema.column(c).type.device_dtype, copy=False),
-                           masks[c]) for c in plan.scan_columns}
-                if ffn is not None:
-                    m = np.asarray(predicate_mask(np, ffn, env, np.ones(n, bool)))
-                    if m.shape == ():
-                        m = np.full(n, bool(m))
-                else:
-                    m = np.ones(n, bool)
-                idx = np.nonzero(m)[0]
-                if idx.size == 0:
-                    continue
-                out_v, out_m = {}, {}
-                for fn, cname in zip(fns, names):
-                    v, valid = fn(env)
-                    v = np.asarray(v)
-                    if v.ndim == 0:
-                        v = np.broadcast_to(v, (n,))
-                    if valid is True:
-                        valid = np.ones(n, bool)
-                    elif valid is False:
-                        valid = np.zeros(n, bool)
-                    st = target.schema.column(cname).type.storage_dtype
-                    out_v[cname] = v[idx].astype(st)
-                    out_m[cname] = np.asarray(valid)[idx]
-                for cname in target.schema.names:
-                    if cname not in out_v:
-                        out_v[cname] = np.zeros(idx.size, target.schema.column(cname).type.storage_dtype)
-                        out_m[cname] = np.zeros(idx.size, bool)
-                if strategy == "colocated":
-                    # pushdown: rows of source shard si belong to target
-                    # shard si by construction — write straight to its
-                    # placements, skipping hash + scatter entirely
-                    shard = target.shards[si]
-                    for node in shard.placements:
-                        ing._writer(shard.shard_id, node).append_batch(out_v, out_m)
-                else:
-                    ing.append(out_v, out_m)
-                total += idx.size
-        return total
-
-    @staticmethod
-    def _resolve_window_ref(wc: A.WindowCall, windows: dict,
-                            _seen: Optional[set] = None) -> A.WindowCall:
-        """Resolve OVER w / OVER (w ...) against the WINDOW clause,
-        following PostgreSQL's copy rules: the referencing spec may not
-        re-partition, may order only when the base does not, and always
-        uses its own frame (the base may not define one when copied);
-        OVER w uses the named window verbatim, frame included."""
-        if wc.ref_name is None:
-            return wc
-        if _seen is None:
-            _seen = set()
-        if wc.ref_name in _seen:
-            raise AnalysisError(
-                f'circular reference in window "{wc.ref_name}"')
-        _seen.add(wc.ref_name)
-        base = windows.get(wc.ref_name)
-        if base is None:
-            raise AnalysisError(f'window "{wc.ref_name}" does not exist')
-        if base.ref_name is not None:
-            base = Cluster._resolve_window_ref(base, windows, _seen)
-        if wc.ref_verbatim:
-            return A.WindowCall(wc.func, base.partition_by, base.order_by,
-                                base.frame)
-        if wc.partition_by:
-            raise AnalysisError(
-                "cannot override PARTITION BY of a named window")
-        if wc.order_by and base.order_by:
-            raise AnalysisError(
-                "cannot override ORDER BY of a named window that has one")
-        if base.frame is not None:
-            raise AnalysisError(
-                "cannot copy a named window that has a frame clause")
-        return A.WindowCall(wc.func, base.partition_by,
-                            wc.order_by or base.order_by, wc.frame)
-
-    def _execute_distinct_on(self, stmt: A.Select) -> Result:
-        """SELECT DISTINCT ON (exprs): keep the first row of each key
-        group in ORDER BY order (PostgreSQL semantics — planned as
-        Unique over Sort).  The key expressions run as trailing hidden
-        outputs of the inner query; deduplication happens on the
-        coordinator, then LIMIT/OFFSET apply to the deduplicated rows."""
-        import dataclasses as _dc
-        on = list(stmt.distinct_on)
-
-        def resolve(e):
-            # ordinals and output aliases resolve to their select item
-            if isinstance(e, A.Literal) and isinstance(e.value, int) \
-                    and not isinstance(e.value, bool):
-                idx = e.value - 1
-                if 0 <= idx < len(stmt.items):
-                    return stmt.items[idx].expr
-            if isinstance(e, A.ColumnRef) and e.table is None:
-                for it in stmt.items:
-                    if it.alias == e.name:
-                        return it.expr
-            return e
-
-        for i, e in enumerate(on):
-            if i < len(stmt.order_by) \
-                    and resolve(stmt.order_by[i].expr) != resolve(e):
-                raise AnalysisError(
-                    "SELECT DISTINCT ON expressions must match initial "
-                    "ORDER BY expressions")
-        order_by = list(stmt.order_by) \
-            or [A.OrderItem(e, True, None) for e in on]
-        hidden = [A.SelectItem(resolve(e), f"__distinct_on_{i}")
-                  for i, e in enumerate(on)]
-        inner = _dc.replace(stmt, items=list(stmt.items) + hidden,
-                            order_by=order_by, limit=None, offset=None,
-                            distinct_on=())
-        r = self._execute_stmt(inner)
-        k = len(on)
-        seen, rows = set(), []
-        for row in r.rows:
-            key = row[-k:]
-            if key in seen:
-                continue
-            seen.add(key)
-            rows.append(row[:-k])
-        if stmt.offset:
-            rows = rows[stmt.offset:]
-        if stmt.limit is not None:
-            rows = rows[:stmt.limit]
-        return Result(columns=r.columns[:-k], rows=rows,
-                      explain={**(r.explain or {}),
-                               "strategy": "distinct_on"},
-                      types=r.types[:-k] if r.types else r.types)
-
-    def _execute_window(self, stmt: A.Select) -> Result:
-        """Window functions: run the base projection (or grouped
-        aggregation) distributed, apply the window pass on the
-        coordinator (pull strategy)."""
-        import dataclasses
-
-        from citus_tpu.executor.window import AGGS, NAVIGATION, compute_window
-        if stmt.distinct:
-            raise UnsupportedFeatureError(
-                "window functions with DISTINCT not supported yet")
-        if stmt.windows or any(isinstance(i.expr, A.WindowCall)
-                               and i.expr.ref_name is not None
-                               for i in stmt.items):
-            import dataclasses
-            wmap = dict(stmt.windows)
-            stmt = dataclasses.replace(stmt, items=[
-                A.SelectItem(self._resolve_window_ref(i.expr, wmap)
-                             if isinstance(i.expr, A.WindowCall) else i.expr,
-                             i.alias)
-                for i in stmt.items])
-        base_items: list[A.SelectItem] = []
-
-        def base_slot(e: A.Expr) -> int:
-            base_items.append(A.SelectItem(e, f"__w{len(base_items)}"))
-            return len(base_items) - 1
-
-        def literal_value(a: A.Expr):
-            if isinstance(a, A.Literal):
-                return a.value
-            if isinstance(a, A.UnOp) and a.op == "-" \
-                    and isinstance(a.operand, A.Literal):
-                return -a.operand.value
-            raise UnsupportedFeatureError(
-                "window function extra arguments must be literals")
-
-        outputs = []  # ("col", slot) | ("win", fn, arg_slots, part, order, frame, params)
-        names = []
-        for i, item in enumerate(stmt.items):
-            e = item.expr
-            if isinstance(e, A.WindowCall):
-                fn = e.func.name
-                if e.func.filter is not None:
-                    if fn not in AGGS:
-                        raise AnalysisError(
-                            "FILTER is only allowed for aggregate window "
-                            "functions")
-                    # same CASE desugar as plain aggregates: the window
-                    # aggregates above skip NULL inputs
-                    from citus_tpu.planner.bind import rewrite_agg_filter
-                    e = dataclasses.replace(e, func=rewrite_agg_filter(e.func))
-                args = [a for a in e.func.args if not isinstance(a, A.Star)]
-                if fn in NAVIGATION:
-                    arg_slots = [base_slot(args[0])] if args else []
-                    params = tuple(literal_value(a) for a in args[1:])
-                elif fn == "ntile":
-                    arg_slots = []
-                    params = tuple(literal_value(a) for a in args[:1])
-                else:
-                    arg_slots = [base_slot(a) for a in args]
-                    params = ()
-                part_slots = [base_slot(p) for p in e.partition_by]
-                order_specs = [(base_slot(oe), asc) for oe, asc in e.order_by]
-                outputs.append(("win", fn, arg_slots, part_slots, order_specs,
-                                e.frame, params))
-                names.append(item.alias or fn)
-            else:
-                outputs.append(("col", base_slot(e)))
-                names.append(item.alias or (e.name if isinstance(e, A.ColumnRef)
-                                            else f"column{i + 1}"))
-        # the base query keeps GROUP BY/HAVING: windows then run over the
-        # grouped rows (PostgreSQL semantics — windows after aggregation)
-        base = A.Select(base_items, stmt.from_, stmt.where,
-                        stmt.group_by, stmt.having)
-        def window_pass(rows_in: list) -> list[tuple]:
-            """Apply every window spec over one row set -> output rows."""
-            n = len(rows_in)
-            cols = [[row[j] for row in rows_in] for j in range(len(base_items))]
-            out_cols = []
-            for spec in outputs:
-                if spec[0] == "col":
-                    out_cols.append(cols[spec[1]])
-                else:
-                    _, fn, arg_slots, part_slots, order_specs, frame, params = spec
-                    out_cols.append(compute_window(
-                        n, fn, [cols[s] for s in arg_slots],
-                        [cols[s] for s in part_slots],
-                        [(cols[s], asc) for s, asc in order_specs],
-                        frame=frame, params=params))
-            return [tuple(c[i] for c in out_cols) for i in range(n)]
-
-        strategy = "window:pull"
-        if self._window_pushdown_eligible(stmt, outputs):
-            # every window partitions by the distribution column, so no
-            # partition spans shards: the whole window computation runs
-            # per shard and results concatenate (reference: pushdown when
-            # partitioned by the distribution column, multi_explain/
-            # query_pushdown_planning safety proof)
-            import dataclasses
-            from citus_tpu.planner.physical import plan_select
-            bound = bind_select(self.catalog, base)
-            plan = plan_select(self.catalog, bound,
-                               direct_limit=self.settings.planner.direct_gid_limit)
-            rows = []
-            for si in plan.shard_indexes:
-                shard_plan = dataclasses.replace(plan, shard_indexes=[si])
-                shard_rows = execute_select(self.catalog, bound, self.settings,
-                                            plan=shard_plan).rows
-                rows.extend(window_pass(shard_rows))
-            strategy = "window:pushdown"
-        else:
-            rows = window_pass(self._execute_stmt(base).rows)
-        # outer ORDER BY / LIMIT over the final outputs (name or position)
-        for oi in reversed(stmt.order_by):
-            idx = None
-            if isinstance(oi.expr, A.Literal) and isinstance(oi.expr.value, int):
-                idx = oi.expr.value - 1
-            elif isinstance(oi.expr, A.ColumnRef) and oi.expr.name in names:
-                idx = names.index(oi.expr.name)
-            if idx is None or not (0 <= idx < len(names)):
-                raise AnalysisError(
-                    "ORDER BY with window functions must reference an output "
-                    "name or position")
-            nf = oi.nulls_first if oi.nulls_first is not None else (not oi.ascending)
-            nulls = [x for x in rows if x[idx] is None]
-            vals = [x for x in rows if x[idx] is not None]
-            vals.sort(key=lambda x, j=idx: x[j], reverse=not oi.ascending)
-            rows = (nulls + vals) if nf else (vals + nulls)
-        if stmt.offset:
-            rows = rows[stmt.offset:]
-        if stmt.limit is not None:
-            rows = rows[:stmt.limit]
-        return Result(columns=names, rows=rows,
-                      explain={"strategy": strategy})
-
-    @staticmethod
-    def _injective_in_column(e: A.Expr, col: str, alias: str) -> bool:
-        """True when ``e`` is an injective function of the column: equal
-        outputs imply equal column values, so partitioning by it can
-        never group rows from different shards.  Covers the column
-        itself and +/- of a constant, * by a nonzero constant, and
-        unary minus, composed."""
-        if isinstance(e, A.ColumnRef):
-            return e.name == col and (e.table is None or e.table == alias)
-        if isinstance(e, A.UnOp) and e.op == "-":
-            return Cluster._injective_in_column(e.operand, col, alias)
-        if isinstance(e, A.BinOp) and e.op in ("+", "-", "*"):
-            def const_val(x):
-                # integers only: float +/× is NOT injective over bigints
-                # (rounding collapses distinct inputs at large magnitude)
-                if isinstance(x, A.Literal) and isinstance(x.value, int) \
-                        and not isinstance(x.value, bool):
-                    return x.value
-                if isinstance(x, A.UnOp) and x.op == "-":
-                    v = const_val(x.operand)
-                    return -v if v is not None else None
-                return None
-            for side, other in ((e.left, e.right), (e.right, e.left)):
-                c = const_val(other)
-                if c is None:
-                    continue
-                if e.op == "*" and c == 0:
-                    return False
-                if e.op == "-" and side is e.right and other is e.left:
-                    # const - expr: still injective
-                    pass
-                if Cluster._injective_in_column(side, col, alias):
-                    return True
-        return False
-
-    def _window_pushdown_eligible(self, stmt: A.Select, outputs) -> bool:
-        """Safe to compute windows per shard: single distributed table,
-        no GROUP BY, and every window's PARTITION BY includes the
-        distribution column or an injective expression over it (equal
-        partition values then imply equal distribution values, and hash
-        partitions never span shards)."""
-        if stmt.group_by or stmt.having:
-            return False
-        if not isinstance(stmt.from_, A.TableRef):
-            return False
-        if not self.catalog.has_table(stmt.from_.name):
-            return False
-        t = self.catalog.table(stmt.from_.name)
-        if not t.is_distributed or t.dist_column is None:
-            return False
-        alias = stmt.from_.alias or stmt.from_.name
-        for item in stmt.items:
-            e = item.expr
-            if not isinstance(e, A.WindowCall):
-                continue
-            if not any(self._injective_in_column(p, t.dist_column, alias)
-                       for p in e.partition_by):
-                return False
-        return True
-
-    _CTE_SEQ = [0]
-
-    #: intermediate results at/above this row count distribute back out
-    #: over the mesh instead of staying coordinator-local (reference:
-    #: RedistributeTaskListResults / distributed_intermediate_results.c)
-    DISTRIBUTED_INTERMEDIATE_ROWS = 4096
-
-    def _schema_from_result(self, r: Result, *, strict_empty: bool = False):
-        """(deduped column names, column types) for materializing a
-        query result as a table.  Planner types win; otherwise infer
-        from values.  ``strict_empty``: refuse to guess types for an
-        empty untyped result (a PERSISTENT table must not silently get
-        bigint columns; throwaway intermediates tolerate the default)."""
-        names, seen = [], set()
-        for i, n in enumerate(r.columns):
-            base = n or f"column{i + 1}"
-            cand, k = base, 1
-            while cand in seen:
-                k += 1
-                cand = f"{base}_{k}"
-            seen.add(cand)
-            names.append(cand)
-        types = list(r.types) if r.types else [None] * len(names)
-        for i, ct_ in enumerate(types):
-            if ct_ is None:
-                if strict_empty and not r.rows:
-                    raise UnsupportedFeatureError(
-                        f"cannot infer the type of column {names[i]!r} "
-                        "from an empty result; create the table "
-                        "explicitly and INSERT instead")
-                types[i] = _infer_column_type([row[i] for row in r.rows])
-        return names, types
-
-    def _create_temp_from_result(self, prefix: str, label: str, r: Result) -> str:
-        """Store a query result as an intermediate-result table (the
-        read_intermediate_result analog for CTEs / derived tables / set
-        operations).  Small results stay local; large ones hash-
-        distribute on their first integer-typed column so downstream
-        joins and aggregations run sharded."""
-        from citus_tpu import types as T
-        names, types = self._schema_from_result(r)
-        self._CTE_SEQ[0] += 1
-        tmp = f"__{prefix}_{self._CTE_SEQ[0]}_{label}"
-        self.catalog.create_table(
-            tmp, Schema([Column(cn, ct_) for cn, ct_ in zip(names, types)]))
-        if len(r.rows) >= self.DISTRIBUTED_INTERMEDIATE_ROWS:
-            dist_col = next(
-                (cn for cn, ct_ in zip(names, types)
-                 if ct_.is_integer or ct_.kind in (T.DATE,)), None)
-            if dist_col is not None:
-                self.catalog.distribute_table(
-                    tmp, dist_col, self.settings.sharding.shard_count,
-                    self.catalog.active_node_ids())
-                self.catalog.commit()
-        if r.rows:
-            self.copy_from(tmp, rows=r.rows)
-        return tmp
-
-    def _execute_derived(self, stmt: A.Select) -> Result:
-        """Derived tables: execute each FROM-subquery, materialize it as
-        an intermediate result, rewrite the FROM item to reference it
-        (reference: RecursivelyPlanSubqueryWalker,
-        recursive_planning.c:1303)."""
-        temps: list[str] = []
-
-        def repl(item):
-            if isinstance(item, A.SubqueryRef):
-                r = self._execute_stmt(item.select)
-                if item.alias.startswith("__corr1row_") \
-                        and "__cnt" in r.columns:
-                    # decorrelated NON-aggregate scalar subquery: enforce
-                    # PostgreSQL's runtime rule that it yields at most
-                    # one row per outer key.  Stricter than PostgreSQL:
-                    # we check every inner key, including ones no outer
-                    # row probes — a conservative error, never a silent
-                    # wrong answer
-                    ci = r.columns.index("__cnt")
-                    ni = (r.columns.index("__cntnull")
-                          if "__cntnull" in r.columns else None)
-                    for row in r.rows:
-                        eff = row[ci] or 0
-                        if ni is not None and (row[ni] or 0) > 0:
-                            eff += 1  # NULL is one distinct row
-                        if eff > 1:
-                            raise AnalysisError(
-                                "more than one row returned by a subquery "
-                                "used as an expression")
-                tmp = self._create_temp_from_result("derived", item.alias, r)
-                temps.append(tmp)
-                return A.TableRef(tmp, item.alias)
-            if isinstance(item, A.FunctionRef):
-                r = _srf_result(item.name, item.args, item.alias)
-                label = item.alias or item.name
-                tmp = self._create_temp_from_result("srf", label, r)
-                temps.append(tmp)
-                return A.TableRef(tmp, item.alias or item.name)
-            if isinstance(item, A.Join):
-                return A.Join(repl(item.left), repl(item.right),
-                              item.kind, item.condition)
-            return item
-
-        try:
-            new_stmt = A.Select(stmt.items, repl(stmt.from_), stmt.where,
-                                stmt.group_by, stmt.having, stmt.order_by,
-                                stmt.limit, stmt.offset, stmt.distinct,
-                                stmt.windows)
-            return self._execute_stmt(new_stmt)
-        finally:
-            for tmp in temps:
-                try:
-                    self.drop_table(tmp)
-                except Exception:
-                    pass
+    def _execute_derived(self, stmt):
+        from citus_tpu.commands.select_exec import _execute_derived
+        return _execute_derived(self, stmt)
 
     def _expand_functions_stmt(self, stmt, depth: int = 0):
-        """Inline user SQL functions (expression macros) everywhere in a
-        SELECT/set operation — the planning-time analog of delegating a
-        distributed function call next to the data
-        (function_call_delegation.c)."""
-        if depth > 8:
-            raise AnalysisError("SQL function expansion too deep (recursive?)")
-        fns = self.catalog.functions
+        from citus_tpu.commands.select_exec import _expand_functions_stmt
+        return _expand_functions_stmt(self, stmt, depth)
 
-        def rw(e, d):
-            if e is None or not isinstance(e, A.Expr):
-                return e
-            if isinstance(e, A.FuncCall) and e.name in fns:
-                spec = fns[e.name]
-                if spec.get("kind") == "statement":
-                    raise AnalysisError(
-                        f'{e.name}() is a trigger function and cannot be '
-                        "called in an expression")
-                if len(e.args) != len(spec["args"]):
-                    raise AnalysisError(
-                        f'{e.name}() expects {len(spec["args"])} arguments')
-                if d > 8:
-                    raise AnalysisError(
-                        "SQL function expansion too deep (recursive?)")
-                from citus_tpu.planner.parser import Parser as _P
-                body = _P(spec["body"]).parse_expr()
-                sub = {n: rw(a, d) for n, a in zip(spec["args"], e.args)}
-                return rw(_subst_args(body, sub), d + 1)
-            if isinstance(e, A.BinOp):
-                return A.BinOp(e.op, rw(e.left, d), rw(e.right, d))
-            if isinstance(e, A.UnOp):
-                return A.UnOp(e.op, rw(e.operand, d))
-            if isinstance(e, A.Between):
-                return A.Between(rw(e.expr, d), rw(e.lo, d), rw(e.hi, d), e.negated)
-            if isinstance(e, A.InList):
-                return A.InList(rw(e.expr, d), tuple(rw(i, d) for i in e.items),
-                                e.negated)
-            if isinstance(e, A.IsNull):
-                return A.IsNull(rw(e.expr, d), e.negated)
-            if isinstance(e, A.Cast):
-                return A.Cast(rw(e.expr, d), e.type_name, e.type_args)
-            if isinstance(e, A.CaseExpr):
-                return A.CaseExpr(tuple((rw(c, d), rw(v, d)) for c, v in e.whens),
-                                  rw(e.else_, d) if e.else_ is not None else None)
-            if isinstance(e, A.FuncCall):
-                import dataclasses
-                return dataclasses.replace(
-                    e, args=tuple(rw(a, d) for a in e.args),
-                    agg_order=tuple((rw(oe, d), asc)
-                                    for oe, asc in e.agg_order),
-                    filter=rw(e.filter, d) if e.filter is not None else None)
-            if isinstance(e, A.WindowCall):
-                return A.WindowCall(rw(e.func, d) if e.func is not None else None,
-                                    tuple(rw(p, d) for p in e.partition_by),
-                                    tuple((rw(oe, d), asc) for oe, asc in e.order_by),
-                                    e.frame, e.ref_name, e.ref_verbatim)
-            return e
-
-        if isinstance(stmt, A.SetOp):
-            return A.SetOp(stmt.op, stmt.all,
-                           self._expand_functions_stmt(stmt.left, depth + 1),
-                           self._expand_functions_stmt(stmt.right, depth + 1),
-                           stmt.order_by, stmt.limit, stmt.offset)
-        return A.Select(
-            [A.SelectItem(rw(i.expr, 0), i.alias) for i in stmt.items],
-            stmt.from_, rw(stmt.where, 0),
-            [rw(g, 0) for g in stmt.group_by], rw(stmt.having, 0),
-            [A.OrderItem(rw(o.expr, 0), o.ascending, o.nulls_first)
-             for o in stmt.order_by],
-            stmt.limit, stmt.offset, stmt.distinct,
-            tuple((wn, rw(spec, 0)) for wn, spec in stmt.windows),
-            tuple(rw(e, 0) for e in stmt.distinct_on))
-
-    def _execute_constant_select(self, stmt: A.Select) -> Result:
-        """SELECT without FROM: constant expressions evaluated on the
-        coordinator (one row), including scalar subqueries."""
-        from citus_tpu.planner.recursive import rewrite_subqueries
-        stmt = rewrite_subqueries(stmt, lambda sub: self._execute_stmt(sub))
-        if stmt.group_by or stmt.having or stmt.distinct:
-            raise UnsupportedFeatureError(
-                "GROUP BY/HAVING/DISTINCT need a FROM clause")
-        row, names = [], []
-        for i, item in enumerate(stmt.items):
-            row.append(_eval_const(item.expr))
-            names.append(item.alias or (item.expr.name
-                                        if isinstance(item.expr, A.ColumnRef)
-                                        else f"column{i + 1}"))
-        rows = [tuple(row)]
-        if stmt.where is not None:
-            if _eval_const(stmt.where) is not True:
-                rows = []
-        if stmt.offset:
-            rows = rows[stmt.offset:]
-        if stmt.limit is not None:
-            rows = rows[:stmt.limit]
-        return Result(columns=names, rows=rows,
-                      explain={"strategy": "constant"})
+    def _execute_constant_select(self, stmt):
+        from citus_tpu.commands.select_exec import _execute_constant_select
+        return _execute_constant_select(self, stmt)
 
     def _expand_views(self, item):
-        """FROM references to views become derived tables over the view's
-        stored SELECT (reference: views as distributed objects,
-        commands/view.c; execution via recursive planning)."""
-        if isinstance(item, A.TableRef) and item.name in self.catalog.views:
-            sel = parse_sql(self.catalog.views[item.name])[0]
-            return A.SubqueryRef(sel, item.alias or item.name)
-        if isinstance(item, A.Join):
-            left = self._expand_views(item.left)
-            right = self._expand_views(item.right)
-            if left is not item.left or right is not item.right:
-                return A.Join(left, right, item.kind, item.condition)
-        return item
+        from citus_tpu.commands.select_exec import _expand_views
+        return _expand_views(self, item)
 
-    def _execute_grouping_sets(self, stmt: A.Select, sets) -> Result:
-        """ROLLUP/CUBE/GROUPING SETS: one grouped execution per set,
-        select items that are grouping expressions of an absent set pad
-        to NULL, results concatenate (reference: native grouping-set
-        execution; here composed over the standard grouped pipeline)."""
-        all_keys = set()
-        for s_ in sets:
-            all_keys.update(s_)
-        names = []
-        for i, item in enumerate(stmt.items):
-            names.append(item.alias or (item.expr.name
-                                        if isinstance(item.expr, A.ColumnRef)
-                                        else f"column{i + 1}"))
-        rows_all: list[tuple] = []
-        types_first = None
-        for s_ in sets:
-            keep_pos, sub_items = [], []
-            grouping_marks = {}  # position -> 0/1 constant for this set
-            for i, item in enumerate(stmt.items):
-                e = item.expr
-                if isinstance(e, A.FuncCall) and e.name == "grouping" \
-                        and len(e.args) == 1:
-                    # GROUPING(col): 1 when the column is rolled up
-                    # (absent from this set), 0 when grouped by
-                    grouping_marks[i] = 0 if e.args[0] in s_ else 1
-                    continue
-                if e in all_keys and e not in s_:
-                    continue  # key absent from this set: pad NULL
-                keep_pos.append(i)
-                sub_items.append(item)
-            # HAVING may reference rolled-up columns: they are NULL in
-            # this set (PostgreSQL semantics)
-            having = stmt.having
-            if having is not None:
-                absent = {k for k in all_keys if k not in s_}
-                if absent:
-                    having = _replace_exprs(
-                        having, {k: A.Literal(None, "null") for k in absent})
-            if not sub_items:
-                # only grouping columns selected and this is the empty
-                # set: the grand-total group is one all-NULL row
-                probe = A.Select([A.SelectItem(
-                    A.FuncCall("count", (A.Star(),)))],
-                    stmt.from_, stmt.where, list(s_), having)
-                if self._execute_stmt(probe).rows:
-                    full = [None] * len(stmt.items)
-                    for pos, mark in grouping_marks.items():
-                        full[pos] = mark
-                    rows_all.append(tuple(full))
-                continue
-            sub = A.Select(sub_items, stmt.from_, stmt.where, list(s_),
-                           having)
-            r = self._execute_stmt(sub)
-            if types_first is None and not any(
-                    i not in keep_pos for i in range(len(stmt.items))):
-                types_first = r.types
-            for row in r.rows:
-                full = [None] * len(stmt.items)
-                for j, pos in enumerate(keep_pos):
-                    full[pos] = row[j]
-                for pos, mark in grouping_marks.items():
-                    full[pos] = mark
-                rows_all.append(tuple(full))
-        if stmt.distinct:
-            rows_all = list(dict.fromkeys(rows_all))
-        rows_all = _sort_rows(rows_all, names, stmt.order_by)
-        if stmt.offset:
-            rows_all = rows_all[stmt.offset:]
-        if stmt.limit is not None:
-            rows_all = rows_all[:stmt.limit]
-        return Result(columns=names, rows=rows_all, types=types_first,
-                      explain={"strategy": "grouping_sets",
-                               "sets": len(sets)})
+    def _execute_grouping_sets(self, stmt, sets):
+        from citus_tpu.commands.select_exec import _execute_grouping_sets
+        return _execute_grouping_sets(self, stmt, sets)
 
-    def _execute_setop(self, stmt: A.SetOp) -> Result:
-        """UNION / INTERSECT / EXCEPT [ALL]: execute both sides, combine
-        on the coordinator with SQL bag/set semantics (NULLs compare
-        equal, like DISTINCT).  Reference: set operations that cannot be
-        pushed down run through recursive planning
-        (recursive_planning.c:223)."""
-        from collections import Counter
-        lres = self._execute_stmt(stmt.left)
-        rres = self._execute_stmt(stmt.right)
-        if len(lres.columns) != len(rres.columns):
-            raise AnalysisError(
-                "each side of a set operation must return the same number "
-                "of columns")
-        lrows, rrows = list(lres.rows), list(rres.rows)
-        if stmt.op == "union":
-            rows = lrows + rrows
-            if not stmt.all:
-                rows = list(dict.fromkeys(rows))
-        elif stmt.op == "intersect":
-            rc = Counter(rrows)
-            if stmt.all:
-                rows, used = [], Counter()
-                for row in lrows:
-                    if used[row] < rc.get(row, 0):
-                        used[row] += 1
-                        rows.append(row)
-            else:
-                rows = [row for row in dict.fromkeys(lrows) if rc.get(row, 0)]
-        else:  # except
-            if stmt.all:
-                rc = Counter(rrows)
-                rows, used = [], Counter()
-                for row in lrows:
-                    if used[row] < rc.get(row, 0):
-                        used[row] += 1
-                    else:
-                        rows.append(row)
-            else:
-                rset = set(rrows)
-                rows = [row for row in dict.fromkeys(lrows) if row not in rset]
-        rows = _sort_rows(rows, lres.columns, stmt.order_by)
-        if stmt.offset:
-            rows = rows[stmt.offset:]
-        if stmt.limit is not None:
-            rows = rows[:stmt.limit]
-        return Result(columns=lres.columns, rows=rows,
-                      types=lres.types or rres.types,
-                      explain={"strategy": f"setop:{stmt.op}"})
+    def _execute_setop(self, stmt):
+        from citus_tpu.commands.select_exec import _execute_setop
+        return _execute_setop(self, stmt)
 
-    def _execute_with(self, stmt: A.WithSelect) -> Result:
-        """Materialize each CTE as a temporary local table (the
-        intermediate-result strategy of recursive_planning.c), rewrite
-        references in later CTEs and the body, execute, drop."""
-        mapping: dict[str, str] = {}
-        temps: list[str] = []
+    def _execute_with(self, stmt):
+        from citus_tpu.commands.select_exec import _execute_with
+        return _execute_with(self, stmt)
 
-        def remap_from(item):
-            if isinstance(item, A.TableRef):
-                if item.name in mapping:
-                    return A.TableRef(mapping[item.name], item.alias or item.name)
-                return item
-            if isinstance(item, A.Join):
-                return A.Join(remap_from(item.left), remap_from(item.right),
-                              item.kind, item.condition)
-            if isinstance(item, A.SubqueryRef):
-                return A.SubqueryRef(remap_select(item.select), item.alias)
-            return item
+    # --- RLS / triggers / privileges: commands/rls.py ---
+    def _policy_predicate(self, role, table, cmd, kind="using"):
+        from citus_tpu.commands.rls import _policy_predicate
+        return _policy_predicate(self, role, table, cmd, kind)
 
-        def remap_select(sel):
-            import dataclasses
-            if isinstance(sel, A.SetOp):
-                return A.SetOp(sel.op, sel.all, remap_select(sel.left),
-                               remap_select(sel.right), sel.order_by,
-                               sel.limit, sel.offset)
-            # dataclasses.replace carries every other field (windows,
-            # future additions) — positional rebuilds have dropped
-            # fields here before
-            return dataclasses.replace(sel, from_=remap_from(sel.from_))
+    def _apply_rls(self, role, stmt):
+        from citus_tpu.commands.rls import _apply_rls
+        return _apply_rls(self, role, stmt)
 
-        try:
-            for name, sel in stmt.ctes:
-                r = self._execute_stmt(remap_select(sel))
-                tmp = self._create_temp_from_result("cte", name, r)
-                mapping[name] = tmp
-                temps.append(tmp)
-            body = remap_select(stmt.body)
-            return self._execute_stmt(body)
-        finally:
-            for tmp in temps:
-                try:
-                    self.drop_table(tmp)
-                except Exception:
-                    pass
+    def _rls_check_update(self, role, stmt):
+        from citus_tpu.commands.rls import _rls_check_update
+        return _rls_check_update(self, role, stmt)
 
-    def _policy_predicate(self, role: str, table: str, cmd: str,
-                          kind: str = "using") -> Optional[A.Expr]:
-        """RLS predicate for (role, table, command): None when RLS is
-        off for the table; FALSE when enabled with no applicable policy
-        (default deny); else the OR of applicable policies' expressions
-        (permissive policies, PostgreSQL default).  ``kind`` selects
-        USING or WITH CHECK (check falls back to using, as PG does)."""
-        if not self.catalog.rls.get(table):
-            return None
-        texts = []
-        for p in self.catalog.policies.get(table, ()):
-            if p["cmd"] not in ("all", cmd):
-                continue
-            if "public" not in p["roles"] and role not in p["roles"]:
-                continue
-            text = p.get(kind) or (p.get("using") if kind == "check" else None)
-            if text:
-                texts.append(text)
-        if not texts:
-            return A.Literal(False, "bool")
-        from citus_tpu.planner.parser import Parser as _P
-        cache = getattr(self, "_policy_expr_cache", None)
-        if cache is None:
-            cache = self._policy_expr_cache = {}
-        exprs = []
-        for t in texts:
-            parsed = cache.get(t)
-            if parsed is None:
-                parsed = cache[t] = _P(t).parse_expr()
-            exprs.append(parsed)
-        out = exprs[0]
-        for e in exprs[1:]:
-            out = A.BinOp("or", out, e)
-        return out
+    def _fire_triggers(self, stmt, depth: int = 0):
+        from citus_tpu.commands.rls import _fire_triggers
+        return _fire_triggers(self, stmt, depth)
 
-    def _apply_rls(self, role: str, stmt: A.Statement):
-        """Row-level security rewrite for a non-superuser role ->
-        (statement, changed).  Every table reference of an RLS-enabled
-        table — in FROM (incl. joins/derived tables), set operations,
-        CTEs, and expression subqueries (scalar/IN/EXISTS) — wraps in a
-        policy-filtered derived table; UPDATE/DELETE additionally AND
-        the predicate into WHERE and enforce WITH CHECK on assignments;
-        INSERT VALUES rows evaluate WITH CHECK per row (reference:
-        commands/policy.c; superuser role=None bypasses, like table
-        owners in PG)."""
-        import dataclasses
-        changed = [False]
-        EMPTY = frozenset()
+    def _fire_triggers_for(self, table, event, depth: int):
+        from citus_tpu.commands.rls import _fire_triggers_for
+        return _fire_triggers_for(self, table, event, depth)
 
-        def rew_from(item, shadow):
-            if isinstance(item, A.TableRef):
-                if item.name in shadow:
-                    return item  # resolves to a CTE, not the base table
-                if not self.catalog.has_table(item.name):
-                    return item
-                f = self._policy_predicate(role, item.name, "select")
-                if f is None:
-                    return item
-                changed[0] = True
-                sel = A.Select([A.SelectItem(A.Star())],
-                               A.TableRef(item.name), f)
-                return A.SubqueryRef(sel,
-                                     item.alias or item.name.split(".")[-1])
-            if isinstance(item, A.Join):
-                return A.Join(rew_from(item.left, shadow),
-                              rew_from(item.right, shadow),
-                              item.kind, item.condition)
-            if isinstance(item, A.SubqueryRef):
-                return A.SubqueryRef(rew_stmt(item.select, shadow),
-                                     item.alias)
-            return item
-
-        def rew_expr(e, shadow):
-            if e is None or not isinstance(e, A.Expr):
-                return e
-            if isinstance(e, A.Subquery):
-                return A.Subquery(rew_stmt(e.select, shadow))
-            if isinstance(e, A.Exists):
-                return A.Exists(rew_stmt(e.select, shadow))
-            if isinstance(e, A.BinOp):
-                return A.BinOp(e.op, rew_expr(e.left, shadow),
-                               rew_expr(e.right, shadow))
-            if isinstance(e, A.UnOp):
-                return A.UnOp(e.op, rew_expr(e.operand, shadow))
-            if isinstance(e, A.Between):
-                return A.Between(rew_expr(e.expr, shadow),
-                                 rew_expr(e.lo, shadow),
-                                 rew_expr(e.hi, shadow), e.negated)
-            if isinstance(e, A.InList):
-                return A.InList(rew_expr(e.expr, shadow),
-                                tuple(rew_expr(i, shadow) for i in e.items),
-                                e.negated)
-            if isinstance(e, A.IsNull):
-                return A.IsNull(rew_expr(e.expr, shadow), e.negated)
-            if isinstance(e, A.Cast):
-                return A.Cast(rew_expr(e.expr, shadow), e.type_name,
-                              e.type_args)
-            if isinstance(e, A.CaseExpr):
-                return A.CaseExpr(
-                    tuple((rew_expr(c, shadow), rew_expr(v, shadow))
-                          for c, v in e.whens),
-                    rew_expr(e.else_, shadow) if e.else_ is not None
-                    else None)
-            if isinstance(e, A.FuncCall):
-                import dataclasses
-                return dataclasses.replace(
-                    e, args=tuple(rew_expr(a, shadow) for a in e.args),
-                    agg_order=tuple((rew_expr(oe, shadow), asc)
-                                    for oe, asc in e.agg_order),
-                    filter=rew_expr(e.filter, shadow)
-                    if e.filter is not None else None)
-            if isinstance(e, A.WindowCall):
-                return A.WindowCall(
-                    rew_expr(e.func, shadow) if e.func is not None else None,
-                    tuple(rew_expr(p, shadow) for p in e.partition_by),
-                    tuple((rew_expr(oe, shadow), asc)
-                          for oe, asc in e.order_by),
-                    e.frame, e.ref_name, e.ref_verbatim)
-            return e
-
-        def rew_stmt(s, shadow):
-            if isinstance(s, A.SetOp):
-                return dataclasses.replace(s, left=rew_stmt(s.left, shadow),
-                                           right=rew_stmt(s.right, shadow))
-            if isinstance(s, A.WithSelect):
-                # a CTE's definition may reference only EARLIER CTE
-                # names; later refs resolve to the base relations
-                seen = set(shadow)
-                new_ctes = []
-                for n, sel in s.ctes:
-                    new_ctes.append((n, rew_stmt(sel, frozenset(seen))))
-                    seen.add(n)
-                return A.WithSelect(new_ctes,
-                                    rew_stmt(s.body, frozenset(seen)))
-            if not isinstance(s, A.Select):
-                return s
-            return dataclasses.replace(
-                s,
-                items=[A.SelectItem(rew_expr(i.expr, shadow), i.alias)
-                       for i in s.items],
-                from_=rew_from(s.from_, shadow) if s.from_ is not None
-                else None,
-                where=rew_expr(s.where, shadow),
-                group_by=[rew_expr(g, shadow) for g in s.group_by],
-                having=rew_expr(s.having, shadow),
-                order_by=[A.OrderItem(rew_expr(o.expr, shadow), o.ascending,
-                                      o.nulls_first) for o in s.order_by])
-
-        if isinstance(stmt, (A.Select, A.SetOp, A.WithSelect)):
-            new_stmt = rew_stmt(stmt, EMPTY)
-            return (new_stmt, True) if changed[0] else (stmt, False)
-        if isinstance(stmt, (A.Update, A.Delete)):
-            cmd = "update" if isinstance(stmt, A.Update) else "delete"
-            f = self._policy_predicate(role, stmt.table, cmd)
-            # embedded subqueries (WHERE / SET) read through RLS too,
-            # regardless of whether the TARGET table has policies
-            new_where = rew_expr(stmt.where, EMPTY)
-            if isinstance(stmt, A.Update):
-                new_assign = [(c, rew_expr(e, EMPTY))
-                              for c, e in stmt.assignments]
-            if f is None:
-                if isinstance(stmt, A.Update):
-                    return (dataclasses.replace(
-                        stmt, assignments=new_assign, where=new_where),
-                        changed[0])
-                return dataclasses.replace(stmt, where=new_where), changed[0]
-            if isinstance(stmt, A.Update):
-                self._rls_check_update(role, stmt)
-            where = f if new_where is None else A.BinOp("and", new_where, f)
-            if isinstance(stmt, A.Update):
-                return (dataclasses.replace(
-                    stmt, assignments=new_assign, where=where), True)
-            return dataclasses.replace(stmt, where=where), True
-        if isinstance(stmt, A.Insert):
-            # the SELECT source / row expressions read through RLS
-            new_select = (rew_stmt(stmt.select, EMPTY)
-                          if stmt.select is not None else None)
-            new_rows = ([[rew_expr(v, EMPTY) for v in row]
-                         for row in stmt.rows] if stmt.rows else stmt.rows)
-            f = self._policy_predicate(role, stmt.table, "insert",
-                                       kind="check")
-            if f is None:
-                if changed[0]:
-                    return dataclasses.replace(
-                        stmt, select=new_select, rows=new_rows), True
-                return stmt, False
-            if stmt.select is not None or not stmt.rows:
-                raise UnsupportedFeatureError(
-                    "INSERT ... SELECT under row-level security is not "
-                    "supported")
-            t = self.catalog.table(stmt.table)
-            cols = stmt.columns or t.schema.names
-            for row in stmt.rows:
-                subst = {c: v for c, v in zip(cols, row)}
-                checked = _subst_args(f, subst)
-                try:
-                    ok = _eval_const(checked)
-                except Exception:
-                    raise UnsupportedFeatureError(
-                        "row-level security WITH CHECK over non-constant "
-                        "inserts is not supported")
-                if ok is not True:
-                    raise AnalysisError(
-                        f'new row violates row-level security policy for '
-                        f'table "{stmt.table}"')
-            return (dataclasses.replace(stmt, rows=new_rows), True) \
-                if changed[0] else (stmt, False)
-        return stmt, False
-
-    def _rls_check_update(self, role: str, stmt: A.Update) -> None:
-        """WITH CHECK enforcement for UPDATE: the NEW row must satisfy
-        the policy (PostgreSQL raises when an update rewrites a row out
-        of policy scope).  Assigned-constant columns substitute into the
-        check expression; a fully-constant result enforces directly;
-        assignments that don't touch any check column are safe when the
-        check falls back to USING (the untouched columns already passed
-        it); anything else fails closed."""
-        eff = self._policy_predicate(role, stmt.table, "update",
-                                     kind="check")
-        if eff is None:
-            return
-        from citus_tpu.planner.recursive import (
-            _walk_columns as _walk_ast_columns,
-        )
-        check_cols = {c.name for c in _walk_ast_columns(eff)
-                      if c.table is None}
-        assigned = dict(stmt.assignments)
-        subst = {}
-        for col, val in assigned.items():
-            if col in check_cols:
-                subst[col] = val
-        if subst:
-            checked = _subst_args(eff, subst)
-            remaining = {c.name for c in _walk_ast_columns(checked)}
-            if remaining:
-                raise UnsupportedFeatureError(
-                    "cannot verify row-level security WITH CHECK for this "
-                    "UPDATE (non-constant or mixed-column assignment)")
-            try:
-                ok = _eval_const(checked)
-            except Exception:
-                raise UnsupportedFeatureError(
-                    "cannot verify row-level security WITH CHECK for this "
-                    "UPDATE (non-constant assignment)")
-            if ok is not True:
-                raise AnalysisError(
-                    "new row violates row-level security policy for "
-                    f'table "{stmt.table}"')
-            return
-        # no check column assigned: safe only when check == using (the
-        # unchanged columns already satisfied USING via the row filter)
-        using = self._policy_predicate(role, stmt.table, "update",
-                                       kind="using")
-        if repr(eff) != repr(using):
-            raise UnsupportedFeatureError(
-                "cannot verify row-level security WITH CHECK for this "
-                "UPDATE (policy has a distinct WITH CHECK expression)")
-
-    def _fire_triggers(self, stmt: A.Statement, depth: int = 0) -> None:
-        """Statement-level AFTER triggers: run each matching trigger's
-        function body after a DML statement completes (reference:
-        commands/trigger.c; bodies are stored SQL statements)."""
-        if isinstance(stmt, A.Insert):
-            table, event = stmt.table, "insert"
-        elif isinstance(stmt, A.Update):
-            table, event = stmt.table, "update"
-        elif isinstance(stmt, A.Delete):
-            table, event = stmt.table, "delete"
-        elif isinstance(stmt, A.Merge):
-            # MERGE may insert, update, or delete: fire all three
-            for evt in ("insert", "update", "delete"):
-                self._fire_triggers_for(stmt.target.name, evt, depth)
-            return
-        else:
-            return
-        self._fire_triggers_for(table, event, depth)
-
-    def _fire_triggers_for(self, table: str, event: str, depth: int) -> None:
-        matching = [t for t in self.catalog.triggers.values()
-                    if t["table"] == table and t["event"] == event]
-        if not matching:
-            return
-        if depth >= 8:
-            raise ExecutionError(
-                "trigger recursion limit exceeded (8 levels)")
-        for trig in matching:
-            fn = self.catalog.functions.get(trig["function"])
-            if fn is None:
-                continue
-            for body_stmt in parse_sql(fn["body"]):
-                self._execute_stmt(body_stmt)
-                self._fire_triggers(body_stmt, depth + 1)
-
-    def _check_privileges(self, role: str, stmt: A.Statement) -> None:
-        """Table-level privilege enforcement for a non-superuser role
-        (reference: standard ACLs propagated by commands/grant.c; a
-        missing grant denies).  DDL and utility statements require
-        superuser (role=None)."""
-        from citus_tpu.errors import CatalogError
-        if role not in self.catalog.roles:
-            raise CatalogError(f'role "{role}" does not exist')
-
-        def deny(priv, table):
-            raise CatalogError(
-                f'permission denied for {table}: role "{role}" lacks {priv}')
-
-        def tables_of(item):
-            if isinstance(item, A.TableRef):
-                return [item.name]
-            if isinstance(item, A.SubqueryRef):
-                return stmt_tables(item.select)
-            if isinstance(item, A.Join):
-                return tables_of(item.left) + tables_of(item.right)
-            return []
-
-        def expr_subselects(e):
-            from citus_tpu.planner.recursive import _walk_expr
-            if e is None or not isinstance(e, A.Expr):
-                return []
-            return [n.select for n in _walk_expr(e)]
-
-        def stmt_tables(s):
-            if isinstance(s, A.SetOp):
-                return stmt_tables(s.left) + stmt_tables(s.right)
-            if not isinstance(s, A.Select):
-                return []
-            out = tables_of(s.from_) if s.from_ is not None else []
-            # subqueries anywhere in expressions read tables too
-            exprs = ([i.expr for i in s.items] + [s.where, s.having]
-                     + list(s.group_by) + [o.expr for o in s.order_by])
-            for e in exprs:
-                for sub in expr_subselects(e):
-                    out.extend(stmt_tables(sub))
-            return out
-
-        def check_read(s, skip=frozenset()):
-            for t in stmt_tables(s):
-                if t in skip:
-                    continue  # CTE name, not a real relation
-                if not self.catalog.has_privilege(role, t, "select"):
-                    deny("SELECT", t)
-
-        if isinstance(stmt, (A.Select, A.SetOp)):
-            check_read(stmt)
-        elif isinstance(stmt, A.WithSelect):
-            # a CTE's definition may reference only EARLIER CTE names —
-            # a same-named reference inside its own body resolves to the
-            # real relation and must be privilege-checked as one
-            seen: set = set()
-            for n, sel in stmt.ctes:
-                check_read(sel, skip=frozenset(seen))
-                seen.add(n)
-            check_read(stmt.body, skip=frozenset(seen))
-        elif isinstance(stmt, A.Insert):
-            if not self.catalog.has_privilege(role, stmt.table, "insert"):
-                deny("INSERT", stmt.table)
-            if stmt.on_conflict is not None \
-                    and stmt.on_conflict.action == "update" \
-                    and not self.catalog.has_privilege(role, stmt.table,
-                                                       "update"):
-                # DO UPDATE modifies existing rows (PostgreSQL requires
-                # UPDATE privilege in addition to INSERT)
-                deny("UPDATE", stmt.table)
-            if stmt.select is not None:
-                check_read(stmt.select)
-        elif isinstance(stmt, A.Update):
-            if not self.catalog.has_privilege(role, stmt.table, "update"):
-                deny("UPDATE", stmt.table)
-            for _c, e in stmt.assignments:
-                for sub in expr_subselects(e):
-                    check_read(sub)
-            for sub in expr_subselects(stmt.where):
-                check_read(sub)
-        elif isinstance(stmt, A.Delete):
-            if not self.catalog.has_privilege(role, stmt.table, "delete"):
-                deny("DELETE", stmt.table)
-            for sub in expr_subselects(stmt.where):
-                check_read(sub)
-        elif isinstance(stmt, A.Truncate):
-            for name in (stmt.table,) + tuple(stmt.more):
-                if not self.catalog.has_privilege(role, name, "truncate"):
-                    deny("TRUNCATE", name)
-        elif isinstance(stmt, (A.Prepare, A.ExecutePrepared, A.Deallocate)):
-            # any role may manage prepared statements (PostgreSQL);
-            # EXECUTE re-enters execute() with the same role, which
-            # checks privileges on the underlying statement
-            pass
-        else:
-            from citus_tpu.errors import CatalogError as _CE
-            raise _CE(f'permission denied: role "{role}" cannot run '
-                      f'{type(stmt).__name__} statements')
+    def _check_privileges(self, role, stmt):
+        from citus_tpu.commands.rls import _check_privileges
+        return _check_privileges(self, role, stmt)
 
     def _execute_utility(self, stmt: A.UtilityCall) -> Result:
-        name, args = stmt.name, stmt.args
-        if name == "create_distributed_table":
-            shard_count = int(args[2]) if len(args) > 2 else None
-            self.create_distributed_table(args[0], args[1], shard_count)
-            return Result(columns=[name], rows=[(None,)])
-        if name == "create_reference_table":
-            self.create_reference_table(args[0])
-            return Result(columns=[name], rows=[(None,)])
-        if name == "create_time_partitions":
-            from citus_tpu.partitioning import create_time_partitions
-            n = create_time_partitions(
-                self, args[0], args[1], args[2],
-                args[3] if len(args) > 3 else None)
-            return Result(columns=[name], rows=[(n > 0,)],
-                          explain={"partitions_created": n})
-        if name == "drop_old_time_partitions":
-            from citus_tpu.partitioning import drop_old_time_partitions
-            n = drop_old_time_partitions(self, args[0], args[1])
-            return Result(columns=[name], rows=[(n,)],
-                          explain={"partitions_dropped": n})
-        if name == "time_partitions":
-            # the time_partitions view (reference: a SQL view over
-            # pg_class + partition bounds)
-            rows = []
-            for t in self.catalog.tables.values():
-                if t.partition_of is not None:
-                    rows.append((t.partition_of["parent"], t.name,
-                                 t.partition_of["lo"], t.partition_of["hi"]))
-            return Result(
-                columns=["parent_table", "partition", "from_value",
-                         "to_value"], rows=sorted(rows))
-        if name == "citus_extensions":
-            return Result(columns=["name", "version"],
-                          rows=sorted((k, v.get("version"))
-                                      for k, v in self.catalog.extensions.items()))
-        if name == "citus_domains":
-            return Result(
-                columns=["name", "base_type", "not_null", "check"],
-                rows=sorted((k, v["base"], v["not_null"], v.get("check"))
-                            for k, v in self.catalog.domains.items()))
-        if name == "citus_collations":
-            return Result(columns=["name", "locale", "provider"],
-                          rows=sorted((k, v.get("locale"), v.get("provider"))
-                                      for k, v in self.catalog.collations.items()))
-        if name == "citus_publications":
-            rows = []
-            for k, v in sorted(self.catalog.publications.items()):
-                tl = v.get("tables")
-                rows.append((k, "ALL TABLES" if tl == "all"
-                             else ", ".join(tl)))
-            return Result(columns=["name", "tables"], rows=rows)
-        if name == "citus_statistics_objects":
-            return Result(
-                columns=["name", "table", "columns", "ndistinct"],
-                rows=sorted((k, v["table"], ", ".join(v["columns"]),
-                             v["ndistinct"])
-                            for k, v in self.catalog.statistics.items()))
-        if name == "citus_stat_pool":
-            # shared task-pool admission counters (the
-            # citus.max_shared_pool_size / shared_connection_stats view)
-            from citus_tpu.executor.admission import GLOBAL_POOL
-            st = GLOBAL_POOL.stats()
-            st["pool_size"] = self.settings.executor.max_shared_pool_size
-            cols = ["pool_size", "in_use", "high_water", "granted",
-                    "denied_optional", "waits"]
-            return Result(columns=cols, rows=[tuple(st[c] for c in cols)])
-        if name == "citus_table_size":
-            return Result(columns=["citus_table_size"],
-                          rows=[(self._table_size(args[0]),)])
-        if name == "citus_shard_sizes":
-            import os as _os
-            rows = []
-            for t in self.catalog.tables.values():
-                for s_ in t.shards:
-                    for node in s_.placements:
-                        d = self.catalog.shard_dir(t.name, s_.shard_id, node)
-                        size = sum(_os.path.getsize(_os.path.join(d, f))
-                                   for f in _os.listdir(d)) if _os.path.isdir(d) else 0
-                        rows.append((t.name, s_.shard_id, node, size))
-            return Result(columns=["table_name", "shardid", "node", "size"], rows=rows)
-        if name == "citus_check_cluster_node_health":
-            import os as _os
-            rows = []
-            for nid in self.catalog.active_node_ids():
-                ok = True
-                for t in self.catalog.tables.values():
-                    for s_ in t.shards:
-                        if nid in s_.placements:
-                            d = self.catalog.shard_dir(t.name, s_.shard_id, nid)
-                            if _os.path.isdir(d) and not _os.access(d, _os.R_OK):
-                                ok = False
-                rows.append((nid, ok))
-            return Result(columns=["node", "healthy"], rows=rows)
-        if name == "master_get_active_worker_nodes":
-            return Result(columns=["node_id"],
-                          rows=[(nid,) for nid in self.catalog.active_node_ids()])
-        if name == "citus_add_node":
-            from citus_tpu.catalog.catalog import NodeMeta
-            nid = max(self.catalog.nodes, default=-1) + 1
-            self.catalog.nodes[nid] = NodeMeta(nid)
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=["citus_add_node"], rows=[(nid,)])
-        if name == "citus_remove_node":
-            nid = int(args[0]) if args else None
-            if nid is None or nid not in self.catalog.nodes:
-                raise CatalogError(f"node {nid} does not exist")
-            for t in self.catalog.tables.values():
-                for s in t.shards:
-                    if nid in s.placements:
-                        raise CatalogError(
-                            f"cannot remove node {nid}: it still has shard placements")
-            del self.catalog.nodes[nid]
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            return Result(columns=["citus_remove_node"], rows=[(None,)])
-        if name == "citus_move_shard_placement":
-            from citus_tpu.operations import move_shard_placement
-            move_shard_placement(self.catalog, int(args[0]), int(args[1]),
-                                 int(args[2]), lock_manager=self.locks)
-            self._plan_cache.clear()
-            return Result(columns=[name], rows=[(None,)])
-        if name == "get_rebalance_table_shards_plan":
-            from citus_tpu.operations import get_rebalance_plan
-            moves = get_rebalance_plan(
-                self.catalog, args[0] if args else None,
-                strategy=str(args[1]) if len(args) > 1 else "by_disk_size")
-            return Result(columns=["shardid", "sourcenode", "targetnode"],
-                          rows=[m.to_row() for m in moves])
-        if name == "rebalance_table_shards":
-            from citus_tpu.operations import rebalance_table_shards
-            moves = rebalance_table_shards(
-                self.catalog, args[0] if args else None,
-                strategy=str(args[1]) if len(args) > 1 else "by_disk_size",
-                lock_manager=self.locks)
-            self._plan_cache.clear()
-            return Result(columns=["rebalance_table_shards"],
-                          rows=[(len(moves),)])
-        if name == "citus_rebalance_start":
-            from citus_tpu.operations import get_rebalance_plan
-            moves = get_rebalance_plan(self.catalog)
-            jid = self.background_jobs.create_job("Rebalance all colocation groups")
-            prev = None
-            for m in moves:
-                prev = self.background_jobs.add_task(
-                    jid, "move_shard",
-                    {"shard_id": m.shard_id, "source": m.source_node, "target": m.target_node},
-                    depends_on=[prev] if prev is not None else None,
-                    node=m.target_node)
-            return Result(columns=["citus_rebalance_start"], rows=[(jid,)])
-        if name == "citus_job_wait":
-            status = self.background_jobs.wait_for_job(int(args[0]))
-            self._plan_cache.clear()
-            return Result(columns=["citus_job_wait"], rows=[(status,)])
-        if name == "citus_cleanup_orphaned_resources":
-            from citus_tpu.operations import try_drop_orphaned_resources
-            n = try_drop_orphaned_resources(self.catalog)
-            return Result(columns=["citus_cleanup_orphaned_resources"], rows=[(n,)])
-        if name == "citus_copy_shard_placement":
-            from citus_tpu.operations import copy_shard_placement
-            copy_shard_placement(self.catalog, int(args[0]), int(args[1]), int(args[2]))
-            self._plan_cache.clear()
-            return Result(columns=[name], rows=[(None,)])
-        if name == "citus_split_shard_by_split_points":
-            from citus_tpu.operations.shard_split import split_shard
-            points = [int(a) for a in args[1:] if not isinstance(a, str) or a.lstrip("-").isdigit()]
-            new_ids = split_shard(self.catalog, int(args[0]), points,
-                                  lock_manager=self.locks)
-            self._plan_cache.clear()
-            return Result(columns=["new_shard_ids"], rows=[(i,) for i in new_ids])
-        if name == "isolate_tenant_to_new_shard":
-            # reference: isolate_shards.c — put one distribution-key value
-            # in its own shard by splitting around its hash
-            from citus_tpu.catalog.hashing import hash_int64_scalar, shard_index_for_hash
-            from citus_tpu.operations.shard_split import split_shard
-            import numpy as _np
-            t = self.catalog.table(args[0])
-            h = hash_int64_scalar(int(args[1]))
-            si = int(shard_index_for_hash(_np.array([h], _np.int32), t.shard_count)[0])
-            shard = t.shards[si]
-            points = []
-            if h - 1 >= shard.hash_min:
-                points.append(h - 1)
-            if h < shard.hash_max:
-                points.append(h)
-            new_ids = split_shard(self.catalog, shard.shard_id, points,
-                                  lock_manager=self.locks)
-            self._plan_cache.clear()
-            return Result(columns=["isolate_tenant_to_new_shard"],
-                          rows=[(new_ids[1 if h - 1 >= shard.hash_min else 0],)])
-        if name == "citus_stat_counters":
-            snap = self.counters.snapshot()
-            return Result(columns=["counter", "value"],
-                          rows=sorted(snap.items()))
-        if name == "citus_stat_counters_reset":
-            self.counters.reset()
-            return Result(columns=[name], rows=[(None,)])
-        if name == "citus_stat_statements":
-            return Result(columns=["query", "executor", "partition_key",
-                                   "calls", "total_time_ms", "rows"],
-                          rows=self.query_stats.rows_view())
-        if name == "citus_stat_statements_reset":
-            self.query_stats.reset()
-            return Result(columns=[name], rows=[(None,)])
-        if name == "citus_schemas":
-            rows = []
-            for sname, info in self.catalog.schemas.items():
-                members = [t for t in self.catalog.tables if t.startswith(sname + ".")]
-                size = sum(self._table_size(m) for m in members)
-                rows.append((sname, info["colocation_id"], info["home_node"],
-                             len(members), size))
-            return Result(columns=["schema_name", "colocation_id", "node",
-                                   "table_count", "schema_size"], rows=rows)
-        if name == "citus_stat_tenants":
-            return Result(columns=["tenant", "query_count", "total_time_ms"],
-                          rows=self.tenant_stats.rows_view())
-        if name == "get_rebalance_progress":
-            rows = []
-            if self._background_jobs is not None:
-                with self._background_jobs._lock:
-                    jobs = [j["job_id"] for j in self._background_jobs._state["jobs"]]
-                for jid in jobs:
-                    rows.extend(self._background_jobs.job_progress(jid))
-            return Result(columns=["task_id", "op", "args", "status", "attempts"],
-                          rows=rows)
-        if name == "citus_stat_activity":
-            return Result(columns=["global_pid", "state", "elapsed_s", "query"],
-                          rows=self.activity.rows_view())
-        if name == "citus_locks":
-            return Result(columns=["resource", "session", "mode", "granted"],
-                          rows=self.locks.lock_rows())
-        if name == "citus_lock_waits":
-            graph = self.locks.wait_graph()
-            return Result(columns=["waiting_session", "blocking_session"],
-                          rows=[(w, b) for w, bs in graph.items() for b in sorted(bs)])
-        if name == "citus_shards":
-            rows = []
-            for t in self.catalog.tables.values():
-                for s in t.shards:
-                    for node in s.placements:
-                        rows.append((t.name, s.shard_id, t.method, t.colocation_id,
-                                     node, s.hash_min, s.hash_max))
-            return Result(columns=["table_name", "shardid", "citus_table_type",
-                                   "colocation_id", "nodename", "shardminvalue",
-                                   "shardmaxvalue"], rows=rows)
-        if name == "citus_tables":
-            from citus_tpu.catalog.stats import table_row_count
-            rows = []
-            for t in self.catalog.tables.values():
-                rows.append((t.name, t.method, t.dist_column, t.colocation_id,
-                             self._table_size(t.name), t.shard_count,
-                             table_row_count(self.catalog, t)))
-            return Result(columns=["table_name", "citus_table_type",
-                                   "distribution_column", "colocation_id",
-                                   "table_size", "shard_count", "row_count"],
-                          rows=rows)
-        if name == "undistribute_table":
-            from citus_tpu.operations.alter_table import undistribute_table
-            undistribute_table(self.catalog, args[0], txlog=self.txlog)
-            self._plan_cache.clear()
-            return Result(columns=[name], rows=[(None,)])
-        if name == "alter_distributed_table":
-            from citus_tpu.operations.alter_table import alter_distributed_table
-            kw = {}
-            if len(args) > 1:
-                kw["shard_count"] = int(args[1])
-            if len(args) > 2:
-                kw["distribution_column"] = str(args[2])
-            alter_distributed_table(self.catalog, args[0], txlog=self.txlog, **kw)
-            self._plan_cache.clear()
-            return Result(columns=[name], rows=[(None,)])
-        if name == "citus_get_node_clock":
-            return Result(columns=["citus_get_node_clock"],
-                          rows=[(self.clock.now(),)])
-        if name == "citus_get_transaction_clock":
-            return Result(columns=["citus_get_transaction_clock"],
-                          rows=[(self.clock.transaction_clock(),)])
-        if name == "citus_create_restore_point":
-            from citus_tpu.operations.restore import create_restore_point
-            create_restore_point(self.catalog, str(args[0]))
-            return Result(columns=["citus_create_restore_point"], rows=[(str(args[0]),)])
-        if name == "citus_list_restore_points":
-            from citus_tpu.operations.restore import list_restore_points
-            return Result(columns=["name", "created_at"],
-                          rows=list_restore_points(self.catalog))
-        if name == "nextval":
-            return Result(columns=["nextval"],
-                          rows=[(self.catalog.nextval(str(args[0])),)])
-        if name == "currval":
-            return Result(columns=["currval"],
-                          rows=[(self.catalog.currval(str(args[0])),)])
-        if name == "setval":
-            v = self.catalog.setval(str(args[0]), int(args[1]))
-            return Result(columns=["setval"], rows=[(v,)])
-        if name == "citus_cdc_events":
-            # consumer API: changes for a table after an LSN (reference:
-            # the decoder stream a subscriber reads)
-            table = str(args[0])
-            from_lsn = int(args[1]) if len(args) > 1 else 0
-            rows = [(e["lsn"], e["op"], e.get("count"),
-                     json.dumps(e.get("rows")) if e.get("rows") else None)
-                    for e in self.cdc.events(table, from_lsn)]
-            return Result(columns=["lsn", "op", "count", "rows"], rows=rows)
-        if name == "citus_roles":
-            return Result(columns=["role_name"],
-                          rows=[(r,) for r in sorted(self.catalog.roles)])
-        if name == "citus_grants":
-            rows = []
-            for tbl, by_role in sorted(self.catalog.grants.items()):
-                for r, privs in sorted(by_role.items()):
-                    rows.append((tbl, r, ",".join(privs)))
-            return Result(columns=["table_name", "role_name", "privileges"],
-                          rows=rows)
-        if name == "get_shard_id_for_distribution_column":
-            from citus_tpu.catalog.hashing import hash_int64_scalar, shard_index_for_hash
-            import numpy as _np
-            t2 = self.catalog.table(str(args[0]))
-            if not t2.is_distributed:
-                return Result(columns=[name], rows=[(t2.shards[0].shard_id,)])
-            h = hash_int64_scalar(int(args[1]))
-            si = int(shard_index_for_hash(_np.array([h], _np.int32),
-                                          t2.shard_count)[0])
-            return Result(columns=[name], rows=[(t2.shards[si].shard_id,)])
-        if name in ("citus_relation_size", "citus_total_relation_size"):
-            return Result(columns=[name],
-                          rows=[(self._table_size(str(args[0])),)])
-        if name == "citus_disable_node":
-            nid = int(args[0])
-            if nid not in self.catalog.nodes:
-                raise CatalogError(f"node {nid} does not exist")
-            self.catalog.nodes[nid].is_active = False
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            self._plan_cache.clear()
-            return Result(columns=[name], rows=[(None,)])
-        if name == "citus_activate_node":
-            nid = int(args[0])
-            if nid not in self.catalog.nodes:
-                raise CatalogError(f"node {nid} does not exist")
-            self.catalog.nodes[nid].is_active = True
-            self.catalog.ddl_epoch += 1
-            self.catalog.commit()
-            self._plan_cache.clear()
-            return Result(columns=[name], rows=[(nid,)])
-        if name == "citus_get_active_worker_nodes":
-            return Result(columns=["node_id"],
-                          rows=[(n,) for n in self.catalog.active_node_ids()])
-        if name == "citus_version":
-            from citus_tpu.version import __version__ as _v
-            return Result(columns=["citus_version"],
-                          rows=[(f"citus_tpu {_v} (capability parity target: "
-                                 "Citus 15.0devel)",)])
-        if name == "citus_dist_stat_activity":
-            return Result(columns=["global_pid", "state", "elapsed_s", "query"],
-                          rows=self.activity.rows_view())
-        if name == "citus_types":
-            return Result(columns=["type_name", "labels"],
-                          rows=[(n, ",".join(ls)) for n, ls in
-                                sorted(self.catalog.types.items())])
-        if name == "citus_policies":
-            rows = []
-            for tbl in sorted(self.catalog.policies):
-                for p in self.catalog.policies[tbl]:
-                    rows.append((tbl, p["name"], p["cmd"],
-                                 ",".join(p["roles"]), p.get("using"),
-                                 p.get("check")))
-            return Result(columns=["table_name", "policy_name", "cmd",
-                                   "roles", "using_expr", "check_expr"],
-                          rows=rows)
-        if name == "citus_triggers":
-            return Result(
-                columns=["trigger_name", "table_name", "event", "function"],
-                rows=[(n, t["table"], t["event"], t["function"])
-                      for n, t in sorted(self.catalog.triggers.items())])
-        if name == "citus_text_search_configs":
-            return Result(
-                columns=["config_name", "parser"],
-                rows=[(n, c.get("parser", "default"))
-                      for n, c in sorted(self.catalog.ts_configs.items())])
-        if name == "citus_views":
-            return Result(columns=["view_name", "definition"],
-                          rows=sorted(self.catalog.views.items()))
-        if name == "citus_sequences":
-            rows = [(n, s["value"], s["increment"], s["start"])
-                    for n, s in sorted(self.catalog.sequences.items())]
-            return Result(columns=["sequence_name", "next_block_start",
-                                   "increment", "start"], rows=rows)
-        if name == "recover_prepared_transactions":
-            from citus_tpu.transaction.recovery import recover_transactions
-            st = recover_transactions(self.catalog, self.txlog,
-                                      peer_inflight=self._peer_inflight())
-            return Result(columns=["recover_prepared_transactions"],
-                          rows=[(st["rolled_forward"] + st["rolled_back"],)])
-        if name == "run_command_on_workers":
-            # reference: operations/citus_tools.c run_command_on_workers —
-            # one row per node.  Nodes here share one engine, so the
-            # command runs ONCE and the result row replicates per node
-            # (running it N times would also repeat side effects)
-            try:
-                r = self.execute(str(args[0]))
-                cell = r.rows[0][0] if r.rows and r.rows[0] else ""
-                ok, res = True, str(cell)
-            except Exception as exc:
-                ok, res = False, str(exc)
-            rows = [(nid, ok, res)
-                    for nid in sorted(self.catalog.active_node_ids())]
-            return Result(columns=["nodeid", "success", "result"], rows=rows)
-        if name in ("run_command_on_shards", "run_command_on_placements"):
-            return self._run_command_on_shards(
-                str(args[0]), str(args[1]),
-                per_placement=(name == "run_command_on_placements"))
-        if name == "master_get_table_ddl_events":
-            return Result(columns=["master_get_table_ddl_events"],
-                          rows=[(d,) for d in self._table_ddl(str(args[0]))])
-        if name == "citus_backend_gpid":
-            import threading as _threading
-            return Result(columns=["citus_backend_gpid"],
-                          rows=[(_threading.get_ident(),)])
-        if name == "citus_coordinator_nodeid":
-            nids = sorted(self.catalog.active_node_ids())
-            return Result(columns=["citus_coordinator_nodeid"],
-                          rows=[(nids[0] if nids else 0,)])
-        raise UnsupportedFeatureError(f"utility {name}() not supported yet")
+        """UDF-style admin calls, dispatched through the commands
+        registry (reference: sql/udfs/ entry points; see
+        commands/utility.py)."""
+        from citus_tpu.commands.utility import execute_utility
+        return execute_utility(self, stmt)
 
-    def _run_command_on_shards(self, table_name: str, command: str,
-                               per_placement: bool = False) -> Result:
-        """reference: citus_tools.c run_command_on_shards/_placements —
-        the %s placeholder becomes the shard; here the command is a
-        SELECT template executed with the plan restricted to one shard
-        (the shard-suffix-name trick has no meaning without SQL-visible
-        shard relations)."""
-        import dataclasses as _dc
+    def _run_command_on_shards(self, table_name, command,
+                               per_placement: bool = False):
+        from citus_tpu.commands.shard_cmds import _run_command_on_shards
+        return _run_command_on_shards(self, table_name, command,
+                                      per_placement=per_placement)
 
-        from citus_tpu.planner.physical import plan_select
-        t = self.catalog.table(table_name)
-        sql = command.replace("%s", table_name)
-        stmt = parse_sql(sql)[0]
-        if not isinstance(stmt, A.Select):
-            raise UnsupportedFeatureError(
-                "run_command_on_shards supports SELECT commands")
-        if not (isinstance(stmt.from_, A.TableRef)
-                and stmt.from_.name == t.name):
-            raise AnalysisError(
-                "run_command_on_shards command must read the named table "
-                "(use %s as the relation)")
-        bound = bind_select(self.catalog, stmt)
-        plan = plan_select(self.catalog, bound,
-                           direct_limit=self.settings.planner.direct_gid_limit)
-        rows = []
-        # one row per shard of the table (reference behavior), even when
-        # the command's WHERE clause would prune some shards
-        for si in range(len(t.shards)):
-            shard = t.shards[si]
-            targets = shard.placements if per_placement else [None]
-            for node in targets:
-                try:
-                    sp = _dc.replace(plan, shard_indexes=[si])
-                    r = execute_select(self.catalog, bound, self.settings,
-                                       plan=sp)
-                    cell = r.rows[0][0] if r.rows and r.rows[0] else ""
-                    row = (shard.shard_id, True, str(cell))
-                except Exception as exc:
-                    row = (shard.shard_id, False, str(exc))
-                if per_placement:
-                    row = (row[0], node) + row[1:]
-                rows.append(row)
-        cols = ["shardid", "nodeid", "success", "result"] if per_placement \
-            else ["shardid", "success", "result"]
-        return Result(columns=cols, rows=rows)
+    def _table_ddl(self, name):
+        from citus_tpu.commands.shard_cmds import _table_ddl
+        return _table_ddl(self, name)
 
-    def _table_ddl(self, name: str) -> list[str]:
-        """Reconstruct the DDL statements that recreate a table
-        (reference: master_get_table_ddl_events,
-        operations/node_protocol.c)."""
-        t = self.catalog.table(name)
-        sql_names = {"bool": "boolean", "int16": "smallint", "int32": "int",
-                     "int64": "bigint", "float32": "real",
-                     "float64": "double", "date": "date",
-                     "timestamp": "timestamp", "text": "text"}
-        cols = []
-        for c in t.schema:
-            enum_t = self.catalog.enum_columns.get(f"{name}.{c.name}")
-            tn = enum_t if enum_t else sql_names.get(c.type.kind, str(c.type))
-            if c.type.is_decimal:
-                tn = str(c.type)  # decimal(p,s) spells itself
-            cols.append(f"{c.name} {tn}"
-                        + (" NOT NULL" if c.not_null else ""))
-        for fk in t.foreign_keys:
-            action = "" if fk["on_delete"] == "restrict" \
-                else f" ON DELETE {fk['on_delete'].upper()}"
-            cols.append(
-                f"FOREIGN KEY ({', '.join(fk['columns'])}) REFERENCES "
-                f"{fk['ref_table']} ({', '.join(fk['ref_columns'])})"
-                + action)
-        out = [f"CREATE TABLE {name} ({', '.join(cols)})"]
-        if t.is_distributed:
-            out.append(f"SELECT create_distributed_table('{name}', "
-                       f"'{t.dist_column}', {t.shard_count})")
-        elif t.is_reference:
-            out.append(f"SELECT create_reference_table('{name}')")
-        return out
 
     def _table_size(self, name: str) -> int:
         import os
@@ -5126,135 +2294,6 @@ class Cluster:
         with jax.profiler.trace(trace_dir):
             return self.execute(sql)
 
-    def _execute_explain(self, stmt: A.Explain) -> Result:
-        if isinstance(stmt.statement, A.SetOp):
-            so = stmt.statement
-            lines = [f"Set Operation: {so.op.upper()}{' ALL' if so.all else ''}"]
-            for side, sub in (("left", so.left), ("right", so.right)):
-                r = self._execute_explain(A.Explain(sub, analyze=stmt.analyze))
-                lines.append(f"  -> {side}:")
-                lines.extend("     " + row[0] for row in r.rows)
-            return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
-        if isinstance(stmt.statement, A.Insert) \
-                and stmt.statement.select is not None:
-            ins = stmt.statement
-            t = self.catalog.table(ins.table)
-            names = list(ins.columns or t.schema.names)
-            strategy = "pull"
-            sel = ins.select
-            if isinstance(sel, A.Select) and isinstance(sel.from_, A.TableRef) \
-                    and not (sel.group_by or sel.having or sel.order_by
-                             or sel.limit or sel.distinct):
-                try:
-                    bound = bind_select(self.catalog, sel)
-                    if not bound.has_aggs and len(bound.final_exprs) == len(names):
-                        strategy = self._insert_select_strategy(
-                            t, bound, list(bound.final_exprs), names)
-                except Exception:
-                    pass
-            lines = [f"Insert into {ins.table} ({', '.join(names)})",
-                     f"  Strategy: {strategy}"
-                     + {"colocated": "  (per-shard pushdown, no re-hash)",
-                        "repartition": "  (array-streaming re-hash)",
-                        "pull": "  (coordinator row materialization)"}[strategy]]
-            if isinstance(sel, (A.Select, A.SetOp)):
-                sub = self._execute_explain(A.Explain(sel, analyze=False))
-                lines.append("  -> source:")
-                lines.extend("     " + row[0] for row in sub.rows)
-            return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
-        if not isinstance(stmt.statement, A.Select):
-            raise UnsupportedFeatureError(
-                "EXPLAIN supports SELECT, set operations, and INSERT..SELECT")
-        sel = stmt.statement
-        if len(sel.group_by) == 1 and isinstance(sel.group_by[0],
-                                                 A.GroupingSetsSpec):
-            spec = sel.group_by[0]
-            full = max(spec.sets, key=len)
-            lines = [f"Grouping Sets: {len(spec.sets)} grouped executions"]
-            inner = A.Select(
-                [i for i in sel.items
-                 if not (isinstance(i.expr, A.FuncCall)
-                         and i.expr.name == "grouping")],
-                sel.from_, sel.where, list(full))
-            sub = self._execute_explain(A.Explain(inner, analyze=stmt.analyze))
-            lines.extend("  " + row[0] for row in sub.rows)
-            return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
-        if isinstance(stmt.statement.from_, A.Join):
-            return self._explain_join(stmt)
-        sel0 = stmt.statement
-        if isinstance(sel0.from_, A.TableRef) \
-                and self.catalog.has_table(sel0.from_.name) \
-                and self.catalog.table(sel0.from_.name).is_partitioned:
-            from citus_tpu.partitioning import prune_partitions
-            pt = self.catalog.table(sel0.from_.name)
-            parts = self.catalog.partitions_of(pt.name)
-            surv = prune_partitions(self.catalog, pt, sel0.where)
-            lines = [f"Append on {pt.name} "
-                     f"(partitions: {len(surv)}/{len(parts)})"]
-            if surv:
-                import dataclasses as _dc
-                rep = _dc.replace(sel0, from_=A.TableRef(
-                    surv[0].name, sel0.from_.alias or pt.name))
-                sub = self._execute_explain(A.Explain(rep, analyze=False))
-                lines.append(f"  Partitions Shown: One of {len(surv)}")
-                lines.extend("  " + r[0] for r in sub.rows)
-            return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
-        bound = bind_select(self.catalog, stmt.statement)
-        from citus_tpu.planner.physical import plan_select
-        plan = plan_select(self.catalog, bound,
-                           direct_limit=self.settings.planner.direct_gid_limit)
-        t = bound.table
-        lines = []
-        kind = ("Router" if plan.is_router else "Distributed") if t.is_distributed else "Local"
-        lines.append(f"{kind} Scan on {t.name} "
-                     f"(shards: {len(plan.shard_indexes)}/{t.shard_count})")
-        if plan.index_eq is not None:
-            icol, ival, iname = plan.index_eq
-            if t.schema.column(icol).type.is_text:
-                # literal was bound to its dictionary id; show the string
-                decoded = self.catalog.decode_strings(t.name, icol, [int(ival)])
-                ival = decoded[0] if decoded else ival
-            lines.append(f"  Index Lookup: {icol} = {ival!r} using {iname}")
-        if plan.intervals:
-            lines.append("  Chunk Pruning: " +
-                         ", ".join(sorted({c.column for c in plan.intervals})))
-        if bound.has_aggs:
-            mode = plan.group_mode
-            desc = {"scalar": "Global Aggregate",
-                    "direct": f"Direct GroupBy (groups: {mode.n_groups}, combine: psum)",
-                    "hash_host": "Hash GroupBy (host combine)"}[mode.kind]
-            lines.append(f"  Partial Aggregate per shard -> {desc}")
-            lines.append(f"    Partials: " + ", ".join(
-                f"{op.kind}[{op.dtype}]" for op in plan.partial_ops))
-        if stmt.analyze:
-            r = execute_select(self.catalog, bound, self.settings)
-            lines.append(f"  Rows: {r.rowcount}  Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
-            tasks = r.explain.get("tasks") or []
-            if tasks:
-                lines.append(f"  Tasks: {len(tasks)}  Tasks Shown: One of {len(tasks)}")
-                si, nrows, dt = tasks[0]
-                lines.append(f"    -> Task (shard index {si}): {nrows} rows, "
-                             f"{dt*1000:.2f} ms device dispatch")
-        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
-
-    def _explain_join(self, stmt: A.Explain) -> Result:
-        from citus_tpu.executor.join_executor import execute_join_select
-        from citus_tpu.planner.join_planner import bind_join_select
-        bj = bind_join_select(self.catalog, stmt.statement)
-        lines = [f"Join ({bj.strategy}) over {len(bj.rels)} relations"]
-        for s_ in bj.steps:
-            keys = ", ".join(f"{l} = {r}" for l, r in
-                             zip(s_.left_keys, s_.right_keys)) or "(cross)"
-            lines.append(f"  {s_.kind.upper()} JOIN {s_.right_alias} ON {keys}")
-        for alias, _t in bj.rels:
-            rp = bj.rel_plans[alias]
-            f = f" filter: {rp.filter}" if rp.filter is not None else ""
-            lines.append(f"  Scan {alias} [{', '.join(rp.columns)}]{f}")
-        if bj.has_aggs:
-            lines.append(f"  GroupBy keys={len(bj.group_keys)} "
-                         f"partials={len(bj.partial_ops)} (host combine)")
-        if stmt.analyze:
-            r = execute_join_select(self.catalog, bj, self.settings)
-            lines.append(f"  Rows: {r.rowcount}  Tasks: {r.explain['tasks']}  "
-                         f"Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
-        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+    def _execute_explain(self, stmt):
+        from citus_tpu.commands.explain import _execute_explain
+        return _execute_explain(self, stmt)

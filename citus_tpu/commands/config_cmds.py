@@ -1,0 +1,232 @@
+"""SET/SHOW (GUC analog), ANALYZE, REINDEX, RETURNING evaluation, and
+extended-statistics ndistinct computation.
+
+Reference: the ~139 citus.* GUCs (shared_library_init.c:980+) with PG
+unit parsing and transactional SET rollback; commands/vacuum.c ANALYZE;
+commands/index.c REINDEX.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from citus_tpu.errors import CatalogError
+from citus_tpu.executor import Result
+from citus_tpu.planner import ast as A
+
+from citus_tpu.cluster import _eval_const, _expand_returning_items  # noqa: E402
+
+
+def _compute_ndistinct(cl, table: str, columns: list) -> int:
+    """count(DISTINCT (cols)) — the extended-statistics ndistinct."""
+    sel = A.Select(
+        [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+        A.SubqueryRef(A.Select(
+            [A.SelectItem(A.ColumnRef(c)) for c in columns],
+            A.TableRef(table), distinct=True), "d"))
+    return int(cl._execute_stmt(sel).rows[0][0])
+
+#: SET/SHOW surface: GUC name -> (settings section, field, coercion)
+#: (reference: the citus.* GUCs, shared_library_init.c:980+).
+#: Settings apply to this Cluster handle (every session of it).
+_GUCS = {
+    "citus.task_executor_backend": ("executor", "task_executor_backend", str),
+    "citus.max_shared_pool_size": ("executor", "max_shared_pool_size", int),
+    "citus.max_adaptive_executor_pool_size": ("executor", "max_tasks_in_flight", int),
+    "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
+    "citus.use_pallas_scan": ("executor", "use_pallas_scan", "bool"),
+    "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
+    "citus.shard_count": ("sharding", "shard_count", int),
+    "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
+    "citus.enable_change_data_capture": (None, "enable_change_data_capture", "bool"),
+    "citus.distributed_deadlock_detection_interval": (None, "deadlock_detection_interval_s", float),
+    # PostgreSQL spelling: bare numbers are MILLISECONDS; unit
+    # suffixes ('3s', '500ms') accepted
+    "lock_timeout": ("executor", "lock_timeout_s", "ms_duration"),
+}
+
+def _guc_key(cl, name: str) -> str:
+    name = name.lower()
+    if name in _GUCS:
+        return name
+    if f"citus.{name}" in _GUCS:
+        return f"citus.{name}"
+    raise CatalogError(f'unrecognized configuration parameter "{name}"')
+
+def _execute_set(cl, stmt: A.SetConfig) -> Result:
+    import dataclasses as _dc
+    key = _guc_key(cl, stmt.name)
+    section, field_, coerce = _GUCS[key]
+    v = stmt.value
+    if coerce == "bool":
+        if not isinstance(v, bool):
+            s = str(v).lower()
+            if s in ("true", "on", "1", "yes"):
+                v = True
+            elif s in ("false", "off", "0", "no"):
+                v = False
+            else:
+                raise CatalogError(
+                    f'parameter "{stmt.name}" requires a Boolean '
+                    f"value (got {stmt.value!r})")
+    elif coerce == "secondary":
+        # PostgreSQL spelling: citus.use_secondary_nodes = always|never
+        if isinstance(v, bool):
+            pass
+        elif str(v).lower() in ("always", "never"):
+            v = str(v).lower() == "always"
+        else:
+            raise CatalogError(
+                f'invalid value for parameter "{stmt.name}": '
+                f"{stmt.value!r} (expected always or never)")
+    elif coerce == "ms_duration":
+        # bare numbers are milliseconds (PostgreSQL); 's'/'ms'
+        # suffixes accepted
+        s = str(v).strip().lower()
+        try:
+            if s.endswith("ms"):
+                v = float(s[:-2]) / 1000.0
+            elif s.endswith("s"):
+                v = float(s[:-1])
+            else:
+                v = float(s) / 1000.0
+        except ValueError:
+            raise CatalogError(
+                f'invalid value for parameter "{stmt.name}": '
+                f"{stmt.value!r}")
+    else:
+        try:
+            v = coerce(v)
+        except (TypeError, ValueError):
+            raise CatalogError(
+                f'invalid value for parameter "{stmt.name}": {stmt.value!r}')
+    from citus_tpu.storage.overlay import current_overlay
+    txn = current_overlay()
+    if txn is not None:
+        # PostgreSQL: a non-LOCAL SET is undone if the transaction
+        # aborts
+        prev_settings, prev_cdc = cl.settings, cl.cdc.enabled
+
+        def _restore(prev_settings=prev_settings, prev_cdc=prev_cdc):
+            cl.settings = prev_settings
+            cl.cdc.enabled = prev_cdc
+            cl._plan_cache.clear()
+        txn.on_rollback.append(_restore)
+    if section is None:
+        cl.settings = _dc.replace(cl.settings, **{field_: v})
+    else:
+        sec = _dc.replace(getattr(cl.settings, section), **{field_: v})
+        cl.settings = _dc.replace(cl.settings, **{section: sec})
+    if key == "citus.enable_change_data_capture":
+        cl.cdc.enabled = bool(v)
+    cl._plan_cache.clear()  # backend/knob changes invalidate plans
+    return Result(columns=[], rows=[])
+
+def _guc_value(cl, key: str) -> str:
+    section, field_, coerce = _GUCS[key]
+    v = getattr(cl.settings, field_) if section is None \
+        else getattr(getattr(cl.settings, section), field_)
+    if coerce == "secondary":
+        return "always" if v else "never"
+    if isinstance(v, bool):
+        return "on" if v else "off"  # PostgreSQL boolean rendering
+    if coerce == "ms_duration":
+        return f"{v * 1000:g}ms"
+    return str(v)
+
+def _execute_show(cl, stmt: A.ShowConfig) -> Result:
+    if stmt.name == "all":
+        rows = [(k, _guc_value(cl, k)) for k in sorted(_GUCS)]
+        return Result(columns=["name", "setting"], rows=rows)
+    key = _guc_key(cl, stmt.name)
+    return Result(columns=[stmt.name], rows=[(_guc_value(cl, key),)])
+
+def _execute_analyze(cl, table: Optional[str]) -> Result:
+    """ANALYZE [table]: recompute extended-statistics ndistinct
+    (column min/max stats are always skip-list-live here, so there
+    is no per-column histogram pass to run)."""
+    if table is not None:
+        cl.catalog.table(table)  # PostgreSQL: unknown relation errors
+    refreshed = 0
+    for name, st in cl.catalog.statistics.items():
+        if table is not None and st["table"] != table:
+            continue
+        if not cl.catalog.has_table(st["table"]):
+            continue
+        st["ndistinct"] = _compute_ndistinct(cl, st["table"],
+                                                  st["columns"])
+        refreshed += 1
+    if refreshed:
+        cl.catalog.commit()
+    return Result(columns=[], rows=[],
+                  explain={"statistics_refreshed": refreshed})
+
+def _execute_reindex(cl, stmt: A.Reindex) -> Result:
+    """REINDEX INDEX name | REINDEX TABLE name: rebuild segment
+    files from the stripe data (recovers from lost/corrupted
+    segments; a missing segment is only a slow path, never wrong)."""
+    from citus_tpu.storage.index import backfill_index
+    from citus_tpu.transaction.locks import EXCLUSIVE
+    if stmt.kind == "index":
+        t, ix = cl._find_index(stmt.name)
+        if ix is None:
+            raise CatalogError(f'index "{stmt.name}" does not exist')
+        targets = [(t, [ix["column"]])]
+    else:
+        t = cl.catalog.table(stmt.name)
+        if t.is_partitioned:
+            targets = [(p, p.index_columns)
+                       for p in cl.catalog.partitions_of(t.name)
+                       if p.indexes]
+        else:
+            targets = [(t, t.index_columns)] if t.indexes else []
+    rebuilt = 0
+    for tt, cols in targets:
+        with cl._write_lock(tt, EXCLUSIVE):
+            for col in cols:
+                cl._drop_index_segments(tt, col)
+            rebuilt += backfill_index(cl.catalog, tt, list(cols))
+            tt.version += 1
+    if targets:
+        cl.catalog.ddl_epoch += 1
+        cl.catalog.commit()
+        cl._plan_cache.clear()
+    return Result(columns=[], rows=[],
+                  explain={"segments_rebuilt": rebuilt})
+
+def _returning_result(cl, table_name, where, items, subst=None):
+    """Evaluate a RETURNING clause as a distributed SELECT over the
+    affected rows (pre-image WHERE); for UPDATE, assignment
+    expressions are substituted into the items so the NEW values are
+    returned (reference: adaptive_executor.c DML RETURNING tuples)."""
+    t = cl.catalog.table(table_name)
+    expanded = _expand_returning_items(t, items, subst)
+    # constant items (e.g. SET c = 'z' substituted into RETURNING c)
+    # cannot ride the distributed select: fold them on the host and
+    # splice one copy per affected row
+    consts, sel_items = {}, []
+    for idx, (e, alias) in enumerate(expanded):
+        try:
+            consts[idx] = _eval_const(e)
+        except Exception:
+            sel_items.append((idx, A.SelectItem(e, alias)))
+    if sel_items:
+        inner = cl._execute_stmt(A.Select(
+            [si for _, si in sel_items], A.TableRef(table_name), where))
+        nrows, inner_rows = len(inner.rows), inner.rows
+    else:
+        cnt = A.Select([A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+                       A.TableRef(table_name), where)
+        nrows = int(cl._execute_stmt(cnt).rows[0][0] or 0)
+        inner_rows = [()] * nrows
+    rows = []
+    for r in inner_rows:
+        full, j = [None] * len(expanded), 0
+        for idx in range(len(expanded)):
+            if idx in consts:
+                full[idx] = consts[idx]
+            else:
+                full[idx] = r[j]
+                j += 1
+        rows.append(tuple(full))
+    return Result(columns=[a for _, a in expanded], rows=rows)

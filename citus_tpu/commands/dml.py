@@ -1,0 +1,281 @@
+"""DML statement handlers: INSERT, COPY, DELETE, UPDATE, MERGE,
+TRUNCATE, VACUUM [ANALYZE], plus config/utility statement dispatch.
+
+Reference: the modify paths of multi_router_planner.c
+(CreateModifyPlan), commands/multi_copy.c, merge_planner/executor, and
+commands/truncate.c / vacuum.c; here each handler drives the columnar
+DML executors (executor/dml.py, executor/merge_executor.py) under the
+colocation-group write-lock protocol.
+"""
+
+from __future__ import annotations
+
+from citus_tpu.commands.registry import handles
+from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
+from citus_tpu.executor import Result
+from citus_tpu.planner import ast as A
+
+
+@handles(A.Insert)
+def insert(cl, stmt):
+    return cl._execute_insert(stmt)
+
+
+@handles(A.CopyTo)
+def copy_to(cl, stmt):
+    from citus_tpu.cluster import _option_bool
+    n = cl.copy_to_csv(
+        stmt.table, stmt.path,
+        delimiter=stmt.options.get("delimiter", ","),
+        header=_option_bool(stmt.options.get("header", "false")),
+        null_string=stmt.options.get("null", ""))
+    return Result(columns=[], rows=[], explain={"copied": n})
+
+
+@handles(A.CopyQueryTo)
+def copy_query_to(cl, stmt):
+    from citus_tpu.cluster import _option_bool
+    r = cl._execute_stmt(stmt.select)
+    nulls = stmt.options.get("null", "")
+    with open(stmt.path, "w", newline="") as fh:
+        w = cl._open_csv_writer(
+            fh, r.columns,
+            delimiter=stmt.options.get("delimiter", ","),
+            header=_option_bool(stmt.options.get("header", "false")))
+        for row in r.rows:
+            w.writerow([nulls if v is None else v for v in row])
+    return Result(columns=[], rows=[], explain={"copied": len(r.rows)})
+
+
+@handles(A.CopyFrom)
+def copy_from(cl, stmt):
+    from citus_tpu.cluster import _option_bool
+    n = cl.copy_from_csv(
+        stmt.table, stmt.path,
+        delimiter=stmt.options.get("delimiter", ","),
+        header=_option_bool(stmt.options.get("header", "false")),
+        null_string=stmt.options.get("null", ""))
+    return Result(columns=[], rows=[], explain={"copied": n})
+
+
+@handles(A.Delete)
+def delete(cl, stmt):
+    from citus_tpu.executor.dml import execute_delete
+    from citus_tpu.planner.bind import Binder
+    from citus_tpu.transaction.locks import EXCLUSIVE
+    t = cl.catalog.table(stmt.table)
+    if t.is_partitioned:
+        return cl._partition_dml(stmt, t)
+    where = Binder(cl.catalog, t).bind_scalar(stmt.where) \
+        if stmt.where is not None else None
+    with cl._write_lock(t, EXCLUSIVE):
+        if cl.catalog.referencing_fks(stmt.table):
+            # RESTRICT / CASCADE / SET NULL on referencing tables
+            # before the parent rows disappear
+            from citus_tpu.integrity import on_parent_delete
+            on_parent_delete(cl, stmt.table, stmt.where)
+        # RETURNING reads the pre-image under the same lock so the rows
+        # returned are exactly the rows deleted
+        ret = cl._returning_result(stmt.table, stmt.where,
+                                   stmt.returning) \
+            if stmt.returning else None
+        t = cl.catalog.table(stmt.table)  # re-fetch: fresh placements
+        from citus_tpu.storage.overlay import current_overlay
+        n = execute_delete(cl.catalog, cl.txlog, t, where,
+                           txn=current_overlay())
+    cl._plan_cache.clear()
+    if cl._cdc_captures(t.name) and n:
+        cl._emit_cdc(t.name, "delete", count=n)
+    if ret is not None:
+        ret.explain["deleted"] = n
+        return ret
+    return Result(columns=[], rows=[], explain={"deleted": n})
+
+
+@handles(A.Update)
+def update(cl, stmt):
+    from citus_tpu.executor.dml import execute_update
+    from citus_tpu.planner.bind import Binder
+    from citus_tpu.planner.bound import BCast, BLiteral
+    from citus_tpu.transaction.locks import EXCLUSIVE
+    t = cl.catalog.table(stmt.table)
+    if t.is_partitioned:
+        return cl._partition_dml(stmt, t)
+    b = Binder(cl.catalog, t)
+    assignments = []
+    for col, e in stmt.assignments:
+        target = t.schema.column(col)
+        bound = b.bind_scalar(e)
+        if target.type.is_text:
+            if isinstance(bound, BLiteral) and isinstance(bound.value, str):
+                did = cl.catalog.encode_strings(t.name, col, [bound.value])[0]
+                bound = BLiteral(int(did), target.type)
+            elif not bound.type.is_text:
+                raise AnalysisError(
+                    f"cannot assign {bound.type} to {col} ({target.type})")
+        elif bound.type.is_text:
+            raise AnalysisError(
+                f"cannot assign text to {col} ({target.type})")
+        elif bound.type != target.type:
+            bound = BCast(bound, target.type)
+        assignments.append((col, bound))
+    where = b.bind_scalar(stmt.where) if stmt.where is not None else None
+    with cl._write_lock(t, EXCLUSIVE):
+        assigned_cols = {c for c, _e in stmt.assignments}
+        if cl.catalog.referencing_fks(stmt.table):
+            from citus_tpu.integrity import on_parent_update
+            on_parent_update(cl, stmt.table, assigned_cols,
+                             stmt.where, stmt.assignments)
+        if t.foreign_keys:
+            from citus_tpu.integrity import check_child_update
+            check_child_update(cl, t, stmt.assignments)
+        ret = None
+        if stmt.returning:
+            # new values = assignments substituted into the items,
+            # evaluated over the pre-image under the same lock
+            subst = {}
+            for col, e in stmt.assignments:
+                subst[A.ColumnRef(col)] = e
+                subst[A.ColumnRef(col, stmt.table)] = e
+            ret = cl._returning_result(stmt.table, stmt.where,
+                                       stmt.returning, subst)
+        t = cl.catalog.table(stmt.table)  # re-fetch: fresh placements
+        from citus_tpu.storage.overlay import current_overlay
+        assigned = {c for c, _e in stmt.assignments}
+        checks = []
+        if any(c in assigned
+               for c, _dn, _d in cl._domain_columns_of(t)):
+            checks.append(
+                lambda v, m: cl._check_domains_physical(t, v, m))
+        if t.partition_of is not None:
+            from citus_tpu.partitioning import check_partition_bounds
+            checks.append(
+                lambda v, m: check_partition_bounds(cl.catalog, t, v, m))
+        check = None
+        if checks:
+            check = lambda v, m: [c(v, m) for c in checks]  # noqa: E731
+        n = execute_update(cl.catalog, cl.txlog, t, assignments,
+                           where, txn=current_overlay(), check=check)
+    cl._plan_cache.clear()
+    if cl._cdc_captures(t.name) and n:
+        cl._emit_cdc(t.name, "update", count=n)
+    if ret is not None:
+        ret.explain["updated"] = n
+        return ret
+    return Result(columns=[], rows=[], explain={"updated": n})
+
+
+@handles(A.Merge)
+def merge(cl, stmt):
+    from citus_tpu.executor.merge_executor import execute_merge
+    from citus_tpu.transaction.locks import EXCLUSIVE
+    _mt = cl.catalog.table(stmt.target.name)
+    if _mt.foreign_keys or cl.catalog.referencing_fks(_mt.name):
+        # the merge executor writes through the storage layer directly;
+        # fail closed rather than bypass FK enforcement
+        raise UnsupportedFeatureError(
+            "MERGE on tables with foreign key constraints is not "
+            "supported")
+    # unique indexes are enforced inside execute_merge (pre-commit
+    # delete-aware probe); FK targets stay refused above
+    with cl._write_lock(cl.catalog.table(stmt.target.name), EXCLUSIVE):
+        st = execute_merge(
+            cl.catalog, cl.txlog, stmt,
+            encode_value=lambda tbl, col, v:
+                int(cl.catalog.encode_strings(tbl, col, [v])[0]))
+    cl._plan_cache.clear()
+    if cl._cdc_captures(stmt.target.name):
+        cl.cdc.emit(stmt.target.name, "merge",
+                    cl.clock.transaction_clock(), force=True,
+                    count=sum(st.values()))
+    return Result(columns=[], rows=[], explain=st)
+
+
+@handles(A.Truncate)
+def truncate(cl, stmt):
+    import contextlib as _ctxlib
+
+    from citus_tpu.integrity import forbid_truncate_referenced
+    from citus_tpu.transaction.locks import EXCLUSIVE
+    from citus_tpu.transaction.write_locks import group_resource
+    # validate EVERY relation up front (existence + FK rule with
+    # list-awareness: a referenced parent is fine when all its children
+    # are in the same list, like PostgreSQL): truncation deletes files
+    # irreversibly, so a bad later name must not leave earlier tables
+    # already emptied
+    names = (stmt.table,) + tuple(stmt.more)
+    expanded = []
+    for name in names:
+        t0 = cl.catalog.table(name)
+        expanded.append(name)
+        if t0.is_partitioned:
+            expanded += [p.name for p in cl.catalog.partitions_of(name)]
+    for name in expanded:
+        forbid_truncate_referenced(cl.catalog, name,
+                                   also_truncated=set(expanded))
+    # acquire every relation's EXCLUSIVE lock (sorted, to dodge
+    # lock-order inversions) BEFORE the first irreversible flip:
+    # PostgreSQL's TRUNCATE a, b is all-or-nothing, so a later table's
+    # lock timeout must fail the statement while no table has been
+    # emptied yet
+    metas = {}
+    for name in expanded:
+        t0 = cl.catalog.table(name)
+        if not t0.is_partitioned:
+            metas.setdefault(group_resource(t0), t0)
+    with _ctxlib.ExitStack() as stack:
+        for res in sorted(metas):
+            stack.enter_context(cl._write_lock(metas[res], EXCLUSIVE))
+        for name in names:
+            cl._truncate_one(name)
+    return Result(columns=[], rows=[])
+
+
+@handles(A.Vacuum)
+def vacuum(cl, stmt):
+    from citus_tpu.executor.dml import execute_vacuum
+    from citus_tpu.transaction.locks import EXCLUSIVE
+    t = cl.catalog.table(stmt.table)
+    if t.is_partitioned:
+        # the parent holds no data: vacuum every partition
+        return cl._fanout_partitions(stmt, aggregate_explain=True)
+    with cl._write_lock(t, EXCLUSIVE):
+        st = execute_vacuum(cl.catalog, cl.catalog.table(stmt.table))
+    cl._plan_cache.clear()
+    return Result(columns=[], rows=[], explain=st)
+
+
+@handles(A.VacuumAnalyze)
+def vacuum_analyze(cl, stmt):
+    cl._execute_stmt(A.Vacuum(stmt.table, stmt.full))
+    return cl._execute_analyze(stmt.table)
+
+
+@handles(A.Analyze)
+def analyze(cl, stmt):
+    return cl._execute_analyze(stmt.table)
+
+
+@handles(A.SetConfig)
+def set_config(cl, stmt):
+    return cl._execute_set(stmt)
+
+
+@handles(A.ShowConfig)
+def show_config(cl, stmt):
+    return cl._execute_show(stmt)
+
+
+@handles(A.Reindex)
+def reindex(cl, stmt):
+    return cl._execute_reindex(stmt)
+
+
+@handles(A.UtilityCall)
+def utility_call(cl, stmt):
+    return cl._execute_utility(stmt)
+
+
+@handles(A.Explain)
+def explain(cl, stmt):
+    return cl._execute_explain(stmt)

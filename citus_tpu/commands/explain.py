@@ -1,0 +1,149 @@
+"""Distributed EXPLAIN [ANALYZE].
+
+Reference: planner/multi_explain.c — distributed plan rendering with
+"Tasks Shown: One of N" per-shard representative plans, EXPLAIN ANALYZE
+piggybacking timings on execution, and strategy display for
+INSERT..SELECT / set operations / grouping sets / joins.
+"""
+
+from __future__ import annotations
+
+from citus_tpu.errors import UnsupportedFeatureError
+from citus_tpu.executor import Result, execute_select
+from citus_tpu.planner import ast as A
+from citus_tpu.planner.bind import bind_select
+
+
+def _execute_explain(cl, stmt: A.Explain) -> Result:
+    if isinstance(stmt.statement, A.SetOp):
+        so = stmt.statement
+        lines = [f"Set Operation: {so.op.upper()}{' ALL' if so.all else ''}"]
+        for side, sub in (("left", so.left), ("right", so.right)):
+            r = _execute_explain(cl, A.Explain(sub, analyze=stmt.analyze))
+            lines.append(f"  -> {side}:")
+            lines.extend("     " + row[0] for row in r.rows)
+        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+    if isinstance(stmt.statement, A.Insert) \
+            and stmt.statement.select is not None:
+        ins = stmt.statement
+        t = cl.catalog.table(ins.table)
+        names = list(ins.columns or t.schema.names)
+        strategy = "pull"
+        sel = ins.select
+        if isinstance(sel, A.Select) and isinstance(sel.from_, A.TableRef) \
+                and not (sel.group_by or sel.having or sel.order_by
+                         or sel.limit or sel.distinct):
+            from citus_tpu.commands.insert import _insert_select_strategy
+            try:
+                bound = bind_select(cl.catalog, sel)
+                if not bound.has_aggs and len(bound.final_exprs) == len(names):
+                    strategy = _insert_select_strategy(
+                        cl, t, bound, list(bound.final_exprs), names)
+            except Exception:
+                pass
+        lines = [f"Insert into {ins.table} ({', '.join(names)})",
+                 f"  Strategy: {strategy}"
+                 + {"colocated": "  (per-shard pushdown, no re-hash)",
+                    "repartition": "  (array-streaming re-hash)",
+                    "pull": "  (coordinator row materialization)"}[strategy]]
+        if isinstance(sel, (A.Select, A.SetOp)):
+            sub = _execute_explain(cl, A.Explain(sel, analyze=False))
+            lines.append("  -> source:")
+            lines.extend("     " + row[0] for row in sub.rows)
+        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+    if not isinstance(stmt.statement, A.Select):
+        raise UnsupportedFeatureError(
+            "EXPLAIN supports SELECT, set operations, and INSERT..SELECT")
+    sel = stmt.statement
+    if len(sel.group_by) == 1 and isinstance(sel.group_by[0],
+                                             A.GroupingSetsSpec):
+        spec = sel.group_by[0]
+        full = max(spec.sets, key=len)
+        lines = [f"Grouping Sets: {len(spec.sets)} grouped executions"]
+        inner = A.Select(
+            [i for i in sel.items
+             if not (isinstance(i.expr, A.FuncCall)
+                     and i.expr.name == "grouping")],
+            sel.from_, sel.where, list(full))
+        sub = _execute_explain(cl, A.Explain(inner, analyze=stmt.analyze))
+        lines.extend("  " + row[0] for row in sub.rows)
+        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+    if isinstance(stmt.statement.from_, A.Join):
+        return _explain_join(cl, stmt)
+    sel0 = stmt.statement
+    if isinstance(sel0.from_, A.TableRef) \
+            and cl.catalog.has_table(sel0.from_.name) \
+            and cl.catalog.table(sel0.from_.name).is_partitioned:
+        from citus_tpu.partitioning import prune_partitions
+        pt = cl.catalog.table(sel0.from_.name)
+        parts = cl.catalog.partitions_of(pt.name)
+        surv = prune_partitions(cl.catalog, pt, sel0.where)
+        lines = [f"Append on {pt.name} "
+                 f"(partitions: {len(surv)}/{len(parts)})"]
+        if surv:
+            import dataclasses as _dc
+            rep = _dc.replace(sel0, from_=A.TableRef(
+                surv[0].name, sel0.from_.alias or pt.name))
+            sub = _execute_explain(cl, A.Explain(rep, analyze=False))
+            lines.append(f"  Partitions Shown: One of {len(surv)}")
+            lines.extend("  " + r[0] for r in sub.rows)
+        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+    bound = bind_select(cl.catalog, stmt.statement)
+    from citus_tpu.planner.physical import plan_select
+    plan = plan_select(cl.catalog, bound,
+                       direct_limit=cl.settings.planner.direct_gid_limit)
+    t = bound.table
+    lines = []
+    kind = ("Router" if plan.is_router else "Distributed") if t.is_distributed else "Local"
+    lines.append(f"{kind} Scan on {t.name} "
+                 f"(shards: {len(plan.shard_indexes)}/{t.shard_count})")
+    if plan.index_eq is not None:
+        icol, ival, iname = plan.index_eq
+        if t.schema.column(icol).type.is_text:
+            # literal was bound to its dictionary id; show the string
+            decoded = cl.catalog.decode_strings(t.name, icol, [int(ival)])
+            ival = decoded[0] if decoded else ival
+        lines.append(f"  Index Lookup: {icol} = {ival!r} using {iname}")
+    if plan.intervals:
+        lines.append("  Chunk Pruning: " +
+                     ", ".join(sorted({c.column for c in plan.intervals})))
+    if bound.has_aggs:
+        mode = plan.group_mode
+        desc = {"scalar": "Global Aggregate",
+                "direct": f"Direct GroupBy (groups: {mode.n_groups}, combine: psum)",
+                "hash_host": "Hash GroupBy (host combine)"}[mode.kind]
+        lines.append(f"  Partial Aggregate per shard -> {desc}")
+        lines.append(f"    Partials: " + ", ".join(
+            f"{op.kind}[{op.dtype}]" for op in plan.partial_ops))
+    if stmt.analyze:
+        r = execute_select(cl.catalog, bound, cl.settings)
+        lines.append(f"  Rows: {r.rowcount}  Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
+        tasks = r.explain.get("tasks") or []
+        if tasks:
+            lines.append(f"  Tasks: {len(tasks)}  Tasks Shown: One of {len(tasks)}")
+            si, nrows, dt = tasks[0]
+            lines.append(f"    -> Task (shard index {si}): {nrows} rows, "
+                         f"{dt*1000:.2f} ms device dispatch")
+    return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+
+def _explain_join(cl, stmt: A.Explain) -> Result:
+    from citus_tpu.executor.join_executor import execute_join_select
+    from citus_tpu.planner.join_planner import bind_join_select
+    bj = bind_join_select(cl.catalog, stmt.statement)
+    lines = [f"Join ({bj.strategy}) over {len(bj.rels)} relations"]
+    for s_ in bj.steps:
+        keys = ", ".join(f"{l} = {r}" for l, r in
+                         zip(s_.left_keys, s_.right_keys)) or "(cross)"
+        lines.append(f"  {s_.kind.upper()} JOIN {s_.right_alias} ON {keys}")
+    for alias, _t in bj.rels:
+        rp = bj.rel_plans[alias]
+        f = f" filter: {rp.filter}" if rp.filter is not None else ""
+        lines.append(f"  Scan {alias} [{', '.join(rp.columns)}]{f}")
+    if bj.has_aggs:
+        lines.append(f"  GroupBy keys={len(bj.group_keys)} "
+                     f"partials={len(bj.partial_ops)} (host combine)")
+    if stmt.analyze:
+        r = execute_join_select(cl.catalog, bj, cl.settings)
+        lines.append(f"  Rows: {r.rowcount}  Tasks: {r.explain['tasks']}  "
+                     f"Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
+    return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])

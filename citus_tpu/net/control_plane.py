@@ -88,6 +88,8 @@ class ControlPlane:
         self.server.register("ddl_lease", self._on_ddl_lease)
         self.server.register("fetch_catalog", self._on_fetch_catalog)
         self.server.register("push_catalog", self._on_push_catalog)
+        self.server.register("fetch_dict", self._on_fetch_dict)
+        self.server.register("grow_dict", self._on_grow_dict)
 
     # ---- server handlers ----------------------------------------------
     def _on_catalog_changed(self, payload: dict) -> dict:
@@ -173,6 +175,40 @@ class ControlPlane:
         self.stats["push_catalog"] += 1
         self.server.broadcast({"event": "catalog_changed", "origin": origin})
         return {"ok": True}
+
+    # ---- dictionary authority ------------------------------------------
+    # Text dictionaries are table-global id assignments; coordinators
+    # without the shared data dir fetch them here and route growth
+    # through the authority so two hosts can never assign one id to
+    # different words (the invariant encode_strings' flock provides on
+    # one host).
+    def _on_fetch_dict(self, payload: dict) -> dict:
+        cat = self.cluster.catalog
+        table, column = str(payload["table"]), str(payload["column"])
+        return {"words": cat.dictionary(table, column)}
+
+    def _on_grow_dict(self, payload: dict) -> dict:
+        cat = self.cluster.catalog
+        table, column = str(payload["table"]), str(payload["column"])
+        fresh = [str(w) for w in payload.get("words", [])]
+        # encode through the authority's own (flock-serialized) growth
+        # path; the full word list goes back so the caller can mirror it
+        cat.encode_strings(table, column, fresh)
+        return {"words": cat.dictionary(table, column)}
+
+    def fetch_dict(self, table: str, column: str):
+        """Client side: the authority's canonical word list, or None
+        when unreachable/not attached."""
+        if self.client is None:
+            return None
+        return self.client.call("fetch_dict", {"table": table,
+                                               "column": column})["words"]
+
+    def grow_dict(self, table: str, column: str, words: list) -> list:
+        if self.client is None:
+            raise RpcError("not attached to a metadata authority")
+        return self.client.call("grow_dict", {
+            "table": table, "column": column, "words": words})["words"]
 
     # ---- client-side ---------------------------------------------------
     def _on_event(self, event: dict) -> None:

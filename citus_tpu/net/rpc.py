@@ -1,21 +1,36 @@
-"""Control-plane RPC: length-prefixed JSON over TCP (stdlib only).
+"""Coordinator RPC: length-prefixed JSON (+ binary) frames over TCP
+(stdlib only).
 
-The data plane rides ICI collectives (parallel/); this is the control
-plane — the analog of the reference's libpq connections carrying
-metadata sync, node management, and 2PC votes between coordinators
-(connection/connection_management.c, metadata/metadata_sync.c).  gRPC
-would serve the same role; a dependency-free socket protocol keeps the
-skeleton self-contained.
+The in-slice data plane rides ICI collectives (parallel/); this carries
+the control plane — metadata sync, node management, 2PC votes — AND the
+cross-host bulk data plane (shard file transfer, remote ingest; the
+analog of the reference's COPY-protocol file transmission,
+executor/transmit.c:1-327, over libpq,
+connection/connection_management.c:276).  gRPC would serve the same
+role; a dependency-free socket protocol keeps the skeleton
+self-contained.
 
-Protocol: every frame is ``<uint32 big-endian length><json body>``.
+Protocol: every frame is ``<uint32 big-endian length><body>``.
 Requests: {"id": n, "method": str, "payload": {...}} ->
 responses {"id": n, "result": {...}} or {"id": n, "error": str}.
+A request or response may carry ONE binary attachment: the JSON frame
+sets "bin": <byte length> and the raw bytes follow as the next frame —
+bulk data never round-trips through base64/JSON.
 A client may upgrade a connection to a subscription with method
 "subscribe"; the server then pushes {"event": ..., ...} frames to it.
+
+Authentication (reference: utils/enable_ssl.c + pg_dist_authinfo): when
+a shared secret is configured, every JSON frame carries
+"hmac": HMAC-SHA256(secret, canonical-body), and the receiving side
+rejects frames whose tag is absent or wrong — an unauthenticated peer
+cannot register, fetch the catalog, or read shard bytes.  The secret is
+distributed out-of-band (config/env), never over the wire.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
 import json
 import socket
 import struct
@@ -23,12 +38,30 @@ import threading
 from typing import Callable, Optional
 
 
-def _send(sock: socket.socket, obj: dict) -> None:
+def _tag(secret: Optional[bytes], data: bytes) -> str:
+    return _hmac.new(secret, data, hashlib.sha256).hexdigest()
+
+
+def _send(sock: socket.socket, obj: dict,
+          secret: Optional[bytes] = None,
+          blob: Optional[bytes] = None) -> None:
+    if blob is not None:
+        obj = dict(obj, bin=len(blob))
+        if secret is not None:
+            # the blob's content digest rides inside the authenticated
+            # JSON frame, so substituting blob bytes (even same-length)
+            # fails verification
+            obj["bin_sha256"] = hashlib.sha256(blob).hexdigest()
+    if secret is not None:
+        body = json.dumps(obj, sort_keys=True).encode()
+        obj = dict(obj, hmac=_tag(secret, body))
     data = json.dumps(obj).encode()
     sock.sendall(struct.pack(">I", len(data)) + data)
+    if blob is not None:
+        sock.sendall(struct.pack(">I", len(blob)) + blob)
 
 
-def _recv(sock: socket.socket) -> Optional[dict]:
+def _recv_raw(sock: socket.socket) -> Optional[bytes]:
     hdr = b""
     while len(hdr) < 4:
         chunk = sock.recv(4 - len(hdr))
@@ -38,20 +71,52 @@ def _recv(sock: socket.socket) -> Optional[dict]:
     (n,) = struct.unpack(">I", hdr)
     body = b""
     while len(body) < n:
-        chunk = sock.recv(min(65536, n - len(body)))
+        chunk = sock.recv(min(1 << 20, n - len(body)))
         if not chunk:
             return None
         body += chunk
-    return json.loads(body)
+    return body
+
+
+class AuthError(RuntimeError):
+    """Frame failed HMAC verification."""
+
+
+def _recv(sock: socket.socket, secret: Optional[bytes] = None
+          ) -> Optional[tuple[dict, Optional[bytes]]]:
+    body = _recv_raw(sock)
+    if body is None:
+        return None
+    msg = json.loads(body)
+    if secret is not None:
+        tag = msg.pop("hmac", None)
+        canon = json.dumps(msg, sort_keys=True).encode()
+        if tag is None or not _hmac.compare_digest(tag, _tag(secret, canon)):
+            raise AuthError("frame failed authentication")
+    blob = None
+    nbin = msg.pop("bin", None)
+    want_digest = msg.pop("bin_sha256", None)
+    if nbin is not None:
+        blob = _recv_raw(sock)
+        if blob is None or len(blob) != nbin:
+            return None
+        if secret is not None:
+            got = hashlib.sha256(blob).hexdigest()
+            if want_digest is None or not _hmac.compare_digest(
+                    got, want_digest):
+                raise AuthError("binary frame failed authentication")
+    return msg, blob
 
 
 class RpcServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[bytes] = None):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(16)
         self.host, self.port = self._sock.getsockname()
+        self.secret = secret
         self.handlers: dict[str, Callable[[dict], dict]] = {}
         self._subscribers: list[socket.socket] = []
         self._conns: list[socket.socket] = []
@@ -80,13 +145,25 @@ class RpcServer:
             self._conns.append(conn)
         try:
             while True:
-                msg = _recv(conn)
-                if msg is None:
+                try:
+                    got = _recv(conn, self.secret)
+                except AuthError:
+                    # reject and drop the connection: an unauthenticated
+                    # peer gets no second guess on the same socket
+                    try:
+                        _send(conn, {"error": "authentication failed"},
+                              self.secret)
+                    except OSError:
+                        pass
                     break
+                if got is None:
+                    break
+                msg, blob = got
                 if msg.get("method") == "subscribe":
                     with self._lock:
                         self._subscribers.append(conn)
-                    _send(conn, {"id": msg.get("id"), "result": {"ok": True}})
+                    _send(conn, {"id": msg.get("id"), "result": {"ok": True}},
+                          self.secret)
                     # connection now belongs to the push loop: it stays
                     # open until broadcast fails or the server stops
                     return
@@ -94,10 +171,19 @@ class RpcServer:
                 try:
                     if fn is None:
                         raise KeyError(f"unknown method {msg.get('method')!r}")
-                    result = fn(msg.get("payload") or {})
-                    _send(conn, {"id": msg.get("id"), "result": result or {}})
+                    payload = msg.get("payload") or {}
+                    if blob is not None:
+                        result = fn(payload, blob)
+                    else:
+                        result = fn(payload)
+                    out_blob = None
+                    if isinstance(result, tuple):
+                        result, out_blob = result
+                    _send(conn, {"id": msg.get("id"), "result": result or {}},
+                          self.secret, blob=out_blob)
                 except Exception as e:  # report, keep serving
-                    _send(conn, {"id": msg.get("id"), "error": str(e)})
+                    _send(conn, {"id": msg.get("id"), "error": str(e)},
+                          self.secret)
         except OSError:
             pass
         with self._lock:
@@ -115,7 +201,7 @@ class RpcServer:
             subs = list(self._subscribers)
         for s in subs:
             try:
-                _send(s, event)
+                _send(s, event, self.secret)
             except OSError:
                 with self._lock:
                     if s in self._subscribers:
@@ -145,29 +231,43 @@ class RpcError(RuntimeError):
 
 
 class RpcClient:
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 secret: Optional[bytes] = None):
         self.addr = (host, port)
+        self.secret = secret
         self._sock = socket.create_connection(self.addr, timeout=timeout)
         self._lock = threading.Lock()
         self._next_id = 0
         self._listener: Optional[threading.Thread] = None
         self._sub_sock: Optional[socket.socket] = None
 
-    def call(self, method: str, payload: Optional[dict] = None) -> dict:
+    def call(self, method: str, payload: Optional[dict] = None,
+             blob: Optional[bytes] = None) -> dict:
+        r, _b = self.call_binary(method, payload, blob)
+        return r
+
+    def call_binary(self, method: str, payload: Optional[dict] = None,
+                    blob: Optional[bytes] = None
+                    ) -> tuple[dict, Optional[bytes]]:
+        """Like call(), returning (result, binary attachment)."""
         try:
             with self._lock:
                 self._next_id += 1
                 rid = self._next_id
                 _send(self._sock, {"id": rid, "method": method,
-                                   "payload": payload or {}})
-                resp = _recv(self._sock)
+                                   "payload": payload or {}},
+                      self.secret, blob=blob)
+                got = _recv(self._sock, self.secret)
+        except AuthError as e:
+            raise RpcError(str(e)) from e
         except OSError as e:
             raise RpcError(f"coordinator connection failed: {e}") from e
-        if resp is None:
+        if got is None:
             raise RpcError("connection closed by coordinator")
+        resp, rblob = got
         if resp.get("error"):
             raise RpcError(resp["error"])
-        return resp.get("result") or {}
+        return resp.get("result") or {}, rblob
 
     def subscribe(self, callback: Callable[[dict], None],
                   on_close: Optional[Callable[[], None]] = None) -> None:
@@ -175,9 +275,9 @@ class RpcClient:
         every event the server broadcasts.  ``on_close`` fires when the
         channel dies (server gone), so the owner can fall back."""
         self._sub_sock = socket.create_connection(self.addr, timeout=10.0)
-        _send(self._sub_sock, {"id": 0, "method": "subscribe"})
-        ack = _recv(self._sub_sock)  # {"result": {"ok": true}}
-        if not (ack and ack.get("result", {}).get("ok")):
+        _send(self._sub_sock, {"id": 0, "method": "subscribe"}, self.secret)
+        ack = _recv(self._sub_sock, self.secret)  # {"result": {"ok": true}}
+        if not (ack and ack[0].get("result", {}).get("ok")):
             raise RpcError("subscription refused")
         self._sub_sock.settimeout(None)
 
@@ -185,13 +285,13 @@ class RpcClient:
             try:
                 while True:
                     try:
-                        event = _recv(self._sub_sock)
-                    except OSError:
+                        event = _recv(self._sub_sock, self.secret)
+                    except (OSError, AuthError):
                         return
                     if event is None:
                         return
                     try:
-                        callback(event)
+                        callback(event[0])
                     except Exception:
                         pass
             finally:

@@ -54,34 +54,43 @@ def flip_latch(data_dir: str, table_meta, shared: bool,
 
     flock has no writer priority, so the exclusive side drops an intent
     marker first: new readers hold off while existing ones drain —
-    PostgreSQL's ACCESS EXCLUSIVE queueing, poor man's edition.  Only
-    one exclusive acquirer exists per group at a time (TRUNCATE already
-    holds the group's EXCLUSIVE write lock), so the marker is safe."""
+    PostgreSQL's ACCESS EXCLUSIVE queueing, poor man's edition.
+
+    Each writer's marker has a UNIQUE name (uuid suffix) carrying the
+    owner pid: a reader may reap a dead owner's marker with no
+    check-then-remove race against a live writer creating a fresh one —
+    unlinking a uniquely-named file can only ever remove THAT dead
+    writer's marker (pid recycling at worst delays readers until their
+    own timeout, never deletes a live marker)."""
+    import glob as _glob
     import os
     import time
+    import uuid as _uuid
     from citus_tpu.utils.filelock import FileLock, LockTimeout
     res = group_resource(table_meta)
     path = os.path.join(data_dir, ".fl_" + res.replace(":", "_") + ".lock")
-    intent = path + ".intent"
     if shared:
         from citus_tpu.transaction.global_deadlock import _pid_alive
         deadline = time.monotonic() + timeout
-        while os.path.exists(intent):
-            # crash cleanup: a writer killed between dropping the intent
-            # and its finally-removal would otherwise hold readers off
-            # forever — the intent records its owner pid; any reader may
-            # reap it once that pid is dead
-            try:
-                with open(intent) as f:
-                    owner = int(f.read().strip() or -1)
-            except (OSError, ValueError):
-                owner = -1  # mid-write or already removed: re-check
-            if owner > 0 and not _pid_alive(owner):
+        while True:
+            held_off = False
+            for intent in _glob.glob(path + ".intent.*"):
                 try:
-                    os.remove(intent)
-                except OSError:
-                    pass
-                continue
+                    with open(intent) as f:
+                        owner = int(f.read().strip() or -1)
+                except (OSError, ValueError):
+                    continue  # mid-write or already removed: re-check
+                if owner > 0 and not _pid_alive(owner):
+                    # crash cleanup: the owner died between creating the
+                    # marker and its finally-removal
+                    try:
+                        os.remove(intent)
+                    except OSError:
+                        pass
+                else:
+                    held_off = True
+            if not held_off:
+                break
             if time.monotonic() >= deadline:
                 raise LockTimeout(
                     f"table flip in progress on {res!r} (reader held off "
@@ -90,6 +99,7 @@ def flip_latch(data_dir: str, table_meta, shared: bool,
         with FileLock(path, shared=True, timeout=timeout):
             yield
         return
+    intent = f"{path}.intent.{_uuid.uuid4().hex[:12]}"
     with open(intent, "w") as f:
         f.write(str(os.getpid()))
     try:

@@ -82,7 +82,8 @@ def test_flip_latch_stale_intent_reaped(tmp_path):
     from citus_tpu.transaction.write_locks import group_resource
     res = group_resource(cl.catalog.table("t"))
     intent = os.path.join(cl.catalog.data_dir,
-                          ".fl_" + res.replace(":", "_") + ".lock.intent")
+                          ".fl_" + res.replace(":", "_")
+                          + ".lock.intent.deadbeef0000")
     # forge a crash: intent owned by a pid that no longer exists
     with open(intent, "w") as f:
         f.write("999999999")
@@ -104,7 +105,8 @@ def test_flip_latch_live_intent_still_blocks(tmp_path):
     from citus_tpu.transaction.write_locks import group_resource
     res = group_resource(cl.catalog.table("t"))
     intent = os.path.join(cl.catalog.data_dir,
-                          ".fl_" + res.replace(":", "_") + ".lock.intent")
+                          ".fl_" + res.replace(":", "_")
+                          + ".lock.intent.cafebabe0000")
     with open(intent, "w") as f:
         f.write(str(os.getpid()))  # this (live) process
     try:
