@@ -1142,6 +1142,15 @@ class Catalog:
         os.replace(tmp, dp)
         self._dict_sig[key] = _stat_sig(dp)
 
+    def _word_type(self, table: str, column: str):
+        """ColumnType for a dictionary column when it needs kind-specific
+        canonicalization (uuid/bytea/array), else None (plain text)."""
+        t = self.tables.get(table)
+        if t is None or not t.schema.has(column):
+            return None
+        ct = t.schema.column(column).type
+        return ct if ct.is_text and ct.kind != "text" else None
+
     def encode_strings(self, table: str, column: str, values):
         """Map strings -> table-global dictionary ids, growing the
         dictionary for unseen strings (ingest path, coordinator-only).
@@ -1163,6 +1172,12 @@ class Catalog:
             nn = ~nulls
             if not nn.any():
                 return out
+            wt = self._word_type(table, column)
+            if wt is not None:
+                # uuid/bytea/array: canonicalize so equal logical values
+                # share one dictionary word (types.normalize_word)
+                arr = arr.copy()
+                arr[nn] = [wt.normalize_word(v) for v in arr[nn]]
             uniq, inverse = np.unique(arr[nn].astype(str), return_inverse=True)
             uid = np.empty(len(uniq), dtype=np.int64)
             fresh = [w for w in (str(w) for w in uniq) if w not in index]
@@ -1197,6 +1212,12 @@ class Catalog:
 
     def lookup_string_id(self, table: str, column: str, value: str) -> Optional[int]:
         self._ensure_dict(table, column)
+        wt = self._word_type(table, column)
+        if wt is not None:
+            try:
+                value = wt.normalize_word(value)
+            except Exception:
+                return None  # malformed literal can never match
         return self._dict_index[(table, column)].get(value)
 
     def decode_strings(self, table: str, column: str, ids) -> list:

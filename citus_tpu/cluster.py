@@ -608,13 +608,41 @@ class Cluster:
     def __init__(self, data_dir: str, *, n_nodes: Optional[int] = None,
                  settings: Optional[Settings] = None,
                  serve_port: Optional[int] = None,
-                 coordinator: Optional[tuple] = None):
+                 coordinator: Optional[tuple] = None,
+                 data_port: Optional[int] = None,
+                 hosted_nodes: Optional[set] = None,
+                 secret: Optional[bytes] = None):
+        """``serve_port``/``coordinator``: control-plane role (metadata
+        authority / attached peer).  ``data_port``: serve this process's
+        shard placements to peers over the bulk data plane
+        (net/data_plane.py; reference: executor/transmit.c file
+        transfer).  ``hosted_nodes``: node ids whose placements live in
+        THIS data dir — None means all (single-host mode); a set enables
+        remote placement reads/writes through node endpoints.
+        ``secret``: shared HMAC secret for all RPC (reference:
+        pg_dist_authinfo / enable_ssl.c)."""
+        if isinstance(secret, str):
+            secret = secret.encode()
+        self._secret = secret
         self.settings = settings or current_settings()
         self.catalog = Catalog(data_dir)
+        if hosted_nodes is not None:
+            self.catalog.hosted_nodes = set(hosted_nodes)
         if n_nodes is None:
-            n_nodes = max(len(jax.devices()), 1)
-        self.catalog.ensure_nodes(n_nodes)
+            n_nodes = 0 if hosted_nodes is not None \
+                else max(len(jax.devices()), 1)
+        if n_nodes:
+            self.catalog.ensure_nodes(n_nodes)
         self.catalog.commit()
+        self._data_server = None
+        if data_port is not None:
+            from citus_tpu.net.data_plane import DataPlaneServer
+            self._data_server = DataPlaneServer(self, port=data_port,
+                                                secret=secret)
+        if hosted_nodes is not None:
+            from citus_tpu.net.data_plane import DataPlaneClient
+            self.catalog.remote_data = DataPlaneClient(self.catalog,
+                                                       secret=secret)
         # transaction log + recovery on open (reference: 2PC recovery at
         # maintenance-daemon startup, transaction_recovery.c)
         from citus_tpu.transaction import TransactionLog
@@ -653,7 +681,8 @@ class Cluster:
         if serve_port is not None or coordinator is not None:
             from citus_tpu.net.control_plane import ControlPlane
             self._control = ControlPlane(self, serve_port=serve_port,
-                                         coordinator=coordinator)
+                                         coordinator=coordinator,
+                                         secret=secret)
             # catalog commits serialize through the authority's DDL
             # lease and ship the document over RPC (push_catalog)
             self.catalog.commit_transport = self._control
@@ -749,9 +778,64 @@ class Cluster:
             self._maintenance.stop()
         if self._control is not None:
             self._control.close()
+        if self._data_server is not None:
+            self._data_server.stop()
+        if self.catalog.remote_data is not None:
+            self.catalog.remote_data.close()
         # release the transaction-log owner marker: our undecided
         # transactions become recoverable by other coordinators
         self.txlog.close()
+
+    # ------------------------------------------------ cross-host topology
+    @property
+    def data_port(self) -> Optional[int]:
+        """Port of this coordinator's bulk data-plane server."""
+        return self._data_server.port if self._data_server else None
+
+    def register_node(self, host: str = "127.0.0.1") -> int:
+        """Join the cluster as a shard-hosting worker: add a node whose
+        placements live in THIS coordinator's data dir, advertising our
+        data-plane endpoint so peers can read/write them over the wire
+        (reference: citus_add_node(nodename, nodeport) +
+        metadata/node_metadata.c ActivateNode)."""
+        if self._data_server is None:
+            raise AnalysisError(
+                "register_node requires data_port= (no data-plane server)")
+        from citus_tpu.catalog.catalog import NodeMeta
+        # adopt the authority's current node map BEFORE allocating an id
+        # (an attached coordinator's local file lags the authority)
+        self._reload_catalog()
+        nid = max(self.catalog.nodes, default=-1) + 1
+        self.catalog.nodes[nid] = NodeMeta(nid, True, host,
+                                           self._data_server.port)
+        if self.catalog.hosted_nodes is None:
+            self.catalog.hosted_nodes = set()
+        self.catalog.hosted_nodes.add(nid)
+        self.catalog.ddl_epoch += 1
+        self.catalog.commit()
+        return nid
+
+    def _ingest_local_batch(self, table_name: str, values: dict,
+                            validity: dict) -> int:
+        """Data-plane server entry: write a physical-encoded batch whose
+        rows hash to shards hosted HERE (the receiving half of a
+        cross-host COPY; reference: the worker side of per-shard COPY
+        streams).  Runs this coordinator's own 2PC."""
+        self._maybe_reload_catalog()
+        t = self.catalog.table(table_name)
+        from citus_tpu.transaction.locks import SHARED
+        with self._write_lock(t, SHARED):
+            t = self.catalog.table(table_name)
+            ing = TableIngestor(self.catalog, t, txlog=self.txlog)
+            try:
+                ing.append(values, validity)
+            except BaseException:
+                ing.abort()
+                raise
+            ing.finish()
+        n = len(next(iter(values.values()))) if values else 0
+        self.counters.bump("rows_ingested_remote", n)
+        return n
 
     def _write_lock(self, table_meta, mode: str):
         """Serialize writers on a table's colocation group (the analog of
@@ -1308,6 +1392,14 @@ class Cluster:
         if t.partition_of is not None:
             from citus_tpu.partitioning import check_partition_bounds
             check_partition_bounds(self.catalog, t, values, validity)
+        remote_n = 0
+        if self.catalog.remote_data is not None:
+            values, validity, remote_n = self._route_remote_batch(
+                t, values, validity)
+            if not values or len(next(iter(values.values()))) == 0:
+                # every row went to remote hosts
+                self.counters.bump("rows_ingested", remote_n)
+                return remote_n
         import contextlib as _ctxlib
 
         from citus_tpu.transaction.locks import EXCLUSIVE, SHARED
@@ -1327,12 +1419,62 @@ class Cluster:
                 self._copy_from_locked(t, txn, columns, values, validity)
                 break
         n = len(next(iter(values.values()))) if values else 0
-        self.counters.bump("rows_ingested", n)
+        self.counters.bump("rows_ingested", n + remote_n)
         if self._cdc_captures(t.name) and n:
             self._emit_cdc(t.name, "insert",
                            rows=self._decode_rows(t, values, validity),
                            columns=t.schema.names)
-        return n
+        return n + remote_n
+
+    def _route_remote_batch(self, t, values, validity):
+        """Split a physical ingest batch by shard ownership: rows whose
+        shard is hosted by another coordinator ship over the data plane
+        (reference: distributed COPY forwarding per-shard streams to the
+        owning worker, commands/multi_copy.c CitusSendTupleToPlacements);
+        the local remainder continues through the normal path.  Returns
+        (local_values, local_validity, rows_shipped)."""
+        from citus_tpu.catalog.hashing import shard_index_for_values
+        if not t.is_distributed:
+            return values, validity, 0
+        owners = [t.shards[si].placements[0] for si in range(t.shard_count)]
+        if not any(self.catalog.is_remote_node(o) for o in owners):
+            return values, validity, 0
+        from citus_tpu.storage.overlay import current_overlay
+        if current_overlay() is not None:
+            raise UnsupportedFeatureError(
+                "writes to remote-hosted shards inside an explicit "
+                "transaction are not supported yet (no cross-host 2PC)")
+        if t.unique_indexes or t.foreign_keys:
+            raise UnsupportedFeatureError(
+                "unique/FK-constrained tables cannot span remote-hosted "
+                "shards yet (constraint probes are host-local)")
+        dist = values[t.dist_column].astype(np.int64)
+        idx = shard_index_for_values(dist, t.shard_count)
+        # group remote shards by owning endpoint: one batch per host
+        by_endpoint: dict = {}
+        remote_rows = np.zeros(len(dist), bool)
+        for si in range(t.shard_count):
+            owner = owners[si]
+            if not self.catalog.is_remote_node(owner):
+                continue
+            sel = idx == si
+            if not sel.any():
+                continue
+            ep = self.catalog.node_endpoint(owner)
+            m = by_endpoint.setdefault(ep, np.zeros(len(dist), bool))
+            m |= sel
+            remote_rows |= sel
+        shipped = 0
+        for ep, m in by_endpoint.items():
+            sub_v = {c: v[m] for c, v in values.items()}
+            sub_m = {c: x[m] for c, x in validity.items()}
+            shipped += self.catalog.remote_data.ship_batch(
+                ep, t.name, sub_v, sub_m)
+        if not remote_rows.any():
+            return values, validity, 0
+        keep = ~remote_rows
+        return ({c: v[keep] for c, v in values.items()},
+                {c: x[keep] for c, x in validity.items()}, shipped)
 
     def _copy_from_locked(self, t, txn, columns, values, validity) -> None:
         """copy_from's body under the table write lock: FK + unique
@@ -1554,15 +1696,18 @@ class Cluster:
         and written incrementally (symmetric with copy_from_csv)."""
         import os as _os
         from citus_tpu.storage import ShardReader
-        from citus_tpu.transaction.write_locks import flip_latch
+        from citus_tpu.transaction.snapshot import read_generation
         t = self.catalog.table(table_name)
         names = t.schema.names
         total = 0
-        with open(path, "w", newline="") as fh, \
-                flip_latch(self.catalog.data_dir, t, shared=True,
-                           timeout=self.settings.executor.lock_timeout_s):
-            # SHARED flip latch: the multi-shard export must not
-            # interleave with TRUNCATE's per-shard flips
+        # NOTE: the export streams to the caller's file, so a mid-export
+        # flip cannot be retried transparently; capture the generation
+        # and fail loudly on a torn export instead of silently writing
+        # a mixture (readers of query results get the retrying
+        # snapshot_read path; COPY TO keeps PostgreSQL's "repeatable
+        # read within the statement" spirit by detecting the overlap)
+        gen0, busy0 = read_generation(self.catalog.data_dir, t)
+        with open(path, "w", newline="") as fh:
             w = self._open_csv_writer(fh, names, delimiter=delimiter,
                                       header=header)
             for shard in t.shards:
@@ -1592,6 +1737,11 @@ class Cluster:
                                 row.append(decoded[c][i])
                         w.writerow(row)
                         total += 1
+        gen1, busy1 = read_generation(self.catalog.data_dir, t)
+        if busy0 or busy1 or gen1 != gen0:
+            raise ExecutionError(
+                "concurrent metadata flip during COPY TO; re-run the "
+                "export")
         return total
 
     # -------------------------------------------------------------- SQL
@@ -1922,10 +2072,26 @@ class Cluster:
                                "tables": sorted(txn.tables)}
                     self.txlog.log(txn.xid, TxState.PREPARED, payload)
                     self.txlog.log(txn.xid, TxState.COMMITTED, payload)
-                    for d in sorted(txn.delete_dirs):
-                        commit_staged_deletes(d, txn.xid)
-                    for d in sorted(txn.ingest_dirs):
-                        commit_staged(d, txn.xid)
+                    # one flip bracket per touched colocation group: a
+                    # snapshot read observes the whole transaction's
+                    # effects on a table or none of them
+                    import contextlib as _ctxlib
+
+                    from citus_tpu.transaction.snapshot import flip_generation
+                    from citus_tpu.transaction.write_locks import group_resource
+                    groups = {}
+                    for name in sorted(txn.tables):
+                        if self.catalog.has_table(name):
+                            t0 = self.catalog.table(name)
+                            groups.setdefault(group_resource(t0), t0)
+                    with _ctxlib.ExitStack() as _flips:
+                        for res in sorted(groups):
+                            _flips.enter_context(flip_generation(
+                                self.catalog.data_dir, groups[res]))
+                        for d in sorted(txn.delete_dirs):
+                            commit_staged_deletes(d, txn.xid)
+                        for d in sorted(txn.ingest_dirs):
+                            commit_staged(d, txn.xid)
                     self.txlog.log(txn.xid, TxState.DONE)
                 else:
                     self.txlog.release(txn.xid)

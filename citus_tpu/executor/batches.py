@@ -71,6 +71,18 @@ def load_shard_batches(
         d = cat.shard_dir(table.name, shard.shard_id, node)
         try:
             FAULTS.hit("read_placement", f"{table.name}:{shard.shard_id}:{node}")
+            if not os.path.isdir(d) and cat.is_remote_node(node) \
+                    and cat.remote_data is not None:
+                # the placement lives on another coordinator: mirror it
+                # over the data plane into the local cache and read that
+                # (reference: task results / shard reads over libpq,
+                # worker_sql_task_protocol.c; here whole-chunk columnar
+                # batches, fetched once per immutable stripe)
+                rd = cat.remote_data.sync_placement(
+                    table.name, shard.shard_id, node,
+                    cat.node_endpoint(node))
+                if rd is not None:
+                    d = rd
             if not os.path.isdir(d):
                 if attempt + 1 < len(nodes):
                     from citus_tpu.executor.executor import GLOBAL_COUNTERS

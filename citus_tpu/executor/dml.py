@@ -127,8 +127,10 @@ def execute_delete(cat: Catalog, txlog: TransactionLog, table: TableMeta,
         txlog.log(xid, TxState.PREPARED,
                   {"kind": "delete", "table": table.name, "placements": staged_dirs})
         txlog.log(xid, TxState.COMMITTED, {"table": table.name})
-        for d in staged_dirs:
-            commit_staged_deletes(d, xid)
+        from citus_tpu.transaction.snapshot import flip_generation
+        with flip_generation(cat.data_dir, table):
+            for d in staged_dirs:
+                commit_staged_deletes(d, xid)
         txlog.log(xid, TxState.DONE)
         return total
     except BaseException:
@@ -266,22 +268,25 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
               {"table": table.name, "placements": staged_delete_dirs,
                "ingest_placements": ingest_dirs})
     from citus_tpu.storage.writer import commit_staged
-    for d in staged_delete_dirs:
-        commit_staged_deletes(d, xid)
-    for d in ingest_dirs:
-        commit_staged(d, xid)
+    from citus_tpu.transaction.snapshot import flip_generation
+    # one flip bracket over deletes + re-insert stripes: a snapshot read
+    # can never observe the deletion without the replacement rows
+    with flip_generation(cat.data_dir, table):
+        for d in staged_delete_dirs:
+            commit_staged_deletes(d, xid)
+        for d in ingest_dirs:
+            commit_staged(d, xid)
     txlog.log(xid, TxState.DONE)
     return total
 
 
 def execute_truncate(cat: Catalog, table: TableMeta) -> None:
-    from citus_tpu.config import current_settings
-    from citus_tpu.transaction.write_locks import flip_latch
-    # EXCLUSIVE flip latch: a concurrent scan holds it SHARED across its
-    # whole load, so it sees every shard pre-truncate or every shard
-    # post-truncate — never a torn mixture
-    with flip_latch(cat.data_dir, table, shared=False,
-                    timeout=current_settings().executor.lock_timeout_s):
+    from citus_tpu.transaction.snapshot import flip_generation
+    # flip-generation bracket: a concurrent snapshot read that overlaps
+    # these per-shard metadata rewrites detects the generation change
+    # and retries — it sees every shard pre-truncate or every shard
+    # post-truncate, never a torn mixture, and never waits on us
+    with flip_generation(cat.data_dir, table):
         for shard in table.shards:
             for node in shard.placements:
                 d = cat.shard_dir(table.name, shard.shard_id, node)
@@ -334,8 +339,12 @@ def execute_vacuum(cat: Catalog, table: TableMeta) -> dict:
             old = d + ".old"
             if os.path.isdir(old):
                 shutil.rmtree(old)
-            os.rename(d, old)
-            os.rename(tmp, d)
+            from citus_tpu.transaction.snapshot import flip_generation
+            with flip_generation(cat.data_dir, table):
+                # the swap window (placement briefly absent) is inside
+                # the flip bracket: an overlapping snapshot read retries
+                os.rename(d, old)
+                os.rename(tmp, d)
             record_cleanup(cat, old, DEFERRED_ON_SUCCESS)
             rewritten += 1
     table.version += 1

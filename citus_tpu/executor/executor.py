@@ -673,17 +673,20 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
     # admission control: one device-dispatch slot per executing query
     # (the citus.max_shared_pool_size analog; 0 = unlimited)
     from citus_tpu.executor.admission import GLOBAL_POOL
-    from citus_tpu.transaction.write_locks import flip_latch
+    from citus_tpu.transaction.snapshot import snapshot_read
     with GLOBAL_POOL.slot(settings.executor.max_shared_pool_size,
-                          timeout=settings.executor.lock_timeout_s), \
-            flip_latch(cat.data_dir, bound.table, shared=True,
-                       timeout=settings.executor.lock_timeout_s):
-        # the SHARED flip latch makes the multi-shard scan atomic
-        # against TRUNCATE's per-shard metadata flips
-        if bound.has_aggs:
-            rows = _run_agg(cat, plan, settings, params)
-        else:
-            rows = _run_projection(cat, plan, settings, params)
+                          timeout=settings.executor.lock_timeout_s):
+        # snapshot read: never blocks behind writers — the scan is
+        # validated against the table's flip generation and retried if
+        # a multi-file metadata flip (TRUNCATE, DML commit) overlapped
+        # (transaction/snapshot.py; the MVCC never-block property the
+        # reference inherits from PostgreSQL)
+        def _attempt():
+            if bound.has_aggs:
+                return _run_agg(cat, plan, settings, params)
+            return _run_projection(cat, plan, settings, params)
+        rows = snapshot_read(cat.data_dir, bound.table, _attempt,
+                             timeout=settings.executor.lock_timeout_s)
     rows = order_and_limit(plan, rows)
     if bound.hidden_outputs:
         keep = len(bound.output_names) - bound.hidden_outputs

@@ -82,7 +82,10 @@ def decode_qualified(cat: Catalog, expr_type: T.ColumnType,
     if expr_type.is_text:
         if source is None:
             return int(raw)
-        return cat.decode_strings(source[0], source[1], [int(raw)])[0]
+        word = cat.decode_strings(source[0], source[1], [int(raw)])[0]
+        if word is not None and expr_type.kind != "text":
+            return expr_type.render_word(word)  # uuid/bytea/array
+        return word
     return expr_type.from_physical(raw.item() if hasattr(raw, "item") else raw)
 
 

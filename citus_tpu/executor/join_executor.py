@@ -575,24 +575,15 @@ def _join_text_src(bj: BoundJoinSelect):
 
 
 def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -> Result:
-    import contextlib
-    import time
+    from citus_tpu.transaction.snapshot import snapshot_read_multi
 
-    from citus_tpu.transaction.write_locks import flip_latch, group_resource
-
-    # SHARED flip latch on every base relation: the multi-shard frame
-    # loads below must not interleave with a TRUNCATE's per-shard
-    # metadata flips (sorted resource order; only-shared never cycles)
-    with contextlib.ExitStack() as _latches:
-        seen = set()
-        for _, t_ in sorted(bj.rels, key=lambda rt: group_resource(rt[1])):
-            res = group_resource(t_)
-            if res not in seen:
-                seen.add(res)
-                _latches.enter_context(flip_latch(
-                    cat.data_dir, t_, shared=True,
-                    timeout=settings.executor.lock_timeout_s))
-        return _execute_join_select(cat, bj, settings)
+    # snapshot read across every base relation: the multi-shard frame
+    # loads below must observe a consistent flip generation per
+    # colocation group — validated, non-blocking (transaction/snapshot.py)
+    return snapshot_read_multi(
+        cat.data_dir, [t_ for _, t_ in bj.rels],
+        lambda: _execute_join_select(cat, bj, settings),
+        timeout=settings.executor.lock_timeout_s)
 
 
 def _execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -> Result:

@@ -351,9 +351,11 @@ def _execute_merge_tx(cat, txlog, target, xid, src_frame, src_n,
     txlog.log(xid, TxState.COMMITTED,
               {"table": target.name, "placements": staged_delete_dirs,
                "ingest_placements": ingest_dirs})
-    for d in staged_delete_dirs:
-        commit_staged_deletes(d, xid)
-    for d in ingest_dirs:
-        commit_staged(d, xid)
+    from citus_tpu.transaction.snapshot import flip_generation
+    with flip_generation(cat.data_dir, target):
+        for d in staged_delete_dirs:
+            commit_staged_deletes(d, xid)
+        for d in ingest_dirs:
+            commit_staged(d, xid)
     txlog.log(xid, TxState.DONE)
     return {"updated": n_updated, "deleted": n_deleted, "inserted": n_inserted}

@@ -148,8 +148,11 @@ class TableIngestor:
                                 "placements": dirs})
                 self.txlog.log(self.xid, TxState.COMMITTED,
                                {"table": self.table.name})
-                for d in dirs:
-                    commit_staged(d, self.xid)
+                from citus_tpu.transaction.snapshot import flip_generation
+                with flip_generation(self.cat.data_dir, self.table):
+                    # a snapshot read sees the whole COPY or none of it
+                    for d in dirs:
+                        commit_staged(d, self.xid)
                 self.txlog.log(self.xid, TxState.DONE)
             return total
         except BaseException:

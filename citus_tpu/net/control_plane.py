@@ -46,8 +46,10 @@ class ControlPlane:
     (the metadata authority) and/or a client connection to one."""
 
     def __init__(self, cluster, serve_port: Optional[int] = None,
-                 coordinator: Optional[tuple] = None):
+                 coordinator: Optional[tuple] = None,
+                 secret: Optional[bytes] = None):
         self.cluster = cluster
+        self.secret = secret
         self.origin = uuid.uuid4().hex[:12]
         self.server: Optional[RpcServer] = None
         self.client: Optional[RpcClient] = None
@@ -65,7 +67,7 @@ class ControlPlane:
         self.stats = {"fetch_catalog": 0, "push_catalog": 0,
                       "lease_acquired": 0, "lease_contended": 0}
         if serve_port is not None:
-            self.server = RpcServer(port=serve_port)
+            self.server = RpcServer(port=serve_port, secret=secret)
             self._register_handlers()
             self.server.start()
             self._write_authority_file()
@@ -74,7 +76,7 @@ class ControlPlane:
         self.push_alive = False
         if coordinator is not None:
             host, port = coordinator
-            self.client = RpcClient(host, int(port))
+            self.client = RpcClient(host, int(port), secret=secret)
             self.client.call("ping")
             self.push_alive = True
             self.client.subscribe(self._on_event, on_close=self._on_push_closed)
@@ -375,7 +377,8 @@ class ControlPlane:
         back to promotion.  Never leaks sockets on failure."""
         c = None
         try:
-            c = RpcClient(info["host"], int(info["port"]))
+            c = RpcClient(info["host"], int(info["port"]),
+                          secret=self.secret)
             c.call("ping")
         except Exception:
             if c is not None:
@@ -419,7 +422,7 @@ class ControlPlane:
                 pass
             self.client = None
         self.push_alive = False
-        self.server = RpcServer(port=0)
+        self.server = RpcServer(port=0, secret=self.secret)
         self._register_handlers()
         self.server.start()
         self._write_authority_file()

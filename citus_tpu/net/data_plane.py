@@ -1,0 +1,367 @@
+"""Cross-host bulk data plane: shard files, ingest batches, and
+dictionaries over RPC.
+
+Reference: the reference moves shard bytes between nodes over libpq —
+COPY-protocol file transfer (executor/transmit.c:1-327), worker-side
+shard copy (operations/worker_shard_copy.c), task results as COPY
+streams (worker/worker_sql_task_protocol.c).  Here every coordinator
+that *hosts* shard placements runs a DataPlaneServer; peers reach it
+through the endpoint advertised in the node catalog (the pg_dist_node
+nodename/nodeport analog) and move bytes as binary RPC frames — no
+shared filesystem required.
+
+Layering (SURVEY §5.8): ICI collectives stay the data plane *within* a
+mesh; this is the DCN path *between* hosts — placement reads, shard
+moves, and ingest routing.  Stripe files are immutable-append, so the
+reader side caches them by name and only re-fetches the small mutable
+files (shard meta, deletion bitmaps, index segments) per sync.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from citus_tpu.net.rpc import RpcClient, RpcError, RpcServer
+
+#: fetch_file chunk size — one RPC round-trip per chunk
+CHUNK_BYTES = 4 << 20
+
+#: mutable placement files re-fetched on every sync (everything else —
+#: stripe .cts files — is immutable once visible)
+_MUTABLE_SUFFIXES = (".json", ".npz", ".bin")
+
+
+def _npz_bytes(arrays: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _npz_load(blob: bytes) -> dict:
+    # never allow_pickle: batches are physical (numeric) arrays, and a
+    # pickle in a network frame would be remote code execution
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def encode_batch(values: dict, validity: dict) -> bytes:
+    """Batches cross the wire PHYSICAL-encoded (text already mapped to
+    table-global dictionary ids by the sending coordinator), so every
+    array is plain numeric — no pickle on either side."""
+    arrays = {}
+    for c, v in values.items():
+        a = np.asarray(v)
+        if a.dtype == object:
+            raise TypeError(
+                f"column {c!r} is not physical-encoded (object dtype)")
+        arrays[f"v__{c}"] = a
+    for c, m in validity.items():
+        arrays[f"m__{c}"] = np.asarray(m, dtype=bool)
+    return _npz_bytes(arrays)
+
+
+def decode_batch(blob: bytes) -> tuple[dict, dict]:
+    arrays = _npz_load(blob)
+    values = {k[3:]: v for k, v in arrays.items() if k.startswith("v__")}
+    validity = {k[3:]: v for k, v in arrays.items() if k.startswith("m__")}
+    return values, validity
+
+
+class DataPlaneServer:
+    """Serves this coordinator's locally-hosted placements."""
+
+    def __init__(self, cluster, port: int = 0,
+                 secret: Optional[bytes] = None):
+        self.cluster = cluster
+        self.server = RpcServer(port=port, secret=secret)
+        s = self.server
+        s.register("ping", lambda p: {"ok": True})
+        s.register("list_placement", self._on_list_placement)
+        s.register("fetch_file", self._on_fetch_file)
+        s.register("put_file", self._on_put_file)
+        s.register("ingest_batch", self._on_ingest_batch)
+        s.register("drop_placement", self._on_drop_placement)
+        s.start()
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def _placement_dir(self, p: dict) -> str:
+        cat = self.cluster.catalog
+        return cat.shard_dir(str(p["table"]), int(p["shard_id"]),
+                             int(p["node"]))
+
+    def _on_list_placement(self, p: dict) -> dict:
+        d = self._placement_dir(p)
+        if not os.path.isdir(d):
+            return {"exists": False, "files": []}
+        files = []
+        for n in sorted(os.listdir(d)):
+            fp = os.path.join(d, n)
+            if os.path.isfile(fp):
+                st = os.stat(fp)
+                files.append({"name": n, "size": st.st_size,
+                              "mtime_ns": st.st_mtime_ns})
+        return {"exists": True, "files": files}
+
+    def _on_fetch_file(self, p: dict) -> tuple[dict, bytes]:
+        d = self._placement_dir(p)
+        name = str(p["name"])
+        if "/" in name or name.startswith(".."):
+            raise ValueError(f"bad file name {name!r}")
+        off = int(p.get("offset", 0))
+        with open(os.path.join(d, name), "rb") as fh:
+            fh.seek(off)
+            data = fh.read(CHUNK_BYTES)
+            eof = fh.read(1) == b""
+        return {"eof": eof, "offset": off, "n": len(data)}, data
+
+    def _on_put_file(self, p: dict, blob: bytes) -> dict:
+        """Receive one placement file (shard move push path).  Writes
+        are staged to .part and renamed on the final chunk so a reader
+        never sees a torn file."""
+        d = self._placement_dir(p)
+        os.makedirs(d, exist_ok=True)
+        name = str(p["name"])
+        if "/" in name or name.startswith(".."):
+            raise ValueError(f"bad file name {name!r}")
+        part = os.path.join(d, name + ".part")
+        mode = "ab" if int(p.get("offset", 0)) else "wb"
+        with open(part, mode) as fh:
+            fh.write(blob)
+        if p.get("last", True):
+            os.replace(part, os.path.join(d, name))
+        return {"ok": True}
+
+    def _on_ingest_batch(self, p: dict, blob: bytes) -> dict:
+        """Ingest a physical-encoded batch whose rows all hash to
+        shards this coordinator hosts (the remote half of a distributed
+        COPY; reference: per-shard COPY streams to the owning worker,
+        commands/multi_copy.c).  Runs a local 2PC through this
+        coordinator's transaction log."""
+        values, validity = decode_batch(blob)
+        n = self.cluster._ingest_local_batch(str(p["table"]), values,
+                                             validity)
+        return {"inserted": n}
+
+    def _on_drop_placement(self, p: dict) -> dict:
+        """Deferred-drop a placement directory after its shard moved
+        away (reference: pg_dist_cleanup deferred source drop)."""
+        from citus_tpu.operations.cleaner import (
+            DEFERRED_ON_SUCCESS, record_cleanup,
+        )
+        d = self._placement_dir(p)
+        if os.path.isdir(d):
+            record_cleanup(self.cluster.catalog, d, DEFERRED_ON_SUCCESS)
+        return {"ok": True}
+
+    def stop(self) -> None:
+        self.server.stop()
+
+
+class DataPlaneClient:
+    """Connection pool to peer coordinators' data servers, plus the
+    remote placement cache (reads) and transfer helpers (moves)."""
+
+    def __init__(self, cat, secret: Optional[bytes] = None):
+        self.cat = cat
+        self.secret = secret
+        self._conns: dict[tuple, RpcClient] = {}
+        self._lock = threading.Lock()
+        self.stats = {"files_fetched": 0, "bytes_fetched": 0,
+                      "batches_shipped": 0, "remote_syncs": 0}
+
+    def _conn(self, endpoint: tuple) -> RpcClient:
+        with self._lock:
+            c = self._conns.get(endpoint)
+        if c is not None:
+            return c
+        # connect OUTSIDE the pool lock: one dead peer's connect timeout
+        # must not stall calls to every healthy endpoint
+        c = RpcClient(endpoint[0], int(endpoint[1]), secret=self.secret)
+        with self._lock:
+            existing = self._conns.get(endpoint)
+            if existing is not None:
+                # lost the race: keep the winner's connection
+                try:
+                    c.close()
+                except Exception:
+                    pass
+                return existing
+            self._conns[endpoint] = c
+            return c
+
+    def _drop_conn(self, endpoint: tuple) -> None:
+        with self._lock:
+            c = self._conns.pop(endpoint, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def call(self, endpoint: tuple, method: str, payload: dict,
+             blob: Optional[bytes] = None) -> dict:
+        try:
+            return self._conn(endpoint).call(method, payload, blob=blob)
+        except RpcError:
+            self._drop_conn(endpoint)
+            raise
+
+    def call_binary(self, endpoint: tuple, method: str, payload: dict):
+        try:
+            return self._conn(endpoint).call_binary(method, payload)
+        except RpcError:
+            self._drop_conn(endpoint)
+            raise
+
+    # ---- read path -----------------------------------------------------
+    def cache_dir(self, table: str, shard_id: int, node: int) -> str:
+        return os.path.join(self.cat.data_dir, ".remote_cache", table,
+                            str(shard_id), str(node))
+
+    def fetch_file(self, endpoint: tuple, spec: dict, dst: str) -> None:
+        tmp = dst + ".part"
+        off = 0
+        with open(tmp, "wb") as fh:
+            while True:
+                r, data = self.call_binary(
+                    endpoint, "fetch_file", dict(spec, offset=off))
+                fh.write(data or b"")
+                off += len(data or b"")
+                self.stats["bytes_fetched"] += len(data or b"")
+                if r.get("eof", True):
+                    break
+        os.replace(tmp, dst)
+        self.stats["files_fetched"] += 1
+
+    def sync_placement(self, table: str, shard_id: int, node: int,
+                       endpoint: tuple) -> Optional[str]:
+        """Mirror a remote placement into the local cache; returns the
+        local directory (None when the remote placement does not
+        exist).  Immutable stripe files are fetched once; mutable files
+        (meta, deletes, index segments) re-fetch when size/mtime moved."""
+        r = self.call(endpoint, "list_placement",
+                      {"table": table, "shard_id": shard_id, "node": node})
+        if not r.get("exists"):
+            return None
+        self.stats["remote_syncs"] += 1
+        d = self.cache_dir(table, shard_id, node)
+        os.makedirs(d, exist_ok=True)
+        sig_path = os.path.join(d, ".sync.json")
+        try:
+            with open(sig_path) as fh:
+                sigs = json.load(fh)
+        except (OSError, ValueError):
+            sigs = {}
+        remote_names = set()
+        for f in r["files"]:
+            name = f["name"]
+            remote_names.add(name)
+            local = os.path.join(d, name)
+            sig = [f["size"], f["mtime_ns"]]
+            immutable = name.endswith(".cts")
+            if os.path.exists(local) and (
+                    immutable or sigs.get(name) == sig):
+                continue
+            self.fetch_file(endpoint,
+                            {"table": table, "shard_id": shard_id,
+                             "node": node, "name": name}, local)
+            sigs[name] = sig
+        # a file deleted remotely (deletes cleared, meta rewritten by
+        # VACUUM/TRUNCATE) must disappear from the mirror too
+        for name in list(os.listdir(d)):
+            if name.startswith(".sync") or name.endswith(".part"):
+                continue
+            if name not in remote_names:
+                try:
+                    os.remove(os.path.join(d, name))
+                except OSError:
+                    pass
+                sigs.pop(name, None)
+        with open(sig_path + ".tmp", "w") as fh:
+            json.dump(sigs, fh)
+        os.replace(sig_path + ".tmp", sig_path)
+        return d
+
+    # ---- transfer helpers (shard move) ---------------------------------
+    def pull_placement(self, table: str, shard_id: int, src_node: int,
+                       endpoint: tuple, dst_dir: str) -> bool:
+        """Copy every file of a remote placement into ``dst_dir``
+        (the over-the-wire half of citus_move_shard_placement's bulk
+        phase; reference: shard_transfer.c:472)."""
+        r = self.call(endpoint, "list_placement",
+                      {"table": table, "shard_id": shard_id,
+                       "node": src_node})
+        if not r.get("exists"):
+            return False
+        os.makedirs(dst_dir, exist_ok=True)
+        from citus_tpu.storage.writer import SHARD_META
+        # meta file last: a crash mid-pull leaves a readable placement
+        names = sorted(f["name"] for f in r["files"])
+        names.sort(key=lambda n: n == SHARD_META)
+        for name in names:
+            self.fetch_file(endpoint,
+                            {"table": table, "shard_id": shard_id,
+                             "node": src_node, "name": name},
+                            os.path.join(dst_dir, name))
+        return True
+
+    def push_placement(self, src_dir: str, table: str, shard_id: int,
+                       dst_node: int, endpoint: tuple) -> None:
+        from citus_tpu.storage.writer import SHARD_META
+        names = sorted(n for n in os.listdir(src_dir)
+                       if os.path.isfile(os.path.join(src_dir, n))
+                       and not n.endswith(".part"))
+        names.sort(key=lambda n: n == SHARD_META)
+        for name in names:
+            path = os.path.join(src_dir, name)
+            size = os.path.getsize(path)
+            off = 0
+            with open(path, "rb") as fh:
+                while True:
+                    data = fh.read(CHUNK_BYTES)
+                    last = off + len(data) >= size
+                    self.call(endpoint, "put_file",
+                              {"table": table, "shard_id": shard_id,
+                               "node": dst_node, "name": name,
+                               "offset": off, "last": last}, blob=data)
+                    off += len(data)
+                    if last:
+                        break
+
+    # ---- write path ----------------------------------------------------
+    def ship_batch(self, endpoint: tuple, table: str, values: dict,
+                   validity: dict) -> int:
+        """Send a physical sub-batch to the coordinator hosting its
+        shards."""
+        r = self.call(endpoint, "ingest_batch", {"table": table},
+                      blob=encode_batch(values, validity))
+        self.stats["batches_shipped"] += 1
+        return int(r.get("inserted", 0))
+
+    def drop_placement(self, endpoint: tuple, table: str, shard_id: int,
+                       node: int) -> None:
+        self.call(endpoint, "drop_placement",
+                  {"table": table, "shard_id": shard_id, "node": node})
+
+    def invalidate_cache(self, table: str) -> None:
+        import shutil
+        d = os.path.join(self.cat.data_dir, ".remote_cache", table)
+        shutil.rmtree(d, ignore_errors=True)
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._conns.values():
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._conns.clear()

@@ -91,6 +91,19 @@ def copy_shard_placement(cat: Catalog, shard_id: int, source_node: int,
     cat.commit()
 
 
+def _pull_one(cat: Catalog, t, s, source_node: int, dst: str) -> None:
+    """One placement's bulk/catch-up copy: shared filesystem when the
+    source directory is local, the RPC data plane when the source node
+    is hosted by another coordinator (reference: the COPY-protocol file
+    pull of executor/transmit.c + worker_shard_copy.c)."""
+    src = cat.shard_dir(t.name, s.shard_id, source_node)
+    if os.path.isdir(src):
+        _copy_placement_files(src, dst)
+    elif cat.is_remote_node(source_node) and cat.remote_data is not None:
+        cat.remote_data.pull_placement(t.name, s.shard_id, source_node,
+                                       cat.node_endpoint(source_node), dst)
+
+
 def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
                          target_node: int, lock_manager=None) -> None:
     """Move a shard placement (and its colocated peers) between nodes.
@@ -99,7 +112,13 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
     colocation group's EXCLUSIVE write lock — the same lock every DML
     writer holds while committing — so a stripe can never land on the
     source placement after the catch-up but before the flip (that write
-    would be silently lost when the source is dropped)."""
+    would be silently lost when the source is dropped).
+
+    Cross-host: a source placement hosted by another coordinator is
+    pulled over the data plane; a remote target is pushed the same way,
+    and the source drop becomes a drop_placement RPC.  The catalog flip
+    still travels through the metadata authority, so every coordinator
+    observes the new placement map."""
     from citus_tpu.transaction.write_locks import EXCLUSIVE, group_write_lock
 
     table, shard = _find_shard(cat, shard_id)
@@ -110,6 +129,7 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
     if target_node not in cat.nodes:
         raise CatalogError(f"node {target_node} does not exist")
     group = _colocated_shards(cat, table, shard)
+    target_remote = cat.is_remote_node(target_node)
     import uuid
     op_id = uuid.uuid4().int & ((1 << 62) - 1)  # collision-free across movers
     for t, s in group:
@@ -119,17 +139,18 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
     try:
         # phase 1: bulk copy with writers still running
         for t, s in group:
-            src = cat.shard_dir(t.name, s.shard_id, source_node)
-            if os.path.isdir(src):
-                _copy_placement_files(src, cat.shard_dir(t.name, s.shard_id,
-                                                         target_node))
+            _pull_one(cat, t, s, source_node,
+                      cat.shard_dir(t.name, s.shard_id, target_node))
         # phase 2: block writers for the diff copy + metadata flip only
         with group_write_lock(cat, table, EXCLUSIVE, lock_manager=lock_manager):
             for t, s in group:
-                src = cat.shard_dir(t.name, s.shard_id, source_node)
                 dst = cat.shard_dir(t.name, s.shard_id, target_node)
-                if os.path.isdir(src):
-                    _copy_placement_files(src, dst)  # final catch-up
+                _pull_one(cat, t, s, source_node, dst)  # final catch-up
+                if target_remote and os.path.isdir(dst):
+                    # staged locally, now push to the hosting coordinator
+                    cat.remote_data.push_placement(
+                        dst, t.name, s.shard_id, target_node,
+                        cat.node_endpoint(target_node))
             for t, s in group:
                 s.placements = [target_node if n == source_node else n
                                 for n in s.placements]
@@ -139,8 +160,23 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
         complete_operation(cat, op_id, success=False)  # cleaner drops targets
         raise
     complete_operation(cat, op_id, success=True)
-    # phase 3: deferred source drop
+    # phase 3: deferred source drop (RPC for a remote-hosted source)
     for t, s in group:
         src = cat.shard_dir(t.name, s.shard_id, source_node)
         if os.path.isdir(src):
             record_cleanup(cat, src, DEFERRED_ON_SUCCESS)
+        elif cat.is_remote_node(source_node) and cat.remote_data is not None:
+            try:
+                cat.remote_data.drop_placement(
+                    cat.node_endpoint(source_node), t.name, s.shard_id,
+                    source_node)
+            except Exception:
+                pass  # deferred cleanup is best-effort; cleaner re-runs
+        if target_remote:
+            # the staging copy in OUR data dir is not a placement —
+            # the hosting coordinator owns the real one now
+            dst = cat.shard_dir(t.name, s.shard_id, target_node)
+            if os.path.isdir(dst):
+                record_cleanup(cat, dst, DEFERRED_ON_SUCCESS)
+        if cat.remote_data is not None:
+            cat.remote_data.invalidate_cache(t.name)

@@ -315,8 +315,15 @@ class Binder:
             inner = self.bind_scalar(e.expr, allow_agg)
             target = T.type_from_sql(e.type_name, list(e.type_args) or None)
             if target.is_text:
+                if isinstance(inner, BLiteral) \
+                        and isinstance(inner.value, str):
+                    # typed literal of a dictionary kind (uuid '...'):
+                    # stays a string until _align coerces it into the
+                    # column's dictionary-id space (normalized there)
+                    return BLiteral(inner.value, target)
                 raise UnsupportedFeatureError("cast to text not supported")
-            if target.kind in (T.DATE, T.TIMESTAMP) \
+            if target.kind in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ,
+                               T.INTERVAL) \
                     and isinstance(inner, BLiteral) \
                     and isinstance(inner.value, str):
                 # typed literal: date '1998-12-01' folds at bind time
@@ -356,7 +363,7 @@ class Binder:
     def _coerce_string_literal(self, lit: BLiteral, target: T.ColumnType,
                                column: Optional[BColumn]) -> BLiteral:
         """'1994-01-01' vs date column, 'AIR' vs text column, etc."""
-        if target.kind in (T.DATE, T.TIMESTAMP):
+        if target.kind in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ, T.INTERVAL):
             return BLiteral(target.to_physical(lit.value), target)
         if target.is_text:
             if column is None:
@@ -456,7 +463,7 @@ class Binder:
             ivl, other_ast = e.right, e.left
         sign = 1 if e.op == "+" else -1
         other = self.bind_scalar(other_ast, allow_agg)
-        if other.type.kind not in (T.DATE, T.TIMESTAMP):
+        if other.type.kind not in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ):
             raise AnalysisError(
                 f"cannot add interval to {other.type}")
         months = sign * ivl.months
@@ -489,6 +496,27 @@ class Binder:
         op = e.op
         if isinstance(e.left, A.IntervalLiteral) \
                 or isinstance(e.right, A.IntervalLiteral):
+            # against an INTERVAL-typed expression the literal is just a
+            # microsecond scalar (comparisons, +, -); month components
+            # have no fixed us length and stay in the civil-arithmetic
+            # path below
+            lit = e.left if isinstance(e.left, A.IntervalLiteral) else e.right
+            other_ast = e.right if lit is e.left else e.left
+            if not isinstance(other_ast, A.IntervalLiteral):
+                try:
+                    other = self.bind_scalar(other_ast, allow_agg)
+                except AnalysisError:
+                    other = None
+                if other is not None and other.type.kind == T.INTERVAL \
+                        and lit.months == 0:
+                    us = lit.days * 86_400_000_000 + lit.micros
+                    blit = BLiteral(us, T.INTERVAL_T)
+                    left, right = (blit, other) if lit is e.left \
+                        else (other, blit)
+                    if op in ("=", "<>", "<", "<=", ">", ">="):
+                        return BBinOp(op, left, right, T.BOOL_T)
+                    rt = T.arith_result_type(op, left.type, right.type)
+                    return BBinOp(op, left, right, rt)
             return self._bind_interval_arith(e, allow_agg)
         left = self.bind_scalar(e.left, allow_agg)
         right = self.bind_scalar(e.right, allow_agg)
@@ -602,7 +630,7 @@ class Binder:
                 raise AnalysisError("date_trunc(unit, expr) expects a literal unit")
             unit = str(e.args[0].value)
             inner = self.bind_scalar(e.args[1], allow_agg)
-            if inner.type.kind not in (T.DATE, T.TIMESTAMP):
+            if inner.type.kind not in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ):
                 raise AnalysisError("date_trunc expects date/timestamp")
             if unit in ("month", "quarter", "year"):
                 return BDateTruncCivil(unit, inner, inner.type)
@@ -610,7 +638,7 @@ class Binder:
         if name == "extract":
             field = str(e.args[0].value).lower()
             inner = self.bind_scalar(e.args[1], allow_agg)
-            if inner.type.kind not in (T.DATE, T.TIMESTAMP):
+            if inner.type.kind not in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ):
                 raise AnalysisError("EXTRACT expects date/timestamp")
             return BExtract(field, inner)
         if name in ("upper", "lower"):

@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
   | (?P<str>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z_0-9$]*)
   | (?P<param>\$\d+)
-  | (?P<op><=|>=|<>|!=|::|=|<|>|\+|-|\*|/|%|\(|\)|,|;|\.)
+  | (?P<op><=|>=|<>|!=|::|=|<|>|\+|-|\*|/|%|\(|\)|\[|\]|,|;|\.)
     """,
     re.VERBOSE,
 )
@@ -1108,13 +1108,24 @@ class Parser:
             self.error("expected type name")
         self.next()
         name = t.value
-        # two-word types: double precision, character varying
+        # two-word types: double precision, character varying,
+        # timestamp with[out] time zone
         if name == "double" and self.peek().kind == "ident" and self.peek().value == "precision":
             self.next()
         elif name == "character":
             if self.peek().kind == "ident" and self.peek().value == "varying":
                 self.next()
             name = "varchar"
+        elif name == "timestamp" and self.peek().kind in ("ident", "kw") \
+                and self.peek().value in ("with", "without"):
+            with_tz = self.next().value == "with"
+            if not (self.peek().value == "time"
+                    and self.peek(1).value == "zone"):
+                self.error("expected TIME ZONE after WITH/WITHOUT")
+            self.next()
+            self.next()
+            if with_tz:
+                name = "timestamptz"
         args: list[int] = []
         if self.at_op("("):
             self.next()
@@ -1126,6 +1137,11 @@ class Parser:
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
+        if self.at_op("[") :
+            # 1-D array type: elem[]
+            self.next()
+            self.expect_op("]")
+            name = name + "[]"
         return name, args
 
     def parse_drop_table(self):
@@ -1793,9 +1809,11 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
-        if t.kind == "ident" and t.value in ("date", "timestamp") \
+        if t.kind == "ident" \
+                and t.value in ("date", "timestamp", "timestamptz",
+                                "uuid", "bytea") \
                 and self.peek(1).kind == "str":
-            # typed literal: date '1998-12-01' / timestamp '...'
+            # typed literal: date '1998-12-01' / uuid 'a0ee...' / ...
             tname = t.value
             self.next()
             lit = self.next()

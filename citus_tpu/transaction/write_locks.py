@@ -43,76 +43,6 @@ def lockfile_path(data_dir: str, res: str) -> str:
 
 
 @contextlib.contextmanager
-def flip_latch(data_dir: str, table_meta, shared: bool,
-               timeout: float = 30.0):
-    """Whole-table metadata-flip latch (TRUNCATE's per-shard meta
-    rewrites are not one atomic operation): readers hold it SHARED
-    across their batch loading, TRUNCATE holds it EXCLUSIVE across all
-    its flips — a scan sees the table entirely before or entirely after
-    (the reference gets this from ACCESS EXCLUSIVE vs ACCESS SHARE).
-    Deliberately NOT the write lock: reads must not wait for UPDATEs.
-
-    flock has no writer priority, so the exclusive side drops an intent
-    marker first: new readers hold off while existing ones drain —
-    PostgreSQL's ACCESS EXCLUSIVE queueing, poor man's edition.
-
-    Each writer's marker has a UNIQUE name (uuid suffix) carrying the
-    owner pid: a reader may reap a dead owner's marker with no
-    check-then-remove race against a live writer creating a fresh one —
-    unlinking a uniquely-named file can only ever remove THAT dead
-    writer's marker (pid recycling at worst delays readers until their
-    own timeout, never deletes a live marker)."""
-    import glob as _glob
-    import os
-    import time
-    import uuid as _uuid
-    from citus_tpu.utils.filelock import FileLock, LockTimeout
-    res = group_resource(table_meta)
-    path = os.path.join(data_dir, ".fl_" + res.replace(":", "_") + ".lock")
-    if shared:
-        from citus_tpu.transaction.global_deadlock import _pid_alive
-        deadline = time.monotonic() + timeout
-        while True:
-            held_off = False
-            for intent in _glob.glob(path + ".intent.*"):
-                try:
-                    with open(intent) as f:
-                        owner = int(f.read().strip() or -1)
-                except (OSError, ValueError):
-                    continue  # mid-write or already removed: re-check
-                if owner > 0 and not _pid_alive(owner):
-                    # crash cleanup: the owner died between creating the
-                    # marker and its finally-removal
-                    try:
-                        os.remove(intent)
-                    except OSError:
-                        pass
-                else:
-                    held_off = True
-            if not held_off:
-                break
-            if time.monotonic() >= deadline:
-                raise LockTimeout(
-                    f"table flip in progress on {res!r} (reader held off "
-                    f"beyond {timeout}s)")
-            time.sleep(0.005)
-        with FileLock(path, shared=True, timeout=timeout):
-            yield
-        return
-    intent = f"{path}.intent.{_uuid.uuid4().hex[:12]}"
-    with open(intent, "w") as f:
-        f.write(str(os.getpid()))
-    try:
-        with FileLock(path, shared=False, timeout=timeout):
-            yield
-    finally:
-        try:
-            os.remove(intent)
-        except OSError:
-            pass
-
-
-@contextlib.contextmanager
 def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
                      timeout: float = 30.0):
     import fcntl

@@ -54,7 +54,12 @@ FLOAT64 = "float64"
 DECIMAL = "decimal"
 DATE = "date"
 TIMESTAMP = "timestamp"
+TIMESTAMPTZ = "timestamptz"
+INTERVAL = "interval"
 TEXT = "text"
+UUID = "uuid"
+BYTEA = "bytea"
+ARRAY = "array"
 
 _EPOCH_DATE = datetime.date(1970, 1, 1)
 
@@ -68,7 +73,12 @@ _STORAGE_DTYPES = {
     DECIMAL: np.int64,
     DATE: np.int32,
     TIMESTAMP: np.int64,
+    TIMESTAMPTZ: np.int64,
+    INTERVAL: np.int64,
     TEXT: np.int32,
+    UUID: np.int32,
+    BYTEA: np.int32,
+    ARRAY: np.int32,
 }
 
 # dtype the expression/aggregate kernels compute in
@@ -82,8 +92,24 @@ _DEVICE_DTYPES = {
     DECIMAL: np.int64,
     DATE: np.int32,
     TIMESTAMP: np.int64,
+    TIMESTAMPTZ: np.int64,
+    INTERVAL: np.int64,
     TEXT: np.int32,
+    UUID: np.int32,
+    BYTEA: np.int32,
+    ARRAY: np.int32,
 }
+
+
+#: kinds whose physical value is a table-global dictionary id — the
+#: fixed-width projection of variable-width data onto the TPU's shape
+#: constraints (SURVEY "hard parts": dictionary/offset encodings at
+#: write time so kernels see fixed-width ids).  The reference stores
+#: arbitrary varlena datums in columnar chunks
+#: (columnar/columnar_tableam.c:718); here every variable-width type
+#: rides the dictionary machinery with kind-specific canonicalization
+#: (normalize_word) and rendering (render_word).
+_DICTIONARY_KINDS = (TEXT, UUID, BYTEA, ARRAY)
 
 
 @dataclass(frozen=True)
@@ -91,6 +117,7 @@ class ColumnType:
     kind: str
     precision: int = 0  # DECIMAL only
     scale: int = 0      # DECIMAL only
+    elem: Optional[str] = None  # ARRAY only: element type name
 
     # ---- classification ------------------------------------------------
     @property
@@ -111,13 +138,79 @@ class ColumnType:
 
     @property
     def is_text(self) -> bool:
-        return self.kind == TEXT
+        """Dictionary-encoded (text-routed) kinds: the physical value is
+        a table-global dictionary id, and every code path that encodes/
+        decodes through the dictionary treats these identically."""
+        return self.kind in _DICTIONARY_KINDS
 
     @property
     def is_orderable_physical(self) -> bool:
         """True when physical-value order == logical order (everything but
-        TEXT, whose dictionary ids are assigned in insertion order)."""
-        return self.kind != TEXT
+        the dictionary kinds, whose ids are assigned in insertion order)."""
+        return self.kind not in _DICTIONARY_KINDS
+
+    # ---- dictionary-kind canonicalization ------------------------------
+    def normalize_word(self, value: Any) -> str:
+        """Python value -> canonical dictionary word.  Different inputs
+        that denote the same logical value must map to one word, or
+        equality comparisons break (e.g. uppercase/lowercase uuids)."""
+        k = self.kind
+        if k == UUID:
+            import uuid as _uuid
+            try:
+                return str(_uuid.UUID(str(value)))
+            except (ValueError, AttributeError, TypeError):
+                raise AnalysisError(
+                    f"invalid input syntax for type uuid: {value!r}")
+        if k == BYTEA:
+            if isinstance(value, (bytes, bytearray, memoryview)):
+                return "\\x" + bytes(value).hex()
+            s = str(value)
+            if s.startswith("\\x"):
+                try:
+                    bytes.fromhex(s[2:])
+                except ValueError:
+                    raise AnalysisError(
+                        f"invalid hexadecimal data for bytea: {value!r}")
+                return "\\x" + s[2:].lower()
+            # PG escape-format / raw string: store its utf-8 bytes
+            return "\\x" + s.encode().hex()
+        if k == ARRAY:
+            import json as _json
+            if isinstance(value, str):
+                try:
+                    value = _json.loads(value)
+                except ValueError:
+                    raise AnalysisError(
+                        f"invalid input syntax for type array: {value!r}")
+            if isinstance(value, np.ndarray):
+                value = value.tolist()
+            if not isinstance(value, (list, tuple)):
+                raise AnalysisError(
+                    f"invalid input syntax for type array: {value!r}")
+            et = _SQL_NAMES.get(self.elem or "")
+            out = []
+            for v in value:
+                if v is None:
+                    out.append(None)
+                elif et is not None and et.is_numeric:
+                    out.append(float(v) if et.is_float else int(v))
+                else:
+                    out.append(str(v) if not isinstance(
+                        v, (int, float, bool)) else v)
+            return _json.dumps(out, separators=(",", ":"))
+        return str(value)
+
+    def render_word(self, word: str) -> Any:
+        """Canonical dictionary word -> Python value (result decode)."""
+        k = self.kind
+        if k == BYTEA:
+            return bytes.fromhex(word[2:]) if word.startswith("\\x") \
+                else word.encode()
+        if k == ARRAY:
+            import json as _json
+            return _json.loads(word)
+        return word
 
     # ---- dtypes --------------------------------------------------------
     @property
@@ -155,6 +248,20 @@ class ColumnType:
             # integer arithmetic: float .timestamp() loses sub-us precision
             delta = value.replace(tzinfo=None) - datetime.datetime(1970, 1, 1)
             return delta // datetime.timedelta(microseconds=1)
+        if k == TIMESTAMPTZ:
+            if isinstance(value, str):
+                value = datetime.datetime.fromisoformat(value)
+            if value.tzinfo is None:
+                # PostgreSQL interprets a naive input in the session
+                # TimeZone; ours is pinned to UTC
+                value = value.replace(tzinfo=datetime.timezone.utc)
+            value = value.astimezone(datetime.timezone.utc)
+            delta = value.replace(tzinfo=None) - datetime.datetime(1970, 1, 1)
+            return delta // datetime.timedelta(microseconds=1)
+        if k == INTERVAL:
+            if isinstance(value, datetime.timedelta):
+                return value // datetime.timedelta(microseconds=1)
+            return _parse_interval_us(str(value))
         raise AnalysisError(f"cannot convert value for type {self}")
 
     def from_physical(self, raw: int | float, null: bool = False) -> Any:
@@ -174,12 +281,78 @@ class ColumnType:
             return _EPOCH_DATE + datetime.timedelta(days=int(raw))
         if k == TIMESTAMP:
             return datetime.datetime.fromtimestamp(raw / 1_000_000, tz=datetime.timezone.utc).replace(tzinfo=None)
+        if k == TIMESTAMPTZ:
+            # tz-aware, pinned UTC (our session TimeZone)
+            return datetime.datetime.fromtimestamp(
+                raw / 1_000_000, tz=datetime.timezone.utc)
+        if k == INTERVAL:
+            return datetime.timedelta(microseconds=int(raw))
         raise AnalysisError(f"cannot convert value for type {self}")
 
     def __str__(self) -> str:
         if self.kind == DECIMAL:
             return f"decimal({self.precision},{self.scale})"
+        if self.kind == ARRAY:
+            return f"{self.elem or 'text'}[]"
         return self.kind
+
+
+#: microseconds per named interval unit (day-time intervals only: a
+#: month has no fixed length in microseconds, so PG-style month/year
+#: components are rejected rather than silently approximated)
+_INTERVAL_UNITS_US = {
+    "microsecond": 1, "microseconds": 1, "us": 1,
+    "millisecond": 1_000, "milliseconds": 1_000, "ms": 1_000,
+    "second": 1_000_000, "seconds": 1_000_000, "sec": 1_000_000,
+    "secs": 1_000_000, "s": 1_000_000,
+    "minute": 60_000_000, "minutes": 60_000_000, "min": 60_000_000,
+    "mins": 60_000_000, "m": 60_000_000,
+    "hour": 3_600_000_000, "hours": 3_600_000_000, "h": 3_600_000_000,
+    "day": 86_400_000_000, "days": 86_400_000_000, "d": 86_400_000_000,
+    "week": 7 * 86_400_000_000, "weeks": 7 * 86_400_000_000,
+}
+
+
+def _parse_interval_us(s: str) -> int:
+    """'1 day 02:30:00', '3 hours', '-90 minutes', '00:00:01.5' ->
+    microseconds.  Month/year components raise (no fixed us length)."""
+    import re
+    total = 0
+    rest = s.strip().lower()
+    if not rest:
+        raise AnalysisError("invalid input syntax for type interval: ''")
+    # leading sign applies to the whole literal (PG: '-1 day 02:00' is
+    # compound; we keep the simpler whole-literal sign)
+    sign = 1
+    if rest.startswith("-") and not re.match(r"-\d+:\d", rest):
+        sign, rest = -1, rest[1:].strip()
+    # hh:mm:ss[.ffffff] tail
+    m = re.search(r"(-?)(\d+):(\d{1,2})(?::(\d{1,2})(\.\d+)?)?\s*$", rest)
+    if m:
+        tsign = -1 if m.group(1) else 1
+        us = (int(m.group(2)) * 3_600_000_000
+              + int(m.group(3)) * 60_000_000
+              + int(m.group(4) or 0) * 1_000_000)
+        if m.group(5):
+            us += round(float(m.group(5)) * 1_000_000)
+        total += tsign * us
+        rest = rest[:m.start()].strip()
+    for num, unit in re.findall(r"(-?\d+(?:\.\d+)?)\s*([a-z]+)", rest):
+        if unit in ("month", "months", "mon", "mons", "year", "years",
+                    "y", "yr", "yrs"):
+            raise AnalysisError(
+                "interval month/year components are not supported "
+                "(no fixed microsecond length); use days")
+        mult = _INTERVAL_UNITS_US.get(unit)
+        if mult is None:
+            raise AnalysisError(
+                f"invalid input syntax for type interval: {s!r}")
+        total += round(float(num) * mult)
+    consumed = re.sub(r"(-?\d+(?:\.\d+)?)\s*([a-z]+)", "", rest).strip()
+    if consumed:
+        raise AnalysisError(
+            f"invalid input syntax for type interval: {s!r}")
+    return sign * total
 
 
 # canonical singletons
@@ -191,7 +364,15 @@ FLOAT32_T = ColumnType(FLOAT32)
 FLOAT64_T = ColumnType(FLOAT64)
 DATE_T = ColumnType(DATE)
 TIMESTAMP_T = ColumnType(TIMESTAMP)
+TIMESTAMPTZ_T = ColumnType(TIMESTAMPTZ)
+INTERVAL_T = ColumnType(INTERVAL)
 TEXT_T = ColumnType(TEXT)
+UUID_T = ColumnType(UUID)
+BYTEA_T = ColumnType(BYTEA)
+
+
+def array_t(elem: str = "text") -> ColumnType:
+    return ColumnType(ARRAY, elem=elem)
 
 
 def decimal_t(precision: int, scale: int) -> ColumnType:
@@ -216,14 +397,23 @@ _SQL_NAMES = {
     "float8": FLOAT64_T,
     "date": DATE_T,
     "timestamp": TIMESTAMP_T,
+    "timestamptz": TIMESTAMPTZ_T,
+    "interval": INTERVAL_T,
     "text": TEXT_T,
     "varchar": TEXT_T,
     "char": TEXT_T,
+    "uuid": UUID_T,
+    "bytea": BYTEA_T,
 }
 
 
 def type_from_sql(name: str, args: Optional[list[int]] = None) -> ColumnType:
     name = name.lower()
+    if name.endswith("[]"):
+        elem = name[:-2].strip()
+        if elem not in _SQL_NAMES and elem not in ("decimal", "numeric"):
+            raise AnalysisError(f"unknown array element type: {elem}")
+        return array_t(elem)
     if name in ("decimal", "numeric"):
         if not args:
             # NUMERIC without precision: default a wide fixed-point
@@ -267,6 +457,20 @@ def arith_result_type(op: str, a: ColumnType, b: ColumnType) -> ColumnType:
         # allow date +/- int (day arithmetic)
         if op in ("+", "-") and a.kind == DATE and b.is_integer:
             return DATE_T
+        # timestamp[tz]/interval arithmetic: both sides are microsecond
+        # int64 physicals, so device addition is exact
+        ts_kinds = (TIMESTAMP, TIMESTAMPTZ)
+        if op in ("+", "-") and a.kind in ts_kinds and b.kind == INTERVAL:
+            return ColumnType(a.kind)
+        if op == "+" and a.kind == INTERVAL and b.kind in ts_kinds:
+            return ColumnType(b.kind)
+        if op == "-" and a.kind in ts_kinds and b.kind == a.kind:
+            return INTERVAL_T
+        if op in ("+", "-") and a.kind == INTERVAL and b.kind == INTERVAL:
+            return INTERVAL_T
+        if op == "*" and ((a.kind == INTERVAL and b.is_integer)
+                          or (a.is_integer and b.kind == INTERVAL)):
+            return INTERVAL_T
         raise AnalysisError(f"operator {op} not defined for {a}, {b}")
     if op == "/":
         # exact decimal division is finalized on host; device computes

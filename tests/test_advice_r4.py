@@ -69,32 +69,36 @@ class TestPartitionBoundEnforcement:
         assert total == pruned == 0
 
 
-def test_flip_latch_stale_intent_reaped(tmp_path):
-    """Medium finding: a writer killed between dropping the .intent
-    marker and its finally-removal must not lock readers out forever —
-    readers reap a marker whose owner pid is dead."""
+def test_snapshot_dead_writer_generation_reaped(tmp_path):
+    """Medium finding (round 4, carried into the snapshot design): a
+    writer killed mid-flip must not lock readers out forever — readers
+    reap a flip registration whose owner pid is dead."""
+    import json
+
     from citus_tpu.config import ExecutorSettings, Settings
     st = Settings(executor=ExecutorSettings(lock_timeout_s=2.0))
     cl = ct.Cluster(str(tmp_path / "db"), settings=st)
     cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
     cl.execute("SELECT create_distributed_table('t', 'k', 4)")
     cl.copy_from("t", columns={"k": np.arange(100), "v": np.arange(100)})
+    from citus_tpu.transaction.snapshot import _snap_paths
     from citus_tpu.transaction.write_locks import group_resource
     res = group_resource(cl.catalog.table("t"))
-    intent = os.path.join(cl.catalog.data_dir,
-                          ".fl_" + res.replace(":", "_")
-                          + ".lock.intent.deadbeef0000")
-    # forge a crash: intent owned by a pid that no longer exists
-    with open(intent, "w") as f:
-        f.write("999999999")
+    path, _lock = _snap_paths(cl.catalog.data_dir, res)
+    # forge a crash: flip registered by a pid that no longer exists
+    with open(path, "w") as f:
+        json.dump({"gen": 7, "writers": {"999999999": 1}}, f)
     assert cl.execute("SELECT count(*) FROM t").rows == [(100,)]
-    assert not os.path.exists(intent)  # reader reaped it
+    with open(path) as f:
+        assert json.load(f)["writers"] == {}  # reader reaped it
     cl.close()
 
 
-def test_flip_latch_live_intent_still_blocks(tmp_path):
-    """A marker owned by a LIVE process keeps holding new readers off
-    (the writer-priority queueing the marker exists for)."""
+def test_snapshot_live_writer_mid_flip_times_out(tmp_path):
+    """A flip registration owned by a LIVE process keeps holding
+    readers off (they cannot observe a consistent generation)."""
+    import json
+
     from citus_tpu.config import ExecutorSettings, Settings
     from citus_tpu.utils.filelock import LockTimeout
     st = Settings(executor=ExecutorSettings(lock_timeout_s=0.3))
@@ -102,18 +106,17 @@ def test_flip_latch_live_intent_still_blocks(tmp_path):
     cl.execute("CREATE TABLE t (k bigint NOT NULL)")
     cl.execute("SELECT create_distributed_table('t', 'k', 4)")
     cl.copy_from("t", columns={"k": np.arange(10)})
+    from citus_tpu.transaction.snapshot import _snap_paths
     from citus_tpu.transaction.write_locks import group_resource
     res = group_resource(cl.catalog.table("t"))
-    intent = os.path.join(cl.catalog.data_dir,
-                          ".fl_" + res.replace(":", "_")
-                          + ".lock.intent.cafebabe0000")
-    with open(intent, "w") as f:
-        f.write(str(os.getpid()))  # this (live) process
+    path, _lock = _snap_paths(cl.catalog.data_dir, res)
+    with open(path, "w") as f:
+        json.dump({"gen": 7, "writers": {str(os.getpid()): 1}}, f)
     try:
         with pytest.raises(LockTimeout):
             cl.execute("SELECT count(*) FROM t")
     finally:
-        os.remove(intent)
+        os.remove(path)
     cl.close()
 
 

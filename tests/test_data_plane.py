@@ -1,0 +1,249 @@
+"""Cross-host bulk data plane: coordinators with SEPARATE data dirs
+exchange shard bytes over RPC — placement reads, distributed COPY
+routing, shard moves, and dictionary sync.
+
+Reference: executor/transmit.c (COPY-protocol file transfer),
+operations/worker_shard_copy.c, commands/multi_copy.c per-shard stream
+forwarding, pg_dist_node nodename/nodeport.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    """Two coordinators, two data dirs, one logical cluster: A is the
+    metadata authority hosting node 0; B attaches and hosts node 1."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    na = a.register_node()
+    b = ct.Cluster(str(tmp_path / "b"), data_port=0, hosted_nodes=set(),
+                   coordinator=("127.0.0.1", a.control_port), n_nodes=0)
+    nb = b.register_node()
+    a._maybe_reload_catalog(force_sync=True)
+    yield a, b, na, nb
+    b.close()
+    a.close()
+
+
+def test_distributed_query_spans_both_processes(pair):
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE t (k bigint NOT NULL, v bigint, c text)")
+    a.execute("SELECT create_distributed_table('t', 'k', 4)")
+    t = a.catalog.table("t")
+    owners = {s.placements[0] for s in t.shards}
+    assert owners == {na, nb}, "shards must land on both hosts"
+    n = 2000
+    a.copy_from("t", columns={
+        "k": np.arange(n), "v": np.arange(n) * 3,
+        "c": [f"w{i % 7}" for i in range(n)]})
+    # rows physically split across the two data dirs
+    local_rows = 0
+    for s in t.shards:
+        d = a.catalog.shard_dir("t", s.shard_id, s.placements[0])
+        if os.path.isdir(d):
+            from citus_tpu.storage.writer import _load_meta
+            local_rows += _load_meta(d)["row_count"]
+    assert 0 < local_rows < n, "some rows must live on the remote host"
+    # the full answer needs shards from BOTH processes
+    assert a.execute("SELECT count(*), sum(v) FROM t").rows == \
+        [(n, 3 * n * (n - 1) // 2)]
+    # text decode across hosts (dictionary authority = A)
+    r = a.execute("SELECT c, count(*) FROM t GROUP BY c ORDER BY c")
+    assert len(r.rows) == 7 and sum(x[1] for x in r.rows) == n
+    # B answers the same query, fetching A-hosted shards over the wire
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT count(*), sum(v) FROM t").rows == \
+        [(n, 3 * n * (n - 1) // 2)]
+    assert a.catalog.remote_data.stats["files_fetched"] > 0
+    assert b.catalog.remote_data.stats["files_fetched"] > 0
+
+
+def test_move_shard_placement_over_the_wire(pair):
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE m (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('m', 'k', 4)")
+    n = 1000
+    a.copy_from("m", columns={"k": np.arange(n), "v": np.ones(n, np.int64)})
+    before = a.execute("SELECT count(*), sum(v) FROM m").rows
+    t = a.catalog.table("m")
+    moved = next(s for s in t.shards if s.placements[0] == nb)
+    # B -> A: pull over the data plane, flip, drop on B via RPC
+    a.execute(f"SELECT citus_move_shard_placement({moved.shard_id}, "
+              f"{nb}, {na})")
+    t = a.catalog.table("m")
+    s2 = next(s for s in t.shards if s.shard_id == moved.shard_id)
+    assert s2.placements == [na]
+    assert os.path.isdir(a.catalog.shard_dir("m", moved.shard_id, na))
+    assert a.execute("SELECT count(*), sum(v) FROM m").rows == before
+    # A -> B: push over the data plane
+    back = next(s for s in t.shards if s.placements[0] == na)
+    a.execute(f"SELECT citus_move_shard_placement({back.shard_id}, "
+              f"{na}, {nb})")
+    assert os.path.isdir(b.catalog.shard_dir("m", back.shard_id, nb))
+    assert a.execute("SELECT count(*), sum(v) FROM m").rows == before
+    # B sees the flipped placement map and still answers
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT count(*), sum(v) FROM m").rows == before
+
+
+def test_remote_write_restrictions(pair):
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE r (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('r', 'k', 4)")
+    from citus_tpu.errors import UnsupportedFeatureError
+    s = a.session()
+    s.execute("BEGIN")
+    with pytest.raises(UnsupportedFeatureError, match="cross-host 2PC"):
+        s.execute("INSERT INTO r VALUES (1, 2)")
+    s.execute("ROLLBACK")
+
+
+def test_update_delete_on_remote_shards_visible(pair):
+    """DML executed on the coordinator hosting the shard is visible to
+    the peer's next read (mutable files re-sync)."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE d (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('d', 'k', 4)")
+    n = 400
+    a.copy_from("d", columns={"k": np.arange(n), "v": np.zeros(n, np.int64)})
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT count(*) FROM d").rows == [(n,)]
+    # B deletes rows it hosts; A must observe the deletion bitmaps
+    t = b.catalog.table("d")
+    hosted = [s for s in t.shards if s.placements[0] == nb]
+    assert hosted
+    r = b.execute("DELETE FROM d WHERE k % 2 = 1")
+    deleted = r.explain["deleted"]
+    assert deleted > 0
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT count(*) FROM d").rows == [(n - deleted,)]
+
+
+def test_two_os_processes_two_data_dirs(tmp_path):
+    """The VERDICT criterion: coordinator processes that do NOT share a
+    data directory answer a distributed query whose shards live on both,
+    and complete citus_move_shard_placement over the wire."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    na = a.register_node()
+    worker = textwrap.dedent(f"""
+        import sys, time
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import citus_tpu as ct
+        b = ct.Cluster({str(tmp_path / 'b')!r}, data_port=0,
+                       hosted_nodes=set(), n_nodes=0,
+                       coordinator=("127.0.0.1", {a.control_port}))
+        nb = b.register_node()
+        print("READY", nb, flush=True)
+        sys.stdout.close()
+        time.sleep(120)
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", worker],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = proc.stdout.readline().split()
+        assert line and line[0] == "READY", f"worker failed: {line}"
+        nb = int(line[1])
+        a._maybe_reload_catalog(force_sync=True)
+        assert a.catalog.nodes[nb].endpoint is not None
+        a.execute("CREATE TABLE big (k bigint NOT NULL, v bigint)")
+        a.execute("SELECT create_distributed_table('big', 'k', 4)")
+        n = 3000
+        a.copy_from("big", columns={"k": np.arange(n),
+                                    "v": np.arange(n)})
+        t = a.catalog.table("big")
+        assert {s.placements[0] for s in t.shards} == {na, nb}
+        # (a) distributed query across both OS processes
+        assert a.execute("SELECT count(*), sum(v) FROM big").rows == \
+            [(n, n * (n - 1) // 2)]
+        # (b) move a remote-hosted shard into this process over the wire
+        moved = next(s for s in t.shards if s.placements[0] == nb)
+        a.execute(f"SELECT citus_move_shard_placement({moved.shard_id}, "
+                  f"{nb}, {na})")
+        assert a.execute("SELECT count(*), sum(v) FROM big").rows == \
+            [(n, n * (n - 1) // 2)]
+        t = a.catalog.table("big")
+        assert next(s for s in t.shards
+                    if s.shard_id == moved.shard_id).placements == [na]
+    finally:
+        proc.kill()
+        proc.wait()
+        a.close()
+
+
+def test_rpc_auth_rejects_unauthenticated_peer(tmp_path):
+    """VERDICT #8: an unauthenticated client is refused registration and
+    catalog fetch; a wrong secret is refused too; the right secret
+    works."""
+    from citus_tpu.net.rpc import RpcClient, RpcError
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0, secret=b"s3cret")
+    a.register_node()
+    port = a.control_port
+    # no secret: server rejects the frame
+    c = RpcClient("127.0.0.1", port)
+    with pytest.raises(RpcError):
+        c.call("fetch_catalog")
+    c.close()
+    # wrong secret
+    c = RpcClient("127.0.0.1", port, secret=b"wrong")
+    with pytest.raises(RpcError):
+        c.call("fetch_catalog")
+    c.close()
+    # right secret: full attach works end-to-end
+    b = ct.Cluster(str(tmp_path / "b"), data_port=0, hosted_nodes=set(),
+                   n_nodes=0, coordinator=("127.0.0.1", port),
+                   secret=b"s3cret")
+    nb = b.register_node()
+    assert nb in b.catalog.nodes
+    # and the DATA plane refuses unauthenticated reads of shard bytes
+    dc = RpcClient("127.0.0.1", a.data_port)
+    with pytest.raises(RpcError):
+        dc.call("list_placement", {"table": "x", "shard_id": 1, "node": 0})
+    dc.close()
+    b.close()
+    a.close()
+
+
+def test_blob_tamper_detection():
+    """A substituted same-length binary frame fails the digest check."""
+    import socket
+    import struct
+    import threading
+
+    from citus_tpu.net.rpc import AuthError, RpcServer, _recv, _send
+    srv = RpcServer(port=0, secret=b"k")
+    received = []
+    srv.register("put", lambda p, blob: received.append(blob) or {"ok": 1})
+    srv.start()
+    # craft a frame with a valid HMAC but swapped blob bytes
+    s = socket.create_connection(("127.0.0.1", srv.port))
+    import hashlib
+    import hmac as hm
+    import json
+    blob = b"A" * 16
+    obj = {"id": 1, "method": "put", "payload": {}, "bin": 16,
+           "bin_sha256": hashlib.sha256(blob).hexdigest()}
+    body = json.dumps(obj, sort_keys=True).encode()
+    obj["hmac"] = hm.new(b"k", body, hashlib.sha256).hexdigest()
+    data = json.dumps(obj).encode()
+    s.sendall(struct.pack(">I", len(data)) + data)
+    s.sendall(struct.pack(">I", 16) + b"B" * 16)  # tampered bytes
+    resp = _recv(s, b"k")
+    assert resp is not None and "authentication" in resp[0].get("error", "")
+    assert received == []
+    s.close()
+    srv.stop()
