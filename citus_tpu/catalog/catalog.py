@@ -1166,7 +1166,12 @@ class Catalog:
             key = (table, column)
             self._ensure_dict(table, column)
             words, index = self._dicts[key], self._dict_index[key]
-            arr = np.asarray(values, dtype=object)
+            # element-wise fill: np.asarray would turn equal-length list
+            # values (array columns) into a 2-D object array
+            vlist = list(values)
+            arr = np.empty(len(vlist), dtype=object)
+            for i, v in enumerate(vlist):
+                arr[i] = v
             nulls = np.array([v is None for v in arr], dtype=bool)
             out = np.zeros(len(arr), dtype=np.int64)
             nn = ~nulls

@@ -96,6 +96,17 @@ def _srf_result(name: str, args, alias) -> "Result":
         end = stop + (1 if step > 0 else -1)
         rows = [(v,) for v in range(start, end, step)]
         return Result(columns=[alias or "generate_series"], rows=rows)
+    if name == "unnest":
+        # reference: unnest(anyarray) SRF — one row per element
+        if len(vals) != 1:
+            raise AnalysisError("unnest(array) expects one argument")
+        arr = vals[0]
+        if arr is None:
+            return Result(columns=[alias or "unnest"], rows=[])
+        if not isinstance(arr, (list, tuple)):
+            raise AnalysisError(f"unnest requires an array (got {arr!r})")
+        return Result(columns=[alias or "unnest"],
+                      rows=[(v,) for v in arr])
     raise UnsupportedFeatureError(
         f"set-returning function {name}() is not supported in FROM")
 
@@ -2258,6 +2269,11 @@ class Cluster:
         if isinstance(stmt, A.Select) and any(
                 isinstance(i.expr, A.WindowCall) for i in stmt.items):
             return self._execute_window(stmt)
+        if isinstance(stmt, A.Select) and any(
+                isinstance(i.expr, A.FuncCall) and i.expr.name == "unnest"
+                for i in stmt.items):
+            from citus_tpu.commands.select_exec import _execute_unnest
+            return _execute_unnest(self, stmt)
         if isinstance(stmt, A.Select):
             # recursive planning: materialize subqueries first
             from citus_tpu.planner.recursive import rewrite_subqueries

@@ -153,7 +153,8 @@ def _apply_rls(cl, role: str, stmt: A.Statement):
                 new_ctes.append((n, rew_stmt(sel, frozenset(seen))))
                 seen.add(n)
             return A.WithSelect(new_ctes,
-                                rew_stmt(s.body, frozenset(seen)))
+                                rew_stmt(s.body, frozenset(seen)),
+                                s.recursive, s.cte_cols)
         if not isinstance(s, A.Select):
             return s
         return dataclasses.replace(

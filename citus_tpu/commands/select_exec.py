@@ -13,7 +13,9 @@ from __future__ import annotations
 
 from typing import Optional
 
-from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
+from citus_tpu.errors import (
+    AnalysisError, ExecutionError, UnsupportedFeatureError,
+)
 from citus_tpu.executor import Result, execute_select
 from citus_tpu.planner import ast as A
 from citus_tpu.planner import parse_sql
@@ -688,7 +690,20 @@ def _execute_with(cl, stmt: A.WithSelect) -> Result:
 
     try:
         for name, sel in stmt.ctes:
-            r = cl._execute_stmt(remap_select(sel))
+            from citus_tpu.cluster import _from_relations
+            if stmt.recursive and name in _from_relations(sel):
+                r = _iterate_recursive_cte(cl, name, sel, remap_select,
+                                           stmt.cte_cols.get(name))
+            else:
+                r = cl._execute_stmt(remap_select(sel))
+                cols = stmt.cte_cols.get(name)
+                if cols is not None:
+                    if len(cols) != len(r.columns):
+                        raise AnalysisError(
+                            f'CTE "{name}" has {len(r.columns)} columns, '
+                            f"{len(cols)} aliases given")
+                    r = Result(columns=list(cols), rows=r.rows,
+                               types=r.types)
             tmp = _create_temp_from_result(cl, "cte", name, r)
             mapping[name] = tmp
             temps.append(tmp)
@@ -700,3 +715,133 @@ def _execute_with(cl, stmt: A.WithSelect) -> Result:
                 cl.drop_table(tmp)
             except Exception:
                 pass
+
+
+#: safety caps for WITH RECURSIVE (the reference relies on PostgreSQL's
+#: executor, which iterates unboundedly; a runaway recursion here would
+#: eat the coordinator, so both depth and total rows are capped)
+RECURSIVE_MAX_ITERATIONS = 500
+RECURSIVE_MAX_ROWS = 1_000_000
+
+
+def _iterate_recursive_cte(cl, name: str, sel, remap_select, cols):
+    """WITH RECURSIVE iteration, coordinator-materialized: the CTE must
+    be ``base UNION [ALL] recursive_term``; each round the recursive
+    term runs with the CTE name bound to the PREVIOUS round's rows (the
+    PostgreSQL working-table semantics), until a round yields nothing
+    new.  Reference: recursive_planning.c:1175-1181 supports recursive
+    CTEs through exactly this materialize-and-iterate shape."""
+    from citus_tpu.cluster import _from_relations
+    if not (isinstance(sel, A.SetOp) and sel.op == "union"):
+        raise UnsupportedFeatureError(
+            "a recursive CTE must be 'base UNION [ALL] recursive-term'")
+    base, rec = sel.left, sel.right
+    if name in _from_relations(base):
+        raise UnsupportedFeatureError(
+            "the recursive reference must be in the second UNION arm")
+    dedup = not sel.all  # UNION distinct: drop already-seen rows
+    base_r = cl._execute_stmt(remap_select(base))
+    out_cols = list(cols) if cols is not None else list(base_r.columns)
+    if cols is not None and len(cols) != len(base_r.columns):
+        raise AnalysisError(
+            f'CTE "{name}" has {len(base_r.columns)} columns, '
+            f"{len(cols)} aliases given")
+    seen = set(base_r.rows) if dedup else None
+    working = list(dict.fromkeys(base_r.rows)) if dedup else list(base_r.rows)
+    result = list(working)
+    iterations = 0
+    while working:
+        iterations += 1
+        if iterations > RECURSIVE_MAX_ITERATIONS:
+            raise ExecutionError(
+                f"recursive CTE {name!r} exceeded "
+                f"{RECURSIVE_MAX_ITERATIONS} iterations")
+        wr = Result(columns=out_cols, rows=working, types=base_r.types)
+        wtmp = _create_temp_from_result(cl, "rcte", name, wr)
+        try:
+            import dataclasses as _dc
+
+            def bind_working(item):
+                if isinstance(item, A.TableRef):
+                    if item.name == name:
+                        return A.TableRef(wtmp, item.alias or name)
+                    return item
+                if isinstance(item, A.Join):
+                    return _dc.replace(item, left=bind_working(item.left),
+                                       right=bind_working(item.right))
+                if isinstance(item, A.SubqueryRef):
+                    return _dc.replace(item, select=_dc.replace(
+                        item.select, from_=bind_working(item.select.from_)))
+                return item
+
+            step = remap_select(rec)
+            step = _dc.replace(step, from_=bind_working(step.from_))
+            rr = cl._execute_stmt(step)
+        finally:
+            try:
+                cl.drop_table(wtmp)
+            except Exception:
+                pass
+        fresh = []
+        for row in rr.rows:
+            if dedup:
+                if row in seen:
+                    continue
+                seen.add(row)
+            fresh.append(row)
+        result.extend(fresh)
+        if len(result) > RECURSIVE_MAX_ROWS:
+            raise ExecutionError(
+                f"recursive CTE {name!r} exceeded {RECURSIVE_MAX_ROWS} rows")
+        working = fresh
+    return Result(columns=out_cols, rows=result, types=base_r.types)
+
+
+def _execute_unnest(cl, stmt):
+    """SELECT ... unnest(arr_expr) ... FROM ...: run the query with the
+    array expression in the unnest's place, then explode each row once
+    per element, repeating the other output columns (PostgreSQL's
+    SRF-in-target-list expansion for a single SRF).
+
+    Reference: unnest(anyarray); multiple SRFs in one target list (PG's
+    lock-step expansion) are not supported."""
+    import dataclasses
+
+    srf_idx = [i for i, it in enumerate(stmt.items)
+               if isinstance(it.expr, A.FuncCall) and it.expr.name == "unnest"]
+    if len(srf_idx) != 1:
+        raise UnsupportedFeatureError(
+            "only one unnest() per target list is supported")
+    i = srf_idx[0]
+    call = stmt.items[i].expr
+    if len(call.args) != 1:
+        raise AnalysisError("unnest(array) expects one argument")
+    if stmt.group_by or stmt.having or stmt.distinct:
+        raise UnsupportedFeatureError(
+            "unnest() cannot be combined with GROUP BY/HAVING/DISTINCT")
+    inner_items = list(stmt.items)
+    inner_items[i] = A.SelectItem(call.args[0],
+                                  stmt.items[i].alias or "unnest")
+    inner = dataclasses.replace(stmt, items=inner_items,
+                                order_by=[], limit=None, offset=None)
+    r = cl._execute_stmt(inner)
+    out_rows = []
+    for row in r.rows:
+        arr = row[i]
+        if arr is None:
+            continue  # PG: NULL array contributes no rows
+        if not isinstance(arr, (list, tuple)):
+            raise AnalysisError(
+                f"unnest requires an array column (got {type(arr).__name__})")
+        for v in arr:
+            out_rows.append(row[:i] + (v,) + row[i + 1:])
+    cols = list(r.columns)
+    cols[i] = stmt.items[i].alias or "unnest"
+    from citus_tpu.cluster import _sort_rows
+    if stmt.order_by:
+        out_rows = _sort_rows(out_rows, cols, stmt.order_by)
+    if stmt.offset:
+        out_rows = out_rows[stmt.offset:]
+    if stmt.limit is not None:
+        out_rows = out_rows[:stmt.limit]
+    return Result(columns=cols, rows=out_rows)

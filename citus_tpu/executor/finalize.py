@@ -37,10 +37,12 @@ def extract_aggs(plan: PhysicalPlan, partials: tuple,
         elif ex.kind == "sum":
             s = np.asarray(partials[ex.slots[0]])
             c = np.asarray(partials[ex.slots[1]])
+            _check_sum_overflow(ex, partials, c)
             out.append((s, c > 0))
         elif ex.kind == "avg":
             s = np.asarray(partials[ex.slots[0]])
             c = np.asarray(partials[ex.slots[1]])
+            _check_sum_overflow(ex, partials, c)
             valid = c > 0
             if ex.out_type.is_float:
                 v = np.divide(s, np.where(valid, c, 1))
@@ -68,6 +70,32 @@ def extract_aggs(plan: PhysicalPlan, partials: tuple,
                 raise AssertionError(ex.kind)
             out.append(fin(ex, partials, cat))
     return out
+
+
+#: |shadow float sum| at or beyond this proves the exact int64 sum
+#: cannot fit (2^62: a 2x margin over int64 range absorbs float error)
+_SUM_OVERFLOW_LIMIT = float(1 << 62)
+
+
+def _check_sum_overflow(ex: AggExtract, partials: tuple, counts) -> None:
+    """sum/avg over int64-accumulated numerics carry a float64 shadow
+    sum in slot 2 (planner/physical.py lower_aggregates); reject results
+    whose true sum provably left int64 range rather than returning the
+    silently wrapped value.  The reference's NUMERIC is arbitrary-
+    precision and never overflows — erroring is the honest analog."""
+    if len(ex.slots) < 3:
+        return
+    shadow = np.asarray(partials[ex.slots[2]], np.float64)
+    # the float cast of a decimal yields the LOGICAL value; the exact
+    # accumulator holds scale-shifted integers — compare in scaled space
+    scale = ex.out_type.scale if ex.out_type.is_decimal else 0
+    limit = _SUM_OVERFLOW_LIMIT / (10.0 ** scale)
+    bad = (np.abs(shadow) >= limit) & (np.asarray(counts) > 0)
+    if bad.any():
+        from citus_tpu.errors import ExecutionError
+        raise ExecutionError(
+            "numeric value out of range: sum() exceeds the exact 64-bit "
+            "accumulator (reduce the aggregate's scale or range)")
 
 
 def decode_qualified(cat: Catalog, expr_type: T.ColumnType,

@@ -471,11 +471,15 @@ class SelectItem:
 
 @dataclass
 class WithSelect(Statement):
-    """WITH name AS (SELECT ...) [, ...] SELECT ... — each CTE
-    materializes as an intermediate result (reference: cte_inline.c /
-    recursive_planning.c materialization path)."""
+    """WITH [RECURSIVE] name AS (SELECT ...) [, ...] SELECT ... — each
+    CTE materializes as an intermediate result (reference: cte_inline.c
+    / recursive_planning.c materialization path; recursive CTEs iterate
+    coordinator-side like recursive_planning.c:1175's supported case)."""
     ctes: list = field(default_factory=list)  # [(name, Select)]
     body: "Select" = None
+    recursive: bool = False
+    # name -> explicit column alias list (WITH r(n) AS ...)
+    cte_cols: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -358,6 +358,10 @@ class Binder:
         if e.type_name == "string":
             # untyped until coerced against the other side of a comparison
             return BLiteral(v, T.TEXT_T)
+        if e.type_name == "array":
+            # stays a Python list until _align coerces it into an array
+            # column's dictionary-id space (canonical JSON word)
+            return BLiteral(list(v), T.array_t())
         raise AnalysisError(f"bad literal {e}")
 
     def _coerce_string_literal(self, lit: BLiteral, target: T.ColumnType,
@@ -383,10 +387,12 @@ class Binder:
         """Insert scale/cast adjustments so both sides share physical space."""
         lt, rt = left.type, right.type
         # string literal coercion
-        if isinstance(right, BLiteral) and rt.is_text and not lt.is_text:
+        if isinstance(right, BLiteral) and rt.is_text and not lt.is_text \
+                and isinstance(right.value, str):
             right = self._coerce_string_literal(right, lt, None)
             rt = right.type
-        if isinstance(left, BLiteral) and lt.is_text and not rt.is_text:
+        if isinstance(left, BLiteral) and lt.is_text and not rt.is_text \
+                and isinstance(left.value, str):
             left = self._coerce_string_literal(left, rt, None)
             lt = left.type
         if lt.is_text and rt.is_text:
@@ -396,9 +402,11 @@ class Binder:
                     e = e.operand  # remapped ids live in the base dictionary
                 return e if isinstance(e, BColumn) else None
             col = text_base(left) or text_base(right)
-            if isinstance(right, BLiteral) and isinstance(right.value, str):
+            if isinstance(right, BLiteral) \
+                    and isinstance(right.value, (str, list, bytes)):
                 right = self._coerce_string_literal(right, lt, col)
-            elif isinstance(left, BLiteral) and isinstance(left.value, str):
+            elif isinstance(left, BLiteral) \
+                    and isinstance(left.value, (str, list, bytes)):
                 left = self._coerce_string_literal(left, rt, col)
             elif isinstance(left, BColumn) and isinstance(right, BColumn):
                 lsrc = self.text_source(left)

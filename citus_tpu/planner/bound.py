@@ -307,7 +307,7 @@ def compile_expr(e: BExpr, xp):
     if isinstance(e, BExtract):
         f = compile_expr(e.operand, xp)
         field = e.field
-        is_ts = e.operand.type.kind == T.TIMESTAMP
+        is_ts = e.operand.type.kind in (T.TIMESTAMP, T.TIMESTAMPTZ)
         US_DAY = np.int64(86_400_000_000)
 
         def run_extract(env):
@@ -350,7 +350,7 @@ def compile_expr(e: BExpr, xp):
     if isinstance(e, BAddMonths):
         f = compile_expr(e.operand, xp)
         months = int(e.months)
-        is_ts = e.operand.type.kind == T.TIMESTAMP
+        is_ts = e.operand.type.kind in (T.TIMESTAMP, T.TIMESTAMPTZ)
         US_DAY = np.int64(86_400_000_000)
 
         def run_add_months(env):
@@ -381,7 +381,7 @@ def compile_expr(e: BExpr, xp):
     if isinstance(e, BDateTruncCivil):
         f = compile_expr(e.operand, xp)
         unit = e.unit
-        is_ts = e.operand.type.kind == T.TIMESTAMP
+        is_ts = e.operand.type.kind in (T.TIMESTAMP, T.TIMESTAMPTZ)
         US_DAY = np.int64(86_400_000_000)
 
         def run_trunc_civil(env):

@@ -373,9 +373,21 @@ class Parser:
 
     def parse_with_select(self) -> A.WithSelect:
         self.expect_kw("with")
+        recursive = False
+        if self.peek().kind == "ident" and self.peek().value == "recursive":
+            self.next()
+            recursive = True
         ctes = []
+        cte_cols: dict = {}
         while True:
             name = self.expect_ident()
+            if self.at_op("("):
+                self.next()
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+                cte_cols[name] = cols
             self.expect_kw("as")
             self.expect_op("(")
             ctes.append((name, self.parse_select()))
@@ -383,7 +395,7 @@ class Parser:
             if not self.accept_op(","):
                 break
         body = self.parse_select()
-        return A.WithSelect(ctes, body)
+        return A.WithSelect(ctes, body, recursive, cte_cols)
 
     def parse_merge(self) -> A.Merge:
         self.expect_kw("merge")
@@ -1827,6 +1839,23 @@ class Parser:
             if self.peek().kind == "ident" and self.peek().value in _IVL_UNITS:
                 unit = self.next().value
             return _parse_interval(body, unit, self.error)
+        if t.kind == "ident" and t.value == "array" \
+                and self.peek(1).kind == "op" and self.peek(1).value == "[":
+            # ARRAY[e1, e2, ...] literal (1-D, literal elements)
+            self.next()
+            self.next()
+            items = []
+            if not (self.peek().kind == "op" and self.peek().value == "]"):
+                while True:
+                    e = self.parse_expr()
+                    v = _const_literal_value(e)
+                    if v is _NOT_CONST:
+                        self.error("ARRAY elements must be literals")
+                    items.append(v)
+                    if not self.accept_op(","):
+                        break
+            self.expect_op("]")
+            return A.Literal(items, "array")
         if t.kind == "ident" and t.value in ("current_date",
                                              "current_timestamp"):
             self.next()
@@ -1931,6 +1960,21 @@ _IVL_UNITS = {
     "minute": ("micros", 60_000_000), "minutes": ("micros", 60_000_000),
     "second": ("micros", 1_000_000), "seconds": ("micros", 1_000_000),
 }
+
+
+_NOT_CONST = object()
+
+
+def _const_literal_value(e):
+    """Literal (or negated numeric literal) -> Python value, else
+    _NOT_CONST."""
+    if isinstance(e, A.Literal):
+        return e.value
+    if isinstance(e, A.UnOp) and e.op == "-" \
+            and isinstance(e.operand, A.Literal) \
+            and isinstance(e.operand.value, (int, float)):
+        return -e.operand.value
+    return _NOT_CONST
 
 
 def _parse_interval(body: str, unit, error) -> A.IntervalLiteral:

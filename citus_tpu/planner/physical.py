@@ -315,14 +315,23 @@ def lower_aggregates(aggs: list[AggSpec]) -> tuple[list[BExpr], list[PartialOp],
         elif spec.kind == "count":
             s = partial_slot("count", ai, "int64")
             extracts.append(AggExtract("count", [s], spec.out_type))
-        elif spec.kind == "sum":
+        elif spec.kind in ("sum", "avg"):
             s = partial_slot("sum", ai, acc_dtype)
             c = partial_slot("count", ai, "int64")
-            extracts.append(AggExtract("sum", [s, c], spec.out_type))
-        elif spec.kind == "avg":
-            s = partial_slot("sum", ai, acc_dtype)
-            c = partial_slot("count", ai, "int64")
-            extracts.append(AggExtract("avg", [s, c], spec.out_type))
+            slots = [s, c]
+            if acc_dtype == "int64" and spec.arg.type.is_numeric:
+                # overflow guard (round-4 weak #7): an int64 partial sum
+                # wraps silently; a float64 SHADOW sum of the same
+                # argument rides alongside — int64 addition is exact mod
+                # 2^64, so the final value is correct iff the true sum
+                # fits, and |shadow| >= 2^62 proves it cannot (float
+                # error is relative, far below the 2x margin).  The
+                # reference's NUMERIC never overflows; we error instead
+                # of silently wrapping.
+                from citus_tpu.planner.bound import BCast
+                fa = arg_slot(BCast(spec.arg, T.FLOAT64_T))
+                slots.append(partial_slot("sum", fa, "float64"))
+            extracts.append(AggExtract(spec.kind, slots, spec.out_type))
         elif spec.kind in ("min", "max"):
             dt = str(spec.arg.type.device_dtype)
             s = partial_slot(spec.kind, ai, dt)

@@ -1,0 +1,116 @@
+"""WITH RECURSIVE: coordinator-materialized iteration, diffed against
+sqlite3's recursive CTEs.
+
+Reference: recursive_planning.c:1175-1181 — the reference supports
+recursive CTEs through materialization; iteration semantics (working
+table = previous round's rows) are PostgreSQL's.
+"""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import ExecutionError, UnsupportedFeatureError
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"))
+    yield c
+    c.close()
+
+
+def test_counting_series(cl):
+    r = cl.execute(
+        "WITH RECURSIVE s(n) AS ("
+        "  SELECT 1 UNION ALL SELECT n + 1 FROM s WHERE n < 10"
+        ") SELECT n FROM s ORDER BY n")
+    assert [row[0] for row in r.rows] == list(range(1, 11))
+    assert r.columns == ["n"]
+
+
+def test_union_distinct_terminates_on_cycle(cl):
+    """UNION (distinct) terminates even when the recursive term
+    revisits rows — the graph-walk termination property."""
+    cl.execute("CREATE TABLE edges (src bigint NOT NULL, dst bigint)")
+    cl.execute("SELECT create_distributed_table('edges', 'src', 4)")
+    # a cycle: 1 -> 2 -> 3 -> 1, plus a tail 3 -> 4
+    cl.copy_from("edges", rows=[(1, 2), (2, 3), (3, 1), (3, 4)])
+    r = cl.execute(
+        "WITH RECURSIVE reach(node) AS ("
+        "  SELECT 1 UNION "
+        "  SELECT e.dst FROM edges e, reach r WHERE e.src = r.node"
+        ") SELECT node FROM reach ORDER BY node")
+    assert [row[0] for row in r.rows] == [1, 2, 3, 4]
+
+
+def test_hierarchy_walk_vs_sqlite(cl):
+    """The VERDICT golden test: an org-hierarchy walk diffed against
+    sqlite3's recursive CTEs."""
+    cl.execute("CREATE TABLE emp (id bigint NOT NULL, boss bigint,"
+               " salary bigint)")
+    cl.execute("SELECT create_distributed_table('emp', 'id', 4)")
+    rng = np.random.default_rng(7)
+    rows = [(0, None, 100)]
+    for i in range(1, 300):
+        rows.append((i, int(rng.integers(0, i)), int(rng.integers(50, 150))))
+    cl.copy_from("emp", rows=rows)
+    sq = sqlite3.connect(":memory:")
+    sq.execute("CREATE TABLE emp (id INTEGER, boss INTEGER, salary INTEGER)")
+    sq.executemany("INSERT INTO emp VALUES (?,?,?)", rows)
+    query = ("WITH RECURSIVE chain(id, depth) AS ("
+             "  SELECT id, 0 FROM emp WHERE boss IS NULL"
+             "  UNION ALL"
+             "  SELECT e.id, c.depth + 1 FROM emp e, chain c"
+             "  WHERE e.boss = c.id"
+             ") SELECT depth, count(*) FROM chain GROUP BY depth "
+             "ORDER BY depth")
+    ours = cl.execute(query).rows
+    theirs = [tuple(r) for r in sq.execute(query).fetchall()]
+    assert ours == theirs
+    # and a filtered subtree (the router-ish case: walk from one root)
+    q2 = ("WITH RECURSIVE sub(id) AS ("
+          "  SELECT id FROM emp WHERE id = 5"
+          "  UNION ALL"
+          "  SELECT e.id FROM emp e, sub s WHERE e.boss = s.id"
+          ") SELECT count(*) FROM sub")
+    assert cl.execute(q2).rows == [tuple(sq.execute(q2).fetchone())]
+
+
+def test_recursive_cte_feeding_body_join(cl):
+    cl.execute("CREATE TABLE fact (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('fact', 'k', 4)")
+    cl.copy_from("fact", columns={"k": np.arange(20),
+                                  "v": np.arange(20) * 10})
+    r = cl.execute(
+        "WITH RECURSIVE keys(n) AS ("
+        "  SELECT 0 UNION ALL SELECT n + 2 FROM keys WHERE n < 8"
+        ") SELECT sum(f.v) FROM fact f, keys WHERE f.k = keys.n")
+    assert r.rows == [(0 + 20 + 40 + 60 + 80,)]
+
+
+def test_plain_with_still_works_with_recursive_keyword(cl):
+    """WITH RECURSIVE where a CTE is NOT self-referencing behaves like a
+    plain CTE (PostgreSQL allows the mix)."""
+    r = cl.execute(
+        "WITH RECURSIVE a(x) AS (SELECT 41), "
+        "b(y) AS (SELECT x + 1 FROM a) SELECT y FROM b")
+    assert r.rows == [(42,)]
+
+
+def test_iteration_cap_raises(cl):
+    with pytest.raises(ExecutionError, match="iterations"):
+        cl.execute(
+            "WITH RECURSIVE s(n) AS ("
+            "  SELECT 1 UNION ALL SELECT n + 1 FROM s"
+            ") SELECT count(*) FROM s")
+
+
+def test_recursive_ref_in_first_arm_rejected(cl):
+    with pytest.raises(UnsupportedFeatureError, match="second UNION arm"):
+        cl.execute(
+            "WITH RECURSIVE s(n) AS ("
+            "  SELECT n FROM s UNION ALL SELECT 1"
+            ") SELECT * FROM s")
