@@ -1,0 +1,219 @@
+"""Two-OS-process cluster fixture (VERDICT #10): authority failover and
+2PC recovery across REAL process kills — not same-process threads.
+
+Reference: the reference tests multi-node behavior with real postgres
+processes (pg_regress_multi.pl) and exercises 2PC recovery by killing
+connections mid-commit (mitmproxy harness); node promotion is
+operations/node_promotion.c.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def _spawn(code: str) -> subprocess.Popen:
+    body = "import jax\njax.config.update('jax_platforms','cpu')\n" + code
+    return subprocess.Popen([sys.executable, "-c", body],
+                            stdout=subprocess.PIPE, text=True, env=ENV)
+
+
+def test_authority_failover_after_process_kill(tmp_path):
+    """The metadata authority dies (SIGKILL); an attached coordinator's
+    maintenance duty promotes itself under the shared-FS arbiter and
+    DDL keeps working."""
+    d = str(tmp_path / "db")
+    auth = _spawn(textwrap.dedent(f"""
+        import sys, time
+        import citus_tpu as ct
+        a = ct.Cluster({d!r}, serve_port=0)
+        print("PORT", a.control_port, flush=True)
+        sys.stdout.close()
+        time.sleep(120)
+    """))
+    try:
+        line = auth.stdout.readline().split()
+        assert line and line[0] == "PORT"
+        port = int(line[1])
+        b = ct.Cluster(d, coordinator=("127.0.0.1", port))
+        b.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+        b.execute("SELECT create_distributed_table('t', 'k', 4)")
+        b.copy_from("t", columns={"k": np.arange(100),
+                                  "v": np.arange(100)})
+        assert b._control.ensure_authority() == "ok"
+        # kill the authority outright — no clean shutdown
+        auth.kill()
+        auth.wait()
+        deadline = time.monotonic() + 15
+        status = None
+        while time.monotonic() < deadline:
+            status = b._control.ensure_authority()
+            if status in ("promoted", "repointed"):
+                break
+            time.sleep(0.2)
+        assert status == "promoted", f"failover did not happen: {status}"
+        # we are the authority now: DDL + queries keep working
+        b.execute("CREATE TABLE t2 (k bigint NOT NULL)")
+        assert b.catalog.has_table("t2")
+        assert b.execute("SELECT count(*) FROM t").rows == [(100,)]
+        assert b._control.server is not None
+        b.close()
+    finally:
+        if auth.poll() is None:
+            auth.kill()
+            auth.wait()
+
+
+def test_2pc_rolls_forward_after_committed_process_killed(tmp_path):
+    """A coordinator process is SIGKILLed after writing PREPARED +
+    COMMITTED but before flipping staged stripes live; a second process
+    sharing the data dir recovers the transaction FORWARD."""
+    d = str(tmp_path / "db")
+    # set up the table from the main process first
+    setup = ct.Cluster(d, n_nodes=2)
+    setup.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    setup.execute("SELECT create_distributed_table('t', 'k', 4)")
+    setup.close()
+    child = _spawn(textwrap.dedent(f"""
+        import os, sys
+        import numpy as np
+        import citus_tpu as ct
+        from citus_tpu.ingest import TableIngestor, encode_columns
+        from citus_tpu.transaction.manager import TxState
+        cl = ct.Cluster({d!r})
+        t = cl.catalog.table("t")
+        values, validity = encode_columns(cl.catalog, t, {{
+            "k": np.arange(500, dtype=np.int64),
+            "v": np.ones(500, dtype=np.int64)}})
+        ing = TableIngestor(cl.catalog, t, txlog=cl.txlog)
+        ing.append(values, validity)
+        for w in ing._writers.values():
+            w.flush()
+        t.version += 1
+        cl.catalog.commit()
+        dirs = [w.directory for w in ing._writers.values()]
+        cl.txlog.log(ing.xid, TxState.PREPARED,
+                     {{"kind": "ingest", "table": "t",
+                       "placements": dirs}})
+        cl.txlog.log(ing.xid, TxState.COMMITTED, {{"table": "t"}})
+        print("STAGED", os.getpid(), flush=True)
+        sys.stdout.flush()
+        import time
+        time.sleep(120)  # killed here: before flipping stripes live
+    """))
+    try:
+        line = child.stdout.readline().split()
+        assert line and line[0] == "STAGED"
+        child.kill()
+        child.wait()
+        # a surviving coordinator recovers on open (recovery runs at
+        # Cluster construction, the maintenance-daemon-startup analog)
+        cl = ct.Cluster(d)
+        assert cl.execute("SELECT count(*), sum(v) FROM t").rows == \
+            [(500, 500)]
+        cl.close()
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+
+
+def test_2pc_rolls_back_after_prepared_only_process_killed(tmp_path):
+    """SIGKILL after PREPARED with no COMMITTED record: the survivor
+    rolls the transaction BACK (reference: RecoverTwoPhaseCommits
+    aborts prepared transactions without a commit record)."""
+    d = str(tmp_path / "db")
+    setup = ct.Cluster(d, n_nodes=2)
+    setup.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    setup.execute("SELECT create_distributed_table('t', 'k', 4)")
+    setup.copy_from("t", columns={"k": np.arange(50),
+                                  "v": np.zeros(50, np.int64)})
+    setup.close()
+    child = _spawn(textwrap.dedent(f"""
+        import os, sys, time
+        import numpy as np
+        import citus_tpu as ct
+        from citus_tpu.ingest import TableIngestor, encode_columns
+        from citus_tpu.transaction.manager import TxState
+        cl = ct.Cluster({d!r})
+        t = cl.catalog.table("t")
+        values, validity = encode_columns(cl.catalog, t, {{
+            "k": np.arange(100, 200, dtype=np.int64),
+            "v": np.ones(100, dtype=np.int64)}})
+        ing = TableIngestor(cl.catalog, t, txlog=cl.txlog)
+        ing.append(values, validity)
+        for w in ing._writers.values():
+            w.flush()
+        dirs = [w.directory for w in ing._writers.values()]
+        cl.txlog.log(ing.xid, TxState.PREPARED,
+                     {{"kind": "ingest", "table": "t",
+                       "placements": dirs}})
+        print("PREPARED", os.getpid(), flush=True)
+        sys.stdout.flush()
+        time.sleep(120)  # killed here: prepared, never committed
+    """))
+    try:
+        line = child.stdout.readline().split()
+        assert line and line[0] == "PREPARED"
+        child.kill()
+        child.wait()
+        cl = ct.Cluster(d)
+        from citus_tpu.transaction.recovery import recover_transactions
+        st = recover_transactions(cl.catalog, cl.txlog)
+        # either the open-time recovery or this explicit pass rolled it
+        # back; the staged rows must never become visible
+        assert cl.execute("SELECT count(*) FROM t").rows == [(50,)]
+        assert cl.execute("SELECT sum(v) FROM t").rows == [(0,)]
+        cl.close()
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+
+
+def test_concurrent_writes_from_two_processes(tmp_path):
+    """Two coordinator processes ingest into one table concurrently;
+    cross-process flocks serialize correctly and nothing is lost."""
+    d = str(tmp_path / "db")
+    setup = ct.Cluster(d, n_nodes=2)
+    setup.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    setup.execute("SELECT create_distributed_table('t', 'k', 4)")
+    setup.close()
+    workers = []
+    for w in range(2):
+        workers.append(_spawn(textwrap.dedent(f"""
+            import numpy as np
+            import citus_tpu as ct
+            cl = ct.Cluster({d!r})
+            for i in range(5):
+                base = {w} * 50_000 + i * 10_000
+                cl.copy_from("t", columns={{
+                    "k": np.arange(base, base + 10_000, dtype=np.int64),
+                    "v": np.ones(10_000, dtype=np.int64)}})
+            cl.close()
+            print("DONE", flush=True)
+        """)))
+    try:
+        for p in workers:
+            out = p.stdout.readline().strip()
+            assert out == "DONE", f"worker failed: {out!r}"
+            p.wait(timeout=30)
+    finally:
+        for p in workers:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    cl = ct.Cluster(d)
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == \
+        [(100_000, 100_000)]
+    cl.close()
