@@ -340,7 +340,11 @@ class Catalog:
 
     def load_document(self, d: dict) -> None:
         """Replace in-memory state with a catalog document (the unit the
-        control plane ships between coordinators)."""
+        control plane ships between coordinators).  Documents written by
+        older builds are lifted through the versioned migrations first
+        (catalog/migrations.py; the ALTER EXTENSION ... UPDATE analog)."""
+        from citus_tpu.catalog.migrations import migrate_document
+        d = migrate_document(d)
         self.tables = {t["name"]: TableMeta.from_json(t) for t in d["tables"]}
         self.nodes = {n["node_id"]: NodeMeta.from_json(n) for n in d["nodes"]}
         self._next_shard_id = d["next_shard_id"]
@@ -365,7 +369,9 @@ class Catalog:
         self.statistics = d.get("statistics", {})
 
     def export_document(self) -> dict:
+        from citus_tpu.catalog.migrations import CATALOG_FORMAT_VERSION
         return {
+            "format_version": CATALOG_FORMAT_VERSION,
             "tables": [t.to_json() for t in self.tables.values()],
             "nodes": [n.to_json() for n in self.nodes.values()],
             "next_shard_id": self._next_shard_id,
@@ -415,6 +421,8 @@ class Catalog:
     def _merge_doc(self, d: dict) -> None:
         """Adopt another coordinator's catalog document into memory
         (tombstones guard drops; table conflicts resolve by version)."""
+        from citus_tpu.catalog.migrations import migrate_document
+        d = migrate_document(d)
         tomb = self._tombstones
         for td in d.get("tables", []):
             name = td["name"]

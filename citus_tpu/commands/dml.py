@@ -24,6 +24,10 @@ def insert(cl, stmt):
 @handles(A.CopyTo)
 def copy_to(cl, stmt):
     from citus_tpu.cluster import _option_bool
+    if str(stmt.options.get("format", "csv")).lower() == "binary":
+        from citus_tpu.commands.copy_binary import copy_to_binary
+        n = copy_to_binary(cl, stmt.table, stmt.path)
+        return Result(columns=[], rows=[], explain={"copied": n})
     n = cl.copy_to_csv(
         stmt.table, stmt.path,
         delimiter=stmt.options.get("delimiter", ","),
@@ -50,6 +54,10 @@ def copy_query_to(cl, stmt):
 @handles(A.CopyFrom)
 def copy_from(cl, stmt):
     from citus_tpu.cluster import _option_bool
+    if str(stmt.options.get("format", "csv")).lower() == "binary":
+        from citus_tpu.commands.copy_binary import copy_from_binary
+        n = copy_from_binary(cl, stmt.table, stmt.path)
+        return Result(columns=[], rows=[], explain={"copied": n})
     n = cl.copy_from_csv(
         stmt.table, stmt.path,
         delimiter=stmt.options.get("delimiter", ","),

@@ -331,3 +331,82 @@ def test_fuzz_new_types_vs_sqlite(tmp_path):
             continue
         assert ours == theirs, f"trial {trial} kind {kind}: {ours} != {theirs}"
     cl.close()
+
+
+def test_copy_binary_round_trip(tmp_path):
+    """COPY WITH (format binary): columnar frames, lossless for every
+    type incl. nulls, portable across clusters (words, not dict ids)."""
+    cl = ct.Cluster(str(tmp_path / "a"))
+    cl.execute("CREATE TABLE src (k bigint NOT NULL, v decimal(12,2),"
+               " s text, at timestamptz, id uuid, tags bigint[])")
+    cl.execute("SELECT create_distributed_table('src', 'k', 4)")
+    u = "a0eebc99-9c0b-4ef8-bb6d-6bb9bd380a11"
+    rows = [(i, None if i % 7 == 0 else i / 4,
+             None if i % 5 == 0 else f"w{i % 3}",
+             datetime.datetime(2024, 1, 1, tzinfo=UTC)
+             + datetime.timedelta(minutes=i),
+             u if i % 2 else None,
+             [i, i + 1]) for i in range(5000)]
+    cl.copy_from("src", rows=rows)
+    p = str(tmp_path / "dump.bin")
+    r = cl.execute(f"COPY src TO '{p}' WITH (format binary)")
+    assert r.explain["copied"] == 5000
+    # import into a DIFFERENT cluster (fresh dictionaries: id spaces
+    # differ, words must carry the data)
+    cl2 = ct.Cluster(str(tmp_path / "b"))
+    cl2.execute("CREATE TABLE dst (k bigint NOT NULL, v decimal(12,2),"
+                " s text, at timestamptz, id uuid, tags bigint[])")
+    cl2.execute("SELECT create_distributed_table('dst', 'k', 8)")
+    cl2.copy_from("dst", rows=[(99999, 1.0, "seed", None, None, None)])
+    r = cl2.execute(f"COPY dst FROM '{p}' WITH (format binary)")
+    assert r.explain["copied"] == 5000
+    for q in ("SELECT count(*), sum(v) FROM {} WHERE s = 'w1'",
+              "SELECT count(*) FROM {} WHERE id = '" + u + "'",
+              "SELECT min(at), max(at) FROM {} WHERE k < 5000"):
+        assert cl.execute(q.format("src")).rows == \
+            cl2.execute(q.format("dst") + " AND k < 99999"
+                        if "WHERE" in q else q.format("dst")).rows
+    assert cl2.execute("SELECT tags FROM dst WHERE k = 3").rows == \
+        [([3, 4],)]
+    cl.close()
+    cl2.close()
+
+
+def test_catalog_migration_framework(tmp_path):
+    """Versioned document migrations (the 69-SQL-migration analog):
+    v0 documents lift through every migration; newer-than-build
+    documents are refused."""
+    import json
+    import os
+
+    from citus_tpu.catalog.migrations import (
+        CATALOG_FORMAT_VERSION, migrate_document,
+    )
+    from citus_tpu.errors import CatalogError
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (k bigint, v bigint)")
+    cl.copy_from("t", rows=[(1, 2)])
+    doc = cl.catalog.export_document()
+    assert doc["format_version"] == CATALOG_FORMAT_VERSION
+    cl.close()
+    # strip to a v0 (round-3) shape
+    doc.pop("format_version")
+    for sec in ("extensions", "domains", "collations", "publications",
+                "statistics", "domain_columns"):
+        doc.pop(sec, None)
+    for td in doc["tables"]:
+        td.pop("indexes", None)
+    path = os.path.join(str(tmp_path / "db"), "catalog.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    cl = ct.Cluster(str(tmp_path / "db"))
+    assert cl.catalog.table("t").indexes == []
+    assert cl.execute("SELECT v FROM t WHERE k = 1").rows == [(2,)]
+    # the next commit re-stamps the current version
+    cl.execute("CREATE TABLE t2 (x bigint)")
+    with open(path) as fh:
+        assert json.load(fh)["format_version"] == CATALOG_FORMAT_VERSION
+    cl.close()
+    # refuse documents from the future
+    with pytest.raises(CatalogError, match="newer than this build"):
+        migrate_document({"format_version": CATALOG_FORMAT_VERSION + 1})
