@@ -2213,6 +2213,11 @@ class Cluster:
     #: another view) would otherwise die with a raw RecursionError
     _MAX_STMT_DEPTH = 64
     _stmt_depth = __import__("threading").local()
+    # original SQL of the statement being executed (thread-local):
+    # remote DML forwarding re-ships the statement text, the closest
+    # thing to the reference's deparse-and-send (we deliberately have
+    # no deparser — commands/dml.py _forward_remote_dml)
+    _stmt_sql = __import__("threading").local()
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         depth = getattr(self._stmt_depth, "v", 0)
@@ -2221,10 +2226,13 @@ class Cluster:
                 "query nesting too deep (possible circular view "
                 "reference)")
         self._stmt_depth.v = depth + 1
+        prev_sql = getattr(self._stmt_sql, "v", None)
+        self._stmt_sql.v = sql_text
         try:
             return self._execute_stmt_inner(stmt, sql_text)
         finally:
             self._stmt_depth.v = depth
+            self._stmt_sql.v = prev_sql
 
     def _execute_stmt_inner(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         if isinstance(stmt, A.WithSelect):

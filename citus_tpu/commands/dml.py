@@ -66,6 +66,48 @@ def copy_from(cl, stmt):
     return Result(columns=[], rows=[], explain={"copied": n})
 
 
+def _forward_remote_dml(cl, stmt, t, where):
+    """A modify statement whose surviving shards live on other
+    coordinators: a single remote owner gets the whole statement
+    forwarded (the router path — reference: deparsed SQL shipped to the
+    owning worker over libpq); shards spanning several hosts raise
+    until cross-host 2PC exists.  Returns a Result when forwarded,
+    None when every surviving shard is local."""
+    if cl.catalog.remote_data is None or not t.is_distributed:
+        return None
+    from citus_tpu.planner.physical import prune_shards
+    owners = {t.shards[si].placements[0]
+              for si in prune_shards(t, where)}
+    remote = {o for o in owners if cl.catalog.is_remote_node(o)}
+    if not remote:
+        return None
+    from citus_tpu.storage.overlay import current_overlay
+    if current_overlay() is not None:
+        raise UnsupportedFeatureError(
+            "DML on remote-hosted shards inside an explicit transaction "
+            "is not supported yet (no cross-host 2PC)")
+    endpoints = {cl.catalog.node_endpoint(o) for o in remote}
+    if owners != remote or len(endpoints) != 1:
+        raise UnsupportedFeatureError(
+            "a modify statement spanning shards on several hosts is not "
+            "supported yet (no cross-host 2PC); filter on the "
+            "distribution column to route it to one host")
+    if getattr(stmt, "returning", None):
+        raise UnsupportedFeatureError(
+            "RETURNING is not supported on forwarded remote DML yet")
+    sql = getattr(cl._stmt_sql, "v", None)
+    if sql is None:
+        raise UnsupportedFeatureError(
+            "cannot forward this modify statement to its remote host "
+            "(no original SQL text — issue it as a single statement)")
+    r = cl.catalog.remote_data.call(next(iter(endpoints)), "execute_sql",
+                                    {"sql": sql})
+    cl._plan_cache.clear()
+    return Result(columns=r.get("columns", []),
+                  rows=[tuple(row) for row in r.get("rows", [])],
+                  explain=r.get("explain", {}))
+
+
 @handles(A.Delete)
 def delete(cl, stmt):
     from citus_tpu.executor.dml import execute_delete
@@ -76,6 +118,9 @@ def delete(cl, stmt):
         return cl._partition_dml(stmt, t)
     where = Binder(cl.catalog, t).bind_scalar(stmt.where) \
         if stmt.where is not None else None
+    fwd = _forward_remote_dml(cl, stmt, t, where)
+    if fwd is not None:
+        return fwd
     with cl._write_lock(t, EXCLUSIVE):
         if cl.catalog.referencing_fks(stmt.table):
             # RESTRICT / CASCADE / SET NULL on referencing tables
@@ -110,6 +155,11 @@ def update(cl, stmt):
     if t.is_partitioned:
         return cl._partition_dml(stmt, t)
     b = Binder(cl.catalog, t)
+    if cl.catalog.remote_data is not None:
+        bw = b.bind_scalar(stmt.where) if stmt.where is not None else None
+        fwd = _forward_remote_dml(cl, stmt, t, bw)
+        if fwd is not None:
+            return fwd
     assignments = []
     for col, e in stmt.assignments:
         target = t.schema.column(col)

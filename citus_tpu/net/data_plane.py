@@ -87,6 +87,7 @@ class DataPlaneServer:
         s.register("put_file", self._on_put_file)
         s.register("ingest_batch", self._on_ingest_batch)
         s.register("drop_placement", self._on_drop_placement)
+        s.register("execute_sql", self._on_execute_sql)
         s.start()
 
     @property
@@ -150,6 +151,19 @@ class DataPlaneServer:
         n = self.cluster._ingest_local_batch(str(p["table"]), values,
                                              validity)
         return {"inserted": n}
+
+    def _on_execute_sql(self, p: dict) -> dict:
+        """Run a forwarded statement on this coordinator (the owner of
+        the statement's shards).  This IS the reference's model: the
+        worker-facing RPC protocol is SQL itself (SURVEY §1: shard
+        queries travel as SQL text over libpq).  The connection is
+        HMAC-authenticated; like a PostgreSQL worker, an authenticated
+        coordinator may run any statement."""
+        r = self.cluster.execute(str(p["sql"]))
+        return {"columns": r.columns,
+                "rows": [list(row) for row in r.rows],
+                "explain": {k: v for k, v in (r.explain or {}).items()
+                            if isinstance(v, (int, float, str))}}
 
     def _on_drop_placement(self, p: dict) -> dict:
         """Deferred-drop a placement directory after its shard moved

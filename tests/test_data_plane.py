@@ -118,13 +118,21 @@ def test_update_delete_on_remote_shards_visible(pair):
     a.copy_from("d", columns={"k": np.arange(n), "v": np.zeros(n, np.int64)})
     b._maybe_reload_catalog(force_sync=True)
     assert b.execute("SELECT count(*) FROM d").rows == [(n,)]
-    # B deletes rows it hosts; A must observe the deletion bitmaps
+    # B deletes rows routed to a shard IT hosts (distribution-column
+    # filter -> local execution on B); A must observe the deletion
+    # bitmaps through the re-synced mutable files
+    from citus_tpu.catalog.hashing import shard_index_for_values
     t = b.catalog.table("d")
-    hosted = [s for s in t.shards if s.placements[0] == nb]
-    assert hosted
-    r = b.execute("DELETE FROM d WHERE k % 2 = 1")
-    deleted = r.explain["deleted"]
-    assert deleted > 0
+    idx = shard_index_for_values(np.arange(n, dtype=np.int64),
+                                 t.shard_count)
+    local_keys = [int(k) for k in range(n)
+                  if t.shards[idx[k]].placements[0] == nb][:5]
+    assert local_keys
+    deleted = 0
+    for k in local_keys:
+        r = b.execute(f"DELETE FROM d WHERE k = {k}")
+        deleted += r.explain["deleted"]
+    assert deleted == len(local_keys)
     from citus_tpu.executor.device_cache import GLOBAL_CACHE
     GLOBAL_CACHE.clear()
     assert a.execute("SELECT count(*) FROM d").rows == [(n - deleted,)]
@@ -247,3 +255,36 @@ def test_blob_tamper_detection():
     assert received == []
     s.close()
     srv.stop()
+
+
+def test_remote_dml_forwarding_and_guard(pair):
+    """A router modify whose shard lives on the peer forwards the
+    statement text (the deparse-and-ship analog); a multi-host modify
+    raises instead of silently skipping remote shards."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE w (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('w', 'k', 4)")
+    n = 500
+    a.copy_from("w", columns={"k": np.arange(n), "v": np.zeros(n, np.int64)})
+    t = a.catalog.table("w")
+    # find a key that routes to a B-hosted shard
+    from citus_tpu.catalog.hashing import shard_index_for_values
+    ks = np.arange(n)
+    idx = shard_index_for_values(ks.astype(np.int64), t.shard_count)
+    remote_keys = [int(k) for k, si in zip(ks, idx)
+                   if t.shards[si].placements[0] == nb]
+    assert remote_keys
+    k0 = remote_keys[0]
+    r = a.execute(f"UPDATE w SET v = 7 WHERE k = {k0}")
+    assert r.explain.get("updated") == 1
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    assert a.execute(f"SELECT v FROM w WHERE k = {k0}").rows == [(7,)]
+    r = a.execute(f"DELETE FROM w WHERE k = {k0}")
+    assert r.explain.get("deleted") == 1
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT count(*) FROM w").rows == [(n - 1,)]
+    # a modify spanning both hosts raises rather than half-applying
+    from citus_tpu.errors import UnsupportedFeatureError
+    with pytest.raises(UnsupportedFeatureError, match="several hosts"):
+        a.execute("UPDATE w SET v = 9")
