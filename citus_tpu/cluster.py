@@ -1446,7 +1446,29 @@ class Cluster:
         (local_values, local_validity, rows_shipped)."""
         from citus_tpu.catalog.hashing import shard_index_for_values
         if not t.is_distributed:
-            return values, validity, 0
+            # reference/local tables: every remote host with a placement
+            # receives the FULL batch (reference tables replicate to all
+            # nodes under 2PC; reference_table_utils.c) — rows counted
+            # once, from the local copy when one exists
+            eps = {self.catalog.node_endpoint(nd)
+                   for s in t.shards for nd in s.placements
+                   if self.catalog.is_remote_node(nd)}
+            if not eps:
+                return values, validity, 0
+            from citus_tpu.storage.overlay import current_overlay
+            if current_overlay() is not None:
+                raise UnsupportedFeatureError(
+                    "writes to remote-hosted placements inside an "
+                    "explicit transaction are not supported yet")
+            shipped = 0
+            for ep in eps:
+                shipped = self.catalog.remote_data.ship_batch(
+                    ep, t.name, values, validity)
+            local_hosted = any(not self.catalog.is_remote_node(nd)
+                               for s in t.shards for nd in s.placements)
+            if local_hosted:
+                return values, validity, 0  # local ingest counts them
+            return {}, {}, shipped
         owners = [t.shards[si].placements[0] for si in range(t.shard_count)]
         if not any(self.catalog.is_remote_node(o) for o in owners):
             return values, validity, 0

@@ -73,7 +73,17 @@ def _forward_remote_dml(cl, stmt, t, where):
     owning worker over libpq); shards spanning several hosts raise
     until cross-host 2PC exists.  Returns a Result when forwarded,
     None when every surviving shard is local."""
-    if cl.catalog.remote_data is None or not t.is_distributed:
+    if cl.catalog.remote_data is None:
+        return None
+    if not t.is_distributed:
+        # a reference table's replicas span hosts: a local-only modify
+        # would diverge them — refuse until replicated cross-host DML
+        # exists (the reference runs these under 2PC to every node)
+        if any(cl.catalog.is_remote_node(nd)
+               for s in t.shards for nd in s.placements):
+            raise UnsupportedFeatureError(
+                "modifying a reference table with remote-hosted replicas "
+                "is not supported yet")
         return None
     from citus_tpu.planner.physical import prune_shards
     owners = {t.shards[si].placements[0]

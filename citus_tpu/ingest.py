@@ -115,11 +115,18 @@ class TableIngestor:
                 sub_v = {c: v[sel] for c, v in values.items()}
                 sub_m = {c: m[sel] for c, m in validity.items()}
                 for node in shard.placements:
+                    if self.cat.is_remote_node(node):
+                        # another coordinator hosts this placement: its
+                        # bytes arrive over the data plane (ship_batch),
+                        # never as a local directory for a foreign node
+                        continue
                     self._writer(shard.shard_id, node).append_batch(sub_v, sub_m)
         else:
             # local table: single shard; reference table: replicate to all
             shard = t.shards[0]
             for node in shard.placements:
+                if self.cat.is_remote_node(node):
+                    continue
                 self._writer(shard.shard_id, node).append_batch(values, validity)
 
     def finish(self) -> int:

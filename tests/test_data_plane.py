@@ -288,3 +288,24 @@ def test_remote_dml_forwarding_and_guard(pair):
     from citus_tpu.errors import UnsupportedFeatureError
     with pytest.raises(UnsupportedFeatureError, match="several hosts"):
         a.execute("UPDATE w SET v = 9")
+
+
+def test_reference_table_replicates_to_remote_host(pair):
+    """Reference-table COPY replicates the full batch to every host
+    with a placement; both coordinators answer joins against it from
+    their local replica; cross-host modifies are refused (divergence)."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE ref (id bigint NOT NULL, name text)")
+    a.execute("SELECT create_reference_table('ref')")
+    a.copy_from("ref", rows=[(1, "one"), (2, "two"), (3, "three")])
+    assert a.execute("SELECT count(*) FROM ref").rows == [(3,)]
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT count(*) FROM ref").rows == [(3,)]
+    # B's replica is LOCAL bytes in B's data dir (not a remote fetch)
+    t = b.catalog.table("ref")
+    import os
+    assert any(os.path.isdir(b.catalog.shard_dir("ref", s.shard_id, nb))
+               for s in t.shards)
+    from citus_tpu.errors import UnsupportedFeatureError
+    with pytest.raises(UnsupportedFeatureError, match="reference table"):
+        a.execute("DELETE FROM ref WHERE id = 1")
