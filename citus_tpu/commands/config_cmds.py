@@ -34,7 +34,6 @@ _GUCS = {
     "citus.max_shared_pool_size": ("executor", "max_shared_pool_size", int),
     "citus.max_adaptive_executor_pool_size": ("executor", "max_tasks_in_flight", int),
     "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
-    "citus.use_pallas_scan": ("executor", "use_pallas_scan", "bool"),
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
     "citus.shard_count": ("sharding", "shard_count", int),
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),

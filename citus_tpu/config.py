@@ -74,7 +74,6 @@ class ExecutorSettings:
     # reference; this is the hand-scheduled alternative (interpreter
     # mode off-TPU).  Scope: the SINGLE-DEVICE streaming path only —
     # the multi-device mesh path always runs the fused sharded worker.
-    use_pallas_scan: bool = False
     # Pad scan batches to power-of-two row counts to bound recompiles.
     batch_row_buckets: bool = True
     # Smallest padded batch (rows) a kernel will ever see.

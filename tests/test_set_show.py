@@ -64,7 +64,7 @@ def test_lock_timeout_pg_units_and_boolean_rendering(cl):
     # booleans render as on/off (PG)
     assert cl.execute("SHOW citus.enable_repartition_joins").rows == [("on",)]
     with pytest.raises(CatalogError, match="Boolean"):
-        cl.execute("SET citus.use_pallas_scan = 'tru'")
+        cl.execute("SET citus.enable_repartition_joins = 'tru'")
     with pytest.raises(CatalogError, match="always or never"):
         cl.execute("SET citus.use_secondary_nodes = 'alway'")
 
