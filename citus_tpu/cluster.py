@@ -622,7 +622,8 @@ class Cluster:
                  coordinator: Optional[tuple] = None,
                  data_port: Optional[int] = None,
                  hosted_nodes: Optional[set] = None,
-                 secret: Optional[bytes] = None):
+                 secret: Optional[bytes] = None,
+                 data_bind_host: str = "127.0.0.1"):
         """``serve_port``/``coordinator``: control-plane role (metadata
         authority / attached peer).  ``data_port``: serve this process's
         shard placements to peers over the bulk data plane
@@ -649,7 +650,8 @@ class Cluster:
         if data_port is not None:
             from citus_tpu.net.data_plane import DataPlaneServer
             self._data_server = DataPlaneServer(self, port=data_port,
-                                                secret=secret)
+                                                secret=secret,
+                                                bind_host=data_bind_host)
         if hosted_nodes is not None:
             from citus_tpu.net.data_plane import DataPlaneClient
             self.catalog.remote_data = DataPlaneClient(self.catalog,
@@ -1404,7 +1406,8 @@ class Cluster:
             from citus_tpu.partitioning import check_partition_bounds
             check_partition_bounds(self.catalog, t, values, validity)
         remote_n = 0
-        if self.catalog.remote_data is not None:
+        if self.catalog.remote_data is not None \
+                and not getattr(self._remote_exec_guard, "v", False):
             values, validity, remote_n = self._route_remote_batch(
                 t, values, validity)
             if not values or len(next(iter(values.values()))) == 0:
@@ -2240,6 +2243,10 @@ class Cluster:
     # thing to the reference's deparse-and-send (we deliberately have
     # no deparser — commands/dml.py _forward_remote_dml)
     _stmt_sql = __import__("threading").local()
+    # set while executing a statement a PEER forwarded to us: such a
+    # statement operates on OUR placements only and must never forward
+    # again (two coordinators would ping-pong a TRUNCATE forever)
+    _remote_exec_guard = __import__("threading").local()
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         depth = getattr(self._stmt_depth, "v", 0)

@@ -73,7 +73,8 @@ def _forward_remote_dml(cl, stmt, t, where):
     owning worker over libpq); shards spanning several hosts raise
     until cross-host 2PC exists.  Returns a Result when forwarded,
     None when every surviving shard is local."""
-    if cl.catalog.remote_data is None:
+    if cl.catalog.remote_data is None \
+            or getattr(cl._remote_exec_guard, "v", False):
         return None
     if not t.is_distributed:
         # a reference table's replicas span hosts: a local-only modify
@@ -238,6 +239,15 @@ def merge(cl, stmt):
     from citus_tpu.executor.merge_executor import execute_merge
     from citus_tpu.transaction.locks import EXCLUSIVE
     _mt = cl.catalog.table(stmt.target.name)
+    if cl.catalog.remote_data is not None and any(
+            cl.catalog.is_remote_node(nd)
+            for s in _mt.shards for nd in s.placements):
+        # the merge executor reads/writes placements directly; a remote
+        # shard would look empty (matched rows re-inserted, then
+        # dropped by the remote-skipping ingest) — fail closed
+        raise UnsupportedFeatureError(
+            "MERGE into a table with remote-hosted shards is not "
+            "supported yet (no cross-host 2PC)")
     if _mt.foreign_keys or cl.catalog.referencing_fks(_mt.name):
         # the merge executor writes through the storage layer directly;
         # fail closed rather than bypass FK enforcement
@@ -291,11 +301,35 @@ def truncate(cl, stmt):
         t0 = cl.catalog.table(name)
         if not t0.is_partitioned:
             metas.setdefault(group_resource(t0), t0)
+    # placements hosted by other coordinators: forward the statement to
+    # each owning host (it truncates ITS placements; the guard stops it
+    # forwarding back), then truncate the local ones.  Not atomic
+    # across hosts — like the per-host 2PC elsewhere — but never the
+    # silent data resurrection of truncating only local directories.
+    if cl.catalog.remote_data is not None \
+            and not getattr(cl._remote_exec_guard, "v", False):
+        eps = {cl.catalog.node_endpoint(nd)
+               for t0 in metas.values()
+               for s in t0.shards for nd in s.placements
+               if cl.catalog.is_remote_node(nd)}
+        if eps:
+            sql = getattr(cl._stmt_sql, "v", None)
+            if sql is None:
+                raise UnsupportedFeatureError(
+                    "cannot forward TRUNCATE to remote placement hosts "
+                    "(no original SQL text — issue it as a single "
+                    "statement)")
+            for ep in sorted(eps):
+                cl.catalog.remote_data.call(ep, "execute_sql",
+                                            {"sql": sql})
     with _ctxlib.ExitStack() as stack:
         for res in sorted(metas):
             stack.enter_context(cl._write_lock(metas[res], EXCLUSIVE))
         for name in names:
             cl._truncate_one(name)
+    if cl.catalog.remote_data is not None:
+        for t0 in metas.values():
+            cl.catalog.remote_data.invalidate_cache(t0.name)
     return Result(columns=[], rows=[])
 
 

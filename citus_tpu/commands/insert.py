@@ -154,8 +154,16 @@ def _execute_upsert(cl, t, stmt: A.Insert, rows: list) -> Result:
         out = []
         for c, v in zip(oc.targets, vals):
             typ = t.schema.column(c).type
-            if v is None or typ.is_text:
+            if v is None:
                 out.append(v)
+            elif typ.is_text:
+                if typ.kind != "text":
+                    # uuid/bytea/array: a non-canonical spelling must
+                    # collide with the stored canonical word, then read
+                    # back the way a SELECT renders it
+                    out.append(typ.render_word(typ.normalize_word(v)))
+                else:
+                    out.append(v)
             else:
                 out.append(typ.from_physical(typ.to_physical(v)))
         return tuple(out)
@@ -275,6 +283,14 @@ def _insert_select_arrays(cl, target, sel: A.Select,
     if not isinstance(sel, A.Select) or not isinstance(sel.from_, A.TableRef):
         return None
     if sel.group_by or sel.having or sel.order_by or sel.limit or sel.distinct:
+        return None
+    if cl.catalog.remote_data is not None and any(
+            cl.catalog.is_remote_node(nd)
+            for s in target.shards for nd in s.placements):
+        # remote-hosted target shards: only the pull path routes rows
+        # over the data plane (copy_from's _route_remote_batch); the
+        # array strategies write placements directly and would drop or
+        # misplace rows for foreign hosts
         return None
     try:
         bound = bind_select(cl.catalog, sel)

@@ -87,8 +87,14 @@ def _check_sum_overflow(ex: AggExtract, partials: tuple, counts) -> None:
         return
     shadow = np.asarray(partials[ex.slots[2]], np.float64)
     # the float cast of a decimal yields the LOGICAL value; the exact
-    # accumulator holds scale-shifted integers — compare in scaled space
+    # accumulator holds integers at the ARGUMENT's scale — compare in
+    # that space.  For sum, out scale == arg scale; avg's output gains
+    # +6 digits (the exact-division scale, extract_aggs avg path) that
+    # the accumulator never holds, so strip them or the check is 10^6
+    # too strict.
     scale = ex.out_type.scale if ex.out_type.is_decimal else 0
+    if ex.kind == "avg":
+        scale = max(0, scale - 6)
     limit = _SUM_OVERFLOW_LIMIT / (10.0 ** scale)
     bad = (np.abs(shadow) >= limit) & (np.asarray(counts) > 0)
     if bad.any():

@@ -32,9 +32,10 @@ from citus_tpu.net.rpc import RpcClient, RpcError, RpcServer
 #: fetch_file chunk size — one RPC round-trip per chunk
 CHUNK_BYTES = 4 << 20
 
-#: mutable placement files re-fetched on every sync (everything else —
-#: stripe .cts files — is immutable once visible)
-_MUTABLE_SUFFIXES = (".json", ".npz", ".bin")
+# (mutability rule: stripe .cts files are immutable once visible and
+# cached forever; every other placement file — meta, deletes, index
+# segments — re-fetches when its size/mtime signature moves.  See
+# sync_placement.)
 
 
 def _npz_bytes(arrays: dict) -> bytes:
@@ -77,9 +78,13 @@ class DataPlaneServer:
     """Serves this coordinator's locally-hosted placements."""
 
     def __init__(self, cluster, port: int = 0,
-                 secret: Optional[bytes] = None):
+                 secret: Optional[bytes] = None,
+                 bind_host: str = "127.0.0.1"):
         self.cluster = cluster
-        self.server = RpcServer(port=port, secret=secret)
+        # bind_host "0.0.0.0" for genuinely cross-machine deployments
+        # (the advertised register_node host must then be routable);
+        # loopback default keeps single-machine clusters unexposed
+        self.server = RpcServer(host=bind_host, port=port, secret=secret)
         s = self.server
         s.register("ping", lambda p: {"ok": True})
         s.register("list_placement", self._on_list_placement)
@@ -159,7 +164,13 @@ class DataPlaneServer:
         queries travel as SQL text over libpq).  The connection is
         HMAC-authenticated; like a PostgreSQL worker, an authenticated
         coordinator may run any statement."""
-        r = self.cluster.execute(str(p["sql"]))
+        guard = self.cluster._remote_exec_guard
+        prev = getattr(guard, "v", False)
+        guard.v = True  # a forwarded statement must never forward again
+        try:
+            r = self.cluster.execute(str(p["sql"]))
+        finally:
+            guard.v = prev
         return {"columns": r.columns,
                 "rows": [list(row) for row in r.rows],
                 "explain": {k: v for k, v in (r.explain or {}).items()

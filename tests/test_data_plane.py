@@ -309,3 +309,58 @@ def test_reference_table_replicates_to_remote_host(pair):
     from citus_tpu.errors import UnsupportedFeatureError
     with pytest.raises(UnsupportedFeatureError, match="reference table"):
         a.execute("DELETE FROM ref WHERE id = 1")
+
+
+def test_insert_select_routes_to_remote_host(pair):
+    """Review finding: the INSERT..SELECT array path must not drop rows
+    hashing to remote-hosted shards — it falls back to the routed pull
+    path in multi-host mode."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE src (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('src', 'k', 4)")
+    a.execute("CREATE TABLE dst (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('dst', 'k', 4, 'src')")
+    n = 800
+    a.copy_from("src", columns={"k": np.arange(n),
+                                "v": np.arange(n) * 2})
+    r = a.execute("INSERT INTO dst SELECT k, v FROM src")
+    assert r.explain["inserted"] == n
+    assert r.explain["strategy"] == "insert_select:pull"
+    assert a.execute("SELECT count(*), sum(v) FROM dst").rows == \
+        [(n, n * (n - 1))]
+    # no divergent local directory was created for the foreign node
+    t = a.catalog.table("dst")
+    for s in t.shards:
+        if s.placements[0] == nb:
+            assert not os.path.isdir(
+                a.catalog.shard_dir("dst", s.shard_id, nb))
+
+
+def test_truncate_forwards_to_remote_host(pair):
+    """Review finding: TRUNCATE must reach remote-hosted placements or
+    their rows resurrect through the remote read path."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE tr (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('tr', 'k', 4)")
+    a.copy_from("tr", columns={"k": np.arange(300),
+                               "v": np.ones(300, np.int64)})
+    assert a.execute("SELECT count(*) FROM tr").rows == [(300,)]
+    a.execute("TRUNCATE tr")
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT count(*) FROM tr").rows == [(0,)]
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT count(*) FROM tr").rows == [(0,)]
+
+
+def test_merge_into_remote_shards_fails_closed(pair):
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE mt (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('mt', 'k', 4)")
+    a.execute("CREATE TABLE ms (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('ms', 'k', 4, 'mt')")
+    from citus_tpu.errors import UnsupportedFeatureError
+    with pytest.raises(UnsupportedFeatureError, match="remote-hosted"):
+        a.execute("MERGE INTO mt USING ms ON mt.k = ms.k "
+                  "WHEN MATCHED THEN UPDATE SET v = ms.v "
+                  "WHEN NOT MATCHED THEN INSERT VALUES (ms.k, ms.v)")

@@ -410,3 +410,34 @@ def test_catalog_migration_framework(tmp_path):
     # refuse documents from the future
     with pytest.raises(CatalogError, match="newer than this build"):
         migrate_document({"format_version": CATALOG_FORMAT_VERSION + 1})
+
+
+def test_avg_overflow_guard_not_overstrict(cl):
+    """Review finding: avg()'s overflow limit must use the ARGUMENT
+    scale, not the +6-digit output scale — legitimate averages of large
+    values must not raise."""
+    cl.execute("CREATE TABLE big (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('big', 'k', 4)")
+    cl.copy_from("big", columns={
+        "k": np.arange(5000, dtype=np.int64),
+        "v": np.full(5000, 1_000_000_000, np.int64)})
+    import decimal
+    assert cl.execute("SELECT avg(v) FROM big").rows == \
+        [(decimal.Decimal("1000000000.000000"),)]
+    # and the guard still fires when the SUM truly leaves int64
+    cl.execute("UPDATE big SET v = 4611686018427387904")  # 2^62
+    with pytest.raises(ExecutionError, match="out of range"):
+        cl.execute("SELECT avg(v) FROM big")
+
+
+def test_upsert_canonicalizes_uuid_conflict_key(cl):
+    """Review finding: a non-canonical uuid spelling must CONFLICT with
+    the stored canonical row, not insert a duplicate."""
+    cl.execute("CREATE TABLE uc (id uuid NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('uc', 'v', 4)")
+    a = "a0eebc99-9c0b-4ef8-bb6d-6bb9bd380a11"
+    cl.execute(f"INSERT INTO uc VALUES ('{a}', 1)")
+    r = cl.execute(f"INSERT INTO uc VALUES ('{a.upper()}', 1) "
+                   "ON CONFLICT (id, v) DO NOTHING")
+    assert r.explain.get("skipped") == 1
+    assert cl.execute("SELECT count(*) FROM uc").rows == [(1,)]
