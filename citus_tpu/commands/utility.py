@@ -352,12 +352,30 @@ def _master_get_active_worker_nodes(cl, name, args):
 
 @utility("citus_add_node")
 def _citus_add_node(cl, name, args):
+    """citus_add_node([nodename, nodeport]): with arguments, the node
+    advertises a data-plane endpoint (pg_dist_node nodename/nodeport,
+    sql/citus--8.0-1.sql:401); without, a local-placement node."""
     from citus_tpu.catalog.catalog import NodeMeta
     nid = max(cl.catalog.nodes, default=-1) + 1
-    cl.catalog.nodes[nid] = NodeMeta(nid)
+    host = str(args[0]) if len(args) > 0 else None
+    port = int(args[1]) if len(args) > 1 else None
+    cl.catalog.nodes[nid] = NodeMeta(nid, True, host, port)
     cl.catalog.ddl_epoch += 1
     cl.catalog.commit()
     return Result(columns=["citus_add_node"], rows=[(nid,)])
+
+
+@utility("citus_remote_stats")
+def _citus_remote_stats(cl, name, args):
+    """Data-plane transfer counters (files/bytes fetched, batches
+    shipped, placement syncs) — the cross-host analog of the
+    connection-level stats views."""
+    rd = cl.catalog.remote_data
+    st = dict(rd.stats) if rd is not None else {}
+    cols = ["files_fetched", "bytes_fetched", "batches_shipped",
+            "remote_syncs"]
+    return Result(columns=cols,
+                  rows=[tuple(st.get(c, 0) for c in cols)])
 
 
 @utility("citus_remove_node")

@@ -1372,7 +1372,8 @@ class Parser:
         "run_command_on_placements", "master_get_table_ddl_events",
         "citus_backend_gpid", "citus_coordinator_nodeid",
         "create_time_partitions", "drop_old_time_partitions",
-        "time_partitions", "citus_stat_pool", "citus_extensions",
+        "time_partitions", "citus_stat_pool", "citus_remote_stats",
+        "citus_extensions",
         "citus_domains", "citus_collations", "citus_publications",
         "citus_statistics_objects",
     }
