@@ -716,6 +716,13 @@ class Cluster:
             return self._control.peer_inflight_xids()
         return set()
 
+    def _gxid_outcome(self, gxid: str):
+        """Resolve a cross-host branch against the authority's outcome
+        store ('commit'/'abort'/None while undecided or unreachable)."""
+        if self._control is not None:
+            return self._control.txn_outcome(gxid)
+        return None
+
     def _on_catalog_commit(self) -> None:
         if self._control is not None:
             self._control.publish_catalog_change()
@@ -757,7 +764,8 @@ class Cluster:
             d.register("transaction_recovery",
                        lambda: recover_transactions(
                            self.catalog, self.txlog,
-                           peer_inflight=self._peer_inflight()),
+                           peer_inflight=self._peer_inflight(),
+                           gxid_outcome=self._gxid_outcome),
                        interval_s=60.0)
             # global deadlock detection (reference:
             # CheckForDistributedDeadlocks every 2 s,
@@ -2145,6 +2153,81 @@ class Cluster:
                 for table, op, kw in txn.cdc_events:
                     # queued only for captured tables at statement time
                     self.cdc.emit(table, op, clock, force=True, **kw)
+        finally:
+            self.catalog._end_staging(txn)
+            txn.release_locks(self)
+            session.txn = None
+
+    # ---- cross-host two-phase branches (reference: PREPARE TRANSACTION
+    # on each worker + COMMIT PREPARED driven by the coordinator,
+    # transaction/remote_transaction.c) -------------------------------
+    def _prepare_branch(self, session, gxid: str) -> None:
+        """Phase 1 of a cross-host transaction branch: persist the
+        catalog version bumps and a durable PREPARED record carrying
+        the global transaction id, keeping the staged state and the
+        write locks.  The branch survives a crash of this process: its
+        PREPARED+gxid record resolves through the authority's outcome
+        store at recovery (presumed abort when no outcome exists)."""
+        from citus_tpu.transaction.manager import TxState
+        txn = session.txn
+        if txn.catalog_dirty or txn.on_commit:
+            raise UnsupportedFeatureError(
+                "DDL cannot ride a cross-host transaction branch")
+        for name in sorted(txn.tables):
+            if self.catalog.has_table(name):
+                self.catalog.table(name).version += 1
+        self.catalog._end_staging(txn)
+        self.catalog.commit()
+        payload = {"kind": "txn", "gxid": gxid,
+                   "placements": sorted(txn.delete_dirs),
+                   "ingest_placements": sorted(txn.ingest_dirs),
+                   "tables": sorted(txn.tables)}
+        self.txlog.log(txn.xid, TxState.PREPARED, payload)
+        txn.branch_payload = payload
+
+    def _finish_branch(self, session, commit: bool) -> None:
+        """Phase 2: COMMITTED + flip (or abort staged), DONE, release."""
+        import contextlib as _ctxlib
+
+        from citus_tpu.storage.deletes import (
+            abort_staged_deletes, commit_staged_deletes,
+        )
+        from citus_tpu.storage.writer import abort_staged, commit_staged
+        from citus_tpu.transaction.manager import TxState
+        from citus_tpu.transaction.snapshot import flip_generation
+        from citus_tpu.transaction.write_locks import group_resource
+        txn = session.txn
+        payload = getattr(txn, "branch_payload", None) or {}
+        try:
+            if commit:
+                self.txlog.log(txn.xid, TxState.COMMITTED, payload)
+                groups = {}
+                for name in payload.get("tables", ()):
+                    if self.catalog.has_table(name):
+                        t0 = self.catalog.table(name)
+                        groups.setdefault(group_resource(t0), t0)
+                with _ctxlib.ExitStack() as _flips:
+                    for res in sorted(groups):
+                        _flips.enter_context(flip_generation(
+                            self.catalog.data_dir, groups[res]))
+                    for d in payload.get("placements", ()):
+                        commit_staged_deletes(d, txn.xid)
+                    for d in payload.get("ingest_placements", ()):
+                        commit_staged(d, txn.xid)
+                self.txlog.log(txn.xid, TxState.DONE)
+                self._plan_cache.clear()
+                if txn.cdc_events:
+                    clock = self.clock.transaction_clock()
+                    for table, op, kw in txn.cdc_events:
+                        self.cdc.emit(table, op, clock, force=True, **kw)
+            else:
+                for d in payload.get("ingest_placements", ()):
+                    abort_staged(d, txn.xid)
+                for d in payload.get("placements", ()):
+                    abort_staged_deletes(d, txn.xid)
+                self.txlog.log(txn.xid, TxState.ABORTED, payload)
+                self.txlog.log(txn.xid, TxState.DONE)
+                self._plan_cache.clear()
         finally:
             self.catalog._end_staging(txn)
             txn.release_locks(self)

@@ -98,11 +98,6 @@ def _forward_remote_dml(cl, stmt, t, where):
             "DML on remote-hosted shards inside an explicit transaction "
             "is not supported yet (no cross-host 2PC)")
     endpoints = {cl.catalog.node_endpoint(o) for o in remote}
-    if owners != remote or len(endpoints) != 1:
-        raise UnsupportedFeatureError(
-            "a modify statement spanning shards on several hosts is not "
-            "supported yet (no cross-host 2PC); filter on the "
-            "distribution column to route it to one host")
     if getattr(stmt, "returning", None):
         raise UnsupportedFeatureError(
             "RETURNING is not supported on forwarded remote DML yet")
@@ -111,12 +106,96 @@ def _forward_remote_dml(cl, stmt, t, where):
         raise UnsupportedFeatureError(
             "cannot forward this modify statement to its remote host "
             "(no original SQL text — issue it as a single statement)")
-    r = cl.catalog.remote_data.call(next(iter(endpoints)), "execute_sql",
-                                    {"sql": sql})
+    if owners == remote and len(endpoints) == 1:
+        # router case: one remote owner, no local shards — forward the
+        # whole statement, its host's own 2PC makes it atomic
+        r = cl.catalog.remote_data.call(next(iter(endpoints)),
+                                        "execute_sql", {"sql": sql})
+        cl._plan_cache.clear()
+        return Result(columns=r.get("columns", []),
+                      rows=[tuple(row) for row in r.get("rows", [])],
+                      explain=r.get("explain", {}))
+    return _two_phase_remote_dml(cl, stmt, t, sql, sorted(endpoints),
+                                 has_local=(owners != remote))
+
+
+def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
+                          has_local: bool) -> Result:
+    """Cross-host 2PC for a modify spanning several hosts (reference:
+    PREPARE TRANSACTION on every write connection + COMMIT PREPARED,
+    transaction_management.c:319 / remote_transaction.c):
+
+    1. dml_prepare on every remote owner (statement runs there against
+       its placements, branch stays staged+locked, PREPARED durable);
+       a local branch prepares the same way when local shards survive;
+    2. the outcome is recorded DURABLY at the metadata authority
+       (gxid_outcomes store — the pg_dist_transaction analog); this is
+       the commit point: a branch that misses phase 2 resolves from it
+       (absent = presumed abort);
+    3. dml_decide(commit) everywhere + local finish."""
+    import uuid as _uuid
+    if cl._control is None:
+        raise UnsupportedFeatureError(
+            "a modify spanning several hosts needs a metadata authority "
+            "(the durable transaction-outcome store); attach the "
+            "coordinators via serve_port/coordinator")
+    gxid = _uuid.uuid4().hex
+    prepared: list = []
+    local_session = None
+    counts: dict = {}
+    try:
+        for ep in endpoints:
+            r = cl.catalog.remote_data.call(
+                ep, "dml_prepare", {"gxid": gxid, "sql": sql})
+            prepared.append(ep)
+            for k, v in (r.get("explain") or {}).items():
+                if isinstance(v, (int, float)):
+                    counts[k] = counts.get(k, 0) + v
+        if has_local:
+            local_session = cl.session()
+            guard = cl._remote_exec_guard
+            prev = getattr(guard, "v", False)
+            guard.v = True
+            try:
+                local_session.execute("BEGIN")
+                r = local_session.execute(sql)
+                cl._prepare_branch(local_session, gxid)
+            finally:
+                guard.v = prev
+            for k, v in (r.explain or {}).items():
+                if isinstance(v, (int, float)):
+                    counts[k] = counts.get(k, 0) + v
+    except BaseException:
+        # decision: abort — recorded first so expired branches agree
+        try:
+            cl._control.record_txn_outcome(gxid, "abort")
+        except Exception:
+            pass  # absent outcome = presumed abort anyway
+        for ep in prepared:
+            try:
+                cl.catalog.remote_data.call(
+                    ep, "dml_decide", {"gxid": gxid, "commit": False})
+            except Exception:
+                pass  # branch expiry resolves it
+        if local_session is not None and local_session.txn is not None:
+            try:
+                cl._finish_branch(local_session, False)
+            except Exception:
+                pass
+        raise
+    # THE commit point: durable before any branch flips
+    cl._control.record_txn_outcome(gxid, "commit")
+    for ep in endpoints:
+        try:
+            cl.catalog.remote_data.call(
+                ep, "dml_decide", {"gxid": gxid, "commit": True})
+        except Exception:
+            pass  # the branch resolves to commit from the outcome store
+    if local_session is not None:
+        cl._finish_branch(local_session, True)
     cl._plan_cache.clear()
-    return Result(columns=r.get("columns", []),
-                  rows=[tuple(row) for row in r.get("rows", [])],
-                  explain=r.get("explain", {}))
+    counts["gxid"] = gxid
+    return Result(columns=[], rows=[], explain=counts)
 
 
 @handles(A.Delete)

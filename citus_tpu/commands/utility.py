@@ -619,7 +619,8 @@ def _citus_cdc_events(cl, name, args):
 def _recover_prepared_transactions(cl, name, args):
     from citus_tpu.transaction.recovery import recover_transactions
     st = recover_transactions(cl.catalog, cl.txlog,
-                              peer_inflight=cl._peer_inflight())
+                              peer_inflight=cl._peer_inflight(),
+                              gxid_outcome=cl._gxid_outcome)
     return Result(columns=["recover_prepared_transactions"],
                   rows=[(st["rolled_forward"] + st["rolled_back"],)])
 

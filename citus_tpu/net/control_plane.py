@@ -92,6 +92,8 @@ class ControlPlane:
         self.server.register("push_catalog", self._on_push_catalog)
         self.server.register("fetch_dict", self._on_fetch_dict)
         self.server.register("grow_dict", self._on_grow_dict)
+        self.server.register("record_txn_outcome", self._on_record_txn_outcome)
+        self.server.register("txn_outcome", self._on_txn_outcome)
 
     # ---- server handlers ----------------------------------------------
     def _on_catalog_changed(self, payload: dict) -> dict:
@@ -211,6 +213,65 @@ class ControlPlane:
             raise RpcError("not attached to a metadata authority")
         return self.client.call("grow_dict", {
             "table": table, "column": column, "words": words})["words"]
+
+    # ---- cross-host transaction outcomes -------------------------------
+    # The durable commit point of a cross-host 2PC: the coordinator
+    # records the global transaction's outcome HERE before sending any
+    # phase-2 decision, so a branch host that crashed (or missed the
+    # decide) resolves the gxid from this store at recovery.  Absence
+    # of an outcome = presumed abort once the origin is gone — the
+    # pg_dist_transaction reconciliation model (transaction_recovery.c:
+    # commit if a record exists, abort otherwise).
+    def _outcomes_path(self) -> str:
+        return os.path.join(self.cluster.catalog.data_dir,
+                            "gxid_outcomes.jsonl")
+
+    def _outcome_store(self, gxid: str, outcome: str) -> None:
+        with self._lock:
+            with open(self._outcomes_path(), "a") as fh:
+                fh.write(json.dumps({"gxid": gxid,
+                                     "outcome": outcome}) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+
+    def _outcome_lookup(self, gxid: str) -> Optional[str]:
+        try:
+            with open(self._outcomes_path()) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        d = json.loads(line)
+                        if d.get("gxid") == gxid:
+                            return d.get("outcome")
+        except OSError:
+            pass
+        return None
+
+    def _on_record_txn_outcome(self, payload: dict) -> dict:
+        self._outcome_store(str(payload["gxid"]), str(payload["outcome"]))
+        return {"ok": True}
+
+    def _on_txn_outcome(self, payload: dict) -> dict:
+        return {"outcome": self._outcome_lookup(str(payload["gxid"]))}
+
+    def record_txn_outcome(self, gxid: str, outcome: str) -> None:
+        """Durably record a cross-host transaction's decision (at the
+        authority; locally when we ARE the authority)."""
+        if self.client is not None:
+            self.client.call("record_txn_outcome",
+                             {"gxid": gxid, "outcome": outcome})
+        else:
+            self._outcome_store(gxid, outcome)
+
+    def txn_outcome(self, gxid: str) -> Optional[str]:
+        """'commit' | 'abort' | None (undecided/unknown)."""
+        try:
+            if self.client is not None:
+                return self.client.call("txn_outcome",
+                                        {"gxid": gxid}).get("outcome")
+            return self._outcome_lookup(gxid)
+        except RpcError:
+            return None
 
     # ---- client-side ---------------------------------------------------
     def _on_event(self, event: dict) -> None:

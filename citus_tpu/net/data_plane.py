@@ -93,7 +93,12 @@ class DataPlaneServer:
         s.register("ingest_batch", self._on_ingest_batch)
         s.register("drop_placement", self._on_drop_placement)
         s.register("execute_sql", self._on_execute_sql)
+        s.register("dml_prepare", self._on_dml_prepare)
+        s.register("dml_decide", self._on_dml_decide)
         s.start()
+        # open cross-host transaction branches: gxid -> (Session, born)
+        self._branches: dict = {}
+        self._branches_mu = threading.Lock()
 
     @property
     def port(self) -> int:
@@ -175,6 +180,75 @@ class DataPlaneServer:
                 "rows": [list(row) for row in r.rows],
                 "explain": {k: v for k, v in (r.explain or {}).items()
                             if isinstance(v, (int, float, str))}}
+
+    #: a branch with no phase-2 decision resolves itself after this
+    #: long (via the authority's outcome store; presumed abort)
+    BRANCH_EXPIRE_S = 120.0
+
+    def _on_dml_prepare(self, p: dict) -> dict:
+        """Phase 1 of a cross-host modify: run the forwarded statement
+        against OUR placements inside an open transaction, then make
+        the branch durable (PREPARED + gxid) while keeping its locks —
+        PostgreSQL's PREPARE TRANSACTION, with the statement shipped as
+        SQL like every worker task in the reference."""
+        import time as _time
+        gxid = str(p["gxid"])
+        cl = self.cluster
+        self._expire_stale_branches()
+        s = cl.session()
+        guard = cl._remote_exec_guard
+        prev = getattr(guard, "v", False)
+        guard.v = True
+        try:
+            s.execute("BEGIN")
+            r = s.execute(str(p["sql"]))
+            cl._prepare_branch(s, gxid)
+        except BaseException:
+            if s.txn is not None:
+                try:
+                    s.execute("ROLLBACK")
+                except Exception:
+                    pass
+            raise
+        finally:
+            guard.v = prev
+        with self._branches_mu:
+            self._branches[gxid] = (s, _time.monotonic())
+        return {"explain": {k: v for k, v in (r.explain or {}).items()
+                            if isinstance(v, (int, float, str))}}
+
+    def _on_dml_decide(self, p: dict) -> dict:
+        gxid = str(p["gxid"])
+        with self._branches_mu:
+            entry = self._branches.pop(gxid, None)
+        if entry is None:
+            # already resolved (expiry raced the decide): report what
+            # the durable outcome store decided so the coordinator can
+            # detect divergence instead of assuming success
+            outcome = None
+            if self.cluster._control is not None:
+                outcome = self.cluster._control.txn_outcome(gxid)
+            return {"ok": False, "resolved": outcome}
+        s, _born = entry
+        self.cluster._finish_branch(s, bool(p.get("commit")))
+        return {"ok": True}
+
+    def _expire_stale_branches(self) -> None:
+        """Resolve branches whose coordinator never sent phase 2: the
+        authority's outcome store decides (absent = presumed abort
+        after the expiry window)."""
+        import time as _time
+        now = _time.monotonic()
+        with self._branches_mu:
+            stale = [(g, s) for g, (s, born) in self._branches.items()
+                     if now - born > self.BRANCH_EXPIRE_S]
+            for g, _s in stale:
+                self._branches.pop(g, None)
+        for gxid, s in stale:
+            outcome = None
+            if self.cluster._control is not None:
+                outcome = self.cluster._control.txn_outcome(gxid)
+            self.cluster._finish_branch(s, outcome == "commit")
 
     def _on_drop_placement(self, p: dict) -> dict:
         """Deferred-drop a placement directory after its shard moved

@@ -35,13 +35,22 @@ ORPHAN_GRACE_SECONDS = 300.0
 
 def recover_transactions(cat: Catalog, txlog: TransactionLog,
                          grace_seconds: float = ORPHAN_GRACE_SECONDS,
-                         peer_inflight: "Optional[set]" = None) -> dict:
+                         peer_inflight: "Optional[set]" = None,
+                         gxid_outcome=None) -> dict:
     """Apply every undecided transaction's outcome; returns counts.
 
     ``peer_inflight``: xids other coordinators report live over the
     control plane (net/control_plane.py) — spared like local in-flight
     transactions.  This is the RPC generalization of the flock liveness
-    probe for deployments where flock can't span hosts."""
+    probe for deployments where flock can't span hosts.
+
+    ``gxid_outcome(gxid) -> 'commit'|'abort'|None``: resolves a
+    cross-host transaction BRANCH (a PREPARED record carrying a gxid)
+    against the authority's durable outcome store — the reconciliation
+    the reference does between pg_dist_transaction and the workers'
+    pg_prepared_xacts (transaction_recovery.c): commit if an outcome
+    record exists, abort if the store says so, leave in place while
+    undecided/unreachable."""
     from citus_tpu.storage.deletes import abort_staged_deletes, commit_staged_deletes
 
     peer_inflight = peer_inflight or set()
@@ -74,6 +83,15 @@ def recover_transactions(cat: Catalog, txlog: TransactionLog,
         kind = payload.get("kind", "ingest")
         placements = payload.get("placements", [])
         ingest_placements = payload.get("ingest_placements", [])
+        if state == TxState.PREPARED and payload.get("gxid"):
+            # cross-host branch: its outcome lives at the authority,
+            # never presumed from local state alone
+            outcome = gxid_outcome(payload["gxid"]) \
+                if gxid_outcome is not None else None
+            if outcome == "commit":
+                state = TxState.COMMITTED
+            elif outcome != "abort":
+                continue  # undecided/unreachable: keep the branch
         if state == TxState.COMMITTED:
             for d in placements:
                 if os.path.isdir(d):

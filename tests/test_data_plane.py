@@ -284,10 +284,11 @@ def test_remote_dml_forwarding_and_guard(pair):
     assert r.explain.get("deleted") == 1
     GLOBAL_CACHE.clear()
     assert a.execute("SELECT count(*) FROM w").rows == [(n - 1,)]
-    # a modify spanning both hosts raises rather than half-applying
-    from citus_tpu.errors import UnsupportedFeatureError
-    with pytest.raises(UnsupportedFeatureError, match="several hosts"):
-        a.execute("UPDATE w SET v = 9")
+    # a modify spanning both hosts runs as a cross-host 2PC
+    r = a.execute("UPDATE w SET v = 9")
+    assert r.explain.get("updated") == n - 1
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT sum(v) FROM w").rows == [(9 * (n - 1),)]
 
 
 def test_reference_table_replicates_to_remote_host(pair):
@@ -364,3 +365,96 @@ def test_merge_into_remote_shards_fails_closed(pair):
         a.execute("MERGE INTO mt USING ms ON mt.k = ms.k "
                   "WHEN MATCHED THEN UPDATE SET v = ms.v "
                   "WHEN NOT MATCHED THEN INSERT VALUES (ms.k, ms.v)")
+
+
+def test_multi_host_update_two_phase_commit(pair):
+    """A modify spanning shards on BOTH hosts commits atomically via
+    cross-host 2PC: prepare everywhere, durable outcome at the
+    authority, decide everywhere."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE tp (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('tp', 'k', 4)")
+    n = 600
+    a.copy_from("tp", columns={"k": np.arange(n), "v": np.zeros(n, np.int64)})
+    r = a.execute("UPDATE tp SET v = 5 WHERE k % 2 = 0")
+    assert r.explain.get("updated") == n // 2
+    assert "gxid" in r.explain
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT sum(v) FROM tp").rows == [(5 * n // 2,)]
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT sum(v) FROM tp").rows == [(5 * n // 2,)]
+    # and a multi-host DELETE
+    r = a.execute("DELETE FROM tp WHERE v = 5")
+    assert r.explain.get("deleted") == n // 2
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT count(*) FROM tp").rows == [(n // 2,)]
+
+
+def test_multi_host_update_aborts_atomically_on_branch_failure(pair):
+    """One branch failing to prepare aborts the WHOLE statement: no
+    host applies anything (presumed abort + explicit decides)."""
+    import threading
+
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE ab (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('ab', 'k', 4)")
+    n = 400
+    a.copy_from("ab", columns={"k": np.arange(n), "v": np.zeros(n, np.int64)})
+    # wedge B's branch: a foreign holder keeps B's colocation-group
+    # flock EXCLUSIVE so B's dml_prepare times out
+    from citus_tpu.transaction.write_locks import group_resource, lockfile_path
+    import fcntl
+    res = group_resource(b.catalog.table("ab"))
+    lockpath = lockfile_path(b.catalog.data_dir, res)
+    fd = open(lockpath, "w")
+    fcntl.flock(fd, fcntl.LOCK_EX)
+    b.settings.executor.lock_timeout_s = 1.0
+    try:
+        with pytest.raises(Exception):
+            a.execute("UPDATE ab SET v = 9")
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        fd.close()
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    # nothing applied anywhere — A's branch rolled back too
+    assert a.execute("SELECT sum(v) FROM ab").rows == [(0,)]
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT sum(v) FROM ab").rows == [(0,)]
+
+
+def test_branch_resolves_from_outcome_store_when_decide_lost(pair):
+    """A prepared branch whose phase-2 decide never arrives resolves
+    from the authority's durable outcome store (commit case) — the
+    pg_dist_transaction reconciliation."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE rb (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('rb', 'k', 4)")
+    n = 200
+    a.copy_from("rb", columns={"k": np.arange(n), "v": np.zeros(n, np.int64)})
+    import uuid as uuid_mod2
+    gxid = uuid_mod2.uuid4().hex
+    # phase 1 directly against B's data server; then "lose" the decide
+    ep = ("127.0.0.1", b.data_port)
+    r = a.catalog.remote_data.call(
+        ep, "dml_prepare", {"gxid": gxid, "sql": "UPDATE rb SET v = 3"})
+    assert r["explain"]["updated"] > 0
+    # durable commit decision at the authority, decide never sent
+    a._control.record_txn_outcome(gxid, "commit")
+    # branch expiry consults the store and COMMITS
+    b._data_server.BRANCH_EXPIRE_S = 0.0
+    b._data_server._expire_stale_branches()
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    updated = [row for row in b.execute("SELECT k, v FROM rb").rows
+               if row[1] == 3]
+    assert updated, "B's branch must have committed from the store"
+    # abort case: no outcome recorded -> presumed abort
+    gxid2 = uuid_mod2.uuid4().hex
+    a.catalog.remote_data.call(
+        ep, "dml_prepare", {"gxid": gxid2, "sql": "UPDATE rb SET v = 8"})
+    b._data_server._expire_stale_branches()
+    GLOBAL_CACHE.clear()
+    assert not [row for row in b.execute("SELECT v FROM rb").rows
+                if row[0] == 8]
