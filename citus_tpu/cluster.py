@@ -767,6 +767,12 @@ class Cluster:
                            peer_inflight=self._peer_inflight(),
                            gxid_outcome=self._gxid_outcome),
                        interval_s=60.0)
+            if self._data_server is not None:
+                # abandoned cross-host branches must resolve (and drop
+                # their write locks) even if no further RPC arrives
+                d.register("branch_expiry",
+                           self._data_server.expire_branches,
+                           interval_s=30.0)
             # global deadlock detection (reference:
             # CheckForDistributedDeadlocks every 2 s,
             # distributed_deadlock_detection.c:105)

@@ -11,7 +11,9 @@ colocation-group write-lock protocol.
 from __future__ import annotations
 
 from citus_tpu.commands.registry import handles
-from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
+from citus_tpu.errors import (
+    AnalysisError, ExecutionError, UnsupportedFeatureError,
+)
 from citus_tpu.executor import Result
 from citus_tpu.planner import ast as A
 
@@ -70,9 +72,9 @@ def _forward_remote_dml(cl, stmt, t, where):
     """A modify statement whose surviving shards live on other
     coordinators: a single remote owner gets the whole statement
     forwarded (the router path — reference: deparsed SQL shipped to the
-    owning worker over libpq); shards spanning several hosts raise
-    until cross-host 2PC exists.  Returns a Result when forwarded,
-    None when every surviving shard is local."""
+    owning worker over libpq); shards spanning several hosts run as a
+    cross-host 2PC (_two_phase_remote_dml).  Returns a Result when
+    handled remotely, None when every surviving shard is local."""
     if cl.catalog.remote_data is None \
             or getattr(cl._remote_exec_guard, "v", False):
         return None
@@ -142,7 +144,35 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
     gxid = _uuid.uuid4().hex
     prepared: list = []
     local_session = None
+    local_prepared = False
     counts: dict = {}
+
+    def _abort_everything() -> None:
+        # claim abort in the decision register first, so any branch
+        # that expires concurrently agrees; then best-effort decides
+        try:
+            cl._control.record_txn_outcome(gxid, "abort")
+        except Exception:
+            pass  # absent outcome = presumed abort via branch claims
+        for ep in prepared:
+            try:
+                cl.catalog.remote_data.call(
+                    ep, "dml_decide", {"gxid": gxid, "commit": False})
+            except Exception:
+                pass  # branch expiry resolves it
+        if local_session is not None and local_session.txn is not None:
+            try:
+                if local_prepared:
+                    cl._finish_branch(local_session, False)
+                else:
+                    # statement failed BEFORE prepare: the txn is a
+                    # plain open transaction — normal rollback cleans
+                    # its staged files (finish_branch's empty payload
+                    # would leak them)
+                    cl._rollback_txn(local_session)
+            except Exception:
+                pass
+
     try:
         for ep in endpoints:
             r = cl.catalog.remote_data.call(
@@ -160,35 +190,36 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
                 local_session.execute("BEGIN")
                 r = local_session.execute(sql)
                 cl._prepare_branch(local_session, gxid)
+                local_prepared = True
             finally:
                 guard.v = prev
             for k, v in (r.explain or {}).items():
                 if isinstance(v, (int, float)):
                     counts[k] = counts.get(k, 0) + v
+        # THE commit point: first writer into the durable decision
+        # register wins — if a participant's presumed-abort claim got
+        # there first, WE must abort
+        winner = cl._control.record_txn_outcome(gxid, "commit")
+        if winner != "commit":
+            raise ExecutionError(
+                "cross-host transaction aborted by a participant "
+                "(branch timed out before the commit decision)")
     except BaseException:
-        # decision: abort — recorded first so expired branches agree
-        try:
-            cl._control.record_txn_outcome(gxid, "abort")
-        except Exception:
-            pass  # absent outcome = presumed abort anyway
-        for ep in prepared:
-            try:
-                cl.catalog.remote_data.call(
-                    ep, "dml_decide", {"gxid": gxid, "commit": False})
-            except Exception:
-                pass  # branch expiry resolves it
-        if local_session is not None and local_session.txn is not None:
-            try:
-                cl._finish_branch(local_session, False)
-            except Exception:
-                pass
+        _abort_everything()
         raise
-    # THE commit point: durable before any branch flips
-    cl._control.record_txn_outcome(gxid, "commit")
     for ep in endpoints:
         try:
-            cl.catalog.remote_data.call(
+            r = cl.catalog.remote_data.call(
                 ep, "dml_decide", {"gxid": gxid, "commit": True})
+            if not r.get("ok") and r.get("resolved") != "commit":
+                # unreachable by design: the decision register makes a
+                # committed gxid resolve to commit everywhere — surface
+                # loudly if the invariant ever breaks
+                raise ExecutionError(
+                    f"cross-host branch on {ep} diverged: resolved="
+                    f"{r.get('resolved')!r} after a committed outcome")
+        except ExecutionError:
+            raise
         except Exception:
             pass  # the branch resolves to commit from the outcome store
     if local_session is not None:

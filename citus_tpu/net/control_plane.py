@@ -226,13 +226,27 @@ class ControlPlane:
         return os.path.join(self.cluster.catalog.data_dir,
                             "gxid_outcomes.jsonl")
 
-    def _outcome_store(self, gxid: str, outcome: str) -> None:
+    def _outcome_store(self, gxid: str, outcome: str) -> str:
+        """First-writer-wins decision register: the FIRST recorded
+        outcome for a gxid is THE outcome; later writers get the winner
+        back.  This is what makes participant-side presumed abort and
+        the coordinator's commit race-free — whoever reaches the store
+        first decides, and everyone else converges on that."""
         with self._lock:
-            with open(self._outcomes_path(), "a") as fh:
-                fh.write(json.dumps({"gxid": gxid,
-                                     "outcome": outcome}) + "\n")
-                fh.flush()
-                os.fsync(fh.fileno())
+            existing = self._outcome_lookup(gxid)
+            if existing is not None:
+                return existing
+            from citus_tpu.catalog.catalog import _catalog_flock
+            with _catalog_flock(self.cluster.catalog.data_dir):
+                existing = self._outcome_lookup(gxid)
+                if existing is not None:
+                    return existing
+                with open(self._outcomes_path(), "a") as fh:
+                    fh.write(json.dumps({"gxid": gxid,
+                                         "outcome": outcome}) + "\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+        return outcome
 
     def _outcome_lookup(self, gxid: str) -> Optional[str]:
         try:
@@ -248,30 +262,35 @@ class ControlPlane:
         return None
 
     def _on_record_txn_outcome(self, payload: dict) -> dict:
-        self._outcome_store(str(payload["gxid"]), str(payload["outcome"]))
-        return {"ok": True}
+        winner = self._outcome_store(str(payload["gxid"]),
+                                     str(payload["outcome"]))
+        return {"ok": True, "outcome": winner}
 
     def _on_txn_outcome(self, payload: dict) -> dict:
         return {"outcome": self._outcome_lookup(str(payload["gxid"]))}
 
-    def record_txn_outcome(self, gxid: str, outcome: str) -> None:
+    def record_txn_outcome(self, gxid: str, outcome: str) -> str:
         """Durably record a cross-host transaction's decision (at the
-        authority; locally when we ARE the authority)."""
+        authority; locally when we ARE the authority).  Returns the
+        WINNING outcome — an earlier writer's decision wins, and the
+        caller must follow it."""
         if self.client is not None:
-            self.client.call("record_txn_outcome",
-                             {"gxid": gxid, "outcome": outcome})
-        else:
-            self._outcome_store(gxid, outcome)
+            return str(self.client.call(
+                "record_txn_outcome",
+                {"gxid": gxid, "outcome": outcome})["outcome"])
+        return self._outcome_store(gxid, outcome)
 
     def txn_outcome(self, gxid: str) -> Optional[str]:
-        """'commit' | 'abort' | None (undecided/unknown)."""
+        """'commit' | 'abort' | None (no outcome recorded at a
+        REACHABLE authority) | 'unknown' (authority unreachable —
+        callers must keep waiting, never presume)."""
         try:
             if self.client is not None:
                 return self.client.call("txn_outcome",
                                         {"gxid": gxid}).get("outcome")
             return self._outcome_lookup(gxid)
         except RpcError:
-            return None
+            return "unknown"
 
     # ---- client-side ---------------------------------------------------
     def _on_event(self, event: dict) -> None:

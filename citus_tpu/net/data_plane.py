@@ -95,10 +95,12 @@ class DataPlaneServer:
         s.register("execute_sql", self._on_execute_sql)
         s.register("dml_prepare", self._on_dml_prepare)
         s.register("dml_decide", self._on_dml_decide)
-        s.start()
         # open cross-host transaction branches: gxid -> (Session, born)
+        # — initialized BEFORE accepting connections (an early
+        # dml_prepare must find them)
         self._branches: dict = {}
         self._branches_mu = threading.Lock()
+        s.start()
 
     @property
     def port(self) -> int:
@@ -234,21 +236,39 @@ class DataPlaneServer:
         return {"ok": True}
 
     def _expire_stale_branches(self) -> None:
-        """Resolve branches whose coordinator never sent phase 2: the
-        authority's outcome store decides (absent = presumed abort
-        after the expiry window)."""
+        """Resolve branches whose coordinator never sent phase 2.
+
+        Presumed abort, done safely: the participant CLAIMS abort
+        through the authority's first-writer-wins decision register —
+        if the coordinator already recorded commit, the claim returns
+        'commit' and the branch commits; if the participant's claim
+        wins, any later coordinator commit attempt gets 'abort' back
+        and aborts everywhere.  An UNREACHABLE authority keeps the
+        branch (locks held — the blocking nature of 2PC; the reference
+        blocks on in-doubt prepared transactions the same way)."""
         import time as _time
+        if self.cluster._control is None:
+            return
         now = _time.monotonic()
         with self._branches_mu:
             stale = [(g, s) for g, (s, born) in self._branches.items()
                      if now - born > self.BRANCH_EXPIRE_S]
-            for g, _s in stale:
-                self._branches.pop(g, None)
         for gxid, s in stale:
-            outcome = None
-            if self.cluster._control is not None:
-                outcome = self.cluster._control.txn_outcome(gxid)
-            self.cluster._finish_branch(s, outcome == "commit")
+            try:
+                winner = self.cluster._control.record_txn_outcome(
+                    gxid, "abort")
+            except Exception:
+                continue  # authority unreachable: keep the branch
+            with self._branches_mu:
+                if self._branches.pop(gxid, None) is None:
+                    continue  # a decide raced us and already resolved it
+            self.cluster._finish_branch(s, winner == "commit")
+
+    def expire_branches(self) -> None:
+        """Maintenance-daemon duty: resolve abandoned branches even when
+        no further RPC ever arrives (a branch must not hold its write
+        locks forever because its coordinator died)."""
+        self._expire_stale_branches()
 
     def _on_drop_placement(self, p: dict) -> dict:
         """Deferred-drop a placement directory after its shard moved
