@@ -109,7 +109,11 @@ import citus_tpu as ct
 from citus_tpu.transaction.locks import DeadlockDetected
 
 data_dir, sync_dir = sys.argv[1], sys.argv[2]
-cl = ct.Cluster(data_dir)
+from citus_tpu.config import ExecutorSettings, Settings
+# a generous lock timeout separates "victim-cancelled by detection"
+# from "gave up by timeout" even on a heavily loaded test box
+cl = ct.Cluster(data_dir, settings=Settings(
+    executor=ExecutorSettings(lock_timeout_s=120.0)))
 s = cl.session()
 s.execute("BEGIN")
 s.execute("UPDATE a SET v = v + 1 WHERE k = 1")   # lock group a
@@ -138,7 +142,9 @@ def test_two_process_opposite_order_resolves_by_victim(tmp_path):
     data_dir = str(tmp_path / "db")
     sync_dir = str(tmp_path / "sync")
     os.makedirs(sync_dir)
-    cl = ct.Cluster(data_dir)
+    from citus_tpu.config import ExecutorSettings, Settings
+    cl = ct.Cluster(data_dir, settings=Settings(
+        executor=ExecutorSettings(lock_timeout_s=120.0)))
     cl.execute("CREATE TABLE a (k bigint, v bigint)")
     cl.execute("CREATE TABLE b (k bigint, v bigint)")
     cl.create_distributed_table("a", "k", 2, colocate_with="none")
@@ -168,11 +174,11 @@ def test_two_process_opposite_order_resolves_by_victim(tmp_path):
     s.execute("UPDATE a SET v = v + 1 WHERE k = 1")  # blocks, then wins
     elapsed = time.time() - t0
     s.execute("COMMIT")
-    out, err = child.communicate(timeout=60)
+    out, err = child.communicate(timeout=180)
     assert "CHILD_DEADLOCK_VICTIM" in out, (out, err)
-    # resolved by cancellation (detection interval ~2s), not by the 30s
-    # lock timeout
-    assert elapsed < 25, f"took {elapsed:.1f}s — smells like LockTimeout"
+    # resolved by cancellation (detection interval ~2s, generous
+    # load headroom), not by the 120s lock timeout
+    assert elapsed < 90, f"took {elapsed:.1f}s — smells like LockTimeout"
     assert cl.execute("SELECT v FROM a WHERE k = 1").rows == [(1,)]
     assert cl.execute("SELECT v FROM b WHERE k = 1").rows == [(1,)]
     cl.close()
