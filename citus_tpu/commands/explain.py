@@ -23,6 +23,42 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
             lines.append(f"  -> {side}:")
             lines.extend("     " + row[0] for row in r.rows)
         return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+    if isinstance(stmt.statement, (A.Update, A.Delete)):
+        # modify-plan display (reference: EXPLAIN on the router /
+        # multi-shard modify path shows the task distribution)
+        m = stmt.statement
+        t = cl.catalog.table(m.table)
+        op = "Update" if isinstance(m, A.Update) else "Delete"
+        if t.is_partitioned:
+            from citus_tpu.partitioning import prune_partitions
+            surv = prune_partitions(cl.catalog, t, m.where)
+            lines = [f"{op} on {m.table} "
+                     f"(partitions: {len(surv)}/"
+                     f"{len(cl.catalog.partitions_of(m.table))})"]
+            return Result(columns=["QUERY PLAN"],
+                          rows=[(l,) for l in lines])
+        from citus_tpu.planner.bind import Binder
+        from citus_tpu.planner.physical import extract_intervals, prune_shards
+        where = Binder(cl.catalog, t).bind_scalar(m.where) \
+            if m.where is not None else None
+        sis = prune_shards(t, where)
+        lines = [f"{op} on {m.table} (shards: {len(sis)}/{len(t.shards)})"]
+        ivs = [c.column for c in extract_intervals(where)] if where is not None else []
+        if ivs:
+            lines.append(f"  Shard/Chunk Pruning: {', '.join(sorted(set(ivs)))}")
+        owners = {t.shards[si].placements[0] for si in sis}
+        remote = {o for o in owners if cl.catalog.is_remote_node(o)}
+        if remote and owners == remote and len(
+                {cl.catalog.node_endpoint(o) for o in remote}) == 1:
+            lines.append("  Strategy: forward to remote owner "
+                         "(router, statement shipped as SQL)")
+        elif remote:
+            lines.append(f"  Strategy: cross-host two-phase commit "
+                         f"({len(remote)} remote node(s))")
+        else:
+            lines.append("  Strategy: local (deletion bitmaps"
+                         + (" + re-insert)" if op == "Update" else ")"))
+        return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
     if isinstance(stmt.statement, A.Insert) \
             and stmt.statement.select is not None:
         ins = stmt.statement
@@ -53,7 +89,8 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
         return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
     if not isinstance(stmt.statement, A.Select):
         raise UnsupportedFeatureError(
-            "EXPLAIN supports SELECT, set operations, and INSERT..SELECT")
+            "EXPLAIN supports SELECT, set operations, UPDATE/DELETE, "
+            "and INSERT..SELECT")
     sel = stmt.statement
     if len(sel.group_by) == 1 and isinstance(sel.group_by[0],
                                              A.GroupingSetsSpec):

@@ -323,7 +323,7 @@ class Binder:
                     return BLiteral(inner.value, target)
                 raise UnsupportedFeatureError("cast to text not supported")
             if target.kind in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ,
-                               T.INTERVAL) \
+                               T.TIME, T.INTERVAL) \
                     and isinstance(inner, BLiteral) \
                     and isinstance(inner.value, str):
                 # typed literal: date '1998-12-01' folds at bind time
@@ -367,7 +367,8 @@ class Binder:
     def _coerce_string_literal(self, lit: BLiteral, target: T.ColumnType,
                                column: Optional[BColumn]) -> BLiteral:
         """'1994-01-01' vs date column, 'AIR' vs text column, etc."""
-        if target.kind in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ, T.INTERVAL):
+        if target.kind in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ, T.TIME,
+                           T.INTERVAL):
             return BLiteral(target.to_physical(lit.value), target)
         if target.is_text:
             if column is None:

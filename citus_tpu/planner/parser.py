@@ -1824,7 +1824,7 @@ class Parser:
             return e
         if t.kind == "ident" \
                 and t.value in ("date", "timestamp", "timestamptz",
-                                "uuid", "bytea") \
+                                "time", "uuid", "bytea") \
                 and self.peek(1).kind == "str":
             # typed literal: date '1998-12-01' / uuid 'a0ee...' / ...
             tname = t.value

@@ -55,6 +55,7 @@ DECIMAL = "decimal"
 DATE = "date"
 TIMESTAMP = "timestamp"
 TIMESTAMPTZ = "timestamptz"
+TIME = "time"
 INTERVAL = "interval"
 TEXT = "text"
 UUID = "uuid"
@@ -74,6 +75,7 @@ _STORAGE_DTYPES = {
     DATE: np.int32,
     TIMESTAMP: np.int64,
     TIMESTAMPTZ: np.int64,
+    TIME: np.int64,
     INTERVAL: np.int64,
     TEXT: np.int32,
     UUID: np.int32,
@@ -93,6 +95,7 @@ _DEVICE_DTYPES = {
     DATE: np.int32,
     TIMESTAMP: np.int64,
     TIMESTAMPTZ: np.int64,
+    TIME: np.int64,
     INTERVAL: np.int64,
     TEXT: np.int32,
     UUID: np.int32,
@@ -258,6 +261,14 @@ class ColumnType:
             value = value.astimezone(datetime.timezone.utc)
             delta = value.replace(tzinfo=None) - datetime.datetime(1970, 1, 1)
             return delta // datetime.timedelta(microseconds=1)
+        if k == TIME:
+            if isinstance(value, str):
+                value = datetime.time.fromisoformat(value)
+            if isinstance(value, datetime.datetime):
+                value = value.time()
+            return (value.hour * 3_600_000_000
+                    + value.minute * 60_000_000
+                    + value.second * 1_000_000 + value.microsecond)
         if k == INTERVAL:
             if isinstance(value, datetime.timedelta):
                 return value // datetime.timedelta(microseconds=1)
@@ -285,6 +296,11 @@ class ColumnType:
             # tz-aware, pinned UTC (our session TimeZone)
             return datetime.datetime.fromtimestamp(
                 raw / 1_000_000, tz=datetime.timezone.utc)
+        if k == TIME:
+            us = int(raw)
+            return datetime.time(us // 3_600_000_000,
+                                 us // 60_000_000 % 60,
+                                 us // 1_000_000 % 60, us % 1_000_000)
         if k == INTERVAL:
             return datetime.timedelta(microseconds=int(raw))
         raise AnalysisError(f"cannot convert value for type {self}")
@@ -365,6 +381,7 @@ FLOAT64_T = ColumnType(FLOAT64)
 DATE_T = ColumnType(DATE)
 TIMESTAMP_T = ColumnType(TIMESTAMP)
 TIMESTAMPTZ_T = ColumnType(TIMESTAMPTZ)
+TIME_T = ColumnType(TIME)
 INTERVAL_T = ColumnType(INTERVAL)
 TEXT_T = ColumnType(TEXT)
 UUID_T = ColumnType(UUID)
@@ -398,6 +415,7 @@ _SQL_NAMES = {
     "date": DATE_T,
     "timestamp": TIMESTAMP_T,
     "timestamptz": TIMESTAMPTZ_T,
+    "time": TIME_T,
     "interval": INTERVAL_T,
     "text": TEXT_T,
     "varchar": TEXT_T,

@@ -441,3 +441,38 @@ def test_upsert_canonicalizes_uuid_conflict_key(cl):
                    "ON CONFLICT (id, v) DO NOTHING")
     assert r.explain.get("skipped") == 1
     assert cl.execute("SELECT count(*) FROM uc").rows == [(1,)]
+
+
+class TestTimeType:
+    def test_time_round_trip_and_filters(self, cl):
+        cl.execute("CREATE TABLE sh (k bigint NOT NULL, at time)")
+        cl.execute("SELECT create_distributed_table('sh', 'k', 4)")
+        cl.copy_from("sh", rows=[
+            (1, "09:15:00"), (2, "18:40:11.25"),
+            (3, datetime.time(23, 59, 59)), (4, None)])
+        rows = dict(cl.execute("SELECT k, at FROM sh").rows)
+        assert rows[1] == datetime.time(9, 15)
+        assert rows[2] == datetime.time(18, 40, 11, 250000)
+        assert rows[3] == datetime.time(23, 59, 59)
+        assert rows[4] is None
+        assert cl.execute(
+            "SELECT count(*) FROM sh WHERE at > '12:00:00'").rows == [(2,)]
+        assert cl.execute(
+            "SELECT count(*) FROM sh WHERE at = time '09:15:00'"
+        ).rows == [(1,)]
+        assert cl.execute(
+            "SELECT min(at), max(at) FROM sh").rows == \
+            [(datetime.time(9, 15), datetime.time(23, 59, 59))]
+
+
+def test_explain_update_delete(cl):
+    cl.execute("CREATE TABLE ex (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('ex', 'k', 8)")
+    cl.copy_from("ex", rows=[(i, i) for i in range(50)])
+    out = "\n".join(r[0] for r in cl.execute(
+        "EXPLAIN UPDATE ex SET v = 1 WHERE k = 5").rows)
+    assert "Update on ex (shards: 1/8)" in out
+    assert "Strategy: local" in out
+    out = "\n".join(r[0] for r in cl.execute(
+        "EXPLAIN DELETE FROM ex").rows)
+    assert "Delete on ex (shards: 8/8)" in out
