@@ -248,3 +248,28 @@ def test_distinct_on_expression(events):
                      "ORDER BY dev % 2, ts DESC").rows
     assert [r[0] for r in got] == [0, 1]
     assert all(r[1] == 19 for r in got)
+
+
+def test_insert_select_on_conflict(tmp_path):
+    """INSERT..SELECT ... ON CONFLICT (pull strategy + upsert
+    machinery; reference: insert_select_executor.c's pull path handles
+    ON CONFLICT via colocated intermediate results)."""
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE dst (k bigint NOT NULL PRIMARY KEY, "
+               "v bigint)")
+    cl.execute("SELECT create_distributed_table('dst', 'k', 4)")
+    cl.execute("CREATE TABLE src (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('src', 'k', 4, 'dst')")
+    cl.copy_from("dst", rows=[(1, 10), (2, 20)])
+    cl.copy_from("src", rows=[(1, 111), (3, 333)])
+    r = cl.execute("INSERT INTO dst SELECT k, v FROM src "
+                   "ON CONFLICT (k) DO UPDATE SET v = excluded.v")
+    assert r.explain["inserted"] == 1 and r.explain["updated"] == 1
+    assert sorted(cl.execute("SELECT k, v FROM dst").rows) == \
+        [(1, 111), (2, 20), (3, 333)]
+    # DO NOTHING flavor
+    r = cl.execute("INSERT INTO dst SELECT k, v FROM src "
+                   "ON CONFLICT (k) DO NOTHING")
+    assert r.explain["skipped"] == 2 and r.explain["inserted"] == 0
+    cl.close()
