@@ -1,0 +1,8 @@
+CREATE TABLE edges (src bigint NOT NULL, dst bigint);
+SELECT create_distributed_table('edges', 'src', 4);
+INSERT INTO edges VALUES (1, 2), (2, 3), (3, 4), (3, 1), (4, 5), (9, 10);
+WITH RECURSIVE s(n) AS (SELECT 1 UNION ALL SELECT n + 1 FROM s WHERE n < 6) SELECT n, n * n FROM s ORDER BY n;
+WITH RECURSIVE reach(node) AS (SELECT 1 UNION SELECT e.dst FROM edges e, reach r WHERE e.src = r.node) SELECT node FROM reach ORDER BY node;
+WITH RECURSIVE hops(node, depth) AS (SELECT 1, 0 UNION ALL SELECT e.dst, h.depth + 1 FROM edges e, hops h WHERE e.src = h.node AND h.depth < 3) SELECT depth, count(*) FROM hops GROUP BY depth ORDER BY depth;
+WITH RECURSIVE a(x) AS (SELECT 41), b(y) AS (SELECT x + 1 FROM a) SELECT y FROM b;
+DROP TABLE edges;
