@@ -2059,6 +2059,10 @@ class Cluster:
                 raise InFailedTransaction(
                     "current transaction is aborted, commands ignored "
                     "until end of transaction block")
+            if txn.remote_endpoints:
+                raise UnsupportedFeatureError(
+                    "savepoints are not supported in a transaction with "
+                    "remote-shard writes yet")
             txn.savepoints.append((stmt.name, txn.snapshot(self.catalog)))
             return Result(columns=[], rows=[])
         if kind == "rollback_to":
@@ -2097,6 +2101,8 @@ class Cluster:
         from citus_tpu.transaction.manager import TxState
 
         txn = session.txn
+        if txn.remote_endpoints:
+            return self._commit_txn_cross_host(session)
         try:
             if not (txn.has_writes or txn.catalog_dirty or txn.on_commit):
                 self.txlog.release(txn.xid)
@@ -2239,11 +2245,83 @@ class Cluster:
             txn.release_locks(self)
             session.txn = None
 
+    def _commit_txn_cross_host(self, session) -> None:
+        """COMMIT of a transaction with open remote branches: prepare
+        every branch (remote sessions + the local one), record the
+        outcome in the authority's first-writer-wins register, decide
+        everywhere (reference: the coordinated-transaction pre-commit
+        PREPARE on all write connections, transaction_management.c:319)."""
+        txn = session.txn
+        gxid = txn.gxid
+        rd = self.catalog.remote_data
+        local_prepared = False
+        try:
+            for ep in sorted(txn.remote_endpoints):
+                rd.call(ep, "txn_branch_prepare", {"gxid": gxid})
+            if txn.has_writes or txn.catalog_dirty or txn.on_commit:
+                self._prepare_branch(session, gxid)
+                local_prepared = True
+            winner = self._control.record_txn_outcome(gxid, "commit")
+            if winner != "commit":
+                raise TransactionError(
+                    "cross-host transaction aborted by a participant "
+                    "(branch timed out before the commit decision)")
+        except BaseException:
+            try:
+                self._control.record_txn_outcome(gxid, "abort")
+            except Exception:
+                pass
+            for ep in sorted(txn.remote_endpoints):
+                try:
+                    rd.call(ep, "txn_branch_abort", {"gxid": gxid})
+                except Exception:
+                    pass
+            if session.txn is not None:
+                try:
+                    if local_prepared:
+                        self._finish_branch(session, False)
+                    else:
+                        txn.remote_endpoints = set()  # already aborted
+                        self._rollback_txn(session)
+                except Exception:
+                    pass
+            raise
+        for ep in sorted(txn.remote_endpoints):
+            try:
+                r = rd.call(ep, "dml_decide",
+                            {"gxid": gxid, "commit": True})
+                if not r.get("ok") and r.get("resolved") != "commit":
+                    raise ExecutionError(
+                        f"cross-host branch on {ep} diverged: resolved="
+                        f"{r.get('resolved')!r} after a committed outcome")
+            except ExecutionError:
+                raise
+            except Exception:
+                pass  # branch resolves to commit from the outcome store
+        if local_prepared:
+            self._finish_branch(session, True)
+        else:
+            # local side never wrote: plain release
+            self.txlog.release(txn.xid)
+            self.catalog._end_staging(txn)
+            txn.release_locks(self)
+            session.txn = None
+        self._plan_cache.clear()
+
     def _rollback_txn(self, session) -> None:
         from citus_tpu.storage.deletes import abort_staged_deletes
         from citus_tpu.storage.writer import abort_staged
 
         txn = session.txn
+        if txn.remote_endpoints and self.catalog.remote_data is not None:
+            # abort the remote branch sessions first (their staged
+            # writes and locks die with them)
+            for ep in sorted(txn.remote_endpoints):
+                try:
+                    self.catalog.remote_data.call(
+                        ep, "txn_branch_abort", {"gxid": txn.gxid})
+                except Exception:
+                    pass  # branch expiry cleans it up
         try:
             for d in sorted(txn.ingest_dirs):
                 abort_staged(d, txn.xid)
@@ -2336,6 +2414,9 @@ class Cluster:
     # statement operates on OUR placements only and must never forward
     # again (two coordinators would ping-pong a TRUNCATE forever)
     _remote_exec_guard = __import__("threading").local()
+    # remote branch counts of an in-transaction modify whose local part
+    # still runs (commands/dml.py _txn_remote_dml sets, handlers merge)
+    _remote_counts = __import__("threading").local()
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         depth = getattr(self._stmt_depth, "v", 0)
@@ -2353,6 +2434,17 @@ class Cluster:
             self._stmt_sql.v = prev_sql
 
     def _execute_stmt_inner(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
+        if isinstance(stmt, (A.Select, A.SetOp, A.WithSelect)):
+            from citus_tpu.storage.overlay import current_overlay
+            txn0 = current_overlay()
+            if txn0 is not None and txn0.remote_written_tables:
+                hit = _from_relations(stmt) & txn0.remote_written_tables
+                if hit:
+                    raise UnsupportedFeatureError(
+                        f"cannot read {sorted(hit)[0]!r} in this "
+                        "transaction after writing its remote-hosted "
+                        "shards (remote staged state is not visible "
+                        "here); COMMIT first")
         if isinstance(stmt, A.WithSelect):
             return self._execute_with(stmt)
         if isinstance(stmt, (A.Select, A.SetOp)) and self.catalog.functions:

@@ -95,10 +95,6 @@ def _forward_remote_dml(cl, stmt, t, where):
     if not remote:
         return None
     from citus_tpu.storage.overlay import current_overlay
-    if current_overlay() is not None:
-        raise UnsupportedFeatureError(
-            "DML on remote-hosted shards inside an explicit transaction "
-            "is not supported yet (no cross-host 2PC)")
     endpoints = {cl.catalog.node_endpoint(o) for o in remote}
     if getattr(stmt, "returning", None):
         raise UnsupportedFeatureError(
@@ -108,6 +104,10 @@ def _forward_remote_dml(cl, stmt, t, where):
         raise UnsupportedFeatureError(
             "cannot forward this modify statement to its remote host "
             "(no original SQL text — issue it as a single statement)")
+    txn = current_overlay()
+    if txn is not None:
+        return _txn_remote_dml(cl, stmt, t, sql, sorted(endpoints), txn,
+                               has_local=(owners != remote))
     if owners == remote and len(endpoints) == 1:
         # router case: one remote owner, no local shards — forward the
         # whole statement, its host's own 2PC makes it atomic
@@ -119,6 +119,49 @@ def _forward_remote_dml(cl, stmt, t, where):
                       explain=r.get("explain", {}))
     return _two_phase_remote_dml(cl, stmt, t, sql, sorted(endpoints),
                                  has_local=(owners != remote))
+
+
+def _txn_remote_dml(cl, stmt, t, sql: str, endpoints: list, txn,
+                    has_local: bool):
+    """A modify inside BEGIN..COMMIT touching remote-hosted shards:
+    each remote owner gets the statement in a PERSISTENT branch session
+    keyed by the transaction's gxid (the reference's worker session of
+    a coordinated transaction); COMMIT later drives the branch 2PC
+    (cluster._commit_txn).  Returns a Result when no local shards
+    survive, else None (local execution continues, remote counts merge
+    via cl._remote_counts)."""
+    import uuid as _uuid
+    if cl._control is None:
+        raise UnsupportedFeatureError(
+            "a transaction touching remote-hosted shards needs a "
+            "metadata authority (the durable outcome store)")
+    if txn.catalog_dirty:
+        raise UnsupportedFeatureError(
+            "DDL and remote-shard DML cannot mix in one transaction yet")
+    if txn.savepoints:
+        raise UnsupportedFeatureError(
+            "savepoints with remote-shard DML are not supported yet")
+    if txn.gxid is None:
+        txn.gxid = _uuid.uuid4().hex
+    counts: dict = {}
+    try:
+        for ep in endpoints:
+            r = cl.catalog.remote_data.call(
+                ep, "txn_stmt", {"gxid": txn.gxid, "sql": sql})
+            txn.remote_endpoints.add(ep)
+            for k, v in (r.get("explain") or {}).items():
+                if isinstance(v, (int, float)):
+                    counts[k] = counts.get(k, 0) + v
+    except BaseException:
+        txn.failed = True  # the block must roll back (branches too)
+        raise
+    txn.remote_written_tables.add(t.name)
+    if has_local:
+        # local part runs normally; the handler adds these in
+        cl._remote_counts.v = counts
+        return None
+    cl._plan_cache.clear()
+    return Result(columns=[], rows=[], explain=counts)
 
 
 def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
@@ -239,6 +282,7 @@ def delete(cl, stmt):
         return cl._partition_dml(stmt, t)
     where = Binder(cl.catalog, t).bind_scalar(stmt.where) \
         if stmt.where is not None else None
+    cl._remote_counts.v = None
     fwd = _forward_remote_dml(cl, stmt, t, where)
     if fwd is not None:
         return fwd
@@ -257,6 +301,10 @@ def delete(cl, stmt):
         from citus_tpu.storage.overlay import current_overlay
         n = execute_delete(cl.catalog, cl.txlog, t, where,
                            txn=current_overlay())
+    pend = getattr(cl._remote_counts, "v", None)
+    if pend:
+        cl._remote_counts.v = None
+        n += int(pend.get("deleted", 0))
     cl._plan_cache.clear()
     if cl._cdc_captures(t.name) and n:
         cl._emit_cdc(t.name, "delete", count=n)
@@ -277,6 +325,7 @@ def update(cl, stmt):
         return cl._partition_dml(stmt, t)
     b = Binder(cl.catalog, t)
     if cl.catalog.remote_data is not None:
+        cl._remote_counts.v = None
         bw = b.bind_scalar(stmt.where) if stmt.where is not None else None
         fwd = _forward_remote_dml(cl, stmt, t, bw)
         if fwd is not None:
@@ -335,6 +384,10 @@ def update(cl, stmt):
             check = lambda v, m: [c(v, m) for c in checks]  # noqa: E731
         n = execute_update(cl.catalog, cl.txlog, t, assignments,
                            where, txn=current_overlay(), check=check)
+    pend = getattr(cl._remote_counts, "v", None)
+    if pend:
+        cl._remote_counts.v = None
+        n += int(pend.get("updated", 0))
     cl._plan_cache.clear()
     if cl._cdc_captures(t.name) and n:
         cl._emit_cdc(t.name, "update", count=n)

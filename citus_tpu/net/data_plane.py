@@ -95,7 +95,11 @@ class DataPlaneServer:
         s.register("execute_sql", self._on_execute_sql)
         s.register("dml_prepare", self._on_dml_prepare)
         s.register("dml_decide", self._on_dml_decide)
-        # open cross-host transaction branches: gxid -> (Session, born)
+        s.register("txn_stmt", self._on_txn_stmt)
+        s.register("txn_branch_prepare", self._on_txn_branch_prepare)
+        s.register("txn_branch_abort", self._on_txn_branch_abort)
+        # open cross-host transaction branches:
+        # gxid -> {"s": Session, "born": monotonic, "prepared": bool}
         # — initialized BEFORE accepting connections (an early
         # dml_prepare must find them)
         self._branches: dict = {}
@@ -187,6 +191,18 @@ class DataPlaneServer:
     #: long (via the authority's outcome store; presumed abort)
     BRANCH_EXPIRE_S = 120.0
 
+    def _run_in_branch(self, s, sql: str) -> dict:
+        """Execute one statement inside a branch session with
+        forwarded-statement (local placements only) semantics."""
+        cl = self.cluster
+        guard = cl._remote_exec_guard
+        prev = getattr(guard, "v", False)
+        guard.v = True
+        try:
+            return s.execute(sql)
+        finally:
+            guard.v = prev
+
     def _on_dml_prepare(self, p: dict) -> dict:
         """Phase 1 of a cross-host modify: run the forwarded statement
         against OUR placements inside an open transaction, then make
@@ -198,12 +214,9 @@ class DataPlaneServer:
         cl = self.cluster
         self._expire_stale_branches()
         s = cl.session()
-        guard = cl._remote_exec_guard
-        prev = getattr(guard, "v", False)
-        guard.v = True
         try:
             s.execute("BEGIN")
-            r = s.execute(str(p["sql"]))
+            r = self._run_in_branch(s, str(p["sql"]))
             cl._prepare_branch(s, gxid)
         except BaseException:
             if s.txn is not None:
@@ -212,12 +225,55 @@ class DataPlaneServer:
                 except Exception:
                     pass
             raise
-        finally:
-            guard.v = prev
         with self._branches_mu:
-            self._branches[gxid] = (s, _time.monotonic())
+            self._branches[gxid] = {"s": s, "born": _time.monotonic(),
+                                    "prepared": True}
         return {"explain": {k: v for k, v in (r.explain or {}).items()
                             if isinstance(v, (int, float, str))}}
+
+    def _on_txn_stmt(self, p: dict) -> dict:
+        """One statement of an INTERACTIVE cross-host transaction: the
+        branch session persists across RPCs (lazily opened with BEGIN)
+        and stays un-prepared until txn_branch_prepare — the worker
+        session of the reference's coordinated transaction."""
+        import time as _time
+        gxid = str(p["gxid"])
+        self._expire_stale_branches()
+        with self._branches_mu:
+            entry = self._branches.get(gxid)
+        if entry is None:
+            s = self.cluster.session()
+            s.execute("BEGIN")
+            entry = {"s": s, "born": _time.monotonic(), "prepared": False}
+            with self._branches_mu:
+                self._branches[gxid] = entry
+        r = self._run_in_branch(entry["s"], str(p["sql"]))
+        entry["born"] = _time.monotonic()  # activity keeps it alive
+        return {"explain": {k: v for k, v in (r.explain or {}).items()
+                            if isinstance(v, (int, float, str))}}
+
+    def _on_txn_branch_prepare(self, p: dict) -> dict:
+        gxid = str(p["gxid"])
+        with self._branches_mu:
+            entry = self._branches.get(gxid)
+        if entry is None:
+            raise KeyError(f"no open branch for gxid {gxid}")
+        self.cluster._prepare_branch(entry["s"], gxid)
+        entry["prepared"] = True
+        return {"ok": True}
+
+    def _on_txn_branch_abort(self, p: dict) -> dict:
+        gxid = str(p["gxid"])
+        with self._branches_mu:
+            entry = self._branches.pop(gxid, None)
+        if entry is None:
+            return {"ok": True}
+        s = entry["s"]
+        if entry["prepared"]:
+            self.cluster._finish_branch(s, False)
+        elif s.txn is not None:
+            s.execute("ROLLBACK")
+        return {"ok": True}
 
     def _on_dml_decide(self, p: dict) -> dict:
         gxid = str(p["gxid"])
@@ -231,29 +287,45 @@ class DataPlaneServer:
             if self.cluster._control is not None:
                 outcome = self.cluster._control.txn_outcome(gxid)
             return {"ok": False, "resolved": outcome}
-        s, _born = entry
-        self.cluster._finish_branch(s, bool(p.get("commit")))
+        self.cluster._finish_branch(entry["s"], bool(p.get("commit")))
         return {"ok": True}
 
     def _expire_stale_branches(self) -> None:
         """Resolve branches whose coordinator never sent phase 2.
 
-        Presumed abort, done safely: the participant CLAIMS abort
-        through the authority's first-writer-wins decision register —
-        if the coordinator already recorded commit, the claim returns
-        'commit' and the branch commits; if the participant's claim
-        wins, any later coordinator commit attempt gets 'abort' back
-        and aborts everywhere.  An UNREACHABLE authority keeps the
-        branch (locks held — the blocking nature of 2PC; the reference
-        blocks on in-doubt prepared transactions the same way)."""
+        PREPARED branches presume abort safely: the participant CLAIMS
+        abort through the authority's first-writer-wins decision
+        register — if the coordinator already recorded commit, the
+        claim returns 'commit' and the branch commits; if the claim
+        wins, any later coordinator commit gets 'abort' back and aborts
+        everywhere.  An UNREACHABLE authority keeps a prepared branch
+        (locks held — the blocking nature of 2PC).  UN-prepared
+        interactive branches have no durable record and no vote: a
+        plain ROLLBACK is always correct for them."""
         import time as _time
         if self.cluster._control is None:
             return
         now = _time.monotonic()
         with self._branches_mu:
-            stale = [(g, s) for g, (s, born) in self._branches.items()
-                     if now - born > self.BRANCH_EXPIRE_S]
-        for gxid, s in stale:
+            # un-prepared interactive branches idle out on a much longer
+            # leash (user think-time is legitimate; activity refreshes
+            # born), prepared ones on the 2PC window
+            stale = [(g, e) for g, e in self._branches.items()
+                     if now - e["born"] > (self.BRANCH_EXPIRE_S
+                                           if e["prepared"]
+                                           else 10 * self.BRANCH_EXPIRE_S)]
+        for gxid, entry in stale:
+            if not entry["prepared"]:
+                with self._branches_mu:
+                    if self._branches.pop(gxid, None) is None:
+                        continue
+                s = entry["s"]
+                if s.txn is not None:
+                    try:
+                        s.execute("ROLLBACK")
+                    except Exception:
+                        pass
+                continue
             try:
                 winner = self.cluster._control.record_txn_outcome(
                     gxid, "abort")
@@ -262,7 +334,7 @@ class DataPlaneServer:
             with self._branches_mu:
                 if self._branches.pop(gxid, None) is None:
                     continue  # a decide raced us and already resolved it
-            self.cluster._finish_branch(s, winner == "commit")
+            self.cluster._finish_branch(entry["s"], winner == "commit")
 
     def expire_branches(self) -> None:
         """Maintenance-daemon duty: resolve abandoned branches even when

@@ -83,6 +83,13 @@ class OpenTransaction:
         self.on_commit: list = []     # deferred physical actions (file drops)
         self.on_rollback: list = []   # cleanup of staged physical artifacts
         self.tombstones_snapshot: dict = {}  # restored on rollback
+        # ---- cross-host branches (interactive 2PC): endpoints holding
+        # an open branch session for this transaction's gxid, and the
+        # tables written remotely (reads of those within the block are
+        # refused — remote staged state is not visible here)
+        self.gxid: "str | None" = None
+        self.remote_endpoints: set = set()
+        self.remote_written_tables: set = set()
 
     # ---- write registration -------------------------------------------
     def record_ingest(self, table_name: str, dirs) -> None:

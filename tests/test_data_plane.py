@@ -458,3 +458,68 @@ def test_branch_resolves_from_outcome_store_when_decide_lost(pair):
     GLOBAL_CACHE.clear()
     assert not [row for row in b.execute("SELECT v FROM rb").rows
                 if row[0] == 8]
+
+
+def test_interactive_cross_host_transaction_commit(pair):
+    """BEGIN..COMMIT spanning hosts: statements accumulate in persistent
+    remote branch sessions; COMMIT drives the branch 2PC."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE it (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('it', 'k', 4)")
+    n = 400
+    a.copy_from("it", columns={"k": np.arange(n), "v": np.zeros(n, np.int64)})
+    s = a.session()
+    s.execute("BEGIN")
+    r1 = s.execute("UPDATE it SET v = 1 WHERE k % 2 = 0")
+    assert r1.explain.get("updated") == n // 2
+    r2 = s.execute("UPDATE it SET v = v + 10 WHERE k % 2 = 0")
+    assert r2.explain.get("updated") == n // 2  # branch sees its own write
+    # other sessions see NOTHING until commit
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT sum(v) FROM it").rows == [(0,)]
+    s.execute("COMMIT")
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT sum(v) FROM it").rows == [(11 * n // 2,)]
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT sum(v) FROM it").rows == [(11 * n // 2,)]
+
+
+def test_interactive_cross_host_transaction_rollback(pair):
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE ir (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('ir', 'k', 4)")
+    n = 200
+    a.copy_from("ir", columns={"k": np.arange(n), "v": np.zeros(n, np.int64)})
+    s = a.session()
+    s.execute("BEGIN")
+    s.execute("UPDATE ir SET v = 7")
+    s.execute("ROLLBACK")
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT sum(v) FROM ir").rows == [(0,)]
+    b._maybe_reload_catalog(force_sync=True)
+    assert b.execute("SELECT sum(v) FROM ir").rows == [(0,)]
+
+
+def test_interactive_cross_host_restrictions(pair):
+    """Read-after-remote-write and savepoints are refused with clear
+    errors inside a cross-host transaction."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE rr (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('rr', 'k', 4)")
+    a.copy_from("rr", columns={"k": np.arange(100),
+                               "v": np.zeros(100, np.int64)})
+    from citus_tpu.errors import UnsupportedFeatureError
+    s = a.session()
+    s.execute("BEGIN")
+    s.execute("UPDATE rr SET v = 1")
+    with pytest.raises(UnsupportedFeatureError, match="remote-hosted"):
+        s.execute("SELECT count(*) FROM rr")
+    s.execute("ROLLBACK")
+    s = a.session()
+    s.execute("BEGIN")
+    s.execute("UPDATE rr SET v = 2")
+    with pytest.raises(UnsupportedFeatureError, match="savepoint"):
+        s.execute("SAVEPOINT sp")
+    s.execute("ROLLBACK")
