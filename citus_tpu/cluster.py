@@ -2267,10 +2267,18 @@ class Cluster:
                     "cross-host transaction aborted by a participant "
                     "(branch timed out before the commit decision)")
         except BaseException:
+            winner = None
             try:
-                self._control.record_txn_outcome(gxid, "abort")
+                winner = self._control.record_txn_outcome(gxid, "abort")
             except Exception:
                 pass
+            if winner == "commit":
+                # our own commit record already landed (its RPC response
+                # was lost): the transaction IS durably committed —
+                # complete the commit instead of diverging
+                self._complete_cross_host_commit(session, txn, gxid,
+                                                 local_prepared)
+                return
             for ep in sorted(txn.remote_endpoints):
                 try:
                     rd.call(ep, "txn_branch_abort", {"gxid": gxid})
@@ -2286,27 +2294,38 @@ class Cluster:
                 except Exception:
                     pass
             raise
-        for ep in sorted(txn.remote_endpoints):
-            try:
-                r = rd.call(ep, "dml_decide",
-                            {"gxid": gxid, "commit": True})
-                if not r.get("ok") and r.get("resolved") != "commit":
-                    raise ExecutionError(
-                        f"cross-host branch on {ep} diverged: resolved="
-                        f"{r.get('resolved')!r} after a committed outcome")
-            except ExecutionError:
-                raise
-            except Exception:
-                pass  # branch resolves to commit from the outcome store
+        self._complete_cross_host_commit(session, txn, gxid,
+                                         local_prepared)
+
+    def _complete_cross_host_commit(self, session, txn, gxid: str,
+                                    local_prepared: bool) -> None:
+        """Phase 2 after a durably-recorded commit: finish the LOCAL
+        branch first (its outcome can never change now; raising before
+        it would strand a prepared branch a later ROLLBACK could abort
+        against the committed outcome), then decide every remote branch,
+        surfacing any divergence AFTER local state is consistent."""
+        rd = self.catalog.remote_data
         if local_prepared:
             self._finish_branch(session, True)
         else:
-            # local side never wrote: plain release
             self.txlog.release(txn.xid)
             self.catalog._end_staging(txn)
             txn.release_locks(self)
             session.txn = None
         self._plan_cache.clear()
+        divergence = None
+        for ep in sorted(txn.remote_endpoints):
+            try:
+                r = rd.call(ep, "dml_decide",
+                            {"gxid": gxid, "commit": True})
+                if not r.get("ok") and r.get("resolved") != "commit":
+                    divergence = (ep, r.get("resolved"))
+            except Exception:
+                pass  # branch resolves to commit from the outcome store
+        if divergence is not None:
+            raise ExecutionError(
+                f"cross-host branch on {divergence[0]} diverged: "
+                f"resolved={divergence[1]!r} after a committed outcome")
 
     def _rollback_txn(self, session) -> None:
         from citus_tpu.storage.deletes import abort_staged_deletes

@@ -190,13 +190,19 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
     local_prepared = False
     counts: dict = {}
 
-    def _abort_everything() -> None:
+    def _abort_everything() -> str:
         # claim abort in the decision register first, so any branch
-        # that expires concurrently agrees; then best-effort decides
+        # that expires concurrently agrees; then best-effort decides.
+        # Returns the REGISTER's winner: 'commit' means our own commit
+        # record already landed (response lost) and the caller must
+        # complete the commit instead.
+        winner = None
         try:
-            cl._control.record_txn_outcome(gxid, "abort")
+            winner = cl._control.record_txn_outcome(gxid, "abort")
         except Exception:
             pass  # absent outcome = presumed abort via branch claims
+        if winner == "commit":
+            return "commit"
         for ep in prepared:
             try:
                 cl.catalog.remote_data.call(
@@ -215,6 +221,29 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
                     cl._rollback_txn(local_session)
             except Exception:
                 pass
+
+
+    def _complete_commit() -> None:
+        # local branch finishes FIRST (its outcome can never change
+        # now; raising before it would strand a committed prepared
+        # branch), then the remote decides — divergence surfaces after
+        # local state is consistent
+        if local_session is not None and local_session.txn is not None:
+            cl._finish_branch(local_session, True)
+        cl._plan_cache.clear()
+        divergence = None
+        for ep in endpoints:
+            try:
+                r = cl.catalog.remote_data.call(
+                    ep, "dml_decide", {"gxid": gxid, "commit": True})
+                if not r.get("ok") and r.get("resolved") != "commit":
+                    divergence = (ep, r.get("resolved"))
+            except Exception:
+                pass  # resolves to commit from the outcome store
+        if divergence is not None:
+            raise ExecutionError(
+                f"cross-host branch on {divergence[0]} diverged: "
+                f"resolved={divergence[1]!r} after a committed outcome")
 
     try:
         for ep in endpoints:
@@ -248,26 +277,14 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
                 "cross-host transaction aborted by a participant "
                 "(branch timed out before the commit decision)")
     except BaseException:
-        _abort_everything()
+        if _abort_everything() == "commit":
+            # our commit record already landed (response lost): the
+            # transaction IS committed — complete it, don't diverge
+            _complete_commit()
+            counts["gxid"] = gxid
+            return Result(columns=[], rows=[], explain=counts)
         raise
-    for ep in endpoints:
-        try:
-            r = cl.catalog.remote_data.call(
-                ep, "dml_decide", {"gxid": gxid, "commit": True})
-            if not r.get("ok") and r.get("resolved") != "commit":
-                # unreachable by design: the decision register makes a
-                # committed gxid resolve to commit everywhere — surface
-                # loudly if the invariant ever breaks
-                raise ExecutionError(
-                    f"cross-host branch on {ep} diverged: resolved="
-                    f"{r.get('resolved')!r} after a committed outcome")
-        except ExecutionError:
-            raise
-        except Exception:
-            pass  # the branch resolves to commit from the outcome store
-    if local_session is not None:
-        cl._finish_branch(local_session, True)
-    cl._plan_cache.clear()
+    _complete_commit()
     counts["gxid"] = gxid
     return Result(columns=[], rows=[], explain=counts)
 
@@ -299,11 +316,13 @@ def delete(cl, stmt):
             if stmt.returning else None
         t = cl.catalog.table(stmt.table)  # re-fetch: fresh placements
         from citus_tpu.storage.overlay import current_overlay
-        n = execute_delete(cl.catalog, cl.txlog, t, where,
-                           txn=current_overlay())
-    pend = getattr(cl._remote_counts, "v", None)
+        try:
+            n = execute_delete(cl.catalog, cl.txlog, t, where,
+                               txn=current_overlay())
+        finally:
+            pend = getattr(cl._remote_counts, "v", None)
+            cl._remote_counts.v = None  # never leak into a later statement
     if pend:
-        cl._remote_counts.v = None
         n += int(pend.get("deleted", 0))
     cl._plan_cache.clear()
     if cl._cdc_captures(t.name) and n:
@@ -324,8 +343,8 @@ def update(cl, stmt):
     if t.is_partitioned:
         return cl._partition_dml(stmt, t)
     b = Binder(cl.catalog, t)
+    cl._remote_counts.v = None
     if cl.catalog.remote_data is not None:
-        cl._remote_counts.v = None
         bw = b.bind_scalar(stmt.where) if stmt.where is not None else None
         fwd = _forward_remote_dml(cl, stmt, t, bw)
         if fwd is not None:
@@ -382,11 +401,13 @@ def update(cl, stmt):
         check = None
         if checks:
             check = lambda v, m: [c(v, m) for c in checks]  # noqa: E731
-        n = execute_update(cl.catalog, cl.txlog, t, assignments,
-                           where, txn=current_overlay(), check=check)
-    pend = getattr(cl._remote_counts, "v", None)
+        try:
+            n = execute_update(cl.catalog, cl.txlog, t, assignments,
+                               where, txn=current_overlay(), check=check)
+        finally:
+            pend = getattr(cl._remote_counts, "v", None)
+            cl._remote_counts.v = None  # never leak into a later statement
     if pend:
-        cl._remote_counts.v = None
         n += int(pend.get("updated", 0))
     cl._plan_cache.clear()
     if cl._cdc_captures(t.name) and n:

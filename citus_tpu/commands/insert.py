@@ -30,22 +30,19 @@ from citus_tpu.cluster import (  # noqa: E402  (loaded post-cluster)
 def execute_insert(cl, stmt: A.Insert) -> Result:
     t = cl.catalog.table(stmt.table)
     if stmt.select is not None:
+        if stmt.returning:
+            raise UnsupportedFeatureError(
+                "RETURNING on INSERT..SELECT is not supported")
         if stmt.on_conflict is not None:
             # pull the source rows, then run the same upsert machinery
             # row literals take (reference: INSERT..SELECT ON CONFLICT
             # goes through the pull / colocated-intermediate-results
             # strategy, insert_select_executor.c README:1223-1238)
-            if stmt.returning:
-                raise UnsupportedFeatureError(
-                    "RETURNING on INSERT..SELECT is not supported")
             inner = cl._execute_stmt(stmt.select)
             rows = [list(r) for r in inner.rows]
             r = _execute_upsert(cl, t, stmt, rows)
             r.explain["strategy"] = "insert_select:upsert_pull"
             return r
-        if stmt.returning:
-            raise UnsupportedFeatureError(
-                "RETURNING on INSERT..SELECT is not supported")
         names = stmt.columns or t.schema.names
         # FK-constrained, unique-indexed, and partitioned targets —
         # and partitioned sources — take the pull path: copy_from's

@@ -643,10 +643,30 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
 # ---------------------------------------------------------------- entry
 
 
+def _guard_remote_written(cat, table_names) -> None:
+    """Refuse reads of tables whose REMOTE shards this transaction
+    wrote: the staged state lives in branch sessions on other hosts and
+    is invisible to local scans — silently returning the pre-image
+    would be wrong.  This executor-level check catches every route to
+    the table (views, subqueries, joins), not just top-level FROMs."""
+    from citus_tpu.storage.overlay import current_overlay
+    txn = current_overlay()
+    if txn is None or not getattr(txn, "remote_written_tables", None):
+        return
+    hit = set(table_names) & txn.remote_written_tables
+    if hit:
+        from citus_tpu.errors import UnsupportedFeatureError
+        raise UnsupportedFeatureError(
+            f"cannot read {sorted(hit)[0]!r} in this transaction after "
+            "writing its remote-hosted shards (remote staged state is "
+            "not visible here); COMMIT first")
+
+
 def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
                    plan: Optional[PhysicalPlan] = None,
                    param_values: Optional[list] = None) -> Result:
     t0 = time.perf_counter()
+    _guard_remote_written(cat, [bound.table.name])
     if plan is None:
         plan = plan_select(cat, bound, direct_limit=settings.planner.direct_gid_limit)
     params = encode_params(cat, bound, param_values)

@@ -575,8 +575,10 @@ def _join_text_src(bj: BoundJoinSelect):
 
 
 def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -> Result:
+    from citus_tpu.executor.executor import _guard_remote_written
     from citus_tpu.transaction.snapshot import snapshot_read_multi
 
+    _guard_remote_written(cat, [t_.name for _, t_ in bj.rels])
     # snapshot read across every base relation: the multi-shard frame
     # loads below must observe a consistent flip generation per
     # colocation group — validated, non-blocking (transaction/snapshot.py)

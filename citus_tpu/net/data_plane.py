@@ -27,6 +27,7 @@ from typing import Optional
 
 import numpy as np
 
+from citus_tpu.errors import ExecutionError
 from citus_tpu.net.rpc import RpcClient, RpcError, RpcServer
 
 #: fetch_file chunk size — one RPC round-trip per chunk
@@ -227,7 +228,8 @@ class DataPlaneServer:
             raise
         with self._branches_mu:
             self._branches[gxid] = {"s": s, "born": _time.monotonic(),
-                                    "prepared": True}
+                                    "prepared": True,
+                                    "mu": threading.Lock()}
         return {"explain": {k: v for k, v in (r.explain or {}).items()
                             if isinstance(v, (int, float, str))}}
 
@@ -244,11 +246,21 @@ class DataPlaneServer:
         if entry is None:
             s = self.cluster.session()
             s.execute("BEGIN")
-            entry = {"s": s, "born": _time.monotonic(), "prepared": False}
+            entry = {"s": s, "born": _time.monotonic(), "prepared": False,
+                     "mu": threading.Lock()}
             with self._branches_mu:
                 self._branches[gxid] = entry
-        r = self._run_in_branch(entry["s"], str(p["sql"]))
-        entry["born"] = _time.monotonic()  # activity keeps it alive
+        with entry["mu"]:
+            # re-check under the entry lock: the expiry duty resolves
+            # branches under the same lock, so a statement can never
+            # run on a session expiry just rolled back (it would
+            # autocommit outside the transaction)
+            with self._branches_mu:
+                if self._branches.get(gxid) is not entry:
+                    raise ExecutionError(
+                        f"transaction branch {gxid} expired")
+            r = self._run_in_branch(entry["s"], str(p["sql"]))
+            entry["born"] = _time.monotonic()  # activity keeps it alive
         return {"explain": {k: v for k, v in (r.explain or {}).items()
                             if isinstance(v, (int, float, str))}}
 
@@ -315,26 +327,33 @@ class DataPlaneServer:
                                            if e["prepared"]
                                            else 10 * self.BRANCH_EXPIRE_S)]
         for gxid, entry in stale:
-            if not entry["prepared"]:
+            with entry["mu"]:
+                if not entry["prepared"]:
+                    # re-check age under the lock: a statement may have
+                    # refreshed the branch while we waited
+                    if _time.monotonic() - entry["born"] \
+                            <= 10 * self.BRANCH_EXPIRE_S:
+                        continue
+                    with self._branches_mu:
+                        if self._branches.pop(gxid, None) is None:
+                            continue
+                    s = entry["s"]
+                    if s.txn is not None:
+                        try:
+                            s.execute("ROLLBACK")
+                        except Exception:
+                            pass
+                    continue
+                try:
+                    winner = self.cluster._control.record_txn_outcome(
+                        gxid, "abort")
+                except Exception:
+                    continue  # authority unreachable: keep the branch
                 with self._branches_mu:
                     if self._branches.pop(gxid, None) is None:
-                        continue
-                s = entry["s"]
-                if s.txn is not None:
-                    try:
-                        s.execute("ROLLBACK")
-                    except Exception:
-                        pass
-                continue
-            try:
-                winner = self.cluster._control.record_txn_outcome(
-                    gxid, "abort")
-            except Exception:
-                continue  # authority unreachable: keep the branch
-            with self._branches_mu:
-                if self._branches.pop(gxid, None) is None:
-                    continue  # a decide raced us and already resolved it
-            self.cluster._finish_branch(entry["s"], winner == "commit")
+                        continue  # a decide raced us; already resolved
+                self.cluster._finish_branch(entry["s"],
+                                            winner == "commit")
 
     def expire_branches(self) -> None:
         """Maintenance-daemon duty: resolve abandoned branches even when
