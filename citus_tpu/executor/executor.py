@@ -691,15 +691,33 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
                           timeout=settings.executor.lock_timeout_s):
         # snapshot read: never blocks behind writers — the scan is
         # validated against the table's flip generation and retried if
-        # a multi-file metadata flip (TRUNCATE, DML commit) overlapped
-        # (transaction/snapshot.py; the MVCC never-block property the
-        # reference inherits from PostgreSQL)
+        # a multi-file metadata flip (TRUNCATE, DML commit, shard
+        # split) overlapped (transaction/snapshot.py; the MVCC
+        # never-block property the reference inherits from PostgreSQL)
+        run_plan = plan
+
         def _attempt():
+            nonlocal run_plan
+            if run_plan.table_shard_count not in (-1,
+                                                  len(bound.table.shards)):
+                # the table's shard map changed since this plan was
+                # built (a split's catalog flip racing the scan):
+                # planned shard indexes would resolve against the NEW
+                # shard list — re-plan before (re)trying
+                run_plan = plan_select(
+                    cat, bound,
+                    direct_limit=settings.planner.direct_gid_limit)
+                if bound.param_specs and param_values is not None:
+                    import dataclasses as _dc
+                    run_plan = _dc.replace(
+                        run_plan,
+                        shard_indexes=run_plan.resolve_shards(param_values))
             if bound.has_aggs:
-                return _run_agg(cat, plan, settings, params)
-            return _run_projection(cat, plan, settings, params)
+                return _run_agg(cat, run_plan, settings, params)
+            return _run_projection(cat, run_plan, settings, params)
         rows = snapshot_read(cat.data_dir, bound.table, _attempt,
                              timeout=settings.executor.lock_timeout_s)
+        plan = run_plan
     rows = order_and_limit(plan, rows)
     if bound.hidden_outputs:
         keep = len(bound.output_names) - bound.hidden_outputs

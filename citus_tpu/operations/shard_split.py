@@ -117,15 +117,22 @@ def _split_shard_locked(cat, table, shard, shard_id, split_points,
                 w.flush()
             break  # one placement is the source of truth; replicas re-copy later
 
-    # phase 2: catalog flip (atomic commit covers the whole group)
-    for t, s, news in plan:
-        idx = t.shards.index(s)
-        t.shards = t.shards[:idx] + news + t.shards[idx + 1:]
-        for i, sh in enumerate(t.shards):
-            sh.index = i
-        t.version += 1
-    cat.ddl_epoch += 1
-    cat.commit()
+    # phase 2: catalog flip (atomic commit covers the whole group).
+    # Bracketed in the snapshot flip generation: a reader whose scan
+    # overlaps the shard-map swap would otherwise resolve its planned
+    # shard indexes against the NEW shard list (torn: half-shards read
+    # as whole, the tail shard missed) — the generation bump makes it
+    # retry with a re-planned shard set (executor/executor.py).
+    from citus_tpu.transaction.snapshot import flip_generation
+    with flip_generation(cat.data_dir, table):
+        for t, s, news in plan:
+            idx = t.shards.index(s)
+            t.shards = t.shards[:idx] + news + t.shards[idx + 1:]
+            for i, sh in enumerate(t.shards):
+                sh.index = i
+            t.version += 1
+        cat.ddl_epoch += 1
+        cat.commit()
 
     # phase 3: deferred drop of old placements
     for t, s, _news in plan:

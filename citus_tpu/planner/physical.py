@@ -100,6 +100,10 @@ class PhysicalPlan:
     # a secondary index: the scan gathers exact rows via per-stripe
     # segments instead of reading every chunk
     index_eq: Optional[tuple] = None
+    # shard-map size at plan time: a mismatch against the live table at
+    # execution detects a shard split's catalog flip racing the scan
+    # (shard_indexes would resolve against the NEW list) -> re-plan
+    table_shard_count: int = -1
 
     @property
     def is_router(self) -> bool:
@@ -403,4 +407,5 @@ def plan_select(cat: Catalog, bound: BoundSelect, *, direct_limit: int = 65536) 
         router_key=router_key,
         router_param=_deferred_router_param(bound.table, bound.filter),
         index_eq=_index_eq(bound.table, bound.filter),
+        table_shard_count=len(bound.table.shards),
     )
