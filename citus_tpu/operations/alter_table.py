@@ -67,16 +67,29 @@ def undistribute_table(cat: Catalog, name: str, txlog=None) -> None:
     if t.method == DistributionMethod.LOCAL:
         raise CatalogError(f'table "{name}" is not distributed')
     values, validity, _ = _collect_all_rows(cat, t)
-    _record_old_placements(cat, t)
-    from citus_tpu.catalog.catalog import ShardMeta
-    t.method = DistributionMethod.LOCAL
-    t.dist_column = None
-    t.colocation_id = 0
-    t.shards = [ShardMeta(cat._alloc_shard_id(), 0, placements=[0])]
-    t.version += 1
-    cat.ddl_epoch += 1
-    cat.commit()
-    _reingest(cat, t, values, validity, txlog)
+    import contextlib as _ctxlib
+
+    from citus_tpu.transaction.snapshot import flip_generation
+    from citus_tpu.transaction.write_locks import group_resource
+    # the whole shard-map swap + re-ingest is one flip to readers: a
+    # scan overlapping it retries (and re-plans on the shard-count
+    # change) instead of seeing empty new shards (executor/executor.py).
+    # The swap changes the colocation group, so hold BOTH identities.
+    with _ctxlib.ExitStack() as _flips:
+        _flips.enter_context(flip_generation(cat.data_dir, t))
+        old_res = group_resource(t)
+        _record_old_placements(cat, t)
+        from citus_tpu.catalog.catalog import ShardMeta
+        t.method = DistributionMethod.LOCAL
+        t.dist_column = None
+        t.colocation_id = 0
+        t.shards = [ShardMeta(cat._alloc_shard_id(), 0, placements=[0])]
+        if group_resource(t) != old_res:
+            _flips.enter_context(flip_generation(cat.data_dir, t))
+        t.version += 1
+        cat.ddl_epoch += 1
+        cat.commit()
+        _reingest(cat, t, values, validity, txlog)
 
 
 def alter_distributed_table(cat: Catalog, name: str, *,
@@ -90,9 +103,23 @@ def alter_distributed_table(cat: Catalog, name: str, *,
     new_count = shard_count or t.shard_count
     new_col = distribution_column or t.dist_column
     values, validity, _ = _collect_all_rows(cat, t)
-    _record_old_placements(cat, t)
-    cat.distribute_table(name, new_col, new_count, cat.active_node_ids(),
-                         colocate_with=colocate_with)
-    t.version += 1
-    cat.commit()
-    _reingest(cat, t, values, validity, txlog)
+    import contextlib as _ctxlib
+
+    from citus_tpu.transaction.snapshot import flip_generation
+    from citus_tpu.transaction.write_locks import group_resource
+    # the swap CHANGES the table's colocation group, so readers may
+    # validate against either identity: hold the flip bracket on BOTH
+    # (old group entered first, new group entered as soon as it exists)
+    # for the whole swap + re-ingest window
+    with _ctxlib.ExitStack() as _flips:
+        _flips.enter_context(flip_generation(cat.data_dir, t))
+        old_res = group_resource(t)
+        _record_old_placements(cat, t)
+        cat.distribute_table(name, new_col, new_count,
+                             cat.active_node_ids(),
+                             colocate_with=colocate_with)
+        if group_resource(t) != old_res:
+            _flips.enter_context(flip_generation(cat.data_dir, t))
+        t.version += 1
+        cat.commit()
+        _reingest(cat, t, values, validity, txlog)

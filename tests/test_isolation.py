@@ -342,3 +342,22 @@ def test_concurrent_truncate_and_join_read(db):
     # rows pre-truncate and 0 after — anything else is a torn read
     assert all(c in (0, 100) for c in counts), counts
     assert cl.execute("SELECT count(*) FROM t").rows == [(0,)]
+
+
+def test_concurrent_reshard_and_readers(db):
+    """alter_distributed_table's shard-map swap + re-ingest is one flip
+    to readers (same guarantee as the split-vs-reader case)."""
+    cl = db
+    results = []
+
+    def reader():
+        for _ in range(25):
+            results.append(cl.execute("SELECT count(*), sum(v) FROM t").rows)
+
+    def reshard():
+        from citus_tpu.operations.alter_table import alter_distributed_table
+        alter_distributed_table(cl.catalog, "t", shard_count=7)
+
+    _run_all([reader, reshard])
+    assert all(r == [(20_000, 20_000)] for r in results)
+    assert cl.catalog.table("t").shard_count == 7
