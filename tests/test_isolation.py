@@ -361,3 +361,49 @@ def test_concurrent_reshard_and_readers(db):
     _run_all([reader, reshard])
     assert all(r == [(20_000, 20_000)] for r in results)
     assert cl.catalog.table("t").shard_count == 7
+
+
+def test_concurrent_move_and_readers(db):
+    """Shard moves flip placements (same bytes, new node): readers must
+    never tear regardless of which placement they resolve."""
+    cl = db
+    t = cl.catalog.table("t")
+    results = []
+
+    def reader():
+        for _ in range(25):
+            results.append(cl.execute("SELECT count(*), sum(v) FROM t").rows)
+
+    def mover():
+        from citus_tpu.operations import move_shard_placement
+        for s in list(cl.catalog.table("t").shards)[:2]:
+            src = s.placements[0]
+            dst = 1 - src if src in (0, 1) else 0
+            move_shard_placement(cl.catalog, s.shard_id, src, dst,
+                                 lock_manager=cl.locks)
+
+    _run_all([reader, mover])
+    assert all(r == [(20_000, 20_000)] for r in results)
+
+
+def test_concurrent_vacuum_and_update(db):
+    """VACUUM's placement rewrite must serialize with UPDATE through
+    the write lock; readers stay consistent throughout."""
+    cl = db
+    results, errs = [], []
+
+    def reader():
+        for _ in range(20):
+            results.append(
+                cl.execute("SELECT count(*) FROM t").rows[0][0])
+
+    def updater():
+        for i in range(4):
+            cl.execute(f"UPDATE t SET v = {i + 2} WHERE k % 10 = 0")
+
+    def vacuumer():
+        for _ in range(3):
+            cl.execute("VACUUM t")
+
+    _run_all([reader, updater, vacuumer])
+    assert all(c == 20_000 for c in results), results[:5]
