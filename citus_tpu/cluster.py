@@ -2170,162 +2170,18 @@ class Cluster:
             txn.release_locks(self)
             session.txn = None
 
-    # ---- cross-host two-phase branches (reference: PREPARE TRANSACTION
-    # on each worker + COMMIT PREPARED driven by the coordinator,
-    # transaction/remote_transaction.c) -------------------------------
+    # ---- cross-host branches: transaction/branches.py ----------------
     def _prepare_branch(self, session, gxid: str) -> None:
-        """Phase 1 of a cross-host transaction branch: persist the
-        catalog version bumps and a durable PREPARED record carrying
-        the global transaction id, keeping the staged state and the
-        write locks.  The branch survives a crash of this process: its
-        PREPARED+gxid record resolves through the authority's outcome
-        store at recovery (presumed abort when no outcome exists)."""
-        from citus_tpu.transaction.manager import TxState
-        txn = session.txn
-        if txn.catalog_dirty or txn.on_commit:
-            raise UnsupportedFeatureError(
-                "DDL cannot ride a cross-host transaction branch")
-        for name in sorted(txn.tables):
-            if self.catalog.has_table(name):
-                self.catalog.table(name).version += 1
-        self.catalog._end_staging(txn)
-        self.catalog.commit()
-        payload = {"kind": "txn", "gxid": gxid,
-                   "placements": sorted(txn.delete_dirs),
-                   "ingest_placements": sorted(txn.ingest_dirs),
-                   "tables": sorted(txn.tables)}
-        self.txlog.log(txn.xid, TxState.PREPARED, payload)
-        txn.branch_payload = payload
+        from citus_tpu.transaction.branches import prepare_branch
+        return prepare_branch(self, session, gxid)
 
     def _finish_branch(self, session, commit: bool) -> None:
-        """Phase 2: COMMITTED + flip (or abort staged), DONE, release."""
-        import contextlib as _ctxlib
-
-        from citus_tpu.storage.deletes import (
-            abort_staged_deletes, commit_staged_deletes,
-        )
-        from citus_tpu.storage.writer import abort_staged, commit_staged
-        from citus_tpu.transaction.manager import TxState
-        from citus_tpu.transaction.snapshot import flip_generation
-        from citus_tpu.transaction.write_locks import group_resource
-        txn = session.txn
-        payload = getattr(txn, "branch_payload", None) or {}
-        try:
-            if commit:
-                self.txlog.log(txn.xid, TxState.COMMITTED, payload)
-                groups = {}
-                for name in payload.get("tables", ()):
-                    if self.catalog.has_table(name):
-                        t0 = self.catalog.table(name)
-                        groups.setdefault(group_resource(t0), t0)
-                with _ctxlib.ExitStack() as _flips:
-                    for res in sorted(groups):
-                        _flips.enter_context(flip_generation(
-                            self.catalog.data_dir, groups[res]))
-                    for d in payload.get("placements", ()):
-                        commit_staged_deletes(d, txn.xid)
-                    for d in payload.get("ingest_placements", ()):
-                        commit_staged(d, txn.xid)
-                self.txlog.log(txn.xid, TxState.DONE)
-                self._plan_cache.clear()
-                if txn.cdc_events:
-                    clock = self.clock.transaction_clock()
-                    for table, op, kw in txn.cdc_events:
-                        self.cdc.emit(table, op, clock, force=True, **kw)
-            else:
-                for d in payload.get("ingest_placements", ()):
-                    abort_staged(d, txn.xid)
-                for d in payload.get("placements", ()):
-                    abort_staged_deletes(d, txn.xid)
-                self.txlog.log(txn.xid, TxState.ABORTED, payload)
-                self.txlog.log(txn.xid, TxState.DONE)
-                self._plan_cache.clear()
-        finally:
-            self.catalog._end_staging(txn)
-            txn.release_locks(self)
-            session.txn = None
+        from citus_tpu.transaction.branches import finish_branch
+        return finish_branch(self, session, commit)
 
     def _commit_txn_cross_host(self, session) -> None:
-        """COMMIT of a transaction with open remote branches: prepare
-        every branch (remote sessions + the local one), record the
-        outcome in the authority's first-writer-wins register, decide
-        everywhere (reference: the coordinated-transaction pre-commit
-        PREPARE on all write connections, transaction_management.c:319)."""
-        txn = session.txn
-        gxid = txn.gxid
-        rd = self.catalog.remote_data
-        local_prepared = False
-        try:
-            for ep in sorted(txn.remote_endpoints):
-                rd.call(ep, "txn_branch_prepare", {"gxid": gxid})
-            if txn.has_writes or txn.catalog_dirty or txn.on_commit:
-                self._prepare_branch(session, gxid)
-                local_prepared = True
-            winner = self._control.record_txn_outcome(gxid, "commit")
-            if winner != "commit":
-                raise TransactionError(
-                    "cross-host transaction aborted by a participant "
-                    "(branch timed out before the commit decision)")
-        except BaseException:
-            winner = None
-            try:
-                winner = self._control.record_txn_outcome(gxid, "abort")
-            except Exception:
-                pass
-            if winner == "commit":
-                # our own commit record already landed (its RPC response
-                # was lost): the transaction IS durably committed —
-                # complete the commit instead of diverging
-                self._complete_cross_host_commit(session, txn, gxid,
-                                                 local_prepared)
-                return
-            for ep in sorted(txn.remote_endpoints):
-                try:
-                    rd.call(ep, "txn_branch_abort", {"gxid": gxid})
-                except Exception:
-                    pass
-            if session.txn is not None:
-                try:
-                    if local_prepared:
-                        self._finish_branch(session, False)
-                    else:
-                        txn.remote_endpoints = set()  # already aborted
-                        self._rollback_txn(session)
-                except Exception:
-                    pass
-            raise
-        self._complete_cross_host_commit(session, txn, gxid,
-                                         local_prepared)
-
-    def _complete_cross_host_commit(self, session, txn, gxid: str,
-                                    local_prepared: bool) -> None:
-        """Phase 2 after a durably-recorded commit: finish the LOCAL
-        branch first (its outcome can never change now; raising before
-        it would strand a prepared branch a later ROLLBACK could abort
-        against the committed outcome), then decide every remote branch,
-        surfacing any divergence AFTER local state is consistent."""
-        rd = self.catalog.remote_data
-        if local_prepared:
-            self._finish_branch(session, True)
-        else:
-            self.txlog.release(txn.xid)
-            self.catalog._end_staging(txn)
-            txn.release_locks(self)
-            session.txn = None
-        self._plan_cache.clear()
-        divergence = None
-        for ep in sorted(txn.remote_endpoints):
-            try:
-                r = rd.call(ep, "dml_decide",
-                            {"gxid": gxid, "commit": True})
-                if not r.get("ok") and r.get("resolved") != "commit":
-                    divergence = (ep, r.get("resolved"))
-            except Exception:
-                pass  # branch resolves to commit from the outcome store
-        if divergence is not None:
-            raise ExecutionError(
-                f"cross-host branch on {divergence[0]} diverged: "
-                f"resolved={divergence[1]!r} after a committed outcome")
+        from citus_tpu.transaction.branches import commit_txn_cross_host
+        return commit_txn_cross_host(self, session)
 
     def _rollback_txn(self, session) -> None:
         from citus_tpu.storage.deletes import abort_staged_deletes
