@@ -955,6 +955,8 @@ class Parser:
         self.expect_op("(")
         cols = []
         fkeys = []
+        table_pk: list = []
+        table_unique: list = []
         while True:
             if self.peek().kind == "ident" and self.peek().value == "foreign":
                 # table constraint: FOREIGN KEY (cols) REFERENCES t (cols)
@@ -969,6 +971,30 @@ class Parser:
                     fcols.append(self.expect_ident())
                 self.expect_op(")")
                 fkeys.append(self._parse_references(fcols))
+                if not self.accept_op(","):
+                    break
+                continue
+            if self.peek().kind == "ident" \
+                    and self.peek().value in ("primary", "unique") \
+                    and self.peek(1).kind in ("ident", "op") \
+                    and (self.peek(1).value == "key"
+                         or self.peek(1).value == "("):
+                # table constraint: PRIMARY KEY (cols) / UNIQUE (cols)
+                is_pk = self.next().value == "primary"
+                if is_pk:
+                    if not (self.peek().kind == "ident"
+                            and self.peek().value == "key"):
+                        self.error("expected KEY after PRIMARY")
+                    self.next()
+                self.expect_op("(")
+                kcols = [self.expect_ident()]
+                while self.accept_op(","):
+                    kcols.append(self.expect_ident())
+                self.expect_op(")")
+                if len(kcols) > 1:
+                    self.error("multi-column PRIMARY KEY/UNIQUE "
+                               "constraints are not supported")
+                (table_pk if is_pk else table_unique).append(kcols[0])
                 if not self.accept_op(","):
                     break
                 continue
@@ -1007,6 +1033,21 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
+        if table_pk or table_unique:
+            # table-level single-column constraints fold onto the column
+            import dataclasses as _dc
+            by_name = {c.name: i for i, c in enumerate(cols)}
+            for cn in table_pk:
+                i = by_name.get(cn)
+                if i is None:
+                    self.error(f"PRIMARY KEY column {cn!r} not defined")
+                cols[i] = _dc.replace(cols[i], primary_key=True,
+                                      not_null=True)
+            for cn in table_unique:
+                i = by_name.get(cn)
+                if i is None:
+                    self.error(f"UNIQUE column {cn!r} not defined")
+                cols[i] = _dc.replace(cols[i], unique=True)
         options: dict = {}
         partition_by = None
         if self.accept_kw("partition"):

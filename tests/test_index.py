@@ -253,3 +253,22 @@ def test_unique_index_inside_transaction_overlay(db):
         db.execute("ROLLBACK", session=s)
     # rolled back: the value is free again
     assert db.copy_from("items", rows=[(770001, 1, "c", 1.0)]) == 1
+
+
+def test_table_level_pk_and_unique_constraints(tmp_path):
+    """PRIMARY KEY (col) / UNIQUE (col) as table constraints fold onto
+    the column (PostgreSQL's table-constraint spelling)."""
+    import citus_tpu as ct
+    from citus_tpu.integrity import UniqueViolation
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint,"
+               " PRIMARY KEY (k), UNIQUE (v))")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    names = {ix["name"] for ix in cl.catalog.table("t").unique_indexes}
+    assert names == {"t_pkey", "t_v_key"}
+    cl.execute("INSERT INTO t VALUES (1, 10)")
+    with pytest.raises(UniqueViolation):
+        cl.execute("INSERT INTO t VALUES (1, 20)")
+    with pytest.raises(UniqueViolation):
+        cl.execute("INSERT INTO t VALUES (2, 10)")
+    cl.close()
