@@ -1409,11 +1409,20 @@ class Cluster:
             raise AnalysisError("provide exactly one of columns= or rows=")
         if rows is not None:
             columns = rows_to_columns(t.schema.names, rows, column_names)
+            if column_names is not None:
+                # rows_to_columns pads OMITTED columns with None; drop
+                # them again so their DEFAULTs apply (a column the user
+                # listed keeps its explicit NULLs)
+                listed = set(column_names)
+                columns = {c: v for c, v in columns.items()
+                           if c in listed
+                           or not t.schema.column(c).default_sql}
         if t.is_partitioned:
             # two-level routing: range partition first, then hash shard
             # within it (each recursive call re-enters with the same
             # session/transaction context)
             return self._copy_into_partitions(t, columns)
+        columns = self._fill_defaults(t, columns)
         self._check_domains(t, columns)
         values, validity = encode_columns(self.catalog, t, columns)
         if t.partition_of is not None:
@@ -1525,6 +1534,32 @@ class Cluster:
         keep = ~remote_rows
         return ({c: v[keep] for c, v in values.items()},
                 {c: x[keep] for c, x in validity.items()}, shipped)
+
+    def _fill_defaults(self, t, columns: dict) -> dict:
+        """Fill columns absent from an ingest batch from their DEFAULT
+        expressions (reference: pg_attrdef defaults applied by the
+        rewriter).  nextval defaults draw one value PER ROW; other
+        defaults are constants folded once."""
+        missing = [c for c in t.schema
+                   if c.name not in columns and c.default_sql]
+        if not missing:
+            return columns
+        if not columns:
+            raise AnalysisError("empty ingest batch")
+        n = len(next(iter(columns.values())))
+        out = dict(columns)
+        from citus_tpu.planner.parser import Parser
+        for col in missing:
+            e = Parser(col.default_sql).parse_expr()
+            if isinstance(e, A.FuncCall) and e.name == "nextval" \
+                    and e.args and isinstance(e.args[0], A.Literal):
+                seq = str(e.args[0].value)
+                out[col.name] = [self.catalog.nextval(seq)
+                                 for _ in range(n)]
+            else:
+                v = _eval_const(e)
+                out[col.name] = [v] * n
+        return out
 
     def _copy_from_locked(self, t, txn, columns, values, validity) -> None:
         """copy_from's body under the table write lock: FK + unique

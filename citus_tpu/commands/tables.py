@@ -47,22 +47,38 @@ def create_table(cl, stmt):
     from citus_tpu import types as T
     cols, enum_binds = [], []
     domain_binds = []
+    serial_seqs = []  # sequences to create for serial columns
+    _SERIAL = {"smallserial": "smallint", "serial": "int",
+               "bigserial": "bigint"}
     for c in stmt.columns:
-        if c.type_name in cl.catalog.types:
-            cols.append(Column(c.name, T.TEXT_T, c.not_null))
+        default_sql = c.default_sql
+        type_name = c.type_name
+        if type_name in _SERIAL:
+            # serial = integer + owned sequence + nextval default
+            # (reference: commands/sequence.c ownership propagation)
+            seq = f"{stmt.name}_{c.name}_seq"
+            serial_seqs.append(seq)
+            default_sql = f"nextval('{seq}')"
+            type_name = _SERIAL[type_name]
+        if type_name in cl.catalog.types:
+            cols.append(Column(c.name, T.TEXT_T, c.not_null,
+                               default_sql=default_sql))
             enum_binds.append((c.name, c.type_name))
-        elif c.type_name in cl.catalog.domains:
-            d = cl.catalog.domains[c.type_name]
+        elif type_name in cl.catalog.domains:
+            d = cl.catalog.domains[type_name]
             cols.append(Column(
                 c.name,
                 type_from_sql(d["base"], d["args"] or None),
-                c.not_null or d["not_null"]))
-            domain_binds.append((c.name, c.type_name))
+                c.not_null or d["not_null"], default_sql=default_sql))
+            domain_binds.append((c.name, type_name))
         else:
             cols.append(Column(
-                c.name, type_from_sql(c.type_name, c.type_args or None),
-                c.not_null))
+                c.name, type_from_sql(type_name, c.type_args or None),
+                c.not_null, default_sql=default_sql))
     schema = Schema(cols)
+    for seq in serial_seqs:
+        if seq not in cl.catalog.sequences:
+            cl.catalog.create_sequence(seq, 1, 1)
     opts = {k: v for k, v in stmt.options.items() if k != "access_method"}
     fks = []
     pre_existing = cl.catalog.has_table(stmt.name)

@@ -197,6 +197,7 @@ class ColumnDef:
     not_null: bool = False
     primary_key: bool = False   # implies not_null + unique index
     unique: bool = False        # column-level UNIQUE constraint
+    default_sql: str = ""       # DEFAULT expression (SQL text)
 
 
 @dataclass

@@ -1003,7 +1003,17 @@ class Parser:
             not_null = False
             primary_key = False
             unique = False
+            default_sql = ""
             while True:
+                if self.peek().kind == "ident" \
+                        and self.peek().value == "default":
+                    self.next()
+                    start = self.peek().pos
+                    self.parse_additive()  # validate the expression
+                    end = self.peek().pos if self.peek().kind != "eof" \
+                        else len(self.text)
+                    default_sql = self.text[start:end].strip()
+                    continue
                 if self.accept_kw("not"):
                     self.expect_kw("null")
                     not_null = True
@@ -1029,7 +1039,7 @@ class Parser:
                     continue
                 break
             cols.append(A.ColumnDef(cname, tname, targs, not_null,
-                                    primary_key, unique))
+                                    primary_key, unique, default_sql))
             if not self.accept_op(","):
                 break
         self.expect_op(")")

@@ -20,6 +20,10 @@ class Column:
     not_null: bool = False
     # immutable on-disk stream key; stays stable across RENAME COLUMN
     storage_name: str = ""
+    # DEFAULT expression as SQL text (literal or nextval('seq')),
+    # evaluated per missing-column row at ingest (reference:
+    # pg_attrdef; sequences back serial columns)
+    default_sql: str = ""
 
     def __post_init__(self):
         if not self.storage_name:
@@ -63,18 +67,26 @@ class Schema:
         raise AnalysisError(f"column {name!r} does not exist")
 
     def to_json(self) -> list:
-        return [
-            {"name": c.name, "kind": c.type.kind, "precision": c.type.precision,
-             "scale": c.type.scale, "not_null": c.not_null,
-             "storage_name": c.storage_name}
-            for c in self.columns
-        ]
+        out = []
+        for c in self.columns:
+            d = {"name": c.name, "kind": c.type.kind,
+                 "precision": c.type.precision, "scale": c.type.scale,
+                 "not_null": c.not_null, "storage_name": c.storage_name}
+            if c.type.elem is not None:
+                d["elem"] = c.type.elem
+            if c.default_sql:
+                d["default"] = c.default_sql
+            out.append(d)
+        return out
 
     @staticmethod
     def from_json(data: list) -> "Schema":
         return Schema([
-            Column(d["name"], ColumnType(d["kind"], d["precision"], d["scale"]),
-                   d["not_null"], d.get("storage_name", d["name"]))
+            Column(d["name"],
+                   ColumnType(d["kind"], d["precision"], d["scale"],
+                              d.get("elem")),
+                   d["not_null"], d.get("storage_name", d["name"]),
+                   d.get("default", ""))
             for d in data
         ])
 

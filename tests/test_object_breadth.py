@@ -235,3 +235,33 @@ def test_analyze_edge_cases(cl):
     cl.execute("ANALYZE")  # no stale entry to trip over
     # VACUUM FULL spelling parses
     cl.execute("VACUUM FULL dc")
+
+
+def test_column_defaults_and_serial(tmp_path):
+    """DEFAULT expressions (pg_attrdef analog) and serial columns
+    (integer + owned sequence + nextval default)."""
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (id bigserial NOT NULL,"
+               " v bigint DEFAULT 7, s text DEFAULT 'none', k bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'id', 4)")
+    cl.execute("INSERT INTO t (k) VALUES (100)")
+    cl.execute("INSERT INTO t (k, v) VALUES (300, 99)")
+    rows = sorted(cl.execute("SELECT id, v, s, k FROM t").rows)
+    assert rows == [(1, 7, "none", 100), (2, 99, "none", 300)]
+    # explicit NULL on a defaulted column stays NULL (the column was
+    # listed); omitted columns without defaults stay NULL too
+    cl.execute("INSERT INTO t (k, v) VALUES (400, NULL)")
+    assert (3, None, "none", 400) in \
+        cl.execute("SELECT id, v, s, k FROM t").rows
+    # defaults survive a catalog round-trip (reopen)
+    cl.close()
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("INSERT INTO t (k) VALUES (500)")
+    got = [r for r in cl.execute("SELECT v, s, k FROM t").rows
+           if r[2] == 500]
+    assert got == [(7, "none", 500)]
+    # serial ids are unique across the reopen
+    ids = [r[0] for r in cl.execute("SELECT id FROM t").rows]
+    assert len(ids) == len(set(ids))
+    cl.close()
