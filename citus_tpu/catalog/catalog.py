@@ -92,6 +92,10 @@ class TableMeta:
     # PHYSICAL bounds, lo inclusive / hi exclusive (None = unbounded)
     partition_by: Optional[dict] = None
     partition_of: Optional[dict] = None
+    # CHECK constraints, each {"name", "sql"} — enforced on every write
+    # path against the encoded batch (reference: pg_constraint CHECK
+    # rows; NULL results pass, like SQL)
+    check_constraints: list = field(default_factory=list)
 
     @property
     def shard_count(self) -> int:
@@ -139,6 +143,7 @@ class TableMeta:
             "indexes": self.indexes,
             "partition_by": self.partition_by,
             "partition_of": self.partition_of,
+            "check_constraints": self.check_constraints,
         }
 
     @staticmethod
@@ -157,6 +162,7 @@ class TableMeta:
             indexes=d.get("indexes", []),
             partition_by=d.get("partition_by"),
             partition_of=d.get("partition_of"),
+            check_constraints=d.get("check_constraints", []),
         )
 
 

@@ -1428,6 +1428,9 @@ class Cluster:
         if t.partition_of is not None:
             from citus_tpu.partitioning import check_partition_bounds
             check_partition_bounds(self.catalog, t, values, validity)
+        if t.check_constraints:
+            from citus_tpu.integrity import enforce_check_constraints
+            enforce_check_constraints(self.catalog, t, values, validity)
         remote_n = 0
         if self.catalog.remote_data is not None \
                 and not getattr(self._remote_exec_guard, "v", False):

@@ -398,6 +398,10 @@ def update(cl, stmt):
             from citus_tpu.partitioning import check_partition_bounds
             checks.append(
                 lambda v, m: check_partition_bounds(cl.catalog, t, v, m))
+        if t.check_constraints:
+            from citus_tpu.integrity import enforce_check_constraints
+            checks.append(
+                lambda v, m: enforce_check_constraints(cl.catalog, t, v, m))
         check = None
         if checks:
             check = lambda v, m: [c(v, m) for c in checks]  # noqa: E731

@@ -141,6 +141,21 @@ def create_table(cl, stmt):
         t0 = cl.catalog.table(stmt.name)
         t0.partition_by = {"column": stmt.partition_by, "kind": "range"}
         cl.catalog.commit()
+    if stmt.checks and not pre_existing \
+            and cl.catalog.has_table(stmt.name):
+        from citus_tpu.planner.bind import Binder
+        from citus_tpu.planner.parser import Parser
+        t0 = cl.catalog.table(stmt.name)
+        for i, sql in enumerate(stmt.checks):
+            # bind now: an unbindable CHECK must fail the CREATE
+            e = Parser(sql).parse_expr()
+            bound = Binder(cl.catalog, t0).bind_scalar(e)
+            if bound.type.kind != "bool":
+                raise AnalysisError(
+                    f"CHECK constraint must be boolean: ({sql})")
+            t0.check_constraints.append(
+                {"name": f"{stmt.name}_check{i + 1}", "sql": sql})
+        cl.catalog.commit()
     return Result(columns=[], rows=[])
 
 

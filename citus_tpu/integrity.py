@@ -574,3 +574,49 @@ def forbid_drop_referenced(catalog, table_name: str) -> None:
         raise AnalysisError(
             f'cannot drop table "{table_name}" because other objects '
             f'depend on it: constraint on table "{refs[0]}"')
+
+
+class CheckViolation(ExecutionError):
+    """A row failed a CHECK constraint (PostgreSQL SQLSTATE 23514)."""
+
+
+def enforce_check_constraints(cat, t, values: dict, validity: dict) -> None:
+    """Evaluate every CHECK constraint over a physical-encoded batch;
+    a FALSE result rejects the batch (NULL results pass, per SQL).
+    Reference: pg_constraint CHECK rows enforced by the executor."""
+    if not t.check_constraints:
+        return
+    import numpy as np
+
+    from citus_tpu.planner.bind import Binder
+    from citus_tpu.planner.bound import compile_expr, predicate_mask
+    from citus_tpu.planner.parser import Parser
+    n = len(next(iter(values.values()))) if values else 0
+    if n == 0:
+        return
+    env = {}
+    for c, v in values.items():
+        m = validity.get(c)
+        env[c] = (np.asarray(v), True if m is None else np.asarray(m, bool))
+    b = Binder(cat, t)
+    for ck in t.check_constraints:
+        bound = b.bind_scalar(Parser(ck["sql"]).parse_expr())
+        fn = compile_expr(bound, np)
+        # predicate_mask applies SQL three-valued logic: NULL -> pass
+        # would be wrong for WHERE (filters out) but CHECK passes NULL,
+        # so evaluate validity explicitly: violation = (valid AND false)
+        val, ok = fn(env)
+        val = np.asarray(val, bool)
+        if val.shape == ():
+            val = np.full(n, bool(val))
+        if ok is True:
+            okm = np.ones(n, bool)
+        elif ok is False:
+            okm = np.zeros(n, bool)
+        else:
+            okm = np.asarray(ok, bool)
+        bad = okm & ~val
+        if bad.any():
+            raise CheckViolation(
+                f'new row for relation "{t.name}" violates check '
+                f'constraint "{ck["name"]}" (CHECK ({ck["sql"]}))')

@@ -227,6 +227,8 @@ class CreateTable(Statement):
     # {"parent", "lo", "hi"} with raw literal values (None = MINVALUE/
     # MAXVALUE); physical conversion happens at DDL execution
     partition_of: "dict | None" = None
+    # CHECK constraints (column- or table-level), SQL text each
+    checks: list = field(default_factory=list)
 
 
 @dataclass

@@ -957,6 +957,7 @@ class Parser:
         fkeys = []
         table_pk: list = []
         table_unique: list = []
+        table_checks: list = []
         while True:
             if self.peek().kind == "ident" and self.peek().value == "foreign":
                 # table constraint: FOREIGN KEY (cols) REFERENCES t (cols)
@@ -971,6 +972,16 @@ class Parser:
                     fcols.append(self.expect_ident())
                 self.expect_op(")")
                 fkeys.append(self._parse_references(fcols))
+                if not self.accept_op(","):
+                    break
+                continue
+            if self.peek().kind == "ident" \
+                    and self.peek().value == "check" \
+                    and self.peek(1).kind == "op" \
+                    and self.peek(1).value == "(":
+                # table constraint: CHECK (expr)
+                self.next()
+                table_checks.append(self._parse_paren_expr_text())
                 if not self.accept_op(","):
                     break
                 continue
@@ -1005,6 +1016,13 @@ class Parser:
             unique = False
             default_sql = ""
             while True:
+                if self.peek().kind == "ident" \
+                        and self.peek().value == "check" \
+                        and self.peek(1).kind == "op" \
+                        and self.peek(1).value == "(":
+                    self.next()
+                    table_checks.append(self._parse_paren_expr_text())
+                    continue
                 if self.peek().kind == "ident" \
                         and self.peek().value == "default":
                     self.next()
@@ -1082,7 +1100,8 @@ class Parser:
                     break
             self.expect_op(")")
         return A.CreateTable(name, cols, if_not_exists, options, fkeys,
-                             partition_by=partition_by)
+                             partition_by=partition_by,
+                             checks=table_checks)
 
     def _parse_copy_path_and_options(self):
         """'path' [WITH (opt [value], ...)] — shared by every COPY form."""

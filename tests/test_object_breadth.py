@@ -265,3 +265,32 @@ def test_column_defaults_and_serial(tmp_path):
     ids = [r[0] for r in cl.execute("SELECT id FROM t").rows]
     assert len(ids) == len(set(ids))
     cl.close()
+
+
+def test_check_constraints(tmp_path):
+    """Column- and table-level CHECK constraints enforced on INSERT,
+    COPY, and UPDATE (pg_constraint CHECK analog; NULL passes)."""
+    import citus_tpu as ct
+    from citus_tpu.integrity import CheckViolation
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE acc (id bigint NOT NULL,"
+               " balance decimal(12,2) CHECK (balance >= 0),"
+               " status text, CHECK (id > 0))")
+    cl.execute("SELECT create_distributed_table('acc', 'id', 4)")
+    assert len(cl.catalog.table("acc").check_constraints) == 2
+    cl.execute("INSERT INTO acc VALUES (1, 100.50, 'open')")
+    with pytest.raises(CheckViolation):
+        cl.execute("INSERT INTO acc VALUES (2, -5, 'open')")
+    with pytest.raises(CheckViolation):
+        cl.copy_from("acc", rows=[(3, 10, "a"), (-4, 10, "b")])
+    with pytest.raises(CheckViolation):
+        cl.execute("UPDATE acc SET balance = balance - 200 WHERE id = 1")
+    # NULL passes a CHECK (SQL three-valued logic)
+    cl.execute("INSERT INTO acc VALUES (5, NULL, 'open')")
+    # survives reopen
+    cl.close()
+    cl = ct.Cluster(str(tmp_path / "db"))
+    with pytest.raises(CheckViolation):
+        cl.execute("INSERT INTO acc VALUES (6, -1, 'x')")
+    assert cl.execute("SELECT count(*) FROM acc").rows == [(2,)]
+    cl.close()
