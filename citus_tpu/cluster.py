@@ -1547,9 +1547,7 @@ class Cluster:
                    if c.name not in columns and c.default_sql]
         if not missing:
             return columns
-        if not columns:
-            raise AnalysisError("empty ingest batch")
-        n = len(next(iter(columns.values())))
+        n = len(next(iter(columns.values()))) if columns else 1
         out = dict(columns)
         from citus_tpu.planner.parser import Parser
         for col in missing:

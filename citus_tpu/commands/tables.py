@@ -192,6 +192,35 @@ def alter_table(cl, stmt):
         import dataclasses as _dc
         for p in cl.catalog.partitions_of(stmt.table):
             cl._execute_stmt(_dc.replace(stmt, table=p.name))
+    if stmt.action == "add_check":
+        from citus_tpu.planner.bind import Binder
+        from citus_tpu.planner.parser import Parser
+        t0 = cl.catalog.table(stmt.table)
+        bound = Binder(cl.catalog, t0).bind_scalar(
+            Parser(stmt.check_sql).parse_expr())
+        if bound.type.kind != "bool":
+            raise AnalysisError(
+                f"CHECK constraint must be boolean: ({stmt.check_sql})")
+        # PostgreSQL validates existing rows at ADD time: any row where
+        # the expression is FALSE (NULL passes) rejects the DDL
+        r = cl._execute_stmt(A.Select(
+            [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+            A.TableRef(stmt.table),
+            A.UnOp("not", Parser(stmt.check_sql).parse_expr())))
+        if r.rows and r.rows[0][0]:
+            raise AnalysisError(
+                f'check constraint of relation "{stmt.table}" is '
+                f"violated by {r.rows[0][0]} existing row(s)")
+        ck_name = stmt.new_name or \
+            f"{stmt.table}_check{len(t0.check_constraints) + 1}"
+        if any(c["name"] == ck_name for c in t0.check_constraints):
+            raise CatalogError(
+                f'constraint "{ck_name}" already exists')
+        t0.check_constraints.append({"name": ck_name,
+                                     "sql": stmt.check_sql})
+        cl.catalog.commit()
+        cl._plan_cache.clear()
+        return Result(columns=[], rows=[])
     if stmt.action == "add_column":
         from citus_tpu import types as T
         tn = stmt.column.type_name

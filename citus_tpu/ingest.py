@@ -184,8 +184,10 @@ class TableIngestor:
 
 def rows_to_columns(schema_names: list[str], rows: Iterable[Sequence[Any]],
                     columns: Optional[list[str]] = None) -> dict[str, list]:
-    """Row tuples -> column lists, filling omitted columns with None."""
-    cols = columns or schema_names
+    """Row tuples -> column lists, filling omitted columns with None.
+    An explicit empty column list means every column is omitted
+    (INSERT ... DEFAULT VALUES)."""
+    cols = schema_names if columns is None else columns
     store: dict[str, list] = {name: [] for name in schema_names}
     for row in rows:
         if len(row) != len(cols):

@@ -677,10 +677,12 @@ class SetOp(Statement):
 @dataclass
 class AlterTable(Statement):
     table: str
-    action: str               # add_column | drop_column | rename_column | rename_table
+    action: str   # add_column | drop_column | rename_column | rename_table
+                  # | add_check
     column: Optional[ColumnDef] = None
     old_name: Optional[str] = None
     new_name: Optional[str] = None
+    check_sql: Optional[str] = None  # ADD [CONSTRAINT n] CHECK (expr)
 
 
 @dataclass

@@ -464,6 +464,20 @@ class Parser:
         self.expect_kw("table")
         name = self.parse_table_name()
         if self.accept_kw("add"):
+            # ADD [CONSTRAINT name] CHECK (expr)
+            if self.peek().kind == "ident" \
+                    and self.peek().value in ("constraint", "check"):
+                ck_name = None
+                if self.peek().value == "constraint":
+                    self.next()
+                    ck_name = self.expect_ident()
+                if not (self.peek().kind == "ident"
+                        and self.peek().value == "check"):
+                    self.error("expected CHECK")
+                self.next()
+                return A.AlterTable(name, "add_check",
+                                    check_sql=self._parse_paren_expr_text(),
+                                    new_name=ck_name)
             self.accept_kw("column")
             cname = self.expect_ident()
             tname, targs = self.parse_type_name()
@@ -1334,6 +1348,16 @@ class Parser:
         if self.at_kw("select"):
             sel = self.parse_select()
             return A.Insert(name, cols, [], select=sel,
+                            on_conflict=self._parse_on_conflict(),
+                            returning=self._parse_returning())
+        if self.peek().kind == "ident" \
+                and self.peek().value == "default" \
+                and self.peek(1).kind == "kw" \
+                and self.peek(1).value == "values":
+            # INSERT INTO t DEFAULT VALUES: one row, all defaults
+            self.next()
+            self.next()
+            return A.Insert(name, [], [[]],
                             on_conflict=self._parse_on_conflict(),
                             returning=self._parse_returning())
         self.expect_kw("values")

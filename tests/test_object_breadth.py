@@ -294,3 +294,26 @@ def test_check_constraints(tmp_path):
         cl.execute("INSERT INTO acc VALUES (6, -1, 'x')")
     assert cl.execute("SELECT count(*) FROM acc").rows == [(2,)]
     cl.close()
+
+
+def test_alter_add_check_and_default_values(tmp_path):
+    import citus_tpu as ct
+    from citus_tpu.errors import AnalysisError
+    from citus_tpu.integrity import CheckViolation
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE t (id bigserial NOT NULL,"
+               " v bigint DEFAULT 42)")
+    cl.execute("SELECT create_distributed_table('t', 'id', 4)")
+    cl.execute("INSERT INTO t DEFAULT VALUES")
+    cl.execute("INSERT INTO t DEFAULT VALUES")
+    assert sorted(cl.execute("SELECT id, v FROM t").rows) == \
+        [(1, 42), (2, 42)]
+    cl.execute("INSERT INTO t (v) VALUES (-7)")
+    # ADD CHECK validates existing rows (NULL passes, FALSE rejects)
+    with pytest.raises(AnalysisError, match="violated by"):
+        cl.execute("ALTER TABLE t ADD CONSTRAINT pos CHECK (v >= 0)")
+    cl.execute("DELETE FROM t WHERE v < 0")
+    cl.execute("ALTER TABLE t ADD CONSTRAINT pos CHECK (v >= 0)")
+    with pytest.raises(CheckViolation):
+        cl.execute("INSERT INTO t (v) VALUES (-1)")
+    cl.close()
