@@ -990,6 +990,16 @@ class Cluster:
             # PostgreSQL: dropping the parent drops its partitions
             for p in list(self.catalog.partitions_of(name)):
                 self.drop_table(p.name)
+        # owned serial sequences die with the table (PostgreSQL drops
+        # sequences owned by a dropped column); ownership here = the
+        # column's default references nextval('<table>_<col>_seq')
+        import re as _re
+        for col in t.schema:
+            m = _re.fullmatch(r"nextval\('([A-Za-z_0-9.]+)'\)",
+                              col.default_sql or "")
+            if m and m.group(1) == f"{name}_{col.name}_seq" \
+                    and m.group(1) in self.catalog.sequences:
+                self.catalog.drop_sequence(m.group(1))
         self.catalog.drop_table(name)
         for key in [k for k in self.catalog.enum_columns
                     if k.startswith(name + ".")]:
@@ -1070,10 +1080,13 @@ class Cluster:
         t = self.catalog.table(name)
         t.partition_of = {"parent": parent, "lo": lo, "hi": hi}
         # constraints declared on the parent apply to every partition
-        # (PostgreSQL propagates both; unique keys were validated at
-        # parent creation to include the partition column)
+        # (PostgreSQL propagates FK, CHECK, and unique constraints;
+        # unique keys were validated at parent creation to include the
+        # partition column)
         import json as _json
         t.foreign_keys = _json.loads(_json.dumps(pt.foreign_keys))
+        t.check_constraints = _json.loads(
+            _json.dumps(pt.check_constraints))
         if pt.method == DistributionMethod.HASH:
             siblings = [p for p in self.catalog.partitions_of(parent)
                         if p.name != name and p.is_distributed]
@@ -1550,8 +1563,14 @@ class Cluster:
         n = len(next(iter(columns.values()))) if columns else 1
         out = dict(columns)
         from citus_tpu.planner.parser import Parser
+        cache = self._default_expr_cache
         for col in missing:
-            e = Parser(col.default_sql).parse_expr()
+            e = cache.get(col.default_sql)
+            if e is None:
+                e = Parser(col.default_sql).parse_expr()
+                if len(cache) > 512:
+                    cache.clear()
+                cache[col.default_sql] = e
             if isinstance(e, A.FuncCall) and e.name == "nextval" \
                     and e.args and isinstance(e.args[0], A.Literal):
                 seq = str(e.args[0].value)
@@ -2328,6 +2347,8 @@ class Cluster:
     # remote branch counts of an in-transaction modify whose local part
     # still runs (commands/dml.py _txn_remote_dml sets, handlers merge)
     _remote_counts = __import__("threading").local()
+    # parsed DEFAULT expressions keyed by their SQL text (immutable)
+    _default_expr_cache: dict = {}
 
     def _execute_stmt(self, stmt: A.Statement, sql_text: Optional[str] = None) -> Result:
         depth = getattr(self._stmt_depth, "v", 0)

@@ -93,11 +93,28 @@ def execute_insert(cl, stmt: A.Insert) -> Result:
                 raise UnsupportedFeatureError("INSERT VALUES must be literals")
             row.append(e.value)
         rows.append(row)
+    # resolve DEFAULTs up front (serial ids included) so ON CONFLICT
+    # and RETURNING see exactly what gets stored — copy_from then
+    # receives the complete batch and never draws defaults again
+    names = list(t.schema.names if stmt.columns is None else stmt.columns)
+    has_defaults = any(c.default_sql and c.name not in names
+                       for c in t.schema)
+    if has_defaults and rows:
+        from citus_tpu.ingest import rows_to_columns
+        listed = set(names)
+        columns = {c: v for c, v in
+                   rows_to_columns(t.schema.names, rows, names).items()
+                   if c in listed
+                   or not t.schema.column(c).default_sql}
+        columns = cl._fill_defaults(t, columns)
+        names = [c for c in t.schema.names if c in columns]
+        rows = [tuple(columns[c][i] for c in names)
+                for i in range(len(rows))]
+        stmt = __import__("dataclasses").replace(stmt, columns=names)
     if stmt.on_conflict is not None:
         return _execute_upsert(cl, t, stmt, rows)
-    n = cl.copy_from(stmt.table, rows=rows, column_names=stmt.columns)
+    n = cl.copy_from(stmt.table, rows=rows, column_names=names)
     if stmt.returning:
-        names = list(stmt.columns or t.schema.names)
         out_rows = []
         for row in rows:
             m = {}
@@ -135,7 +152,7 @@ def _execute_upsert(cl, t, stmt: A.Insert, rows: list) -> Result:
     if not oc.targets:
         raise UnsupportedFeatureError(
             "ON CONFLICT requires an explicit (column, ...) target")
-    names = list(stmt.columns or t.schema.names)
+    names = list(t.schema.names if stmt.columns is None else stmt.columns)
     for c in oc.targets:
         if not t.schema.has(c):
             raise AnalysisError(f"column {c!r} does not exist")

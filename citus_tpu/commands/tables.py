@@ -76,9 +76,6 @@ def create_table(cl, stmt):
                 c.name, type_from_sql(type_name, c.type_args or None),
                 c.not_null, default_sql=default_sql))
     schema = Schema(cols)
-    for seq in serial_seqs:
-        if seq not in cl.catalog.sequences:
-            cl.catalog.create_sequence(seq, 1, 1)
     opts = {k: v for k, v in stmt.options.items() if k != "access_method"}
     fks = []
     pre_existing = cl.catalog.has_table(stmt.name)
@@ -110,6 +107,20 @@ def create_table(cl, stmt):
                     raise UnsupportedFeatureError(
                         "unique constraint on partitioned table "
                         "must include the partition column")
+    if stmt.checks and not pre_existing:
+        # pre-validate CHECK expressions BEFORE the table commits
+        # (CREATE TABLE is all-or-nothing, like the index/partition
+        # validation above) — bound against a transient TableMeta
+        from citus_tpu.catalog.catalog import TableMeta as _TM
+        from citus_tpu.planner.bind import Binder
+        from citus_tpu.planner.parser import Parser
+        probe = _TM(name=stmt.name, schema=schema)
+        for sql in stmt.checks:
+            bound = Binder(cl.catalog, probe).bind_scalar(
+                Parser(sql).parse_expr())
+            if bound.type.kind != "bool":
+                raise AnalysisError(
+                    f"CHECK constraint must be boolean: ({sql})")
     if stmt.foreign_keys and not pre_existing:
         from citus_tpu.integrity import declare_fks
         fks = declare_fks(cl.catalog, stmt.name,
@@ -143,18 +154,20 @@ def create_table(cl, stmt):
         cl.catalog.commit()
     if stmt.checks and not pre_existing \
             and cl.catalog.has_table(stmt.name):
-        from citus_tpu.planner.bind import Binder
-        from citus_tpu.planner.parser import Parser
         t0 = cl.catalog.table(stmt.name)
-        for i, sql in enumerate(stmt.checks):
-            # bind now: an unbindable CHECK must fail the CREATE
-            e = Parser(sql).parse_expr()
-            bound = Binder(cl.catalog, t0).bind_scalar(e)
-            if bound.type.kind != "bool":
-                raise AnalysisError(
-                    f"CHECK constraint must be boolean: ({sql})")
+        for i, sql in enumerate(stmt.checks):  # pre-validated above
             t0.check_constraints.append(
                 {"name": f"{stmt.name}_check{i + 1}", "sql": sql})
+        cl.catalog.commit()
+    if serial_seqs and not pre_existing \
+            and cl.catalog.has_table(stmt.name):
+        # owned sequences exist only once the table does; a stale
+        # same-named sequence from an earlier incarnation restarts
+        # (PostgreSQL drops owned sequences with their table)
+        for seq in serial_seqs:
+            if seq in cl.catalog.sequences:
+                cl.catalog.drop_sequence(seq)
+            cl.catalog.create_sequence(seq, 1, 1)
         cl.catalog.commit()
     return Result(columns=[], rows=[])
 

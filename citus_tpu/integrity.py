@@ -580,6 +580,29 @@ class CheckViolation(ExecutionError):
     """A row failed a CHECK constraint (PostgreSQL SQLSTATE 23514)."""
 
 
+#: compiled CHECK predicates keyed (table, version, sql) — re-binding
+#: every write batch would put parser+binder cost on the hot path;
+#: version keys the cache so DDL invalidates naturally (bounded size)
+_CHECK_FN_CACHE: dict = {}
+
+
+def _compiled_check(cat, t, sql: str):
+    import numpy as np
+
+    from citus_tpu.planner.bind import Binder
+    from citus_tpu.planner.bound import compile_expr
+    from citus_tpu.planner.parser import Parser
+    key = (t.name, t.version, sql)
+    fn = _CHECK_FN_CACHE.get(key)
+    if fn is None:
+        bound = Binder(cat, t).bind_scalar(Parser(sql).parse_expr())
+        fn = compile_expr(bound, np)
+        if len(_CHECK_FN_CACHE) > 512:
+            _CHECK_FN_CACHE.clear()
+        _CHECK_FN_CACHE[key] = fn
+    return fn
+
+
 def enforce_check_constraints(cat, t, values: dict, validity: dict) -> None:
     """Evaluate every CHECK constraint over a physical-encoded batch;
     a FALSE result rejects the batch (NULL results pass, per SQL).
@@ -587,10 +610,6 @@ def enforce_check_constraints(cat, t, values: dict, validity: dict) -> None:
     if not t.check_constraints:
         return
     import numpy as np
-
-    from citus_tpu.planner.bind import Binder
-    from citus_tpu.planner.bound import compile_expr, predicate_mask
-    from citus_tpu.planner.parser import Parser
     n = len(next(iter(values.values()))) if values else 0
     if n == 0:
         return
@@ -598,10 +617,8 @@ def enforce_check_constraints(cat, t, values: dict, validity: dict) -> None:
     for c, v in values.items():
         m = validity.get(c)
         env[c] = (np.asarray(v), True if m is None else np.asarray(m, bool))
-    b = Binder(cat, t)
     for ck in t.check_constraints:
-        bound = b.bind_scalar(Parser(ck["sql"]).parse_expr())
-        fn = compile_expr(bound, np)
+        fn = _compiled_check(cat, t, ck["sql"])
         # predicate_mask applies SQL three-valued logic: NULL -> pass
         # would be wrong for WHERE (filters out) but CHECK passes NULL,
         # so evaluate validity explicitly: violation = (valid AND false)

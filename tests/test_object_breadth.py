@@ -317,3 +317,45 @@ def test_alter_add_check_and_default_values(tmp_path):
     with pytest.raises(CheckViolation):
         cl.execute("INSERT INTO t (v) VALUES (-1)")
     cl.close()
+
+
+def test_check_constraints_inherited_by_partitions(tmp_path):
+    """Review finding: parent CHECK constraints must bind to every
+    partition (PostgreSQL propagates them); writes through the parent
+    or directly into a leaf are both enforced."""
+    import citus_tpu as ct
+    from citus_tpu.integrity import CheckViolation
+    cl = ct.Cluster(str(tmp_path / "db"))
+    cl.execute("CREATE TABLE m (id bigint NOT NULL, v bigint,"
+               " CHECK (v > 0)) PARTITION BY RANGE (id)")
+    cl.execute("CREATE TABLE m1 PARTITION OF m "
+               "FOR VALUES FROM (0) TO (100)")
+    cl.execute("SELECT create_distributed_table('m', 'id', 4)")
+    with pytest.raises(CheckViolation):
+        cl.execute("INSERT INTO m VALUES (1, -5)")
+    with pytest.raises(CheckViolation):
+        cl.copy_from("m1", rows=[(2, -1)])
+    cl.execute("INSERT INTO m VALUES (3, 5)")
+    assert cl.execute("SELECT count(*) FROM m").rows == [(1,)]
+    cl.close()
+
+
+def test_create_table_atomic_with_bad_check_and_serial_lifecycle(tmp_path):
+    """Review findings: a failing CHECK leaves NO half-created table,
+    and serial sequences die with their table (a recreated table
+    restarts at 1)."""
+    import citus_tpu as ct
+    cl = ct.Cluster(str(tmp_path / "db"))
+    with pytest.raises(Exception):
+        cl.execute("CREATE TABLE bad (x bigint, CHECK (nosuch > 0))")
+    assert not cl.catalog.has_table("bad")
+    cl.execute("CREATE TABLE s2 (id serial NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('s2', 'id', 4)")
+    cl.execute("INSERT INTO s2 (v) VALUES (1)")
+    cl.execute("DROP TABLE s2")
+    assert "s2_id_seq" not in cl.catalog.sequences
+    cl.execute("CREATE TABLE s2 (id serial NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('s2', 'id', 4)")
+    r = cl.execute("INSERT INTO s2 (v) VALUES (2) RETURNING id")
+    assert r.rows == [(1,)]  # fresh sequence, not the old counter
+    cl.close()
