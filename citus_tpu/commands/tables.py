@@ -205,6 +205,37 @@ def alter_table(cl, stmt):
         import dataclasses as _dc
         for p in cl.catalog.partitions_of(stmt.table):
             cl._execute_stmt(_dc.replace(stmt, table=p.name))
+    if stmt.action == "drop_constraint":
+        t0 = cl.catalog.table(stmt.table)
+        kept = [c for c in t0.check_constraints
+                if c["name"] != stmt.old_name]
+        fks_kept = [f for f in t0.foreign_keys
+                    if f.get("name") != stmt.old_name]
+        if len(kept) == len(t0.check_constraints) \
+                and len(fks_kept) == len(t0.foreign_keys):
+            raise CatalogError(
+                f'constraint "{stmt.old_name}" of relation '
+                f'"{stmt.table}" does not exist')
+        t0.check_constraints[:] = kept
+        t0.foreign_keys[:] = fks_kept
+        t0.version += 1
+        cl.catalog.commit()
+        cl._plan_cache.clear()
+        return Result(columns=[], rows=[])
+    if stmt.action == "set_default":
+        import dataclasses as _dc
+        t0 = cl.catalog.table(stmt.table)
+        t0.schema.column(stmt.old_name)  # must exist
+        if stmt.check_sql is not None:
+            from citus_tpu.planner.parser import Parser
+            Parser(stmt.check_sql).parse_expr()  # must parse
+        t0.schema.columns[:] = [
+            _dc.replace(c, default_sql=stmt.check_sql or "")
+            if c.name == stmt.old_name else c
+            for c in t0.schema.columns]
+        t0.version += 1
+        cl.catalog.commit()
+        return Result(columns=[], rows=[])
     if stmt.action == "add_check":
         from citus_tpu.planner.bind import Binder
         from citus_tpu.planner.parser import Parser

@@ -488,6 +488,11 @@ class Parser:
             return A.AlterTable(name, "add_column",
                                 column=A.ColumnDef(cname, tname, targs, not_null))
         if self.accept_kw("drop"):
+            if self.peek().kind == "ident" \
+                    and self.peek().value == "constraint":
+                self.next()
+                return A.AlterTable(name, "drop_constraint",
+                                    old_name=self.expect_ident())
             self.accept_kw("column")
             return A.AlterTable(name, "drop_column", old_name=self.expect_ident())
         if self.accept_kw("rename"):
@@ -503,6 +508,29 @@ class Parser:
             self.expect_kw("to")
             return A.AlterTable(name, "rename_column", old_name=old,
                                 new_name=self.expect_ident())
+        if self.accept_kw("alter"):
+            # ALTER COLUMN c SET DEFAULT expr / DROP DEFAULT
+            self.accept_kw("column")
+            cname = self.expect_ident()
+            if self.accept_kw("set"):
+                if not (self.peek().kind == "ident"
+                        and self.peek().value == "default"):
+                    self.error("expected DEFAULT")
+                self.next()
+                start = self.peek().pos
+                self.parse_additive()
+                end = self.peek().pos if self.peek().kind != "eof" \
+                    else len(self.text)
+                return A.AlterTable(name, "set_default", old_name=cname,
+                                    check_sql=self.text[start:end].strip())
+            if self.accept_kw("drop"):
+                if not (self.peek().kind == "ident"
+                        and self.peek().value == "default"):
+                    self.error("expected DEFAULT")
+                self.next()
+                return A.AlterTable(name, "set_default", old_name=cname,
+                                    check_sql=None)
+            self.error("expected SET DEFAULT or DROP DEFAULT")
         if self.peek().kind == "ident" \
                 and self.peek().value in ("enable", "disable"):
             enable = self.next().value == "enable"
