@@ -1511,6 +1511,19 @@ class Cluster:
             if local_hosted:
                 return values, validity, 0  # local ingest counts them
             return {}, {}, shipped
+        # replicated shards spanning hosts: routing writes the primary
+        # placement only, so a replica on another host would silently
+        # diverge — fail closed, like the reference-table guard above
+        # (the reference replicates these writes under 2PC to every
+        # placement; multi_copy.c per-placement streams)
+        if any(len(s.placements) > 1
+               and any(self.catalog.is_remote_node(nd)
+                       for nd in s.placements)
+               for s in t.shards):
+            raise UnsupportedFeatureError(
+                "writing to a distributed table whose replicated shard "
+                "placements span hosts is not supported yet (only one "
+                "placement would receive the rows, diverging replicas)")
         owners = [t.shards[si].placements[0] for si in range(t.shard_count)]
         if not any(self.catalog.is_remote_node(o) for o in owners):
             return values, validity, 0

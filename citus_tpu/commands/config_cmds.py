@@ -17,6 +17,14 @@ from citus_tpu.planner import ast as A
 from citus_tpu.cluster import _eval_const, _expand_returning_items  # noqa: E402
 
 
+def _remote_task_mode(v) -> str:
+    """citus.remote_task_execution = push | pull | auto."""
+    s = str(v).lower()
+    if s not in ("push", "pull", "auto"):
+        raise ValueError(s)
+    return s
+
+
 def _compute_ndistinct(cl, table: str, columns: list) -> int:
     """count(DISTINCT (cols)) — the extended-statistics ndistinct."""
     sel = A.Select(
@@ -34,6 +42,7 @@ _GUCS = {
     "citus.max_shared_pool_size": ("executor", "max_shared_pool_size", int),
     "citus.max_adaptive_executor_pool_size": ("executor", "max_tasks_in_flight", int),
     "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
+    "citus.remote_task_execution": ("executor", "remote_task_execution", _remote_task_mode),
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
     "citus.shard_count": ("sharding", "shard_count", int),
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),

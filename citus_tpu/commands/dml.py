@@ -89,8 +89,19 @@ def _forward_remote_dml(cl, stmt, t, where):
                 "is not supported yet")
         return None
     from citus_tpu.planner.physical import prune_shards
-    owners = {t.shards[si].placements[0]
-              for si in prune_shards(t, where)}
+    surviving = prune_shards(t, where)
+    # replicated shards spanning hosts: the modify would run against
+    # one placement only, silently diverging the replica on the other
+    # host — fail closed, mirroring the reference-table guard above
+    if any(len(t.shards[si].placements) > 1
+           and any(cl.catalog.is_remote_node(nd)
+                   for nd in t.shards[si].placements)
+           for si in surviving):
+        raise UnsupportedFeatureError(
+            "modifying a distributed table whose replicated shard "
+            "placements span hosts is not supported yet (only one "
+            "placement would see the modify, diverging replicas)")
+    owners = {t.shards[si].placements[0] for si in surviving}
     remote = {o for o in owners if cl.catalog.is_remote_node(o)}
     if not remote:
         return None
@@ -195,12 +206,25 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
         # that expires concurrently agrees; then best-effort decides.
         # Returns the REGISTER's winner: 'commit' means our own commit
         # record already landed (response lost) and the caller must
-        # complete the commit instead.
-        winner = None
+        # complete the commit instead; 'in-doubt' means the claim never
+        # reached the register (authority unreachable) — a prepared
+        # branch must then be LEFT ALONE: deciding abort on it without
+        # a durable claim could diverge from a commit record that did
+        # (or will) land, so prepared branches resolve against the
+        # outcome register instead (absent record = presumed abort).
         try:
             winner = cl._control.record_txn_outcome(gxid, "abort")
         except Exception:
-            pass  # absent outcome = presumed abort via branch claims
+            # the abort claim is NOT durable; only a local branch that
+            # never prepared is unambiguous and safe to roll back
+            if local_session is not None \
+                    and local_session.txn is not None \
+                    and not local_prepared:
+                try:
+                    cl._rollback_txn(local_session)
+                except Exception:
+                    pass
+            return "in-doubt"
         if winner == "commit":
             return "commit"
         for ep in prepared:
@@ -221,6 +245,7 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
                     cl._rollback_txn(local_session)
             except Exception:
                 pass
+        return "abort"
 
 
     def _complete_commit() -> None:
@@ -276,13 +301,21 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
             raise ExecutionError(
                 "cross-host transaction aborted by a participant "
                 "(branch timed out before the commit decision)")
-    except BaseException:
-        if _abort_everything() == "commit":
+    except BaseException as exc:
+        outcome = _abort_everything()
+        if outcome == "commit":
             # our commit record already landed (response lost): the
             # transaction IS committed — complete it, don't diverge
             _complete_commit()
             counts["gxid"] = gxid
             return Result(columns=[], rows=[], explain=counts)
+        if outcome == "in-doubt":
+            from citus_tpu.errors import TransactionError
+            raise TransactionError(
+                f"cross-host transaction {gxid} is in doubt: the abort "
+                f"decision could not be durably recorded (metadata "
+                f"authority unreachable); prepared branches are left to "
+                f"resolve against the outcome register") from exc
         raise
     _complete_commit()
     counts["gxid"] = gxid

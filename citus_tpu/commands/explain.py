@@ -161,6 +161,13 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
             si, nrows, dt = tasks[0]
             lines.append(f"    -> Task (shard index {si}): {nrows} rows, "
                          f"{dt*1000:.2f} ms device dispatch")
+        rtasks = r.explain.get("remote_tasks") or []
+        if rtasks:
+            lines.append(f"  Remote Tasks: {len(rtasks)}")
+            for si, node, nbytes, dt in rtasks:
+                lines.append(f"    -> Task (shard index {si}): pushed to "
+                             f"node {node}, {nbytes} result bytes, "
+                             f"{dt*1000:.2f} ms")
     return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
 
 def _explain_join(cl, stmt: A.Explain) -> Result:

@@ -107,6 +107,18 @@ def create_table(cl, stmt):
                     raise UnsupportedFeatureError(
                         "unique constraint on partitioned table "
                         "must include the partition column")
+    if serial_seqs and not pre_existing:
+        # a serial column's implicit sequence must not clobber a
+        # pre-existing same-named sequence (PostgreSQL errors with
+        # 'relation already exists'); the one exception is a leftover
+        # OWNED by an earlier incarnation of this same table, which a
+        # DROP TABLE crash could strand — that one restarts below.
+        # Validated BEFORE the table commits: all-or-nothing.
+        for seq in serial_seqs:
+            existing = cl.catalog.sequences.get(seq)
+            if existing is not None \
+                    and existing.get("owner") != stmt.name:
+                raise CatalogError(f'relation "{seq}" already exists')
     if stmt.checks and not pre_existing:
         # pre-validate CHECK expressions BEFORE the table commits
         # (CREATE TABLE is all-or-nothing, like the index/partition
@@ -162,12 +174,16 @@ def create_table(cl, stmt):
     if serial_seqs and not pre_existing \
             and cl.catalog.has_table(stmt.name):
         # owned sequences exist only once the table does; a stale
-        # same-named sequence from an earlier incarnation restarts
-        # (PostgreSQL drops owned sequences with their table)
+        # same-owner sequence from an earlier incarnation restarts
+        # (PostgreSQL drops owned sequences with their table) —
+        # foreign sequences were rejected before the table committed
         for seq in serial_seqs:
             if seq in cl.catalog.sequences:
                 cl.catalog.drop_sequence(seq)
             cl.catalog.create_sequence(seq, 1, 1)
+            # ownership tag: lets the pre-validation above tell a
+            # restartable leftover from somebody else's sequence
+            cl.catalog.sequences[seq]["owner"] = stmt.name
         cl.catalog.commit()
     return Result(columns=[], rows=[])
 
@@ -239,6 +255,7 @@ def alter_table(cl, stmt):
     if stmt.action == "add_check":
         from citus_tpu.planner.bind import Binder
         from citus_tpu.planner.parser import Parser
+        from citus_tpu.transaction.locks import EXCLUSIVE
         t0 = cl.catalog.table(stmt.table)
         bound = Binder(cl.catalog, t0).bind_scalar(
             Parser(stmt.check_sql).parse_expr())
@@ -246,23 +263,31 @@ def alter_table(cl, stmt):
             raise AnalysisError(
                 f"CHECK constraint must be boolean: ({stmt.check_sql})")
         # PostgreSQL validates existing rows at ADD time: any row where
-        # the expression is FALSE (NULL passes) rejects the DDL
-        r = cl._execute_stmt(A.Select(
-            [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
-            A.TableRef(stmt.table),
-            A.UnOp("not", Parser(stmt.check_sql).parse_expr())))
-        if r.rows and r.rows[0][0]:
-            raise AnalysisError(
-                f'check constraint of relation "{stmt.table}" is '
-                f"violated by {r.rows[0][0]} existing row(s)")
-        ck_name = stmt.new_name or \
-            f"{stmt.table}_check{len(t0.check_constraints) + 1}"
-        if any(c["name"] == ck_name for c in t0.check_constraints):
-            raise CatalogError(
-                f'constraint "{ck_name}" already exists')
-        t0.check_constraints.append({"name": ck_name,
-                                     "sql": stmt.check_sql})
-        cl.catalog.commit()
+        # the expression is FALSE (NULL passes) rejects the DDL.  The
+        # validation scan and the catalog commit hold the colocation
+        # group's EXCLUSIVE write lock as ONE critical section — a
+        # writer landing between them could commit a violating row the
+        # scan never saw (PostgreSQL holds AccessExclusiveLock across
+        # ADD CONSTRAINT's validation for the same reason); reads are
+        # snapshot-based and never block behind this lock
+        with cl._write_lock(t0, EXCLUSIVE):
+            t0 = cl.catalog.table(stmt.table)  # re-fetch under lock
+            r = cl._execute_stmt(A.Select(
+                [A.SelectItem(A.FuncCall("count", (A.Star(),)))],
+                A.TableRef(stmt.table),
+                A.UnOp("not", Parser(stmt.check_sql).parse_expr())))
+            if r.rows and r.rows[0][0]:
+                raise AnalysisError(
+                    f'check constraint of relation "{stmt.table}" is '
+                    f"violated by {r.rows[0][0]} existing row(s)")
+            ck_name = stmt.new_name or \
+                f"{stmt.table}_check{len(t0.check_constraints) + 1}"
+            if any(c["name"] == ck_name for c in t0.check_constraints):
+                raise CatalogError(
+                    f'constraint "{ck_name}" already exists')
+            t0.check_constraints.append({"name": ck_name,
+                                         "sql": stmt.check_sql})
+            cl.catalog.commit()
         cl._plan_cache.clear()
         return Result(columns=[], rows=[])
     if stmt.action == "add_column":

@@ -82,6 +82,14 @@ class ExecutorSettings:
     # erroring (analog of lock_timeout; deadlocks are detected and
     # cancelled immediately regardless).
     lock_timeout_s: float = 30.0
+    # Routing for SELECTs over placements hosted by another
+    # coordinator: "push" executes the worker half of the plan on the
+    # owning host and ships only partial-agg/result rows
+    # (executor/worker_tasks.py; the reference's task-push model,
+    # worker_sql_task_protocol.c), "pull" mirrors placement files here
+    # first (sync_placement), "auto" pushes whenever the task codec can
+    # express the plan and falls back to pull otherwise.
+    remote_task_execution: str = "auto"
 
 
 @dataclass

@@ -38,6 +38,19 @@ def bucket_rows(n: int, min_rows: int) -> int:
     return b
 
 
+def _pull_placement_fallback(cat: Catalog, table: TableMeta, shard,
+                             node: int) -> Optional[str]:
+    """PULL path: mirror a remote placement's files into the local
+    cache and scan them here — O(placement bytes) over DCN (reference:
+    shard reads over libpq, executor/transmit.c).  This is the
+    executor's ONLY sync_placement call site; the preferred PUSH path
+    (executor/worker_tasks.py) ships the worker plan to the owning
+    coordinator instead and only lands here on fallback, per the
+    citus.remote_task_execution policy."""
+    return cat.remote_data.sync_placement(
+        table.name, shard.shard_id, node, cat.node_endpoint(node))
+
+
 def load_shard_batches(
     cat: Catalog, plan: PhysicalPlan, shard_index: int, *,
     min_batch_rows: int = 8192, max_batch_rows: int = 1 << 22,
@@ -73,14 +86,7 @@ def load_shard_batches(
             FAULTS.hit("read_placement", f"{table.name}:{shard.shard_id}:{node}")
             if not os.path.isdir(d) and cat.is_remote_node(node) \
                     and cat.remote_data is not None:
-                # the placement lives on another coordinator: mirror it
-                # over the data plane into the local cache and read that
-                # (reference: task results / shard reads over libpq,
-                # worker_sql_task_protocol.c; here whole-chunk columnar
-                # batches, fetched once per immutable stripe)
-                rd = cat.remote_data.sync_placement(
-                    table.name, shard.shard_id, node,
-                    cat.node_endpoint(node))
+                rd = _pull_placement_fallback(cat, table, shard, node)
                 if rd is not None:
                     d = rd
             if not os.path.isdir(d):

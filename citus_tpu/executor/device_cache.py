@@ -3,8 +3,11 @@
 The reference keeps hot table blocks in PostgreSQL shared buffers; the
 TPU-native analog is keeping decompressed, padded column batches resident
 in device HBM across queries.  Entries are keyed by
-(table, table.version, shard, projected columns, pruning signature,
-bucket) — any ingest/DDL bumps the version and naturally invalidates.
+(table, table.version, snapshot flip generation, shard, projected
+columns, pruning signature, bucket) — any ingest/DDL bumps the version
+and naturally invalidates, and the generation keys out the two windows
+version alone misses (the version is committed before the stripe flip,
+and a torn scan's put must not satisfy the seqlock retry after it).
 
 A simple byte-bounded LRU keeps us inside HBM (v5e ~16 GB); eviction
 drops the device reference and lets JAX free the buffers.
@@ -61,5 +64,15 @@ def plan_cache_key(plan, data_dir: str) -> tuple:
     # the data_dir) uniquely identify the relation incarnation — a dropped
     # and recreated table can never alias a cache entry
     shard_ids = tuple(t.shards[i].shard_id for i in plan.shard_indexes)
-    return (data_dir, t.name, t.version, tuple(plan.scan_columns),
+    # the snapshot flip generation is part of the key, not just
+    # table.version: writers commit the version bump BEFORE flipping
+    # stripes live, and a torn scan's put must not be served to the
+    # seqlock retry that follows it.  Generations are strictly
+    # monotonic, so an entry keyed at gen g can only ever be read by
+    # an attempt that also validates at gen g — which proves no flip
+    # overlapped the span from this key computation to that
+    # validation, i.e. the cached scan was consistent.
+    from citus_tpu.transaction.snapshot import read_generation
+    gen, _busy = read_generation(data_dir, t)
+    return (data_dir, t.name, t.version, gen, tuple(plan.scan_columns),
             shard_ids, intervals)

@@ -435,8 +435,21 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     mode = plan.group_mode.kind
     penv = _params_env(params)
     if mode in ("scalar", "direct"):
+        # push the worker half to coordinators OWNING remote-only
+        # placements (ship partial-agg states, not stripe files); the
+        # local run covers the remaining shards and any push fallbacks
+        from citus_tpu.executor.worker_tasks import push_remote_tasks
+        local, remote_partials = push_remote_tasks(cat, plan, settings,
+                                                   params)
+        run_plan = plan
+        if local != plan.shard_indexes:
+            import dataclasses
+            run_plan = dataclasses.replace(plan, shard_indexes=local)
         partials = (_run_partials_cpu if backend == "cpu" else _run_partials_jax)(
-            cat, plan, settings, params)
+            cat, run_plan, settings, params)
+        if remote_partials:
+            partials = combine_partials_host(
+                plan, [partials, *remote_partials])
         if mode == "scalar":
             # one group: scalars become length-1 arrays; vector partials
             # (HLL registers) gain a leading group axis
@@ -467,7 +480,11 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     (ops/hash_agg.py) with exact host merge of the per-shard tables and
     host handling of spilled rows.  cpu backend: full host grouping."""
     from citus_tpu.executor.host_agg import HostGroupAccumulator
+    from citus_tpu.executor.worker_tasks import note_inexpressible
 
+    # hash_host partials (per-shard hash tables / exact value sets) are
+    # not elementwise-combinable — remote-only shards take the pull path
+    note_inexpressible(cat, plan, settings)
     backend = settings.executor.task_executor_backend
     acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
     pcols, pvalids = params
@@ -612,8 +629,28 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             filter_fn = jax.jit(device_mask)
             plan.runtime_cache["jit_filter"] = filter_fn
 
+    # remote-only placements execute scan+filter where the data lives
+    # and return already-compacted rows; local shards stream below
+    from citus_tpu.executor.worker_tasks import push_remote_tasks
+    local, remote_batches = push_remote_tasks(cat, plan, settings, params)
+    run_plan = plan
+    if local != plan.shard_indexes:
+        import dataclasses
+        run_plan = dataclasses.replace(plan, shard_indexes=local)
     env_batches = []
-    for si in plan.shard_indexes:
+    for values, validity in remote_batches:
+        if not plan.scan_columns:
+            continue
+        n = len(values[plan.scan_columns[0]])
+        if n == 0:
+            continue
+        env = {c: (values[c].astype(
+                       plan.bound.table.schema.column(c).type.device_dtype,
+                       copy=False),
+                   validity[c]) for c in plan.scan_columns}
+        env.update(penv)
+        env_batches.append((env, np.ones(n, bool)))
+    for si in run_plan.shard_indexes:
         for values, masks, n in load_shard_batches(
                 cat, plan, si, min_batch_rows=1):
             cols = tuple(values[c].astype(plan.bound.table.schema.column(c).type.device_dtype,
@@ -738,6 +775,7 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
             "intervals": [c.column for c in plan.intervals],
             "elapsed_s": elapsed,
             "tasks": plan.runtime_cache.get("task_times", []),
+            "remote_tasks": plan.runtime_cache.get("remote_tasks", []),
             "router_key": plan.router_key,
         },
     )

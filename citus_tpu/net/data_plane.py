@@ -94,6 +94,7 @@ class DataPlaneServer:
         s.register("ingest_batch", self._on_ingest_batch)
         s.register("drop_placement", self._on_drop_placement)
         s.register("execute_sql", self._on_execute_sql)
+        s.register("execute_task", self._on_execute_task)
         s.register("dml_prepare", self._on_dml_prepare)
         s.register("dml_decide", self._on_dml_decide)
         s.register("txn_stmt", self._on_txn_stmt)
@@ -188,6 +189,22 @@ class DataPlaneServer:
                 "explain": {k: v for k, v in (r.explain or {}).items()
                             if isinstance(v, (int, float, str))}}
 
+    def _on_execute_task(self, p: dict) -> tuple[dict, bytes]:
+        """Run the worker half of a pushed SELECT against a placement
+        this coordinator hosts and return the encoded partial states as
+        one binary frame (reference: worker_sql_task_protocol.c — the
+        task travels as a serialized plan fragment rather than SQL
+        text, and results come back as one frame instead of a COPY
+        stream).  See executor/worker_tasks.py for the codec."""
+        from citus_tpu.executor.worker_tasks import run_worker_task
+        guard = self.cluster._remote_exec_guard
+        prev = getattr(guard, "v", False)
+        guard.v = True  # a pushed task must never push again
+        try:
+            return run_worker_task(self.cluster, p)
+        finally:
+            guard.v = prev
+
     #: a branch with no phase-2 decision resolves itself after this
     #: long (via the authority's outcome store; presumed abort)
     BRANCH_EXPIRE_S = 120.0
@@ -246,10 +263,19 @@ class DataPlaneServer:
         if entry is None:
             s = self.cluster.session()
             s.execute("BEGIN")
-            entry = {"s": s, "born": _time.monotonic(), "prepared": False,
-                     "mu": threading.Lock()}
+            ours = {"s": s, "born": _time.monotonic(), "prepared": False,
+                    "mu": threading.Lock()}
+            # insert atomically: two first statements of the same gxid
+            # racing here must converge on ONE branch session — the
+            # loser rolls its session back instead of leaking an open
+            # transaction (whose locks would block until process exit)
             with self._branches_mu:
-                self._branches[gxid] = entry
+                entry = self._branches.setdefault(gxid, ours)
+            if entry is not ours:
+                try:
+                    s.execute("ROLLBACK")
+                except Exception:
+                    pass
         with entry["mu"]:
             # re-check under the entry lock: the expiry duty resolves
             # branches under the same lock, so a statement can never
@@ -463,6 +489,7 @@ class DataPlaneClient:
         if not r.get("exists"):
             return None
         self.stats["remote_syncs"] += 1
+        bytes_before = self.stats["bytes_fetched"]
         d = self.cache_dir(table, shard_id, node)
         os.makedirs(d, exist_ok=True)
         sig_path = os.path.join(d, ".sync.json")
@@ -499,6 +526,9 @@ class DataPlaneClient:
         with open(sig_path + ".tmp", "w") as fh:
             json.dump(sigs, fh)
         os.replace(sig_path + ".tmp", sig_path)
+        from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        GLOBAL_COUNTERS.bump("placement_sync_bytes",
+                             self.stats["bytes_fetched"] - bytes_before)
         return d
 
     # ---- transfer helpers (shard move) ---------------------------------

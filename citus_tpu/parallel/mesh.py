@@ -30,6 +30,18 @@ def shard_axis_size(mesh: Mesh) -> int:
     return mesh.shape[SHARD_AXIS]
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: older releases expose it as
+    jax.experimental.shard_map with the replication check named
+    check_rep instead of check_vma."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def sharded_partial_agg(worker, combine_kinds: list[str], mesh: Mesh) -> Callable:
     """Wrap a worker fn (cols, valids, row_mask) -> partial tuple into a
     shard_map'd program over stacked inputs [n_dev, N]:
@@ -71,8 +83,8 @@ def sharded_partial_agg(worker, combine_kinds: list[str], mesh: Mesh) -> Callabl
             P(SHARD_AXIS) if kind == "none" else P()
             for kind in combine_kinds
         )
-        fn = jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False)
+        fn = shard_map_compat(per_shard, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
         return fn(cols, valids, row_mask)
 
     return run

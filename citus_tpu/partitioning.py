@@ -217,7 +217,8 @@ def _parse_ts(v) -> datetime.datetime:
         return v
     if isinstance(v, datetime.date):
         return datetime.datetime(v.year, v.month, v.day)
-    return datetime.datetime.fromisoformat(str(v))
+    from citus_tpu.types import parse_datetime
+    return parse_datetime(str(v))
 
 
 def _advance(t: datetime.datetime, interval):

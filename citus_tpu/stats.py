@@ -33,6 +33,13 @@ class StatCounters:
         "plan_cache_hits",
         "plan_cache_misses",
         "connection_failovers",
+        # remote SELECT task push (executor/worker_tasks.py) vs the
+        # sync_placement pull path: result bytes shipped per pushed
+        # task against stripe bytes mirrored per pulled placement
+        "remote_tasks_pushed",
+        "remote_task_fallbacks",
+        "remote_task_result_bytes",
+        "placement_sync_bytes",
     ]
 
     def __init__(self):

@@ -58,7 +58,6 @@ def compress(data: bytes, codec: str, level: int = 3) -> bytes:
         return data
     lib, ids = _native()
     if lib is not None and codec in ids:
-        import ctypes
         import numpy as np
         cid = ids[codec]
         bound = lib.ct_compress_bound(cid, len(data))
@@ -70,8 +69,13 @@ def compress(data: bytes, codec: str, level: int = 3) -> bytes:
         if n > 0:
             return out[:n].tobytes()
     if codec == CODEC_ZSTD:
-        if _zstd is None:  # pragma: no cover
-            raise StorageError("zstandard module not available")
+        if _zstd is None:
+            # no python-zstandard and no native backend: degrade to the
+            # stdlib codec instead of making every write path unusable.
+            # decompress() mirrors the fallback, so files written in
+            # this environment round-trip; genuine zstd bytes from
+            # elsewhere still fail cleanly there.
+            return zlib.compress(data, min(level, 9))
         return _zstd.ZstdCompressor(level=level).compress(data)
     if codec == CODEC_ZLIB:
         return zlib.compress(data, min(level, 9))
@@ -91,7 +95,6 @@ def decompress(data: bytes, codec: str, raw_size: int) -> bytes:
         return data
     lib, ids = _native()
     if lib is not None and codec in ids:
-        import ctypes
         import numpy as np
         out = np.empty(raw_size, np.uint8)
         src = np.frombuffer(data, np.uint8)
@@ -104,8 +107,15 @@ def decompress(data: bytes, codec: str, raw_size: int) -> bytes:
         if n >= 0:
             return out[:n].tobytes()
     if codec == CODEC_ZSTD:
-        if _zstd is None:  # pragma: no cover
-            raise StorageError("zstandard module not available")
+        if _zstd is None:
+            try:
+                # mirror of the compress() fallback: zstd-labelled data
+                # written without a zstd backend is zlib bytes
+                return zlib.decompress(data)
+            except zlib.error as e:
+                raise StorageError(
+                    "zstd-compressed data but no zstd backend available "
+                    "(install zstandard or build the native codec)") from e
         return _zstd.ZstdDecompressor().decompress(data, max_output_size=raw_size)
     if codec == CODEC_ZLIB:
         return zlib.decompress(data)

@@ -112,12 +112,33 @@ def commit_txn_cross_host(cl, session) -> None:
             raise TransactionError(
                 "cross-host transaction aborted by a participant "
                 "(branch timed out before the commit decision)")
-    except BaseException:
-        winner = None
+    except BaseException as exc:
         try:
             winner = cl._control.record_txn_outcome(gxid, "abort")
         except Exception:
-            pass
+            # the abort claim never reached the register (authority
+            # unreachable): the outcome is IN DOUBT.  A commit record
+            # may have landed unseen (our record_txn_outcome response
+            # lost) — sending txn_branch_abort to already-PREPARED
+            # branches here could diverge from that committed outcome.
+            # Leave every prepared branch to resolve against the
+            # register (absent record = presumed abort on expiry); only
+            # an un-prepared local txn is unambiguous to roll back.
+            if session.txn is not None and not local_prepared:
+                try:
+                    txn.remote_endpoints = set()  # branches stay put
+                    cl._rollback_txn(session)
+                except Exception:
+                    pass
+            elif local_prepared:
+                # detach: the prepared local branch outlives the
+                # session and resolves with the others
+                session.txn = None
+            raise TransactionError(
+                f"cross-host transaction {gxid} is in doubt: the abort "
+                f"decision could not be durably recorded (metadata "
+                f"authority unreachable); prepared branches are left "
+                f"to resolve against the outcome register") from exc
         if winner == "commit":
             # our own commit record already landed (its RPC response
             # was lost): the transaction IS durably committed —

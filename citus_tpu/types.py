@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import datetime
 import decimal
+import re
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -63,6 +64,36 @@ BYTEA = "bytea"
 ARRAY = "array"
 
 _EPOCH_DATE = datetime.date(1970, 1, 1)
+
+_TZ_SUFFIX = re.compile(r"([+-]\d{2})(?::?(\d{2}))?$")
+
+
+def _iso_compat(s: str) -> str:
+    """Normalize ISO timestamp/time strings for fromisoformat on
+    Python < 3.11, which rejects 'Z', bare '+HH'/'+HHMM' offsets
+    (e.g. '2024-01-02 00:00:00+00' as PostgreSQL emits), and
+    fractional seconds that are not exactly 3 or 6 digits."""
+    if s.endswith(("Z", "z")):
+        s = s[:-1] + "+00:00"
+    # a trailing [+-]HH only means a zone offset after a time component;
+    # without the colon guard a bare date's '-DD' would match
+    off = ""
+    m = _TZ_SUFFIX.search(s)
+    if m and ":" in s[:m.start()]:
+        off = m.group(1) + ":" + (m.group(2) or "00")
+        s = s[:m.start()]
+    fm = re.search(r"\.(\d{1,6})$", s)
+    if fm and len(fm.group(1)) not in (3, 6):
+        s = s[:fm.start(1)] + fm.group(1).ljust(6, "0")
+    return s + off
+
+
+def parse_datetime(s: str) -> datetime.datetime:
+    return datetime.datetime.fromisoformat(_iso_compat(s))
+
+
+def parse_time(s: str) -> datetime.time:
+    return datetime.time.fromisoformat(_iso_compat(s))
 
 _STORAGE_DTYPES = {
     BOOL: np.int8,
@@ -242,18 +273,22 @@ class ColumnType:
             q = d.scaleb(self.scale).to_integral_value(rounding=decimal.ROUND_HALF_UP)
             return int(q)
         if k == DATE:
+            if isinstance(value, (int, np.integer)):
+                # already-physical (days since epoch), matching the
+                # numeric ndarray fast path in ingest.encode_columns
+                return int(value)
             if isinstance(value, str):
                 value = datetime.date.fromisoformat(value)
             return (value - _EPOCH_DATE).days
         if k == TIMESTAMP:
             if isinstance(value, str):
-                value = datetime.datetime.fromisoformat(value)
+                value = parse_datetime(value)
             # integer arithmetic: float .timestamp() loses sub-us precision
             delta = value.replace(tzinfo=None) - datetime.datetime(1970, 1, 1)
             return delta // datetime.timedelta(microseconds=1)
         if k == TIMESTAMPTZ:
             if isinstance(value, str):
-                value = datetime.datetime.fromisoformat(value)
+                value = parse_datetime(value)
             if value.tzinfo is None:
                 # PostgreSQL interprets a naive input in the session
                 # TimeZone; ours is pinned to UTC
@@ -263,7 +298,7 @@ class ColumnType:
             return delta // datetime.timedelta(microseconds=1)
         if k == TIME:
             if isinstance(value, str):
-                value = datetime.time.fromisoformat(value)
+                value = parse_time(value)
             if isinstance(value, datetime.datetime):
                 value = value.time()
             return (value.hour * 3_600_000_000

@@ -29,6 +29,19 @@ def test_no_todo_markers():
     assert not hits, hits
 
 
+def test_executor_pull_path_has_single_call_site():
+    """The executor reaches sync_placement (the O(placement-bytes) pull
+    path) through exactly ONE helper — batches._pull_placement_fallback.
+    The aggregate/projection paths (executor.py) and the push subsystem
+    (worker_tasks.py) must ship tasks, never placement files."""
+    hits = {}
+    for p in (PKG / "executor").glob("*.py"):
+        n = p.read_text().count("sync_placement(")
+        if n:
+            hits[p.name] = n
+    assert hits == {"batches.py": 1}, hits
+
+
 def test_agg_registry_complete():
     """Every registered aggregate declares lower+finalize (bind may be
     None only for internal kinds the binder dispatches itself)."""

@@ -179,7 +179,13 @@ def test_alter_table_add_drop_rename(db):
     check(db, "SELECT count(bonus) FROM t")
     # drop
     cl.execute("ALTER TABLE t DROP COLUMN bonus")
-    sq.execute("ALTER TABLE t DROP COLUMN bonus")
+    import sqlite3 as _sq3
+    if _sq3.sqlite_version_info >= (3, 35):
+        sq.execute("ALTER TABLE t DROP COLUMN bonus")
+    else:  # old sqlite: emulate via rebuild
+        sq.execute("CREATE TABLE t_new AS SELECT k, v, s FROM t")
+        sq.execute("DROP TABLE t")
+        sq.execute("ALTER TABLE t_new RENAME TO t")
     from citus_tpu.errors import AnalysisError
     with pytest.raises(AnalysisError):
         cl.execute("SELECT bonus FROM t")

@@ -407,3 +407,27 @@ def test_concurrent_vacuum_and_update(db):
 
     _run_all([reader, updater, vacuumer])
     assert all(c == 20_000 for c in results), results[:5]
+
+
+def test_device_cache_key_includes_flip_generation(db):
+    """The HBM cache must key on the snapshot flip generation, not just
+    table.version: writers commit the version bump BEFORE flipping
+    stripes live, so a scan in that window (or a torn scan whose put
+    survives the seqlock retry) would otherwise poison the cache under
+    the new version and serve stale counts forever after."""
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    from citus_tpu.transaction.snapshot import flip_generation
+
+    cl = db
+    GLOBAL_CACHE.clear()
+    assert cl.execute("SELECT count(*) FROM t").rows == [(20_000,)]
+    h0 = GLOBAL_CACHE.hits
+    assert cl.execute("SELECT count(*) FROM t").rows == [(20_000,)]
+    assert GLOBAL_CACHE.hits == h0 + 1  # quiescent repeat: same key
+    # a completed flip bumps the generation; the old entry must be
+    # unreachable even though table.version did not change
+    with flip_generation(cl.catalog.data_dir, cl.catalog.table("t")):
+        pass
+    h1 = GLOBAL_CACHE.hits
+    assert cl.execute("SELECT count(*) FROM t").rows == [(20_000,)]
+    assert GLOBAL_CACHE.hits == h1  # new generation: fresh entry

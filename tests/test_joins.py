@@ -112,8 +112,18 @@ def test_full_outer_join(db):
     sql = ("SELECT count(*) FROM orders o FULL OUTER JOIN lineitem l "
            "ON o.o_orderkey = l.l_orderkey")
     ours = cl.execute(sql).rows
-    # sqlite supports FULL OUTER JOIN since 3.39
-    theirs = sq.execute(sql).fetchall()
+    import sqlite3 as _sq3
+    if _sq3.sqlite_version_info >= (3, 39):
+        theirs = sq.execute(sql).fetchall()
+    else:  # old sqlite: FULL OUTER = left join + unmatched right rows
+        left = sq.execute(
+            "SELECT count(*) FROM orders o LEFT JOIN lineitem l "
+            "ON o.o_orderkey = l.l_orderkey").fetchall()[0][0]
+        anti = sq.execute(
+            "SELECT count(*) FROM lineitem l WHERE NOT EXISTS "
+            "(SELECT 1 FROM orders o WHERE o.o_orderkey = l.l_orderkey)"
+        ).fetchall()[0][0]
+        theirs = [(left + anti,)]
     assert canon(ours) == canon(theirs)
 
 
@@ -121,7 +131,12 @@ def test_right_join(db):
     cl, sq = db
     sql = ("SELECT count(*) FROM lineitem l RIGHT JOIN orders o "
            "ON o.o_orderkey = l.l_orderkey")
-    assert canon(cl.execute(sql).rows) == canon(sq.execute(sql).fetchall())
+    import sqlite3 as _sq3
+    oracle_sql = sql if _sq3.sqlite_version_info >= (3, 39) else (
+        # old sqlite: a RIGHT JOIN is the swapped LEFT JOIN
+        "SELECT count(*) FROM orders o LEFT JOIN lineitem l "
+        "ON o.o_orderkey = l.l_orderkey")
+    assert canon(cl.execute(sql).rows) == canon(sq.execute(oracle_sql).fetchall())
 
 
 def test_qualified_star_and_ambiguity(db):

@@ -41,7 +41,39 @@ def loaded(tmp_path_factory):
     sq = sqlite3.connect(":memory:")
     sq.execute("CREATE TABLE m (id INTEGER, n INTEGER, q REAL, x REAL, s TEXT)")
     sq.executemany("INSERT INTO m VALUES (?,?,?,?,?)", rows)
+    _ensure_math_funcs(sq)
     return cl, sq
+
+
+def _ensure_math_funcs(sq):
+    """Older sqlite builds lack SQLITE_ENABLE_MATH_FUNCTIONS; register
+    equivalents so the oracle still answers (NULL on NULL input or
+    domain error, matching sqlite's native behavior)."""
+    try:
+        sq.execute("SELECT floor(1.5)")
+        return
+    except sqlite3.OperationalError:
+        pass
+    import math
+
+    def _f(fn):
+        def g(*a):
+            if any(v is None for v in a):
+                return None
+            try:
+                return fn(*a)
+            except ValueError:
+                return None
+        return g
+
+    for name, nargs, fn in [
+        ("floor", 1, math.floor), ("ceil", 1, math.ceil),
+        ("sqrt", 1, math.sqrt), ("ln", 1, math.log),
+        ("exp", 1, math.exp), ("power", 2, math.pow),
+        ("mod", 2, math.fmod),
+        ("sign", 1, lambda v: (v > 0) - (v < 0)),
+    ]:
+        sq.create_function(name, nargs, _f(fn))
 
 
 QUERIES = [

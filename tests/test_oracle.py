@@ -159,12 +159,17 @@ def test_coalesce_nullif_group_ordinals(loaded):
 
 def test_having_without_group_by(loaded):
     cl, sq = loaded
-    for sql in [
-        "SELECT count(*) FROM events HAVING count(*) > 10",
-        "SELECT count(*) FROM events HAVING count(*) > 1000000",
+    import sqlite3 as _sq3
+    for sql, thresh in [
+        ("SELECT count(*) FROM events HAVING count(*) > 10", 10),
+        ("SELECT count(*) FROM events HAVING count(*) > 1000000", 1000000),
     ]:
         ours = cl.execute(sql).rows
-        theirs = sq.execute(sql).fetchall()
+        if _sq3.sqlite_version_info >= (3, 39):
+            theirs = sq.execute(sql).fetchall()
+        else:  # old sqlite rejects bare HAVING: apply the filter by hand
+            n = sq.execute("SELECT count(*) FROM events").fetchall()[0][0]
+            theirs = [(n,)] if n > thresh else []
         assert ours == [tuple(r) for r in theirs], sql
 
 

@@ -1,0 +1,385 @@
+"""Remote task-execution subsystem (executor/worker_tasks.py + the
+execute_task RPC): the worker half of a SELECT plan ships to the shard's
+owning host, runs through that host's own batch pipeline, and only
+partial-aggregate / result rows come back — versus the sync_placement
+pull path that mirrors whole placements over the wire.
+
+Reference: worker_sql_task_protocol.c (worker-side task execution) and
+the adaptive executor's task-push model (adaptive_executor.c).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import (
+    CatalogError, TransactionError, UnsupportedFeatureError,
+)
+from citus_tpu.executor.device_cache import GLOBAL_CACHE
+from citus_tpu.executor.executor import GLOBAL_COUNTERS
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    """Two coordinators, two data dirs, one logical cluster: A is the
+    metadata authority hosting node 0; B attaches and hosts node 1."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    na = a.register_node()
+    b = ct.Cluster(str(tmp_path / "b"), data_port=0, hosted_nodes=set(),
+                   coordinator=("127.0.0.1", a.control_port), n_nodes=0)
+    nb = b.register_node()
+    a._maybe_reload_catalog(force_sync=True)
+    yield a, b, na, nb
+    b.close()
+    a.close()
+
+
+def _load(a, n=20000):
+    a.execute("CREATE TABLE t (k bigint NOT NULL, v bigint, c text)")
+    a.execute("SELECT create_distributed_table('t', 'k', 4)")
+    a.copy_from("t", columns={
+        "k": np.arange(n), "v": np.arange(n) * 3,
+        "c": [f"w{i % 7}" for i in range(n)]})
+    GLOBAL_CACHE.clear()
+    GLOBAL_COUNTERS.reset()
+    return n
+
+
+def _remote_stripe_bytes(a, b, table="t"):
+    total = 0
+    t = a.catalog.table(table)
+    for s in t.shards:
+        nd = s.placements[0]
+        if not a.catalog.is_remote_node(nd):
+            continue
+        d = b.catalog.shard_dir(table, s.shard_id, nd)
+        if os.path.isdir(d):
+            for f in os.listdir(d):
+                total += os.path.getsize(os.path.join(d, f))
+    return total
+
+
+def test_push_aggregate_no_placement_sync(pair):
+    """The acceptance criterion: a cross-host aggregate executes via
+    execute_task push — zero sync_placement calls, result bytes an
+    order of magnitude under the stripe bytes pull would mirror."""
+    a, b, na, nb = pair
+    n = _load(a)
+    t = a.catalog.table("t")
+    assert {s.placements[0] for s in t.shards} == {na, nb}
+    r = a.execute("SELECT count(*), sum(v) FROM t")
+    assert r.rows == [(n, 3 * n * (n - 1) // 2)]
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["remote_tasks_pushed"] >= 1
+    assert snap["remote_task_fallbacks"] == 0
+    assert snap["placement_sync_bytes"] == 0
+    assert a.catalog.remote_data.stats["remote_syncs"] == 0
+    stripe_bytes = _remote_stripe_bytes(a, b)
+    assert stripe_bytes > 0
+    assert snap["remote_task_result_bytes"] * 10 <= stripe_bytes, \
+        (snap["remote_task_result_bytes"], stripe_bytes)
+
+
+def test_push_group_by_text(pair):
+    """GROUP BY over a text column pushes too: dictionary ids are
+    table-global (authority-mirrored), so worker partials combine."""
+    a, b, na, nb = pair
+    n = _load(a)
+    r = a.execute("SELECT c, count(*), sum(v) FROM t GROUP BY c ORDER BY c")
+    exp = {}
+    for i in range(n):
+        key = f"w{i % 7}"
+        cnt, sv = exp.get(key, (0, 0))
+        exp[key] = (cnt + 1, sv + 3 * i)
+    assert r.rows == [(k, c, s) for k, (c, s) in sorted(exp.items())]
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["remote_tasks_pushed"] >= 1
+    assert snap["placement_sync_bytes"] == 0
+
+
+def test_push_projection(pair):
+    """Filtered projections push: the owning host scans, filters, and
+    ships only surviving rows."""
+    a, b, na, nb = pair
+    _load(a)
+    r = a.execute("SELECT k, v FROM t WHERE k < 10 ORDER BY k")
+    assert r.rows == [(i, 3 * i) for i in range(10)]
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["remote_tasks_pushed"] >= 1
+    assert snap["placement_sync_bytes"] == 0
+
+
+def test_explain_analyze_shows_remote_tasks(pair):
+    a, b, na, nb = pair
+    _load(a)
+    r = a.execute("EXPLAIN ANALYZE SELECT count(*) FROM t")
+    txt = "\n".join(row[0] for row in r.rows)
+    assert "Remote Tasks:" in txt, txt
+    assert "pushed to node" in txt, txt
+
+
+def test_pull_policy_uses_sync_placement(pair):
+    """SET citus.remote_task_execution = pull disables push: the same
+    query mirrors placement files and still answers correctly."""
+    a, b, na, nb = pair
+    n = _load(a)
+    a.execute("SET citus.remote_task_execution = pull")
+    r = a.execute("SELECT count(*), sum(v) FROM t")
+    assert r.rows == [(n, 3 * n * (n - 1) // 2)]
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["remote_tasks_pushed"] == 0
+    assert snap["placement_sync_bytes"] > 0
+    assert a.catalog.remote_data.stats["remote_syncs"] >= 1
+
+
+def test_inexpressible_shape_falls_back(pair):
+    """count(DISTINCT ...) partials are not elementwise-combinable —
+    the task codec refuses, the fallback counter records it, and the
+    pull path answers correctly."""
+    a, b, na, nb = pair
+    _load(a)
+    r = a.execute("SELECT count(DISTINCT c) FROM t")
+    assert r.rows == [(7,)]
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["remote_task_fallbacks"] >= 1
+    assert snap["remote_tasks_pushed"] == 0
+
+
+def test_worker_sigkill_fails_over_cleanly(tmp_path):
+    """SIGKILL of the owning worker process: pushes fail over to the
+    pull path (fallback counter), the query surfaces a clean error for
+    the unreachable placements instead of hanging, and the coordinator
+    keeps answering queries that do not need the dead host."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    na = a.register_node()
+    worker = textwrap.dedent(f"""
+        import sys, time
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import citus_tpu as ct
+        b = ct.Cluster({str(tmp_path / 'b')!r}, data_port=0,
+                       hosted_nodes=set(), n_nodes=0,
+                       coordinator=("127.0.0.1", {a.control_port}))
+        nb = b.register_node()
+        print("READY", nb, flush=True)
+        sys.stdout.close()
+        time.sleep(120)
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", worker],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = proc.stdout.readline().split()
+        assert line and line[0] == "READY", f"worker failed: {line}"
+        nb = int(line[1])
+        a._maybe_reload_catalog(force_sync=True)
+        a.execute("CREATE TABLE big (k bigint NOT NULL, v bigint)")
+        a.execute("SELECT create_distributed_table('big', 'k', 4)")
+        a.execute("CREATE TABLE solo (k bigint NOT NULL, v bigint)")
+        a.copy_from("solo", columns={"k": np.arange(5),
+                                     "v": np.arange(5)})
+        n = 3000
+        a.copy_from("big", columns={"k": np.arange(n),
+                                    "v": np.arange(n)})
+        GLOBAL_CACHE.clear()
+        GLOBAL_COUNTERS.reset()
+        assert a.execute("SELECT count(*), sum(v) FROM big").rows == \
+            [(n, n * (n - 1) // 2)]
+        assert GLOBAL_COUNTERS.snapshot()["remote_tasks_pushed"] >= 1
+        proc.kill()
+        proc.wait()
+        GLOBAL_CACHE.clear()
+        fb0 = GLOBAL_COUNTERS.snapshot()["remote_task_fallbacks"]
+        with pytest.raises(Exception):
+            a.execute("SELECT count(*), sum(v) FROM big")
+        assert GLOBAL_COUNTERS.snapshot()["remote_task_fallbacks"] > fb0
+        # the cluster is not wedged: local-only tables still answer
+        assert a.execute("SELECT count(*), sum(v) FROM solo").rows == \
+            [(5, 10)]
+    finally:
+        proc.kill()
+        proc.wait()
+        a.close()
+
+
+# ---- satellite regressions ------------------------------------------------
+
+
+def test_2pc_abort_in_doubt_leaves_prepared_branches(pair):
+    """When the abort claim cannot reach the outcome register, already
+    PREPARED branches must NOT receive abort decides — they stay
+    prepared and resolve against the register; the statement surfaces
+    an in-doubt error (not a silent partial abort)."""
+    a, b, na, nb = pair
+    _load(a, n=2000)
+    orig = a._control.record_txn_outcome
+
+    def boom(gxid, outcome):
+        raise RuntimeError("authority unreachable")
+
+    a._control.record_txn_outcome = boom
+    try:
+        with pytest.raises(TransactionError, match="in doubt"):
+            a.execute("UPDATE t SET v = v + 1")
+        with b._data_server._branches_mu:
+            branches = {g: e["prepared"]
+                        for g, e in b._data_server._branches.items()}
+        assert branches, "remote branch must survive the in-doubt abort"
+        assert all(branches.values()), branches
+    finally:
+        a._control.record_txn_outcome = orig
+
+
+def test_interactive_txn_commit_in_doubt_leaves_prepared_branches(pair):
+    """Same property for BEGIN..COMMIT (transaction/branches.py): a
+    commit whose outcome record AND abort claim both fail leaves the
+    prepared remote branch untouched and raises in-doubt."""
+    a, b, na, nb = pair
+    _load(a, n=2000)
+    s = a.session()
+    s.execute("BEGIN")
+    s.execute("UPDATE t SET v = v + 1")
+    orig = a._control.record_txn_outcome
+
+    def boom(gxid, outcome):
+        raise RuntimeError("authority unreachable")
+
+    a._control.record_txn_outcome = boom
+    try:
+        with pytest.raises(TransactionError, match="in doubt"):
+            s.execute("COMMIT")
+        with b._data_server._branches_mu:
+            branches = {g: e["prepared"]
+                        for g, e in b._data_server._branches.items()}
+        assert branches and all(branches.values()), branches
+    finally:
+        a._control.record_txn_outcome = orig
+
+
+def test_replicated_cross_host_writes_fail_closed(pair):
+    """shard_replication_factor > 1 with placements spanning hosts:
+    ingest and modify statements refuse (only one placement would see
+    the write, silently diverging its replica); reads still work."""
+    a, b, na, nb = pair
+    a.execute("SET citus.shard_replication_factor = 2")
+    a.execute("CREATE TABLE r2 (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('r2', 'k', 4)")
+    t = a.catalog.table("r2")
+    assert any(len(s.placements) > 1 for s in t.shards)
+    with pytest.raises(UnsupportedFeatureError, match="span hosts"):
+        a.copy_from("r2", columns={"k": np.arange(10),
+                                   "v": np.arange(10)})
+    with pytest.raises(UnsupportedFeatureError, match="span hosts"):
+        a.execute("UPDATE r2 SET v = 1")
+    with pytest.raises(UnsupportedFeatureError, match="span hosts"):
+        a.execute("DELETE FROM r2")
+    assert a.execute("SELECT count(*) FROM r2").rows == [(0,)]
+
+
+def test_txn_stmt_branch_creation_race(pair):
+    """Concurrent first statements of the same gxid converge on ONE
+    branch session; the loser's session rolls back instead of leaking
+    an open transaction whose locks would wedge later writers."""
+    a, b, na, nb = pair
+    a.execute("CREATE TABLE rt (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('rt', 'k', 4)")
+    ep = a.catalog.node_endpoint(nb)
+    gxid = "race-gxid-1"
+    errs = []
+    barrier = threading.Barrier(4)
+
+    def stmt(i):
+        try:
+            barrier.wait(5)
+            a.catalog.remote_data.call(
+                ep, "txn_stmt",
+                {"gxid": gxid,
+                 "sql": f"INSERT INTO rt VALUES ({i}, {i})"})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=stmt, args=(i,)) for i in range(4)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join(30)
+    assert not errs, errs
+    with b._data_server._branches_mu:
+        assert list(b._data_server._branches) == [gxid]
+    a.catalog.remote_data.call(ep, "txn_branch_abort", {"gxid": gxid})
+    with b._data_server._branches_mu:
+        assert gxid not in b._data_server._branches
+    # no leaked open transaction: a later writer acquires the group
+    # lock immediately instead of waiting out a stranded session
+    a.copy_from("rt", columns={"k": np.arange(4), "v": np.arange(4)})
+    assert a.execute("SELECT count(*) FROM rt").rows == [(4,)]
+
+
+def test_add_check_takes_exclusive_write_lock(tmp_cluster):
+    """ALTER TABLE ADD CHECK holds the colocation group's EXCLUSIVE
+    write lock across validation scan + catalog commit — a concurrent
+    writer can no longer slip a violating row between the two."""
+    from citus_tpu.transaction.locks import EXCLUSIVE
+    cl = tmp_cluster
+    cl.execute("CREATE TABLE ck (k bigint NOT NULL, v bigint)")
+    cl.copy_from("ck", columns={"k": np.arange(10), "v": np.arange(10)})
+    cl.execute("SET lock_timeout = '400ms'")
+    t = cl.catalog.table("ck")
+    held, release = threading.Event(), threading.Event()
+
+    def holder():
+        with cl._write_lock(t, EXCLUSIVE):
+            held.set()
+            release.wait(15)
+
+    th = threading.Thread(target=holder)
+    th.start()
+    assert held.wait(10)
+    try:
+        with pytest.raises(Exception):
+            cl.execute("ALTER TABLE ck ADD CHECK (v >= 0)")
+        assert not cl.catalog.table("ck").check_constraints
+    finally:
+        release.set()
+        th.join(15)
+    cl.execute("ALTER TABLE ck ADD CHECK (v >= 0)")
+    assert cl.catalog.table("ck").check_constraints
+    # validation still rejects violated constraints
+    from citus_tpu.errors import AnalysisError
+    with pytest.raises(AnalysisError, match="violated"):
+        cl.execute("ALTER TABLE ck ADD CHECK (v > 5)")
+
+
+def test_serial_does_not_clobber_foreign_sequence(tmp_cluster):
+    """CREATE TABLE with a serial column refuses to restart a
+    pre-existing same-named sequence it does not own; a leftover from
+    a dropped incarnation of the SAME table still restarts."""
+    cl = tmp_cluster
+    cl.execute("CREATE SEQUENCE st_id_seq")
+    assert cl.catalog.nextval("st_id_seq") == 1
+    with pytest.raises(CatalogError, match="already exists"):
+        cl.execute("CREATE TABLE st (id bigserial, v bigint)")
+    assert not cl.catalog.has_table("st")  # all-or-nothing
+    assert cl.catalog.nextval("st_id_seq") == 2  # untouched
+    cl.execute("DROP SEQUENCE st_id_seq")
+    # normal serial lifecycle: create, draw, drop leaves nothing behind
+    cl.execute("CREATE TABLE st (id bigserial, v bigint)")
+    assert cl.catalog.sequences["st_id_seq"].get("owner") == "st"
+    cl.execute("INSERT INTO st (v) VALUES (7)")
+    assert cl.execute("SELECT id, v FROM st").rows == [(1, 7)]
+    cl.execute("DROP TABLE st")
+    # a same-owner leftover restarts (simulates a crashed DROP that
+    # kept the sequence): re-creating the table must succeed
+    cl.catalog.create_sequence("st_id_seq", 5, 1)
+    cl.catalog.sequences["st_id_seq"]["owner"] = "st"
+    cl.execute("CREATE TABLE st (id bigserial, v bigint)")
+    cl.execute("INSERT INTO st (v) VALUES (8)")
+    assert cl.execute("SELECT id FROM st").rows == [(1,)]  # restarted
