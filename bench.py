@@ -327,6 +327,35 @@ def main() -> None:
     if peak is not None:
         extra["hbm_peak_bytes_per_sec"] = peak
         extra["q1_fraction_of_hbm_peak"] = round(bytes_per_sec / peak, 4)
+    if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        # host-decode/device-compute overlap on an uncached Q1 scan
+        # (executor/pipeline.py): busy fractions near 1.0 on both
+        # halves mean the read-ahead queue is hiding decode behind
+        # device rounds; a low device fraction = host-bound pipeline
+        from citus_tpu.executor.device_cache import GLOBAL_CACHE
+        GLOBAL_CACHE.clear()
+        t0 = time.perf_counter()
+        r = cl.execute(Q1)
+        wall = time.perf_counter() - t0
+        pl = (r.explain or {}).get("pipeline") or {}
+        if pl and wall > 0:
+            extra["pipeline"] = {
+                "host_decode_ms": pl.get("host_decode_ms", 0),
+                "device_ms": pl.get("device_ms", 0),
+                "h2d_bytes": pl.get("h2d_bytes", 0),
+                "host_stalls": pl.get("host_stalls", 0),
+                "device_stalls": pl.get("device_stalls", 0),
+                "host_decode_busy_fraction": round(
+                    pl.get("host_decode_ms", 0) / (wall * 1000), 4),
+                "device_busy_fraction": round(
+                    pl.get("device_ms", 0) / (wall * 1000), 4),
+                # lower bound on overlapped work: both halves cannot
+                # sum past the wall unless they ran concurrently
+                "overlap_fraction": round(max(
+                    0.0, (pl.get("host_decode_ms", 0)
+                          + pl.get("device_ms", 0)) / (wall * 1000) - 1.0),
+                    4),
+            }
     if os.environ.get("BENCH_CONCURRENCY", "1") != "0":
         bench_concurrency(cl, extra)
     if os.environ.get("BENCH_JOIN", "1") != "0":

@@ -40,7 +40,13 @@ def _compute_ndistinct(cl, table: str, columns: list) -> int:
 _GUCS = {
     "citus.task_executor_backend": ("executor", "task_executor_backend", str),
     "citus.max_shared_pool_size": ("executor", "max_shared_pool_size", int),
-    "citus.max_adaptive_executor_pool_size": ("executor", "max_tasks_in_flight", int),
+    # per-node remote-task RPC window cap (slow-start ramp target,
+    # executor/pipeline.py); formerly aliased the device in-flight
+    # window, which now has its own name below
+    "citus.max_adaptive_executor_pool_size": ("executor", "max_adaptive_pool_size", int),
+    "citus.max_tasks_in_flight": ("executor", "max_tasks_in_flight", int),
+    # host read-ahead queue depth for the decode thread; 0 = inline
+    "citus.executor_prefetch_depth": ("executor", "executor_prefetch_depth", int),
     "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
     "citus.remote_task_execution": ("executor", "remote_task_execution", _remote_task_mode),
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),

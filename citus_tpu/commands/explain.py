@@ -164,10 +164,24 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
         rtasks = r.explain.get("remote_tasks") or []
         if rtasks:
             lines.append(f"  Remote Tasks: {len(rtasks)}")
-            for si, node, nbytes, dt in rtasks:
+            for si, node, nbytes, rpc_s, dec_s in rtasks:
                 lines.append(f"    -> Task (shard index {si}): pushed to "
                              f"node {node}, {nbytes} result bytes, "
-                             f"{dt*1000:.2f} ms")
+                             f"{rpc_s*1000:.2f} ms rpc, "
+                             f"{dec_s*1000:.2f} ms decode")
+        pl = r.explain.get("pipeline") or {}
+        if pl:
+            lines.append(
+                f"  Pipeline: host decode {pl.get('host_decode_ms', 0):.2f}"
+                f" ms, device {pl.get('device_ms', 0):.2f} ms, "
+                f"H2D {pl.get('h2d_bytes', 0)} bytes, "
+                f"stalls host={pl.get('host_stalls', 0)} "
+                f"device={pl.get('device_stalls', 0)}")
+            if "remote_wait_ms" in pl:
+                lines.append(
+                    f"    Remote Wait: {pl['remote_wait_ms']:.2f} ms "
+                    f"(overlapped {pl['remote_overlapped_ms']:.2f} ms, "
+                    f"peak in-flight {pl['remote_inflight_peak']})")
     return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
 
 def _explain_join(cl, stmt: A.Explain) -> Result:

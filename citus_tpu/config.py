@@ -62,8 +62,20 @@ class ExecutorSettings:
     max_tasks_in_flight: int = 2
     # Process-wide cap on queries driving device work concurrently;
     # 0 = unlimited (analog of citus.max_shared_pool_size backed by
-    # connection/shared_connection_stats.c's shared counters).
+    # connection/shared_connection_stats.c's shared counters).  Extra
+    # concurrent remote-task RPCs beyond a query's first take OPTIONAL
+    # slots from this same pool (executor/pipeline.py).
     max_shared_pool_size: int = 0
+    # Per-worker-node cap on concurrent execute_task RPCs — the
+    # citus.max_adaptive_executor_pool_size analog.  Each node's
+    # dispatch window starts at 1 and ramps by one per success toward
+    # this cap (slow start, executor/pipeline.py).
+    max_adaptive_pool_size: int = 16
+    # Host read-ahead depth (batches; rounds on the mesh path) the
+    # background decode worker keeps prepared ahead of device compute —
+    # citus.executor_prefetch_depth.  0 = decode inline on the
+    # dispatching thread (no host/device overlap).
+    executor_prefetch_depth: int = 2
     # Prefer replica (non-primary) placements for reads — the
     # citus.use_secondary_nodes='always' analog; failover to the
     # primary still applies when no replica answers.

@@ -117,6 +117,10 @@ def load_shard_batches(
         source = reader.lookup_eq(cols, col, value, plan.intervals)
     else:
         source = reader.scan(cols, plan.intervals)
+    # NOTE: under the pipelined executor this generator runs on the
+    # host decode thread (executor/pipeline.py HostPrefetcher), so the
+    # decode_batch fault point below fires there — delays injected on
+    # it model slow host-side decompression overlapping device compute
     for batch in source:
         for c in cols:
             pend_v[c].append(batch.values[c])
@@ -124,11 +128,13 @@ def load_shard_batches(
             pend_m[c].append(np.ones(batch.row_count, bool) if m is None else m)
         pend_rows += batch.row_count
         if pend_rows >= max_batch_rows:
+            FAULTS.hit("decode_batch", f"{table.name}:{shard.shard_id}")
             yield _drain(cols, pend_v, pend_m, pend_rows)
             pend_v = {c: [] for c in cols}
             pend_m = {c: [] for c in cols}
             pend_rows = 0
     if pend_rows:
+        FAULTS.hit("decode_batch", f"{table.name}:{shard.shard_id}")
         yield _drain(cols, pend_v, pend_m, pend_rows)
 
 

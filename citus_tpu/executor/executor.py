@@ -157,15 +157,14 @@ def _empty_partials(plan: PhysicalPlan, xp):
     return tuple(outs)
 
 
-#: streaming mode keeps at most this many batches in flight on the
-#: device ahead of the kernel consuming them (double buffering: the host
-#: decompresses + transfers batch i+1..i+2 while batch i computes);
-#: ExecutorSettings.max_tasks_in_flight raises the window
-PREFETCH_DEPTH = 2
-
-
 def _prefetch_depth(settings: Settings) -> int:
-    return max(PREFETCH_DEPTH, settings.executor.max_tasks_in_flight)
+    """Device-side in-flight window: streaming mode keeps at most this
+    many batch outputs un-synced ahead of the kernel consuming them.
+    Governed by SET citus.executor_prefetch_depth (floor of 1 so the
+    depth-0 'decode inline' setting still double-buffers the device);
+    max_tasks_in_flight raises the window further."""
+    return max(1, settings.executor.executor_prefetch_depth,
+               settings.executor.max_tasks_in_flight)
 
 
 def _iter_padded_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings):
@@ -206,6 +205,10 @@ def _run_mesh_round(plan, run, buf: list, n_dev: int, shard_sharding,
     worker+collective, and (optionally) retain the device-sharded inputs
     for the HBM cache.  -> (device outputs, input bytes)."""
     import jax
+    from citus_tpu.testing.faults import FAULTS
+    # delay injections here model device-side round latency for the
+    # host/device overlap tests (the decode half is decode_batch)
+    FAULTS.hit("device_round", plan.bound.table.name)
     bucket = max(b.padded_rows for b in buf)
     while len(buf) < n_dev:
         buf.append(empty_batch(plan.bound.table, plan, bucket, -1))
@@ -230,11 +233,15 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                       params=((), ())):
     import jax
     import jax.numpy as jnp
+    from citus_tpu.executor.pipeline import (
+        PipelineStats, prefetch_batches, read_ahead_depth,
+    )
     from citus_tpu.parallel.mesh import default_mesh, sharded_partial_agg, shard_axis_size
 
     pcols, pvalids = params
     devices = jax.devices()
     kinds = _combine_kinds(plan)
+    pstats = PipelineStats()
 
     from citus_tpu.executor.device_cache import GLOBAL_CACHE, plan_cache_key
     from citus_tpu.storage.overlay import current_overlay
@@ -286,42 +293,61 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         nbytes = 0
         inflight: deque = deque()
         stream = _iter_padded_batches(cat, plan, settings)
+        t_peek = time.perf_counter()
         first = next(stream, None)
         if first is None:
             return combine_partials_host(plan, [_empty_partials(plan, np)])
         second = next(stream, None)
+        pstats.host_decode_s += time.perf_counter() - t_peek
         if second is None:
             host_iter = iter([first])  # 1 batch: default-device path
         else:
             import itertools as _it
+            # host/device overlap: the decode thread prepares the NEXT
+            # round (up to executor_prefetch_depth rounds of n_dev
+            # batches) while the device executes the current one
+            host_iter_m = prefetch_batches(
+                _it.chain([first, second], stream),
+                read_ahead_depth(settings) * n_dev, pstats)
             buf: list = []
-            for hb in _it.chain([first, second], stream):
-                buf.append(hb)
-                if len(buf) < n_dev:
-                    continue
-                out, nb = _run_mesh_round(
-                    plan, run, buf, n_dev, shard_sharding,
-                    p_stack, pv_stack, collect)
-                acc.append(out)
-                nbytes += nb
-                buf = []
-                if collect is not None and nbytes > GLOBAL_CACHE.capacity:
-                    collect = None  # working set exceeds HBM cache: stream
-                if collect is None:
-                    inflight.append(out)
-                    if len(inflight) > _prefetch_depth(settings):
-                        jax.block_until_ready(inflight.popleft())
-            if buf:
-                out, nb = _run_mesh_round(
-                    plan, run, buf, n_dev, shard_sharding,
-                    p_stack, pv_stack, collect)
-                acc.append(out)
-                nbytes += nb
+            try:
+                for hb in host_iter_m:
+                    buf.append(hb)
+                    if len(buf) < n_dev:
+                        continue
+                    t_dev = time.perf_counter()
+                    out, nb = _run_mesh_round(
+                        plan, run, buf, n_dev, shard_sharding,
+                        p_stack, pv_stack, collect)
+                    acc.append(out)
+                    nbytes += nb
+                    buf = []
+                    if collect is not None and nbytes > GLOBAL_CACHE.capacity:
+                        collect = None  # working set exceeds HBM cache: stream
+                    if collect is None:
+                        inflight.append(out)
+                        if len(inflight) > _prefetch_depth(settings):
+                            jax.block_until_ready(inflight.popleft())
+                    pstats.device_s += time.perf_counter() - t_dev
+                if buf:
+                    t_dev = time.perf_counter()
+                    out, nb = _run_mesh_round(
+                        plan, run, buf, n_dev, shard_sharding,
+                        p_stack, pv_stack, collect)
+                    acc.append(out)
+                    nbytes += nb
+                    pstats.device_s += time.perf_counter() - t_dev
+            finally:
+                host_iter_m.close()
             if collect is not None and nbytes <= GLOBAL_CACHE.capacity:
                 jax.block_until_ready([r[0] for r in collect])
                 GLOBAL_CACHE.put(mkey, collect, nbytes)
-            return combine_partials_host(
-                plan, [tuple(np.asarray(o) for o in out) for out in acc])
+            t_dev = time.perf_counter()
+            acc_np = [tuple(np.asarray(o) for o in out) for out in acc]
+            pstats.device_s += time.perf_counter() - t_dev
+            pstats.h2d_bytes = nbytes
+            pstats.publish(plan)
+            return combine_partials_host(plan, acc_np)
 
     # ---- single-device path: streaming pipeline + HBM pinning --------
     from collections import deque
@@ -378,41 +404,61 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         # and pin them only if the whole working set fits the cache —
         # past capacity, throughput degrades to the pipeline rate
         # instead of collapsing (SURVEY §2.4 "Pipelined ingest")
+        from citus_tpu.testing.faults import FAULTS
         collect: Optional[list] = None if overlaid else []
         nbytes = 0
         inflight: deque = deque()
         if host_iter is None:
             host_iter = _iter_padded_batches(cat, plan, settings)
-        for hb in host_iter:
-            db = ShardBatch(tuple(jax.device_put(c) for c in hb.cols),
-                            tuple(jax.device_put(v) for v in hb.valids),
-                            jax.device_put(hb.row_mask), hb.n_rows,
-                            hb.padded_rows, hb.shard_index)
-            t0 = time.perf_counter()
-            out = _worker_for(db.padded_rows)(db.cols + pcols,
-                                             db.valids + pvalids,
-                                             db.row_mask)
-            acc_dev = out if acc_dev is None else merge(acc_dev, out)
-            task_times.append((db.shard_index, db.n_rows,
-                               time.perf_counter() - t0))
-            nbytes += (sum(c.nbytes for c in hb.cols)
-                       + sum(v.nbytes for v in hb.valids)
-                       + hb.row_mask.nbytes)
-            if collect is not None:
-                collect.append(db)
-                if nbytes > GLOBAL_CACHE.capacity:
-                    collect = None  # working set exceeds HBM cache: stream
-            if collect is None:
-                # bound in-flight device memory: wait for the output from
-                # max_tasks_in_flight batches ago before admitting another
-                inflight.append(out)
-                if len(inflight) > _prefetch_depth(settings):
-                    jax.block_until_ready(inflight.popleft())
+        # host/device overlap: the decode thread runs the host half of
+        # the scan (read_ahead_depth batches ahead) while this thread
+        # feeds the device
+        host_iter = prefetch_batches(host_iter, read_ahead_depth(settings),
+                                     pstats)
+        try:
+            for hb in host_iter:
+                t_dev = time.perf_counter()
+                FAULTS.hit("device_round", plan.bound.table.name)
+                db = ShardBatch(tuple(jax.device_put(c) for c in hb.cols),
+                                tuple(jax.device_put(v) for v in hb.valids),
+                                jax.device_put(hb.row_mask), hb.n_rows,
+                                hb.padded_rows, hb.shard_index)
+                t0 = time.perf_counter()
+                out = _worker_for(db.padded_rows)(db.cols + pcols,
+                                                 db.valids + pvalids,
+                                                 db.row_mask)
+                acc_dev = out if acc_dev is None else merge(acc_dev, out)
+                task_times.append((db.shard_index, db.n_rows,
+                                   time.perf_counter() - t0))
+                nbytes += (sum(c.nbytes for c in hb.cols)
+                           + sum(v.nbytes for v in hb.valids)
+                           + hb.row_mask.nbytes)
+                if collect is not None:
+                    collect.append(db)
+                    if nbytes > GLOBAL_CACHE.capacity:
+                        collect = None  # working set exceeds HBM cache
+                if collect is None:
+                    # bound in-flight device memory: wait for the output
+                    # from _prefetch_depth batches ago before admitting
+                    # another
+                    inflight.append(out)
+                    if len(inflight) > _prefetch_depth(settings):
+                        jax.block_until_ready(inflight.popleft())
+                pstats.device_s += time.perf_counter() - t_dev
+        finally:
+            host_iter.close()
         if acc_dev is None:
             return combine_partials_host(plan, [_empty_partials(plan, np)])
         if collect is not None:
             jax.block_until_ready([b.cols for b in collect])
             GLOBAL_CACHE.put(key, collect, nbytes)
+        pstats.h2d_bytes = nbytes
+        t_dev = time.perf_counter()
+        partials = tuple(np.asarray(o) for o in jax.device_get(acc_dev))
+        pstats.device_s += time.perf_counter() - t_dev
+        pstats.publish(plan)
+        plan.runtime_cache["task_times"] = task_times
+        return partials
     plan.runtime_cache["task_times"] = task_times
     return tuple(np.asarray(o) for o in jax.device_get(acc_dev))
 
@@ -436,17 +482,32 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     penv = _params_env(params)
     if mode in ("scalar", "direct"):
         # push the worker half to coordinators OWNING remote-only
-        # placements (ship partial-agg states, not stripe files); the
-        # local run covers the remaining shards and any push fallbacks
-        from citus_tpu.executor.worker_tasks import push_remote_tasks
-        local, remote_partials = push_remote_tasks(cat, plan, settings,
-                                                   params)
+        # placements (ship partial-agg states, not stripe files) and
+        # OVERLAP the remote waits with the local shard scan: dispatch
+        # first, scan while the RPCs fly, collect as they complete.
+        # Push fallbacks scan locally in a second pass; combine is
+        # associative, so the split changes nothing in the result.
+        from citus_tpu.executor.pipeline import dispatch_remote_tasks
+        run = _run_partials_cpu if backend == "cpu" else _run_partials_jax
+        local, dispatch = dispatch_remote_tasks(cat, plan, settings, params)
         run_plan = plan
         if local != plan.shard_indexes:
             import dataclasses
             run_plan = dataclasses.replace(plan, shard_indexes=local)
-        partials = (_run_partials_cpu if backend == "cpu" else _run_partials_jax)(
-            cat, run_plan, settings, params)
+        try:
+            partials = run(cat, run_plan, settings, params)
+        except BaseException:
+            dispatch.abort()  # no RPC thread outlives the attempt
+            raise
+        fallback, remote_partials = dispatch.collect()
+        if fallback:
+            import dataclasses
+            tt = list(plan.runtime_cache.get("task_times", []))
+            fb_plan = dataclasses.replace(plan, shard_indexes=fallback)
+            remote_partials = [*remote_partials,
+                               run(cat, fb_plan, settings, params)]
+            plan.runtime_cache["task_times"] = (
+                tt + list(plan.runtime_cache.get("task_times", [])))
         if remote_partials:
             partials = combine_partials_host(
                 plan, [partials, *remote_partials])
@@ -629,14 +690,49 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             filter_fn = jax.jit(device_mask)
             plan.runtime_cache["jit_filter"] = filter_fn
 
+    def _scan_shards(rp, out: list) -> None:
+        for si in rp.shard_indexes:
+            for values, masks, n in load_shard_batches(
+                    cat, plan, si, min_batch_rows=1):
+                cols = tuple(values[c].astype(plan.bound.table.schema.column(c).type.device_dtype,
+                                              copy=False) for c in plan.scan_columns)
+                valids = tuple(masks[c] for c in plan.scan_columns)
+                if filter_fn is not None:
+                    mask = np.asarray(filter_fn(cols + pcols, valids + pvalids,
+                                                np.ones(n, bool)))
+                elif plan.bound.filter is not None:
+                    from citus_tpu.planner.bound import compile_expr, predicate_mask
+                    cfn_np = plan.runtime_cache.get("np_filter")
+                    if cfn_np is None:
+                        cfn_np = compile_expr(plan.bound.filter, np)
+                        plan.runtime_cache["np_filter"] = cfn_np
+                    env = {c: (cols[i], valids[i]) for i, c in enumerate(plan.scan_columns)}
+                    env.update(penv)
+                    mask = np.asarray(predicate_mask(np, cfn_np, env, np.ones(n, bool)))
+                    mask = mask & np.ones(n, bool)
+                else:
+                    mask = np.ones(n, bool)
+                env = {c: (cols[i], valids[i]) for i, c in enumerate(plan.scan_columns)}
+                env.update(penv)
+                out.append((env, mask))
+
     # remote-only placements execute scan+filter where the data lives
-    # and return already-compacted rows; local shards stream below
-    from citus_tpu.executor.worker_tasks import push_remote_tasks
-    local, remote_batches = push_remote_tasks(cat, plan, settings, params)
+    # and return already-compacted rows; local shards stream HERE while
+    # the remote RPCs are in flight (the adaptive executor's overlap of
+    # worker waits with the coordinator's own placements)
+    from citus_tpu.executor.pipeline import dispatch_remote_tasks
+    local, dispatch = dispatch_remote_tasks(cat, plan, settings, params)
     run_plan = plan
     if local != plan.shard_indexes:
         import dataclasses
         run_plan = dataclasses.replace(plan, shard_indexes=local)
+    local_batches: list = []
+    try:
+        _scan_shards(run_plan, local_batches)
+    except BaseException:
+        dispatch.abort()  # no RPC thread outlives the attempt
+        raise
+    fallback, remote_batches = dispatch.collect()
     env_batches = []
     for values, validity in remote_batches:
         if not plan.scan_columns:
@@ -650,30 +746,11 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                    validity[c]) for c in plan.scan_columns}
         env.update(penv)
         env_batches.append((env, np.ones(n, bool)))
-    for si in run_plan.shard_indexes:
-        for values, masks, n in load_shard_batches(
-                cat, plan, si, min_batch_rows=1):
-            cols = tuple(values[c].astype(plan.bound.table.schema.column(c).type.device_dtype,
-                                          copy=False) for c in plan.scan_columns)
-            valids = tuple(masks[c] for c in plan.scan_columns)
-            if filter_fn is not None:
-                mask = np.asarray(filter_fn(cols + pcols, valids + pvalids,
-                                            np.ones(n, bool)))
-            elif plan.bound.filter is not None:
-                from citus_tpu.planner.bound import compile_expr, predicate_mask
-                cfn_np = plan.runtime_cache.get("np_filter")
-                if cfn_np is None:
-                    cfn_np = compile_expr(plan.bound.filter, np)
-                    plan.runtime_cache["np_filter"] = cfn_np
-                env = {c: (cols[i], valids[i]) for i, c in enumerate(plan.scan_columns)}
-                env.update(penv)
-                mask = np.asarray(predicate_mask(np, cfn_np, env, np.ones(n, bool)))
-                mask = mask & np.ones(n, bool)
-            else:
-                mask = np.ones(n, bool)
-            env = {c: (cols[i], valids[i]) for i, c in enumerate(plan.scan_columns)}
-            env.update(penv)
-            env_batches.append((env, mask))
+    env_batches.extend(local_batches)
+    if fallback:
+        import dataclasses
+        _scan_shards(dataclasses.replace(plan, shard_indexes=fallback),
+                     env_batches)
     return project_rows(plan, cat, env_batches)
 
 
@@ -776,6 +853,7 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
             "elapsed_s": elapsed,
             "tasks": plan.runtime_cache.get("task_times", []),
             "remote_tasks": plan.runtime_cache.get("remote_tasks", []),
+            "pipeline": plan.runtime_cache.get("pipeline", {}),
             "router_key": plan.router_key,
         },
     )

@@ -1,0 +1,429 @@
+"""Pipelined adaptive executor: the two wall-clock overlaps the
+reference's adaptive executor gets from connection-level concurrency.
+
+Reference: AdaptiveExecutor (adaptive_executor.c:775) keeps one
+connection pool PER WORKER NODE, growing each pool from one connection
+toward citus.max_adaptive_executor_pool_size by slow-start (README:
+1670-1688), all pools bounded globally by citus.max_shared_pool_size's
+shared-memory counters — so a multi-host query costs the *max* of the
+per-host times, not the sum.  SURVEY §2.4 maps "intra-node multi-core
+parallelism / pipelined ingest" to XLA async streams; this module is
+the host half of that lowering.
+
+Two pieces:
+
+- ``dispatch_remote_tasks`` / ``RemoteTaskDispatch``: fan out
+  ``execute_task`` RPCs on threads with a per-node in-flight window
+  (slow-start: each node starts at 1 and ramps toward
+  ``citus.max_adaptive_executor_pool_size`` on successes), each extra
+  concurrent RPC taking an OPTIONAL slot from the cross-query
+  ``citus.max_shared_pool_size`` pool (denied = stay at the current
+  width).  The caller dispatches first, scans local placements while
+  the RPCs fly, and collects as they complete; per-task failures fall
+  back to the local pull path exactly like the serial dispatcher did.
+- ``prefetch_batches`` / ``HostPrefetcher``: a bounded read-ahead
+  queue fed by a background decode worker producing padded
+  ``ShardBatch``es (chunk decompress, null decode, pad, stack) while
+  the device executes the previous round — backpressure at
+  ``citus.executor_prefetch_depth``, errors from the decode thread
+  re-raised at the consumer, prompt cancellation when the consumer
+  dies.  Depth 0 decodes inline (the pre-pipeline serial behavior).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Iterator, Optional
+
+from citus_tpu.errors import ExecutionError
+
+_perf = time.perf_counter
+
+
+class PipelineStats:
+    """Per-query pipeline accounting.  The decode thread owns
+    host_decode_s/device_stalls, the consumer owns the rest — disjoint
+    writers, read only after the pipeline is joined."""
+
+    def __init__(self) -> None:
+        self.host_decode_s = 0.0   # time inside the host decode iterator
+        self.device_s = 0.0        # H2D transfer + kernel dispatch + sync
+        self.h2d_bytes = 0         # bytes shipped host -> device
+        self.host_stalls = 0       # consumer found the queue empty
+        self.device_stalls = 0     # producer found the queue full
+
+    def as_dict(self) -> dict:
+        return {
+            "host_decode_ms": round(self.host_decode_s * 1000, 3),
+            "device_ms": round(self.device_s * 1000, 3),
+            "h2d_bytes": int(self.h2d_bytes),
+            "host_stalls": int(self.host_stalls),
+            "device_stalls": int(self.device_stalls),
+        }
+
+    def publish(self, plan) -> None:
+        """Merge into the plan's EXPLAIN surface and the global
+        counters (the citus_stat_counters analog)."""
+        from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        plan.runtime_cache.setdefault("pipeline", {}).update(self.as_dict())
+        if self.host_stalls:
+            GLOBAL_COUNTERS.bump("pipeline_host_stalls", self.host_stalls)
+        if self.device_stalls:
+            GLOBAL_COUNTERS.bump("pipeline_device_stalls",
+                                 self.device_stalls)
+
+
+def read_ahead_depth(settings) -> int:
+    """Host read-ahead queue depth (citus.executor_prefetch_depth);
+    0 disables the decode thread entirely."""
+    return max(0, settings.executor.executor_prefetch_depth)
+
+
+# ------------------------------------------------- host/device overlap
+
+
+class _InlineHostIter:
+    """Depth-0 degenerate prefetcher: decode inline on the consumer
+    thread (the serial pre-pipeline behavior), still timing the host
+    half so EXPLAIN stays comparable."""
+
+    def __init__(self, source: Iterator, stats: Optional[PipelineStats]):
+        self._source = iter(source)
+        self._stats = stats
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = _perf()
+        try:
+            return next(self._source)
+        finally:
+            if self._stats is not None:
+                self._stats.host_decode_s += _perf() - t0
+
+    def close(self) -> None:
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            close()
+
+
+class HostPrefetcher:
+    """Bounded read-ahead over a host batch iterator, fed by one
+    background decode worker.  The queue depth IS the backpressure:
+    the decode thread blocks when the device is ``depth`` batches
+    behind, so host memory stays bounded no matter how large the scan.
+
+    Exceptions raised by the source (fault injections included) are
+    re-raised at the consumer's next ``__next__``.  ``close()``
+    cancels the worker promptly even when it is blocked on a full
+    queue (consumer died mid-scan)."""
+
+    _ITEM, _DONE, _ERR = 0, 1, 2
+
+    def __init__(self, source: Iterator, depth: int,
+                 stats: Optional[PipelineStats] = None):
+        from citus_tpu.storage.overlay import current_overlay
+        self._source = iter(source)
+        self._stats = stats
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._cancel = threading.Event()
+        # the transaction overlay is thread-local: the decode thread
+        # must see the consumer's staged writes, not a bare snapshot
+        self._txn = current_overlay()
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="citus-host-decode")
+        self._finished = False
+        self._thread.start()
+
+    # ---- producer (decode thread) ----
+    def _put(self, item) -> bool:
+        stalled = False
+        while not self._cancel.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                if not stalled and self._stats is not None:
+                    # device behind: backpressure holds the decode
+                    self._stats.device_stalls += 1
+                    stalled = True
+        return False
+
+    def _produce(self) -> None:
+        from citus_tpu.storage.overlay import transaction_overlay
+        with transaction_overlay(self._txn):
+            self._produce_inner()
+
+    def _produce_inner(self) -> None:
+        try:
+            while not self._cancel.is_set():
+                t0 = _perf()
+                try:
+                    batch = next(self._source)
+                except StopIteration:
+                    self._put((self._DONE, None))
+                    return
+                finally:
+                    if self._stats is not None:
+                        self._stats.host_decode_s += _perf() - t0
+                if not self._put((self._ITEM, batch)):
+                    return
+        except BaseException as e:  # surfaces at the consumer
+            self._put((self._ERR, e))
+
+    # ---- consumer ----
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        try:
+            kind, val = self._q.get_nowait()
+        except queue.Empty:
+            if self._stats is not None:
+                # host behind: the device would starve here
+                self._stats.host_stalls += 1
+            while True:
+                try:
+                    kind, val = self._q.get(timeout=0.5)
+                    break
+                except queue.Empty:
+                    if not self._thread.is_alive() and self._q.empty():
+                        raise ExecutionError(
+                            "host decode worker died without a result")
+        if kind == self._ITEM:
+            return val
+        self._finished = True
+        if kind == self._ERR:
+            raise val
+        raise StopIteration
+
+    def close(self) -> None:
+        """Cancel the decode worker and drain; idempotent, safe to call
+        from a ``finally`` around the consumer loop."""
+        self._cancel.set()
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+
+
+def prefetch_batches(source: Iterator, depth: int,
+                     stats: Optional[PipelineStats] = None):
+    """Wrap a host batch iterator in the read-ahead pipeline (depth >=
+    1) or the inline fallback (depth 0)."""
+    if depth <= 0:
+        return _InlineHostIter(source, stats)
+    return HostPrefetcher(source, depth, stats)
+
+
+# ------------------------------------------------ remote task dispatch
+
+
+class _NodePool:
+    """Per-worker-node dispatch window (the WorkerPool analog): starts
+    at one in-flight RPC and ramps by one per success toward the
+    citus.max_adaptive_executor_pool_size cap — slow start."""
+
+    __slots__ = ("window", "inflight", "pending")
+
+    def __init__(self):
+        self.window = 1
+        self.inflight = 0
+        self.pending: deque = deque()
+
+
+class RemoteTaskDispatch:
+    """In-flight remote execute_task fan-out.  Construction starts the
+    RPCs; ``collect()`` blocks until every task settled and returns
+    ``(fallback_shard_indexes, results)`` — failed tasks fall back to
+    the local pull path, successes carry decoded partials/batches.
+    ``abort()`` (error path) drops undispatched tasks and waits out the
+    in-flight ones so no thread outlives the query attempt."""
+
+    def __init__(self, cat, plan, settings, tasks, is_agg: bool):
+        self.cat = cat
+        self.plan = plan
+        self.cap = max(1, settings.executor.max_adaptive_pool_size)
+        self.shared_limit = settings.executor.max_shared_pool_size
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._nodes: dict[int, _NodePool] = {}
+        self._is_agg = is_agg
+        self._results: dict[int, object] = {}
+        self._fallback: list[int] = []
+        self._tlog: list[tuple] = []
+        self._total = len(tasks)
+        self._settled = 0
+        self._inflight_total = 0
+        self._inflight_peak = 0
+        self._aborted = False
+        self._t_start = _perf()
+        self._t_last_done = self._t_start
+        for si, node, ep, task in tasks:
+            pool = self._nodes.setdefault(int(node), _NodePool())
+            pool.pending.append((si, node, ep, task))
+        with self._mu:
+            self._launch_locked()
+
+    # ---- scheduling (caller holds self._mu) ----
+    def _launch_locked(self) -> None:
+        from citus_tpu.executor.admission import GLOBAL_POOL
+        progress = True
+        while progress:
+            progress = False
+            for pool in self._nodes.values():
+                if not pool.pending or pool.inflight >= pool.window:
+                    continue
+                if self._inflight_total == 0:
+                    holds_slot = False  # rides the query's required slot
+                elif GLOBAL_POOL.acquire(self.shared_limit, optional=True):
+                    holds_slot = True
+                else:
+                    return  # shared pool saturated; retry on completion
+                si, node, ep, task = pool.pending.popleft()
+                pool.inflight += 1
+                self._inflight_total += 1
+                self._inflight_peak = max(self._inflight_peak,
+                                          self._inflight_total)
+                threading.Thread(
+                    target=self._run_one, daemon=True,
+                    name=f"citus-remote-task-{si}",
+                    args=(pool, si, node, ep, task, holds_slot)).start()
+                progress = True
+
+    # ---- one RPC (worker thread) ----
+    def _run_one(self, pool, si, node, ep, task, holds_slot) -> None:
+        from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        from citus_tpu.net.data_plane import _npz_load, decode_batch
+        from citus_tpu.testing.faults import FAULTS
+        payload = None
+        nbytes = 0
+        rpc_s = dec_s = 0.0
+        ok = False
+        t0 = _perf()
+        try:
+            FAULTS.hit("execute_task",
+                       f"{task['table']}:{task['shard_id']}:{node}")
+            meta, blob = self.cat.remote_data.call_binary_pooled(
+                ep, "execute_task", task)
+            rpc_s = _perf() - t0
+            t1 = _perf()
+            if self._is_agg:
+                arrays = _npz_load(blob)
+                payload = tuple(arrays[f"a__{i}"]
+                                for i in range(len(arrays)))
+            else:
+                payload = decode_batch(blob)
+            dec_s = _perf() - t1
+            nbytes = len(blob)
+            ok = True
+        except Exception:
+            # worker dead, version skew, codec refused server-side:
+            # this shard scans locally through the pull path instead
+            pass
+        from citus_tpu.executor.admission import GLOBAL_POOL
+        if holds_slot:
+            GLOBAL_POOL.release()
+        with self._mu:
+            pool.inflight -= 1
+            self._inflight_total -= 1
+            if ok:
+                pool.window = min(self.cap, pool.window + 1)  # slow start
+                self._results[si] = payload
+                self._tlog.append((si, int(node), nbytes, rpc_s, dec_s))
+                GLOBAL_COUNTERS.bump("remote_tasks_pushed")
+                GLOBAL_COUNTERS.bump("remote_task_result_bytes", nbytes)
+            else:
+                self._fallback.append(si)
+                GLOBAL_COUNTERS.bump("remote_task_fallbacks")
+            self._settled += 1
+            self._t_last_done = _perf()
+            if not self._aborted:
+                self._launch_locked()
+            if self._settled >= self._total and self._inflight_total == 0:
+                self._cv.notify_all()
+
+    # ---- caller side ----
+    def collect(self) -> tuple[list[int], list]:
+        """Wait for every in-flight task; returns (fallback shard
+        indexes, successful results in shard-index order) and publishes
+        the overlap/peak stats."""
+        from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        t_enter = _perf()
+        with self._cv:
+            while self._settled < self._total or self._inflight_total:
+                self._cv.wait(0.5)
+            fallback = sorted(self._fallback)
+            results = [self._results[si] for si in sorted(self._results)]
+            tlog = sorted(self._tlog)
+            peak = self._inflight_peak
+            t_last = self._t_last_done
+        wait_s = _perf() - t_enter
+        # the stretch of remote in-flight time the caller spent doing
+        # local work instead of blocking — the overlap win itself
+        overlapped_s = max(0.0, min(t_enter, t_last) - self._t_start)
+        self.plan.runtime_cache["remote_tasks"] = tlog
+        if self._total:
+            pl = self.plan.runtime_cache.setdefault("pipeline", {})
+            pl["remote_wait_ms"] = round(wait_s * 1000, 3)
+            pl["remote_overlapped_ms"] = round(overlapped_s * 1000, 3)
+            pl["remote_inflight_peak"] = peak
+            GLOBAL_COUNTERS.bump_max("remote_tasks_inflight_peak", peak)
+            GLOBAL_COUNTERS.bump("remote_task_wait_overlapped_ms",
+                                 int(overlapped_s * 1000))
+        return fallback, results
+
+    def abort(self) -> None:
+        """Error path: stop launching, count nothing, wait out the
+        in-flight RPCs so no worker thread outlives the attempt."""
+        with self._cv:
+            self._aborted = True
+            for pool in self._nodes.values():
+                self._settled += len(pool.pending)
+                pool.pending.clear()
+            while self._inflight_total:
+                self._cv.wait(0.5)
+
+
+def dispatch_remote_tasks(cat, plan, settings, params=((), ())
+                          ) -> tuple[list[int], RemoteTaskDispatch]:
+    """Start the remote fan-out for every remote-only placement of
+    ``plan`` and return immediately: ``(local_shard_indexes,
+    dispatch)``.  The caller scans the local shards while the RPCs are
+    in flight, then ``dispatch.collect()``s.  Inexpressible plans (or
+    policy "pull") push nothing — everything stays local."""
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    from citus_tpu.executor.worker_tasks import encode_task, split_pushable
+    plan.runtime_cache["pipeline"] = {}
+    local, remote = split_pushable(cat, plan, settings)
+    if not remote:
+        plan.runtime_cache["remote_tasks"] = []
+        return list(local), RemoteTaskDispatch(cat, plan, settings, [], False)
+    template = encode_task(plan, params)
+    if template is None:
+        GLOBAL_COUNTERS.bump("remote_task_fallbacks", len(remote))
+        plan.runtime_cache["remote_tasks"] = []
+        return (sorted(local + [si for si, _, _ in remote]),
+                RemoteTaskDispatch(cat, plan, settings, [], False))
+    tasks = [(si, node,
+              ep, dict(template,
+                       shard_id=plan.bound.table.shards[si].shard_id,
+                       node=node))
+             for si, node, ep in remote]
+    return list(local), RemoteTaskDispatch(
+        cat, plan, settings, tasks, template["kind"] == "agg")

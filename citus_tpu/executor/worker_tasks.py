@@ -25,10 +25,13 @@ Three pieces:
   entries), returning partial-agg states (or filtered projection rows)
   as one binary frame.
 - `push_remote_tasks` — the coordinator side: one `execute_task` RPC
-  per remote-only placement; returned partials merge with local ones
-  in the existing `combine_partials_host` stage.  Failures and
-  inexpressible shapes fall back to the `sync_placement` pull path,
-  governed by `SET citus.remote_task_execution = push|pull|auto`.
+  per remote-only placement, fanned out in parallel through the
+  adaptive dispatcher in executor/pipeline.py (per-node slow-start
+  windows under citus.max_adaptive_executor_pool_size); returned
+  partials merge with local ones in the existing
+  `combine_partials_host` stage.  Failures and inexpressible shapes
+  fall back to the `sync_placement` pull path, governed by
+  `SET citus.remote_task_execution = push|pull|auto`.
 """
 
 from __future__ import annotations
@@ -40,7 +43,7 @@ from typing import Optional
 import numpy as np
 
 from citus_tpu.errors import ExecutionError
-from citus_tpu.net.data_plane import _npz_bytes, _npz_load, decode_batch
+from citus_tpu.net.data_plane import _npz_bytes
 from citus_tpu.planner import bound as B
 from citus_tpu.planner.bind import BoundSelect
 from citus_tpu.planner.physical import (
@@ -320,46 +323,18 @@ def push_remote_tasks(cat, plan: PhysicalPlan, settings, params=((), ())):
     tuples ready for combine_partials_host; projection results are
     decoded (values, validity) batches.  Any per-shard failure (or an
     inexpressible plan) falls back to scanning that shard locally via
-    the pull path and bumps remote_task_fallbacks."""
-    from citus_tpu.executor.executor import GLOBAL_COUNTERS
-    local, remote = split_pushable(cat, plan, settings)
-    tlog: list = []
-    results: list = []
-    if not remote:
-        plan.runtime_cache["remote_tasks"] = tlog
-        return local, results
-    template = encode_task(plan, params)
-    if template is None:
-        GLOBAL_COUNTERS.bump("remote_task_fallbacks", len(remote))
-        plan.runtime_cache["remote_tasks"] = tlog
-        return sorted(local + [si for si, _, _ in remote]), results
-    is_agg = template["kind"] == "agg"
-    for si, node, ep in remote:
-        task = dict(template,
-                    shard_id=plan.bound.table.shards[si].shard_id,
-                    node=node)
-        t0 = time.perf_counter()
-        try:
-            meta, blob = cat.remote_data.call_binary(
-                ep, "execute_task", task)
-            if is_agg:
-                arrays = _npz_load(blob)
-                results.append(tuple(arrays[f"a__{i}"]
-                                     for i in range(len(arrays))))
-            else:
-                results.append(decode_batch(blob))
-        except Exception:
-            # worker dead, version skew, codec refused server-side:
-            # this shard scans locally through the pull path instead
-            GLOBAL_COUNTERS.bump("remote_task_fallbacks")
-            local.append(si)
-            continue
-        GLOBAL_COUNTERS.bump("remote_tasks_pushed")
-        GLOBAL_COUNTERS.bump("remote_task_result_bytes", len(blob))
-        tlog.append((si, int(node), len(blob),
-                     time.perf_counter() - t0))
-    plan.runtime_cache["remote_tasks"] = tlog
-    return sorted(local), results
+    the pull path and bumps remote_task_fallbacks.
+
+    Dispatch goes through the pipelined adaptive fan-out
+    (executor/pipeline.py): RPCs fly in parallel per node with
+    slow-start windows, so cross-host latency is the max of per-host
+    times rather than the sum.  Callers that want the overlap itself
+    (local scan while RPCs fly) call dispatch_remote_tasks directly
+    and collect() after their local work."""
+    from citus_tpu.executor.pipeline import dispatch_remote_tasks
+    local, dispatch = dispatch_remote_tasks(cat, plan, settings, params)
+    fallback, results = dispatch.collect()
+    return sorted(local + fallback), results
 
 
 def note_inexpressible(cat, plan: PhysicalPlan, settings) -> None:
@@ -372,6 +347,7 @@ def note_inexpressible(cat, plan: PhysicalPlan, settings) -> None:
     if remote:
         GLOBAL_COUNTERS.bump("remote_task_fallbacks", len(remote))
     plan.runtime_cache["remote_tasks"] = []
+    plan.runtime_cache["pipeline"] = {}
 
 
 # ------------------------------------------------------ worker side

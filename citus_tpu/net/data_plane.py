@@ -406,10 +406,19 @@ class DataPlaneClient:
     """Connection pool to peer coordinators' data servers, plus the
     remote placement cache (reads) and transfer helpers (moves)."""
 
+    #: idle pooled connections kept per endpoint (beyond the primary);
+    #: excess checkins close rather than hoard sockets
+    POOL_IDLE_MAX = 8
+
     def __init__(self, cat, secret: Optional[bytes] = None):
         self.cat = cat
         self.secret = secret
         self._conns: dict[tuple, RpcClient] = {}
+        # per-endpoint idle connections for CONCURRENT RPCs to one peer
+        # (RpcClient serializes on its socket; the adaptive executor's
+        # parallel dispatch needs one socket per in-flight task, like
+        # the reference's per-worker connection pools)
+        self._idle: dict[tuple, list] = {}
         self._lock = threading.Lock()
         self.stats = {"files_fetched": 0, "bytes_fetched": 0,
                       "batches_shipped": 0, "remote_syncs": 0}
@@ -457,6 +466,39 @@ class DataPlaneClient:
         except RpcError:
             self._drop_conn(endpoint)
             raise
+
+    def call_binary_pooled(self, endpoint: tuple, method: str,
+                           payload: dict):
+        """Like call_binary, but on a checked-out pooled connection so
+        concurrent calls to the SAME endpoint each get their own socket
+        (the primary connection serializes).  Failed connections are
+        closed, never returned to the pool."""
+        key = (endpoint[0], int(endpoint[1]))
+        with self._lock:
+            idle = self._idle.get(key)
+            c = idle.pop() if idle else None
+        if c is None:
+            # connect outside the lock, same rationale as _conn
+            c = RpcClient(key[0], key[1], secret=self.secret)
+        try:
+            out = c.call_binary(method, payload)
+        except BaseException:
+            try:
+                c.close()
+            except Exception:
+                pass
+            raise
+        with self._lock:
+            idle = self._idle.setdefault(key, [])
+            if len(idle) < self.POOL_IDLE_MAX:
+                idle.append(c)
+                c = None
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+        return out
 
     # ---- read path -----------------------------------------------------
     def cache_dir(self, table: str, shard_id: int, node: int) -> str:
@@ -599,9 +641,13 @@ class DataPlaneClient:
 
     def close(self) -> None:
         with self._lock:
-            for c in self._conns.values():
-                try:
-                    c.close()
-                except Exception:
-                    pass
+            conns = list(self._conns.values())
             self._conns.clear()
+            for idle in self._idle.values():
+                conns.extend(idle)
+            self._idle.clear()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass

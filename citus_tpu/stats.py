@@ -40,6 +40,14 @@ class StatCounters:
         "remote_task_fallbacks",
         "remote_task_result_bytes",
         "placement_sync_bytes",
+        # pipelined executor (executor/pipeline.py): stalls of the host
+        # decode / device dispatch halves, the high-water mark of
+        # concurrent remote-task RPCs, and remote wait hidden behind
+        # local work
+        "pipeline_host_stalls",
+        "pipeline_device_stalls",
+        "remote_tasks_inflight_peak",
+        "remote_task_wait_overlapped_ms",
     ]
 
     def __init__(self):
@@ -49,6 +57,11 @@ class StatCounters:
     def bump(self, name: str, by: int = 1) -> None:
         with self._mu:
             self._c[name] = self._c.get(name, 0) + by
+
+    def bump_max(self, name: str, value: int) -> None:
+        """High-water-mark counters: keep the max seen, not a sum."""
+        with self._mu:
+            self._c[name] = max(self._c.get(name, 0), value)
 
     def snapshot(self) -> dict[str, int]:
         with self._mu:

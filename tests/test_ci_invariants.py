@@ -42,6 +42,19 @@ def test_executor_pull_path_has_single_call_site():
     assert hits == {"batches.py": 1}, hits
 
 
+def test_remote_dispatch_is_parallel_only():
+    """Remote execute_task RPCs go through the parallel fan-out
+    (pipeline.RemoteTaskDispatch over pooled connections) — never a
+    sequential per-task call_binary loop in worker_tasks.py, which
+    would cost the SUM of per-host times instead of the max."""
+    wt = (PKG / "executor" / "worker_tasks.py").read_text()
+    assert "call_binary" not in wt, \
+        "worker_tasks.py must not dispatch RPCs itself"
+    assert "dispatch_remote_tasks" in wt
+    pl = (PKG / "executor" / "pipeline.py").read_text()
+    assert "call_binary_pooled" in pl
+
+
 def test_agg_registry_complete():
     """Every registered aggregate declares lower+finalize (bind may be
     None only for internal kinds the binder dispatches itself)."""
