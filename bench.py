@@ -147,6 +147,48 @@ def bench_concurrency(cl, extra: dict) -> None:
     }
 
 
+def bench_plan_cache(cl, extra: dict) -> None:
+    """Query-family compile amortization (executor/kernel_cache.py +
+    planner/auto_param.py): cold compile cost, warm plan-cache hit
+    latency, and the kernel hit rate across a Q6 literal family —
+    textually distinct SQL that hoists to one structural fingerprint."""
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    from citus_tpu.executor.kernel_cache import GLOBAL_KERNELS
+    GLOBAL_KERNELS.clear()
+    cl._plan_cache.invalidate_all()
+    c0 = GLOBAL_COUNTERS.snapshot()
+    t0 = time.perf_counter()
+    cl.execute(Q6)
+    cold_s = time.perf_counter() - t0
+    c1 = GLOBAL_COUNTERS.snapshot()
+    t0 = time.perf_counter()
+    cl.execute(Q6)
+    warm_s = time.perf_counter() - t0
+    c2 = GLOBAL_COUNTERS.snapshot()
+    variants = [Q6.replace("< 24", f"< {24 + i}") for i in (1, 2, 3, 4)]
+    v0 = GLOBAL_COUNTERS.snapshot()
+    t0 = time.perf_counter()
+    for q in variants:
+        cl.execute(q)
+    fam_s = (time.perf_counter() - t0) / len(variants)
+    v1 = GLOBAL_COUNTERS.snapshot()
+    hits = v1["kernel_cache_hits"] - v0["kernel_cache_hits"]
+    misses = v1["kernel_cache_misses"] - v0["kernel_cache_misses"]
+    extra["plan_cache"] = {
+        "cold_ms": round(cold_s * 1000, 1),
+        "cold_compile_ms":
+            c1["kernel_compile_ms"] - c0["kernel_compile_ms"],
+        "warm_hit_ms": round(warm_s * 1000, 1),
+        "warm_plan_cache_hit": bool(
+            c2["plan_cache_hits"] - c1["plan_cache_hits"]),
+        "literal_variant_avg_ms": round(fam_s * 1000, 1),
+        "literal_variant_kernel_hit_rate": round(
+            hits / max(1, hits + misses), 3),
+        "literal_variant_compile_ms":
+            v1["kernel_compile_ms"] - v0["kernel_compile_ms"],
+    }
+
+
 def ensure_join_data(cl: "ct.Cluster", n_orders: int) -> None:
     """orders_b: the build side of the repartition join, distributed on
     o_custkey so the l_orderkey = o_orderkey join must reshuffle."""
@@ -358,6 +400,8 @@ def main() -> None:
             }
     if os.environ.get("BENCH_CONCURRENCY", "1") != "0":
         bench_concurrency(cl, extra)
+    if os.environ.get("BENCH_PLAN_CACHE", "1") != "0":
+        bench_plan_cache(cl, extra)
     if os.environ.get("BENCH_JOIN", "1") != "0":
         n_orders = N_ROWS // 4
         ensure_join_data(cl, n_orders)

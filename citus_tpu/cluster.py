@@ -667,8 +667,18 @@ class Cluster:
         self.clock = CausalClock(data_dir)
         self.cdc = ChangeDataCapture(data_dir, self.settings.enable_change_data_capture)
         # plan cache keyed by SQL text (reference analog: prepared-statement
-        # plan caching + local_plan_cache.c); invalidated by table version
-        self._plan_cache: dict[str, tuple] = {}
+        # plan caching + local_plan_cache.c); entries are validated per
+        # lookup against their table's identity/version and the catalog
+        # object-state token — DDL on one table no longer evicts plans
+        # for others (planner/plan_cache.py)
+        from citus_tpu.executor.kernel_cache import (
+            GLOBAL_KERNELS, configure_persistent_cache,
+        )
+        from citus_tpu.planner.plan_cache import PlanCache
+        self._plan_cache = PlanCache()
+        GLOBAL_KERNELS.set_capacity(self.settings.executor.kernel_cache_size)
+        if self.settings.executor.jit_cache_dir:
+            configure_persistent_cache(self.settings.executor.jit_cache_dir)
         self._background_jobs = None
         self._maintenance = None
         # per-thread implicit sessions: {thread ident: (Thread, Session)}
@@ -1115,7 +1125,7 @@ class Cluster:
             return
         with self._write_lock(t, EXCLUSIVE):
             execute_truncate(self.catalog, self.catalog.table(name))
-        self._plan_cache.clear()
+        self._plan_cache.invalidate_table(name)
         if self._cdc_captures(t.name):
             self.cdc.emit(t.name, "truncate",
                           self.clock.transaction_clock(), force=True)
@@ -1294,7 +1304,7 @@ class Cluster:
             t.version += 1
             self.catalog.ddl_epoch += 1
             self.catalog.commit()
-        self._plan_cache.clear()
+        self._plan_cache.invalidate_table(t.name)
 
     def _execute_create_index(self, stmt: A.CreateIndex) -> Result:
         self.create_index(stmt.name, stmt.table, stmt.column,
@@ -1325,7 +1335,7 @@ class Cluster:
             t.version += 1
             self.catalog.ddl_epoch += 1
             self.catalog.commit()
-        self._plan_cache.clear()
+        self._plan_cache.invalidate_table(t.name)
         return Result(columns=[], rows=[])
 
     def create_distributed_table(self, name: str, dist_column: str,
@@ -2320,15 +2330,14 @@ class Cluster:
                 f"{len(params)} parameters were supplied")
         key = ("$param", sql)
         backend = self.settings.executor.task_executor_backend
-        cached = self._plan_cache.get(key)
-        if cached is not None:
-            bound, plan, version, epoch, cbackend = cached
-            if (epoch == self.catalog.ddl_epoch
-                    and bound.table.version == version
-                    and cbackend == backend):
+        cache_on = self.settings.planner.plan_cache_mode != "force_custom"
+        if cache_on:
+            entry = self._plan_cache.lookup(key, self.catalog, backend)
+            if entry is not None:
                 self.counters.bump("plan_cache_hits")
-                return execute_select(self.catalog, bound, self.settings,
-                                      plan=plan, param_values=params)
+                return execute_select(self.catalog, entry.bound,
+                                      self.settings, plan=entry.plan,
+                                      param_values=params)
         try:
             bound = bind_select(self.catalog, stmt, param_count=n_params)
         except UnsupportedFeatureError:
@@ -2336,11 +2345,42 @@ class Cluster:
         from citus_tpu.planner.physical import plan_select
         plan = plan_select(self.catalog, bound,
                            direct_limit=self.settings.planner.direct_gid_limit)
-        self._plan_cache[key] = (bound, plan, bound.table.version,
-                                 self.catalog.ddl_epoch, backend)
-        self.counters.bump("plan_cache_misses")
+        if cache_on:
+            self._plan_cache.put(key, bound, plan, self.catalog, backend)
+            self.counters.bump("plan_cache_misses")
         return execute_select(self.catalog, bound, self.settings, plan=plan,
                               param_values=params)
+
+    def _cached_select_plan(self, stmt: A.Select, key):
+        """Bind + plan a single-table SELECT through the surgical plan
+        cache, auto-parameterizing filter literals so literal variants
+        of one query family share a structural fingerprint (and thus
+        compiled kernels, executor/kernel_cache.py) even when their SQL
+        texts differ.  ``key`` None (internal recursion, no stable text)
+        skips caching entirely.  Returns (bound, plan, values, hit)."""
+        backend = self.settings.executor.task_executor_backend
+        mode = self.settings.planner.plan_cache_mode
+        cache_on = key is not None and mode != "force_custom"
+        if cache_on:
+            entry = self._plan_cache.lookup(key, self.catalog, backend)
+            if entry is not None:
+                self.counters.bump("plan_cache_hits")
+                return entry.bound, entry.plan, entry.values, True
+        bound = bind_select(self.catalog, stmt)
+        values = None
+        if cache_on:
+            from citus_tpu.planner.auto_param import auto_parameterize
+            ap = auto_parameterize(bound)
+            if ap is not None:
+                bound, values = ap
+        from citus_tpu.planner.physical import plan_select
+        plan = plan_select(self.catalog, bound,
+                           direct_limit=self.settings.planner.direct_gid_limit)
+        if cache_on:
+            self._plan_cache.put(key, bound, plan, self.catalog, backend,
+                                 values=values)
+            self.counters.bump("plan_cache_misses")
+        return bound, plan, values, False
 
     #: statement-recursion ceiling: subquery materialization, view
     #: expansion, and partition fan-out all re-enter _execute_stmt; a
@@ -2468,22 +2508,10 @@ class Cluster:
             bj = bind_join_select(self.catalog, stmt)
             return execute_join_select(self.catalog, bj, self.settings)
         if isinstance(stmt, A.Select):
-            cached = self._plan_cache.get(sql_text) if sql_text else None
-            if cached is not None:
-                bound, plan, version, epoch, backend = cached
-                if (epoch == self.catalog.ddl_epoch
-                        and bound.table.version == version
-                        and backend == self.settings.executor.task_executor_backend):
-                    return execute_select(self.catalog, bound, self.settings, plan=plan)
-            bound = bind_select(self.catalog, stmt)
-            from citus_tpu.planner.physical import plan_select
-            plan = plan_select(self.catalog, bound,
-                               direct_limit=self.settings.planner.direct_gid_limit)
-            if sql_text:
-                self._plan_cache[sql_text] = (
-                    bound, plan, bound.table.version, self.catalog.ddl_epoch,
-                    self.settings.executor.task_executor_backend)
-            return execute_select(self.catalog, bound, self.settings, plan=plan)
+            bound, plan, values, _ = self._cached_select_plan(
+                stmt, sql_text or None)
+            return execute_select(self.catalog, bound, self.settings,
+                                  plan=plan, param_values=values)
         # everything below SELECT dispatches through the per-statement
         # handler registry (commands/; the DistributeObjectOps analog)
         from citus_tpu.commands import loader as _loader

@@ -25,6 +25,15 @@ def _remote_task_mode(v) -> str:
     return s
 
 
+def _plan_cache_mode(v) -> str:
+    """citus.plan_cache_mode = auto | force_generic | force_custom
+    (reference: the plancache.c GUC of the same name)."""
+    s = str(v).lower()
+    if s not in ("auto", "force_generic", "force_custom"):
+        raise ValueError(s)
+    return s
+
+
 def _compute_ndistinct(cl, table: str, columns: list) -> int:
     """count(DISTINCT (cols)) — the extended-statistics ndistinct."""
     sel = A.Select(
@@ -49,6 +58,11 @@ _GUCS = {
     "citus.executor_prefetch_depth": ("executor", "executor_prefetch_depth", int),
     "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
     "citus.remote_task_execution": ("executor", "remote_task_execution", _remote_task_mode),
+    # query-family compile amortization (executor/kernel_cache.py,
+    # planner/auto_param.py)
+    "citus.plan_cache_mode": ("planner", "plan_cache_mode", _plan_cache_mode),
+    "citus.kernel_cache_size": ("executor", "kernel_cache_size", int),
+    "citus.jit_cache_dir": ("executor", "jit_cache_dir", str),
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
     "citus.shard_count": ("sharding", "shard_count", int),
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
@@ -133,6 +147,12 @@ def _execute_set(cl, stmt: A.SetConfig) -> Result:
         cl.settings = _dc.replace(cl.settings, **{section: sec})
     if key == "citus.enable_change_data_capture":
         cl.cdc.enabled = bool(v)
+    elif key == "citus.kernel_cache_size":
+        from citus_tpu.executor.kernel_cache import GLOBAL_KERNELS
+        GLOBAL_KERNELS.set_capacity(int(v))
+    elif key == "citus.jit_cache_dir":
+        from citus_tpu.executor.kernel_cache import configure_persistent_cache
+        configure_persistent_cache(v)
     cl._plan_cache.clear()  # backend/knob changes invalidate plans
     return Result(columns=[], rows=[])
 
@@ -204,7 +224,8 @@ def _execute_reindex(cl, stmt: A.Reindex) -> Result:
     if targets:
         cl.catalog.ddl_epoch += 1
         cl.catalog.commit()
-        cl._plan_cache.clear()
+        for tt, _cols in targets:
+            cl._plan_cache.invalidate_table(tt.name)
     return Result(columns=[], rows=[],
                   explain={"segments_rebuilt": rebuilt})
 

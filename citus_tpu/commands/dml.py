@@ -124,7 +124,7 @@ def _forward_remote_dml(cl, stmt, t, where):
         # whole statement, its host's own 2PC makes it atomic
         r = cl.catalog.remote_data.call(next(iter(endpoints)),
                                         "execute_sql", {"sql": sql})
-        cl._plan_cache.clear()
+        cl._plan_cache.invalidate_table(t.name)
         return Result(columns=r.get("columns", []),
                       rows=[tuple(row) for row in r.get("rows", [])],
                       explain=r.get("explain", {}))
@@ -171,7 +171,7 @@ def _txn_remote_dml(cl, stmt, t, sql: str, endpoints: list, txn,
         # local part runs normally; the handler adds these in
         cl._remote_counts.v = counts
         return None
-    cl._plan_cache.clear()
+    cl._plan_cache.invalidate_table(t.name)
     return Result(columns=[], rows=[], explain=counts)
 
 
@@ -357,7 +357,7 @@ def delete(cl, stmt):
             cl._remote_counts.v = None  # never leak into a later statement
     if pend:
         n += int(pend.get("deleted", 0))
-    cl._plan_cache.clear()
+    cl._plan_cache.invalidate_table(t.name)
     if cl._cdc_captures(t.name) and n:
         cl._emit_cdc(t.name, "delete", count=n)
     if ret is not None:
@@ -446,7 +446,7 @@ def update(cl, stmt):
             cl._remote_counts.v = None  # never leak into a later statement
     if pend:
         n += int(pend.get("updated", 0))
-    cl._plan_cache.clear()
+    cl._plan_cache.invalidate_table(t.name)
     if cl._cdc_captures(t.name) and n:
         cl._emit_cdc(t.name, "update", count=n)
     if ret is not None:
@@ -482,7 +482,7 @@ def merge(cl, stmt):
             cl.catalog, cl.txlog, stmt,
             encode_value=lambda tbl, col, v:
                 int(cl.catalog.encode_strings(tbl, col, [v])[0]))
-    cl._plan_cache.clear()
+    cl._plan_cache.invalidate_table(stmt.target.name)
     if cl._cdc_captures(stmt.target.name):
         cl.cdc.emit(stmt.target.name, "merge",
                     cl.clock.transaction_clock(), force=True,
@@ -564,7 +564,7 @@ def vacuum(cl, stmt):
         return cl._fanout_partitions(stmt, aggregate_explain=True)
     with cl._write_lock(t, EXCLUSIVE):
         st = execute_vacuum(cl.catalog, cl.catalog.table(stmt.table))
-    cl._plan_cache.clear()
+    cl._plan_cache.invalidate_table(t.name)
     return Result(columns=[], rows=[], explain=st)
 
 

@@ -153,8 +153,26 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
         lines.append(f"    Partials: " + ", ".join(
             f"{op.kind}[{op.dtype}]" for op in plan.partial_ops))
     if stmt.analyze:
-        r = execute_select(cl.catalog, bound, cl.settings)
+        # execute through the plan cache (keyed by the statement's AST
+        # repr, never the surrounding EXPLAIN text) so repeated ANALYZE
+        # shows real hit/miss + compile-amortization behavior
+        from citus_tpu.executor.kernel_cache import plan_fingerprint
+        c0 = cl.counters.snapshot()
+        xbound, xplan, values, cache_hit = cl._cached_select_plan(
+            stmt.statement, ("$explain", repr(stmt.statement)))
+        r = execute_select(cl.catalog, xbound, cl.settings, plan=xplan,
+                           param_values=values)
+        c1 = cl.counters.snapshot()
         lines.append(f"  Rows: {r.rowcount}  Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
+        compile_ms = c1.get("kernel_compile_ms", 0) \
+            - c0.get("kernel_compile_ms", 0)
+        lines.append(
+            f"  Plan Cache: {'hit' if cache_hit else 'miss'}  "
+            f"fingerprint {plan_fingerprint(xplan)[:12]}  "
+            f"compile {compile_ms} ms")
+        dh = c1.get("device_cache_hits", 0) - c0.get("device_cache_hits", 0)
+        dm = c1.get("device_cache_misses", 0) - c0.get("device_cache_misses", 0)
+        lines.append(f"  Device Cache: {dh} hit(s), {dm} miss(es)")
         tasks = r.explain.get("tasks") or []
         if tasks:
             lines.append(f"  Tasks: {len(tasks)}  Tasks Shown: One of {len(tasks)}")

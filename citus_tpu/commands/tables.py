@@ -236,7 +236,7 @@ def alter_table(cl, stmt):
         t0.foreign_keys[:] = fks_kept
         t0.version += 1
         cl.catalog.commit()
-        cl._plan_cache.clear()
+        cl._plan_cache.invalidate_table(stmt.table)
         return Result(columns=[], rows=[])
     if stmt.action == "set_default":
         import dataclasses as _dc
@@ -288,7 +288,7 @@ def alter_table(cl, stmt):
             t0.check_constraints.append({"name": ck_name,
                                          "sql": stmt.check_sql})
             cl.catalog.commit()
-        cl._plan_cache.clear()
+        cl._plan_cache.invalidate_table(stmt.table)
         return Result(columns=[], rows=[])
     if stmt.action == "add_column":
         from citus_tpu import types as T
@@ -405,5 +405,7 @@ def alter_table(cl, stmt):
         raise UnsupportedFeatureError(
             f"ALTER TABLE {stmt.action} not supported")
     cl.catalog.commit()
-    cl._plan_cache.clear()
+    # rename included: entries under the old name drop naturally — the
+    # old name no longer resolves to this TableMeta object
+    cl._plan_cache.invalidate_table(stmt.table)
     return Result(columns=[], rows=[])

@@ -49,6 +49,13 @@ class PlannerSettings:
     # Buckets per mesh axis for repartition, reference
     # citus.repartition_join_bucket_count_per_node.
     repartition_bucket_count_per_device: int = 1
+    # Plan caching for SELECTs (reference citus.plan_cache_mode /
+    # plancache.c): "auto" hoists filter literals into synthetic params
+    # so literal variants of one query family share a generic plan's
+    # compiled kernels; "force_generic" behaves the same (every cached
+    # plan is generic here); "force_custom" disables hoisting AND plan
+    # caching — every statement re-binds, re-plans, re-prunes.
+    plan_cache_mode: str = "auto"
 
 
 @dataclass
@@ -102,6 +109,13 @@ class ExecutorSettings:
     # first (sync_placement), "auto" pushes whenever the task codec can
     # express the plan and falls back to pull otherwise.
     remote_task_execution: str = "auto"
+    # Entry cap of the process-wide compiled-kernel LRU keyed by
+    # structural plan fingerprint (executor/kernel_cache.py) —
+    # citus.kernel_cache_size.
+    kernel_cache_size: int = 512
+    # Directory for JAX's persistent on-disk XLA compilation cache so
+    # process restarts skip compiles — citus.jit_cache_dir ("" = off).
+    jit_cache_dir: str = ""
 
 
 @dataclass

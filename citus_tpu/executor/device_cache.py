@@ -21,6 +21,11 @@ from typing import Optional
 DEFAULT_CAPACITY_BYTES = 6 << 30
 
 
+def _counters():
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    return GLOBAL_COUNTERS
+
+
 class DeviceBatchCache:
     def __init__(self, capacity_bytes: int = DEFAULT_CAPACITY_BYTES):
         self.capacity = capacity_bytes
@@ -33,9 +38,11 @@ class DeviceBatchCache:
         e = self._entries.get(key)
         if e is None:
             self.misses += 1
+            _counters().bump("device_cache_misses")
             return None
         self._entries.move_to_end(key)
         self.hits += 1
+        _counters().bump("device_cache_hits")
         return e[0]
 
     def put(self, key: tuple, batches: list, nbytes: int) -> None:
@@ -44,6 +51,7 @@ class DeviceBatchCache:
         while self._bytes + nbytes > self.capacity and self._entries:
             _, (_, old_bytes) = self._entries.popitem(last=False)
             self._bytes -= old_bytes
+            _counters().bump("device_cache_evicted_bytes", old_bytes)
         self._entries[key] = (batches, nbytes)
         self._bytes += nbytes
 

@@ -28,9 +28,13 @@ from citus_tpu.executor.batches import (
     ShardBatch, bucket_rows, empty_batch, load_shard_batches, pad_to_batch,
 )
 from citus_tpu.executor.finalize import finalize_groups, order_and_limit, project_rows
+from citus_tpu.executor.kernel_cache import get_kernel, jit_compile
 from citus_tpu.ops.scan_agg import build_worker_fn, combine_partials_host
+from citus_tpu.planner.auto_param import PHYSICAL_SRC, substitute_params
 from citus_tpu.planner.bind import BoundSelect
-from citus_tpu.planner.physical import PhysicalPlan, plan_select
+from citus_tpu.planner.physical import (
+    PhysicalPlan, _index_eq, extract_intervals, plan_select, prune_shards,
+)
 from citus_tpu.stats import StatCounters
 
 # process-wide counters (the citus_stat_counters analog); Cluster exposes
@@ -103,6 +107,12 @@ def encode_params(cat: Catalog, bound, values: Optional[list]):
         if v is None:
             pcols.append(np.zeros((), ptype.device_dtype))
             pvalids.append(np.zeros((), bool))
+            continue
+        if src == PHYSICAL_SRC:
+            # auto-parameterized literal: value is already bound-level
+            # physical (dates, scaled decimals, dictionary ids)
+            pcols.append(np.asarray(v, ptype.device_dtype))
+            pvalids.append(np.ones((), bool))
             continue
         if ptype.is_text:
             pid = cat.lookup_string_id(src[0], src[1], str(v))
@@ -267,11 +277,11 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         # separately
         mkey = key + ("mesh", n_dev)
         mcached = None if overlaid else GLOBAL_CACHE.get(mkey)
-        run = plan.runtime_cache.get("mesh_run")
-        if run is None:
-            worker = build_worker_fn(plan, jnp)
-            run = sharded_partial_agg(worker, kinds, mesh)
-            plan.runtime_cache["mesh_run"] = run
+        run = get_kernel(
+            plan, "mesh_run",
+            lambda: sharded_partial_agg(build_worker_fn(plan, jnp), kinds,
+                                        mesh),
+            extra=("mesh", n_dev))
         # parameters replicate across the shard axis ([n_dev] stacks of
         # the 0-d values); never cached — they change per execution
         p_stack = tuple(np.stack([p] * n_dev) for p in pcols)
@@ -353,10 +363,8 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     from collections import deque
 
     task_times: list = []
-    jitted = plan.runtime_cache.get("jit_worker")
-    if jitted is None:
-        jitted = jax.jit(build_worker_fn(plan, jnp))
-        plan.runtime_cache["jit_worker"] = jitted
+    jitted = get_kernel(plan, "jit_worker",
+                        lambda: jit_compile(build_worker_fn(plan, jnp)))
     # NOTE (round 5): the opt-in Pallas worker was removed rather than
     # shipped unproven.  The TPU tunnel was down for rounds 4 AND 5, so
     # the kernel could never Mosaic-compile on hardware (round 2 removed
@@ -369,8 +377,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     # behind an A/B in bench.py.
     def _worker_for(n_padded: int):
         return jitted
-    merge = plan.runtime_cache.get("jit_merge")
-    if merge is None:
+    def _build_merge():
         def _merge(a, b):
             out = []
             for x, y, kind in zip(a, b, kinds):
@@ -381,8 +388,8 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                 else:
                     out.append(jnp.maximum(x, y))
             return tuple(out)
-        merge = jax.jit(_merge)
-        plan.runtime_cache["jit_merge"] = merge
+        return jit_compile(_merge)
+    merge = get_kernel(plan, "jit_merge", _build_merge)
 
     # accumulate on device; a single device_get at the end avoids one
     # host round-trip per batch (the tunnel/PCIe latency dominates
@@ -565,10 +572,10 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         from citus_tpu.planner.bound import compile_expr as _ce
 
         S = settings.planner.hash_agg_slots
-        jitted = plan.runtime_cache.get("jit_hash_worker")
-        if jitted is None:
-            jitted = jax.jit(build_hash_agg_worker(plan, jnp, S))
-            plan.runtime_cache["jit_hash_worker"] = jitted
+        jitted = get_kernel(
+            plan, "jit_hash_worker",
+            lambda: jit_compile(build_hash_agg_worker(plan, jnp, S)),
+            extra=(S,))
         key_fns_np = [_ce(k, np) for k in plan.bound.group_keys]
         arg_fns_np = [_ce(a, np) for a in plan.agg_args]
         batches = _load_all_batches(cat, plan, settings)
@@ -609,10 +616,10 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                 jnp.concatenate([t[2] for t in dev_tables]),
             )
             mkey = f"jit_table_merge_{n_pad}"
-            merge_jit = plan.runtime_cache.get(mkey)
-            if merge_jit is None:
-                merge_jit = jax.jit(build_table_merge(plan, jnp, S))
-                plan.runtime_cache[mkey] = merge_jit
+            merge_jit = get_kernel(
+                plan, mkey,
+                lambda: jit_compile(build_table_merge(plan, jnp, S)),
+                extra=(S,))
             key_tables, partials, rows, entry_spill = merge_jit(*entries)
         else:
             key_tables, partials, rows = dev_tables[0]
@@ -679,16 +686,15 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         import jax.numpy as jnp
         from citus_tpu.planner.bound import compile_expr, predicate_mask
 
-        filter_fn = plan.runtime_cache.get("jit_filter")
-        if filter_fn is None:
+        def _build_filter():
             cfn = compile_expr(plan.bound.filter, jnp)
             all_names = tuple(plan.scan_columns) + pnames
 
             def device_mask(cols, valids, row_mask):
                 env = {n: (c, v) for n, c, v in zip(all_names, cols, valids)}
                 return row_mask & predicate_mask(jnp, cfn, env, row_mask)
-            filter_fn = jax.jit(device_mask)
-            plan.runtime_cache["jit_filter"] = filter_fn
+            return jit_compile(device_mask)
+        filter_fn = get_kernel(plan, "jit_filter", _build_filter)
 
     def _scan_shards(rp, out: list) -> None:
         for si in rp.shard_indexes:
@@ -776,6 +782,29 @@ def _guard_remote_written(cat, table_names) -> None:
             "not visible here); COMMIT first")
 
 
+def _bind_time_prune(plan: PhysicalPlan, params) -> PhysicalPlan:
+    """Custom-plan pruning for one execution of a generic plan: the
+    bind-time physical param values are substituted back into the filter
+    and the shard set, chunk intervals, tenant router key and index
+    fast-path are re-derived — a cached generic plan prunes exactly like
+    a freshly-planned literal query (reference: deferred pruning on
+    Job->deferredPruning).  The shared runtime_cache dict rides along,
+    so jitted kernels are reused across parameter values."""
+    bound = plan.bound
+    pcols, pvalids = params
+    phys = [pcols[i].item() if bool(pvalids[i]) else None
+            for i in range(len(pcols))]
+    sub = substitute_params(bound.filter, phys)
+    shard_indexes, router_key = prune_shards(bound.table, sub, return_key=True)
+    if plan.router_param is not None and phys[plan.router_param] is None:
+        shard_indexes = []  # dist = NULL matches nothing
+    import dataclasses
+    return dataclasses.replace(
+        plan, shard_indexes=shard_indexes, router_key=router_key,
+        intervals=extract_intervals(sub),
+        index_eq=_index_eq(bound.table, sub))
+
+
 def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
                    plan: Optional[PhysicalPlan] = None,
                    param_values: Optional[list] = None) -> Result:
@@ -785,13 +814,9 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
         plan = plan_select(cat, bound, direct_limit=settings.planner.direct_gid_limit)
     params = encode_params(cat, bound, param_values)
     if bound.param_specs:
-        # deferred pruning: resolve the shard set for THESE parameter
-        # values on a per-execution view of the cached plan (shared
-        # runtime_cache, so jitted kernels are reused across values)
-        resolved = plan.resolve_shards(param_values)
-        if resolved != plan.shard_indexes:
-            import dataclasses
-            plan = dataclasses.replace(plan, shard_indexes=resolved)
+        # deferred pruning: re-derive the shard/interval view of the
+        # cached generic plan for THESE parameter values
+        plan = _bind_time_prune(plan, params)
     GLOBAL_COUNTERS.bump("queries_executed")
     if plan.is_router:
         GLOBAL_COUNTERS.bump("router_queries")
@@ -821,11 +846,8 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
                 run_plan = plan_select(
                     cat, bound,
                     direct_limit=settings.planner.direct_gid_limit)
-                if bound.param_specs and param_values is not None:
-                    import dataclasses as _dc
-                    run_plan = _dc.replace(
-                        run_plan,
-                        shard_indexes=run_plan.resolve_shards(param_values))
+                if bound.param_specs:
+                    run_plan = _bind_time_prune(run_plan, params)
             if bound.has_aggs:
                 return _run_agg(cat, run_plan, settings, params)
             return _run_projection(cat, run_plan, settings, params)

@@ -10,12 +10,13 @@ coordinator gather plus a combine query for the same step
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+from citus_tpu.executor.kernel_cache import jit_compile
 
 SHARD_AXIS = "shard"
 
@@ -72,7 +73,6 @@ def sharded_partial_agg(worker, combine_kinds: list[str], mesh: Mesh) -> Callabl
 
     n_in = None  # in_specs built per call from pytree structure
 
-    @functools.partial(jax.jit, static_argnums=())
     def run(cols, valids, row_mask):
         in_specs = (
             tuple(P(SHARD_AXIS) for _ in cols),
@@ -87,4 +87,4 @@ def sharded_partial_agg(worker, combine_kinds: list[str], mesh: Mesh) -> Callabl
                               out_specs=out_specs, check_vma=False)
         return fn(cols, valids, row_mask)
 
-    return run
+    return jit_compile(run)

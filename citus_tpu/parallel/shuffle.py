@@ -18,7 +18,6 @@ reference's dynamically-sized bucket files.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -26,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from citus_tpu.executor.kernel_cache import jit_compile
 from citus_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat
 
 
@@ -89,7 +89,7 @@ def build_repartition(mesh: Mesh, n_cols: int, capacity: int):
     out_specs = (tuple(P(SHARD_AXIS) for _ in range(n_cols)), P(SHARD_AXIS), P())
     fn = shard_map_compat(per_device, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_vma=False)
-    return jax.jit(fn)
+    return jit_compile(fn)
 
 
 def _sorted_join_indexes(lgid, lvalid, rgid, rvalid, join_cap: int):
@@ -174,7 +174,7 @@ def build_repartition_join(mesh: Mesh, n_lcols: int, n_rcols: int,
     out_specs = (cols(n_lcols), cols(n_rcols), P(SHARD_AXIS), P())
     fn = shard_map_compat(per_device, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_vma=False)
-    return jax.jit(fn)
+    return jit_compile(fn)
 
 
 def repartition_host(values: tuple, target: np.ndarray, mask: np.ndarray,

@@ -731,5 +731,7 @@ def predicate_mask(xp, fn, env, n_rows_like):
     else:
         out = v & valid
     if not (hasattr(out, "shape") and out.shape):
-        out = xp.full(n_rows_like.shape, bool(out)) if hasattr(n_rows_like, "shape") else out
+        # 0-d predicate (param-only / hoisted-literal comparison): keep
+        # it symbolic — bool() would fail on a traced scalar under jit
+        out = xp.full(n_rows_like.shape, out) if hasattr(n_rows_like, "shape") else out
     return out

@@ -48,6 +48,20 @@ class StatCounters:
         "pipeline_device_stalls",
         "remote_tasks_inflight_peak",
         "remote_task_wait_overlapped_ms",
+        # surgical plan-cache invalidation (planner/plan_cache.py):
+        # targeted entry drops and LRU pressure
+        "plan_cache_invalidations",
+        "plan_cache_evictions",
+        # process-wide compiled-kernel LRU keyed by structural plan
+        # fingerprint (executor/kernel_cache.py); compile_ms books the
+        # trace+compile wall time XLA spends on true misses
+        "kernel_cache_hits",
+        "kernel_cache_misses",
+        "kernel_compile_ms",
+        # HBM-resident batch cache (executor/device_cache.py)
+        "device_cache_hits",
+        "device_cache_misses",
+        "device_cache_evicted_bytes",
     ]
 
     def __init__(self):

@@ -55,6 +55,18 @@ def test_remote_dispatch_is_parallel_only():
     assert "call_binary_pooled" in pl
 
 
+def test_jit_confined_to_kernel_cache():
+    """``jax.jit`` is invoked only inside executor/kernel_cache.py
+    (through its jit_compile wrapper), so per-plan ad-hoc compiles —
+    invisible to the kernel cache and its compile-time accounting —
+    cannot silently regrow anywhere in the package."""
+    hits = []
+    for p in PKG.rglob("*.py"):
+        if "jax.jit" in p.read_text():
+            hits.append(str(p.relative_to(PKG)))
+    assert hits == ["executor/kernel_cache.py"], hits
+
+
 def test_agg_registry_complete():
     """Every registered aggregate declares lower+finalize (bind may be
     None only for internal kinds the binder dispatches itself)."""

@@ -116,6 +116,26 @@ def test_push_projection(pair):
     assert snap["placement_sync_bytes"] == 0
 
 
+def test_pushed_literal_variants_share_kernels(pair):
+    """Acceptance criterion on the push path: re-running a literal
+    variant of a cross-host aggregate performs zero new XLA compiles —
+    the coordinator-side merge AND the worker-side execute_task kernels
+    (decoded plans, same structural fingerprint) all land in the
+    process-wide kernel LRU."""
+    a, b, na, nb = pair
+    n = _load(a)
+    r1 = a.execute("SELECT count(*), sum(v) FROM t WHERE v < 60000")
+    assert r1.rows == [(n, 3 * n * (n - 1) // 2)]
+    snap0 = GLOBAL_COUNTERS.snapshot()
+    r2 = a.execute("SELECT count(*), sum(v) FROM t WHERE v < 60003")
+    snap1 = GLOBAL_COUNTERS.snapshot()
+    assert r2.rows == r1.rows  # both predicates keep every row
+    assert snap1["remote_tasks_pushed"] > snap0["remote_tasks_pushed"]
+    assert snap1["kernel_cache_hits"] > snap0["kernel_cache_hits"]
+    assert snap1["kernel_cache_misses"] == snap0["kernel_cache_misses"]
+    assert snap1["kernel_compile_ms"] == snap0["kernel_compile_ms"]
+
+
 def test_explain_analyze_shows_remote_tasks(pair):
     a, b, na, nb = pair
     _load(a)
