@@ -189,6 +189,36 @@ def bench_plan_cache(cl, extra: dict) -> None:
     }
 
 
+def bench_trace_overhead(cl, extra: dict) -> None:
+    """Tracing cost (observability/): warm Q1 wall time with sampling
+    off (the allocation-free no-op recorder) vs sample_rate=1.0 (every
+    span recorded).  The acceptance bar is < 3% overhead at rate 0
+    relative to this build's own untraced baseline — measured here as
+    rate-0 vs rate-0 jitter-adjusted by taking the best of several
+    reps, the same protocol the headline metric uses."""
+    reps = int(os.environ.get("BENCH_TRACE_REPS", "3"))
+
+    def best_of(sql: str) -> float:
+        cl.execute(sql)  # warm
+        return min(_t_wall(cl, sql) for _ in range(reps))
+
+    def _t_wall(cl, sql):
+        t0 = time.perf_counter()
+        cl.execute(sql)
+        return time.perf_counter() - t0
+
+    cl.execute("SET citus.trace_sample_rate = 0")
+    off_s = best_of(Q1)
+    cl.execute("SET citus.trace_sample_rate = 1.0")
+    on_s = best_of(Q1)
+    cl.execute("SET citus.trace_sample_rate = 0")
+    extra["trace_overhead"] = {
+        "q1_rate0_ms": round(off_s * 1000, 2),
+        "q1_rate1_ms": round(on_s * 1000, 2),
+        "sampled_overhead_fraction": round(max(0.0, on_s / off_s - 1.0), 4),
+    }
+
+
 def ensure_join_data(cl: "ct.Cluster", n_orders: int) -> None:
     """orders_b: the build side of the repartition join, distributed on
     o_custkey so the l_orderkey = o_orderkey join must reshuffle."""
@@ -402,6 +432,8 @@ def main() -> None:
         bench_concurrency(cl, extra)
     if os.environ.get("BENCH_PLAN_CACHE", "1") != "0":
         bench_plan_cache(cl, extra)
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        bench_trace_overhead(cl, extra)
     if os.environ.get("BENCH_JOIN", "1") != "0":
         n_orders = N_ROWS // 4
         ensure_join_data(cl, n_orders)

@@ -25,6 +25,7 @@ from citus_tpu.errors import (
 )
 from citus_tpu.executor import Result, execute_select
 from citus_tpu.ingest import TableIngestor, encode_columns, rows_to_columns
+from citus_tpu.observability import trace as _trace
 from citus_tpu.planner import ast as A
 from citus_tpu.planner import parse_sql
 from citus_tpu.planner.bind import bind_select
@@ -1908,7 +1909,7 @@ class Cluster:
 
     def execute(self, sql: str, params: Optional[Sequence[Any]] = None,
                 role: Optional[str] = None, session=None) -> Result:
-        import time as _time
+        from citus_tpu.observability.trace import clock as _clock
         if session is None:
             session = self._default_session()
         if session.txn is None:
@@ -1916,13 +1917,29 @@ class Cluster:
             # (statements hold references into it; PostgreSQL blocks
             # conflicting DDL with locks instead)
             self._maybe_reload_catalog()
-        stmts = parse_sql(sql)
+        # sampling gate: None on the unsampled hot path (no Span ever
+        # allocates); a nested execute() (EXECUTE of a prepared
+        # statement) joins the outer trace instead of rooting a new one
+        qt = None
+        if _trace.current() is None:
+            qt = _trace.begin_query(sql, self.settings.observability)
+        try:
+            with _trace.span("parse"):
+                stmts = parse_sql(sql)
+        except BaseException:
+            if qt is not None:
+                qt.finish()
+            raise
         if role is not None:
             for stmt in stmts:
                 self._check_privileges(role, stmt)
         result = Result(columns=[], rows=[])
         gpid = self.activity.enter(sql)
-        t0 = _time.perf_counter()
+        # live phase reporting: executor set_phase() calls land on this
+        # statement's activity row (works with or without sampling)
+        _trace.push_phase_sink(
+            lambda phase, _g=gpid: self.activity.set_phase(_g, phase))
+        t0 = _clock()
         # active role for statements synthesized mid-execution (the
         # upsert's internal UPDATE must see the same RLS policies);
         # per-thread: concurrent execute() calls must not see each
@@ -1980,18 +1997,41 @@ class Cluster:
                 self._exec_roles.pop(_tid, None)
             else:
                 self._exec_roles[_tid] = _prev_role
+            _trace.pop_phase_sink()
             self.activity.exit(gpid)
+            if qt is not None:
+                self._finish_query_trace(qt, sql)
         # the nested execute() of an EXECUTE already recorded the
         # underlying statement — don't double-count the wrapper
         if not (len(stmts) == 1 and isinstance(stmts[0], A.ExecutePrepared)):
             executor = result.explain.get("strategy", "utility") if result.explain else "utility"
-            elapsed = _time.perf_counter() - t0
+            elapsed = _clock() - t0
             rkey = result.explain.get("router_key") if result.explain else None
             self.query_stats.record(sql, elapsed, result.rowcount, str(executor),
                                     partition_key="" if rkey is None else str(rkey))
             if rkey is not None:
                 self.tenant_stats.record(str(rkey), elapsed)
         return result
+
+    def _finish_query_trace(self, qt, sql: str) -> None:
+        """Close a sampled query's trace: slow-log capture at the
+        citus.log_min_duration_ms threshold, Chrome-trace export when
+        citus.trace_export_dir is set, last-trace debug hook."""
+        from citus_tpu.observability.export import write_chrome_trace
+        from citus_tpu.observability.slowlog import GLOBAL_SLOW_LOG
+        obs = self.settings.observability
+        dur_ms = qt.finish()
+        slow = obs.log_min_duration_ms >= 0 \
+            and dur_ms >= obs.log_min_duration_ms
+        if slow:
+            GLOBAL_SLOW_LOG.record(sql, dur_ms, qt.trace)
+        if qt.sampled or slow:
+            _trace.set_last(qt.trace)
+            if obs.trace_export_dir:
+                try:
+                    write_chrome_trace(qt.trace, obs.trace_export_dir)
+                except OSError:
+                    pass  # export is best-effort; never fail the query
 
     def _execute_in_session(self, stmt, sql, stmts, params, role) -> Result:
         """One statement through parameter substitution, RLS rewrite,
@@ -2331,23 +2371,30 @@ class Cluster:
         key = ("$param", sql)
         backend = self.settings.executor.task_executor_backend
         cache_on = self.settings.planner.plan_cache_mode != "force_custom"
+        _trace.set_phase("plan")
         if cache_on:
             entry = self._plan_cache.lookup(key, self.catalog, backend)
             if entry is not None:
                 self.counters.bump("plan_cache_hits")
+                with _trace.span("plan", cache_hit=True):
+                    pass
                 return execute_select(self.catalog, entry.bound,
                                       self.settings, plan=entry.plan,
                                       param_values=params)
-        try:
-            bound = bind_select(self.catalog, stmt, param_count=n_params)
-        except UnsupportedFeatureError:
-            return None  # fall back to literal substitution
-        from citus_tpu.planner.physical import plan_select
-        plan = plan_select(self.catalog, bound,
-                           direct_limit=self.settings.planner.direct_gid_limit)
-        if cache_on:
-            self._plan_cache.put(key, bound, plan, self.catalog, backend)
-            self.counters.bump("plan_cache_misses")
+        with _trace.span("plan", cache_hit=False):
+            try:
+                with _trace.span("bind"):
+                    bound = bind_select(self.catalog, stmt,
+                                        param_count=n_params)
+            except UnsupportedFeatureError:
+                return None  # fall back to literal substitution
+            from citus_tpu.planner.physical import plan_select
+            plan = plan_select(
+                self.catalog, bound,
+                direct_limit=self.settings.planner.direct_gid_limit)
+            if cache_on:
+                self._plan_cache.put(key, bound, plan, self.catalog, backend)
+                self.counters.bump("plan_cache_misses")
         return execute_select(self.catalog, bound, self.settings, plan=plan,
                               param_values=params)
 
@@ -2361,25 +2408,39 @@ class Cluster:
         backend = self.settings.executor.task_executor_backend
         mode = self.settings.planner.plan_cache_mode
         cache_on = key is not None and mode != "force_custom"
+        _trace.set_phase("plan")
         if cache_on:
             entry = self._plan_cache.lookup(key, self.catalog, backend)
             if entry is not None:
                 self.counters.bump("plan_cache_hits")
+                with _trace.span("plan", cache_hit=True) as psp:
+                    if psp.recording:
+                        from citus_tpu.executor.kernel_cache import (
+                            plan_fingerprint,
+                        )
+                        psp.set(fingerprint=plan_fingerprint(entry.plan)[:12])
                 return entry.bound, entry.plan, entry.values, True
-        bound = bind_select(self.catalog, stmt)
-        values = None
-        if cache_on:
-            from citus_tpu.planner.auto_param import auto_parameterize
-            ap = auto_parameterize(bound)
-            if ap is not None:
-                bound, values = ap
-        from citus_tpu.planner.physical import plan_select
-        plan = plan_select(self.catalog, bound,
-                           direct_limit=self.settings.planner.direct_gid_limit)
-        if cache_on:
-            self._plan_cache.put(key, bound, plan, self.catalog, backend,
-                                 values=values)
-            self.counters.bump("plan_cache_misses")
+        with _trace.span("plan", cache_hit=False) as psp:
+            with _trace.span("bind"):
+                bound = bind_select(self.catalog, stmt)
+            values = None
+            if cache_on:
+                from citus_tpu.planner.auto_param import auto_parameterize
+                with _trace.span("auto_param"):
+                    ap = auto_parameterize(bound)
+                if ap is not None:
+                    bound, values = ap
+            from citus_tpu.planner.physical import plan_select
+            plan = plan_select(
+                self.catalog, bound,
+                direct_limit=self.settings.planner.direct_gid_limit)
+            if cache_on:
+                self._plan_cache.put(key, bound, plan, self.catalog, backend,
+                                     values=values)
+                self.counters.bump("plan_cache_misses")
+            if psp.recording:
+                from citus_tpu.executor.kernel_cache import plan_fingerprint
+                psp.set(fingerprint=plan_fingerprint(plan)[:12])
         return bound, plan, values, False
 
     #: statement-recursion ceiling: subquery materialization, view

@@ -34,6 +34,14 @@ def _plan_cache_mode(v) -> str:
     return s
 
 
+def _sample_rate(v) -> float:
+    """citus.trace_sample_rate = 0.0 .. 1.0."""
+    f = float(v)
+    if not 0.0 <= f <= 1.0:
+        raise ValueError(v)
+    return f
+
+
 def _compute_ndistinct(cl, table: str, columns: list) -> int:
     """count(DISTINCT (cols)) — the extended-statistics ndistinct."""
     sel = A.Select(
@@ -63,6 +71,12 @@ _GUCS = {
     "citus.plan_cache_mode": ("planner", "plan_cache_mode", _plan_cache_mode),
     "citus.kernel_cache_size": ("executor", "kernel_cache_size", int),
     "citus.jit_cache_dir": ("executor", "jit_cache_dir", str),
+    # distributed tracing (observability/): span-tree sampling rate,
+    # slow-query force-capture threshold (ms; -1 off), Chrome-trace
+    # export directory ("" off)
+    "citus.trace_sample_rate": ("observability", "trace_sample_rate", _sample_rate),
+    "citus.log_min_duration_ms": ("observability", "log_min_duration_ms", float),
+    "citus.trace_export_dir": ("observability", "trace_export_dir", str),
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
     "citus.shard_count": ("sharding", "shard_count", int),
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
@@ -169,6 +183,13 @@ def _guc_value(cl, key: str) -> str:
     return str(v)
 
 def _execute_show(cl, stmt: A.ShowConfig) -> Result:
+    if stmt.name.lower() == "citus.metrics":
+        # SHOW citus.metrics: the Prometheus text exposition, one row
+        # per line (scripts/metrics_exporter.py serves the same text)
+        from citus_tpu.observability.export import prometheus_text
+        return Result(columns=["metrics"],
+                      rows=[(line,) for line in
+                            prometheus_text(cl).splitlines()])
     if stmt.name == "all":
         rows = [(k, _guc_value(cl, k)) for k in sorted(_GUCS)]
         return Result(columns=["name", "setting"], rows=rows)

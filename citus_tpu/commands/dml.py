@@ -15,6 +15,7 @@ from citus_tpu.errors import (
     AnalysisError, ExecutionError, UnsupportedFeatureError,
 )
 from citus_tpu.executor import Result
+from citus_tpu.observability import trace as _trace
 from citus_tpu.planner import ast as A
 
 
@@ -253,6 +254,14 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
         # now; raising before it would strand a committed prepared
         # branch), then the remote decides — divergence surfaces after
         # local state is consistent
+        _c_span = _trace.span("2pc_decide", participants=len(endpoints))
+        _c_span.__enter__()
+        try:
+            _complete_commit_body()
+        finally:
+            _c_span.__exit__(None, None, None)
+
+    def _complete_commit_body() -> None:
         if local_session is not None and local_session.txn is not None:
             cl._finish_branch(local_session, True)
         cl._plan_cache.clear()
@@ -271,32 +280,35 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
                 f"resolved={divergence[1]!r} after a committed outcome")
 
     try:
-        for ep in endpoints:
-            r = cl.catalog.remote_data.call(
-                ep, "dml_prepare", {"gxid": gxid, "sql": sql})
-            prepared.append(ep)
-            for k, v in (r.get("explain") or {}).items():
-                if isinstance(v, (int, float)):
-                    counts[k] = counts.get(k, 0) + v
-        if has_local:
-            local_session = cl.session()
-            guard = cl._remote_exec_guard
-            prev = getattr(guard, "v", False)
-            guard.v = True
-            try:
-                local_session.execute("BEGIN")
-                r = local_session.execute(sql)
-                cl._prepare_branch(local_session, gxid)
-                local_prepared = True
-            finally:
-                guard.v = prev
-            for k, v in (r.explain or {}).items():
-                if isinstance(v, (int, float)):
-                    counts[k] = counts.get(k, 0) + v
+        with _trace.span("2pc_prepare", participants=len(endpoints),
+                         local=bool(has_local)):
+            for ep in endpoints:
+                r = cl.catalog.remote_data.call(
+                    ep, "dml_prepare", {"gxid": gxid, "sql": sql})
+                prepared.append(ep)
+                for k, v in (r.get("explain") or {}).items():
+                    if isinstance(v, (int, float)):
+                        counts[k] = counts.get(k, 0) + v
+            if has_local:
+                local_session = cl.session()
+                guard = cl._remote_exec_guard
+                prev = getattr(guard, "v", False)
+                guard.v = True
+                try:
+                    local_session.execute("BEGIN")
+                    r = local_session.execute(sql)
+                    cl._prepare_branch(local_session, gxid)
+                    local_prepared = True
+                finally:
+                    guard.v = prev
+                for k, v in (r.explain or {}).items():
+                    if isinstance(v, (int, float)):
+                        counts[k] = counts.get(k, 0) + v
         # THE commit point: first writer into the durable decision
         # register wins — if a participant's presumed-abort claim got
         # there first, WE must abort
-        winner = cl._control.record_txn_outcome(gxid, "commit")
+        with _trace.span("2pc_commit_point"):
+            winner = cl._control.record_txn_outcome(gxid, "commit")
         if winner != "commit":
             raise ExecutionError(
                 "cross-host transaction aborted by a participant "

@@ -153,54 +153,87 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
         lines.append(f"    Partials: " + ", ".join(
             f"{op.kind}[{op.dtype}]" for op in plan.partial_ops))
     if stmt.analyze:
-        # execute through the plan cache (keyed by the statement's AST
-        # repr, never the surrounding EXPLAIN text) so repeated ANALYZE
-        # shows real hit/miss + compile-amortization behavior
-        from citus_tpu.executor.kernel_cache import plan_fingerprint
-        c0 = cl.counters.snapshot()
+        lines.extend(_run_analyze(cl, stmt))
+    return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+
+
+def _run_analyze(cl, stmt: A.Explain) -> list[str]:
+    """Execute the statement under a FORCED trace and render every
+    timing line from the resulting span tree (the same tree the
+    Chrome-trace exporter and slow-query ring see), so EXPLAIN ANALYZE
+    can never drift from the tracing instrumentation.
+
+    Executes through the plan cache (keyed by the statement's AST repr,
+    never the surrounding EXPLAIN text) so repeated ANALYZE shows real
+    hit/miss + compile-amortization behavior."""
+    from citus_tpu.executor.kernel_cache import plan_fingerprint
+    from citus_tpu.observability import trace as _trace
+    c0 = cl.counters.snapshot()
+    qt = _trace.begin_query(f"explain analyze {stmt.statement!r:.80}",
+                            cl.settings.observability, force=True)
+    try:
         xbound, xplan, values, cache_hit = cl._cached_select_plan(
             stmt.statement, ("$explain", repr(stmt.statement)))
         r = execute_select(cl.catalog, xbound, cl.settings, plan=xplan,
                            param_values=values)
-        c1 = cl.counters.snapshot()
-        lines.append(f"  Rows: {r.rowcount}  Elapsed: {r.explain['elapsed_s']*1000:.2f} ms")
-        compile_ms = c1.get("kernel_compile_ms", 0) \
-            - c0.get("kernel_compile_ms", 0)
-        lines.append(
-            f"  Plan Cache: {'hit' if cache_hit else 'miss'}  "
-            f"fingerprint {plan_fingerprint(xplan)[:12]}  "
-            f"compile {compile_ms} ms")
-        dh = c1.get("device_cache_hits", 0) - c0.get("device_cache_hits", 0)
-        dm = c1.get("device_cache_misses", 0) - c0.get("device_cache_misses", 0)
-        lines.append(f"  Device Cache: {dh} hit(s), {dm} miss(es)")
-        tasks = r.explain.get("tasks") or []
-        if tasks:
-            lines.append(f"  Tasks: {len(tasks)}  Tasks Shown: One of {len(tasks)}")
-            si, nrows, dt = tasks[0]
-            lines.append(f"    -> Task (shard index {si}): {nrows} rows, "
-                         f"{dt*1000:.2f} ms device dispatch")
-        rtasks = r.explain.get("remote_tasks") or []
-        if rtasks:
-            lines.append(f"  Remote Tasks: {len(rtasks)}")
-            for si, node, nbytes, rpc_s, dec_s in rtasks:
-                lines.append(f"    -> Task (shard index {si}): pushed to "
-                             f"node {node}, {nbytes} result bytes, "
-                             f"{rpc_s*1000:.2f} ms rpc, "
-                             f"{dec_s*1000:.2f} ms decode")
-        pl = r.explain.get("pipeline") or {}
-        if pl:
+    finally:
+        qt.finish()
+    c1 = cl.counters.snapshot()
+    tr = qt.trace
+    _trace.set_last(tr)
+    lines = []
+    ex = tr.find("execute")
+    elapsed_ms = ex.duration_ms if ex is not None \
+        else r.explain["elapsed_s"] * 1000
+    lines.append(f"  Rows: {r.rowcount}  Elapsed: {elapsed_ms:.2f} ms")
+    ps = tr.find("plan")
+    hit = ps.attrs.get("cache_hit", cache_hit) if ps is not None \
+        else cache_hit
+    fp = (ps.attrs.get("fingerprint") if ps is not None else None) \
+        or plan_fingerprint(xplan)[:12]
+    compile_ms = int(sum(s.duration_ms
+                         for s in tr.find_all("kernel_compile")))
+    lines.append(f"  Plan Cache: {'hit' if hit else 'miss'}  "
+                 f"fingerprint {fp}  compile {compile_ms} ms")
+    dh = c1.get("device_cache_hits", 0) - c0.get("device_cache_hits", 0)
+    dm = c1.get("device_cache_misses", 0) - c0.get("device_cache_misses", 0)
+    lines.append(f"  Device Cache: {dh} hit(s), {dm} miss(es)")
+    rounds = tr.find_all("device_round")
+    tasks = r.explain.get("tasks") or []
+    if tasks:
+        lines.append(f"  Tasks: {len(tasks)}  "
+                     f"Tasks Shown: One of {len(tasks)}")
+        si, nrows, dt = tasks[0]
+        lines.append(f"    -> Task (shard index {si}): {nrows} rows, "
+                     f"{dt*1000:.2f} ms device dispatch")
+    elif rounds:
+        lines.append(f"  Device Rounds: {len(rounds)}  "
+                     f"({sum(s.duration_ms for s in rounds):.2f} ms)")
+    rtasks = tr.find_all("remote_task")
+    if rtasks:
+        lines.append(f"  Remote Tasks: {len(rtasks)}")
+        for s in rtasks:
             lines.append(
-                f"  Pipeline: host decode {pl.get('host_decode_ms', 0):.2f}"
-                f" ms, device {pl.get('device_ms', 0):.2f} ms, "
-                f"H2D {pl.get('h2d_bytes', 0)} bytes, "
-                f"stalls host={pl.get('host_stalls', 0)} "
-                f"device={pl.get('device_stalls', 0)}")
-            if "remote_wait_ms" in pl:
-                lines.append(
-                    f"    Remote Wait: {pl['remote_wait_ms']:.2f} ms "
-                    f"(overlapped {pl['remote_overlapped_ms']:.2f} ms, "
-                    f"peak in-flight {pl['remote_inflight_peak']})")
-    return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+                f"    -> Task (shard index {s.attrs.get('shard_index')}): "
+                f"pushed to node {s.attrs.get('node')}, "
+                f"{s.attrs.get('bytes', 0)} result bytes, "
+                f"{s.attrs.get('rpc_ms', 0):.2f} ms rpc, "
+                f"{s.attrs.get('dec_ms', 0):.2f} ms decode")
+    pl = (ex.attrs.get("pipeline") if ex is not None else None) \
+        or r.explain.get("pipeline") or {}
+    if pl:
+        lines.append(
+            f"  Pipeline: host decode {pl.get('host_decode_ms', 0):.2f}"
+            f" ms, device {pl.get('device_ms', 0):.2f} ms, "
+            f"H2D {pl.get('h2d_bytes', 0)} bytes, "
+            f"stalls host={pl.get('host_stalls', 0)} "
+            f"device={pl.get('device_stalls', 0)}")
+        if "remote_wait_ms" in pl:
+            lines.append(
+                f"    Remote Wait: {pl['remote_wait_ms']:.2f} ms "
+                f"(overlapped {pl['remote_overlapped_ms']:.2f} ms, "
+                f"peak in-flight {pl['remote_inflight_peak']})")
+    return lines
 
 def _explain_join(cl, stmt: A.Explain) -> Result:
     from citus_tpu.executor.join_executor import execute_join_select

@@ -216,7 +216,8 @@ def _citus_stat_counters_reset(cl, name, args):
 @utility("citus_stat_statements")
 def _citus_stat_statements(cl, name, args):
     return Result(columns=["query", "executor", "partition_key",
-                           "calls", "total_time_ms", "rows"],
+                           "calls", "total_time_ms", "rows",
+                           "p50_ms", "p95_ms", "p99_ms"],
                   rows=cl.query_stats.rows_view())
 
 
@@ -234,8 +235,36 @@ def _citus_stat_tenants(cl, name, args):
 
 @utility("citus_stat_activity", "citus_dist_stat_activity")
 def _citus_stat_activity(cl, name, args):
-    return Result(columns=["global_pid", "state", "elapsed_s", "query"],
+    return Result(columns=["global_pid", "state", "elapsed_s", "query",
+                           "phase"],
                   rows=cl.activity.rows_view())
+
+
+@utility("citus_metrics")
+def _citus_metrics(cl, name, args):
+    """Prometheus text exposition as rows — same payload SHOW
+    citus.metrics returns and scripts/metrics_exporter.py serves."""
+    from citus_tpu.observability.export import prometheus_text
+    return Result(columns=["metrics"],
+                  rows=[(line,) for line in
+                        prometheus_text(cl).splitlines()])
+
+
+@utility("citus_slow_queries")
+def _citus_slow_queries(cl, name, args):
+    """The bounded slow-query ring (citus.log_min_duration_ms),
+    newest first, with per-phase durations from each query's trace."""
+    from citus_tpu.observability.slowlog import GLOBAL_SLOW_LOG
+    return Result(columns=["captured_at", "duration_ms", "trace_id",
+                           "phases", "query"],
+                  rows=GLOBAL_SLOW_LOG.rows_view())
+
+
+@utility("citus_slow_queries_reset")
+def _citus_slow_queries_reset(cl, name, args):
+    from citus_tpu.observability.slowlog import GLOBAL_SLOW_LOG
+    GLOBAL_SLOW_LOG.clear()
+    return Result(columns=[name], rows=[(None,)])
 
 
 @utility("citus_locks")

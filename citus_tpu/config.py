@@ -119,6 +119,25 @@ class ExecutorSettings:
 
 
 @dataclass
+class ObservabilitySettings:
+    """Distributed tracing + slow-query capture (observability/)."""
+
+    # Fraction of queries recorded as full span trees (0.0-1.0) —
+    # citus.trace_sample_rate.  0.0 keeps the hot path on the no-op
+    # recorder (allocation-free; the near-zero-overhead default).
+    trace_sample_rate: float = 0.0
+    # Queries at/above this wall time (ms) are captured into the
+    # bounded in-memory slow-query ring with their span tree; any
+    # non-negative value force-samples every query so the tree exists
+    # when the threshold verdict lands — citus.log_min_duration_ms
+    # (-1 disables, the log_min_duration_statement analog).
+    log_min_duration_ms: float = -1.0
+    # Directory receiving one Chrome trace-event JSON (Perfetto-
+    # loadable) per sampled query — citus.trace_export_dir ("" = off).
+    trace_export_dir: str = ""
+
+
+@dataclass
 class ShardingSettings:
     # Default shard count for create_distributed_table
     # (reference GUC citus.shard_count, default 32).
@@ -134,6 +153,8 @@ class Settings:
     planner: PlannerSettings = field(default_factory=PlannerSettings)
     executor: ExecutorSettings = field(default_factory=ExecutorSettings)
     sharding: ShardingSettings = field(default_factory=ShardingSettings)
+    observability: ObservabilitySettings = field(
+        default_factory=ObservabilitySettings)
     # reference GUC citus.enable_change_data_capture
     enable_change_data_capture: bool = False
     # start the maintenance daemon with the cluster (reference: the

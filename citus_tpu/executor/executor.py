@@ -15,7 +15,6 @@ the coordinator.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -29,6 +28,8 @@ from citus_tpu.executor.batches import (
 )
 from citus_tpu.executor.finalize import finalize_groups, order_and_limit, project_rows
 from citus_tpu.executor.kernel_cache import get_kernel, jit_compile
+from citus_tpu.observability import trace as _trace
+from citus_tpu.observability.trace import clock
 from citus_tpu.ops.scan_agg import build_worker_fn, combine_partials_host
 from citus_tpu.planner.auto_param import PHYSICAL_SRC, substitute_params
 from citus_tpu.planner.bind import BoundSelect
@@ -219,6 +220,8 @@ def _run_mesh_round(plan, run, buf: list, n_dev: int, shard_sharding,
     # delay injections here model device-side round latency for the
     # host/device overlap tests (the decode half is decode_batch)
     FAULTS.hit("device_round", plan.bound.table.name)
+    t0_round = clock()
+    n_real = len(buf)
     bucket = max(b.padded_rows for b in buf)
     while len(buf) < n_dev:
         buf.append(empty_batch(plan.bound.table, plan, bucket, -1))
@@ -236,6 +239,11 @@ def _run_mesh_round(plan, run, buf: list, n_dev: int, shard_sharding,
               + mask.nbytes)
     if collect is not None:
         collect.append((dcols, dvalids, dmask))
+    ctx = _trace.current()
+    if ctx is not None:
+        tr, parent = ctx
+        tr.add_closed("device_round", parent.span_id, t0_round, clock(),
+                      {"batches": n_real, "bytes": int(nbytes)})
     return out, nbytes
 
 
@@ -252,6 +260,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     devices = jax.devices()
     kinds = _combine_kinds(plan)
     pstats = PipelineStats()
+    _trace.set_phase("device")
 
     from citus_tpu.executor.device_cache import GLOBAL_CACHE, plan_cache_key
     from citus_tpu.storage.overlay import current_overlay
@@ -303,12 +312,12 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         nbytes = 0
         inflight: deque = deque()
         stream = _iter_padded_batches(cat, plan, settings)
-        t_peek = time.perf_counter()
+        t_peek = clock()
         first = next(stream, None)
         if first is None:
             return combine_partials_host(plan, [_empty_partials(plan, np)])
         second = next(stream, None)
-        pstats.host_decode_s += time.perf_counter() - t_peek
+        pstats.host_decode_s += clock() - t_peek
         if second is None:
             host_iter = iter([first])  # 1 batch: default-device path
         else:
@@ -325,7 +334,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                     buf.append(hb)
                     if len(buf) < n_dev:
                         continue
-                    t_dev = time.perf_counter()
+                    t_dev = clock()
                     out, nb = _run_mesh_round(
                         plan, run, buf, n_dev, shard_sharding,
                         p_stack, pv_stack, collect)
@@ -338,24 +347,25 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                         inflight.append(out)
                         if len(inflight) > _prefetch_depth(settings):
                             jax.block_until_ready(inflight.popleft())
-                    pstats.device_s += time.perf_counter() - t_dev
+                    pstats.device_s += clock() - t_dev
                 if buf:
-                    t_dev = time.perf_counter()
+                    t_dev = clock()
                     out, nb = _run_mesh_round(
                         plan, run, buf, n_dev, shard_sharding,
                         p_stack, pv_stack, collect)
                     acc.append(out)
                     nbytes += nb
-                    pstats.device_s += time.perf_counter() - t_dev
+                    pstats.device_s += clock() - t_dev
             finally:
                 host_iter_m.close()
             if collect is not None and nbytes <= GLOBAL_CACHE.capacity:
                 jax.block_until_ready([r[0] for r in collect])
                 GLOBAL_CACHE.put(mkey, collect, nbytes)
-            t_dev = time.perf_counter()
+            t_dev = clock()
             acc_np = [tuple(np.asarray(o) for o in out) for out in acc]
-            pstats.device_s += time.perf_counter() - t_dev
+            pstats.device_s += clock() - t_dev
             pstats.h2d_bytes = nbytes
+            GLOBAL_COUNTERS.bump("bytes_scanned", nbytes)
             pstats.publish(plan)
             return combine_partials_host(plan, acc_np)
 
@@ -398,12 +408,12 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     acc_dev = None
     if cached is not None:
         for b in cached:
-            t0 = time.perf_counter()
+            t0 = clock()
             out = _worker_for(b.padded_rows)(b.cols + pcols,
                                             b.valids + pvalids, b.row_mask)
             acc_dev = out if acc_dev is None else merge(acc_dev, out)
             task_times.append((b.shard_index, b.n_rows,
-                               time.perf_counter() - t0))
+                               clock() - t0))
     else:
         # stream: decompress batch i+1 on the host and transfer it while
         # batch i computes (XLA's async dispatch overlaps the copy and
@@ -424,19 +434,19 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                                      pstats)
         try:
             for hb in host_iter:
-                t_dev = time.perf_counter()
+                t_dev = clock()
                 FAULTS.hit("device_round", plan.bound.table.name)
                 db = ShardBatch(tuple(jax.device_put(c) for c in hb.cols),
                                 tuple(jax.device_put(v) for v in hb.valids),
                                 jax.device_put(hb.row_mask), hb.n_rows,
                                 hb.padded_rows, hb.shard_index)
-                t0 = time.perf_counter()
+                t0 = clock()
                 out = _worker_for(db.padded_rows)(db.cols + pcols,
                                                  db.valids + pvalids,
                                                  db.row_mask)
                 acc_dev = out if acc_dev is None else merge(acc_dev, out)
                 task_times.append((db.shard_index, db.n_rows,
-                                   time.perf_counter() - t0))
+                                   clock() - t0))
                 nbytes += (sum(c.nbytes for c in hb.cols)
                            + sum(v.nbytes for v in hb.valids)
                            + hb.row_mask.nbytes)
@@ -451,7 +461,14 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                     inflight.append(out)
                     if len(inflight) > _prefetch_depth(settings):
                         jax.block_until_ready(inflight.popleft())
-                pstats.device_s += time.perf_counter() - t_dev
+                pstats.device_s += clock() - t_dev
+                ctx = _trace.current()
+                if ctx is not None:
+                    tr, parent = ctx
+                    tr.add_closed(
+                        "device_round", parent.span_id, t_dev, clock(),
+                        {"shard_index": int(hb.shard_index),
+                         "rows": int(hb.n_rows)})
         finally:
             host_iter.close()
         if acc_dev is None:
@@ -460,9 +477,10 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             jax.block_until_ready([b.cols for b in collect])
             GLOBAL_CACHE.put(key, collect, nbytes)
         pstats.h2d_bytes = nbytes
-        t_dev = time.perf_counter()
+        GLOBAL_COUNTERS.bump("bytes_scanned", nbytes)
+        t_dev = clock()
         partials = tuple(np.asarray(o) for o in jax.device_get(acc_dev))
-        pstats.device_s += time.perf_counter() - t_dev
+        pstats.device_s += clock() - t_dev
         pstats.publish(plan)
         plan.runtime_cache["task_times"] = task_times
         return partials
@@ -531,7 +549,10 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             return []
         sel_parts = tuple(np.asarray(p)[occupied] for p in parts)
         return finalize_groups(plan, cat, keys, sel_parts, params_env=penv)
-    return _run_agg_hash_host(cat, plan, settings, params)
+    # unbounded-cardinality GROUP BY: per-shard hash tables merge on the
+    # host, so the whole strategy renders as one host_agg span
+    with _trace.span("host_agg", shards=len(plan.shard_indexes)):
+        return _run_agg_hash_host(cat, plan, settings, params)
 
 
 def _params_env(params) -> dict:
@@ -808,15 +829,28 @@ def _bind_time_prune(plan: PhysicalPlan, params) -> PhysicalPlan:
 def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
                    plan: Optional[PhysicalPlan] = None,
                    param_values: Optional[list] = None) -> Result:
-    t0 = time.perf_counter()
+    t0 = clock()
     _guard_remote_written(cat, [bound.table.name])
     if plan is None:
         plan = plan_select(cat, bound, direct_limit=settings.planner.direct_gid_limit)
     params = encode_params(cat, bound, param_values)
-    if bound.param_specs:
-        # deferred pruning: re-derive the shard/interval view of the
-        # cached generic plan for THESE parameter values
-        plan = _bind_time_prune(plan, params)
+    _exec_span = _trace.span("execute")
+    _exec_span.__enter__()
+    try:
+        if bound.param_specs:
+            # deferred pruning: re-derive the shard/interval view of the
+            # cached generic plan for THESE parameter values
+            with _trace.span("prune"):
+                plan = _bind_time_prune(plan, params)
+        return _execute_select_traced(cat, bound, settings, plan, params,
+                                      t0, _exec_span)
+    finally:
+        _exec_span.__exit__(None, None, None)
+
+
+def _execute_select_traced(cat: Catalog, bound: BoundSelect,
+                           settings: Settings, plan: PhysicalPlan,
+                           params, t0: float, exec_span) -> Result:
     GLOBAL_COUNTERS.bump("queries_executed")
     if plan.is_router:
         GLOBAL_COUNTERS.bump("router_queries")
@@ -854,12 +888,24 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
         rows = snapshot_read(cat.data_dir, bound.table, _attempt,
                              timeout=settings.executor.lock_timeout_s)
         plan = run_plan
-    rows = order_and_limit(plan, rows)
-    if bound.hidden_outputs:
-        keep = len(bound.output_names) - bound.hidden_outputs
-        rows = [r[:keep] for r in rows]
+    _trace.set_phase("finalize")
+    with _trace.span("finalize"):
+        rows = order_and_limit(plan, rows)
+        if bound.hidden_outputs:
+            keep = len(bound.output_names) - bound.hidden_outputs
+            rows = [r[:keep] for r in rows]
     GLOBAL_COUNTERS.bump("rows_returned", len(rows))
-    elapsed = time.perf_counter() - t0
+    elapsed = clock() - t0
+    if exec_span.recording:
+        exec_span.set(
+            strategy=plan.group_mode.kind if bound.has_aggs else "projection",
+            shards=len(plan.shard_indexes), router=bool(plan.is_router),
+            rows=len(rows))
+        pipe = plan.runtime_cache.get("pipeline") or {}
+        if pipe:
+            # the full pipeline-overlap dict rides the span so EXPLAIN
+            # ANALYZE and the Chrome export render from one source
+            exec_span.attrs["pipeline"] = dict(pipe)
     visible = list(bound.output_names)
     if bound.hidden_outputs:
         visible = visible[:len(visible) - bound.hidden_outputs]

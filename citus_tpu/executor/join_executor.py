@@ -30,6 +30,8 @@ from citus_tpu.errors import ExecutionError
 from citus_tpu.executor.executor import Result
 from citus_tpu.executor.finalize import finalize_groups, order_and_limit, project_rows
 from citus_tpu.executor.host_agg import HostGroupAccumulator
+from citus_tpu.observability import trace as _trace
+from citus_tpu.observability.trace import clock
 from citus_tpu.planner.bound import BColumn, BKeyRef, compile_expr, predicate_mask
 from citus_tpu.planner.join_planner import BoundJoinSelect, RelPlan
 from citus_tpu.storage import ShardReader
@@ -589,8 +591,9 @@ def execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -
 
 
 def _execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) -> Result:
-    import time
-    t0 = time.perf_counter()
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    GLOBAL_COUNTERS.bump("join_queries")
+    t0 = clock()
     strategy = bj.strategy
     if strategy == "repartition" and not settings.planner.enable_repartition_joins:
         strategy = "pull"
@@ -603,12 +606,14 @@ def _execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) 
     elif (strategy == "repartition" and bj.repartition_spec is not None
           and _get_mesh(settings) is None):
         # single-repartition with host buckets (cpu oracle / one device)
-        overrides, shuffle_mode = _repartition_tasks(cat, bj, settings)
+        with _trace.span("shuffle", mode="host"):
+            overrides, shuffle_mode = _repartition_tasks(cat, bj, settings)
         tasks = [(None, fo) for fo in overrides]
     elif strategy == "repartition":
         # on a mesh the step-wise path joins each equi step on device
         # (all_to_all exchange + per-device sort join, one host fetch)
-        frame_n = _stepwise_shuffle_join(cat, bj, settings)
+        with _trace.span("shuffle", mode="mesh"):
+            frame_n = _stepwise_shuffle_join(cat, bj, settings)
         shuffle_mode = f"{frame_n[2]}:{frame_n[3]}-step"
         tasks = [(None, {"__result__": (frame_n[0], frame_n[1])})]
     else:
@@ -662,7 +667,7 @@ def _execute_join_select(cat: Catalog, bj: BoundJoinSelect, settings: Settings) 
     explain = {
         "strategy": f"join:{strategy}",
         "tasks": len(tasks),
-        "elapsed_s": time.perf_counter() - t0,
+        "elapsed_s": clock() - t0,
     }
     if shuffle_mode is not None:
         explain["shuffle"] = shuffle_mode

@@ -34,9 +34,11 @@ from __future__ import annotations
 
 import hashlib
 import threading
-import time
 from collections import OrderedDict
 from typing import Callable, Optional
+
+from citus_tpu.observability import trace as _trace
+from citus_tpu.observability.trace import clock
 
 #: default LRU entry cap (kernels, not bytes: compiled executables are
 #: host-memory cheap relative to HBM batches) — citus.kernel_cache_size
@@ -76,7 +78,7 @@ class _TimedJit:
                 before = fn._cache_size()
             except Exception:
                 before = None
-            t0 = time.perf_counter()
+            t0 = clock()
             out = fn(*args, **kw)
             if before is not None:
                 try:
@@ -84,8 +86,16 @@ class _TimedJit:
                 except Exception:
                     grew = False
                 if grew:
-                    ms = int((time.perf_counter() - t0) * 1000)
-                    _counters().bump("kernel_compile_ms", max(1, ms))
+                    t1 = clock()
+                    _counters().bump("kernel_compile_ms",
+                                     max(1, int((t1 - t0) * 1000)))
+                    # compiles are detected after the fact (the trace
+                    # cache grew across the call) — record retroactively
+                    ctx = _trace.current()
+                    if ctx is not None:
+                        tr, parent = ctx
+                        tr.add_closed("kernel_compile", parent.span_id,
+                                      t0, t1)
         return out
 
     def __getattr__(self, name):
@@ -180,10 +190,14 @@ def get_kernel(plan, slot: str, build: Callable[[], object],
     k = GLOBAL_KERNELS.get(key)
     if k is None:
         _counters().bump("kernel_cache_misses")
-        k = build()
+        _trace.set_phase("compile")
+        with _trace.span("kernel", slot=slot, cache="miss"):
+            k = build()
         GLOBAL_KERNELS.put(key, k)
     else:
         _counters().bump("kernel_cache_hits")
+        with _trace.span("kernel", slot=slot, cache="hit"):
+            pass
     rc[slot] = k
     return k
 

@@ -34,13 +34,12 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from collections import deque
 from typing import Iterator, Optional
 
 from citus_tpu.errors import ExecutionError
-
-_perf = time.perf_counter
+from citus_tpu.observability import trace as _trace
+from citus_tpu.observability.trace import clock as _perf
 
 
 class PipelineStats:
@@ -272,6 +271,10 @@ class RemoteTaskDispatch:
         self._inflight_total = 0
         self._inflight_peak = 0
         self._aborted = False
+        # trace context captured BEFORE the RPC threads start: spans
+        # they open attach to the dispatching query's tree, and the
+        # (trace_id, parent span_id) pair rides in each task payload
+        self._trace_ctx = _trace.capture()
         self._t_start = _perf()
         self._t_last_done = self._t_start
         for si, node, ep, task in tasks:
@@ -315,6 +318,16 @@ class RemoteTaskDispatch:
         nbytes = 0
         rpc_s = dec_s = 0.0
         ok = False
+        meta = None
+        rspan = None
+        if self._trace_ctx is not None:
+            tr, parent = self._trace_ctx
+            rspan = tr.open_span("remote_task", parent.span_id,
+                                 {"shard_index": int(si), "node": int(node)})
+            # span context rides in the payload; the worker records its
+            # half against the same trace_id and returns it in the meta
+            task = dict(task, trace={"trace_id": tr.trace_id,
+                                     "parent_span_id": rspan.span_id})
         t0 = _perf()
         try:
             FAULTS.hit("execute_task",
@@ -336,6 +349,14 @@ class RemoteTaskDispatch:
             # worker dead, version skew, codec refused server-side:
             # this shard scans locally through the pull path instead
             pass
+        if rspan is not None:
+            tr, _parent = self._trace_ctx
+            rspan.set(ok=ok, bytes=int(nbytes),
+                      rpc_ms=round(rpc_s * 1000, 3),
+                      dec_ms=round(dec_s * 1000, 3))
+            tr.close_span(rspan)
+            if ok and isinstance(meta, dict) and meta.get("spans"):
+                tr.graft(meta["spans"], rspan)
         from citus_tpu.executor.admission import GLOBAL_POOL
         if holds_slot:
             GLOBAL_POOL.release()
@@ -364,6 +385,8 @@ class RemoteTaskDispatch:
         indexes, successful results in shard-index order) and publishes
         the overlap/peak stats."""
         from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        if self._total:
+            _trace.set_phase("remote-wait")
         t_enter = _perf()
         with self._cv:
             while self._settled < self._total or self._inflight_total:

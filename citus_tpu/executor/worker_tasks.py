@@ -37,13 +37,14 @@ Three pieces:
 from __future__ import annotations
 
 import os
-import time
 from typing import Optional
 
 import numpy as np
 
 from citus_tpu.errors import ExecutionError
 from citus_tpu.net.data_plane import _npz_bytes
+from citus_tpu.observability import trace as _trace
+from citus_tpu.observability.trace import clock
 from citus_tpu.planner import bound as B
 from citus_tpu.planner.bind import BoundSelect
 from citus_tpu.planner.physical import (
@@ -455,7 +456,7 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
     from citus_tpu.executor.executor import (
         _run_partials_cpu, _run_partials_jax,
     )
-    t0 = time.perf_counter()
+    t0 = clock()
     if int(p.get("v", -1)) != TASK_VERSION:
         raise ExecutionError(
             f"task version {p.get('v')!r} != {TASK_VERSION}")
@@ -492,18 +493,23 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
 
         def _attempt():
             return run(cat, plan, settings, params)
-        partials = snapshot_read(cat.data_dir, t, _attempt,
-                                 timeout=settings.executor.lock_timeout_s)
-        blob = _npz_bytes({f"a__{i}": np.asarray(x)
-                           for i, x in enumerate(partials)})
+        with _trace.span("worker_scan", shard_id=shard_id, kind="agg"):
+            partials = snapshot_read(
+                cat.data_dir, t, _attempt,
+                timeout=settings.executor.lock_timeout_s)
+        with _trace.span("worker_encode"):
+            blob = _npz_bytes({f"a__{i}": np.asarray(x)
+                               for i, x in enumerate(partials)})
     else:
         def _attempt():
             return _run_task_projection(cat, plan, params, p.get("limit"))
-        values, validity, n_rows = snapshot_read(
-            cat.data_dir, t, _attempt,
-            timeout=settings.executor.lock_timeout_s)
+        with _trace.span("worker_scan", shard_id=shard_id, kind="projection"):
+            values, validity, n_rows = snapshot_read(
+                cat.data_dir, t, _attempt,
+                timeout=settings.executor.lock_timeout_s)
         from citus_tpu.net.data_plane import encode_batch
-        blob = encode_batch(values, validity)
+        with _trace.span("worker_encode"):
+            blob = encode_batch(values, validity)
     stripe_bytes = 0
     d = cat.shard_dir(name, shard_id, node)
     if os.path.isdir(d):
@@ -513,5 +519,5 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
                 stripe_bytes += os.path.getsize(fp)
     meta = {"ok": True, "node": node, "n_rows": int(n_rows),
             "stripe_bytes": int(stripe_bytes),
-            "elapsed_s": time.perf_counter() - t0}
+            "elapsed_s": clock() - t0}
     return meta, blob

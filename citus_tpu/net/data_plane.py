@@ -195,13 +195,41 @@ class DataPlaneServer:
         one binary frame (reference: worker_sql_task_protocol.c — the
         task travels as a serialized plan fragment rather than SQL
         text, and results come back as one frame instead of a COPY
-        stream).  See executor/worker_tasks.py for the codec."""
+        stream).  See executor/worker_tasks.py for the codec.
+
+        When the task carries a trace context ({trace_id, parent
+        span_id} injected by the coordinator's RemoteTaskDispatch), the
+        worker half records its own spans against that trace_id and
+        ships them back in the meta — the coordinator grafts them under
+        its remote_task span, so the query tree stays single-rooted
+        across hosts."""
         from citus_tpu.executor.worker_tasks import run_worker_task
+        from citus_tpu.observability import trace as _trace
         guard = self.cluster._remote_exec_guard
         prev = getattr(guard, "v", False)
         guard.v = True  # a pushed task must never push again
         try:
-            return run_worker_task(self.cluster, p)
+            tctx = p.get("trace")
+            if not isinstance(tctx, dict) or "trace_id" not in tctx:
+                return run_worker_task(self.cluster, p)
+            wt = _trace.Trace(trace_id=str(tctx["trace_id"]))
+            root = wt.open_span(
+                "execute_task", tctx.get("parent_span_id"),
+                {"host": int(p.get("node", 0)),
+                 "shard_id": int(p.get("shard_id", -1)),
+                 "table": str(p.get("table", ""))})
+            try:
+                with _trace.activate(wt, root):
+                    meta, blob = run_worker_task(self.cluster, p)
+            finally:
+                wt.close_span(root)
+            root.set(rows=meta.get("n_rows", 0))
+            spans = wt.export_spans()
+            # every worker span renders on this host's process row
+            for d in spans:
+                d["attrs"].setdefault("host", int(p.get("node", 0)))
+            meta["spans"] = spans
+            return meta, blob
         finally:
             guard.v = prev
 

@@ -1,0 +1,139 @@
+"""Trace + metrics exporters.
+
+- Chrome trace-event JSON: one file per sampled query under
+  ``citus.trace_export_dir``; loads directly in Perfetto / chrome://
+  tracing.  Coordinator spans render as process 1, every remote host's
+  grafted ``execute_task`` subtree as its own process row, and each
+  event's args carry span_id/parent_id so the tree survives the format.
+- Prometheus text exposition: all StatCounters as counters, cache
+  occupancy as gauges, and per-query-family latency histograms from
+  ``QueryStats`` (scripts/metrics_exporter.py + SHOW citus.metrics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+#: coordinator pid in the trace-event timeline; remote hosts offset
+#: their node id from here
+COORD_PID = 1
+_REMOTE_PID_BASE = 1000
+
+
+def chrome_trace_events(trace) -> dict:
+    """Render a finished Trace as a Chrome trace-event document
+    ("X" complete events, ts/dur in microseconds)."""
+    events = []
+    pids = {COORD_PID: "coordinator"}
+    for s in trace.spans:
+        t1 = s.t1 if s.t1 is not None else s.t0
+        host = s.attrs.get("host")
+        if host is None:
+            pid = COORD_PID
+        else:
+            pid = _REMOTE_PID_BASE + int(host)
+            pids[pid] = f"worker node {host}"
+        args = {k: v for k, v in s.attrs.items()
+                if isinstance(v, (int, float, str, bool))}
+        args["span_id"] = s.span_id
+        if s.parent_id is not None:
+            args["parent_id"] = s.parent_id
+        events.append({
+            "name": s.name,
+            "cat": "citus",
+            "ph": "X",
+            "ts": round((trace.t0_wall + (s.t0 - trace.t0)) * 1e6, 3),
+            "dur": round(max(0.0, t1 - s.t0) * 1e6, 3),
+            "pid": pid,
+            "tid": 1,
+            "args": args,
+        })
+    for pid, name in sorted(pids.items()):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": name}})
+    return {"traceEvents": events,
+            "otherData": {"trace_id": trace.trace_id}}
+
+
+def write_chrome_trace(trace, export_dir: str) -> str:
+    """Write one Perfetto-loadable JSON per trace; returns the path."""
+    os.makedirs(export_dir, exist_ok=True)
+    path = os.path.join(export_dir, f"trace_{trace.trace_id}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(chrome_trace_events(trace), f)
+    os.replace(tmp, path)
+    return path
+
+
+# ----------------------------------------------------------- prometheus
+
+
+_LABEL_BAD = re.compile(r"[\\\"\n]")
+
+
+def _label(v: str) -> str:
+    return _LABEL_BAD.sub("_", v)[:200]
+
+
+#: families with fewer calls than the busiest N are dropped from the
+#: histogram section (label cardinality bound)
+TOP_FAMILIES = 20
+
+
+def prometheus_text(cluster) -> str:
+    """Text-format exposition of the cluster's metrics: every
+    StatCounters name, cache-occupancy gauges, and per-query-family
+    latency histograms (log-scale buckets from QueryStats)."""
+    out = []
+
+    counters = cluster.counters.snapshot()
+    for name in sorted(counters):
+        out.append(f"# TYPE citus_{name} counter")
+        out.append(f"citus_{name} {counters[name]}")
+
+    gauges = _gauges(cluster)
+    for name in sorted(gauges):
+        out.append(f"# TYPE citus_{name} gauge")
+        out.append(f"citus_{name} {gauges[name]}")
+
+    fams = _family_histograms(cluster)
+    if fams:
+        out.append("# TYPE citus_query_latency_ms histogram")
+        for family, hist in fams:
+            lab = _label(family)
+            cum = 0
+            for bound, n in zip(hist.BOUNDS_MS, hist.counts):
+                cum += n
+                out.append(f'citus_query_latency_ms_bucket'
+                           f'{{family="{lab}",le="{bound:g}"}} {cum}')
+            out.append(f'citus_query_latency_ms_bucket'
+                       f'{{family="{lab}",le="+Inf"}} {hist.count}')
+            out.append(f'citus_query_latency_ms_sum{{family="{lab}"}} '
+                       f'{hist.sum_ms:.3f}')
+            out.append(f'citus_query_latency_ms_count{{family="{lab}"}} '
+                       f'{hist.count}')
+    return "\n".join(out) + "\n"
+
+
+def _gauges(cluster) -> dict:
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    from citus_tpu.executor.kernel_cache import GLOBAL_KERNELS
+    from citus_tpu.observability.slowlog import GLOBAL_SLOW_LOG
+    return {
+        "kernel_cache_entries": len(GLOBAL_KERNELS),
+        "plan_cache_entries": len(cluster._plan_cache),
+        "device_cache_bytes": int(GLOBAL_CACHE._bytes),
+        "device_cache_capacity_bytes": int(GLOBAL_CACHE.capacity),
+        "slow_log_entries": len(GLOBAL_SLOW_LOG),
+        "live_queries": len(cluster.activity.rows_view()),
+    }
+
+
+def _family_histograms(cluster) -> list[tuple]:
+    stats = cluster.query_stats.histograms_view()
+    stats.sort(key=lambda kv: -kv[1].count)
+    return stats[:TOP_FAMILIES]

@@ -1,0 +1,58 @@
+"""Bounded in-memory slow-query ring (log_min_duration_statement
+analog).  Queries whose wall time crosses ``citus.log_min_duration_ms``
+are force-sampled by the tracer, so each entry carries its span tree's
+phase breakdown, not just SQL + duration."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+DEFAULT_CAPACITY = 128
+
+
+def _counters():
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    return GLOBAL_COUNTERS
+
+
+class SlowQueryLog:
+    """Ring of the most recent slow queries; oldest entries fall off."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, capacity))
+
+    def record(self, sql: str, duration_ms: float, trace=None) -> None:
+        phases = ""
+        trace_id = ""
+        if trace is not None:
+            trace_id = trace.trace_id
+            root = trace.root()
+            if root is not None:
+                parts = [f"{s.name}={s.duration_ms:.1f}ms"
+                         for s in trace.children(root.span_id)]
+                phases = " ".join(parts)
+        with self._mu:
+            self._ring.append((time.time(), round(duration_ms, 3),
+                               trace_id, phases, sql))
+        _counters().bump("slow_queries_logged")
+
+    def rows_view(self) -> list[tuple]:
+        """(logged_at, duration_ms, trace_id, phases, query), newest
+        first — the citus_slow_queries() view."""
+        with self._mu:
+            return list(reversed(self._ring))
+
+    def clear(self) -> None:
+        with self._mu:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._ring)
+
+
+GLOBAL_SLOW_LOG = SlowQueryLog()

@@ -300,6 +300,13 @@ class Parser:
             if not (self.accept_op("=") or self.accept_kw("to")):
                 self.error("expected = or TO after SET name")
             t = self.next()
+            # SET citus.log_min_duration_ms = -1 (negative sentinel)
+            if t.kind == "op" and t.value == "-" and self.peek().kind == "num":
+                t = self.next()
+                n = float(t.value) \
+                    if ("." in t.value or "e" in t.value.lower()) \
+                    else int(t.value)
+                return A.SetConfig(name, -n)
             if t.kind == "str":
                 value: object = t.value[1:-1].replace("''", "'")
             elif t.kind == "num":
@@ -1475,6 +1482,7 @@ class Parser:
         "master_get_active_worker_nodes",
         "citus_stat_counters", "citus_stat_counters_reset",
         "citus_stat_statements", "citus_stat_statements_reset",
+        "citus_metrics", "citus_slow_queries", "citus_slow_queries_reset",
         "citus_stat_activity", "citus_locks", "citus_lock_waits",
         "citus_shards", "citus_tables", "recover_prepared_transactions",
         "nextval", "currval", "setval", "citus_views", "citus_sequences",

@@ -4,9 +4,11 @@ Reference analogs:
 - citus_stat_counters  (src/backend/distributed/stats/stat_counters.c —
   lock-free per-backend slots; here a lock-guarded counter dict)
 - citus_stat_statements (stats/query_stats.c — shmem hash by queryId;
-  here keyed by normalized SQL text)
+  here keyed by normalized SQL text, with log-scale latency histograms
+  for p50/p95/p99)
 - citus_stat_activity  (transaction/backend_data.c global pids; here
-  live statements with a global id)
+  live statements with a global id and a live execution phase fed by
+  the tracer, observability/trace.py)
 """
 
 from __future__ import annotations
@@ -15,6 +17,8 @@ import itertools
 import re
 import threading
 import time
+from bisect import bisect_left
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 
@@ -62,6 +66,17 @@ class StatCounters:
         "device_cache_hits",
         "device_cache_misses",
         "device_cache_evicted_bytes",
+        # distributed tracing (observability/): sampled query roots,
+        # spans recorded, slow-ring entries, and per-phase wall time
+        # folded from span close (observability/trace.py _SPAN_MS)
+        "trace_queries_sampled",
+        "trace_spans_recorded",
+        "slow_queries_logged",
+        "span_parse_ms",
+        "span_plan_ms",
+        "span_execute_ms",
+        "span_finalize_ms",
+        "span_remote_task_ms",
     ]
 
     def __init__(self):
@@ -88,16 +103,73 @@ class StatCounters:
 
 
 _WS = re.compile(r"\s+")
-_NUM = re.compile(r"\b\d+(\.\d+)?\b")
-_STR = re.compile(r"'(?:[^']|'')*'")
+# One scanner, ordered alternation: double-quoted identifiers and $N
+# parameter markers are PRESERVED (a bare \b\d+\b pass used to rewrite
+# digits inside them — '"t 1"' -> '"t ?"', '$1' -> '$?' — merging stats
+# buckets across distinct relations/params); single-quoted strings and
+# free-standing numeric literals become "?".  The lookaround keeps
+# digits glued to identifier characters (t1, k_2, x2y) untouched.
+_TOKEN = re.compile(
+    r'"(?:[^"]|"")*"'               # quoted identifier — keep verbatim
+    r"|'(?:[^']|'')*'"              # string literal    -> ?
+    r"|\$\d+"                       # parameter marker  — keep verbatim
+    r"|(?<![\w$])\d+(?:\.\d+)?(?![\w.])"  # numeric literal -> ?
+)
+
+
+def _token_sub(m: re.Match) -> str:
+    t = m.group(0)
+    if t.startswith('"') or t.startswith("$"):
+        return t
+    return "?"
 
 
 def normalize_query(sql: str) -> str:
     """Replace literals with placeholders so executions of the same shape
     share one statistics bucket (queryId analog)."""
-    out = _STR.sub("?", sql)
-    out = _NUM.sub("?", out)
+    out = _TOKEN.sub(_token_sub, sql)
     return _WS.sub(" ", out).strip().lower()
+
+
+class LatencyHistogram:
+    """Bounded log-scale latency histogram: 18 power-of-two buckets
+    from 0.25 ms to ~32.8 s plus overflow — fixed memory per query
+    family, good-enough p50/p95/p99 by linear interpolation inside the
+    winning bucket (reference: pg_stat_statements keeps only mean/min/
+    max; the histogram is what the Prometheus exporter wants)."""
+
+    #: inclusive upper bounds (ms) of the finite buckets
+    BOUNDS_MS = [0.25 * (2 ** i) for i in range(18)]
+
+    __slots__ = ("counts", "count", "sum_ms")
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BOUNDS_MS) + 1)  # + overflow
+        self.count = 0
+        self.sum_ms = 0.0
+
+    def record(self, ms: float) -> None:
+        self.counts[bisect_left(self.BOUNDS_MS, ms)] += 1
+        self.count += 1
+        self.sum_ms += ms
+
+    def percentile(self, p: float) -> float:
+        """Estimated latency (ms) at quantile ``p`` in [0, 1]."""
+        if self.count == 0:
+            return 0.0
+        target = p * self.count
+        cum = 0
+        for i, n in enumerate(self.counts):
+            if n == 0:
+                continue
+            if cum + n >= target:
+                hi = (self.BOUNDS_MS[i] if i < len(self.BOUNDS_MS)
+                      else self.BOUNDS_MS[-1] * 2)
+                lo = self.BOUNDS_MS[i - 1] if i > 0 else 0.0
+                frac = (target - cum) / n
+                return lo + (hi - lo) * frac
+            cum += n
+        return self.BOUNDS_MS[-1] * 2
 
 
 @dataclass
@@ -107,12 +179,22 @@ class QueryStat:
     rows: int = 0
     executor: str = ""
     partition_key: str = ""
+    hist: LatencyHistogram = field(default_factory=LatencyHistogram)
 
 
 class QueryStats:
+    """Normalized-query statistics with an O(1) LFU eviction: keys live
+    in per-call-count buckets (insertion-ordered, so ties evict the
+    stalest), and a ``_min_calls`` cursor tracks the coldest bucket.
+    The old least-called min-scan was O(n) per insert once the table
+    filled — every new query family paid a full-table walk."""
+
     def __init__(self, max_entries: int = 5000):
         self._mu = threading.Lock()
         self._stats: dict[str, QueryStat] = {}
+        # calls -> keys at that call count (LFU frequency buckets)
+        self._freq: dict[int, OrderedDict] = {}
+        self._min_calls = 1
         self.max_entries = max_entries
 
     def record(self, sql: str, elapsed_s: float, rows: int, executor: str,
@@ -122,27 +204,57 @@ class QueryStats:
             st = self._stats.get(key)
             if st is None:
                 if len(self._stats) >= self.max_entries:
-                    # evict the least-called entry (reference evicts by LRU
-                    # on its dump cycle; least-called is close enough here)
-                    victim = min(self._stats, key=lambda k: self._stats[k].calls)
-                    del self._stats[victim]
+                    self._evict_locked()
                 st = self._stats[key] = QueryStat(executor=executor,
                                                   partition_key=partition_key)
+            else:
+                bucket = self._freq.get(st.calls)
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket:
+                        del self._freq[st.calls]
+                        if self._min_calls == st.calls:
+                            self._min_calls = st.calls + 1
             st.calls += 1
+            if st.calls == 1:
+                self._min_calls = 1
+            self._freq.setdefault(st.calls, OrderedDict())[key] = None
             st.total_time_s += elapsed_s
             st.rows += rows
             st.executor = executor
+            st.hist.record(elapsed_s * 1000.0)
+
+    def _evict_locked(self) -> None:
+        # reference evicts by LRU on its dump cycle; least-called
+        # (oldest within the coldest bucket) is close enough here
+        while self._min_calls not in self._freq:
+            self._min_calls += 1  # defensive; invariant keeps this O(1)
+        bucket = self._freq[self._min_calls]
+        victim, _ = bucket.popitem(last=False)
+        if not bucket:
+            del self._freq[self._min_calls]
+        del self._stats[victim]
 
     def rows_view(self) -> list[tuple]:
         with self._mu:
             return [(q, s.executor, s.partition_key, s.calls,
-                     round(s.total_time_s * 1000, 3), s.rows)
+                     round(s.total_time_s * 1000, 3), s.rows,
+                     round(s.hist.percentile(0.50), 3),
+                     round(s.hist.percentile(0.95), 3),
+                     round(s.hist.percentile(0.99), 3))
                     for q, s in sorted(self._stats.items(),
                                        key=lambda kv: -kv[1].total_time_s)]
+
+    def histograms_view(self) -> list[tuple]:
+        """(normalized query, LatencyHistogram) pairs for exporters."""
+        with self._mu:
+            return [(q, s.hist) for q, s in self._stats.items()]
 
     def reset(self) -> None:
         with self._mu:
             self._stats.clear()
+            self._freq.clear()
+            self._min_calls = 1
 
 
 class TenantStats:
@@ -172,7 +284,13 @@ class TenantStats:
             st[1] += elapsed_s
 
     def rows_view(self) -> list[tuple]:
+        now = time.time()
         with self._mu:
+            # expire at read time: a tenant whose window elapsed with no
+            # new record would otherwise show its stale count forever
+            for k in [k for k, st in self._t.items()
+                      if now - st[2] > self.WINDOW_S]:
+                del self._t[k]
             return [(k, c, round(t * 1000, 3))
                     for k, (c, t, _) in sorted(self._t.items(),
                                                key=lambda kv: -kv[1][0])]
@@ -187,6 +305,9 @@ class Activity:
     sql: str
     started_at: float
     state: str = "active"
+    # live execution phase (plan / compile / device / remote-wait /
+    # finalize), fed by observability/trace.py's phase sink
+    phase: str = ""
 
 
 class ActivityTracker:
@@ -204,8 +325,15 @@ class ActivityTracker:
         with self._mu:
             self._live.pop(gpid, None)
 
+    def set_phase(self, gpid: int, phase: str) -> None:
+        with self._mu:
+            a = self._live.get(gpid)
+            if a is not None:
+                a.phase = phase
+
     def rows_view(self) -> list[tuple]:
         now = time.time()
         with self._mu:
-            return [(a.gpid, a.state, round(now - a.started_at, 3), a.sql)
+            return [(a.gpid, a.state, round(now - a.started_at, 3), a.sql,
+                     a.phase)
                     for a in self._live.values()]

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Prometheus text-exposition exporter for a citus_tpu data directory.
+
+Opens a Cluster over ``data_dir`` and either dumps the metrics text once
+to stdout (default) or serves it on ``--port`` at ``/metrics`` until
+interrupted — the minimal scrape target for a Prometheus job:
+
+    python scripts/metrics_exporter.py /path/to/db             # one dump
+    python scripts/metrics_exporter.py /path/to/db --port 9187 # serve
+
+The payload is exactly what ``SHOW citus.metrics`` / ``SELECT
+citus_metrics()`` return in-process: StatCounters as counters, cache
+occupancy as gauges, and per-query-family latency histograms
+(citus_tpu/observability/export.py).  Note that counters are
+per-process — this exporter sees the activity of ITS cluster handle,
+which is the normal embedded deployment (one process owns the data
+dir); point it at a live workload by running it inside that process or
+scraping SHOW citus.metrics through SQL instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("data_dir", help="cluster data directory")
+    ap.add_argument("--port", type=int, default=0,
+                    help="serve /metrics on this port instead of a "
+                         "one-shot stdout dump")
+    args = ap.parse_args(argv)
+
+    from citus_tpu import Cluster
+    from citus_tpu.observability.export import prometheus_text
+
+    cl = Cluster(args.data_dir)
+    try:
+        if not args.port:
+            sys.stdout.write(prometheus_text(cl))
+            return 0
+
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = prometheus_text(cl).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        srv = HTTPServer(("0.0.0.0", args.port), Handler)
+        print(f"serving /metrics on :{srv.server_address[1]}",
+              file=sys.stderr)
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.server_close()
+        return 0
+    finally:
+        cl.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

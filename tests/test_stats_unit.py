@@ -1,0 +1,149 @@
+"""Unit tests for citus_tpu/stats.py: query normalization (quoted
+identifiers, $N markers, identifier-adjacent digits), the log-scale
+latency histogram behind p50/p95/p99, the O(1) LFU eviction in
+QueryStats, and TenantStats' expire-at-read window.
+"""
+
+import time
+
+from citus_tpu.stats import (LatencyHistogram, QueryStats, TenantStats,
+                             normalize_query)
+
+
+# ------------------------------------------------------- normalization
+
+
+def test_normalize_replaces_literals():
+    assert normalize_query("SELECT * FROM t WHERE v < 100") \
+        == "select * from t where v < ?"
+    assert normalize_query("SELECT 1.5, 'abc' FROM t") \
+        == "select ?, ? from t"
+
+
+def test_normalize_keeps_identifier_adjacent_digits():
+    # regression: a bare \b\d+\b pass rewrote the 1 in t1 / k_2 / x2y
+    assert normalize_query("SELECT k_2 FROM t1 WHERE x2y = 3") \
+        == "select k_2 from t1 where x2y = ?"
+
+
+def test_normalize_keeps_quoted_identifiers_verbatim():
+    # regression: digits inside double-quoted identifiers were mangled
+    # ('"2024"' -> '"?"'), merging stats buckets across relations
+    assert normalize_query('SELECT v FROM "2024" WHERE v = 7') \
+        == 'select v from "2024" where v = ?'
+    assert normalize_query('SELECT "a""b 1" FROM t') \
+        == 'select "a""b 1" from t'
+
+
+def test_normalize_keeps_parameter_markers():
+    # regression: '$1' became '$?', erasing which parameter slot
+    assert normalize_query("SELECT v FROM t WHERE k = $1 AND v > $12") \
+        == "select v from t where k = $1 and v > $12"
+
+
+def test_normalize_string_with_digits():
+    assert normalize_query("SELECT * FROM t WHERE s = 'v 100'") \
+        == "select * from t where s = ?"
+
+
+# ---------------------------------------------------- latency histogram
+
+
+def test_histogram_percentiles_monotone():
+    h = LatencyHistogram()
+    for ms in range(1, 101):  # 1..100 ms, uniform
+        h.record(float(ms))
+    p50, p95, p99 = (h.percentile(p) for p in (0.50, 0.95, 0.99))
+    assert h.count == 100
+    assert 0 < p50 <= p95 <= p99
+    # log-scale buckets: estimates land in the right decade
+    assert 16 <= p50 <= 128
+    assert p99 <= 256
+
+
+def test_histogram_empty_and_overflow():
+    h = LatencyHistogram()
+    assert h.percentile(0.5) == 0.0
+    h.record(10 ** 9)  # beyond the last bound -> overflow bucket
+    assert h.counts[-1] == 1
+    assert h.percentile(0.5) >= LatencyHistogram.BOUNDS_MS[-1]
+
+
+# ------------------------------------------------------- LFU eviction
+
+
+def test_query_stats_percentile_columns():
+    qs = QueryStats()
+    for _ in range(4):
+        qs.record("SELECT sum(v) FROM t", 0.010, 1, "adaptive")
+    rows = qs.rows_view()
+    assert len(rows) == 1
+    q, executor, pkey, calls, total_ms, rows_n, p50, p95, p99 = rows[0]
+    assert calls == 4 and executor == "adaptive"
+    assert 0 < p50 <= p95 <= p99
+
+
+def test_lfu_evicts_coldest_family():
+    qs = QueryStats(max_entries=3)
+    qs.record("SELECT 1 FROM a", 0.001, 1, "e")   # calls=1 (victim)
+    for _ in range(3):
+        qs.record("SELECT 1 FROM b", 0.001, 1, "e")  # calls=3
+    for _ in range(2):
+        qs.record("SELECT 1 FROM c", 0.001, 1, "e")  # calls=2
+    qs.record("SELECT 1 FROM d", 0.001, 1, "e")   # evicts the coldest
+    keys = {r[0] for r in qs.rows_view()}
+    assert "select ? from a" not in keys
+    assert {"select ? from b", "select ? from c",
+            "select ? from d"} == keys
+
+
+def test_lfu_tie_breaks_by_insertion_order():
+    qs = QueryStats(max_entries=2)
+    qs.record("SELECT 1 FROM a", 0.001, 1, "e")  # calls=1, older
+    qs.record("SELECT 1 FROM b", 0.001, 1, "e")  # calls=1, newer
+    qs.record("SELECT 1 FROM c", 0.001, 1, "e")  # evicts a (stalest)
+    keys = {r[0] for r in qs.rows_view()}
+    assert keys == {"select ? from b", "select ? from c"}
+
+
+def test_lfu_hot_key_survives_heavy_churn():
+    qs = QueryStats(max_entries=10)
+    for _ in range(50):
+        qs.record("SELECT * FROM hot", 0.001, 1, "e")
+    for i in range(100):  # one-call families churn through the table
+        qs.record(f"SELECT * FROM cold_{i} WHERE x = 'u'", 0.001, 1, "e")
+    keys = {r[0] for r in qs.rows_view()}
+    assert "select * from hot" in keys
+    # internal invariant: frequency buckets account for every key
+    assert sum(len(b) for b in qs._freq.values()) == len(qs._stats)
+
+
+def test_lfu_min_calls_cursor_resets_on_insert():
+    qs = QueryStats(max_entries=100)
+    for _ in range(5):
+        qs.record("SELECT * FROM hot", 0.001, 1, "e")
+    assert qs._min_calls <= 5
+    qs.record("SELECT * FROM newcomer", 0.001, 1, "e")
+    assert qs._min_calls == 1  # new family re-opens the coldest bucket
+
+
+# ------------------------------------------------------- tenant window
+
+
+def test_tenant_stats_expire_at_read(monkeypatch):
+    ts = TenantStats()
+    now = [1000.0]
+    monkeypatch.setattr(time, "time", lambda: now[0])
+    ts.record("acme", 0.010)
+    ts.record("acme", 0.010)
+    ts.record("globex", 0.005)
+    rows = dict((k, (c, ms)) for k, c, ms in ts.rows_view())
+    assert rows["acme"][0] == 2 and rows["globex"][0] == 1
+    # regression: past the window with NO new record, the stale counts
+    # used to show forever; rows_view must expire them
+    now[0] += TenantStats.WINDOW_S + 1
+    assert ts.rows_view() == []
+    # a fresh record after expiry starts a clean window
+    ts.record("acme", 0.002)
+    rows = ts.rows_view()
+    assert rows == [("acme", 1, 2.0)]

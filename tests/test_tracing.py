@@ -1,0 +1,234 @@
+"""End-to-end distributed tracing (citus_tpu/observability/): span-tree
+shape, cross-RPC trace_id propagation over a 2-host in-process cluster,
+the allocation-free unsampled hot path, slow-query force-capture, the
+Chrome-trace / Prometheus exporters, and the live-phase activity view.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.observability import trace as T
+from citus_tpu.observability.slowlog import GLOBAL_SLOW_LOG
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"))
+    c.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    c.execute("SELECT create_distributed_table('t', 'k', 4)")
+    c.copy_from("t", columns={"k": np.arange(2000),
+                              "v": np.arange(2000) * 2})
+    yield c
+    c.close()
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    """Two coordinators, one logical cluster (same shape as the
+    worker-tasks fixture): A hosts node 0, B attaches and hosts 1."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    a.register_node()
+    b = ct.Cluster(str(tmp_path / "b"), data_port=0, hosted_nodes=set(),
+                   coordinator=("127.0.0.1", a.control_port), n_nodes=0)
+    b.register_node()
+    a._maybe_reload_catalog(force_sync=True)
+    yield a, b
+    b.close()
+    a.close()
+
+
+# ------------------------------------------------------ tree correctness
+
+
+def test_span_tree_single_rooted_no_orphans(cl):
+    cl.execute("SET citus.trace_sample_rate = 1.0")
+    cl.execute("SELECT count(*), sum(v) FROM t WHERE v < 3000")
+    tr = T.last_trace()
+    assert tr is not None
+    root = tr.root()
+    assert root is not None and root.name == "query"
+    ids = {s.span_id for s in tr.spans}
+    roots = [s for s in tr.spans
+             if s.parent_id is None or s.parent_id not in ids]
+    assert roots == [root], [s.name for s in roots]
+    # the canonical phases hang off the tree
+    names = {s.name for s in tr.spans}
+    assert {"parse", "plan", "execute", "finalize"} <= names, names
+    # every span closed, durations folded into counters
+    assert all(s.t1 is not None for s in tr.spans)
+    snap = cl.counters.snapshot()
+    assert snap["trace_queries_sampled"] >= 1
+    assert snap["trace_spans_recorded"] >= len(tr.spans)
+
+
+def test_plan_span_reports_cache_hit(cl):
+    cl.execute("SET citus.trace_sample_rate = 1.0")
+    cl.execute("SELECT sum(v) FROM t WHERE v < 100")
+    cl.execute("SELECT sum(v) FROM t WHERE v < 100")
+    tr = T.last_trace()
+    ps = tr.find("plan")
+    assert ps is not None and ps.attrs.get("cache_hit") is True
+
+
+def test_unsampled_path_is_allocation_free(cl):
+    cl.execute("SET citus.trace_sample_rate = 0")
+    cl.execute("SELECT count(*) FROM t")  # settle caches/compiles
+    before = T.span_allocations()
+    cl.execute("SELECT count(*) FROM t WHERE k = 7")
+    cl.execute("SELECT sum(v) FROM t")
+    assert T.span_allocations() == before
+
+
+def test_sample_rate_validation(cl):
+    from citus_tpu.errors import CatalogError
+    with pytest.raises(CatalogError):
+        cl.execute("SET citus.trace_sample_rate = 1.5")
+
+
+# ------------------------------------------------------------ slow log
+
+
+def test_slow_log_force_captures_at_threshold(cl):
+    GLOBAL_SLOW_LOG.clear()
+    cl.execute("SET citus.trace_sample_rate = 0")
+    cl.execute("SET citus.log_min_duration_ms = 0")
+    cl.execute("SELECT count(*) FROM t")
+    assert len(GLOBAL_SLOW_LOG) >= 1
+    ts, dur_ms, trace_id, phases, sql = GLOBAL_SLOW_LOG.rows_view()[0]
+    assert "count(*)" in sql and dur_ms >= 0
+    assert "execute=" in phases  # per-phase breakdown from the tree
+    # threshold off -> no further capture
+    GLOBAL_SLOW_LOG.clear()
+    cl.execute("SET citus.log_min_duration_ms = -1")
+    cl.execute("SELECT count(*) FROM t")
+    assert len(GLOBAL_SLOW_LOG) == 0
+    # a high threshold watches but does not capture fast queries
+    cl.execute("SET citus.log_min_duration_ms = 60000")
+    cl.execute("SELECT count(*) FROM t")
+    assert len(GLOBAL_SLOW_LOG) == 0
+    r = cl.execute("SELECT citus_slow_queries()")
+    assert r.columns[1] == "duration_ms"
+
+
+# ------------------------------------------------------- cross-host RPC
+
+
+def test_remote_spans_share_trace_id_and_nest(pair, tmp_path):
+    """The acceptance criterion: a sampled multi-shard aggregate over a
+    2-host cluster exports ONE Chrome trace whose remote execute_task
+    spans nest under the coordinator's query span, sharing trace_id."""
+    a, b = pair
+    n = 8000
+    a.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('t', 'k', 4)")
+    a.copy_from("t", columns={"k": np.arange(n), "v": np.arange(n)})
+    export = tmp_path / "traces"
+    a.execute("SET citus.trace_sample_rate = 1.0")
+    a.execute(f"SET citus.trace_export_dir = '{export}'")
+    r = a.execute("SELECT count(*), sum(v) FROM t")
+    assert r.rows == [(n, n * (n - 1) // 2)]
+    tr = T.last_trace()
+    root = tr.root()
+    assert root.name == "query"
+    rtasks = tr.find_all("remote_task")
+    assert rtasks, [s.name for s in tr.spans]
+    by_id = {s.span_id: s for s in tr.spans}
+    # worker-recorded execute_task spans were grafted under remote_task
+    # spans of the SAME trace (single tree, one trace_id)
+    wspans = tr.find_all("execute_task")
+    assert wspans, [s.name for s in tr.spans]
+    for w in wspans:
+        anchor = by_id[w.parent_id]
+        assert anchor.name == "remote_task"
+        # ancestry chains to the coordinator's query root
+        cur = anchor
+        while cur.parent_id is not None:
+            cur = by_id[cur.parent_id]
+        assert cur is root
+        # grafted times are re-anchored inside the RPC window
+        assert anchor.t0 <= w.t0 and w.t1 <= anchor.t1 + 1e-6
+    # worker body spans came along too
+    assert tr.find("worker_scan") is not None
+    # exported Chrome trace: one file for this query, loadable JSON
+    files = [f for f in os.listdir(export) if f.endswith(".json")]
+    assert f"trace_{tr.trace_id}.json" in files
+    doc = json.load(open(export / f"trace_{tr.trace_id}.json"))
+    assert doc["otherData"]["trace_id"] == tr.trace_id
+    evts = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in evts}
+    assert {"query", "remote_task", "execute_task"} <= names
+    # remote worker spans render on a different pid row than the
+    # coordinator's
+    pids = {e["pid"] for e in evts if e["name"] == "execute_task"}
+    assert pids and 1 not in pids
+
+
+def test_explain_analyze_renders_from_span_tree(pair):
+    a, b = pair
+    n = 4000
+    a.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('t', 'k', 4)")
+    a.copy_from("t", columns={"k": np.arange(n), "v": np.arange(n)})
+    a.execute("SET citus.trace_sample_rate = 0")  # forced trace anyway
+    r = a.execute("EXPLAIN ANALYZE SELECT count(*) FROM t")
+    txt = "\n".join(row[0] for row in r.rows)
+    assert "Plan Cache:" in txt and "Elapsed:" in txt
+    assert "Remote Tasks:" in txt and "pushed to node" in txt, txt
+    # the lines came from the forced trace's tree
+    tr = T.last_trace()
+    assert tr.find("remote_task") is not None
+    assert "forced" in tr.reasons
+
+
+# ----------------------------------------------------------- exporters
+
+
+def test_prometheus_text_exposition(cl):
+    cl.execute("SELECT count(*) FROM t")
+    r = cl.execute("SHOW citus.metrics")
+    txt = "\n".join(row[0] for row in r.rows)
+    assert "# TYPE citus_queries_executed counter" in txt
+    assert "citus_plan_cache_entries" in txt
+    assert "citus_query_latency_ms_bucket" in txt
+    assert 'le="+Inf"' in txt
+    assert "citus_query_latency_ms_count" in txt
+    # SQL-function spelling returns the same payload
+    r2 = cl.execute("SELECT citus_metrics()")
+    assert "\n".join(row[0] for row in r2.rows).splitlines()[0] \
+        == txt.splitlines()[0]
+
+
+def test_activity_reports_phase(cl):
+    """ActivityTracker rows end with the live phase; a finished query
+    leaves no rows, so drive the tracker directly."""
+    gpid = cl.activity.enter("SELECT 1")
+    T.push_phase_sink(lambda ph, _g=gpid: cl.activity.set_phase(_g, ph))
+    try:
+        T.set_phase("remote-wait")
+        rows = cl.execute("SELECT citus_stat_activity()").rows
+        mine = [r for r in rows if r[0] == gpid]
+        assert mine and mine[0][-1] == "remote-wait"
+    finally:
+        T.pop_phase_sink()
+        cl.activity.exit(gpid)
+
+
+def test_two_pc_spans_on_cross_host_write(pair):
+    a, b = pair
+    n = 1000
+    a.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('t', 'k', 4)")
+    a.copy_from("t", columns={"k": np.arange(n), "v": np.arange(n)})
+    a.execute("SET citus.trace_sample_rate = 1.0")
+    a.execute("UPDATE t SET v = v + 1 WHERE v >= 0")
+    # the multi-host modify recorded its 2PC phases in SOME sampled
+    # trace this statement produced
+    tr = T.last_trace()
+    names = {s.name for s in tr.spans}
+    assert "2pc_prepare" in names and "2pc_commit_point" in names, names
+    assert "2pc_decide" in names, names
