@@ -341,38 +341,47 @@ class Catalog:
             return
         with open(p) as fh:
             d = json.load(fh)
-        self.load_document(d)
-        self._doc_sig = _stat_sig(p)
+        with self._lock:
+            self.load_document(d)
+            self._doc_sig = _stat_sig(p)
 
     def load_document(self, d: dict) -> None:
         """Replace in-memory state with a catalog document (the unit the
         control plane ships between coordinators).  Documents written by
         older builds are lifted through the versioned migrations first
-        (catalog/migrations.py; the ALTER EXTENSION ... UPDATE analog)."""
+        (catalog/migrations.py; the ALTER EXTENSION ... UPDATE analog).
+
+        Swaps every section atomically under the catalog lock: an MX
+        invalidation reload arrives on the subscriber thread while
+        sessions read the catalog, and a reader must never observe new
+        tables with old schemas."""
         from citus_tpu.catalog.migrations import migrate_document
         d = migrate_document(d)
-        self.tables = {t["name"]: TableMeta.from_json(t) for t in d["tables"]}
-        self.nodes = {n["node_id"]: NodeMeta.from_json(n) for n in d["nodes"]}
-        self._next_shard_id = d["next_shard_id"]
-        self._next_colocation_id = d["next_colocation_id"]
-        self.schemas = d.get("schemas", {})
-        self.views = d.get("views", {})
-        self.sequences = d.get("sequences", {})
-        self.roles = d.get("roles", {})
-        self.grants = d.get("grants", {})
-        self.functions = d.get("functions", {})
-        self.types = d.get("types", {})
-        self.enum_columns = d.get("enum_columns", {})
-        self.policies = d.get("policies", {})
-        self.rls = d.get("rls", {})
-        self.triggers = d.get("triggers", {})
-        self.ts_configs = d.get("ts_configs", {})
-        self.extensions = d.get("extensions", {})
-        self.domain_columns = d.get("domain_columns", {})
-        self.domains = d.get("domains", {})
-        self.collations = d.get("collations", {})
-        self.publications = d.get("publications", {})
-        self.statistics = d.get("statistics", {})
+        with self._lock:
+            self.tables = {t["name"]: TableMeta.from_json(t)
+                           for t in d["tables"]}
+            self.nodes = {n["node_id"]: NodeMeta.from_json(n)
+                          for n in d["nodes"]}
+            self._next_shard_id = d["next_shard_id"]
+            self._next_colocation_id = d["next_colocation_id"]
+            self.schemas = d.get("schemas", {})
+            self.views = d.get("views", {})
+            self.sequences = d.get("sequences", {})
+            self.roles = d.get("roles", {})
+            self.grants = d.get("grants", {})
+            self.functions = d.get("functions", {})
+            self.types = d.get("types", {})
+            self.enum_columns = d.get("enum_columns", {})
+            self.policies = d.get("policies", {})
+            self.rls = d.get("rls", {})
+            self.triggers = d.get("triggers", {})
+            self.ts_configs = d.get("ts_configs", {})
+            self.extensions = d.get("extensions", {})
+            self.domain_columns = d.get("domain_columns", {})
+            self.domains = d.get("domains", {})
+            self.collations = d.get("collations", {})
+            self.publications = d.get("publications", {})
+            self.statistics = d.get("statistics", {})
 
     def export_document(self) -> dict:
         from citus_tpu.catalog.migrations import CATALOG_FORMAT_VERSION
@@ -405,7 +414,8 @@ class Catalog:
     def tombstone(self, section: str, name: str) -> None:
         """Record a deletion so the commit-time merge never resurrects a
         dropped object from a concurrent coordinator's document."""
-        self._tombstones.setdefault(section, set()).add(name)
+        with self._lock:
+            self._tombstones.setdefault(section, set()).add(name)
 
     def _merge_foreign_locked(self) -> None:
         """Adopt another coordinator's catalog changes before storing
@@ -422,9 +432,9 @@ class Catalog:
                 d = json.load(fh)
         except (OSError, json.JSONDecodeError):
             return
-        self._merge_doc(d)
+        self._merge_doc_locked(d)
 
-    def _merge_doc(self, d: dict) -> None:
+    def _merge_doc_locked(self, d: dict) -> None:
         """Adopt another coordinator's catalog document into memory
         (tombstones guard drops; table conflicts resolve by version)."""
         from citus_tpu.catalog.migrations import migrate_document
@@ -538,7 +548,7 @@ class Catalog:
                     remote = tr.fetch_catalog_doc()
                     with self._lock:
                         if remote is not None:
-                            self._merge_doc(remote)
+                            self._merge_doc_locked(remote)
                         doc = self.export_document()
                         tombs = {k: sorted(v)
                                  for k, v in self._tombstones.items()}
@@ -547,16 +557,16 @@ class Catalog:
                 # invalidation (tagged with our origin); only now are the
                 # drop tombstones consumed (a failed push must leave them
                 # for the flock fallback's merge)
-                with self._lock:
-                    self._tombstones = {}
                 # stamp the authority's file write as our own so the
                 # mtime poller doesn't treat our commit as foreign and
                 # reload underneath concurrent readers
-                try:
-                    self.self_mtime = os.path.getmtime(self._path())
-                    self._doc_sig = _stat_sig(self._path())
-                except OSError:
-                    pass
+                with self._lock:
+                    self._tombstones = {}
+                    try:
+                        self.self_mtime = os.path.getmtime(self._path())
+                        self._doc_sig = _stat_sig(self._path())
+                    except OSError:
+                        pass
                 return
             except Exception:
                 # authority unreachable mid-commit: fall through to the
@@ -1050,9 +1060,10 @@ class Catalog:
         return value
 
     def _alloc_shard_id(self) -> int:
-        sid = self._next_shard_id
-        self._next_shard_id += 1
-        return sid
+        with self._lock:
+            sid = self._next_shard_id
+            self._next_shard_id += 1
+            return sid
 
     # ---- nodes --------------------------------------------------------
     def ensure_nodes(self, count: int) -> list[int]:
@@ -1089,20 +1100,23 @@ class Catalog:
 
     def _ensure_dict(self, table: str, column: str) -> None:
         key = (table, column)
-        if key in self._dicts:
-            return
-        p = self._dict_path(table, column)
-        if not os.path.exists(p):
-            # attached coordinator without the side file: the authority
-            # holds the canonical dictionary — mirror it locally
-            self._fetch_remote_dict(table, column)
-        words = []
-        if os.path.exists(p):
-            with open(p) as fh:
-                words = json.load(fh)
-        self._dicts[key] = words
-        self._dict_index[key] = {w: i for i, w in enumerate(words)}
-        self._dict_sig[key] = _stat_sig(p)
+        # reentrant: encode_strings already holds the catalog lock, and
+        # lookup paths may race store_document clearing the caches
+        with self._lock:
+            if key in self._dicts:
+                return
+            p = self._dict_path(table, column)
+            if not os.path.exists(p):
+                # attached coordinator without the side file: the
+                # authority holds the canonical dictionary — mirror it
+                self._fetch_remote_dict(table, column)
+            words = []
+            if os.path.exists(p):
+                with open(p) as fh:
+                    words = json.load(fh)
+            self._dicts[key] = words
+            self._dict_index[key] = {w: i for i, w in enumerate(words)}
+            self._dict_sig[key] = _stat_sig(p)
 
     def _fetch_remote_dict(self, table: str, column: str) -> bool:
         """Mirror the authority's dictionary side file (returns True
@@ -1122,9 +1136,10 @@ class Catalog:
             json.dump(words, fh)
         os.replace(tmp, p)
         key = (table, column)
-        self._dicts[key] = list(words)
-        self._dict_index[key] = {w: i for i, w in enumerate(words)}
-        self._dict_sig[key] = _stat_sig(p)
+        with self._lock:
+            self._dicts[key] = list(words)
+            self._dict_index[key] = {w: i for i, w in enumerate(words)}
+            self._dict_sig[key] = _stat_sig(p)
         return True
 
     def _merge_disk_dict(self, table: str, column: str) -> None:
@@ -1139,11 +1154,12 @@ class Catalog:
             return
         with open(p) as fh:
             disk = json.load(fh)
-        words, index = self._dicts[key], self._dict_index[key]
-        for w in disk[len(words):]:
-            index.setdefault(w, len(words))
-            words.append(w)
-        self._dict_sig[key] = sig
+        with self._lock:
+            words, index = self._dicts[key], self._dict_index[key]
+            for w in disk[len(words):]:
+                index.setdefault(w, len(words))
+                words.append(w)
+            self._dict_sig[key] = sig
 
     def _store_dict(self, table: str, column: str) -> None:
         key = (table, column)
@@ -1154,7 +1170,8 @@ class Catalog:
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, dp)
-        self._dict_sig[key] = _stat_sig(dp)
+        with self._lock:
+            self._dict_sig[key] = _stat_sig(dp)
 
     def _word_type(self, table: str, column: str):
         """ColumnType for a dictionary column when it needs kind-specific

@@ -81,8 +81,8 @@ class ChangeDataCapture:
             # dropped by the os.replace
             p = self._path(table)
             size = os.path.getsize(p) if os.path.exists(p) else 0
-            entries = self._load_index(table)
-            pmax = self._prefix_max(table, size, entries)
+            entries = self._load_index_locked(table)
+            pmax = self._prefix_max_locked(table, size, entries)
             last_off = entries[-1][1] if entries else -INDEX_STRIDE_BYTES
             if size - last_off >= INDEX_STRIDE_BYTES:
                 # `size` is a record boundary (appends are whole lines
@@ -101,7 +101,7 @@ class ChangeDataCapture:
             self._prefix_cache[table] = (size + len(line.encode()),
                                          max(pmax, lsn))
 
-    def _prefix_max(self, table: str, size: int, entries) -> int:
+    def _prefix_max_locked(self, table: str, size: int, entries) -> int:
         """Max lsn over the stream's first ``size`` bytes.  Cached per
         table; foreign appends (another process emitting into the same
         stream) are folded in by scanning only the grown delta.  Called
@@ -146,7 +146,7 @@ class ChangeDataCapture:
         return m
 
     # ------------------------------------------------------------- read
-    def _load_index(self, table: str) -> list[tuple[int, int]]:
+    def _load_index_locked(self, table: str) -> list[tuple[int, int]]:
         """[(lsn, byte offset)] ascending; cached on (mtime, size)."""
         p = self._index_path(table)
         try:
@@ -177,7 +177,10 @@ class ChangeDataCapture:
         stride).  Old-format entries without pmax are never trusted."""
         if from_lsn <= 0:
             return 0
-        entries = self._load_index(table)
+        # readers share the cache with emit(): the store below must not
+        # race emit's invalidating pop
+        with self._mu:
+            entries = self._load_index_locked(table)
         best = 0
         for _lsn, off, pmax in entries:
             if pmax is None or pmax > from_lsn:

@@ -2313,6 +2313,7 @@ class Cluster:
                 try:
                     self.catalog.remote_data.call(
                         ep, "txn_branch_abort", {"gxid": txn.gxid})
+                # lint: disable=SWL01 -- peer unreachable: branch expiry resolves the orphan branch
                 except Exception:
                     pass  # branch expiry cleans it up
         try:
@@ -2325,6 +2326,7 @@ class Cluster:
             for act in reversed(txn.on_rollback):
                 try:
                     act()
+                # lint: disable=SWL01 -- rollback actions are best-effort; orphan files never affect reads
                 except Exception:
                     pass  # best-effort: orphan files never affect reads
             if txn.catalog_dirty:

@@ -82,6 +82,17 @@ _GUCS = {
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
     "citus.enable_change_data_capture": (None, "enable_change_data_capture", "bool"),
     "citus.distributed_deadlock_detection_interval": (None, "deadlock_detection_interval_s", float),
+    # every settings field the code reads is SET/SHOW-reachable
+    # (cituslint GUC01): batch floor below which shards merge into one
+    # device dispatch, router fast-path shard cap, GROUP BY hash-slot
+    # budget, repartition-join fanout, and the maintenance/authority
+    # daemon knobs
+    "citus.executor_min_batch_rows": ("executor", "min_batch_rows", int),
+    "citus.direct_gid_limit": ("planner", "direct_gid_limit", int),
+    "citus.hash_agg_slots": ("planner", "hash_agg_slots", int),
+    "citus.repartition_bucket_count_per_device": ("planner", "repartition_bucket_count_per_device", int),
+    "citus.start_maintenance_daemon": (None, "start_maintenance_daemon", "bool"),
+    "citus.authority_watch_interval": (None, "authority_watch_interval_s", float),
     # PostgreSQL spelling: bare numbers are MILLISECONDS; unit
     # suffixes ('3s', '500ms') accepted
     "lock_timeout": ("executor", "lock_timeout_s", "ms_duration"),

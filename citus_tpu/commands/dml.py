@@ -223,6 +223,7 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
                     and not local_prepared:
                 try:
                     cl._rollback_txn(local_session)
+                # lint: disable=SWL01 -- in-doubt path: recovery resolves the branch; rollback is opportunistic
                 except Exception:
                     pass
             return "in-doubt"
@@ -232,6 +233,7 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
             try:
                 cl.catalog.remote_data.call(
                     ep, "dml_decide", {"gxid": gxid, "commit": False})
+            # lint: disable=SWL01 -- abort already durable in the outcome store; branch expiry resolves it
             except Exception:
                 pass  # branch expiry resolves it
         if local_session is not None and local_session.txn is not None:
@@ -244,6 +246,7 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
                     # its staged files (finish_branch's empty payload
                     # would leak them)
                     cl._rollback_txn(local_session)
+            # lint: disable=SWL01 -- abort outcome already durable; local cleanup failure surfaces via recovery
             except Exception:
                 pass
         return "abort"
@@ -272,6 +275,7 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
                     ep, "dml_decide", {"gxid": gxid, "commit": True})
                 if not r.get("ok") and r.get("resolved") != "commit":
                     divergence = (ep, r.get("resolved"))
+            # lint: disable=SWL01 -- commit already durable; an unreachable peer resolves from the outcome store
             except Exception:
                 pass  # resolves to commit from the outcome store
         if divergence is not None:

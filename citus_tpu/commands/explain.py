@@ -75,6 +75,7 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
                 if not bound.has_aggs and len(bound.final_exprs) == len(names):
                     strategy = _insert_select_strategy(
                         cl, t, bound, list(bound.final_exprs), names)
+            # lint: disable=SWL01 -- EXPLAIN-only strategy probe; a bind failure falls back to the generic label
             except Exception:
                 pass
         lines = [f"Insert into {ins.table} ({', '.join(names)})",

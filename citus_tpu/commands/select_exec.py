@@ -420,6 +420,7 @@ def _execute_derived(cl, stmt: A.Select) -> Result:
         for tmp in temps:
             try:
                 cl.drop_table(tmp)
+            # lint: disable=SWL01 -- temp-table cleanup is best-effort; the cleaner duty removes orphans
             except Exception:
                 pass
 
@@ -713,6 +714,7 @@ def _execute_with(cl, stmt: A.WithSelect) -> Result:
         for tmp in temps:
             try:
                 cl.drop_table(tmp)
+            # lint: disable=SWL01 -- temp-table cleanup is best-effort; the cleaner duty removes orphans
             except Exception:
                 pass
 
@@ -780,6 +782,7 @@ def _iterate_recursive_cte(cl, name: str, sel, remap_select, cols):
         finally:
             try:
                 cl.drop_table(wtmp)
+            # lint: disable=SWL01 -- temp-table cleanup is best-effort; the cleaner duty removes orphans
             except Exception:
                 pass
         fresh = []

@@ -224,6 +224,7 @@ def configure_persistent_cache(path: Optional[str]) -> bool:
         try:
             import jax
             jax.config.update(knob, v)
+        # lint: disable=SWL01 -- tuning knob only; older jax builds lack it and the cache works without it
         except Exception:
             pass
     _persistent_dir = str(path)

@@ -217,6 +217,7 @@ class HostPrefetcher:
         if close is not None:
             try:
                 close()
+            # lint: disable=SWL01 -- source close at shutdown is best-effort; batches already delivered
             except Exception:
                 pass
 
@@ -303,6 +304,7 @@ class RemoteTaskDispatch:
                 self._inflight_total += 1
                 self._inflight_peak = max(self._inflight_peak,
                                           self._inflight_total)
+                # lint: disable=THR02 -- workers settle through _cv (wait() blocks until inflight drains); no handle kept
                 threading.Thread(
                     target=self._run_one, daemon=True,
                     name=f"citus-remote-task-{si}",
@@ -345,6 +347,7 @@ class RemoteTaskDispatch:
             dec_s = _perf() - t1
             nbytes = len(blob)
             ok = True
+        # lint: disable=SWL01 -- failure is counted below as remote_task_fallbacks; shard rescans locally
         except Exception:
             # worker dead, version skew, codec refused server-side:
             # this shard scans locally through the pull path instead

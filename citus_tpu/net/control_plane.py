@@ -23,6 +23,7 @@ import json
 import os
 import threading
 import time
+from citus_tpu.utils.clock import now as wall_now
 import uuid
 from contextlib import contextmanager
 from typing import Optional
@@ -160,7 +161,8 @@ class ControlPlane:
         with cat._lock, _catalog_flock(cat.data_dir):
             cat._merge_foreign_locked()
             doc = cat.export_document()
-        self.stats["fetch_catalog"] += 1
+        with self._lock:
+            self.stats["fetch_catalog"] += 1
         return {"doc": doc}
 
     def _on_push_catalog(self, payload: dict) -> dict:
@@ -176,7 +178,8 @@ class ControlPlane:
         self.cluster.catalog.store_document(payload["doc"],
                                             payload.get("tombstones"))
         self.cluster._on_foreign_catalog_applied()
-        self.stats["push_catalog"] += 1
+        with self._lock:
+            self.stats["push_catalog"] += 1
         self.server.broadcast({"event": "catalog_changed", "origin": origin})
         return {"ok": True}
 
@@ -381,6 +384,11 @@ class ControlPlane:
                                               "origin": self.origin})
 
     def _on_push_closed(self) -> None:
+        # lock-free ON PURPOSE: fires on the subscriber thread, possibly
+        # while _try_repoint_locked holds _failover_mu mid-subscribe —
+        # taking the lock here would deadlock; a plain bool store is the
+        # protocol (set-before-subscribe, cleared by whoever sees death)
+        # lint: disable=LOCK01 -- on_close callback may fire while _failover_mu is held; bool store is the documented lock-free protocol
         self.push_alive = False
 
     # ---- authority failover (reference: node_promotion.c) ---------------
@@ -392,7 +400,7 @@ class ControlPlane:
         with open(tmp, "w") as fh:
             json.dump({"host": "127.0.0.1", "port": self.server.port,
                        "origin": self.origin, "pid": os.getpid(),
-                       "promoted_at": time.time()}, fh)
+                       "promoted_at": wall_now()}, fh)
         os.replace(tmp, self._authority_path())
 
     def _read_authority_file(self) -> Optional[dict]:
@@ -430,10 +438,11 @@ class ControlPlane:
                     info = self._read_authority_file()
                     if info is None or info.get("origin") == self.origin:
                         return "ok"
-                    if self._try_repoint(info):
+                    if self._try_repoint_locked(info):
                         old_server, self.server = self.server, None
                         try:
                             old_server.stop()
+                        # lint: disable=SWL01 -- stepping down: closing the dead server socket is best-effort
                         except Exception:
                             pass
                         return "stepped_down"
@@ -446,13 +455,14 @@ class ControlPlane:
                 # promoted while we waited
                 info = self._read_authority_file()
                 if info and info.get("origin") != self.origin \
-                        and self._try_repoint(info):
+                        and self._try_repoint_locked(info):
                     return "repointed"
-                self._promote()
+                self._promote_locked()
                 return "promoted"
 
-    def _try_repoint(self, info: dict) -> bool:
-        """Subscribe to the advertised authority if it answers; any
+    def _try_repoint_locked(self, info: dict) -> bool:
+        """Subscribe to the advertised authority if it answers (called
+        with _failover_mu held); any
         mid-handshake failure (it died between ping and subscribe) falls
         back to promotion.  Never leaks sockets on failure."""
         c = None
@@ -464,6 +474,7 @@ class ControlPlane:
             if c is not None:
                 try:
                     c.close()
+                # lint: disable=SWL01 -- probe socket to a dead peer; close failure changes nothing
                 except Exception:
                     pass
             return False
@@ -478,26 +489,30 @@ class ControlPlane:
             self.client = old
             try:
                 c.close()
+            # lint: disable=SWL01 -- subscribe failed mid-handshake; closing the half-open socket is best-effort
             except Exception:
                 pass
             return False
         if old is not None:
             try:
                 old.close()
+            # lint: disable=SWL01 -- superseded client connection; close failure changes nothing
             except Exception:
                 pass
         # events may have been missed during the outage: force a re-sync
         self.cluster._catalog_dirty = True
         return True
 
-    def _promote(self) -> None:
+    def _promote_locked(self) -> None:
         """Become the metadata authority: serve, advertise, re-sync.
+        Called with _failover_mu held (ensure_authority).
         Reference: citus_promote_clone_and_rebalance / node promotion
         turning a secondary into the metadata writer
         (operations/node_promotion.c)."""
         if self.client is not None:
             try:
                 self.client.close()
+            # lint: disable=SWL01 -- promoting: the old push channel is already dead
             except Exception:
                 pass
             self.client = None
@@ -512,6 +527,7 @@ class ControlPlane:
         try:
             with cat._lock, _catalog_flock(cat.data_dir):
                 cat._merge_foreign_locked()
+        # lint: disable=SWL01 -- pre-serve re-sync is opportunistic; the authority serves its in-memory doc
         except Exception:
             pass
         self.cluster._plan_cache.clear()

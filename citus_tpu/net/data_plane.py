@@ -75,6 +75,14 @@ def decode_batch(blob: bytes) -> tuple[dict, dict]:
     return values, validity
 
 
+def _bump_pool_error() -> None:
+    """Count a swallowed data-plane failure (failed close/rollback or an
+    unreachable peer on a best-effort path).  These paths deliberately
+    keep going — the counter is how the swallow stays visible in SHOW
+    STATS and the Prometheus exporter instead of vanishing."""
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    GLOBAL_COUNTERS.bump("data_plane_pool_errors")
+
 class DataPlaneServer:
     """Serves this coordinator's locally-hosted placements."""
 
@@ -269,7 +277,7 @@ class DataPlaneServer:
                 try:
                     s.execute("ROLLBACK")
                 except Exception:
-                    pass
+                    _bump_pool_error()
             raise
         with self._branches_mu:
             self._branches[gxid] = {"s": s, "born": _time.monotonic(),
@@ -303,7 +311,7 @@ class DataPlaneServer:
                 try:
                     s.execute("ROLLBACK")
                 except Exception:
-                    pass
+                    _bump_pool_error()
         with entry["mu"]:
             # re-check under the entry lock: the expiry duty resolves
             # branches under the same lock, so a statement can never
@@ -396,12 +404,13 @@ class DataPlaneServer:
                         try:
                             s.execute("ROLLBACK")
                         except Exception:
-                            pass
+                            _bump_pool_error()
                     continue
                 try:
                     winner = self.cluster._control.record_txn_outcome(
                         gxid, "abort")
                 except Exception:
+                    _bump_pool_error()
                     continue  # authority unreachable: keep the branch
                 with self._branches_mu:
                     if self._branches.pop(gxid, None) is None:
@@ -466,7 +475,7 @@ class DataPlaneClient:
                 try:
                     c.close()
                 except Exception:
-                    pass
+                    _bump_pool_error()
                 return existing
             self._conns[endpoint] = c
             return c
@@ -478,7 +487,7 @@ class DataPlaneClient:
             try:
                 c.close()
             except Exception:
-                pass
+                _bump_pool_error()
 
     def call(self, endpoint: tuple, method: str, payload: dict,
              blob: Optional[bytes] = None) -> dict:
@@ -514,7 +523,7 @@ class DataPlaneClient:
             try:
                 c.close()
             except Exception:
-                pass
+                _bump_pool_error()
             raise
         with self._lock:
             idle = self._idle.setdefault(key, [])
@@ -525,7 +534,7 @@ class DataPlaneClient:
             try:
                 c.close()
             except Exception:
-                pass
+                _bump_pool_error()
         return out
 
     # ---- read path -----------------------------------------------------
@@ -678,4 +687,4 @@ class DataPlaneClient:
             try:
                 c.close()
             except Exception:
-                pass
+                _bump_pool_error()

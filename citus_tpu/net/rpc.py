@@ -137,6 +137,7 @@ class RpcServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            # lint: disable=THR02 -- per-connection handler exits when stop() closes its socket; nothing to join
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -224,6 +225,9 @@ class RpcServer:
                     pass
             self._subscribers.clear()
             self._conns.clear()
+        # closing the listen socket unblocks accept(); reap the loop
+        if self._thread.is_alive():
+            self._thread.join(timeout=1.0)
 
 
 class RpcError(RuntimeError):
@@ -292,12 +296,14 @@ class RpcClient:
                         return
                     try:
                         callback(event[0])
+                    # lint: disable=SWL01 -- a broken subscriber callback must not kill the listener thread
                     except Exception:
                         pass
             finally:
                 if on_close is not None:
                     try:
                         on_close()
+                    # lint: disable=SWL01 -- on_close is a user callback; the listener is already exiting
                     except Exception:
                         pass
 
@@ -311,3 +317,10 @@ class RpcClient:
                     s.close()
                 except OSError:
                     pass
+        # closing _sub_sock makes the listener's recv fail; reap it —
+        # unless close() is running ON the listener (an on_close
+        # callback closing its own client must not self-join)
+        _listener = getattr(self, "_listener", None)
+        if _listener is not None and _listener.is_alive() \
+                and _listener is not threading.current_thread():
+            _listener.join(timeout=1.0)

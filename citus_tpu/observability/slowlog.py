@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import threading
 import time
+from citus_tpu.utils.clock import now as wall_now
 from collections import deque
 from typing import Optional
 
@@ -36,7 +37,7 @@ class SlowQueryLog:
                          for s in trace.children(root.span_id)]
                 phases = " ".join(parts)
         with self._mu:
-            self._ring.append((time.time(), round(duration_ms, 3),
+            self._ring.append((wall_now(), round(duration_ms, 3),
                                trace_id, phases, sql))
         _counters().bump("slow_queries_logged")
 

@@ -32,6 +32,8 @@ import os
 import random
 import threading
 import time
+
+from citus_tpu.utils.clock import now as wall_now
 import uuid
 from typing import Optional
 
@@ -143,7 +145,7 @@ class Trace:
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
         self.spans: list[Span] = []
         # wall anchor for exporters: span.t0 - self.t0 offsets t0_wall
-        self.t0_wall = time.time()
+        self.t0_wall = wall_now()
         self.t0 = clock()
         self._mu = threading.Lock()
         self.reasons: set[str] = set()
@@ -309,6 +311,7 @@ def set_phase(phase: str) -> None:
     if sinks:
         try:
             sinks[-1](phase)
+        # lint: disable=SWL01 -- observability sink must never raise into the executor hot path
         except Exception:
             pass
 

@@ -23,6 +23,7 @@ import json
 import os
 import shutil
 import time
+from citus_tpu.utils.clock import now as wall_now
 
 from citus_tpu.catalog import Catalog
 
@@ -64,7 +65,7 @@ def record_cleanup(cat: Catalog, resource_path: str, policy: str = DEFERRED_ON_S
         records = _load(cat)
         records.append({
             "path": resource_path, "policy": policy,
-            "operation_id": operation_id, "recorded_at": time.time(),
+            "operation_id": operation_id, "recorded_at": wall_now(),
         })
         _store(cat, records)
 

@@ -19,6 +19,7 @@ import json
 import os
 import shutil
 import time
+from citus_tpu.utils.clock import now as wall_now
 
 from citus_tpu.catalog import Catalog
 from citus_tpu.errors import CatalogError
@@ -53,7 +54,7 @@ def create_restore_point(cat: Catalog, name: str) -> str:
                     shutil.copy2(os.path.join(root, f), os.path.join(dst, rel, f))
                     metas.append(os.path.join(rel, f))
     with open(os.path.join(dst, "restore_point.json"), "w") as fh:
-        json.dump({"name": name, "created_at": time.time(), "metas": metas}, fh)
+        json.dump({"name": name, "created_at": wall_now(), "metas": metas}, fh)
     return dst
 
 

@@ -170,6 +170,7 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
                 cat.remote_data.drop_placement(
                     cat.node_endpoint(source_node), t.name, s.shard_id,
                     source_node)
+            # lint: disable=SWL01 -- deferred cleanup is best-effort; the cleaner duty re-runs it
             except Exception:
                 pass  # deferred cleanup is best-effort; cleaner re-runs
         if target_remote:

@@ -18,6 +18,7 @@ import json
 import os
 import threading
 import time
+from citus_tpu.utils.clock import now as wall_now
 import traceback
 from typing import Callable, Optional
 
@@ -81,7 +82,7 @@ class BackgroundJobRunner:
             self._state["next_job_id"] += 1
             self._state["jobs"].append({
                 "job_id": jid, "description": description,
-                "status": JobStatus.SCHEDULED, "created_at": time.time(),
+                "status": JobStatus.SCHEDULED, "created_at": wall_now(),
             })
             self._store()
             return jid
@@ -128,8 +129,8 @@ class BackgroundJobRunner:
 
     def wait_for_job(self, job_id: int, timeout: float = 60.0) -> str:
         """citus_job_wait analog."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = wall_now() + timeout
+        while wall_now() < deadline:
             st = self.job_status(job_id)
             if st in (JobStatus.DONE, JobStatus.FAILED, JobStatus.CANCELLED):
                 return st

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
+from citus_tpu.utils.clock import now as wall_now
 from dataclasses import dataclass
 from typing import Callable
 
@@ -78,11 +79,11 @@ class MaintenanceDaemon:
             d.runs += 1
         except Exception:
             d.errors += 1
-        d.last_run = time.time()
+        d.last_run = wall_now()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            now = time.time()
+            now = wall_now()
             for d in self._duties:
                 if now - d.last_run >= self._interval(d):
                     self._run_duty(d)

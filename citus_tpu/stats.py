@@ -17,6 +17,7 @@ import itertools
 import re
 import threading
 import time
+from citus_tpu.utils.clock import now as wall_now
 from bisect import bisect_left
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -77,6 +78,20 @@ class StatCounters:
         "span_execute_ms",
         "span_finalize_ms",
         "span_remote_task_ms",
+        # cross-host ingest routed through the data plane (cluster.py)
+        "rows_ingested_remote",
+        # data-plane connection pool: send/recv/connect failures that
+        # trigger a reconnect or failover (net/data_plane.py) — silent
+        # before, every swallow now counts here
+        "data_plane_pool_errors",
+        # authority failovers that ended in self-promotion
+        # (net/control_plane.py ensure_authority)
+        "authority_promotions",
+        # per-stripe secondary-index probes served (storage/reader.py)
+        "index_lookups",
+        # victims cancelled by the global deadlock detector
+        # (transaction/global_deadlock.py)
+        "deadlocks_cancelled",
     ]
 
     def __init__(self):
@@ -270,7 +285,7 @@ class TenantStats:
         self.max_tenants = max_tenants
 
     def record(self, tenant: str, elapsed_s: float) -> None:
-        now = time.time()
+        now = wall_now()
         with self._mu:
             st = self._t.get(tenant)
             if st is None:
@@ -284,7 +299,7 @@ class TenantStats:
             st[1] += elapsed_s
 
     def rows_view(self) -> list[tuple]:
-        now = time.time()
+        now = wall_now()
         with self._mu:
             # expire at read time: a tenant whose window elapsed with no
             # new record would otherwise show its stale count forever
@@ -318,7 +333,7 @@ class ActivityTracker:
     def enter(self, sql: str) -> int:
         gpid = next(_GPID)
         with self._mu:
-            self._live[gpid] = Activity(gpid, sql, time.time())
+            self._live[gpid] = Activity(gpid, sql, wall_now())
         return gpid
 
     def exit(self, gpid: int) -> None:
@@ -332,7 +347,7 @@ class ActivityTracker:
                 a.phase = phase
 
     def rows_view(self) -> list[tuple]:
-        now = time.time()
+        now = wall_now()
         with self._mu:
             return [(a.gpid, a.state, round(now - a.started_at, 3), a.sql,
                      a.phase)

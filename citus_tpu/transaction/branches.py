@@ -128,6 +128,7 @@ def commit_txn_cross_host(cl, session) -> None:
                 try:
                     txn.remote_endpoints = set()  # branches stay put
                     cl._rollback_txn(session)
+                # lint: disable=SWL01 -- in-doubt path: TransactionError below surfaces the state; rollback is opportunistic
                 except Exception:
                     pass
             elif local_prepared:
@@ -149,6 +150,7 @@ def commit_txn_cross_host(cl, session) -> None:
         for ep in sorted(txn.remote_endpoints):
             try:
                 rd.call(ep, "txn_branch_abort", {"gxid": gxid})
+            # lint: disable=SWL01 -- abort already durable; an unreachable branch expires against the register
             except Exception:
                 pass
         if session.txn is not None:
@@ -158,6 +160,7 @@ def commit_txn_cross_host(cl, session) -> None:
                 else:
                     txn.remote_endpoints = set()  # already aborted
                     cl._rollback_txn(session)
+            # lint: disable=SWL01 -- original failure re-raised below; local cleanup failure resolves via recovery
             except Exception:
                 pass
         raise
@@ -187,6 +190,7 @@ def complete_cross_host_commit(cl, session, txn, gxid: str,
                         {"gxid": gxid, "commit": True})
             if not r.get("ok") and r.get("resolved") != "commit":
                 divergence = (ep, r.get("resolved"))
+        # lint: disable=SWL01 -- commit already durable; an unreachable peer resolves from the outcome store
         except Exception:
             pass  # branch resolves to commit from the outcome store
     if divergence is not None:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from citus_tpu.utils.clock import now as wall_now
 from typing import Optional
 
 from citus_tpu.transaction.locks import EXCLUSIVE, SHARED, DeadlockDetected
@@ -100,7 +101,7 @@ def _cancel_path(data_dir: str, gpid: str) -> str:
 def request_cancel(data_dir: str, gpid: str,
                    nonce: Optional[str] = None) -> None:
     _write_record(_cancel_path(data_dir, gpid),
-                  {"at": time.time(), "nonce": nonce})
+                  {"at": wall_now(), "nonce": nonce})
 
 
 def check_cancelled(data_dir: str, gpid: str,

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+from citus_tpu.utils.clock import now as wall_now
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -54,7 +55,7 @@ class LockManager:
             # wall clock, not monotonic: start times feed the GLOBAL
             # youngest-dies victim policy, where they compare against
             # other processes' wall-clock records
-            self._session_started.setdefault(session_id, time.time())
+            self._session_started.setdefault(session_id, wall_now())
 
     def release_all(self, session_id: int) -> None:
         with self._mu:
@@ -101,7 +102,7 @@ class LockManager:
                         res.waiters = [(s, m) for s, m in res.waiters if s != session_id]
                         self._waiting_for.pop(session_id, None)
                         return
-                    victim = self._find_deadlock_victim()
+                    victim = self._find_deadlock_victim_locked()
                     if victim is not None:
                         if victim == session_id:
                             self._victims.discard(victim)
@@ -169,7 +170,7 @@ class LockManager:
         with self._mu:
             return dict(self._session_started)
 
-    def _find_deadlock_victim(self) -> Optional[int]:
+    def _find_deadlock_victim_locked(self) -> Optional[int]:
         """DFS cycle search; victim = youngest session in the cycle
         (CheckForDistributedDeadlocks policy).  Runs under self._mu
         (called from acquire); shares the cycle search with the global

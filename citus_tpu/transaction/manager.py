@@ -28,6 +28,7 @@ import json
 import os
 import threading
 import time
+from citus_tpu.utils.clock import now as wall_now
 import uuid
 from typing import Optional
 
@@ -158,14 +159,14 @@ class TransactionLog:
             with open(self.path, "a") as fh:
                 fh.write(json.dumps({"xid": -1, "state": TxState.BLOCK,
                                      "block": [lo, hi], "owner": self.owner,
-                                     "at": time.time()}) + "\n")
+                                     "at": wall_now()}) + "\n")
                 fh.flush()
                 os.fsync(fh.fileno())
         self._block_lo, self._block_hi = lo, hi
         self._next_xid = lo
 
     def log(self, xid: int, state: str, payload: Optional[dict] = None) -> None:
-        self._append({"xid": xid, "state": state, "at": time.time(),
+        self._append({"xid": xid, "state": state, "at": wall_now(),
                       "payload": payload or {}})
         if state == TxState.DONE:
             with self._lock:

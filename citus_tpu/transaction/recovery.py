@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import re
 import time
+from citus_tpu.utils.clock import now as wall_now
 
 from citus_tpu.catalog import Catalog
 from citus_tpu.storage.writer import SHARD_META, abort_staged, commit_staged
@@ -137,7 +138,7 @@ def recover_transactions(cat: Catalog, txlog: TransactionLog,
     known = {xid for xid, _, _ in txlog.outstanding()}
     known |= {rec["xid"] for rec in txlog.records()
               if rec["state"] != TxState.BLOCK}
-    now = time.time()
+    now = wall_now()
 
     def sweepable(xid: int, path: str) -> bool:
         if xid in known or xid in txlog.inflight() or xid in peer_inflight:

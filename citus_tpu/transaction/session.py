@@ -62,10 +62,10 @@ class OpenTransaction:
     """State of one BEGIN..COMMIT block."""
 
     def __init__(self, xid: int, lock_sid: int):
-        import time as _time
+        from citus_tpu.utils.clock import now as wall_now
         self.xid = xid
         self.lock_sid = lock_sid
-        self.started = _time.time()  # deadlock victim policy: youngest dies
+        self.started = wall_now()  # deadlock victim policy: youngest dies
         self.failed = False
         self.ingest_dirs: set[str] = set()   # staged stripes
         self.delete_dirs: set[str] = set()   # staged deletion bitmaps
@@ -308,6 +308,7 @@ class OpenTransaction:
             for act in reversed(self.on_rollback[snap["n_on_rollback"]:]):
                 try:
                     act()
+                # lint: disable=SWL01 -- savepoint rollback actions are best-effort; orphan files never affect reads
                 except Exception:
                     pass
             del self.on_rollback[snap["n_on_rollback"]:]

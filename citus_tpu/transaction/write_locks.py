@@ -47,7 +47,8 @@ def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
                      timeout: float = 30.0):
     import fcntl
     import os
-    import time
+
+    from citus_tpu.utils.clock import now as wall_now
 
     from citus_tpu.transaction.global_deadlock import (
         check_cancelled, clear_record, flock_wait_instrumented, make_gpid,
@@ -76,9 +77,9 @@ def group_write_lock(cat, table_meta, mode: str, lock_manager=None,
             flock_wait_instrumented(
                 fd, fcntl.LOCK_SH if mode == SHARED else fcntl.LOCK_EX,
                 timeout, data_dir=cat.data_dir, gpid=gpid, res=res,
-                mode=mode, started=time.time())
+                mode=mode, started=wall_now())
             hold_rec = publish_hold(cat.data_dir, gpid, res, mode,
-                                    time.time())
+                                    wall_now())
             yield
         finally:
             try:

@@ -19,6 +19,26 @@ COUNTER_BITS = 22
 COUNTER_MASK = (1 << COUNTER_BITS) - 1
 MAX_COUNTER = COUNTER_MASK
 
+# ------------------------------------------------------------ wall clock
+# The package's ONE wall-clock door: cituslint (CONF01) confines
+# time.time() to this module, so every TTL, expiry stamp, and activity
+# timestamp reads the same swappable clock.  Tests install a fake with
+# set_wall_clock() to drive time-dependent logic deterministically.
+
+_wall_clock = time.time
+
+
+def now() -> float:
+    """Wall-clock seconds since the epoch, through the test seam."""
+    return _wall_clock()
+
+
+def set_wall_clock(fn) -> None:
+    """Replace the wall clock (tests only); ``None`` restores the real
+    one.  Affects every now() caller package-wide."""
+    global _wall_clock
+    _wall_clock = time.time if fn is None else fn
+
 
 def pack(ms: int, counter: int) -> int:
     return (ms << COUNTER_BITS) | (counter & COUNTER_MASK)

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# One-shot static-analysis entry point: AST-based lint over the whole
+# package (tools/cituslint).  Exit 0 = clean tree, 1 = diagnostics.
+#
+#   scripts/lint.sh                 # lint citus_tpu with every rule
+#   scripts/lint.sh --select LOCK01 # one rule
+#   scripts/lint.sh --list-rules    # rule table
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m tools.cituslint citus_tpu "$@"

@@ -1,13 +1,99 @@
 """Repo invariants, run as part of the suite (reference: ci/*.sh —
 check_gucs_are_alphabetically_sorted.sh, check_migration_files.sh,
-banned.h.sh — enforced there as CI scripts; here as always-on tests)."""
+banned.h.sh — enforced there as CI scripts; here as always-on tests).
+
+The source-shape checks that used to live here as hand-rolled regex
+scans are now thin wrappers over tools/cituslint — one AST framework,
+one suppression mechanism, one failure shape (see test_lint_clean.py
+for the full-tree run).  Runtime invariants (registry completeness,
+document round-trips, golden pairing) stay as plain tests: they need
+imports, not parsing.
+"""
 
 import pathlib
 import re
 
+from tools.cituslint import run_lint
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 PKG = REPO / "citus_tpu"
 
+
+def _lint(*rule_ids: str):
+    return run_lint(str(PKG), select=set(rule_ids))
+
+
+# ------------------------------------------------- cituslint wrappers
+
+def test_no_todo_markers():
+    """No TODO/FIXME stubs in the package (TODO01: the framework ships
+    complete components, not placeholders)."""
+    assert _lint("TODO01") == []
+
+
+def test_executor_pull_path_has_single_call_site():
+    """The executor reaches sync_placement (the O(placement-bytes) pull
+    path) through exactly ONE door — executor/batches.py (CONF01's
+    confined-method table).  The aggregate/projection paths and the
+    push subsystem must ship tasks, never placement files."""
+    assert _lint("CONF01") == []
+    # the confinement table itself must keep pinning the method
+    from tools.cituslint.rules import CONFINED_METHODS
+    assert CONFINED_METHODS["sync_placement"] == ("executor/batches.py",)
+
+
+def test_remote_dispatch_is_parallel_only():
+    """Remote execute_task RPCs go through the parallel fan-out
+    (pipeline.RemoteTaskDispatch over pooled connections) — never a
+    sequential per-task call_binary loop in worker_tasks.py (CONF01's
+    banned-method + required-identifier tables)."""
+    assert _lint("CONF01") == []
+    from tools.cituslint.rules import BANNED_METHODS, REQUIRED_IDENTIFIERS
+    assert "executor/worker_tasks.py" in BANNED_METHODS["call_binary"]
+    assert "dispatch_remote_tasks" in \
+        REQUIRED_IDENTIFIERS["executor/worker_tasks.py"]
+    assert "call_binary_pooled" in \
+        REQUIRED_IDENTIFIERS["executor/pipeline.py"]
+
+
+def test_jit_confined_to_kernel_cache():
+    """``jax.jit`` is invoked only inside executor/kernel_cache.py
+    (through its jit_compile wrapper), so per-plan ad-hoc compiles —
+    invisible to the kernel cache and its compile-time accounting —
+    cannot silently regrow anywhere in the package."""
+    assert _lint("CONF01") == []
+    from tools.cituslint.rules import CONFINED_CALLS
+    assert CONFINED_CALLS["jax.jit"] == ("executor/kernel_cache.py",)
+
+
+def test_perf_counter_confined_to_trace():
+    """time.perf_counter is called only in observability/trace.py (the
+    package-wide ``clock``), so every subsystem's timings share one
+    clock and fold consistently into spans and counters."""
+    assert _lint("CONF01") == []
+    from tools.cituslint.rules import CONFINED_CALLS
+    assert CONFINED_CALLS["time.perf_counter"] == \
+        ("observability/trace.py",)
+
+
+def test_wall_clock_confined_to_utils_clock():
+    """time.time() goes through utils/clock.py now() (the swappable
+    wall-clock seam) everywhere — TTLs and activity timestamps are
+    fake-clock-testable package-wide."""
+    assert _lint("CONF01") == []
+    from tools.cituslint.rules import CONFINED_CALLS
+    assert CONFINED_CALLS["time.time"] == ("utils/clock.py",)
+
+
+def test_no_dead_counters():
+    """Every name in StatCounters.COUNTERS has at least one bump site
+    (CNT02) and every bump names a declared counter (CNT01) — a counter
+    nothing increments is a lie in every metrics dashboard, and a typo'd
+    bump counts into the void."""
+    assert _lint("CNT01", "CNT02") == []
+
+
+# --------------------------------------------------- runtime invariants
 
 def test_golden_files_paired():
     """Every golden .sql has an .out and vice versa (the reference's
@@ -16,55 +102,6 @@ def test_golden_files_paired():
     sqls = {p.stem for p in golden.glob("*.sql")}
     outs = {p.stem for p in golden.glob("*.out")}
     assert sqls == outs, (sqls - outs, outs - sqls)
-
-
-def test_no_todo_markers():
-    """No TODO/FIXME stubs in the package (the framework ships complete
-    components, not placeholders)."""
-    hits = []
-    for p in PKG.rglob("*.py"):
-        for i, line in enumerate(p.read_text().splitlines(), 1):
-            if re.search(r"\b(TODO|FIXME|XXX)\b", line):
-                hits.append(f"{p.relative_to(REPO)}:{i}")
-    assert not hits, hits
-
-
-def test_executor_pull_path_has_single_call_site():
-    """The executor reaches sync_placement (the O(placement-bytes) pull
-    path) through exactly ONE helper — batches._pull_placement_fallback.
-    The aggregate/projection paths (executor.py) and the push subsystem
-    (worker_tasks.py) must ship tasks, never placement files."""
-    hits = {}
-    for p in (PKG / "executor").glob("*.py"):
-        n = p.read_text().count("sync_placement(")
-        if n:
-            hits[p.name] = n
-    assert hits == {"batches.py": 1}, hits
-
-
-def test_remote_dispatch_is_parallel_only():
-    """Remote execute_task RPCs go through the parallel fan-out
-    (pipeline.RemoteTaskDispatch over pooled connections) — never a
-    sequential per-task call_binary loop in worker_tasks.py, which
-    would cost the SUM of per-host times instead of the max."""
-    wt = (PKG / "executor" / "worker_tasks.py").read_text()
-    assert "call_binary" not in wt, \
-        "worker_tasks.py must not dispatch RPCs itself"
-    assert "dispatch_remote_tasks" in wt
-    pl = (PKG / "executor" / "pipeline.py").read_text()
-    assert "call_binary_pooled" in pl
-
-
-def test_jit_confined_to_kernel_cache():
-    """``jax.jit`` is invoked only inside executor/kernel_cache.py
-    (through its jit_compile wrapper), so per-plan ad-hoc compiles —
-    invisible to the kernel cache and its compile-time accounting —
-    cannot silently regrow anywhere in the package."""
-    hits = []
-    for p in PKG.rglob("*.py"):
-        if "jax.jit" in p.read_text():
-            hits.append(str(p.relative_to(PKG)))
-    assert hits == ["executor/kernel_cache.py"], hits
 
 
 def test_agg_registry_complete():
@@ -133,38 +170,3 @@ def test_config_fields_are_commented():
                                     and not s.startswith("class")):
             in_class = in_class and s.startswith("class")
     assert not missing, missing
-
-
-def test_no_dead_counters():
-    """Every name in StatCounters.COUNTERS has at least one bump site
-    (or span-fold mapping) somewhere under citus_tpu/ — a counter that
-    nothing increments is a lie in every metrics dashboard.  The check
-    looks for the name as a string literal outside its declaration in
-    stats.py, which covers direct bump("name") calls and indirect
-    routes like trace._SPAN_MS."""
-    from citus_tpu.stats import StatCounters
-    dead = []
-    srcs = []
-    for p in PKG.rglob("*.py"):
-        text = p.read_text()
-        if p.name == "stats.py":
-            # strip the COUNTERS declaration itself: appearing there is
-            # the definition, not a use
-            text = re.sub(r"COUNTERS\s*=\s*\([^)]*\)", "", text, flags=re.S)
-        srcs.append(text)
-    blob = "\n".join(srcs)
-    for name in StatCounters.COUNTERS:
-        if f'"{name}"' not in blob and f"'{name}'" not in blob:
-            dead.append(name)
-    assert not dead, f"counters never bumped anywhere: {dead}"
-
-
-def test_perf_counter_confined_to_trace():
-    """time.perf_counter is called only in observability/trace.py (the
-    package-wide ``clock``), so every subsystem's timings share one
-    clock and fold consistently into spans and counters."""
-    hits = []
-    for p in PKG.rglob("*.py"):
-        if "perf_counter" in p.read_text():
-            hits.append(str(p.relative_to(PKG)))
-    assert hits == ["observability/trace.py"], hits

@@ -160,7 +160,7 @@ def test_flock_commit_between_fetch_and_push_survives(pair, tmp_path):
         c = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
         c.execute("CREATE TABLE from_flock (x bigint)")
         c.close()
-        b.catalog._merge_doc(doc)
+        b.catalog._merge_doc_locked(doc)
         b.catalog.views["v_from_push"] = "SELECT 1"
         b._control.push_catalog_doc(b.catalog.export_document())
     assert a.catalog.has_table("from_flock"), "flock commit overwritten"

@@ -1,0 +1,145 @@
+"""The shipped tree is cituslint-clean — tier-1 enforcement of every
+rule in tools/cituslint (lock discipline, call confinement, silent
+swallows, counter/GUC consistency, thread hygiene, pragma discipline).
+
+Alongside it: regression tests for concrete races the lock rule
+uncovered, and the fake-wall-clock seam the confinement sweep added.
+"""
+
+import os
+import threading
+
+import pytest
+
+from tools.cituslint import run_lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_citus_tpu_is_lint_clean():
+    diags = run_lint(os.path.join(REPO_ROOT, "citus_tpu"))
+    assert diags == [], "cituslint diagnostics:\n" + "\n".join(
+        str(d) for d in diags)
+
+
+# ---------------------------------------------------------- regressions
+# Races found by LOCK01 and fixed in the same sweep.  Each test drives
+# the pre-fix interleaving hard enough to fail (flakily but reliably
+# across the thread count) on the unguarded code.
+
+
+def test_alloc_shard_id_is_race_free(tmp_path):
+    """Catalog._alloc_shard_id read-increment-write ran without the
+    catalog lock: two DDL threads could mint the SAME shard id."""
+    from citus_tpu.catalog.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    ids, per_thread, n_threads = [], 200, 8
+    out = [[] for _ in range(n_threads)]
+
+    def mint(slot):
+        for _ in range(per_thread):
+            out[slot].append(cat._alloc_shard_id())
+
+    threads = [threading.Thread(target=mint, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for chunk in out:
+        ids.extend(chunk)
+    assert len(set(ids)) == n_threads * per_thread, "duplicate shard ids"
+
+
+def test_tombstone_concurrent_with_commit_consume(tmp_path):
+    """Catalog.tombstone mutated _tombstones unguarded while commit
+    swaps the dict under the lock; concurrent drops must never lose an
+    entry within one round."""
+    from citus_tpu.catalog.catalog import Catalog
+
+    cat = Catalog(str(tmp_path))
+    n = 64
+
+    def drop(i):
+        cat.tombstone("tables", f"t{i}")
+
+    threads = [threading.Thread(target=drop, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(cat._tombstones["tables"]) == n
+
+
+def test_control_plane_stats_bumps_are_locked():
+    """ControlPlane RPC handlers bumped self.stats['...'] += 1 from
+    handler threads without the lock (lost updates under concurrency).
+    The fix routes every bump through self._lock — assert the source
+    invariant directly so a regression cannot reintroduce the bare
+    increment."""
+    from tools.cituslint import run_lint as lint
+
+    diags = lint(os.path.join(REPO_ROOT, "citus_tpu"), select={"LOCK01"})
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+# ----------------------------------------------------------- wall clock
+
+
+@pytest.fixture
+def fake_clock():
+    from citus_tpu.utils import clock
+
+    state = {"t": 1_000_000.0}
+    clock.set_wall_clock(lambda: state["t"])
+    try:
+        yield state
+    finally:
+        clock.set_wall_clock(None)
+
+
+def test_wall_clock_seam(fake_clock):
+    from citus_tpu.utils import clock
+
+    assert clock.now() == 1_000_000.0
+    fake_clock["t"] += 5.5
+    assert clock.now() == 1_000_005.5
+
+
+def test_wall_clock_restore():
+    import time
+
+    from citus_tpu.utils import clock
+
+    clock.set_wall_clock(lambda: 42.0)
+    assert clock.now() == 42.0
+    clock.set_wall_clock(None)
+    assert abs(clock.now() - time.time()) < 5.0
+
+
+def test_session_started_reads_fake_clock(fake_clock):
+    """OpenTransaction.started (the deadlock victim policy's age) goes
+    through the shared clock, so tests can age transactions without
+    sleeping."""
+    from citus_tpu.transaction.session import OpenTransaction
+
+    old = OpenTransaction(xid=1, lock_sid=1)
+    fake_clock["t"] += 100.0
+    young = OpenTransaction(xid=2, lock_sid=2)
+    assert young.started - old.started == 100.0
+
+
+def test_activity_tracker_reads_fake_clock(fake_clock):
+    """stats.py timestamps (activity view, tenant windows) follow the
+    seam: advancing the fake clock moves measured durations exactly."""
+    from citus_tpu.stats import ActivityTracker
+
+    tr = ActivityTracker()
+    gpid = tr.enter("SELECT 1")
+    fake_clock["t"] += 30.0
+    row = next(r for r in tr.rows_view() if r[0] == gpid)
+    # (gpid, state, elapsed_s, sql, phase): exactly the fake delta
+    assert row[2] == 30.0
+    tr.exit(gpid)

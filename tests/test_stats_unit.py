@@ -4,7 +4,6 @@ latency histogram behind p50/p95/p99, the O(1) LFU eviction in
 QueryStats, and TenantStats' expire-at-read window.
 """
 
-import time
 
 from citus_tpu.stats import (LatencyHistogram, QueryStats, TenantStats,
                              normalize_query)
@@ -131,9 +130,11 @@ def test_lfu_min_calls_cursor_resets_on_insert():
 
 
 def test_tenant_stats_expire_at_read(monkeypatch):
+    from citus_tpu.utils import clock
+
     ts = TenantStats()
     now = [1000.0]
-    monkeypatch.setattr(time, "time", lambda: now[0])
+    monkeypatch.setattr(clock, "_wall_clock", lambda: now[0])
     ts.record("acme", 0.010)
     ts.record("acme", 0.010)
     ts.record("globex", 0.005)

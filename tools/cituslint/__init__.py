@@ -1,0 +1,30 @@
+"""cituslint — AST-based static analysis for the citus_tpu package.
+
+One engine replaces the grown pile of regex CI checks (reference:
+the ci/ lint battery — banned.h.sh and friends — enforced there as
+shell scripts over raw source).  The package is parsed ONCE into
+per-module symbol/call/attribute indexes (engine.py); a registry of
+rule classes (rules.py) walks those indexes and reports
+``file:line rule-id message`` diagnostics.
+
+Run it::
+
+    python -m tools.cituslint citus_tpu          # CLI, exit 1 on findings
+    from tools.cituslint import run_lint         # importable
+    diags = run_lint("citus_tpu")
+
+Suppress a finding on a specific line with a justified pragma::
+
+    risky_write()  # lint: disable=LOCK01 -- single-threaded at startup
+
+The justification (the text after ``--``) is REQUIRED: a bare
+``# lint: disable=ID`` is itself a diagnostic (SUP01).
+"""
+
+from tools.cituslint.engine import (  # noqa: F401
+    Diagnostic,
+    PackageIndex,
+    Rule,
+    run_lint,
+)
+from tools.cituslint.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,55 @@
+"""CLI: ``python -m tools.cituslint citus_tpu [--select ID,ID ...]``.
+
+Exit status 0 when the tree is clean, 1 when any diagnostic survives
+suppression filtering (2 on usage errors) — suitable for CI and
+scripts/lint.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.cituslint.engine import run_lint
+from tools.cituslint.rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.cituslint",
+        description="AST-based static analysis for citus_tpu "
+                    "(lock discipline, call confinement, silent "
+                    "swallows, metrics/GUC consistency)")
+    ap.add_argument("package", nargs="?", default="citus_tpu",
+                    help="package directory to lint (default: citus_tpu)")
+    ap.add_argument("--select", metavar="IDS",
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rc in ALL_RULES:
+            doc = (rc.__doc__ or "").strip().split("\n")[0]
+            print(f"{rc.id:8s} {rc.name:40s} {doc}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+    try:
+        diags = run_lint(args.package, select=select)
+    except FileNotFoundError as e:
+        print(f"cituslint: {e}", file=sys.stderr)
+        return 2
+    for d in diags:
+        print(d)
+    if diags:
+        print(f"cituslint: {len(diags)} diagnostic(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

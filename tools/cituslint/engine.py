@@ -1,0 +1,254 @@
+"""cituslint engine: parse the package once, index it, run rules.
+
+The engine is rule-agnostic.  It owns:
+
+- ``ModuleIndex``   — one parsed module: AST (with parent links),
+  import-alias resolution (``import time as _t`` → ``_t.time()``
+  resolves to ``time.time``), and the suppression-pragma table.
+- ``PackageIndex``  — every ``*.py`` under one package directory.
+- ``Rule``          — the base class rules subclass; ``run_lint``
+  instantiates each rule, collects diagnostics, applies suppressions,
+  and reports unjustified/unknown pragmas as diagnostics themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+#: ``# lint: disable=ID1,ID2 -- why this is safe``
+_PRAGMA = re.compile(
+    r"#\s*lint:\s*disable=([A-Za-z0-9_, ]+?)(?:\s*--\s*(.*\S))?\s*$")
+
+
+@dataclass(frozen=True, order=True)
+class Diagnostic:
+    """One finding: ``path:line rule-id message``."""
+
+    path: str       # display path (package dir name + in-package path)
+    line: int
+    rule_id: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line} {self.rule_id} {self.message}"
+
+
+@dataclass(frozen=True)
+class Suppression:
+    line: int             # line the pragma comment sits on
+    rule_ids: tuple       # ids it names
+    justification: str    # "" when missing (→ SUP01)
+    standalone: bool      # own-line pragma: also covers the next line
+
+
+class ModuleIndex:
+    """One module's AST plus the derived lookup structures every rule
+    needs: parent links, import aliases, and suppression pragmas."""
+
+    def __init__(self, pkg_root: str, path: str, display_prefix: str):
+        self.path = path
+        self.rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+        self.display = f"{display_prefix}/{self.rel}"
+        with open(path, encoding="utf-8") as fh:
+            self.source = fh.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._lint_parent = parent  # type: ignore[attr-defined]
+        self._index_imports()
+        self._index_pragmas()
+
+    # ---- imports -------------------------------------------------------
+    def _index_imports(self) -> None:
+        #: local name -> imported dotted module ("_t" -> "time")
+        self.aliases: dict[str, str] = {}
+        #: local name -> "module.member" ("jit" -> "jax.jit")
+        self.members: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.aliases[root] = root
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.members[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expression like ``_t.time`` or
+        ``jit`` with import aliases resolved; None when the chain is
+        not a plain Name/Attribute path."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        root, rest = parts[0], parts[1:]
+        if root in self.members:
+            return ".".join([self.members[root]] + rest)
+        if root in self.aliases:
+            return ".".join([self.aliases[root]] + rest)
+        return ".".join(parts)
+
+    # ---- suppressions --------------------------------------------------
+    def _index_pragmas(self) -> None:
+        self.pragmas: list[Suppression] = []
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            comments = [t for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except tokenize.TokenizeError:  # pragma: no cover - parse ok'd
+            comments = []
+        for tok in comments:
+            m = _PRAGMA.search(tok.string)
+            if not m:
+                continue
+            ids = tuple(s.strip() for s in m.group(1).split(",")
+                        if s.strip())
+            line = tok.start[0]
+            prefix = self.lines[line - 1][:tok.start[1]]
+            self.pragmas.append(Suppression(
+                line=line, rule_ids=ids,
+                justification=(m.group(2) or "").strip(),
+                standalone=not prefix.strip()))
+
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        """True when a JUSTIFIED pragma covers (line, rule_id): the
+        pragma trails the line itself, or sits alone on the line above."""
+        for p in self.pragmas:
+            if rule_id not in p.rule_ids or not p.justification:
+                continue
+            if p.line == line or (p.standalone and p.line == line - 1):
+                return True
+        return False
+
+
+class PackageIndex:
+    """Every module of one package, parsed once and shared by rules."""
+
+    def __init__(self, package_path: str):
+        self.root = os.path.abspath(package_path)
+        if not os.path.isdir(self.root):
+            raise FileNotFoundError(f"not a package directory: "
+                                    f"{package_path}")
+        self.display_prefix = os.path.basename(self.root.rstrip("/"))
+        self.modules: list[ModuleIndex] = []
+        self.by_rel: dict[str, ModuleIndex] = {}
+        self.errors: list[Diagnostic] = []
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    mod = ModuleIndex(self.root, path, self.display_prefix)
+                except SyntaxError as e:
+                    rel = os.path.relpath(path, self.root)
+                    self.errors.append(Diagnostic(
+                        f"{self.display_prefix}/{rel}", e.lineno or 1,
+                        "PARSE", f"syntax error: {e.msg}"))
+                    continue
+                self.modules.append(mod)
+                self.by_rel[mod.rel] = mod
+        self._cache: dict[str, object] = {}
+
+    def cached(self, key: str, build):
+        """Memo slot shared across rules (e.g. the parsed COUNTERS
+        list) so each cross-module fact is derived once per run."""
+        if key not in self._cache:
+            self._cache[key] = build()
+        return self._cache[key]
+
+
+class Rule:
+    """Base rule.  Subclasses set ``id``/``name``/``doc`` and override
+    one or both hooks; ``diag`` builds a Diagnostic with the rule id
+    filled in."""
+
+    id = ""
+    name = ""
+
+    def check_module(self, mod: ModuleIndex,
+                     pkg: PackageIndex) -> Iterable[Diagnostic]:
+        return ()
+
+    def check_package(self, pkg: PackageIndex) -> Iterable[Diagnostic]:
+        return ()
+
+    def diag(self, mod: ModuleIndex, line: int, message: str) -> Diagnostic:
+        return Diagnostic(mod.display, line, self.id, message)
+
+
+def _pragma_diags(pkg: PackageIndex, known_ids: set) -> list[Diagnostic]:
+    """Pragmas are linted too: a suppression without a justification
+    (SUP01) or naming an unknown rule id (SUP02) is a finding — silent
+    or typo'd opt-outs must not pass review."""
+    out = []
+    for mod in pkg.modules:
+        for p in mod.pragmas:
+            if not p.justification:
+                out.append(Diagnostic(
+                    mod.display, p.line, "SUP01",
+                    "lint suppression needs a justification: "
+                    "'# lint: disable=ID -- why this is safe'"))
+            for rid in p.rule_ids:
+                if rid not in known_ids:
+                    out.append(Diagnostic(
+                        mod.display, p.line, "SUP02",
+                        f"suppression names unknown rule id {rid!r}"))
+    return out
+
+
+def run_lint(package_path: str, select: Optional[set] = None,
+             rules: Optional[list] = None) -> list[Diagnostic]:
+    """Lint one package directory; returns surviving diagnostics
+    sorted by (path, line).  ``select`` restricts to a set of rule
+    ids; ``rules`` substitutes the rule-class registry (tests)."""
+    from tools.cituslint.rules import ALL_RULES
+    pkg = PackageIndex(package_path)
+    rule_classes = list(rules if rules is not None else ALL_RULES)
+    known_ids = {rc.id for rc in rule_classes} | {"SUP01", "SUP02", "PARSE"}
+    diags: list[Diagnostic] = list(pkg.errors)
+    for rc in rule_classes:
+        if select is not None and rc.id not in select:
+            continue
+        rule = rc()
+        for mod in pkg.modules:
+            diags.extend(rule.check_module(mod, pkg))
+        diags.extend(rule.check_package(pkg))
+    kept = []
+    for d in diags:
+        mod = _module_for(pkg, d.path)
+        if mod is not None and mod.suppressed(d.line, d.rule_id):
+            continue
+        kept.append(d)
+    if select is None or select & {"SUP01", "SUP02"}:
+        kept.extend(_pragma_diags(pkg, known_ids))
+    return sorted(set(kept))
+
+
+def _module_for(pkg: PackageIndex, display: str) -> Optional[ModuleIndex]:
+    prefix = pkg.display_prefix + "/"
+    if display.startswith(prefix):
+        return pkg.by_rel.get(display[len(prefix):])
+    return None
