@@ -219,6 +219,90 @@ def bench_trace_overhead(cl, extra: dict) -> None:
     }
 
 
+def bench_wait_overhead(cl, extra: dict) -> None:
+    """Wait-event seam cost (stats.begin_wait/end_wait): warm Q1 wall
+    time with the brackets live vs stubbed to no-ops at every
+    instrumented call site.  The seam only opens brackets on genuinely
+    blocking branches, so a warm local scan should measure within
+    noise — the acceptance bar for 'near-free when idle'."""
+    import citus_tpu.commands.dml as _dml
+    import citus_tpu.executor.executor as _ex
+    import citus_tpu.executor.pipeline as _pl
+    import citus_tpu.transaction.locks as _lk
+    reps = int(os.environ.get("BENCH_WAIT_REPS", "3"))
+
+    def best_of() -> float:
+        cl.execute(Q1)  # warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            cl.execute(Q1)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    on_s = best_of()
+    sites = [(m, m.begin_wait, m.end_wait) for m in (_dml, _ex, _pl, _lk)]
+    try:
+        for m, _, _ in sites:
+            m.begin_wait = lambda event: (event, 0.0)
+            m.end_wait = lambda token: 0.0
+        off_s = best_of()
+    finally:
+        for m, bw, ew in sites:
+            m.begin_wait, m.end_wait = bw, ew
+    extra["wait_event_overhead"] = {
+        "q1_instrumented_ms": round(on_s * 1000, 2),
+        "q1_stubbed_ms": round(off_s * 1000, 2),
+        "overhead_fraction": round(max(0.0, on_s / off_s - 1.0), 4),
+    }
+
+
+def bench_stat_fanout(extra: dict) -> None:
+    """citus_cluster_metrics fan-out latency on a 3-node cluster
+    (authority + two attached workers, all loopback): the wall cost of
+    one merged scrape — probe threads + per-node get_node_stats round
+    trips + Prometheus rendering."""
+    import shutil
+    import tempfile
+
+    import citus_tpu as ct
+    reps = int(os.environ.get("BENCH_FANOUT_REPS", "5"))
+    root = tempfile.mkdtemp(prefix="bench_fanout_", dir=_HERE)
+    a = ct.Cluster(os.path.join(root, "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    workers = []
+    try:
+        a.register_node()
+        for name in ("b", "c"):
+            w = ct.Cluster(os.path.join(root, name), data_port=0,
+                           hosted_nodes=set(), n_nodes=0,
+                           coordinator=("127.0.0.1", a.control_port))
+            w.register_node()
+            workers.append(w)
+        a._maybe_reload_catalog(force_sync=True)
+        a.execute("SELECT citus_cluster_metrics()")  # warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            r = a.execute("SELECT citus_cluster_metrics()")
+            ts.append(time.perf_counter() - t0)
+        txt = "\n".join(row[0] for row in r.rows)
+        extra["stat_fanout"] = {
+            "nodes": 3,
+            "cluster_metrics_best_ms": round(min(ts) * 1000, 2),
+            "cluster_metrics_avg_ms": round(
+                sum(ts) / len(ts) * 1000, 2),
+            "series_lines": sum(
+                1 for ln in txt.splitlines()
+                if ln and not ln.startswith("#")),
+        }
+    finally:
+        for w in workers:
+            w.close()
+        a.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def ensure_join_data(cl: "ct.Cluster", n_orders: int) -> None:
     """orders_b: the build side of the repartition join, distributed on
     o_custkey so the l_orderkey = o_orderkey join must reshuffle."""
@@ -434,6 +518,10 @@ def main() -> None:
         bench_plan_cache(cl, extra)
     if os.environ.get("BENCH_TRACE", "1") != "0":
         bench_trace_overhead(cl, extra)
+    if os.environ.get("BENCH_WAIT", "1") != "0":
+        bench_wait_overhead(cl, extra)
+    if os.environ.get("BENCH_FANOUT", "1") != "0":
+        bench_stat_fanout(extra)
     if os.environ.get("BENCH_JOIN", "1") != "0":
         n_orders = N_ROWS // 4
         ensure_join_data(cl, n_orders)

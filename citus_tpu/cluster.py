@@ -25,6 +25,7 @@ from citus_tpu.errors import (
 )
 from citus_tpu.executor import Result, execute_select
 from citus_tpu.ingest import TableIngestor, encode_columns, rows_to_columns
+from citus_tpu import stats as _stats
 from citus_tpu.observability import trace as _trace
 from citus_tpu.planner import ast as A
 from citus_tpu.planner import parse_sql
@@ -1939,6 +1940,11 @@ class Cluster:
         # statement's activity row (works with or without sampling)
         _trace.push_phase_sink(
             lambda phase, _g=gpid: self.activity.set_phase(_g, phase))
+        # likewise the wait-event seam (stats.begin_wait/end_wait): a
+        # blocking branch hit mid-statement lands on this row's
+        # wait_event column
+        _stats.push_wait_sink(
+            lambda event, _g=gpid: self.activity.set_wait(_g, event))
         t0 = _clock()
         # active role for statements synthesized mid-execution (the
         # upsert's internal UPDATE must see the same RLS policies);
@@ -1998,6 +2004,7 @@ class Cluster:
             else:
                 self._exec_roles[_tid] = _prev_role
             _trace.pop_phase_sink()
+            _stats.pop_wait_sink()
             self.activity.exit(gpid)
             if qt is not None:
                 self._finish_query_trace(qt, sql)

@@ -77,6 +77,8 @@ _GUCS = {
     "citus.trace_sample_rate": ("observability", "trace_sample_rate", _sample_rate),
     "citus.log_min_duration_ms": ("observability", "log_min_duration_ms", float),
     "citus.trace_export_dir": ("observability", "trace_export_dir", str),
+    "citus.stat_fanout_timeout_s": ("observability", "stat_fanout_timeout_s",
+                                    float),
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
     "citus.shard_count": ("sharding", "shard_count", int),
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),

@@ -17,6 +17,7 @@ from citus_tpu.errors import (
 from citus_tpu.executor import Result
 from citus_tpu.observability import trace as _trace
 from citus_tpu.planner import ast as A
+from citus_tpu.stats import begin_wait, end_wait
 
 
 @handles(A.Insert)
@@ -259,9 +260,11 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
         # local state is consistent
         _c_span = _trace.span("2pc_decide", participants=len(endpoints))
         _c_span.__enter__()
+        wtok = begin_wait("2pc_decision")
         try:
             _complete_commit_body()
         finally:
+            end_wait(wtok)
             _c_span.__exit__(None, None, None)
 
     def _complete_commit_body() -> None:
@@ -312,7 +315,11 @@ def _two_phase_remote_dml(cl, stmt, t, sql: str, endpoints: list,
         # register wins — if a participant's presumed-abort claim got
         # there first, WE must abort
         with _trace.span("2pc_commit_point"):
-            winner = cl._control.record_txn_outcome(gxid, "commit")
+            wtok = begin_wait("2pc_decision")
+            try:
+                winner = cl._control.record_txn_outcome(gxid, "commit")
+            finally:
+                end_wait(wtok)
         if winner != "commit":
             raise ExecutionError(
                 "cross-host transaction aborted by a participant "

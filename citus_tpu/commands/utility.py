@@ -233,11 +233,37 @@ def _citus_stat_tenants(cl, name, args):
                   rows=cl.tenant_stats.rows_view())
 
 
-@utility("citus_stat_activity", "citus_dist_stat_activity")
+@utility("citus_stat_activity")
 def _citus_stat_activity(cl, name, args):
     return Result(columns=["global_pid", "state", "elapsed_s", "query",
-                           "phase"],
+                           "phase", "wait_event"],
                   rows=cl.activity.rows_view())
+
+
+@utility("citus_dist_stat_activity")
+def _citus_dist_stat_activity(cl, name, args):
+    """Cluster-wide activity: the stat fan-out's merged payloads, one
+    row per live statement on ANY node, node-attributed (reference:
+    citus_dist_stat_activity over every worker).  A node that misses
+    its citus.stat_fanout_timeout_s budget shows one node_unreachable
+    row rather than hanging or failing the view."""
+    from citus_tpu.observability.cluster_stats import (
+        cluster_node_stats, payload_node,
+    )
+    rows = []
+    for p in cluster_node_stats(cl):
+        node = payload_node(p)
+        if p.get("unreachable"):
+            rows.append((None, node, "node_unreachable", None,
+                         p.get("endpoint", ""), "", ""))
+            continue
+        for a in p.get("activity", []):
+            gpid, state, elapsed_s, sql, phase, wait_event = a
+            rows.append((gpid, node, state, elapsed_s, sql, phase,
+                         wait_event))
+    return Result(columns=["global_pid", "node", "state", "elapsed_s",
+                           "query", "phase", "wait_event"],
+                  rows=rows)
 
 
 @utility("citus_metrics")
@@ -248,6 +274,39 @@ def _citus_metrics(cl, name, args):
     return Result(columns=["metrics"],
                   rows=[(line,) for line in
                         prometheus_text(cl).splitlines()])
+
+
+@utility("citus_cluster_metrics")
+def _citus_cluster_metrics(cl, name, args):
+    """Cluster-wide Prometheus text: every node's counters/gauges as
+    node-labeled series, in-flight task progress as gauges, and a
+    citus_node_unreachable marker per dead node."""
+    from citus_tpu.observability.export import prometheus_cluster_text
+    return Result(columns=["metrics"],
+                  rows=[(line,) for line in
+                        prometheus_cluster_text(cl).splitlines()])
+
+
+@utility("citus_cluster_slow_queries")
+def _citus_cluster_slow_queries(cl, name, args):
+    """Every node's slow-query ring merged, node-attributed, newest
+    first across the cluster."""
+    from citus_tpu.observability.cluster_stats import (
+        cluster_node_stats, payload_node,
+    )
+    rows = []
+    for p in cluster_node_stats(cl):
+        if p.get("unreachable"):
+            continue
+        node = payload_node(p)
+        for r in p.get("slow_queries", []):
+            logged_at, duration_ms, trace_id, phases, sql = r
+            rows.append((node, logged_at, duration_ms, trace_id, phases,
+                         sql))
+    rows.sort(key=lambda r: -(r[1] or 0))
+    return Result(columns=["node", "captured_at", "duration_ms",
+                           "trace_id", "phases", "query"],
+                  rows=rows)
 
 
 @utility("citus_slow_queries")
@@ -284,11 +343,13 @@ def _citus_lock_waits(cl, name, args):
 def _get_rebalance_progress(cl, name, args):
     rows = []
     if cl._background_jobs is not None:
-        with cl._background_jobs._lock:
-            jobs = [j["job_id"] for j in cl._background_jobs._state["jobs"]]
+        # public snapshot only — no reaching into the runner's lock/state
+        jobs = [j["job_id"] for j in cl._background_jobs.jobs_view()["jobs"]]
         for jid in jobs:
             rows.extend(cl._background_jobs.job_progress(jid))
-    return Result(columns=["task_id", "op", "args", "status", "attempts"],
+    return Result(columns=["task_id", "op", "args", "status", "attempts",
+                           "phase", "bytes_done", "bytes_total",
+                           "started_at", "eta_s"],
                   rows=rows)
 
 

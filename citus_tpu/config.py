@@ -135,6 +135,11 @@ class ObservabilitySettings:
     # Directory receiving one Chrome trace-event JSON (Perfetto-
     # loadable) per sampled query — citus.trace_export_dir ("" = off).
     trace_export_dir: str = ""
+    # Per-node budget (seconds) for the cluster stat fan-out
+    # (observability/cluster_stats.py): a node that does not answer
+    # get_node_stats within this window degrades to a node_unreachable
+    # row instead of hanging the view — citus.stat_fanout_timeout_s.
+    stat_fanout_timeout_s: float = 2.0
 
 
 @dataclass

@@ -36,11 +36,23 @@ from citus_tpu.planner.bind import BoundSelect
 from citus_tpu.planner.physical import (
     PhysicalPlan, _index_eq, extract_intervals, plan_select, prune_shards,
 )
-from citus_tpu.stats import StatCounters
+from citus_tpu.stats import StatCounters, begin_wait, end_wait
 
 # process-wide counters (the citus_stat_counters analog); Cluster exposes
 # a view over this
 GLOBAL_COUNTERS = StatCounters()
+
+
+def _block_ready(x) -> None:
+    """block_until_ready under a device_round wait bracket: the stretch
+    the backend spends blocked on device backpressure shows up in the
+    activity view and the wait_device_round_ms counter."""
+    import jax
+    wtok = begin_wait("device_round")
+    try:
+        jax.block_until_ready(x)
+    finally:
+        end_wait(wtok)
 
 
 @dataclass
@@ -346,7 +358,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                     if collect is None:
                         inflight.append(out)
                         if len(inflight) > _prefetch_depth(settings):
-                            jax.block_until_ready(inflight.popleft())
+                            _block_ready(inflight.popleft())
                     pstats.device_s += clock() - t_dev
                 if buf:
                     t_dev = clock()
@@ -359,7 +371,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             finally:
                 host_iter_m.close()
             if collect is not None and nbytes <= GLOBAL_CACHE.capacity:
-                jax.block_until_ready([r[0] for r in collect])
+                _block_ready([r[0] for r in collect])
                 GLOBAL_CACHE.put(mkey, collect, nbytes)
             t_dev = clock()
             acc_np = [tuple(np.asarray(o) for o in out) for out in acc]
@@ -460,7 +472,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                     # another
                     inflight.append(out)
                     if len(inflight) > _prefetch_depth(settings):
-                        jax.block_until_ready(inflight.popleft())
+                        _block_ready(inflight.popleft())
                 pstats.device_s += clock() - t_dev
                 ctx = _trace.current()
                 if ctx is not None:
@@ -474,7 +486,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         if acc_dev is None:
             return combine_partials_host(plan, [_empty_partials(plan, np)])
         if collect is not None:
-            jax.block_until_ready([b.cols for b in collect])
+            _block_ready([b.cols for b in collect])
             GLOBAL_CACHE.put(key, collect, nbytes)
         pstats.h2d_bytes = nbytes
         GLOBAL_COUNTERS.bump("bytes_scanned", nbytes)

@@ -40,6 +40,7 @@ from typing import Iterator, Optional
 from citus_tpu.errors import ExecutionError
 from citus_tpu.observability import trace as _trace
 from citus_tpu.observability.trace import clock as _perf
+from citus_tpu.stats import begin_wait, end_wait
 
 
 class PipelineStats:
@@ -187,14 +188,18 @@ class HostPrefetcher:
             if self._stats is not None:
                 # host behind: the device would starve here
                 self._stats.host_stalls += 1
-            while True:
-                try:
-                    kind, val = self._q.get(timeout=0.5)
-                    break
-                except queue.Empty:
-                    if not self._thread.is_alive() and self._q.empty():
-                        raise ExecutionError(
-                            "host decode worker died without a result")
+            wtok = begin_wait("prefetch_stall")
+            try:
+                while True:
+                    try:
+                        kind, val = self._q.get(timeout=0.5)
+                        break
+                    except queue.Empty:
+                        if not self._thread.is_alive() and self._q.empty():
+                            raise ExecutionError(
+                                "host decode worker died without a result")
+            finally:
+                end_wait(wtok)
         if kind == self._ITEM:
             return val
         self._finished = True
@@ -392,8 +397,15 @@ class RemoteTaskDispatch:
             _trace.set_phase("remote-wait")
         t_enter = _perf()
         with self._cv:
-            while self._settled < self._total or self._inflight_total:
-                self._cv.wait(0.5)
+            if self._settled < self._total or self._inflight_total:
+                # only a real block opens a wait bracket: a fan-out that
+                # finished behind local work must not book phantom ms
+                wtok = begin_wait("remote_rpc")
+                try:
+                    while self._settled < self._total or self._inflight_total:
+                        self._cv.wait(0.5)
+                finally:
+                    end_wait(wtok)
             fallback = sorted(self._fallback)
             results = [self._results[si] for si in sorted(self._results)]
             tlog = sorted(self._tlog)

@@ -95,6 +95,13 @@ class ControlPlane:
         self.server.register("grow_dict", self._on_grow_dict)
         self.server.register("record_txn_outcome", self._on_record_txn_outcome)
         self.server.register("txn_outcome", self._on_txn_outcome)
+        self.server.register("get_node_stats", self._on_get_node_stats)
+
+    def _on_get_node_stats(self, payload: dict) -> dict:
+        """The authority's own stat snapshot (the same payload the
+        data-plane servers expose; observability/cluster_stats.py)."""
+        from citus_tpu.observability.cluster_stats import local_node_stats
+        return local_node_stats(self.cluster)
 
     # ---- server handlers ----------------------------------------------
     def _on_catalog_changed(self, payload: dict) -> dict:

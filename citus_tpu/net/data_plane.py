@@ -108,6 +108,7 @@ class DataPlaneServer:
         s.register("txn_stmt", self._on_txn_stmt)
         s.register("txn_branch_prepare", self._on_txn_branch_prepare)
         s.register("txn_branch_abort", self._on_txn_branch_abort)
+        s.register("get_node_stats", self._on_get_node_stats)
         # open cross-host transaction branches:
         # gxid -> {"s": Session, "born": monotonic, "prepared": bool}
         # — initialized BEFORE accepting connections (an early
@@ -124,6 +125,12 @@ class DataPlaneServer:
         cat = self.cluster.catalog
         return cat.shard_dir(str(p["table"]), int(p["shard_id"]),
                              int(p["node"]))
+
+    def _on_get_node_stats(self, p: dict) -> dict:
+        """One-payload local stat snapshot for the cluster fan-out
+        (observability/cluster_stats.py)."""
+        from citus_tpu.observability.cluster_stats import local_node_stats
+        return local_node_stats(self.cluster)
 
     def _on_list_placement(self, p: dict) -> dict:
         d = self._placement_dir(p)
@@ -543,17 +550,24 @@ class DataPlaneClient:
                             str(shard_id), str(node))
 
     def fetch_file(self, endpoint: tuple, spec: dict, dst: str) -> None:
+        from citus_tpu.stats import begin_wait, end_wait
         tmp = dst + ".part"
         off = 0
-        with open(tmp, "wb") as fh:
-            while True:
-                r, data = self.call_binary(
-                    endpoint, "fetch_file", dict(spec, offset=off))
-                fh.write(data or b"")
-                off += len(data or b"")
-                self.stats["bytes_fetched"] += len(data or b"")
-                if r.get("eof", True):
-                    break
+        # the whole chunk loop is one remote_rpc wait: the caller is
+        # blocked on peer network/disk until the file lands
+        wtok = begin_wait("remote_rpc")
+        try:
+            with open(tmp, "wb") as fh:
+                while True:
+                    r, data = self.call_binary(
+                        endpoint, "fetch_file", dict(spec, offset=off))
+                    fh.write(data or b"")
+                    off += len(data or b"")
+                    self.stats["bytes_fetched"] += len(data or b"")
+                    if r.get("eof", True):
+                        break
+        finally:
+            end_wait(wtok)
         os.replace(tmp, dst)
         self.stats["files_fetched"] += 1
 
@@ -622,15 +636,22 @@ class DataPlaneClient:
         if not r.get("exists"):
             return False
         os.makedirs(dst_dir, exist_ok=True)
+        from citus_tpu.services.background_jobs import report_progress
         from citus_tpu.storage.writer import SHARD_META
         # meta file last: a crash mid-pull leaves a readable placement
-        names = sorted(f["name"] for f in r["files"])
+        sizes = {f["name"]: int(f.get("size", 0)) for f in r["files"]}
+        names = sorted(sizes)
         names.sort(key=lambda n: n == SHARD_META)
         for name in names:
+            dst = os.path.join(dst_dir, name)
+            already = name.endswith(".cts") and os.path.exists(dst)
             self.fetch_file(endpoint,
                             {"table": table, "shard_id": shard_id,
-                             "node": src_node, "name": name},
-                            os.path.join(dst_dir, name))
+                             "node": src_node, "name": name}, dst)
+            if name.endswith(".cts") and not already:
+                # stripe bytes shipped feed the owning move's progress
+                # record (no-op outside a background task)
+                report_progress(add_bytes=sizes[name])
         return True
 
     def push_placement(self, src_dir: str, table: str, shard_id: int,

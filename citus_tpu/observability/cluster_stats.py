@@ -1,0 +1,128 @@
+"""Cluster-wide stat fan-out.
+
+Reference: citus_dist_stat_activity / citus_stat_activity and friends
+(SURVEY §5.5) — the coordinator asks EVERY node for its local stat
+snapshot and merges the rows with node attribution.  The reference runs
+the collection UDF over its connection pools; here a ``get_node_stats``
+RPC (registered on both the control plane and every data-plane server)
+returns one node's counters, gauges, activity rows, slow-log entries,
+and background-task progress in a single payload.
+
+Liveness discipline: each remote endpoint is probed on its own thread
+with a per-node timeout (``citus.stat_fanout_timeout_s``).  A dead or
+wedged node degrades to a ``node_unreachable`` payload instead of
+raising or hanging the view — monitoring must keep working exactly when
+the cluster is unhealthy.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from citus_tpu.net.rpc import RpcClient
+
+
+def _counters():
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    return GLOBAL_COUNTERS
+
+
+def local_node_stats(cluster) -> dict:
+    """This process's full observability payload — the get_node_stats
+    RPC body.  Everything is JSON-safe (the payload crosses the wire
+    verbatim)."""
+    from citus_tpu.observability.export import _gauges
+    from citus_tpu.observability.slowlog import GLOBAL_SLOW_LOG
+    cat = cluster.catalog
+    hosted = cat.hosted_nodes
+    node_ids = (sorted(hosted) if hosted is not None
+                else cat.active_node_ids())
+    progress = []
+    if cluster._background_jobs is not None:
+        progress = cluster._background_jobs.jobs_view()["tasks"]
+    return {
+        "node_ids": node_ids,
+        "counters": cluster.counters.snapshot(),
+        "gauges": {k: int(v) for k, v in _gauges(cluster).items()},
+        "activity": [list(r) for r in cluster.activity.rows_view()],
+        "slow_queries": [list(r) for r in GLOBAL_SLOW_LOG.rows_view()],
+        "progress": progress,
+    }
+
+
+def _probe(endpoint: tuple, secret: Optional[bytes],
+           timeout_s: float) -> dict:
+    """One get_node_stats round trip on a dedicated connection.  The
+    connect timeout doubles as the socket recv timeout, so a wedged
+    (accepting but not answering) peer also fails within budget."""
+    c = RpcClient(endpoint[0], int(endpoint[1]), timeout=timeout_s,
+                  secret=secret)
+    try:
+        return c.call("get_node_stats")
+    finally:
+        c.close()
+
+
+def cluster_node_stats(cluster, timeout_s: Optional[float] = None
+                       ) -> list[dict]:
+    """Fan out get_node_stats to every live endpoint and merge: one
+    payload per coordinator process, the local process served in-line.
+    Unreachable peers yield ``{"unreachable": True, "node_ids": [...],
+    "error": ...}`` payloads — callers render those as node_unreachable
+    rows rather than failing the whole view."""
+    if timeout_s is None:
+        timeout_s = cluster.settings.observability.stat_fanout_timeout_s
+    cat = cluster.catalog
+    payloads = [local_node_stats(cluster)]
+    # group remote logical nodes by the coordinator endpoint hosting them
+    by_endpoint: dict[tuple, list[int]] = {}
+    for nid in cat.active_node_ids():
+        if cat.is_remote_node(nid):
+            ep = cat.node_endpoint(nid)
+            if ep is not None:
+                by_endpoint.setdefault((ep[0], int(ep[1])), []).append(nid)
+    if not by_endpoint:
+        return payloads
+    secret = getattr(cat.remote_data, "secret", None)
+    results: dict[tuple, dict] = {}
+    results_mu = threading.Lock()
+
+    def probe_one(ep: tuple) -> None:
+        try:
+            r = _probe(ep, secret, timeout_s)
+        except Exception as e:
+            r = {"unreachable": True, "error": str(e)}
+        with results_mu:
+            results[ep] = r
+
+    threads = []
+    for ep in sorted(by_endpoint):
+        _counters().bump("stat_fanout_probes")
+        # lint: disable=THR02 -- joined with the per-node timeout below; a straggler past its budget is abandoned by design (daemon)
+        th = threading.Thread(target=probe_one, args=(ep,), daemon=True,
+                              name=f"stat-fanout-{ep[0]}:{ep[1]}")
+        th.start()
+        threads.append((ep, th))
+    for ep, th in threads:
+        # each probe already bounds itself via the socket timeout; the
+        # join timeout is the backstop for a thread wedged pre-connect
+        th.join(timeout=timeout_s + 0.5)
+    for ep, th in threads:
+        with results_mu:
+            r = results.get(ep)
+        if r is None:
+            r = {"unreachable": True, "error": "probe timed out"}
+        r.setdefault("node_ids", sorted(by_endpoint[ep]))
+        r["endpoint"] = f"{ep[0]}:{ep[1]}"
+        if r.get("unreachable"):
+            _counters().bump("stat_fanout_unreachable")
+        payloads.append(r)
+    return payloads
+
+
+def payload_node(payload: dict) -> int:
+    """The node id a merged payload's rows are attributed to: the lowest
+    logical node the coordinator hosts (a process may host several)."""
+    ids = payload.get("node_ids") or []
+    return min(ids) if ids else -1

@@ -83,25 +83,57 @@ def _label(v: str) -> str:
 #: histogram section (label cardinality bound)
 TOP_FAMILIES = 20
 
+#: curated HELP docs (counter/gauge name -> text); names not listed get
+#: generated text.  Every HELP line quotes the bare internal name, so a
+#: reader of SHOW citus.metrics can still find the pre-_total series
+#: names the counters are known by inside the process.
+METRIC_HELP = {
+    "queries_executed": "SQL statements executed by this process",
+    "bytes_scanned": "columnar bytes staged for device scans",
+    "wait_remote_rpc_ms": "ms blocked on remote RPC round trips",
+    "wait_lock_ms": "ms blocked acquiring advisory locks",
+    "wait_prefetch_stall_ms": "ms the device starved for host decode",
+    "wait_device_round_ms": "ms blocked on device round backpressure",
+    "wait_2pc_decision_ms": "ms blocked on 2PC decision round trips",
+    "stat_fanout_probes": "get_node_stats probes issued by this node",
+    "stat_fanout_unreachable":
+        "stat fan-out probes degraded to node_unreachable",
+    "live_queries": "statements currently executing",
+    "slow_log_entries": "entries in the in-memory slow-query ring",
+}
+
+
+def _help_line(name: str, series: str) -> str:
+    doc = METRIC_HELP.get(name, name.replace("_", " "))
+    return f"# HELP {series} {doc} (internal name: {name})"
+
 
 def prometheus_text(cluster) -> str:
     """Text-format exposition of the cluster's metrics: every
     StatCounters name, cache-occupancy gauges, and per-query-family
-    latency histograms (log-scale buckets from QueryStats)."""
+    latency histograms (log-scale buckets from QueryStats).  Counter
+    series carry the conventional _total suffix; HELP lines keep the
+    bare internal names discoverable."""
     out = []
 
     counters = cluster.counters.snapshot()
     for name in sorted(counters):
-        out.append(f"# TYPE citus_{name} counter")
-        out.append(f"citus_{name} {counters[name]}")
+        series = f"citus_{name}_total"
+        out.append(_help_line(name, series))
+        out.append(f"# TYPE {series} counter")
+        out.append(f"{series} {counters[name]}")
 
     gauges = _gauges(cluster)
     for name in sorted(gauges):
-        out.append(f"# TYPE citus_{name} gauge")
-        out.append(f"citus_{name} {gauges[name]}")
+        series = f"citus_{name}"
+        out.append(_help_line(name, series))
+        out.append(f"# TYPE {series} gauge")
+        out.append(f"{series} {gauges[name]}")
 
     fams = _family_histograms(cluster)
     if fams:
+        out.append("# HELP citus_query_latency_ms per-query-family "
+                   "latency (internal name: query_latency_ms)")
         out.append("# TYPE citus_query_latency_ms histogram")
         for family, hist in fams:
             lab = _label(family)
@@ -116,6 +148,67 @@ def prometheus_text(cluster) -> str:
                        f'{hist.sum_ms:.3f}')
             out.append(f'citus_query_latency_ms_count{{family="{lab}"}} '
                        f'{hist.count}')
+    return "\n".join(out) + "\n"
+
+
+def prometheus_cluster_text(cluster, payloads=None) -> str:
+    """Cluster-wide exposition: the stat fan-out's merged payloads as
+    node-labeled series (SELECT citus_cluster_metrics(), and what
+    scripts/metrics_exporter.py serves in cluster mode).  Unreachable
+    peers surface as citus_node_unreachable{node=...} 1 — the scrape
+    itself never fails on a dead node."""
+    from citus_tpu.observability.cluster_stats import (
+        cluster_node_stats, payload_node,
+    )
+    if payloads is None:
+        payloads = cluster_node_stats(cluster)
+    out = []
+    reachable = [p for p in payloads if not p.get("unreachable")]
+
+    counter_names = sorted({n for p in reachable
+                            for n in p.get("counters", {})})
+    for name in counter_names:
+        series = f"citus_{name}_total"
+        out.append(_help_line(name, series))
+        out.append(f"# TYPE {series} counter")
+        for p in reachable:
+            if name in p.get("counters", {}):
+                out.append(f'{series}{{node="{payload_node(p)}"}} '
+                           f'{p["counters"][name]}')
+
+    gauge_names = sorted({n for p in reachable for n in p.get("gauges", {})})
+    for name in gauge_names:
+        series = f"citus_{name}"
+        out.append(_help_line(name, series))
+        out.append(f"# TYPE {series} gauge")
+        for p in reachable:
+            if name in p.get("gauges", {}):
+                out.append(f'{series}{{node="{payload_node(p)}"}} '
+                           f'{p["gauges"][name]}')
+
+    out.append("# HELP citus_node_unreachable 1 when the stat fan-out "
+               "could not reach the node within citus.stat_fanout_timeout_s")
+    out.append("# TYPE citus_node_unreachable gauge")
+    for p in payloads:
+        out.append(f'citus_node_unreachable{{node="{payload_node(p)}"}} '
+                   f'{1 if p.get("unreachable") else 0}')
+
+    # in-flight background-task byte progress, node-attributed (the
+    # Prometheus face of get_rebalance_progress)
+    prog = [(payload_node(p), t) for p in reachable
+            for t in p.get("progress", []) if t.get("status") == "running"]
+    if prog:
+        for series, key in (("citus_task_bytes_done", "bytes_done"),
+                            ("citus_task_bytes_total", "bytes_total")):
+            out.append(f"# HELP {series} background task progress "
+                       f"({key} of the running move/split)")
+            out.append(f"# TYPE {series} gauge")
+            for node, t in prog:
+                out.append(
+                    f'{series}{{node="{node}",task_id="{t["task_id"]}",'
+                    f'op="{_label(str(t.get("op", "")))}",'
+                    f'phase="{_label(str(t.get("phase", "")))}"}} '
+                    f'{int(t.get(key) or 0)}')
     return "\n".join(out) + "\n"
 
 

@@ -21,6 +21,7 @@ from citus_tpu.catalog.hashing import hash_int64
 from citus_tpu.errors import CatalogError
 from citus_tpu.operations.cleaner import DEFERRED_ON_SUCCESS, record_cleanup
 from citus_tpu.operations.shard_transfer import _colocated_shards, _find_shard
+from citus_tpu.services.background_jobs import report_progress
 from citus_tpu.storage import ShardReader, ShardWriter
 
 
@@ -85,6 +86,16 @@ def _split_shard_locked(cat, table, shard, shard_id, split_points,
             new_ids_first = [n.shard_id for n in news]
 
     # phase 1: write redistributed data for every member table
+    bytes_total = 0
+    for t, s, _news in plan:
+        for node in s.placements:
+            src = cat.shard_dir(t.name, s.shard_id, node)
+            if os.path.isdir(src):
+                bytes_total += sum(
+                    os.path.getsize(os.path.join(src, n))
+                    for n in os.listdir(src) if n.endswith(".cts"))
+                break  # mirror the single-source redistribute below
+    report_progress(phase="copy", bytes_done=0, bytes_total=bytes_total)
     for t, s, news in plan:
         if t.dist_column is None:
             raise CatalogError(f"table {t.name} has no distribution column")
@@ -115,6 +126,10 @@ def _split_shard_locked(cat, table, shard, shard_id, split_points,
                     writers[bi].append_batch(vals, valid)
             for w in writers.values():
                 w.flush()
+            # whole source placement redistributed: book its stripe bytes
+            report_progress(add_bytes=sum(
+                os.path.getsize(os.path.join(src, n))
+                for n in os.listdir(src) if n.endswith(".cts")))
             break  # one placement is the source of truth; replicas re-copy later
 
     # phase 2: catalog flip (atomic commit covers the whole group).
@@ -124,6 +139,7 @@ def _split_shard_locked(cat, table, shard, shard_id, split_points,
     # as whole, the tail shard missed) — the generation bump makes it
     # retry with a re-planned shard set (executor/executor.py).
     from citus_tpu.transaction.snapshot import flip_generation
+    report_progress(phase="flip")
     with flip_generation(cat.data_dir, table):
         for t, s, news in plan:
             idx = t.shards.index(s)
@@ -135,6 +151,7 @@ def _split_shard_locked(cat, table, shard, shard_id, split_points,
         cat.commit()
 
     # phase 3: deferred drop of old placements
+    report_progress(phase="cleanup")
     for t, s, _news in plan:
         for node in s.placements:
             d = cat.shard_dir(t.name, s.shard_id, node)

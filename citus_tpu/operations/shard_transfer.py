@@ -29,6 +29,7 @@ from citus_tpu.errors import CatalogError
 from citus_tpu.operations.cleaner import (
     DEFERRED_ON_SUCCESS, ON_FAILURE, complete_operation, record_cleanup,
 )
+from citus_tpu.services.background_jobs import report_progress
 from citus_tpu.storage.writer import SHARD_META, _load_meta
 
 
@@ -42,6 +43,10 @@ def _copy_placement_files(src: str, dst: str) -> None:
     for n in names:
         if not os.path.exists(os.path.join(dst, n)):
             shutil.copy2(os.path.join(src, n), os.path.join(dst, n))
+            # stripes actually shipped count toward the move's byte
+            # progress; skipped (already-present) files were booked by
+            # the pass that copied them
+            report_progress(add_bytes=os.path.getsize(os.path.join(dst, n)))
     # deletion bitmaps travel with the placement (they are re-copied on
     # every pass: unlike stripes they mutate in place)
     from citus_tpu.storage.deletes import DELETES_FILE
@@ -91,6 +96,32 @@ def copy_shard_placement(cat: Catalog, shard_id: int, source_node: int,
     cat.commit()
 
 
+def _stripe_bytes_total(cat: Catalog, group, source_node: int) -> int:
+    """Total stripe (.cts) bytes the move will ship, summed across the
+    colocation group — the denominator of the move's progress record.
+    Remote-hosted sources are sized over the data plane; an unreachable
+    source just leaves the total at whatever was countable."""
+    total = 0
+    for t, s in group:
+        src = cat.shard_dir(t.name, s.shard_id, source_node)
+        if os.path.isdir(src):
+            for n in os.listdir(src):
+                if n.endswith(".cts"):
+                    total += os.path.getsize(os.path.join(src, n))
+        elif cat.is_remote_node(source_node) and cat.remote_data is not None:
+            try:
+                r = cat.remote_data.call(
+                    cat.node_endpoint(source_node), "list_placement",
+                    {"table": t.name, "shard_id": s.shard_id,
+                     "node": source_node})
+                total += sum(int(f["size"]) for f in r.get("files", [])
+                             if f["name"].endswith(".cts"))
+            # lint: disable=SWL01 -- sizing is advisory; the copy itself surfaces a dead source
+            except Exception:
+                pass
+    return total
+
+
 def _pull_one(cat: Catalog, t, s, source_node: int, dst: str) -> None:
     """One placement's bulk/catch-up copy: shared filesystem when the
     source directory is local, the RPC data plane when the source node
@@ -136,12 +167,15 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
         dst = cat.shard_dir(t.name, s.shard_id, target_node)
         if not os.path.isdir(dst):
             record_cleanup(cat, dst, ON_FAILURE, operation_id=op_id)
+    report_progress(phase="copy", bytes_done=0,
+                    bytes_total=_stripe_bytes_total(cat, group, source_node))
     try:
         # phase 1: bulk copy with writers still running
         for t, s in group:
             _pull_one(cat, t, s, source_node,
                       cat.shard_dir(t.name, s.shard_id, target_node))
         # phase 2: block writers for the diff copy + metadata flip only
+        report_progress(phase="flip")
         with group_write_lock(cat, table, EXCLUSIVE, lock_manager=lock_manager):
             for t, s in group:
                 dst = cat.shard_dir(t.name, s.shard_id, target_node)
@@ -161,6 +195,7 @@ def move_shard_placement(cat: Catalog, shard_id: int, source_node: int,
         raise
     complete_operation(cat, op_id, success=True)
     # phase 3: deferred source drop (RPC for a remote-hosted source)
+    report_progress(phase="cleanup")
     for t, s in group:
         src = cat.shard_dir(t.name, s.shard_id, source_node)
         if os.path.isdir(src):

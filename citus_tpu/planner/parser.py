@@ -1483,6 +1483,7 @@ class Parser:
         "citus_stat_counters", "citus_stat_counters_reset",
         "citus_stat_statements", "citus_stat_statements_reset",
         "citus_metrics", "citus_slow_queries", "citus_slow_queries_reset",
+        "citus_cluster_metrics", "citus_cluster_slow_queries",
         "citus_stat_activity", "citus_locks", "citus_lock_waits",
         "citus_shards", "citus_tables", "recover_prepared_transactions",
         "nextval", "currval", "setval", "citus_views", "citus_sequences",

@@ -10,6 +10,14 @@ Here: a thread-pool executor over a persisted job/task queue.  Tasks are
 named operations with JSON arguments (a registry maps names to Python
 callables), dependencies gate execution order, failures retry up to
 ``max_attempts``, and state survives restarts via the catalog data dir.
+
+Each task row is a live progress record (reference: the DSM progress
+monitor behind get_rebalance_progress, progress/multi_progress.c): the
+running operation calls the module-level ``report_progress()`` to update
+its own row's ``phase`` / ``bytes_done`` / ``bytes_total`` in place, and
+views derive a rate-based ETA from ``started_at``.  Progress updates are
+memory-only — a crash loses at most the progress of the task being
+retried anyway; durable state still changes only at claim/finish.
 """
 
 from __future__ import annotations
@@ -33,6 +41,34 @@ class JobStatus:
     DONE = "done"
     FAILED = "failed"
     CANCELLED = "cancelled"
+
+
+# the task a worker thread is currently executing, so report_progress()
+# from anywhere inside the operation lands on the right row without
+# threading a handle through every call layer
+_current_task = threading.local()
+
+
+def report_progress(phase: Optional[str] = None,
+                    bytes_done: Optional[int] = None,
+                    bytes_total: Optional[int] = None,
+                    add_bytes: int = 0) -> None:
+    """Update the calling background task's progress row in place.
+    No-op when the caller is not running under a background task (the
+    same operations run synchronously from utility commands too)."""
+    bound = getattr(_current_task, "bound", None)
+    if bound is None:
+        return
+    runner, task = bound
+    with runner._lock:
+        if phase is not None:
+            task["phase"] = phase
+        if bytes_total is not None:
+            task["bytes_total"] = int(bytes_total)
+        if bytes_done is not None:
+            task["bytes_done"] = int(bytes_done)
+        elif add_bytes:
+            task["bytes_done"] = int(task.get("bytes_done") or 0) + int(add_bytes)
 
 
 class BackgroundJobRunner:
@@ -98,18 +134,48 @@ class BackgroundJobRunner:
                 "status": JobStatus.SCHEDULED, "depends_on": depends_on or [],
                 "node": node, "attempts": 0, "max_attempts": max_attempts,
                 "error": None,
+                # live progress record, updated in place by the running
+                # operation through report_progress()
+                "phase": "", "bytes_done": 0, "bytes_total": 0,
+                "started_at": None,
             })
             self._store()
         self._wake.set()
         return tid
 
+    @staticmethod
+    def _eta_s(t: dict, now: float) -> Optional[float]:
+        """Rate-derived seconds-to-completion for a running task with
+        byte progress; None when no rate can be established yet."""
+        done = t.get("bytes_done") or 0
+        total = t.get("bytes_total") or 0
+        started = t.get("started_at")
+        if (t["status"] != JobStatus.RUNNING or not started
+                or done <= 0 or total <= done):
+            return None
+        elapsed = max(1e-9, now - started)
+        return round((total - done) * elapsed / done, 3)
+
     def job_progress(self, job_id: int) -> list[tuple]:
         """Per-task progress rows (reference: get_rebalance_progress over
-        the DSM progress monitor, progress/multi_progress.c)."""
+        the DSM progress monitor, progress/multi_progress.c).  Columns:
+        (task_id, op, args, status, attempts, phase, bytes_done,
+        bytes_total, started_at, eta_s)."""
+        now = wall_now()
         with self._lock:
             return [(t["task_id"], t["op"], str(t["args"]), t["status"],
-                     t["attempts"]) for t in self._state["tasks"]
-                    if t["job_id"] == job_id]
+                     t["attempts"], t.get("phase") or "",
+                     int(t.get("bytes_done") or 0),
+                     int(t.get("bytes_total") or 0),
+                     t.get("started_at"), self._eta_s(t, now))
+                    for t in self._state["tasks"] if t["job_id"] == job_id]
+
+    def jobs_view(self) -> dict:
+        """Public snapshot of the job/task queue — row copies, so
+        callers never need (and must not reach for) ``_lock``/``_state``."""
+        with self._lock:
+            return {"jobs": [dict(j) for j in self._state["jobs"]],
+                    "tasks": [dict(t) for t in self._state["tasks"]]}
 
     def job_status(self, job_id: int) -> str:
         with self._lock:
@@ -176,6 +242,11 @@ class BackgroundJobRunner:
                     continue
                 t["status"] = JobStatus.RUNNING
                 t["attempts"] += 1
+                # fresh progress record per attempt: a retry must not
+                # resume a dead attempt's bytes_done or phase
+                t["phase"] = "starting"
+                t["bytes_done"] = 0
+                t["started_at"] = wall_now()
                 if node is not None:
                     self._node_running[node] = self._node_running.get(node, 0) + 1
                 self._store()
@@ -203,6 +274,7 @@ class BackgroundJobRunner:
             if fn is None:
                 self._finish(task, JobStatus.FAILED, f"unknown op {task['op']!r}")
                 continue
+            _current_task.bound = (self, task)
             try:
                 fn(**task["args"])
                 self._finish(task, JobStatus.DONE, None)
@@ -218,3 +290,5 @@ class BackgroundJobRunner:
                         self._store()
                 else:
                     self._finish(task, JobStatus.FAILED, err)
+            finally:
+                _current_task.bound = None

@@ -92,6 +92,17 @@ class StatCounters:
         # victims cancelled by the global deadlock detector
         # (transaction/global_deadlock.py)
         "deadlocks_cancelled",
+        # cumulative per-event blocked time from the wait-event seam
+        # (begin_wait/end_wait below; WaitEventSet analog, SURVEY §2.5)
+        "wait_remote_rpc_ms",
+        "wait_lock_ms",
+        "wait_prefetch_stall_ms",
+        "wait_device_round_ms",
+        "wait_2pc_decision_ms",
+        # cluster stat fan-out (observability/cluster_stats.py): probes
+        # issued and per-node failures degraded to node_unreachable rows
+        "stat_fanout_probes",
+        "stat_fanout_unreachable",
     ]
 
     def __init__(self):
@@ -115,6 +126,84 @@ class StatCounters:
         with self._mu:
             for k in self._c:
                 self._c[k] = 0
+
+
+# ---------------------------------------------------------- wait events
+#
+# WaitEventSet analog (SURVEY §2.5): a backend entering a blocking
+# branch brackets it with begin_wait/end_wait.  The event name feeds the
+# activity view's wait_event column through a thread-local sink stack
+# (mirroring trace.py's phase sinks — nested execute() restores), and
+# the blocked wall time folds into a cumulative wait_*_ms counter.  The
+# seam costs nothing on non-blocking paths: call sites only reach it
+# AFTER the fast path (queue non-empty, lock granted first try) failed.
+
+#: registered wait events -> their cumulative counters.  cituslint CNT03
+#: cross-checks every begin_wait("...") literal in the package against
+#: these keys, both directions.
+WAIT_COUNTERS = {
+    "remote_rpc": "wait_remote_rpc_ms",
+    "lock": "wait_lock_ms",
+    "prefetch_stall": "wait_prefetch_stall_ms",
+    "device_round": "wait_device_round_ms",
+    "2pc_decision": "wait_2pc_decision_ms",
+}
+
+WAIT_EVENTS = tuple(sorted(WAIT_COUNTERS))
+
+_wait_tls = threading.local()
+
+
+def _counters():
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    return GLOBAL_COUNTERS
+
+
+def push_wait_sink(sink) -> None:
+    """Install a wait-event sink for this thread (cluster.execute binds
+    ActivityTracker.set_wait).  Stacked: nested execute() restores."""
+    sinks = getattr(_wait_tls, "sinks", None)
+    if sinks is None:
+        sinks = _wait_tls.sinks = []
+    sinks.append(sink)
+
+
+def pop_wait_sink() -> None:
+    sinks = getattr(_wait_tls, "sinks", None)
+    if sinks:
+        sinks.pop()
+
+
+def begin_wait(event: str):
+    """Mark this backend blocked in ``event``; returns the token
+    end_wait() needs.  The event name must be a key of WAIT_COUNTERS
+    (lint-enforced at literal call sites)."""
+    sinks = getattr(_wait_tls, "sinks", None)
+    if sinks:
+        try:
+            sinks[-1](event)
+        # lint: disable=SWL01 -- a broken sink must not break the waiting backend
+        except Exception:
+            pass
+    from citus_tpu.observability.trace import clock
+    return event, clock()
+
+
+def end_wait(token) -> float:
+    """Close a begin_wait() bracket: clear the backend's wait_event and
+    fold the blocked wall time into the event's counter.  Returns ms."""
+    event, t0 = token
+    from citus_tpu.observability.trace import clock
+    ms = (clock() - t0) * 1000.0
+    _counters().bump(WAIT_COUNTERS[event], max(1, int(ms)))
+    sinks = getattr(_wait_tls, "sinks", None)
+    if sinks:
+        try:
+            sinks[-1]("")
+        # lint: disable=SWL01 -- a broken sink must not break the waiting backend
+        except Exception:
+            pass
+    return ms
 
 
 _WS = re.compile(r"\s+")
@@ -323,6 +412,9 @@ class Activity:
     # live execution phase (plan / compile / device / remote-wait /
     # finalize), fed by observability/trace.py's phase sink
     phase: str = ""
+    # current blocking wait event (a WAIT_COUNTERS key, "" when not
+    # blocked), fed by the begin_wait/end_wait sink above
+    wait_event: str = ""
 
 
 class ActivityTracker:
@@ -346,9 +438,15 @@ class ActivityTracker:
             if a is not None:
                 a.phase = phase
 
+    def set_wait(self, gpid: int, event: str) -> None:
+        with self._mu:
+            a = self._live.get(gpid)
+            if a is not None:
+                a.wait_event = event
+
     def rows_view(self) -> list[tuple]:
         now = wall_now()
         with self._mu:
             return [(a.gpid, a.state, round(now - a.started_at, 3), a.sql,
-                     a.phase)
+                     a.phase, a.wait_event)
                     for a in self._live.values()]

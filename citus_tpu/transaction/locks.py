@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from citus_tpu.errors import TransactionError
+from citus_tpu.stats import begin_wait, end_wait
 
 SHARED = "shared"
 EXCLUSIVE = "exclusive"
@@ -87,6 +88,7 @@ class LockManager:
                 return  # re-entrant / already sufficient
             res.waiters.append((session_id, mode))
             self._waiting_for[session_id] = resource
+            wtok = None  # wait bracket opens on first actual block
             try:
                 while True:
                     if session_id in self._victims:
@@ -117,8 +119,12 @@ class LockManager:
                         res.waiters = [(s, m) for s, m in res.waiters if s != session_id]
                         self._waiting_for.pop(session_id, None)
                         raise LockTimeout(f"could not acquire {resource!r} within timeout")
+                    if wtok is None:
+                        wtok = begin_wait("lock")
                     self._mu.wait(timeout=min(remaining, 0.5))
             finally:
+                if wtok is not None:
+                    end_wait(wtok)
                 if self._waiting_for.get(session_id) == resource:
                     self._waiting_for.pop(session_id, None)
                     res.waiters = [(s, m) for s, m in res.waiters if s != session_id]

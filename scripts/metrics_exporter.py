@@ -7,11 +7,15 @@ interrupted — the minimal scrape target for a Prometheus job:
 
     python scripts/metrics_exporter.py /path/to/db             # one dump
     python scripts/metrics_exporter.py /path/to/db --port 9187 # serve
+    python scripts/metrics_exporter.py /path/to/db --cluster   # fan-out
 
-The payload is exactly what ``SHOW citus.metrics`` / ``SELECT
+The default payload is exactly what ``SHOW citus.metrics`` / ``SELECT
 citus_metrics()`` return in-process: StatCounters as counters, cache
 occupancy as gauges, and per-query-family latency histograms
-(citus_tpu/observability/export.py).  Note that counters are
+(citus_tpu/observability/export.py).  ``--cluster`` serves the
+node-labeled fan-out text instead (``SELECT citus_cluster_metrics()``):
+every live node's series tagged ``{node="N"}`` plus
+``citus_node_unreachable`` markers.  Note that plain counters are
 per-process — this exporter sees the activity of ITS cluster handle,
 which is the normal embedded deployment (one process owns the data
 dir); point it at a live workload by running it inside that process or
@@ -24,43 +28,61 @@ import argparse
 import sys
 
 
+def render_metrics(cl, cluster_wide: bool) -> str:
+    if cluster_wide:
+        from citus_tpu.observability.export import prometheus_cluster_text
+        return prometheus_cluster_text(cl)
+    from citus_tpu.observability.export import prometheus_text
+    return prometheus_text(cl)
+
+
+def make_server(cl, port: int, cluster_wide: bool = False,
+                host: str = "0.0.0.0"):
+    """Build (not run) the /metrics HTTP server — separable so tests
+    can scrape a live port without spawning the script."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib naming)
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = render_metrics(cl, cluster_wide).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    return HTTPServer((host, port), Handler)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("data_dir", help="cluster data directory")
     ap.add_argument("--port", type=int, default=0,
                     help="serve /metrics on this port instead of a "
                          "one-shot stdout dump")
+    ap.add_argument("--cluster", action="store_true",
+                    help="serve the cluster-wide node-labeled fan-out "
+                         "text (citus_cluster_metrics) instead of the "
+                         "local process view")
     args = ap.parse_args(argv)
 
     from citus_tpu import Cluster
-    from citus_tpu.observability.export import prometheus_text
 
     cl = Cluster(args.data_dir)
     try:
         if not args.port:
-            sys.stdout.write(prometheus_text(cl))
+            sys.stdout.write(render_metrics(cl, args.cluster))
             return 0
 
-        from http.server import BaseHTTPRequestHandler, HTTPServer
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib naming)
-                if self.path.rstrip("/") not in ("", "/metrics"):
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = prometheus_text(cl).encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *a):  # quiet
-                pass
-
-        srv = HTTPServer(("0.0.0.0", args.port), Handler)
+        srv = make_server(cl, args.port, cluster_wide=args.cluster)
         print(f"serving /metrics on :{srv.server_address[1]}",
               file=sys.stderr)
         try:

@@ -261,6 +261,53 @@ def test_declared_and_used_counters_quiet(tmp_path):
     assert run_lint(pkg, select={"CNT01", "CNT02"}) == []
 
 
+# --------------------------------------------------------------- CNT03
+
+WAITS_FIXTURE = """
+    class StatCounters:
+        COUNTERS = ["wait_lock_ms", "wait_remote_rpc_ms"]
+
+    WAIT_COUNTERS = {
+        "lock": "wait_lock_ms",
+        "remote_rpc": "wait_remote_rpc_ms",
+    }
+"""
+
+
+def test_unregistered_wait_event_fires(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "stats.py": WAITS_FIXTURE,
+        "m.py": ("from stats import begin_wait\n"
+                 "def f():\n"
+                 "    begin_wait('lock')\n"
+                 "    begin_wait('remote_rpc')\n"
+                 "    begin_wait('made_up_stall')\n"),
+    })
+    diags = run_lint(pkg, select={"CNT03"})
+    assert len(diags) == 1 and "made_up_stall" in diags[0].message
+
+
+def test_unentered_wait_event_fires(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "stats.py": WAITS_FIXTURE,
+        "m.py": ("def f(stats):\n"
+                 "    stats.begin_wait('lock')\n"),
+    })
+    diags = run_lint(pkg, select={"CNT03"})
+    assert len(diags) == 1 and "remote_rpc" in diags[0].message
+
+
+def test_registered_and_entered_wait_events_quiet(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "stats.py": WAITS_FIXTURE,
+        "m.py": ("from stats import begin_wait\n"
+                 "def f(stats):\n"
+                 "    begin_wait('lock')\n"
+                 "    stats.begin_wait('remote_rpc')\n"),
+    })
+    assert run_lint(pkg, select={"CNT03"}) == []
+
+
 # ------------------------------------------------------------- GUC01
 
 CONFIG_FIXTURE = """

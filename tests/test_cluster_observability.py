@@ -1,0 +1,448 @@
+"""Cluster-wide observability plane: the wait-event seam
+(stats.begin_wait/end_wait), the get_node_stats fan-out behind
+citus_dist_stat_activity / citus_cluster_metrics, background-task
+progress records, and the metrics exporter's HTTP mode.
+
+Reference analogs: citus_dist_stat_activity (global pids merged across
+workers), WaitEventSet instrumentation, and
+get_rebalance_progress's bytes/phase columns.
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu import stats
+from citus_tpu.executor.executor import GLOBAL_COUNTERS
+from citus_tpu.testing.faults import FAULTS
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    """Authority + one attached worker (two processes' worth of state in
+    one test process; node 0 hosted by a, node 1 by b)."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    a.register_node()
+    b = ct.Cluster(str(tmp_path / "b"), data_port=0, hosted_nodes=set(),
+                   coordinator=("127.0.0.1", a.control_port), n_nodes=0)
+    b.register_node()
+    a._maybe_reload_catalog(force_sync=True)
+    yield a, b
+    FAULTS.disarm()
+    b.close()
+    a.close()
+
+
+@pytest.fixture()
+def trio(tmp_path):
+    """Authority + two attached workers — three live nodes, so the stat
+    fan-out probes two remote endpoints."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    a.register_node()
+    workers = []
+    try:
+        for name in ("b", "c"):
+            w = ct.Cluster(str(tmp_path / name), data_port=0,
+                           hosted_nodes=set(), n_nodes=0,
+                           coordinator=("127.0.0.1", a.control_port))
+            w.register_node()
+            workers.append(w)
+        a._maybe_reload_catalog(force_sync=True)
+        yield a, workers
+    finally:
+        FAULTS.disarm()
+        for w in workers:
+            w.close()
+        a.close()
+
+
+def _load(cl, n=20000, shards=2, table="t"):
+    cl.execute(f"CREATE TABLE {table} (k bigint NOT NULL, v bigint)")
+    cl.execute(f"SELECT create_distributed_table('{table}', 'k', {shards})")
+    cl.copy_from(table, columns={"k": np.arange(n), "v": np.arange(n) * 3})
+    return n
+
+
+# ------------------------------------------------------ wait-event seam
+
+
+def test_wait_bracket_folds_blocked_ms():
+    before = GLOBAL_COUNTERS.snapshot()["wait_lock_ms"]
+    tok = stats.begin_wait("lock")
+    time.sleep(0.02)
+    ms = stats.end_wait(tok)
+    assert ms >= 15
+    after = GLOBAL_COUNTERS.snapshot()["wait_lock_ms"]
+    assert after - before >= 15
+
+
+def test_wait_bracket_books_at_least_one_ms():
+    """A sub-ms block still books 1 ms — a bracketed wait is never
+    invisible in the cumulative counter."""
+    before = GLOBAL_COUNTERS.snapshot()["wait_remote_rpc_ms"]
+    stats.end_wait(stats.begin_wait("remote_rpc"))
+    assert GLOBAL_COUNTERS.snapshot()["wait_remote_rpc_ms"] - before >= 1
+
+
+def test_wait_sink_sees_enter_and_clear():
+    seen = []
+    stats.push_wait_sink(seen.append)
+    try:
+        tok = stats.begin_wait("prefetch_stall")
+        stats.end_wait(tok)
+    finally:
+        stats.pop_wait_sink()
+    assert seen == ["prefetch_stall", ""]
+    # popped: further brackets don't reach the sink
+    stats.end_wait(stats.begin_wait("prefetch_stall"))
+    assert seen == ["prefetch_stall", ""]
+
+
+def test_wait_events_registry_matches_counters():
+    for ev, ctr in stats.WAIT_COUNTERS.items():
+        assert ctr in stats.StatCounters.COUNTERS
+    assert stats.WAIT_EVENTS == tuple(sorted(stats.WAIT_COUNTERS))
+
+
+def test_activity_rows_carry_wait_event(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"))
+    try:
+        gpid = cl.activity.enter("SELECT 1")
+        cl.activity.set_wait(gpid, "lock")
+        row = [r for r in cl.activity.rows_view() if r[0] == gpid][0]
+        assert row[-1] == "lock"
+        cl.activity.set_wait(gpid, "")
+        row = [r for r in cl.activity.rows_view() if r[0] == gpid][0]
+        assert row[-1] == ""
+        cl.activity.exit(gpid)
+    finally:
+        cl.close()
+
+
+def test_lock_contention_books_wait(tmp_path):
+    """Two threads racing one advisory lock: the loser's blocked time
+    lands in wait_lock_ms and its activity row shows wait_event=lock."""
+    cl = ct.Cluster(str(tmp_path / "db"))
+    try:
+        before = GLOBAL_COUNTERS.snapshot()["wait_lock_ms"]
+        cl.locks.acquire(1, "race")
+        events = []
+        stats.push_wait_sink(events.append)
+
+        def _release_soon():
+            time.sleep(0.05)
+            cl.locks.release(1, "race")
+
+        t = threading.Thread(target=_release_soon)
+        t.start()
+        try:
+            cl.locks.acquire(2, "race", timeout=5.0)
+        finally:
+            t.join()
+            stats.pop_wait_sink()
+        cl.locks.release(2, "race")
+        assert GLOBAL_COUNTERS.snapshot()["wait_lock_ms"] - before >= 40
+        assert events and events[0] == "lock" and events[-1] == ""
+    finally:
+        cl.close()
+
+
+# -------------------------------------------------- stat fan-out views
+
+
+def test_dist_stat_activity_shows_remote_wait(pair):
+    """A query blocked on a remote task shows up in
+    citus_dist_stat_activity with wait_event=remote_rpc, and the view
+    carries per-node rows from every live endpoint."""
+    a, b = pair
+    _load(a)
+    a.execute("SELECT count(*) FROM t")  # warm plans/caches
+    FAULTS.arm("execute_task", delay_s=1.0)
+    done = threading.Event()
+
+    def _run():
+        try:
+            a.execute("SELECT count(*) FROM t")
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run)
+    t.start()
+    try:
+        seen_wait = None
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and seen_wait is None:
+            r = a.execute("SELECT citus_dist_stat_activity()")
+            cols = r.columns
+            for row in r.rows:
+                d = dict(zip(cols, row))
+                if d["wait_event"] == "remote_rpc":
+                    seen_wait = d
+                    break
+            time.sleep(0.02)
+        assert seen_wait is not None, "never observed remote_rpc wait"
+        assert seen_wait["node"] is not None
+        assert "count(*)" in seen_wait["query"]
+    finally:
+        FAULTS.disarm()
+        done.wait(10)
+        t.join()
+    assert GLOBAL_COUNTERS.snapshot()["wait_remote_rpc_ms"] >= 900
+
+
+def test_dist_stat_activity_merges_remote_rows(pair):
+    """A statement live on the WORKER's handle is visible from the
+    coordinator's merged view, attributed to the worker's node."""
+    a, b = pair
+    gpid = b.activity.enter("SELECT 'held open'")
+    try:
+        r = a.execute("SELECT citus_dist_stat_activity()")
+        rows = [dict(zip(r.columns, row)) for row in r.rows]
+        remote = [d for d in rows if d["global_pid"] == gpid
+                  and "held open" in d["query"]]
+        assert remote, rows
+        assert remote[0]["node"] == 1
+    finally:
+        b.activity.exit(gpid)
+
+
+def test_dead_node_degrades_to_unreachable_within_timeout(pair):
+    """Kill node 1 (as seen from the coordinator: its endpoint stops
+    answering) — the merged view degrades to a node_unreachable row
+    within the per-node budget instead of raising or hanging."""
+    a, b = pair
+    a.execute("SET citus.stat_fanout_timeout_s = 0.5")
+    # a wedged peer: accepts the probe but never answers get_node_stats
+    # (a hard-killed process behaves the same through this sandbox's
+    # loopback proxy — the connection opens, then blackholes)
+    b._data_server.server.register(
+        "get_node_stats", lambda p: time.sleep(30) or {})
+    t0 = time.monotonic()
+    r = a.execute("SELECT citus_dist_stat_activity()")
+    elapsed = time.monotonic() - t0
+    rows = [dict(zip(r.columns, row)) for row in r.rows]
+    dead = [d for d in rows if d["state"] == "node_unreachable"]
+    assert dead and dead[0]["node"] == 1
+    # dead endpoint costs at most the per-node timeout (+ join slack)
+    assert elapsed < 2.5, elapsed
+    assert GLOBAL_COUNTERS.snapshot()["stat_fanout_unreachable"] >= 1
+
+
+def test_cluster_metrics_node_labels(trio):
+    a, workers = trio
+    _load(a, shards=3)
+    a.execute("SELECT count(*) FROM t")
+    r = a.execute("SELECT citus_cluster_metrics()")
+    txt = "\n".join(row[0] for row in r.rows)
+    assert "# TYPE citus_queries_executed_total counter" in txt
+    # every node's series is labeled; the coordinator's sees our queries
+    assert 'citus_queries_executed_total{node="0"}' in txt
+    assert 'citus_node_unreachable{node="1"} 0' in txt
+    assert 'citus_node_unreachable{node="2"} 0' in txt
+    # kill one worker (endpoint rewired to a hole): its series degrade
+    # to the unreachable marker while the others keep reporting
+    a.execute("SET citus.stat_fanout_timeout_s = 0.5")
+    workers[0]._data_server.server.register(
+        "get_node_stats", lambda p: time.sleep(30) or {})
+    txt2 = "\n".join(
+        row[0] for row in
+        a.execute("SELECT citus_cluster_metrics()").rows)
+    assert 'citus_node_unreachable{node="1"} 1' in txt2
+    assert 'citus_node_unreachable{node="2"} 0' in txt2
+
+
+def test_cluster_slow_queries_attributes_node(pair):
+    a, b = pair
+    from citus_tpu.observability.slowlog import GLOBAL_SLOW_LOG
+    GLOBAL_SLOW_LOG.clear()
+    a.execute("SET citus.log_min_duration_ms = 0")
+    a.execute("SELECT 1")
+    r = a.execute("SELECT citus_cluster_slow_queries()")
+    assert r.columns[0] == "node"
+    assert any("SELECT 1" in str(row[-1]) for row in r.rows), r.rows
+
+
+def test_get_node_stats_rpc_payload(pair):
+    """The RPC itself: one round trip returns counters + gauges +
+    activity + progress in a single JSON-safe payload."""
+    a, b = pair
+    from citus_tpu.net.rpc import RpcClient
+    host, port = a.catalog.node_endpoint(1)
+    c = RpcClient(host, port, timeout=5.0, secret=a.catalog.remote_data.secret)
+    try:
+        p = c.call("get_node_stats", {})
+    finally:
+        c.close()
+    assert p["node_ids"] == [1]
+    assert "queries_executed" in p["counters"]
+    assert "live_queries" in p["gauges"]
+    assert isinstance(p["activity"], list)
+    assert isinstance(p["progress"], list)
+
+
+# ------------------------------------------------- progress monitoring
+
+
+def test_rebalance_progress_phases_and_bytes(tmp_path):
+    """Poll get_rebalance_progress during a slowed shard move: bytes
+    climb monotonically, phases walk copy -> flip -> cleanup, and the
+    running task surfaces as citus_task_bytes_* gauges in
+    citus_cluster_metrics."""
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    try:
+        _load(cl, n=30000, shards=4)
+        t = cl.catalog.table("t")
+        shard = t.shards[0]
+        src = shard.placements[0]
+        dst = 1 - src
+        FAULTS.arm("shard_move_copy", delay_s=0.15)
+        jid = cl.background_jobs.create_job("observability move")
+        tid = cl.background_jobs.add_task(
+            jid, "move_shard", {"shard_id": shard.shard_id,
+                                "source": src, "target": dst})
+        seen_phases, byte_trail, metrics_saw_task = [], [], False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            r = cl.execute("SELECT get_rebalance_progress()")
+            rows = [dict(zip(r.columns, row)) for row in r.rows
+                    if row[0] == tid]
+            if rows and rows[0]["status"] in ("done", "failed"):
+                assert rows[0]["status"] == "done", rows[0]
+                break
+            if rows and rows[0]["status"] == "running":
+                d = rows[0]
+                if d["phase"] and (not seen_phases
+                                   or seen_phases[-1] != d["phase"]):
+                    seen_phases.append(d["phase"])
+                byte_trail.append(d["bytes_done"])
+                if not metrics_saw_task:
+                    txt = "\n".join(
+                        row[0] for row in
+                        cl.execute("SELECT citus_cluster_metrics()").rows)
+                    metrics_saw_task = "citus_task_bytes_done{" in txt
+            time.sleep(0.02)
+        else:
+            pytest.fail("move never finished")
+        assert "copy" in seen_phases, seen_phases
+        assert byte_trail == sorted(byte_trail), byte_trail
+        assert byte_trail and byte_trail[-1] > 0
+        assert metrics_saw_task
+        # phases recorded in order (any subset, but never out of order)
+        order = {"starting": 0, "copy": 1, "flip": 2, "cleanup": 3}
+        ranks = [order[p] for p in seen_phases]
+        assert ranks == sorted(ranks), seen_phases
+        # finished task reports its final odometer + schema'd columns
+        r = cl.execute("SELECT get_rebalance_progress()")
+        d = [dict(zip(r.columns, row)) for row in r.rows if row[0] == tid][0]
+        assert d["bytes_total"] > 0 and d["bytes_done"] >= d["bytes_total"]
+        assert d["started_at"] is not None
+        assert r.columns == ["task_id", "op", "args", "status", "attempts",
+                             "phase", "bytes_done", "bytes_total",
+                             "started_at", "eta_s"]
+    finally:
+        FAULTS.disarm()
+        cl.close()
+
+
+def test_jobs_view_is_a_copy(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    try:
+        runner = cl.background_jobs
+        v = runner.jobs_view()
+        assert v == {"jobs": [], "tasks": []}
+        v["tasks"].append({"oops": True})
+        assert runner.jobs_view()["tasks"] == []
+    finally:
+        cl.close()
+
+
+def test_eta_derives_from_rate():
+    from citus_tpu.services.background_jobs import BackgroundJobRunner
+    t = {"status": "running", "started_at": 100.0,
+         "bytes_done": 250, "bytes_total": 1000}
+    # 250 bytes in 10 s -> 750 more at the same rate = 30 s
+    assert BackgroundJobRunner._eta_s(t, 110.0) == pytest.approx(30.0)
+    t["bytes_done"] = 0
+    assert BackgroundJobRunner._eta_s(t, 110.0) is None
+    t.update(bytes_done=1000)
+    assert BackgroundJobRunner._eta_s(t, 110.0) is None
+    t.update(bytes_done=250, status="done")
+    assert BackgroundJobRunner._eta_s(t, 110.0) is None
+
+
+# ------------------------------------------------------- HTTP exporter
+
+
+def _scrape(port, path="/metrics"):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode()
+
+
+def test_metrics_exporter_http_scrape(tmp_path):
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__)
+                           .resolve().parents[1] / "scripts"))
+    try:
+        import metrics_exporter
+    finally:
+        sys.path.pop(0)
+    cl = ct.Cluster(str(tmp_path / "db"))
+    srv = None
+    try:
+        cl.execute("SELECT 1")
+        srv = metrics_exporter.make_server(cl, 0, host="127.0.0.1")
+        port = srv.server_address[1]
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        status, ctype, body = _scrape(port)
+        assert status == 200
+        assert ctype.startswith("text/plain")
+        assert "# TYPE citus_queries_executed_total counter" in body
+        # every sample line parses as <name>{labels}? <value>
+        for line in body.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name_part, val = line.rsplit(" ", 1)
+            float(val)
+            assert name_part.startswith("citus_"), line
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _scrape(port, "/nope")
+        assert exc.value.code == 404
+    finally:
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        cl.close()
+
+
+def test_metrics_exporter_cluster_mode_labels(pair):
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__)
+                           .resolve().parents[1] / "scripts"))
+    try:
+        import metrics_exporter
+    finally:
+        sys.path.pop(0)
+    a, b = pair
+    a.execute("SELECT 1")
+    srv = metrics_exporter.make_server(a, 0, cluster_wide=True,
+                                       host="127.0.0.1")
+    try:
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        status, _, body = _scrape(srv.server_address[1])
+        assert status == 200
+        assert 'node="0"' in body and 'node="1"' in body
+        assert "citus_node_unreachable" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()

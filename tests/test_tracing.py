@@ -192,7 +192,8 @@ def test_prometheus_text_exposition(cl):
     cl.execute("SELECT count(*) FROM t")
     r = cl.execute("SHOW citus.metrics")
     txt = "\n".join(row[0] for row in r.rows)
-    assert "# TYPE citus_queries_executed counter" in txt
+    assert "# TYPE citus_queries_executed_total counter" in txt
+    assert "# HELP citus_queries_executed_total" in txt
     assert "citus_plan_cache_entries" in txt
     assert "citus_query_latency_ms_bucket" in txt
     assert 'le="+Inf"' in txt
@@ -204,15 +205,18 @@ def test_prometheus_text_exposition(cl):
 
 
 def test_activity_reports_phase(cl):
-    """ActivityTracker rows end with the live phase; a finished query
-    leaves no rows, so drive the tracker directly."""
+    """ActivityTracker rows carry the live phase (and a wait_event
+    column after it); a finished query leaves no rows, so drive the
+    tracker directly."""
     gpid = cl.activity.enter("SELECT 1")
     T.push_phase_sink(lambda ph, _g=gpid: cl.activity.set_phase(_g, ph))
     try:
         T.set_phase("remote-wait")
-        rows = cl.execute("SELECT citus_stat_activity()").rows
-        mine = [r for r in rows if r[0] == gpid]
-        assert mine and mine[0][-1] == "remote-wait"
+        r = cl.execute("SELECT citus_stat_activity()")
+        mine = [dict(zip(r.columns, row)) for row in r.rows
+                if row[0] == gpid]
+        assert mine and mine[0]["phase"] == "remote-wait"
+        assert mine[0]["wait_event"] == ""
     finally:
         T.pop_phase_sink()
         cl.activity.exit(gpid)

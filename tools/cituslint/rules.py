@@ -17,6 +17,9 @@ CNT01     ``bump("name")`` / span-fold strings must name a counter
           declared in ``StatCounters.COUNTERS``
 CNT02     every declared counter must have a bump site (dead counters
           lie in every dashboard)
+CNT03     ``begin_wait("event")`` names must be registered in
+          ``stats.WAIT_COUNTERS`` and every registered wait event must
+          have a begin_wait site (both directions)
 GUC01     ``settings.<section>.<field>`` reads must resolve to a
           declared Settings field
 GUC02     every settings field the code reads must be SET/SHOW-covered
@@ -500,6 +503,72 @@ class DeadCounterRule(Rule):
                 f"anywhere in the package")
 
 
+def _wait_events_decl(pkg: PackageIndex):
+    """(event names, (lineno, end_lineno), module) of the module-level
+    ``WAIT_COUNTERS`` dict in <pkg>/stats.py; (set(), None, None) when
+    absent."""
+
+    def build():
+        mod = pkg.by_rel.get("stats.py")
+        if mod is None:
+            return (set(), None, None)
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "WAIT_COUNTERS"
+                    for t in stmt.targets) \
+                    and isinstance(stmt.value, ast.Dict):
+                keys = {k.value for k in stmt.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+                return (keys, (stmt.lineno, stmt.end_lineno), mod)
+        return (set(), None, None)
+
+    return pkg.cached("wait_events_decl", build)
+
+
+class WaitEventRule(Rule):
+    """Cross-consistency for the wait-event seam: every literal
+    ``begin_wait("event")`` in the package must name a key of
+    ``stats.WAIT_COUNTERS`` (a typo'd event books blocked time into a
+    KeyError at end_wait), and every registered event must have at
+    least one begin_wait site (an unentered event lies in every
+    wait-profile dashboard)."""
+
+    id = "CNT03"
+    name = "wait events registered"
+
+    def check_package(self, pkg):
+        events, span, decl_mod = _wait_events_decl(pkg)
+        if decl_mod is None or not events:
+            return
+        entered = set()
+        for mod in pkg.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if fname != "begin_wait" or not node.args:
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    continue
+                if arg.value not in events:
+                    yield self.diag(
+                        mod, node.lineno,
+                        f"begin_wait of unregistered wait event "
+                        f"{arg.value!r} (not a stats.WAIT_COUNTERS key)")
+                else:
+                    entered.add(arg.value)
+        for ev in sorted(events - entered):
+            yield self.diag(
+                decl_mod, span[0],
+                f"wait event {ev!r} is registered but no begin_wait "
+                f"site enters it")
+
+
 # -------------------------------------------------------------- GUC01/02
 
 
@@ -672,6 +741,7 @@ ALL_RULES = [
     SilentSwallowRule,
     CounterNameRule,
     DeadCounterRule,
+    WaitEventRule,
     SettingsFieldRule,
     TodoMarkerRule,
 ]
