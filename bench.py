@@ -189,6 +189,63 @@ def bench_plan_cache(cl, extra: dict) -> None:
     }
 
 
+def bench_megabatch(cl, extra: dict) -> None:
+    """Same-family query coalescing (executor/megabatch.py): K clients
+    hammering ONE router point-lookup family, serial (window=0) vs
+    coalesced (window>0) QPS, plus the dispatch occupancy histogram —
+    the high-QPS lever ROADMAP open item 1 names."""
+    import threading
+    from citus_tpu.executor.megabatch import GLOBAL_MEGABATCH
+    n_clients = int(os.environ.get("BENCH_MB_CLIENTS", "8"))
+    per_client = int(os.environ.get("BENCH_MB_QUERIES", "8"))
+    window_ms = float(os.environ.get("BENCH_MB_WINDOW_MS", "5"))
+    sql = ("SELECT sum(l_quantity), count(*) FROM lineitem "
+           "WHERE l_orderkey = 4242")
+
+    def storm() -> float:
+        bar = threading.Barrier(n_clients)
+
+        def run() -> None:
+            bar.wait()
+            for _ in range(per_client):
+                cl.execute(sql)
+        ts = [threading.Thread(target=run) for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return time.perf_counter() - t0
+
+    cl.execute(sql)  # serial plan + kernels warm
+    cl.execute(f"SET citus.megabatch_window_ms = {window_ms}")
+    cl.execute(f"SET citus.megabatch_max_size = {n_clients}")
+    cl.execute(sql)  # batched: kernels warm
+    cl.execute("SET citus.megabatch_window_ms = 0")
+    serial_wall = storm()
+    st0 = GLOBAL_MEGABATCH.stats()
+    cl.execute(f"SET citus.megabatch_window_ms = {window_ms}")
+    batched_wall = storm()
+    st1 = GLOBAL_MEGABATCH.stats()
+    cl.execute("SET citus.megabatch_window_ms = 0")
+    n = n_clients * per_client
+    batches = st1["batches"] - st0["batches"]
+    queries = st1["queries"] - st0["queries"]
+    hist = {k: st1["occupancy_hist"].get(k, 0)
+            - st0["occupancy_hist"].get(k, 0)
+            for k in st1["occupancy_hist"]}
+    extra["megabatch"] = {
+        "clients": n_clients,
+        "queries": n,
+        "window_ms": window_ms,
+        "serial_qps": round(n / serial_wall, 1),
+        "batched_qps": round(n / batched_wall, 1),
+        "speedup": round(serial_wall / batched_wall, 2),
+        "avg_occupancy": round(queries / max(1, batches), 2),
+        "occupancy_hist": {k: v for k, v in sorted(hist.items()) if v},
+    }
+
+
 def bench_trace_overhead(cl, extra: dict) -> None:
     """Tracing cost (observability/): warm Q1 wall time with sampling
     off (the allocation-free no-op recorder) vs sample_rate=1.0 (every
@@ -516,6 +573,8 @@ def main() -> None:
         bench_concurrency(cl, extra)
     if os.environ.get("BENCH_PLAN_CACHE", "1") != "0":
         bench_plan_cache(cl, extra)
+    if os.environ.get("BENCH_MEGABATCH", "1") != "0":
+        bench_megabatch(cl, extra)
     if os.environ.get("BENCH_TRACE", "1") != "0":
         bench_trace_overhead(cl, extra)
     if os.environ.get("BENCH_WAIT", "1") != "0":

@@ -2018,6 +2018,14 @@ class Cluster:
                                     partition_key="" if rkey is None else str(rkey))
             if rkey is not None:
                 self.tenant_stats.record(str(rkey), elapsed)
+            mb = result.explain.get("megabatch") if result.explain else None
+            if mb:
+                # per-STATEMENT occupancy attribution: one note per user
+                # query that rode a batch (the per-batch half books in
+                # the dispatcher itself)
+                from citus_tpu.executor.megabatch import GLOBAL_MEGABATCH
+                GLOBAL_MEGABATCH.note_query_occupancy(
+                    int(mb.get("occupancy", 1)))
         return result
 
     def _finish_query_trace(self, qt, sql: str) -> None:

@@ -71,6 +71,11 @@ _GUCS = {
     "citus.plan_cache_mode": ("planner", "plan_cache_mode", _plan_cache_mode),
     "citus.kernel_cache_size": ("executor", "kernel_cache_size", int),
     "citus.jit_cache_dir": ("executor", "jit_cache_dir", str),
+    # same-family query coalescing (executor/megabatch.py): dispatch
+    # window (ms; 0 = off, byte-identical serial path) and per-batch
+    # occupancy bound
+    "citus.megabatch_window_ms": ("executor", "megabatch_window_ms", float),
+    "citus.megabatch_max_size": ("executor", "megabatch_max_size", int),
     # distributed tracing (observability/): span-tree sampling rate,
     # slow-query force-capture threshold (ms; -1 off), Chrome-trace
     # export directory ("" off)

@@ -199,6 +199,13 @@ def _run_analyze(cl, stmt: A.Explain) -> list[str]:
     dh = c1.get("device_cache_hits", 0) - c0.get("device_cache_hits", 0)
     dm = c1.get("device_cache_misses", 0) - c0.get("device_cache_misses", 0)
     lines.append(f"  Device Cache: {dh} hit(s), {dm} miss(es)")
+    mb = (ex.attrs.get("megabatch") if ex is not None else None) \
+        or r.explain.get("megabatch")
+    if mb:
+        lines.append(
+            f"  Batch: occupancy {mb.get('occupancy')}/"
+            f"window {mb.get('window_ms', 0):g} ms  "
+            f"(wait {mb.get('wait_ms', 0):.2f} ms)")
     rounds = tr.find_all("device_round")
     tasks = r.explain.get("tasks") or []
     if tasks:

@@ -197,8 +197,29 @@ def _citus_stat_pool(cl, name, args):
     st = GLOBAL_POOL.stats()
     st["pool_size"] = cl.settings.executor.max_shared_pool_size
     cols = ["pool_size", "in_use", "high_water", "granted",
-            "denied_optional", "waits"]
+            "denied_optional", "waits", "coalesced"]
     return Result(columns=cols, rows=[tuple(st[c] for c in cols)])
+
+
+@utility("citus_megabatch_stats")
+def _citus_megabatch_stats(cl, name, args):
+    # same-family coalescing view (executor/megabatch.py): dispatch and
+    # occupancy accounting next to the knobs that shape it
+    from citus_tpu.executor.megabatch import GLOBAL_MEGABATCH
+    st = GLOBAL_MEGABATCH.stats()
+
+    def _hist(h: dict) -> str:
+        return ", ".join(f"{k}:{v}" for k, v in sorted(h.items()))
+    ex = cl.settings.executor
+    return Result(
+        columns=["window_ms", "max_size", "batches", "queries",
+                 "fallbacks", "avg_occupancy", "occupancy_hist",
+                 "query_occupancy_hist"],
+        rows=[(ex.megabatch_window_ms, ex.megabatch_max_size,
+               st["batches"], st["queries"], st["fallbacks"],
+               round(st["avg_occupancy"], 2),
+               _hist(st["occupancy_hist"]),
+               _hist(st["query_occupancy_hist"]))])
 
 
 @utility("citus_stat_counters")

@@ -116,6 +116,15 @@ class ExecutorSettings:
     # Directory for JAX's persistent on-disk XLA compilation cache so
     # process restarts skip compiles — citus.jit_cache_dir ("" = off).
     jit_cache_dir: str = ""
+    # Same-family query coalescing (executor/megabatch.py): queries
+    # whose plans share a fingerprint and arrive within this window
+    # (ms) stack into ONE vmap-lifted device dispatch —
+    # citus.megabatch_window_ms.  0 (the default) disables coalescing:
+    # the serial path runs byte-identical to before.
+    megabatch_window_ms: float = 0.0
+    # Upper bound on queries per coalesced dispatch; a full batch
+    # dispatches before the window closes — citus.megabatch_max_size.
+    megabatch_max_size: int = 32
 
 
 @dataclass

@@ -33,6 +33,9 @@ class SharedTaskPool:
         self.granted = 0
         self.denied_optional = 0
         self.waits = 0
+        # queries served WITHOUT a slot of their own because a megabatch
+        # leader's single dispatch carried them (executor/megabatch.py)
+        self.coalesced = 0
 
     def acquire(self, limit: Optional[int], *, optional: bool = False,
                 timeout: float = 30.0) -> bool:
@@ -81,12 +84,19 @@ class SharedTaskPool:
                 self.release()
         return _ctx()
 
+    def note_coalesced(self, n: int) -> None:
+        """Book ``n`` follower queries the holder's one slot is serving."""
+        if n <= 0:
+            return
+        with self._cv:
+            self.coalesced += n
+
     def stats(self) -> dict:
         with self._cv:
             return {"in_use": self.in_use, "high_water": self.high_water,
                     "granted": self.granted,
                     "denied_optional": self.denied_optional,
-                    "waits": self.waits}
+                    "waits": self.waits, "coalesced": self.coalesced}
 
 
 #: the process-wide pool (the shared-memory counters analog)

@@ -854,6 +854,15 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
             # cached generic plan for THESE parameter values
             with _trace.span("prune"):
                 plan = _bind_time_prune(plan, params)
+            # window > 0 opts parameterized queries into same-family
+            # coalescing; at 0 (default) the module is never imported
+            # and the serial path below is byte-identical to before
+            if settings.executor.megabatch_window_ms > 0:
+                from citus_tpu.executor.megabatch import maybe_megabatch
+                r = maybe_megabatch(cat, bound, settings, plan, params,
+                                    t0, _exec_span)
+                if r is not None:
+                    return r
         return _execute_select_traced(cat, bound, settings, plan, params,
                                       t0, _exec_span)
     finally:
@@ -900,6 +909,17 @@ def _execute_select_traced(cat: Catalog, bound: BoundSelect,
         rows = snapshot_read(cat.data_dir, bound.table, _attempt,
                              timeout=settings.executor.lock_timeout_s)
         plan = run_plan
+    return _finish_select(bound, plan, rows, t0, exec_span)
+
+
+def _finish_select(bound: BoundSelect, plan: PhysicalPlan, rows: list[tuple],
+                   t0: float, exec_span, megabatch: Optional[dict] = None
+                   ) -> Result:
+    """Shared tail of the serial and megabatched paths: ORDER/LIMIT +
+    hidden-output trim, result-shape counters, span attrs and the
+    explain dict.  Runs on the issuing caller's own thread either way,
+    so per-query spans and stat attribution are identical under
+    coalescing (``megabatch`` adds the occupancy attrs)."""
     _trace.set_phase("finalize")
     with _trace.span("finalize"):
         rows = order_and_limit(plan, rows)
@@ -918,22 +938,27 @@ def _execute_select_traced(cat: Catalog, bound: BoundSelect,
             # the full pipeline-overlap dict rides the span so EXPLAIN
             # ANALYZE and the Chrome export render from one source
             exec_span.attrs["pipeline"] = dict(pipe)
+        if megabatch:
+            exec_span.attrs["megabatch"] = dict(megabatch)
     visible = list(bound.output_names)
     if bound.hidden_outputs:
         visible = visible[:len(visible) - bound.hidden_outputs]
+    explain = {
+        "strategy": plan.group_mode.kind if bound.has_aggs else "projection",
+        "shards": len(plan.shard_indexes),
+        "router": plan.is_router,
+        "intervals": [c.column for c in plan.intervals],
+        "elapsed_s": elapsed,
+        "tasks": plan.runtime_cache.get("task_times", []),
+        "remote_tasks": plan.runtime_cache.get("remote_tasks", []),
+        "pipeline": plan.runtime_cache.get("pipeline", {}),
+        "router_key": plan.router_key,
+    }
+    if megabatch:
+        explain["megabatch"] = dict(megabatch)
     return Result(
         columns=visible,
         rows=rows,
         types=[e.type for e in bound.final_exprs][:len(visible)],
-        explain={
-            "strategy": plan.group_mode.kind if bound.has_aggs else "projection",
-            "shards": len(plan.shard_indexes),
-            "router": plan.is_router,
-            "intervals": [c.column for c in plan.intervals],
-            "elapsed_s": elapsed,
-            "tasks": plan.runtime_cache.get("task_times", []),
-            "remote_tasks": plan.runtime_cache.get("remote_tasks", []),
-            "pipeline": plan.runtime_cache.get("pipeline", {}),
-            "router_key": plan.router_key,
-        },
+        explain=explain,
     )

@@ -72,8 +72,15 @@ class _TimedJit:
         self._mu = threading.Lock()
 
     def __call__(self, *args, **kw):
+        from citus_tpu.testing.faults import FAULTS
         fn = self._fn
         with self._mu:
+            # per-dispatch injection point UNDER the kernel lock: a
+            # delay armed here serializes across every caller of this
+            # compiled executable, which is what makes the megabatch
+            # A/B throughput test (tests/test_megabatch.py) a fair
+            # model of per-dispatch device latency
+            FAULTS.hit("kernel_dispatch", "")
             try:
                 before = fn._cache_size()
             except Exception:

@@ -65,6 +65,7 @@ _SPAN_MS = {
     "execute": "span_execute_ms",
     "finalize": "span_finalize_ms",
     "remote_task": "span_remote_task_ms",
+    "megabatch": "span_megabatch_ms",
 }
 
 

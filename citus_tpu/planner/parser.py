@@ -1503,7 +1503,8 @@ class Parser:
         "run_command_on_placements", "master_get_table_ddl_events",
         "citus_backend_gpid", "citus_coordinator_nodeid",
         "create_time_partitions", "drop_old_time_partitions",
-        "time_partitions", "citus_stat_pool", "citus_remote_stats",
+        "time_partitions", "citus_stat_pool", "citus_megabatch_stats",
+        "citus_remote_stats",
         "citus_extensions",
         "citus_domains", "citus_collations", "citus_publications",
         "citus_statistics_objects",

@@ -99,6 +99,15 @@ class StatCounters:
         "wait_prefetch_stall_ms",
         "wait_device_round_ms",
         "wait_2pc_decision_ms",
+        "wait_megabatch_ms",
+        # same-family query coalescing (executor/megabatch.py):
+        # queries that rode a batch, device dispatches issued for them,
+        # and groups that fell back to the serial path; span_megabatch_ms
+        # folds each query's enqueue->scatter stretch from its trace span
+        "megabatch_queries",
+        "megabatch_batches",
+        "megabatch_fallbacks",
+        "span_megabatch_ms",
         # cluster stat fan-out (observability/cluster_stats.py): probes
         # issued and per-node failures degraded to node_unreachable rows
         "stat_fanout_probes",
@@ -147,6 +156,9 @@ WAIT_COUNTERS = {
     "prefetch_stall": "wait_prefetch_stall_ms",
     "device_round": "wait_device_round_ms",
     "2pc_decision": "wait_2pc_decision_ms",
+    # parked in a coalescing window (executor/megabatch.py) — a
+    # scheduling stall, deliberately distinct from device_round
+    "megabatch_wait": "wait_megabatch_ms",
 }
 
 WAIT_EVENTS = tuple(sorted(WAIT_COUNTERS))

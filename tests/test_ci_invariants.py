@@ -66,6 +66,18 @@ def test_jit_confined_to_kernel_cache():
     assert CONFINED_CALLS["jax.jit"] == ("executor/kernel_cache.py",)
 
 
+def test_vmap_confined_to_megabatch():
+    """``jax.vmap`` (query-axis batching) lives only in
+    executor/megabatch.py and executor/kernel_cache.py, so every
+    batched kernel flows through get_kernel's ``batched:`` slots and
+    the single jit door — a vmap call anywhere else would dodge both
+    the kernel cache and megabatch's occupancy accounting."""
+    assert _lint("CONF01") == []
+    from tools.cituslint.rules import CONFINED_CALLS
+    assert CONFINED_CALLS["jax.vmap"] == \
+        ("executor/megabatch.py", "executor/kernel_cache.py")
+
+
 def test_perf_counter_confined_to_trace():
     """time.perf_counter is called only in observability/trace.py (the
     package-wide ``clock``), so every subsystem's timings share one

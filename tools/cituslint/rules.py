@@ -222,6 +222,10 @@ CONFINED_CALLS = {
     # jax.jit only inside the kernel cache's jit_compile wrapper, so
     # ad-hoc compiles can't dodge cache accounting
     "jax.jit": ("executor/kernel_cache.py",),
+    # query-axis batching: vmap-lifted kernels exist only where they
+    # flow through get_kernel's batched: slots (executor/megabatch.py)
+    # or the jit door itself
+    "jax.vmap": ("executor/megabatch.py", "executor/kernel_cache.py"),
     # one span-timing clock for the whole package
     "time.perf_counter": ("observability/trace.py",),
     # one wall clock, swappable in tests (utils/clock.py now())
