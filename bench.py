@@ -360,6 +360,126 @@ def bench_stat_fanout(extra: dict) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_workload(extra: dict) -> None:
+    """Closed-loop multi-tenant harness (workload/scheduler.py): mixed
+    router + analytic traffic from N client threads in EACH of two
+    coordinator OS processes sharing one cluster, admission squeezed
+    through a small shared pool so the stride scheduler is the choke
+    point.  Reports sustained QPS and per-tenant p50/p99."""
+    import shutil
+    import subprocess as sp
+    import tempfile
+    import textwrap
+    import threading
+
+    import citus_tpu as ct
+    clients = int(os.environ.get("BENCH_WL_CLIENTS", "6"))
+    seconds = float(os.environ.get("BENCH_WL_SECONDS", "6"))
+    pool = int(os.environ.get("BENCH_WL_POOL", "4"))
+    root = tempfile.mkdtemp(prefix="bench_workload_", dir=_HERE)
+    d = os.path.join(root, "db")
+
+    # one client thread's closed loop: router lookups on its own tenant
+    # key, every 8th query the shared-bucket analytic scan
+    driver = textwrap.dedent("""
+        def _drive(cl, clients, seconds, out):
+            import threading, time
+
+            def loop(ci):
+                tenant = str(ci % 4)
+                lat = out.setdefault(tenant, [])
+                alat = out.setdefault("*", [])
+                router = f"SELECT sum(v) FROM wt WHERE k = {ci % 4}"
+                analytic = "SELECT count(*), sum(v) FROM wt"
+                i = 0
+                deadline = time.monotonic() + seconds
+                while time.monotonic() < deadline:
+                    sql, dst = ((analytic, alat) if i % 8 == 7
+                                else (router, lat))
+                    t0 = time.perf_counter()
+                    try:
+                        cl.execute(sql)
+                    except Exception:
+                        i += 1
+                        continue
+                    dst.append(time.perf_counter() - t0)
+                    i += 1
+            ts = [threading.Thread(target=loop, args=(ci,))
+                  for ci in range(clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+    """)
+    child_code = driver + textwrap.dedent(f"""
+        import json, sys
+        import citus_tpu as ct
+        cl = ct.Cluster({d!r}, coordinator=("127.0.0.1", PORT))
+        cl.execute("SET citus.max_shared_pool_size = {pool}")
+        cl.execute("SELECT sum(v) FROM wt WHERE k = 1")  # warm
+        print("READY", flush=True)
+        sys.stdin.readline()  # GO
+        out = {{}}
+        _drive(cl, {clients}, {seconds}, out)
+        cl.close()
+        print("RESULT " + json.dumps(out), flush=True)
+    """)
+
+    a = ct.Cluster(d, serve_port=0)
+    child = None
+    try:
+        a.execute("CREATE TABLE wt (k bigint NOT NULL, v bigint)")
+        a.execute("SELECT create_distributed_table('wt', 'k', 8)")
+        n = 200_000
+        a.copy_from("wt", columns={"k": np.arange(n) % 64,
+                                   "v": np.arange(n)})
+        a.execute("SET citus.max_shared_pool_size = %d" % pool)
+        for t in range(4):
+            a.execute(f"SELECT citus_add_tenant_quota('{t}', 1.0)")
+        a.execute("SELECT sum(v) FROM wt WHERE k = 1")  # warm
+        # the second coordinator always runs the cpu backend: a second
+        # OS process cannot share the TPU, and admission behavior (the
+        # thing under test) is device-independent
+        code = ("import jax\njax.config.update('jax_platforms','cpu')\n"
+                + child_code.replace("PORT", str(a.control_port)))
+        child = sp.Popen([sys.executable, "-c", code], stdin=sp.PIPE,
+                         stdout=sp.PIPE, text=True)
+        assert child.stdout.readline().strip() == "READY"
+        ns = {}
+        exec(compile(driver, "<bench_workload>", "exec"), ns)
+        out = {}
+        child.stdin.write("GO\n")
+        child.stdin.flush()
+        t0 = time.perf_counter()
+        ns["_drive"](a, clients, seconds, out)
+        line = child.stdout.readline()
+        wall = time.perf_counter() - t0
+        assert line.startswith("RESULT "), line
+        for tenant, lats in json.loads(line[len("RESULT "):]).items():
+            out.setdefault(tenant, []).extend(lats)
+        total = sum(len(v) for v in out.values())
+        tenants = {
+            t: {"queries": len(v),
+                "p50_ms": round(float(np.percentile(v, 50)) * 1000, 2),
+                "p99_ms": round(float(np.percentile(v, 99)) * 1000, 2)}
+            for t, v in sorted(out.items()) if v
+        }
+        extra["workload"] = {
+            "coordinators": 2,
+            "clients_per_coordinator": clients,
+            "shared_pool_size": pool,
+            "duration_s": seconds,
+            "sustained_qps": round(total / wall, 1),
+            "tenants": tenants,
+        }
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()
+            child.wait()
+        a.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def ensure_join_data(cl: "ct.Cluster", n_orders: int) -> None:
     """orders_b: the build side of the repartition join, distributed on
     o_custkey so the l_orderkey = o_orderkey join must reshuffle."""
@@ -581,6 +701,8 @@ def main() -> None:
         bench_wait_overhead(cl, extra)
     if os.environ.get("BENCH_FANOUT", "1") != "0":
         bench_stat_fanout(extra)
+    if os.environ.get("BENCH_WORKLOAD", "1") != "0":
+        bench_workload(extra)
     if os.environ.get("BENCH_JOIN", "1") != "0":
         n_orders = N_ROWS // 4
         ensure_join_data(cl, n_orders)

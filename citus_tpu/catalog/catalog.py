@@ -105,6 +105,21 @@ class TableMeta:
     def is_distributed(self) -> bool:
         return self.method == DistributionMethod.HASH
 
+    def route_hashes(self, hashes):
+        """Shard indexes owning the given signed int32 hash values under
+        the table's ACTUAL range layout.  The uniform-arithmetic mapping
+        (hashing.shard_index_for_hash) is only valid until the first
+        shard split makes the ranges non-uniform; they always tile the
+        int32 space contiguously, so a bisect on hash_min is exact."""
+        import numpy as np
+        mins = np.array([s.hash_min for s in self.shards], np.int64)
+        h = np.asarray(hashes).astype(np.int64)
+        return (np.searchsorted(mins, h, side="right") - 1).astype(np.int32)
+
+    def route_hash(self, h: int) -> int:
+        """Scalar ``route_hashes`` (router fast path, utilities)."""
+        return int(self.route_hashes([int(h)])[0])
+
     @property
     def is_reference(self) -> bool:
         return self.method == DistributionMethod.REFERENCE
@@ -852,12 +867,14 @@ class Catalog:
             if os.path.exists(dp):
                 os.remove(dp)
 
-    def distribute_table(self, name: str, dist_column: str, shard_count: int,
-                         node_ids: list[int], colocate_with: Optional[str] = None,
-                         replication_factor: int = 1) -> TableMeta:
-        """create_distributed_table analog (reference:
-        src/backend/distributed/commands/create_distributed_table.c).
-        Caller is responsible for moving any existing data."""
+    def resolve_colocation_id(self, name: str, dist_column: str,
+                              shard_count: int,
+                              colocate_with: Optional[str] = None) -> int:
+        """The colocation id ``distribute_table`` would assign, without
+        mutating the table.  Lets alter_distributed_table learn the
+        table's POST-swap flip identity first, so it can register the
+        flip bracket on it before any reader can see the new shard map
+        (fresh ids are allocated here, so the answer stays valid)."""
         with self._lock:
             t = self.table(name)
             col = t.schema.column(dist_column)
@@ -887,6 +904,21 @@ class Catalog:
                 if colocation_id is None:
                     colocation_id = self._next_colocation_id
                     self._next_colocation_id += 1
+            return colocation_id
+
+    def distribute_table(self, name: str, dist_column: str, shard_count: int,
+                         node_ids: list[int], colocate_with: Optional[str] = None,
+                         replication_factor: int = 1,
+                         colocation_id: Optional[int] = None) -> TableMeta:
+        """create_distributed_table analog (reference:
+        src/backend/distributed/commands/create_distributed_table.c).
+        Caller is responsible for moving any existing data.  An explicit
+        ``colocation_id`` (from resolve_colocation_id) skips selection."""
+        if colocation_id is None:
+            colocation_id = self.resolve_colocation_id(
+                name, dist_column, shard_count, colocate_with)
+        with self._lock:
+            t = self.table(name)
             self.ddl_epoch += 1
             ranges = shard_hash_ranges(shard_count)
             rf = max(1, min(int(replication_factor), len(node_ids)))

@@ -1498,7 +1498,7 @@ class Cluster:
         owning worker, commands/multi_copy.c CitusSendTupleToPlacements);
         the local remainder continues through the normal path.  Returns
         (local_values, local_validity, rows_shipped)."""
-        from citus_tpu.catalog.hashing import shard_index_for_values
+        from citus_tpu.catalog.hashing import hash_int64
         if not t.is_distributed:
             # reference/local tables: every remote host with a placement
             # receives the FULL batch (reference tables replicate to all
@@ -1549,7 +1549,7 @@ class Cluster:
                 "unique/FK-constrained tables cannot span remote-hosted "
                 "shards yet (constraint probes are host-local)")
         dist = values[t.dist_column].astype(np.int64)
-        idx = shard_index_for_values(dist, t.shard_count)
+        idx = t.route_hashes(hash_int64(dist))
         # group remote shards by owning endpoint: one batch per host
         by_endpoint: dict = {}
         remote_rows = np.zeros(len(dist), bool)
@@ -2018,6 +2018,12 @@ class Cluster:
                                     partition_key="" if rkey is None else str(rkey))
             if rkey is not None:
                 self.tenant_stats.record(str(rkey), elapsed)
+            if result.explain and "strategy" in result.explain:
+                # live scheduler histogram behind citus_stat_tenants():
+                # router queries under their key, analytics under "*"
+                from citus_tpu.workload import GLOBAL_SCHEDULER, tenant_key
+                GLOBAL_SCHEDULER.record_latency(tenant_key(rkey),
+                                                elapsed * 1000.0)
             mb = result.explain.get("megabatch") if result.explain else None
             if mb:
                 # per-STATEMENT occupancy attribution: one note per user

@@ -34,6 +34,13 @@ def _plan_cache_mode(v) -> str:
     return s
 
 
+def _window_ms(v) -> float:
+    """citus.megabatch_window_ms = <ms> | auto (stored as -1)."""
+    if str(v).lower() == "auto":
+        return -1.0
+    return float(v)
+
+
 def _sample_rate(v) -> float:
     """citus.trace_sample_rate = 0.0 .. 1.0."""
     f = float(v)
@@ -74,8 +81,14 @@ _GUCS = {
     # same-family query coalescing (executor/megabatch.py): dispatch
     # window (ms; 0 = off, byte-identical serial path) and per-batch
     # occupancy bound
-    "citus.megabatch_window_ms": ("executor", "megabatch_window_ms", float),
+    "citus.megabatch_window_ms": ("executor", "megabatch_window_ms", _window_ms),
     "citus.megabatch_max_size": ("executor", "megabatch_max_size", int),
+    # multi-tenant admission defaults (workload/scheduler.py): fair-
+    # share weight for unregistered tenants, per-tenant queue bound
+    # (0 = unbounded) and sustained-QPS token bucket (0 = unlimited)
+    "citus.tenant_default_weight": ("workload", "tenant_default_weight", float),
+    "citus.tenant_queue_depth": ("workload", "tenant_queue_depth", int),
+    "citus.tenant_rate_limit_qps": ("workload", "tenant_rate_limit_qps", float),
     # distributed tracing (observability/): span-tree sampling rate,
     # slow-query force-capture threshold (ms; -1 off), Chrome-trace
     # export directory ("" off)

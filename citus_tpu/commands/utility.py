@@ -197,7 +197,7 @@ def _citus_stat_pool(cl, name, args):
     st = GLOBAL_POOL.stats()
     st["pool_size"] = cl.settings.executor.max_shared_pool_size
     cols = ["pool_size", "in_use", "high_water", "granted",
-            "denied_optional", "waits", "coalesced"]
+            "denied_optional", "waits", "coalesced", "timeouts"]
     return Result(columns=cols, rows=[tuple(st[c] for c in cols)])
 
 
@@ -250,8 +250,25 @@ def _citus_stat_statements_reset(cl, name, args):
 
 @utility("citus_stat_tenants")
 def _citus_stat_tenants(cl, name, args):
-    return Result(columns=["tenant", "query_count", "total_time_ms"],
-                  rows=cl.tenant_stats.rows_view())
+    # live view: the 60 s sliding window (router attribution) joined
+    # with the workload scheduler's admission accounting and latency
+    # percentiles; "*" is the shared class (multi-shard analytics)
+    from citus_tpu.workload import GLOBAL_SCHEDULER
+    window = {r[0]: r for r in cl.tenant_stats.rows_view()}
+    sched = {r[0]: r for r in GLOBAL_SCHEDULER.rows_view()}
+    rows = []
+    for t in set(window) | set(sched):
+        _, qc, tt = window.get(t, (t, 0, 0.0))
+        (_, running, queued, granted, shed, coalesced, remote,
+         p50, p99) = sched.get(t, (t, 0, 0, 0, 0, 0, 0, 0.0, 0.0))
+        rows.append((t, qc, tt, running, queued, granted, shed,
+                     coalesced, remote, p50, p99))
+    rows.sort(key=lambda r: (-r[5], -r[1], str(r[0])))
+    return Result(columns=["tenant", "query_count", "total_time_ms",
+                           "running", "queued", "granted", "shed",
+                           "coalesced", "remote_tasks", "p50_ms",
+                           "p99_ms"],
+                  rows=rows)
 
 
 @utility("citus_stat_activity")
@@ -427,13 +444,12 @@ def _citus_tables(cl, name, args):
 def _get_shard_id_for_distribution_column(cl, name, args):
     import numpy as _np
 
-    from citus_tpu.catalog.hashing import hash_int64_scalar, shard_index_for_hash
+    from citus_tpu.catalog.hashing import hash_int64_scalar
     t2 = cl.catalog.table(str(args[0]))
     if not t2.is_distributed:
         return Result(columns=[name], rows=[(t2.shards[0].shard_id,)])
     h = hash_int64_scalar(int(args[1]))
-    si = int(shard_index_for_hash(_np.array([h], _np.int32),
-                                  t2.shard_count)[0])
+    si = t2.route_hash(h)
     return Result(columns=[name], rows=[(t2.shards[si].shard_id,)])
 
 
@@ -627,14 +643,11 @@ def _citus_split_shard_by_split_points(cl, name, args):
 def _isolate_tenant_to_new_shard(cl, name, args):
     # reference: isolate_shards.c — put one distribution-key value in its
     # own shard by splitting around its hash
-    import numpy as _np
-
-    from citus_tpu.catalog.hashing import hash_int64_scalar, shard_index_for_hash
+    from citus_tpu.catalog.hashing import hash_int64_scalar
     from citus_tpu.operations.shard_split import split_shard
     t = cl.catalog.table(args[0])
     h = hash_int64_scalar(int(args[1]))
-    si = int(shard_index_for_hash(_np.array([h], _np.int32), t.shard_count)[0])
-    shard = t.shards[si]
+    shard = t.shards[t.route_hash(h)]
     points = []
     if h - 1 >= shard.hash_min:
         points.append(h - 1)
@@ -645,6 +658,50 @@ def _isolate_tenant_to_new_shard(cl, name, args):
     cl._plan_cache.clear()
     return Result(columns=["isolate_tenant_to_new_shard"],
                   rows=[(new_ids[1 if h - 1 >= shard.hash_min else 0],)])
+
+
+# ----------------------------------------------------- workload management
+
+@utility("citus_add_tenant_quota")
+def _citus_add_tenant_quota(cl, name, args):
+    # SELECT citus_add_tenant_quota(tenant, weight [, max_concurrency
+    # [, rate_limit_qps [, queue_depth]]]) — control half of the
+    # workload scheduler (workload/registry.py); 0 falls back to the
+    # citus.tenant_* GUC defaults
+    from citus_tpu.workload import GLOBAL_TENANTS
+    GLOBAL_TENANTS.set_quota(
+        str(args[0]),
+        weight=float(args[1]) if len(args) > 1 else 0.0,
+        max_concurrency=int(args[2]) if len(args) > 2 else 0,
+        rate_limit_qps=float(args[3]) if len(args) > 3 else 0.0,
+        queue_depth=int(args[4]) if len(args) > 4 else 0)
+    return Result(columns=[name], rows=[(str(args[0]),)])
+
+
+@utility("citus_remove_tenant_quota")
+def _citus_remove_tenant_quota(cl, name, args):
+    from citus_tpu.workload import GLOBAL_TENANTS
+    return Result(columns=[name],
+                  rows=[(GLOBAL_TENANTS.remove(str(args[0])),)])
+
+
+@utility("citus_tenant_quotas")
+def _citus_tenant_quotas(cl, name, args):
+    from citus_tpu.workload import GLOBAL_TENANTS
+    return Result(columns=["tenant", "weight", "max_concurrency",
+                           "rate_limit_qps", "queue_depth", "pinned_node"],
+                  rows=GLOBAL_TENANTS.rows_view())
+
+
+@utility("citus_isolate_tenant_to_node")
+def _citus_isolate_tenant_to_node(cl, name, args):
+    # isolate_tenant_to_new_shard + move_shard_placement in one call:
+    # the tenant's shard lands on a dedicated host and the pin is
+    # recorded in the quota registry (workload/isolation.py)
+    from citus_tpu.workload.isolation import isolate_tenant_to_node
+    shard_id = isolate_tenant_to_node(cl, str(args[0]), args[1],
+                                      int(args[2]))
+    return Result(columns=[name], rows=[(shard_id,)])
 
 
 @utility("undistribute_table")

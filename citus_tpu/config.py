@@ -120,11 +120,33 @@ class ExecutorSettings:
     # whose plans share a fingerprint and arrive within this window
     # (ms) stack into ONE vmap-lifted device dispatch —
     # citus.megabatch_window_ms.  0 (the default) disables coalescing:
-    # the serial path runs byte-identical to before.
+    # the serial path runs byte-identical to before.  SET ... = auto
+    # stores -1: the dispatcher sizes the window per plan family from
+    # an arrival-rate EWMA (wait only when another arrival is likely).
     megabatch_window_ms: float = 0.0
     # Upper bound on queries per coalesced dispatch; a full batch
     # dispatches before the window closes — citus.megabatch_max_size.
     megabatch_max_size: int = 32
+
+
+@dataclass
+class WorkloadSettings:
+    """Multi-tenant admission defaults (workload/scheduler.py) — the
+    fallback class for tenants without an explicit
+    citus_add_tenant_quota() row."""
+
+    # Fair-share weight of an unregistered tenant —
+    # citus.tenant_default_weight.  Slot share converges to
+    # weight / sum(weights of queued tenants).
+    tenant_default_weight: float = 1.0
+    # Per-tenant admission queue bound — citus.tenant_queue_depth.
+    # A tenant with this many queries already queued has new arrivals
+    # fast-failed with the retryable shed error.  0 = unbounded (the
+    # legacy pool behavior).
+    tenant_queue_depth: int = 0
+    # Per-tenant sustained QPS admission rate (token bucket with one
+    # second of burst) — citus.tenant_rate_limit_qps.  0 = unlimited.
+    tenant_rate_limit_qps: float = 0.0
 
 
 @dataclass
@@ -167,6 +189,7 @@ class Settings:
     planner: PlannerSettings = field(default_factory=PlannerSettings)
     executor: ExecutorSettings = field(default_factory=ExecutorSettings)
     sharding: ShardingSettings = field(default_factory=ShardingSettings)
+    workload: WorkloadSettings = field(default_factory=WorkloadSettings)
     observability: ObservabilitySettings = field(
         default_factory=ObservabilitySettings)
     # reference GUC citus.enable_change_data_capture

@@ -43,5 +43,15 @@ class ExecutionError(CitusTpuError):
     """Runtime failure while executing a plan."""
 
 
+class AdmissionShedError(ExecutionError):
+    """A query was load-shed by the workload scheduler before taking a
+    slot (tenant queue depth or QPS rate limit exceeded).  Distinct and
+    retryable: the client should back off and resend — nothing ran, no
+    state changed (the reference fast-fails with a dedicated sqlstate
+    when shared_connection_stats denies a connection)."""
+
+    retryable = True
+
+
 class TransactionError(CitusTpuError):
     """Distributed transaction / 2PC failure."""

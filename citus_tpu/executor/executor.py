@@ -76,7 +76,8 @@ def _combine_kinds(plan: PhysicalPlan) -> list[str]:
     kinds = []
     for op in plan.partial_ops:
         kinds.append({"sum": "sum", "count": "sum", "min": "min",
-                      "max": "max", "hll": "max", "ddsk": "sum"}[op.kind])
+                      "max": "max", "hll": "max", "ddsk": "sum",
+                      "topk": "sum", "topkv": "max"}[op.kind])
     if plan.group_mode.kind == "direct":
         kinds.append("sum")  # group row counts
     return kinds
@@ -169,6 +170,12 @@ def _empty_partials(plan: PhysicalPlan, xp):
         elif op.kind == "ddsk":
             from citus_tpu.planner.aggregates import DDSK_M
             outs.append(np.zeros((DDSK_M,), np.int64))
+        elif op.kind == "topk":
+            from citus_tpu.planner.aggregates import TOPK_M
+            outs.append(np.zeros((TOPK_M,), np.int64))
+        elif op.kind == "topkv":
+            from citus_tpu.planner.aggregates import TOPK_M
+            outs.append(np.full((TOPK_M,), np.iinfo(np.int64).min, np.int64))
         elif op.kind in ("sum", "count"):
             base = np.int64(0) if op.kind == "count" else dt.type(0)
             outs.append(np.zeros((G,), dt) if G else np.asarray(base, dt))
@@ -594,7 +601,7 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     # distinct/collect partial states are exact value (multi)sets: only
     # the host accumulation path can carry them
     has_exact = any(op.kind in ("distinct", "collect", "collect_set", "hll",
-                                "ddsk")
+                                "ddsk", "topk", "topkv")
                     for op in plan.partial_ops)
     if backend != "cpu" and not has_exact:
         import jax
@@ -854,10 +861,11 @@ def execute_select(cat: Catalog, bound: BoundSelect, settings: Settings,
             # cached generic plan for THESE parameter values
             with _trace.span("prune"):
                 plan = _bind_time_prune(plan, params)
-            # window > 0 opts parameterized queries into same-family
-            # coalescing; at 0 (default) the module is never imported
+            # window != 0 opts parameterized queries into same-family
+            # coalescing (negative = auto-sized from the plan family's
+            # arrival rate); at 0 (default) the module is never imported
             # and the serial path below is byte-identical to before
-            if settings.executor.megabatch_window_ms > 0:
+            if settings.executor.megabatch_window_ms != 0:
                 from citus_tpu.executor.megabatch import maybe_megabatch
                 r = maybe_megabatch(cat, bound, settings, plan, params,
                                     t0, _exec_span)
@@ -878,11 +886,14 @@ def _execute_select_traced(cat: Catalog, bound: BoundSelect,
     elif len(plan.shard_indexes) > 1:
         GLOBAL_COUNTERS.bump("multi_shard_queries")
     # admission control: one device-dispatch slot per executing query
-    # (the citus.max_shared_pool_size analog; 0 = unlimited)
-    from citus_tpu.executor.admission import GLOBAL_POOL
+    # (the citus.max_shared_pool_size analog; 0 = unlimited), granted
+    # through the tenant-aware fair-share scheduler — router queries
+    # are charged to their distribution-key tenant, multi-shard
+    # analytics to the shared "*" tenant
     from citus_tpu.transaction.snapshot import snapshot_read
-    with GLOBAL_POOL.slot(settings.executor.max_shared_pool_size,
-                          timeout=settings.executor.lock_timeout_s):
+    from citus_tpu.workload import GLOBAL_SCHEDULER, tenant_key
+    with GLOBAL_SCHEDULER.slot(settings, tenant_key(plan.router_key),
+                               timeout=settings.executor.lock_timeout_s):
         # snapshot read: never blocks behind writers — the scan is
         # validated against the table's flip generation and retried if
         # a multi-file metadata flip (TRUNCATE, DML commit, shard

@@ -44,6 +44,16 @@ class HostGroupAccumulator:
                 from citus_tpu.planner.aggregates import DDSK_M
                 row.append(np.zeros(DDSK_M, np.int64))
                 continue
+            if op.kind == "topk":
+                from citus_tpu.planner.aggregates import TOPK_M
+                row.append(np.zeros(TOPK_M, np.int64))
+                continue
+            if op.kind == "topkv":
+                from citus_tpu.planner.aggregates import (
+                    TOPK_M, TOPK_SENTINEL,
+                )
+                row.append(np.full(TOPK_M, TOPK_SENTINEL, np.int64))
+                continue
             dt = np.dtype(op.dtype)
             if op.kind in ("min", "max"):
                 row.append(dt.type(_sentinel(op.kind, dt)))
@@ -135,6 +145,29 @@ class HostGroupAccumulator:
                 local.append([flat[g * DDSK_M:(g + 1) * DDSK_M]
                               for g in range(L)])
                 continue
+            if op.kind in ("topk", "topkv"):
+                from citus_tpu.planner.aggregates import (
+                    TOPK_M, TOPK_SENTINEL, topk_buckets,
+                )
+                v, ok = arg_np[op.arg_index]
+                v64 = np.asarray(v).astype(np.int64)
+                bucket = topk_buckets(np, v64)
+                nz = np.nonzero(ok)[0]
+                if op.kind == "topk":
+                    flat = np.zeros(L * TOPK_M, np.int64)
+                    if nz.size:
+                        idx = inverse[nz].astype(np.int64) * TOPK_M \
+                            + bucket[nz]
+                        np.add.at(flat, idx, 1)
+                else:
+                    flat = np.full(L * TOPK_M, TOPK_SENTINEL, np.int64)
+                    if nz.size:
+                        idx = inverse[nz].astype(np.int64) * TOPK_M \
+                            + bucket[nz]
+                        np.maximum.at(flat, idx, v64[nz])
+                local.append([flat[g * TOPK_M:(g + 1) * TOPK_M]
+                              for g in range(L)])
+                continue
             if op.kind == "collect":
                 v, ok = arg_np[op.arg_index]
                 lists = [[] for _ in range(L)]
@@ -177,10 +210,10 @@ class HostGroupAccumulator:
             for pi, op in enumerate(self.partial_ops):
                 if op.kind in ("distinct", "collect_set"):
                     self._accs[gi][pi] |= local[pi][li]
-                elif op.kind == "hll":
+                elif op.kind in ("hll", "topkv"):
                     np.maximum(self._accs[gi][pi], local[pi][li],
                                out=self._accs[gi][pi])
-                elif op.kind == "ddsk":
+                elif op.kind in ("ddsk", "topk"):
                     self._accs[gi][pi] += local[pi][li]
                 elif op.kind == "collect":
                     self._accs[gi][pi].extend(local[pi][li])
@@ -251,7 +284,7 @@ class HostGroupAccumulator:
                 for g in range(G):
                     a[g] = self._accs[g][pi]
                 partials.append(a)
-            elif op.kind in ("hll", "ddsk"):
+            elif op.kind in ("hll", "ddsk", "topk", "topkv"):
                 partials.append(np.stack(
                     [self._accs[g][pi] for g in range(G)]))
             elif op.kind == "distinct":

@@ -58,6 +58,11 @@ def _counters():
     return GLOBAL_COUNTERS
 
 
+# expected inter-arrival gap (s) beyond which an auto-sized window
+# treats a plan family as sparse and stops waiting
+_AUTO_SPARSE_S = 0.025
+
+
 class _Waiter:
     """One query parked in a dispatch queue: its full execution context
     plus the scatter slots the leader fills."""
@@ -95,6 +100,8 @@ class MegabatchDispatcher:
     def __init__(self):
         self._mu = threading.Lock()
         self._queues: dict[tuple, _Queue] = {}
+        # auto-window state: plan family -> (last arrival t, EWMA gap s)
+        self._arrivals: dict[tuple, tuple[float, float]] = {}
         self.batches = 0
         self.queries = 0
         self.fallbacks = 0
@@ -158,6 +165,33 @@ class MegabatchDispatcher:
                     x.serial = True
                 x.done.set()
 
+    # ------------------------------------------------- adaptive window
+
+    def resolve_window(self, key: tuple, window_ms: float) -> float:
+        """Window (seconds) for this submission.  A fixed setting
+        passes through; negative (SET citus.megabatch_window_ms =
+        auto) sizes the window from the family's inter-arrival EWMA:
+        wait ~4 expected gaps (bounded to 0.5-10 ms) while arrivals
+        are bursty, and don't wait at all once the family goes sparse
+        (expected gap above _AUTO_SPARSE_S) — a sparse family would
+        pay the whole window's latency for an empty batch."""
+        if window_ms >= 0:
+            return window_ms / 1000.0
+        now = clock()
+        with self._mu:
+            prev = self._arrivals.get(key)
+            if prev is None:
+                if len(self._arrivals) >= 4096:
+                    self._arrivals.clear()
+                self._arrivals[key] = (now, _AUTO_SPARSE_S)
+                return 0.0
+            t_last, ewma = prev
+            ewma = 0.8 * ewma + 0.2 * (now - t_last)
+            self._arrivals[key] = (now, ewma)
+        if ewma > _AUTO_SPARSE_S:
+            return 0.0
+        return min(max(4.0 * ewma, 0.0005), 0.010)
+
     # ------------------------------------------------------- execution
 
     def _dispatch(self, batch: list[_Waiter]) -> None:
@@ -185,8 +219,8 @@ class MegabatchDispatcher:
                 raise
 
     def _run_group(self, group: list[_Waiter]) -> None:
-        from citus_tpu.executor.admission import GLOBAL_POOL
         from citus_tpu.transaction.snapshot import snapshot_read
+        from citus_tpu.workload import GLOBAL_SCHEDULER, tenant_key
         w0 = group[0]
         cat, settings, plan = w0.cat, w0.settings, w0.plan
         bound = plan.bound
@@ -200,11 +234,14 @@ class MegabatchDispatcher:
         # device, so per-literal interval/index pruning can be dropped
         # without changing any result
         scan_plan = dataclasses.replace(plan, intervals=[], index_eq=None)
-        # ONE admission slot per device dispatch — the coalesced
-        # queries beyond the first are bookkept, not admitted
-        with GLOBAL_POOL.slot(settings.executor.max_shared_pool_size,
-                              timeout=settings.executor.lock_timeout_s):
-            GLOBAL_POOL.note_coalesced(occ - 1)
+        # ONE admission slot per device dispatch, admitted under the
+        # batch LEADER's tenant; coalesced followers (who may belong
+        # to other tenants) are bookkept against their own tenants,
+        # not admitted
+        with GLOBAL_SCHEDULER.slot(settings, tenant_key(plan.router_key),
+                                   timeout=settings.executor.lock_timeout_s):
+            GLOBAL_SCHEDULER.note_coalesced(
+                [tenant_key(x.plan.router_key) for x in group[1:]])
 
             def _attempt():
                 if bound.has_aggs:
@@ -418,7 +455,7 @@ def megabatch_eligible(cat, bound, settings, plan) -> bool:
     open transaction overlay (staged writes are per-session state the
     shared scan must not see)."""
     ex = settings.executor
-    if ex.megabatch_window_ms <= 0 or ex.task_executor_backend == "cpu":
+    if ex.megabatch_window_ms == 0 or ex.task_executor_backend == "cpu":
         return False
     if not bound.param_specs or not plan.shard_indexes:
         return False
@@ -470,7 +507,11 @@ def maybe_megabatch(cat, bound, settings, plan, params, t0, exec_span):
     ex = settings.executor
     w = _Waiter(cat, bound, settings, plan, params)
     key = (cat.data_dir, bound.table.name, plan_fingerprint(plan))
-    GLOBAL_MEGABATCH.submit(w, key, ex.megabatch_window_ms / 1000.0,
+    window_s = GLOBAL_MEGABATCH.resolve_window(key, ex.megabatch_window_ms)
+    if window_s <= 0.0 and ex.megabatch_window_ms < 0:
+        # auto judged this family sparse: run serial, pay no window
+        return None
+    GLOBAL_MEGABATCH.submit(w, key, window_s,
                             max(1, ex.megabatch_max_size))
     if w.serial or w.payload is None:
         return None
@@ -490,7 +531,7 @@ def maybe_megabatch(cat, bound, settings, plan, params, t0, exec_span):
         rows = project_rows(plan, cat, data)
     wait_ms = (clock() - w.t_enq) * 1000.0
     info = {"occupancy": w.occupancy,
-            "window_ms": ex.megabatch_window_ms,
+            "window_ms": round(window_s * 1000.0, 3),
             "wait_ms": round(wait_ms, 3)}
     ctx = _trace.current()
     if ctx is not None:

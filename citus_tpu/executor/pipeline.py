@@ -291,7 +291,7 @@ class RemoteTaskDispatch:
 
     # ---- scheduling (caller holds self._mu) ----
     def _launch_locked(self) -> None:
-        from citus_tpu.executor.admission import GLOBAL_POOL
+        from citus_tpu.workload import GLOBAL_SCHEDULER
         progress = True
         while progress:
             progress = False
@@ -300,7 +300,7 @@ class RemoteTaskDispatch:
                     continue
                 if self._inflight_total == 0:
                     holds_slot = False  # rides the query's required slot
-                elif GLOBAL_POOL.acquire(self.shared_limit, optional=True):
+                elif GLOBAL_SCHEDULER.try_extra(self.shared_limit):
                     holds_slot = True
                 else:
                     return  # shared pool saturated; retry on completion
@@ -365,9 +365,9 @@ class RemoteTaskDispatch:
             tr.close_span(rspan)
             if ok and isinstance(meta, dict) and meta.get("spans"):
                 tr.graft(meta["spans"], rspan)
-        from citus_tpu.executor.admission import GLOBAL_POOL
+        from citus_tpu.workload import GLOBAL_SCHEDULER
         if holds_slot:
-            GLOBAL_POOL.release()
+            GLOBAL_SCHEDULER.release_extra()
         with self._mu:
             pool.inflight -= 1
             self._inflight_total -= 1

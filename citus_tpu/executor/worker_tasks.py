@@ -57,7 +57,8 @@ TASK_VERSION = 1
 
 #: partial-op kinds whose cross-host combine is a pure elementwise
 #: sum/min/max (combine_partials_host) — the only states worth shipping
-_COMBINABLE_KINDS = {"sum", "count", "min", "max", "hll", "ddsk"}
+_COMBINABLE_KINDS = {"sum", "count", "min", "max", "hll", "ddsk",
+                     "topk", "topkv"}
 
 
 class TaskCodecError(Exception):
@@ -243,9 +244,13 @@ def encode_task(plan: PhysicalPlan, params=((), ())) -> Optional[dict]:
 
 
 def _encode_task(plan: PhysicalPlan, params) -> dict:
+    from citus_tpu.workload import tenant_key
     bound = plan.bound
     task = {
         "v": TASK_VERSION,
+        # tenant attribution rides the wire so the worker's scheduler
+        # books whose query its device time served
+        "tenant": tenant_key(plan.router_key),
         "table": bound.table.name,
         "table_version": bound.table.version,
         "scan_columns": list(plan.scan_columns),

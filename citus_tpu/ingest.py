@@ -15,7 +15,7 @@ from typing import Any, Iterable, Optional, Sequence
 import numpy as np
 
 from citus_tpu.catalog import Catalog, DistributionMethod, TableMeta
-from citus_tpu.catalog.hashing import shard_index_for_values
+from citus_tpu.catalog.hashing import hash_int64
 from citus_tpu.errors import AnalysisError
 from citus_tpu.storage import ShardWriter
 
@@ -108,7 +108,7 @@ class TableIngestor:
         t = self.table
         if t.method == DistributionMethod.HASH:
             dist = values[t.dist_column].astype(np.int64)
-            idx = shard_index_for_values(dist, t.shard_count)
+            idx = t.route_hashes(hash_int64(dist))
             for si in np.unique(idx):
                 sel = idx == si
                 shard = t.shards[int(si)]

@@ -220,6 +220,11 @@ class DataPlaneServer:
         across hosts."""
         from citus_tpu.executor.worker_tasks import run_worker_task
         from citus_tpu.observability import trace as _trace
+        from citus_tpu.workload import GLOBAL_SCHEDULER
+        if p.get("tenant"):
+            # book the pushed task against the originating tenant so
+            # citus_stat_tenants() on THIS host shows who drove it
+            GLOBAL_SCHEDULER.note_remote_task(str(p["tenant"]))
         guard = self.cluster._remote_exec_guard
         prev = getattr(guard, "v", False)
         guard.v = True  # a pushed task must never push again

@@ -78,14 +78,20 @@ def undistribute_table(cat: Catalog, name: str, txlog=None) -> None:
     with _ctxlib.ExitStack() as _flips:
         _flips.enter_context(flip_generation(cat.data_dir, t))
         old_res = group_resource(t)
+        # post-swap identity is knowable upfront (local => colocation 0):
+        # register its flip BEFORE the mutation is reader-visible, or a
+        # reader binding mid-swap validates a quiet new group and scans
+        # the still-empty local shard as a consistent image
+        from types import SimpleNamespace
+        new_ident = SimpleNamespace(name=name, colocation_id=0)
+        if group_resource(new_ident) != old_res:
+            _flips.enter_context(flip_generation(cat.data_dir, new_ident))
         _record_old_placements(cat, t)
         from citus_tpu.catalog.catalog import ShardMeta
         t.method = DistributionMethod.LOCAL
         t.dist_column = None
         t.colocation_id = 0
         t.shards = [ShardMeta(cat._alloc_shard_id(), 0, placements=[0])]
-        if group_resource(t) != old_res:
-            _flips.enter_context(flip_generation(cat.data_dir, t))
         t.version += 1
         cat.ddl_epoch += 1
         cat.commit()
@@ -114,12 +120,23 @@ def alter_distributed_table(cat: Catalog, name: str, *,
     with _ctxlib.ExitStack() as _flips:
         _flips.enter_context(flip_generation(cat.data_dir, t))
         old_res = group_resource(t)
+        # register the flip on the POST-swap identity BEFORE mutating:
+        # the shared TableMeta is reader-visible the instant
+        # distribute_table assigns the new shard list, and a reader
+        # binding in that window validates against the NEW colocation
+        # group — it must already see a writer mid-flip there, or it
+        # reads the not-yet-reingested (empty) shards as a clean scan
+        from types import SimpleNamespace
+        new_id = cat.resolve_colocation_id(name, new_col, new_count,
+                                           colocate_with)
+        new_ident = SimpleNamespace(name=name, colocation_id=new_id)
+        if group_resource(new_ident) != old_res:
+            _flips.enter_context(flip_generation(cat.data_dir, new_ident))
         _record_old_placements(cat, t)
         cat.distribute_table(name, new_col, new_count,
                              cat.active_node_ids(),
-                             colocate_with=colocate_with)
-        if group_resource(t) != old_res:
-            _flips.enter_context(flip_generation(cat.data_dir, t))
+                             colocate_with=colocate_with,
+                             colocation_id=new_id)
         t.version += 1
         cat.commit()
         _reingest(cat, t, values, validity, txlog)

@@ -101,6 +101,41 @@ def build_worker_fn(plan: PhysicalPlan, xp) -> Callable:
                             DDSK_M, dtype=np.int32)[:, None]
                         outs.append(xp.sum(
                             (onehot & ok[None, :]).astype(np.int64), axis=1))
+                elif op.kind == "topk":
+                    # heavy-hitter count sketch: hashed bucket per row,
+                    # one-hot segment sum into [M] — psum-combinable
+                    # like ddsk (numpy bincounts for the same reason)
+                    from citus_tpu.planner.aggregates import (
+                        TOPK_M, topk_buckets,
+                    )
+                    bucket = topk_buckets(xp, xp.asarray(v).astype(np.int64))
+                    if xp.__name__ == "numpy":
+                        outs.append(np.bincount(
+                            bucket[np.asarray(ok)],
+                            minlength=TOPK_M).astype(np.int64))
+                    else:
+                        onehot = bucket[None, :] == xp.arange(
+                            TOPK_M, dtype=np.int32)[:, None]
+                        outs.append(xp.sum(
+                            (onehot & ok[None, :]).astype(np.int64), axis=1))
+                elif op.kind == "topkv":
+                    # companion value register: max value per hash
+                    # bucket (INT64_MIN = empty) — max-combinable
+                    from citus_tpu.planner.aggregates import (
+                        TOPK_M, TOPK_SENTINEL, topk_buckets,
+                    )
+                    v64 = xp.asarray(v).astype(np.int64)
+                    bucket = topk_buckets(xp, v64)
+                    upd = xp.where(ok, v64, TOPK_SENTINEL)
+                    if xp.__name__ == "numpy":
+                        acc = np.full((TOPK_M,), TOPK_SENTINEL, np.int64)
+                        outs.append(_np_scatter_max(acc, bucket, upd))
+                    else:
+                        onehot = bucket[None, :] == xp.arange(
+                            TOPK_M, dtype=np.int32)[:, None]
+                        outs.append(xp.max(
+                            xp.where(onehot, upd[None, :], TOPK_SENTINEL),
+                            axis=1))
                 elif op.kind == "hll":
                     # HyperLogLog registers: per-row (bucket, rho), then a
                     # one-hot segment max into [m] — combinable across
@@ -236,11 +271,11 @@ def combine_partials_host(plan: PhysicalPlan, shard_partials: list[tuple]) -> tu
     out = []
     for i, op in enumerate(ops):
         stack = np.stack([np.asarray(sp[i]) for sp in shard_partials])
-        if op.kind in ("sum", "count", "ddsk"):
+        if op.kind in ("sum", "count", "ddsk", "topk"):
             out.append(stack.sum(axis=0))
         elif op.kind == "min":
             out.append(stack.min(axis=0))
-        elif op.kind in ("max", "hll"):
+        elif op.kind in ("max", "hll", "topkv"):
             out.append(stack.max(axis=0))
         else:
             raise AssertionError(f"uncombinable partial kind {op.kind!r}")

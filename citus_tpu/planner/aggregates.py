@@ -509,6 +509,87 @@ def _finalize_approx_percentile(ex, partials, cat):
     return out, valid
 
 
+# ------------------------------------------- heavy hitters (approx_top_k)
+#
+# Same fixed-shape recipe as HLL/DDSketch above: a hashed count-array
+# sketch (a one-row count-min row) instead of a variable-size
+# space-saving list.  Each value hashes (splitmix64, like the HLL
+# bucketing) into one of TOPK_M count buckets; a parallel value
+# register keeps the max value seen per bucket so the finalizer can
+# name the heavy hitter the count belongs to.  Counts combine with the
+# same psum as plain sums, registers with the same elementwise max as
+# plain max partials — no new collectives.  A hash collision inflates a
+# bucket's count by the colliding light value's rows; with TOPK_M
+# buckets the probability a given heavy hitter shares a bucket is
+# ~n_distinct/TOPK_M, the usual count-min bound.
+
+TOPK_M = 1024                        # count buckets (power of two)
+TOPK_SENTINEL = np.int64(np.iinfo(np.int64).min)  # empty value register
+
+
+def topk_buckets(xp, bits):
+    """int64 value bits -> bucket [N] int32 (callers mask invalid rows
+    themselves)."""
+    h = bits.astype(np.uint64)
+    # splitmix64 finalizer (same mix as hll_rho_buckets)
+    h = (h ^ (h >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    h = (h ^ (h >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    h = h ^ (h >> np.uint64(31))
+    return (h & np.uint64(TOPK_M - 1)).astype(np.int32)
+
+
+def _bind_approx_top_k(binder, e):
+    from citus_tpu.planner import ast_nodes as A
+    from citus_tpu.planner.bind import AggSpec
+    if len(e.args) != 2:
+        raise AnalysisError("approx_top_k() expects (column, k)")
+    kl = e.args[1]
+    if not (isinstance(kl, A.Literal) and isinstance(kl.value, int)
+            and not isinstance(kl.value, bool)):
+        raise AnalysisError("approx_top_k() k must be an integer literal")
+    k = int(kl.value)
+    if not 1 <= k <= 64:
+        raise AnalysisError("approx_top_k() k must be in [1, 64]")
+    arg = binder.bind_scalar(e.args[0])
+    if not arg.type.is_integer:
+        raise AnalysisError(f"approx_top_k() over {arg.type} not supported")
+    if e.distinct:
+        raise UnsupportedFeatureError("approx_top_k(DISTINCT ...) not supported")
+    return AggSpec("approx_top_k", arg, T.TEXT_T, param=k)
+
+
+def _lower_approx_top_k(spec, arg_slot, partial_slot):
+    from citus_tpu.planner.physical import AggExtract
+    ai = arg_slot(spec.arg)
+    counts = partial_slot("topk", ai, "int64")
+    values = partial_slot("topkv", ai, "int64")
+    return AggExtract("approx_top_k", [counts, values], spec.out_type,
+                      param=spec.param)
+
+
+def _finalize_approx_top_k(ex, partials, cat):
+    import json as _json
+    counts = np.asarray(partials[ex.slots[0]], np.int64)
+    values = np.asarray(partials[ex.slots[1]], np.int64)
+    if counts.ndim == 1:        # scalar query: one sketch
+        counts = counts[None, :]
+        values = values[None, :]
+    out = np.empty(counts.shape[0], object)
+    valid = np.zeros(counts.shape[0], bool)
+    for g in range(counts.shape[0]):
+        hot = np.nonzero(counts[g] > 0)[0]
+        if hot.size == 0:
+            continue
+        valid[g] = True
+        # top-k buckets by count (value as the deterministic tiebreak)
+        order = sorted(hot, key=lambda b: (-int(counts[g][b]),
+                                           int(values[g][b])))
+        out[g] = _json.dumps(
+            [{"value": int(values[g][b]), "count": int(counts[g][b])}
+             for b in order[:ex.param]])
+    return out, valid
+
+
 # ----------------------------------------------- DISTINCT sum/avg
 
 
@@ -576,6 +657,8 @@ register(AggDef("approx_count_distinct", _bind_approx_distinct,
 register(AggDef("approx_percentile", _bind_approx_percentile,
                 _lower_approx_percentile, _finalize_approx_percentile,
                 host_grouped=True))
+register(AggDef("approx_top_k", _bind_approx_top_k, _lower_approx_top_k,
+                _finalize_approx_top_k, host_grouped=True))
 
 
 def finalize_kind(kind: str):

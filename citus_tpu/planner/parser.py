@@ -1505,6 +1505,8 @@ class Parser:
         "create_time_partitions", "drop_old_time_partitions",
         "time_partitions", "citus_stat_pool", "citus_megabatch_stats",
         "citus_remote_stats",
+        "citus_add_tenant_quota", "citus_remove_tenant_quota",
+        "citus_tenant_quotas", "citus_isolate_tenant_to_node",
         "citus_extensions",
         "citus_domains", "citus_collations", "citus_publications",
         "citus_statistics_objects",

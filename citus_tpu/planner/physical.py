@@ -31,7 +31,7 @@ import numpy as np
 
 from citus_tpu import types as T
 from citus_tpu.catalog import Catalog, TableMeta
-from citus_tpu.catalog.hashing import hash_int64_scalar, shard_index_for_hash
+from citus_tpu.catalog.hashing import hash_int64_scalar
 from citus_tpu.catalog.stats import column_bounds
 from citus_tpu.planner.bind import AggSpec, BoundSelect
 from citus_tpu.planner.bound import (
@@ -117,9 +117,7 @@ class PhysicalPlan:
         if v is None:
             return []  # dist = NULL matches nothing
         h = hash_int64_scalar(int(v))
-        idx = int(shard_index_for_hash(np.array([h], np.int32),
-                                       self.bound.table.shard_count)[0])
-        return [idx]
+        return [self.bound.table.route_hash(h)]
 
 
 # ------------------------------------------------------------ pruning
@@ -199,7 +197,7 @@ def prune_shards(table: TableMeta, filter_: Optional[BExpr],
                 and isinstance(right, BLiteral) and right.value is not None
                 and not isinstance(right.value, float)):
             h = hash_int64_scalar(int(right.value))
-            idx = int(shard_index_for_hash(np.array([h], np.int32), table.shard_count)[0])
+            idx = table.route_hash(h)
             return ([idx], right.value) if return_key else [idx]
     return (all_idx, key) if return_key else all_idx
 

@@ -112,6 +112,12 @@ class StatCounters:
         # issued and per-node failures degraded to node_unreachable rows
         "stat_fanout_probes",
         "stat_fanout_unreachable",
+        # workload scheduler (workload/scheduler.py): queries fast-
+        # failed by tenant queue-depth/rate limits, the high-water mark
+        # of queued admissions, and cumulative fair-share queue wait
+        "tenant_shed",
+        "admission_queue_depth_peak",
+        "wait_admission_ms",
     ]
 
     def __init__(self):
@@ -159,6 +165,10 @@ WAIT_COUNTERS = {
     # parked in a coalescing window (executor/megabatch.py) — a
     # scheduling stall, deliberately distinct from device_round
     "megabatch_wait": "wait_megabatch_ms",
+    # queued in the workload scheduler's fair-share admission queue
+    # (workload/scheduler.py) — waiting for a slot grant, not holding
+    # one; distinct from megabatch_wait (already admitted, coalescing)
+    "admission_wait": "wait_admission_ms",
 }
 
 WAIT_EVENTS = tuple(sorted(WAIT_COUNTERS))

@@ -1,0 +1,17 @@
+"""Workload management: tenant quotas, fair-share admission, isolation.
+
+The subsystem the rest of the package admits device work through:
+
+- ``registry``  — per-tenant quotas (weight / concurrency / QPS /
+  queue depth) + pinned-node records, GUC-backed defaults
+- ``scheduler`` — stride-scheduled fair-share slot dispatch over the
+  shared task pool, with load shedding and live per-tenant stats
+- ``isolation`` — pin a tenant's router traffic to a dedicated host
+"""
+
+from citus_tpu.workload.registry import (  # noqa: F401
+    GLOBAL_TENANTS, SHARED_TENANT, TenantQuota, TenantRegistry, tenant_key,
+)
+from citus_tpu.workload.scheduler import (  # noqa: F401
+    GLOBAL_SCHEDULER, TenantScheduler,
+)

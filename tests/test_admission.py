@@ -59,6 +59,68 @@ def test_unlimited_by_default():
     assert pool.high_water == 64
 
 
+def test_fifo_ticket_order():
+    """Regression: a freed slot goes to the LONGEST-waiting required
+    acquirer, not whichever thread the OS wakes first."""
+    pool = SharedTaskPool()
+    pool.acquire(1)
+    order = []
+    started = []
+    threads = []
+
+    def waiter(i):
+        started.append(i)
+        pool.acquire(1, timeout=10)
+        order.append(i)
+        time.sleep(0.01)
+        pool.release()
+
+    for i in range(4):
+        t = threading.Thread(target=waiter, args=(i,))
+        threads.append(t)
+        t.start()
+        # arrival order is the ticket order: wait until i is queued
+        deadline = time.monotonic() + 5
+        while len(pool._waiters) < i + 1 and time.monotonic() < deadline:
+            time.sleep(0.001)
+    pool.release()
+    for t in threads:
+        t.join()
+    assert order == [0, 1, 2, 3]
+    # waits counts waiters, not grants: the seed acquire never waited
+    assert pool.waits == 4
+    assert pool.granted == 5
+
+
+def test_optional_never_barges_waiters():
+    """Regression: with a required waiter queued, an optional acquire
+    is denied even at the instant a slot frees — the freed slot belongs
+    to the queue head."""
+    pool = SharedTaskPool()
+    pool.acquire(1)
+    got = []
+    t = threading.Thread(target=lambda: got.append(
+        pool.acquire(1, timeout=10)))
+    t.start()
+    deadline = time.monotonic() + 5
+    while not pool._waiters and time.monotonic() < deadline:
+        time.sleep(0.001)
+    pool.release()  # head ticket now owns the slot, maybe not yet awake
+    assert pool.acquire(1, optional=True) is False
+    t.join()
+    assert got == [True]
+    pool.release()
+
+
+def test_timeout_counter_in_stats():
+    pool = SharedTaskPool()
+    pool.acquire(1)
+    with pytest.raises(ExecutionError, match="max_shared_pool_size"):
+        pool.acquire(1, timeout=0.05)
+    assert pool.stats()["timeouts"] == 1
+    pool.release()
+
+
 def test_queries_bounded_end_to_end(tmp_path):
     """Concurrent queries through the SQL surface respect the cap and
     the citus_stat_pool view reports it."""

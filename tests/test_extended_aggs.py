@@ -311,3 +311,85 @@ def test_approx_percentile_empty_and_nulls(tmp_path):
                     "(ORDER BY v) FROM e")
     assert math.isclose(float(r2.rows[0][0]), 42.0, rel_tol=0.06)
     cl.close()
+
+
+# ------------- approx_top_k (count-array heavy hitters, ROADMAP #4) ---
+
+
+def _topk_expected(values, k):
+    """Replicate the sketch on the host: splitmix64 bucket counts plus
+    a max-value register per bucket, exactly the arrays the device
+    psum/max-combines (planner/aggregates.py: topk_buckets)."""
+    import json
+
+    from citus_tpu.planner.aggregates import (TOPK_M, TOPK_SENTINEL,
+                                              topk_buckets)
+    b = topk_buckets(np, np.asarray(values, np.int64))
+    counts = np.bincount(b, minlength=TOPK_M).astype(np.int64)
+    regs = np.full(TOPK_M, TOPK_SENTINEL, np.int64)
+    np.maximum.at(regs, b, np.asarray(values, np.int64))
+    hot = np.nonzero(counts > 0)[0]
+    order = sorted(hot, key=lambda i: (-int(counts[i]), int(regs[i])))
+    return json.dumps([{"value": int(regs[i]), "count": int(counts[i])}
+                       for i in order[:k]])
+
+
+def test_approx_top_k_exact_small_domain(tmp_path):
+    """With a small collision-free domain the sketch IS the exact
+    frequency table: top-k must match numpy counts bit-for-bit across
+    an 8-shard psum merge."""
+    import json
+
+    from citus_tpu.planner.aggregates import topk_buckets
+    cl = ct.Cluster(str(tmp_path / "topk"))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 8)")
+    # zipf-ish skew over 20 distinct values; verify the domain really
+    # is collision-free so exact equality is a fair demand
+    dom = np.arange(20, dtype=np.int64) * 7 - 31
+    assert len(np.unique(topk_buckets(np, dom))) == len(dom)
+    rng = np.random.default_rng(9)
+    v = dom[np.minimum(rng.geometric(0.25, 20_000) - 1, 19)]
+    cl.copy_from("t", columns={"k": np.arange(len(v)), "v": v})
+    got = cl.execute("SELECT approx_top_k(v, 5) FROM t").rows[0][0]
+    uniq, cnt = np.unique(v, return_counts=True)
+    order = sorted(range(len(uniq)), key=lambda i: (-int(cnt[i]),
+                                                    int(uniq[i])))
+    want = [{"value": int(uniq[i]), "count": int(cnt[i])}
+            for i in order[:5]]
+    assert json.loads(got) == want
+    cl.close()
+
+
+def test_approx_top_k_matches_host_sketch(db):
+    """200-distinct column: collisions are expected and deterministic —
+    the merged device sketch must equal the host replication exactly,
+    scalar and per-group."""
+    cl, d = db
+    got = cl.execute("SELECT approx_top_k(v, 8) FROM t").rows[0][0]
+    assert got == _topk_expected(d["v"], 8)
+    for gi, s in cl.execute("SELECT g, approx_top_k(v, 3) FROM t "
+                            "GROUP BY g ORDER BY g").rows:
+        assert s == _topk_expected(d["v"][d["g"] == gi], 3), gi
+    # backend-deterministic: cpu task executor produces the same text
+    with settings_override(executor=ExecutorSettings(
+            task_executor_backend="cpu")):
+        assert cl.execute("SELECT approx_top_k(v, 8) FROM t"
+                          ).rows[0][0] == got
+
+
+def test_approx_top_k_empty_and_validation(tmp_path):
+    from citus_tpu.errors import AnalysisError, UnsupportedFeatureError
+    cl = ct.Cluster(str(tmp_path / "topkv"))
+    cl.execute("CREATE TABLE e (k bigint NOT NULL, v bigint, s text, "
+               "f double)")
+    cl.execute("SELECT create_distributed_table('e', 'k', 2)")
+    assert cl.execute("SELECT approx_top_k(v, 4) FROM e").rows == [(None,)]
+    for bad in ("approx_top_k(v)", "approx_top_k(v, k)",
+                "approx_top_k(v, 0)", "approx_top_k(v, 65)",
+                "approx_top_k(s, 4)", "approx_top_k(f, 4)"):
+        with pytest.raises(AnalysisError):
+            cl.execute(f"SELECT {bad} FROM e")
+    with pytest.raises(UnsupportedFeatureError):
+        cl.execute("SELECT approx_top_k(DISTINCT v, 4) FROM e")
+    cl.close()

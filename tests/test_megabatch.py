@@ -226,3 +226,70 @@ def test_megabatch_gucs_round_trip(db):
                              "fallbacks"]
     cl.execute("SET citus.megabatch_window_ms = 0")
     assert cl.settings.executor.megabatch_window_ms == 0.0
+
+
+# ------------------------------------------------- adaptive auto window
+
+
+def _mb_stats(cl):
+    r = cl.execute("SELECT citus_megabatch_stats()")
+    return dict(zip(r.columns, r.rows[0]))
+
+
+def test_auto_window_beats_fixed_under_bursty_arrivals(db):
+    """SET citus.megabatch_window_ms = auto sizes the wait from the plan
+    family's inter-arrival EWMA: under a bursty storm it still
+    coalesces (occupancy > 1) but never parks queries for a whole
+    oversized fixed window, so wall time is <= the fixed configuration
+    on the same workload."""
+    cl = db
+    sql = "SELECT sum(v), count(*) FROM t WHERE k = 42"
+    K, R = 6, 4
+    cl.execute(sql)  # warm compile + device caches
+    cl.execute("SET citus.megabatch_max_size = 32")
+
+    def storm():
+        bar = threading.Barrier(K)
+
+        def run():
+            bar.wait()
+            for _ in range(R):
+                cl.execute(sql)
+        ts = [threading.Thread(target=run) for _ in range(K)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return time.monotonic() - t0
+
+    cl.execute("SET citus.megabatch_window_ms = auto")
+    s0 = _mb_stats(cl)
+    auto_wall = storm()
+    s1 = _mb_stats(cl)
+    # the bursty family coalesced under auto: batched queries
+    # outnumber batches (occupancy > 1 on average)
+    assert s1["queries"] - s0["queries"] > s1["batches"] - s0["batches"], \
+        (s0, s1)
+    # fixed oversized window: every round parks for the full window
+    # (max_size 32 means the batch never fills early)
+    cl.execute("SET citus.megabatch_window_ms = 40")
+    fixed_wall = storm()
+    assert auto_wall <= fixed_wall, (auto_wall, fixed_wall)
+
+
+def test_auto_window_sparse_family_stays_serial(db):
+    """A family arriving slower than the sparseness threshold pays no
+    window at all under auto: maybe_megabatch bows out pre-queue, so
+    megabatch counters do not move."""
+    cl = db
+    sql = "SELECT sum(v) FROM t WHERE k = 7"
+    expected = cl.execute(sql).rows
+    cl.execute("SET citus.megabatch_window_ms = auto")
+    s0 = _mb_stats(cl)
+    for _ in range(5):
+        assert cl.execute(sql).rows == expected
+        time.sleep(0.03)  # above _AUTO_SPARSE_S: the family is sparse
+    s1 = _mb_stats(cl)
+    assert s1["queries"] == s0["queries"], (s0, s1)
+    assert s1["batches"] == s0["batches"], (s0, s1)

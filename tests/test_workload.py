@@ -1,0 +1,319 @@
+"""Tenant-aware workload scheduler: fairness invariants, shedding,
+quota/isolation surface (citus_tpu/workload/).
+
+The fairness tests drive a private SharedTaskPool + TenantScheduler pair
+so global pool counters stay untouched; the SQL-surface tests go through
+a real Cluster.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.config import ExecutorSettings, Settings, WorkloadSettings
+from citus_tpu.errors import AdmissionShedError, AnalysisError, ExecutionError
+from citus_tpu.executor.admission import SharedTaskPool
+from citus_tpu.utils.clock import set_wall_clock
+from citus_tpu.workload import GLOBAL_TENANTS, TenantScheduler
+
+
+def _settings(limit, **wl):
+    return Settings(executor=ExecutorSettings(max_shared_pool_size=limit),
+                    workload=WorkloadSettings(**wl))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    GLOBAL_TENANTS.clear()
+    yield
+    GLOBAL_TENANTS.clear()
+    set_wall_clock(None)
+
+
+def _drive(sched, settings, tenant, stop, hold_s, latencies=None):
+    while not stop.is_set():
+        t0 = time.monotonic()
+        sched.acquire(settings, tenant)
+        try:
+            time.sleep(hold_s)
+        finally:
+            sched.release(tenant)
+        if latencies is not None:
+            latencies.append(time.monotonic() - t0)
+
+
+# ------------------------------------------------------------- fairness
+
+
+def test_equal_weight_tenants_get_equal_share():
+    """One tenant flooding 8 threads cannot monopolize: with equal
+    weights every tenant's share of granted slots stays >= 1/N - 10%."""
+    sched = TenantScheduler(pool=SharedTaskPool())
+    st = _settings(1)
+    stop = threading.Event()
+    threads = []
+    for i in range(8):  # the noisy tenant floods
+        threads.append(threading.Thread(
+            target=_drive, args=(sched, st, "noisy", stop, 0.001)))
+    for t in ("a", "b", "c"):  # three polite single-thread tenants
+        threads.append(threading.Thread(
+            target=_drive, args=(sched, st, t, stop, 0.001)))
+    for t in threads:
+        t.start()
+    time.sleep(0.6)
+    stop.set()
+    for t in threads:
+        t.join()
+    rows = {r[0]: r for r in sched.rows_view()}
+    total = sum(r[3] for r in rows.values())
+    assert total > 50
+    for tenant in ("noisy", "a", "b", "c"):
+        share = rows[tenant][3] / total
+        assert share >= (1 / 4) - 0.10, (tenant, share, rows)
+
+
+def test_weights_bias_share():
+    """weight 3 vs 1 under equal demand converges toward a 3:1 split."""
+    GLOBAL_TENANTS.set_quota("gold", weight=3.0)
+    GLOBAL_TENANTS.set_quota("basic", weight=1.0)
+    sched = TenantScheduler(pool=SharedTaskPool())
+    st = _settings(1)
+    stop = threading.Event()
+    threads = [threading.Thread(target=_drive,
+                                args=(sched, st, t, stop, 0.001))
+               for t in ("gold", "basic") for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)
+    stop.set()
+    for t in threads:
+        t.join()
+    rows = {r[0]: r for r in sched.rows_view()}
+    total = rows["gold"][3] + rows["basic"][3]
+    assert total > 50
+    assert rows["gold"][3] / total >= 0.60, rows
+    assert rows["basic"][3] / total >= 0.10, rows
+
+
+def test_noisy_neighbor_light_tenant_p99():
+    """A light tenant's p99 under a flooding neighbor stays within 3x
+    its isolated p99 (the headline fairness acceptance)."""
+    work_s = 0.02
+
+    def light_run(sched, st, n=15):
+        lat = []
+        for _ in range(n):
+            t0 = time.monotonic()
+            sched.acquire(st, "light")
+            try:
+                time.sleep(work_s)
+            finally:
+                sched.release("light")
+            lat.append(time.monotonic() - t0)
+        lat.sort()
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    # isolated baseline
+    sched = TenantScheduler(pool=SharedTaskPool())
+    st = _settings(1)
+    p99_isolated = light_run(sched, st)
+
+    # contended: 6 heavy threads flooding the same single slot
+    sched = TenantScheduler(pool=SharedTaskPool())
+    stop = threading.Event()
+    heavy = [threading.Thread(target=_drive,
+                              args=(sched, st, "heavy", stop, work_s))
+             for _ in range(6)]
+    for t in heavy:
+        t.start()
+    try:
+        p99_contended = light_run(sched, st)
+    finally:
+        stop.set()
+        for t in heavy:
+            t.join()
+    assert p99_contended <= 3 * p99_isolated + 0.01, \
+        (p99_isolated, p99_contended)
+
+
+def test_degenerate_single_tenant_is_fifo():
+    """No quotas, default GUCs, one tenant class: grant order is strict
+    arrival order, and timeout raises the pool's own error shape."""
+    pool = SharedTaskPool()
+    sched = TenantScheduler(pool=pool)
+    st = _settings(1)
+    sched.acquire(st, "*")
+    order = []
+    threads = []
+
+    def waiter(i):
+        sched.acquire(st, "*")
+        order.append(i)
+        time.sleep(0.005)
+        sched.release("*")
+
+    for i in range(3):
+        t = threading.Thread(target=waiter, args=(i,))
+        threads.append(t)
+        t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(r[0] == "*" and r[2] == i + 1 for r in sched.rows_view()):
+                break
+            time.sleep(0.001)
+    sched.release("*")
+    for t in threads:
+        t.join()
+    assert order == [0, 1, 2]
+    assert pool.in_use == 0
+    with sched.slot(st, "*"):
+        with pytest.raises(ExecutionError, match="max_shared_pool_size"):
+            sched.acquire(st, "other", timeout=0.05)
+
+
+# ------------------------------------------------------------- shedding
+
+
+def test_queue_depth_shed_is_fast_retryable_and_slotless():
+    pool = SharedTaskPool()
+    sched = TenantScheduler(pool=pool)
+    st = _settings(1, tenant_queue_depth=2)
+    sched.acquire(st, "a")  # slot holder
+    threads = []
+    for _ in range(2):
+        t = threading.Thread(
+            target=lambda: (sched.acquire(st, "a", timeout=10),
+                            sched.release("a")))
+        threads.append(t)
+        t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if any(r[0] == "a" and r[2] == 2 for r in sched.rows_view()):
+            break
+        time.sleep(0.001)
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionShedError) as ei:
+        sched.acquire(st, "a")
+    assert time.monotonic() - t0 < 0.1  # fast fail, never queued
+    assert ei.value.retryable is True
+    assert isinstance(ei.value, ExecutionError)
+    assert "tenant_queue_depth" in str(ei.value)
+    assert pool.in_use == 1  # a shed query never held a slot
+    sched.release("a")
+    for t in threads:
+        t.join()
+    row = {r[0]: r for r in sched.rows_view()}["a"]
+    assert row[4] == 1  # shed
+    assert pool.in_use == 0
+
+
+def test_rate_limit_token_bucket_shed_and_refill():
+    fake = [1000.0]
+    set_wall_clock(lambda: fake[0])
+    sched = TenantScheduler(pool=SharedTaskPool())
+    st = _settings(0, tenant_rate_limit_qps=2.0)  # burst capacity 2
+    for _ in range(2):
+        sched.acquire(st, "r")
+        sched.release("r")
+    with pytest.raises(AdmissionShedError, match="tenant_rate_limit_qps"):
+        sched.acquire(st, "r")
+    fake[0] += 1.0  # one second refills 2 tokens
+    sched.acquire(st, "r")
+    sched.release("r")
+    row = {r[0]: r for r in sched.rows_view()}["r"]
+    assert row[3] == 3 and row[4] == 1  # granted, shed
+
+
+def test_tenant_shed_counter_bumps():
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    before = GLOBAL_COUNTERS.snapshot().get("tenant_shed", 0)
+    sched = TenantScheduler(pool=SharedTaskPool())
+    st = _settings(0, tenant_rate_limit_qps=1.0)
+    sched.acquire(st, "x")
+    sched.release("x")
+    with pytest.raises(AdmissionShedError):
+        sched.acquire(st, "x")
+    assert GLOBAL_COUNTERS.snapshot()["tenant_shed"] == before + 1
+
+
+# ------------------------------------------------------- SQL surface
+
+
+def _make_cluster(tmp_path, nodes=2, **exec_kw):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=nodes,
+                    settings=Settings(executor=ExecutorSettings(**exec_kw)))
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute("SELECT create_distributed_table('t', 'k', 4)")
+    cl.copy_from("t", columns={"k": np.arange(2000, dtype=np.int64) % 50,
+                               "v": np.arange(2000, dtype=np.int64)})
+    return cl
+
+
+def test_quota_utilities_roundtrip(tmp_path):
+    cl = _make_cluster(tmp_path, nodes=1)
+    cl.execute("SELECT citus_add_tenant_quota('7', 2.5, 3, 10.0, 8)")
+    rows = cl.execute("SELECT citus_tenant_quotas()").rows
+    assert rows == [("7", 2.5, 3, 10.0, 8, None)]
+    assert cl.execute("SELECT citus_remove_tenant_quota('7')").rows == [(True,)]
+    assert cl.execute("SELECT citus_tenant_quotas()").rows == []
+    cl.close()
+
+
+def test_stat_tenants_live_view(tmp_path):
+    cl = _make_cluster(tmp_path, nodes=1)
+    cl.execute("SELECT count(*) FROM t WHERE k = 5")
+    cl.execute("SELECT count(*) FROM t WHERE k = 5")
+    cl.execute("SELECT sum(v) FROM t")
+    view = cl.execute("SELECT citus_stat_tenants()")
+    assert view.columns[:3] == ["tenant", "query_count", "total_time_ms"]
+    rows = {r[0]: dict(zip(view.columns, r)) for r in view.rows}
+    assert rows["5"]["query_count"] == 2
+    assert rows["5"]["granted"] >= 2
+    assert rows["5"]["p99_ms"] > 0
+    # multi-shard analytics book under the shared "*" class
+    assert rows["*"]["granted"] >= 1
+    assert rows["*"]["running"] == 0 and rows["*"]["queued"] == 0
+    cl.close()
+
+
+def test_sql_set_tenant_gucs(tmp_path):
+    cl = _make_cluster(tmp_path, nodes=1)
+    cl.execute("SET citus.tenant_default_weight = 2.0")
+    cl.execute("SET citus.tenant_queue_depth = 16")
+    cl.execute("SET citus.tenant_rate_limit_qps = 100.0")
+    assert cl.execute("SHOW citus.tenant_default_weight").rows == [("2.0",)]
+    assert cl.execute("SHOW citus.tenant_queue_depth").rows == [("16",)]
+    assert cl.execute("SHOW citus.tenant_rate_limit_qps").rows == [("100.0",)]
+    cl.close()
+
+
+def test_isolate_tenant_to_node(tmp_path):
+    cl = _make_cluster(tmp_path, nodes=2)
+    before = cl.execute("SELECT count(*), sum(v) FROM t WHERE k = 7").rows
+    nodes = cl.catalog.active_node_ids()
+    target = nodes[-1]
+    r = cl.execute(f"SELECT citus_isolate_tenant_to_node('t', 7, {target})")
+    shard_id = r.rows[0][0]
+    t = cl.catalog.table("t")
+    shard = next(s for s in t.shards if s.shard_id == shard_id)
+    assert shard.placements == [target]
+    # the isolated shard holds exactly the tenant's hash range
+    assert cl.execute("SELECT count(*), sum(v) FROM t WHERE k = 7").rows \
+        == before
+    quotas = {r[0]: r for r in cl.execute("SELECT citus_tenant_quotas()").rows}
+    assert quotas["7"][5] == target  # pinned_node recorded
+    with pytest.raises(AnalysisError):
+        cl.execute("SELECT citus_isolate_tenant_to_node('t', 7, 99)")
+    cl.close()
+
+
+def test_shed_error_surfaces_through_sql(tmp_path):
+    cl = _make_cluster(tmp_path, nodes=1)
+    cl.execute("SET citus.tenant_rate_limit_qps = 1.0")
+    cl.execute("SELECT count(*) FROM t WHERE k = 3")
+    with pytest.raises(AdmissionShedError, match="retry after backoff"):
+        cl.execute("SELECT count(*) FROM t WHERE k = 3")
+    cl.close()

@@ -230,6 +230,13 @@ CONFINED_CALLS = {
     "time.perf_counter": ("observability/trace.py",),
     # one wall clock, swappable in tests (utils/clock.py now())
     "time.time": ("utils/clock.py",),
+    # raw pool slots flow through the tenant-aware fair-share
+    # scheduler only (workload/scheduler.py); anything else acquiring
+    # directly would barge the per-tenant admission queues
+    "citus_tpu.executor.admission.GLOBAL_POOL.acquire":
+        ("workload/scheduler.py",),
+    "citus_tpu.executor.admission.GLOBAL_POOL.release":
+        ("workload/scheduler.py",),
 }
 
 #: method name -> in-package files allowed to CALL it (receiver-typed
