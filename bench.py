@@ -480,6 +480,85 @@ def bench_workload(extra: dict) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_rebalance(extra: dict) -> None:
+    """Online rebalancing (operations/shard_transfer.py): N writer
+    threads hammer the table for the whole life of a background shard
+    move; the contract is zero failed writes with the blocked-write
+    window (the locked final catch-up + metadata flip) a tiny fraction
+    of the total move time.  Reports sustained write QPS under the
+    move, blocked-write ms, and CDC catch-up rounds."""
+    import shutil
+    import tempfile
+    import threading
+
+    import citus_tpu as ct
+    from citus_tpu.config import Settings
+    from citus_tpu.testing.faults import FAULTS
+
+    writers = int(os.environ.get("BENCH_RB_WRITERS", "4"))
+    n = int(os.environ.get("BENCH_RB_ROWS", "200000"))
+    root = tempfile.mkdtemp(prefix="bench_rebalance_", dir=_HERE)
+    cl = ct.Cluster(os.path.join(root, "db"), n_nodes=2,
+                    settings=Settings(enable_change_data_capture=True))
+    try:
+        cl.execute("CREATE TABLE rb (k bigint NOT NULL, v bigint)")
+        cl.execute("SELECT create_distributed_table('rb', 'k', 4)")
+        cl.copy_from("rb", columns={"k": np.arange(n, dtype=np.int64),
+                                    "v": np.arange(n, dtype=np.int64) % 97})
+        shard = cl.catalog.table("rb").shards[0]
+        src = shard.placements[0]
+        # stretch the bulk pass so the writers demonstrably overlap it
+        FAULTS.arm("shard_move_copy", delay_s=0.3, times=1)
+        jid = cl.background_jobs.create_job("bench move")
+        cl.background_jobs.add_task(
+            jid, "move_shard", {"shard_id": shard.shard_id,
+                                "source": src, "target": 1 - src})
+        stop = threading.Event()
+        wrote, failed = [], []
+
+        def hammer(base):
+            i = 0
+            while not stop.is_set():
+                k = base + i * writers
+                try:
+                    cl.execute(f"INSERT INTO rb VALUES ({k}, {k % 97})")
+                    wrote.append(k)
+                except Exception:
+                    failed.append(k)
+                i += 1
+
+        ts = [threading.Thread(target=hammer, args=(10 * n + w,))
+              for w in range(writers)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        status = cl.background_jobs.wait_for_job(jid)
+        stop.set()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        FAULTS.disarm()
+        r = cl.execute("SELECT citus_shard_move_stats()")
+        d = [dict(zip(r.columns, row)) for row in r.rows
+             if row[0] == "move" and row[1] == shard.shard_id][-1]
+        extra["rebalance"] = {
+            "move_status": status,
+            "writer_threads": writers,
+            "writes_total": len(wrote),
+            "writes_failed": len(failed),
+            "sustained_write_qps": round(len(wrote) / wall, 1),
+            "catchup_rounds": d["catchup_rounds"],
+            "blocked_write_ms": d["blocked_write_ms"],
+            "move_total_ms": d["total_ms"],
+            "blocked_fraction": round(
+                d["blocked_write_ms"] / max(d["total_ms"], 1), 4),
+        }
+    finally:
+        FAULTS.disarm()
+        cl.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def ensure_join_data(cl: "ct.Cluster", n_orders: int) -> None:
     """orders_b: the build side of the repartition join, distributed on
     o_custkey so the l_orderkey = o_orderkey join must reshuffle."""
@@ -703,6 +782,8 @@ def main() -> None:
         bench_stat_fanout(extra)
     if os.environ.get("BENCH_WORKLOAD", "1") != "0":
         bench_workload(extra)
+    if os.environ.get("BENCH_REBALANCE", "1") != "0":
+        bench_rebalance(extra)
     if os.environ.get("BENCH_JOIN", "1") != "0":
         n_orders = N_ROWS // 4
         ensure_join_data(cl, n_orders)

@@ -1097,6 +1097,20 @@ class Catalog:
             self._next_shard_id += 1
             return sid
 
+    def flip_placement(self, table, shard, source_node: int,
+                       target_node: int) -> None:
+        """Retarget one shard placement: the metadata half of a shard
+        move.  In-memory only — the caller commits, and the commit IS
+        the move's 2PC decision record (transaction/branches.py
+        commit_metadata_flip).  Confined to operations/shard_transfer.py
+        (cituslint CONF01): a flip anywhere else would skip the final
+        catch-up under the colocation group's write lock and lose
+        writes raced onto the source."""
+        with self._lock:
+            shard.placements = [target_node if n == source_node else n
+                                for n in shard.placements]
+            table.version += 1
+
     # ---- nodes --------------------------------------------------------
     def ensure_nodes(self, count: int) -> list[int]:
         with self._lock:

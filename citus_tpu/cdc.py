@@ -209,6 +209,19 @@ class ChangeDataCapture:
                 if rec["lsn"] > from_lsn:
                     yield rec
 
+    def has_stream(self, table: str) -> bool:
+        """True when a change stream exists for the table — shard moves
+        use it to pick their catch-up lag measure (pending change
+        records when a stream exists, bytes-copied otherwise)."""
+        return os.path.exists(self._path(table))
+
+    def pending_count(self, table: str, from_lsn: int) -> int:
+        """Number of change records with lsn > from_lsn: the replication
+        lag a shard move's catch-up loop compares against
+        citus.shard_move_catchup_threshold.  Costs O(tail) via the
+        sparse index, like events()."""
+        return sum(1 for _ in self.events(table, from_lsn))
+
     def last_lsn(self, table: str) -> int:
         """Newest change lsn — tail-read, O(last records) not
         O(history).  The window grows backwards until it holds at least

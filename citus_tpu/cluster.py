@@ -760,7 +760,8 @@ class Cluster:
             r = BackgroundJobRunner(self.catalog)
             r.register("move_shard", lambda shard_id, source, target:
                        move_shard_placement(self.catalog, shard_id, source, target,
-                                            lock_manager=self.locks))
+                                            lock_manager=self.locks,
+                                            settings=self.settings))
             r.start()
             self._background_jobs = r
         return self._background_jobs

@@ -100,6 +100,13 @@ _GUCS = {
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
     "citus.shard_count": ("sharding", "shard_count", int),
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
+    # non-blocking shard moves (operations/shard_transfer.py): lag bar
+    # the catch-up loop must get under before taking the write lock,
+    # the bound on catch-up rounds, and whether the source placement
+    # drop is deferred to the cleaner or done inline after the flip
+    "citus.shard_move_catchup_threshold": ("sharding", "shard_move_catchup_threshold", int),
+    "citus.shard_move_max_catchup_rounds": ("sharding", "shard_move_max_catchup_rounds", int),
+    "citus.defer_drop_after_shard_move": ("sharding", "defer_drop_after_shard_move", "bool"),
     "citus.enable_change_data_capture": (None, "enable_change_data_capture", "bool"),
     "citus.distributed_deadlock_detection_interval": (None, "deadlock_detection_interval_s", float),
     # every settings field the code reads is SET/SHOW-reachable

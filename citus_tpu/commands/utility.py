@@ -564,9 +564,25 @@ def _citus_coordinator_nodeid(cl, name, args):
 def _citus_move_shard_placement(cl, name, args):
     from citus_tpu.operations import move_shard_placement
     move_shard_placement(cl.catalog, int(args[0]), int(args[1]),
-                         int(args[2]), lock_manager=cl.locks)
+                         int(args[2]), lock_manager=cl.locks,
+                         settings=cl.settings)
     cl._plan_cache.clear()
     return Result(columns=[name], rows=[(None,)])
+
+
+@utility("citus_shard_move_stats")
+def _citus_shard_move_stats(cl, name, args):
+    # per-move view of the non-blocking sequence (operations/
+    # shard_transfer.py MOVE_STATS): catch-up rounds run and the
+    # blocked-write window — the milliseconds writers were actually
+    # excluded — next to the total move time they'd have been blocked
+    # for under a stop-the-world copy
+    from citus_tpu.operations import MOVE_STATS
+    cols = ["op", "shard_id", "source", "target", "bytes_copied",
+            "catchup_rounds", "blocked_write_ms", "total_ms"]
+    return Result(columns=cols,
+                  rows=[tuple(r.get(c) for c in cols)
+                        for r in MOVE_STATS.rows()])
 
 
 @utility("get_rebalance_table_shards_plan")
@@ -585,7 +601,7 @@ def _rebalance_table_shards(cl, name, args):
     moves = rebalance_table_shards(
         cl.catalog, args[0] if args else None,
         strategy=str(args[1]) if len(args) > 1 else "by_disk_size",
-        lock_manager=cl.locks)
+        lock_manager=cl.locks, settings=cl.settings)
     cl._plan_cache.clear()
     return Result(columns=["rebalance_table_shards"], rows=[(len(moves),)])
 
@@ -634,7 +650,7 @@ def _citus_split_shard_by_split_points(cl, name, args):
     points = [int(a) for a in args[1:]
               if not isinstance(a, str) or a.lstrip("-").isdigit()]
     new_ids = split_shard(cl.catalog, int(args[0]), points,
-                          lock_manager=cl.locks)
+                          lock_manager=cl.locks, settings=cl.settings)
     cl._plan_cache.clear()
     return Result(columns=["new_shard_ids"], rows=[(i,) for i in new_ids])
 
@@ -654,7 +670,7 @@ def _isolate_tenant_to_new_shard(cl, name, args):
     if h < shard.hash_max:
         points.append(h)
     new_ids = split_shard(cl.catalog, shard.shard_id, points,
-                          lock_manager=cl.locks)
+                          lock_manager=cl.locks, settings=cl.settings)
     cl._plan_cache.clear()
     return Result(columns=["isolate_tenant_to_new_shard"],
                   rows=[(new_ids[1 if h - 1 >= shard.hash_min else 0],)])

@@ -181,6 +181,21 @@ class ShardingSettings:
     # Replication factor for distributed tables
     # (reference citus.shard_replication_factor).
     shard_replication_factor: int = 1
+    # Non-blocking shard moves (operations/shard_transfer.py).  The
+    # catch-up loop keeps replaying source deltas to the target while
+    # the replication lag (pending CDC records committed after the last
+    # pass started) stays above this; only below it does the move take
+    # the colocation group's EXCLUSIVE lock for the final micro
+    # catch-up + metadata flip (citus.shard_move_catchup_threshold).
+    shard_move_catchup_threshold: int = 16
+    # Bounded retries: after this many catch-up rounds the move stops
+    # chasing a hot writer and proceeds to the locked final catch-up
+    # (citus.shard_move_max_catchup_rounds).
+    shard_move_max_catchup_rounds: int = 10
+    # Keep the source placement until the next cleaner pass so readers
+    # that planned against it finish safely; False drops it inline
+    # right after the flip (citus.defer_drop_after_shard_move).
+    defer_drop_after_shard_move: bool = True
 
 
 @dataclass

@@ -631,15 +631,20 @@ class DataPlaneClient:
 
     # ---- transfer helpers (shard move) ---------------------------------
     def pull_placement(self, table: str, shard_id: int, src_node: int,
-                       endpoint: tuple, dst_dir: str) -> bool:
+                       endpoint: tuple, dst_dir: str) -> int:
         """Copy every file of a remote placement into ``dst_dir``
         (the over-the-wire half of citus_move_shard_placement's bulk
-        phase; reference: shard_transfer.c:472)."""
+        phase; reference: shard_transfer.c:472).  Returns stripe bytes
+        actually fetched this pass — a move's catch-up loop re-runs the
+        pull per round and uses the delta as its lag proxy, so stripes
+        already complete at the destination (same name AND same size:
+        stripes are immutable, but a killed earlier pass can leave a
+        short .part-promoted truncation) are skipped, not re-shipped."""
         r = self.call(endpoint, "list_placement",
                       {"table": table, "shard_id": shard_id,
                        "node": src_node})
         if not r.get("exists"):
-            return False
+            return 0
         os.makedirs(dst_dir, exist_ok=True)
         from citus_tpu.services.background_jobs import report_progress
         from citus_tpu.storage.writer import SHARD_META
@@ -647,17 +652,21 @@ class DataPlaneClient:
         sizes = {f["name"]: int(f.get("size", 0)) for f in r["files"]}
         names = sorted(sizes)
         names.sort(key=lambda n: n == SHARD_META)
+        stripe_bytes = 0
         for name in names:
             dst = os.path.join(dst_dir, name)
-            already = name.endswith(".cts") and os.path.exists(dst)
+            if name.endswith(".cts") and os.path.exists(dst) \
+                    and os.path.getsize(dst) == sizes[name]:
+                continue  # complete immutable stripe from an earlier pass
             self.fetch_file(endpoint,
                             {"table": table, "shard_id": shard_id,
                              "node": src_node, "name": name}, dst)
-            if name.endswith(".cts") and not already:
+            if name.endswith(".cts"):
                 # stripe bytes shipped feed the owning move's progress
                 # record (no-op outside a background task)
                 report_progress(add_bytes=sizes[name])
-        return True
+                stripe_bytes += sizes[name]
+        return stripe_bytes
 
     def push_placement(self, src_dir: str, table: str, shard_id: int,
                        dst_node: int, endpoint: tuple) -> None:

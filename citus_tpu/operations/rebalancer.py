@@ -110,11 +110,14 @@ def get_rebalance_plan(cat: Catalog, table_name: str | None = None,
 def rebalance_table_shards(cat: Catalog, table_name: str | None = None,
                            threshold: float = 0.1,
                            strategy: str = "by_disk_size",
-                           lock_manager=None) -> list[RebalanceMove]:
+                           lock_manager=None,
+                           settings=None) -> list[RebalanceMove]:
     """Plan + execute (reference: rebalance_table_shards / the background
-    variant citus_rebalance_start)."""
+    variant citus_rebalance_start — each move runs the non-blocking
+    catch-up sequence, so a foreground rebalance only blocks writers
+    for the per-move flip windows)."""
     moves = get_rebalance_plan(cat, table_name, threshold, strategy=strategy)
     for m in moves:
         move_shard_placement(cat, m.shard_id, m.source_node, m.target_node,
-                             lock_manager=lock_manager)
+                             lock_manager=lock_manager, settings=settings)
     return moves

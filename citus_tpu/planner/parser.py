@@ -1504,7 +1504,7 @@ class Parser:
         "citus_backend_gpid", "citus_coordinator_nodeid",
         "create_time_partitions", "drop_old_time_partitions",
         "time_partitions", "citus_stat_pool", "citus_megabatch_stats",
-        "citus_remote_stats",
+        "citus_shard_move_stats", "citus_remote_stats",
         "citus_add_tenant_quota", "citus_remove_tenant_quota",
         "citus_tenant_quotas", "citus_isolate_tenant_to_node",
         "citus_extensions",

@@ -118,6 +118,14 @@ class StatCounters:
         "tenant_shed",
         "admission_queue_depth_peak",
         "wait_admission_ms",
+        # non-blocking shard moves (operations/shard_transfer.py):
+        # catch-up rounds run across all moves, cumulative wall time the
+        # colocation group's writers were actually blocked (the final
+        # micro-catch-up + flip window only), and time the mover spent
+        # parked between catch-up rounds
+        "shard_move_catchup_rounds",
+        "shard_move_blocked_write_ms",
+        "wait_shard_move_catchup_ms",
     ]
 
     def __init__(self):
@@ -169,6 +177,9 @@ WAIT_COUNTERS = {
     # (workload/scheduler.py) — waiting for a slot grant, not holding
     # one; distinct from megabatch_wait (already admitted, coalescing)
     "admission_wait": "wait_admission_ms",
+    # a shard mover draining replication lag between catch-up passes
+    # (operations/shard_transfer.py) — the mover waits, writers do not
+    "shard_move_catchup": "wait_shard_move_catchup_ms",
 }
 
 WAIT_EVENTS = tuple(sorted(WAIT_COUNTERS))

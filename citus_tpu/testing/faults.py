@@ -31,6 +31,7 @@ class _Arm:
     times: int = -1          # -1 = unlimited
     match: Optional[str] = None
     after: int = 0           # skip the first N hits
+    kill: bool = False       # os._exit(1): SIGKILL-equivalent, no handlers
     hits: int = 0
 
 
@@ -41,10 +42,11 @@ class FaultInjector:
 
     def arm(self, point: str, *, error: Optional[BaseException] = None,
             delay_s: float = 0.0, times: int = -1,
-            match: Optional[str] = None, after: int = 0) -> None:
+            match: Optional[str] = None, after: int = 0,
+            kill: bool = False) -> None:
         with self._mu:
             self._arms[point] = _Arm(error=error, delay_s=delay_s, times=times,
-                                     match=match, after=after)
+                                     match=match, after=after, kill=kill)
 
     def disarm(self, point: Optional[str] = None) -> None:
         with self._mu:
@@ -69,8 +71,15 @@ class FaultInjector:
                 return
             delay = arm.delay_s
             error = arm.error
+            kill = arm.kill
         if delay:
             time.sleep(delay)
+        if kill:
+            # crash-recovery tests: die like SIGKILL — no except blocks,
+            # no finally clauses, no atexit — so the survivors (cleaner
+            # adoption, registry pid liveness) are what gets exercised
+            import os
+            os._exit(1)
         if error is not None:
             raise error
 

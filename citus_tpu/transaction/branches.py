@@ -197,3 +197,29 @@ def complete_cross_host_commit(cl, session, txn, gxid: str,
         raise ExecutionError(
             f"cross-host branch on {divergence[0]} diverged: "
             f"resolved={divergence[1]!r} after a committed outcome")
+
+
+# ---- metadata-flip branch (shard moves / splits) -----------------
+def commit_metadata_flip(cat, operation_id: int, mutate) -> None:
+    """The 2PC shape of a shard move's catalog flip, without a remote
+    participant: the operation registry row is the prepared branch, the
+    committed catalog document is the outcome register.
+
+    PREPARE — the registry row (operations/cleaner.py) enters the
+    ``decide`` phase with the mover's op-gated cleanup records already
+    durable: the half-moved target dirs parked ON_FAILURE, the source
+    placements parked ON_SUCCESS.  DECIDE — ``mutate()`` retargets the
+    placements in memory and ``cat.commit()`` publishes the flip in one
+    atomic document swap (cross-host through the metadata authority).
+    RESOLVE — a crash anywhere in the window follows presumed abort,
+    exactly like an in-doubt branch above: the next cleaner pass adopts
+    the dead operation's records and arbitrates each path against the
+    committed document — flip landed: targets are live placements
+    (kept) and sources are orphans (dropped); flip never landed: the
+    reverse.  Either way the cluster keeps serving from whichever side
+    the decision record names."""
+    from citus_tpu.operations.cleaner import mark_operation_phase
+    mark_operation_phase(cat, operation_id, "decide")
+    mutate()
+    cat.commit()
+    mark_operation_phase(cat, operation_id, "decided")

@@ -40,7 +40,7 @@ def isolate_tenant_to_node(cl, table: str, tenant_value, node: int) -> int:
         points.append(h)
     if points:
         new_ids = split_shard(cl.catalog, shard.shard_id, points,
-                              lock_manager=cl.locks)
+                              lock_manager=cl.locks, settings=cl.settings)
         shard_id = new_ids[1 if h - 1 >= shard.hash_min else 0]
     else:
         shard_id = shard.shard_id  # already alone in its shard
@@ -49,7 +49,8 @@ def isolate_tenant_to_node(cl, table: str, tenant_value, node: int) -> int:
     for src in list(target.placements):
         if src != node:
             move_shard_placement(cl.catalog, shard_id, src, node,
-                                 lock_manager=cl.locks)
+                                 lock_manager=cl.locks,
+                                 settings=cl.settings)
     GLOBAL_TENANTS.pin(str(tenant_value), int(node))
     cl._plan_cache.clear()
     return shard_id

@@ -334,8 +334,11 @@ def test_rebalance_progress_phases_and_bytes(tmp_path):
         assert byte_trail == sorted(byte_trail), byte_trail
         assert byte_trail and byte_trail[-1] > 0
         assert metrics_saw_task
-        # phases recorded in order (any subset, but never out of order)
-        order = {"starting": 0, "copy": 1, "flip": 2, "cleanup": 3}
+        # phases recorded in order (any subset, but never out of order);
+        # the non-blocking move added a catchup phase between copy and
+        # flip (operations/shard_transfer.py)
+        order = {"starting": 0, "copy": 1, "catchup": 2, "flip": 3,
+                 "cleanup": 4}
         ranks = [order[p] for p in seen_phases]
         assert ranks == sorted(ranks), seen_phases
         # finished task reports its final odometer + schema'd columns

@@ -244,6 +244,11 @@ CONFINED_CALLS = {
 CONFINED_METHODS = {
     # the O(placement-bytes) pull path has exactly one executor door
     "sync_placement": ("executor/batches.py",),
+    # the catalog placement flip is the move's 2PC decision — it must
+    # ride the non-blocking sequence (final catch-up under the group
+    # write lock + commit_metadata_flip); a flip anywhere else loses
+    # writes raced onto the source
+    "flip_placement": ("operations/shard_transfer.py",),
 }
 
 #: method name -> files where calling it is banned outright
