@@ -360,6 +360,105 @@ def bench_stat_fanout(extra: dict) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_wire(extra: dict) -> None:
+    """Wire-format A/B (net/data_plane.py CTFR frame vs legacy npz):
+    host decode of a ~32 MB task result (micro A/B — the zero-copy
+    frombuffer view vs the zip-container copy), then the same remote
+    fan-out queries — a distributed agg and a repartition join — on a
+    3-host loopback cluster under each citus.wire_format, with the
+    remote-RPC wait and per-codec byte counters for both runs."""
+    import shutil
+    import tempfile
+
+    import citus_tpu as ct
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    from citus_tpu.net.data_plane import (
+        _npz_bytes, _npz_load, decode_frame, encode_frame,
+    )
+
+    arrays = {f"c{i}": np.arange(1_000_000, dtype=np.int64)
+              for i in range(4)}
+    frame_blob, npz_blob = encode_frame(arrays), _npz_bytes(arrays)
+
+    def best_decode(fn, blob) -> float:
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn(blob)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    f_ms = best_decode(decode_frame, frame_blob) * 1000
+    z_ms = best_decode(_npz_load, npz_blob) * 1000
+
+    root = tempfile.mkdtemp(prefix="bench_wire_", dir=_HERE)
+    a = ct.Cluster(os.path.join(root, "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    workers = []
+    try:
+        a.register_node()
+        for name in ("b", "c"):
+            w = ct.Cluster(os.path.join(root, name), data_port=0,
+                           hosted_nodes=set(), n_nodes=0,
+                           coordinator=("127.0.0.1", a.control_port))
+            w.register_node()
+            workers.append(w)
+        a._maybe_reload_catalog(force_sync=True)
+        n = int(os.environ.get("BENCH_WIRE_ROWS", "400000"))
+        a.execute("CREATE TABLE lw (k bigint NOT NULL, v bigint)")
+        a.execute("SELECT create_distributed_table('lw', 'k', 8)")
+        a.copy_from("lw", columns={"k": np.arange(n),
+                                   "v": np.arange(n) % 97})
+        # ow distributed on g, joined on o: forces the repartition path
+        a.execute("CREATE TABLE ow (o bigint NOT NULL, g bigint)")
+        a.execute("SELECT create_distributed_table('ow', 'g', 8)")
+        a.copy_from("ow", columns={"o": np.arange(n // 4),
+                                   "g": np.arange(n // 4) % 31})
+        agg = "SELECT count(*), sum(v) FROM lw"
+        join = "SELECT count(*) FROM lw l JOIN ow o ON l.k = o.o"
+        runs = {}
+        for fmt in ("frame", "npz"):
+            a.execute(f"SET citus.wire_format = {fmt}")
+            GLOBAL_CACHE.clear()
+            a.execute(agg)
+            a.execute(join)  # plans + kernels warm under this format
+            GLOBAL_CACHE.clear()
+            c0 = GLOBAL_COUNTERS.snapshot()
+            t0 = time.perf_counter()
+            a.execute(agg)
+            agg_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            a.execute(join)
+            join_s = time.perf_counter() - t0
+            c1 = GLOBAL_COUNTERS.snapshot()
+            runs[fmt] = {
+                "agg_ms": round(agg_s * 1000, 2),
+                "repartition_join_ms": round(join_s * 1000, 2),
+                "wait_remote_rpc_ms": round(
+                    c1["wait_remote_rpc_ms"] - c0["wait_remote_rpc_ms"],
+                    2),
+                "wire_frame_bytes":
+                    c1["wire_frame_bytes"] - c0["wire_frame_bytes"],
+                "wire_npz_bytes":
+                    c1["wire_npz_bytes"] - c0["wire_npz_bytes"],
+            }
+        a.execute("SET citus.wire_format = frame")
+        extra["wire"] = {
+            "decode_frame_ms": round(f_ms, 3),
+            "decode_npz_ms": round(z_ms, 3),
+            # the acceptance bar: frame cuts host decode by >= 30 %
+            "decode_cut_fraction": round(1.0 - f_ms / max(z_ms, 1e-9), 4),
+            "frame": runs["frame"],
+            "npz": runs["npz"],
+        }
+    finally:
+        for w in workers:
+            w.close()
+        a.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_workload(extra: dict) -> None:
     """Closed-loop multi-tenant harness (workload/scheduler.py): mixed
     router + analytic traffic from N client threads in EACH of two
@@ -780,6 +879,8 @@ def main() -> None:
         bench_wait_overhead(cl, extra)
     if os.environ.get("BENCH_FANOUT", "1") != "0":
         bench_stat_fanout(extra)
+    if os.environ.get("BENCH_WIRE", "1") != "0":
+        bench_wire(extra)
     if os.environ.get("BENCH_WORKLOAD", "1") != "0":
         bench_workload(extra)
     if os.environ.get("BENCH_REBALANCE", "1") != "0":

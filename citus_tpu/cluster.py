@@ -1518,7 +1518,8 @@ class Cluster:
             shipped = 0
             for ep in eps:
                 shipped = self.catalog.remote_data.ship_batch(
-                    ep, t.name, values, validity)
+                    ep, t.name, values, validity,
+                    wire=self.settings.executor.wire_format)
             local_hosted = any(not self.catalog.is_remote_node(nd)
                                for s in t.shards for nd in s.placements)
             if local_hosted:
@@ -1570,7 +1571,8 @@ class Cluster:
             sub_v = {c: v[m] for c, v in values.items()}
             sub_m = {c: x[m] for c, x in validity.items()}
             shipped += self.catalog.remote_data.ship_batch(
-                ep, t.name, sub_v, sub_m)
+                ep, t.name, sub_v, sub_m,
+                wire=self.settings.executor.wire_format)
         if not remote_rows.any():
             return values, validity, 0
         keep = ~remote_rows

@@ -25,6 +25,14 @@ def _remote_task_mode(v) -> str:
     return s
 
 
+def _wire_format(v) -> str:
+    """citus.wire_format = frame | npz (net/data_plane.py codecs)."""
+    s = str(v).lower()
+    if s not in ("frame", "npz"):
+        raise ValueError(s)
+    return s
+
+
 def _plan_cache_mode(v) -> str:
     """citus.plan_cache_mode = auto | force_generic | force_custom
     (reference: the plancache.c GUC of the same name)."""
@@ -73,6 +81,9 @@ _GUCS = {
     "citus.executor_prefetch_depth": ("executor", "executor_prefetch_depth", int),
     "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
     "citus.remote_task_execution": ("executor", "remote_task_execution", _remote_task_mode),
+    # wire codec for execute_task results / placement bundles: the
+    # zero-copy columnar frame (default) or the legacy npz container
+    "citus.wire_format": ("executor", "wire_format", _wire_format),
     # query-family compile amortization (executor/kernel_cache.py,
     # planner/auto_param.py)
     "citus.plan_cache_mode": ("planner", "plan_cache_mode", _plan_cache_mode),

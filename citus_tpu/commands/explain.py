@@ -237,10 +237,12 @@ def _run_analyze(cl, stmt: A.Explain) -> list[str]:
             f"stalls host={pl.get('host_stalls', 0)} "
             f"device={pl.get('device_stalls', 0)}")
         if "remote_wait_ms" in pl:
+            wire = f", wire {pl['wire_format']}" \
+                if pl.get("wire_format") else ""
             lines.append(
                 f"    Remote Wait: {pl['remote_wait_ms']:.2f} ms "
                 f"(overlapped {pl['remote_overlapped_ms']:.2f} ms, "
-                f"peak in-flight {pl['remote_inflight_peak']})")
+                f"peak in-flight {pl['remote_inflight_peak']}{wire})")
     return lines
 
 def _explain_join(cl, stmt: A.Explain) -> Result:

@@ -127,6 +127,13 @@ class ExecutorSettings:
     # Upper bound on queries per coalesced dispatch; a full batch
     # dispatches before the window closes — citus.megabatch_max_size.
     megabatch_max_size: int = 32
+    # Wire codec for execute_task results and placement-sync bundles —
+    # citus.wire_format.  "frame" (default) ships the zero-copy
+    # columnar frame (versioned header + raw little-endian buffers,
+    # decoded as np.frombuffer views); "npz" keeps the legacy
+    # zip-container encode for rollback.  Decode always sniffs the
+    # frame magic, so mixed-version clusters interoperate.
+    wire_format: str = "frame"
 
 
 @dataclass

@@ -13,14 +13,17 @@ the host half of that lowering.
 Two pieces:
 
 - ``dispatch_remote_tasks`` / ``RemoteTaskDispatch``: fan out
-  ``execute_task`` RPCs on threads with a per-node in-flight window
-  (slow-start: each node starts at 1 and ramps toward
+  ``execute_task`` RPCs through the coordinator's single event loop
+  (net/event_loop.py, the WaitEventSet analog — O(1) dispatcher
+  threads no matter how wide the fan-out) with a per-node in-flight
+  window (slow-start: each node starts at 1 and ramps toward
   ``citus.max_adaptive_executor_pool_size`` on successes), each extra
   concurrent RPC taking an OPTIONAL slot from the cross-query
   ``citus.max_shared_pool_size`` pool (denied = stay at the current
   width).  The caller dispatches first, scans local placements while
-  the RPCs fly, and collects as they complete; per-task failures fall
-  back to the local pull path exactly like the serial dispatcher did.
+  the RPCs fly, and collects as they complete — result decode happens
+  on the collecting thread, not the loop; per-task failures fall back
+  to the local pull path exactly like the serial dispatcher did.
 - ``prefetch_batches`` / ``HostPrefetcher``: a bounded read-ahead
   queue fed by a background decode worker producing padded
   ``ShardBatch``es (chunk decompress, null decode, pad, stack) while
@@ -265,20 +268,26 @@ class RemoteTaskDispatch:
         self.plan = plan
         self.cap = max(1, settings.executor.max_adaptive_pool_size)
         self.shared_limit = settings.executor.max_shared_pool_size
+        self.wire = settings.executor.wire_format
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._nodes: dict[int, _NodePool] = {}
         self._is_agg = is_agg
-        self._results: dict[int, object] = {}
+        # si -> (node, meta, blob, rpc_s, rspan): raw response frames,
+        # decoded on the COLLECTING thread so the event loop never
+        # serializes decode work behind socket readiness
+        self._raw: dict[int, tuple] = {}
         self._fallback: list[int] = []
-        self._tlog: list[tuple] = []
         self._total = len(tasks)
         self._settled = 0
         self._inflight_total = 0
         self._inflight_peak = 0
         self._aborted = False
-        # trace context captured BEFORE the RPC threads start: spans
-        # they open attach to the dispatching query's tree, and the
+        # ONE dispatcher drives the whole fan-out (started lazily; a
+        # local-only query never spins it up)
+        self._loop = cat.remote_data.event_loop() if tasks else None
+        # trace context captured BEFORE the RPCs start: spans opened
+        # for them attach to the dispatching query's tree, and the
         # (trace_id, parent span_id) pair rides in each task payload
         self._trace_ctx = _trace.capture()
         self._t_start = _perf()
@@ -309,63 +318,55 @@ class RemoteTaskDispatch:
                 self._inflight_total += 1
                 self._inflight_peak = max(self._inflight_peak,
                                           self._inflight_total)
-                # lint: disable=THR02 -- workers settle through _cv (wait() blocks until inflight drains); no handle kept
-                threading.Thread(
-                    target=self._run_one, daemon=True,
-                    name=f"citus-remote-task-{si}",
-                    args=(pool, si, node, ep, task, holds_slot)).start()
+                rspan = None
+                if self._trace_ctx is not None:
+                    tr, parent = self._trace_ctx
+                    rspan = tr.open_span(
+                        "remote_task", parent.span_id,
+                        {"shard_index": int(si), "node": int(node)})
+                    # span context rides in the payload; the worker
+                    # records its half against the same trace_id and
+                    # returns it in the meta
+                    task = dict(task, trace={
+                        "trace_id": tr.trace_id,
+                        "parent_span_id": rspan.span_id})
+                t0 = _perf()
+                # done_cb runs ON the loop thread (never inline here),
+                # so holding self._mu across submit cannot deadlock
+                self._loop.submit(
+                    ep, "execute_task", task,
+                    done_cb=lambda fut, pool=pool, si=si, node=node,
+                    rspan=rspan, holds_slot=holds_slot, t0=t0:
+                    self._on_done(fut, pool, si, node, rspan,
+                                  holds_slot, t0))
                 progress = True
 
-    # ---- one RPC (worker thread) ----
-    def _run_one(self, pool, si, node, ep, task, holds_slot) -> None:
+    # ---- one RPC settled (event-loop thread) ----
+    def _on_done(self, fut, pool, si, node, rspan, holds_slot,
+                 t0) -> None:
         from citus_tpu.executor.executor import GLOBAL_COUNTERS
-        from citus_tpu.net.data_plane import _npz_load, decode_batch
-        from citus_tpu.testing.faults import FAULTS
-        payload = None
-        nbytes = 0
-        rpc_s = dec_s = 0.0
-        ok = False
-        meta = None
-        rspan = None
-        if self._trace_ctx is not None:
-            tr, parent = self._trace_ctx
-            rspan = tr.open_span("remote_task", parent.span_id,
-                                 {"shard_index": int(si), "node": int(node)})
-            # span context rides in the payload; the worker records its
-            # half against the same trace_id and returns it in the meta
-            task = dict(task, trace={"trace_id": tr.trace_id,
-                                     "parent_span_id": rspan.span_id})
-        t0 = _perf()
+        from citus_tpu.workload import GLOBAL_SCHEDULER
+        rpc_s = _perf() - t0
+        meta = blob = None
+        ok = True
         try:
-            FAULTS.hit("execute_task",
-                       f"{task['table']}:{task['shard_id']}:{node}")
-            meta, blob = self.cat.remote_data.call_binary_pooled(
-                ep, "execute_task", task)
-            rpc_s = _perf() - t0
-            t1 = _perf()
-            if self._is_agg:
-                arrays = _npz_load(blob)
-                payload = tuple(arrays[f"a__{i}"]
-                                for i in range(len(arrays)))
-            else:
-                payload = decode_batch(blob)
-            dec_s = _perf() - t1
-            nbytes = len(blob)
-            ok = True
+            meta, blob = fut.result()
         # lint: disable=SWL01 -- failure is counted below as remote_task_fallbacks; shard rescans locally
         except Exception:
             # worker dead, version skew, codec refused server-side:
             # this shard scans locally through the pull path instead
-            pass
+            ok = False
+        if blob is None:
+            ok = False  # a pushed task must return a binary frame
+        nbytes = len(blob) if blob is not None else 0
         if rspan is not None:
             tr, _parent = self._trace_ctx
+            # dec_ms lands later, from the collecting thread's decode
             rspan.set(ok=ok, bytes=int(nbytes),
-                      rpc_ms=round(rpc_s * 1000, 3),
-                      dec_ms=round(dec_s * 1000, 3))
+                      rpc_ms=round(rpc_s * 1000, 3), dec_ms=0.0)
             tr.close_span(rspan)
             if ok and isinstance(meta, dict) and meta.get("spans"):
                 tr.graft(meta["spans"], rspan)
-        from citus_tpu.workload import GLOBAL_SCHEDULER
         if holds_slot:
             GLOBAL_SCHEDULER.release_extra()
         with self._mu:
@@ -373,8 +374,7 @@ class RemoteTaskDispatch:
             self._inflight_total -= 1
             if ok:
                 pool.window = min(self.cap, pool.window + 1)  # slow start
-                self._results[si] = payload
-                self._tlog.append((si, int(node), nbytes, rpc_s, dec_s))
+                self._raw[si] = (int(node), meta, blob, rpc_s, rspan)
                 GLOBAL_COUNTERS.bump("remote_tasks_pushed")
                 GLOBAL_COUNTERS.bump("remote_task_result_bytes", nbytes)
             else:
@@ -391,8 +391,10 @@ class RemoteTaskDispatch:
     def collect(self) -> tuple[list[int], list]:
         """Wait for every in-flight task; returns (fallback shard
         indexes, successful results in shard-index order) and publishes
-        the overlap/peak stats."""
+        the overlap/peak stats.  Decode runs here, on the caller — the
+        event loop only moves bytes."""
         from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        from citus_tpu.net.data_plane import decode_batch, decode_partials
         if self._total:
             _trace.set_phase("remote-wait")
         t_enter = _perf()
@@ -406,12 +408,33 @@ class RemoteTaskDispatch:
                         self._cv.wait(0.5)
                 finally:
                     end_wait(wtok)
-            fallback = sorted(self._fallback)
-            results = [self._results[si] for si in sorted(self._results)]
-            tlog = sorted(self._tlog)
+            fallback = list(self._fallback)
+            raw = dict(self._raw)
             peak = self._inflight_peak
             t_last = self._t_last_done
         wait_s = _perf() - t_enter
+        results, tlog = [], []
+        for si in sorted(raw):
+            node, meta, blob, rpc_s, rspan = raw[si]
+            t1 = _perf()
+            try:
+                payload = decode_partials(blob) if self._is_agg \
+                    else decode_batch(blob)
+            # lint: disable=SWL01 -- counted as remote_task_fallbacks below; shard rescans locally
+            except Exception:
+                # decode failed after a successful RPC (codec skew):
+                # the shard rescans locally.  remote_tasks_pushed was
+                # already bumped when the frame landed — an accepted
+                # asymmetry for this rare path.
+                fallback.append(si)
+                GLOBAL_COUNTERS.bump("remote_task_fallbacks")
+                continue
+            dec_s = _perf() - t1
+            if rspan is not None:
+                rspan.set(dec_ms=round(dec_s * 1000, 3))
+            results.append(payload)
+            tlog.append((si, node, len(blob), rpc_s, dec_s))
+        fallback = sorted(fallback)
         # the stretch of remote in-flight time the caller spent doing
         # local work instead of blocking — the overlap win itself
         overlapped_s = max(0.0, min(t_enter, t_last) - self._t_start)
@@ -421,6 +444,7 @@ class RemoteTaskDispatch:
             pl["remote_wait_ms"] = round(wait_s * 1000, 3)
             pl["remote_overlapped_ms"] = round(overlapped_s * 1000, 3)
             pl["remote_inflight_peak"] = peak
+            pl["wire_format"] = self.wire
             GLOBAL_COUNTERS.bump_max("remote_tasks_inflight_peak", peak)
             GLOBAL_COUNTERS.bump("remote_task_wait_overlapped_ms",
                                  int(overlapped_s * 1000))
@@ -453,6 +477,12 @@ def dispatch_remote_tasks(cat, plan, settings, params=((), ())
         plan.runtime_cache["remote_tasks"] = []
         return list(local), RemoteTaskDispatch(cat, plan, settings, [], False)
     template = encode_task(plan, params)
+    if template is not None:
+        # the coordinator's citus.wire_format decides how the WORKER
+        # encodes its result; a worker that predates the key defaults
+        # to npz, and decode always sniffs the magic — either way the
+        # response decodes
+        template = dict(template, wire=settings.executor.wire_format)
     if template is None:
         GLOBAL_COUNTERS.bump("remote_task_fallbacks", len(remote))
         plan.runtime_cache["remote_tasks"] = []

@@ -42,7 +42,7 @@ from typing import Optional
 import numpy as np
 
 from citus_tpu.errors import ExecutionError
-from citus_tpu.net.data_plane import _npz_bytes
+from citus_tpu.net.data_plane import encode_partials
 from citus_tpu.observability import trace as _trace
 from citus_tpu.observability.trace import clock
 from citus_tpu.planner import bound as B
@@ -453,11 +453,14 @@ def _run_task_projection(cat, plan: PhysicalPlan, params,
 def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
     """Execute one pushed task against a locally-hosted placement.
 
-    Returns (meta, blob): for agg tasks the blob is an npz of partial
+    Returns (meta, blob): for agg tasks the blob holds the partial
     states (a__0..a__N in partial-op order, plus the trailing group-row
     counts in direct mode); for projection tasks an encode_batch of the
-    filtered scan columns.  Raising here surfaces as an RpcError at the
-    coordinator, which falls back to the pull path for this shard."""
+    filtered scan columns.  The task's "wire" key (the PUSHING
+    coordinator's citus.wire_format) picks the codec — columnar frame
+    by default, npz when absent.  Raising here surfaces as an RpcError
+    at the coordinator, which falls back to the pull path for this
+    shard."""
     from citus_tpu.executor.executor import (
         _run_partials_cpu, _run_partials_jax,
     )
@@ -491,6 +494,7 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
     plan, params = _decode_plan(t, p, si)
     settings = cluster.settings
     from citus_tpu.transaction.snapshot import snapshot_read
+    wire = str(p.get("wire", "npz"))
     n_rows = 0
     if p["kind"] == "agg":
         backend = settings.executor.task_executor_backend
@@ -503,8 +507,7 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
                 cat.data_dir, t, _attempt,
                 timeout=settings.executor.lock_timeout_s)
         with _trace.span("worker_encode"):
-            blob = _npz_bytes({f"a__{i}": np.asarray(x)
-                               for i, x in enumerate(partials)})
+            blob = encode_partials(partials, wire)
     else:
         def _attempt():
             return _run_task_projection(cat, plan, params, p.get("limit"))
@@ -514,7 +517,7 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
                 timeout=settings.executor.lock_timeout_s)
         from citus_tpu.net.data_plane import encode_batch
         with _trace.span("worker_encode"):
-            blob = encode_batch(values, validity)
+            blob = encode_batch(values, validity, wire)
     stripe_bytes = 0
     d = cat.shard_dir(name, shard_id, node)
     if os.path.isdir(d):

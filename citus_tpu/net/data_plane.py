@@ -22,6 +22,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import struct
 import threading
 from typing import Optional
 
@@ -52,7 +53,155 @@ def _npz_load(blob: bytes) -> dict:
         return {k: z[k] for k in z.files}
 
 
-def encode_batch(values: dict, validity: dict) -> bytes:
+# ---------------------------------------------------------------------
+# Zero-copy columnar wire frame ("CTFR", Arrow-style fixed-width).
+#
+# Layout (all integers little-endian):
+#   preamble   b"CTFR" + <B version> + 3 pad + <I ncols>      (12 bytes)
+#   directory  per column: <H name_len> + utf8 name
+#              + <B dtype_code> + <B ndim> + ndim * <Q dim>
+#              + <Q buffer_offset> + <Q buffer_nbytes>
+#   buffers    raw little-endian array bytes, each 64-byte aligned
+#
+# The receiver decodes with np.frombuffer views over the ONE contiguous
+# blob — no per-column copy, no zip container, no Python loop over
+# elements.  Validity bitmaps travel as ordinary bool columns under the
+# same m__ prefix encode_batch already uses.  The dtype table is an
+# allowlist: anything outside it (or any malformed offset) raises
+# FrameError — decode never falls back to pickle.
+
+FRAME_MAGIC = b"CTFR"
+FRAME_VERSION = 1
+_FRAME_ALIGN = 64
+
+_FRAME_DTYPES = {
+    0: np.dtype(np.bool_),
+    1: np.dtype(np.int8), 2: np.dtype(np.int16),
+    3: np.dtype(np.int32), 4: np.dtype(np.int64),
+    5: np.dtype(np.uint8), 6: np.dtype(np.uint16),
+    7: np.dtype(np.uint32), 8: np.dtype(np.uint64),
+    9: np.dtype(np.float32), 10: np.dtype(np.float64),
+}
+_FRAME_CODES = {dt: code for code, dt in _FRAME_DTYPES.items()}
+
+
+class FrameError(ValueError):
+    """Blob is not a well-formed columnar frame (bad magic/version/
+    dtype/offset or truncated)."""
+
+
+def encode_frame(arrays: dict) -> bytes:
+    """Encode named fixed-width arrays as one contiguous frame."""
+    cols = []
+    for name, v in arrays.items():
+        a = np.asarray(v)
+        if not a.flags.c_contiguous:
+            # (ascontiguousarray only off the fast path: it would also
+            # promote 0-d scalars to 1-d, changing partial shapes)
+            a = np.ascontiguousarray(a)
+        dt = a.dtype.newbyteorder("=")
+        if dt not in _FRAME_CODES:
+            raise FrameError(f"column {name!r}: dtype {a.dtype} has no "
+                             f"frame encoding")
+        if a.dtype.byteorder == ">":
+            a = a.astype(a.dtype.newbyteorder("<"))
+        cols.append((name.encode(), _FRAME_CODES[dt], a))
+    parts = [FRAME_MAGIC,
+             struct.pack("<BxxxI", FRAME_VERSION, len(cols))]
+    # directory size is knowable up front, so buffer offsets (absolute
+    # into the blob) are computed in the same pass
+    dir_len = sum(2 + len(nm) + 2 + 8 * a.ndim + 16
+                  for nm, _code, a in cols)
+    off = len(FRAME_MAGIC) + 8 + dir_len
+    bufs = []
+    for nm, code, a in cols:
+        pad = (-off) % _FRAME_ALIGN
+        if pad:
+            bufs.append(b"\x00" * pad)
+            off += pad
+        parts.append(struct.pack("<H", len(nm)) + nm)
+        parts.append(struct.pack("<BB", code, a.ndim))
+        for dim in a.shape:
+            parts.append(struct.pack("<Q", dim))
+        parts.append(struct.pack("<QQ", off, a.nbytes))
+        if a.nbytes:  # memoryview can't cast zero-sized shapes
+            bufs.append(memoryview(a).cast("B"))
+        off += a.nbytes
+    return b"".join(parts + bufs)
+
+
+def decode_frame(blob: bytes) -> dict:
+    """Decode a frame into {name: np.ndarray}, every array a READ-ONLY
+    np.frombuffer view into ``blob`` — zero host copy."""
+    mv = memoryview(blob)
+    try:
+        if bytes(mv[:4]) != FRAME_MAGIC:
+            raise FrameError("bad frame magic")
+        version, ncols = struct.unpack_from("<BxxxI", mv, 4)
+        if version != FRAME_VERSION:
+            raise FrameError(f"unsupported frame version {version}")
+        out = {}
+        pos = 12
+        for _ in range(ncols):
+            (name_len,) = struct.unpack_from("<H", mv, pos)
+            pos += 2
+            name = bytes(mv[pos:pos + name_len]).decode()
+            if len(name.encode()) != name_len:
+                raise FrameError("truncated column name")
+            pos += name_len
+            code, ndim = struct.unpack_from("<BB", mv, pos)
+            pos += 2
+            dt = _FRAME_DTYPES.get(code)
+            if dt is None:
+                raise FrameError(f"unknown dtype code {code}")
+            shape = struct.unpack_from("<" + "Q" * ndim, mv, pos)
+            pos += 8 * ndim
+            off, nbytes = struct.unpack_from("<QQ", mv, pos)
+            pos += 16
+            count = 1
+            for dim in shape:
+                count *= dim
+            if count * dt.itemsize != nbytes or off + nbytes > len(mv):
+                raise FrameError(f"column {name!r}: bad buffer bounds")
+            out[name] = np.frombuffer(
+                mv[off:off + nbytes], dtype=dt.newbyteorder("<")
+            ).reshape(shape)
+        return out
+    except struct.error as e:
+        raise FrameError(f"truncated frame: {e}") from e
+    except UnicodeDecodeError as e:
+        raise FrameError(f"bad column name: {e}") from e
+
+
+def _bump_wire(name: str, by: int) -> None:
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    GLOBAL_COUNTERS.bump(name, by)
+
+
+def _encode_arrays(arrays: dict, wire: str) -> bytes:
+    """Encode by the session's citus.wire_format; a dtype the frame
+    can't express (none today on the physical-encoded paths) falls back
+    to npz rather than failing the query."""
+    if wire == "frame":
+        try:
+            return encode_frame(arrays)
+        except FrameError:
+            pass
+    return _npz_bytes(arrays)
+
+
+def _decode_arrays(blob: bytes) -> dict:
+    """Magic-sniffing decode: both codecs are always accepted, so mixed
+    citus.wire_format settings across a cluster interoperate."""
+    if blob[:4] == FRAME_MAGIC:
+        _bump_wire("wire_frame_bytes", len(blob))
+        return decode_frame(blob)
+    _bump_wire("wire_npz_bytes", len(blob))
+    return _npz_load(blob)
+
+
+def encode_batch(values: dict, validity: dict,
+                 wire: str = "frame") -> bytes:
     """Batches cross the wire PHYSICAL-encoded (text already mapped to
     table-global dictionary ids by the sending coordinator), so every
     array is plain numeric — no pickle on either side."""
@@ -65,14 +214,26 @@ def encode_batch(values: dict, validity: dict) -> bytes:
         arrays[f"v__{c}"] = a
     for c, m in validity.items():
         arrays[f"m__{c}"] = np.asarray(m, dtype=bool)
-    return _npz_bytes(arrays)
+    return _encode_arrays(arrays, wire)
 
 
 def decode_batch(blob: bytes) -> tuple[dict, dict]:
-    arrays = _npz_load(blob)
+    arrays = _decode_arrays(blob)
     values = {k[3:]: v for k, v in arrays.items() if k.startswith("v__")}
     validity = {k[3:]: v for k, v in arrays.items() if k.startswith("m__")}
     return values, validity
+
+
+def encode_partials(partials, wire: str = "frame") -> bytes:
+    """Encode a worker task's partial-agg state tuple (positional
+    arrays) for the wire."""
+    return _encode_arrays(
+        {f"a__{i}": np.asarray(x) for i, x in enumerate(partials)}, wire)
+
+
+def decode_partials(blob: bytes) -> tuple:
+    arrays = _decode_arrays(blob)
+    return tuple(arrays[f"a__{i}"] for i in range(len(arrays)))
 
 
 def _bump_pool_error() -> None:
@@ -98,6 +259,7 @@ class DataPlaneServer:
         s.register("ping", lambda p: {"ok": True})
         s.register("list_placement", self._on_list_placement)
         s.register("fetch_file", self._on_fetch_file)
+        s.register("pull_placement_bundle", self._on_pull_placement_bundle)
         s.register("put_file", self._on_put_file)
         s.register("ingest_batch", self._on_ingest_batch)
         s.register("drop_placement", self._on_drop_placement)
@@ -156,6 +318,20 @@ class DataPlaneServer:
             data = fh.read(CHUNK_BYTES)
             eof = fh.read(1) == b""
         return {"eof": eof, "offset": off, "n": len(data)}, data
+
+    def _on_pull_placement_bundle(self, p: dict) -> tuple[dict, bytes]:
+        """Ship many small placement files as ONE columnar frame (each
+        file a uint8 column) — placement sync pays one RPC round-trip
+        and one zero-copy decode instead of a fetch_file per file."""
+        d = self._placement_dir(p)
+        arrays = {}
+        for name in p.get("names") or []:
+            name = str(name)
+            if "/" in name or name.startswith(".."):
+                raise ValueError(f"bad file name {name!r}")
+            with open(os.path.join(d, name), "rb") as fh:
+                arrays[name] = np.frombuffer(fh.read(), dtype=np.uint8)
+        return {"n": len(arrays)}, encode_frame(arrays)
 
     def _on_put_file(self, p: dict, blob: bytes) -> dict:
         """Receive one placement file (shard move push path).  Writes
@@ -220,7 +396,13 @@ class DataPlaneServer:
         across hosts."""
         from citus_tpu.executor.worker_tasks import run_worker_task
         from citus_tpu.observability import trace as _trace
+        from citus_tpu.testing.faults import FAULTS
         from citus_tpu.workload import GLOBAL_SCHEDULER
+        # fault point rides the per-connection SERVER thread: injected
+        # delays on concurrent tasks overlap (as real slow workers do)
+        # instead of serializing on the coordinator's dispatch loop
+        FAULTS.hit("execute_task",
+                   f"{p.get('table')}:{p.get('shard_id')}:{p.get('node')}")
         if p.get("tenant"):
             # book the pushed task against the originating tenant so
             # citus_stat_tenants() on THIS host shows who drove it
@@ -469,8 +651,40 @@ class DataPlaneClient:
         # the reference's per-worker connection pools)
         self._idle: dict[tuple, list] = {}
         self._lock = threading.Lock()
+        # the single selector-driven dispatcher for concurrent RPCs
+        # (net/event_loop.py), created on first use
+        self._loop = None
         self.stats = {"files_fetched": 0, "bytes_fetched": 0,
                       "batches_shipped": 0, "remote_syncs": 0}
+
+    def event_loop(self):
+        """The shared RpcEventLoop for this client (lazily started)."""
+        from citus_tpu.net.event_loop import RpcEventLoop
+        with self._lock:
+            if self._loop is None:
+                self._loop = RpcEventLoop(secret=self.secret)
+            return self._loop
+
+    def evict_endpoint(self, endpoint: tuple) -> None:
+        """Drop every pooled/primary/loop connection to a dead endpoint
+        so the next call reconnects instead of inheriting a socket the
+        peer already closed (the stat fan-out calls this when a node
+        stops answering get_node_stats)."""
+        key = (str(endpoint[0]), int(endpoint[1]))
+        dead = []
+        with self._lock:
+            dead.extend(self._idle.pop(key, []))
+            for k in [k for k in self._conns
+                      if (str(k[0]), int(k[1])) == key]:
+                dead.append(self._conns.pop(k))
+            loop = self._loop
+        for c in dead:
+            try:
+                c.close()
+            except Exception:
+                _bump_pool_error()
+        if loop is not None:
+            loop.evict_endpoint(key)
 
     def _conn(self, endpoint: tuple) -> RpcClient:
         with self._lock:
@@ -528,7 +742,14 @@ class DataPlaneClient:
             c = idle.pop() if idle else None
         if c is None:
             # connect outside the lock, same rationale as _conn
-            c = RpcClient(key[0], key[1], secret=self.secret)
+            try:
+                c = RpcClient(key[0], key[1], secret=self.secret)
+            except OSError:
+                # the endpoint refuses connections: its parked idle
+                # siblings are stale too — evict rather than hand a
+                # dead socket to the next caller
+                self.evict_endpoint(key)
+                raise
         try:
             out = c.call_binary(method, payload)
         except BaseException:
@@ -576,6 +797,66 @@ class DataPlaneClient:
         os.replace(tmp, dst)
         self.stats["files_fetched"] += 1
 
+    def fetch_bundle(self, endpoint: tuple, base: dict, names: list,
+                     dst_dir: str) -> None:
+        """Fetch many small placement files as ONE frame RPC through
+        the event loop (each file a uint8 column), writing them
+        atomically in the given order.  Raises RpcError/FrameError on
+        failure — callers fall back to per-file fetch_file."""
+        from citus_tpu.stats import begin_wait, end_wait
+        fut = self.event_loop().submit(
+            endpoint, "pull_placement_bundle", dict(base, names=list(names)))
+        wtok = begin_wait("remote_rpc")
+        try:
+            _r, blob = fut.result()
+        finally:
+            end_wait(wtok)
+        arrays = decode_frame(blob or b"")
+        _bump_wire("wire_frame_bytes", len(blob or b""))
+        for name in names:
+            a = arrays[name]
+            dst = os.path.join(dst_dir, name)
+            tmp = dst + ".part"
+            with open(tmp, "wb") as fh:
+                fh.write(memoryview(a))
+            os.replace(tmp, dst)
+            self.stats["bytes_fetched"] += a.nbytes
+            self.stats["files_fetched"] += 1
+
+    def _fetch_many(self, endpoint: tuple, base: dict, needed: list,
+                    dst_dir: str):
+        """Fetch (name, tag, size) triples in order: small files
+        coalesce into bundle RPCs (≤ CHUNK_BYTES of payload each),
+        large files stream chunked through fetch_file, and a failed
+        bundle (old peer, truncated frame) degrades to per-file
+        fetches.  Yields each triple once its file is on disk."""
+        i = 0
+        while i < len(needed):
+            if needed[i][2] >= CHUNK_BYTES:
+                self.fetch_file(endpoint, dict(base, name=needed[i][0]),
+                                os.path.join(dst_dir, needed[i][0]))
+                yield needed[i]
+                i += 1
+                continue
+            group, total = [], 0
+            while i < len(needed) and needed[i][2] < CHUNK_BYTES \
+                    and (not group or total + needed[i][2] <= CHUNK_BYTES):
+                group.append(needed[i])
+                total += needed[i][2]
+                i += 1
+            if len(group) > 1:
+                try:
+                    self.fetch_bundle(endpoint, base,
+                                      [n for n, _t, _z in group], dst_dir)
+                    yield from group
+                    continue
+                except (RpcError, FrameError, KeyError, OSError):
+                    _bump_pool_error()  # visible; per-file path below
+            for g in group:
+                self.fetch_file(endpoint, dict(base, name=g[0]),
+                                os.path.join(dst_dir, g[0]))
+                yield g
+
     def sync_placement(self, table: str, shard_id: int, node: int,
                        endpoint: tuple) -> Optional[str]:
         """Mirror a remote placement into the local cache; returns the
@@ -597,6 +878,7 @@ class DataPlaneClient:
         except (OSError, ValueError):
             sigs = {}
         remote_names = set()
+        needed = []
         for f in r["files"]:
             name = f["name"]
             remote_names.add(name)
@@ -606,9 +888,9 @@ class DataPlaneClient:
             if os.path.exists(local) and (
                     immutable or sigs.get(name) == sig):
                 continue
-            self.fetch_file(endpoint,
-                            {"table": table, "shard_id": shard_id,
-                             "node": node, "name": name}, local)
+            needed.append((name, sig, int(f.get("size", 0))))
+        base = {"table": table, "shard_id": shard_id, "node": node}
+        for name, sig, _sz in self._fetch_many(endpoint, base, needed, d):
             sigs[name] = sig
         # a file deleted remotely (deletes cleared, meta rewritten by
         # VACUUM/TRUNCATE) must disappear from the mirror too
@@ -652,20 +934,22 @@ class DataPlaneClient:
         sizes = {f["name"]: int(f.get("size", 0)) for f in r["files"]}
         names = sorted(sizes)
         names.sort(key=lambda n: n == SHARD_META)
-        stripe_bytes = 0
+        needed = []
         for name in names:
             dst = os.path.join(dst_dir, name)
             if name.endswith(".cts") and os.path.exists(dst) \
                     and os.path.getsize(dst) == sizes[name]:
                 continue  # complete immutable stripe from an earlier pass
-            self.fetch_file(endpoint,
-                            {"table": table, "shard_id": shard_id,
-                             "node": src_node, "name": name}, dst)
+            needed.append((name, None, sizes[name]))
+        stripe_bytes = 0
+        base = {"table": table, "shard_id": shard_id, "node": src_node}
+        for name, _tag, sz in self._fetch_many(endpoint, base, needed,
+                                               dst_dir):
             if name.endswith(".cts"):
                 # stripe bytes shipped feed the owning move's progress
                 # record (no-op outside a background task)
-                report_progress(add_bytes=sizes[name])
-                stripe_bytes += sizes[name]
+                report_progress(add_bytes=sz)
+                stripe_bytes += sz
         return stripe_bytes
 
     def push_placement(self, src_dir: str, table: str, shard_id: int,
@@ -693,11 +977,11 @@ class DataPlaneClient:
 
     # ---- write path ----------------------------------------------------
     def ship_batch(self, endpoint: tuple, table: str, values: dict,
-                   validity: dict) -> int:
+                   validity: dict, wire: str = "frame") -> int:
         """Send a physical sub-batch to the coordinator hosting its
         shards."""
         r = self.call(endpoint, "ingest_batch", {"table": table},
-                      blob=encode_batch(values, validity))
+                      blob=encode_batch(values, validity, wire))
         self.stats["batches_shipped"] += 1
         return int(r.get("inserted", 0))
 
@@ -718,8 +1002,11 @@ class DataPlaneClient:
             for idle in self._idle.values():
                 conns.extend(idle)
             self._idle.clear()
+            loop, self._loop = self._loop, None
         for c in conns:
             try:
                 c.close()
             except Exception:
                 _bump_pool_error()
+        if loop is not None:
+            loop.close()

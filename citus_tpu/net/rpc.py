@@ -42,9 +42,12 @@ def _tag(secret: Optional[bytes], data: bytes) -> str:
     return _hmac.new(secret, data, hashlib.sha256).hexdigest()
 
 
-def _send(sock: socket.socket, obj: dict,
-          secret: Optional[bytes] = None,
-          blob: Optional[bytes] = None) -> None:
+def encode_message(obj: dict, secret: Optional[bytes] = None,
+                   blob: Optional[bytes] = None) -> bytes:
+    """Serialize one request/response (JSON frame + optional binary
+    frame) to the full on-wire byte string.  Shared by the blocking
+    client/server paths here and the non-blocking event loop
+    (net/event_loop.py), so both speak the identical protocol."""
     if blob is not None:
         obj = dict(obj, bin=len(blob))
         if secret is not None:
@@ -56,9 +59,16 @@ def _send(sock: socket.socket, obj: dict,
         body = json.dumps(obj, sort_keys=True).encode()
         obj = dict(obj, hmac=_tag(secret, body))
     data = json.dumps(obj).encode()
-    sock.sendall(struct.pack(">I", len(data)) + data)
+    out = struct.pack(">I", len(data)) + data
     if blob is not None:
-        sock.sendall(struct.pack(">I", len(blob)) + blob)
+        out += struct.pack(">I", len(blob)) + blob
+    return out
+
+
+def _send(sock: socket.socket, obj: dict,
+          secret: Optional[bytes] = None,
+          blob: Optional[bytes] = None) -> None:
+    sock.sendall(encode_message(obj, secret, blob))
 
 
 def _recv_raw(sock: socket.socket) -> Optional[bytes]:
@@ -82,17 +92,26 @@ class AuthError(RuntimeError):
     """Frame failed HMAC verification."""
 
 
-def _recv(sock: socket.socket, secret: Optional[bytes] = None
-          ) -> Optional[tuple[dict, Optional[bytes]]]:
-    body = _recv_raw(sock)
-    if body is None:
-        return None
+def decode_json_frame(body: bytes, secret: Optional[bytes] = None) -> dict:
+    """Parse + authenticate one JSON frame body (hmac popped/verified).
+    Raises AuthError on a bad or missing tag.  The "bin"/"bin_sha256"
+    keys are left in place — the caller decides how to read the blob
+    frame (blocking here, incrementally in the event loop)."""
     msg = json.loads(body)
     if secret is not None:
         tag = msg.pop("hmac", None)
         canon = json.dumps(msg, sort_keys=True).encode()
         if tag is None or not _hmac.compare_digest(tag, _tag(secret, canon)):
             raise AuthError("frame failed authentication")
+    return msg
+
+
+def _recv(sock: socket.socket, secret: Optional[bytes] = None
+          ) -> Optional[tuple[dict, Optional[bytes]]]:
+    body = _recv_raw(sock)
+    if body is None:
+        return None
+    msg = decode_json_frame(body, secret)
     blob = None
     nbin = msg.pop("bin", None)
     want_digest = msg.pop("bin_sha256", None)

@@ -117,6 +117,13 @@ def cluster_node_stats(cluster, timeout_s: Optional[float] = None
         r["endpoint"] = f"{ep[0]}:{ep[1]}"
         if r.get("unreachable"):
             _counters().bump("stat_fanout_unreachable")
+            # the data-plane pools keep idle sockets to this endpoint;
+            # a peer that just stopped answering has closed them — evict
+            # so the next RPC reconnects instead of failing on a stale
+            # socket (node-death staleness fix)
+            rd = getattr(cluster.catalog, "remote_data", None)
+            if rd is not None:
+                rd.evict_endpoint(ep)
         payloads.append(r)
     return payloads
 

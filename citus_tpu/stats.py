@@ -118,6 +118,11 @@ class StatCounters:
         "tenant_shed",
         "admission_queue_depth_peak",
         "wait_admission_ms",
+        # wire format A/B (net/data_plane.py): bytes decoded from
+        # zero-copy columnar frames vs the legacy npz container, so
+        # SHOW STATS exposes which codec actually carried the traffic
+        "wire_frame_bytes",
+        "wire_npz_bytes",
         # non-blocking shard moves (operations/shard_transfer.py):
         # catch-up rounds run across all moves, cumulative wall time the
         # colocation group's writers were actually blocked (the final

@@ -42,6 +42,7 @@ def build_segment(directory: str, stripe_file: str, column: str,
     p = segment_path(directory, stripe_file, column)
     tmp = p + ".tmp"
     with open(tmp, "wb") as fh:
+        # lint: disable=CONF01 -- on-disk index segment format, not wire traffic (the wire codecs live in net/data_plane.py)
         np.savez(fh, sv=vals[order], pos=pos[order])
     os.replace(tmp, p)
 
@@ -51,6 +52,7 @@ def load_segment(directory: str, stripe_file: str, column: str):
     p = segment_path(directory, stripe_file, column)
     if not os.path.exists(p):
         return None
+    # lint: disable=CONF01 -- on-disk index segment format, not wire traffic (the wire codecs live in net/data_plane.py)
     with np.load(p) as z:
         return z["sv"], z["pos"]
 

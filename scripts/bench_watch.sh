@@ -11,13 +11,13 @@ while [ "$i" -lt "$N" ]; do
     LAST=$(tail -1 TUNNEL_PROBES.log)
     case "$LAST" in
     *"rc=0"*DEVICES*)
-        if [ ! -f .bench_fresh_r10 ]; then
+        if [ ! -f .bench_fresh_r11 ]; then
             BENCH_PROBE_TIMEOUT_S=240 BENCH_RETRY_DELAY_S=30 \
                 BENCH_JOIN=1 BENCH_SWEEP=1 \
                 python bench.py > .bench_auto.out 2> .bench_auto.err
             # a fresh (non-fallback) record carries no "stale" marker
             if [ -s .bench_auto.out ] && ! grep -q '"stale": true' .bench_auto.out; then
-                touch .bench_fresh_r10
+                touch .bench_fresh_r11
             fi
         fi
         ;;

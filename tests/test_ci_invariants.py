@@ -44,16 +44,30 @@ def test_executor_pull_path_has_single_call_site():
 
 def test_remote_dispatch_is_parallel_only():
     """Remote execute_task RPCs go through the parallel fan-out
-    (pipeline.RemoteTaskDispatch over pooled connections) — never a
-    sequential per-task call_binary loop in worker_tasks.py (CONF01's
-    banned-method + required-identifier tables)."""
+    (pipeline.RemoteTaskDispatch over the single event-loop dispatcher,
+    net/event_loop.py) — never a sequential per-task call_binary loop
+    in worker_tasks.py (CONF01's banned-method + required-identifier
+    tables)."""
     assert _lint("CONF01") == []
     from tools.cituslint.rules import BANNED_METHODS, REQUIRED_IDENTIFIERS
     assert "executor/worker_tasks.py" in BANNED_METHODS["call_binary"]
     assert "dispatch_remote_tasks" in \
         REQUIRED_IDENTIFIERS["executor/worker_tasks.py"]
-    assert "call_binary_pooled" in \
+    assert "event_loop" in \
         REQUIRED_IDENTIFIERS["executor/pipeline.py"]
+
+
+def test_wire_codecs_confined_to_data_plane():
+    """np.savez/np.load (the legacy npz wire fallback) and selector use
+    (the event-loop dispatcher) stay confined to net/ — array
+    serialization anywhere else must route through the data plane's
+    frame codec (CONF01's confined-call table)."""
+    assert _lint("CONF01") == []
+    from tools.cituslint.rules import CONFINED_CALLS
+    assert CONFINED_CALLS["numpy.savez"] == ("net/data_plane.py",)
+    assert CONFINED_CALLS["numpy.load"] == ("net/data_plane.py",)
+    assert CONFINED_CALLS["selectors.DefaultSelector"] == \
+        ("net/event_loop.py",)
 
 
 def test_jit_confined_to_kernel_cache():

@@ -10,6 +10,7 @@ per-item delay makes "overlapped" vs "serial" differ by integer
 multiples of the delay, far above scheduler noise.
 """
 
+import threading
 import time
 
 import numpy as np
@@ -143,6 +144,44 @@ def test_per_task_failure_falls_back_mid_flight(quad):
     snap = GLOBAL_COUNTERS.snapshot()
     assert snap["remote_tasks_pushed"] == 2
     assert snap["remote_task_fallbacks"] == 1
+
+
+def test_collect_creates_o1_threads_under_wide_fanout(pair, monkeypatch):
+    """64 remote tasks dispatch through ONE selector-driven event loop:
+    the coordinator's collect path creates no per-RPC thread (the old
+    citus-remote-task-* dispatch threads), and total thread creation
+    during the query stays far below the fan-out width — O(1)
+    dispatcher threads per coordinator, not O(tasks) per query."""
+    a = pair
+    n = _load(a, shards=128)
+    started = []
+    orig_start = threading.Thread.start
+
+    def record(self):
+        started.append(self.name)
+        return orig_start(self)
+
+    monkeypatch.setattr(threading.Thread, "start", record)
+    try:
+        r = a.execute("SELECT count(*), sum(v) FROM t")
+    finally:
+        monkeypatch.undo()
+    assert r.rows == [(n, 3 * n * (n - 1) // 2)]
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["remote_tasks_pushed"] == 64, snap
+    assert not [nm for nm in started if "citus-remote-task" in nm], started
+    assert sum("citus-rpc-loop" in nm for nm in started) <= 1, started
+    # the only other creations are the local scan's decode workers and
+    # the WORKER-side per-connection server handlers (unnamed
+    # "Thread-N (_serve_conn)" threads) — the latter bounded by the
+    # pool cap, not the 64-task fan-out
+    conns = [nm for nm in started
+             if nm.startswith("Thread-") or "_serve_conn" in nm]
+    others = [nm for nm in started
+              if nm not in conns and "citus-host-decode" not in nm
+              and "citus-rpc-loop" not in nm]
+    assert not others, others
+    assert len(conns) < 32, conns
 
 
 def test_prefetch_overlaps_decode_with_device(tmp_cluster):

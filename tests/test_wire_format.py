@@ -1,0 +1,247 @@
+"""Zero-copy columnar wire frames (net/data_plane.py: CTFR codec).
+
+Covers the frame codec contract from every side: round trips across the
+full dtype allowlist (with validity masks), degenerate frames (zero
+rows, zero columns), malformed-input rejection (FrameError, never
+pickle), the zero-copy guarantee (decoded arrays are frombuffer views
+into the wire blob), the ≥30 % host-decode win over the legacy npz
+container, the citus.wire_format GUC, and an end-to-end A/B on a real
+two-host cluster showing both codecs produce identical rows while
+bumping their own byte counters.
+"""
+
+import struct
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+from citus_tpu.executor.device_cache import GLOBAL_CACHE
+from citus_tpu.executor.executor import GLOBAL_COUNTERS
+from citus_tpu.net.data_plane import (
+    _FRAME_DTYPES, _npz_bytes, _npz_load, FRAME_MAGIC, FRAME_VERSION,
+    FrameError, decode_batch, decode_frame, decode_partials, encode_batch,
+    encode_frame, encode_partials,
+)
+from citus_tpu.testing.faults import FAULTS
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    """Authority + one attached worker — half of a table's shards land
+    on the remote host (same harness as test_pipeline.py)."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    a.register_node()
+    b = ct.Cluster(str(tmp_path / "b"), data_port=0, hosted_nodes=set(),
+                   coordinator=("127.0.0.1", a.control_port), n_nodes=0)
+    b.register_node()
+    a._maybe_reload_catalog(force_sync=True)
+    yield a
+    FAULTS.disarm()
+    b.close()
+    a.close()
+
+
+# ------------------------------------------------------- codec round trips
+
+def test_frame_roundtrip_all_dtypes():
+    """Every dtype in the allowlist survives encode/decode bit-exact,
+    keeping dtype and shape — fuzzed values, not hand-picked ones."""
+    rng = np.random.default_rng(7)
+    arrays = {}
+    for code, dt in _FRAME_DTYPES.items():
+        n = int(rng.integers(1, 2000))
+        if dt == np.dtype(np.bool_):
+            a = rng.integers(0, 2, n).astype(bool)
+        elif dt.kind == "f":
+            a = rng.standard_normal(n).astype(dt)
+        else:
+            info = np.iinfo(dt)
+            a = rng.integers(info.min, info.max, n,
+                             dtype=np.int64 if dt.kind == "i"
+                             else np.uint64).astype(dt)
+        arrays[f"col_{code}"] = a
+    out = decode_frame(encode_frame(arrays))
+    assert set(out) == set(arrays)
+    for k, a in arrays.items():
+        assert out[k].dtype == a.dtype.newbyteorder("<"), k
+        np.testing.assert_array_equal(out[k], a)
+
+
+def test_frame_roundtrip_multidim_zero_row_zero_col():
+    """2-D buffers, zero-row columns, and the empty (zero-column) frame
+    all round trip; buffer alignment never corrupts neighbors."""
+    arrays = {
+        "mat": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "empty": np.empty(0, dtype=np.int64),
+        "one": np.array([True]),
+        "wide": np.zeros((0, 5), dtype=np.uint16),
+        "scalar": np.array(2.5, dtype=np.float64),  # 0-d agg partial
+        "strided": np.arange(10, dtype=np.int64)[::2],
+    }
+    out = decode_frame(encode_frame(arrays))
+    for k, a in arrays.items():
+        assert out[k].shape == a.shape, k
+        np.testing.assert_array_equal(out[k], a)
+    assert decode_frame(encode_frame({})) == {}
+
+
+def test_batch_roundtrip_with_validity_masks():
+    """encode_batch keeps the v__/m__ column naming, so validity
+    bitmaps survive the wire as ordinary bool columns."""
+    values = {"k": np.arange(100, dtype=np.int64),
+              "v": np.linspace(0, 1, 100, dtype=np.float64)}
+    validity = {"v": np.arange(100) % 3 != 0}
+    v2, m2 = decode_batch(encode_batch(values, validity))
+    assert set(v2) == {"k", "v"} and set(m2) == {"v"}
+    np.testing.assert_array_equal(v2["k"], values["k"])
+    np.testing.assert_array_equal(v2["v"], values["v"])
+    np.testing.assert_array_equal(m2["v"], validity["v"])
+
+
+def test_partials_roundtrip_positional():
+    parts = (np.arange(5, dtype=np.int64),
+             np.array([1.5, 2.5]),
+             np.zeros(0, dtype=np.uint32))
+    out = decode_partials(encode_partials(parts))
+    assert len(out) == 3
+    for a, b in zip(parts, out):
+        assert b.dtype == a.dtype.newbyteorder("<")
+        np.testing.assert_array_equal(a, b)
+
+
+def test_npz_blob_still_decodes():
+    """Magic-sniffing decode accepts the legacy npz container, so a
+    frame-default coordinator interoperates with an npz peer."""
+    values = {"k": np.arange(10, dtype=np.int64)}
+    blob = encode_batch(values, {}, wire="npz")
+    assert blob[:4] != FRAME_MAGIC
+    v2, _m = decode_batch(blob)
+    np.testing.assert_array_equal(v2["k"], values["k"])
+
+
+# ------------------------------------------------------ malformed inputs
+
+def test_frame_rejects_malformed_inputs():
+    """Bad magic, bad version, truncation, out-of-bounds buffers, and
+    unknown dtype codes all raise FrameError — a clean parse error, not
+    a crash and never a pickle path."""
+    good = encode_frame({"a": np.arange(64, dtype=np.int64)})
+    with pytest.raises(FrameError, match="magic"):
+        decode_frame(b"XXXX" + good[4:])
+    with pytest.raises(FrameError, match="version"):
+        decode_frame(FRAME_MAGIC
+                     + struct.pack("<BxxxI", FRAME_VERSION + 9, 0))
+    with pytest.raises(FrameError, match="truncated"):
+        decode_frame(good[:7])  # header cut mid-preamble
+    with pytest.raises(FrameError, match="bounds"):
+        decode_frame(good[:-8])  # buffer shorter than the directory says
+    bad_dtype = (FRAME_MAGIC + struct.pack("<BxxxI", FRAME_VERSION, 1)
+                 + struct.pack("<H", 1) + b"a"
+                 + struct.pack("<BB", 200, 1) + struct.pack("<Q", 0)
+                 + struct.pack("<QQ", 28, 0))
+    with pytest.raises(FrameError, match="dtype code"):
+        decode_frame(bad_dtype)
+
+
+def test_object_dtype_never_crosses_the_wire():
+    """Non-physical (object dtype) columns are refused at encode time,
+    and a pickled npz payload is refused at decode time — neither
+    codec ever deserializes arbitrary objects."""
+    with pytest.raises(TypeError, match="physical"):
+        encode_batch({"c": np.array(["raw", "text"], dtype=object)}, {})
+    with pytest.raises(FrameError):
+        encode_frame({"c": np.array(["raw", "text"], dtype=object)})
+    pickled = _npz_bytes({"v__c": np.arange(3)})  # valid container...
+    import io
+    import zipfile
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:  # ...vs an object payload
+        with zipfile.ZipFile(io.BytesIO(pickled)) as src:
+            for n in src.namelist():
+                z.writestr(n, src.read(n))
+        obj = io.BytesIO()
+        np.save(obj, np.array([{"x": 1}], dtype=object),
+                allow_pickle=True)
+        z.writestr("v__evil.npy", obj.getvalue())
+    with pytest.raises(ValueError):
+        decode_batch(buf.getvalue())
+
+
+# ----------------------------------------------------------- zero copy
+
+def test_decode_frame_is_zero_copy():
+    """Decoded arrays are READ-ONLY frombuffer views into the one wire
+    blob — no per-column host copy."""
+    arrays = {"a": np.arange(4096, dtype=np.int64),
+              "b": np.ones(1000, dtype=np.float32)}
+    blob = encode_frame(arrays)
+    raw = np.frombuffer(blob, dtype=np.uint8)
+    out = decode_frame(blob)
+    for k in arrays:
+        assert not out[k].flags.writeable, k
+        assert np.shares_memory(out[k], raw), k
+
+
+def test_frame_decode_cuts_host_decode_time_vs_npz():
+    """The acceptance A/B: frame decode of a ~32 MB batch beats npz by
+    >= 30 % (it is typically >10x — frombuffer views vs a zip-container
+    copy). Best-of-3 each to shave scheduler noise."""
+    arrays = {f"v__c{i}": np.arange(1_000_000, dtype=np.int64)
+              for i in range(4)}
+    frame = encode_frame(arrays)
+    npz = _npz_bytes(arrays)
+
+    def best_of(fn, blob):
+        t = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(blob)
+            t.append(time.perf_counter() - t0)
+            assert len(out) == 4
+        return min(t)
+
+    t_frame = best_of(decode_frame, frame)
+    t_npz = best_of(_npz_load, npz)
+    assert t_frame <= 0.7 * t_npz, (t_frame, t_npz)
+
+
+# ------------------------------------------------------------- GUC + e2e
+
+def test_wire_format_guc_roundtrip(tmp_cluster):
+    cl = tmp_cluster
+    assert cl.execute("SHOW citus.wire_format").rows == [("frame",)]
+    cl.execute("SET citus.wire_format = npz")
+    assert cl.execute("SHOW citus.wire_format").rows == [("npz",)]
+    cl.execute("SET citus.wire_format = FRAME")  # case-insensitive
+    assert cl.execute("SHOW citus.wire_format").rows == [("frame",)]
+    with pytest.raises(CatalogError):
+        cl.execute("SET citus.wire_format = arrow2")
+
+
+def test_end_to_end_frame_vs_npz_identical_rows(pair):
+    """Same query pushed to a real remote worker under both wire
+    formats: identical rows, and each run bumps its own byte counter —
+    proof the chosen codec actually carried the task results."""
+    a = pair
+    n = 20000
+    a.execute("CREATE TABLE wt (k bigint NOT NULL, v bigint)")
+    a.execute("SELECT create_distributed_table('wt', 'k', 4)")
+    a.copy_from("wt", columns={"k": np.arange(n), "v": np.arange(n) * 3})
+    q = "SELECT count(*), sum(v) FROM wt"
+    expected = [(n, 3 * n * (n - 1) // 2)]
+    rows = {}
+    for fmt in ("frame", "npz"):
+        a.execute(f"SET citus.wire_format = {fmt}")
+        GLOBAL_CACHE.clear()
+        GLOBAL_COUNTERS.reset()
+        rows[fmt] = a.execute(q).rows
+        snap = GLOBAL_COUNTERS.snapshot()
+        assert snap["remote_tasks_pushed"] > 0, (fmt, snap)
+        assert snap[f"wire_{fmt}_bytes"] > 0, (fmt, snap)
+        other = "npz" if fmt == "frame" else "frame"
+        assert snap[f"wire_{other}_bytes"] == 0, (fmt, snap)
+    assert rows["frame"] == rows["npz"] == expected

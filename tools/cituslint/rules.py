@@ -237,6 +237,14 @@ CONFINED_CALLS = {
         ("workload/scheduler.py",),
     "citus_tpu.executor.admission.GLOBAL_POOL.release":
         ("workload/scheduler.py",),
+    # wire codecs live in the data plane: npz is the LEGACY wire
+    # fallback (zip container), and anything else serializing arrays
+    # for the network must go through the frame codec there
+    "numpy.savez": ("net/data_plane.py",),
+    "numpy.load": ("net/data_plane.py",),
+    # exactly one selector-driven dispatcher per process — ad-hoc
+    # selectors would re-grow thread-per-RPC shapes around it
+    "selectors.DefaultSelector": ("net/event_loop.py",),
 }
 
 #: method name -> in-package files allowed to CALL it (receiver-typed
@@ -263,7 +271,9 @@ BANNED_METHODS = {
 #: the dispatch invariant)
 REQUIRED_IDENTIFIERS = {
     "executor/worker_tasks.py": ("dispatch_remote_tasks",),
-    "executor/pipeline.py": ("call_binary_pooled",),
+    # the fan-out must ride the single event-loop dispatcher
+    # (cat.remote_data.event_loop()), not per-RPC threads
+    "executor/pipeline.py": ("event_loop",),
 }
 
 
