@@ -276,6 +276,36 @@ def bench_trace_overhead(cl, extra: dict) -> None:
     }
 
 
+def bench_recorder_overhead(cl, extra: dict) -> None:
+    """Flight-recorder cost (observability/flight_recorder.py): warm Q1
+    wall time with the sampler off vs ticking at interval=100ms (ring
+    append + health checks + one segment line per tick, all off the
+    query path).  The acceptance bar is < 3% overhead — the sampler
+    runs on its own thread and only takes subsystem snapshot locks."""
+    reps = int(os.environ.get("BENCH_RECORDER_REPS", "3"))
+
+    def best_of(sql: str) -> float:
+        cl.execute(sql)  # warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            cl.execute(sql)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    cl.execute("SET citus.flight_recorder_interval_ms = 0")
+    off_s = best_of(Q1)
+    cl.execute("SET citus.flight_recorder_interval_ms = 100")
+    on_s = best_of(Q1)
+    cl.execute("SET citus.flight_recorder_interval_ms = 0")
+    extra["recorder_overhead"] = {
+        "q1_recorder_off_ms": round(off_s * 1000, 2),
+        "q1_recorder_100ms_ms": round(on_s * 1000, 2),
+        "recorder_overhead_fraction": round(
+            max(0.0, on_s / off_s - 1.0), 4),
+    }
+
+
 def bench_wait_overhead(cl, extra: dict) -> None:
     """Wait-event seam cost (stats.begin_wait/end_wait): warm Q1 wall
     time with the brackets live vs stubbed to no-ops at every
@@ -875,6 +905,8 @@ def main() -> None:
         bench_megabatch(cl, extra)
     if os.environ.get("BENCH_TRACE", "1") != "0":
         bench_trace_overhead(cl, extra)
+    if os.environ.get("BENCH_RECORDER", "1") != "0":
+        bench_recorder_overhead(cl, extra)
     if os.environ.get("BENCH_WAIT", "1") != "0":
         bench_wait_overhead(cl, extra)
     if os.environ.get("BENCH_FANOUT", "1") != "0":

@@ -694,6 +694,15 @@ class Cluster:
         self.tenant_stats = TenantStats()
         self.activity = ActivityTracker()
         self.locks = LockManager()
+        # flight recorder: continuous metric history + health events
+        # (observability/flight_recorder.py); its sampler only runs
+        # while citus.flight_recorder_interval_ms > 0.  The reset hook
+        # keeps its rate baselines coherent with counter resets — and is
+        # removed in close(): GLOBAL_COUNTERS outlives this handle.
+        from citus_tpu.observability.flight_recorder import FlightRecorder
+        self.flight_recorder = FlightRecorder(self, data_dir)
+        self.counters.add_reset_hook(self.flight_recorder.reset_baselines)
+        self.flight_recorder.apply()
         # thread id -> role active in that thread's execute() call
         self._exec_roles: dict[int, Optional[str]] = {}
         # control plane (reference: metadata sync + 2PC votes over libpq;
@@ -816,6 +825,10 @@ class Cluster:
             self._background_jobs.stop()
         if self._maintenance is not None:
             self._maintenance.stop()
+        # sampler joined before the servers drop; the reset hook must
+        # not outlive this handle (GLOBAL_COUNTERS is process-global)
+        self.flight_recorder.stop()
+        self.counters.remove_reset_hook(self.flight_recorder.reset_baselines)
         if self._control is not None:
             self._control.close()
         if self._data_server is not None:

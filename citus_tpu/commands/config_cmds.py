@@ -108,6 +108,15 @@ _GUCS = {
     "citus.trace_export_dir": ("observability", "trace_export_dir", str),
     "citus.stat_fanout_timeout_s": ("observability", "stat_fanout_timeout_s",
                                     float),
+    # cluster flight recorder (observability/flight_recorder.py):
+    # background sampling cadence (ms; 0 = recorder off) and on-disk
+    # segment retention (seconds)
+    "citus.flight_recorder_interval_ms": ("observability",
+                                          "flight_recorder_interval_ms",
+                                          float),
+    "citus.flight_recorder_retention_s": ("observability",
+                                          "flight_recorder_retention_s",
+                                          float),
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
     "citus.shard_count": ("sharding", "shard_count", int),
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
@@ -216,6 +225,8 @@ def _execute_set(cl, stmt: A.SetConfig) -> Result:
     elif key == "citus.jit_cache_dir":
         from citus_tpu.executor.kernel_cache import configure_persistent_cache
         configure_persistent_cache(v)
+    elif key == "citus.flight_recorder_interval_ms":
+        cl.flight_recorder.apply()  # start/stop the sampler to match
     cl._plan_cache.clear()  # backend/knob changes invalidate plans
     return Result(columns=[], rows=[])
 

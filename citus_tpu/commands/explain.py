@@ -199,6 +199,15 @@ def _run_analyze(cl, stmt: A.Explain) -> list[str]:
     dh = c1.get("device_cache_hits", 0) - c0.get("device_cache_hits", 0)
     dm = c1.get("device_cache_misses", 0) - c0.get("device_cache_misses", 0)
     lines.append(f"  Device Cache: {dh} hit(s), {dm} miss(es)")
+    # HBM odometer for THIS statement (hits replay resident bytes,
+    # streams book the transfer) + what the cache holds resident now
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    hbm = (c1.get("device_hbm_touched_bytes", 0)
+           - c0.get("device_hbm_touched_bytes", 0))
+    mv = GLOBAL_CACHE.memory_view()
+    lines.append(f"  Memory: {hbm} HBM bytes touched, "
+                 f"cache-resident {mv['live_bytes']} bytes "
+                 f"(high water {mv['high_water_bytes']})")
     mb = (ex.attrs.get("megabatch") if ex is not None else None) \
         or r.explain.get("megabatch")
     if mb:

@@ -230,7 +230,12 @@ def _citus_stat_counters(cl, name, args):
 
 @utility("citus_stat_counters_reset")
 def _citus_stat_counters_reset(cl, name, args):
+    # one atomic observability reset: counters zero, then their reset
+    # hooks re-zero derived state (the flight recorder's rate
+    # baselines), then the per-family latency histograms drop — so no
+    # surface can difference post-reset values against pre-reset ones
     cl.counters.reset()
+    cl.query_stats.reset()
     return Result(columns=[name], rows=[(None,)])
 
 
@@ -344,6 +349,89 @@ def _citus_cluster_slow_queries(cl, name, args):
     rows.sort(key=lambda r: -(r[1] or 0))
     return Result(columns=["node", "captured_at", "duration_ms",
                            "trace_id", "phases", "query"],
+                  rows=rows)
+
+
+#: citus_health_events() severity per event kind — the row type half of
+#: the health-event contract (cituslint CNT04 checks every kind
+#: declared in observability/flight_recorder.py appears here).
+_HEALTH_SEVERITY = {
+    "p99_regression": "warning",
+    "shed_rate_spike": "warning",
+    "catchup_stall": "warning",
+    "pool_saturation": "critical",
+    "dead_node": "critical",
+    "device_probe_wedged": "warning",
+}
+
+
+@utility("citus_stat_history")
+def _citus_stat_history(cl, name, args):
+    """Time-series view over the flight recorder's ring, cluster-wide:
+    (ts, node, metric, value, rate) rows fanned in through
+    get_node_stats; dead nodes contribute nothing (degraded, not
+    fatal).  Args: metric name, optional lookback window in seconds."""
+    from citus_tpu.observability.cluster_stats import (
+        cluster_node_stats, payload_node,
+    )
+    metric = str(args[0]) if args else None
+    since_s = float(args[1]) if len(args) > 1 else None
+    from citus_tpu.utils.clock import now as wall_now
+    cutoff = None if since_s is None else wall_now() - since_s
+    rows = []
+    for p in cluster_node_stats(cl):
+        if p.get("unreachable"):
+            continue
+        node = payload_node(p)
+        for h in p.get("history", []):
+            ts, mname, value, rate = h
+            if metric is not None and mname != metric:
+                continue
+            if cutoff is not None and ts < cutoff:
+                continue
+            rows.append((ts, node, mname, value, rate))
+    rows.sort(key=lambda r: (r[0], r[1], r[2]))
+    return Result(columns=["ts", "node", "metric", "value", "rate"],
+                  rows=rows)
+
+
+@utility("citus_health_events")
+def _citus_health_events(cl, name, args):
+    """The health engine's typed event log, cluster-wide and node-
+    attributed; an unreachable node yields one dead_node row from the
+    coordinator's own recorder rather than failing the view."""
+    from citus_tpu.observability.cluster_stats import (
+        cluster_node_stats, payload_node,
+    )
+    rows = []
+    for p in cluster_node_stats(cl):
+        if p.get("unreachable"):
+            continue
+        node = payload_node(p)
+        for e in p.get("health", []):
+            ts, kind, subject, value, baseline, detail, active = e
+            rows.append((ts, node, kind,
+                         _HEALTH_SEVERITY.get(kind, "warning"), subject,
+                         value, baseline, bool(active), detail))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    return Result(columns=["ts", "node", "kind", "severity", "subject",
+                           "value", "baseline", "active", "detail"],
+                  rows=rows)
+
+
+@utility("citus_device_memory")
+def _citus_device_memory(cl, name, args):
+    """HBM ledger of the device batch cache: one row per
+    (table, tenant) attribution plus total/high-water/capacity rows —
+    the invariant surface (entry rows sum exactly to the total)."""
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    mv = GLOBAL_CACHE.memory_view()
+    rows = [("entry", table, tenant, b)
+            for table, tenant, b in mv["by_owner"]]
+    rows.append(("total", None, None, mv["live_bytes"]))
+    rows.append(("high_water", None, None, mv["high_water_bytes"]))
+    rows.append(("capacity", None, None, mv["capacity_bytes"]))
+    return Result(columns=["scope", "table", "tenant", "bytes"],
                   rows=rows)
 
 

@@ -178,6 +178,16 @@ class ObservabilitySettings:
     # get_node_stats within this window degrades to a node_unreachable
     # row instead of hanging the view — citus.stat_fanout_timeout_s.
     stat_fanout_timeout_s: float = 2.0
+    # Sampling cadence (ms) of the flight recorder's background metric
+    # history (observability/flight_recorder.py) —
+    # citus.flight_recorder_interval_ms.  0 (the default) keeps the
+    # recorder off: no sampler thread, no disk segments.
+    flight_recorder_interval_ms: float = 0.0
+    # Retention (seconds) for the recorder's rotated on-disk history
+    # segments under <data_dir>/flight_recorder/ — segments whose
+    # start timestamp ages past this are pruned at rotation time —
+    # citus.flight_recorder_retention_s.
+    flight_recorder_retention_s: float = 3600.0
 
 
 @dataclass

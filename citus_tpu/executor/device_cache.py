@@ -1,4 +1,4 @@
-"""HBM-resident column batch cache.
+"""HBM-resident column batch cache with real device-memory accounting.
 
 The reference keeps hot table blocks in PostgreSQL shared buffers; the
 TPU-native analog is keeping decompressed, padded column batches resident
@@ -10,15 +10,26 @@ version alone misses (the version is committed before the stripe flip,
 and a torn scan's put must not satisfy the seqlock retry after it).
 
 A simple byte-bounded LRU keeps us inside HBM (v5e ~16 GB); eviction
-drops the device reference and lets JAX free the buffers.
+drops the device reference and lets JAX free the buffers.  Beyond the
+hit/miss/evicted counters the cache now keeps an HBM ledger: live
+resident bytes, the high-water mark, and per-(table, tenant)
+attribution — surfaced through ``citus_device_memory()``, the
+Prometheus gauges, and EXPLAIN ANALYZE's ``Memory:`` line (which also
+folds the device_hbm_touched_bytes counter bumped on every hit and
+streaming transfer).
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Optional
 
 DEFAULT_CAPACITY_BYTES = 6 << 30
+
+#: attribution bucket for entries cached outside any tenant slot
+#: (megabatch family entries shared across tenants, warmup scans)
+SHARED_TENANT = "*"
 
 
 def _counters():
@@ -29,35 +40,93 @@ def _counters():
 class DeviceBatchCache:
     def __init__(self, capacity_bytes: int = DEFAULT_CAPACITY_BYTES):
         self.capacity = capacity_bytes
-        self._entries: OrderedDict[tuple, tuple[list, int]] = OrderedDict()
+        self._mu = threading.Lock()
+        # key -> (batches, nbytes, (table, tenant) owner)
+        self._entries: OrderedDict[tuple, tuple[list, int, tuple]] = \
+            OrderedDict()
         self._bytes = 0
+        self._high_water = 0
+        # (table, tenant) -> resident bytes attributed to that pair
+        self._attr: dict[tuple, int] = {}
         self.hits = 0
         self.misses = 0
 
+    @staticmethod
+    def _owner(key: tuple, tenant: Optional[str]) -> tuple:
+        # plan_cache_key() puts the table name at index 1 (and the mesh
+        # variant only appends suffix elements, so it holds there too)
+        table = key[1] if len(key) > 1 else "?"
+        return (str(table), tenant if tenant else SHARED_TENANT)
+
     def get(self, key: tuple) -> Optional[list]:
-        e = self._entries.get(key)
+        touched = 0
+        with self._mu:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                touched = e[1]
+            else:
+                self.misses += 1
         if e is None:
-            self.misses += 1
             _counters().bump("device_cache_misses")
             return None
-        self._entries.move_to_end(key)
-        self.hits += 1
         _counters().bump("device_cache_hits")
+        # a hit replays the resident entry's bytes through the device —
+        # the same HBM traffic EXPLAIN ANALYZE accounts for streams
+        _counters().bump("device_hbm_touched_bytes", touched)
         return e[0]
 
-    def put(self, key: tuple, batches: list, nbytes: int) -> None:
+    def put(self, key: tuple, batches: list, nbytes: int,
+            tenant: Optional[str] = None) -> None:
         if nbytes > self.capacity:
             return  # too large to cache; stream it
-        while self._bytes + nbytes > self.capacity and self._entries:
-            _, (_, old_bytes) = self._entries.popitem(last=False)
-            self._bytes -= old_bytes
-            _counters().bump("device_cache_evicted_bytes", old_bytes)
-        self._entries[key] = (batches, nbytes)
-        self._bytes += nbytes
+        evicted = 0
+        with self._mu:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+                self._attr_sub_locked(old[2], old[1])
+            while self._bytes + nbytes > self.capacity and self._entries:
+                _, (_, old_bytes, old_owner) = \
+                    self._entries.popitem(last=False)
+                self._bytes -= old_bytes
+                self._attr_sub_locked(old_owner, old_bytes)
+                evicted += old_bytes
+            owner = self._owner(key, tenant)
+            self._entries[key] = (batches, nbytes, owner)
+            self._bytes += nbytes
+            self._attr[owner] = self._attr.get(owner, 0) + nbytes
+            self._high_water = max(self._high_water, self._bytes)
+        if evicted:
+            _counters().bump("device_cache_evicted_bytes", evicted)
+
+    def _attr_sub_locked(self, owner: tuple, nbytes: int) -> None:
+        left = self._attr.get(owner, 0) - nbytes
+        if left > 0:
+            self._attr[owner] = left
+        else:
+            self._attr.pop(owner, None)
+
+    def memory_view(self) -> dict:
+        """HBM ledger snapshot: live/high-water/capacity bytes plus the
+        per-(table, tenant) attribution (sums exactly to live_bytes)."""
+        with self._mu:
+            return {
+                "live_bytes": self._bytes,
+                "high_water_bytes": self._high_water,
+                "capacity_bytes": self.capacity,
+                "entries": len(self._entries),
+                "by_owner": sorted(
+                    (table, tenant, b)
+                    for (table, tenant), b in self._attr.items()),
+            }
 
     def clear(self) -> None:
-        self._entries.clear()
-        self._bytes = 0
+        with self._mu:
+            self._entries.clear()
+            self._attr.clear()
+            self._bytes = 0  # high-water survives: it is an odometer
 
 
 GLOBAL_CACHE = DeviceBatchCache()

@@ -291,6 +291,10 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     overlaid = txn is not None and plan.bound.table.name in txn.tables
     key = plan_cache_key(plan, cat.data_dir)
     cached = None if overlaid else GLOBAL_CACHE.get(key)
+    # HBM attribution: resident entries are charged to the tenant whose
+    # query pinned them (the shared bucket for non-router scans)
+    from citus_tpu.workload import tenant_key
+    cache_tenant = tenant_key(plan.router_key)
 
     host_iter = None
     # a single-batch table cached under the non-mesh key serves from the
@@ -379,12 +383,13 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                 host_iter_m.close()
             if collect is not None and nbytes <= GLOBAL_CACHE.capacity:
                 _block_ready([r[0] for r in collect])
-                GLOBAL_CACHE.put(mkey, collect, nbytes)
+                GLOBAL_CACHE.put(mkey, collect, nbytes, tenant=cache_tenant)
             t_dev = clock()
             acc_np = [tuple(np.asarray(o) for o in out) for out in acc]
             pstats.device_s += clock() - t_dev
             pstats.h2d_bytes = nbytes
             GLOBAL_COUNTERS.bump("bytes_scanned", nbytes)
+            GLOBAL_COUNTERS.bump("device_hbm_touched_bytes", nbytes)
             pstats.publish(plan)
             return combine_partials_host(plan, acc_np)
 
@@ -494,9 +499,10 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             return combine_partials_host(plan, [_empty_partials(plan, np)])
         if collect is not None:
             _block_ready([b.cols for b in collect])
-            GLOBAL_CACHE.put(key, collect, nbytes)
+            GLOBAL_CACHE.put(key, collect, nbytes, tenant=cache_tenant)
         pstats.h2d_bytes = nbytes
         GLOBAL_COUNTERS.bump("bytes_scanned", nbytes)
+        GLOBAL_COUNTERS.bump("device_hbm_touched_bytes", nbytes)
         t_dev = clock()
         partials = tuple(np.asarray(o) for o in jax.device_get(acc_dev))
         pstats.device_s += clock() - t_dev

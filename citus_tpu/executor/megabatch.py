@@ -372,9 +372,12 @@ def _batched_agg(cat, plan, settings, group: list[_Waiter]) -> list:
                 if nbytes > GLOBAL_CACHE.capacity:
                     collect = None
         _counters().bump("bytes_scanned", nbytes)
+        _counters().bump("device_hbm_touched_bytes", nbytes)
         if collect is not None and outs:
             from citus_tpu.executor.executor import _block_ready
             _block_ready([b.cols for b in collect])
+            # family-wide entry shared across every literal variant:
+            # attributed to the shared tenant bucket, not one rider
             GLOBAL_CACHE.put(key, collect, nbytes)
     if not outs:
         empty = _empty_partials(plan, np)

@@ -41,7 +41,7 @@ def local_node_stats(cluster) -> dict:
     progress = []
     if cluster._background_jobs is not None:
         progress = cluster._background_jobs.jobs_view()["tasks"]
-    return {
+    payload = {
         "node_ids": node_ids,
         "counters": cluster.counters.snapshot(),
         "gauges": {k: int(v) for k, v in _gauges(cluster).items()},
@@ -49,6 +49,12 @@ def local_node_stats(cluster) -> dict:
         "slow_queries": [list(r) for r in GLOBAL_SLOW_LOG.rows_view()],
         "progress": progress,
     }
+    # flight-recorder time series + health events ride the same RPC
+    # (empty when the recorder is off — no payload growth by default)
+    rec = getattr(cluster, "flight_recorder", None)
+    if rec is not None:
+        payload.update(rec.export_payload())
+    return payload
 
 
 def _probe(endpoint: tuple, secret: Optional[bytes],
@@ -115,6 +121,7 @@ def cluster_node_stats(cluster, timeout_s: Optional[float] = None
             r = {"unreachable": True, "error": "probe timed out"}
         r.setdefault("node_ids", sorted(by_endpoint[ep]))
         r["endpoint"] = f"{ep[0]}:{ep[1]}"
+        rec = getattr(cluster, "flight_recorder", None)
         if r.get("unreachable"):
             _counters().bump("stat_fanout_unreachable")
             # the data-plane pools keep idle sockets to this endpoint;
@@ -124,6 +131,12 @@ def cluster_node_stats(cluster, timeout_s: Optional[float] = None
             rd = getattr(cluster.catalog, "remote_data", None)
             if rd is not None:
                 rd.evict_endpoint(ep)
+            # feed the health engine: a dead endpoint is a typed event
+            # on the coordinator's recorder (resolved when it answers)
+            if rec is not None:
+                rec.note_dead_node(r["endpoint"])
+        elif rec is not None:
+            rec.clear_dead_node(r["endpoint"])
         payloads.append(r)
     return payloads
 

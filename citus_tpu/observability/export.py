@@ -100,6 +100,20 @@ METRIC_HELP = {
         "stat fan-out probes degraded to node_unreachable",
     "live_queries": "statements currently executing",
     "slow_log_entries": "entries in the in-memory slow-query ring",
+    "pool_in_use": "admission-pool slots held right now",
+    "pool_high_water": "peak concurrent admission-pool slots",
+    "tenant_queued": "queries waiting in tenant admission queues",
+    "device_cache_high_water_bytes":
+        "peak HBM bytes the device batch cache ever held",
+    "device_hbm_touched_bytes":
+        "HBM bytes touched by device scans (hits + streams)",
+    "health_p99_regression": "active p99-regression health events",
+    "health_shed_rate_spike": "active shed-rate-spike health events",
+    "health_catchup_stall": "active catch-up-stall health events",
+    "health_pool_saturation": "active pool-saturation health events",
+    "health_dead_node": "active dead-node health events",
+    "health_device_probe_wedged":
+        "active wedged-device-probe health events",
 }
 
 
@@ -129,6 +143,19 @@ def prometheus_text(cluster) -> str:
         out.append(_help_line(name, series))
         out.append(f"# TYPE {series} gauge")
         out.append(f"{series} {gauges[name]}")
+
+    # per-tenant queue depth, labeled (the flat citus_tenant_queued
+    # gauge above is the sum; cardinality is bounded by the scheduler's
+    # own tenant table)
+    from citus_tpu.workload.scheduler import GLOBAL_SCHEDULER
+    sched_rows = GLOBAL_SCHEDULER.rows_view()
+    if sched_rows:
+        out.append("# HELP citus_tenant_queue_depth queries waiting in "
+                   "this tenant's admission queue")
+        out.append("# TYPE citus_tenant_queue_depth gauge")
+        for r in sched_rows:
+            out.append(f'citus_tenant_queue_depth'
+                       f'{{tenant="{_label(str(r[0]))}"}} {int(r[2])}')
 
     fams = _family_histograms(cluster)
     if fams:
@@ -213,17 +240,40 @@ def prometheus_cluster_text(cluster, payloads=None) -> str:
 
 
 def _gauges(cluster) -> dict:
+    from citus_tpu.executor.admission import GLOBAL_POOL
     from citus_tpu.executor.device_cache import GLOBAL_CACHE
     from citus_tpu.executor.kernel_cache import GLOBAL_KERNELS
     from citus_tpu.observability.slowlog import GLOBAL_SLOW_LOG
-    return {
+    from citus_tpu.workload.scheduler import GLOBAL_SCHEDULER
+    mv = GLOBAL_CACHE.memory_view()
+    pool = GLOBAL_POOL.stats()
+    sched = GLOBAL_SCHEDULER.rows_view()
+    g = {
         "kernel_cache_entries": len(GLOBAL_KERNELS),
         "plan_cache_entries": len(cluster._plan_cache),
-        "device_cache_bytes": int(GLOBAL_CACHE._bytes),
+        "device_cache_bytes": int(mv["live_bytes"]),
+        "device_cache_high_water_bytes": int(mv["high_water_bytes"]),
         "device_cache_capacity_bytes": int(GLOBAL_CACHE.capacity),
         "slow_log_entries": len(GLOBAL_SLOW_LOG),
         "live_queries": len(cluster.activity.rows_view()),
+        # admission saturation as proper gauges (the counters above are
+        # cumulative; operators watching a scrape need the level)
+        "pool_in_use": int(pool["in_use"]),
+        "pool_high_water": int(pool["high_water"]),
+        "tenant_queued": int(sum(r[2] for r in sched)),
     }
+    # health engine: one 0/1-or-more gauge per declared event kind
+    # (each kind spelled out — the CNT04 contract with the declaration
+    # in observability/flight_recorder.py)
+    rec = getattr(cluster, "flight_recorder", None)
+    active = rec.active_counts() if rec is not None else {}
+    g["health_p99_regression"] = active.get("p99_regression", 0)
+    g["health_shed_rate_spike"] = active.get("shed_rate_spike", 0)
+    g["health_catchup_stall"] = active.get("catchup_stall", 0)
+    g["health_pool_saturation"] = active.get("pool_saturation", 0)
+    g["health_dead_node"] = active.get("dead_node", 0)
+    g["health_device_probe_wedged"] = active.get("device_probe_wedged", 0)
+    return g
 
 
 def _family_histograms(cluster) -> list[tuple]:

@@ -1510,6 +1510,8 @@ class Parser:
         "citus_extensions",
         "citus_domains", "citus_collations", "citus_publications",
         "citus_statistics_objects",
+        "citus_stat_history", "citus_health_events",
+        "citus_device_memory",
     }
 
     def parse_select_or_utility(self) -> A.Statement:

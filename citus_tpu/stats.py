@@ -131,11 +131,24 @@ class StatCounters:
         "shard_move_catchup_rounds",
         "shard_move_blocked_write_ms",
         "wait_shard_move_catchup_ms",
+        # cluster flight recorder (observability/flight_recorder.py):
+        # sampler ticks taken, disk-segment rotations, errors swallowed
+        # by the sampler loop, and typed events the health engine raised
+        "flight_recorder_ticks",
+        "flight_recorder_rotations",
+        "flight_recorder_errors",
+        "health_events_emitted",
+        # HBM bytes a query actually touched on device: cache hits book
+        # the resident entry's size, streaming scans book the transfer
+        # (executor/device_cache.py, executor/executor.py, megabatch.py);
+        # EXPLAIN ANALYZE's Memory: line is this counter's delta
+        "device_hbm_touched_bytes",
     ]
 
     def __init__(self):
         self._mu = threading.Lock()
         self._c = {name: 0 for name in self.COUNTERS}
+        self._reset_hooks: list = []
 
     def bump(self, name: str, by: int = 1) -> None:
         with self._mu:
@@ -150,10 +163,33 @@ class StatCounters:
         with self._mu:
             return dict(self._c)
 
+    def add_reset_hook(self, fn) -> None:
+        """Register a callable invoked after every reset() — consumers
+        holding derived state keyed to counter values (the flight
+        recorder's rate baselines) re-zero with the counters instead of
+        differencing across the reset."""
+        with self._mu:
+            if fn not in self._reset_hooks:
+                self._reset_hooks.append(fn)
+
+    def remove_reset_hook(self, fn) -> None:
+        with self._mu:
+            if fn in self._reset_hooks:
+                self._reset_hooks.remove(fn)
+
     def reset(self) -> None:
         with self._mu:
             for k in self._c:
                 self._c[k] = 0
+            hooks = list(self._reset_hooks)
+        # hooks run AFTER the counter lock is released: a hook may take
+        # its own lock while a concurrent sampler holding that lock
+        # calls snapshot() — nesting here would deadlock
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:  # lint: disable=SWL01 -- one broken consumer must not block the reset for the rest
+                continue
 
 
 # ---------------------------------------------------------- wait events

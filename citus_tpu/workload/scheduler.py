@@ -63,6 +63,15 @@ def _pool():
     return GLOBAL_POOL
 
 
+def _advisory_saturated() -> bool:
+    """Health-engine advisory (observability/flight_recorder.py): True
+    while a pool_saturation event is active on this process.  A plain
+    bool read — never a lock — so checking it under scheduler._cv is
+    deadlock-free by construction."""
+    from citus_tpu.observability.flight_recorder import ADVISORY
+    return ADVISORY.pool_saturated
+
+
 class _Ticket:
     __slots__ = ("granted",)
 
@@ -198,9 +207,15 @@ class TenantScheduler:
                                       f"{st.rate_limit_qps:g} qps "
                                       "(citus.tenant_rate_limit_qps)")
             st.tokens -= 1.0
-        if st.queue_depth > 0 and len(st.queue) >= st.queue_depth:
+        depth = st.queue_depth
+        if depth > 0 and _advisory_saturated():
+            # the flight recorder's health engine flagged sustained
+            # admission-pool saturation: shed at half the configured
+            # depth so queues drain instead of timing out under load
+            depth = max(1, depth // 2)
+        if depth > 0 and len(st.queue) >= depth:
             self._shed_locked(st, f"tenant {st.name!r} admission queue full "
-                                  f"({st.queue_depth} waiters, "
+                                  f"({depth} waiters, "
                                   "citus.tenant_queue_depth)")
 
     def _shed_locked(self, st: _TenantState, why: str) -> None:

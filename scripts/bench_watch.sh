@@ -2,24 +2,50 @@
 # Probe the TPU tunnel on a ~14 min cadence all round (honest rc in
 # TUNNEL_PROBES.log); the moment a probe sees DEVICES, capture a fresh
 # bench (once), refreshing .bench_last_good.json via bench.py itself.
+#
+# Wedge detection: two consecutive rc=124 probes mean the tunnel is
+# wedged, not merely busy — append a structured {"event":"tunnel_wedged"}
+# line to TUNNEL_PROBES.log and arm the marker file the flight
+# recorder's health engine turns into a device_probe_wedged event /
+# Prometheus gauge, instead of silently replaying the stale number.
+# A later healthy probe (rc=0 with DEVICES) disarms the marker.
 cd /root/repo || exit 1
 N=${WATCH_ITERS:-45}
+WEDGE_MARKER=${CITUS_WEDGE_MARKER:-.tunnel_wedged}
 i=0
+WEDGED_STREAK=0
 while [ "$i" -lt "$N" ]; do
     i=$((i + 1))
     sh scripts/tunnel_probe.sh
     LAST=$(tail -1 TUNNEL_PROBES.log)
     case "$LAST" in
     *"rc=0"*DEVICES*)
-        if [ ! -f .bench_fresh_r11 ]; then
+        WEDGED_STREAK=0
+        rm -f "$WEDGE_MARKER"
+        if [ ! -f .bench_fresh_r12 ]; then
             BENCH_PROBE_TIMEOUT_S=240 BENCH_RETRY_DELAY_S=30 \
                 BENCH_JOIN=1 BENCH_SWEEP=1 \
                 python bench.py > .bench_auto.out 2> .bench_auto.err
             # a fresh (non-fallback) record carries no "stale" marker
             if [ -s .bench_auto.out ] && ! grep -q '"stale": true' .bench_auto.out; then
-                touch .bench_fresh_r11
+                touch .bench_fresh_r12
             fi
         fi
+        ;;
+    *"rc=124"*)
+        WEDGED_STREAK=$((WEDGED_STREAK + 1))
+        if [ "$WEDGED_STREAK" -ge 2 ]; then
+            TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+            EV="{\"event\":\"tunnel_wedged\",\"ts\":\"$TS\",\"consecutive_rc124\":$WEDGED_STREAK}"
+            echo "$EV" >> TUNNEL_PROBES.log
+            printf '%s\n' "$EV" > "$WEDGE_MARKER"
+        fi
+        ;;
+    *"rc=skip"*)
+        # bench holds the device: says nothing about tunnel health
+        ;;
+    *)
+        WEDGED_STREAK=0
         ;;
     esac
     sleep 840

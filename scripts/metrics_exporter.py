@@ -76,13 +76,32 @@ def main(argv=None) -> int:
 
     from citus_tpu import Cluster
 
-    cl = Cluster(args.data_dir)
+    # Failure semantics: dead nodes are a DEGRADED scrape, not a failed
+    # one — the render itself folds them into citus_node_unreachable
+    # markers (observability/export.py).  Only a total failure (cluster
+    # won't open, render raises, port won't bind) exits non-zero.
+    try:
+        cl = Cluster(args.data_dir)
+    except Exception as e:
+        print(f"metrics_exporter: cannot open cluster: {e}",
+              file=sys.stderr)
+        return 1
     try:
         if not args.port:
-            sys.stdout.write(render_metrics(cl, args.cluster))
+            try:
+                sys.stdout.write(render_metrics(cl, args.cluster))
+            except Exception as e:
+                print(f"metrics_exporter: render failed: {e}",
+                      file=sys.stderr)
+                return 1
             return 0
 
-        srv = make_server(cl, args.port, cluster_wide=args.cluster)
+        try:
+            srv = make_server(cl, args.port, cluster_wide=args.cluster)
+        except OSError as e:
+            print(f"metrics_exporter: cannot bind :{args.port}: {e}",
+                  file=sys.stderr)
+            return 1
         print(f"serving /metrics on :{srv.server_address[1]}",
               file=sys.stderr)
         try:

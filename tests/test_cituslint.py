@@ -308,6 +308,79 @@ def test_registered_and_entered_wait_events_quiet(tmp_path):
     assert run_lint(pkg, select={"CNT03"}) == []
 
 
+# --------------------------------------------------------------- CNT04
+
+RECORDER_FIXTURE = """
+    HEALTH_EVENT_KINDS = {
+        "p99_regression": "p99 above baseline",
+        "dead_node": "endpoint unreachable",
+    }
+"""
+
+# both kinds surfaced: export uses the health_<kind> gauge spelling,
+# utility uses the bare kind in its severity row table
+EXPORT_FIXTURE = ('def g(d, active):\n'
+                  '    d["health_p99_regression"] = active\n'
+                  '    d["health_dead_node"] = active\n')
+UTILITY_FIXTURE = ('SEV = {"p99_regression": "warning",\n'
+                   '       "dead_node": "critical"}\n')
+
+CNT04_BASE = {
+    "observability/__init__.py": "",
+    "commands/__init__.py": "",
+    "observability/flight_recorder.py": RECORDER_FIXTURE,
+}
+
+
+def test_health_kind_missing_gauge_fires(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        **CNT04_BASE,
+        "observability/export.py":
+            'def g(d, active):\n    d["health_p99_regression"] = active\n',
+        "commands/utility.py": UTILITY_FIXTURE,
+    })
+    diags = run_lint(pkg, select={"CNT04"})
+    assert len(diags) == 1
+    assert "dead_node" in diags[0].message
+    assert "Prometheus" in diags[0].message
+
+
+def test_health_kind_missing_row_type_fires(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        **CNT04_BASE,
+        "observability/export.py": EXPORT_FIXTURE,
+        "commands/utility.py": 'SEV = {"p99_regression": "warning"}\n',
+    })
+    diags = run_lint(pkg, select={"CNT04"})
+    assert len(diags) == 1
+    assert "dead_node" in diags[0].message
+    assert "citus_health_events" in diags[0].message
+
+
+def test_undeclared_emit_kind_fires(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        **CNT04_BASE,
+        "observability/export.py": EXPORT_FIXTURE,
+        "commands/utility.py": UTILITY_FIXTURE,
+        "m.py": ("def f(rec):\n"
+                 "    rec.emit_event('p99_regression', 'x', 1, 0, 'd')\n"
+                 "    rec.emit_event('made_up_alarm', 'x', 1, 0, 'd')\n"),
+    })
+    diags = run_lint(pkg, select={"CNT04"})
+    assert len(diags) == 1 and "made_up_alarm" in diags[0].message
+
+
+def test_health_kinds_fully_surfaced_quiet(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        **CNT04_BASE,
+        "observability/export.py": EXPORT_FIXTURE,
+        "commands/utility.py": UTILITY_FIXTURE,
+        "m.py": ("def f(rec):\n"
+                 "    rec.emit_event('dead_node', 'h:1', 1, 0, 'down')\n"),
+    })
+    assert run_lint(pkg, select={"CNT04"}) == []
+
+
 # ------------------------------------------------------------- GUC01
 
 CONFIG_FIXTURE = """

@@ -380,6 +380,59 @@ def test_eta_derives_from_rate():
     assert BackgroundJobRunner._eta_s(t, 110.0) is None
 
 
+# ------------------------------------------- flight-recorder fan-out
+
+
+def test_stat_history_cluster_fanout_monotonic(trio):
+    """citus_stat_history fans the recorder rings in from every node:
+    three hosts' samples merge into one monotonic-ts, node-attributed
+    time series."""
+    a, workers = trio
+    _load(a, shards=3)
+    a.execute("SELECT count(*) FROM t")  # books remote RPC wait ms
+    for node in [a] + workers:
+        node.flight_recorder.run_once()
+    a.execute("SELECT sum(v) FROM t")
+    for node in [a] + workers:
+        node.flight_recorder.run_once()
+    r = a.execute("SELECT citus_stat_history('wait_remote_rpc_ms', 60)")
+    assert r.columns == ["ts", "node", "metric", "value", "rate"]
+    rows = [dict(zip(r.columns, row)) for row in r.rows]
+    assert {d["node"] for d in rows} == {0, 1, 2}
+    assert all(d["metric"] == "wait_remote_rpc_ms" for d in rows)
+    ts = [d["ts"] for d in rows]
+    assert ts == sorted(ts)
+    # two ticks per node survived the lookback window
+    assert len(rows) == 6
+    # the coordinator actually blocked on remote RPCs between its ticks
+    coord = [d for d in rows if d["node"] == 0]
+    assert coord[-1]["value"] >= coord[0]["value"] >= 0
+
+
+def test_stat_history_degrades_and_raises_dead_node_event(trio):
+    """A dead worker degrades citus_stat_history to the live nodes'
+    rows and raises exactly one dead_node health event on the
+    coordinator's recorder (resolved when the node answers again)."""
+    a, workers = trio
+    a.execute("SET citus.stat_fanout_timeout_s = 0.5")
+    for node in [a, workers[1]]:
+        node.flight_recorder.run_once()
+    workers[0]._data_server.server.register(
+        "get_node_stats", lambda p: time.sleep(30) or {})
+    r = a.execute("SELECT citus_stat_history('queries_executed')")
+    nodes = {row[1] for row in r.rows}
+    assert 1 not in nodes and 0 in nodes and 2 in nodes
+    # repeat fan-outs dedupe into one active event
+    a.execute("SELECT citus_stat_history('queries_executed')")
+    assert a.flight_recorder.active_counts()["dead_node"] == 1
+    ev = a.execute("SELECT citus_health_events()")
+    dead = [dict(zip(ev.columns, row)) for row in ev.rows
+            if row[2] == "dead_node"]
+    assert len(dead) == 1
+    assert dead[0]["severity"] == "critical" and dead[0]["active"] is True
+    assert dead[0]["node"] == 0  # the coordinator's recorder observed it
+
+
 # ------------------------------------------------------- HTTP exporter
 
 
@@ -449,3 +502,63 @@ def test_metrics_exporter_cluster_mode_labels(pair):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_metrics_exporter_cluster_scrape_with_dead_node(pair):
+    """--cluster scrape with one dead worker is a DEGRADED success: the
+    HTTP response is still 200, the live node's series are present, and
+    the dead node surfaces as citus_node_unreachable=1 — never a scrape
+    failure."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__)
+                           .resolve().parents[1] / "scripts"))
+    try:
+        import metrics_exporter
+    finally:
+        sys.path.pop(0)
+    a, b = pair
+    a.execute("SELECT 1")
+    a.execute("SET citus.stat_fanout_timeout_s = 0.5")
+    b._data_server.server.register(
+        "get_node_stats", lambda p: time.sleep(30) or {})
+    srv = metrics_exporter.make_server(a, 0, cluster_wide=True,
+                                       host="127.0.0.1")
+    try:
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        status, _, body = _scrape(srv.server_address[1])
+        assert status == 200
+        assert 'citus_node_unreachable{node="1"} 1' in body
+        assert 'citus_node_unreachable{node="0"} 0' in body
+        assert 'citus_queries_executed_total{node="0"}' in body
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_metrics_exporter_main_exit_codes(tmp_path, monkeypatch, capsys):
+    """main() exits 0 on a working one-shot dump and non-zero only on
+    total failure (unopenable cluster / render exception)."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__)
+                           .resolve().parents[1] / "scripts"))
+    try:
+        import metrics_exporter
+    finally:
+        sys.path.pop(0)
+    assert metrics_exporter.main([str(tmp_path / "db")]) == 0
+    out = capsys.readouterr().out
+    assert "# TYPE citus_queries_executed_total counter" in out
+    # a data dir that cannot open is a total failure -> rc 1
+    bogus = tmp_path / "not_a_dir"
+    bogus.write_text("plain file, not a data dir")
+    assert metrics_exporter.main([str(bogus)]) == 1
+    assert "cannot open cluster" in capsys.readouterr().err
+    # a render-time exception in one-shot mode is a total failure too
+
+    def _boom(cl, cluster_wide):
+        raise RuntimeError("render exploded")
+
+    monkeypatch.setattr(metrics_exporter, "render_metrics", _boom)
+    assert metrics_exporter.main([str(tmp_path / "db2")]) == 1
+    assert "render failed" in capsys.readouterr().err

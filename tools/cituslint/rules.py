@@ -20,6 +20,9 @@ CNT02     every declared counter must have a bump site (dead counters
 CNT03     ``begin_wait("event")`` names must be registered in
           ``stats.WAIT_COUNTERS`` and every registered wait event must
           have a begin_wait site (both directions)
+CNT04     every health-event kind in ``HEALTH_EVENT_KINDS`` must have a
+          Prometheus gauge export and a ``citus_health_events()`` row
+          type; ``emit_event("kind")`` literals must be declared
 GUC01     ``settings.<section>.<field>`` reads must resolve to a
           declared Settings field
 GUC02     every settings field the code reads must be SET/SHOW-covered
@@ -257,6 +260,10 @@ CONFINED_METHODS = {
     # write lock + commit_metadata_flip); a flip anywhere else loses
     # writes raced onto the source
     "flip_placement": ("operations/shard_transfer.py",),
+    # flight-recorder segment writes are the recorder's only disk
+    # side-effect — confining the write door keeps retention/rotation
+    # accounting honest (no second writer aging the segments)
+    "append_segment_line": ("observability/flight_recorder.py",),
 }
 
 #: method name -> files where calling it is banned outright
@@ -595,6 +602,92 @@ class WaitEventRule(Rule):
                 f"site enters it")
 
 
+def _health_kinds_decl(pkg: PackageIndex):
+    """(kind names, (lineno, end_lineno), module) of the module-level
+    ``HEALTH_EVENT_KINDS`` dict in <pkg>/observability/flight_recorder.py;
+    (set(), None, None) when absent."""
+
+    def build():
+        mod = pkg.by_rel.get("observability/flight_recorder.py")
+        if mod is None:
+            return (set(), None, None)
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name)
+                    and t.id == "HEALTH_EVENT_KINDS"
+                    for t in stmt.targets) \
+                    and isinstance(stmt.value, ast.Dict):
+                keys = {k.value for k in stmt.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+                return (keys, (stmt.lineno, stmt.end_lineno), mod)
+        return (set(), None, None)
+
+    return pkg.cached("health_kinds_decl", build)
+
+
+def _module_strings(mod: ModuleIndex) -> set:
+    """All string constants appearing anywhere in a module."""
+    return {n.value for n in ast.walk(mod.tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+class HealthEventRule(Rule):
+    """Cross-consistency for the health-event seam: every kind declared
+    in ``flight_recorder.HEALTH_EVENT_KINDS`` must surface BOTH as a
+    Prometheus gauge in ``observability/export.py`` (the ``health_<kind>``
+    literal) and as a ``citus_health_events()`` row type in
+    ``commands/utility.py`` (the severity table) — an alert kind that
+    exists in only one surface is invisible to half the operators.  And
+    every literal ``emit_event("kind")`` must name a declared kind (a
+    typo'd kind raises at runtime on the sampler thread, where nobody
+    is watching)."""
+
+    id = "CNT04"
+    name = "health-event kinds exported"
+
+    #: kind must appear (bare or ``health_``-prefixed) in each of these
+    SURFACES = (
+        ("observability/export.py", "Prometheus gauge export"),
+        ("commands/utility.py", "citus_health_events() row type"),
+    )
+
+    def check_package(self, pkg):
+        kinds, span, decl_mod = _health_kinds_decl(pkg)
+        if decl_mod is None or not kinds:
+            return
+        for rel, what in self.SURFACES:
+            mod = pkg.by_rel.get(rel)
+            if mod is None:
+                continue
+            strings = _module_strings(mod)
+            for kind in sorted(kinds):
+                if kind not in strings \
+                        and f"health_{kind}" not in strings:
+                    yield self.diag(
+                        decl_mod, span[0],
+                        f"health-event kind {kind!r} has no {what} "
+                        f"in {rel}")
+        for mod in pkg.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if fname not in ("emit_event", "_emit_locked") \
+                        or not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and arg.value not in kinds:
+                    yield self.diag(
+                        mod, node.lineno,
+                        f"emit of undeclared health-event kind "
+                        f"{arg.value!r} (not a HEALTH_EVENT_KINDS key)")
+
+
 # -------------------------------------------------------------- GUC01/02
 
 
@@ -768,6 +861,7 @@ ALL_RULES = [
     CounterNameRule,
     DeadCounterRule,
     WaitEventRule,
+    HealthEventRule,
     SettingsFieldRule,
     TodoMarkerRule,
 ]
