@@ -609,6 +609,122 @@ def bench_workload(extra: dict) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_rollup(extra: dict) -> None:
+    """Continuous-aggregation A/B (rollup/): a dashboard closed loop
+    runs against a wide event table while writer threads keep heavy
+    ingest flowing and the background refresh loop folds CDC deltas.
+    The A arm re-scans raw events (citus.enable_rollup_routing = off);
+    the B arm serves the same query from the rollup table.  Reports
+    QPS + p99 per arm, the steady-state refresh lag sampled during the
+    run, and how long the lag takes to converge once ingest stops."""
+    import shutil
+    import tempfile
+    import threading
+
+    import citus_tpu as ct
+    from citus_tpu.config import Settings
+
+    n = int(os.environ.get("BENCH_ROLLUP_ROWS", "300000"))
+    seconds = float(os.environ.get("BENCH_ROLLUP_SECONDS", "5"))
+    batch = int(os.environ.get("BENCH_ROLLUP_INGEST_BATCH", "2000"))
+    tenants = 16
+    root = tempfile.mkdtemp(prefix="bench_rollup_", dir=_HERE)
+    dash_q = ("SELECT tid, count(*), sum(v), "
+              "approx_count_distinct(kind), "
+              "approx_percentile(0.5) WITHIN GROUP (ORDER BY v) "
+              "FROM ev GROUP BY tid")
+
+    def make_batch(rng, rows):
+        return {
+            "tid": rng.integers(0, tenants, rows).astype(np.int64),
+            "kind": np.array([f"k{int(x)}" for x in
+                              rng.integers(0, 64, rows)], object),
+            "v": rng.uniform(1.0, 100.0, rows),
+            "code": rng.integers(0, 32, rows).astype(np.int64),
+        }
+
+    cl = ct.Cluster(os.path.join(root, "db"),
+                    settings=Settings(enable_change_data_capture=True))
+    try:
+        cl.execute("CREATE TABLE ev (tid bigint NOT NULL, kind text, "
+                   "v double, code bigint)")
+        cl.execute("SELECT create_distributed_table('ev', 'tid', 8)")
+        rng = np.random.default_rng(0)
+        done = 0
+        while done < n:
+            m = min(200_000, n - done)
+            cl.copy_from("ev", columns=make_batch(rng, m))
+            done += m
+        cl.execute("SELECT citus_create_rollup('ev_r', 'ev', 'tid', "
+                   "'count(*), sum(v), approx_count_distinct(kind), "
+                   "approx_percentile(v), approx_top_k(code)')")
+        cl.execute("SET citus.rollup_refresh_interval_ms = 100")
+
+        stop = threading.Event()
+        ingested = [0]
+
+        def pound():
+            wrng = np.random.default_rng(1)
+            while not stop.is_set():
+                cl.copy_from("ev", columns=make_batch(wrng, batch))
+                ingested[0] += batch
+
+        def arm(route_on):
+            cl.execute("SET citus.enable_rollup_routing = "
+                       + ("on" if route_on else "off"))
+            cl.execute(dash_q)  # warm compile outside the window
+            lats, lags = [], []
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                cl.execute(dash_q)
+                lats.append(time.perf_counter() - t0)
+                if route_on and len(lats) % 10 == 0:
+                    lags.append(
+                        cl.execute("SELECT citus_rollups()").rows[0][6])
+            return {
+                "queries": len(lats),
+                "qps": round(len(lats) / seconds, 1),
+                "p50_ms": round(float(np.percentile(lats, 50)) * 1000, 2),
+                "p99_ms": round(float(np.percentile(lats, 99)) * 1000, 2),
+            }, lags
+
+        th = threading.Thread(target=pound)
+        th.start()
+        try:
+            raw, _ = arm(route_on=False)
+            rolled, lags = arm(route_on=True)
+        finally:
+            stop.set()
+            th.join()
+        # lag convergence: once ingest stops the watermark must reach
+        # the CDC head and stay there
+        t0 = time.monotonic()
+        converged = None
+        while time.monotonic() - t0 < 60:
+            if cl.execute("SELECT citus_rollups()").rows[0][6] == 0:
+                converged = round(time.monotonic() - t0, 2)
+                break
+            time.sleep(0.05)
+        cl.execute("SET citus.rollup_refresh_interval_ms = 0")
+        extra["rollup"] = {
+            "source_rows": n + ingested[0],
+            "ingested_during_run": ingested[0],
+            "raw_scan": raw,
+            "rollup": rolled,
+            "speedup_p50": round(raw["p50_ms"] / max(rolled["p50_ms"],
+                                                     1e-6), 1),
+            "steady_state_lag_changes": {
+                "mean": round(float(np.mean(lags)), 1) if lags else 0,
+                "max": int(max(lags)) if lags else 0,
+            },
+            "lag_converged_after_ingest_s": converged,
+        }
+    finally:
+        cl.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_rebalance(extra: dict) -> None:
     """Online rebalancing (operations/shard_transfer.py): N writer
     threads hammer the table for the whole life of a background shard
@@ -917,6 +1033,8 @@ def main() -> None:
         bench_workload(extra)
     if os.environ.get("BENCH_REBALANCE", "1") != "0":
         bench_rebalance(extra)
+    if os.environ.get("BENCH_ROLLUP", "1") != "0":
+        bench_rollup(extra)
     if os.environ.get("BENCH_JOIN", "1") != "0":
         n_orders = N_ROWS // 4
         ensure_join_data(cl, n_orders)

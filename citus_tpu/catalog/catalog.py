@@ -293,6 +293,11 @@ class Catalog:
         self.collations: dict[str, dict] = {}
         self.publications: dict[str, dict] = {}
         self.statistics: dict[str, dict] = {}
+        # continuous-aggregation rollup specs: name -> {"source",
+        # "table", "group_cols", "aggs", "backend"} (rollup/manager.py;
+        # the refresh watermark lives in the rollup progress TABLE, not
+        # here — it must commit atomically with the delta apply)
+        self.rollups: dict[str, dict] = {}
         # sequences: name -> {"value": next unreserved, "increment": n,
         # "start": n}; nextval hands out values from an in-memory block
         # reserved by bumping the persisted high-water mark (gaps on
@@ -397,6 +402,7 @@ class Catalog:
             self.collations = d.get("collations", {})
             self.publications = d.get("publications", {})
             self.statistics = d.get("statistics", {})
+            self.rollups = d.get("rollups", {})
 
     def export_document(self) -> dict:
         from citus_tpu.catalog.migrations import CATALOG_FORMAT_VERSION
@@ -424,6 +430,7 @@ class Catalog:
             "collations": self.collations,
             "publications": self.publications,
             "statistics": self.statistics,
+            "rollups": self.rollups,
         }
 
     def tombstone(self, section: str, name: str) -> None:
@@ -481,7 +488,7 @@ class Catalog:
                     "enum_columns", "schemas", "rls",
                     "triggers", "ts_configs", "extensions", "domains",
                     "collations", "publications", "statistics",
-                    "domain_columns"):
+                    "rollups", "domain_columns"):
             disk = d.get(sec, {})
             mem = getattr(self, sec)
             dead = tomb.get(sec, set())

@@ -703,6 +703,12 @@ class Cluster:
         self.flight_recorder = FlightRecorder(self, data_dir)
         self.counters.add_reset_hook(self.flight_recorder.reset_baselines)
         self.flight_recorder.apply()
+        # continuous aggregation (rollup/manager.py): the CDC-fed
+        # incremental refresh loop only runs while
+        # citus.rollup_refresh_interval_ms > 0
+        from citus_tpu.rollup import RollupManager
+        self.rollup_manager = RollupManager(self)
+        self.rollup_manager.apply()
         # thread id -> role active in that thread's execute() call
         self._exec_roles: dict[int, Optional[str]] = {}
         # control plane (reference: metadata sync + 2PC votes over libpq;
@@ -825,6 +831,7 @@ class Cluster:
             self._background_jobs.stop()
         if self._maintenance is not None:
             self._maintenance.stop()
+        self.rollup_manager.stop()
         # sampler joined before the servers drop; the reset hook must
         # not outlive this handle (GLOBAL_COUNTERS is process-global)
         self.flight_recorder.stop()
@@ -2608,6 +2615,14 @@ class Cluster:
             bj = bind_join_select(self.catalog, stmt)
             return execute_join_select(self.catalog, bj, self.settings)
         if isinstance(stmt, A.Select):
+            if self.catalog.rollups:
+                # continuous aggregation: a dashboard query whose shape
+                # a rollup materializes is answered from stored sketch
+                # state (stale by the refresh lag) instead of scanning
+                from citus_tpu.rollup.routing import maybe_execute_rollup
+                rres = maybe_execute_rollup(self, stmt)
+                if rres is not None:
+                    return rres
             bound, plan, values, _ = self._cached_select_plan(
                 stmt, sql_text or None)
             return execute_select(self.catalog, bound, self.settings,

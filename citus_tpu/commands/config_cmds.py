@@ -57,6 +57,15 @@ def _sample_rate(v) -> float:
     return f
 
 
+def _percentile_backend(v) -> str:
+    """citus.percentile_backend = ddsketch | tdigest (the sketch kind
+    approx_percentile rollup columns store, rollup/sketches.py)."""
+    s = str(v).lower()
+    if s not in ("ddsketch", "tdigest"):
+        raise ValueError(s)
+    return s
+
+
 def _compute_ndistinct(cl, table: str, columns: list) -> int:
     """count(DISTINCT (cols)) — the extended-statistics ndistinct."""
     sel = A.Select(
@@ -117,6 +126,18 @@ _GUCS = {
     "citus.flight_recorder_retention_s": ("observability",
                                           "flight_recorder_retention_s",
                                           float),
+    # continuous aggregation (rollup/): refresh-loop cadence (ms; 0 =
+    # loop off, refresh via citus_refresh_rollups()), percentile sketch
+    # backend for NEW rollups, and the per-batch source-row bound
+    "citus.rollup_refresh_interval_ms": ("rollup",
+                                         "rollup_refresh_interval_ms",
+                                         float),
+    "citus.percentile_backend": ("rollup", "percentile_backend",
+                                 _percentile_backend),
+    "citus.rollup_max_batch_rows": ("rollup", "rollup_max_batch_rows",
+                                    int),
+    "citus.enable_rollup_routing": ("rollup", "enable_rollup_routing",
+                                    "bool"),
     "citus.enable_repartition_joins": ("planner", "enable_repartition_joins", "bool"),
     "citus.shard_count": ("sharding", "shard_count", int),
     "citus.shard_replication_factor": ("sharding", "shard_replication_factor", int),
@@ -227,6 +248,8 @@ def _execute_set(cl, stmt: A.SetConfig) -> Result:
         configure_persistent_cache(v)
     elif key == "citus.flight_recorder_interval_ms":
         cl.flight_recorder.apply()  # start/stop the sampler to match
+    elif key == "citus.rollup_refresh_interval_ms":
+        cl.rollup_manager.apply()  # start/stop the refresh loop
     cl._plan_cache.clear()  # backend/knob changes invalidate plans
     return Result(columns=[], rows=[])
 

@@ -126,6 +126,24 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
             lines.append(f"  Partitions Shown: One of {len(surv)}")
             lines.extend("  " + r[0] for r in sub.rows)
         return Result(columns=["QUERY PLAN"], rows=[(l,) for l in lines])
+    if cl.catalog.rollups:
+        from citus_tpu.rollup.routing import match_rollup
+        m = match_rollup(cl, sel0)
+        if m is not None:
+            rname, rspec, rplan = m
+            lines = [f"Rollup Scan on {rspec['table']} "
+                     f"(rollup: {rname}, source: {rspec['source']})"]
+            aggs = [f"{k}->{o}" for k, o, _p in rplan["items"]
+                    if k != "group"]
+            lines.append("  Finalize From Stored Sketches: "
+                         + ", ".join(aggs))
+            if rplan["groups"]:
+                lines.append("  Re-merge GroupBy: "
+                             + ", ".join(rplan["groups"]))
+            if stmt.analyze:
+                lines.extend(_run_analyze(cl, stmt))
+            return Result(columns=["QUERY PLAN"],
+                          rows=[(l,) for l in lines])
     bound = bind_select(cl.catalog, stmt.statement)
     from citus_tpu.planner.physical import plan_select
     plan = plan_select(cl.catalog, bound,

@@ -169,6 +169,31 @@ def _execute_upsert(cl, t, stmt: A.Insert, rows: list) -> Result:
             raise UnsupportedFeatureError(
                 "ON CONFLICT DO UPDATE cannot modify the distribution "
                 "column")
+    # sketch_merge(col, excluded.col) assignments merge serialized
+    # sketch states host-side before the UPDATE runs: the batched probe
+    # below fetches the stored word alongside the conflict key, the
+    # rollup codec merges it with the proposed row's word, and the
+    # assignment collapses to a plain string literal (which the UPDATE
+    # path dictionary-encodes like any other text-routed value)
+    merge_cols: list = []
+    for c, e in oc.assignments:
+        if isinstance(e, A.FuncCall) and e.name == "sketch_merge":
+            if t.schema.column(c).type.kind != "sketch":
+                raise AnalysisError(
+                    f"sketch_merge() target column {c!r} is not a "
+                    f"sketch column")
+            if len(e.args) != 2 \
+                    or not (isinstance(e.args[0], A.ColumnRef)
+                            and e.args[0].name == c
+                            and e.args[0].table in (None, t.name)) \
+                    or not (isinstance(e.args[1], A.ColumnRef)
+                            and e.args[1].table == "excluded"
+                            and e.args[1].name == c):
+                raise AnalysisError(
+                    "sketch_merge() must be written as "
+                    "sketch_merge(col, excluded.col) on the assigned "
+                    "column")
+            merge_cols.append(c)
     key_idx = [names.index(c) for c in oc.targets]
 
     def norm_key(vals) -> tuple:
@@ -215,7 +240,9 @@ def _execute_upsert(cl, t, stmt: A.Insert, rows: list) -> Result:
         # (pruned by the distribution-column IN-list) into a set
         probe_rows = [row for row in rows
                       if not any(row[i] is None for i in key_idx)]
-        existing: set = set()
+        # conflict key -> stored values of the sketch-merge source
+        # columns (an empty tuple when none are requested)
+        existing: dict = {}
         if probe_rows:
             where = None
             if t.is_distributed and t.dist_column in names:
@@ -224,9 +251,11 @@ def _execute_upsert(cl, t, stmt: A.Insert, rows: list) -> Result:
                 where = A.InList(A.ColumnRef(t.dist_column),
                                  tuple(_pylit(v) for v in dvals), False)
             chk = A.Select([A.SelectItem(A.ColumnRef(c))
-                            for c in oc.targets],
+                            for c in list(oc.targets) + merge_cols],
                            A.TableRef(t.name), where)
-            existing = {tuple(r) for r in cl._execute_stmt(chk).rows}
+            nk = len(oc.targets)
+            existing = {tuple(r[:nk]): tuple(r[nk:])
+                        for r in cl._execute_stmt(chk).rows}
         to_insert: list = []
         affected: set = set()  # keys inserted/updated by this command
         for row in rows:
@@ -256,8 +285,25 @@ def _execute_upsert(cl, t, stmt: A.Insert, rows: list) -> Result:
                 eq = A.BinOp("=", A.ColumnRef(c), _pylit(v))
                 cond = eq if cond is None else A.BinOp("and", cond, eq)
             excl = {c: _pylit(v) for c, v in zip(names, row)}
-            assignments = [(c, _subst_excluded(e, excl))
-                           for c, e in oc.assignments]
+            stored = dict(zip(merge_cols, existing.get(key, ())))
+            assignments = []
+            for c, e in oc.assignments:
+                e2 = _subst_excluded(e, excl)
+                if c in stored and isinstance(e2, A.FuncCall) \
+                        and e2.name == "sketch_merge":
+                    from citus_tpu.rollup.sketches import (
+                        merge_sketch_words,
+                    )
+                    cur = stored[c]
+                    new = e2.args[1].value \
+                        if isinstance(e2.args[1], A.Literal) else None
+                    if cur is None or new is None:
+                        merged = new if cur is None else cur
+                    else:
+                        merged = merge_sketch_words(str(cur), str(new))
+                    e2 = A.Literal(merged,
+                                   "null" if merged is None else "string")
+                assignments.append((c, e2))
             where = cond
             if oc.where is not None:
                 where = A.BinOp("and", cond,

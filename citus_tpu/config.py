@@ -191,6 +191,33 @@ class ObservabilitySettings:
 
 
 @dataclass
+class RollupSettings:
+    """Continuous aggregation (rollup/manager.py): CDC-fed incremental
+    refresh of sketch rollup tables."""
+
+    # Cadence (ms) of the background refresh consumer —
+    # citus.rollup_refresh_interval_ms.  0 (the default) keeps the
+    # consumer thread off; refresh can still be driven explicitly via
+    # citus_refresh_rollups() / RollupManager.refresh_once().
+    rollup_refresh_interval_ms: float = 0.0
+    # Percentile sketch backend newly created rollups store —
+    # citus.percentile_backend: "ddsketch" (log-bucket histogram,
+    # device psum-combinable, ~2.7% relative value error) or "tdigest"
+    # (fixed-slot centroid digest, host-compressed, ~2% rank error —
+    # the reference's planner/tdigest_extension.c backend).
+    percentile_backend: str = "ddsketch"
+    # Max CDC delta rows folded into one rollup per refresh tick —
+    # citus.rollup_max_batch_rows; the tail beyond it stays in the
+    # stream for the next tick (the watermark only advances past what
+    # was applied).
+    rollup_max_batch_rows: int = 65536
+    # citus.enable_rollup_routing: answer matching dashboard queries
+    # from rollup state (stale by the refresh lag) instead of a raw
+    # scan.  Off gives benchmarks and tests their raw-scan arm.
+    enable_rollup_routing: bool = True
+
+
+@dataclass
 class ShardingSettings:
     # Default shard count for create_distributed_table
     # (reference GUC citus.shard_count, default 32).
@@ -224,6 +251,7 @@ class Settings:
     workload: WorkloadSettings = field(default_factory=WorkloadSettings)
     observability: ObservabilitySettings = field(
         default_factory=ObservabilitySettings)
+    rollup: RollupSettings = field(default_factory=RollupSettings)
     # reference GUC citus.enable_change_data_capture
     enable_change_data_capture: bool = False
     # start the maintenance daemon with the cluster (reference: the

@@ -236,6 +236,42 @@ def decode_partials(blob: bytes) -> tuple:
     return tuple(arrays[f"a__{i}"] for i in range(len(arrays)))
 
 
+def sketch_words_to_arrays(name: str, words) -> dict:
+    """Pack a column of sketch words (``"kind:ver:b64"`` strings, or None
+    for SQL NULL) into fixed-width arrays under the existing frame dtype
+    allowlist: one uint8 payload blob, int64 end-offsets, and a bool
+    validity mask.  Sketch words are pure ASCII by construction
+    (types.py validates the envelope), so no text dictionary is needed —
+    the column stays self-contained on the wire."""
+    blobs = [b"" if w is None else str(w).encode("ascii") for w in words]
+    ends = np.cumsum([len(b) for b in blobs], dtype=np.int64) \
+        if blobs else np.zeros(0, dtype=np.int64)
+    payload = np.frombuffer(b"".join(blobs), dtype=np.uint8)
+    valid = np.array([w is not None for w in words], dtype=bool)
+    return {f"sk__{name}": payload, f"sko__{name}": ends,
+            f"skm__{name}": valid}
+
+
+def arrays_to_sketch_words(arrays: dict, name: str) -> list:
+    """Inverse of sketch_words_to_arrays -> list of Optional[str]."""
+    payload = np.asarray(arrays[f"sk__{name}"], dtype=np.uint8)
+    ends = np.asarray(arrays[f"sko__{name}"], dtype=np.int64)
+    valid = np.asarray(arrays[f"skm__{name}"], dtype=bool)
+    if ends.shape[0] != valid.shape[0]:
+        raise FrameError(f"sketch column {name!r}: offsets/validity "
+                         f"length mismatch")
+    if ends.shape[0] and int(ends[-1]) != payload.shape[0]:
+        raise FrameError(f"sketch column {name!r}: payload length "
+                         f"mismatch")
+    raw = payload.tobytes()
+    out, start = [], 0
+    for i in range(ends.shape[0]):
+        end = int(ends[i])
+        out.append(raw[start:end].decode("ascii") if valid[i] else None)
+        start = end
+    return out
+
+
 def _bump_pool_error() -> None:
     """Count a swallowed data-plane failure (failed close/rollback or an
     unreachable peer on a best-effort path).  These paths deliberately

@@ -1512,6 +1512,8 @@ class Parser:
         "citus_statistics_objects",
         "citus_stat_history", "citus_health_events",
         "citus_device_memory",
+        "citus_create_rollup", "citus_drop_rollup",
+        "citus_refresh_rollups", "citus_rollups",
     }
 
     def parse_select_or_utility(self) -> A.Statement:

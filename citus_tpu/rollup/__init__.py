@@ -1,0 +1,25 @@
+"""Continuous aggregation: storable sketches + CDC-fed rollup refresh.
+
+Reference scenario: Citus real-time analytics (SURVEY §2.12 CDC, §2.6
+aggregate push-down) — heavy event ingest plus dashboards served from
+small pre-aggregated rollup tables kept fresh incrementally, instead of
+re-scanning raw events per dashboard hit.
+
+Layout:
+
+- ``sketches``  — the serialized sketch value codec (encode / decode /
+  merge / finalize) shared by storage, the upsert merge path, and the
+  dashboard routing path.
+- ``kernels``   — delta-batch partial builders riding the same
+  psum/max-combine kernel family as the scan aggregates (compiled
+  through ``executor/kernel_cache.jit_compile`` — the one jax.jit site).
+- ``manager``   — rollup specs, the CDC-fed refresh loop with a durable
+  per-rollup LSN watermark, and the ``citus_rollups()`` view rows.
+- ``routing``   — planner-side matcher that serves dashboard queries
+  from the rollup table, finalizing stored sketches host-side.
+"""
+
+from citus_tpu.rollup.sketches import (  # noqa: F401
+    decode_sketch, encode_sketch, finalize_sketch, merge_sketch_words,
+)
+from citus_tpu.rollup.manager import RollupManager  # noqa: F401

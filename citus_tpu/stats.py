@@ -143,6 +143,18 @@ class StatCounters:
         # (executor/device_cache.py, executor/executor.py, megabatch.py);
         # EXPLAIN ANALYZE's Memory: line is this counter's delta
         "device_hbm_touched_bytes",
+        # continuous aggregation (rollup/manager.py, rollup/routing.py):
+        # refresh-loop ticks, source rows folded into rollup state,
+        # errors swallowed by the loop, CDC changes a merge-only rollup
+        # could not fold (update/delete ops, NULL group keys), queries
+        # the planner answered from a rollup instead of a raw scan, and
+        # the loop's parked-between-ticks wall time
+        "rollup_refresh_ticks",
+        "rollup_rows_folded",
+        "rollup_refresh_errors",
+        "rollup_skipped_changes",
+        "rollup_queries_served",
+        "wait_rollup_refresh_ms",
     ]
 
     def __init__(self):
@@ -221,6 +233,9 @@ WAIT_COUNTERS = {
     # a shard mover draining replication lag between catch-up passes
     # (operations/shard_transfer.py) — the mover waits, writers do not
     "shard_move_catchup": "wait_shard_move_catchup_ms",
+    # the rollup refresh loop parked between ticks (rollup/manager.py)
+    # — the background consumer waits, ingest and queries do not
+    "rollup_refresh": "wait_rollup_refresh_ms",
 }
 
 WAIT_EVENTS = tuple(sorted(WAIT_COUNTERS))

@@ -128,6 +128,7 @@ def write_stripe_file(
     chunk_row_limit: int,
     codec: str,
     level: int,
+    no_stats_columns: frozenset = frozenset(),
 ) -> StripeFooter:
     """Write one stripe atomically (temp file + rename).
 
@@ -135,6 +136,9 @@ def write_stripe_file(
     validity is a bool array or None when the chunk has no nulls.  Min/max
     stats are computed over valid rows only, like the reference's
     UpdateChunkSkipNodeMinMax (columnar_writer.c:664).
+    ``no_stats_columns`` suppresses min/max for columns whose physical ids
+    carry no value order (sketch state words): a skip node of None means
+    "cannot prune", which is the only correct answer there.
     """
     footer = StripeFooter(
         row_count=int(sum(chunk_row_counts)),
@@ -167,7 +171,7 @@ def write_stripe_file(
                     valid_vals = values[validity]
                 else:
                     valid_vals = values
-                if valid_vals.size:
+                if valid_vals.size and name not in no_stats_columns:
                     cs.minimum = _np_to_jsonable(valid_vals.min())
                     cs.maximum = _np_to_jsonable(valid_vals.max())
                 stats_list.append(cs)

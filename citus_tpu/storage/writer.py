@@ -155,6 +155,11 @@ class ShardWriter:
         # sorted value->offset segment per indexed column
         self.index_columns = tuple(index_columns)
         os.makedirs(directory, exist_ok=True)
+        # sketch columns store dictionary ids whose order says nothing
+        # about the state they name — never write min/max skip stats
+        from citus_tpu.types import SKETCH
+        self._no_stats_columns = frozenset(
+            c.storage_name for c in schema if c.type.kind == SKETCH)
         self._buf: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
         self._buf_valid: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
         self._buf_rows = 0
@@ -237,7 +242,8 @@ class ShardWriter:
             fname = f"stripe-{sid:06d}-x{self.staged_xid}-p{os.getpid()}.cts"
             write_stripe_file(
                 os.path.join(self.directory, fname), column_chunks, chunk_rows,
-                self.chunk_row_limit, self.codec, self.level)
+                self.chunk_row_limit, self.codec, self.level,
+                no_stats_columns=self._no_stats_columns)
             self._build_index_segments(fname, col_vals, col_valid)
             staged["stripes"].append({"file": fname, "row_count": n})
             staged["row_count"] += n
@@ -249,7 +255,8 @@ class ShardWriter:
                 fname = f"stripe-{sid:06d}.cts"
                 write_stripe_file(
                     os.path.join(self.directory, fname), column_chunks, chunk_rows,
-                    self.chunk_row_limit, self.codec, self.level)
+                    self.chunk_row_limit, self.codec, self.level,
+                    no_stats_columns=self._no_stats_columns)
                 self._build_index_segments(fname, col_vals, col_valid)
                 meta["stripes"].append({"file": fname, "row_count": n})
                 meta["row_count"] += n

@@ -62,6 +62,7 @@ TEXT = "text"
 UUID = "uuid"
 BYTEA = "bytea"
 ARRAY = "array"
+SKETCH = "sketch"
 
 _EPOCH_DATE = datetime.date(1970, 1, 1)
 
@@ -112,6 +113,7 @@ _STORAGE_DTYPES = {
     UUID: np.int32,
     BYTEA: np.int32,
     ARRAY: np.int32,
+    SKETCH: np.int32,
 }
 
 # dtype the expression/aggregate kernels compute in
@@ -132,8 +134,12 @@ _DEVICE_DTYPES = {
     UUID: np.int32,
     BYTEA: np.int32,
     ARRAY: np.int32,
+    SKETCH: np.int32,
 }
 
+
+#: sketch word prefixes the SKETCH kind accepts ("<kind>:<version>:<b64>")
+SKETCH_WORD_KINDS = ("hll", "ddsk", "topk", "tdg")
 
 #: kinds whose physical value is a table-global dictionary id — the
 #: fixed-width projection of variable-width data onto the TPU's shape
@@ -143,7 +149,7 @@ _DEVICE_DTYPES = {
 #: (columnar/columnar_tableam.c:718); here every variable-width type
 #: rides the dictionary machinery with kind-specific canonicalization
 #: (normalize_word) and rendering (render_word).
-_DICTIONARY_KINDS = (TEXT, UUID, BYTEA, ARRAY)
+_DICTIONARY_KINDS = (TEXT, UUID, BYTEA, ARRAY, SKETCH)
 
 
 @dataclass(frozen=True)
@@ -233,6 +239,18 @@ class ColumnType:
                     out.append(str(v) if not isinstance(
                         v, (int, float, bool)) else v)
             return _json.dumps(out, separators=(",", ":"))
+        if k == SKETCH:
+            # self-describing "<kind>:<version>:<base64 payload>" word;
+            # the payload codec lives in rollup/sketches.py — the type
+            # layer only guards the envelope so a stray string can't
+            # enter a sketch column and break merges later
+            s = str(value)
+            parts = s.split(":", 2)
+            if len(parts) != 3 or parts[0] not in SKETCH_WORD_KINDS \
+                    or not parts[1].isdigit():
+                raise AnalysisError(
+                    f"invalid input syntax for type sketch: {value!r}")
+            return s
         return str(value)
 
     def render_word(self, word: str) -> Any:
@@ -421,6 +439,7 @@ INTERVAL_T = ColumnType(INTERVAL)
 TEXT_T = ColumnType(TEXT)
 UUID_T = ColumnType(UUID)
 BYTEA_T = ColumnType(BYTEA)
+SKETCH_T = ColumnType(SKETCH)
 
 
 def array_t(elem: str = "text") -> ColumnType:
@@ -457,6 +476,7 @@ _SQL_NAMES = {
     "char": TEXT_T,
     "uuid": UUID_T,
     "bytea": BYTEA_T,
+    "sketch": SKETCH_T,
 }
 
 

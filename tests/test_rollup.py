@@ -1,0 +1,459 @@
+"""Continuous aggregation end-to-end: citus_create_rollup backfill,
+CDC-driven incremental refresh with lag convergence, planner routing of
+dashboard queries to the rollup (EXPLAIN-visible), the t-digest
+percentile backend, and the exactly-once restart regression at fault
+point ``rollup_refresh``."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.config import Settings
+from citus_tpu.testing.faults import FAULTS
+
+DASH_Q = ("SELECT tid, count(*), sum(v), approx_count_distinct(kind), "
+          "approx_percentile(0.5) WITHIN GROUP (ORDER BY v) "
+          "FROM ev GROUP BY tid ORDER BY tid")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    FAULTS.disarm()
+
+
+def make_cluster(tmp_path, rows=300, tenants=5):
+    cl = ct.Cluster(
+        str(tmp_path / "db"), n_nodes=1,
+        settings=Settings(enable_change_data_capture=True,
+                          start_maintenance_daemon=False))
+    cl.execute("CREATE TABLE ev (tid bigint NOT NULL, kind text, "
+               "v double, code bigint)")
+    cl.execute("SELECT create_distributed_table('ev', 'tid', 4)")
+    ingest(cl, rows, tenants=tenants)
+    return cl
+
+
+def ingest(cl, rows, *, tenants=5, seed=0):
+    rng = np.random.default_rng(seed)
+    cl.copy_from("ev", columns={
+        "tid": rng.integers(0, tenants, rows).astype(np.int64),
+        "kind": np.array([f"k{int(x)}" for x in
+                          rng.integers(0, 40, rows)], object),
+        "v": rng.uniform(1.0, 100.0, rows),
+        "code": rng.integers(0, 8, rows).astype(np.int64),
+    })
+
+
+def oracle(cl):
+    """Raw-scan GROUP BY truth: {tid: (count, sum, distinct kinds)}."""
+    res = cl.execute("SELECT tid, count(*), sum(v), count(DISTINCT kind) "
+                     "FROM ev GROUP BY tid")
+    return {r[0]: (r[1], float(r[2]), r[3]) for r in res.rows}
+
+
+def create_rollup(cl, aggs="count(*), sum(v), approx_count_distinct(kind), "
+                           "approx_percentile(v), approx_top_k(code)"):
+    cl.execute(f"SELECT citus_create_rollup('ev_r', 'ev', 'tid', '{aggs}')")
+
+
+# -------------------------------------------------- create + backfill
+
+def test_create_rollup_backfills_and_matches_oracle(tmp_path):
+    cl = make_cluster(tmp_path)
+    try:
+        create_rollup(cl)
+        truth = oracle(cl)
+        rows = cl.execute("SELECT tid, n_rows, sum_v, acd_kind FROM ev_r").rows
+        assert {r[0] for r in rows} == set(truth)
+        from citus_tpu.rollup.sketches import decode_sketch, finalize_sketch
+        for tid, n, s, acd in rows:
+            assert n == truth[tid][0]
+            assert s == pytest.approx(truth[tid][1])
+            # the stored hll word finalizes within the documented ±9%
+            # 1-sigma bound (3 sigma allowance) of the exact distinct
+            est, ok = finalize_sketch("hll", decode_sketch(acd)[1])
+            assert ok
+            exact = truth[tid][2]
+            assert abs(est - exact) <= max(3, 0.27 * exact), (tid, est)
+        # the rollup is colocated with its source
+        src = cl.catalog.table("ev")
+        rt = cl.catalog.table("ev_r")
+        assert rt.is_distributed and rt.dist_column == "tid"
+        assert len(rt.shards) == len(src.shards)
+        # view starts converged: backfill watermark == CDC head
+        name, source, table, backend, wm, head, pending = \
+            cl.execute("SELECT citus_rollups()").rows[0]
+        assert (name, source, table, backend) == ("ev_r", "ev", "ev_r",
+                                                  "ddsk")
+        assert wm == head and pending == 0
+    finally:
+        cl.close()
+
+
+def test_create_rollup_validation_errors(tmp_path):
+    from citus_tpu.errors import AnalysisError
+    cl = make_cluster(tmp_path, rows=20)
+    try:
+        for bad in [
+            "SELECT citus_create_rollup('r1', 'ev', 'kind', 'count(*)')",
+            "SELECT citus_create_rollup('r1', 'ev', 'tid', 'avg(v)')",
+            "SELECT citus_create_rollup('r1', 'ev', 'tid', "
+            "'approx_top_k(kind)')",
+            "SELECT citus_create_rollup('r1', 'ev', 'tid, nope', "
+            "'count(*)')",
+        ]:
+            with pytest.raises(AnalysisError):
+                cl.execute(bad)
+        # a source without CDC has no delta stream to refresh from
+        cl.execute("CREATE TABLE quiet (a bigint)")
+        cl.execute("SELECT create_distributed_table('quiet', 'a', 2)")
+        cl.cdc.enabled = False
+        try:
+            with pytest.raises(AnalysisError):
+                cl.execute("SELECT citus_create_rollup('r2', 'quiet', "
+                           "'a', 'count(*)')")
+        finally:
+            cl.cdc.enabled = True
+    finally:
+        cl.close()
+
+
+def test_sketch_merge_demands_sketch_column(tmp_path):
+    from citus_tpu.errors import AnalysisError
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1)
+    try:
+        cl.execute("CREATE TABLE kv (k bigint, n bigint)")
+        cl.execute("SELECT create_distributed_table('kv', 'k', 2)")
+        with pytest.raises(AnalysisError):
+            cl.execute("INSERT INTO kv VALUES (1, 2) ON CONFLICT (k) "
+                       "DO UPDATE SET n = sketch_merge(n, excluded.n)")
+    finally:
+        cl.close()
+
+
+# --------------------------------------------- incremental refresh
+
+def test_refresh_converges_to_cdc_head(tmp_path):
+    cl = make_cluster(tmp_path, rows=200)
+    try:
+        create_rollup(cl)
+        ingest(cl, 150, seed=1)
+        ingest(cl, 150, seed=2)
+        _, _, _, _, wm, head, pending = \
+            cl.execute("SELECT citus_rollups()").rows[0]
+        assert pending > 0 and head > wm  # lag is visible before refresh
+        folded = cl.execute("SELECT citus_refresh_rollups()").rows[0][0]
+        assert folded == 300
+        _, _, _, _, wm, head, pending = \
+            cl.execute("SELECT citus_rollups()").rows[0]
+        assert wm == head and pending == 0  # lag converged
+        truth = oracle(cl)
+        for tid, n, s in cl.execute(
+                "SELECT tid, n_rows, sum_v FROM ev_r").rows:
+            assert n == truth[tid][0]
+            assert s == pytest.approx(truth[tid][1])
+    finally:
+        cl.close()
+
+
+def test_refresh_respects_batch_limit(tmp_path):
+    cl = make_cluster(tmp_path, rows=50)
+    try:
+        create_rollup(cl, aggs="count(*)")
+        cl.execute("SET citus.rollup_max_batch_rows = 40")
+        for seed in (1, 2, 3):
+            ingest(cl, 60, seed=seed)
+        # each refresh_once folds <= ~one batch; run_once drains all
+        first = cl.rollup_manager.refresh_once("ev_r")
+        assert first is not None and first <= 60
+        cl.rollup_manager.run_once()
+        truth = oracle(cl)
+        for tid, n in cl.execute("SELECT tid, n_rows FROM ev_r").rows:
+            assert n == truth[tid][0]
+    finally:
+        cl.close()
+
+
+def test_background_refresh_loop_follows_guc(tmp_path):
+    cl = make_cluster(tmp_path, rows=100)
+    try:
+        create_rollup(cl, aggs="count(*), sum(v)")
+        assert cl.rollup_manager._thread is None  # interval 0 = off
+        cl.execute("SET citus.rollup_refresh_interval_ms = 20")
+        assert cl.rollup_manager._thread is not None
+        ingest(cl, 120, seed=3)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if cl.execute("SELECT citus_rollups()").rows[0][6] == 0:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("background refresh never converged")
+        truth = oracle(cl)
+        for tid, n, s in cl.execute(
+                "SELECT tid, n_rows, sum_v FROM ev_r").rows:
+            assert n == truth[tid][0]
+            assert s == pytest.approx(truth[tid][1])
+        cl.execute("SET citus.rollup_refresh_interval_ms = 0")
+        assert cl.rollup_manager._thread is None
+    finally:
+        cl.close()
+
+
+def test_updates_and_deletes_are_counted_not_folded(tmp_path):
+    cl = make_cluster(tmp_path, rows=100)
+    try:
+        create_rollup(cl, aggs="count(*)")
+        from citus_tpu.executor.executor import GLOBAL_COUNTERS
+        before = GLOBAL_COUNTERS.snapshot().get("rollup_skipped_changes", 0)
+        cl.execute("DELETE FROM ev WHERE tid = 0")
+        cl.execute("UPDATE ev SET v = v + 1 WHERE tid = 1")
+        cl.rollup_manager.run_once()
+        after = GLOBAL_COUNTERS.snapshot().get("rollup_skipped_changes", 0)
+        assert after > before
+        # the watermark still advances past the skipped changes
+        assert cl.execute("SELECT citus_rollups()").rows[0][6] == 0
+    finally:
+        cl.close()
+
+
+# ------------------------------------------------------ routing
+
+def test_dashboard_query_routes_to_rollup(tmp_path):
+    cl = make_cluster(tmp_path, rows=400)
+    try:
+        create_rollup(cl)
+        r_roll = cl.execute(DASH_Q)
+        assert r_roll.explain.get("strategy") == "rollup"
+        assert r_roll.explain.get("rollup") == "ev_r"
+        cl.execute("SET citus.enable_rollup_routing = off")
+        r_raw = cl.execute(DASH_Q)
+        assert (r_raw.explain or {}).get("strategy") != "rollup"
+        cl.execute("SET citus.enable_rollup_routing = on")
+        assert [r[0] for r in r_roll.rows] == [r[0] for r in r_raw.rows]
+        for roll, raw in zip(r_roll.rows, r_raw.rows):
+            assert roll[1] == raw[1]                      # count exact
+            assert roll[2] == pytest.approx(raw[2])       # sum exact
+            # both arms run the same sketch algorithms over the same
+            # rows, so approx answers agree exactly too
+            assert roll[3] == raw[3]
+            assert roll[4] == pytest.approx(raw[4])
+    finally:
+        cl.close()
+
+
+def test_where_on_group_cols_and_scalar_shape_route(tmp_path):
+    cl = make_cluster(tmp_path, rows=200)
+    try:
+        create_rollup(cl)
+        q = ("SELECT count(*), sum(v), approx_top_k(code, 3) FROM ev "
+             "WHERE tid IN (1, 2)")
+        r = cl.execute(q)
+        assert r.explain.get("strategy") == "rollup"
+        n, s = cl.execute("SELECT count(*), sum(v) FROM ev "
+                          "WHERE tid IN (1, 2)  -- raw arm\n").rows[0]
+        assert (r.rows[0][0], r.rows[0][1]) == (n, pytest.approx(s))
+        top = json.loads(r.rows[0][2])
+        assert 1 <= len(top) <= 3 and top[0]["count"] >= top[-1]["count"]
+    finally:
+        cl.close()
+
+
+def test_non_matching_queries_fall_through(tmp_path):
+    cl = make_cluster(tmp_path, rows=100)
+    try:
+        create_rollup(cl, aggs="count(*), sum(v)")
+        for q in [
+            "SELECT kind, count(*) FROM ev GROUP BY kind",   # not a group col
+            "SELECT tid, max(v) FROM ev GROUP BY tid",       # agg not stored
+            "SELECT tid, count(*) FROM ev WHERE v > 5 GROUP BY tid",
+            "SELECT tid, count(DISTINCT kind) FROM ev GROUP BY tid",
+        ]:
+            r = cl.execute(q)
+            assert (r.explain or {}).get("strategy") != "rollup", q
+    finally:
+        cl.close()
+
+
+def test_explain_shows_rollup_scan(tmp_path):
+    cl = make_cluster(tmp_path, rows=50)
+    try:
+        create_rollup(cl)
+        lines = [r[0] for r in cl.execute("EXPLAIN " + DASH_Q).rows]
+        assert lines[0].startswith("Rollup Scan on ev_r")
+        assert any("Finalize From Stored Sketches" in l for l in lines)
+        cl.execute("SET citus.enable_rollup_routing = off")
+        lines = [r[0] for r in cl.execute("EXPLAIN " + DASH_Q).rows]
+        assert not lines[0].startswith("Rollup Scan"), lines[0]
+    finally:
+        cl.close()
+
+
+def test_drop_rollup_restores_raw_plan(tmp_path):
+    cl = make_cluster(tmp_path, rows=50)
+    try:
+        create_rollup(cl, aggs="count(*)")
+        q = "SELECT tid, count(*) FROM ev GROUP BY tid"
+        assert cl.execute(q).explain.get("strategy") == "rollup"
+        cl.execute("SELECT citus_drop_rollup('ev_r')")
+        assert not cl.catalog.rollups
+        assert not cl.catalog.has_table("ev_r")
+        assert (cl.execute(q).explain or {}).get("strategy") != "rollup"
+    finally:
+        cl.close()
+
+
+# -------------------------------------------------- t-digest backend
+
+def test_tdigest_percentile_backend(tmp_path):
+    cl = make_cluster(tmp_path, rows=400, tenants=2)
+    try:
+        cl.execute("SET citus.percentile_backend = tdigest")
+        assert cl.execute("SHOW citus.percentile_backend").rows[0][0] \
+            == "tdigest"
+        create_rollup(cl, aggs="count(*), approx_percentile(v)")
+        assert cl.execute("SELECT citus_rollups()").rows[0][3] == "tdg"
+        word = cl.execute(
+            "SELECT apct_v FROM ev_r WHERE tid = 0").rows[0][0]
+        assert word.startswith("tdg:")
+        # incremental refresh merges t-digests like any other sketch
+        ingest(cl, 300, tenants=2, seed=5)
+        cl.rollup_manager.run_once()
+        est = cl.execute(
+            "SELECT approx_percentile(0.5) WITHIN GROUP (ORDER BY v) "
+            "FROM ev WHERE tid = 0").rows[0]
+        truth = sorted(r[0] for r in cl.execute(
+            "SELECT v FROM ev WHERE tid = 0").rows)
+        exact = truth[len(truth) // 2]
+        # ~2% rank error over uniform[1,100] values: stay within ±10
+        assert abs(float(est[0]) - exact) < 10.0, (est, exact)
+        with pytest.raises(Exception):
+            cl.execute("SET citus.percentile_backend = nope")
+    finally:
+        cl.close()
+
+
+# ---------------------------------------- exactly-once kill/restart
+
+_CHILD = r"""
+import os, sys
+import citus_tpu as ct
+from citus_tpu.config import Settings
+from citus_tpu.testing.faults import FAULTS
+db = sys.argv[1]
+FAULTS.arm("rollup_refresh", kill=True)
+cl = ct.Cluster(db, settings=Settings(enable_change_data_capture=True,
+                                      start_maintenance_daemon=False))
+cl.execute("INSERT INTO ev VALUES (1, 'kx', 5.0, 3), (2, 'ky', 6.0, 4), "
+           "(1, 'kz', 7.0, 3)")
+try:
+    cl.rollup_manager.run_once()
+except BaseException:
+    pass
+os._exit(7)  # fault never fired: the parent fails on this exit code
+"""
+
+
+def test_refresh_kill_between_apply_and_watermark_is_exactly_once(tmp_path):
+    """Kill the refresh between the delta upsert and the watermark
+    commit: recovery must roll BOTH back, and the next refresh replays
+    the batch exactly once — no double counting, no gap — landing on
+    the raw-scan oracle."""
+    cl = make_cluster(tmp_path, rows=120)
+    create_rollup(cl, aggs="count(*), sum(v), approx_count_distinct(kind)")
+    wm_before = cl.rollup_manager.watermark("ev_r")
+    base = {r[0]: (r[1], r[2]) for r in cl.execute(
+        "SELECT tid, n_rows, sum_v FROM ev_r").rows}
+    cl.close()
+
+    db = str(tmp_path / "db")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", _CHILD, db], env=env,
+                       timeout=180, capture_output=True)
+    assert r.returncode == 1, (r.returncode, r.stderr[-2000:])
+
+    cl2 = ct.Cluster(db, settings=Settings(enable_change_data_capture=True,
+                                           start_maintenance_daemon=False))
+    try:
+        # the torn transaction rolled back whole: watermark unmoved AND
+        # no delta rows leaked into the rollup
+        assert cl2.rollup_manager.watermark("ev_r") == wm_before
+        after_crash = {r[0]: (r[1], r[2]) for r in cl2.execute(
+            "SELECT tid, n_rows, sum_v FROM ev_r").rows}
+        assert after_crash == base
+        # replay folds the batch exactly once
+        cl2.rollup_manager.run_once()
+        truth = oracle(cl2)
+        got = {r[0]: (r[1], float(r[2])) for r in cl2.execute(
+            "SELECT tid, n_rows, sum_v FROM ev_r").rows}
+        assert set(got) == set(truth)
+        for tid in truth:
+            assert got[tid][0] == truth[tid][0]
+            assert got[tid][1] == pytest.approx(truth[tid][1])
+        # a second refresh is a no-op (idempotent at the head)
+        assert cl2.rollup_manager.run_once() == 0
+        assert cl2.execute("SELECT citus_rollups()").rows[0][6] == 0
+    finally:
+        cl2.close()
+
+
+# ------------------------------------------------ A/B speed (slow)
+
+@pytest.mark.slow
+def test_rollup_serves_dashboard_faster_than_raw_scan(tmp_path):
+    """Acceptance A/B: the rollup arm answers the dashboard query well
+    inside each sketch's error bound of the raw-scan oracle while
+    running >=10x faster on a wide source table."""
+    cl = make_cluster(tmp_path, rows=50_000, tenants=8)
+    try:
+        create_rollup(cl)
+
+        def timed(n=5):
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.monotonic()
+                res = cl.execute(DASH_Q)
+                best = min(best, time.monotonic() - t0)
+            return best, res
+
+        cl.execute("SET citus.enable_rollup_routing = off")
+        raw_t, raw = timed()
+        cl.execute("SET citus.enable_rollup_routing = on")
+        roll_t, roll = timed()
+        assert roll.explain.get("strategy") == "rollup"
+        for a, b in zip(roll.rows, raw.rows):
+            assert a[0] == b[0] and a[1] == b[1]
+            assert a[2] == pytest.approx(b[2])
+            assert abs(a[3] - b[3]) <= max(3, 0.27 * b[3])
+            assert a[4] == pytest.approx(b[4], rel=0.06)
+        assert roll_t * 10 <= raw_t, (roll_t, raw_t)
+
+        # refresh lag converges after a concurrent ingest burst
+        stop = threading.Event()
+
+        def pound():
+            s = 100
+            while not stop.is_set():
+                ingest(cl, 500, tenants=8, seed=s)
+                s += 1
+
+        th = threading.Thread(target=pound)
+        th.start()
+        time.sleep(1.0)
+        stop.set()
+        th.join()
+        cl.execute("SELECT citus_refresh_rollups()")
+        assert cl.execute("SELECT citus_rollups()").rows[0][6] == 0
+        truth = oracle(cl)
+        for tid, n in cl.execute("SELECT tid, n_rows FROM ev_r").rows:
+            assert n == truth[tid][0]
+    finally:
+        cl.close()

@@ -1,0 +1,256 @@
+"""Sketch serialization round-trips (rollup/sketches.py): every kind
+encodes→decodes losslessly, word-level merge equals state-level merge,
+words survive both wire codecs (frame + npz) and a storage write/read
+cycle, and finalized estimates stay inside each sketch's documented
+error bound."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.config import Settings
+from citus_tpu.errors import AnalysisError
+from citus_tpu.net.data_plane import (
+    _decode_arrays, _encode_arrays, arrays_to_sketch_words, decode_frame,
+    encode_frame, sketch_words_to_arrays,
+)
+from citus_tpu.rollup import sketches as sk
+
+KINDS = ("hll", "ddsk", "topk", "tdg")
+
+
+def _random_state(kind: str, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    if kind == "hll":
+        return rng.integers(0, 30, sk.HLL_M).astype(np.int32)
+    if kind == "ddsk":
+        s = np.zeros(sk.DDSK_M, np.int64)
+        idx = rng.choice(sk.DDSK_M, 40, replace=False)
+        s[idx] = rng.integers(1, 1000, idx.size)
+        return s
+    if kind == "topk":
+        s = sk.empty_state("topk")
+        idx = rng.choice(sk.TOPK_M, 25, replace=False)
+        s[idx] = rng.integers(1, 500, idx.size)
+        s[sk.TOPK_M + idx] = rng.integers(-10**9, 10**9, idx.size)
+        return s
+    return sk.tdg_from_values(rng.normal(50.0, 10.0, 500))
+
+
+# ------------------------------------------------------ codec laws
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_encode_decode_roundtrip(kind):
+    state = _random_state(kind, 1)
+    word = sk.encode_sketch(kind, state)
+    # the word passes the SKETCH column type's envelope check
+    assert word.split(":", 2)[0] == kind
+    k2, s2 = sk.decode_sketch(word)
+    assert k2 == kind
+    assert np.array_equal(np.asarray(state), np.asarray(s2))
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_word_merge_equals_state_merge(kind):
+    a, b = _random_state(kind, 2), _random_state(kind, 3)
+    direct = sk.merge_states(kind, a, b)
+    via_words = sk.merge_sketch_words(
+        sk.encode_sketch(kind, a), sk.encode_sketch(kind, b))
+    _, merged = sk.decode_sketch(via_words)
+    assert np.array_equal(np.asarray(direct), np.asarray(merged))
+
+
+@pytest.mark.parametrize("kind", ("hll", "ddsk", "topk"))
+def test_merge_commutative_associative(kind):
+    a, b, c = (_random_state(kind, s) for s in (4, 5, 6))
+    ab = sk.merge_states(kind, a, b)
+    ba = sk.merge_states(kind, b, a)
+    assert np.array_equal(ab, ba)
+    assert np.array_equal(sk.merge_states(kind, ab, c),
+                          sk.merge_states(kind, a, sk.merge_states(kind, b, c)))
+
+
+def test_empty_state_is_merge_identity():
+    for kind in ("hll", "ddsk", "topk"):
+        s = _random_state(kind, 7)
+        merged = sk.merge_states(kind, s, sk.empty_state(kind))
+        assert np.array_equal(s, merged)
+
+
+def test_cross_kind_merge_rejected():
+    with pytest.raises(AnalysisError):
+        sk.merge_sketch_words(sk.encode_sketch("hll", sk.empty_state("hll")),
+                              sk.encode_sketch("ddsk", sk.empty_state("ddsk")))
+
+
+@pytest.mark.parametrize("word", [
+    "notakind:1:AAAA",
+    "hll:9:AAAA",                       # unsupported version
+    "hll:1:!!notbase64!!",
+    "hll:1:" + "QQ==",                  # wrong payload size
+    "plainstring",
+])
+def test_malformed_words_rejected(word):
+    with pytest.raises(AnalysisError):
+        sk.decode_sketch(word)
+
+
+def test_sparse_decode_rejects_out_of_range_bucket():
+    bad_idx = np.asarray([sk.DDSK_M + 5], "<i4").tobytes()
+    payload = bad_idx + np.asarray([3], "<i8").tobytes()
+    import base64
+    word = "ddsk:1:" + base64.b64encode(payload).decode()
+    with pytest.raises(AnalysisError):
+        sk.decode_sketch(word)
+
+
+# ----------------------------------------------------- error bounds
+
+def test_hll_estimate_within_documented_bound():
+    n = 5000
+    from citus_tpu.rollup import kernels
+    bits = kernels.value_bits(np.arange(n, dtype=np.int64) * 7919 + 13)
+    gidx = np.zeros(n, np.int64)
+    part = kernels.delta_partials("hll", gidx, np.ones(n, bool), 1, bits)
+    word = sk.encode_sketch("hll", part[0])
+    est, ok = sk.finalize_sketch("hll", sk.decode_sketch(word)[1])
+    assert ok
+    # documented 1-sigma error is ±9% (1.04/sqrt(128)); allow 3 sigma
+    assert abs(est - n) / n < 0.27, est
+
+
+def test_ddsk_percentile_within_relative_bound():
+    rng = np.random.default_rng(12)
+    vals = rng.lognormal(3.0, 1.0, 4000)
+    from citus_tpu.rollup import kernels
+    gidx = np.zeros(vals.size, np.int64)
+    part = kernels.delta_partials("ddsk", gidx, np.ones(vals.size, bool),
+                                  1, vals)
+    _, state = sk.decode_sketch(sk.encode_sketch("ddsk", part[0]))
+    for frac in (0.1, 0.5, 0.95):
+        est, ok = sk.finalize_sketch("ddsk", state, frac)
+        assert ok
+        true = float(np.quantile(vals, frac))
+        assert abs(est - true) / true < 0.06, (frac, est, true)
+
+
+def test_tdg_percentile_within_rank_bound():
+    rng = np.random.default_rng(13)
+    vals = rng.uniform(0.0, 100.0, 4000)
+    halves = [sk.tdg_from_values(vals[:2000]), sk.tdg_from_values(vals[2000:])]
+    word = sk.merge_sketch_words(sk.encode_sketch("tdg", halves[0]),
+                                 sk.encode_sketch("tdg", halves[1]))
+    _, state = sk.decode_sketch(word)
+    for frac in (0.1, 0.5, 0.9):
+        est, ok = sk.finalize_sketch("tdg", state, frac)
+        assert ok
+        # uniform[0,100]: value error == 100 * rank error; ~2% documented
+        assert abs(est - 100.0 * frac) < 5.0, (frac, est)
+
+
+def test_topk_exact_on_skewed_input():
+    from citus_tpu.rollup import kernels
+    values = np.array([7] * 50 + [11] * 30 + [13] * 5, np.int64)
+    gidx = np.zeros(values.size, np.int64)
+    counts, vals = kernels.delta_partials(
+        "topk", gidx, np.ones(values.size, bool), 1,
+        kernels.value_bits(values))
+    state = sk.empty_state("topk")
+    state[:sk.TOPK_M] = counts[0]
+    state[sk.TOPK_M:] = vals[0]
+    word = sk.encode_sketch("topk", state)
+    import json
+    top, ok = sk.finalize_sketch("topk", sk.decode_sketch(word)[1], 2)
+    assert ok
+    got = json.loads(top)
+    assert got[0] == {"value": 7, "count": 50}
+    assert got[1] == {"value": 11, "count": 30}
+
+
+# ----------------------------------------------------- wire formats
+
+def _words_fixture():
+    return [sk.encode_sketch(k, _random_state(k, i))
+            for i, k in enumerate(KINDS)] + [None, "hll:1:" + "A" * 172]
+
+
+@pytest.mark.parametrize("wire", ("frame", "npz"))
+def test_sketch_words_wire_roundtrip(wire):
+    words = _words_fixture()
+    arrays = sketch_words_to_arrays("apct_v", words)
+    blob = _encode_arrays(arrays, wire)
+    back = _decode_arrays(blob)
+    assert arrays_to_sketch_words(back, "apct_v") == words
+    # merged-through-the-wire equals merged-locally
+    a, b = words[0], sk.encode_sketch("hll", _random_state("hll", 42))
+    wired = arrays_to_sketch_words(
+        _decode_arrays(_encode_arrays(
+            sketch_words_to_arrays("c", [a]), wire)), "c")[0]
+    assert sk.merge_sketch_words(wired, b) == sk.merge_sketch_words(a, b)
+
+
+def test_sketch_words_empty_and_all_null():
+    for words in ([], [None, None]):
+        arrays = sketch_words_to_arrays("x", words)
+        blob = encode_frame(arrays)
+        assert arrays_to_sketch_words(decode_frame(blob), "x") == words
+
+
+# ---------------------------------------------------- storage cycle
+
+def test_sketch_column_storage_roundtrip(tmp_path):
+    """Words survive a real stripe write + reopen, merge correctly from
+    storage, and the skip list never records min/max for them."""
+    words = [sk.encode_sketch(k, _random_state(k, i + 20))
+             for i, k in enumerate(("hll", "ddsk", "topk"))]
+    db = str(tmp_path / "db")
+    cl = ct.Cluster(db, n_nodes=1)
+    cl.execute("CREATE TABLE st (k bigint, w sketch)")
+    cl.execute("SELECT create_distributed_table('st', 'k', 2)")
+    for i, w in enumerate(words):
+        cl.execute(f"INSERT INTO st VALUES ({i}, '{w}')")
+    cl.execute("INSERT INTO st VALUES (99, NULL)")
+    cl.close()
+
+    cl2 = ct.Cluster(db, n_nodes=1)
+    try:
+        got = dict(cl2.execute("SELECT k, w FROM st").rows)
+        assert [got[i] for i in range(3)] == words
+        assert got[99] is None
+        # stored word is still mergeable state, not an opaque string
+        merged = sk.merge_sketch_words(got[0], got[0])
+        assert sk.decode_sketch(merged)[0] == "hll"
+        # no min/max skip stats on the sketch stream (dictionary ids
+        # carry no value order, so any stat would invite bogus pruning)
+        from citus_tpu.storage.format import read_stripe_footer
+        shard_dirs = {
+            os.path.dirname(p) for p in glob.glob(
+                os.path.join(db, "**", "stripe-*.cts"), recursive=True)}
+        checked = 0
+        for sd in shard_dirs:
+            for stripe in glob.glob(os.path.join(sd, "stripe-*.cts")):
+                footer = read_stripe_footer(stripe)
+                if "w" not in footer.columns:
+                    continue
+                for cs in footer.columns["w"]:
+                    assert cs.minimum is None and cs.maximum is None
+                    checked += 1
+                for cs in footer.columns["k"]:
+                    assert cs.minimum is not None  # stats still on others
+        assert checked, "no sketch column chunks found on disk"
+    finally:
+        cl2.close()
+
+
+def test_invalid_word_rejected_at_insert(tmp_path):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=1,
+                    settings=Settings())
+    try:
+        cl.execute("CREATE TABLE st (k bigint, w sketch)")
+        with pytest.raises(AnalysisError):
+            cl.execute("INSERT INTO st VALUES (1, 'not-a-sketch')")
+    finally:
+        cl.close()

@@ -264,6 +264,12 @@ CONFINED_METHODS = {
     # side-effect — confining the write door keeps retention/rotation
     # accounting honest (no second writer aging the segments)
     "append_segment_line": ("observability/flight_recorder.py",),
+    # rollup refresh is the ONE door that advances a rollup past its
+    # watermark: delta fold + upsert + watermark write commit as a
+    # single transaction there (exactly-once restart replay); a second
+    # caller would double-apply deltas or tear the watermark
+    "refresh_once": ("rollup/manager.py",),
+    "_apply_batch": ("rollup/manager.py",),
 }
 
 #: method name -> files where calling it is banned outright
