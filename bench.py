@@ -344,6 +344,72 @@ def bench_wait_overhead(cl, extra: dict) -> None:
     }
 
 
+_SANITIZE_CHILD = r"""
+import json, sys, time
+import numpy as np
+import citus_tpu as ct
+from citus_tpu.config import Settings
+
+cl = ct.Cluster(sys.argv[1],
+                settings=Settings(start_maintenance_daemon=False))
+cl.execute("CREATE TABLE b (k bigint NOT NULL, v double)")
+cl.execute("SELECT create_distributed_table('b', 'k', 8)")
+n = int(sys.argv[2])
+cl.copy_from("b", columns={"k": np.arange(n, dtype=np.int64) % 97,
+                           "v": np.linspace(0.0, 1.0, n)})
+q = "SELECT k, count(*), sum(v) FROM b GROUP BY k"
+cl.execute(q)  # warm: compile + cache
+ts = []
+for _ in range(int(sys.argv[3])):
+    t0 = time.perf_counter()
+    cl.execute(q)
+    ts.append(time.perf_counter() - t0)
+cl.close()
+print(json.dumps({"best_ms": min(ts) * 1000}))
+"""
+
+
+def bench_sanitize_overhead(extra: dict) -> None:
+    """Concurrency-sanitizer cost (utils/sanitizer.py): warm Q1-shape
+    wall time in a fresh process with CITUS_SANITIZE unset vs =1
+    (every package lock wrapped, order graph + begin_wait hook live).
+    Also asserts the off-mode zero-cost contract in THIS process:
+    threading.Lock is still the raw C factory and the stats-seam guard
+    is one False attribute read — off mode must be a passthrough, not
+    merely cheap."""
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import threading as _th
+
+    from citus_tpu.utils import sanitizer as _san
+    assert _th.Lock is _san._real_Lock and not _san._ACTIVE, \
+        "sanitizer must be an exact passthrough when CITUS_SANITIZE is unset"
+    rows = int(os.environ.get("BENCH_SANITIZE_ROWS", "200000"))
+    reps = int(os.environ.get("BENCH_SANITIZE_REPS", "3"))
+
+    def run(sanitize: bool) -> float:
+        with tempfile.TemporaryDirectory() as td:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("CITUS_SANITIZE", None)
+            if sanitize:
+                env["CITUS_SANITIZE"] = "1"
+            out = subprocess.run(
+                [_sys.executable, "-c", _SANITIZE_CHILD,
+                 os.path.join(td, "db"), str(rows), str(reps)],
+                env=env, capture_output=True, timeout=600, check=True)
+            return json.loads(out.stdout)["best_ms"]
+
+    off_ms = run(False)
+    on_ms = run(True)
+    extra["sanitizer_overhead"] = {
+        "q1_sanitize_off_ms": round(off_ms, 2),
+        "q1_sanitize_on_ms": round(on_ms, 2),
+        "overhead_fraction": round(max(0.0, on_ms / off_ms - 1.0), 4),
+        "off_mode_passthrough": True,  # asserted above
+    }
+
+
 def bench_stat_fanout(extra: dict) -> None:
     """citus_cluster_metrics fan-out latency on a 3-node cluster
     (authority + two attached workers, all loopback): the wall cost of
@@ -1025,6 +1091,8 @@ def main() -> None:
         bench_recorder_overhead(cl, extra)
     if os.environ.get("BENCH_WAIT", "1") != "0":
         bench_wait_overhead(cl, extra)
+    if os.environ.get("BENCH_SANITIZE", "0") == "1":
+        bench_sanitize_overhead(extra)
     if os.environ.get("BENCH_FANOUT", "1") != "0":
         bench_stat_fanout(extra)
     if os.environ.get("BENCH_WIRE", "1") != "0":

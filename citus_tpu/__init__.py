@@ -27,6 +27,15 @@ import jax as _jax
 # must happen before any array is created
 _jax.config.update("jax_enable_x64", True)
 
+# the concurrency sanitizer (CITUS_SANITIZE=1|raise) wraps every lock
+# the package creates, so it must activate before any submodule import
+# runs a ``threading.Lock()``; a no-op when the env var is unset
+from citus_tpu.utils import sanitizer as _sanitizer
+
+_sanitizer.install()
+citus_sanitizer_report = _sanitizer.report
+citus_sanitizer_reset = _sanitizer.reset
+
 from citus_tpu.version import __version__
 from citus_tpu.config import Settings, current_settings
 from citus_tpu.cluster import Cluster

@@ -295,12 +295,20 @@ class RemoteTaskDispatch:
         for si, node, ep, task in tasks:
             pool = self._nodes.setdefault(int(node), _NodePool())
             pool.pending.append((si, node, ep, task))
-        with self._mu:
-            self._launch_locked()
+        self._launch()
 
     # ---- scheduling (caller holds self._mu) ----
-    def _launch_locked(self) -> None:
+    def _plan_locked(self) -> list:
+        """Pick every launchable task and bump the in-flight
+        accounting; returns fully-built submit descriptors.  The
+        actual ``submit`` (JSON encode + wake) happens OUTSIDE the
+        lock in ``_launch`` — under the old hold-``_mu``-across-submit
+        shape, the event-loop thread's completion callback blocked on
+        ``_mu`` for as long as a submitting caller spent encoding,
+        stalling every other in-flight RPC behind one thread's CPU
+        work (the citussan BLK01 loop-thread hazard)."""
         from citus_tpu.workload import GLOBAL_SCHEDULER
+        batch = []
         progress = True
         while progress:
             progress = False
@@ -312,7 +320,7 @@ class RemoteTaskDispatch:
                 elif GLOBAL_SCHEDULER.try_extra(self.shared_limit):
                     holds_slot = True
                 else:
-                    return  # shared pool saturated; retry on completion
+                    return batch  # pool saturated; retry on completion
                 si, node, ep, task = pool.pending.popleft()
                 pool.inflight += 1
                 self._inflight_total += 1
@@ -330,16 +338,34 @@ class RemoteTaskDispatch:
                     task = dict(task, trace={
                         "trace_id": tr.trace_id,
                         "parent_span_id": rspan.span_id})
+                batch.append((ep, task, pool, si, node, rspan,
+                              holds_slot))
+                progress = True
+        return batch
+
+    def _launch(self) -> None:
+        """Launch until no pool can accept more work: plan under the
+        (bookkeeping-only) lock, submit outside it.  Safe concurrently
+        from callers and the loop-thread done_cb: the accounting a plan
+        bumps is committed before ``_mu`` is released, so a racing plan
+        never double-launches a task."""
+        while True:
+            # lint: disable=BLK01 -- bookkeeping-only microsection: planning never encodes, submits, or blocks
+            with self._mu:
+                batch = self._plan_locked()
+            if not batch:
+                return
+            for ep, task, pool, si, node, rspan, holds_slot in batch:
                 t0 = _perf()
                 # done_cb runs ON the loop thread (never inline here),
-                # so holding self._mu across submit cannot deadlock
+                # so a caller may hold its own locks across _launch
                 self._loop.submit(
                     ep, "execute_task", task,
                     done_cb=lambda fut, pool=pool, si=si, node=node,
                     rspan=rspan, holds_slot=holds_slot, t0=t0:
+                    # lint: disable=BLK01 -- done_cb fires post-settle; _on_done's result()/lock never block the loop
                     self._on_done(fut, pool, si, node, rspan,
                                   holds_slot, t0))
-                progress = True
 
     # ---- one RPC settled (event-loop thread) ----
     def _on_done(self, fut, pool, si, node, rspan, holds_slot,
@@ -350,6 +376,7 @@ class RemoteTaskDispatch:
         meta = blob = None
         ok = True
         try:
+            # lint: disable=BLK01 -- done_cb fires after the future settles; result() returns immediately
             meta, blob = fut.result()
         # lint: disable=SWL01 -- failure is counted below as remote_task_fallbacks; shard rescans locally
         except Exception:
@@ -369,6 +396,7 @@ class RemoteTaskDispatch:
                 tr.graft(meta["spans"], rspan)
         if holds_slot:
             GLOBAL_SCHEDULER.release_extra()
+        # lint: disable=BLK01 -- bookkeeping-only microsection on the loop thread; no holder blocks inside it
         with self._mu:
             pool.inflight -= 1
             self._inflight_total -= 1
@@ -382,10 +410,11 @@ class RemoteTaskDispatch:
                 GLOBAL_COUNTERS.bump("remote_task_fallbacks")
             self._settled += 1
             self._t_last_done = _perf()
-            if not self._aborted:
-                self._launch_locked()
+            relaunch = not self._aborted
             if self._settled >= self._total and self._inflight_total == 0:
                 self._cv.notify_all()
+        if relaunch:
+            self._launch()
 
     # ---- caller side ----
     def collect(self) -> tuple[list[int], list]:

@@ -67,6 +67,7 @@ def get_lib():
         if LIB is not None or _attempted:
             return LIB
         _attempted = True
+        # lint: disable=BLK01 -- one-shot native build: the lock exists precisely to run make exactly once
         if _try_build():
             try:
                 LIB = _bind(ctypes.CDLL(_SO))

@@ -277,9 +277,11 @@ class RpcClient:
             with self._lock:
                 self._next_id += 1
                 rid = self._next_id
+                # lint: disable=BLK01 -- the client lock SERIALIZES the wire protocol: one request/response
                 _send(self._sock, {"id": rid, "method": method,
                                    "payload": payload or {}},
                       self.secret, blob=blob)
+                # lint: disable=BLK01 -- in flight per socket is the design; async callers use RpcEventLoop instead
                 got = _recv(self._sock, self.secret)
         except AuthError as e:
             raise RpcError(str(e)) from e

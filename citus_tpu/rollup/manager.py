@@ -146,7 +146,12 @@ class RollupManager:
         self._cluster = cluster
         self._stop = threading.Event()
         self._thread = None
-        self._refresh_mu = threading.Lock()
+        # refresh/drop serialize PER ROLLUP NAME through this busy set
+        # instead of one lock held across execute(): execute can park
+        # in admission_wait, and blocking there while holding a plain
+        # mutex is exactly the wait-under-lock stall citussan flags
+        self._busy_cv = threading.Condition()
+        self._busy: set = set()
 
     # ------------------------------------------------------- lifecycle
 
@@ -307,16 +312,34 @@ class RollupManager:
                           watermark=wm0, progress_insert=True)
         return spec
 
+    def _claim(self, name: str) -> None:
+        """Take the per-name refresh/drop slot (blocks while another
+        thread folds or drops the same rollup; holds NO lock after)."""
+        with self._busy_cv:
+            while name in self._busy:
+                self._busy_cv.wait()
+            self._busy.add(name)
+
+    def _unclaim(self, name: str) -> None:
+        with self._busy_cv:
+            self._busy.discard(name)
+            self._busy_cv.notify_all()
+
     def drop_rollup(self, name: str) -> None:
         cl = self._cluster
         if name not in cl.catalog.rollups:
             raise AnalysisError(f"rollup {name!r} does not exist")
-        with self._refresh_mu:
+        self._claim(name)
+        try:
+            if name not in cl.catalog.rollups:  # raced a concurrent drop
+                raise AnalysisError(f"rollup {name!r} does not exist")
             del cl.catalog.rollups[name]
             cl.catalog.commit()
             cl.execute(f"DROP TABLE {name}")
             cl.execute(f"DELETE FROM {PROGRESS_TABLE} "
                        f"WHERE rollup = {_sql_lit(name)}")
+        finally:
+            self._unclaim(name)
 
     def _ensure_progress_table(self) -> None:
         cl = self._cluster
@@ -343,7 +366,11 @@ class RollupManager:
         spec = cl.catalog.rollups.get(name)
         if spec is None:
             raise AnalysisError(f"rollup {name!r} does not exist")
-        with self._refresh_mu:
+        self._claim(name)
+        try:
+            spec = cl.catalog.rollups.get(name)
+            if spec is None:  # dropped while we waited for the slot
+                return None
             wm = self.watermark(name)
             if wm is None:
                 return None
@@ -378,6 +405,8 @@ class RollupManager:
             self._apply_batch(name, spec, flat_rows, need, watermark=upto,
                               progress_insert=False)
             return len(flat_rows)
+        finally:
+            self._unclaim(name)
 
     # --------------------------------------------------- batch folding
 

@@ -17,6 +17,7 @@ import itertools
 import re
 import threading
 import time
+from citus_tpu.utils import sanitizer as _san
 from citus_tpu.utils.clock import now as wall_now
 from bisect import bisect_left
 from collections import OrderedDict
@@ -274,6 +275,8 @@ def begin_wait(event: str):
         # lint: disable=SWL01 -- a broken sink must not break the waiting backend
         except Exception:
             pass
+    if _san._ACTIVE:  # one attribute read when the sanitizer is off
+        _san.on_begin_wait(event)
     from citus_tpu.observability.trace import clock
     return event, clock()
 

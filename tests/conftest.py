@@ -39,3 +39,17 @@ def tmp_cluster(tmp_path):
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _sanitizer_teardown_gate():
+    """When the suite runs under CITUS_SANITIZE, an empty
+    citus_sanitizer_report() at teardown is part of the contract —
+    findings any individual test missed still fail the run."""
+    yield
+    from citus_tpu.utils import sanitizer
+
+    if sanitizer.enabled():
+        findings = sanitizer.report()
+        assert not findings, (
+            "concurrency sanitizer findings at teardown: %r" % findings)

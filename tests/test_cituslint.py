@@ -548,3 +548,350 @@ def test_cli_main_exit_codes(tmp_path, capsys):
     clean = make_pkg(tmp_path / "c", {"m.py": "X = 1\n"})
     assert main([clean]) == 0
     assert main(["--list-rules"]) == 0
+
+
+# ------------------------------------------------------------- LOCK02
+
+ORDER_CYCLE_2 = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def ab(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def ba(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+ORDER_CONSISTENT = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._a:
+                with self._b:
+                    pass
+"""
+
+
+def test_lock_order_two_lock_cycle(tmp_path):
+    diags = run_lint(make_pkg(tmp_path, {"pair.py": ORDER_CYCLE_2}),
+                     select={"LOCK02"})
+    assert ids(diags) == ["LOCK02"]
+    msg = diags[0].message
+    assert "cycle" in msg and "Pair._a" in msg and "Pair._b" in msg
+
+
+def test_lock_order_consistent_is_quiet(tmp_path):
+    assert run_lint(make_pkg(tmp_path, {"pair.py": ORDER_CONSISTENT}),
+                    select={"LOCK02"}) == []
+
+
+def test_lock_order_three_lock_rotation(tmp_path):
+    src = """
+        import threading
+
+        class Trio:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._c = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def bc(self):
+                with self._b:
+                    with self._c:
+                        pass
+
+            def ca(self):
+                with self._c:
+                    with self._a:
+                        pass
+    """
+    diags = run_lint(make_pkg(tmp_path, {"trio.py": src}),
+                     select={"LOCK02"})
+    assert ids(diags) == ["LOCK02"]
+    for node in ("Trio._a", "Trio._b", "Trio._c"):
+        assert node in diags[0].message
+
+
+def test_lock_order_resolves_through_locked_helper(tmp_path):
+    src = """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def _grab_b_locked(self):
+                with self._b:
+                    pass
+
+            def ab(self):
+                with self._a:
+                    self._grab_b_locked()
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    diags = run_lint(make_pkg(tmp_path, {"pair.py": src}),
+                     select={"LOCK02"})
+    assert ids(diags) == ["LOCK02"]
+    assert "cycle" in diags[0].message
+
+
+def test_lock_order_self_reacquire_nonreentrant(tmp_path):
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def outer(self):
+                with self._mu:
+                    self._inner()
+
+            def _inner(self):
+                with self._mu:
+                    pass
+    """
+    diags = run_lint(make_pkg(tmp_path, {"box.py": src}),
+                     select={"LOCK02"})
+    assert ids(diags) == ["LOCK02"]
+    assert "self-deadlock" in diags[0].message
+
+
+def test_lock_order_rlock_reacquire_is_quiet(tmp_path):
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.RLock()
+
+            def outer(self):
+                with self._mu:
+                    self._inner()
+
+            def _inner(self):
+                with self._mu:
+                    pass
+    """
+    assert run_lint(make_pkg(tmp_path, {"box.py": src}),
+                    select={"LOCK02"}) == []
+
+
+# -------------------------------------------------------------- BLK01
+
+BLK_RPC_UNDER_LOCK = """
+    import threading
+
+    class Client:
+        def __init__(self, rpc):
+            self._mu = threading.Lock()
+            self.rpc = rpc
+
+        def fetch(self):
+            with self._mu:
+                return self.rpc.call_binary("get", {})
+"""
+
+BLK_RPC_OUTSIDE_LOCK = """
+    import threading
+
+    class Client:
+        def __init__(self, rpc):
+            self._mu = threading.Lock()
+            self.rpc = rpc
+            self.last = None
+
+        def fetch(self):
+            got = self.rpc.call_binary("get", {})
+            with self._mu:
+                self.last = got
+            return got
+"""
+
+
+def test_blocking_rpc_under_lock_fires(tmp_path):
+    diags = run_lint(make_pkg(tmp_path, {"c.py": BLK_RPC_UNDER_LOCK}),
+                     select={"BLK01"})
+    assert ids(diags) == ["BLK01"]
+    assert "RPC" in diags[0].message and "Client._mu" in diags[0].message
+
+
+def test_blocking_rpc_outside_lock_is_quiet(tmp_path):
+    assert run_lint(make_pkg(tmp_path, {"c.py": BLK_RPC_OUTSIDE_LOCK}),
+                    select={"BLK01"}) == []
+
+
+def test_blocking_sleep_on_loop_thread_fires(tmp_path):
+    src = """
+        import time
+
+        class RpcEventLoop:
+            def _run(self):
+                while True:
+                    time.sleep(0.1)
+    """
+    diags = run_lint(make_pkg(tmp_path, {"loop.py": src}),
+                     select={"BLK01"})
+    assert ids(diags) == ["BLK01"]
+    assert "time.sleep" in diags[0].message
+    assert "RpcEventLoop" in diags[0].message
+
+
+def test_blocking_done_cb_body_is_loop_reachable(tmp_path):
+    src = """
+        import time
+
+        class Dispatch:
+            def __init__(self, loop):
+                self._loop = loop
+
+            def go(self):
+                self._loop.submit(
+                    "ep", done_cb=lambda fut: self._settle(fut))
+
+            def _settle(self, fut):
+                time.sleep(1.0)
+    """
+    diags = run_lint(make_pkg(tmp_path, {"d.py": src}),
+                     select={"BLK01"})
+    assert ids(diags) == ["BLK01"]
+    assert "time.sleep" in diags[0].message
+
+
+def test_bounded_waits_under_lock_are_quiet(tmp_path):
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self, q, t):
+                self._mu = threading.Lock()
+                self.q = q
+                self.t = t
+
+            def drain(self):
+                with self._mu:
+                    item = self.q.get(timeout=1.0)
+                    self.t.join(5.0)
+                    return item
+    """
+    assert run_lint(make_pkg(tmp_path, {"b.py": src}),
+                    select={"BLK01"}) == []
+
+
+def test_unbounded_join_under_lock_fires(tmp_path):
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self, t):
+                self._mu = threading.Lock()
+                self.t = t
+
+            def stop(self):
+                with self._mu:
+                    self.t.join()
+    """
+    diags = run_lint(make_pkg(tmp_path, {"b.py": src}),
+                     select={"BLK01"})
+    assert ids(diags) == ["BLK01"]
+    assert "join" in diags[0].message
+
+
+# -------------------------------------------------------------- JIT01
+
+JIT_IMPURE = """
+    import jax
+
+    COUNTERS = None
+
+    def build():
+        def kern(x):
+            COUNTERS.bump("kernel_calls")
+            return x + 1
+        return jax.vmap(kern)
+"""
+
+JIT_PURE = """
+    import jax
+
+    def build():
+        def kern(x):
+            return x + 1
+        return jax.vmap(kern)
+"""
+
+
+def test_jit_purity_counter_bump_fires(tmp_path):
+    diags = run_lint(make_pkg(tmp_path, {"k.py": JIT_IMPURE}),
+                     select={"JIT01"})
+    assert ids(diags) == ["JIT01"]
+    assert "COUNTERS bump" in diags[0].message
+    assert "trace time" in diags[0].message
+
+
+def test_jit_purity_pure_kernel_is_quiet(tmp_path):
+    assert run_lint(make_pkg(tmp_path, {"k.py": JIT_PURE}),
+                    select={"JIT01"}) == []
+
+
+def test_jit_purity_clock_read_via_jit_compile(tmp_path):
+    src = """
+        import time
+
+        def build(cache):
+            def kern(x):
+                t0 = time.perf_counter()
+                return x * t0
+            return cache.jit_compile(kern)
+    """
+    diags = run_lint(make_pkg(tmp_path, {"k.py": src}),
+                     select={"JIT01"})
+    assert ids(diags) == ["JIT01"]
+    assert "clock read" in diags[0].message
+
+
+def test_new_rules_suppressible_with_pragma(tmp_path):
+    src = """
+        import threading
+
+        class Client:
+            def __init__(self, rpc):
+                self._mu = threading.Lock()
+                self.rpc = rpc
+
+            def fetch(self):
+                with self._mu:
+                    # lint: disable=BLK01 -- single-writer socket, lock IS the wire serializer
+                    return self.rpc.call_binary("get", {})
+    """
+    assert run_lint(make_pkg(tmp_path, {"c.py": src}),
+                    select={"BLK01", "SUP01", "SUP02"}) == []

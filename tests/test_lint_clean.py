@@ -143,3 +143,47 @@ def test_activity_tracker_reads_fake_clock(fake_clock):
     # (gpid, state, elapsed_s, sql, phase): exactly the fake delta
     assert row[2] == 30.0
     tr.exit(gpid)
+
+
+# -------------------------------------------------- citussan (PR 14)
+
+
+def test_concurrency_rules_clean():
+    """LOCK02/BLK01/JIT01 on the shipped tree: the lock-order graph is
+    acyclic, nothing blocks under a lock or on the event-loop thread
+    without a reviewed pragma, and every jit-traced body is pure."""
+    diags = run_lint(os.path.join(REPO_ROOT, "citus_tpu"),
+                     select={"LOCK02", "BLK01", "JIT01"})
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_background_loop_thread_hygiene():
+    """THR01/THR02 audit of the background loops (flight recorder,
+    rollup refresh, event-loop wake channel, maintenance, cleaner):
+    every thread has an explicit daemon= and a reachable bounded
+    join, statically enforced."""
+    diags = run_lint(os.path.join(REPO_ROOT, "citus_tpu"),
+                     select={"THR01", "THR02"})
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_event_loop_stop_is_bounded_and_daemon():
+    """Runtime half of the audit for the newest loop: the RpcEventLoop
+    thread is a daemon, close() returns promptly (bounded join), the
+    wake-channel socketpair is closed, and close() is idempotent."""
+    import time
+
+    from citus_tpu.net.event_loop import RpcEventLoop
+
+    loop = RpcEventLoop()
+    assert loop._thread.daemon is True
+    # the service thread starts lazily on first submit; an unreachable
+    # endpoint is fine — the future fails on the loop thread, and what
+    # we assert is that close() still joins within its 5s bound
+    fut = loop.submit(("127.0.0.1", 1), "ping", timeout=0.5)
+    t0 = time.perf_counter()
+    loop.close()
+    assert time.perf_counter() - t0 < 6.0
+    assert not loop._thread.is_alive()
+    assert fut.done()
+    loop.close()  # second close must not raise or hang

@@ -1,0 +1,767 @@
+"""citussan: whole-program concurrency rules (LOCK02/BLK01/JIT01).
+
+The per-attribute discipline rule (LOCK01) answers "is this shared
+attribute always written under its lock"; these three answer the next
+questions up the stack:
+
+========  ==============================================================
+LOCK02    lock acquisition ORDER: build the static lock-order graph —
+          an edge A→B for every ``with lockA:`` scope that acquires
+          lockB, resolved through same-class method calls (including
+          the ``*_locked`` helper convention) and same-module function
+          calls — and flag every cycle as a potential deadlock, plus
+          re-acquisition of a non-reentrant ``threading.Lock``.
+BLK01     blocking operations (socket recv/sendall/connect/accept,
+          RpcClient ``call_binary*``, ``time.sleep``, no-timeout
+          ``Thread.join``/``Queue.get``/``Future.result``,
+          ``subprocess.*``, ``open()`` file I/O) executed while a lock
+          is held, or from any function reachable on the
+          ``RpcEventLoop`` loop thread (seeded from ``_run`` and every
+          ``done_cb=`` passed to ``submit``); a lock acquire on the
+          loop thread is flagged too — a contended acquire there stalls
+          every in-flight RPC behind one caller.
+JIT01     jit purity: a function handed to ``jit_compile``/``jax.vmap``
+          (the doors ``kernel_cache.get_kernel`` builds flow through)
+          must not bump counters, read clocks, take locks, or do
+          tracer-visible I/O — those run ONCE at trace time and
+          silently vanish on every cache hit.
+========  ==============================================================
+
+All three are static over-approximations with the usual escape hatch:
+a justified ``# lint: disable=ID -- why this is safe`` pragma.  The
+runtime half of citussan (``citus_tpu/utils/sanitizer.py``,
+``CITUS_SANITIZE=1``) checks the same properties on the schedules the
+test suite actually executes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.cituslint.engine import ModuleIndex, PackageIndex, Rule
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
+                   "threading.Condition"}
+
+
+def _self_attr(node: ast.AST, self_name: str) -> Optional[str]:
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == self_name:
+        return node.attr
+    return None
+
+
+def _class_locks(mod: ModuleIndex, cls: ast.ClassDef) -> dict:
+    """``{attr: factory}`` for every ``self.<attr> = threading.Lock()``
+    (or RLock/Condition) assignment in ``__init__``."""
+    out: dict = {}
+    for meth in cls.body:
+        if not isinstance(meth, ast.FunctionDef) or meth.name != "__init__":
+            continue
+        args = meth.args.posonlyargs + meth.args.args
+        self_name = args[0].arg if args else "self"
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                factory = mod.dotted(node.value.func)
+                if factory in _LOCK_FACTORIES:
+                    for t in node.targets:
+                        attr = _self_attr(t, self_name)
+                        if attr is not None:
+                            out[attr] = factory
+    return out
+
+
+def _module_locks(mod: ModuleIndex) -> dict:
+    """``{name: factory}`` for module-level ``NAME = threading.Lock()``
+    (or RLock/Condition) assignments."""
+    out: dict = {}
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) \
+                and isinstance(stmt.value, ast.Call):
+            factory = mod.dotted(stmt.value.func)
+            if factory in _LOCK_FACTORIES:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = factory
+    return out
+
+
+def _module_functions(mod: ModuleIndex) -> dict:
+    return {stmt.name: stmt for stmt in mod.tree.body
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _class_methods(cls: ast.ClassDef) -> dict:
+    return {stmt.name: stmt for stmt in cls.body
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _fn_self_name(fn) -> Optional[str]:
+    args = fn.args.posonlyargs + fn.args.args
+    return args[0].arg if args else None
+
+
+def _iter_body_children(node: ast.AST):
+    """Children of ``node`` EXCLUDING nested function/lambda bodies —
+    code inside a nested def runs on its own schedule, not under the
+    locks lexically around its definition."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+
+
+class _FnScope:
+    """One analyzable function: its AST plus how to resolve the calls
+    it makes (same-class methods through ``self``, same-module
+    top-level functions by name)."""
+
+    __slots__ = ("mod", "fn", "cls", "methods", "funcs", "self_name")
+
+    def __init__(self, mod: ModuleIndex, fn, cls: Optional[ast.ClassDef],
+                 methods: dict, funcs: dict):
+        self.mod = mod
+        self.fn = fn
+        self.cls = cls
+        self.methods = methods
+        self.funcs = funcs
+        self.self_name = _fn_self_name(fn) if cls is not None else None
+
+    def key(self):
+        return (self.mod.rel, id(self.fn))
+
+    def resolve_call(self, call: ast.Call) -> Optional["_FnScope"]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and self.self_name \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id == self.self_name \
+                and f.attr in self.methods:
+            return _FnScope(self.mod, self.methods[f.attr], self.cls,
+                            self.methods, self.funcs)
+        if isinstance(f, ast.Name) and f.id in self.funcs:
+            return _FnScope(self.mod, self.funcs[f.id], None, {},
+                            self.funcs)
+        return None
+
+    def lock_node(self, expr: ast.AST, class_locks: dict,
+                  mod_locks: dict) -> Optional[str]:
+        """Graph-node id a ``with <expr>:`` item acquires, or None."""
+        if self.self_name and self.cls is not None:
+            attr = _self_attr(expr, self.self_name)
+            if attr in class_locks:
+                return f"{self.mod.rel}:{self.cls.name}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in mod_locks:
+            return f"{self.mod.rel}:{expr.id}"
+        return None
+
+
+def _iter_scopes(mod: ModuleIndex) -> Iterable[tuple]:
+    """Yield (scope, class_locks, mod_locks) for every top-level
+    function and every method of every class in ``mod``."""
+    mod_locks = _module_locks(mod)
+    funcs = _module_functions(mod)
+    for fn in funcs.values():
+        yield (_FnScope(mod, fn, None, {}, funcs), {}, mod_locks)
+    for cls in mod.tree.body:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        class_locks = _class_locks(mod, cls)
+        methods = _class_methods(cls)
+        for meth in methods.values():
+            yield (_FnScope(mod, meth, cls, methods, funcs),
+                   class_locks, mod_locks)
+
+
+# --------------------------------------------------------------- LOCK02
+
+
+class LockOrderRule(Rule):
+    """Static lock-acquisition-order graph: an edge A→B whenever code
+    holding A acquires B (lexically nested ``with`` blocks, resolved
+    through same-class and same-module calls, ``*_locked`` helpers
+    included).  Any cycle in the graph is a potential deadlock — two
+    threads entering it from different edges park on each other
+    forever.  Re-acquiring a non-reentrant ``threading.Lock`` already
+    held on the same path is a guaranteed self-deadlock and is flagged
+    directly."""
+
+    id = "LOCK02"
+    name = "lock acquisition order"
+
+    def check_package(self, pkg):
+        edges: dict = {}   # (a, b) -> (mod, line)
+        kinds: dict = {}   # node id -> factory dotted name
+        for mod in pkg.modules:
+            for scope, class_locks, mod_locks in _iter_scopes(mod):
+                for node, factory in class_locks.items():
+                    kinds.setdefault(
+                        f"{mod.rel}:{scope.cls.name}.{node}", factory)
+                for name, factory in mod_locks.items():
+                    kinds.setdefault(f"{mod.rel}:{name}", factory)
+                self._walk(scope, class_locks, mod_locks, (),
+                           frozenset([scope.key()]), edges, 0)
+        for (a, b), (mod, line) in sorted(
+                edges.items(), key=lambda kv: (kv[1][0].rel, kv[1][1])):
+            if a == b and kinds.get(a) == "threading.Lock":
+                yield self.diag(
+                    mod, line,
+                    f"re-acquires non-reentrant lock {a} while already "
+                    f"holding it on this path — guaranteed self-deadlock")
+        graph: dict = {}
+        for (a, b) in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        for cycle in _find_cycles(graph):
+            sites = [(edges[(cycle[i], cycle[(i + 1) % len(cycle)])], i)
+                     for i in range(len(cycle))]
+            (mod, line), _i = min(
+                sites, key=lambda s: (s[0][0].rel, s[0][1]))
+            path = " -> ".join(cycle + [cycle[0]])
+            where = "; ".join(
+                f"{cycle[i]}->{cycle[(i + 1) % len(cycle)]} at "
+                f"{m.rel}:{ln}" for (m, ln), i in sites)
+            yield self.diag(
+                mod, line,
+                f"lock-order cycle {path} (potential deadlock): {where}")
+
+    def _walk(self, scope: _FnScope, class_locks: dict, mod_locks: dict,
+              held: tuple, stack: frozenset, edges: dict,
+              depth: int) -> None:
+        if depth > 12:
+            return
+
+        def visit(node, held):
+            if isinstance(node, ast.With):
+                acquired = []
+                for item in node.items:
+                    ln = scope.lock_node(item.context_expr, class_locks,
+                                         mod_locks)
+                    if ln is not None:
+                        for h in held:
+                            edges.setdefault(
+                                (h, ln), (scope.mod, node.lineno))
+                        acquired.append(ln)
+                inner = held + tuple(acquired)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    ln = scope.lock_node(f.value, class_locks, mod_locks)
+                    if ln is not None:
+                        for h in held:
+                            edges.setdefault(
+                                (h, ln), (scope.mod, node.lineno))
+                callee = scope.resolve_call(node)
+                if callee is not None and callee.key() not in stack \
+                        and held:
+                    self._walk(callee, class_locks if callee.cls else {},
+                               mod_locks, held,
+                               stack | {callee.key()}, edges, depth + 1)
+            for child in _iter_body_children(node):
+                visit(child, held)
+
+        for stmt in scope.fn.body:
+            visit(stmt, held)
+
+
+def _find_cycles(graph: dict) -> list:
+    """Deterministic list of elementary cycles, one per strongly
+    connected component that contains one (node lists, rotation-
+    normalized to start at the smallest node)."""
+    index: dict = {}
+    low: dict = {}
+    on_stack: dict = {}
+    stack: list = []
+    sccs: list = []
+    counter = [0]
+    nodes = sorted(set(graph) | {b for bs in graph.values() for b in bs})
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack[v] = True
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif on_stack.get(w):
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack[w] = False
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(sorted(comp))
+
+    for v in nodes:
+        if v not in index:
+            strongconnect(v)
+
+    cycles = []
+    for comp in sccs:
+        comp_set = set(comp)
+        start = comp[0]
+        # one representative cycle: DFS inside the SCC back to start
+        path = [start]
+        seen = {start}
+
+        def dfs(v):
+            for w in sorted(graph.get(v, ())):
+                if w == start and len(path) > 1:
+                    return True
+                if w in comp_set and w not in seen:
+                    seen.add(w)
+                    path.append(w)
+                    if dfs(w):
+                        return True
+                    path.pop()
+            return False
+
+        if dfs(start):
+            cycles.append(list(path))
+    return cycles
+
+
+# ---------------------------------------------------------------- BLK01
+
+#: dotted calls that block the calling thread outright
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep()",
+    "socket.create_connection": "socket connect",
+}
+
+#: attribute-call names that block (receiver-typed; the resolver cannot
+#: see the receiver's type, so these are name-matched — precise enough
+#: because the names are idiomatically unambiguous in this tree)
+_BLOCKING_METHODS = {
+    "sendall": "socket sendall",
+    "recv": "socket recv",
+    "connect": "socket connect",
+    "accept": "socket accept",
+    "call_binary": "a synchronous RPC round-trip",
+    "call_binary_pooled": "a synchronous RPC round-trip",
+}
+
+
+#: locks whose PURPOSE is serializing a durable-log / on-disk append:
+#: holding them across the guarded file I/O is the design (WAL
+#: discipline — the write and the in-memory state it mirrors must
+#: commit atomically), so ``open()`` under them is exempt from BLK01.
+#: Sleeps, RPCs, joins, and subprocesses under them are still flagged.
+#: Reviewed like CONF01's tables: adding a lock here is a design
+#: decision, not a suppression.
+IO_SERIALIZING_LOCKS = {
+    # catalog document store: mutate-in-memory + rewrite-file is one
+    # critical section; a torn pair would desync every session
+    "catalog/catalog.py:Catalog._lock",
+    # CDC stream appends: LSN assignment and the segment append commit
+    # together (exactly-once replay depends on it)
+    "cdc.py:ChangeDataCapture._mu",
+    # 2PC outcome store and failover authority file: the decision and
+    # its durable record must be indivisible
+    "net/control_plane.py:ControlPlane._lock",
+    "net/control_plane.py:ControlPlane._failover_mu",
+    # flight-recorder segment writes: _io_mu exists solely to order
+    # rotate-vs-append; samples are small JSON lines
+    "observability/flight_recorder.py:FlightRecorder._io_mu",
+    # background-job records: claim/finish state flips pair with their
+    # on-disk store (crash adoption replays from it)
+    "services/background_jobs.py:BackgroundJobRunner._lock",
+    # the transaction WAL itself
+    "transaction/manager.py:TransactionLog._lock",
+    # causal-clock persistence: the tick and its floor file pair up
+    "utils/clock.py:CausalClock._mu",
+}
+
+
+def _blocking_desc(mod: ModuleIndex, call: ast.Call) -> Optional[str]:
+    """Human description when ``call`` is a blocking operation, else
+    None.  ``join()``/``get()``/``result()`` only count with zero
+    positional args and no timeout bound (``",".join(xs)`` and
+    ``d.get(k)`` take args; a bounded wait is a decision already
+    made)."""
+    dotted = mod.dotted(call.func)
+    if dotted in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[dotted]
+    if dotted is not None and dotted.split(".")[0] == "subprocess":
+        return f"{dotted}() subprocess"
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        return "file I/O (open)"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    name = call.func.attr
+    if name in _BLOCKING_METHODS:
+        return _BLOCKING_METHODS[name]
+    if name in ("join", "get", "result") and not call.args:
+        kwargs = {kw.arg for kw in call.keywords}
+        if "timeout" not in kwargs and "block" not in kwargs:
+            return {"join": "unbounded Thread.join()",
+                    "get": "unbounded Queue.get()",
+                    "result": "unbounded Future.result()"}[name]
+    return None
+
+
+def _fn_blockers(scope: _FnScope, memo: dict, stack: frozenset) -> list:
+    """Transitive blocking operations reachable from ``scope.fn``
+    through resolvable calls: ``[(desc, line)]`` (lines are in the
+    function that directly performs the operation)."""
+    key = scope.key()
+    if key in memo:
+        return memo[key]
+    out: list = []
+
+    def visit(node):
+        if isinstance(node, ast.Call):
+            desc = _blocking_desc(scope.mod, node)
+            if desc is not None:
+                out.append((desc, node.lineno))
+            callee = scope.resolve_call(node)
+            if callee is not None and callee.key() not in stack:
+                for desc, _line in _fn_blockers(
+                        callee, memo, stack | {callee.key()}):
+                    out.append((f"{desc} (via "
+                                f"{callee.fn.name}())", node.lineno))
+        for child in _iter_body_children(node):
+            visit(child)
+
+    for stmt in scope.fn.body:
+        visit(stmt)
+    memo[key] = out
+    return out
+
+
+class BlockingCallRule(Rule):
+    """Blocking operations in the two places they can wedge the whole
+    process: (a) while a ``threading`` lock is held — every other
+    thread needing that lock now waits on a peer's network/disk/sleep,
+    the classic convoy that turns one slow RPC into a stalled
+    coordinator; (b) in any function that runs on the ``RpcEventLoop``
+    loop thread (``_run`` and its callees, plus every ``done_cb``
+    handed to ``submit``) — the loop multiplexes ALL in-flight RPCs,
+    so one blocking call there stops the entire data-plane fan-out.
+    Lock acquires on the loop thread are flagged for the same reason:
+    a contended acquire blocks the loop behind whoever holds it."""
+
+    id = "BLK01"
+    name = "blocking call under lock / on event-loop thread"
+
+    def check_module(self, mod, pkg):
+        memo: dict = {}
+        for scope, class_locks, mod_locks in _iter_scopes(mod):
+            yield from self._check_under_lock(scope, class_locks,
+                                              mod_locks, memo)
+
+    def check_package(self, pkg):
+        loop_fns = self._loop_thread_scopes(pkg)
+        memo: dict = {}
+        for scope, class_locks, mod_locks in loop_fns:
+            for node in ast.walk(scope.fn):
+                if isinstance(node, ast.Call):
+                    desc = _blocking_desc(scope.mod, node)
+                    if desc is not None:
+                        yield self.diag(
+                            scope.mod, node.lineno,
+                            f"{scope.fn.name}() runs on the RpcEventLoop "
+                            f"thread but performs {desc} — a block here "
+                            f"stalls every in-flight RPC")
+                    callee = scope.resolve_call(node)
+                    if callee is not None \
+                            and callee.key() != scope.key():
+                        for desc, line in _fn_blockers(
+                                callee, memo,
+                                frozenset([callee.key()])):
+                            yield self.diag(
+                                scope.mod, node.lineno,
+                                f"{scope.fn.name}() runs on the "
+                                f"RpcEventLoop thread but calls "
+                                f"{callee.fn.name}() which performs "
+                                f"{desc} (line {line})")
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        ln = scope.lock_node(item.context_expr,
+                                             class_locks, mod_locks)
+                        if ln is not None:
+                            yield self.diag(
+                                scope.mod, node.lineno,
+                                f"{scope.fn.name}() acquires {ln} on the "
+                                f"RpcEventLoop thread — a contended "
+                                f"acquire stalls every in-flight RPC")
+
+    # ---- (a) blocking while a lock is held -----------------------------
+
+    def _check_under_lock(self, scope: _FnScope, class_locks: dict,
+                          mod_locks: dict, memo: dict):
+        base_held = scope.fn.name.endswith("_locked")
+        diags = []
+        # a *_locked helper's (unnamed) held lock is I/O-serializing
+        # when every lock its class owns is in the table
+        conv_io_ok = bool(class_locks) and scope.cls is not None and all(
+            f"{scope.mod.rel}:{scope.cls.name}.{attr}"
+            in IO_SERIALIZING_LOCKS for attr in class_locks)
+
+        def io_exempt(lock_name, desc) -> bool:
+            if not desc.startswith("file I/O"):
+                return False
+            if lock_name in IO_SERIALIZING_LOCKS:
+                return True
+            return lock_name is None and conv_io_ok
+
+        def visit(node, held):
+            if isinstance(node, ast.With):
+                acquired = [scope.lock_node(item.context_expr,
+                                            class_locks, mod_locks)
+                            for item in node.items]
+                acquired = [a for a in acquired if a is not None]
+                if acquired:
+                    inner = acquired[0]
+                else:
+                    inner = held
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call) and held:
+                lock_name = held if isinstance(held, str) else None
+                lock_desc = lock_name or "a lock (*_locked convention)"
+                desc = _blocking_desc(scope.mod, node)
+                if desc is not None and not io_exempt(lock_name, desc):
+                    diags.append(self.diag(
+                        scope.mod, node.lineno,
+                        f"{scope.fn.name}() performs {desc} while "
+                        f"holding {lock_desc}"))
+                callee = scope.resolve_call(node)
+                if callee is not None and callee.key() != scope.key():
+                    for desc, line in _fn_blockers(
+                            callee, memo, frozenset([callee.key()])):
+                        if io_exempt(lock_name, desc):
+                            continue
+                        diags.append(self.diag(
+                            scope.mod, node.lineno,
+                            f"{scope.fn.name}() calls "
+                            f"{callee.fn.name}() which performs {desc} "
+                            f"(line {line}) while holding {lock_desc}"))
+            for child in _iter_body_children(node):
+                visit(child, held)
+
+        for stmt in scope.fn.body:
+            visit(stmt, True if base_held else False)
+        return diags
+
+    # ---- (b) the event-loop thread's reachable set ---------------------
+
+    def _loop_thread_scopes(self, pkg: PackageIndex) -> list:
+        """Scopes that execute on the RpcEventLoop thread: ``_run`` and
+        its same-class callees (transitively), plus every function
+        passed as ``done_cb=`` to a ``.submit(...)`` call anywhere in
+        the package (lambdas resolve through the self-methods they
+        invoke), plus THEIR same-class callees."""
+
+        def build():
+            seeds: list = []
+            # seed 1: RpcEventLoop._run
+            for mod in pkg.modules:
+                for cls in mod.tree.body:
+                    if isinstance(cls, ast.ClassDef) \
+                            and cls.name == "RpcEventLoop":
+                        methods = _class_methods(cls)
+                        funcs = _module_functions(mod)
+                        if "_run" in methods:
+                            seeds.append(
+                                (_FnScope(mod, methods["_run"], cls,
+                                          methods, funcs),
+                                 _class_locks(mod, cls),
+                                 _module_locks(mod)))
+            # seed 2: done_cb= arguments to .submit() calls
+            for mod in pkg.modules:
+                funcs = _module_functions(mod)
+                mod_locks = _module_locks(mod)
+                for cls in [None] + [c for c in mod.tree.body
+                                     if isinstance(c, ast.ClassDef)]:
+                    body = mod.tree.body if cls is None else cls.body
+                    methods = _class_methods(cls) if cls else {}
+                    class_locks = _class_locks(mod, cls) if cls else {}
+                    for holder in body:
+                        if not isinstance(holder, (ast.FunctionDef,
+                                                   ast.AsyncFunctionDef)):
+                            continue
+                        self_name = _fn_self_name(holder) if cls else None
+                        for call in ast.walk(holder):
+                            if not (isinstance(call, ast.Call)
+                                    and isinstance(call.func,
+                                                   ast.Attribute)
+                                    and call.func.attr == "submit"):
+                                continue
+                            for kw in call.keywords:
+                                if kw.arg != "done_cb":
+                                    continue
+                                for target in self._cb_targets(
+                                        kw.value, self_name, methods,
+                                        funcs):
+                                    seeds.append(
+                                        (_FnScope(mod, target, cls,
+                                                  methods, funcs),
+                                         class_locks, mod_locks))
+            # close over same-class / same-module resolvable calls
+            out: list = []
+            seen: set = set()
+            queue = list(seeds)
+            while queue:
+                scope, class_locks, mod_locks = queue.pop()
+                if scope.key() in seen:
+                    continue
+                seen.add(scope.key())
+                out.append((scope, class_locks, mod_locks))
+                for node in ast.walk(scope.fn):
+                    if isinstance(node, ast.Call):
+                        callee = scope.resolve_call(node)
+                        if callee is not None \
+                                and callee.key() not in seen:
+                            queue.append(
+                                (callee,
+                                 class_locks if callee.cls else {},
+                                 mod_locks))
+            return out
+
+        return pkg.cached("blk01_loop_scopes", build)
+
+    def _cb_targets(self, expr: ast.AST, self_name: Optional[str],
+                    methods: dict, funcs: dict) -> list:
+        """Function nodes a ``done_cb=<expr>`` resolves to."""
+        out: list = []
+        if isinstance(expr, ast.Lambda):
+            for node in ast.walk(expr.body):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and self_name \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == self_name \
+                        and node.func.attr in methods:
+                    out.append(methods[node.func.attr])
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id in funcs:
+                    out.append(funcs[node.func.id])
+        elif isinstance(expr, ast.Attribute) and self_name \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == self_name \
+                and expr.attr in methods:
+            out.append(methods[expr.attr])
+        elif isinstance(expr, ast.Name) and expr.id in funcs:
+            out.append(funcs[expr.id])
+        return out
+
+
+# ---------------------------------------------------------------- JIT01
+
+#: dotted calls whose value changes between trace time and run time —
+#: inside a traced body they freeze to one trace-time constant
+_IMPURE_DOTTED = {
+    "time.time": "wall-clock read",
+    "time.perf_counter": "clock read",
+    "time.monotonic": "clock read",
+    "citus_tpu.utils.clock.now": "wall-clock read",
+    "citus_tpu.observability.trace.clock": "clock read",
+}
+
+_IMPURE_METHODS = {
+    "bump": "COUNTERS bump",
+    "bump_max": "COUNTERS bump",
+    "acquire": "lock acquire",
+    "begin_wait": "wait-event bracket",
+}
+
+
+class JitPurityRule(Rule):
+    """Purity of traced bodies: any function lifted through
+    ``jit_compile(f)`` or ``jax.vmap(f)`` (the only doors into the
+    kernel cache) executes ONCE under the tracer; counter bumps, clock
+    reads, lock acquires, wait brackets, and I/O inside it are burned
+    into the trace — they fire at compile time and silently never
+    again on a cache hit, so the stats lie exactly when the cache
+    works."""
+
+    id = "JIT01"
+    name = "jit-traced body purity"
+
+    _LIFTERS = {"jax.vmap"}
+
+    def check_module(self, mod, pkg):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            dotted = mod.dotted(node.func)
+            is_lifter = dotted in self._LIFTERS or (
+                dotted is not None
+                and dotted.split(".")[-1] == "jit_compile")
+            if not is_lifter:
+                continue
+            target = self._resolve_fn_arg(node.args[0], node)
+            if target is None:
+                continue
+            fname, body = target
+            yield from self._check_body(mod, fname, body)
+
+    def _resolve_fn_arg(self, arg: ast.AST, call: ast.Call):
+        """(name, body-stmts) when the lifted argument is a local
+        ``def``/``lambda``; None for opaque builder-call results
+        (``build_worker_fn(plan, jnp)`` — checked at their own
+        ``jit_compile`` sites when they have one)."""
+        if isinstance(arg, ast.Lambda):
+            return ("<lambda>", [ast.Expr(value=arg.body)])
+        if not isinstance(arg, ast.Name):
+            return None
+        # walk outward through enclosing scopes for a matching def
+        cur = call
+        while cur is not None:
+            cur = getattr(cur, "_lint_parent", None)
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Module)):
+                for stmt in cur.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and stmt.name == arg.id:
+                        return (stmt.name, stmt.body)
+        return None
+
+    def _check_body(self, mod: ModuleIndex, fname: str, body):
+        for stmt in body:
+            for node in ast.walk(stmt):
+                what = None
+                if isinstance(node, ast.Call):
+                    dotted = mod.dotted(node.func)
+                    if dotted in _IMPURE_DOTTED:
+                        what = _IMPURE_DOTTED[dotted]
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in _IMPURE_METHODS:
+                        what = _IMPURE_METHODS[node.func.attr]
+                    elif isinstance(node.func, ast.Name) \
+                            and node.func.id in ("print", "open",
+                                                 "begin_wait"):
+                        what = ("wait-event bracket"
+                                if node.func.id == "begin_wait"
+                                else "tracer-visible I/O")
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        ctx = item.context_expr
+                        if isinstance(ctx, ast.Attribute) \
+                                and ctx.attr.startswith(("_mu", "_lock",
+                                                         "_cv")):
+                            what = "lock acquire"
+                if what is not None:
+                    yield self.diag(
+                        mod, node.lineno,
+                        f"{fname}() is jit-traced but performs {what} "
+                        f"inside the traced body — it fires once at "
+                        f"trace time and vanishes on every cache hit")

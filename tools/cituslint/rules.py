@@ -39,6 +39,9 @@ import re
 from typing import Optional
 
 from tools.cituslint.engine import ModuleIndex, PackageIndex, Rule
+from tools.cituslint.concurrency import (
+    BlockingCallRule, JitPurityRule, LockOrderRule,
+)
 
 # --------------------------------------------------------------- LOCK01
 
@@ -860,6 +863,9 @@ class TodoMarkerRule(Rule):
 
 ALL_RULES = [
     LockDisciplineRule,
+    LockOrderRule,
+    BlockingCallRule,
+    JitPurityRule,
     ConfinedCallRule,
     ThreadDaemonRule,
     ThreadJoinRule,
