@@ -675,6 +675,153 @@ def bench_workload(extra: dict) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_multi_coordinator(extra: dict) -> None:
+    """Query-from-any-node scaling (citus_tpu/metadata/): aggregate QPS
+    as 1 -> 2 -> 4 coordinator OS processes serve zipfian mixed traffic
+    from a ~1M-tenant namespace against one shared cluster.  Every
+    coordinator admits from the SAME catalog-persisted quota table (64
+    registered heavy hitters in two priority classes, the long tail on
+    GUC defaults), so the run also proves zero divergent admission
+    decisions: each process reports a fingerprint over the effective
+    admission inputs of fixed probe tenants, and all must match.
+
+    Scaling is only meaningful when host cores >= coordinator count;
+    the record carries host_cores so a 1-core container's flat curve
+    reads as saturation, not a sync-engine bottleneck."""
+    import shutil
+    import subprocess as sp
+    import tempfile
+    import textwrap
+
+    import citus_tpu as ct
+    clients = int(os.environ.get("BENCH_MC_CLIENTS", "4"))
+    seconds = float(os.environ.get("BENCH_MC_SECONDS", "3"))
+    tenant_space = int(os.environ.get("BENCH_MC_TENANTS", "1000000"))
+    counts = [1, 2, 4]
+    root = tempfile.mkdtemp(prefix="bench_multicoord_", dir=_HERE)
+    d = os.path.join(root, "db")
+
+    child_code = textwrap.dedent(f"""
+        import hashlib, json, sys, threading, time
+        import numpy as np
+        import citus_tpu as ct
+        from citus_tpu.workload import GLOBAL_TENANTS
+        seat = int(sys.argv[1])
+        cl = ct.Cluster({d!r}, coordinator=("127.0.0.1", PORT))
+        cl.metadata_sync.sync_once()
+        # admission fingerprint over fixed probe tenants: registered
+        # heavy hitters AND defaulted long-tail ids; any divergence in
+        # quotas, classes, or GUC fallbacks changes the digest
+        wl = cl.settings.workload
+        probe = []
+        for t in [str(i) for i in range(1, 65)] + ["999983", "717171"]:
+            q = GLOBAL_TENANTS.get(t)
+            pclass = (q.priority_class if q and q.priority_class
+                      else wl.tenant_default_priority_class)
+            probe.append((t, q.weight if q else wl.tenant_default_weight,
+                          q.max_concurrency if q else 0,
+                          q.rate_limit_qps if q else wl.tenant_rate_limit_qps,
+                          q.queue_depth if q else wl.tenant_queue_depth,
+                          pclass, GLOBAL_TENANTS.class_weight(pclass)))
+        fp = hashlib.sha1(json.dumps(probe).encode()).hexdigest()[:16]
+        cl.execute("SELECT sum(v) FROM mt WHERE k = 1")  # warm
+        print("READY", flush=True)
+        sys.stdin.readline()  # GO
+        counts = [0] * {clients}
+
+        def loop(ci):
+            rng = np.random.default_rng(1000 * seat + ci)
+            i = 0
+            deadline = time.monotonic() + {seconds}
+            while time.monotonic() < deadline:
+                # zipfian tenant draw over the ~{tenant_space} namespace
+                t = int(min(rng.zipf(1.2), {tenant_space}))
+                sql = ("SELECT count(*), sum(v) FROM mt" if i % 8 == 7
+                       else f"SELECT sum(v) FROM mt WHERE k = {{t}}")
+                try:
+                    cl.execute(sql)
+                    counts[ci] += 1
+                except Exception:
+                    pass
+                i += 1
+        ts = [threading.Thread(target=loop, args=(ci,))
+              for ci in range({clients})]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        cl.close()
+        print("RESULT " + json.dumps(
+            {{"count": sum(counts), "wall": wall, "fingerprint": fp}}),
+            flush=True)
+    """)
+
+    a = ct.Cluster(d, serve_port=0)
+    procs = []
+    try:
+        a.execute("CREATE TABLE mt (k bigint NOT NULL, v bigint)")
+        a.execute("SELECT create_distributed_table('mt', 'k', 8)")
+        n = 200_000
+        rng = np.random.default_rng(7)
+        keys = np.minimum(rng.zipf(1.2, size=n), tenant_space).astype(np.int64)
+        a.copy_from("mt", columns={"k": keys, "v": np.arange(n)})
+        # replicated control plane: two priority classes, 64 registered
+        # heavy hitters, the other ~1M tenants on GUC defaults
+        a.execute("SELECT citus_add_priority_class('gold', 4.0)")
+        a.execute("SELECT citus_add_priority_class('basic', 1.0)")
+        for t in range(1, 65):
+            pc = "gold" if t <= 8 else "basic"
+            a.execute(f"SELECT citus_add_tenant_quota('{t}', 2.0, 0, 0.0,"
+                      f" 0, '{pc}')")
+        qps_by_count = {}
+        fingerprints = set()
+        code = ("import jax\njax.config.update('jax_platforms','cpu')\n"
+                + child_code.replace("PORT", str(a.control_port)))
+        for k in counts:
+            procs = [sp.Popen([sys.executable, "-c", code, str(seat)],
+                              stdin=sp.PIPE, stdout=sp.PIPE, text=True)
+                     for seat in range(k)]
+            for p in procs:
+                assert p.stdout.readline().strip() == "READY"
+            for p in procs:
+                p.stdin.write("GO\n")
+                p.stdin.flush()
+            total = 0
+            for p in procs:
+                line = p.stdout.readline()
+                assert line.startswith("RESULT "), line
+                r = json.loads(line[len("RESULT "):])
+                total += r["count"] / max(r["wall"], 1e-9)
+                fingerprints.add(r["fingerprint"])
+                p.wait()
+            procs = []
+            qps_by_count[str(k)] = round(total, 1)
+        q1 = qps_by_count["1"]
+        extra["multi_coordinator"] = {
+            "host_cores": os.cpu_count() or 1,
+            "clients_per_coordinator": clients,
+            "duration_s": seconds,
+            "tenant_namespace": tenant_space,
+            "registered_quotas": 64,
+            "qps_by_coordinators": qps_by_count,
+            "scaling_x2": round(qps_by_count["2"] / max(q1, 1e-9), 2),
+            "scaling_x4": round(qps_by_count["4"] / max(q1, 1e-9), 2),
+            # one distinct fingerprint across every coordinator = zero
+            # divergent admission decisions
+            "admission_fingerprints": len(fingerprints),
+            "divergent_admission_decisions": len(fingerprints) - 1,
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        a.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_rollup(extra: dict) -> None:
     """Continuous-aggregation A/B (rollup/): a dashboard closed loop
     runs against a wide event table while writer threads keep heavy
@@ -1099,6 +1246,8 @@ def main() -> None:
         bench_wire(extra)
     if os.environ.get("BENCH_WORKLOAD", "1") != "0":
         bench_workload(extra)
+    if os.environ.get("BENCH_MULTICOORD", "1") != "0":
+        bench_multi_coordinator(extra)
     if os.environ.get("BENCH_REBALANCE", "1") != "0":
         bench_rebalance(extra)
     if os.environ.get("BENCH_ROLLUP", "1") != "0":

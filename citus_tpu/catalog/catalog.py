@@ -191,6 +191,10 @@ class NodeMeta:
     # process's own data directory (shared-dir / single-host mode).
     host: Optional[str] = None
     port: Optional[int] = None
+    # citus_activate_node_metadata marked this node as a full metadata
+    # peer (pg_dist_node.hasmetadata analog): it runs the sync engine
+    # and may plan/admit locally ("query from any node")
+    metadata_synced: bool = False
 
     @property
     def endpoint(self) -> Optional[tuple]:
@@ -203,12 +207,15 @@ class NodeMeta:
         if self.host is not None:
             d["host"] = self.host
             d["port"] = self.port
+        if self.metadata_synced:
+            d["metadata_synced"] = True
         return d
 
     @staticmethod
     def from_json(d):
         return NodeMeta(d["node_id"], d["is_active"],
-                        d.get("host"), d.get("port"))
+                        d.get("host"), d.get("port"),
+                        bool(d.get("metadata_synced", False)))
 
 
 def _catalog_flock(data_dir: str):
@@ -298,6 +305,14 @@ class Catalog:
         # the refresh watermark lives in the rollup progress TABLE, not
         # here — it must commit atomically with the delta apply)
         self.rollups: dict[str, dict] = {}
+        # replicated tenant control plane (metadata/quotas.py is the
+        # only write door, cituslint CONF01): tenant -> {"weight",
+        # "max_concurrency", "rate_limit_qps", "queue_depth",
+        # "priority_class"}; priority class -> {"weight"}.  Persisting
+        # quotas here is what makes admission decisions identical on
+        # every coordinator (PR 9 kept them process-local).
+        self.tenant_quotas: dict[str, dict] = {}
+        self.priority_classes: dict[str, dict] = {}
         # sequences: name -> {"value": next unreserved, "increment": n,
         # "start": n}; nextval hands out values from an in-memory block
         # reserved by bumping the persisted high-water mark (gaps on
@@ -403,6 +418,8 @@ class Catalog:
             self.publications = d.get("publications", {})
             self.statistics = d.get("statistics", {})
             self.rollups = d.get("rollups", {})
+            self.tenant_quotas = d.get("tenant_quotas", {})
+            self.priority_classes = d.get("priority_classes", {})
 
     def export_document(self) -> dict:
         from citus_tpu.catalog.migrations import CATALOG_FORMAT_VERSION
@@ -431,6 +448,8 @@ class Catalog:
             "publications": self.publications,
             "statistics": self.statistics,
             "rollups": self.rollups,
+            "tenant_quotas": self.tenant_quotas,
+            "priority_classes": self.priority_classes,
         }
 
     def tombstone(self, section: str, name: str) -> None:
@@ -438,6 +457,29 @@ class Catalog:
         dropped object from a concurrent coordinator's document."""
         with self._lock:
             self._tombstones.setdefault(section, set()).add(name)
+
+    # ---- replicated tenant control plane ------------------------------
+    # The three writers below mutate the catalog-persisted quota
+    # sections WITHOUT committing; metadata/quotas.py (the one file
+    # cituslint CONF01 admits) wraps them in the 2PC
+    # commit_metadata_flip sequence and mirrors the result into the
+    # process-local registry.  A write anywhere else would change this
+    # coordinator's admission behavior without replicating it.
+
+    def put_tenant_quota(self, tenant: str, quota: dict) -> None:
+        with self._lock:
+            self.tenant_quotas[tenant] = dict(quota)
+
+    def drop_tenant_quota(self, tenant: str) -> bool:
+        with self._lock:
+            found = self.tenant_quotas.pop(tenant, None) is not None
+            if found:
+                self.tombstone("tenant_quotas", tenant)
+            return found
+
+    def put_priority_class(self, name: str, weight: float) -> None:
+        with self._lock:
+            self.priority_classes[name] = {"weight": float(weight)}
 
     def _merge_foreign_locked(self) -> None:
         """Adopt another coordinator's catalog changes before storing
@@ -488,7 +530,8 @@ class Catalog:
                     "enum_columns", "schemas", "rls",
                     "triggers", "ts_configs", "extensions", "domains",
                     "collations", "publications", "statistics",
-                    "rollups", "domain_columns"):
+                    "rollups", "domain_columns",
+                    "tenant_quotas", "priority_classes"):
             disk = d.get(sec, {})
             mem = getattr(self, sec)
             dead = tomb.get(sec, set())

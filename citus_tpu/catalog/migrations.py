@@ -22,7 +22,7 @@ from __future__ import annotations
 from citus_tpu.errors import CatalogError
 
 #: the document shape this build writes
-CATALOG_FORMAT_VERSION = 2
+CATALOG_FORMAT_VERSION = 3
 
 #: every section the current shape carries with an empty default —
 #: migration 0->1 materializes them so later code never .get()-guards
@@ -57,10 +57,19 @@ def _migrate_1_to_2(doc: dict) -> None:
             nd.pop("host")  # half-written endpoint: meaningless alone
 
 
+def _migrate_2_to_3(doc: dict) -> None:
+    """Round-5 shape -> round-6: the tenant control plane moves into
+    the catalog (tenant quotas + priority classes replicate to every
+    coordinator instead of living process-local)."""
+    doc.setdefault("tenant_quotas", {})
+    doc.setdefault("priority_classes", {})
+
+
 #: ordered, append-only: MIGRATIONS[v] lifts a version-v document to v+1
 MIGRATIONS = {
     0: _migrate_0_to_1,
     1: _migrate_1_to_2,
+    2: _migrate_2_to_3,
 }
 
 

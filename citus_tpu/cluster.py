@@ -727,6 +727,17 @@ class Cluster:
             # lease and ship the document over RPC (push_catalog)
             self.catalog.commit_transport = self._control
         self.catalog.on_commit = self._on_catalog_commit
+        # metadata sync engine (metadata/sync.py): per-object
+        # pull-on-mismatch convergence against the authority; the
+        # interval loop only runs while attached and
+        # citus.metadata_sync_interval_ms > 0
+        from citus_tpu.metadata import MetadataSync, hydrate_tenant_registry
+        self.metadata_sync = MetadataSync(self)
+        self.metadata_sync.apply()
+        # mirror the catalog-persisted tenant control plane into the
+        # process-local registry, so this coordinator admits identically
+        # to every other holder of the same document from statement one
+        hydrate_tenant_registry(self.catalog)
         # mtime-poll baseline: our own open-time commit; anything newer
         # is a foreign change (avoids missing commits that land between
         # construction and the first execute)
@@ -757,8 +768,10 @@ class Cluster:
     def _on_foreign_catalog_applied(self) -> None:
         """A pushed catalog document was just stored into our live
         catalog (authority side): drop cached plans keyed on the old
-        metadata."""
+        metadata and re-mirror the replicated tenant sections."""
         self._plan_cache.clear()
+        from citus_tpu.metadata import hydrate_tenant_registry
+        hydrate_tenant_registry(self.catalog)
 
     @property
     def control_port(self) -> Optional[int]:
@@ -832,6 +845,7 @@ class Cluster:
         if self._maintenance is not None:
             self._maintenance.stop()
         self.rollup_manager.stop()
+        self.metadata_sync.stop()
         # sampler joined before the servers drop; the reset hook must
         # not outlive this handle (GLOBAL_COUNTERS is process-global)
         self.flight_recorder.stop()
@@ -946,7 +960,13 @@ class Cluster:
         if self._control is not None and self._control.connected:
             if self._catalog_dirty:
                 self._catalog_dirty = False
-                self._reload_catalog()
+                # this statement would have planned against stale
+                # metadata had the invalidation not been honored
+                self.counters.bump("metadata_stale_reads")
+                # incremental first: pull exactly the divergent objects
+                # (metadata/sync.py); fall back to the full document
+                if not self.metadata_sync.pull_on_mismatch():
+                    self._reload_catalog()
                 try:
                     self._catalog_mtime = os.path.getmtime(self.catalog._path())
                 except OSError:
@@ -997,6 +1017,9 @@ class Cluster:
                 self.catalog.nodes = {}
             self.catalog.ddl_epoch += 1  # invalidate cached plans
         self._plan_cache.clear()
+        # replicated tenant sections may have changed with the document
+        from citus_tpu.metadata import hydrate_tenant_registry
+        hydrate_tenant_registry(self.catalog)
 
     # ------------------------------------------------------------- DDL
     def create_table(self, name: str, schema: Schema, *, if_not_exists: bool = False,

@@ -109,6 +109,20 @@ _GUCS = {
     "citus.tenant_default_weight": ("workload", "tenant_default_weight", float),
     "citus.tenant_queue_depth": ("workload", "tenant_queue_depth", int),
     "citus.tenant_rate_limit_qps": ("workload", "tenant_rate_limit_qps", float),
+    # priority class for tenants without an explicit class (the
+    # two-level stride tree's fallback node, workload/scheduler.py)
+    "citus.tenant_default_priority_class": ("workload",
+                                            "tenant_default_priority_class",
+                                            str),
+    # multi-coordinator metadata sync (metadata/sync.py): background
+    # pull-on-mismatch cadence (ms; 0 = loop off, sync still runs at
+    # invalidation + citus_sync_metadata()) and the incremental-sync
+    # master switch (off = full-document fetch per invalidation)
+    "citus.metadata_sync_interval_ms": ("metadata",
+                                        "metadata_sync_interval_ms",
+                                        float),
+    "citus.enable_metadata_sync": ("metadata", "enable_metadata_sync",
+                                   "bool"),
     # distributed tracing (observability/): span-tree sampling rate,
     # slow-query force-capture threshold (ms; -1 off), Chrome-trace
     # export directory ("" off)
@@ -250,6 +264,9 @@ def _execute_set(cl, stmt: A.SetConfig) -> Result:
         cl.flight_recorder.apply()  # start/stop the sampler to match
     elif key == "citus.rollup_refresh_interval_ms":
         cl.rollup_manager.apply()  # start/stop the refresh loop
+    elif key in ("citus.metadata_sync_interval_ms",
+                 "citus.enable_metadata_sync"):
+        cl.metadata_sync.apply()  # start/stop the sync loop to match
     cl._plan_cache.clear()  # backend/knob changes invalidate plans
     return Result(columns=[], rows=[])
 

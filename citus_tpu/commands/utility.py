@@ -362,6 +362,7 @@ _HEALTH_SEVERITY = {
     "pool_saturation": "critical",
     "dead_node": "critical",
     "device_probe_wedged": "warning",
+    "metadata_sync_lag": "warning",
 }
 
 
@@ -675,6 +676,30 @@ def _citus_activate_node(cl, name, args):
     return Result(columns=[name], rows=[(nid,)])
 
 
+@utility("citus_activate_node_metadata")
+def _citus_activate_node_metadata(cl, name, args):
+    # start_metadata_sync_to_node/citus_activate_node analog: mark the
+    # node a full metadata peer (pg_dist_node.hasmetadata) so it plans
+    # and admits locally; the sync engine keeps its catalog converged
+    nid = int(args[0])
+    if nid not in cl.catalog.nodes:
+        raise CatalogError(f"node {nid} does not exist")
+    cl.catalog.nodes[nid].metadata_synced = True
+    cl.catalog.ddl_epoch += 1
+    cl.catalog.commit()
+    return Result(columns=[name], rows=[(nid,)])
+
+
+@utility("citus_sync_metadata")
+def _citus_sync_metadata(cl, name, args):
+    # one on-demand pull-on-mismatch round against the metadata
+    # authority (the interval loop's unit of work); returns how many
+    # catalog objects were applied — 0 means already converged, and on
+    # the authority itself there is nothing to pull from
+    applied = cl.metadata_sync.sync_once()
+    return Result(columns=["objects_applied"], rows=[(applied,)])
+
+
 @utility("citus_get_active_worker_nodes")
 def _citus_get_active_worker_nodes(cl, name, args):
     return Result(columns=["node_id"],
@@ -811,32 +836,53 @@ def _isolate_tenant_to_new_shard(cl, name, args):
 @utility("citus_add_tenant_quota")
 def _citus_add_tenant_quota(cl, name, args):
     # SELECT citus_add_tenant_quota(tenant, weight [, max_concurrency
-    # [, rate_limit_qps [, queue_depth]]]) — control half of the
-    # workload scheduler (workload/registry.py); 0 falls back to the
-    # citus.tenant_* GUC defaults
-    from citus_tpu.workload import GLOBAL_TENANTS
-    GLOBAL_TENANTS.set_quota(
-        str(args[0]),
+    # [, rate_limit_qps [, queue_depth [, priority_class]]]]) — a
+    # REPLICATED catalog write (metadata/quotas.py): the quota persists
+    # in the catalog document and every coordinator's registry mirrors
+    # it, so admission decisions match cluster-wide; 0/"" falls back to
+    # the citus.tenant_* GUC defaults
+    from citus_tpu.metadata import replicated_set_quota
+    replicated_set_quota(
+        cl, str(args[0]),
         weight=float(args[1]) if len(args) > 1 else 0.0,
         max_concurrency=int(args[2]) if len(args) > 2 else 0,
         rate_limit_qps=float(args[3]) if len(args) > 3 else 0.0,
-        queue_depth=int(args[4]) if len(args) > 4 else 0)
+        queue_depth=int(args[4]) if len(args) > 4 else 0,
+        priority_class=str(args[5]) if len(args) > 5 else "")
     return Result(columns=[name], rows=[(str(args[0]),)])
 
 
 @utility("citus_remove_tenant_quota")
 def _citus_remove_tenant_quota(cl, name, args):
-    from citus_tpu.workload import GLOBAL_TENANTS
+    from citus_tpu.metadata import replicated_remove_quota
     return Result(columns=[name],
-                  rows=[(GLOBAL_TENANTS.remove(str(args[0])),)])
+                  rows=[(replicated_remove_quota(cl, str(args[0])),)])
 
 
 @utility("citus_tenant_quotas")
 def _citus_tenant_quotas(cl, name, args):
     from citus_tpu.workload import GLOBAL_TENANTS
     return Result(columns=["tenant", "weight", "max_concurrency",
-                           "rate_limit_qps", "queue_depth", "pinned_node"],
+                           "rate_limit_qps", "queue_depth", "pinned_node",
+                           "priority_class"],
                   rows=GLOBAL_TENANTS.rows_view())
+
+
+@utility("citus_add_priority_class")
+def _citus_add_priority_class(cl, name, args):
+    # SELECT citus_add_priority_class(class, weight) — a class node in
+    # the scheduler's two-level stride tree; replicated like a quota
+    from citus_tpu.metadata import replicated_set_class
+    replicated_set_class(cl, str(args[0]),
+                         float(args[1]) if len(args) > 1 else 1.0)
+    return Result(columns=[name], rows=[(str(args[0]),)])
+
+
+@utility("citus_priority_classes")
+def _citus_priority_classes(cl, name, args):
+    from citus_tpu.workload import GLOBAL_TENANTS
+    return Result(columns=["class", "weight"],
+                  rows=GLOBAL_TENANTS.classes_view())
 
 
 @utility("citus_isolate_tenant_to_node")

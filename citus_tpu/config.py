@@ -154,6 +154,12 @@ class WorkloadSettings:
     # Per-tenant sustained QPS admission rate (token bucket with one
     # second of burst) — citus.tenant_rate_limit_qps.  0 = unlimited.
     tenant_rate_limit_qps: float = 0.0
+    # Priority class a tenant without an explicit class lands in —
+    # citus.tenant_default_priority_class.  Classes partition the
+    # stride scheduler into a two-level tree (class weight splits the
+    # slot supply between classes, tenant weight splits a class's
+    # share); one class degenerates to the flat PR 9 ring.
+    tenant_default_priority_class: str = "default"
 
 
 @dataclass
@@ -218,6 +224,24 @@ class RollupSettings:
 
 
 @dataclass
+class MetadataSettings:
+    """Multi-coordinator metadata sync (metadata/sync.py): pull-on-
+    mismatch catalog replication so any attached coordinator plans and
+    admits identically to the authority."""
+
+    # Cadence (ms) of the attached coordinator's background sync loop —
+    # citus.metadata_sync_interval_ms.  0 (the default) keeps the loop
+    # off: convergence still happens at statement start when a
+    # catalog_changed invalidation arrived, and on demand via
+    # SELECT citus_sync_metadata().
+    metadata_sync_interval_ms: float = 0.0
+    # Master switch for incremental pull-on-mismatch sync —
+    # citus.enable_metadata_sync.  Off = invalidations fall back to the
+    # legacy full-document fetch (correct, O(catalog) per reload).
+    enable_metadata_sync: bool = True
+
+
+@dataclass
 class ShardingSettings:
     # Default shard count for create_distributed_table
     # (reference GUC citus.shard_count, default 32).
@@ -252,6 +276,7 @@ class Settings:
     observability: ObservabilitySettings = field(
         default_factory=ObservabilitySettings)
     rollup: RollupSettings = field(default_factory=RollupSettings)
+    metadata: MetadataSettings = field(default_factory=MetadataSettings)
     # reference GUC citus.enable_change_data_capture
     enable_change_data_capture: bool = False
     # start the maintenance daemon with the cluster (reference: the

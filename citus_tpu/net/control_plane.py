@@ -66,7 +66,8 @@ class ControlPlane:
         # duty vs explicit calls)
         self._failover_mu = threading.Lock()
         self.stats = {"fetch_catalog": 0, "push_catalog": 0,
-                      "lease_acquired": 0, "lease_contended": 0}
+                      "lease_acquired": 0, "lease_contended": 0,
+                      "metadata_versions": 0, "metadata_pull": 0}
         if serve_port is not None:
             self.server = RpcServer(port=serve_port, secret=secret)
             self._register_handlers()
@@ -96,6 +97,8 @@ class ControlPlane:
         self.server.register("record_txn_outcome", self._on_record_txn_outcome)
         self.server.register("txn_outcome", self._on_txn_outcome)
         self.server.register("get_node_stats", self._on_get_node_stats)
+        self.server.register("metadata_versions", self._on_metadata_versions)
+        self.server.register("metadata_pull", self._on_metadata_pull)
 
     def _on_get_node_stats(self, payload: dict) -> dict:
         """The authority's own stat snapshot (the same payload the
@@ -189,6 +192,38 @@ class ControlPlane:
             self.stats["push_catalog"] += 1
         self.server.broadcast({"event": "catalog_changed", "origin": origin})
         return {"ok": True}
+
+    # ---- metadata sync (pull-on-mismatch; metadata/sync.py) ------------
+    # The authority serves its per-object version vector cheaply; a
+    # stale peer diffs it against its own and pulls ONLY the mismatched
+    # objects, shipped as one CTFR frame in the RPC's binary attachment
+    # (the same framed channel the event-loop data plane speaks).
+
+    def _on_metadata_versions(self, payload: dict) -> dict:
+        from citus_tpu.metadata.sync import authority_versions
+        with self._lock:
+            self.stats["metadata_versions"] += 1
+        return authority_versions(self.cluster)
+
+    def _on_metadata_pull(self, payload: dict):
+        from citus_tpu.metadata.sync import serve_metadata_pull
+        with self._lock:
+            self.stats["metadata_pull"] += 1
+        return serve_metadata_pull(self.cluster, payload)
+
+    def metadata_versions(self) -> Optional[dict]:
+        """Client side: the authority's version vector + ddl_epoch, or
+        None when not attached."""
+        if self.client is None:
+            return None
+        return self.client.call("metadata_versions")
+
+    def metadata_pull(self, keys: list) -> tuple:
+        """Client side: (result, CTFR frame bytes) holding the
+        requested objects."""
+        if self.client is None:
+            raise RpcError("not attached to a metadata authority")
+        return self.client.call_binary("metadata_pull", {"keys": keys})
 
     # ---- dictionary authority ------------------------------------------
     # Text dictionaries are table-global id assignments; coordinators

@@ -114,6 +114,13 @@ METRIC_HELP = {
     "health_dead_node": "active dead-node health events",
     "health_device_probe_wedged":
         "active wedged-device-probe health events",
+    "health_metadata_sync_lag": "active metadata-sync-lag health events",
+    "metadata_sync_bytes":
+        "catalog bytes shipped to this coordinator as CTFR frames",
+    "metadata_sync_rounds": "metadata pull-on-mismatch rounds run",
+    "metadata_stale_reads":
+        "statements that observed a stale catalog before converging",
+    "wait_metadata_sync_ms": "ms blocked on metadata sync round trips",
 }
 
 
@@ -273,6 +280,7 @@ def _gauges(cluster) -> dict:
     g["health_pool_saturation"] = active.get("pool_saturation", 0)
     g["health_dead_node"] = active.get("dead_node", 0)
     g["health_device_probe_wedged"] = active.get("device_probe_wedged", 0)
+    g["health_metadata_sync_lag"] = active.get("metadata_sync_lag", 0)
     return g
 
 

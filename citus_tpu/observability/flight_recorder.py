@@ -49,6 +49,8 @@ HEALTH_EVENT_KINDS = {
     "pool_saturation": "admission pool pinned at its configured limit",
     "dead_node": "stat fan-out probe found an unreachable endpoint",
     "device_probe_wedged": "bench watcher flagged the device tunnel wedged",
+    "metadata_sync_lag": "coordinator's catalog trailing the authority "
+                         "across consecutive sync rounds",
 }
 
 RING_SAMPLES = 512        # in-memory history ring (per node)
@@ -297,6 +299,13 @@ class FlightRecorder:
     def clear_dead_node(self, endpoint: str) -> None:
         with self._mu:
             self._resolve_locked("dead_node", endpoint)
+
+    def resolve_event(self, kind: str, subject: str) -> None:
+        """Public resolve door for externally-raised kinds (the metadata
+        sync engine clears its own metadata_sync_lag once a round
+        converges; dead_node has its dedicated pair above)."""
+        with self._mu:
+            self._resolve_locked(kind, subject)
 
     def emit_event(self, kind: str, subject: str, value, baseline,
                    detail: str) -> None:

@@ -1507,6 +1507,8 @@ class Parser:
         "citus_shard_move_stats", "citus_remote_stats",
         "citus_add_tenant_quota", "citus_remove_tenant_quota",
         "citus_tenant_quotas", "citus_isolate_tenant_to_node",
+        "citus_add_priority_class", "citus_priority_classes",
+        "citus_activate_node_metadata", "citus_sync_metadata",
         "citus_extensions",
         "citus_domains", "citus_collations", "citus_publications",
         "citus_statistics_objects",

@@ -156,6 +156,14 @@ class StatCounters:
         "rollup_skipped_changes",
         "rollup_queries_served",
         "wait_rollup_refresh_ms",
+        # multi-coordinator metadata sync (metadata/sync.py): catalog
+        # bytes shipped as CTFR frames, pull-on-mismatch rounds run,
+        # statements that observed a stale catalog before converging,
+        # and wall time blocked on a sync round trip
+        "metadata_sync_bytes",
+        "metadata_sync_rounds",
+        "metadata_stale_reads",
+        "wait_metadata_sync_ms",
     ]
 
     def __init__(self):
@@ -237,6 +245,10 @@ WAIT_COUNTERS = {
     # the rollup refresh loop parked between ticks (rollup/manager.py)
     # — the background consumer waits, ingest and queries do not
     "rollup_refresh": "wait_rollup_refresh_ms",
+    # a coordinator pulling mismatched catalog objects from the
+    # metadata authority (metadata/sync.py) — version-vector fetch +
+    # CTFR frame pull round trips
+    "metadata_sync": "wait_metadata_sync_ms",
 }
 
 WAIT_EVENTS = tuple(sorted(WAIT_COUNTERS))

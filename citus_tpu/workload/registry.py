@@ -37,22 +37,30 @@ class TenantQuota:
     rate_limit_qps: float = 0.0   # 0 = use citus.tenant_rate_limit_qps
     queue_depth: int = 0          # 0 = use citus.tenant_queue_depth
     pinned_node: Optional[int] = None
+    # "" = citus.tenant_default_priority_class; classes form the upper
+    # level of the scheduler's two-level stride tree
+    priority_class: str = ""
 
 
 class TenantRegistry:
     def __init__(self):
         self._mu = threading.Lock()
         self._quotas: dict[str, TenantQuota] = {}
+        # priority class -> weight of its node in the stride tree;
+        # unregistered classes weigh 1.0 (a lone default class makes
+        # the tree degenerate to the flat ring)
+        self._classes: dict[str, float] = {}
 
     def set_quota(self, tenant: str, *, weight: float = 0.0,
                   max_concurrency: int = 0, rate_limit_qps: float = 0.0,
-                  queue_depth: int = 0) -> None:
+                  queue_depth: int = 0, priority_class: str = "") -> None:
         with self._mu:
             q = self._quotas.setdefault(tenant, TenantQuota())
             q.weight = float(weight)
             q.max_concurrency = int(max_concurrency)
             q.rate_limit_qps = float(rate_limit_qps)
             q.queue_depth = int(queue_depth)
+            q.priority_class = str(priority_class)
 
     def get(self, tenant: str) -> Optional[TenantQuota]:
         with self._mu:
@@ -69,15 +77,32 @@ class TenantRegistry:
             q = self._quotas.setdefault(tenant, TenantQuota())
             q.pinned_node = node
 
+    def set_class(self, name: str, weight: float) -> None:
+        with self._mu:
+            self._classes[name] = max(float(weight), 1e-6)
+
+    def remove_class(self, name: str) -> bool:
+        with self._mu:
+            return self._classes.pop(name, None) is not None
+
+    def class_weight(self, name: str) -> float:
+        with self._mu:
+            return self._classes.get(name, 1.0)
+
+    def classes_view(self) -> list[tuple]:
+        with self._mu:
+            return sorted(self._classes.items())
+
     def rows_view(self) -> list[tuple]:
         with self._mu:
             return [(t, q.weight, q.max_concurrency, q.rate_limit_qps,
-                     q.queue_depth, q.pinned_node)
+                     q.queue_depth, q.pinned_node, q.priority_class)
                     for t, q in sorted(self._quotas.items())]
 
     def clear(self) -> None:
         with self._mu:
             self._quotas.clear()
+            self._classes.clear()
 
 
 #: process-wide quota table (control state, like the GUC tree)

@@ -8,12 +8,19 @@ single choke point every query path flows through instead of raw
 ``SharedTaskPool`` acquisition (cituslint CONF01 confines
 ``GLOBAL_POOL.acquire``/``release`` to this package):
 
-- per-tenant FIFO queues, drained by **stride scheduling**: each tenant
-  carries a virtual ``pass`` that advances by ``STRIDE1/weight`` per
-  grant, and the tenant with the minimum pass owns the next free slot.
-  Equal weights converge to equal slot share; a waiter can never be
-  barged by a new arrival (arrivals enqueue behind their tenant's tail
-  and only queue heads are grant candidates).
+- per-tenant FIFO queues, drained by **stride scheduling over a
+  two-level tree**: each tenant belongs to a priority class (its
+  catalog-persisted quota's ``priority_class``, else
+  citus.tenant_default_priority_class).  A grant first picks the
+  minimum-pass class (class pass advances by ``STRIDE1/class_weight``),
+  then the minimum-pass runnable tenant inside it (tenant pass advances
+  by ``STRIDE1/weight``).  Class weights split the slot supply between
+  classes, tenant weights split a class's share; one class degenerates
+  to the flat ring.  Equal weights converge to equal slot share; a
+  waiter can never be barged by a new arrival (arrivals enqueue behind
+  their tenant's tail and only queue heads are grant candidates).  Ties
+  break by name, so two coordinators with the same replicated quotas
+  make the same decision sequence.
 - queue-depth-bounded **load shedding**: a tenant whose queue is full
   (or whose QPS token bucket is empty) fast-fails with the retryable
   ``AdmissionShedError`` instead of piling up blocked threads.
@@ -79,14 +86,27 @@ class _Ticket:
         self.granted = False
 
 
+class _ClassState:
+    """Upper-level node of the stride tree: one per priority class with
+    a runnable tenant (created lazily, joins at the class-level virtual
+    time like tenants do)."""
+
+    __slots__ = ("name", "pass_")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.pass_ = 0.0
+
+
 class _TenantState:
     __slots__ = ("name", "queue", "running", "extra", "granted", "shed",
-                 "coalesced", "timeouts", "pass_", "weight",
+                 "coalesced", "timeouts", "pass_", "weight", "pclass",
                  "max_concurrency", "queue_depth", "rate_limit_qps",
                  "tokens", "t_tokens", "hist", "remote_tasks")
 
     def __init__(self, name: str):
         self.name = name
+        self.pclass = "default"
         self.queue: deque = deque()   # _Tickets, arrival order
         self.running = 0
         self.extra = 0                # optional intra-query slots held
@@ -111,9 +131,11 @@ class TenantScheduler:
     def __init__(self, pool=None):
         self._cv = threading.Condition()
         self._t: dict[str, _TenantState] = {}
+        self._classes: dict[str, _ClassState] = {}
         self._held = 0          # mirrors GLOBAL_POOL.in_use for our grants
         self._last_limit = 0    # limit seen by the most recent acquire
         self._global_pass = 0.0
+        self._global_class_pass = 0.0
         # tests pass a private SharedTaskPool; the real scheduler ledgers
         # into the process-wide pool so citus_stat_pool stays truthful
         self._pool_override = pool
@@ -141,7 +163,16 @@ class TenantScheduler:
                           else wl.tenant_queue_depth)
         st.rate_limit_qps = (q.rate_limit_qps if q and q.rate_limit_qps > 0
                              else wl.tenant_rate_limit_qps)
+        st.pclass = (q.priority_class if q and q.priority_class
+                     else wl.tenant_default_priority_class)
         return st
+
+    def _class_locked(self, name: str) -> _ClassState:
+        cs = self._classes.get(name)
+        if cs is None:
+            cs = self._classes[name] = _ClassState(name)
+            cs.pass_ = self._global_class_pass
+        return cs
 
     def _evict_locked(self) -> None:
         idle = [t for t, s in self._t.items()
@@ -225,21 +256,31 @@ class TenantScheduler:
                                  "retry after backoff")
 
     def _dispatch_locked(self, limit: int) -> None:
-        """Grant queued tickets while slots are free: minimum-pass
-        stride dispatch over tenants whose queue head is runnable."""
+        """Grant queued tickets while slots are free: two-level
+        minimum-pass stride dispatch — minimum-pass class first, then
+        the minimum-pass runnable tenant within it.  Name tiebreaks at
+        both levels keep the decision sequence identical across
+        coordinators sharing the replicated quota catalog."""
         while True:
             if limit and limit > 0 and self._held >= limit:
                 return
-            best = None
+            # min-pass runnable tenant per class (a tenant is runnable
+            # when its queue head exists and its cap has headroom)
+            heads: dict[str, _TenantState] = {}
             for s in self._t.values():
                 if not s.queue:
                     continue
                 if s.max_concurrency and s.running >= s.max_concurrency:
                     continue
-                if best is None or s.pass_ < best.pass_:
-                    best = s
-            if best is None:
+                cur = heads.get(s.pclass)
+                if cur is None or (s.pass_, s.name) < (cur.pass_, cur.name):
+                    heads[s.pclass] = s
+            if not heads:
                 return
+            cname = min(heads,
+                        key=lambda c: (self._class_locked(c).pass_, c))
+            best = heads[cname]
+            cs = self._class_locked(cname)
             w = best.queue.popleft()
             w.granted = True
             best.running += 1
@@ -247,6 +288,8 @@ class TenantScheduler:
             self._held += 1
             self._global_pass = max(self._global_pass, best.pass_)
             best.pass_ += STRIDE1 / best.weight
+            self._global_class_pass = max(self._global_class_pass, cs.pass_)
+            cs.pass_ += STRIDE1 / GLOBAL_TENANTS.class_weight(cname)
             self._cv.notify_all()
 
     def release(self, tenant: str) -> None:
@@ -358,7 +401,9 @@ class TenantScheduler:
         their pool slots — only the per-tenant view resets."""
         with self._cv:
             self._t.clear()
+            self._classes.clear()
             self._global_pass = 0.0
+            self._global_class_pass = 0.0
 
 
 #: the process-wide scheduler every query path admits through

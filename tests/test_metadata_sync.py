@@ -1,0 +1,341 @@
+"""Multi-coordinator metadata sync + catalog-persisted tenant control
+plane (citus_tpu/metadata/): version-vector convergence, replicated
+quota writes, kill-matrix exactly-once apply, and plan/admission
+equivalence across coordinators (the "query from any node" invariants).
+
+Reference: Citus MX metadata sync (metadata_sync.c) is tested with real
+multi-node clusters; here the cross-coordinator tests use real OS
+processes sharing a data dir through the metadata authority.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.metadata.sync import version_vector
+from citus_tpu.workload import GLOBAL_TENANTS
+
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def _spawn(code: str) -> subprocess.Popen:
+    body = "import jax\njax.config.update('jax_platforms','cpu')\n" + code
+    return subprocess.Popen([sys.executable, "-c", body],
+                            stdout=subprocess.PIPE, text=True, env=ENV)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    GLOBAL_TENANTS.clear()
+    yield
+    GLOBAL_TENANTS.clear()
+
+
+# ------------------------------------------------------- version vector
+
+
+def test_version_vector_names_exactly_the_divergent_objects():
+    base = {
+        "format_version": 3,
+        "tables": [{"name": "t", "version": 1}],
+        "nodes": [{"node_id": 0, "is_active": True}],
+        "next_shard_id": 102008, "next_colocation_id": 1,
+        "schemas": {"public": {}},
+        "tenant_quotas": {"7": {"weight": 1.0}},
+    }
+    v1 = version_vector(base)
+    assert set(v1) == {"tables/t", "nodes/0", "allocators/next_shard_id",
+                       "allocators/next_colocation_id", "schemas/public",
+                       "tenant_quotas/7"}
+    # touch one object: exactly one entry changes
+    changed = dict(base, tables=[{"name": "t", "version": 2}])
+    v2 = version_vector(changed)
+    assert {k for k in v1 if v1[k] != v2.get(k)} == {"tables/t"}
+    # add one object: exactly one new key
+    grown = dict(base, tenant_quotas={"7": {"weight": 1.0},
+                                      "8": {"weight": 2.0}})
+    v3 = version_vector(grown)
+    assert set(v3) - set(v1) == {"tenant_quotas/8"}
+    assert all(v3[k] == v1[k] for k in v1)
+
+
+# ------------------------------------- replicated tenant control plane
+
+
+def test_replicated_quota_persists_across_reopen(tmp_path):
+    d = str(tmp_path / "db")
+    cl = ct.Cluster(d)
+    cl.execute("SELECT citus_add_tenant_quota('7', 2.5, 3, 10.0, 8, 'gold')")
+    cl.execute("SELECT citus_add_priority_class('gold', 4.0)")
+    assert cl.catalog.tenant_quotas["7"]["priority_class"] == "gold"
+    assert cl.catalog.priority_classes["gold"] == {"weight": 4.0}
+    cl.close()
+    GLOBAL_TENANTS.clear()
+    # a fresh process-equivalent open hydrates the registry from the doc
+    cl2 = ct.Cluster(d)
+    assert cl2.execute("SELECT citus_tenant_quotas()").rows == \
+        [("7", 2.5, 3, 10.0, 8, None, "gold")]
+    assert cl2.execute("SELECT citus_priority_classes()").rows == \
+        [("gold", 4.0)]
+    # removal tombstones the catalog entry and retires the mirror
+    assert cl2.execute("SELECT citus_remove_tenant_quota('7')").rows == \
+        [(True,)]
+    assert "7" not in cl2.catalog.tenant_quotas
+    assert GLOBAL_TENANTS.get("7") is None
+    cl2.close()
+
+
+def test_hydration_leaves_locally_registered_quotas_alone(tmp_path):
+    """Internal tenants registered straight against the registry (the
+    rollup refresh worker pattern) survive catalog re-hydration."""
+    from citus_tpu.metadata import hydrate_tenant_registry
+    cl = ct.Cluster(str(tmp_path / "db"))
+    GLOBAL_TENANTS.set_quota("_internal", weight=9.0)
+    cl.execute("SELECT citus_add_tenant_quota('7', 2.5)")
+    hydrate_tenant_registry(cl.catalog)
+    assert GLOBAL_TENANTS.get("_internal").weight == 9.0
+    assert GLOBAL_TENANTS.get("7").weight == 2.5
+    cl.close()
+
+
+def test_quota_write_on_a_is_pulled_by_b(tmp_path):
+    """The acceptance shape: quotas written through coordinator A are
+    queryable through coordinator B — here via the incremental
+    pull-on-mismatch engine, asserting B's own catalog content."""
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0)
+    b = ct.Cluster(str(tmp_path / "b"),
+                   coordinator=("127.0.0.1", a.control_port))
+    before = a.counters.snapshot().get("metadata_sync_bytes", 0)
+    a.execute("SELECT citus_add_tenant_quota('42', 5.0, 2, 0.0, 4, 'gold')")
+    a.execute("SELECT citus_add_priority_class('gold', 4.0)")
+    # drive rounds until B's own catalog holds the replicated sections
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        b.metadata_sync.sync_once()
+        if "42" in b.catalog.tenant_quotas:
+            break
+        time.sleep(0.05)
+    assert b.catalog.tenant_quotas["42"]["priority_class"] == "gold"
+    assert b.catalog.priority_classes["gold"] == {"weight": 4.0}
+    # converged: the next round applies nothing (exactly-once)
+    assert b.metadata_sync.sync_once() == 0
+    assert b.execute("SELECT citus_tenant_quotas()").rows[0][0] == "42"
+    snap = a.counters.snapshot()
+    assert snap.get("metadata_sync_rounds", 0) >= 2
+    assert snap.get("metadata_sync_bytes", 0) > before
+    b.close()
+    a.close()
+
+
+def test_sync_retires_objects_dropped_on_the_authority(tmp_path):
+    a = ct.Cluster(str(tmp_path / "a"), serve_port=0)
+    b = ct.Cluster(str(tmp_path / "b"),
+                   coordinator=("127.0.0.1", a.control_port))
+    a.execute("SELECT citus_add_tenant_quota('9', 1.0)")
+    b.metadata_sync.sync_once()
+    assert "9" in b.catalog.tenant_quotas
+    a.execute("SELECT citus_remove_tenant_quota('9')")
+    deadline = time.monotonic() + 10
+    while "9" in b.catalog.tenant_quotas and time.monotonic() < deadline:
+        b.metadata_sync.sync_once()
+        time.sleep(0.05)
+    assert "9" not in b.catalog.tenant_quotas
+    b.close()
+    a.close()
+
+
+# ------------------------------------------------- lag health event
+
+
+def test_metadata_sync_lag_event_emits_and_resolves(tmp_path):
+    from citus_tpu.metadata.sync import SYNC_LAG_ROUNDS
+    cl = ct.Cluster(str(tmp_path / "db"))
+    ms = cl.metadata_sync
+    for _ in range(SYNC_LAG_ROUNDS):
+        ms._note_diverged(3)
+    health = cl.flight_recorder.events_rows()
+    lag = [e for e in health if e[1] == "metadata_sync_lag"]
+    assert lag and lag[-1][6]  # active
+    rows = cl.execute("SELECT citus_health_events()").rows
+    mine = [r for r in rows if r[2] == "metadata_sync_lag"]
+    assert mine and mine[-1][3] == "warning"
+    ms._note_converged()
+    health = cl.flight_recorder.events_rows()
+    lag = [e for e in health if e[1] == "metadata_sync_lag"]
+    assert lag and not lag[-1][6]  # resolved
+    cl.close()
+
+
+# ------------------------------------------------- two-level scheduler
+
+
+def test_priority_classes_split_share_by_class_not_tenant():
+    """One gold tenant vs three basic tenants, class weights 3:1.  A
+    flat ring would hand gold ~25%; the two-level tree gives the gold
+    CLASS ~75% regardless of tenant population."""
+    import threading
+    from citus_tpu.config import ExecutorSettings, Settings, WorkloadSettings
+    from citus_tpu.executor.admission import SharedTaskPool
+    from citus_tpu.workload import TenantScheduler
+    GLOBAL_TENANTS.set_class("gold", 3.0)
+    GLOBAL_TENANTS.set_class("basic", 1.0)
+    GLOBAL_TENANTS.set_quota("g1", priority_class="gold")
+    for t in ("b1", "b2", "b3"):
+        GLOBAL_TENANTS.set_quota(t, priority_class="basic")
+    sched = TenantScheduler(pool=SharedTaskPool())
+    st = Settings(executor=ExecutorSettings(max_shared_pool_size=1),
+                  workload=WorkloadSettings())
+    stop = threading.Event()
+
+    def drive(tenant):
+        while not stop.is_set():
+            sched.acquire(st, tenant)
+            try:
+                time.sleep(0.001)
+            finally:
+                sched.release(tenant)
+
+    threads = [threading.Thread(target=drive, args=(t,))
+               for t in ("g1", "b1", "b2", "b3") for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)
+    stop.set()
+    for t in threads:
+        t.join()
+    rows = {r[0]: r for r in sched.rows_view()}
+    total = sum(rows[t][3] for t in ("g1", "b1", "b2", "b3"))
+    assert total > 50
+    gold_share = rows["g1"][3] / total
+    assert gold_share >= 0.60, (gold_share, rows)
+    # within a class the flat stride still applies: basics stay close
+    basics = sorted(rows[t][3] for t in ("b1", "b2", "b3"))
+    assert basics[0] >= basics[-1] * 0.3, rows
+
+
+# ---------------------------------------------- kill matrix (real procs)
+
+
+def test_kill_mid_sync_apply_restarts_and_converges(tmp_path):
+    """A coordinator SIGKILLed at the metadata_sync_apply fault point —
+    after pulling, before applying — restarts, re-diffs, and lands on
+    the authority's document; the re-run applies the same objects
+    exactly once (the follow-up round applies 0)."""
+    d = str(tmp_path / "db")
+    auth = ct.Cluster(d, serve_port=0)
+    auth.execute("SELECT citus_add_tenant_quota('13', 7.0, 0, 0.0, 0, 'gold')")
+    auth.execute("SELECT citus_add_priority_class('gold', 2.0)")
+    port = auth.control_port
+    attach_dir = str(tmp_path / "attach")
+    victim = _spawn(textwrap.dedent(f"""
+        import citus_tpu as ct
+        from citus_tpu.testing.faults import FAULTS
+        FAULTS.arm("metadata_sync_apply", kill=True)
+        b = ct.Cluster({attach_dir!r}, coordinator=("127.0.0.1", {port}))
+        print("SYNCING", flush=True)
+        b.metadata_sync.sync_once()   # os._exit(1) at the fault point
+        print("UNREACHABLE", flush=True)
+    """))
+    try:
+        assert victim.stdout.readline().split() == ["SYNCING"]
+        victim.wait(timeout=30)
+        assert victim.returncode == 1  # died AT the fault point
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+            victim.wait()
+    # same data dir, no fault: the restarted coordinator converges
+    survivor = _spawn(textwrap.dedent(f"""
+        import citus_tpu as ct
+        b = ct.Cluster({attach_dir!r}, coordinator=("127.0.0.1", {port}))
+        n1 = b.metadata_sync.sync_once()
+        n2 = b.metadata_sync.sync_once()
+        q = b.catalog.tenant_quotas.get("13", {{}})
+        print("RESULT", n1, n2, q.get("priority_class"), flush=True)
+        b.close()
+    """))
+    try:
+        out = survivor.stdout.readline().split()
+        assert out[0] == "RESULT", out
+        n1, n2, pclass = int(out[1]), int(out[2]), out[3]
+        assert n1 > 0          # the interrupted batch applied on restart
+        assert n2 == 0         # exactly once: nothing left to re-apply
+        assert pclass == "gold"
+        survivor.wait(timeout=30)
+    finally:
+        if survivor.poll() is None:
+            survivor.kill()
+            survivor.wait()
+    auth.close()
+
+
+def test_plan_and_admission_equivalence_across_coordinators(tmp_path):
+    """Two attached coordinators plan the same query to the same
+    fingerprint and resolve the same tenants to the same admission
+    inputs — the zero-divergence half of query-from-any-node."""
+    d = str(tmp_path / "db")
+    auth = ct.Cluster(d, serve_port=0)
+    auth.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    auth.execute("SELECT create_distributed_table('t', 'k', 8)")
+    auth.copy_from("t", columns={"k": np.arange(400, dtype=np.int64) % 20,
+                                 "v": np.arange(400, dtype=np.int64)})
+    auth.execute("SELECT citus_add_priority_class('gold', 3.0)")
+    auth.execute("SELECT citus_add_tenant_quota('5', 4.0, 2, 50.0, 8, 'gold')")
+    auth.execute("SELECT citus_add_tenant_quota('11', 1.0, 1, 5.0, 2, '')")
+    port = auth.control_port
+    child = textwrap.dedent("""
+        import json, sys
+        import citus_tpu as ct
+        from citus_tpu.executor.kernel_cache import plan_fingerprint
+        from citus_tpu.planner import parse_sql
+        from citus_tpu.planner.bind import bind_select
+        from citus_tpu.planner.physical import plan_select
+        from citus_tpu.workload import GLOBAL_TENANTS
+        b = ct.Cluster(sys.argv[1], coordinator=("127.0.0.1", int(sys.argv[2])))
+        b.metadata_sync.sync_once()
+        fps = []
+        for sql in ("SELECT count(*), sum(v) FROM t WHERE k = 5",
+                    "SELECT k, sum(v) FROM t GROUP BY k"):
+            bound = bind_select(b.catalog, parse_sql(sql)[0])
+            fps.append(plan_fingerprint(plan_select(b.catalog, bound)))
+        admission = []
+        for tenant in ("5", "11", "999"):
+            q = GLOBAL_TENANTS.get(tenant)
+            wl = b.settings.workload
+            pclass = (q.priority_class if q and q.priority_class
+                      else wl.tenant_default_priority_class)
+            admission.append((
+                tenant,
+                q.weight if q else wl.tenant_default_weight,
+                q.max_concurrency if q else 0,
+                q.rate_limit_qps if q else wl.tenant_rate_limit_qps,
+                q.queue_depth if q else wl.tenant_queue_depth,
+                pclass, GLOBAL_TENANTS.class_weight(pclass)))
+        print("JSON " + json.dumps({"fps": fps, "admission": admission}),
+              flush=True)
+        b.close()
+    """)
+
+    def run(sub: str) -> dict:
+        body = ("import jax\njax.config.update('jax_platforms','cpu')\n"
+                + child)
+        p = subprocess.run(
+            [sys.executable, "-c", body, str(tmp_path / sub), str(port)],
+            stdout=subprocess.PIPE, text=True, env=ENV, timeout=120)
+        for line in p.stdout.splitlines():
+            if line.startswith("JSON "):
+                import json
+                return json.loads(line[5:])
+        raise AssertionError(f"no JSON line in child output: {p.stdout!r}")
+
+    r1 = run("c1")
+    r2 = run("c2")
+    assert r1["fps"] == r2["fps"]
+    assert r1["admission"] == r2["admission"]
+    auth.close()

@@ -256,7 +256,7 @@ def test_quota_utilities_roundtrip(tmp_path):
     cl = _make_cluster(tmp_path, nodes=1)
     cl.execute("SELECT citus_add_tenant_quota('7', 2.5, 3, 10.0, 8)")
     rows = cl.execute("SELECT citus_tenant_quotas()").rows
-    assert rows == [("7", 2.5, 3, 10.0, 8, None)]
+    assert rows == [("7", 2.5, 3, 10.0, 8, None, "")]
     assert cl.execute("SELECT citus_remove_tenant_quota('7')").rows == [(True,)]
     assert cl.execute("SELECT citus_tenant_quotas()").rows == []
     cl.close()

@@ -273,6 +273,14 @@ CONFINED_METHODS = {
     # caller would double-apply deltas or tear the watermark
     "refresh_once": ("rollup/manager.py",),
     "_apply_batch": ("rollup/manager.py",),
+    # the replicated tenant control plane has ONE write door
+    # (metadata/quotas.py): every catalog quota/class write must ride
+    # the 2PC commit_metadata_flip sequence and re-hydrate the local
+    # registry — a bare put anywhere else forks this coordinator's
+    # admission behavior from the rest of the cluster
+    "put_tenant_quota": ("metadata/quotas.py",),
+    "drop_tenant_quota": ("metadata/quotas.py",),
+    "put_priority_class": ("metadata/quotas.py",),
 }
 
 #: method name -> files where calling it is banned outright
