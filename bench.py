@@ -246,6 +246,72 @@ def bench_megabatch(cl, extra: dict) -> None:
     }
 
 
+def bench_scan_fuse(cl, extra: dict) -> None:
+    """Fused single-dispatch hot loop A/B (ops/scan_agg.py
+    build_fused_worker_fn + the executor's donated-accumulator loop):
+    uncached Q1 through the fused path vs the staged host worker
+    (task_executor_backend = 'cpu') — rows/s, dispatch counts, and
+    pipeline stall counters per arm — plus a uuid vs text
+    high-cardinality ingest A/B: the uuid lane encoding keeps the
+    dictionary flat at zero entries while text grows linearly."""
+    import uuid as _uuid
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+
+    def measure():
+        GLOBAL_CACHE.clear()
+        c0 = GLOBAL_COUNTERS.snapshot()
+        t0 = time.perf_counter()
+        cl.execute(Q1)
+        wall = time.perf_counter() - t0
+        c1 = GLOBAL_COUNTERS.snapshot()
+        return wall, {k: c1[k] - c0[k] for k in (
+            "fused_dispatches", "pipeline_host_stalls",
+            "pipeline_device_stalls")}
+
+    cl.execute(Q1)  # fused arm: plan + kernels warm
+    fused_wall, fused_c = measure()
+    cl.execute("SET citus.task_executor_backend = 'cpu'")
+    cl.execute(Q1)  # staged arm warm
+    staged_wall, staged_c = measure()
+    cl.execute("SET citus.task_executor_backend = 'tpu'")
+    fuse = {
+        "fused_rows_per_sec": round(N_ROWS / fused_wall, 1),
+        "staged_cpu_rows_per_sec": round(N_ROWS / staged_wall, 1),
+        "speedup_vs_staged": round(staged_wall / fused_wall, 2),
+        "fused_dispatches": fused_c["fused_dispatches"],
+        "fused_host_stalls": fused_c["pipeline_host_stalls"],
+        "fused_device_stalls": fused_c["pipeline_device_stalls"],
+        "staged_fused_dispatches": staged_c["fused_dispatches"],
+        "staged_host_stalls": staged_c["pipeline_host_stalls"],
+    }
+    n = int(os.environ.get("BENCH_FUSE_UUIDS", "300000"))
+    words = [str(_uuid.UUID(int=(i * 2654435761) % (1 << 128)))
+             for i in range(n)]
+    cl.execute("DROP TABLE IF EXISTS fuse_uuid_ab")
+    cl.execute("DROP TABLE IF EXISTS fuse_text_ab")
+    cl.execute("CREATE TABLE fuse_uuid_ab (k bigint NOT NULL, u uuid)")
+    cl.execute("SELECT create_distributed_table('fuse_uuid_ab', 'k', 4)")
+    t0 = time.perf_counter()
+    cl.copy_from("fuse_uuid_ab", columns={"k": np.arange(n), "u": words})
+    uuid_wall = time.perf_counter() - t0
+    cl.execute("CREATE TABLE fuse_text_ab (k bigint NOT NULL, u text)")
+    cl.execute("SELECT create_distributed_table('fuse_text_ab', 'k', 4)")
+    t0 = time.perf_counter()
+    cl.copy_from("fuse_text_ab", columns={"k": np.arange(n), "u": words})
+    text_wall = time.perf_counter() - t0
+    cat = cl.catalog
+    cat._ensure_dict("fuse_text_ab", "u")
+    fuse["uuid_ingest"] = {
+        "distinct_values": n,
+        "uuid_rows_per_sec": round(n / uuid_wall, 1),
+        "text_rows_per_sec": round(n / text_wall, 1),
+        "uuid_dict_entries": len(cat._dicts.get(("fuse_uuid_ab", "u"), ())),
+        "text_dict_entries": len(cat._dicts[("fuse_text_ab", "u")]),
+    }
+    extra["scan_fuse"] = fuse
+
+
 def bench_trace_overhead(cl, extra: dict) -> None:
     """Tracing cost (observability/): warm Q1 wall time with sampling
     off (the allocation-free no-op recorder) vs sample_rate=1.0 (every
@@ -1232,6 +1298,8 @@ def main() -> None:
         bench_plan_cache(cl, extra)
     if os.environ.get("BENCH_MEGABATCH", "1") != "0":
         bench_megabatch(cl, extra)
+    if os.environ.get("BENCH_SCAN_FUSE", "1") != "0":
+        bench_scan_fuse(cl, extra)
     if os.environ.get("BENCH_TRACE", "1") != "0":
         bench_trace_overhead(cl, extra)
     if os.environ.get("BENCH_RECORDER", "1") != "0":

@@ -1773,9 +1773,17 @@ class Cluster:
                 if col.type.is_text:
                     vals.append(self.catalog.decode_strings(
                         t.name, cname, [int(phys)])[0])
+                elif col.type.kind == "uuid":
+                    continue  # recombined below from the lane pair
                 else:
                     vals.append(col.type.from_physical(
                         np.asarray(phys).item()))
+            if col.type.kind == "uuid":
+                from citus_tpu import types as T
+                lane = values[T.uuid_lane_name(cname)]
+                vals = [T.uuid_from_lane_pair(int(h), int(l))
+                        for h, l, ok in zip(values[cname], lane,
+                                            validity[cname]) if ok]
             self._check_domain_values(dn, dom, vals)
 
     def _cdc_captures(self, table: str) -> bool:
@@ -1819,6 +1827,7 @@ class Cluster:
             if col.type.is_text:
                 text_cache[c] = self.catalog.decode_strings(
                     t.name, c, values[c].tolist())
+        from citus_tpu import types as T
         for i in range(n):
             row = []
             for c in names:
@@ -1827,6 +1836,10 @@ class Cluster:
                     row.append(None)
                 elif col.type.is_text:
                     row.append(text_cache[c][i])
+                elif col.type.kind == "uuid":
+                    row.append(T.uuid_from_lane_pair(
+                        int(values[c][i]),
+                        int(values[T.uuid_lane_name(c)][i])))
                 else:
                     row.append(col.type.from_physical(values[c][i].item()))
             out.append(row)
@@ -1892,7 +1905,8 @@ class Cluster:
                 if not _os.path.isdir(d):
                     continue
                 reader = ShardReader(d, t.schema)
-                for batch in reader.scan(names):
+                from citus_tpu import types as T
+                for batch in reader.scan(t.schema.physical_names(names)):
                     decoded = {}
                     for c in names:
                         col = t.schema.column(c)
@@ -1900,6 +1914,10 @@ class Cluster:
                         if col.type.is_text:
                             decoded[c] = self.catalog.decode_strings(
                                 table_name, c, vals.tolist())
+                        elif col.type.kind == "uuid":
+                            lane = batch.values[T.uuid_lane_name(c)]
+                            decoded[c] = [T.uuid_from_lane_pair(int(h), int(l))
+                                          for h, l in zip(vals, lane)]
                         else:
                             decoded[c] = [col.type.from_physical(v.item())
                                           for v in vals]

@@ -88,6 +88,9 @@ _GUCS = {
     "citus.max_tasks_in_flight": ("executor", "max_tasks_in_flight", int),
     # host read-ahead queue depth for the decode thread; 0 = inline
     "citus.executor_prefetch_depth": ("executor", "executor_prefetch_depth", int),
+    # native stripe read+decompress pool width; 0 = auto
+    # (min(8, cpu_count), storage/reader.py)
+    "citus.decode_threads": ("executor", "decode_threads", int),
     "citus.use_secondary_nodes": ("executor", "use_secondary_nodes", "secondary"),
     "citus.remote_task_execution": ("executor", "remote_task_execution", _remote_task_mode),
     # wire codec for execute_task results / placement bundles: the
@@ -257,6 +260,9 @@ def _execute_set(cl, stmt: A.SetConfig) -> Result:
     elif key == "citus.kernel_cache_size":
         from citus_tpu.executor.kernel_cache import GLOBAL_KERNELS
         GLOBAL_KERNELS.set_capacity(int(v))
+    elif key == "citus.decode_threads":
+        from citus_tpu.storage.reader import set_decode_threads
+        set_decode_threads(int(v))
     elif key == "citus.jit_cache_dir":
         from citus_tpu.executor.kernel_cache import configure_persistent_cache
         configure_persistent_cache(v)

@@ -59,6 +59,15 @@ def copy_to_binary(cl, table_name: str, path: str) -> int:
                         arrays[f"v__{c}"] = np.asarray(
                             [w if (m and w is not None) else ""
                              for w, m in zip(words, masks[c])], dtype=str)
+                    elif ct.kind == "uuid":
+                        # lanes recombine to canonical words: the file
+                        # stays portable and format-compatible
+                        from citus_tpu import types as T
+                        lane = values[T.uuid_lane_name(c)]
+                        arrays[f"v__{c}"] = np.asarray(
+                            [T.uuid_from_lane_pair(int(h), int(l)) if m
+                             else "" for h, l, m in
+                             zip(values[c], lane, masks[c])], dtype=str)
                     else:
                         arrays[f"v__{c}"] = values[c]
                     arrays[f"m__{c}"] = np.asarray(masks[c], bool)
@@ -99,7 +108,7 @@ def copy_from_binary(cl, table_name: str, path: str) -> int:
                 ct = t.schema.column(c).type
                 v = arrays[f"v__{c}"]
                 m = np.asarray(arrays[f"m__{c}"], bool)
-                if ct.is_text:
+                if ct.is_text or ct.kind == "uuid":
                     columns[c] = [w if ok else None
                                   for w, ok in zip(v.tolist(), m)]
                 elif m.all():

@@ -409,6 +409,17 @@ def update(cl, stmt):
     for col, e in stmt.assignments:
         target = t.schema.column(col)
         bound = b.bind_scalar(e)
+        if target.type.kind == "uuid":
+            # fold a string literal to the physical 128-bit value here;
+            # the executor splits it into int64 lanes (dictionary bypass)
+            if isinstance(bound, BLiteral) and isinstance(bound.value, str):
+                bound = BLiteral(target.type.to_physical(bound.value),
+                                 target.type)
+            elif bound.type.kind != "uuid":
+                raise AnalysisError(
+                    f"cannot assign {bound.type} to {col} ({target.type})")
+            assignments.append((col, bound))
+            continue
         if target.type.is_text:
             if isinstance(bound, BLiteral) and isinstance(bound.value, str):
                 did = cl.catalog.encode_strings(t.name, col, [bound.value])[0]

@@ -155,7 +155,7 @@ def _execute_explain(cl, stmt: A.Explain) -> Result:
                  f"(shards: {len(plan.shard_indexes)}/{t.shard_count})")
     if plan.index_eq is not None:
         icol, ival, iname = plan.index_eq
-        if t.schema.column(icol).type.is_text:
+        if t.schema.scan_column(icol).type.is_text:
             # literal was bound to its dictionary id; show the string
             decoded = cl.catalog.decode_strings(t.name, icol, [int(ival)])
             ival = decoded[0] if decoded else ival
@@ -257,12 +257,19 @@ def _run_analyze(cl, stmt: A.Explain) -> list[str]:
     pl = (ex.attrs.get("pipeline") if ex is not None else None) \
         or r.explain.get("pipeline") or {}
     if pl:
-        lines.append(
+        line = (
             f"  Pipeline: host decode {pl.get('host_decode_ms', 0):.2f}"
             f" ms, device {pl.get('device_ms', 0):.2f} ms, "
             f"H2D {pl.get('h2d_bytes', 0)} bytes, "
             f"stalls host={pl.get('host_stalls', 0)} "
             f"device={pl.get('device_stalls', 0)}")
+        if "fused_dispatches" in pl:
+            # the 1-dispatch-per-batch claim, visible per statement
+            line += f", fused dispatches {pl['fused_dispatches']}"
+        if "stream_window_peak_bytes" in pl:
+            line += (f", stream window peak "
+                     f"{pl['stream_window_peak_bytes']} bytes")
+        lines.append(line)
         if "remote_wait_ms" in pl:
             wire = f", wire {pl['wire_format']}" \
                 if pl.get("wire_format") else ""

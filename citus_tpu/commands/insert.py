@@ -377,6 +377,10 @@ def _insert_select_arrays(cl, target, sel: A.Select,
         tgt = target.schema.column(cname).type
         if e.type != tgt:
             return None
+        if tgt.kind == "uuid":
+            # uuid lanes travel in pairs; the pull path rematerializes
+            # canonical strings and re-encodes both lanes on ingest
+            return None
         if tgt.is_text:
             if not isinstance(e, BColumn):
                 return None
@@ -466,7 +470,7 @@ def _stream_insert_select(cl, ing, target, bound, plan, fns, ffn,
         for values, masks, n in load_shard_batches(
                 cl.catalog, plan, si, min_batch_rows=1):
             env = {c: (values[c].astype(
-                        bound.table.schema.column(c).type.device_dtype, copy=False),
+                        bound.table.schema.scan_dtype(c, device=True), copy=False),
                        masks[c]) for c in plan.scan_columns}
             if ffn is not None:
                 m = np.asarray(predicate_mask(np, ffn, env, np.ones(n, bool)))

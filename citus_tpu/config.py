@@ -83,6 +83,10 @@ class ExecutorSettings:
     # citus.executor_prefetch_depth.  0 = decode inline on the
     # dispatching thread (no host/device overlap).
     executor_prefetch_depth: int = 2
+    # Worker threads for the native stripe read+decompress pool
+    # (storage/reader.py) — citus.decode_threads.  0 = auto:
+    # min(8, cpu_count).
+    decode_threads: int = 0
     # Prefer replica (non-primary) placements for reads — the
     # citus.use_secondary_nodes='always' analog; failover to the
     # primary still applies when no replica answers.

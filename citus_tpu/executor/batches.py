@@ -148,7 +148,7 @@ def pad_to_batch(table: TableMeta, plan: PhysicalPlan, values: dict, masks: dict
                  n_rows: int, padded_rows: int, shard_index: int) -> ShardBatch:
     cols_out, valids_out = [], []
     for c in plan.scan_columns:
-        dt = table.schema.column(c).type.device_dtype
+        dt = table.schema.scan_dtype(c, device=True)
         v = values[c].astype(dt, copy=False)
         m = masks[c]
         if padded_rows != n_rows:
@@ -166,7 +166,7 @@ def empty_batch(table: TableMeta, plan: PhysicalPlan, padded_rows: int,
                 shard_index: int) -> ShardBatch:
     cols, valids = [], []
     for c in plan.scan_columns:
-        dt = table.schema.column(c).type.device_dtype
+        dt = table.schema.scan_dtype(c, device=True)
         cols.append(np.zeros(padded_rows, dt))
         valids.append(np.ones(padded_rows, bool))
     return ShardBatch(tuple(cols), tuple(valids), np.zeros(padded_rows, bool),

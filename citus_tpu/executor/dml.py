@@ -81,6 +81,27 @@ def _matched_rows_per_stripe(cat: Catalog, table: TableMeta, directory: str,
     return merged, matched_batches
 
 
+def _uuid_assignment(e: BExpr, env: dict, n: int):
+    """UPDATE SET <uuid_col> = <expr>: evaluate to (hi, lo, valid) int64
+    lane arrays — compile_expr cannot carry the 128-bit literal."""
+    from citus_tpu import types as T
+    from citus_tpu.planner.bound import BColumn, BLiteral
+    if isinstance(e, BLiteral):
+        if e.value is None:
+            z = np.zeros(n, np.int64)
+            return z, z, np.zeros(n, bool)
+        hi, lo = T.uuid_int_to_lanes(int(e.value))
+        return (np.full(n, hi, np.int64), np.full(n, lo, np.int64),
+                np.ones(n, bool))
+    if isinstance(e, BColumn) and e.type.kind == T.UUID:
+        hv, hm = env[e.name]
+        lv, _lm = env[T.uuid_lane_name(e.name)]
+        m = np.ones(n, bool) if hm is True else np.asarray(hm)
+        return np.asarray(hv), np.asarray(lv), m
+    raise UnsupportedFeatureError(
+        "UPDATE of a uuid column requires a uuid literal or column")
+
+
 def execute_delete(cat: Catalog, txlog: TransactionLog, table: TableMeta,
                    where: Optional[BExpr], txn=None) -> int:
     """``txn``: an open interactive transaction (transaction/session.py)
@@ -177,6 +198,9 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
     from citus_tpu.ingest import TableIngestor
 
     staged_delete_dirs = []
+    # scan and rebuild in PHYSICAL column space: a uuid column is two
+    # int64 lane streams on disk, and the re-insert writer expects both
+    all_columns = table.schema.physical_names(all_columns)
     new_values = {c: [] for c in all_columns}
     new_valid = {c: [] for c in all_columns}
     assign_map = dict(assignments)
@@ -206,12 +230,28 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
                         # statement must leave nothing unregistered
                         txn.record_deletes(table.name, [pd])
         # build replacement rows
+        from citus_tpu import types as T
+        assigned_lanes = {
+            T.uuid_lane_name(c) for c in assign_map
+            if table.schema.column(c).type.kind == T.UUID}
         for batch, mask in matched:
             idx = np.nonzero(mask)[0]
             env = {c: (batch.values[c],
                        batch.validity[c] if batch.validity[c] is not None else True)
                    for c in all_columns}
             for c in all_columns:
+                if c in assigned_lanes:
+                    continue  # filled alongside its base uuid column
+                if c in assign_map and not T.is_uuid_lane(c) \
+                        and table.schema.column(c).type.kind == T.UUID:
+                    hi, lo, valid = _uuid_assignment(assign_map[c], env,
+                                                     batch.row_count)
+                    new_values[c].append(hi[idx])
+                    new_valid[c].append(valid[idx])
+                    lane = T.uuid_lane_name(c)
+                    new_values[lane].append(lo[idx])
+                    new_valid[lane].append(valid[idx])
+                    continue
                 if c in assign_map:
                     v, valid = compile_expr(assign_map[c], np)(env)
                     v = np.asarray(v)
@@ -231,7 +271,7 @@ def _execute_update_tx(cat, txlog, table, assignments, where,
         if txn is None:
             txlog.release(xid)
         return 0
-    values = {c: np.concatenate(new_values[c]).astype(table.schema.column(c).type.storage_dtype)
+    values = {c: np.concatenate(new_values[c]).astype(table.schema.scan_dtype(c))
               for c in all_columns}
     validity = {c: np.concatenate(new_valid[c]) for c in all_columns}
     if table.unique_indexes:
@@ -327,11 +367,12 @@ def execute_vacuum(cat: Catalog, table: TableMeta) -> dict:
                             level=table.compression_level,
                             index_columns=tuple(table.index_columns))
             live = 0
-            for batch in reader.scan(table.schema.names):
-                vals = {c: batch.values[c] for c in table.schema.names}
+            pnames = table.schema.physical_names()
+            for batch in reader.scan(pnames):
+                vals = {c: batch.values[c] for c in pnames}
                 valid = {c: (batch.validity[c] if batch.validity[c] is not None
                              else np.ones(batch.row_count, bool))
-                         for c in table.schema.names}
+                         for c in pnames}
                 w.append_batch(vals, valid)
                 live += batch.row_count
             w.flush()

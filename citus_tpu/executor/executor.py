@@ -20,6 +20,7 @@ from typing import Optional
 
 import numpy as np
 
+from citus_tpu import types as T
 from citus_tpu.catalog import Catalog
 from citus_tpu.config import Settings
 from citus_tpu.errors import ExecutionError
@@ -30,7 +31,10 @@ from citus_tpu.executor.finalize import finalize_groups, order_and_limit, projec
 from citus_tpu.executor.kernel_cache import get_kernel, jit_compile
 from citus_tpu.observability import trace as _trace
 from citus_tpu.observability.trace import clock
-from citus_tpu.ops.scan_agg import build_worker_fn, combine_partials_host
+from citus_tpu.ops.scan_agg import (
+    build_fused_worker_fn, build_worker_fn, combine_kinds,
+    combine_partials_host,
+)
 from citus_tpu.planner.auto_param import PHYSICAL_SRC, substitute_params
 from citus_tpu.planner.bind import BoundSelect
 from citus_tpu.planner.physical import (
@@ -72,17 +76,6 @@ class Result:
         return iter(self.rows)
 
 
-def _combine_kinds(plan: PhysicalPlan) -> list[str]:
-    kinds = []
-    for op in plan.partial_ops:
-        kinds.append({"sum": "sum", "count": "sum", "min": "min",
-                      "max": "max", "hll": "max", "ddsk": "sum",
-                      "topk": "sum", "topkv": "max"}[op.kind])
-    if plan.group_mode.kind == "direct":
-        kinds.append("sum")  # group row counts
-    return kinds
-
-
 def _load_all_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> list[ShardBatch]:
     """Load every (shard, batch) padded to a common power-of-two bucket."""
     from citus_tpu.testing.faults import FAULTS
@@ -118,9 +111,13 @@ def encode_params(cat: Catalog, bound, values: Optional[list]):
             f"query requires {len(bound.param_specs)} parameters")
     pcols, pvalids = [], []
     for (ptype, src), v in zip(bound.param_specs, values):
+        is_uuid = ptype.kind == T.UUID
         if v is None:
-            pcols.append(np.zeros((), ptype.device_dtype))
-            pvalids.append(np.zeros((), bool))
+            # a uuid parameter occupies two env slots (hi + lo lanes)
+            for _ in range(2 if is_uuid else 1):
+                pcols.append(np.zeros((), np.int64 if is_uuid
+                                      else ptype.device_dtype))
+                pvalids.append(np.zeros((), bool))
             continue
         if src == PHYSICAL_SRC:
             # auto-parameterized literal: value is already bound-level
@@ -131,6 +128,12 @@ def encode_params(cat: Catalog, bound, values: Optional[list]):
         if ptype.is_text:
             pid = cat.lookup_string_id(src[0], src[1], str(v))
             phys = -1 if pid is None else pid
+        elif is_uuid:
+            hi, lo = T.uuid_int_to_lanes(ptype.to_physical(v))
+            for lane in (hi, lo):
+                pcols.append(np.asarray(lane, np.int64))
+                pvalids.append(np.ones((), bool))
+            continue
         else:
             phys = ptype.to_physical(v)
         pcols.append(np.asarray(phys, ptype.device_dtype))
@@ -146,8 +149,9 @@ def _run_partials_cpu(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     for si in plan.shard_indexes:
         for values, masks, n in load_shard_batches(
                 cat, plan, si, min_batch_rows=1):
-            cols = tuple(values[c].astype(plan.bound.table.schema.column(c).type.device_dtype,
-                                          copy=False) for c in plan.scan_columns)
+            cols = tuple(values[c].astype(
+                plan.bound.table.schema.scan_dtype(c, device=True),
+                copy=False) for c in plan.scan_columns)
             valids = tuple(masks[c] for c in plan.scan_columns)
             shard_results.append(worker(cols + pcols, valids + pvalids,
                                         np.ones(n, bool)))
@@ -277,7 +281,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
 
     pcols, pvalids = params
     devices = jax.devices()
-    kinds = _combine_kinds(plan)
+    kinds = combine_kinds(plan)
     pstats = PipelineStats()
     _trace.set_phase("device")
 
@@ -393,62 +397,55 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             pstats.publish(plan)
             return combine_partials_host(plan, acc_np)
 
-    # ---- single-device path: streaming pipeline + HBM pinning --------
-    from collections import deque
-
+    # ---- single-device path: fused streaming pipeline + HBM pinning --
     task_times: list = []
-    jitted = get_kernel(plan, "jit_worker",
-                        lambda: jit_compile(build_worker_fn(plan, jnp)))
     # NOTE (round 5): the opt-in Pallas worker was removed rather than
     # shipped unproven.  The TPU tunnel was down for rounds 4 AND 5, so
     # the kernel could never Mosaic-compile on hardware (round 2 removed
     # Pallas kernels for exactly that int64 lowering risk, commit
     # 7756e0e), and an interpreter-verified kernel that has never met
     # the compiler it targets is a liability, not a feature (round-4
-    # VERDICT).  The fused-XLA worker above IS the production kernel:
+    # VERDICT).  The fused-XLA kernel below IS the production kernel:
     # one jitted program per plan shape, fully fused by XLA.  Resurrect
     # from git history (ops/pallas_scan.py) when a chip is reachable,
     # behind an A/B in bench.py.
-    def _worker_for(n_padded: int):
-        return jitted
-    def _build_merge():
-        def _merge(a, b):
-            out = []
-            for x, y, kind in zip(a, b, kinds):
-                if kind == "sum":
-                    out.append(x + y)
-                elif kind == "min":
-                    out.append(jnp.minimum(x, y))
-                else:
-                    out.append(jnp.maximum(x, y))
-            return tuple(out)
-        return jit_compile(_merge)
-    merge = get_kernel(plan, "jit_merge", _build_merge)
-
-    # accumulate on device; a single device_get at the end avoids one
-    # host round-trip per batch (the tunnel/PCIe latency dominates
-    # otherwise — same reason the reference streams per-task results
-    # instead of row-at-a-time fetches)
-    acc_dev = None
+    #
+    # The fused kernel folds the per-batch worker AND the running merge
+    # into ONE dispatch: the partial-agg registers ride along as a
+    # donated argument (acc buffers are reused in place by XLA), so
+    # each batch costs a single kernel launch and the accumulators
+    # never leave the device until the final device_get.
+    fused = get_kernel(
+        plan, "jit_fused",
+        lambda: jit_compile(build_fused_worker_fn(plan, jnp),
+                            donate_argnums=0))
+    acc_dev = tuple(jax.device_put(p) for p in _empty_partials(plan, np))
+    n_dispatch = 0
     if cached is not None:
         for b in cached:
             t0 = clock()
-            out = _worker_for(b.padded_rows)(b.cols + pcols,
-                                            b.valids + pvalids, b.row_mask)
-            acc_dev = out if acc_dev is None else merge(acc_dev, out)
+            acc_dev = fused(acc_dev, b.cols + pcols, b.valids + pvalids,
+                            b.row_mask)
+            n_dispatch += 1
             task_times.append((b.shard_index, b.n_rows,
                                clock() - t0))
     else:
         # stream: decompress batch i+1 on the host and transfer it while
-        # batch i computes (XLA's async dispatch overlaps the copy and
-        # compute streams); collect device references opportunistically
-        # and pin them only if the whole working set fits the cache —
-        # past capacity, throughput degrades to the pipeline rate
-        # instead of collapsing (SURVEY §2.4 "Pipelined ingest")
+        # batch i computes — double-buffering: the H2D copy stream and
+        # the compute stream overlap under XLA's async dispatch, and
+        # the donated accumulator chain serializes only the (tiny)
+        # register update, not the batch transfers.  Collect device
+        # references opportunistically and pin them only if the whole
+        # working set fits the cache — past capacity, throughput
+        # degrades to the pipeline rate instead of collapsing (SURVEY
+        # §2.4 "Pipelined ingest")
         from citus_tpu.testing.faults import FAULTS
         collect: Optional[list] = None if overlaid else []
         nbytes = 0
-        inflight: deque = deque()
+        depth = _prefetch_depth(settings)
+        window_bytes = 0       # un-synced streamed bytes on device
+        window_peak = 0
+        since_sync = 0
         if host_iter is None:
             host_iter = _iter_padded_batches(cat, plan, settings)
         # host/device overlap: the decode thread runs the host half of
@@ -465,26 +462,32 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                                 jax.device_put(hb.row_mask), hb.n_rows,
                                 hb.padded_rows, hb.shard_index)
                 t0 = clock()
-                out = _worker_for(db.padded_rows)(db.cols + pcols,
-                                                 db.valids + pvalids,
-                                                 db.row_mask)
-                acc_dev = out if acc_dev is None else merge(acc_dev, out)
+                acc_dev = fused(acc_dev, db.cols + pcols,
+                                db.valids + pvalids, db.row_mask)
+                n_dispatch += 1
                 task_times.append((db.shard_index, db.n_rows,
                                    clock() - t0))
-                nbytes += (sum(c.nbytes for c in hb.cols)
-                           + sum(v.nbytes for v in hb.valids)
-                           + hb.row_mask.nbytes)
+                bb = (sum(c.nbytes for c in hb.cols)
+                      + sum(v.nbytes for v in hb.valids)
+                      + hb.row_mask.nbytes)
+                nbytes += bb
                 if collect is not None:
                     collect.append(db)
                     if nbytes > GLOBAL_CACHE.capacity:
                         collect = None  # working set exceeds HBM cache
                 if collect is None:
-                    # bound in-flight device memory: wait for the output
-                    # from _prefetch_depth batches ago before admitting
-                    # another
-                    inflight.append(out)
-                    if len(inflight) > _prefetch_depth(settings):
-                        _block_ready(inflight.popleft())
+                    # bound in-flight device memory: the accumulator
+                    # chain orders every fused round, so syncing the
+                    # current registers retires all admitted batches —
+                    # at most `depth` batches are ever un-synced (the
+                    # double-buffer window the peak-HBM test bounds)
+                    window_bytes += bb
+                    window_peak = max(window_peak, window_bytes)
+                    since_sync += 1
+                    if since_sync >= depth:
+                        _block_ready(acc_dev)
+                        since_sync = 0
+                        window_bytes = 0
                 pstats.device_s += clock() - t_dev
                 ctx = _trace.current()
                 if ctx is not None:
@@ -495,7 +498,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                          "rows": int(hb.n_rows)})
         finally:
             host_iter.close()
-        if acc_dev is None:
+        if n_dispatch == 0:
             return combine_partials_host(plan, [_empty_partials(plan, np)])
         if collect is not None:
             _block_ready([b.cols for b in collect])
@@ -507,8 +510,15 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         partials = tuple(np.asarray(o) for o in jax.device_get(acc_dev))
         pstats.device_s += clock() - t_dev
         pstats.publish(plan)
+        GLOBAL_COUNTERS.bump("fused_dispatches", n_dispatch)
+        pl = plan.runtime_cache.setdefault("pipeline", {})
+        pl["fused_dispatches"] = n_dispatch
+        pl["stream_window_peak_bytes"] = window_peak
         plan.runtime_cache["task_times"] = task_times
         return partials
+    GLOBAL_COUNTERS.bump("fused_dispatches", n_dispatch)
+    plan.runtime_cache.setdefault("pipeline", {})["fused_dispatches"] = \
+        n_dispatch
     plan.runtime_cache["task_times"] = task_times
     return tuple(np.asarray(o) for o in jax.device_get(acc_dev))
 
@@ -529,7 +539,7 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings,
              params=((), ())) -> list[tuple]:
     backend = settings.executor.task_executor_backend
     mode = plan.group_mode.kind
-    penv = _params_env(params)
+    penv = _params_env(plan, params)
     if mode in ("scalar", "direct"):
         # push the worker half to coordinators OWNING remote-only
         # placements (ship partial-agg states, not stripe files) and
@@ -580,10 +590,11 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         return _run_agg_hash_host(cat, plan, settings, params)
 
 
-def _params_env(params) -> dict:
+def _params_env(plan, params) -> dict:
+    from citus_tpu.planner.bound import param_env_names
     pcols, pvalids = params
-    return {f"__param_{i}": (c, v)
-            for i, (c, v) in enumerate(zip(pcols, pvalids))}
+    return dict(zip(param_env_names(plan.bound.param_specs),
+                    zip(pcols, pvalids)))
 
 
 def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
@@ -602,7 +613,7 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     backend = settings.executor.task_executor_backend
     acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
     pcols, pvalids = params
-    penv = _params_env(params)
+    penv = _params_env(plan, params)
 
     # distinct/collect partial states are exact value (multi)sets: only
     # the host accumulation path can carry them
@@ -699,7 +710,7 @@ def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     for si in plan.shard_indexes:
         for values, masks, n in load_shard_batches(
                 cat, plan, si, min_batch_rows=1):
-            cols = tuple(values[c].astype(plan.bound.table.schema.column(c).type.device_dtype,
+            cols = tuple(values[c].astype(plan.bound.table.schema.scan_dtype(c, device=True),
                                           copy=False) for c in plan.scan_columns)
             valids = tuple(masks[c] for c in plan.scan_columns)
             mask, keys, args = worker(cols + pcols, valids + pvalids,
@@ -724,7 +735,7 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     backend = settings.executor.task_executor_backend
     use_jax = backend != "cpu"
     pcols, pvalids = params
-    penv = _params_env(params)
+    penv = _params_env(plan, params)
     pnames = tuple(penv)
     filter_fn = None
     if use_jax and plan.bound.filter is not None:
@@ -746,7 +757,7 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         for si in rp.shard_indexes:
             for values, masks, n in load_shard_batches(
                     cat, plan, si, min_batch_rows=1):
-                cols = tuple(values[c].astype(plan.bound.table.schema.column(c).type.device_dtype,
+                cols = tuple(values[c].astype(plan.bound.table.schema.scan_dtype(c, device=True),
                                               copy=False) for c in plan.scan_columns)
                 valids = tuple(masks[c] for c in plan.scan_columns)
                 if filter_fn is not None:
@@ -793,7 +804,7 @@ def _run_projection(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         if n == 0:
             continue
         env = {c: (values[c].astype(
-                       plan.bound.table.schema.column(c).type.device_dtype,
+                       plan.bound.table.schema.scan_dtype(c, device=True),
                        copy=False),
                    validity[c]) for c in plan.scan_columns}
         env.update(penv)

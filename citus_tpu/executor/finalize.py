@@ -17,8 +17,10 @@ import numpy as np
 
 from citus_tpu import types as T
 from citus_tpu.catalog import Catalog
+from citus_tpu.errors import UnsupportedFeatureError
 from citus_tpu.planner.bound import (
-    BColumn, BDictRemap, BKeyRef, compile_expr, predicate_mask, walk,
+    BColumn, BDictRemap, BKeyRef, BLiteral, compile_expr, predicate_mask,
+    walk,
 )
 from citus_tpu.planner.physical import AggExtract, PhysicalPlan
 
@@ -146,6 +148,42 @@ def default_text_src(plan):
     return resolve
 
 
+def _uuid_lane_strings(hi_v, hi_m, lo_v, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Recombine hi/lo int64 lane arrays into canonical uuid strings.
+
+    uuid columns are stored as two order-preserving int64 lanes
+    (dictionary bypass, types.py) — outputs rebuild the 128-bit value
+    here, on the already-filtered result set, never on the device."""
+    hi_v = np.asarray(hi_v).reshape(-1)
+    lo_v = np.asarray(lo_v).reshape(-1)
+    if isinstance(hi_m, (bool, np.bool_)):
+        hi_m = np.full(n, bool(hi_m))
+    else:
+        hi_m = np.asarray(hi_m).reshape(-1)
+    out = np.empty(n, object)
+    for i in range(n):
+        if hi_m[i]:
+            out[i] = T.uuid_from_lane_pair(int(hi_v[i]), int(lo_v[i]))
+    return out, hi_m
+
+
+def _uuid_output(e, env_get, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Evaluate a uuid-typed output expr without compile_expr (whose
+    literal cast would overflow int64).  ``env_get(name)`` returns the
+    (values, valid) pair for a column/lane env name."""
+    if isinstance(e, BColumn):
+        hv, hm = env_get(e.name)
+        lv, _lm = env_get(T.uuid_lane_name(e.name))
+        return _uuid_lane_strings(hv, hm, lv, n)
+    if isinstance(e, BLiteral):
+        out = np.empty(n, object)
+        if e.value is not None:
+            out[:] = e.type.from_physical(int(e.value))
+        return out, np.full(n, e.value is not None)
+    raise UnsupportedFeatureError(
+        f"uuid output expression {type(e).__name__} not supported yet")
+
+
 def finalize_groups(
     plan: PhysicalPlan, cat: Catalog,
     key_arrays: list[tuple[np.ndarray, np.ndarray]],
@@ -173,8 +211,29 @@ def finalize_groups(
     resolve = text_src or default_text_src(plan)
     text_cols = [resolve(e) for e in bound.final_exprs]
 
+    # a uuid group key spans two key slots: the visible hi-lane key and
+    # its hidden trailing lo-lane key (bind_select appends it) — locate
+    # the lane slot by name so BKeyRef outputs can recombine
+    lane_slot = {}
+    for i, k in enumerate(bound.group_keys):
+        if isinstance(k, BColumn) and k.type.kind == T.UUID:
+            lane_slot[i] = next(
+                j for j, g in enumerate(bound.group_keys)
+                if isinstance(g, BColumn)
+                and g.name == T.uuid_lane_name(k.name))
+
     out_cols = []
     for e in bound.final_exprs:
+        if e.type.kind == T.UUID:
+            if isinstance(e, BKeyRef) and e.index in lane_slot:
+                hv, hm = key_arrays[e.index]
+                lv, _lm = key_arrays[lane_slot[e.index]]
+                v, valid = _uuid_lane_strings(hv, hm, lv, n_groups)
+            else:
+                v, valid = _uuid_output(
+                    e, lambda name: env[name], n_groups)
+            out_cols.append((v, valid, e.type))
+            continue
         fn = compile_expr(e, np)
         v, valid = fn(env)
         v = np.broadcast_to(np.asarray(v), (n_groups,) + np.shape(v)[1:]) \
@@ -208,7 +267,10 @@ def project_rows(plan: PhysicalPlan, cat: Catalog, env_batches: list[dict],
     text_cols = [resolve(e) for e in bound.final_exprs]
     fns = plan.runtime_cache.get("np_final_fns")
     if fns is None:
-        fns = [compile_expr(e, np) for e in bound.final_exprs]
+        # uuid exprs are recombined from lanes below, not compiled —
+        # compile_expr's literal cast cannot hold a 128-bit value
+        fns = [None if e.type.kind == T.UUID else compile_expr(e, np)
+               for e in bound.final_exprs]
         plan.runtime_cache["np_final_fns"] = fns
     for env, mask in env_batches:
         idx = np.nonzero(mask)[0]
@@ -220,6 +282,12 @@ def project_rows(plan: PhysicalPlan, cat: Catalog, env_batches: list[dict],
                    for name, (v, m) in env.items()}
         cols = []
         for e, fn in zip(bound.final_exprs, fns):
+            if fn is None:
+                v, valid = _uuid_output(
+                    e, lambda name: sel_env[name], idx.size)
+                cols.append((v, np.broadcast_to(np.asarray(valid),
+                                                (idx.size,)), e.type))
+                continue
             v, valid = fn(sel_env)
             v = np.broadcast_to(np.asarray(v), (idx.size,) + np.shape(v)[1:]) \
                 if np.shape(v)[:1] != (idx.size,) else np.asarray(v)

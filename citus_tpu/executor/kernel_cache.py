@@ -164,7 +164,8 @@ def plan_fingerprint(plan) -> str:
     ops, group mode, (scan column, device dtype) pairs, parameter count.
     Bound expression nodes are frozen dataclasses, so their reprs are
     canonical; param count (not spec contents) keeps coordinator plans
-    and worker-decoded plans (param_specs=[None]*n) on one fingerprint.
+    and worker-decoded plans (logical specs rebuilt from the task's
+    param_specs types) on one fingerprint.
     """
     fp = plan.runtime_cache.get("_fingerprint")
     if fp is None:
@@ -175,7 +176,7 @@ def plan_fingerprint(plan) -> str:
             repr(plan.agg_args),
             repr(plan.partial_ops),
             repr(plan.group_mode),
-            repr([(c, str(schema.column(c).type.device_dtype))
+            repr([(c, str(schema.scan_dtype(c, device=True)))
                   for c in plan.scan_columns]),
             str(len(plan.bound.param_specs)),
         ]

@@ -292,8 +292,9 @@ def _stacked_params(group: list[_Waiter], q_pad: int):
     """Gather each $N across the group into a [q_pad] array (leading
     query axis).  Padding replicates the first query's values so padded
     lanes compute something valid and get discarded at scatter."""
+    from citus_tpu.planner.bound import param_env_names
     w0 = group[0]
-    n_params = len(w0.bound.param_specs)
+    n_params = len(param_env_names(w0.bound.param_specs))
     pcols, pvalids = [], []
     for j in range(n_params):
         vals = [w.params[0][j] for w in group]
@@ -325,34 +326,45 @@ def _batched_agg(cat, plan, settings, group: list[_Waiter]) -> list:
     )
     from citus_tpu.executor.kernel_cache import get_kernel, jit_compile
     from citus_tpu.executor.batches import ShardBatch
-    from citus_tpu.ops.scan_agg import build_worker_fn
+    from citus_tpu.ops.scan_agg import build_fused_worker_fn
     from citus_tpu.testing.faults import FAULTS
 
     q = len(group)
     qp = _q_pad(q)
     pcols, pvalids = _stacked_params(group, qp)
+    from citus_tpu.planner.bound import param_env_names
     n_cols = len(plan.scan_columns)
-    n_params = len(plan.bound.param_specs)
+    n_params = len(param_env_names(plan.bound.param_specs))
     axes = (None,) * n_cols + (0,) * n_params
 
     def _build():
-        # data columns broadcast across the query axis; only the
-        # trailing 0-d param "columns" map over it
-        return jit_compile(jax.vmap(build_worker_fn(plan, jnp),
-                                    in_axes=(axes, axes, None)))
-    batched = get_kernel(plan, "batched:jit_worker", _build)
+        # data columns broadcast across the query axis; the running
+        # accumulator registers and the trailing 0-d param "columns"
+        # map over it.  Same fused single-dispatch shape as the serial
+        # path: one kernel round per batch folds every rider's partials
+        # in place (acc donated — the [qp]-stacked registers stay
+        # device-resident across the whole shared scan)
+        return jit_compile(jax.vmap(build_fused_worker_fn(plan, jnp),
+                                    in_axes=(0, axes, axes, None)),
+                           donate_argnums=0)
+    batched = get_kernel(plan, "batched:jit_fused", _build)
 
     _trace.set_phase("device")
     # interval-free scan: the device-cache entry is the family-wide
     # full-shard batch set, shared by every literal variant
     key = plan_cache_key(plan, cat.data_dir)
     cached = GLOBAL_CACHE.get(key)
-    outs = []
+    # [qp]-stacked accumulator registers, one slot per rider (padding
+    # slots replay rider 0's params; their results are sliced off)
+    acc = tuple(jax.device_put(np.stack([p] * qp))
+                for p in _empty_partials(plan, np))
+    n_dispatch = 0
     if cached is not None:
         for b in cached:
             FAULTS.hit("device_round", plan.bound.table.name)
-            outs.append(batched(b.cols + pcols, b.valids + pvalids,
-                                b.row_mask))
+            acc = batched(acc, b.cols + pcols, b.valids + pvalids,
+                          b.row_mask)
+            n_dispatch += 1
     else:
         collect: Optional[list] = []
         nbytes = 0
@@ -362,8 +374,9 @@ def _batched_agg(cat, plan, settings, group: list[_Waiter]) -> list:
                             tuple(jax.device_put(v) for v in hb.valids),
                             jax.device_put(hb.row_mask), hb.n_rows,
                             hb.padded_rows, hb.shard_index)
-            outs.append(batched(db.cols + pcols, db.valids + pvalids,
-                                db.row_mask))
+            acc = batched(acc, db.cols + pcols, db.valids + pvalids,
+                          db.row_mask)
+            n_dispatch += 1
             nbytes += (sum(c.nbytes for c in hb.cols)
                        + sum(v.nbytes for v in hb.valids)
                        + hb.row_mask.nbytes)
@@ -373,18 +386,16 @@ def _batched_agg(cat, plan, settings, group: list[_Waiter]) -> list:
                     collect = None
         _counters().bump("bytes_scanned", nbytes)
         _counters().bump("device_hbm_touched_bytes", nbytes)
-        if collect is not None and outs:
+        if collect is not None and n_dispatch:
             from citus_tpu.executor.executor import _block_ready
             _block_ready([b.cols for b in collect])
             # family-wide entry shared across every literal variant:
             # attributed to the shared tenant bucket, not one rider
             GLOBAL_CACHE.put(key, collect, nbytes)
-    if not outs:
-        empty = _empty_partials(plan, np)
-        return [("agg", [empty]) for _ in group]
-    host = [tuple(np.asarray(o) for o in out) for out in outs]
-    return [("agg", [tuple(o[qi] for o in h) for h in host])
-            for qi in range(q)]
+    if n_dispatch:
+        _counters().bump("fused_dispatches", n_dispatch)
+    host = tuple(np.asarray(o) for o in jax.device_get(acc))
+    return [("agg", [tuple(o[qi] for o in host)]) for qi in range(q)]
 
 
 def _batched_projection(cat, plan, settings, group: list[_Waiter]) -> list:
@@ -399,9 +410,10 @@ def _batched_projection(cat, plan, settings, group: list[_Waiter]) -> list:
     q = len(group)
     qp = _q_pad(q)
     pcols, pvalids = _stacked_params(group, qp)
-    penvs = [_params_env(w.params) for w in group]
+    penvs = [_params_env(plan, w.params) for w in group]
+    from citus_tpu.planner.bound import param_env_names
     n_cols = len(plan.scan_columns)
-    n_params = len(plan.bound.param_specs)
+    n_params = len(param_env_names(plan.bound.param_specs))
     axes = (None,) * n_cols + (0,) * n_params
 
     batched = None
@@ -427,7 +439,7 @@ def _batched_projection(cat, plan, settings, group: list[_Waiter]) -> list:
     for si in plan.shard_indexes:
         for values, masks, n in load_shard_batches(cat, plan, si,
                                                    min_batch_rows=1):
-            cols = tuple(values[c].astype(schema.column(c).type.device_dtype,
+            cols = tuple(values[c].astype(schema.scan_dtype(c, device=True),
                                           copy=False)
                          for c in plan.scan_columns)
             valids = tuple(masks[c] for c in plan.scan_columns)
@@ -482,7 +494,7 @@ def _finalize_agg(cat, plan, batch_partials, params) -> list[tuple]:
     )
     from citus_tpu.executor.finalize import finalize_groups
     from citus_tpu.ops.scan_agg import combine_partials_host
-    penv = _params_env(params)
+    penv = _params_env(plan, params)
     partials = combine_partials_host(plan, batch_partials)
     if plan.group_mode.kind == "scalar":
         partials = tuple(

@@ -53,7 +53,7 @@ from citus_tpu.planner.physical import (
 from citus_tpu.storage.reader import Interval
 from citus_tpu.types import ColumnType
 
-TASK_VERSION = 1
+TASK_VERSION = 2
 
 #: partial-op kinds whose cross-host combine is a pure elementwise
 #: sum/min/max (combine_partials_host) — the only states worth shipping
@@ -115,7 +115,8 @@ def _enc_expr(e: B.BExpr) -> dict:
         return {"n": "lit", "v": _json_scalar(e.value),
                 "t": _enc_type(e.type)}
     if isinstance(e, B.BParam):
-        return {"n": "param", "i": e.index, "t": _enc_type(e.type)}
+        return {"n": "param", "i": e.index, "t": _enc_type(e.type),
+                "lane": e.lane}
     if isinstance(e, B.BBinOp):
         return {"n": "bin", "op": e.op, "l": _enc_expr(e.left),
                 "r": _enc_expr(e.right), "t": _enc_type(e.type)}
@@ -176,7 +177,8 @@ def _dec_expr(d: dict) -> B.BExpr:
     if n == "lit":
         return B.BLiteral(d["v"], _dec_type(d["t"]))
     if n == "param":
-        return B.BParam(int(d["i"]), _dec_type(d["t"]))
+        return B.BParam(int(d["i"]), _dec_type(d["t"]),
+                        str(d.get("lane", "")))
     if n == "bin":
         return B.BBinOp(str(d["op"]), _dec_expr(d["l"]),
                         _dec_expr(d["r"]), _dec_type(d["t"]))
@@ -259,6 +261,11 @@ def _encode_task(plan: PhysicalPlan, params) -> dict:
                        bool(iv.lo_inclusive), bool(iv.hi_inclusive)]
                       for iv in plan.intervals],
         "params": _enc_params(params),
+        # logical $N types (uuid spans TWO positional "params" lanes):
+        # the worker rebuilds param_specs from these so env names and
+        # the plan fingerprint's parameter count match the coordinator
+        "param_specs": [_enc_type(pt)
+                        for pt, _src in plan.bound.param_specs],
     }
     try:
         task["index_eq"] = (None if plan.index_eq is None else
@@ -362,7 +369,11 @@ def note_inexpressible(cat, plan: PhysicalPlan, settings) -> None:
 def _decode_plan(t, p: dict, shard_index: int):
     """Rebuild the synthetic BoundSelect + PhysicalPlan for one task."""
     filter_ = None if p["filter"] is None else _dec_expr(p["filter"])
-    n_params = len(p.get("params", []))
+    # logical specs travel in the task: a uuid spec owns two entries of
+    # p["params"] (hi + lo lanes), so param_env_names on this side
+    # yields the same env layout encode_params produced on the pusher
+    param_specs = [(_dec_type(d), "task")
+                   for d in p.get("param_specs", [])]
     if p["kind"] == "agg":
         group_keys = [_dec_expr(k) for k in p["group_keys"]]
         agg_args = [_dec_expr(a) for a in p["agg_args"]]
@@ -382,7 +393,7 @@ def _decode_plan(t, p: dict, shard_index: int):
         table=t, filter=filter_, group_keys=group_keys, aggs=[],
         final_exprs=[], output_names=[], having=None, order_by=[],
         limit=None, offset=None, distinct=False,
-        param_specs=[None] * n_params)
+        param_specs=param_specs)
     intervals = [Interval(str(c), lo, hi, bool(li), bool(hi_inc))
                  for c, lo, hi, li, hi_inc in p.get("intervals", [])]
     index_eq = p.get("index_eq")
@@ -409,8 +420,9 @@ def _run_task_projection(cat, plan: PhysicalPlan, params,
     from citus_tpu.planner.bound import compile_expr, predicate_mask
     t = plan.bound.table
     pcols, pvalids = params
-    penv = {f"__param_{i}": (c, v)
-            for i, (c, v) in enumerate(zip(pcols, pvalids))}
+    from citus_tpu.planner.bound import param_env_names
+    penv = dict(zip(param_env_names(plan.bound.param_specs),
+                    zip(pcols, pvalids)))
     cfn = (compile_expr(plan.bound.filter, np)
            if plan.bound.filter is not None else None)
     vals: dict = {c: [] for c in plan.scan_columns}
@@ -419,7 +431,7 @@ def _run_task_projection(cat, plan: PhysicalPlan, params,
     for values, masks, n in load_shard_batches(
             cat, plan, plan.shard_indexes[0], min_batch_rows=1):
         cols = tuple(
-            values[c].astype(t.schema.column(c).type.device_dtype,
+            values[c].astype(t.schema.scan_dtype(c, device=True),
                              copy=False) for c in plan.scan_columns)
         valids = tuple(masks[c] for c in plan.scan_columns)
         if cfn is not None:
@@ -442,7 +454,7 @@ def _run_task_projection(cat, plan: PhysicalPlan, params,
             break
     values_out, validity_out = {}, {}
     for c in plan.scan_columns:
-        dt = t.schema.column(c).type.device_dtype
+        dt = t.schema.scan_dtype(c, device=True)
         values_out[c] = (np.concatenate(vals[c]) if vals[c]
                          else np.zeros(0, dt))
         validity_out[c] = (np.concatenate(masks_out[c]) if masks_out[c]

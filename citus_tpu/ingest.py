@@ -18,6 +18,7 @@ from citus_tpu.catalog import Catalog, DistributionMethod, TableMeta
 from citus_tpu.catalog.hashing import hash_int64
 from citus_tpu.errors import AnalysisError
 from citus_tpu.storage import ShardWriter
+from citus_tpu.types import UUID, uuid_lane_arrays, uuid_lane_name
 
 
 def encode_columns(
@@ -36,7 +37,8 @@ def encode_columns(
             n = len(data)
         elif len(data) != n:
             raise AnalysisError("ragged ingest batch")
-        if isinstance(data, np.ndarray) and data.dtype != object and not col.type.is_text:
+        if isinstance(data, np.ndarray) and data.dtype != object \
+                and not col.type.is_text and col.type.kind != UUID:
             # already-numeric fast path: no per-value conversion
             if col.type.kind == "decimal" and np.issubdtype(data.dtype, np.floating):
                 # round half away from zero, matching to_physical's
@@ -59,6 +61,14 @@ def encode_columns(
                             f"invalid input value for enum {enum_t}: {v!r}")
             ids = cat.encode_strings(table.name, col.name, list(data))
             values[col.name] = np.asarray(ids, dtype=col.type.storage_dtype)
+        elif col.type.kind == UUID:
+            # dictionary bypass: the 128-bit value splits into two
+            # order-preserving int64 lane streams; no table-global ids
+            hi, lo = uuid_lane_arrays(data)
+            values[col.name] = hi
+            lane = uuid_lane_name(col.name)
+            values[lane] = lo
+            validity[lane] = valid
         else:
             phys = [col.type.to_physical(v) for v in data]
             values[col.name] = np.asarray(phys, dtype=col.type.storage_dtype)

@@ -22,23 +22,25 @@ from citus_tpu.storage import ShardReader
 
 
 def _collect_all_rows(cat: Catalog, table) -> tuple[dict, dict, int]:
-    """Read every live row of a table into column arrays."""
-    vals = {c.name: [] for c in table.schema}
-    valid = {c.name: [] for c in table.schema}
+    """Read every live row of a table into column arrays (PHYSICAL
+    column space: uuid columns carry their lane stream alongside)."""
+    names = table.schema.physical_names()
+    vals = {c: [] for c in names}
+    valid = {c: [] for c in names}
     total = 0
     for shard in table.shards:
         d = cat.shard_dir(table.name, shard.shard_id, shard.placements[0])
         if not os.path.isdir(d):
             continue
         reader = ShardReader(d, table.schema)
-        for batch in reader.scan(table.schema.names):
-            for c in table.schema.names:
+        for batch in reader.scan(names):
+            for c in names:
                 vals[c].append(batch.values[c])
                 m = batch.validity[c]
                 valid[c].append(np.ones(batch.row_count, bool) if m is None else m)
             total += batch.row_count
     out_v = {c: (np.concatenate(v) if v else
-                 np.zeros(0, table.schema.column(c).type.storage_dtype))
+                 np.zeros(0, table.schema.scan_dtype(c)))
              for c, v in vals.items()}
     out_m = {c: (np.concatenate(m) if m else np.zeros(0, bool))
              for c, m in valid.items()}

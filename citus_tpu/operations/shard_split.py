@@ -77,7 +77,8 @@ def _route_pass(cat: Catalog, t, src: str, new_files: list[str],
             codec=t.compression, level=t.compression_level,
             index_columns=tuple(t.index_columns))
     only = set(new_files)
-    for batch in reader.scan(t.schema.names, apply_deletes=False,
+    pnames = t.schema.physical_names()
+    for batch in reader.scan(pnames, apply_deletes=False,
                              only_stripes=only):
         keep = _snapshot_mask(src, batch, snapshot)
         h = hash_int64(batch.values[t.dist_column].astype(np.int64))
@@ -88,11 +89,11 @@ def _route_pass(cat: Catalog, t, src: str, new_files: list[str],
                 sel = sel & alive
             if not sel.any():
                 continue
-            vals = {c: batch.values[c][sel] for c in t.schema.names}
+            vals = {c: batch.values[c][sel] for c in pnames}
             valid = {c: (batch.validity[c][sel]
                          if batch.validity[c] is not None
                          else np.ones(int(sel.sum()), bool))
-                     for c in t.schema.names}
+                     for c in pnames}
             writers[bi].append_batch(vals, valid)
     for w in writers.values():
         w.flush()

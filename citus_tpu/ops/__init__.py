@@ -6,6 +6,10 @@ the reference's row-at-a-time ColumnarScanNext hot loop
 columnar_reader.c:323) with whole-batch XLA computations.
 """
 
-from citus_tpu.ops.scan_agg import build_worker_fn, combine_partials_host
+from citus_tpu.ops.scan_agg import (
+    build_fused_worker_fn, build_worker_fn, combine_kinds,
+    combine_partials_host,
+)
 
-__all__ = ["build_worker_fn", "combine_partials_host"]
+__all__ = ["build_fused_worker_fn", "build_worker_fn", "combine_kinds",
+           "combine_partials_host"]

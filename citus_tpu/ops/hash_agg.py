@@ -25,7 +25,7 @@ from typing import Callable
 
 import numpy as np
 
-from citus_tpu.planner.bound import _as_mask, compile_expr, predicate_mask
+from citus_tpu.planner.bound import _as_mask, compile_expr, param_env_names, predicate_mask
 from citus_tpu.planner.physical import PhysicalPlan
 from citus_tpu.ops.scan_agg import _sentinel
 
@@ -95,8 +95,7 @@ def build_hash_agg_worker(plan: PhysicalPlan, xp, slots: int) -> Callable:
     filter_fn = compile_expr(plan.bound.filter, xp) if plan.bound.filter is not None else None
     key_fns = [compile_expr(k, xp) for k in plan.bound.group_keys]
     arg_fns = [compile_expr(a, xp) for a in plan.agg_args]
-    names = plan.scan_columns + [f"__param_{i}"
-                                 for i in range(len(plan.bound.param_specs))]
+    names = plan.scan_columns + param_env_names(plan.bound.param_specs)
     partial_ops = plan.partial_ops
     S = slots
 

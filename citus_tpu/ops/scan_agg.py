@@ -28,7 +28,7 @@ from typing import Callable
 
 import numpy as np
 
-from citus_tpu.planner.bound import compile_expr, predicate_mask
+from citus_tpu.planner.bound import compile_expr, param_env_names, predicate_mask
 from citus_tpu.planner.physical import PhysicalPlan
 
 
@@ -49,8 +49,7 @@ def build_worker_fn(plan: PhysicalPlan, xp) -> Callable:
     mode = plan.group_mode
     # $N parameters ride as trailing 0-d "columns": the jitted kernel
     # treats them as traced inputs, so one compile serves every value
-    names = plan.scan_columns + [f"__param_{i}"
-                                 for i in range(len(plan.bound.param_specs))]
+    names = plan.scan_columns + param_env_names(plan.bound.param_specs)
     partial_ops = plan.partial_ops
 
     def eval_mask(env, row_mask):
@@ -258,6 +257,54 @@ def _np_scatter_min(acc, idx, upd):
 def _np_scatter_max(acc, idx, upd):
     np.maximum.at(acc, idx, upd)
     return acc
+
+
+def combine_kinds(plan: PhysicalPlan) -> list[str]:
+    """Elementwise combine op per partial state, in build_worker_fn
+    output order (the trailing "sum" is direct mode's group row
+    counts).  Shared by the host combine, the mesh collectives, and
+    the fused running merge below."""
+    kinds = []
+    for op in plan.partial_ops:
+        kinds.append({"sum": "sum", "count": "sum", "min": "min",
+                      "max": "max", "hll": "max", "ddsk": "sum",
+                      "topk": "sum", "topkv": "max"}[op.kind])
+    if plan.group_mode.kind == "direct":
+        kinds.append("sum")
+    return kinds
+
+
+def build_fused_worker_fn(plan: PhysicalPlan, xp) -> Callable:
+    """Fused single-dispatch hot loop: decode→filter→partial-agg AND
+    the running cross-batch merge in one kernel.
+
+    ``fused(acc, cols, valids, row_mask) -> acc'`` folds one batch into
+    the running partial-agg registers.  The executor jits it with
+    ``donate_argnums=0`` so the register buffers are donated back to
+    the output and stay device-resident across the whole scan — one
+    kernel launch per batch, no separate merge dispatch, no host
+    round-trip until the final ``device_get``.  Each accumulator has
+    the same shape/dtype as the matching ``_empty_partials`` seed, so
+    donation reuses every buffer in place."""
+    if plan.group_mode.kind == "hash_host":
+        raise ValueError("fused accumulation needs device-combinable "
+                         "partials (scalar/direct group modes)")
+    worker = build_worker_fn(plan, xp)
+    kinds = combine_kinds(plan)
+
+    def fused(acc, cols, valids, row_mask):
+        out = worker(cols, valids, row_mask)
+        new = []
+        for a, o, kind in zip(acc, out, kinds):
+            if kind == "sum":
+                new.append(a + o)
+            elif kind == "min":
+                new.append(xp.minimum(a, o))
+            else:
+                new.append(xp.maximum(a, o))
+        return tuple(new)
+
+    return fused
 
 
 def combine_partials_host(plan: PhysicalPlan, shard_partials: list[tuple]) -> tuple:

@@ -82,7 +82,10 @@ class BoundSelect:
         if not self.has_aggs:
             for e in self.final_exprs:
                 cols.update(referenced_columns(e))
-        return sorted(cols)
+        # a uuid column always scans with its low int64 lane (projection
+        # and grouping recombine the pair); lane refs from rewritten
+        # filters pass through unchanged
+        return sorted(self.table.schema.physical_names(sorted(cols)))
 
 
 def _like_to_regex(pattern: str) -> "re.Pattern":
@@ -314,6 +317,15 @@ class Binder:
         if isinstance(e, A.Cast):
             inner = self.bind_scalar(e.expr, allow_agg)
             target = T.type_from_sql(e.type_name, list(e.type_args) or None)
+            if target.kind == T.UUID:
+                if isinstance(inner, BLiteral) \
+                        and isinstance(inner.value, str):
+                    # typed literal: uuid '...' folds to its 128-bit int
+                    return BLiteral(target.to_physical(inner.value), target)
+                if inner.type.kind == T.UUID:
+                    return inner
+                raise UnsupportedFeatureError(
+                    "cast to uuid requires a uuid value or string literal")
             if target.is_text:
                 if isinstance(inner, BLiteral) \
                         and isinstance(inner.value, str):
@@ -369,6 +381,10 @@ class Binder:
         """'1994-01-01' vs date column, 'AIR' vs text column, etc."""
         if target.kind in (T.DATE, T.TIMESTAMP, T.TIMESTAMPTZ, T.TIME,
                            T.INTERVAL):
+            return BLiteral(target.to_physical(lit.value), target)
+        if target.kind == T.UUID:
+            # dictionary bypass: the literal folds to its 128-bit integer;
+            # _bind_uuid_compare splits it into int64 lane literals
             return BLiteral(target.to_physical(lit.value), target)
         if target.is_text:
             if column is None:
@@ -539,6 +555,9 @@ class Binder:
             if enum_cmp is not None:
                 return enum_cmp
         left, right = self._align(left, right)
+        if op in ("=", "<>", "<", "<=", ">", ">=") \
+                and (left.type.kind == T.UUID or right.type.kind == T.UUID):
+            return self._bind_uuid_compare(op, left, right)
         if op in ("=", "<>", "<", "<=", ">", ">="):
             if left.type.is_text and op not in ("=", "<>"):
                 raise UnsupportedFeatureError("ordered comparison on text columns")
@@ -551,6 +570,52 @@ class Binder:
             out = T.decimal_t(38, max(left.type.scale if left.type.is_decimal else 0,
                                       right.type.scale if right.type.is_decimal else 0))
         return BBinOp(op, left, right, out)
+
+    def _uuid_lane_exprs(self, e: BExpr) -> tuple[BExpr, BExpr]:
+        """uuid-typed operand -> (hi, lo) int64 lane expressions.  The
+        base column stream carries the high 64 bits; the companion
+        "<name>::lo" stream carries the low 64."""
+        from citus_tpu.planner.bound import BParam
+        if isinstance(e, BColumn):
+            return (BColumn(e.name, T.INT64_T),
+                    BColumn(T.uuid_lane_name(e.name), T.INT64_T))
+        if isinstance(e, BLiteral):
+            if e.value is None:
+                return BLiteral(None, T.INT64_T), BLiteral(None, T.INT64_T)
+            hi, lo = T.uuid_int_to_lanes(int(e.value))
+            return BLiteral(hi, T.INT64_T), BLiteral(lo, T.INT64_T)
+        if isinstance(e, BParam):
+            return (BParam(e.index, T.INT64_T),
+                    BParam(e.index, T.INT64_T, lane=T.UUID_LANE_SUFFIX))
+        raise UnsupportedFeatureError(
+            f"uuid comparison over {type(e).__name__} not supported")
+
+    def _bind_uuid_compare(self, op: str, left: BExpr,
+                           right: BExpr) -> BExpr:
+        """uuid comparisons lower onto the two int64 lane streams (the
+        dictionary-bypass path): equality is lane-wise AND; ordering is
+        lexicographic on (hi, lo) — the offset-binary lane encoding
+        makes signed int64 order match unsigned 128-bit order."""
+        if left.type.kind != T.UUID or right.type.kind != T.UUID:
+            raise AnalysisError(
+                f"cannot compare {left.type} and {right.type}")
+        lh, ll = self._uuid_lane_exprs(left)
+        rh, rl = self._uuid_lane_exprs(right)
+
+        def eq(a, b):
+            return BBinOp("=", a, b, T.BOOL_T)
+
+        if op == "=":
+            return BBinOp("and", eq(lh, rh), eq(ll, rl), T.BOOL_T)
+        if op == "<>":
+            return BBinOp("or", BBinOp("<>", lh, rh, T.BOOL_T),
+                          BBinOp("<>", ll, rl, T.BOOL_T), T.BOOL_T)
+        strict = "<" if op in ("<", "<=") else ">"
+        return BBinOp(
+            "or", BBinOp(strict, lh, rh, T.BOOL_T),
+            BBinOp("and", eq(lh, rh), BBinOp(op, ll, rl, T.BOOL_T),
+                   T.BOOL_T),
+            T.BOOL_T)
 
     def _bind_in(self, e: A.InList, allow_agg: bool) -> BExpr:
         target = self.bind_scalar(e.expr, allow_agg)
@@ -926,6 +991,9 @@ class Binder:
         if kind in ("min", "max"):
             if t.is_text:
                 raise UnsupportedFeatureError("min/max over text not supported yet")
+            if t.kind == T.UUID:
+                raise UnsupportedFeatureError(
+                    "min/max over uuid not supported yet")
             return t
         raise AnalysisError(f"unknown aggregate {kind}")
 
@@ -1198,6 +1266,13 @@ def bind_select(catalog: Catalog, stmt: A.Select,
             b._ast_key_map.setdefault(g, i)
         except TypeError:
             pass
+    # a uuid group key carries its low int64 lane as a hidden trailing
+    # key, so grouping is exact over all 128 bits; finalize recombines
+    # the pair by lane name.  Appending after key_map keeps BKeyRef
+    # indices of the visible keys stable.
+    for k in list(group_keys):
+        if isinstance(k, BColumn) and k.type.kind == T.UUID:
+            group_keys.append(BColumn(T.uuid_lane_name(k.name), T.INT64_T))
 
     has_agg_funcs = any(_contains_agg(i.expr) for i in items) or \
         (stmt.having is not None) or bool(group_keys)

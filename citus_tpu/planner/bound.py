@@ -47,10 +47,13 @@ class BParam(BExpr):
     runtime array — kernels jitted once serve every parameter value."""
     index: int  # 0-based
     type: T.ColumnType
+    # "" = the whole value (uuid: its high lane); types.UUID_LANE_SUFFIX
+    # = the low int64 lane of a uuid parameter
+    lane: str = ""
 
     @property
     def env_name(self) -> str:
-        return f"__param_{self.index}"
+        return f"__param_{self.index}{self.lane}"
 
 
 @dataclass(frozen=True)
@@ -269,6 +272,18 @@ def walk(e: BExpr):
 
 def referenced_columns(e: BExpr) -> list[str]:
     return sorted({n.name for n in walk(e) if isinstance(n, BColumn)})
+
+
+def param_env_names(param_specs) -> list[str]:
+    """Worker env names for plan parameters, in positional order; a uuid
+    parameter contributes its low int64 lane right after its high lane
+    (matching BParam.env_name for both lanes)."""
+    out: list[str] = []
+    for i, spec in enumerate(param_specs):
+        out.append(f"__param_{i}")
+        if spec[0].kind == T.UUID:
+            out.append(f"__param_{i}{T.UUID_LANE_SUFFIX}")
+    return out
 
 
 # ---------------------------------------------------------- compilation

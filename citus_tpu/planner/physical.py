@@ -209,6 +209,9 @@ def _key_domain(cat: Catalog, table: TableMeta, key: BExpr,
                 bounds: dict[str, tuple]) -> Optional[KeyDomain]:
     """Provable physical domain of a group key, or None."""
     if isinstance(key, BColumn):
+        if key.type.kind == T.UUID or T.is_uuid_lane(key.name):
+            # 128-bit lane pairs have no enumerable domain
+            return None
         if key.type.is_text:
             size = len(cat.dictionary(table.name, key.name))
             return KeyDomain(lo=0, size=size + 1)

@@ -10,7 +10,10 @@ from dataclasses import dataclass, field
 from typing import Iterator
 
 from citus_tpu.errors import AnalysisError
-from citus_tpu.types import ColumnType, type_from_sql
+from citus_tpu.types import (
+    UUID, ColumnType, is_uuid_lane, type_from_sql, uuid_lane_base,
+    uuid_lane_name,
+)
 
 
 @dataclass(frozen=True)
@@ -89,6 +92,49 @@ class Schema:
                    d.get("default", ""))
             for d in data
         ])
+
+    # ---- uuid lane resolution ------------------------------------------
+    # A uuid column owns a companion int64 stream named
+    # "<name>::lo" (types.UUID_LANE_SUFFIX).  Lane names are valid scan/
+    # storage identifiers everywhere below the planner, but are not
+    # schema columns: resolve them through these helpers.
+
+    def scan_column(self, name: str) -> Column:
+        """Like column(), but lane names resolve to their base uuid
+        column (the lane inherits nullability from it)."""
+        if is_uuid_lane(name):
+            base = self.column(uuid_lane_base(name))
+            if base.type.kind != UUID:
+                raise AnalysisError(f"column {name!r} does not exist")
+            return base
+        return self.column(name)
+
+    def scan_storage_name(self, name: str) -> str:
+        """Scan name -> on-disk stream key (lane streams derive theirs
+        from the base column's storage_name, so RENAME stays free)."""
+        if is_uuid_lane(name):
+            return uuid_lane_name(self.scan_column(name).storage_name)
+        return self.column(name).storage_name
+
+    def scan_dtype(self, name: str, device: bool = False):
+        """Scan name -> storage (or device) dtype; uuid lanes are int64
+        either way."""
+        col = self.scan_column(name)
+        return col.type.device_dtype if device else col.type.storage_dtype
+
+    def physical_names(self, names=None) -> list[str]:
+        """Expand column names to physical stream names: every uuid
+        column contributes its lane companion right after itself.
+        Already-expanded lane names pass through unchanged."""
+        out: list[str] = []
+        for n in (self.names if names is None else names):
+            out.append(n)
+            if not is_uuid_lane(n) and self.has(n) \
+                    and self.column(n).type.kind == UUID:
+                lane = uuid_lane_name(n)
+                if names is None or lane not in names:
+                    out.append(lane)
+        return out
 
     @staticmethod
     def of(*cols: tuple) -> "Schema":

@@ -164,6 +164,14 @@ class StatCounters:
         "metadata_sync_rounds",
         "metadata_stale_reads",
         "wait_metadata_sync_ms",
+        # fused single-dispatch hot loop (executor/executor.py,
+        # executor/megabatch.py): kernel rounds issued with the running
+        # partial-agg registers donated in (1 per batch — the staged
+        # worker+merge pair would be 2), and rows in chunks the footer
+        # min/max admission refuted BEFORE their streams were read or
+        # decompressed (storage/reader.py)
+        "fused_dispatches",
+        "fused_rows_skipped",
     ]
 
     def __init__(self):

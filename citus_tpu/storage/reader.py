@@ -17,10 +17,33 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from citus_tpu.errors import StorageError
+from citus_tpu.errors import AnalysisError, StorageError
 from citus_tpu.schema import Schema
 from citus_tpu.storage.format import read_stripe_footer, read_chunk
 from citus_tpu.storage.writer import _load_meta
+
+
+# SET citus.decode_threads pushes here (the process-wide native pool has
+# no cluster handle, like kernel_cache's set_capacity); None = read the
+# ambient settings
+_DECODE_THREADS: Optional[int] = None
+
+
+def set_decode_threads(n: int) -> None:
+    global _DECODE_THREADS
+    _DECODE_THREADS = int(n)
+
+
+def decode_thread_count() -> int:
+    """Threads for the native read+decompress pool — citus.decode_threads
+    (0 = auto: min(8, cpu_count))."""
+    n = _DECODE_THREADS
+    if n is None:
+        from citus_tpu.config import current_settings
+        n = current_settings().executor.decode_threads
+    if n > 0:
+        return n
+    return min(8, os.cpu_count() or 1)
 
 
 @dataclass(frozen=True)
@@ -94,7 +117,7 @@ class ShardReader:
         from citus_tpu.storage.overlay import visible_deletes
         constraints = constraints or []
         for col in columns:
-            self.schema.column(col)  # validate projection
+            self.schema.scan_column(col)  # validate projection
         delete_cache = visible_deletes(self.directory) if apply_deletes else {}
         for stripe in self.meta["stripes"]:
             if only_stripes is not None and stripe["file"] not in only_stripes:
@@ -106,6 +129,13 @@ class ShardReader:
                 from citus_tpu.executor.executor import GLOBAL_COUNTERS
                 GLOBAL_COUNTERS.bump("chunks_total", footer.chunk_count)
                 GLOBAL_COUNTERS.bump("chunks_selected", int(selected.sum()))
+                # rows refuted by footer min/max BEFORE any stream bytes
+                # of theirs are read or decompressed — the fused hot
+                # loop's admission win
+                skipped = int(np.asarray(
+                    footer.chunk_row_counts)[~selected].sum())
+                if skipped:
+                    GLOBAL_COUNTERS.bump("fused_rows_skipped", skipped)
             except ImportError:
                 pass
             if not selected.any():
@@ -126,8 +156,9 @@ class ShardReader:
                 for ci in sel_idx:
                     vals, valid = {}, {}
                     for col in columns:
-                        c = self.schema.column(col)
-                        stream = footer.columns.get(c.storage_name)
+                        c = self.schema.scan_column(col)
+                        stream = footer.columns.get(
+                            self.schema.scan_storage_name(col))
                         if stream is None:
                             # column added after this stripe: all NULL
                             n_ = footer.chunk_row_counts[ci]
@@ -193,8 +224,9 @@ class ShardReader:
                     local = np.sort(pos[chunk_of == ci]) - bounds[ci]
                     vals, valid = {}, {}
                     for col in columns:
-                        c = self.schema.column(col)
-                        stream = footer.columns.get(c.storage_name)
+                        c = self.schema.scan_column(col)
+                        stream = footer.columns.get(
+                            self.schema.scan_storage_name(col))
                         if stream is None:
                             # column added after this stripe: all NULL
                             vals[col] = np.zeros(local.size, c.type.storage_dtype)
@@ -241,7 +273,7 @@ class ShardReader:
         streams = []  # (col, k, stats)
         missing = []  # columns added after this stripe was written
         for col in columns:
-            sname = self.schema.column(col).storage_name
+            sname = self.schema.scan_storage_name(col)
             if sname not in footer.columns:
                 missing.append(col)
                 continue
@@ -256,8 +288,7 @@ class ShardReader:
         if len(streams) >= 8:
             # thread-pooled read+decompress (each worker owns a file
             # handle + scratch) — saturates cold-scan bandwidth
-            import os as _os
-            nt = min(8, _os.cpu_count() or 1)
+            nt = decode_thread_count()
             rc = lib.ct_read_streams_mt(
                 path.encode(), cid, len(streams),
                 offs.ctypes.data_as(i64p), clens.ctypes.data_as(i64p),
@@ -276,22 +307,22 @@ class ShardReader:
         per_col_vals: dict[str, list] = {c: [None] * len(sel_idx) for c in columns}
         per_col_valid: dict[str, list] = {c: [None] * len(sel_idx) for c in columns}
         for si, (col, k, s) in enumerate(streams):
-            dt = self.schema.column(col).type.storage_dtype
+            dt = self.schema.scan_column(col).type.storage_dtype
             arr = out[dsts[si]:dsts[si] + rlens[si]].view(dt)
             if arr.shape[0] != s.row_count:
                 return None
             per_col_vals[col][k] = arr
         for col in missing:
-            dt = self.schema.column(col).type.storage_dtype
+            dt = self.schema.scan_column(col).type.storage_dtype
             for k, ci in enumerate(sel_idx):
                 n_ = footer.chunk_row_counts[ci]
                 per_col_vals[col][k] = np.zeros(n_, dt)
                 per_col_valid[col][k] = np.zeros(n_, bool)
         # validity streams (usually few; read individually)
-        null_streams = [(col, k, footer.columns[self.schema.column(col).storage_name][ci])
+        null_streams = [(col, k, footer.columns[self.schema.scan_storage_name(col)][ci])
                         for col in columns if col not in missing
                         for k, ci in enumerate(sel_idx)
-                        if footer.columns[self.schema.column(col).storage_name][ci].has_nulls]
+                        if footer.columns[self.schema.scan_storage_name(col)][ci].has_nulls]
         if null_streams:
             from citus_tpu.storage import compression as comp
             with open(path, "rb") as fh:
@@ -327,7 +358,10 @@ class ShardReader:
     def _selected_chunks(self, footer, constraints: list[Interval]) -> np.ndarray:
         mask = np.ones(footer.chunk_count, dtype=bool)
         for c in constraints:
-            sname = self.schema.column(c.column).storage_name                 if self.schema.has(c.column) else c.column
+            try:
+                sname = self.schema.scan_storage_name(c.column)
+            except AnalysisError:
+                sname = c.column
             chunks = footer.columns.get(sname)
             if chunks is None:
                 # column added after this stripe: every row is NULL there,

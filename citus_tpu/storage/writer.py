@@ -160,8 +160,11 @@ class ShardWriter:
         from citus_tpu.types import SKETCH
         self._no_stats_columns = frozenset(
             c.storage_name for c in schema if c.type.kind == SKETCH)
-        self._buf: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
-        self._buf_valid: dict[str, list[np.ndarray]] = {c.name: [] for c in schema}
+        # physical stream names: schema columns plus the int64 lane
+        # companion each uuid column carries ("<name>::lo")
+        self._names = schema.physical_names()
+        self._buf: dict[str, list[np.ndarray]] = {n: [] for n in self._names}
+        self._buf_valid: dict[str, list[np.ndarray]] = {n: [] for n in self._names}
         self._buf_rows = 0
 
     # ------------------------------------------------------------------
@@ -178,10 +181,15 @@ class ShardWriter:
             return
         if set(values) != set(self._buf):
             raise StorageError(f"batch columns {sorted(values)} != schema {sorted(self._buf)}")
-        for col in self.schema.names:
-            v = np.asarray(values[col], dtype=self.schema.column(col).type.storage_dtype)
+        for col in self._names:
+            v = np.asarray(values[col], dtype=self.schema.scan_dtype(col))
             self._buf[col].append(v)
             va = None if validity is None else validity.get(col)
+            if va is None and validity is not None:
+                # lane streams share the base uuid column's validity
+                from citus_tpu.types import is_uuid_lane, uuid_lane_base
+                if is_uuid_lane(col):
+                    va = validity.get(uuid_lane_base(col))
             self._buf_valid[col].append(
                 np.ones(n, dtype=bool) if va is None else np.asarray(va, dtype=bool))
         self._buf_rows += n
@@ -214,12 +222,12 @@ class ShardWriter:
     def _flush_rows(self, n: int) -> None:
         column_chunks: dict[str, list] = {}
         chunk_rows: list[int] = []
-        col_vals = {c: self._take(self._buf, c, n) for c in self.schema.names}
-        col_valid = {c: self._take(self._buf_valid, c, n) for c in self.schema.names}
+        col_vals = {c: self._take(self._buf, c, n) for c in self._names}
+        col_valid = {c: self._take(self._buf_valid, c, n) for c in self._names}
         for start in range(0, n, self.chunk_row_limit):
             stop = min(start + self.chunk_row_limit, n)
             chunk_rows.append(stop - start)
-        for col in self.schema.names:
+        for col in self._names:
             chunks = []
             for start in range(0, n, self.chunk_row_limit):
                 stop = min(start + self.chunk_row_limit, n)
@@ -232,7 +240,7 @@ class ShardWriter:
                     chunks.append((vals, valid))
                 else:
                     chunks.append((vals, None))
-            column_chunks[self.schema.column(col).storage_name] = chunks
+            column_chunks[self.schema.scan_storage_name(col)] = chunks
         if self.staged_xid is not None:
             # staged stripes get a transaction-unique name so concurrent
             # ingests into one placement can never collide on a file

@@ -110,7 +110,7 @@ _STORAGE_DTYPES = {
     TIME: np.int64,
     INTERVAL: np.int64,
     TEXT: np.int32,
-    UUID: np.int32,
+    UUID: np.int64,
     BYTEA: np.int32,
     ARRAY: np.int32,
     SKETCH: np.int32,
@@ -131,7 +131,7 @@ _DEVICE_DTYPES = {
     TIME: np.int64,
     INTERVAL: np.int64,
     TEXT: np.int32,
-    UUID: np.int32,
+    UUID: np.int64,
     BYTEA: np.int32,
     ARRAY: np.int32,
     SKETCH: np.int32,
@@ -148,8 +148,70 @@ SKETCH_WORD_KINDS = ("hll", "ddsk", "topk", "tdg")
 #: arbitrary varlena datums in columnar chunks
 #: (columnar/columnar_tableam.c:718); here every variable-width type
 #: rides the dictionary machinery with kind-specific canonicalization
-#: (normalize_word) and rendering (render_word).
-_DICTIONARY_KINDS = (TEXT, UUID, BYTEA, ARRAY, SKETCH)
+#: (normalize_word) and rendering (render_word).  UUID left this club:
+#: it is already fixed-width (128 bits), so it stores as two int64
+#: lanes per column and never touches the table-global dictionary.
+_DICTIONARY_KINDS = (TEXT, BYTEA, ARRAY, SKETCH)
+
+
+# ---- uuid lane encoding --------------------------------------------------
+#
+# A uuid column stores as TWO int64 streams: the base column holds the
+# high 64 bits, a companion "<name>::lo" stream holds the low 64 bits.
+# Both lanes are offset-binary (bit 63 flipped), so SIGNED int64 order
+# on (hi, lo) equals unsigned 128-bit order equals canonical lowercase
+# hex text order — chunk min/max stats on the lanes prune correctly and
+# equality/ordering run directly on fixed-width lanes in the kernels.
+
+#: companion-stream suffix ("::" cannot appear in a SQL identifier path
+#: that reaches storage, so derived names never collide with user columns)
+UUID_LANE_SUFFIX = "::lo"
+
+_LANE_BIAS = 1 << 63
+_U64 = (1 << 64) - 1
+
+
+def is_uuid_lane(name: str) -> bool:
+    return name.endswith(UUID_LANE_SUFFIX)
+
+
+def uuid_lane_name(name: str) -> str:
+    return name + UUID_LANE_SUFFIX
+
+
+def uuid_lane_base(name: str) -> str:
+    return name[:-len(UUID_LANE_SUFFIX)] if is_uuid_lane(name) else name
+
+
+def uuid_int_to_lanes(value: int) -> tuple[int, int]:
+    """128-bit uuid int -> (hi, lo) signed offset-binary int64 lanes."""
+    return (((value >> 64) & _U64) - _LANE_BIAS), ((value & _U64) - _LANE_BIAS)
+
+
+def uuid_lanes_to_int(hi: int, lo: int) -> int:
+    """(hi, lo) signed offset-binary lanes -> 128-bit uuid int."""
+    return (((int(hi) + _LANE_BIAS) & _U64) << 64) | ((int(lo) + _LANE_BIAS) & _U64)
+
+
+def uuid_lane_arrays(values) -> tuple[np.ndarray, np.ndarray]:
+    """Iterable of uuid spellings (str/UUID/None) -> (hi, lo) int64
+    arrays (0 under nulls; validity is tracked separately)."""
+    n = len(values)
+    hi = np.zeros(n, np.int64)
+    lo = np.zeros(n, np.int64)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        h, l = uuid_int_to_lanes(UUID_T.to_physical(v))
+        hi[i] = h
+        lo[i] = l
+    return hi, lo
+
+
+def uuid_from_lane_pair(hi, lo) -> str:
+    """One (hi, lo) lane pair -> canonical lowercase uuid string."""
+    import uuid as _uuid
+    return str(_uuid.UUID(int=uuid_lanes_to_int(hi, lo)))
 
 
 @dataclass(frozen=True)
@@ -186,8 +248,10 @@ class ColumnType:
     @property
     def is_orderable_physical(self) -> bool:
         """True when physical-value order == logical order (everything but
-        the dictionary kinds, whose ids are assigned in insertion order)."""
-        return self.kind not in _DICTIONARY_KINDS
+        the dictionary kinds, whose ids are assigned in insertion order,
+        and uuid, whose single-lane physical is only a partial order —
+        the full order needs both lanes)."""
+        return self.kind not in _DICTIONARY_KINDS and self.kind != UUID
 
     # ---- dictionary-kind canonicalization ------------------------------
     def normalize_word(self, value: Any) -> str:
@@ -326,6 +390,15 @@ class ColumnType:
             if isinstance(value, datetime.timedelta):
                 return value // datetime.timedelta(microseconds=1)
             return _parse_interval_us(str(value))
+        if k == UUID:
+            import uuid as _uuid
+            if isinstance(value, _uuid.UUID):
+                return value.int
+            try:
+                return _uuid.UUID(str(value)).int
+            except (ValueError, AttributeError, TypeError):
+                raise AnalysisError(
+                    f"invalid input syntax for type uuid: {value!r}")
         raise AnalysisError(f"cannot convert value for type {self}")
 
     def from_physical(self, raw: int | float, null: bool = False) -> Any:
@@ -356,6 +429,9 @@ class ColumnType:
                                  us // 1_000_000 % 60, us % 1_000_000)
         if k == INTERVAL:
             return datetime.timedelta(microseconds=int(raw))
+        if k == UUID:
+            import uuid as _uuid
+            return str(_uuid.UUID(int=int(raw)))
         raise AnalysisError(f"cannot convert value for type {self}")
 
     def __str__(self) -> str:

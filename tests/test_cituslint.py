@@ -879,6 +879,34 @@ def test_jit_purity_clock_read_via_jit_compile(tmp_path):
     assert "clock read" in diags[0].message
 
 
+def test_jit_purity_covers_donated_accumulator_body(tmp_path):
+    # the fused hot-loop shape: the accumulator-threading body handed
+    # to jit_compile WITH donate_argnums is purity-checked exactly like
+    # a plain traced body — donation kwargs must not hide it
+    src = """
+        COUNTERS = None
+
+        def build(cache):
+            def fused(acc, cols, valids, row_mask):
+                COUNTERS.bump("fused_dispatches")
+                return tuple(a + c for a, c in zip(acc, cols))
+            return cache.jit_compile(fused, donate_argnums=0)
+    """
+    diags = run_lint(make_pkg(tmp_path, {"k.py": src}),
+                     select={"JIT01"})
+    assert ids(diags) == ["JIT01"]
+    assert "COUNTERS bump" in diags[0].message
+
+    pure = """
+        def build(cache, xp):
+            def fused(acc, cols, valids, row_mask):
+                return tuple(xp.minimum(a, c) for a, c in zip(acc, cols))
+            return cache.jit_compile(fused, donate_argnums=0)
+    """
+    assert run_lint(make_pkg(tmp_path, {"k.py": pure}),
+                    select={"JIT01"}) == []
+
+
 def test_new_rules_suppressible_with_pragma(tmp_path):
     src = """
         import threading

@@ -51,8 +51,8 @@ def test_jit_kernel_reused_across_values(db):
     plan = cl._plan_cache[("$param", sql)][1]
     # one plan object; its jitted worker was traced exactly once even
     # though four different parameter values executed
-    assert "mesh_run" in plan.runtime_cache or "jit_worker" in plan.runtime_cache
-    jitted = plan.runtime_cache.get("jit_worker")
+    assert "mesh_run" in plan.runtime_cache or "jit_fused" in plan.runtime_cache
+    jitted = plan.runtime_cache.get("jit_fused")
     if jitted is not None and hasattr(jitted, "_cache_size"):
         assert jitted._cache_size() == 1
 

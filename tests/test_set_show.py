@@ -99,3 +99,17 @@ def test_show_all_and_unknown(cl):
         cl.execute("SHOW citus.nope")
     with pytest.raises(CatalogError):
         cl.execute("SET citus.shard_count = 'many'")
+
+
+def test_set_decode_threads_drives_native_pool(cl):
+    from citus_tpu.storage import reader as R
+    # default: 0 = auto (min(8, cpu_count)); SHOW renders the raw GUC
+    assert cl.execute("SHOW citus.decode_threads").rows == [("0",)]
+    try:
+        cl.execute("SET citus.decode_threads = 3")
+        assert cl.execute("SHOW citus.decode_threads").rows == [("3",)]
+        assert R.decode_thread_count() == 3
+        cl.execute("SET citus.decode_threads = 0")   # back to auto
+        assert R.decode_thread_count() >= 1
+    finally:
+        R.set_decode_threads(0)

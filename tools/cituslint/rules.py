@@ -251,6 +251,13 @@ CONFINED_CALLS = {
     # exactly one selector-driven dispatcher per process — ad-hoc
     # selectors would re-grow thread-per-RPC shapes around it
     "selectors.DefaultSelector": ("net/event_loop.py",),
+    # the fused decode→filter→partial-agg(+merge) kernel builder lives
+    # in ops/ and is entered only through the executor's jit_fused /
+    # batched:jit_fused kernel-cache slots — an ad-hoc fused build
+    # elsewhere would dodge both the cache and the donated-accumulator
+    # discipline (the dtype/shape contract with _empty_partials)
+    "citus_tpu.ops.scan_agg.build_fused_worker_fn":
+        ("executor/executor.py", "executor/megabatch.py"),
 }
 
 #: method name -> in-package files allowed to CALL it (receiver-typed
