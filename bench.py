@@ -312,6 +312,107 @@ def bench_scan_fuse(cl, extra: dict) -> None:
     extra["scan_fuse"] = fuse
 
 
+def bench_hash_agg(cl, extra: dict) -> None:
+    """Streaming fused hash aggregation A/B (ops/hash_agg.py
+    build_fused_hash_worker + the executor's donated HBM-resident
+    table): high-cardinality GROUP BY through the fused device path vs
+    the staged host accumulator (task_executor_backend = 'cpu') —
+    rows/s plus the dispatch/spill counters — then a 2-host loopback
+    push-vs-pull A/B: shipped hash-table partials (TASK_VERSION 3
+    "hash" tasks) against the pull path's raw-placement bytes."""
+    import shutil
+    import tempfile
+
+    import citus_tpu as ct
+    from citus_tpu.executor.device_cache import GLOBAL_CACHE
+    from citus_tpu.executor.executor import GLOBAL_COUNTERS
+
+    # l_orderkey spans ~N_ROWS/4 distinct values: unprovable domain ->
+    # the hash_host group mode, the path under test
+    sql = ("SELECT l_orderkey, count(*), sum(l_quantity) "
+           "FROM lineitem GROUP BY l_orderkey")
+
+    def measure():
+        GLOBAL_CACHE.clear()
+        c0 = GLOBAL_COUNTERS.snapshot()
+        t0 = time.perf_counter()
+        cl.execute(sql)
+        wall = time.perf_counter() - t0
+        c1 = GLOBAL_COUNTERS.snapshot()
+        return wall, {k: c1[k] - c0[k] for k in (
+            "hash_fused_dispatches", "hash_spill_rows")}
+
+    cl.execute("SET citus.hash_agg_slots = auto")
+    cl.execute(sql)  # fused arm: plan + kernels warm
+    fused_wall, fused_c = measure()
+    cl.execute("SET citus.task_executor_backend = 'cpu'")
+    cl.execute(sql)  # staged arm warm
+    staged_wall, _ = measure()
+    cl.execute("SET citus.task_executor_backend = 'tpu'")
+    cl.execute("SET citus.hash_agg_slots = 8192")
+    hagg = {
+        "fused_rows_per_sec": round(N_ROWS / fused_wall, 1),
+        "staged_cpu_rows_per_sec": round(N_ROWS / staged_wall, 1),
+        # acceptance bar: >= 2x the staged host accumulator
+        "speedup_vs_staged": round(staged_wall / fused_wall, 2),
+        "hash_fused_dispatches": fused_c["hash_fused_dispatches"],
+        "hash_spill_rows": fused_c["hash_spill_rows"],
+    }
+
+    root = tempfile.mkdtemp(prefix="bench_hashagg_", dir=_HERE)
+    a = ct.Cluster(os.path.join(root, "a"), serve_port=0, data_port=0,
+                   hosted_nodes=set(), n_nodes=0)
+    b = None
+    try:
+        a.register_node()
+        b = ct.Cluster(os.path.join(root, "b"), data_port=0,
+                       hosted_nodes=set(), n_nodes=0,
+                       coordinator=("127.0.0.1", a.control_port))
+        b.register_node()
+        a._maybe_reload_catalog(force_sync=True)
+        n = int(os.environ.get("BENCH_HASH_AGG_ROWS", "400000"))
+        a.execute("CREATE TABLE hb (k bigint NOT NULL, v bigint)")
+        a.execute("SELECT create_distributed_table('hb', 'k', 8)")
+        # spread ~50k distinct keys over a > direct_gid_limit domain so
+        # the planner picks hash_host (the pushable-partials path)
+        a.copy_from("hb", columns={"k": (np.arange(n) % 50_000) * 20_000_003,
+                                   "v": np.arange(n)})
+        q = "SELECT k, count(*), sum(v) FROM hb GROUP BY k"
+        runs = {}
+        for mode in ("push", "pull"):
+            a.execute(f"SET citus.remote_task_execution = {mode}")
+            GLOBAL_CACHE.clear()
+            a.execute(q)  # plans + kernels warm under this mode
+            GLOBAL_CACHE.clear()
+            c0 = GLOBAL_COUNTERS.snapshot()
+            t0 = time.perf_counter()
+            a.execute(q)
+            wall = time.perf_counter() - t0
+            c1 = GLOBAL_COUNTERS.snapshot()
+            runs[mode] = {
+                "ms": round(wall * 1000, 2),
+                "remote_tasks_pushed":
+                    c1["remote_tasks_pushed"] - c0["remote_tasks_pushed"],
+                "hash_partials_pushed":
+                    c1["hash_partials_pushed"]
+                    - c0["hash_partials_pushed"],
+                "remote_task_fallbacks":
+                    c1["remote_task_fallbacks"]
+                    - c0["remote_task_fallbacks"],
+                "remote_task_result_bytes":
+                    c1["remote_task_result_bytes"]
+                    - c0["remote_task_result_bytes"],
+            }
+        a.execute("SET citus.remote_task_execution = auto")
+        hagg["push_vs_pull"] = runs
+    finally:
+        if b is not None:
+            b.close()
+        a.close()
+        shutil.rmtree(root, ignore_errors=True)
+    extra["hash_agg"] = hagg
+
+
 def bench_trace_overhead(cl, extra: dict) -> None:
     """Tracing cost (observability/): warm Q1 wall time with sampling
     off (the allocation-free no-op recorder) vs sample_rate=1.0 (every
@@ -1300,6 +1401,8 @@ def main() -> None:
         bench_megabatch(cl, extra)
     if os.environ.get("BENCH_SCAN_FUSE", "1") != "0":
         bench_scan_fuse(cl, extra)
+    if os.environ.get("BENCH_HASH_AGG", "1") != "0":
+        bench_hash_agg(cl, extra)
     if os.environ.get("BENCH_TRACE", "1") != "0":
         bench_trace_overhead(cl, extra)
     if os.environ.get("BENCH_RECORDER", "1") != "0":

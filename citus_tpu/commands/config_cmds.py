@@ -49,6 +49,17 @@ def _window_ms(v) -> float:
     return float(v)
 
 
+def _hash_slots(v) -> int:
+    """citus.hash_agg_slots = <slots> | auto (stored as 0: sized from
+    catalog row-count stats at execution)."""
+    if str(v).lower() == "auto":
+        return 0
+    n = int(v)
+    if n < 0:
+        raise ValueError(v)
+    return n
+
+
 def _sample_rate(v) -> float:
     """citus.trace_sample_rate = 0.0 .. 1.0."""
     f = float(v)
@@ -174,7 +185,7 @@ _GUCS = {
     # daemon knobs
     "citus.executor_min_batch_rows": ("executor", "min_batch_rows", int),
     "citus.direct_gid_limit": ("planner", "direct_gid_limit", int),
-    "citus.hash_agg_slots": ("planner", "hash_agg_slots", int),
+    "citus.hash_agg_slots": ("planner", "hash_agg_slots", _hash_slots),
     "citus.repartition_bucket_count_per_device": ("planner", "repartition_bucket_count_per_device", int),
     "citus.start_maintenance_daemon": (None, "start_maintenance_daemon", "bool"),
     "citus.authority_watch_interval": (None, "authority_watch_interval_s", float),

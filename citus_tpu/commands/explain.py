@@ -270,6 +270,11 @@ def _run_analyze(cl, stmt: A.Explain) -> list[str]:
             line += (f", stream window peak "
                      f"{pl['stream_window_peak_bytes']} bytes")
         lines.append(line)
+        if "hash_slots" in pl:
+            lines.append(
+                f"    Hash: hash slots {pl['hash_slots']}, "
+                f"occupancy {pl.get('hash_occupancy_pct', 0):g}%, "
+                f"spilled {pl.get('hash_spilled_rows', 0)} rows")
         if "remote_wait_ms" in pl:
             wire = f", wire {pl['wire_format']}" \
                 if pl.get("wire_format") else ""

@@ -41,7 +41,9 @@ class PlannerSettings:
     # Direct-gid when the composite key domain is provably <= this bound
     # (exact, collision-free scatter-add).
     direct_gid_limit: int = 65536
-    # Slot count for the fingerprint hash-aggregate fallback.
+    # Slot count for the fingerprint hash-aggregate fallback; 0 = auto
+    # (SET citus.hash_agg_slots = auto): sized from catalog row-count
+    # stats, next power of two clamped [1024, 1<<20].
     hash_agg_slots: int = 8192
     # Enable repartition (all_to_all) joins; reference GUC
     # citus.enable_repartition_joins.

@@ -597,115 +597,304 @@ def _params_env(plan, params) -> dict:
                     zip(pcols, pvalids)))
 
 
+def _hash_has_exact(plan: PhysicalPlan) -> bool:
+    """distinct/collect partial states are exact value (multi)sets and
+    sketch registers have their own merge laws: only the host
+    accumulation path (and the pull path on the wire) can carry them."""
+    return any(op.kind in ("distinct", "collect", "collect_set", "hll",
+                           "ddsk", "topk", "topkv")
+               for op in plan.partial_ops)
+
+
+def _hash_slots(cat: Catalog, plan: PhysicalPlan, settings: Settings) -> int:
+    """citus.hash_agg_slots; 0 (= auto) sizes the table from catalog
+    row-count stats — next power of two, clamped [1024, 1<<20] — so
+    small tables don't pay a megaslot fetch and big ones don't spill
+    every other row."""
+    S = settings.planner.hash_agg_slots
+    if S > 0:
+        return S
+    from citus_tpu.catalog.stats import table_row_count
+    try:
+        n = table_row_count(cat, cat.table(plan.bound.table.name))
+    except Exception:
+        n = 0
+    n = max(1, int(n))
+    return min(1 << 20, max(1024, 1 << (n - 1).bit_length()))
+
+
+def _hash_key_dtypes(plan: PhysicalPlan, penv: dict) -> tuple:
+    """Device dtype of each group-key expression, probed by evaluating
+    the compiled key on a zero-row scan env (uuid lanes, casts and
+    dictionary remaps all resolve without trusting declared types)."""
+    from citus_tpu.planner.bound import compile_expr
+    schema = plan.bound.table.schema
+    env = {c: (np.zeros(0, schema.scan_dtype(c, device=True)),
+               np.zeros(0, bool))
+           for c in plan.scan_columns}
+    env.update(penv)
+    dts = []
+    for k in plan.bound.group_keys:
+        kv, _ = compile_expr(k, np)(env)
+        dts.append(np.asarray(kv).dtype)
+    return tuple(dts)
+
+
+def _stream_hash_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings,
+                         params, fused, state, acc, penv, pstats, hs):
+    """Stream the plan's shards through the fused hash kernel.
+
+    One dispatch per batch against the DONATED running table ``state``;
+    spill masks drain into ``acc`` per prefetch window (not per batch),
+    at the same sync points that bound the un-synced H2D window — so
+    peak device footprint stays O(slots) + depth × batch bytes and the
+    host never materializes the scan.  ``hs`` accumulates dispatch /
+    window / spill bookkeeping across calls (local + fallback passes).
+    """
+    import jax
+    from citus_tpu.executor.pipeline import prefetch_batches, read_ahead_depth
+    from citus_tpu.testing.faults import FAULTS
+    pcols, pvalids = params
+    depth = _prefetch_depth(settings)
+    pending: list = []   # (host batch, device spill mask) awaiting drain
+
+    def _drain():
+        for hb, sp in pending:
+            sp = np.asarray(sp)
+            if sp.any():
+                n_sp = int(sp.sum())
+                GLOBAL_COUNTERS.bump("hash_spill_rows", n_sp)
+                hs["spilled"] += n_sp
+                env = {n: (np.asarray(c), np.asarray(v))
+                       for n, c, v in zip(plan.scan_columns, hb.cols,
+                                          hb.valids)}
+                env.update(penv)
+                acc.add_batch(sp, [f(env) for f in hs["key_fns_np"]],
+                              [f(env) for f in hs["arg_fns_np"]])
+        pending.clear()
+
+    window_bytes = 0
+    since_sync = 0
+    host_iter = prefetch_batches(_iter_padded_batches(cat, plan, settings),
+                                 read_ahead_depth(settings), pstats)
+    try:
+        for hb in host_iter:
+            t_dev = clock()
+            FAULTS.hit("device_round", plan.bound.table.name)
+            db = ShardBatch(tuple(jax.device_put(c) for c in hb.cols),
+                            tuple(jax.device_put(v) for v in hb.valids),
+                            jax.device_put(hb.row_mask), hb.n_rows,
+                            hb.padded_rows, hb.shard_index)
+            t0 = clock()
+            state, spill = fused(state, db.cols + pcols,
+                                 db.valids + pvalids, db.row_mask)
+            hs["n_dispatch"] += 1
+            hs["task_times"].append((db.shard_index, db.n_rows, clock() - t0))
+            bb = (sum(c.nbytes for c in hb.cols)
+                  + sum(v.nbytes for v in hb.valids) + hb.row_mask.nbytes)
+            hs["nbytes"] += bb
+            pending.append((hb, spill))
+            window_bytes += bb
+            hs["window_peak"] = max(hs["window_peak"], window_bytes)
+            since_sync += 1
+            if since_sync >= depth:
+                _block_ready(state)
+                _drain()
+                since_sync = 0
+                window_bytes = 0
+            pstats.device_s += clock() - t_dev
+            ctx = _trace.current()
+            if ctx is not None:
+                tr, parent = ctx
+                tr.add_closed("device_round", parent.span_id, t_dev, clock(),
+                              {"shard_index": int(hb.shard_index),
+                               "rows": int(hb.n_rows)})
+    finally:
+        host_iter.close()
+    _drain()
+    return state
+
+
+def _run_hash_device(cat: Catalog, plan: PhysicalPlan, settings: Settings,
+                     params, acc, penv, push_remote: bool):
+    """Device half of a hash_host plan: stream every local batch into ONE
+    donated HBM-resident hash table (kernel slot ``jit_hash_fused``),
+    draining spills into ``acc`` exactly.  With ``push_remote``,
+    remote-only shards ship as hash tasks first and their returned table
+    partials re-insert through the fused device merge door
+    (``jit_hash_merge``); push fallbacks re-stream locally.  Returns the
+    fetched (key_tables, partials, rows) host arrays."""
+    import jax
+    import jax.numpy as jnp
+    from citus_tpu.executor.pipeline import PipelineStats
+    from citus_tpu.ops.hash_agg import (
+        build_fused_hash_worker, build_fused_entry_merge, empty_hash_state,
+        merge_hash_tables_into,
+    )
+    from citus_tpu.planner.bound import compile_expr as _ce
+
+    pstats = PipelineStats()
+    _trace.set_phase("device")
+    S = _hash_slots(cat, plan, settings)
+    key_dtypes = _hash_key_dtypes(plan, penv)
+    fused = get_kernel(
+        plan, "jit_hash_fused",
+        lambda: jit_compile(build_fused_hash_worker(plan, jnp, key_dtypes),
+                            donate_argnums=0))
+    hs = {"n_dispatch": 0, "window_peak": 0, "nbytes": 0, "spilled": 0,
+          "task_times": [],
+          "key_fns_np": [_ce(k, np) for k in plan.bound.group_keys],
+          "arg_fns_np": [_ce(a, np) for a in plan.agg_args]}
+    state = jax.device_put(empty_hash_state(plan, S, key_dtypes))
+
+    dispatch = None
+    run_plan = plan
+    if push_remote:
+        from citus_tpu.executor.pipeline import dispatch_remote_tasks
+        local, dispatch = dispatch_remote_tasks(cat, plan, settings, params)
+        if local != plan.shard_indexes:
+            import dataclasses
+            run_plan = dataclasses.replace(plan, shard_indexes=local)
+    try:
+        state = _stream_hash_batches(cat, run_plan, settings, params, fused,
+                                     state, acc, penv, pstats, hs)
+    except BaseException:
+        if dispatch is not None:
+            dispatch.abort()  # no RPC thread outlives the attempt
+        raise
+    if dispatch is not None:
+        fallback, remote = dispatch.collect()
+        if fallback:
+            import dataclasses
+            fb_plan = dataclasses.replace(plan, shard_indexes=fallback)
+            state = _stream_hash_batches(cat, fb_plan, settings, params,
+                                         fused, state, acc, penv, pstats, hs)
+        if remote:
+            merge_jit = get_kernel(
+                plan, "jit_hash_merge",
+                lambda: jit_compile(
+                    build_fused_entry_merge(plan, jnp, key_dtypes),
+                    donate_argnums=0))
+            for table, spilled in remote:
+                if table is not None:
+                    key_e, part_e, row_e = table
+                    state, espill = merge_jit(
+                        state,
+                        tuple((jnp.asarray(kv), jnp.asarray(kf))
+                              for kv, kf in key_e),
+                        tuple(jnp.asarray(p) for p in part_e),
+                        jnp.asarray(row_e))
+                    espill = np.asarray(espill)
+                    if espill.any():
+                        # fingerprint-collision losers among remote
+                        # entries: merge exactly on the host
+                        merge_hash_tables_into(acc, plan, key_e, part_e,
+                                               row_e, entry_mask=espill)
+                if spilled is not None:
+                    sk, sp, sr = spilled
+                    merge_hash_tables_into(acc, plan, sk, sp, sr)
+                GLOBAL_COUNTERS.bump("hash_partials_pushed")
+    t_dev = clock()
+    fetched = jax.device_get(state)
+    pstats.device_s += clock() - t_dev
+    h_keys = [(np.asarray(kv), np.asarray(kf)) for kv, kf in fetched[0]]
+    h_partials = tuple(np.asarray(p) for p in fetched[1])
+    h_rows = np.asarray(fetched[2])
+    GLOBAL_COUNTERS.bump("bytes_scanned", hs["nbytes"])
+    GLOBAL_COUNTERS.bump("device_hbm_touched_bytes", hs["nbytes"])
+    GLOBAL_COUNTERS.bump("hash_fused_dispatches", hs["n_dispatch"])
+    pstats.h2d_bytes = hs["nbytes"]
+    pstats.publish(plan)
+    pl = plan.runtime_cache.setdefault("pipeline", {})
+    pl["fused_dispatches"] = hs["n_dispatch"]
+    pl["stream_window_peak_bytes"] = hs["window_peak"]
+    pl["hash_slots"] = S
+    pl["hash_occupancy_pct"] = round(100.0 * int((h_rows > 0).sum()) / S, 1)
+    pl["hash_spilled_rows"] = hs["spilled"]
+    plan.runtime_cache["task_times"] = hs["task_times"]
+    return h_keys, h_partials, h_rows
+
+
+def _run_hash_partial_state(cat: Catalog, plan: PhysicalPlan,
+                            settings: Settings, params=((), ())):
+    """Worker half of a pushed hash task: -> (table | None, spilled |
+    None) where ``table`` is the merged device hash table's host arrays
+    and ``spilled`` re-renders the host accumulator's exact groups as
+    entry arrays (key values, int8 flags [valid+1], partial values, one
+    synthetic row per group).  cpu-backend workers ship spill-only."""
+    from citus_tpu.executor.host_agg import HostGroupAccumulator
+
+    acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
+    penv = _params_env(plan, params)
+    table = None
+    if settings.executor.task_executor_backend != "cpu":
+        table = _run_hash_device(cat, plan, settings, params, acc, penv,
+                                 push_remote=False)
+    else:
+        pcols, pvalids = params
+        worker = build_worker_fn(plan, np)
+        for si in plan.shard_indexes:
+            for values, masks, n in load_shard_batches(
+                    cat, plan, si, min_batch_rows=1):
+                cols = tuple(values[c].astype(
+                    plan.bound.table.schema.scan_dtype(c, device=True),
+                    copy=False) for c in plan.scan_columns)
+                valids = tuple(masks[c] for c in plan.scan_columns)
+                mask, keys, args = worker(cols + pcols, valids + pvalids,
+                                          np.ones(n, bool))
+                acc.add_batch(
+                    np.asarray(mask),
+                    [(np.asarray(v), m if isinstance(m, bool)
+                      else np.asarray(m)) for v, m in keys],
+                    [(np.asarray(v), m if isinstance(m, bool)
+                      else np.asarray(m)) for v, m in args])
+    key_arrays, partials = acc.finalize(
+        [k.type for k in plan.bound.group_keys])
+    spilled = None
+    if key_arrays:
+        G = int(np.asarray(key_arrays[0][0]).shape[0])
+        keys_w = [(np.asarray(vals),
+                   np.asarray(valid).astype(np.int8) + 1)
+                  for vals, valid in key_arrays]
+        spilled = (keys_w, tuple(np.asarray(p) for p in partials or ()),
+                   np.ones(G, np.int64))
+    return table, spilled
+
+
 def _run_agg_hash_host(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                        params=((), ())) -> list[tuple]:
     """Unbounded GROUP BY cardinality.
 
-    tpu backend: device-side open-addressed hash aggregation
-    (ops/hash_agg.py) with exact host merge of the per-shard tables and
-    host handling of spilled rows.  cpu backend: full host grouping."""
+    tpu backend: streaming fused device hash aggregation
+    (ops/hash_agg.py build_fused_hash_worker) — one donated HBM-resident
+    table, one dispatch per batch, exact host merge of the final table
+    and of spilled rows; remote-only shards push hash tasks and ship
+    table partials back over CTFR frames.  cpu backend (and exact
+    value-set partials): full host grouping over the pull path."""
     from citus_tpu.executor.host_agg import HostGroupAccumulator
     from citus_tpu.executor.worker_tasks import note_inexpressible
 
-    # hash_host partials (per-shard hash tables / exact value sets) are
-    # not elementwise-combinable — remote-only shards take the pull path
-    note_inexpressible(cat, plan, settings)
     backend = settings.executor.task_executor_backend
     acc = HostGroupAccumulator(len(plan.bound.group_keys), plan.partial_ops)
     pcols, pvalids = params
     penv = _params_env(plan, params)
 
-    # distinct/collect partial states are exact value (multi)sets: only
-    # the host accumulation path can carry them
-    has_exact = any(op.kind in ("distinct", "collect", "collect_set", "hll",
-                                "ddsk", "topk", "topkv")
-                    for op in plan.partial_ops)
-    if backend != "cpu" and not has_exact:
-        import jax
-        import jax.numpy as jnp
-        from citus_tpu.ops.hash_agg import (
-            build_hash_agg_worker, build_table_merge, merge_hash_tables_into,
-        )
-        from citus_tpu.planner.bound import compile_expr as _ce
-
-        S = settings.planner.hash_agg_slots
-        jitted = get_kernel(
-            plan, "jit_hash_worker",
-            lambda: jit_compile(build_hash_agg_worker(plan, jnp, S)),
-            extra=(S,))
-        key_fns_np = [_ce(k, np) for k in plan.bound.group_keys]
-        arg_fns_np = [_ce(a, np) for a in plan.agg_args]
-        batches = _load_all_batches(cat, plan, settings)
-        if not batches:
-            key_arrays, partials = acc.finalize(
-                [k.type for k in plan.bound.group_keys],
-                scalar=not plan.bound.group_keys)
-            if partials is None:
-                return []
-            return finalize_groups(plan, cat, key_arrays, partials,
-                                   params_env=penv)
-        dev_tables = []   # per-batch (key_tables, partials, rows) on device
-        spills = []       # (batch, device spill mask)
-        for b in batches:
-            key_tables, partials, rows, spill = jitted(
-                b.cols + pcols, b.valids + pvalids, b.row_mask)
-            dev_tables.append((key_tables, partials, rows))
-            spills.append((b, spill))
-        entry_spill = None
-        entries = None
-        if len(dev_tables) > 1:
-            # combine ON DEVICE (VERDICT #8): occupied table entries are
-            # rows of (keys, partial states); re-insert them with merge
-            # semantics.  Table count pads to a power of two so the merge
-            # kernel compiles once per bucket.
-            n_pad = 1 << (len(dev_tables) - 1).bit_length()
-            while len(dev_tables) < n_pad:
-                zt = tuple((jnp.zeros_like(kv), jnp.zeros_like(kf))
-                           for kv, kf in dev_tables[0][0])
-                zp = tuple(jnp.zeros_like(p) for p in dev_tables[0][1])
-                dev_tables.append((zt, zp, jnp.zeros_like(dev_tables[0][2])))
-            entries = (
-                tuple((jnp.concatenate([t[0][ki][0] for t in dev_tables]),
-                       jnp.concatenate([t[0][ki][1] for t in dev_tables]))
-                      for ki in range(len(plan.bound.group_keys))),
-                tuple(jnp.concatenate([t[1][pi] for t in dev_tables])
-                      for pi in range(len(plan.partial_ops))),
-                jnp.concatenate([t[2] for t in dev_tables]),
-            )
-            mkey = f"jit_table_merge_{n_pad}"
-            merge_jit = get_kernel(
-                plan, mkey,
-                lambda: jit_compile(build_table_merge(plan, jnp, S)),
-                extra=(S,))
-            key_tables, partials, rows, entry_spill = merge_jit(*entries)
-        else:
-            key_tables, partials, rows = dev_tables[0]
-        # ONE synchronized fetch per query: the merged table + spill masks
-        fetched = jax.device_get(
-            (key_tables, partials, rows,
-             entry_spill if entry_spill is not None else (),
-             [s for _, s in spills]))
-        h_keys, h_partials, h_rows, h_entry_spill, h_spills = fetched
+    if backend != "cpu" and not _hash_has_exact(plan):
+        from citus_tpu.ops.hash_agg import merge_hash_tables_into
+        h_keys, h_partials, h_rows = _run_hash_device(
+            cat, plan, settings, params, acc, penv, push_remote=True)
         merge_hash_tables_into(acc, plan, h_keys, h_partials, h_rows)
-        if entries is not None and np.asarray(h_entry_spill).any():
-            # fingerprint-collision losers among entries: merge exactly
-            e_keys, e_partials, e_rows = jax.device_get(entries)
-            merge_hash_tables_into(acc, plan, e_keys, e_partials, e_rows,
-                                   entry_mask=np.asarray(h_entry_spill))
-        for (b, _), spill in zip(spills, h_spills):
-            spill = np.asarray(spill)
-            if spill.any():
-                env = {n: (np.asarray(c), np.asarray(v))
-                       for n, c, v in zip(plan.scan_columns, b.cols, b.valids)}
-                env.update(penv)
-                keys = [f(env) for f in key_fns_np]
-                args = [f(env) for f in arg_fns_np]
-                acc.add_batch(spill, keys, args)
-        key_arrays, partials = acc.finalize([k.type for k in plan.bound.group_keys])
+        key_arrays, partials = acc.finalize(
+            [k.type for k in plan.bound.group_keys],
+            scalar=not plan.bound.group_keys)
         if partials is None:
             return []
-        return finalize_groups(plan, cat, key_arrays, partials, params_env=penv)
+        return finalize_groups(plan, cat, key_arrays, partials,
+                               params_env=penv)
 
+    # exact value-set partials (or the cpu oracle backend) stay host-only
+    # and are not elementwise-combinable — remote-only shards pull
+    note_inexpressible(cat, plan, settings)
     worker = build_worker_fn(plan, np)
     for si in plan.shard_indexes:
         for values, masks, n in load_shard_batches(

@@ -17,6 +17,21 @@ from citus_tpu.planner.physical import PartialOp
 from citus_tpu.ops.scan_agg import _sentinel
 
 
+def _canon_float_keys(kv_np: list) -> list:
+    """Canonicalize float KEY values before their bit patterns become
+    group identity: ``-0.0`` → ``0.0`` and every NaN payload → the
+    canonical quiet NaN, matching the device path's ``_canon_keys``
+    (ops/hash_agg.py) so both paths land SQL-equal values in ONE group."""
+    out = []
+    for v, m in kv_np:
+        if np.issubdtype(v.dtype, np.floating):
+            dt = v.dtype
+            v = np.where(v == dt.type(0), dt.type(0.0), v)
+            v = np.where(np.isnan(v), dt.type(np.nan), v)
+        out.append((v, m))
+    return out
+
+
 class HostGroupAccumulator:
     def __init__(self, n_keys: int, partial_ops: list[PartialOp]):
         self.n_keys = n_keys
@@ -86,7 +101,7 @@ class HostGroupAccumulator:
                 m = m[sel]
             return v, m
 
-        kv_np = [norm(v, m) for v, m in keys]
+        kv_np = _canon_float_keys([norm(v, m) for v, m in keys])
         arg_np = [norm(v, m) for v, m in args]
 
         if n_keys:
@@ -233,9 +248,10 @@ class HostGroupAccumulator:
         if sel.size == 0:
             return
         n_keys = self.n_keys
-        kv_np = [(np.asarray(v)[sel],
-                  np.asarray(m)[sel] if not isinstance(m, bool)
-                  else np.full(sel.size, m)) for v, m in keys]
+        kv_np = _canon_float_keys(
+            [(np.asarray(v)[sel],
+              np.asarray(m)[sel] if not isinstance(m, bool)
+              else np.full(sel.size, m)) for v, m in keys])
         if n_keys:
             enc = np.empty((sel.size, 2 * n_keys), np.int64)
             for ki, (kv, kvalid) in enumerate(kv_np):

@@ -245,6 +245,9 @@ class MegabatchDispatcher:
 
             def _attempt():
                 if bound.has_aggs:
+                    if plan.group_mode.kind == "hash_host":
+                        return _batched_hash_agg(cat, scan_plan, settings,
+                                                 group)
                     return _batched_agg(cat, scan_plan, settings, group)
                 return _batched_projection(cat, scan_plan, settings, group)
             payloads = snapshot_read(cat.data_dir, bound.table, _attempt,
@@ -398,6 +401,96 @@ def _batched_agg(cat, plan, settings, group: list[_Waiter]) -> list:
     return [("agg", [tuple(o[qi] for o in host)]) for qi in range(q)]
 
 
+def _batched_hash_agg(cat, plan, settings, group: list[_Waiter]) -> list:
+    """Shared scan + ONE vmap-lifted fused hash dispatch per batch over
+    [qp]-stacked donated hash tables (kernel slot
+    ``batched:jit_hash_fused``).  Spill masks drain per batch into
+    per-query HostGroupAccumulators with each rider's own params env;
+    scatter hands every waiter its table slice + accumulator and the
+    exact host merge + finalize run on the callers' threads."""
+    import jax
+    import jax.numpy as jnp
+
+    from citus_tpu.executor.batches import ShardBatch
+    from citus_tpu.executor.executor import (
+        _hash_key_dtypes, _hash_slots, _iter_padded_batches, _params_env,
+    )
+    from citus_tpu.executor.host_agg import HostGroupAccumulator
+    from citus_tpu.executor.kernel_cache import get_kernel, jit_compile
+    from citus_tpu.ops.hash_agg import build_fused_hash_worker, \
+        empty_hash_state
+    from citus_tpu.planner.bound import compile_expr, param_env_names
+    from citus_tpu.testing.faults import FAULTS
+
+    q = len(group)
+    qp = _q_pad(q)
+    pcols, pvalids = _stacked_params(group, qp)
+    penvs = [_params_env(plan, w.params) for w in group]
+    n_cols = len(plan.scan_columns)
+    n_params = len(param_env_names(plan.bound.param_specs))
+    axes = (None,) * n_cols + (0,) * n_params
+    S = _hash_slots(cat, plan, settings)
+    key_dtypes = _hash_key_dtypes(plan, penvs[0])
+
+    def _build():
+        # table state maps over the query axis (donated, stays
+        # device-resident across the shared scan); data columns
+        # broadcast; the 0-d param "columns" map
+        return jit_compile(
+            jax.vmap(build_fused_hash_worker(plan, jnp, key_dtypes),
+                     in_axes=(0, axes, axes, None)),
+            donate_argnums=0)
+    batched = get_kernel(plan, "batched:jit_hash_fused", _build)
+
+    key_fns_np = [compile_expr(k, np) for k in plan.bound.group_keys]
+    arg_fns_np = [compile_expr(a, np) for a in plan.agg_args]
+    accs = [HostGroupAccumulator(len(plan.bound.group_keys),
+                                 plan.partial_ops) for _ in group]
+
+    _trace.set_phase("device")
+    state = jax.device_put(jax.tree_util.tree_map(
+        lambda a: np.stack([a] * qp), empty_hash_state(plan, S, key_dtypes)))
+    n_dispatch = 0
+    nbytes = 0
+    spilled = 0
+    for hb in _iter_padded_batches(cat, plan, settings):
+        FAULTS.hit("device_round", plan.bound.table.name)
+        db = ShardBatch(tuple(jax.device_put(c) for c in hb.cols),
+                        tuple(jax.device_put(v) for v in hb.valids),
+                        jax.device_put(hb.row_mask), hb.n_rows,
+                        hb.padded_rows, hb.shard_index)
+        state, spills = batched(state, db.cols + pcols, db.valids + pvalids,
+                                db.row_mask)
+        n_dispatch += 1
+        nbytes += (sum(c.nbytes for c in hb.cols)
+                   + sum(v.nbytes for v in hb.valids) + hb.row_mask.nbytes)
+        spills = np.asarray(spills)  # [qp, N]; syncs this round
+        if spills[:q].any():
+            base = {n: (np.asarray(c), np.asarray(v))
+                    for n, c, v in zip(plan.scan_columns, hb.cols, hb.valids)}
+            for qi in range(q):
+                sp = spills[qi]
+                if not sp.any():
+                    continue
+                spilled += int(sp.sum())
+                env = dict(base)
+                env.update(penvs[qi])
+                accs[qi].add_batch(sp, [f(env) for f in key_fns_np],
+                                   [f(env) for f in arg_fns_np])
+    c = _counters()
+    c.bump("bytes_scanned", nbytes)
+    c.bump("device_hbm_touched_bytes", nbytes)
+    if n_dispatch:
+        c.bump("hash_fused_dispatches", n_dispatch)
+    if spilled:
+        c.bump("hash_spill_rows", spilled)
+    host = jax.device_get(state)
+    return [("hash_agg",
+             (jax.tree_util.tree_map(lambda a: np.asarray(a)[qi], host),
+              accs[qi]))
+            for qi in range(q)]
+
+
 def _batched_projection(cat, plan, settings, group: list[_Waiter]) -> list:
     """Shared scan + one vmapped filter evaluation -> per-query (env,
     mask) batches.  Row extraction (project_rows) happens per query on
@@ -475,7 +568,12 @@ def megabatch_eligible(cat, bound, settings, plan) -> bool:
     if not bound.param_specs or not plan.shard_indexes:
         return False
     if bound.has_aggs and plan.group_mode.kind not in ("scalar", "direct"):
-        return False
+        # hash_host rides too (vmap-lifted fused hash kernel) unless its
+        # partials are exact value sets / sketches — those accumulate on
+        # the host per query and gain nothing from a shared dispatch
+        from citus_tpu.executor.executor import _hash_has_exact
+        if plan.group_mode.kind != "hash_host" or _hash_has_exact(plan):
+            return False
     from citus_tpu.storage.overlay import current_overlay
     if current_overlay() is not None:
         return False
@@ -507,6 +605,25 @@ def _finalize_agg(cat, plan, batch_partials, params) -> list[tuple]:
         return []
     sel = tuple(np.asarray(p)[occupied] for p in parts)
     return finalize_groups(plan, cat, keys, sel, params_env=penv)
+
+
+def _finalize_hash_agg(cat, plan, data, params) -> list[tuple]:
+    """Per-query exact merge + finalize of a hash_host rider's table
+    slice — the exact tail of the serial _run_agg_hash_host, run on the
+    caller's own thread."""
+    from citus_tpu.executor.executor import _params_env
+    from citus_tpu.executor.finalize import finalize_groups
+    from citus_tpu.ops.hash_agg import merge_hash_tables_into
+    state, acc = data
+    key_tables, partials, rows = state
+    penv = _params_env(plan, params)
+    merge_hash_tables_into(acc, plan, key_tables, partials, rows)
+    key_arrays, parts = acc.finalize(
+        [k.type for k in plan.bound.group_keys],
+        scalar=not plan.bound.group_keys)
+    if parts is None:
+        return []
+    return finalize_groups(plan, cat, key_arrays, parts, params_env=penv)
 
 
 def maybe_megabatch(cat, bound, settings, plan, params, t0, exec_span):
@@ -542,6 +659,8 @@ def maybe_megabatch(cat, bound, settings, plan, params, t0, exec_span):
     kind, data = w.payload
     if kind == "agg":
         rows = _finalize_agg(cat, plan, data, params)
+    elif kind == "hash_agg":
+        rows = _finalize_hash_agg(cat, plan, data, params)
     else:
         rows = project_rows(plan, cat, data)
     wait_ms = (clock() - w.t_enq) * 1000.0

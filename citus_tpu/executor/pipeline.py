@@ -263,7 +263,7 @@ class RemoteTaskDispatch:
     ``abort()`` (error path) drops undispatched tasks and waits out the
     in-flight ones so no thread outlives the query attempt."""
 
-    def __init__(self, cat, plan, settings, tasks, is_agg: bool):
+    def __init__(self, cat, plan, settings, tasks, payload_kind: str):
         self.cat = cat
         self.plan = plan
         self.cap = max(1, settings.executor.max_adaptive_pool_size)
@@ -272,7 +272,9 @@ class RemoteTaskDispatch:
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._nodes: dict[int, _NodePool] = {}
-        self._is_agg = is_agg
+        # "agg" -> decode_partials, "hash" -> decode_hash_partials,
+        # anything else -> decode_batch (projection rows)
+        self._payload_kind = payload_kind
         # si -> (node, meta, blob, rpc_s, rspan): raw response frames,
         # decoded on the COLLECTING thread so the event loop never
         # serializes decode work behind socket readiness
@@ -423,7 +425,9 @@ class RemoteTaskDispatch:
         the overlap/peak stats.  Decode runs here, on the caller — the
         event loop only moves bytes."""
         from citus_tpu.executor.executor import GLOBAL_COUNTERS
-        from citus_tpu.net.data_plane import decode_batch, decode_partials
+        from citus_tpu.net.data_plane import (decode_batch,
+                                              decode_hash_partials,
+                                              decode_partials)
         if self._total:
             _trace.set_phase("remote-wait")
         t_enter = _perf()
@@ -447,8 +451,12 @@ class RemoteTaskDispatch:
             node, meta, blob, rpc_s, rspan = raw[si]
             t1 = _perf()
             try:
-                payload = decode_partials(blob) if self._is_agg \
-                    else decode_batch(blob)
+                if self._payload_kind == "agg":
+                    payload = decode_partials(blob)
+                elif self._payload_kind == "hash":
+                    payload = decode_hash_partials(blob)
+                else:
+                    payload = decode_batch(blob)
             # lint: disable=SWL01 -- counted as remote_task_fallbacks below; shard rescans locally
             except Exception:
                 # decode failed after a successful RPC (codec skew):
@@ -504,7 +512,7 @@ def dispatch_remote_tasks(cat, plan, settings, params=((), ())
     local, remote = split_pushable(cat, plan, settings)
     if not remote:
         plan.runtime_cache["remote_tasks"] = []
-        return list(local), RemoteTaskDispatch(cat, plan, settings, [], False)
+        return list(local), RemoteTaskDispatch(cat, plan, settings, [], "")
     template = encode_task(plan, params)
     if template is not None:
         # the coordinator's citus.wire_format decides how the WORKER
@@ -516,11 +524,11 @@ def dispatch_remote_tasks(cat, plan, settings, params=((), ())
         GLOBAL_COUNTERS.bump("remote_task_fallbacks", len(remote))
         plan.runtime_cache["remote_tasks"] = []
         return (sorted(local + [si for si, _, _ in remote]),
-                RemoteTaskDispatch(cat, plan, settings, [], False))
+                RemoteTaskDispatch(cat, plan, settings, [], ""))
     tasks = [(si, node,
               ep, dict(template,
                        shard_id=plan.bound.table.shards[si].shard_id,
                        node=node))
              for si, node, ep in remote]
     return list(local), RemoteTaskDispatch(
-        cat, plan, settings, tasks, template["kind"] == "agg")
+        cat, plan, settings, tasks, template["kind"])

@@ -15,9 +15,13 @@ Three pieces:
   reusing the planner's worker/combine split) serialized as a compact
   JSON-safe dict.  Text predicates and group keys travel as dictionary
   ids: dictionaries are table-global and authority-mirrored, so ids
-  agree across hosts.  Shapes the codec cannot carry (hash_host
-  grouping, distinct/collect partials, combine-phase expressions)
-  return None and take the pull path.
+  agree across hosts.  hash_host GROUP BY ships as a "hash" task whose
+  result is the worker's merged device hash table + host-exact spilled
+  entries as CTFR frame columns (TASK_VERSION 3; v2 peers reject the
+  version and the coordinator falls back to pull).  Shapes the codec
+  cannot carry (distinct/collect partials, sketch states under
+  hash_host, combine-phase expressions) return None and take the pull
+  path.
 - `run_worker_task` — the worker side: rebuild a synthetic
   BoundSelect + PhysicalPlan and run it through this host's OWN batch
   pipeline and device/host aggregation (HBM cache included: the
@@ -53,12 +57,17 @@ from citus_tpu.planner.physical import (
 from citus_tpu.storage.reader import Interval
 from citus_tpu.types import ColumnType
 
-TASK_VERSION = 2
+TASK_VERSION = 3
 
 #: partial-op kinds whose cross-host combine is a pure elementwise
 #: sum/min/max (combine_partials_host) — the only states worth shipping
 _COMBINABLE_KINDS = {"sum", "count", "min", "max", "hll", "ddsk",
                      "topk", "topkv"}
+
+#: partial-op kinds a hash-table SLOT can merge (device entry-merge door
+#: and HostGroupAccumulator.merge_partials share these semantics) — the
+#: shippable subset for hash_host tasks
+_HASH_MERGE_KINDS = {"sum", "count", "min", "max"}
 
 
 class TaskCodecError(Exception):
@@ -275,14 +284,24 @@ def _encode_task(plan: PhysicalPlan, params) -> dict:
         task["index_eq"] = None  # index lookup is an optimization only
     if bound.has_aggs:
         gm = plan.group_mode
-        if gm.kind not in ("scalar", "direct"):
-            raise TaskCodecError("hash_host grouping returns per-shard "
-                                 "hash tables, not combinable partials")
-        for op in plan.partial_ops:
-            if op.kind not in _COMBINABLE_KINDS or op.extra_args:
-                raise TaskCodecError(f"uncombinable partial {op.kind!r}")
+        if gm.kind in ("scalar", "direct"):
+            kind = "agg"
+            for op in plan.partial_ops:
+                if op.kind not in _COMBINABLE_KINDS or op.extra_args:
+                    raise TaskCodecError(f"uncombinable partial {op.kind!r}")
+        elif gm.kind == "hash_host":
+            # the merged device hash table is fixed-shape arrays: ships
+            # whenever every partial state merges slot-wise (exact value
+            # sets and sketch registers stay on the pull path)
+            kind = "hash"
+            for op in plan.partial_ops:
+                if op.kind not in _HASH_MERGE_KINDS or op.extra_args:
+                    raise TaskCodecError(
+                        f"unshippable hash partial {op.kind!r}")
+        else:
+            raise TaskCodecError(f"unknown group mode {gm.kind!r}")
         task.update({
-            "kind": "agg",
+            "kind": kind,
             "group_keys": [_enc_expr(k) for k in bound.group_keys],
             "agg_args": [_enc_expr(a) for a in plan.agg_args],
             "partial_ops": [[op.kind, op.arg_index, op.dtype]
@@ -352,9 +371,9 @@ def push_remote_tasks(cat, plan: PhysicalPlan, settings, params=((), ())):
 
 def note_inexpressible(cat, plan: PhysicalPlan, settings) -> None:
     """Account would-be pushes for plan shapes the executor never even
-    offers to the codec (hash_host grouping): each remote-only shard
-    counts as a fallback so the stat views show the pull traffic's
-    cause."""
+    offers to the codec (exact value-set partials, cpu-oracle hash
+    grouping): each remote-only shard counts as a fallback so the stat
+    views show the pull traffic's cause."""
     from citus_tpu.executor.executor import GLOBAL_COUNTERS
     _, remote = split_pushable(cat, plan, settings)
     if remote:
@@ -374,7 +393,7 @@ def _decode_plan(t, p: dict, shard_index: int):
     # yields the same env layout encode_params produced on the pusher
     param_specs = [(_dec_type(d), "task")
                    for d in p.get("param_specs", [])]
-    if p["kind"] == "agg":
+    if p["kind"] in ("agg", "hash"):
         group_keys = [_dec_expr(k) for k in p["group_keys"]]
         agg_args = [_dec_expr(a) for a in p["agg_args"]]
         partial_ops = [PartialOp(str(k), int(ai), str(dt))
@@ -467,8 +486,9 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
 
     Returns (meta, blob): for agg tasks the blob holds the partial
     states (a__0..a__N in partial-op order, plus the trailing group-row
-    counts in direct mode); for projection tasks an encode_batch of the
-    filtered scan columns.  The task's "wire" key (the PUSHING
+    counts in direct mode); for hash tasks an encode_hash_partials frame
+    (merged device hash table + host-exact spilled entries); for
+    projection tasks an encode_batch of the filtered scan columns.  The task's "wire" key (the PUSHING
     coordinator's citus.wire_format) picks the codec — columnar frame
     by default, npz when absent.  Raising here surfaces as an RpcError
     at the coordinator, which falls back to the pull path for this
@@ -520,6 +540,18 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
                 timeout=settings.executor.lock_timeout_s)
         with _trace.span("worker_encode"):
             blob = encode_partials(partials, wire)
+    elif p["kind"] == "hash":
+        from citus_tpu.executor.executor import _run_hash_partial_state
+        from citus_tpu.net.data_plane import encode_hash_partials
+
+        def _attempt():
+            return _run_hash_partial_state(cat, plan, settings, params)
+        with _trace.span("worker_scan", shard_id=shard_id, kind="hash"):
+            table, spilled = snapshot_read(
+                cat.data_dir, t, _attempt,
+                timeout=settings.executor.lock_timeout_s)
+        with _trace.span("worker_encode"):
+            blob = encode_hash_partials(table, spilled, wire)
     else:
         def _attempt():
             return _run_task_projection(cat, plan, params, p.get("limit"))

@@ -236,6 +236,53 @@ def decode_partials(blob: bytes) -> tuple:
     return tuple(arrays[f"a__{i}"] for i in range(len(arrays)))
 
 
+def encode_hash_partials(table, spilled, wire: str = "frame") -> bytes:
+    """hash_host GROUP BY partial state for the wire (TASK_VERSION 3):
+    the worker's merged device hash table — key value tables, int8 key
+    flags (1 = stored null, 2 = stored valid), partial tables, per-slot
+    row counts — under ``hk__/hkf__/hp__/hr__`` keys, plus the
+    host-exact spilled entries (rendered back from the accumulator)
+    under ``xk__/xkf__/xp__/xr__``.  Either half may be None: cpu-backend
+    workers ship spill-only frames, empty shards ship neither."""
+    arrays: dict = {}
+
+    def put(kp, fp, pp, rk, entries):
+        keys, partials, rows = entries
+        for i, (kv, kf) in enumerate(keys):
+            arrays[f"{kp}{i}"] = np.asarray(kv)
+            arrays[f"{fp}{i}"] = np.asarray(kf, np.int8)
+        for j, p in enumerate(partials):
+            arrays[f"{pp}{j}"] = np.asarray(p)
+        arrays[rk] = np.asarray(rows, np.int64)
+
+    if table is not None:
+        put("hk__", "hkf__", "hp__", "hr__", table)
+    if spilled is not None:
+        put("xk__", "xkf__", "xp__", "xr__", spilled)
+    return _encode_arrays(arrays, wire)
+
+
+def decode_hash_partials(blob: bytes):
+    """Inverse of encode_hash_partials -> (table | None, spilled | None),
+    each ``([(key_vals, key_flags)...], partials tuple, rows)``."""
+    arrays = _decode_arrays(blob)
+
+    def grab(kp, fp, pp, rk):
+        if rk not in arrays:
+            return None
+        keys = []
+        while f"{kp}{len(keys)}" in arrays:
+            i = len(keys)
+            keys.append((arrays[f"{kp}{i}"], arrays[f"{fp}{i}"]))
+        partials = []
+        while f"{pp}{len(partials)}" in arrays:
+            partials.append(arrays[f"{pp}{len(partials)}"])
+        return keys, tuple(partials), arrays[rk]
+
+    return (grab("hk__", "hkf__", "hp__", "hr__"),
+            grab("xk__", "xkf__", "xp__", "xr__"))
+
+
 def sketch_words_to_arrays(name: str, words) -> dict:
     """Pack a column of sketch words (``"kind:ver:b64"`` strings, or None
     for SQL NULL) into fixed-width arrays under the existing frame dtype

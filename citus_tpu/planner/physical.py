@@ -217,12 +217,15 @@ def _key_domain(cat: Catalog, table: TableMeta, key: BExpr,
             return KeyDomain(lo=0, size=size + 1)
         if key.type.kind == T.BOOL:
             return KeyDomain(lo=0, size=3)
+        if key.type.is_float:
+            # never direct-encode floats: -0.0/0.0 and NaN payloads
+            # need the hash path's canonical equality, and NaN poisons
+            # min/max stats (which would masquerade as "all null" here)
+            return None
         b = bounds.get(key.name)
         if b is None:
             return KeyDomain(lo=0, size=1)  # no rows / all null
         lo, hi, _ = b
-        if key.type.is_float:
-            return None
         return KeyDomain(lo=int(lo), size=int(hi) - int(lo) + 2)
     if isinstance(key, BDateTrunc):
         inner = _key_domain(cat, table, key.operand, bounds)

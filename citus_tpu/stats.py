@@ -172,6 +172,15 @@ class StatCounters:
         # decompressed (storage/reader.py)
         "fused_dispatches",
         "fused_rows_skipped",
+        # streaming fused hash aggregation (executor/executor.py,
+        # executor/megabatch.py, ops/hash_agg.py): fused hash-table
+        # kernel rounds (1 per batch, table donated in), rows that lost
+        # a fingerprint-collision probe and drained into the exact host
+        # accumulator, and remote hash-table partials merged back
+        # through the device merge door (executor/pipeline.py push path)
+        "hash_fused_dispatches",
+        "hash_spill_rows",
+        "hash_partials_pushed",
     ]
 
     def __init__(self):

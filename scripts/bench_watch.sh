@@ -22,13 +22,13 @@ while [ "$i" -lt "$N" ]; do
     *"rc=0"*DEVICES*)
         WEDGED_STREAK=0
         rm -f "$WEDGE_MARKER"
-        if [ ! -f .bench_fresh_r17 ]; then
+        if [ ! -f .bench_fresh_r18 ]; then
             BENCH_PROBE_TIMEOUT_S=240 BENCH_RETRY_DELAY_S=30 \
                 BENCH_JOIN=1 BENCH_SWEEP=1 \
                 python bench.py > .bench_auto.out 2> .bench_auto.err
             # a fresh (non-fallback) record carries no "stale" marker
             if [ -s .bench_auto.out ] && ! grep -q '"stale": true' .bench_auto.out; then
-                touch .bench_fresh_r17
+                touch .bench_fresh_r18
             fi
         fi
         ;;

@@ -258,6 +258,16 @@ CONFINED_CALLS = {
     # discipline (the dtype/shape contract with _empty_partials)
     "citus_tpu.ops.scan_agg.build_fused_worker_fn":
         ("executor/executor.py", "executor/megabatch.py"),
+    # same discipline for the streaming fused hash-table builder: only
+    # the executor's jit_hash_fused / batched:jit_hash_fused slots may
+    # enter it (the slot count / donated-state contract with
+    # empty_hash_state)
+    "citus_tpu.ops.hash_agg.build_fused_hash_worker":
+        ("executor/executor.py", "executor/megabatch.py"),
+    # hash-partial frames are wire format: encoded only by the task
+    # codec halves, never ad-hoc
+    "citus_tpu.net.data_plane.encode_hash_partials":
+        ("executor/worker_tasks.py", "net/data_plane.py"),
 }
 
 #: method name -> in-package files allowed to CALL it (receiver-typed
