@@ -1184,6 +1184,121 @@ def bench_rebalance(extra: dict) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_autopilot(extra: dict) -> None:
+    """Self-driving rebalancing A/B (services/autopilot.py): the same
+    zipfian hot-shard storm under citus.autopilot = off | observe | on.
+    Per arm: hot-query p99 before/after the autopilot's decision
+    window, actions executed/observed/declined, and failed writes while
+    a move ran (the contract: zero).  The observe arm's decision log is
+    the dry-run instrument — same decisions as 'on', no moves."""
+    import shutil
+    import tempfile
+    import threading
+
+    import citus_tpu as ct
+
+    n = int(os.environ.get("BENCH_AP_ROWS", "100000"))
+    probes = int(os.environ.get("BENCH_AP_PROBES", "150"))
+    arms = {}
+    for arm in ("off", "observe", "on"):
+        root = tempfile.mkdtemp(prefix=f"bench_autopilot_{arm}_", dir=_HERE)
+        cl = ct.Cluster(os.path.join(root, "db"), n_nodes=2)
+        try:
+            cl.execute("CREATE TABLE ap (k bigint NOT NULL, v bigint)")
+            cl.execute("SELECT create_distributed_table('ap', 'k', 4)")
+            cl.copy_from("ap", columns={
+                "k": np.arange(n, dtype=np.int64),
+                "v": np.arange(n, dtype=np.int64) % 97})
+            cl.execute(f"SET citus.autopilot = {arm}")
+            cl.execute("SET citus.autopilot_sustain_ticks = 2")
+            cl.execute("SET citus.autopilot_cooldown_s = 3600")
+            cl.counters.reset()  # re-zeros the attribution ledger too
+            s = cl.session()
+            s.execute("PREPARE appt AS SELECT sum(v) FROM ap WHERE k = $1")
+            # hot-tenant storm: every probe routes to a shard placed on
+            # node 0, so node 0's placements run away in the attribution
+            # ledger while node 1 idles — the shape the autopilot fixes
+            from citus_tpu.catalog.hashing import hash_int64_scalar
+            t = cl.catalog.table("ap")
+            keys, k = [], 0
+            while len(keys) < 8 and k < n:
+                sidx = t.route_hash(hash_int64_scalar(k))
+                if t.shards[sidx].placements[0] == 0:
+                    keys.append(k)
+                k += 1
+            keys = (keys * (2 * probes // len(keys) + 1))[:2 * probes]
+
+            def storm(ks):
+                lat = []
+                for k in ks:
+                    t0 = time.perf_counter()
+                    s.execute(f"EXECUTE appt ({int(k)})")
+                    lat.append(time.perf_counter() - t0)
+                lat.sort()
+                return round(lat[int(0.99 * (len(lat) - 1))] * 1000, 3)
+
+            before = [tuple(s.placements)
+                      for s in cl.catalog.table("ap").shards]
+            p99_storm = storm(keys[:probes])
+            # decision window: the storm KEEPS RUNNING (the EWMA rates
+            # the planner reads are live rates, not history) and a
+            # writer hammers ingest while the duty evaluates — and, in
+            # the 'on' arm, executes its one move under both
+            stop = threading.Event()
+            wrote, failed = [], []
+
+            def hammer():
+                i = 0
+                while not stop.is_set():
+                    k = 10 * n + i
+                    try:
+                        cl.execute(f"INSERT INTO ap VALUES ({k}, {k % 97})")
+                        wrote.append(k)
+                    except Exception:
+                        failed.append(k)
+                    i += 1
+
+            s2 = cl.session()
+            s2.execute("PREPARE hot AS SELECT sum(v) FROM ap WHERE k = $1")
+
+            def hot_loop():
+                i = 0
+                while not stop.is_set():
+                    s2.execute(f"EXECUTE hot ({int(keys[i % probes])})")
+                    i += 1
+
+            threads = [threading.Thread(target=hammer),
+                       threading.Thread(target=hot_loop)]
+            for th in threads:
+                th.start()
+            for _ in range(6):
+                cl.autopilot.duty()
+                time.sleep(0.25)
+            stop.set()
+            for th in threads:
+                th.join()
+            p99_after = storm(keys[probes:])
+            after = [tuple(s.placements)
+                     for s in cl.catalog.table("ap").shards]
+            snap = cl.counters.snapshot()
+            arms[arm] = {
+                "p99_storm_ms": p99_storm,
+                "p99_after_ms": p99_after,
+                "placements_moved": sum(b != a
+                                        for b, a in zip(before, after)),
+                "actions_executed": snap["autopilot_actions_executed"],
+                "actions_observed": snap["autopilot_actions_observed"],
+                "actions_declined": snap["autopilot_actions_declined"],
+                "decision_log_rows": len(cl.autopilot.log_rows()),
+                "writes_total": len(wrote),
+                "writes_failed": len(failed),
+            }
+        finally:
+            cl.close()
+            shutil.rmtree(root, ignore_errors=True)
+    extra["autopilot"] = arms
+
+
 def ensure_join_data(cl: "ct.Cluster", n_orders: int) -> None:
     """orders_b: the build side of the repartition join, distributed on
     o_custkey so the l_orderkey = o_orderkey join must reshuffle."""
@@ -1421,6 +1536,8 @@ def main() -> None:
         bench_multi_coordinator(extra)
     if os.environ.get("BENCH_REBALANCE", "1") != "0":
         bench_rebalance(extra)
+    if os.environ.get("BENCH_AUTOPILOT", "1") != "0":
+        bench_autopilot(extra)
     if os.environ.get("BENCH_ROLLUP", "1") != "0":
         bench_rollup(extra)
     if os.environ.get("BENCH_JOIN", "1") != "0":

@@ -703,6 +703,16 @@ class Cluster:
         self.flight_recorder = FlightRecorder(self, data_dir)
         self.counters.add_reset_hook(self.flight_recorder.reset_baselines)
         self.flight_recorder.apply()
+        # per-placement load attribution re-zeros with the counters so
+        # the ledger-balance invariant survives stat resets
+        from citus_tpu.observability.load_attribution import (
+            GLOBAL_ATTRIBUTION,
+        )
+        self.counters.add_reset_hook(GLOBAL_ATTRIBUTION.reset)
+        # autopilot decision loop (services/autopilot.py): evaluated as
+        # a maintenance duty, gated on citus.autopilot (default off)
+        from citus_tpu.services.autopilot import Autopilot
+        self.autopilot = Autopilot(self)
         # continuous aggregation (rollup/manager.py): the CDC-fed
         # incremental refresh loop only runs while
         # citus.rollup_refresh_interval_ms > 0
@@ -726,6 +736,13 @@ class Cluster:
             # catalog commits serialize through the authority's DDL
             # lease and ship the document over RPC (push_catalog)
             self.catalog.commit_transport = self._control
+            # placement-mirror sync elision trusts the data_changed
+            # invalidation stream only while it is attached; the probe
+            # is re-evaluated on every sync (net/data_plane.py)
+            if self.catalog.remote_data is not None:
+                self.catalog.remote_data.invalidation_fresh = (
+                    lambda: self._control is not None
+                    and self._control.connected)
         self.catalog.on_commit = self._on_catalog_commit
         # metadata sync engine (metadata/sync.py): per-object
         # pull-on-mismatch convergence against the authority; the
@@ -818,10 +835,20 @@ class Cluster:
             # CheckForDistributedDeadlocks every 2 s,
             # distributed_deadlock_detection.c:105)
             from citus_tpu.transaction.global_deadlock import run_detection
+            # priority: a due detection pass runs before any other due
+            # duty in the same tick — under load (an autopilot move, a
+            # slow cleanup) victim selection must not wait a tick out
             d.register("deadlock_detection",
                        lambda: run_detection(self),
                        interval_s=lambda:
-                       self.settings.deadlock_detection_interval_s)
+                       self.settings.deadlock_detection_interval_s,
+                       priority=10)
+            # autopilot decision loop; the duty itself checks the mode
+            # GUC every tick, so SET citus.autopilot takes effect on a
+            # running daemon without re-registration
+            d.register("autopilot", self.autopilot.duty,
+                       interval_s=lambda:
+                       self.settings.autopilot.interval_s)
             if self._control is not None:
                 # authority health / lease-based promotion (reference:
                 # node_promotion.c; HA via external failover managers in
@@ -850,6 +877,10 @@ class Cluster:
         # not outlive this handle (GLOBAL_COUNTERS is process-global)
         self.flight_recorder.stop()
         self.counters.remove_reset_hook(self.flight_recorder.reset_baselines)
+        from citus_tpu.observability.load_attribution import (
+            GLOBAL_ATTRIBUTION,
+        )
+        self.counters.remove_reset_hook(GLOBAL_ATTRIBUTION.reset)
         if self._control is not None:
             self._control.close()
         if self._data_server is not None:
@@ -936,15 +967,33 @@ class Cluster:
                 yield
                 return
             from citus_tpu.transaction.write_locks import group_write_lock
-            with group_write_lock(self.catalog, table_meta, mode,
-                                  lock_manager=self.locks,
-                                  timeout=self.settings.executor.lock_timeout_s):
-                # force_sync: an RPC invalidation push may not have
-                # arrived yet; a writer that just waited out a mover must
-                # check staleness synchronously before touching placements
-                self._maybe_reload_catalog(force_sync=True)
-                yield
+            try:
+                with group_write_lock(self.catalog, table_meta, mode,
+                                      lock_manager=self.locks,
+                                      timeout=self.settings.executor.lock_timeout_s):
+                    # force_sync: an RPC invalidation push may not have
+                    # arrived yet; a writer that just waited out a mover
+                    # must check staleness synchronously before touching
+                    # placements
+                    self._maybe_reload_catalog(force_sync=True)
+                    yield
+            finally:
+                # every auto-commit write funnels through here: expire
+                # placement-mirror elision tokens cluster-wide (spurious
+                # on a failed write — costs one RTT, never staleness)
+                self._publish_data_changed(table_meta.name)
         return _ctx()
+
+    def _publish_data_changed(self, table_name: str) -> None:
+        """A committed write touched ``table_name``: expire our own
+        placement-mirror elision tokens (our mirrors of its remote
+        placements may now trail their sources) and broadcast the
+        data_changed event so every peer coordinator expires theirs."""
+        rd = getattr(self.catalog, "remote_data", None)
+        if rd is not None:
+            rd.note_data_changed(table_name)
+        if self._control is not None:
+            self._control.publish_data_change(table_name)
 
     def _maybe_reload_catalog(self, force_sync: bool = False) -> None:
         """Pick up metadata written by other coordinators sharing this
@@ -2363,6 +2412,11 @@ class Cluster:
                 self.txlog.release(txn.xid)
                 raise
             self._plan_cache.clear()
+            if txn.has_writes:
+                # the txn write path bypasses _write_lock's publication:
+                # expire placement-mirror elision tokens here instead
+                for name in sorted(txn.tables):
+                    self._publish_data_changed(name)
             if txn.cdc_events:
                 clock = self.clock.transaction_clock()
                 for table, op, kw in txn.cdc_events:

@@ -25,6 +25,17 @@ def _remote_task_mode(v) -> str:
     return s
 
 
+def _autopilot_mode(v) -> str:
+    """citus.autopilot = off | observe | on.  The SET parser coerces
+    bare on/off to booleans before coercion sees them."""
+    if isinstance(v, bool):
+        return "on" if v else "off"
+    s = str(v).lower()
+    if s not in ("off", "observe", "on"):
+        raise ValueError(s)
+    return s
+
+
 def _wire_format(v) -> str:
     """citus.wire_format = frame | npz (net/data_plane.py codecs)."""
     s = str(v).lower()
@@ -154,6 +165,15 @@ _GUCS = {
     "citus.flight_recorder_retention_s": ("observability",
                                           "flight_recorder_retention_s",
                                           float),
+    # autopilot control loop (services/autopilot.py): mode switch plus
+    # its hysteresis knobs — evaluation cadence, consecutive-tick
+    # sustain requirement, post-action cooldown, and the greedy
+    # balance trigger threshold
+    "citus.autopilot": ("autopilot", "mode", _autopilot_mode),
+    "citus.autopilot_interval_s": ("autopilot", "interval_s", float),
+    "citus.autopilot_sustain_ticks": ("autopilot", "sustain_ticks", int),
+    "citus.autopilot_cooldown_s": ("autopilot", "cooldown_s", float),
+    "citus.autopilot_threshold": ("autopilot", "threshold", float),
     # continuous aggregation (rollup/): refresh-loop cadence (ms; 0 =
     # loop off, refresh via citus_refresh_rollups()), percentile sketch
     # backend for NEW rollups, and the per-batch source-row bound

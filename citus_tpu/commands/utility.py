@@ -363,6 +363,7 @@ _HEALTH_SEVERITY = {
     "dead_node": "critical",
     "device_probe_wedged": "warning",
     "metadata_sync_lag": "warning",
+    "autopilot_action": "info",
 }
 
 
@@ -418,6 +419,72 @@ def _citus_health_events(cl, name, args):
     return Result(columns=["ts", "node", "kind", "severity", "subject",
                            "value", "baseline", "active", "detail"],
                   rows=rows)
+
+
+@utility("citus_shard_load")
+def _citus_shard_load(cl, name, args):
+    """The per-placement attribution ledger, cluster-wide: every node's
+    booked (table, shard, placement, tenant) load fanned in through
+    get_node_stats — ``observer`` is the node that did the work (a
+    coordinator scanning a mirrored remote placement books there;
+    a worker running a pushed task books on itself).  Optional arg:
+    table-name filter."""
+    from citus_tpu.observability.cluster_stats import (
+        cluster_node_stats, payload_node,
+    )
+    table = str(args[0]) if args else None
+    rows = []
+    for p in cluster_node_stats(cl):
+        if p.get("unreachable"):
+            continue
+        observer = payload_node(p)
+        for r in p.get("shard_load", []):
+            if table is not None and r[0] != table:
+                continue
+            rows.append((observer, *r))
+    rows.sort(key=lambda r: (-r[6], r[1], r[2], r[3], str(r[4]), r[0]))
+    return Result(columns=["observer", "table_name", "shard_id", "node",
+                           "tenant", "queries", "device_ms",
+                           "bytes_scanned", "rows_returned",
+                           "remote_wait_ms", "ewma_ms_per_s"],
+                  rows=rows)
+
+
+@utility("citus_rebalance_plan")
+def _citus_rebalance_plan(cl, name, args):
+    """Dry-run rebalance plan (operations/rebalance_plan.py): ordered
+    move/split/isolate steps with expected-benefit scores, computed
+    from the current catalog + attribution snapshot.  Pure
+    observability — executes nothing.  Args: strategy (default
+    by_observed_load), optional imbalance threshold."""
+    from citus_tpu.operations.rebalance_plan import (
+        PLAN_COLUMNS, build_rebalance_plan, plan_rows,
+    )
+    strategy = str(args[0]) if args else "by_observed_load"
+    threshold = float(args[1]) if len(args) > 1 else 0.1
+    steps = build_rebalance_plan(cl.catalog, strategy,
+                                 threshold=threshold)
+    return Result(columns=list(PLAN_COLUMNS), rows=plan_rows(steps))
+
+
+@utility("citus_autopilot_log")
+def _citus_autopilot_log(cl, name, args):
+    """The autopilot's decision ring, cluster-wide: every evaluated
+    action — executed, observed (dry-run mode), declined, adopted —
+    with the evidence snapshot that drove it (services/autopilot.py)."""
+    from citus_tpu.observability.cluster_stats import (
+        cluster_node_stats, payload_node,
+    )
+    from citus_tpu.services.autopilot import LOG_COLUMNS
+    rows = []
+    for p in cluster_node_stats(cl):
+        if p.get("unreachable"):
+            continue
+        node = payload_node(p)
+        for r in p.get("autopilot", []):
+            rows.append((node, *r))
+    rows.sort(key=lambda r: (-(r[1] or 0), r[0]))
+    return Result(columns=["node", *LOG_COLUMNS], rows=rows)
 
 
 @utility("citus_device_memory")

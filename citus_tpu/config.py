@@ -248,6 +248,33 @@ class MetadataSettings:
 
 
 @dataclass
+class AutopilotSettings:
+    """Self-driving rebalance loop (services/autopilot.py): a
+    maintenance-daemon duty that turns health events + per-placement
+    load attribution into rebalance actions with hysteresis."""
+
+    # citus.autopilot — "off" (default: duty is a no-op), "observe"
+    # (evaluate + log every decision with evidence, execute nothing),
+    # "on" (execute through the operation registry).
+    mode: str = "off"
+    # Evaluation cadence (seconds) of the autopilot duty —
+    # citus.autopilot_interval_s.
+    interval_s: float = 1.0
+    # A plan step must recur for this many consecutive evaluation
+    # ticks before the autopilot acts on it (hysteresis against
+    # transient spikes) — citus.autopilot_sustain_ticks.
+    sustain_ticks: int = 3
+    # Quiet period (seconds) after any executed/adopted action before
+    # the next one may run — citus.autopilot_cooldown_s.  Persisted in
+    # autopilot_state.json, so the cooldown survives a restart.
+    cooldown_s: float = 60.0
+    # Greedy-balance trigger: a plan step only counts when the hi-lo
+    # load gap exceeds this fraction of the mean node load —
+    # citus.autopilot_threshold.
+    threshold: float = 0.5
+
+
+@dataclass
 class ShardingSettings:
     # Default shard count for create_distributed_table
     # (reference GUC citus.shard_count, default 32).
@@ -283,6 +310,7 @@ class Settings:
         default_factory=ObservabilitySettings)
     rollup: RollupSettings = field(default_factory=RollupSettings)
     metadata: MetadataSettings = field(default_factory=MetadataSettings)
+    autopilot: AutopilotSettings = field(default_factory=AutopilotSettings)
     # reference GUC citus.enable_change_data_capture
     enable_change_data_capture: bool = False
     # start the maintenance daemon with the cluster (reference: the

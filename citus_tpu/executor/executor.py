@@ -270,6 +270,22 @@ def _run_mesh_round(plan, run, buf: list, n_dev: int, shard_sharding,
     return out, nbytes
 
 
+def _book_mesh_round(buf: list, nb: int, round_s: float,
+                     task_bytes: list, mesh_task_times: list) -> None:
+    """Split one mesh round's H2D bytes and device time across the
+    round's REAL shard members for attribution (``_run_mesh_round``
+    appends shard_index=-1 pad batches into ``buf`` in place; their
+    padding overhead belongs to the shards that forced the round).  The
+    byte remainder lands on the first member so the ledger total stays
+    exactly equal to the bytes_scanned counter bump."""
+    real = [mb for mb in buf if mb.shard_index >= 0] or buf
+    share, rem = divmod(int(nb), len(real))
+    for i, mb in enumerate(real):
+        task_bytes.append((mb.shard_index, share + (rem if i == 0 else 0)))
+        mesh_task_times.append(
+            (mb.shard_index, mb.n_rows, round_s / len(real)))
+
+
 def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                       params=((), ())):
     import jax
@@ -337,6 +353,8 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         shard_sharding = NamedSharding(mesh, PartitionSpec("shard"))
         collect: Optional[list] = None if overlaid else []
         nbytes = 0
+        task_bytes: list = []
+        mesh_task_times: list = []
         inflight: deque = deque()
         stream = _iter_padded_batches(cat, plan, settings)
         t_peek = clock()
@@ -367,6 +385,8 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                         p_stack, pv_stack, collect)
                     acc.append(out)
                     nbytes += nb
+                    _book_mesh_round(buf, nb, clock() - t_dev,
+                                     task_bytes, mesh_task_times)
                     buf = []
                     if collect is not None and nbytes > GLOBAL_CACHE.capacity:
                         collect = None  # working set exceeds HBM cache: stream
@@ -382,6 +402,8 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                         p_stack, pv_stack, collect)
                     acc.append(out)
                     nbytes += nb
+                    _book_mesh_round(buf, nb, clock() - t_dev,
+                                     task_bytes, mesh_task_times)
                     pstats.device_s += clock() - t_dev
             finally:
                 host_iter_m.close()
@@ -394,11 +416,17 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             pstats.h2d_bytes = nbytes
             GLOBAL_COUNTERS.bump("bytes_scanned", nbytes)
             GLOBAL_COUNTERS.bump("device_hbm_touched_bytes", nbytes)
+            plan.runtime_cache["task_bytes"] = task_bytes
+            # attribution-only (not the EXPLAIN Tasks section, which
+            # renders single-device dispatches): per-round device time
+            # split across the round's shard members
+            plan.runtime_cache["mesh_task_times"] = mesh_task_times
             pstats.publish(plan)
             return combine_partials_host(plan, acc_np)
 
     # ---- single-device path: fused streaming pipeline + HBM pinning --
     task_times: list = []
+    task_bytes: list = []
     # NOTE (round 5): the opt-in Pallas worker was removed rather than
     # shipped unproven.  The TPU tunnel was down for rounds 4 AND 5, so
     # the kernel could never Mosaic-compile on hardware (round 2 removed
@@ -471,6 +499,7 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
                       + sum(v.nbytes for v in hb.valids)
                       + hb.row_mask.nbytes)
                 nbytes += bb
+                task_bytes.append((db.shard_index, bb))
                 if collect is not None:
                     collect.append(db)
                     if nbytes > GLOBAL_CACHE.capacity:
@@ -515,11 +544,13 @@ def _run_partials_jax(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         pl["fused_dispatches"] = n_dispatch
         pl["stream_window_peak_bytes"] = window_peak
         plan.runtime_cache["task_times"] = task_times
+        plan.runtime_cache["task_bytes"] = task_bytes
         return partials
     GLOBAL_COUNTERS.bump("fused_dispatches", n_dispatch)
     plan.runtime_cache.setdefault("pipeline", {})["fused_dispatches"] = \
         n_dispatch
     plan.runtime_cache["task_times"] = task_times
+    plan.runtime_cache["task_bytes"] = task_bytes
     return tuple(np.asarray(o) for o in jax.device_get(acc_dev))
 
 
@@ -563,11 +594,14 @@ def _run_agg(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         if fallback:
             import dataclasses
             tt = list(plan.runtime_cache.get("task_times", []))
+            tb = list(plan.runtime_cache.get("task_bytes", []))
             fb_plan = dataclasses.replace(plan, shard_indexes=fallback)
             remote_partials = [*remote_partials,
                                run(cat, fb_plan, settings, params)]
             plan.runtime_cache["task_times"] = (
                 tt + list(plan.runtime_cache.get("task_times", [])))
+            plan.runtime_cache["task_bytes"] = (
+                tb + list(plan.runtime_cache.get("task_bytes", [])))
         if remote_partials:
             partials = combine_partials_host(
                 plan, [partials, *remote_partials])
@@ -693,6 +727,7 @@ def _stream_hash_batches(cat: Catalog, plan: PhysicalPlan, settings: Settings,
             bb = (sum(c.nbytes for c in hb.cols)
                   + sum(v.nbytes for v in hb.valids) + hb.row_mask.nbytes)
             hs["nbytes"] += bb
+            hs["task_bytes"].append((db.shard_index, bb))
             pending.append((hb, spill))
             window_bytes += bb
             hs["window_peak"] = max(hs["window_peak"], window_bytes)
@@ -742,7 +777,7 @@ def _run_hash_device(cat: Catalog, plan: PhysicalPlan, settings: Settings,
         lambda: jit_compile(build_fused_hash_worker(plan, jnp, key_dtypes),
                             donate_argnums=0))
     hs = {"n_dispatch": 0, "window_peak": 0, "nbytes": 0, "spilled": 0,
-          "task_times": [],
+          "task_times": [], "task_bytes": [],
           "key_fns_np": [_ce(k, np) for k in plan.bound.group_keys],
           "arg_fns_np": [_ce(a, np) for a in plan.agg_args]}
     state = jax.device_put(empty_hash_state(plan, S, key_dtypes))
@@ -812,6 +847,7 @@ def _run_hash_device(cat: Catalog, plan: PhysicalPlan, settings: Settings,
     pl["hash_occupancy_pct"] = round(100.0 * int((h_rows > 0).sum()) / S, 1)
     pl["hash_spilled_rows"] = hs["spilled"]
     plan.runtime_cache["task_times"] = hs["task_times"]
+    plan.runtime_cache["task_bytes"] = hs["task_bytes"]
     return h_keys, h_partials, h_rows
 
 
@@ -1160,14 +1196,28 @@ def _finish_select(bound: BoundSelect, plan: PhysicalPlan, rows: list[tuple],
     visible = list(bound.output_names)
     if bound.hidden_outputs:
         visible = visible[:len(visible) - bound.hidden_outputs]
+    # attribution booking consumes the per-execution task logs exactly
+    # once (pop, not get): a later execution of this cached plan that
+    # serves entirely from HBM re-books nothing stale
+    task_times = plan.runtime_cache.pop("task_times", [])
+    task_bytes = plan.runtime_cache.pop("task_bytes", [])
+    remote_tasks = plan.runtime_cache.pop("remote_tasks", [])
+    mesh_times = plan.runtime_cache.pop("mesh_task_times", [])
+    from citus_tpu.observability.load_attribution import GLOBAL_ATTRIBUTION
+    from citus_tpu.workload import tenant_key
+    GLOBAL_ATTRIBUTION.book_query(
+        bound.table, tenant_key(plan.router_key),
+        task_times + mesh_times, task_bytes,
+        len(rows), remote_tasks,
+        head_si=plan.shard_indexes[0] if plan.shard_indexes else None)
     explain = {
         "strategy": plan.group_mode.kind if bound.has_aggs else "projection",
         "shards": len(plan.shard_indexes),
         "router": plan.is_router,
         "intervals": [c.column for c in plan.intervals],
         "elapsed_s": elapsed,
-        "tasks": plan.runtime_cache.get("task_times", []),
-        "remote_tasks": plan.runtime_cache.get("remote_tasks", []),
+        "tasks": task_times,
+        "remote_tasks": remote_tasks,
         "pipeline": plan.runtime_cache.get("pipeline", {}),
         "router_key": plan.router_key,
     }

@@ -569,6 +569,20 @@ def run_worker_task(cluster, p: dict) -> tuple[dict, bytes]:
             fp = os.path.join(d, fn)
             if os.path.isfile(fp):
                 stripe_bytes += os.path.getsize(fp)
+    # pushed-execution attribution: the placement's own host books the
+    # device work its scan did (popped from the inner run's task logs,
+    # so the worker-local ledger stays balanced against the worker's
+    # own bytes_scanned counter); query/row counts stay with the
+    # pushing coordinator — they are booked once at its _finish_select
+    from citus_tpu.observability.load_attribution import GLOBAL_ATTRIBUTION
+    att_times = plan.runtime_cache.pop("task_times", [])
+    att_bytes = plan.runtime_cache.pop("task_bytes", [])
+    dev_ms = sum(s for _si, _n, s in att_times) * 1000.0
+    if not att_times:
+        dev_ms = (clock() - t0) * 1000.0  # host-only task: wall fallback
+    GLOBAL_ATTRIBUTION.book(name, shard_id, node, str(p.get("tenant", "*")),
+                            device_ms=dev_ms,
+                            bytes_scanned=sum(b for _si, b in att_bytes))
     meta = {"ok": True, "node": node, "n_rows": int(n_rows),
             "stripe_bytes": int(stripe_bytes),
             "elapsed_s": clock() - t0}

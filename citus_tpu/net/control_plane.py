@@ -86,6 +86,7 @@ class ControlPlane:
     def _register_handlers(self) -> None:
         self.server.register("ping", lambda p: {"ok": True})
         self.server.register("catalog_changed", self._on_catalog_changed)
+        self.server.register("data_changed", self._on_data_changed)
         self.server.register("report_inflight", self._on_report_inflight)
         self.server.register("cluster_inflight", self._on_cluster_inflight)
         self.server.register("tx_event", self._on_tx_event)
@@ -115,6 +116,23 @@ class ControlPlane:
         self.server.broadcast({"event": "catalog_changed",
                                "origin": payload.get("origin")})
         return {"ok": True}
+
+    def _on_data_changed(self, payload: dict) -> dict:
+        """A peer committed a DATA write into a table: expire our
+        placement-mirror elision tokens for it and re-broadcast so every
+        other subscriber expires theirs (the invalidation stream behind
+        placement_sync_elided)."""
+        if payload.get("origin") != self.origin:
+            self._note_data_changed(payload.get("table"))
+        self.server.broadcast({"event": "data_changed",
+                               "origin": payload.get("origin"),
+                               "table": payload.get("table")})
+        return {"ok": True}
+
+    def _note_data_changed(self, table) -> None:
+        rd = getattr(self.cluster.catalog, "remote_data", None)
+        if rd is not None and table:
+            rd.note_data_changed(str(table))
 
     def _on_report_inflight(self, payload: dict) -> dict:
         with self._lock:
@@ -342,6 +360,9 @@ class ControlPlane:
         if event.get("event") == "catalog_changed" \
                 and event.get("origin") != self.origin:
             self.cluster._catalog_dirty = True
+        elif event.get("event") == "data_changed" \
+                and event.get("origin") != self.origin:
+            self._note_data_changed(event.get("table"))
 
     # ---- outbound ------------------------------------------------------
     def publish_catalog_change(self) -> None:
@@ -354,6 +375,22 @@ class ControlPlane:
         elif self.server is not None:
             self.server.broadcast({"event": "catalog_changed",
                                    "origin": self.origin})
+
+    def publish_data_change(self, table: str) -> None:
+        """Tell every coordinator a committed write touched ``table``.
+        A lost publication is safe only because receivers gate elision
+        on their push stream being alive (``connected``): the same
+        outage that loses the event also disables the fast path."""
+        payload = {"origin": self.origin, "table": table}
+        if self.client is not None:
+            try:
+                self.client.call("data_changed", payload)
+            except RpcError:
+                pass  # authority down: peers stop eliding (push dead)
+        elif self.server is not None:
+            self.server.broadcast({"event": "data_changed",
+                                   "origin": self.origin,
+                                   "table": table})
 
     def report_inflight(self) -> None:
         if self.client is not None:

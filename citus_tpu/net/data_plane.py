@@ -739,6 +739,17 @@ class DataPlaneClient:
         self._loop = None
         self.stats = {"files_fetched": 0, "bytes_fetched": 0,
                       "batches_shipped": 0, "remote_syncs": 0}
+        # per-table data-invalidation epoch plus per-placement sync
+        # tokens: a mirror whose token still equals the table's epoch
+        # (and whose invalidation stream is live, see
+        # ``invalidation_fresh``) is proven current and can skip the
+        # list_placement round trip entirely (placement_sync_elided)
+        self._sync_epochs: dict[str, int] = {}
+        self._sync_tokens: dict[tuple, int] = {}
+        # set by the Cluster to a zero-arg probe answering "is the
+        # control-plane invalidation stream trusted right now?"; while
+        # None (or returning False) every sync pays the full RTT
+        self.invalidation_fresh = None
 
     def event_loop(self):
         """The shared RpcEventLoop for this client (lazily started)."""
@@ -945,14 +956,28 @@ class DataPlaneClient:
         """Mirror a remote placement into the local cache; returns the
         local directory (None when the remote placement does not
         exist).  Immutable stripe files are fetched once; mutable files
-        (meta, deletes, index segments) re-fetch when size/mtime moved."""
+        (meta, deletes, index segments) re-fetch when size/mtime moved.
+
+        A mirror already proven current — synced at the table's present
+        data epoch, with the control-plane invalidation stream still
+        attached — skips even the list_placement round trip (the
+        ``placement_sync_elided`` counter tracks the saved RTTs)."""
+        d = self.cache_dir(table, shard_id, node)
+        with self._lock:
+            epoch = self._sync_epochs.get(table, 0)
+            token = self._sync_tokens.get((table, shard_id, node))
+        fresh = self.invalidation_fresh
+        if (token == epoch and fresh is not None and fresh()
+                and os.path.isfile(os.path.join(d, ".sync.json"))):
+            from citus_tpu.executor.executor import GLOBAL_COUNTERS
+            GLOBAL_COUNTERS.bump("placement_sync_elided")
+            return d
         r = self.call(endpoint, "list_placement",
                       {"table": table, "shard_id": shard_id, "node": node})
         if not r.get("exists"):
             return None
         self.stats["remote_syncs"] += 1
         bytes_before = self.stats["bytes_fetched"]
-        d = self.cache_dir(table, shard_id, node)
         os.makedirs(d, exist_ok=True)
         sig_path = os.path.join(d, ".sync.json")
         try:
@@ -992,6 +1017,11 @@ class DataPlaneClient:
         from citus_tpu.executor.executor import GLOBAL_COUNTERS
         GLOBAL_COUNTERS.bump("placement_sync_bytes",
                              self.stats["bytes_fetched"] - bytes_before)
+        # record the epoch captured BEFORE the list_placement RPC: a
+        # write invalidating mid-sync bumps the epoch past this token,
+        # so the next sync pays the RTT again (no lost-update window)
+        with self._lock:
+            self._sync_tokens[(table, shard_id, node)] = epoch
         return d
 
     # ---- transfer helpers (shard move) ---------------------------------
@@ -1073,10 +1103,18 @@ class DataPlaneClient:
         self.call(endpoint, "drop_placement",
                   {"table": table, "shard_id": shard_id, "node": node})
 
+    def note_data_changed(self, table: str) -> None:
+        """A committed write landed in this table somewhere in the
+        cluster: every mirrored placement may now trail its source, so
+        expire the elision tokens by bumping the table's data epoch."""
+        with self._lock:
+            self._sync_epochs[table] = self._sync_epochs.get(table, 0) + 1
+
     def invalidate_cache(self, table: str) -> None:
         import shutil
         d = os.path.join(self.cat.data_dir, ".remote_cache", table)
         shutil.rmtree(d, ignore_errors=True)
+        self.note_data_changed(table)
 
     def close(self) -> None:
         with self._lock:

@@ -41,6 +41,7 @@ def local_node_stats(cluster) -> dict:
     progress = []
     if cluster._background_jobs is not None:
         progress = cluster._background_jobs.jobs_view()["tasks"]
+    from citus_tpu.observability.load_attribution import GLOBAL_ATTRIBUTION
     payload = {
         "node_ids": node_ids,
         "counters": cluster.counters.snapshot(),
@@ -48,6 +49,11 @@ def local_node_stats(cluster) -> dict:
         "activity": [list(r) for r in cluster.activity.rows_view()],
         "slow_queries": [list(r) for r in GLOBAL_SLOW_LOG.rows_view()],
         "progress": progress,
+        # per-placement attribution ledger + autopilot decisions: both
+        # fan in cluster-wide (citus_shard_load / citus_autopilot_log)
+        "shard_load": [list(r) for r in GLOBAL_ATTRIBUTION.rows_view()],
+        "autopilot": [list(r) for r in cluster.autopilot.log_rows()]
+        if getattr(cluster, "autopilot", None) is not None else [],
     }
     # flight-recorder time series + health events ride the same RPC
     # (empty when the recorder is off — no payload growth by default)

@@ -115,6 +115,15 @@ METRIC_HELP = {
     "health_device_probe_wedged":
         "active wedged-device-probe health events",
     "health_metadata_sync_lag": "active metadata-sync-lag health events",
+    "health_autopilot_action": "active autopilot-action health events",
+    "autopilot_ticks": "autopilot evaluation ticks run",
+    "autopilot_actions_executed": "rebalance actions the autopilot ran",
+    "autopilot_actions_observed":
+        "actions the autopilot would have run (observe mode)",
+    "autopilot_actions_declined":
+        "actions the autopilot evaluated and declined",
+    "placement_sync_elided":
+        "pull-path placement syncs skipped via the invalidation epoch",
     "metadata_sync_bytes":
         "catalog bytes shipped to this coordinator as CTFR frames",
     "metadata_sync_rounds": "metadata pull-on-mismatch rounds run",
@@ -163,6 +172,38 @@ def prometheus_text(cluster) -> str:
         for r in sched_rows:
             out.append(f'citus_tenant_queue_depth'
                        f'{{tenant="{_label(str(r[0]))}"}} {int(r[2])}')
+
+    # per-placement load attribution, labeled; cardinality bounded by
+    # the ledger's top-K sampler cap (same cap as the flight-recorder
+    # shard_load: ring series)
+    from citus_tpu.observability.load_attribution import (
+        GLOBAL_ATTRIBUTION, RING_TOP_K,
+    )
+    att = GLOBAL_ATTRIBUTION.rows_view()[:RING_TOP_K]
+    if att:
+        for series, idx, doc in (
+                ("citus_shard_load_device_ms_total", 5,
+                 "device ms attributed to this placement"),
+                ("citus_shard_load_bytes_total", 6,
+                 "bytes scanned attributed to this placement")):
+            out.append(f"# HELP {series} {doc} "
+                       "(internal view: citus_shard_load)")
+            out.append(f"# TYPE {series} counter")
+            for r in att:
+                out.append(
+                    f'{series}{{table="{_label(str(r[0]))}",'
+                    f'shard="{int(r[1])}",node="{int(r[2])}",'
+                    f'tenant="{_label(str(r[3]))}"}} {r[idx]}')
+
+    # autopilot decisions by outcome (the per-outcome flat counters
+    # above remain for SHOW citus.metrics discoverability)
+    out.append("# HELP citus_autopilot_actions_total autopilot "
+               "decisions by outcome (services/autopilot.py)")
+    out.append("# TYPE citus_autopilot_actions_total counter")
+    for outcome in ("executed", "observed", "declined"):
+        out.append(f'citus_autopilot_actions_total'
+                   f'{{outcome="{outcome}"}} '
+                   f'{counters.get("autopilot_actions_" + outcome, 0)}')
 
     fams = _family_histograms(cluster)
     if fams:
@@ -281,6 +322,7 @@ def _gauges(cluster) -> dict:
     g["health_dead_node"] = active.get("dead_node", 0)
     g["health_device_probe_wedged"] = active.get("device_probe_wedged", 0)
     g["health_metadata_sync_lag"] = active.get("metadata_sync_lag", 0)
+    g["health_autopilot_action"] = active.get("autopilot_action", 0)
     return g
 
 

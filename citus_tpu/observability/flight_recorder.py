@@ -51,6 +51,8 @@ HEALTH_EVENT_KINDS = {
     "device_probe_wedged": "bench watcher flagged the device tunnel wedged",
     "metadata_sync_lag": "coordinator's catalog trailing the authority "
                          "across consecutive sync rounds",
+    "autopilot_action": "autopilot executed (or observed) a rebalance "
+                        "action for a sustained hot placement",
 }
 
 RING_SAMPLES = 512        # in-memory history ring (per node)
@@ -206,6 +208,15 @@ class FlightRecorder:
                 agg.counts[i] += c
         m["query_p99_ms"] = round(agg.percentile(0.99), 3) if agg.count \
             else 0.0
+        # per-placement attribution: advance the EWMA rates on the
+        # sampler's cadence and ring the top placements so
+        # citus_stat_history('shard_load:...') rates work like any
+        # other counter series
+        from citus_tpu.observability.load_attribution import (
+            GLOBAL_ATTRIBUTION,
+        )
+        GLOBAL_ATTRIBUTION.tick()
+        m.update(GLOBAL_ATTRIBUTION.ring_metrics())
         return m
 
     # --------------------------------------------------- health engine

@@ -1514,6 +1514,7 @@ class Parser:
         "citus_statistics_objects",
         "citus_stat_history", "citus_health_events",
         "citus_device_memory",
+        "citus_shard_load", "citus_rebalance_plan", "citus_autopilot_log",
         "citus_create_rollup", "citus_drop_rollup",
         "citus_refresh_rollups", "citus_rollups",
     }

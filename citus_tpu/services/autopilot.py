@@ -1,0 +1,275 @@
+"""Autopilot: the flight-recorder→rebalancer control loop.
+
+Closes ROADMAP item 3's observe→decide→act gap: the sensors
+(flight-recorder health events, per-placement load attribution) and
+the actuators (non-blocking shard moves through the crash-safe
+operation registry) exist — this duty connects them.
+
+Control discipline
+------------------
+* ``citus.autopilot = off | observe | on`` (default off).  ``observe``
+  evaluates and logs every decision — with the evidence snapshot that
+  drove it — but executes nothing, making the decision log itself the
+  dry-run A/B instrument.
+* Hysteresis: the same plan step must recur for
+  ``citus.autopilot_sustain_ticks`` consecutive evaluations before the
+  autopilot acts; every action starts a ``citus.autopilot_cooldown_s``
+  quiet period; at most ONE autopilot operation is ever in flight.
+* Exactly-once across restarts: an ``autopilot``-kind row in the
+  operation registry (operations/cleaner.py) brackets each executed
+  action.  A restarted autopilot that finds a dead owner's row adopts
+  it — retires the row, enters cooldown, logs the adoption — instead
+  of re-deciding, so a SIGKILL mid-decision never yields two moves.
+  The cooldown timestamp itself persists in
+  ``<data_dir>/autopilot_state.json``.
+* Conservative actuation: only ``move`` steps execute; ``split`` and
+  ``isolate`` steps are logged as advisory decisions for an operator
+  (the dry-run plan view shows them with scores).
+
+Every decision — executed, observed, declined, adopted — lands in a
+bounded ring surfaced cluster-wide via ``citus_autopilot_log()`` and
+as ``autopilot_actions_*`` counters (Prometheus:
+``citus_autopilot_actions_total{outcome=...}``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+
+from citus_tpu.utils.clock import now as wall_now
+
+LOG_MAX = 256          # retained decision-ring entries
+MODES = ("off", "observe", "on")
+#: health-event kinds whose activity rides the evidence snapshot
+TRIGGER_KINDS = ("p99_regression", "shed_rate_spike", "pool_saturation")
+
+LOG_COLUMNS = ("ts", "mode", "decision", "action", "table_name",
+               "shard_id", "source_node", "target_node", "score",
+               "reason", "evidence")
+
+STATE_FILE = "autopilot_state.json"
+
+
+class Autopilot:
+    """Per-cluster decision loop, driven as a maintenance duty."""
+
+    def __init__(self, cluster) -> None:
+        self._cl = cluster
+        self._mu = threading.Lock()
+        self._log: deque = deque(maxlen=LOG_MAX)
+        # plan-step key -> consecutive ticks it has been the top step
+        self._pending: dict[tuple, int] = {}
+        self._state_path = os.path.join(cluster.catalog.data_dir,
+                                        STATE_FILE)
+        self._state = self._load_state()
+        # (kind, subject) of our last emitted health event, resolved
+        # once the cooldown that action started expires
+        self._live_event: tuple | None = None
+
+    # ------------------------------------------------------------ state
+
+    def _load_state(self) -> dict:
+        try:
+            with open(self._state_path, "r", encoding="utf-8") as f:
+                st = json.load(f)
+            return st if isinstance(st, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _store_state(self) -> None:
+        tmp = self._state_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self._state, f)
+            os.replace(tmp, self._state_path)
+        except OSError:
+            pass  # state is an optimization (cooldown across restarts)
+
+    # ------------------------------------------------------------- duty
+
+    def duty(self) -> None:
+        """One evaluation tick (registered with the maintenance daemon;
+        interval = citus.autopilot_interval_s)."""
+        ap = self._cl.settings.autopilot
+        mode = str(ap.mode)
+        if mode not in ("observe", "on"):
+            return
+        self._cl.counters.bump("autopilot_ticks")
+        from citus_tpu.observability.load_attribution import (
+            GLOBAL_ATTRIBUTION,
+        )
+        from citus_tpu.operations.rebalance_plan import build_rebalance_plan
+        GLOBAL_ATTRIBUTION.tick()
+        rec = self._cl.flight_recorder
+        active = rec.active_counts()
+        health = {k: active.get(k, 0) for k in TRIGGER_KINDS
+                  if active.get(k, 0)}
+        steps = build_rebalance_plan(
+            self._cl.catalog, "by_observed_load",
+            threshold=float(ap.threshold), max_steps=4)
+        now = wall_now()
+        self._maybe_resolve_event(now, float(ap.cooldown_s))
+        if not steps:
+            self._pending.clear()
+            if health:
+                # a health trigger with no actionable plan is itself a
+                # decision worth auditing (the "we looked and held
+                # still" record the A/B analysis needs)
+                self._decide(mode, "declined", None, 0,
+                             "no actionable plan for active health "
+                             "events", health, now)
+            return
+        step = steps[0]
+        key = (step.action, step.table, step.shard_id,
+               step.source_node, step.target_node)
+        seen = self._pending.get(key, 0) + 1
+        self._pending = {key: seen}
+        sustain = max(1, int(ap.sustain_ticks))
+        if seen < sustain:
+            self._decide(mode, "declined", step, seen,
+                         f"sustaining {seen}/{sustain}", health, now)
+            return
+        last_ts = float(self._state.get("last_action_ts", 0.0))
+        if now - last_ts < float(ap.cooldown_s):
+            self._decide(mode, "declined", step, seen,
+                         f"cooldown ({ap.cooldown_s:.0f}s after "
+                         f"{self._state.get('last_action_key')})",
+                         health, now)
+            return
+        stale = self._check_inflight(now)
+        if stale == "live":
+            self._decide(mode, "declined", step, seen,
+                         "autopilot operation already in flight",
+                         health, now)
+            return
+        if stale == "adopted":
+            self._decide(mode, "declined", step, seen,
+                         "adopted a crashed autopilot's decision; "
+                         "entering its cooldown instead of re-acting",
+                         health, now)
+            return
+        if step.action != "move":
+            self._decide(mode, "declined", step, seen,
+                         f"{step.action} is advisory: surfaced for an "
+                         "operator, never auto-executed", health, now)
+            self._pending.clear()
+            return
+        if mode == "observe":
+            self._enter_cooldown(key, None, now)
+            self._decide(mode, "observed", step, seen,
+                         "observe mode: would execute", health, now)
+            self._pending.clear()
+            return
+        self._execute(step, key, seen, health, now)
+        self._pending.clear()
+
+    # -------------------------------------------------------- execution
+
+    def _execute(self, step, key: tuple, seen: int, health: dict,
+                 now: float) -> None:
+        import uuid
+
+        from citus_tpu.operations.cleaner import (
+            complete_operation, mark_operation_phase, register_operation,
+        )
+        from citus_tpu.operations.shard_transfer import move_shard_placement
+        cat = self._cl.catalog
+        op_id = uuid.uuid4().int & ((1 << 62) - 1)
+        # registry row FIRST: if we die mid-move, the next autopilot
+        # (any coordinator on this data dir) adopts this row instead of
+        # deciding again — the exactly-once bracket
+        register_operation(cat, op_id, kind="autopilot")
+        mark_operation_phase(cat, op_id, "decided")
+        self._enter_cooldown(key, op_id, now)
+        ok = False
+        try:
+            move_shard_placement(cat, step.shard_id, step.source_node,
+                                 step.target_node,
+                                 lock_manager=self._cl.locks,
+                                 settings=self._cl.settings)
+            ok = True
+        finally:
+            complete_operation(cat, op_id, success=ok)
+            self._decide("on", "executed" if ok else "failed", step, seen,
+                         f"moved shard {step.shard_id} "
+                         f"{step.source_node}->{step.target_node}"
+                         if ok else "move raised; registry row retired",
+                         health, wall_now())
+        subject = f"{step.table}.{step.shard_id}"
+        self._cl.flight_recorder.emit_event(
+            "autopilot_action", subject, step.score, 0.0,
+            f"autopilot moved {subject} node {step.source_node}->"
+            f"{step.target_node} (score {step.score:.2f})")
+        self._live_event = ("autopilot_action", subject)
+
+    def _enter_cooldown(self, key: tuple, op_id, now: float) -> None:
+        self._state = {"last_action_ts": now,
+                       "last_action_key": list(key),
+                       "last_op_id": op_id}
+        self._store_state()
+
+    def _maybe_resolve_event(self, now: float, cooldown_s: float) -> None:
+        if self._live_event is None:
+            return
+        if now - float(self._state.get("last_action_ts", 0.0)) >= cooldown_s:
+            self._cl.flight_recorder.resolve_event(*self._live_event)
+            self._live_event = None
+
+    def _check_inflight(self, now: float) -> str:
+        """Scan the operation registry for autopilot rows: 'live' while
+        one runs (ours or another coordinator's), 'adopted' when a dead
+        owner's row was just retired, '' when clear."""
+        from citus_tpu.operations.cleaner import (
+            _pid_alive, complete_operation, operations_view,
+        )
+        cat = self._cl.catalog
+        adopted = False
+        for op_id, row in sorted(operations_view(cat).items()):
+            if row.get("kind") != "autopilot":
+                continue
+            if _pid_alive(int(row.get("pid", -1))):
+                # ours never linger (the execute bracket retires them
+                # in a finally), so a live row IS a concurrent
+                # autopilot: max-concurrent-ops = 1
+                return "live"
+            # dead owner: it had DECIDED (row exists ⇒ past the point
+            # of no return) — the move op itself has its own registry
+            # row/cleaner handling; retire the decision row and take
+            # over its cooldown so the cluster never double-acts
+            complete_operation(cat, int(op_id), success=False)
+            adopted = True
+        if adopted:
+            self._enter_cooldown(("adopted",), None, now)
+            return "adopted"
+        return ""
+
+    # ----------------------------------------------------- decision log
+
+    def _decide(self, mode: str, decision: str, step, seen: int,
+                reason: str, health: dict, now: float) -> None:
+        counter = {"executed": "autopilot_actions_executed",
+                   "failed": "autopilot_actions_executed",
+                   "observed": "autopilot_actions_observed"}.get(
+                       decision, "autopilot_actions_declined")
+        self._cl.counters.bump(counter)
+        evidence = {"health": health, "sustain": seen,
+                    "mode": mode}
+        if step is not None:
+            evidence["step"] = step.to_row(1)
+        row = (round(float(now), 3), mode, decision,
+               step.action if step else "", step.table if step else "",
+               step.shard_id if step else -1,
+               step.source_node if step else -1,
+               step.target_node if step else -1,
+               round(float(step.score), 4) if step else 0.0,
+               reason, json.dumps(evidence, sort_keys=True))
+        with self._mu:
+            self._log.append(row)
+
+    def log_rows(self) -> list[tuple]:
+        """Newest-first decision rows for citus_autopilot_log()."""
+        with self._mu:
+            return list(reversed(self._log))

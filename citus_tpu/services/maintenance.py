@@ -28,6 +28,10 @@ class Duty:
     # a float, or a zero-arg callable re-read every tick (so SET-style
     # runtime changes to an interval take effect on a running daemon)
     interval_s: "float | Callable[[], float]"
+    # higher runs first within a tick: a due latency-critical duty
+    # (deadlock detection) must never wait behind a long-running
+    # housekeeping duty that happened to register earlier
+    priority: int = 0
     last_run: float = 0.0
     runs: int = 0
     errors: int = 0
@@ -43,8 +47,9 @@ class MaintenanceDaemon:
                       lambda: try_drop_orphaned_resources(cat),
                       cleanup_interval_s)
 
-    def register(self, name: str, fn: Callable[[], object], interval_s: float) -> None:
-        self._duties.append(Duty(name, fn, interval_s))
+    def register(self, name: str, fn: Callable[[], object], interval_s: float,
+                 priority: int = 0) -> None:
+        self._duties.append(Duty(name, fn, interval_s, priority=priority))
 
     def start(self) -> None:
         if self._thread is not None:
@@ -62,8 +67,14 @@ class MaintenanceDaemon:
 
     def run_once(self) -> None:
         """Run every duty immediately (tests + explicit triggers)."""
-        for d in self._duties:
+        for d in self._ordered():
             self._run_duty(d)
+
+    def _ordered(self) -> list[Duty]:
+        """Duties in execution order: priority desc, then registration
+        order (sorted() is stable, so equal priorities keep their
+        historical ordering)."""
+        return sorted(self._duties, key=lambda d: -d.priority)
 
     @staticmethod
     def _interval(d: Duty) -> float:
@@ -84,7 +95,7 @@ class MaintenanceDaemon:
     def _loop(self) -> None:
         while not self._stop.is_set():
             now = wall_now()
-            for d in self._duties:
+            for d in self._ordered():
                 if now - d.last_run >= self._interval(d):
                     self._run_duty(d)
             self._stop.wait(timeout=0.2)

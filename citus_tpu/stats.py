@@ -181,6 +181,18 @@ class StatCounters:
         "hash_fused_dispatches",
         "hash_spill_rows",
         "hash_partials_pushed",
+        # pull-path placement syncs skipped because the control plane's
+        # data-invalidation epoch proved the local mirror current
+        # (net/data_plane.py sync_placement fast path)
+        "placement_sync_elided",
+        # autopilot control loop (services/autopilot.py): evaluation
+        # ticks, and decisions by outcome — executed a rebalance action,
+        # observed one (citus.autopilot=observe logs without acting),
+        # declined one (hysteresis / cooldown / in-flight guard)
+        "autopilot_ticks",
+        "autopilot_actions_executed",
+        "autopilot_actions_observed",
+        "autopilot_actions_declined",
     ]
 
     def __init__(self):

@@ -74,6 +74,10 @@ def finish_branch(cl, session, commit: bool) -> None:
                     commit_staged(d, txn.xid)
             cl.txlog.log(txn.xid, TxState.DONE)
             cl._plan_cache.clear()
+            # this host just flipped new data into placements other
+            # coordinators may mirror: expire elision tokens everywhere
+            for name in sorted(payload.get("tables", ())):
+                cl._publish_data_changed(name)
             if txn.cdc_events:
                 clock = cl.clock.transaction_clock()
                 for table, op, kw in txn.cdc_events:

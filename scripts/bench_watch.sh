@@ -8,7 +8,9 @@
 # line to TUNNEL_PROBES.log and arm the marker file the flight
 # recorder's health engine turns into a device_probe_wedged event /
 # Prometheus gauge, instead of silently replaying the stale number.
-# A later healthy probe (rc=0 with DEVICES) disarms the marker.
+# A later healthy probe (any rc=0) disarms the marker: requiring the
+# DEVICES substring too silently skipped captures whenever the probe's
+# stdout formatting drifted — rc is the authority, the substring is not.
 cd /root/repo || exit 1
 N=${WATCH_ITERS:-45}
 WEDGE_MARKER=${CITUS_WEDGE_MARKER:-.tunnel_wedged}
@@ -19,16 +21,16 @@ while [ "$i" -lt "$N" ]; do
     sh scripts/tunnel_probe.sh
     LAST=$(tail -1 TUNNEL_PROBES.log)
     case "$LAST" in
-    *"rc=0"*DEVICES*)
+    *"rc=0"*)
         WEDGED_STREAK=0
         rm -f "$WEDGE_MARKER"
-        if [ ! -f .bench_fresh_r18 ]; then
+        if [ ! -f .bench_fresh_r19 ]; then
             BENCH_PROBE_TIMEOUT_S=240 BENCH_RETRY_DELAY_S=30 \
                 BENCH_JOIN=1 BENCH_SWEEP=1 \
                 python bench.py > .bench_auto.out 2> .bench_auto.err
             # a fresh (non-fallback) record carries no "stale" marker
             if [ -s .bench_auto.out ] && ! grep -q '"stale": true' .bench_auto.out; then
-                touch .bench_fresh_r18
+                touch .bench_fresh_r19
             fi
         fi
         ;;

@@ -15,3 +15,11 @@ RC=$?
 LAST=$(printf '%s\n' "$OUT" | grep DEVICES | tail -1)
 [ -n "$LAST" ] || LAST=$(printf '%s\n' "$OUT" | tail -1)
 echo "$TS rc=$RC $LAST" >> /root/repo/TUNNEL_PROBES.log
+# forensics: the one-line summary drops the axon-plugin stack trace that
+# explains WHY a probe failed; keep every probe's complete output in a
+# companion log (indented so probes stay visually delimited) without
+# breaking the one-line-per-probe format the watcher's tail -1 parses
+{
+    echo "$TS rc=$RC full output:"
+    printf '%s\n' "$OUT" | sed 's/^/    /'
+} >> /root/repo/TUNNEL_PROBES.full.log

@@ -1,0 +1,215 @@
+"""Autopilot (services/autopilot.py): the flight-recorder→rebalancer
+loop.  Hysteresis, observe/on modes, exactly-once across crashes via
+the operation registry, and the decision log's evidence trail."""
+
+import json
+import subprocess
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+from citus_tpu.executor.executor import GLOBAL_COUNTERS
+from citus_tpu.observability.load_attribution import GLOBAL_ATTRIBUTION
+from citus_tpu.operations.cleaner import operations_view, register_operation
+
+
+@pytest.fixture()
+def cl(tmp_path):
+    c = ct.Cluster(str(tmp_path / "db"), n_nodes=2)
+    c.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    c.execute("SELECT create_distributed_table('t', 'k', 4)")
+    n = 8000
+    c.copy_from("t", columns={"k": np.arange(n, dtype=np.int64),
+                              "v": np.arange(n, dtype=np.int64)})
+    GLOBAL_COUNTERS.reset()
+    yield c
+    c.close()
+
+
+def _heat_node0(cl, ms=5000.0):
+    """Book a hot-shard storm: all device time lands on node 0's
+    placements, so by_observed_load plans a move 0 -> 1."""
+    for s in cl.catalog.table("t").shards:
+        node = s.placements[0]
+        GLOBAL_ATTRIBUTION.book("t", s.shard_id, node, "hot" if node == 0
+                                else "*",
+                                device_ms=ms if node == 0 else 1.0,
+                                queries=1)
+
+
+def _placements(cl):
+    return [tuple(s.placements) for s in cl.catalog.table("t").shards]
+
+
+def test_guc_round_trip_and_default_off(cl):
+    assert cl.execute("SHOW citus.autopilot").rows == [("off",)]
+    cl.execute("SET citus.autopilot = observe")
+    assert cl.execute("SHOW citus.autopilot").rows == [("observe",)]
+    cl.execute("SET citus.autopilot = on")
+    assert cl.settings.autopilot.mode == "on"
+    cl.execute("SET citus.autopilot = off")
+    with pytest.raises(CatalogError):
+        cl.execute("SET citus.autopilot = maybe")
+    cl.execute("SET citus.autopilot_sustain_ticks = 5")
+    assert cl.settings.autopilot.sustain_ticks == 5
+    cl.execute("SET citus.autopilot_cooldown_s = 120")
+    assert cl.settings.autopilot.cooldown_s == 120.0
+
+
+def test_off_mode_is_inert(cl):
+    _heat_node0(cl)
+    cl.autopilot.duty()
+    assert GLOBAL_COUNTERS.snapshot()["autopilot_ticks"] == 0
+    assert cl.autopilot.log_rows() == []
+
+
+def test_observe_mode_logs_but_never_moves(cl):
+    """Observe mode: the decision (with evidence) lands in the log and
+    counters; zero moves, counter- AND registry-asserted."""
+    cl.execute("SET citus.autopilot = observe")
+    cl.execute("SET citus.autopilot_sustain_ticks = 2")
+    _heat_node0(cl)
+    before = _placements(cl)
+    cl.autopilot.duty()   # sustain 1/2 -> declined
+    cl.autopilot.duty()   # sustained -> observed
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["autopilot_ticks"] == 2
+    assert snap["autopilot_actions_observed"] == 1
+    assert snap["autopilot_actions_declined"] == 1
+    assert snap["autopilot_actions_executed"] == 0
+    assert _placements(cl) == before
+    assert operations_view(cl.catalog) == {}
+    rows = cl.autopilot.log_rows()
+    assert rows[0][2] == "observed" and rows[0][3] == "move"
+    ev = json.loads(rows[0][10])
+    assert ev["mode"] == "observe" and ev["sustain"] == 2
+    assert "health" in ev and "step" in ev
+    # SQL surface fans the ring in with node attribution
+    r = cl.execute("SELECT citus_autopilot_log()")
+    assert r.rowcount == 2
+    assert r.columns[0] == "node" and "evidence" in r.columns
+
+
+def test_hysteresis_requires_consecutive_recurrence(cl):
+    cl.execute("SET citus.autopilot = observe")
+    cl.execute("SET citus.autopilot_sustain_ticks = 3")
+    _heat_node0(cl)
+    cl.autopilot.duty()
+    cl.autopilot.duty()
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["autopilot_actions_observed"] == 0
+    assert snap["autopilot_actions_declined"] == 2
+    reasons = [row[9] for row in cl.autopilot.log_rows()]
+    assert any("sustaining" in r for r in reasons)
+
+
+def test_on_mode_executes_exactly_one_move(cl):
+    """The e2e loop: hot-shard storm -> sustained decision -> ONE
+    registry-bracketed move; the cooldown then holds further actions,
+    and queries keep answering through and after the move."""
+    n = 8000
+    expect = [(n, n * (n - 1) // 2)]
+    cl.execute("SET citus.autopilot = on")
+    cl.execute("SET citus.autopilot_sustain_ticks = 2")
+    cl.execute("SET citus.autopilot_cooldown_s = 3600")
+    _heat_node0(cl)
+    before = _placements(cl)
+    cl.autopilot.duty()
+    assert _placements(cl) == before   # hysteresis: no first-tick move
+    cl.autopilot.duty()
+    after = _placements(cl)
+    assert after != before
+    moved = [i for i, (b, a) in enumerate(zip(before, after)) if b != a]
+    assert len(moved) == 1             # exactly one placement moved
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["autopilot_actions_executed"] == 1
+    assert operations_view(cl.catalog) == {}   # bracket retired
+    assert cl.execute("SELECT count(*), sum(v) FROM t").rows == expect
+    # the action is a typed health event while the cooldown holds
+    assert cl.flight_recorder.active_counts().get("autopilot_action") == 1
+    # further storms decline on cooldown: still exactly one move
+    _heat_node0(cl)
+    cl.autopilot.duty()
+    cl.autopilot.duty()
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["autopilot_actions_executed"] == 1
+    assert _placements(cl) == after
+    rows = cl.autopilot.log_rows()
+    assert rows[0][2] == "declined" and "cooldown" in rows[0][9]
+    assert any(row[2] == "executed" for row in rows)
+
+
+def test_crashed_autopilot_is_adopted_not_repeated(cl):
+    """A dead coordinator's in-flight autopilot row (SIGKILL between
+    decision and completion) is adopted: the row retires, its cooldown
+    is inherited, and NO second move happens — exactly-once."""
+    p = subprocess.Popen(["sleep", "0"])
+    p.wait()   # reaped: the pid is genuinely dead
+    register_operation(cl.catalog, 12345, kind="autopilot", pid=p.pid)
+    cl.execute("SET citus.autopilot = on")
+    cl.execute("SET citus.autopilot_sustain_ticks = 1")
+    cl.execute("SET citus.autopilot_cooldown_s = 3600")
+    _heat_node0(cl)
+    before = _placements(cl)
+    cl.autopilot.duty()
+    assert _placements(cl) == before
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["autopilot_actions_executed"] == 0
+    assert operations_view(cl.catalog) == {}   # adopted row retired
+    rows = cl.autopilot.log_rows()
+    assert rows[0][2] == "declined" and "adopted" in rows[0][9]
+    # the inherited cooldown persists on disk across a restart
+    from citus_tpu.services.autopilot import Autopilot
+    reborn = Autopilot(cl)
+    assert float(reborn._state["last_action_ts"]) > 0.0
+    cl.autopilot.duty()
+    assert snap["autopilot_actions_executed"] == 0
+    assert _placements(cl) == before
+
+
+def test_live_autopilot_row_blocks_concurrent_action(cl):
+    """max-concurrent-ops = 1: a LIVE autopilot row (another
+    coordinator mid-move) declines this tick without retiring it."""
+    import os
+    register_operation(cl.catalog, 777, kind="autopilot", pid=os.getpid())
+    cl.execute("SET citus.autopilot = on")
+    cl.execute("SET citus.autopilot_sustain_ticks = 1")
+    _heat_node0(cl)
+    before = _placements(cl)
+    cl.autopilot.duty()
+    assert _placements(cl) == before
+    assert "777" in operations_view(cl.catalog)   # NOT adopted
+    rows = cl.autopilot.log_rows()
+    assert rows[0][2] == "declined" and "in flight" in rows[0][9]
+
+
+def test_no_plan_with_health_event_logs_declined(cl):
+    """A health trigger with nothing actionable is itself an audited
+    decision (the 'we looked and held still' record)."""
+    cl.execute("SET citus.autopilot = observe")
+    cl.flight_recorder.emit_event("p99_regression", "query_p99_ms",
+                                  100.0, 10.0, "test")
+    cl.autopilot.duty()   # balanced cluster: no steps
+    rows = cl.autopilot.log_rows()
+    assert rows and rows[0][2] == "declined"
+    assert "no actionable plan" in rows[0][9]
+    ev = json.loads(rows[0][10])
+    assert ev["health"].get("p99_regression") == 1
+
+
+def test_deadlock_duty_outranks_autopilot_in_a_tick(cl):
+    """The deadlock detector's scheduling priority: within one
+    maintenance tick it runs before every priority-0 duty (autopilot,
+    cleanup), so victim selection never waits out a slow housekeeping
+    pass — the scheduling fix for the two-process deadlock flake."""
+    d = cl.maintenance
+    names = [duty.name for duty in d._ordered()]
+    assert names[0] == "deadlock_detection"
+    assert "autopilot" in names
+    assert names.index("deadlock_detection") < names.index("autopilot")
+    ran = []
+    d.register("probe_low", lambda: ran.append("low"), 0.0)
+    # priority is honored over registration order, not just sorted once
+    assert [x.name for x in d._ordered()][0] == "deadlock_detection"

@@ -1,0 +1,145 @@
+"""Dry-run rebalance planning (operations/rebalance_plan.py +
+SELECT citus_rebalance_plan(strategy)): deterministic, side-effect
+free, and strategy-aware (shard count / bytes / observed load)."""
+
+import numpy as np
+import pytest
+
+import citus_tpu as ct
+from citus_tpu.errors import CatalogError
+from citus_tpu.operations.rebalance_plan import (
+    ISOLATE_TENANT_SHARE, build_rebalance_plan, plan_rows,
+)
+
+
+def make_cluster(tmp_path, nodes=2, shards=4, n=8000):
+    cl = ct.Cluster(str(tmp_path / "db"), n_nodes=nodes)
+    cl.execute("CREATE TABLE t (k bigint NOT NULL, v bigint)")
+    cl.execute(f"SELECT create_distributed_table('t', 'k', {shards})")
+    cl.copy_from("t", columns={"k": np.arange(n, dtype=np.int64),
+                               "v": np.arange(n, dtype=np.int64)})
+    return cl
+
+
+def _placements(cl, table="t"):
+    return [tuple(s.placements) for s in cl.catalog.table(table).shards]
+
+
+def test_plan_deterministic_and_side_effect_free(tmp_path):
+    cl = make_cluster(tmp_path)
+    cl.execute("SELECT citus_add_node('w2', 5432)")
+    before = _placements(cl)
+    r1 = cl.execute("SELECT citus_rebalance_plan('by_shard_count')")
+    r2 = cl.execute("SELECT citus_rebalance_plan('by_shard_count')")
+    assert r1.rows == r2.rows
+    assert r1.rowcount >= 1  # empty new node attracts moves
+    # pure observability: nothing moved, nothing registered
+    assert _placements(cl) == before
+    from citus_tpu.operations.cleaner import operations_view
+    assert operations_view(cl.catalog) == {}
+    cols = r1.columns
+    assert list(cols) == ["step", "action", "table_name", "shard_id",
+                          "source_node", "target_node", "cost", "score",
+                          "reason"]
+    by = {c: i for i, c in enumerate(cols)}
+    for row in r1.rows:
+        assert row[by["action"]] == "move"
+        assert row[by["target_node"]] == 2   # the empty node
+        assert 0.0 < row[by["score"]] <= 1.0
+    # steps are ordered 1..N
+    assert [row[by["step"]] for row in r1.rows] == \
+        list(range(1, r1.rowcount + 1))
+    cl.close()
+
+
+def test_balanced_cluster_plans_nothing(tmp_path):
+    cl = make_cluster(tmp_path)
+    assert cl.execute("SELECT citus_rebalance_plan('by_shard_count')").rows == []
+    assert cl.execute("SELECT citus_rebalance_plan('by_bytes')").rows == []
+    cl.close()
+
+
+def test_unknown_strategy_raises(tmp_path):
+    cl = make_cluster(tmp_path)
+    with pytest.raises(CatalogError):
+        cl.execute("SELECT citus_rebalance_plan('by_vibes')")
+    cl.close()
+
+
+def test_by_bytes_strategy_moves_toward_empty_node(tmp_path):
+    cl = make_cluster(tmp_path)
+    cl.execute("SELECT citus_add_node('w2', 5432)")
+    r = cl.execute("SELECT citus_rebalance_plan('by_bytes', 0.2)")
+    assert r.rowcount >= 1
+    by = {c: i for i, c in enumerate(r.columns)}
+    for row in r.rows:
+        assert row[by["cost"]] > 0.0      # real stripe bytes moved
+    # the empty node attracts the first (highest-benefit) move
+    assert r.rows[0][by["target_node"]] == 2
+    cl.close()
+
+
+def test_by_observed_load_follows_attribution(tmp_path):
+    """Load booked against node 0's placements produces a plan moving a
+    hot slot off node 0 — and an explicit snapshot makes the plan a
+    pure function of its inputs."""
+    cl = make_cluster(tmp_path)
+    t = cl.catalog.table("t")
+    scores = {}
+    for s in t.shards:
+        node = s.placements[0]
+        scores[("t", s.shard_id, node)] = 500.0 if node == 0 else 1.0
+    p1 = build_rebalance_plan(cl.catalog, "by_observed_load",
+                              load_scores=scores, attribution_rows=[])
+    p2 = build_rebalance_plan(cl.catalog, "by_observed_load",
+                              load_scores=scores, attribution_rows=[])
+    assert p1 == p2
+    assert p1 and p1[0].action == "move"
+    assert p1[0].source_node == 0 and p1[0].target_node == 1
+    assert plan_rows(p1)[0][0] == 1
+    cl.close()
+
+
+def test_unsplittable_hot_slot_plans_split(tmp_path):
+    """A single group slot heavier than the whole gap cannot be fixed
+    by a move: the plan recognizes the shape and proposes a split."""
+    cl = make_cluster(tmp_path, shards=1, n=2000)
+    sid = cl.catalog.table("t").shards[0].shard_id
+    src = cl.catalog.table("t").shards[0].placements[0]
+    steps = build_rebalance_plan(cl.catalog, "by_shard_count")
+    assert len(steps) == 1
+    assert steps[0].action == "split"
+    assert steps[0].shard_id == sid and steps[0].source_node == src
+    cl.close()
+
+
+def test_dominant_tenant_plans_isolation(tmp_path):
+    """Under by_observed_load, one tenant carrying >= 60% of the
+    hottest unmovable placement yields an isolate step, not a split."""
+    cl = make_cluster(tmp_path, shards=1, n=2000)
+    s = cl.catalog.table("t").shards[0]
+    node = s.placements[0]
+    scores = {("t", s.shard_id, node): 1000.0}
+    rows = [["t", s.shard_id, node, "42", 10, 800.0, 0, 0, 0.0, 0.0],
+            ["t", s.shard_id, node, "7", 3, 200.0, 0, 0, 0.0, 0.0]]
+    steps = build_rebalance_plan(cl.catalog, "by_observed_load",
+                                 load_scores=scores, attribution_rows=rows)
+    assert len(steps) == 1
+    st = steps[0]
+    assert st.action == "isolate"
+    assert "42" in st.reason
+    assert st.score >= ISOLATE_TENANT_SHARE
+    # a diffuse tenant mix on the same shape degrades to a split
+    diffuse = [["t", s.shard_id, node, str(i), 1, 100.0, 0, 0, 0.0, 0.0]
+               for i in range(10)]
+    steps2 = build_rebalance_plan(cl.catalog, "by_observed_load",
+                                  load_scores=scores,
+                                  attribution_rows=diffuse)
+    assert steps2 and steps2[0].action == "split"
+    cl.close()
+
+
+def test_single_node_plans_nothing(tmp_path):
+    cl = make_cluster(tmp_path, nodes=1)
+    assert build_rebalance_plan(cl.catalog, "by_shard_count") == []
+    cl.close()

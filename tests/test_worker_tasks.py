@@ -403,3 +403,51 @@ def test_serial_does_not_clobber_foreign_sequence(tmp_cluster):
     cl.execute("CREATE TABLE st (id bigserial, v bigint)")
     cl.execute("INSERT INTO st (v) VALUES (8)")
     assert cl.execute("SELECT id FROM st").rows == [(1,)]  # restarted
+
+
+def test_pull_sync_elision_skips_unchanged_placements(pair):
+    """Second pull-mode query over unchanged remote placements skips
+    the per-placement list_placement RTT entirely: the elision token
+    (data epoch + live invalidation stream) proves the mirror current."""
+    a, b, na, nb = pair
+    n = _load(a)
+    a.execute("SET citus.remote_task_execution = pull")
+    assert a.execute("SELECT count(*) FROM t").rows == [(n,)]
+    syncs1 = a.catalog.remote_data.stats["remote_syncs"]
+    assert syncs1 >= 1
+    GLOBAL_CACHE.clear()   # drop HBM so the scan re-consults the mirror
+    assert a.execute("SELECT sum(v) FROM t").rows == [(3 * n * (n - 1) // 2,)]
+    snap = GLOBAL_COUNTERS.snapshot()
+    assert snap["placement_sync_elided"] >= 1
+    # proof the RTT was saved: no new list_placement round trips
+    assert a.catalog.remote_data.stats["remote_syncs"] == syncs1
+
+
+def test_write_invalidates_elision_cluster_wide(pair):
+    """A write through the OTHER coordinator expires the elision tokens
+    via the control-plane data_changed push: the next pull pays the RTT
+    again and reads the fresh rows (no stale mirror)."""
+    a, b, na, nb = pair
+    n = _load(a)
+    a.execute("SET citus.remote_task_execution = pull")
+    assert a.execute("SELECT count(*) FROM t").rows == [(n,)]
+    GLOBAL_CACHE.clear()
+    a.execute("SELECT count(*) FROM t")   # arm the elision fast path
+    syncs = a.catalog.remote_data.stats["remote_syncs"]
+    b.copy_from("t", columns={"k": np.array([10 * n]),
+                              "v": np.array([7]), "c": ["w0"]})
+    GLOBAL_CACHE.clear()
+    assert a.execute("SELECT count(*) FROM t").rows == [(n + 1,)]
+    # the tokens expired: the mirrors re-synced over the wire
+    assert a.catalog.remote_data.stats["remote_syncs"] > syncs
+
+
+def test_elision_distrusted_without_push_stream(tmp_path):
+    """No control plane (file-mtime polling topology): every sync pays
+    the RTT — elision only activates when the invalidation stream is
+    provably attached."""
+    import citus_tpu.net.data_plane as dp
+    a = ct.Cluster(str(tmp_path / "solo"), n_nodes=2)
+    rd = dp.DataPlaneClient(a.catalog)
+    assert rd.invalidation_fresh is None   # never wired -> no elision
+    a.close()

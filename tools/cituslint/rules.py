@@ -268,6 +268,26 @@ CONFINED_CALLS = {
     # codec halves, never ad-hoc
     "citus_tpu.net.data_plane.encode_hash_partials":
         ("executor/worker_tasks.py", "net/data_plane.py"),
+    # placement-mutating operations have exactly five doors: the
+    # rebalancer, the autopilot's actuator, the SQL command surface,
+    # tenant isolation's split+move composition, and the background-job
+    # runner registration.  A bare move/split launched from query-path
+    # code would race the group-write-lock + catalog-flip discipline
+    # those doors ride (and dodge the operation registry the autopilot
+    # uses for exactly-once).  Both dotted forms are pinned because the
+    # package __init__ re-exports move_shard_placement.
+    "citus_tpu.operations.shard_transfer.move_shard_placement": (
+        "operations/rebalancer.py", "services/autopilot.py",
+        "commands/utility.py", "workload/isolation.py", "cluster.py"),
+    "citus_tpu.operations.move_shard_placement": (
+        "operations/rebalancer.py", "services/autopilot.py",
+        "commands/utility.py", "workload/isolation.py", "cluster.py"),
+    "citus_tpu.operations.shard_split.split_shard": (
+        "commands/utility.py", "workload/isolation.py"),
+    "citus_tpu.operations.split_shard": (
+        "commands/utility.py", "workload/isolation.py"),
+    "citus_tpu.workload.isolation.isolate_tenant_to_node": (
+        "commands/utility.py",),
 }
 
 #: method name -> in-package files allowed to CALL it (receiver-typed
